@@ -1,0 +1,89 @@
+"""paddle_tpu — a TPU-native deep-learning framework with a Paddle-shaped API.
+
+Capabilities mirror the PaddlePaddle reference (see SURVEY.md); the
+implementation is idiomatic JAX/XLA/Pallas/pjit: ops lower to XLA, autograd is
+jax.vjp-based, distributed training is mesh/sharding-first, kernels that need
+hand-tuning are Pallas.
+"""
+from __future__ import annotations
+
+# Core types
+from .core.tensor import Parameter, Tensor, to_tensor  # noqa: F401
+from .core.autograd import enable_grad, grad  # noqa: F401
+from .core.autograd import no_grad_decorator as _ngd
+
+no_grad = _ngd()  # paddle.no_grad usable as decorator and context manager
+
+# dtypes
+from .framework.dtype import (  # noqa: F401
+    bfloat16, bool_ as bool8, complex64, complex128, float16, float32, float64,
+    get_default_dtype, int8, int16, int32, int64, set_default_dtype, uint8,
+)
+from .framework import dtype as _dtype_mod
+
+dtype = _dtype_mod.DType
+
+# places & device
+from .framework.place import (  # noqa: F401
+    CPUPlace, CUDAPinnedPlace, CUDAPlace, Place, TPUPlace, XPUPlace,
+)
+from .framework.device import (  # noqa: F401
+    device_count, get_device, is_compiled_with_cuda, is_compiled_with_rocm,
+    is_compiled_with_tpu, is_compiled_with_xpu, set_device,
+)
+from .framework.flags import get_flags, set_flags  # noqa: F401
+from .framework.random import get_rng_state, seed, set_rng_state  # noqa: F401
+
+# full functional tensor surface (also patches Tensor methods)
+from .tensor import *  # noqa: F401,F403
+from .tensor import creation as _creation  # noqa: F401
+
+# subpackages (imported lazily below to keep import time low would be nicer,
+# but paddle exposes them eagerly; mirror that)
+from . import amp  # noqa: F401
+from . import autograd  # noqa: F401
+from . import device  # noqa: F401
+from . import distributed  # noqa: F401
+from . import framework  # noqa: F401
+from . import incubate  # noqa: F401
+from . import io  # noqa: F401
+from . import jit  # noqa: F401
+from . import metric  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import profiler  # noqa: F401
+from . import static  # noqa: F401
+from . import vision  # noqa: F401
+
+from .framework.io import load, save  # noqa: F401
+from . import regularizer  # noqa: F401
+from .regularizer import L1Decay, L2Decay  # noqa: F401
+from .hapi.model import Model  # noqa: F401
+from .hapi import summary  # noqa: F401
+
+__version__ = "0.1.0"
+
+
+def is_grad_enabled():
+    from .core.autograd import grad_enabled
+    return grad_enabled()
+
+
+def in_dynamic_mode():
+    from .static.program import in_static_mode
+    return not in_static_mode()
+
+
+def enable_static():
+    from .static.program import _enable_static
+    _enable_static()
+
+
+def disable_static():
+    from .static.program import _disable_static
+    _disable_static()
+
+
+def synchronize():
+    from .framework.device import synchronize as _sync
+    _sync()

@@ -1,0 +1,104 @@
+"""Automatic mixed precision.
+
+Reference: /root/reference/python/paddle/amp/auto_cast.py:668 (O1 allowlist
+autocast / O2 pure-half with master weights). TPU-native stance: bfloat16 is
+the native half dtype (no loss scaling needed); fp16 is accepted for parity.
+O1 is implemented at the dispatch layer: ops on the allowlist cast their
+floating inputs to the amp dtype before execution.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+from ..framework import dtype as dtype_mod
+
+_state = threading.local()
+
+# Allowlist mirrors the reference's fp16 white list (matmul/conv class ops,
+# /root/reference/python/paddle/amp/auto_cast.py:141-152)
+WHITE_LIST = {
+    "matmul", "linear", "conv1d", "conv2d", "conv3d", "conv1d_transpose",
+    "conv2d_transpose", "conv3d_transpose", "einsum", "mm", "bmm", "mv",
+    "scaled_dot_product_attention", "addmm",
+}
+# Blacklist ops stay in fp32 (numerically sensitive)
+BLACK_LIST = {
+    "exp", "square", "log", "mean", "sum", "cos_sim", "softmax",
+    "softmax_with_cross_entropy", "sigmoid_cross_entropy_with_logits",
+    "cross_entropy", "c_softmax_with_cross_entropy", "layer_norm", "erf",
+    "logsumexp", "log_softmax", "batch_norm", "group_norm", "instance_norm",
+}
+
+
+def amp_state():
+    return getattr(_state, "amp", None)
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    prev = amp_state()
+    if enable:
+        white = set(WHITE_LIST)
+        black = set(BLACK_LIST)
+        if custom_white_list:
+            white |= set(custom_white_list)
+            black -= set(custom_white_list)
+        if custom_black_list:
+            black |= set(custom_black_list)
+            white -= set(custom_black_list)
+        _state.amp = {
+            "level": level,
+            "dtype": dtype_mod.convert_dtype(dtype),
+            "white": white,
+            "black": black,
+        }
+    else:
+        _state.amp = None
+    try:
+        yield
+    finally:
+        _state.amp = prev
+
+
+amp_guard = auto_cast
+
+
+def maybe_autocast_args(op_name, arrays):
+    """Called by dispatch: cast float inputs per AMP state. O1 = allowlist;
+    O2 = everything except blacklist."""
+    st = amp_state()
+    if st is None:
+        return arrays
+    name = op_name.split("/")[-1]
+    target = st["dtype"].np_dtype
+    if name in st["black"]:
+        cast_to = jnp.float32
+    elif name in st["white"] or st["level"] == "O2":
+        cast_to = target
+    else:
+        return arrays
+    out = []
+    for a in arrays:
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating) and \
+                a.dtype != jnp.float64:
+            out.append(a.astype(cast_to) if a.dtype != cast_to else a)
+        else:
+            out.append(a)
+    return out
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2 decoration: cast model params to the amp dtype (master weights live
+    in the optimizer's f32 moments — Adam here always keeps f32 state)."""
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    for m in model_list:
+        m.astype(dtype)
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers

@@ -1,0 +1,7 @@
+"""paddle.autograd equivalent (reference: /root/reference/python/paddle/autograd/)."""
+from ..core.autograd import backward, grad, no_grad, enable_grad  # noqa: F401
+from .py_layer import PyLayer, PyLayerContext  # noqa: F401
+from .functional import jacobian, hessian, vjp, jvp  # noqa: F401
+
+is_grad_enabled = None
+from ..core.autograd import grad_enabled as is_grad_enabled  # noqa: F401,E402

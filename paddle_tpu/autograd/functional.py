@@ -1,0 +1,80 @@
+"""Functional autodiff transforms over Tensor functions (paddle.incubate.autograd
+surface; reference python/paddle/incubate/autograd/functional.py). These wrap
+jax transforms directly — the TPU-native win: jacobian/hessian/jvp/vjp are
+native XLA programs, not op-by-op replays.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core.dispatch import unwrap
+from ..core.tensor import Tensor
+
+
+def _fn_on_arrays(func):
+    def f(*arrays):
+        t_args = [Tensor(a, stop_gradient=False) for a in arrays]
+        out = func(*t_args)
+        if isinstance(out, (tuple, list)):
+            return tuple(unwrap(o) for o in out)
+        return unwrap(out)
+    return f
+
+
+def _wrap_tree(tree):
+    return jax.tree_util.tree_map(lambda a: Tensor(a), tree)
+
+
+def vjp(func, xs, v=None):
+    xs_l = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrays = [unwrap(x) for x in xs_l]
+    out, vjp_fn = jax.vjp(_fn_on_arrays(func), *arrays)
+    if v is None:
+        import jax.numpy as jnp
+        v_arr = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        v_arr = jax.tree_util.tree_map(unwrap, v) if isinstance(v, (list, tuple)) \
+            else unwrap(v)
+    grads = vjp_fn(v_arr)
+    return _wrap_tree(out), list(_wrap_tree(grads))
+
+
+def jvp(func, xs, v=None):
+    xs_l = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrays = [unwrap(x) for x in xs_l]
+    if v is None:
+        import jax.numpy as jnp
+        tangents = tuple(jnp.ones_like(a) for a in arrays)
+    else:
+        v_l = v if isinstance(v, (list, tuple)) else [v]
+        tangents = tuple(unwrap(t) for t in v_l)
+    out, jv = jax.jvp(_fn_on_arrays(func), tuple(arrays), tangents)
+    return _wrap_tree(out), _wrap_tree(jv)
+
+
+def jacobian(func, xs, create_graph=False, allow_unused=False):
+    xs_l = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrays = [unwrap(x) for x in xs_l]
+    jac = jax.jacrev(_fn_on_arrays(func), argnums=tuple(range(len(arrays))))(*arrays)
+    jac = _wrap_tree(jac)
+    if not isinstance(xs, (list, tuple)):
+        return jac[0] if isinstance(jac, (tuple, list)) else jac
+    return jac
+
+
+def hessian(func, xs, create_graph=False, allow_unused=False):
+    xs_l = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrays = [unwrap(x) for x in xs_l]
+    hes = jax.hessian(_fn_on_arrays(func), argnums=tuple(range(len(arrays))))(*arrays)
+    hes = _wrap_tree(hes)
+    if not isinstance(xs, (list, tuple)):
+        h = hes
+        while isinstance(h, (tuple, list)):
+            h = h[0]
+        return h
+    return hes
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    from ..core.autograd import backward as _backward
+    return _backward(tensors, grad_tensors, retain_graph)

@@ -1,0 +1,89 @@
+"""Custom autograd ops — paddle.autograd.PyLayer.
+
+Reference: /root/reference/python/paddle/autograd/py_layer.py. A user defines
+static ``forward``/``backward``; forward runs eagerly, and a GradNode is
+recorded whose pullback calls the user's ``backward``.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from ..core import autograd
+from ..core.autograd import GradNode
+from ..core.tensor import Tensor
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.extra = {}
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensors_in = [a for a in args if isinstance(a, Tensor)]
+        record = autograd.grad_enabled() and any(
+            not t.stop_gradient for t in tensors_in
+        )
+        with autograd.no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(out, (tuple, list))
+        out_list = list(out) if multi else [out]
+
+        if record:
+            def vjp_fn(cots):
+                if not isinstance(cots, tuple):
+                    cots = (cots,)
+                cot_tensors = [
+                    Tensor(c, stop_gradient=True) if c is not None else None
+                    for c in cots
+                ]
+                with autograd.no_grad():
+                    grads = cls.backward(ctx, *cot_tensors)
+                if not isinstance(grads, (tuple, list)):
+                    grads = (grads,)
+                out_cots = []
+                gi = 0
+                for a in tensors_in:
+                    g = grads[gi] if gi < len(grads) else None
+                    gi += 1
+                    out_cots.append(g._data if isinstance(g, Tensor) else g)
+                return tuple(out_cots)
+
+            node = GradNode(
+                vjp_fn, tensors_in, n_outputs=len(out_list), name=cls.__name__,
+                out_templates=[(tuple(t.shape), t._data.dtype) for t in out_list],
+            )
+            for i, t in enumerate(out_list):
+                t.stop_gradient = False
+                t._grad_node = node
+                t._output_index = i
+                t.is_leaf = False
+        return out if multi else out_list[0]
+
+
+LegacyPyLayer = PyLayer

@@ -1,0 +1,4 @@
+from . import autograd, dispatch  # noqa: F401
+from .autograd import enable_grad, grad_enabled, no_grad  # noqa: F401
+from .dispatch import apply_op, unwrap, wrap  # noqa: F401
+from .tensor import Parameter, Tensor, to_tensor  # noqa: F401

@@ -1,0 +1,326 @@
+"""Eager autograd engine.
+
+The reference builds an eager grad graph of ``GradNodeBase`` nodes
+(/root/reference/paddle/fluid/eager/grad_node_info.h:168) and runs a
+topological queue walk in ``egr::Backward``
+(/root/reference/paddle/fluid/eager/backward.cc:380,104). This module is the
+TPU-native equivalent: every differentiable op call records a GradNode holding
+the ``jax.vjp`` pullback (residuals live on device as jax arrays); backward is
+the same in-degree topological walk, with each pullback executing eagerly as
+cached XLA ops.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from collections import defaultdict, deque
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+_state = threading.local()
+
+
+def grad_enabled() -> bool:
+    return getattr(_state, "grad_enabled", True)
+
+
+def _set_grad_enabled(v: bool):
+    _state.grad_enabled = v
+
+
+@contextlib.contextmanager
+def no_grad():
+    prev = grad_enabled()
+    _set_grad_enabled(False)
+    try:
+        yield
+    finally:
+        _set_grad_enabled(prev)
+
+
+@contextlib.contextmanager
+def enable_grad():
+    prev = grad_enabled()
+    _set_grad_enabled(True)
+    try:
+        yield
+    finally:
+        _set_grad_enabled(prev)
+
+
+class no_grad_decorator:
+    """paddle.no_grad works both as context manager and decorator."""
+
+    def __call__(self, func):
+        import functools
+
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return func(*args, **kwargs)
+
+        return wrapper
+
+    def __enter__(self):
+        self._ctx = no_grad()
+        return self._ctx.__enter__()
+
+    def __exit__(self, *exc):
+        return self._ctx.__exit__(*exc)
+
+
+class InputRef:
+    """Edge snapshot taken at record time.
+
+    In-place ops rebind ``tensor._grad_node`` after recording (math._inplace),
+    so edges must be resolved when the node is CREATED, not when backward
+    runs — otherwise an in-place op's node points at itself (the reference
+    avoids this with TensorWrapper snapshots,
+    /root/reference/paddle/fluid/eager/tensor_wrapper.h).
+    """
+
+    __slots__ = ("tensor", "node", "output_index")
+
+    def __init__(self, tensor):
+        self.tensor = tensor
+        self.node = tensor._grad_node
+        self.output_index = tensor._output_index
+
+
+class GradNode:
+    """One recorded differentiable op."""
+
+    __slots__ = (
+        "vjp_fn", "input_refs", "n_outputs", "name", "_hooks",
+        "out_templates", "__weakref__",
+    )
+
+    def __init__(self, vjp_fn, inputs, n_outputs: int, name: str = "op",
+                 out_templates=None):
+        self.vjp_fn = vjp_fn
+        self.input_refs = [InputRef(t) for t in inputs]
+        self.n_outputs = n_outputs
+        self.name = name
+        self._hooks = None
+        # (shape, dtype) per output — used to build zero cotangents for
+        # outputs never consumed downstream.
+        self.out_templates = out_templates or []
+
+    def next_nodes(self):
+        return [r.node for r in self.input_refs if r.node is not None]
+
+    def release(self):
+        self.vjp_fn = None
+        self.input_refs = []
+
+
+def _is_float0(x) -> bool:
+    return getattr(x, "dtype", None) == jax.dtypes.float0
+
+
+def _accum(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a + b
+
+
+def backward(tensors, grad_tensors=None, retain_graph: bool = False,
+             accumulate_only=None):
+    """Run reverse accumulation from ``tensors`` (paddle.autograd.backward).
+
+    Mirrors RunBackward (/root/reference/paddle/fluid/eager/backward.cc:104):
+    build the in-degree map over reachable grad nodes, then process a ready
+    queue, accumulating output cotangents per node until all its consumers
+    ran. Leaf tensors with ``stop_gradient=False`` receive ``.grad``.
+
+    ``accumulate_only``: optional set of tensor ids — when given, only those
+    leaves receive ``.grad`` (used by paddle.grad so unrelated parameters'
+    ``.grad`` is never touched).
+    """
+    from .tensor import Tensor
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+
+    # Seed cotangents.
+    node_cots = {}  # id(node) -> list of cotangents per output index
+    roots = []
+    for t, g in zip(tensors, grad_tensors):
+        node = t._grad_node
+        if node is None:
+            continue
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {t.shape}"
+                )
+            gval = jax.numpy.ones_like(t._data)
+        else:
+            gval = g._data if isinstance(g, Tensor) else jax.numpy.asarray(g)
+        nid = id(node)
+        if nid not in node_cots:
+            node_cots[nid] = [None] * node.n_outputs
+            roots.append(node)
+        node_cots[nid][t._output_index] = _accum(
+            node_cots[nid][t._output_index], gval
+        )
+
+    # Build in-degree over the reachable graph (number of consumer nodes that
+    # will feed cotangents into each node).
+    indeg = defaultdict(int)
+    seen = set()
+    stack = list(roots)
+    while stack:
+        node = stack.pop()
+        nid = id(node)
+        if nid in seen:
+            continue
+        seen.add(nid)
+        for nxt in node.next_nodes():
+            indeg[id(nxt)] += 1
+            stack.append(nxt)
+
+    ready = deque(n for n in roots if indeg[id(n)] == 0)
+    processed = set()
+    while ready:
+        node = ready.popleft()
+        nid = id(node)
+        if nid in processed:
+            continue
+        processed.add(nid)
+        cots = node_cots.pop(nid, None)
+        if node.vjp_fn is None:
+            if cots is not None:
+                raise RuntimeError(
+                    "Trying to backward through the graph a second time; "
+                    "specify retain_graph=True on the first backward."
+                )
+            continue
+        if cots is None:
+            # Reachable node that never received a cotangent (its outputs
+            # feed only non-differentiable paths): propagate topologically
+            # without computing, so downstream in-degrees still drain.
+            in_cots = [None] * len(node.input_refs)
+        else:
+            in_cots = _call_vjp(node, cots)
+            if node._hooks:
+                for hook in node._hooks:
+                    in_cots = hook(in_cots)
+        refs = list(node.input_refs)
+        for ref, c in zip(refs, in_cots):
+            usable = c is not None and not _is_float0(c)
+            t = ref.tensor
+            nxt = ref.node
+            if usable and nxt is not None:
+                xid = id(nxt)
+                if xid not in node_cots:
+                    node_cots[xid] = [None] * nxt.n_outputs
+                node_cots[xid][ref.output_index] = _accum(
+                    node_cots[xid][ref.output_index], c
+                )
+            if usable and nxt is None and not t.stop_gradient:
+                if accumulate_only is None or id(t) in accumulate_only:
+                    _accumulate_leaf_grad(t, c)
+            if nxt is not None:
+                # ALWAYS drain the edge, even for None/float0 cotangents —
+                # otherwise nodes with a non-diff consumer never fire.
+                xid = id(nxt)
+                indeg[xid] -= 1
+                if indeg[xid] <= 0:
+                    ready.append(nxt)
+        if not retain_graph:
+            node.release()
+
+
+def _call_vjp(node, cots):
+    """Invoke the stored pullback, substituting zeros for unused outputs."""
+    filled = []
+    for i, c in enumerate(cots):
+        if c is None:
+            shape, dtype = node.out_templates[i]
+            if jax.numpy.issubdtype(dtype, jax.numpy.inexact):
+                c = jax.numpy.zeros(shape, dtype)
+            else:
+                # Integer/bool outputs take float0 cotangents in jax.
+                c = np.zeros(shape, jax.dtypes.float0)
+        filled.append(c)
+    if node.n_outputs == 1:
+        return node.vjp_fn(filled[0])
+    return node.vjp_fn(tuple(filled))
+
+
+def _accumulate_leaf_grad(t, cot):
+    from .tensor import Tensor
+
+    cot = jax.numpy.asarray(cot)
+    if cot.dtype != t._data.dtype and hasattr(cot, "astype"):
+        cot = cot.astype(t._data.dtype)
+    if t._grad_hooks:
+        for h in t._grad_hooks:
+            out = h(Tensor(cot, stop_gradient=True))
+            if out is not None:
+                cot = out._data if isinstance(out, Tensor) else out
+    if t.grad is None:
+        t.grad = Tensor(cot, stop_gradient=True)
+        t.grad.name = (t.name or "tensor") + "@GRAD"
+    else:
+        t.grad._data = t.grad._data + cot
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=None,
+    create_graph=False,
+    only_inputs=True,
+    allow_unused=False,
+    no_grad_vars=None,
+):
+    """paddle.grad: compute grads of outputs w.r.t. inputs without touching
+    ``.grad`` on unrelated leaves (reference GeneralGrad,
+    /root/reference/paddle/fluid/eager/general_grad.h)."""
+    from .tensor import Tensor
+
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (higher-order eager grad) is not supported yet; "
+            "use paddle_tpu.incubate.autograd or jax.grad composition."
+        )
+    saved = [t.grad for t in inputs]
+    saved_stop = [t.stop_gradient for t in inputs]
+    for t in inputs:
+        t.grad = None
+        t.stop_gradient = False
+    try:
+        backward(outputs, grad_tensors=grad_outputs,
+                 retain_graph=bool(retain_graph),
+                 accumulate_only={id(t) for t in inputs})
+        results = []
+        for t in inputs:
+            g = t.grad
+            if g is None and not allow_unused:
+                raise RuntimeError(
+                    "One of the differentiated tensors appears to not have "
+                    "been used in the graph. Set allow_unused=True if this "
+                    "is desired."
+                )
+            results.append(g)
+        return results
+    finally:
+        for t, s, ss in zip(inputs, saved, saved_stop):
+            t.grad = s
+            t.stop_gradient = ss

@@ -1,0 +1,134 @@
+"""Eager op dispatch.
+
+The reference's eager hot path is ``*_ad_func → PHI api → KernelFactory →
+kernel`` (/root/reference/paddle/fluid/eager/auto_code_generator/generator/
+eager_gen.py; SURVEY §3.1). The TPU-native equivalent collapses that chain:
+an op is a pure jax function; dispatch (a) unwraps Tensor args to jax arrays,
+(b) if autograd is recording, runs the op under ``jax.vjp`` so the pullback +
+residuals become the GradNode, (c) wraps outputs. Kernel selection, data
+transform, and infermeta are all subsumed by XLA (shape/dtype inference is
+jax abstract eval; fusion happens at jit time).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.flags import flag_value
+from . import autograd
+from .autograd import GradNode
+from .tensor import Tensor
+
+
+def unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def wrap(arr, stop_gradient=True) -> Tensor:
+    t = Tensor(arr, stop_gradient=stop_gradient)
+    return t
+
+
+def _check_nan_inf(name, arrays):
+    for a in arrays:
+        if jnp.issubdtype(a.dtype, jnp.inexact):
+            bad = bool(jnp.any(~jnp.isfinite(a)))
+            if bad:
+                raise FloatingPointError(
+                    f"Operator {name} output contains NaN or Inf "
+                    f"(FLAGS_check_nan_inf is set)."
+                )
+
+
+def apply_op(name: str, jax_fn: Callable, *args, _outputs_stop_grad=None,
+             **static_kwargs) -> Any:
+    """Run ``jax_fn`` over mixed Tensor / python args, recording autograd.
+
+    ``static_kwargs`` are compile-time constants. Tensor positional args are
+    the differentiable inputs. Returns Tensor or tuple of Tensors mirroring
+    ``jax_fn``'s output structure.
+    """
+    tensor_pos = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    tensors = [args[i] for i in tensor_pos]
+    arrays = [t._data for t in tensors]
+
+    # AMP O1/O2 autocast at the dispatch boundary (analog of the generated
+    # eager_amp_auto_cast.h hooks in the reference).
+    from ..amp.auto_cast import amp_state, maybe_autocast_args
+    if amp_state() is not None:
+        arrays = maybe_autocast_args(name, arrays)
+
+    def f(*arrs):
+        full = list(args)
+        for p, a in zip(tensor_pos, arrs):
+            full[p] = a
+        return jax_fn(*full, **static_kwargs)
+
+    # Static-graph mode: execute with placeholder values for shape flow AND
+    # record the op into the current Program for compiled replay
+    # (the Block.append_op analog; see paddle_tpu/static/program.py).
+    from ..static import program as static_program
+    if static_program.in_static_mode():
+        out = f(*arrays)
+        multi_s = isinstance(out, (tuple, list))
+        out_leaves_s = list(out) if multi_s else [out]
+        wrapped_s = [Tensor(o, stop_gradient=True) for o in out_leaves_s]
+        static_program.default_main_program().record(
+            name, f, tensors, wrapped_s)
+        return tuple(wrapped_s) if multi_s else wrapped_s[0]
+
+    record = autograd.grad_enabled() and any(
+        not t.stop_gradient for t in tensors
+    )
+
+    if record:
+        out, vjp_fn = jax.vjp(f, *arrays)
+    else:
+        out = f(*arrays)
+        vjp_fn = None
+
+    multi = isinstance(out, (tuple, list))
+    out_leaves = list(out) if multi else [out]
+
+    if flag_value("FLAGS_check_nan_inf"):
+        _check_nan_inf(name, [o for o in out_leaves if isinstance(o, jax.Array)])
+
+    if record:
+        node = GradNode(
+            vjp_fn, tensors, n_outputs=len(out_leaves), name=name,
+            out_templates=[(o.shape, o.dtype) for o in out_leaves],
+        )
+        wrapped = []
+        for i, o in enumerate(out_leaves):
+            sg = False
+            if _outputs_stop_grad is not None and _outputs_stop_grad[i]:
+                sg = True
+            t = Tensor(o, stop_gradient=sg)
+            t._grad_node = node
+            t._output_index = i
+            t.is_leaf = False
+            wrapped.append(t)
+    else:
+        wrapped = [Tensor(o, stop_gradient=True) for o in out_leaves]
+
+    if multi:
+        return tuple(wrapped)
+    return wrapped[0]
+
+
+def defop(name: str, jax_fn: Callable):
+    """Build a paddle-shaped op function from a jax function.
+
+    The produced function accepts Tensors/arrays/python scalars positionally
+    plus keyword attrs, and ignores the trailing ``name=`` kwarg paddle APIs
+    carry.
+    """
+
+    def op(*args, name=None, **kwargs):  # noqa: A002 - paddle API shape
+        return apply_op(name or jax_fn.__name__, jax_fn, *args, **kwargs)
+
+    op.__name__ = name
+    return op

@@ -1,0 +1,276 @@
+"""The framework Tensor: a paddle-shaped handle over a jax.Array.
+
+The reference's eager Tensor is ``paddle::Tensor``
+(/root/reference/paddle/phi/api/include/tensor.h:86) with autograd metadata
+(``AutogradMeta``, /root/reference/paddle/fluid/eager/autograd_meta.h:61)
+attached by the eager runtime, and Python methods patched on in
+/root/reference/paddle/fluid/pybind/eager_method.cc. Here the storage is a
+jax.Array (device-resident, async), autograd metadata is a GradNode reference,
+and the rich method surface is patched on by paddle_tpu.tensor at import time
+— same layering, XLA-native storage.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtype_mod
+from ..framework.device import current_jax_device
+from ..framework.place import CPUPlace, Place, TPUPlace
+from . import autograd
+
+_tensor_counter = [0]
+
+
+def _auto_name(prefix="generated_tensor"):
+    _tensor_counter[0] += 1
+    return f"{prefix}_{_tensor_counter[0]}"
+
+
+class Tensor:
+    __slots__ = (
+        "_data", "stop_gradient", "grad", "_grad_node", "_output_index",
+        "name", "persistable", "is_leaf", "_grad_hooks", "trainable",
+        "__weakref__", "__dict__",
+    )
+
+    def __init__(self, data, dtype=None, place: Optional[Place] = None,
+                 stop_gradient: bool = True, name: Optional[str] = None):
+        if isinstance(data, Tensor):
+            data = data._data
+        jdt = dtype_mod.to_jax_dtype(dtype)
+        if isinstance(data, jax.Array):
+            if jdt is not None and data.dtype != jdt:
+                data = data.astype(jdt)
+            self._data = data
+        else:
+            arr = np.asarray(data)
+            if jdt is None and arr.dtype == np.float64:
+                # paddle default: python floats / float64 numpy become the
+                # default float dtype unless explicitly requested
+                if not isinstance(data, np.ndarray) or arr.dtype != np.float64:
+                    jdt = dtype_mod.to_jax_dtype(dtype_mod.get_default_dtype())
+            dev = place.jax_device() if place is not None else current_jax_device()
+            self._data = jax.device_put(
+                arr.astype(jdt) if jdt is not None else arr, dev
+            )
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._grad_node = None
+        self._output_index = 0
+        self.name = name or _auto_name()
+        self.persistable = False
+        self.is_leaf = True
+        self.trainable = True
+        self._grad_hooks = None
+
+    # ---------------- basic properties ----------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    dim = ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def dtype(self) -> dtype_mod.DType:
+        return dtype_mod.convert_dtype(self._data.dtype)
+
+    @property
+    def place(self) -> Place:
+        try:
+            dev = list(self._data.devices())[0]
+        except Exception:
+            return CPUPlace()
+        if dev.platform.lower() == "cpu":
+            return CPUPlace()
+        return TPUPlace(dev.id)
+
+    @property
+    def T(self):
+        from ..tensor import linalg
+        return linalg.transpose_last2(self) if self.ndim >= 2 else self
+
+    def numel(self):
+        return self.size
+
+    # ---------------- conversion ----------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError(
+                "The truth value of a Tensor with more than one element is "
+                "ambiguous."
+            )
+        return bool(self.item())
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-D tensor")
+        return self._data.shape[0]
+
+    def __repr__(self):
+        grad_s = "" if self.stop_gradient else ", stop_gradient=False"
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+            f"place={self.place}{grad_s},\n       {np.asarray(self._data)})"
+        )
+
+    # ---------------- autograd ----------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        autograd.backward([self], [grad_tensor] if grad_tensor is not None else None,
+                          retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._data, stop_gradient=True, name=self.name + ".detach")
+        return t
+
+    def detach_(self):
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        from ..core.dispatch import apply_op
+        return apply_op("clone", lambda x: x + 0, self)
+
+    def register_hook(self, hook):
+        """Hook on this tensor's accumulated leaf gradient."""
+        if self._grad_hooks is None:
+            self._grad_hooks = []
+        self._grad_hooks.append(hook)
+
+        class _Handle:
+            def remove(handle_self):
+                try:
+                    self._grad_hooks.remove(hook)
+                except ValueError:
+                    pass
+
+        return _Handle()
+
+    @property
+    def gradient(self):
+        return None if self.grad is None else self.grad.numpy()
+
+    # ---------------- placement ----------------
+    def cpu(self):
+        return Tensor(jax.device_put(self._data, jax.devices("cpu")[0]),
+                      stop_gradient=self.stop_gradient)
+
+    def to(self, *args, **kwargs):
+        device = kwargs.get("device")
+        dtype = kwargs.get("dtype")
+        for a in args:
+            if isinstance(a, (str, Place)):
+                device = a
+            else:
+                dtype = a
+        out = self
+        if dtype is not None:
+            out = out.astype(dtype)
+        if device is not None:
+            if isinstance(device, str):
+                from ..framework.device import set_device, device_guard
+                with device_guard(device):
+                    dev = current_jax_device()
+            else:
+                dev = device.jax_device()
+            out = Tensor(jax.device_put(out._data, dev),
+                         stop_gradient=out.stop_gradient)
+        return out
+
+    def pin_memory(self):
+        return self
+
+    def cuda(self, *a, **k):  # compat: lands on the accelerator
+        return self.to("tpu")
+
+    def tpu(self, *a, **k):
+        return self.to("tpu")
+
+    # ---------------- mutation ----------------
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._data
+        arr = jnp.asarray(value, dtype=self._data.dtype)
+        if tuple(arr.shape) != tuple(self._data.shape):
+            arr = arr.reshape(self._data.shape)
+        self._data = jax.device_put(arr, list(self._data.devices())[0])
+        return self
+
+    def copy_(self, other, *args):
+        return self.set_value(other)
+
+    def fill_(self, value):
+        self._data = jnp.full_like(self._data, value)
+        return self
+
+    def zero_(self):
+        return self.fill_(0)
+
+    def _bump_version(self):
+        pass
+
+    # block_until_ready passthrough for benchmarking
+    def block_until_ready(self):
+        jax.block_until_ready(self._data)
+        return self
+
+    # value semantics helpers used by optimizers (functional update)
+    def _replace_data(self, new_data):
+        self._data = new_data
+        return self
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor equivalent."""
+    if isinstance(data, Tensor) and dtype is None and place is None:
+        t = Tensor(data._data, stop_gradient=stop_gradient)
+        return t
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+
+
+class Parameter(Tensor):
+    """A trainable leaf tensor (reference: paddle.fluid.framework.Parameter)."""
+
+    def __init__(self, data, dtype=None, name=None, trainable=True):
+        super().__init__(data, dtype=dtype, name=name or _auto_name("param"),
+                         stop_gradient=not trainable)
+        self.persistable = True
+        self.trainable = trainable

@@ -1,0 +1,117 @@
+"""paddle.device equivalent (+ cuda-compat namespace that lands on TPU)."""
+import types as _types
+
+from ..framework.device import (  # noqa: F401
+    device_count, device_guard, get_device, is_compiled_with_cuda,
+    is_compiled_with_rocm, is_compiled_with_tpu, is_compiled_with_xpu,
+    set_device, synchronize,
+)
+from ..framework.place import CPUPlace, CUDAPlace, Place, TPUPlace  # noqa: F401
+
+
+def get_all_device_type():
+    return ["cpu", "tpu"]
+
+
+def get_available_device():
+    import jax
+    out = ["cpu"]
+    if any(d.platform != "cpu" for d in jax.devices()):
+        out.append("tpu")
+    return out
+
+
+class Stream:
+    """Compat stream object. XLA manages its own streams; operations are
+    ordered by data dependence, so these are no-ops that preserve the API."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+    def wait_event(self, event):
+        pass
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+def current_stream(device=None):
+    return Stream(device)
+
+
+def set_stream(stream):
+    return stream
+
+
+def stream_guard(stream):
+    import contextlib
+
+    @contextlib.contextmanager
+    def _guard():
+        yield
+    return _guard()
+
+
+def _mem_stats():
+    import jax
+    try:
+        dev = jax.devices()[0]
+        stats = dev.memory_stats() or {}
+        return stats
+    except Exception:
+        return {}
+
+
+def max_memory_allocated(device=None):
+    return _mem_stats().get("peak_bytes_in_use", 0)
+
+
+def max_memory_reserved(device=None):
+    return _mem_stats().get("peak_bytes_in_use", 0)
+
+
+def memory_allocated(device=None):
+    return _mem_stats().get("bytes_in_use", 0)
+
+
+def memory_reserved(device=None):
+    return _mem_stats().get("bytes_limit", 0)
+
+
+def empty_cache():
+    pass
+
+
+cuda = _types.SimpleNamespace(
+    Stream=Stream, Event=Event, current_stream=current_stream,
+    stream_guard=stream_guard, synchronize=synchronize,
+    device_count=lambda: device_count("tpu"),
+    max_memory_allocated=max_memory_allocated,
+    max_memory_reserved=max_memory_reserved,
+    memory_allocated=memory_allocated, memory_reserved=memory_reserved,
+    empty_cache=empty_cache,
+    get_device_properties=lambda *a: _types.SimpleNamespace(
+        name="TPU", total_memory=_mem_stats().get("bytes_limit", 0)),
+)
+
+tpu = cuda

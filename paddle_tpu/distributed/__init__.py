@@ -1,0 +1,50 @@
+"""paddle.distributed equivalent — mesh-first distributed layer."""
+from . import fleet  # noqa: F401
+from . import auto_parallel as auto  # noqa: F401
+from .communication import *  # noqa: F401,F403
+from .communication.collective import ReduceOp  # noqa: F401
+from .env import (  # noqa: F401
+    ParallelEnv, get_rank, get_world_size, init_parallel_env, is_initialized,
+)
+from .group import Group, destroy_process_group, get_group, new_group  # noqa: F401
+from .mesh_utils import (  # noqa: F401
+    build_mesh, get_global_mesh, set_global_mesh, shard_tensor_data,
+    with_constraint,
+)
+from .parallel import DataParallel  # noqa: F401
+from .auto_parallel.interface import ProcessMesh, shard_op, shard_tensor  # noqa: F401
+
+import types as _types
+from .fleet.meta_parallel.sharding import (  # noqa: F401
+    group_sharded_parallel, save_group_sharded_model,
+)
+
+sharding = _types.SimpleNamespace(
+    group_sharded_parallel=group_sharded_parallel,
+    save_group_sharded_model=save_group_sharded_model,
+)
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """paddle.distributed.spawn — multiprocess launch on one host."""
+    import multiprocessing as mp
+    import os
+    n = nprocs if nprocs > 0 else 1
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(n):
+        env_update = {
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(n),
+        }
+
+        def target(r=rank, upd=env_update):
+            os.environ.update(upd)
+            func(*args)
+        p = ctx.Process(target=target, daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+    return procs

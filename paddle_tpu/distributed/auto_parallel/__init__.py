@@ -1,0 +1,3 @@
+from .interface import ProcessMesh, shard_op, shard_tensor  # noqa: F401
+from .engine import Engine  # noqa: F401
+from .strategy import Strategy  # noqa: F401

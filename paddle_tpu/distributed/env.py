@@ -1,0 +1,104 @@
+"""Distributed environment & bootstrap.
+
+Reference contract: ranks discover each other via env vars set by the
+launcher (PaddleCloudRoleMaker,
+/root/reference/python/paddle/distributed/fleet/base/role_maker.py:848-972 —
+PADDLE_TRAINER_ENDPOINTS / PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ID). The
+TPU-native bootstrap keeps those env names and maps them onto
+jax.distributed.initialize (coordination service = the TCPStore analog).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+_initialized = False
+
+
+def _env_int(name, default=0):
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def get_rank(group=None) -> int:
+    if group is not None:
+        return group.get_group_rank(global_rank())
+    return global_rank()
+
+
+def global_rank() -> int:
+    if _initialized:
+        return jax.process_index()
+    return _env_int("PADDLE_TRAINER_ID", 0)
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    if _initialized:
+        return jax.process_count()
+    return _env_int("PADDLE_TRAINERS_NUM", 1)
+
+
+def init_parallel_env():
+    """paddle.distributed.init_parallel_env
+    (reference: python/paddle/distributed/parallel.py:921)."""
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    endpoints = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+    n = _env_int("PADDLE_TRAINERS_NUM", 1)
+    rank = _env_int("PADDLE_TRAINER_ID", 0)
+    if n > 1 and endpoints:
+        coordinator = endpoints.split(",")[0]
+        jax.distributed.initialize(
+            coordinator_address=coordinator, num_processes=n, process_id=rank)
+        _initialized = True
+    return ParallelEnv()
+
+
+class ParallelEnv:
+    @property
+    def rank(self):
+        return global_rank()
+
+    @property
+    def local_rank(self):
+        return _env_int("PADDLE_RANK_IN_NODE", global_rank())
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def dev_id(self):
+        return _env_int("FLAGS_selected_tpus", 0)
+
+    @property
+    def current_endpoint(self):
+        eps = self.trainer_endpoints
+        r = self.rank
+        return eps[r] if r < len(eps) else ""
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else []
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def parallel_device_count() -> int:
+    """Devices visible for sharding (real chips, or virtual CPU devices when
+    XLA_FLAGS=--xla_force_host_platform_device_count is set for testing)."""
+    return len(jax.devices())

@@ -1,0 +1,16 @@
+"""paddle.distributed.fleet equivalent."""
+from . import meta_parallel  # noqa: F401
+from .distributed_strategy import DistributedStrategy  # noqa: F401
+from .fleet_api import (  # noqa: F401
+    barrier_worker, distributed_model, distributed_optimizer,
+    get_hybrid_communicate_group, init, is_first_worker, is_initialized,
+    save_inference_model, save_persistables, worker_index, worker_num,
+)
+from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
+
+PaddleCloudRoleMaker = None
+
+
+class UserDefinedRoleMaker:
+    def __init__(self, *a, **k):
+        pass

@@ -1,0 +1,108 @@
+"""DistributedStrategy — the fleet feature-toggle surface.
+
+Reference: /root/reference/python/paddle/distributed/fleet/base/
+distributed_strategy.py:117 (protobuf-backed, ~40 toggles; SURVEY §2.6 lists
+the property lines). Here it is a plain typed object with the same names;
+toggles that XLA subsumes (fuse_all_reduce_ops, nccl knobs, ...) are accepted
+and recorded but change nothing — documented inert.
+"""
+from __future__ import annotations
+
+import copy
+import json
+
+
+_DEFAULT_CONFIGS = {
+    "amp_configs": {
+        "init_loss_scaling": 32768.0, "incr_every_n_steps": 1000,
+        "decr_every_n_nan_or_inf": 2, "incr_ratio": 2.0, "decr_ratio": 0.5,
+        "use_dynamic_loss_scaling": True, "custom_white_list": [],
+        "custom_black_list": [], "use_pure_fp16": False, "use_bf16": True,
+        "use_fp16_guard": True,
+    },
+    "recompute_configs": {"checkpoints": [], "enable_offload": False,
+                          "checkpoint_shape": []},
+    "pipeline_configs": {"micro_batch_size": 1, "accumulate_steps": 1,
+                         "schedule_mode": "1F1B", "p2p_cache_shape": True},
+    "hybrid_configs": {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+                       "sharding_degree": 1, "sep_degree": 1},
+    "sharding_configs": {"sharding_segment_strategy": "segment_broadcast_MB",
+                         "segment_broadcast_MB": 32, "sharding_degree": 8,
+                         "mp_degree": 1, "stage": 1, "offload": False},
+    "gradient_merge_configs": {"k_steps": 1, "avg": True},
+    "localsgd_configs": {"k_steps": 1, "begin_step": 1},
+    "adaptive_localsgd_configs": {"init_k_steps": 1, "begin_step": 1},
+    "dgc_configs": {"rampup_begin_step": 0, "rampup_step": 1,
+                    "sparsity": [0.999]},
+    "lars_configs": {"lars_coeff": 0.001, "lars_weight_decay": 0.0005,
+                     "epsilon": 0, "exclude_from_weight_decay": []},
+    "lamb_configs": {"lamb_weight_decay": 0.01,
+                     "exclude_from_weight_decay": []},
+    "a_sync_configs": {"k_steps": -1, "max_merge_var_num": 1,
+                       "send_queue_size": 16,
+                       "independent_recv_thread": False},
+    "qat_configs": {"channel_wise_abs_max": True, "weight_bits": 8,
+                    "activation_bits": 8, "not_quant_pattern": []},
+    "tensor_parallel_configs": {"tensor_parallel_degree": 1,
+                                "tensor_init_seed": -1},
+}
+
+_BOOL_TOGGLES = [
+    "amp", "asp", "recompute", "sync_nccl_allreduce",
+    "use_hierarchical_allreduce", "sync_batch_norm", "fuse_all_reduce_ops",
+    "find_unused_parameters", "sharding", "without_graph_optimization",
+    "fuse_grad_merge", "pipeline", "tensor_parallel", "localsgd",
+    "adaptive_localsgd", "dgc", "gradient_merge", "lars", "lamb", "elastic",
+    "auto", "semi_auto", "auto_search", "qat", "heter_ccl_mode", "a_sync",
+    "fp16_allreduce", "fuse_grad_size_in_MB", "last_comm_group_size_MB",
+]
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self.__dict__["_flags"] = {t: False for t in _BOOL_TOGGLES}
+        self.__dict__["_configs"] = copy.deepcopy(_DEFAULT_CONFIGS)
+        self.__dict__["_scalars"] = {
+            "nccl_comm_num": 1,
+            "gradient_scale_configs": {"scale_strategy": "avg"},
+        }
+        # execution/build strategy accepted for compat
+        self.__dict__["execution_strategy"] = None
+        self.__dict__["build_strategy"] = None
+
+    def __getattr__(self, name):
+        flags = self.__dict__["_flags"]
+        configs = self.__dict__["_configs"]
+        scalars = self.__dict__["_scalars"]
+        if name in flags:
+            return flags[name]
+        if name in configs:
+            return configs[name]
+        if name in scalars:
+            return scalars[name]
+        raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        flags = self.__dict__["_flags"]
+        configs = self.__dict__["_configs"]
+        scalars = self.__dict__["_scalars"]
+        if name in flags:
+            flags[name] = bool(value)
+        elif name in configs:
+            merged = dict(configs[name])
+            merged.update(value or {})
+            configs[name] = merged
+        elif name in ("execution_strategy", "build_strategy"):
+            self.__dict__[name] = value
+        else:
+            scalars[name] = value
+
+    def to_json(self):
+        return json.dumps({"flags": self._flags, "configs": self._configs,
+                           "scalars": {k: v for k, v in self._scalars.items()
+                                       if isinstance(v, (int, float, str,
+                                                         list, dict))}})
+
+    def __repr__(self):
+        on = [k for k, v in self._flags.items() if v]
+        return f"DistributedStrategy(enabled={on})"

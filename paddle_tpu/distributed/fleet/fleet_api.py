@@ -1,0 +1,124 @@
+"""fleet.init / distributed_model / distributed_optimizer
+(reference: /root/reference/python/paddle/distributed/fleet/fleet.py:100,168,1060).
+
+TPU-native: fleet.init reads strategy.hybrid_configs and builds the device
+mesh (topology.py); distributed_model attaches sharding metadata (DP batch
+axis, TP layer PartitionSpecs already set by mp_layers); distributed_optimizer
+wraps the optimizer so TrainStep/pjit runs sharded. Single-process eager
+training continues to work unchanged (world_size==1 collectives are identity).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import env
+from .distributed_strategy import DistributedStrategy
+from .topology import CommunicateTopology, HybridCommunicateGroup
+
+_fleet_state = {
+    "initialized": False,
+    "strategy": None,
+    "hcg": None,
+}
+
+
+def init(role_maker=None, is_collective=False, strategy: Optional[DistributedStrategy] = None):
+    strategy = strategy or DistributedStrategy()
+    hc = strategy.hybrid_configs
+    dims = [hc.get("dp_degree", 1), hc.get("pp_degree", 1),
+            hc.get("sharding_degree", 1), hc.get("mp_degree", 1)]
+    names = ["data", "pipe", "sharding", "model"]
+    if hc.get("sep_degree", 1) > 1:
+        dims.insert(3, hc["sep_degree"])
+        names.insert(3, "sep")
+    topo = CommunicateTopology(names, dims)
+    hcg = HybridCommunicateGroup(topo)
+    _fleet_state.update(initialized=True, strategy=strategy, hcg=hcg)
+    env.init_parallel_env()
+    return _FleetAPI
+
+
+def get_hybrid_communicate_group() -> HybridCommunicateGroup:
+    return _fleet_state["hcg"]
+
+
+def is_initialized():
+    return _fleet_state["initialized"]
+
+
+def distributed_model(model):
+    """Wrap for hybrid parallel. DP grads are averaged by the mesh (psum in
+    the compiled step); PP wraps in PipelineParallel when pp_degree>1."""
+    hcg = _fleet_state["hcg"]
+    if hcg is None:
+        return model
+    if hcg.get_pipe_parallel_world_size() > 1:
+        from .meta_parallel.pipeline_parallel import PipelineParallel
+        return PipelineParallel(model, hcg,
+                                _fleet_state["strategy"])
+    model._fleet_hcg = hcg
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    hcg = _fleet_state["hcg"]
+    if hcg is None:
+        return optimizer
+    from .meta_parallel.hybrid_optimizer import HybridParallelOptimizer
+    return HybridParallelOptimizer(optimizer, hcg,
+                                   strategy or _fleet_state["strategy"])
+
+
+def worker_index():
+    return env.global_rank()
+
+
+def worker_num():
+    return env.get_world_size()
+
+
+def is_first_worker():
+    return env.global_rank() == 0
+
+
+def barrier_worker():
+    from ..communication.collective import barrier
+    barrier()
+
+
+def save_persistables(executor=None, dirname=None, main_program=None, **kw):
+    import os
+    import paddle_tpu as P
+    if main_program is not None and hasattr(main_program, "all_parameters"):
+        state = {p.name: p for p in main_program.all_parameters()}
+        P.save(state, os.path.join(dirname, "persistables.pdparams"))
+
+
+def save_inference_model(executor=None, dirname=None, feeded_var_names=None,
+                         target_vars=None, main_program=None, **kw):
+    from ...static.io import save_inference_model as _sim
+    import os
+    return _sim(os.path.join(dirname or ".", "model"), feeded_var_names or [],
+                target_vars or [], executor, program=main_program)
+
+
+class _FleetAPIType:
+    init = staticmethod(init)
+    distributed_model = staticmethod(distributed_model)
+    distributed_optimizer = staticmethod(distributed_optimizer)
+    worker_index = staticmethod(worker_index)
+    worker_num = staticmethod(worker_num)
+    is_first_worker = staticmethod(is_first_worker)
+    barrier_worker = staticmethod(barrier_worker)
+    save_persistables = staticmethod(save_persistables)
+    save_inference_model = staticmethod(save_inference_model)
+    get_hybrid_communicate_group = staticmethod(get_hybrid_communicate_group)
+    is_initialized = staticmethod(is_initialized)
+    DistributedStrategy = DistributedStrategy
+
+    @property
+    def worker_endpoints(self):
+        return env.ParallelEnv().trainer_endpoints
+
+
+_FleetAPI = _FleetAPIType()

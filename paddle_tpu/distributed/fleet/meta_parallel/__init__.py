@@ -1,0 +1,11 @@
+from .hybrid_optimizer import HybridParallelGradScaler, HybridParallelOptimizer  # noqa: F401
+from .mp_layers import (  # noqa: F401
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding, get_rng_state_tracker,
+)
+from .pipeline_parallel import PipelineParallel, PipelineParallelWithInterleave  # noqa: F401
+from .pp_layers import LayerDesc, PipelineLayer, SegmentLayers, SharedLayerDesc  # noqa: F401
+from .sharding import (  # noqa: F401
+    GroupShardedOptimizerStage2, GroupShardedStage2, GroupShardedStage3,
+    group_sharded_parallel, save_group_sharded_model,
+)

@@ -1,0 +1,132 @@
+"""Tensor-parallel (Megatron-style) layers.
+
+Reference: /root/reference/python/paddle/distributed/fleet/layers/mpu/
+mp_layers.py:35,173,343,558 (VocabParallelEmbedding / ColumnParallelLinear /
+RowParallelLinear / ParallelCrossEntropy built from explicit c_ops).
+
+TPU-native mechanism: the layers hold logically-full parameters annotated
+with a PartitionSpec (`param.dist_spec`); under a mesh the pjit/GSPMD
+compiler shards the matmuls and inserts the identity/allreduce collectives
+the reference codes by hand (column: no fwd comm; row: psum fwd). Sharding
+constraints on activations steer XLA to the Megatron pattern. Eager
+single-chip execution is exact (full weights).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....core.dispatch import apply_op
+from ....nn import functional as F
+from ....nn import initializer as I
+from ....nn.initializer_utils import create_parameter_with_attr
+from ....nn.layer.layers import Layer
+from ...mesh_utils import get_global_mesh, with_constraint
+
+
+def _mark(param, *spec):
+    param.dist_spec = tuple(spec)
+    return param
+
+
+class VocabParallelEmbedding(Layer):
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.weight = _mark(create_parameter_with_attr(
+            [num_embeddings, embedding_dim], self._dtype, weight_attr, False,
+            default_initializer=I.XavierNormal()), "mp", None)
+
+    def forward(self, x):
+        out = F.embedding(x, self.weight)
+        return out
+
+
+class ColumnParallelLinear(Layer):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.gather_output = gather_output
+        self.weight = _mark(create_parameter_with_attr(
+            [in_features, out_features], self._dtype, weight_attr, False,
+            default_initializer=I.XavierNormal()), None, "mp")
+        if has_bias or has_bias is None:
+            self.bias = _mark(create_parameter_with_attr(
+                [out_features], self._dtype, None, True,
+                default_initializer=I.Constant(0.0)), "mp")
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if get_global_mesh() is not None:
+            spec = (None,) * (out.ndim - 1)
+            if self.gather_output:
+                out = apply_op("mp_gather",
+                               lambda a: with_constraint(a, *spec, None), out)
+            else:
+                out = apply_op("mp_keep_sharded",
+                               lambda a: with_constraint(a, *spec, "mp"), out)
+        return out
+
+
+class RowParallelLinear(Layer):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.input_is_parallel = input_is_parallel
+        self.weight = _mark(create_parameter_with_attr(
+            [in_features, out_features], self._dtype, weight_attr, False,
+            default_initializer=I.XavierNormal()), "mp", None)
+        if has_bias:
+            self.bias = _mark(create_parameter_with_attr(
+                [out_features], self._dtype, None, True,
+                default_initializer=I.Constant(0.0)), None)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        # contraction over the mp-sharded dim → GSPMD inserts the allreduce
+        out = F.linear(x, self.weight, self.bias)
+        if get_global_mesh() is not None:
+            spec = (None,) * (out.ndim - 1)
+            out = apply_op("mp_allreduce_out",
+                           lambda a: with_constraint(a, *spec, None), out)
+        return out
+
+
+class ParallelCrossEntropy(Layer):
+    """Vocab-parallel softmax CE (reference mp_layers.py:558 →
+    c_softmax_with_cross_entropy). With GSPMD the plain CE over the sharded
+    logits axis compiles to the same pattern."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):  # noqa: A002
+        return F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index)
+
+
+def get_rng_state_tracker():
+    """TP-rank dropout determinism helper (reference:
+    fleet/meta_parallel/parallel_layers/random.py). Keys already derive from
+    the traced base key per step; expose the paddle API."""
+    class _Tracker:
+        def add(self, name, seed):
+            pass
+
+        def rng_state(self, name="global_seed"):
+            import contextlib
+
+            @contextlib.contextmanager
+            def _s():
+                yield
+            return _s()
+
+    return _Tracker()

@@ -1,0 +1,116 @@
+"""PipelineLayer / LayerDesc — pipeline model description.
+
+Reference: /root/reference/python/paddle/distributed/fleet/meta_parallel/
+parallel_layers/pp_layers.py:57,77,209 (LayerDesc / SharedLayerDesc /
+PipelineLayer with segmentation). The description API is preserved; execution
+maps stages onto the "pp" mesh axis via the shard_map microbatch loop in
+pipeline_parallel.py.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ....nn.layer.layers import Layer
+
+
+class LayerDesc:
+    def __init__(self, layer_cls, *inputs, **kwargs):
+        self.layer_cls = layer_cls
+        self.inputs = inputs
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_cls(*self.inputs, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_cls, forward_func=None, shared_weight_attr="weight",
+                 *inputs, **kwargs):
+        super().__init__(layer_cls, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """Split N layers into num_parts (reference pp_layers.py:93)."""
+
+    def __init__(self, layers_desc, num_parts, method="uniform"):
+        self.descs = layers_desc
+        self.num_parts = num_parts
+        self.method = method
+
+    def do_segment(self) -> List[int]:
+        n = len(self.descs)
+        if self.method == "uniform":
+            per = n // self.num_parts
+            extra = n % self.num_parts
+            bounds = [0]
+            for i in range(self.num_parts):
+                bounds.append(bounds[-1] + per + (1 if i < extra else 0))
+            return bounds
+        raise NotImplementedError(f"segment method {self.method}")
+
+
+class PipelineLayer(Layer):
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0,
+                 recompute_ctx=None, num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._layers_desc = list(layers)
+        self._loss_fn = loss_fn
+        self._topo = topology
+        self._num_stages = num_stages or 1
+        self._seg_method = seg_method
+
+        # materialize all layers (single-process holds the full model; stage
+        # assignment becomes a mesh placement concern at compile time)
+        self.segment_bounds = SegmentLayers(
+            self._layers_desc, self._num_stages, seg_method).do_segment()
+        self._shared = {}
+        built = []
+        from .mp_layers import _mark  # noqa: F401
+        for i, item in enumerate(self._layers_desc):
+            if isinstance(item, SharedLayerDesc):
+                if item.layer_name in self._shared:
+                    built.append(("shared", item, self._shared[item.layer_name]))
+                    continue
+                layer = item.build_layer()
+                self._shared[item.layer_name] = layer
+                self.add_sublayer(str(i), layer)
+                built.append(("shared_first", item, layer))
+            elif isinstance(item, LayerDesc):
+                layer = item.build_layer()
+                self.add_sublayer(str(i), layer)
+                built.append(("layer", item, layer))
+            elif isinstance(item, Layer):
+                self.add_sublayer(str(i), item)
+                built.append(("layer", None, item))
+            elif callable(item):
+                built.append(("func", None, item))
+            else:
+                raise TypeError(f"bad pipeline item {item}")
+        self._built = built
+
+    def get_stage_of_layer(self, layer_idx):
+        for s in range(self._num_stages):
+            if self.segment_bounds[s] <= layer_idx < self.segment_bounds[s + 1]:
+                return s
+        return self._num_stages - 1
+
+    def stage_layers(self, stage):
+        lo, hi = self.segment_bounds[stage], self.segment_bounds[stage + 1]
+        return self._built[lo:hi]
+
+    def forward(self, x):
+        out = x
+        for kind, desc, layer in self._built:
+            if kind == "func":
+                out = layer(out)
+            elif kind == "shared" and desc.forward_func is not None:
+                out = desc.forward_func(layer, out)
+            else:
+                out = layer(out)
+        return out

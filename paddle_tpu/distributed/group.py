@@ -1,0 +1,110 @@
+"""Process groups as mesh-axis views.
+
+The reference's ProcessGroup (/root/reference/paddle/fluid/distributed/
+collective/process_group.h:53) manages transport comms per rank list. On TPU
+the transport is XLA over ICI; a "group" is metadata: the ranks it contains
+and (when it corresponds to a mesh axis) the axis name collectives reduce
+over. The Python API surface (new_group, group.process_ids, task.wait())
+is preserved.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from . import env
+
+
+class Task:
+    """Completed-collective handle (ProcessGroup::Task analog). XLA dispatch
+    is async already; wait() is a device sync."""
+
+    def __init__(self, result=None):
+        self._result = result
+
+    def is_completed(self):
+        return True
+
+    def wait(self, timeout=None):
+        if self._result is not None:
+            import jax
+            jax.block_until_ready(self._result)
+        return True
+
+    def synchronize(self):
+        self.wait()
+
+
+class Group:
+    def __init__(self, ranks: List[int], gid: int = 0,
+                 mesh_axis: Optional[str] = None):
+        self.ranks = list(ranks)
+        self.id = gid
+        self.mesh_axis = mesh_axis
+
+    @property
+    def nranks(self):
+        return len(self.ranks)
+
+    @property
+    def world_size(self):
+        return len(self.ranks)
+
+    @property
+    def process_ids(self):
+        return self.ranks
+
+    @property
+    def rank(self):
+        return self.get_group_rank(env.global_rank())
+
+    def get_group_rank(self, rank):
+        try:
+            return self.ranks.index(rank)
+        except ValueError:
+            return -1
+
+    def is_member(self):
+        return env.global_rank() in self.ranks
+
+    def __repr__(self):
+        return f"Group(id={self.id}, ranks={self.ranks}, axis={self.mesh_axis})"
+
+
+_groups = {}
+_next_gid = [1]
+_default_group: Optional[Group] = None
+
+
+def _get_or_create_default() -> Group:
+    global _default_group
+    if _default_group is None:
+        _default_group = Group(list(range(env.get_world_size())), gid=0)
+    return _default_group
+
+
+def get_group(gid: int = 0) -> Group:
+    if gid == 0:
+        return _get_or_create_default()
+    return _groups[gid]
+
+
+def new_group(ranks=None, backend=None, timeout=None,
+              mesh_axis: Optional[str] = None) -> Group:
+    """paddle.distributed.new_group
+    (reference: python/paddle/distributed/collective.py:185)."""
+    if ranks is None:
+        ranks = list(range(env.get_world_size()))
+    gid = _next_gid[0]
+    _next_gid[0] += 1
+    g = Group(sorted(ranks), gid, mesh_axis=mesh_axis)
+    _groups[gid] = g
+    return g
+
+
+def destroy_process_group(group=None):
+    global _default_group
+    if group is None:
+        _groups.clear()
+        _default_group = None
+    else:
+        _groups.pop(group.id, None)

@@ -1,0 +1,72 @@
+"""Global device-mesh management — the spine of the distributed design.
+
+The reference composes dp/mp/pp/sharding process groups from an N-D rank grid
+(HybridCommunicateGroup,
+/root/reference/python/paddle/distributed/fleet/base/topology.py:140). Here
+the same topology IS a jax.sharding.Mesh whose axes are named after the
+paddle axes ("dp", "pp", "sharding", "mp", optionally "sep"); collectives are
+XLA collectives over mesh axes, and "process groups" are views over mesh
+axes (paddle_tpu/distributed/group.py).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+_state = threading.local()
+
+
+def build_mesh(axes: Dict[str, int], devices=None) -> Mesh:
+    """axes: ordered {axis_name: degree}. Degrees must multiply to #devices
+    (axes with degree 1 are kept so PartitionSpecs stay stable)."""
+    devices = devices if devices is not None else jax.devices()
+    names = list(axes.keys())
+    degrees = [int(axes[n]) for n in names]
+    total = int(np.prod(degrees))
+    if total > len(devices):
+        raise ValueError(
+            f"mesh {axes} needs {total} devices, only {len(devices)} visible")
+    dev_array = np.asarray(devices[:total]).reshape(degrees)
+    return Mesh(dev_array, names)
+
+
+def set_global_mesh(mesh: Mesh):
+    _state.mesh = mesh
+
+
+def get_global_mesh() -> Optional[Mesh]:
+    return getattr(_state, "mesh", None)
+
+
+def mesh_axis_size(axis: str) -> int:
+    mesh = get_global_mesh()
+    if mesh is None or axis not in mesh.axis_names:
+        return 1
+    return mesh.shape[axis]
+
+
+def named_sharding(*spec) -> Optional[NamedSharding]:
+    mesh = get_global_mesh()
+    if mesh is None:
+        return None
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def shard_tensor_data(arr, *spec):
+    """Place a jax array with the given PartitionSpec on the global mesh."""
+    sh = named_sharding(*spec)
+    if sh is None:
+        return arr
+    return jax.device_put(arr, sh)
+
+
+def with_constraint(arr, *spec):
+    mesh = get_global_mesh()
+    if mesh is None:
+        return arr
+    return jax.lax.with_sharding_constraint(
+        arr, NamedSharding(mesh, PartitionSpec(*spec)))

@@ -1,0 +1,59 @@
+"""DataParallel wrapper
+(reference: /root/reference/python/paddle/distributed/parallel.py:202 — wraps
+model with the C++ EagerReducer for bucketed fused allreduce overlapped with
+backward, reducer.cc).
+
+TPU-native: DP gradient sync is a mesh reduction inside the compiled step —
+there is no reducer protocol to run. This wrapper preserves the API and marks
+the model for batch-axis sharding ("dp") so the TrainStep/pjit path shards
+inputs and averages grads via psum automatically. Single-process eager
+behavior is identical to bare model.
+"""
+from __future__ import annotations
+
+from ..nn.layer.layers import Layer
+from . import env
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.add_sublayer("_layers", layers)
+        self.find_unused_parameters = find_unused_parameters
+        for p in layers.parameters():
+            if not hasattr(p, "dist_spec"):
+                p.dist_spec = None  # replicated params, dp-sharded batch
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    @property
+    def parameters_(self):
+        return self._layers.parameters()
+
+
+def get_rank(group=None):
+    return env.get_rank(group)
+
+
+def get_world_size(group=None):
+    return env.get_world_size(group)
+
+
+init_parallel_env = env.init_parallel_env
+ParallelEnv = env.ParallelEnv

@@ -1,0 +1,9 @@
+from . import dtype, flags, place, random  # noqa: F401
+from .dtype import (  # noqa: F401
+    DType, convert_dtype, get_default_dtype, set_default_dtype, to_jax_dtype,
+)
+from .flags import get_flags, set_flags  # noqa: F401
+from .place import (  # noqa: F401
+    CPUPlace, CUDAPinnedPlace, CUDAPlace, Place, TPUPlace, XPUPlace,
+)
+from .random import Generator, get_rng_state, seed, set_rng_state  # noqa: F401

@@ -1,0 +1,175 @@
+"""Dtype system for paddle_tpu.
+
+Paddle exposes dtypes both as objects (``paddle.float32``) and as strings
+(``'float32'``). The reference implements this as ``VarType`` proto enums
+(/root/reference/paddle/fluid/framework/framework.proto) plus conversion
+helpers. Here dtypes are thin named wrappers over numpy/jax dtypes so they
+interop directly with jax.numpy.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+try:
+    import ml_dtypes
+
+    _BF16 = ml_dtypes.bfloat16
+    _FP8_E4M3 = getattr(ml_dtypes, "float8_e4m3fn", None)
+    _FP8_E5M2 = getattr(ml_dtypes, "float8_e5m2", None)
+except ImportError:  # pragma: no cover
+    _BF16 = None
+    _FP8_E4M3 = None
+    _FP8_E5M2 = None
+
+
+class DType:
+    """A framework dtype: named, hashable, convertible to numpy/jax dtype."""
+
+    __slots__ = ("name", "np_dtype", "is_floating", "is_integer", "is_complex", "itemsize")
+
+    def __init__(self, name: str, np_dtype):
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype)
+        kind = self.np_dtype.kind
+        # bfloat16/fp8 are 'V'-kind in numpy terms under ml_dtypes unless
+        # registered; test explicitly.
+        self.is_floating = kind == "f" or name in ("bfloat16", "float8_e4m3fn", "float8_e5m2")
+        self.is_integer = kind in ("i", "u")
+        self.is_complex = kind == "c"
+        self.itemsize = self.np_dtype.itemsize
+
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    def __str__(self):
+        return self.name
+
+    def __hash__(self):
+        return hash(self.name)
+
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self.name == other.name
+        if isinstance(other, str):
+            return self.name == _canon_name(other)
+        try:
+            return self.np_dtype == np.dtype(other)
+        except TypeError:
+            return NotImplemented
+
+
+def _canon_name(name: str) -> str:
+    aliases = {
+        "float": "float32",
+        "double": "float64",
+        "half": "float16",
+        "int": "int32",
+        "long": "int64",
+        "bfloat": "bfloat16",
+    }
+    return aliases.get(name, name)
+
+
+bool_ = DType("bool", np.bool_)
+uint8 = DType("uint8", np.uint8)
+int8 = DType("int8", np.int8)
+int16 = DType("int16", np.int16)
+int32 = DType("int32", np.int32)
+int64 = DType("int64", np.int64)
+float16 = DType("float16", np.float16)
+float32 = DType("float32", np.float32)
+float64 = DType("float64", np.float64)
+complex64 = DType("complex64", np.complex64)
+complex128 = DType("complex128", np.complex128)
+
+if _BF16 is not None:
+    bfloat16 = DType("bfloat16", _BF16)
+else:  # pragma: no cover
+    bfloat16 = DType("bfloat16", np.float32)
+
+if _FP8_E4M3 is not None:
+    float8_e4m3fn = DType("float8_e4m3fn", _FP8_E4M3)
+    float8_e5m2 = DType("float8_e5m2", _FP8_E5M2)
+
+_ALL = [bool_, uint8, int8, int16, int32, int64, float16, float32, float64,
+        complex64, complex128, bfloat16]
+if _FP8_E4M3 is not None:
+    _ALL += [float8_e4m3fn, float8_e5m2]
+
+_BY_NAME = {d.name: d for d in _ALL}
+_BY_NAME["bool"] = bool_
+_BY_NPDTYPE = {d.np_dtype: d for d in reversed(_ALL)}
+
+
+def convert_dtype(dtype) -> DType:
+    """Coerce str / numpy dtype / DType / jax dtype into a framework DType."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, DType):
+        return dtype
+    if isinstance(dtype, str):
+        name = _canon_name(dtype)
+        if name in _BY_NAME:
+            return _BY_NAME[name]
+        raise ValueError(f"Unknown dtype string: {dtype!r}")
+    npd = np.dtype(dtype)
+    if npd in _BY_NPDTYPE:
+        return _BY_NPDTYPE[npd]
+    raise ValueError(f"Unsupported dtype: {dtype!r}")
+
+
+def to_jax_dtype(dtype):
+    """DType/str → numpy dtype usable by jax.numpy. None passes through."""
+    if dtype is None:
+        return None
+    return convert_dtype(dtype).np_dtype
+
+
+# Default dtype handling (paddle.set_default_dtype / get_default_dtype).
+_default_dtype = float32
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    d = convert_dtype(d)
+    if not d.is_floating:
+        raise TypeError(f"set_default_dtype only accepts floating dtypes, got {d}")
+    _default_dtype = d
+
+
+def get_default_dtype() -> str:
+    return _default_dtype.name
+
+
+def default_float_dtype() -> DType:
+    return _default_dtype
+
+
+def is_floating_dtype(dtype) -> bool:
+    return convert_dtype(dtype).is_floating
+
+
+_PROMOTE_ORDER = {
+    "bool": 0, "uint8": 1, "int8": 2, "int16": 3, "int32": 4, "int64": 5,
+    "float8_e4m3fn": 6, "float8_e5m2": 6, "float16": 7, "bfloat16": 7,
+    "float32": 8, "float64": 9, "complex64": 10, "complex128": 11,
+}
+
+
+def promote_types(a, b) -> DType:
+    """Simple type promotion mirroring jnp.promote_types for common cases."""
+    a, b = convert_dtype(a), convert_dtype(b)
+    if a == b:
+        return a
+    r = np.promote_types(a.np_dtype, b.np_dtype) if (
+        a.name not in ("bfloat16",) and b.name not in ("bfloat16",)
+    ) else None
+    if r is not None:
+        return convert_dtype(r)
+    # bfloat16 promotion: bf16 + f16 → f32; bf16 + f32 → f32; bf16 + int → bf16
+    other = b if a.name == "bfloat16" else a
+    if other.is_integer or other.name == "bool":
+        return bfloat16
+    if other.name in ("float16",):
+        return float32
+    return other if _PROMOTE_ORDER[other.name] > _PROMOTE_ORDER["bfloat16"] else bfloat16

@@ -1,0 +1,85 @@
+"""Typed global flag registry.
+
+The reference centralizes ~90 gflags in /root/reference/paddle/phi/core/flags.cc
+and exposes them through ``paddle.set_flags/get_flags`` with ``FLAGS_*`` env-var
+overrides (/root/reference/python/paddle/fluid/framework.py:7764). This is the
+TPU-native equivalent: a single typed registry, env-var override at definition
+time, same Python surface.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Union
+
+
+class _Flag:
+    __slots__ = ("name", "value", "default", "type_", "help")
+
+    def __init__(self, name, default, help_=""):
+        self.name = name
+        self.default = default
+        self.type_ = type(default)
+        self.help = help_
+        env = os.environ.get(name)
+        self.value = self._parse(env) if env is not None else default
+
+    def _parse(self, s: str):
+        if self.type_ is bool:
+            return s.lower() in ("1", "true", "yes", "on")
+        return self.type_(s)
+
+    def set(self, v):
+        if self.type_ is bool and isinstance(v, str):
+            v = self._parse(v)
+        self.value = self.type_(v)
+
+
+_REGISTRY: Dict[str, _Flag] = {}
+
+
+def define_flag(name: str, default, help_: str = ""):
+    if not name.startswith("FLAGS_"):
+        name = "FLAGS_" + name
+    if name not in _REGISTRY:
+        _REGISTRY[name] = _Flag(name, default, help_)
+    return _REGISTRY[name]
+
+
+def get_flags(flags: Union[str, List[str]]) -> Dict[str, Any]:
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for f in flags:
+        key = f if f.startswith("FLAGS_") else "FLAGS_" + f
+        if key not in _REGISTRY:
+            raise ValueError(f"Unknown flag: {f}")
+        out[f] = _REGISTRY[key].value
+    return out
+
+
+def set_flags(flags: Dict[str, Any]):
+    for k, v in flags.items():
+        key = k if k.startswith("FLAGS_") else "FLAGS_" + k
+        if key not in _REGISTRY:
+            raise ValueError(f"Unknown flag: {k}")
+        _REGISTRY[key].set(v)
+
+
+def flag_value(name: str):
+    key = name if name.startswith("FLAGS_") else "FLAGS_" + name
+    return _REGISTRY[key].value
+
+
+# Core flags (the subset of the reference's flags.cc that has TPU meaning;
+# others are accepted as inert toggles so reference scripts don't break).
+define_flag("FLAGS_check_nan_inf", False, "check outputs for nan/inf after every op")
+define_flag("FLAGS_benchmark", False, "synchronize after every op (for timing)")
+define_flag("FLAGS_eager_op_jit_cache", True, "cache per-op compiled executables in eager mode")
+define_flag("FLAGS_fraction_of_gpu_memory_to_use", 0.92, "accepted for compat; XLA manages HBM")
+define_flag("FLAGS_allocator_strategy", "auto_growth", "compat; XLA BFC allocator is used")
+define_flag("FLAGS_cudnn_deterministic", False, "compat; maps to XLA deterministic ops")
+define_flag("FLAGS_use_stream_safe_cuda_allocator", True, "compat no-op")
+define_flag("FLAGS_new_executor_serial_run", False, "run static programs op-serially (debug)")
+define_flag("FLAGS_enable_pir_api", False, "compat no-op")
+define_flag("FLAGS_log_memory_stats", False, "log live/peak buffer stats on allocation")
+define_flag("FLAGS_tpu_matmul_precision", "default", "jax matmul precision: default|high|highest")

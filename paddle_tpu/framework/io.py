@@ -1,0 +1,56 @@
+"""paddle.save / paddle.load
+(reference: /root/reference/python/paddle/framework/io.py:656,898 — pickled
+state_dict with per-tensor segments). Format here: a pickle where Tensors are
+replaced by numpy arrays tagged with dtype/shape — readable without jax and
+layout-compatible with the dict-of-arrays contract.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        return {"__tensor__": True, "data": obj.numpy(), "name": obj.name,
+                "stop_gradient": obj.stop_gradient}
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        packed = [_pack(v) for v in obj]
+        return packed if isinstance(obj, list) else tuple(packed)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, dict):
+        if obj.get("__tensor__"):
+            if return_numpy:
+                return obj["data"]
+            t = Tensor(obj["data"], stop_gradient=obj.get("stop_gradient", True))
+            t.name = obj.get("name", t.name)
+            return t
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        un = [_unpack(v, return_numpy) for v in obj]
+        return un if isinstance(obj, list) else tuple(un)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    return_numpy = configs.get("return_numpy", False)
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy)

@@ -1,0 +1,96 @@
+"""Places — logical device locations.
+
+Mirrors the reference's ``phi::Place`` hierarchy
+(/root/reference/paddle/phi/common/place.h:58) with the device set that makes
+sense on a TPU-native stack: CPUPlace and TPUPlace (CUDAPlace is accepted as an
+alias for TPUPlace so reference scripts keep running, with a warning).
+"""
+from __future__ import annotations
+
+import jax
+
+
+class Place:
+    """Base place. A place maps onto a jax.Device."""
+
+    device_type = "undefined"
+
+    def __init__(self, device_id: int = 0):
+        self._device_id = int(device_id)
+
+    def get_device_id(self) -> int:
+        return self._device_id
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self._device_id})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Place)
+            and self.device_type == other.device_type
+            and self._device_id == other._device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self._device_id))
+
+    def jax_device(self):
+        """Resolve to a concrete jax.Device."""
+        devs = [d for d in jax.devices() if _matches(d, self.device_type)]
+        if not devs:
+            # Fall back to host CPU devices (always present).
+            devs = jax.devices("cpu")
+        return devs[self._device_id % len(devs)]
+
+
+def _matches(dev, device_type: str) -> bool:
+    plat = dev.platform.lower()
+    if device_type == "cpu":
+        return plat == "cpu"
+    if device_type == "tpu":
+        # axon/tpu platforms both present as accelerators
+        return plat not in ("cpu",)
+    return False
+
+
+class CPUPlace(Place):
+    device_type = "cpu"
+
+    def __init__(self):
+        super().__init__(0)
+
+    def __repr__(self):
+        return "Place(cpu)"
+
+
+class TPUPlace(Place):
+    device_type = "tpu"
+
+    def __repr__(self):
+        return f"Place(tpu:{self._device_id})"
+
+
+class CUDAPlace(TPUPlace):
+    """Compat alias: reference scripts constructing CUDAPlace land on TPU."""
+
+    def __repr__(self):
+        return f"Place(tpu:{self._device_id})  # CUDAPlace compat"
+
+
+class CUDAPinnedPlace(CPUPlace):
+    pass
+
+
+class XPUPlace(TPUPlace):
+    pass
+
+
+def _accelerator_available() -> bool:
+    try:
+        return any(d.platform.lower() != "cpu" for d in jax.devices())
+    except RuntimeError:  # pragma: no cover
+        return False
+
+
+def default_place() -> Place:
+    return TPUPlace(0) if _accelerator_available() else CPUPlace()

@@ -1,0 +1,101 @@
+"""Global RNG state.
+
+The reference keeps per-device ``phi::Generator`` states
+(/root/reference/paddle/phi/core/generator.h) seeded by ``paddle.seed``. JAX
+randomness is functional (explicit keys), so the framework keeps a stateful
+Generator that hands out fresh subkeys to each consuming op — stateful API on
+the outside, pure keys on the inside. Traced/jit code should use
+``paddle_tpu.nn.functional`` ops that accept explicit seeds, or rely on the
+per-call key threading the jit wrapper does.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as np
+
+
+class Generator:
+    """Stateful splitter over a jax PRNG key."""
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self.manual_seed(seed)
+
+    def manual_seed(self, seed: int):
+        with getattr(self, "_lock", threading.Lock()):
+            self._seed = int(seed)
+            self._key = jax.random.key(int(seed))
+            self._counter = 0
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        """Return a fresh subkey; advances state."""
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            self._counter += 1
+            return sub
+
+    def get_state(self):
+        with self._lock:
+            return (self._seed, self._counter, jax.random.key_data(self._key))
+
+    def set_state(self, state):
+        seed, counter, key_data = state
+        with self._lock:
+            self._seed = seed
+            self._counter = counter
+            self._key = jax.random.wrap_key_data(np.asarray(key_data))
+
+
+_default_generator = Generator(seed=np.random.randint(0, 2**31 - 1))
+
+# When tracing a whole training step (paddle_tpu.jit.TrainStep), random ops
+# must derive keys from a per-call traced base key instead of host state, so
+# each compiled step invocation gets fresh randomness. This scope provides
+# that base; next_key() folds an incrementing counter into it.
+_trace_scope = threading.local()
+
+
+class traced_key_scope:
+    def __init__(self, base_key):
+        self.base_key = base_key
+
+    def __enter__(self):
+        self._prev = getattr(_trace_scope, "state", None)
+        _trace_scope.state = {"base": self.base_key, "counter": 0}
+        return self
+
+    def __exit__(self, *exc):
+        _trace_scope.state = self._prev
+        return False
+
+
+def seed(s: int) -> Generator:
+    """paddle.seed equivalent: reseed the global generator."""
+    _default_generator.manual_seed(s)
+    return _default_generator
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def next_key():
+    st = getattr(_trace_scope, "state", None)
+    if st is not None:
+        st["counter"] += 1
+        return jax.random.fold_in(st["base"], st["counter"])
+    return _default_generator.next_key()
+
+
+def get_rng_state():
+    return [_default_generator.get_state()]
+
+
+def set_rng_state(states):
+    _default_generator.set_state(states[0])

@@ -1,0 +1,2 @@
+from .model import Model  # noqa: F401
+from .summary import summary  # noqa: F401

@@ -1,0 +1,128 @@
+"""High-level Model API (reference: /root/reference/python/paddle/hapi/model.py:1045,
+fit at :1740) — Keras-like train/eval/predict over a Layer."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..io import DataLoader
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._loss = None
+        self._optimizer = None
+        self._metrics = []
+        self.stop_training = False
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, **kwargs):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is not None:
+            self._metrics = metrics if isinstance(metrics, (list, tuple)) \
+                else [metrics]
+
+    def _loader(self, data, batch_size, shuffle):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          drop_last=shuffle)
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if labels is None or isinstance(labels, (list, tuple)) \
+            else [labels]
+        outputs = self.network(*inputs)
+        losses = self._loss(outputs, *labels) if labels else self._loss(outputs)
+        losses.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = []
+        for m in self._metrics:
+            m.update(m.compute(outputs, *labels))
+        return [float(losses.numpy())], [m.accumulate() for m in self._metrics]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if labels is None or isinstance(labels, (list, tuple)) \
+            else [labels]
+        outputs = self.network(*inputs)
+        losses = self._loss(outputs, *labels) if self._loss else None
+        for m in self._metrics:
+            m.update(m.compute(outputs, *labels))
+        return ([float(losses.numpy())] if losses is not None else [],
+                [m.accumulate() for m in self._metrics])
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        from ..core.autograd import no_grad
+        with no_grad():
+            return self.network(*inputs)
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            **kwargs):
+        loader = self._loader(train_data, batch_size, shuffle)
+        history = {"loss": []}
+        for epoch in range(epochs):
+            for m in self._metrics:
+                m.reset()
+            for step, batch in enumerate(loader):
+                x, y = batch[0], batch[1] if len(batch) > 1 else None
+                loss, metrics = self.train_batch(x, y)
+                history["loss"].append(loss[0])
+                if verbose and step % log_freq == 0:
+                    print(f"Epoch {epoch + 1}/{epochs} step {step}: "
+                          f"loss={loss[0]:.4f}")
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size, verbose=verbose)
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, **kwargs):
+        loader = self._loader(eval_data, batch_size, False)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            x, y = batch[0], batch[1] if len(batch) > 1 else None
+            loss, _ = self.eval_batch(x, y)
+            losses.extend(loss)
+        result = {"loss": [float(np.mean(losses))] if losses else []}
+        for m in self._metrics:
+            result[m.name()] = m.accumulate()
+        if verbose:
+            print("Eval:", result)
+        return result
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1, **kwargs):
+        loader = self._loader(test_data, batch_size, False)
+        outputs = []
+        for batch in loader:
+            x = batch[0] if isinstance(batch, (list, tuple)) else batch
+            outputs.append(self.predict_batch(x))
+        return outputs
+
+    def save(self, path, training=True):
+        import paddle_tpu as P
+        P.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            P.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        import paddle_tpu as P
+        self.network.set_state_dict(P.load(path + ".pdparams"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary as _summary
+        return _summary(self.network, input_size, dtypes=dtype)

@@ -1,0 +1,4 @@
+"""paddle.incubate equivalent (autograd prims via jax transforms, fused ops,
+MoE). """
+from . import autograd  # noqa: F401
+from . import nn  # noqa: F401

@@ -1,0 +1,22 @@
+"""Fused layers (reference: python/paddle/incubate/nn/layer/fused_transformer.py).
+On TPU, 'fused' is what XLA does to the plain layers; these classes preserve
+the API and route to the standard implementations + Pallas attention.
+"""
+from ...nn.layer.transformer import (  # noqa: F401
+    TransformerEncoderLayer as FusedTransformerEncoderLayer,
+)
+from ...nn.layer.transformer import MultiHeadAttention as FusedMultiHeadAttention  # noqa: F401
+
+
+class FusedFeedForward:
+    def __new__(cls, d_model, dim_feedforward, dropout_rate=0.1, **kw):
+        from ...nn import Dropout, Linear, Sequential, ReLU
+        return Sequential(Linear(d_model, dim_feedforward), ReLU(),
+                          Dropout(dropout_rate),
+                          Linear(dim_feedforward, d_model))
+
+
+class FusedLinear:
+    def __new__(cls, in_features, out_features, **kw):
+        from ...nn import Linear
+        return Linear(in_features, out_features)

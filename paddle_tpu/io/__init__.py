@@ -1,0 +1,6 @@
+from .dataloader import DataLoader, get_worker_info  # noqa: F401
+from .dataset import (  # noqa: F401
+    BatchSampler, ChainDataset, ComposeDataset, ConcatDataset, Dataset,
+    DistributedBatchSampler, IterableDataset, RandomSampler, Sampler,
+    SequenceSampler, Subset, TensorDataset, random_split,
+)

@@ -1,0 +1,197 @@
+"""DataLoader.
+
+Reference: /root/reference/python/paddle/fluid/reader.py:311 +
+fluid/dataloader/dataloader_iter.py:162,370 (single/multiprocess iterators,
+shared-memory transport, async device transfer). TPU-native equivalent:
+multiprocessing workers feed host numpy batches through a queue; the main
+process overlaps host→HBM transfer (jax.device_put is async) with a small
+prefetch depth, which is the TPU analog of pin_memory+cuda streams.
+"""
+from __future__ import annotations
+
+import itertools
+import multiprocessing as mp
+import queue as queue_mod
+import threading
+import traceback
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import BatchSampler, IterableDataset
+
+
+class _WorkerInfo:
+    def __init__(self, id, num_workers, dataset, seed):  # noqa: A002
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+        self.seed = seed
+
+
+_worker_info = None
+
+
+def get_worker_info():
+    return _worker_info
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, (list, tuple)):
+        return [default_collate_fn([b[i] for b in batch])
+                for i in range(len(sample))]
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, Tensor):
+        return np.stack([np.asarray(s._data) for s in batch])
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, float, np.number)):
+        return np.asarray(batch)
+    return batch
+
+
+def _to_tensor_tree(batch, return_list=True):
+    if isinstance(batch, np.ndarray):
+        return Tensor(batch)
+    if isinstance(batch, (list, tuple)):
+        return [_to_tensor_tree(b) for b in batch]
+    if isinstance(batch, dict):
+        return {k: _to_tensor_tree(v) for k, v in batch.items()}
+    return batch
+
+
+def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id,
+                 num_workers, seed):
+    global _worker_info
+    _worker_info = _WorkerInfo(worker_id, num_workers, dataset, seed)
+    np.random.seed((seed + worker_id) % (2 ** 31))
+    while True:
+        task = index_queue.get()
+        if task is None:
+            break
+        batch_id, indices = task
+        try:
+            samples = [dataset[i] for i in indices]
+            data = collate_fn(samples)
+            data_queue.put((batch_id, data, None))
+        except Exception:  # pragma: no cover
+            data_queue.put((batch_id, None, traceback.format_exc()))
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None, return_list=True,
+                 batch_sampler=None, batch_size=1, shuffle=False,
+                 drop_last=False, collate_fn=None, num_workers=0,
+                 use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 persistent_workers=False):
+        self.dataset = dataset
+        self.return_list = return_list
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = int(num_workers)
+        self.prefetch_factor = prefetch_factor
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self._iterable = isinstance(dataset, IterableDataset)
+        if self._iterable:
+            self.batch_size = batch_size
+            self.batch_sampler = None
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+            self.batch_size = getattr(batch_sampler, "batch_size", batch_size)
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size,
+                drop_last=drop_last)
+            self.batch_size = batch_size
+
+    def __len__(self):
+        if self._iterable:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        return len(self.batch_sampler)
+
+    def __iter__(self):
+        if self._iterable:
+            return self._iter_iterable()
+        if self.num_workers == 0:
+            return self._iter_single()
+        return self._iter_multiprocess()
+
+    def _iter_iterable(self):
+        batch = []
+        for sample in self.dataset:
+            batch.append(sample)
+            if self.batch_size is not None and len(batch) == self.batch_size:
+                yield _to_tensor_tree(self.collate_fn(batch))
+                batch = []
+        if batch:
+            yield _to_tensor_tree(self.collate_fn(batch))
+
+    def _iter_single(self):
+        for indices in self.batch_sampler:
+            samples = [self.dataset[i] for i in indices]
+            yield _to_tensor_tree(self.collate_fn(samples))
+
+    def _iter_multiprocess(self):
+        ctx = mp.get_context("fork")
+        index_queues = []
+        data_queue = ctx.Queue()
+        workers = []
+        seed = np.random.randint(0, 2 ** 31)
+        for wid in range(self.num_workers):
+            iq = ctx.Queue()
+            w = ctx.Process(
+                target=_worker_loop,
+                args=(self.dataset, iq, data_queue, self.collate_fn, wid,
+                      self.num_workers, seed),
+                daemon=True)
+            w.start()
+            workers.append(w)
+            index_queues.append(iq)
+
+        try:
+            sampler_iter = iter(self.batch_sampler)
+            batch_id = 0
+            sent = 0
+            reorder = {}
+            next_yield = 0
+            # pre-fill
+            for _ in range(self.prefetch_factor * self.num_workers):
+                try:
+                    indices = next(sampler_iter)
+                except StopIteration:
+                    break
+                index_queues[sent % self.num_workers].put((batch_id, indices))
+                batch_id += 1
+                sent += 1
+
+            done = 0
+            total = len(self.batch_sampler)
+            while next_yield < total:
+                if next_yield in reorder:
+                    data = reorder.pop(next_yield)
+                    yield _to_tensor_tree(data)
+                    next_yield += 1
+                    continue
+                bid, data, err = data_queue.get(
+                    timeout=self.timeout if self.timeout else None)
+                if err is not None:
+                    raise RuntimeError(f"DataLoader worker failed:\n{err}")
+                try:
+                    indices = next(sampler_iter)
+                    index_queues[sent % self.num_workers].put(
+                        (batch_id, indices))
+                    batch_id += 1
+                    sent += 1
+                except StopIteration:
+                    pass
+                reorder[bid] = data
+        finally:
+            for iq in index_queues:
+                iq.put(None)
+            for w in workers:
+                w.join(timeout=1)
+                if w.is_alive():
+                    w.terminate()

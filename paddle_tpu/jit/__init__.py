@@ -1,0 +1,6 @@
+from .api import (  # noqa: F401
+    StaticFunction, TranslatedLayer, enable_to_static, ignore_module, load,
+    not_to_static, save, to_static,
+)
+from .functional import functional_call, state_arrays  # noqa: F401
+from .train_step import TrainStep  # noqa: F401

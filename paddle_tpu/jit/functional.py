@@ -1,0 +1,69 @@
+"""Functionalization of stateful Layers — the hinge between paddle-shaped
+eager modules and jax transforms (jit/grad/vmap/pjit).
+
+The reference's analog is dy2static's ``partial_program``
+(/root/reference/python/paddle/jit/dy2static/partial_program.py) which runs a
+traced program inside dygraph. Here the direction is TPU-idiomatic: a Layer's
+parameters/buffers are extracted to a pytree, and ``functional_call`` runs the
+layer's Python forward with arrays swapped in — so ``jax.jit``, ``jax.grad``,
+``jax.vjp`` and pjit shardings all apply directly to paddle Layers.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, Tuple
+
+import jax
+
+from ..core import autograd
+from ..core.tensor import Tensor
+
+
+def state_arrays(layer) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Extract (params, buffers) as name->jax.Array dicts."""
+    params = {name: p._data for name, p in layer.named_parameters()}
+    buffers = {name: b._data for name, b in layer.named_buffers()
+               if b is not None}
+    return params, buffers
+
+
+@contextlib.contextmanager
+def _swapped_state(layer, params, buffers):
+    named_p = dict(layer.named_parameters())
+    named_b = {n: b for n, b in layer.named_buffers() if b is not None}
+    old_p = {n: t._data for n, t in named_p.items()}
+    old_b = {n: t._data for n, t in named_b.items()}
+    try:
+        for n, arr in params.items():
+            if n in named_p:
+                named_p[n]._data = arr
+        for n, arr in buffers.items():
+            if n in named_b:
+                named_b[n]._data = arr
+        yield
+    finally:
+        for n, t in named_p.items():
+            t._data = old_p[n]
+        for n, t in named_b.items():
+            t._data = old_b[n]
+
+
+def functional_call(layer, params, buffers, *args, training=None, **kwargs):
+    """Run layer's forward with the given arrays; returns raw jax arrays.
+
+    Must be called under trace (jit/grad) or eagerly; autograd recording is
+    disabled since differentiation is jax's job here.
+    """
+    prev_training = layer.training
+    if training is not None:
+        layer.train() if training else layer.eval()
+    try:
+        with _swapped_state(layer, params, buffers), autograd.no_grad():
+            t_args = [Tensor(a, stop_gradient=True) if isinstance(a, jax.Array)
+                      else a for a in args]
+            out = layer(*t_args, **kwargs)
+        return jax.tree_util.tree_map(
+            lambda x: x._data if isinstance(x, Tensor) else x, out,
+            is_leaf=lambda x: isinstance(x, Tensor))
+    finally:
+        layer.train() if prev_training else layer.eval()

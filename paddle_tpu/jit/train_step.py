@@ -1,0 +1,146 @@
+"""TrainStep — one fully-compiled, buffer-donated training step.
+
+This is the TPU performance path: forward + backward + optimizer update as a
+single XLA program (the analog of the reference's whole-Program execution via
+InterpreterCore, but with fusion done by XLA). Eager `loss.backward();
+opt.step()` keeps working for UX; TrainStep is what benchmarks and real
+training loops should use.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..framework import random as random_mod
+from ..nn.clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
+from .functional import _swapped_state, state_arrays
+
+
+def _functional_clip(grad_clip, grads: dict) -> dict:
+    if grad_clip is None:
+        return grads
+    if isinstance(grad_clip, ClipGradByGlobalNorm):
+        gn = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                          for g in grads.values()))
+        clip = grad_clip.clip_norm
+        factor = jnp.where(gn > clip, clip / jnp.maximum(gn, 1e-12), 1.0)
+        return {k: (g * factor.astype(g.dtype)) for k, g in grads.items()}
+    if isinstance(grad_clip, ClipGradByNorm):
+        out = {}
+        for k, g in grads.items():
+            n = jnp.sqrt(jnp.sum(jnp.square(g)))
+            out[k] = jnp.where(n > grad_clip.clip_norm,
+                               g * (grad_clip.clip_norm / n), g)
+        return out
+    if isinstance(grad_clip, ClipGradByValue):
+        return {k: jnp.clip(g, grad_clip.min, grad_clip.max)
+                for k, g in grads.items()}
+    return grads
+
+
+class TrainStep:
+    """Compile model.forward + loss + optimizer into one donated XLA step.
+
+    Usage::
+
+        step = TrainStep(model, loss_fn, optimizer)   # loss_fn(out, *labels)
+        loss = step(x, label)                          # one fused device step
+    """
+
+    def __init__(self, model, loss_fn: Callable, optimizer,
+                 donate: bool = True):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self._compiled = None
+        self._donate = donate
+        self._named_params = dict(model.named_parameters())
+        self._trainable = {n: p for n, p in self._named_params.items()
+                           if not p.stop_gradient}
+
+    def _init_opt_state(self):
+        opt = self.optimizer
+        state = {}
+        for name, p in self._trainable.items():
+            state[name] = {an: opt._get_accum(an, p)
+                           for an in opt._accum_names}
+        return state
+
+    def _writeback_opt_state(self, state):
+        opt = self.optimizer
+        for name, p in self._trainable.items():
+            for an in opt._accum_names:
+                opt._set_accum(an, p, state[name][an])
+
+    def _build(self):
+        model = self.model
+        loss_fn = self.loss_fn
+        opt = self.optimizer
+        trainable_names = list(self._trainable.keys())
+        grad_clip = getattr(opt, "_grad_clip", None)
+        update_rule = opt._update_rule
+        wd_by_name = {n: opt._wd_for(p) for n, p in self._trainable.items()}
+        lr_mult = {n: getattr(p, "optimize_attr", {"learning_rate": 1.0})[
+            "learning_rate"] for n, p in self._trainable.items()}
+
+        def pure_step(params, buffers, opt_state, lr, t, key, *batch):
+            def loss_of(train_params):
+                all_params = {**params, **train_params}
+                from ..core import autograd as ag
+                with _swapped_state(model, all_params, buffers), ag.no_grad(), \
+                        random_mod.traced_key_scope(key):
+                    t_batch = [Tensor(a, stop_gradient=True) for a in batch]
+                    out = model(*t_batch[:self._n_inputs])
+                    loss_t = loss_fn(out, *t_batch[self._n_inputs:])
+                return loss_t._data if isinstance(loss_t, Tensor) else loss_t
+
+            train_params = {n: params[n] for n in trainable_names}
+            loss, grads = jax.value_and_grad(loss_of)(train_params)
+            grads = _functional_clip(grad_clip, grads)
+            new_params = dict(params)
+            new_state = {}
+            for n in trainable_names:
+                g = grads[n]
+                p_arr = params[n]
+                if g.dtype != p_arr.dtype:
+                    g = g.astype(p_arr.dtype)
+                if opt._l2_coeff and not opt._decoupled_wd():
+                    g = g + opt._l2_coeff * p_arr
+                p_new, s_new = update_rule(
+                    p_arr, g, lr * lr_mult[n], t,
+                    jnp.asarray(wd_by_name[n], jnp.float32), opt_state[n])
+                new_params[n] = p_new
+                new_state[n] = s_new
+            return loss, new_params, new_state
+
+        donate = (0, 2) if self._donate else ()
+        self._compiled = jax.jit(pure_step, donate_argnums=donate)
+
+    def __call__(self, *batch, n_inputs: Optional[int] = None):
+        """batch = model inputs followed by loss_fn extra args (labels)."""
+        self._n_inputs = n_inputs if n_inputs is not None else \
+            getattr(self, "_n_inputs", len(batch) - 1)
+        if self._compiled is None:
+            self._build()
+        params, buffers = state_arrays(self.model)
+        opt_state = self._init_opt_state()
+        self.optimizer._step_count += 1
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        t = jnp.asarray(self.optimizer._step_count, jnp.int32)
+        key = random_mod.next_key()
+        arrays = [b._data if isinstance(b, Tensor) else jnp.asarray(b)
+                  for b in batch]
+        loss, new_params, new_state = self._compiled(
+            params, buffers, opt_state, lr, t, key, *arrays)
+        for n, p in self._named_params.items():
+            p._data = new_params[n]
+        self._writeback_opt_state(new_state)
+        if isinstance(self.optimizer._lr, object) and hasattr(
+                self.optimizer._lr, "step") and not isinstance(
+                self.optimizer._lr, (int, float)):
+            pass  # LR scheduler stepping is the caller's choice (paddle API)
+        return Tensor(loss)

@@ -1,0 +1,80 @@
+"""Gradient clipping (reference: /root/reference/python/paddle/fluid/clip.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):  # noqa: A002
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, apply_op("clip_by_value",
+                                    lambda a: jnp.clip(a, self.min, self.max), g)))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+
+            def _clip(a):
+                n = jnp.sqrt(jnp.sum(jnp.square(a)))
+                return jnp.where(n > self.clip_norm, a * (self.clip_norm / n), a)
+            out.append((p, apply_op("clip_by_norm", _clip, g)))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Global-norm clip across all grads — matches the reference's cross-group
+    hybrid-parallel semantics when grads are already full (mesh-sharded grads
+    are globally correct because reductions under pjit are global)."""
+
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        grads = [g for p, g in params_grads
+                 if g is not None and getattr(p, "need_clip", True)]
+        if not grads:
+            return params_grads
+
+        def _global_norm(*gs):
+            return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                                for g in gs))
+        gn = apply_op("global_norm", _global_norm, *grads)
+
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+
+            def _scale(a, n):
+                factor = jnp.where(n > self.clip_norm,
+                                   self.clip_norm / jnp.maximum(n, 1e-12), 1.0)
+                return a * factor.astype(a.dtype)
+            out.append((p, apply_op("global_norm_clip", _scale, g, gn)))
+        return out

@@ -1,0 +1,13 @@
+from .activation import *  # noqa: F401,F403
+from .attention import flash_attention, scaled_dot_product_attention  # noqa: F401
+from .common import *  # noqa: F401,F403
+from .conv import (  # noqa: F401
+    conv1d, conv1d_transpose, conv2d, conv2d_transpose, conv3d,
+    conv3d_transpose,
+)
+from .loss import *  # noqa: F401,F403
+from .norm import (  # noqa: F401
+    batch_norm, group_norm, instance_norm, layer_norm, local_response_norm,
+    normalize, spectral_norm,
+)
+from .pooling import *  # noqa: F401,F403

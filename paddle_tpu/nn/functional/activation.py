@@ -1,0 +1,172 @@
+"""Activation functionals (reference: /root/reference/python/paddle/nn/functional/activation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op
+from ...framework import random as random_mod
+
+
+def _unop(name, fn):
+    def op(x, name=None):
+        return apply_op(name, fn, x)
+    op.__name__ = name
+    return op
+
+
+relu = _unop("relu", jax.nn.relu)
+relu6 = _unop("relu6", jax.nn.relu6)
+sigmoid = _unop("sigmoid", jax.nn.sigmoid)
+tanh = _unop("tanh", jnp.tanh)
+silu = _unop("silu", jax.nn.silu)
+softsign = _unop("softsign", jax.nn.soft_sign)
+tanhshrink = _unop("tanhshrink", lambda a: a - jnp.tanh(a))
+mish = _unop("mish", lambda a: a * jnp.tanh(jax.nn.softplus(a)))
+hardswish = _unop("hardswish", lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0)
+
+
+def relu_(x, name=None):
+    from ...tensor.math import _inplace
+    return _inplace(x, relu(x))
+
+
+def gelu(x, approximate=False, name=None):
+    return apply_op("gelu", lambda a: jax.nn.gelu(a, approximate=approximate), x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply_op("leaky_relu",
+                    lambda a: jax.nn.leaky_relu(a, negative_slope), x)
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply_op("elu", lambda a: jax.nn.elu(a, alpha), x)
+
+
+def elu_(x, alpha=1.0, name=None):
+    from ...tensor.math import _inplace
+    return _inplace(x, elu(x, alpha))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply_op("selu",
+                    lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), x)
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply_op("celu", lambda a: jax.nn.celu(a, alpha), x)
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):  # noqa: A002
+    return apply_op("hardtanh", lambda a: jnp.clip(a, min, max), x)
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply_op("hardsigmoid",
+                    lambda a: jnp.clip(a * slope + offset, 0.0, 1.0), x)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply_op("hardshrink",
+                    lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), x)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply_op(
+        "softshrink",
+        lambda a: jnp.where(a > threshold, a - threshold,
+                            jnp.where(a < -threshold, a + threshold, 0.0)), x)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply_op(
+        "softplus",
+        lambda a: jnp.where(a * beta > threshold, a,
+                            jax.nn.softplus(a * beta) / beta), x)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply_op("thresholded_relu",
+                    lambda a: jnp.where(a > threshold, a, value), x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def _prelu(a, w):
+        if w.size == 1:
+            return jnp.where(a >= 0, a, w.reshape(()) * a)
+        ch_axis = 1 if data_format[1] == "C" else a.ndim - 1
+        shape = [1] * a.ndim
+        shape[ch_axis] = w.size
+        return jnp.where(a >= 0, a, w.reshape(shape) * a)
+    return apply_op("prelu", _prelu, x, weight)
+
+
+def rrelu(x, lower=0.125, upper=0.3333333, training=False, name=None):
+    if training:
+        key = random_mod.next_key()
+        def _rrelu(a):
+            slope = jax.random.uniform(key, a.shape, a.dtype, lower, upper)
+            return jnp.where(a >= 0, a, slope * a)
+        return apply_op("rrelu", _rrelu, x)
+    mid = (lower + upper) / 2.0
+    return apply_op("rrelu", lambda a: jnp.where(a >= 0, a, mid * a), x)
+
+
+def maxout(x, groups, axis=1, name=None):
+    def _maxout(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = list(a.shape[:ax]) + [groups, c // groups] + list(a.shape[ax + 1:])
+        return jnp.max(a.reshape(new_shape), axis=ax)
+    return apply_op("maxout", _maxout, x)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...framework import dtype as dtype_mod
+    jdt = dtype_mod.to_jax_dtype(dtype)
+    def _softmax(a):
+        if jdt is not None:
+            a = a.astype(jdt)
+        return jax.nn.softmax(a, axis=axis)
+    return apply_op("softmax", _softmax, x)
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    from ...tensor.math import _inplace
+    return _inplace(x, softmax(x, axis, dtype))
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...framework import dtype as dtype_mod
+    jdt = dtype_mod.to_jax_dtype(dtype)
+    def _lsm(a):
+        if jdt is not None:
+            a = a.astype(jdt)
+        return jax.nn.log_softmax(a, axis=axis)
+    return apply_op("log_softmax", _lsm, x)
+
+
+def log_sigmoid(x, name=None):
+    return apply_op("log_sigmoid", jax.nn.log_sigmoid, x)
+
+
+def glu(x, axis=-1, name=None):
+    return apply_op("glu", lambda a: jax.nn.glu(a, axis=axis), x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    key = random_mod.next_key()
+    def _gs(a):
+        g = jax.random.gumbel(key, a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            y_hard = jax.nn.one_hot(jnp.argmax(y, axis=axis), y.shape[axis],
+                                    axis=axis, dtype=y.dtype)
+            y = y_hard - jax.lax.stop_gradient(y) + y  # straight-through
+        return y
+    return apply_op("gumbel_softmax", _gs, x)

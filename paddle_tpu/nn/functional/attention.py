@@ -1,0 +1,66 @@
+"""Attention functionals.
+
+The reference exposes fused CUDA attention (`fused_attention`, `flash_attn` —
+/root/reference/paddle/phi/api/yaml/ops.yaml:546). Here
+scaled_dot_product_attention uses the Pallas flash-attention kernel on TPU
+(paddle_tpu/ops/flash_attention.py) with an XLA fallback elsewhere.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op
+
+
+def _sdpa_reference(q, k, v, mask=None, dropout_p=0.0, causal=False, scale=None):
+    # q,k,v: [batch, seq, heads, head_dim] (paddle flash-attn layout)
+    s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    qt = jnp.swapaxes(q, 1, 2)  # [b, h, sq, d]
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * s
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(cm, logits, jnp.asarray(-1e30, logits.dtype))
+    if mask is not None:
+        logits = logits + mask.astype(logits.dtype)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, training=True,
+                                 name=None):
+    """paddle.nn.functional.scaled_dot_product_attention.
+
+    Layout [batch, seq, num_heads, head_dim]. Uses the Pallas flash kernel on
+    TPU when shapes allow; falls back to the XLA softmax path.
+    """
+    from ...ops import flash_attention as fa
+
+    def _sdpa(q, k, v, *m):
+        mask = m[0] if m else None
+        if fa.supported(q, k, v, mask, is_causal):
+            return fa.flash_attention_bshd(q, k, v, causal=is_causal)
+        return _sdpa_reference(q, k, v, mask, dropout_p, is_causal)
+
+    if attn_mask is not None:
+        return apply_op("scaled_dot_product_attention", _sdpa, query, key,
+                        value, attn_mask)
+    return apply_op("scaled_dot_product_attention", _sdpa, query, key, value)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    """paddle.nn.functional.flash_attention.flash_attention parity."""
+    out = scaled_dot_product_attention(query, key, value, None, dropout,
+                                       causal, training)
+    if return_softmax:
+        return out, None
+    return out, None

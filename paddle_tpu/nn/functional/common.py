@@ -1,0 +1,330 @@
+"""Common functionals: linear, dropout, embedding, one_hot, interpolate, ...
+(reference: /root/reference/python/paddle/nn/functional/common.py, input.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import apply_op, unwrap
+from ...core.tensor import Tensor
+from ...framework import dtype as dtype_mod
+from ...framework import random as random_mod
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b, W shape [in, out] (paddle layout) — straight to the MXU."""
+    if bias is not None:
+        return apply_op("linear", lambda a, w, b: jnp.matmul(a, w) + b,
+                        x, weight, bias)
+    return apply_op("linear", lambda a, w: jnp.matmul(a, w), x, weight)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        return x if mode == "upscale_in_train" else \
+            apply_op("dropout_scale", lambda a: a * (1.0 - p), x)
+    key = random_mod.next_key()
+
+    def _dropout(a):
+        if axis is None:
+            keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        else:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            mask_shape = [a.shape[i] if i in axes else 1 for i in range(a.ndim)]
+            keep = jax.random.bernoulli(key, 1.0 - p, tuple(mask_shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), jnp.zeros_like(a))
+        return jnp.where(keep, a, jnp.zeros_like(a))
+
+    return apply_op("dropout", _dropout, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = [2, 3] if data_format == "NCHW" else [1, 2]
+    drop_axes = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=drop_axes, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    drop_axes = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=drop_axes, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    key = random_mod.next_key()
+
+    def _ad(a):
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        alpha_p = -alpha * scale
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        q = 1.0 - p
+        a_coef = (q + alpha_p ** 2 * q * p) ** -0.5
+        b_coef = -a_coef * alpha_p * p
+        return a_coef * jnp.where(keep, a, alpha_p) + b_coef
+
+    return apply_op("alpha_dropout", _ad, x)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def _embed(ids, w):
+        out = jnp.take(w, ids, axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, jnp.zeros_like(out), out)
+        return out
+    return apply_op("embedding", _embed, x, weight)
+
+
+def one_hot(x, num_classes, name=None):
+    return apply_op("one_hot",
+                    lambda i: jax.nn.one_hot(i, num_classes, dtype=jnp.float32), x)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def _ls(l, *pd):
+        k = l.shape[-1]
+        if pd:
+            return (1 - epsilon) * l + epsilon * pd[0]
+        return (1 - epsilon) * l + epsilon / k
+    if prior_dist is not None:
+        return apply_op("label_smooth", _ls, label, prior_dist)
+    return apply_op("label_smooth", _ls, label)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def _cs(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        d1 = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        d2 = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return num / jnp.maximum(d1 * d2, eps)
+    return apply_op("cosine_similarity", _cs, x1, x2)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def _bilinear(a, b, w, *mb):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if mb:
+            out = out + mb[0]
+        return out
+    if bias is not None:
+        return apply_op("bilinear", _bilinear, x1, x2, weight, bias)
+    return apply_op("bilinear", _bilinear, x1, x2, weight)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def _ps(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c // (r * r), r, r, h, w)
+            a = a.transpose(0, 1, 4, 2, 5, 3)
+            return a.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, r, r, c // (r * r))
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h * r, w * r, c // (r * r))
+    return apply_op("pixel_shuffle", _ps, x)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def _pu(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c, h // r, r, w // r, r)
+            a = a.transpose(0, 1, 3, 5, 2, 4)
+            return a.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h // r, r, w // r, r, c)
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h // r, w // r, c * r * r)
+    return apply_op("pixel_unshuffle", _pu, x)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def _cs(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            return a.reshape(n, groups, c // groups, h, w).transpose(
+                0, 2, 1, 3, 4).reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        return a.reshape(n, h, w, groups, c // groups).transpose(
+            0, 1, 2, 4, 3).reshape(n, h, w, c)
+    return apply_op("channel_shuffle", _cs, x)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    channel_last = not data_format.startswith("NC")
+    n_spatial = None
+
+    def _interp(a):
+        sp_axes = list(range(2, a.ndim)) if not channel_last else \
+            list(range(1, a.ndim - 1))
+        in_sp = [a.shape[i] for i in sp_axes]
+        if size is not None:
+            out_sp = [int(unwrap(s)) for s in (size if isinstance(size, (list, tuple))
+                                               else [size])]
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) else \
+                [scale_factor] * len(in_sp)
+            out_sp = [int(d * float(f)) for d, f in zip(in_sp, sf)]
+        jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+                 "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+        new_shape = list(a.shape)
+        for ax, d in zip(sp_axes, out_sp):
+            new_shape[ax] = d
+        if jmode == "nearest":
+            # index-based nearest (paddle uses floor convention)
+            out = a
+            for ax, (din, dout) in zip(sp_axes, zip(in_sp, out_sp)):
+                idx = jnp.floor(jnp.arange(dout) * (din / dout)).astype(jnp.int32)
+                out = jnp.take(out, idx, axis=ax)
+            return out
+        return jax.image.resize(a, new_shape, method=jmode)
+
+    return apply_op("interpolate", _interp, x)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format, name)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    from .conv import _tuplize
+    k = _tuplize(kernel_sizes, 2)
+    s = _tuplize(strides, 2)
+    d = _tuplize(dilations, 2)
+    if isinstance(paddings, int):
+        p = [(paddings, paddings), (paddings, paddings)]
+    elif len(paddings) == 2:
+        p = [(paddings[0], paddings[0]), (paddings[1], paddings[1])]
+    else:
+        p = [(paddings[0], paddings[2]), (paddings[1], paddings[3])]
+
+    def _unfold(a):
+        n, c, h, w = a.shape
+        patches = jax.lax.conv_general_dilated_patches(
+            a, filter_shape=k, window_strides=s, padding=p, rhs_dilation=d,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        # patches: [N, C*kh*kw, oh, ow]
+        return patches.reshape(n, c * k[0] * k[1], -1)
+    return apply_op("unfold", _unfold, x)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    from .conv import _tuplize
+    out_hw = _tuplize(output_sizes, 2)
+    k = _tuplize(kernel_sizes, 2)
+    s = _tuplize(strides, 2)
+    d = _tuplize(dilations, 2)
+    pd = _tuplize(paddings, 2) if not isinstance(paddings, int) else (paddings,) * 2
+
+    def _fold(a):
+        n, ckk, l = a.shape
+        c = ckk // (k[0] * k[1])
+        oh = (out_hw[0] + 2 * pd[0] - (d[0] * (k[0] - 1) + 1)) // s[0] + 1
+        ow = (out_hw[1] + 2 * pd[1] - (d[1] * (k[1] - 1) + 1)) // s[1] + 1
+        cols = a.reshape(n, c, k[0], k[1], oh, ow)
+        out = jnp.zeros((n, c, out_hw[0] + 2 * pd[0], out_hw[1] + 2 * pd[1]),
+                        a.dtype)
+        for i in range(k[0]):
+            for j in range(k[1]):
+                hi = i * d[0]
+                wj = j * d[1]
+                out = out.at[:, :, hi:hi + oh * s[0]:s[0],
+                             wj:wj + ow * s[1]:s[1]].add(cols[:, :, i, j])
+        return out[:, :, pd[0]:out.shape[2] - pd[0], pd[1]:out.shape[3] - pd[1]]
+    return apply_op("fold", _fold, x)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
+    from ...tensor.manipulation import pad as _tensor_pad
+    return _tensor_pad(x, pad, mode, value, data_format, name)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    def _ts(a):
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        v = a.reshape(n, seg_num, c, h, w)
+        fold_c = int(c * shift_ratio)
+        left = jnp.concatenate([v[:, 1:, :fold_c],
+                                jnp.zeros_like(v[:, :1, :fold_c])], axis=1)
+        mid = jnp.concatenate([jnp.zeros_like(v[:, :1, fold_c:2 * fold_c]),
+                               v[:, :-1, fold_c:2 * fold_c]], axis=1)
+        rest = v[:, :, 2 * fold_c:]
+        return jnp.concatenate([left, mid, rest], axis=2).reshape(nt, c, h, w)
+    return apply_op("temporal_shift", _ts, x)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    raise NotImplementedError("class_center_sample: PS-style API out of scope")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    def _gs(a, g):
+        n, c, h, w = a.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            ix = (gx + 1) * (w - 1) / 2
+            iy = (gy + 1) * (h - 1) / 2
+        else:
+            ix = ((gx + 1) * w - 1) / 2
+            iy = ((gy + 1) * h - 1) / 2
+        if mode == "nearest":
+            ix_r = jnp.clip(jnp.round(ix), 0, w - 1).astype(jnp.int32)
+            iy_r = jnp.clip(jnp.round(iy), 0, h - 1).astype(jnp.int32)
+            return a[jnp.arange(n)[:, None, None], :, iy_r, ix_r].transpose(0, 3, 1, 2)
+        x0 = jnp.floor(ix)
+        y0 = jnp.floor(iy)
+        x1, y1 = x0 + 1, y0 + 1
+        wx1, wy1 = ix - x0, iy - y0
+        wx0, wy0 = 1 - wx1, 1 - wy1
+
+        def sample(yy, xx):
+            valid = (xx >= 0) & (xx <= w - 1) & (yy >= 0) & (yy <= h - 1)
+            xi = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+            yi = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+            v = a[jnp.arange(n)[:, None, None], :, yi, xi]  # [n,hg,wg,c]
+            return jnp.where(valid[..., None], v, 0.0)
+
+        out = (sample(y0, x0) * (wx0 * wy0)[..., None]
+               + sample(y0, x1) * (wx1 * wy0)[..., None]
+               + sample(y1, x0) * (wx0 * wy1)[..., None]
+               + sample(y1, x1) * (wx1 * wy1)[..., None])
+        return out.transpose(0, 3, 1, 2)
+    return apply_op("grid_sample", _gs, x, grid)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    def _ag(th):
+        n, _, _ = th.shape
+        h, w = int(out_shape[2]), int(out_shape[3])
+        if align_corners:
+            ys = jnp.linspace(-1, 1, h)
+            xs = jnp.linspace(-1, 1, w)
+        else:
+            ys = (jnp.arange(h) * 2 + 1) / h - 1
+            xs = (jnp.arange(w) * 2 + 1) / w - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)  # [h,w,3]
+        return jnp.einsum("hwk,njk->nhwj", base, th)
+    return apply_op("affine_grid", _ag, theta)

@@ -1,0 +1,187 @@
+"""Convolution functionals lowering to XLA conv_general_dilated (MXU path).
+
+Reference API: /root/reference/python/paddle/nn/functional/conv.py. The
+reference dispatches to cuDNN; here the op is a single lax.conv_general_dilated
+that XLA tiles onto the MXU (bf16-friendly).
+Kernel layout is paddle's OIHW; data layout NCHW or NHWC via data_format.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import apply_op
+
+
+def _tuplize(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    return tuple(int(x) for x in v)
+
+
+def _pad_spec(padding, n, strides, input_spatial, kernel_spatial, dilation):
+    """Return lax padding spec for paddle padding argument."""
+    if isinstance(padding, str):
+        p = padding.upper()
+        if p == "VALID":
+            return [(0, 0)] * n
+        if p == "SAME":
+            out = []
+            for i in range(n):
+                eff_k = (kernel_spatial[i] - 1) * dilation[i] + 1
+                out_dim = -(-input_spatial[i] // strides[i])
+                total = max(0, (out_dim - 1) * strides[i] + eff_k - input_spatial[i])
+                out.append((total // 2, total - total // 2))
+            return out
+        raise ValueError(f"bad padding {padding}")
+    if isinstance(padding, (int, np.integer)):
+        return [(int(padding), int(padding))] * n
+    padding = list(padding)
+    if len(padding) == n:
+        if isinstance(padding[0], (list, tuple)):
+            return [tuple(p) for p in padding]
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _dim_numbers(n, data_format):
+    if data_format in ("NCHW", "NCL", "NCDHW"):
+        lhs = "NC" + "DHW"[3 - n:]
+        out = lhs
+    else:
+        lhs = "N" + "DHW"[3 - n:] + "C"
+        out = lhs
+    rhs = "OI" + "DHW"[3 - n:]
+    return (lhs, rhs, out)
+
+
+def _conv_nd(n, x, weight, bias, stride, padding, dilation, groups, data_format):
+    strides = _tuplize(stride, n)
+    dil = _tuplize(dilation, n)
+    channel_last = not data_format.startswith("NC")
+    dn_str = _dim_numbers(n, data_format)
+
+    def _conv(a, w, *maybe_bias):
+        spatial = a.shape[2:] if not channel_last else a.shape[1:-1]
+        ksp = w.shape[2:]
+        pads = _pad_spec(padding, n, strides, spatial, ksp, dil)
+        dn = jax.lax.conv_dimension_numbers(a.shape, w.shape, dn_str)
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=strides, padding=pads, rhs_dilation=dil,
+            dimension_numbers=dn, feature_group_count=groups,
+            preferred_element_type=None,
+        )
+        if maybe_bias:
+            b = maybe_bias[0]
+            shape = [1] * out.ndim
+            shape[1 if not channel_last else -1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    if bias is not None:
+        return apply_op(f"conv{n}d", _conv, x, weight, bias)
+    return apply_op(f"conv{n}d", _conv, x, weight)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv_nd(1, x, weight, bias, stride, padding, dilation, groups,
+                    data_format)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv_nd(2, x, weight, bias, stride, padding, dilation, groups,
+                    data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv_nd(3, x, weight, bias, stride, padding, dilation, groups,
+                    data_format)
+
+
+def _conv_transpose_nd(n, x, weight, bias, stride, padding, output_padding,
+                       dilation, groups, data_format, output_size=None):
+    strides = _tuplize(stride, n)
+    dil = _tuplize(dilation, n)
+    channel_last = not data_format.startswith("NC")
+    dn_str = _dim_numbers(n, data_format)
+    opad = _tuplize(output_padding, n) if not isinstance(output_padding, int) \
+        else (output_padding,) * n
+
+    def _convt(a, w, *maybe_bias):
+        spatial = a.shape[2:] if not channel_last else a.shape[1:-1]
+        ksp = w.shape[2:]
+        if isinstance(padding, str):
+            pads = _pad_spec(padding, n, strides, spatial, ksp, dil)
+        else:
+            pads = _pad_spec(padding, n, strides, spatial, ksp, dil)
+        # Gradient-of-conv formulation: lax.conv_transpose. Paddle weight
+        # layout for transpose conv is [in_c, out_c/groups, *k]; lax wants IO
+        # spec — use transpose_kernel=True with OIHW-style numbers swapped.
+        low_pads = []
+        for i in range(n):
+            eff_k = (ksp[i] - 1) * dil[i] + 1
+            lo = eff_k - 1 - pads[i][0]
+            hi = eff_k - 1 - pads[i][1] + opad[i]
+            low_pads.append((lo, hi))
+        if groups == 1:
+            wt = jnp.swapaxes(w, 0, 1)  # -> [out_c, in_c, *k]
+            wt = jnp.flip(wt, axis=tuple(range(2, 2 + n)))
+            dn = jax.lax.conv_dimension_numbers(a.shape, wt.shape, dn_str)
+            out = jax.lax.conv_general_dilated(
+                a, wt, window_strides=(1,) * n, padding=low_pads,
+                lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn)
+        else:
+            in_c = w.shape[0]
+            gsize = in_c // groups
+            outs = []
+            for g in range(groups):
+                wg = w[g * gsize:(g + 1) * gsize]
+                wt = jnp.swapaxes(wg, 0, 1)
+                wt = jnp.flip(wt, axis=tuple(range(2, 2 + n)))
+                if channel_last:
+                    ag = a[..., g * gsize:(g + 1) * gsize]
+                else:
+                    ag = a[:, g * gsize:(g + 1) * gsize]
+                dn = jax.lax.conv_dimension_numbers(ag.shape, wt.shape, dn_str)
+                outs.append(jax.lax.conv_general_dilated(
+                    ag, wt, window_strides=(1,) * n, padding=low_pads,
+                    lhs_dilation=strides, rhs_dilation=dil,
+                    dimension_numbers=dn))
+            out = jnp.concatenate(outs, axis=-1 if channel_last else 1)
+        if maybe_bias:
+            b = maybe_bias[0]
+            shape = [1] * out.ndim
+            shape[1 if not channel_last else -1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    if bias is not None:
+        return apply_op(f"conv{n}d_transpose", _convt, x, weight, bias)
+    return apply_op(f"conv{n}d_transpose", _convt, x, weight)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCL", name=None):
+    return _conv_transpose_nd(1, x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, data_format)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCHW", name=None):
+    return _conv_transpose_nd(2, x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, data_format)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCDHW", name=None):
+    return _conv_transpose_nd(3, x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, data_format)

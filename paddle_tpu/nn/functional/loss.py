@@ -1,0 +1,285 @@
+"""Loss functionals (reference: /root/reference/python/paddle/nn/functional/loss.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op
+from ...core.tensor import Tensor
+
+
+def _reduce(val, reduction):
+    if reduction == "mean":
+        return jnp.mean(val)
+    if reduction == "sum":
+        return jnp.sum(val)
+    return val
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",  # noqa: A002
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None):
+    def _ce(logits, lab, *w):
+        lp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else \
+            jnp.log(jnp.maximum(logits, 1e-30))
+        n_classes = logits.shape[axis]
+        if soft_label:
+            soft = lab
+            if label_smoothing > 0.0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / n_classes
+            loss = -jnp.sum(soft * lp, axis=axis)
+        else:
+            lab_i = lab.astype(jnp.int32)
+            if lab_i.ndim == lp.ndim:
+                lab_i = jnp.squeeze(lab_i, axis=axis)
+            if label_smoothing > 0.0:
+                oh = jax.nn.one_hot(lab_i, n_classes, axis=axis, dtype=lp.dtype)
+                soft = oh * (1 - label_smoothing) + label_smoothing / n_classes
+                loss = -jnp.sum(soft * lp, axis=axis)
+            else:
+                loss = -jnp.take_along_axis(
+                    lp, jnp.expand_dims(lab_i, axis), axis=axis
+                ).squeeze(axis)
+            mask = lab_i != ignore_index
+            loss = jnp.where(mask, loss, 0.0)
+            if w:
+                wt = jnp.take(w[0], jnp.clip(lab_i, 0, n_classes - 1))
+                wt = jnp.where(mask, wt, 0.0)
+                loss = loss * wt
+                if reduction == "mean":
+                    return jnp.sum(loss) / jnp.maximum(jnp.sum(wt), 1e-12)
+            if reduction == "mean":
+                # mean over NON-ignored tokens (paddle semantics) — applies
+                # for any ignore_index value incl. the default -100
+                cnt = jnp.maximum(jnp.sum(mask.astype(loss.dtype)), 1.0)
+                return jnp.sum(loss) / cnt
+        return _reduce(loss, reduction)
+
+    args = [input, label]
+    if weight is not None:
+        args.append(weight)
+    return apply_op("cross_entropy", _ce, *args)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    # paddle returns loss w/ trailing 1-dim kept
+    from .activation import softmax as _softmax
+    from ...tensor.manipulation import unsqueeze
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",  # noqa: A002
+             name=None):
+    def _nll(lp, lab, *w):
+        lab_i = lab.astype(jnp.int32)
+        loss = -jnp.take_along_axis(lp, lab_i[..., None] if lp.ndim == lab_i.ndim + 1
+                                    else lab_i, axis=1 if lp.ndim > 1 else 0)
+        loss = loss.squeeze(1) if loss.ndim > lab_i.ndim else loss
+        mask = lab_i != ignore_index
+        loss = jnp.where(mask, loss, 0.0)
+        if w:
+            wt = jnp.take(w[0], jnp.clip(lab_i, 0, w[0].shape[0] - 1))
+            wt = jnp.where(mask, wt, 0.0)
+            loss = loss * wt
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(wt), 1e-12)
+        return _reduce(loss, reduction)
+    args = [input, label]
+    if weight is not None:
+        args.append(weight)
+    return apply_op("nll_loss", _nll, *args)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):  # noqa: A002
+    def _bce(p, y, *w):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+    args = [input, label]
+    if weight is not None:
+        args.append(weight)
+    return apply_op("binary_cross_entropy", _bce, *args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def _bcewl(z, y, *extra):
+        idx = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = extra[idx]; idx += 1
+        if pos_weight is not None:
+            pw = extra[idx]
+        max_val = jnp.maximum(-z, 0.0)
+        if pw is not None:
+            log_w = (pw - 1.0) * y + 1.0
+            loss = (1 - y) * z + log_w * (jnp.log1p(jnp.exp(-jnp.abs(z))) + max_val)
+        else:
+            loss = jnp.maximum(z, 0.0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+    args = [logit, label]
+    if weight is not None:
+        args.append(weight)
+    if pos_weight is not None:
+        args.append(pos_weight)
+    return apply_op("bce_with_logits", _bcewl, *args)
+
+
+def mse_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return apply_op("mse_loss",
+                    lambda a, b: _reduce(jnp.square(a - b), reduction),
+                    input, label)
+
+
+def l1_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return apply_op("l1_loss",
+                    lambda a, b: _reduce(jnp.abs(a - b), reduction), input, label)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):  # noqa: A002
+    def _sl1(a, b):
+        # paddle semantics: 0.5*d^2/delta when |d| < delta, else |d| - 0.5*delta
+        d = a - b
+        abs_d = jnp.abs(d)
+        loss = jnp.where(abs_d < delta, 0.5 * d * d / delta, abs_d - 0.5 * delta)
+        return _reduce(loss, reduction)
+    return apply_op("smooth_l1_loss", _sl1, input, label)
+
+
+def kl_div(input, label, reduction="mean", name=None):  # noqa: A002
+    def _kl(lp, y):
+        loss = y * (jnp.log(jnp.maximum(y, 1e-12)) - lp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / lp.shape[0]
+        return _reduce(loss, reduction)
+    return apply_op("kl_div", _kl, input, label)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",  # noqa: A002
+                        name=None):
+    return apply_op(
+        "margin_ranking_loss",
+        lambda a, b, y: _reduce(jnp.maximum(0.0, -y * (a - b) + margin), reduction),
+        input, other, label)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):  # noqa: A002
+    return apply_op(
+        "hinge_embedding_loss",
+        lambda a, y: _reduce(jnp.where(y == 1, a, jnp.maximum(0.0, margin - a)),
+                             reduction),
+        input, label)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean",
+                          name=None):
+    def _cel(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / (
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1) + 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+    return apply_op("cosine_embedding_loss", _cel, input1, input2, label)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,  # noqa: A002
+                        epsilon=1e-06, swap=False, reduction="mean", name=None):
+    def _tml(a, pos, neg):
+        def dist(u, v):
+            return jnp.sum(jnp.abs(u - v) ** p + epsilon, axis=-1) ** (1.0 / p)
+        d_ap = dist(a, pos)
+        d_an = dist(a, neg)
+        if swap:
+            d_pn = dist(pos, neg)
+            d_an = jnp.minimum(d_an, d_pn)
+        return _reduce(jnp.maximum(0.0, d_ap - d_an + margin), reduction)
+    return apply_op("triplet_margin_loss", _tml, input, positive, negative)
+
+
+def log_loss(input, label, epsilon=0.0001, name=None):  # noqa: A002
+    return apply_op(
+        "log_loss",
+        lambda p, y: -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon),
+        input, label)
+
+
+def square_error_cost(input, label):  # noqa: A002
+    return apply_op("square_error_cost", lambda a, b: jnp.square(a - b),
+                    input, label)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def _sfl(z, y, *nrm):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0.0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if nrm:
+            loss = loss / nrm[0]
+        return _reduce(loss, reduction)
+    args = [logit, label]
+    if normalizer is not None:
+        args.append(normalizer)
+    return apply_op("sigmoid_focal_loss", _sfl, *args)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via the standard forward algorithm in log space (lax.scan over T)."""
+    def _ctc(lp, lab, in_len, lab_len):
+        # lp: [T, N, C] log-probs (paddle convention: logits; apply log_softmax)
+        lp = jax.nn.log_softmax(lp, axis=-1)
+        T, N, C = lp.shape
+        S = lab.shape[1]
+        ext_len = 2 * S + 1
+        ext = jnp.full((N, ext_len), blank, dtype=lab.dtype)
+        ext = ext.at[:, 1::2].set(lab)
+        neg_inf = jnp.asarray(-1e30, lp.dtype)
+
+        init = jnp.full((N, ext_len), neg_inf)
+        init = init.at[:, 0].set(lp[0, :, blank])
+        init = init.at[:, 1].set(
+            jnp.take_along_axis(lp[0], lab[:, :1], axis=1)[:, 0])
+
+        same_as_prev2 = jnp.concatenate(
+            [jnp.ones((N, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        def step(alpha, lp_t):
+            a_prev1 = jnp.concatenate([jnp.full((N, 1), neg_inf), alpha[:, :-1]],
+                                      axis=1)
+            a_prev2 = jnp.concatenate([jnp.full((N, 2), neg_inf), alpha[:, :-2]],
+                                      axis=1)
+            a_prev2 = jnp.where(same_as_prev2, neg_inf, a_prev2)
+            merged = jnp.logaddexp(alpha, jnp.logaddexp(a_prev1, a_prev2))
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            return merged + emit, None
+
+        def scan_step(carry, t):
+            alpha = carry
+            new_alpha, _ = step(alpha, lp[t])
+            alpha = jnp.where((t >= 1) & (t < in_len)[:, None], new_alpha, alpha)
+            return alpha, None
+
+        alpha, _ = jax.lax.scan(scan_step, init, jnp.arange(T))
+        last = 2 * lab_len - 1
+        ll_last = jnp.take_along_axis(alpha, (last + 1)[:, None], axis=1)[:, 0]
+        ll_prev = jnp.take_along_axis(alpha, last[:, None], axis=1)[:, 0]
+        nll = -jnp.logaddexp(ll_last, ll_prev)
+        if reduction == "mean":
+            return jnp.mean(nll / lab_len.astype(nll.dtype))
+        return _reduce(nll, reduction)
+    return apply_op("ctc_loss", _ctc, log_probs, labels, input_lengths,
+                    label_lengths)

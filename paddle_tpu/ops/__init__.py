@@ -1,0 +1,2 @@
+"""Custom TPU kernels (Pallas) — the framework's analog of the reference's
+fused CUDA ops (/root/reference/paddle/fluid/operators/fused/)."""

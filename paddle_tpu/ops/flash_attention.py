@@ -1,0 +1,48 @@
+"""Flash attention for TPU.
+
+Replaces the reference's CUDA flash_attn binding
+(/root/reference/paddle/phi/backends/dynload/flashattn.cc). A Pallas kernel
+implementation lands behind `flash_attention_bshd`; `supported()` gates usage
+by platform/shape so callers can fall back to the XLA softmax path.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform.lower() not in ("cpu",)
+    except Exception:  # pragma: no cover
+        return False
+
+
+def supported(q, k, v, mask, causal) -> bool:
+    if mask is not None:
+        return False
+    if not _on_tpu():
+        return False
+    # block constraints: seq multiple of 128, head_dim in {64,128,256}
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if d not in (64, 128, 256):
+        return False
+    if sq % 128 != 0 or sk % 128 != 0:
+        return False
+    return True
+
+
+def flash_attention_bshd(q, k, v, causal=False, scale=None):
+    """[B,S,H,D] layout wrapper over the BHSD pallas kernel."""
+    from .pallas_attention import mha
+
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    out = mha(qt, kt, vt, causal=causal, sm_scale=s)
+    return jnp.swapaxes(out, 1, 2)

@@ -1,0 +1,134 @@
+"""Pallas flash-attention (TPU) — forward kernel with online softmax.
+
+Design: grid (batch*heads, q_blocks); each program streams K/V blocks through
+VMEM with a fori_loop, keeping running max/denominator (classic
+flash-attention). bf16 inputs accumulate in f32 on the MXU. Backward uses a
+custom VJP that recomputes attention with the XLA einsum path (a Pallas
+backward kernel is a later optimization).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _mha_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, block_k,
+                    kv_len):
+    # q_ref: [block_q, d]; k_ref/v_ref: [kv_len, d]; o_ref: [block_q, d]
+    block_q = q_ref.shape[0]
+    d = q_ref.shape[1]
+    q = q_ref[:].astype(jnp.float32) * sm_scale
+    q_idx = pl.program_id(1)
+
+    m_init = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l_init = jnp.zeros((block_q,), jnp.float32)
+    acc_init = jnp.zeros((block_q, d), jnp.float32)
+
+    num_kb = kv_len // block_k
+
+    def body(kb, carry):
+        m_prev, l_prev, acc = carry
+        k = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_cur = jnp.max(s, axis=1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return m_new, l_new, acc
+
+    if causal:
+        # only loop over blocks at/below the diagonal
+        last_kb = jax.lax.div((q_idx + 1) * block_q + block_k - 1, block_k)
+        last_kb = jnp.minimum(last_kb, num_kb)
+    else:
+        last_kb = num_kb
+
+    m, l, acc = jax.lax.fori_loop(0, last_kb, body,
+                                  (m_init, l_init, acc_init))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[:] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def _mha_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    qr = q.reshape(b * h, sq, d)
+    kr = k.reshape(b * h, sk, d)
+    vr = v.reshape(b * h, sk, d)
+
+    kernel = functools.partial(_mha_fwd_kernel, sm_scale=sm_scale,
+                               causal=causal, block_k=block_k, kv_len=sk)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((None, sk, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((None, sk, d), lambda bh, i: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+    )(qr, kr, vr)
+    return out.reshape(b, h, sq, d)
+
+
+def _mha_reference(q, k, v, causal, sm_scale):
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * sm_scale
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(mask, logits, NEG_INF)
+    p = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def mha(q, k, v, causal=False, sm_scale=None, block_q=DEFAULT_BLOCK_Q,
+        block_k=DEFAULT_BLOCK_K):
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    return _mha_fwd(q, k, v, causal, sm_scale, block_q, block_k)
+
+
+def _mha_vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    out = _mha_fwd(q, k, v, causal, sm_scale, block_q, block_k)
+    return out, (q, k, v)
+
+
+def _mha_vjp_bwd(causal, sm_scale, block_q, block_k, res, g):
+    q, k, v = res
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    _, vjp_fn = jax.vjp(
+        lambda qq, kk, vv: _mha_reference(qq, kk, vv, causal, sm_scale),
+        q, k, v)
+    return vjp_fn(g)
+
+
+mha.defvjp(_mha_vjp_fwd, _mha_vjp_bwd)

@@ -1,0 +1,371 @@
+"""Optimizer base + standard optimizers
+(reference: /root/reference/python/paddle/optimizer/optimizer.py:91).
+
+Updates are pure jax functions jitted once per (optimizer, param-shape/dtype)
+and applied to the raw arrays — functional inside, stateful paddle API outside
+(accumulators, grad clip, regularization, LR schedulers). Under
+paddle_tpu.jit the same ``_update_rule`` runs traced, so one code path serves
+eager and compiled training.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import no_grad
+from ..core.tensor import Tensor
+from .lr import LRScheduler
+
+
+class Optimizer:
+    _accum_names: List[str] = []
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        self._lr = learning_rate
+        self._parameters = list(parameters) if parameters is not None else None
+        self._grad_clip = grad_clip
+        if isinstance(weight_decay, (int, float)) or weight_decay is None:
+            self._l2_coeff = float(weight_decay or 0.0)
+            self._wd_obj = None
+        else:
+            self._wd_obj = weight_decay  # L1Decay / L2Decay object
+            self._l2_coeff = getattr(weight_decay, "coeff", 0.0)
+        # name -> param_id -> jax array
+        self._accumulators: Dict[str, Dict[int, jax.Array]] = {}
+        self._step_count = 0
+
+    # ------------- lr -------------
+    def get_lr(self) -> float:
+        if isinstance(self._lr, LRScheduler):
+            return float(self._lr())
+        return float(self._lr)
+
+    def set_lr(self, value):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError(
+                "set_lr cannot be used while the lr is an LRScheduler")
+        self._lr = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._lr = scheduler
+
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+    # ------------- accumulators -------------
+    def _get_accum(self, name, p, init=None):
+        store = self._accumulators.setdefault(name, {})
+        pid = id(p)
+        if pid not in store:
+            store[pid] = jnp.zeros_like(p._data) if init is None else init
+        return store[pid]
+
+    def _set_accum(self, name, p, value):
+        self._accumulators[name][id(p)] = value
+
+    # ------------- the update -------------
+    def _update_rule(self, p_data, grad, lr, t, wd, state: dict) -> tuple:
+        """Return (new_p, new_state). Pure function of arrays; ``wd`` is the
+        traced decoupled weight-decay coefficient (0 when gated off)."""
+        raise NotImplementedError
+
+    @no_grad()
+    def step(self):
+        params = self._parameters
+        if params is None:
+            raise ValueError(
+                "Optimizer created without parameters; pass parameters=")
+        params_grads = [(p, p.grad) for p in params
+                        if not p.stop_gradient and p.grad is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr_val = self.get_lr()
+        self._step_count += 1
+        for p, g in params_grads:
+            if g is None:
+                continue
+            g_arr = g._data if isinstance(g, Tensor) else g
+            if g_arr.dtype != p._data.dtype:
+                g_arr = g_arr.astype(p._data.dtype)
+            # regularization: per-param regularizer wins over the optimizer's
+            # weight_decay (paddle precedence); decay objects (L1Decay/
+            # L2Decay) apply their own rule
+            p_reg = getattr(p, "regularizer", None)
+            if p_reg is not None:
+                g_arr = p_reg.apply(g_arr, p._data)
+            elif self._wd_obj is not None:
+                g_arr = self._wd_obj.apply(g_arr, p._data)
+            elif self._l2_coeff and not self._decoupled_wd():
+                g_arr = g_arr + self._l2_coeff * p._data
+            p_lr = lr_val * getattr(p, "optimize_attr",
+                                    {"learning_rate": 1.0})["learning_rate"]
+            state = {name: self._get_accum(name, p)
+                     for name in self._accum_names}
+            new_p, new_state = self._apply_jit(
+                p._data, g_arr, jnp.asarray(p_lr, jnp.float32),
+                jnp.asarray(self._step_count, jnp.int32),
+                jnp.asarray(self._wd_for(p), jnp.float32), state)
+            p._data = new_p
+            for name in self._accum_names:
+                self._set_accum(name, p, new_state[name])
+
+    def _decoupled_wd(self):
+        return False
+
+    def _wd_for(self, p) -> float:
+        """Decoupled weight decay coefficient for this param (AdamW-style)."""
+        return 0.0
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def _apply_jit(self, p, g, lr, t, wd, state):
+        return self._update_rule(p, g, lr, t, wd, state)
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in (parameters or self._parameters)]
+
+    @no_grad()
+    def clear_grad(self, set_to_zero=True):
+        if self._parameters:
+            for p in self._parameters:
+                p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    # ------------- state dict -------------
+    def state_dict(self):
+        sd = {}
+        for name, store in self._accumulators.items():
+            for i, p in enumerate(self._parameters or []):
+                if id(p) in store:
+                    sd[f"{p.name}_{name}"] = Tensor(store[id(p)])
+        if isinstance(self._lr, LRScheduler):
+            sd["LR_Scheduler"] = self._lr.state_dict()
+        sd["@step"] = self._step_count
+        return sd
+
+    def set_state_dict(self, state_dict):
+        for p in self._parameters or []:
+            for name in self._accum_names:
+                key = f"{p.name}_{name}"
+                if key in state_dict:
+                    v = state_dict[key]
+                    arr = v._data if isinstance(v, Tensor) else jnp.asarray(v)
+                    self._accumulators.setdefault(name, {})[id(p)] = arr
+        if "LR_Scheduler" in state_dict and isinstance(self._lr, LRScheduler):
+            self._lr.set_state_dict(state_dict["LR_Scheduler"])
+        self._step_count = int(state_dict.get("@step", self._step_count))
+
+    set_dict = set_state_dict
+
+
+class SGD(Optimizer):
+    _accum_names: List[str] = []
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    def _update_rule(self, p, g, lr, t, wd, state):
+        return p - lr.astype(p.dtype) * g, state
+
+
+class Momentum(Optimizer):
+    _accum_names = ["velocity"]
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, rescale_grad=1.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _update_rule(self, p, g, lr, t, wd, state):
+        v = state["velocity"]
+        lr = lr.astype(p.dtype)
+        v_new = self._momentum * v + g
+        if self._nesterov:
+            p_new = p - lr * (g + self._momentum * v_new)
+        else:
+            p_new = p - lr * v_new
+        return p_new, {"velocity": v_new}
+
+
+class Adam(Optimizer):
+    _accum_names = ["moment1", "moment2"]
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._eps = epsilon
+
+    def _update_rule(self, p, g, lr, t, wd, state):
+        m, v = state["moment1"], state["moment2"]
+        g32 = g.astype(jnp.float32)
+        m = self._beta1 * m + (1 - self._beta1) * g32
+        v = self._beta2 * v + (1 - self._beta2) * jnp.square(g32)
+        tf = t.astype(jnp.float32)
+        m_hat = m / (1 - self._beta1 ** tf)
+        v_hat = v / (1 - self._beta2 ** tf)
+        upd = lr * m_hat / (jnp.sqrt(v_hat) + self._eps)
+        return (p.astype(jnp.float32) - upd).astype(p.dtype), \
+            {"moment1": m, "moment2": v}
+
+    def _get_accum(self, name, p, init=None):
+        store = self._accumulators.setdefault(name, {})
+        pid = id(p)
+        if pid not in store:
+            store[pid] = jnp.zeros(p._data.shape, jnp.float32)
+        return store[pid]
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip)
+        self._wd = float(weight_decay) if not hasattr(weight_decay, "coeff") \
+            else weight_decay.coeff
+        self._apply_decay_fn = apply_decay_param_fun
+
+    def _decoupled_wd(self):
+        return True
+
+    def _wd_for(self, p) -> float:
+        if self._apply_decay_fn is not None and \
+                not self._apply_decay_fn(p.name):
+            return 0.0
+        return self._wd
+
+    def _update_rule(self, p, g, lr, t, wd, state):
+        m, v = state["moment1"], state["moment2"]
+        g32 = g.astype(jnp.float32)
+        m = self._beta1 * m + (1 - self._beta1) * g32
+        v = self._beta2 * v + (1 - self._beta2) * jnp.square(g32)
+        tf = t.astype(jnp.float32)
+        m_hat = m / (1 - self._beta1 ** tf)
+        v_hat = v / (1 - self._beta2 ** tf)
+        p32 = p.astype(jnp.float32)
+        p32 = p32 * (1.0 - lr * wd)
+        upd = lr * m_hat / (jnp.sqrt(v_hat) + self._eps)
+        return (p32 - upd).astype(p.dtype), {"moment1": m, "moment2": v}
+
+
+class Adagrad(Optimizer):
+    _accum_names = ["moment"]
+
+    def __init__(self, learning_rate, epsilon=1e-06, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._eps = epsilon
+        self._init_val = initial_accumulator_value
+
+    def _update_rule(self, p, g, lr, t, wd, state):
+        mom = state["moment"] + jnp.square(g)
+        p_new = p - lr.astype(p.dtype) * g / (jnp.sqrt(mom) + self._eps)
+        return p_new, {"moment": mom}
+
+
+class Adadelta(Optimizer):
+    _accum_names = ["avg_squared_grad", "avg_squared_update"]
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-06, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._eps = epsilon
+        self._rho = rho
+
+    def _update_rule(self, p, g, lr, t, wd, state):
+        sq_g = self._rho * state["avg_squared_grad"] + (1 - self._rho) * g * g
+        upd = g * jnp.sqrt(state["avg_squared_update"] + self._eps) / \
+            jnp.sqrt(sq_g + self._eps)
+        sq_u = self._rho * state["avg_squared_update"] + (1 - self._rho) * upd * upd
+        return p - lr.astype(p.dtype) * upd, \
+            {"avg_squared_grad": sq_g, "avg_squared_update": sq_u}
+
+
+class Adamax(Optimizer):
+    _accum_names = ["moment", "inf_norm"]
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _update_rule(self, p, g, lr, t, wd, state):
+        m = self._beta1 * state["moment"] + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(g))
+        tf = t.astype(jnp.float32)
+        lr_t = (lr / (1 - self._beta1 ** tf)).astype(p.dtype)
+        p_new = p - lr_t * m / (u + self._eps)
+        return p_new, {"moment": m, "inf_norm": u}
+
+
+class RMSProp(Optimizer):
+    _accum_names = ["mean_square", "mean_grad", "momentum_acc"]
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-06, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho, self._eps = rho, epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _update_rule(self, p, g, lr, t, wd, state):
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * g * g
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * g
+            denom = jnp.sqrt(ms - mg * mg + self._eps)
+        else:
+            mg = state["mean_grad"]
+            denom = jnp.sqrt(ms + self._eps)
+        mom = self._momentum * state["momentum_acc"] + \
+            lr.astype(p.dtype) * g / denom
+        return p - mom, {"mean_square": ms, "mean_grad": mg,
+                         "momentum_acc": mom}
+
+
+class Lamb(Optimizer):
+    _accum_names = ["moment1", "moment2"]
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-06, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _wd_for(self, p) -> float:
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            return 0.0
+        return self._lamb_wd
+
+    def _update_rule(self, p, g, lr, t, wd, state):
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * g * g
+        tf = t.astype(jnp.float32)
+        m_hat = m / (1 - self._beta1 ** tf)
+        v_hat = v / (1 - self._beta2 ** tf)
+        r = m_hat / (jnp.sqrt(v_hat) + self._eps) + wd.astype(p.dtype) * p
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+        r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return p - lr.astype(p.dtype) * trust * r, \
+            {"moment1": m, "moment2": v}

@@ -1,0 +1,220 @@
+"""Profiler (reference: /root/reference/python/paddle/profiler/profiler.py:344
++ platform/profiler/ C++ tracers). TPU-native: host spans are recorded by a
+lightweight in-process tracer (chrome-trace export), device activity comes
+from jax.profiler (XPlane/xprof) when a trace dir is given — the analog of the
+reference's HostTracer + CudaTracer pair.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from enum import Enum
+from typing import Callable, Iterable, Optional
+
+import jax
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class SummaryView(Enum):
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+class _HostTracer:
+    """In-process span recorder (analog of reference HostTracer,
+    /root/reference/paddle/fluid/platform/profiler/host_tracer.h:26)."""
+
+    def __init__(self):
+        self.events = []
+        self._lock = threading.Lock()
+        self.enabled = False
+
+    def add(self, name, start_ns, end_ns, tid):
+        if not self.enabled:
+            return
+        with self._lock:
+            self.events.append(
+                {"name": name, "ph": "X", "ts": start_ns / 1e3,
+                 "dur": (end_ns - start_ns) / 1e3, "pid": os.getpid(),
+                 "tid": tid})
+
+    def export_chrome_tracing(self, path):
+        with open(path, "w") as f:
+            json.dump({"traceEvents": self.events}, f)
+
+
+_tracer = _HostTracer()
+
+
+class RecordEvent:
+    """Span marker usable as context manager or begin/end pair — same surface
+    as paddle.profiler.RecordEvent; also emits a jax named span so device
+    traces correlate."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._jax_ctx = None
+        self._start = None
+
+    def begin(self):
+        self._start = time.perf_counter_ns()
+        try:
+            self._jax_ctx = jax.named_scope(self.name)
+            self._jax_ctx.__enter__()
+        except Exception:
+            self._jax_ctx = None
+
+    def end(self):
+        if self._jax_ctx is not None:
+            self._jax_ctx.__exit__(None, None, None)
+            self._jax_ctx = None
+        if self._start is not None:
+            _tracer.add(self.name, self._start, time.perf_counter_ns(),
+                        threading.get_ident())
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    def scheduler(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        cycle = closed + ready + record
+        if repeat and s >= cycle * repeat:
+            return ProfilerState.CLOSED
+        pos = s % cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    os.makedirs(dir_name, exist_ok=True)
+
+    def handler(prof):
+        name = worker_name or f"worker_{os.getpid()}"
+        _tracer.export_chrome_tracing(
+            os.path.join(dir_name, f"{name}_{int(time.time())}.json"))
+
+    return handler
+
+
+def export_protobuf(dir_name: str, worker_name: Optional[str] = None):
+    return export_chrome_tracing(dir_name, worker_name)
+
+
+class Profiler:
+    def __init__(self, *, targets: Optional[Iterable] = None, scheduler=None,
+                 on_trace_ready=None, record_shapes=False, profile_memory=False,
+                 timer_only=False, emit_nvtx=False, custom_device_types=None,
+                 with_flops=False):
+        self._scheduler = scheduler if callable(scheduler) else (
+            make_scheduler(closed=0, ready=0, record=scheduler[1] - scheduler[0],
+                           skip_first=scheduler[0])
+            if isinstance(scheduler, (tuple, list)) else None)
+        self._on_trace_ready = on_trace_ready
+        self._step = 0
+        self._jax_trace_dir = None
+        self.timer_only = timer_only
+        self._step_times = []
+        self._last_step_t = None
+
+    def start(self):
+        _tracer.enabled = True
+        _tracer.events.clear()
+        self._last_step_t = time.perf_counter()
+        if not self.timer_only:
+            self._jax_trace_dir = os.environ.get(
+                "PADDLE_TPU_TRACE_DIR", "/tmp/paddle_tpu_trace")
+            try:
+                jax.profiler.start_trace(self._jax_trace_dir)
+            except Exception:
+                self._jax_trace_dir = None
+
+    def stop(self):
+        _tracer.enabled = False
+        if self._jax_trace_dir is not None:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._jax_trace_dir = None
+        if self._on_trace_ready is not None:
+            self._on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._last_step_t is not None:
+            self._step_times.append(now - self._last_step_t)
+        self._last_step_t = now
+        self._step += 1
+
+    def step_info(self, unit=None):
+        if not self._step_times:
+            return ""
+        import numpy as np
+        ts = np.asarray(self._step_times[-10:])
+        return (f"avg step time {ts.mean()*1000:.2f} ms "
+                f"(min {ts.min()*1000:.2f}, max {ts.max()*1000:.2f})")
+
+    def export(self, path, format=None):  # noqa: A002
+        _tracer.export_chrome_tracing(path)
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms", views=None):
+        from collections import defaultdict
+        agg = defaultdict(lambda: [0.0, 0])
+        for e in _tracer.events:
+            agg[e["name"]][0] += e["dur"]
+            agg[e["name"]][1] += 1
+        lines = ["name\ttotal_us\tcalls"]
+        for name, (dur, calls) in sorted(agg.items(), key=lambda kv: -kv[1][0]):
+            lines.append(f"{name}\t{dur:.1f}\t{calls}")
+        table = "\n".join(lines)
+        print(table)
+        return table
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

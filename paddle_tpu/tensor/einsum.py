@@ -1,0 +1,14 @@
+"""einsum (reference: /root/reference/python/paddle/tensor/einsum.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+
+
+def einsum(equation, *operands, name=None):
+    if len(operands) == 1 and isinstance(operands[0], (list, tuple)):
+        operands = tuple(operands[0])
+    return apply_op("einsum",
+                    lambda *xs: jnp.einsum(equation, *xs, optimize="optimal"),
+                    *operands)

@@ -1,0 +1,268 @@
+"""Linear algebra ops (reference: /root/reference/python/paddle/tensor/linalg.py).
+
+matmul (linalg.py:138 in the reference) lowers straight to jnp.matmul → XLA
+dot_general on the MXU; precision is controlled by FLAGS_tpu_matmul_precision.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+from ..framework.flags import flag_value
+
+
+def _precision():
+    p = flag_value("FLAGS_tpu_matmul_precision")
+    return None if p == "default" else p
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def _matmul(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b
+        return jnp.matmul(a, b, precision=_precision())
+    return apply_op("matmul", _matmul, x, y)
+
+
+def mm(input, mat2, name=None):  # noqa: A002
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def dot(x, y, name=None):
+    return apply_op("dot", lambda a, b: jnp.sum(a * b, axis=-1), x, y)
+
+
+def mv(x, vec, name=None):
+    return apply_op("mv", lambda a, v: jnp.matmul(a, v, precision=_precision()),
+                    x, vec)
+
+
+def t(input, name=None):  # noqa: A002
+    return apply_op("t", lambda a: a.T if a.ndim == 2 else a, input)
+
+
+def transpose_last2(x):
+    return apply_op("T", lambda a: jnp.swapaxes(a, -1, -2), x)
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    def _norm(a):
+        if p == "fro" or (p == 2 and axis is None):
+            if axis is None:
+                return jnp.sqrt(jnp.sum(jnp.square(a)))
+            return jnp.linalg.norm(a, ord=2 if not isinstance(axis, (list, tuple))
+                                   else "fro", axis=_ax(axis), keepdims=keepdim)
+        if p == np.inf or p == float("inf"):
+            return jnp.max(jnp.abs(a), axis=_ax(axis), keepdims=keepdim)
+        if p == -np.inf or p == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=_ax(axis), keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=_ax(axis), keepdims=keepdim)
+        return jnp.sum(jnp.abs(a) ** p, axis=_ax(axis), keepdims=keepdim) ** (1.0 / p)
+    return apply_op("norm", _norm, x)
+
+
+def _ax(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def dist(x, y, p=2, name=None):
+    def _dist(a, b):
+        d = a - b
+        if p == 0:
+            return jnp.sum((d != 0).astype(d.dtype)).astype(d.dtype)
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d))
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(d))
+        return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+    return apply_op("dist", _dist, x, y)
+
+
+def cond(x, p=None, name=None):
+    return apply_op("cond", lambda a: jnp.linalg.cond(a, p=p), x)
+
+
+def cholesky(x, upper=False, name=None):
+    def _chol(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2).conj() if upper else L
+    return apply_op("cholesky", _chol, x)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def _cs(b, L):
+        Lm = jnp.swapaxes(L, -1, -2).conj() if upper else L
+        z = jax.scipy.linalg.solve_triangular(Lm, b, lower=True)
+        return jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(Lm, -1, -2).conj(), z, lower=False)
+    return apply_op("cholesky_solve", _cs, x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    def _ts(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return apply_op("triangular_solve", _ts, x, y)
+
+
+def solve(x, y, name=None):
+    return apply_op("solve", jnp.linalg.solve, x, y)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def _lstsq(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank, sv
+    return apply_op("lstsq", _lstsq, x, y)
+
+
+def inv(x, name=None):
+    return apply_op("inv", jnp.linalg.inv, x)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply_op("pinv",
+                    lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), x)
+
+
+def det(x, name=None):
+    return apply_op("det", jnp.linalg.det, x)
+
+
+def slogdet(x, name=None):
+    def _slogdet(a):
+        s, ld = jnp.linalg.slogdet(a)
+        return jnp.stack([s, ld])
+    return apply_op("slogdet", _slogdet, x)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply_op("matrix_rank",
+                    lambda a: jnp.linalg.matrix_rank(a, tol=tol), x)
+
+
+def matrix_power(x, n, name=None):
+    return apply_op("matrix_power", lambda a: jnp.linalg.matrix_power(a, n), x)
+
+
+def qr(x, mode="reduced", name=None):
+    return apply_op("qr", lambda a: tuple(jnp.linalg.qr(a, mode=mode)), x)
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply_op("svd",
+                    lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)), x)
+
+
+def eig(x, name=None):
+    def _eig(a):
+        # XLA TPU lacks general eig; do it on host cpu via numpy bridge
+        w, v = np.linalg.eig(np.asarray(a))
+        return jnp.asarray(w), jnp.asarray(v)
+    arr = x._data if isinstance(x, Tensor) else x
+    w, v = np.linalg.eig(np.asarray(arr))
+    return Tensor(w), Tensor(v)
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply_op("eigh", lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), x)
+
+
+def eigvals(x, name=None):
+    arr = x._data if isinstance(x, Tensor) else x
+    return Tensor(np.linalg.eigvals(np.asarray(arr)))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply_op("eigvalsh", lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), x)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def _lu(a):
+        lu_mat, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_mat, (piv + 1).astype(jnp.int32)
+    outs = apply_op("lu", _lu, x)
+    if get_infos:
+        z = Tensor(jnp.zeros((), jnp.int32))
+        return outs[0], outs[1], z
+    return outs
+
+
+def multi_dot(tensors, name=None):
+    return apply_op("multi_dot", lambda *xs: jnp.linalg.multi_dot(xs), *tensors)
+
+
+def cross(x, y, axis=9, name=None):
+    def _cross(a, b):
+        ax = axis
+        if ax == 9:
+            ax = next(i for i, d in enumerate(a.shape) if d == 3)
+        return jnp.cross(a, b, axis=ax)
+    return apply_op("cross", _cross, x, y)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):  # noqa: A002
+    arr = np.asarray(input._data if isinstance(input, Tensor) else input)
+    lo, hi = (min, max) if (min != 0 or max != 0) else (arr.min(), arr.max())
+    h, _ = np.histogram(arr, bins=bins, range=(lo, hi))
+    return Tensor(h.astype(np.int64))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    arr = np.asarray(x._data if isinstance(x, Tensor) else x)
+    w = np.asarray(weights._data) if isinstance(weights, Tensor) else weights
+    return Tensor(np.bincount(arr, weights=w, minlength=minlength))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply_op("corrcoef", lambda a: jnp.corrcoef(a, rowvar=rowvar), x)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply_op("cov", lambda a: jnp.cov(a, rowvar=rowvar,
+                                             ddof=1 if ddof else 0), x)
+
+
+def matrix_exp(x, name=None):
+    return apply_op("matrix_exp", jax.scipy.linalg.expm, x)
+
+
+def householder_product(x, tau, name=None):
+    def _hp(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+        q = jnp.broadcast_to(eye, a.shape[:-2] + (m, m)).copy() if a.ndim > 2 else eye
+        def body(i, q):
+            v = jnp.where(jnp.arange(m) > i, a[..., i], 0.0)
+            v = v.at[..., i].set(1.0) if v.ndim == 1 else v
+            H = eye - t[..., i][..., None, None] * (v[..., None] * v[..., None, :])
+            return q @ H
+        for i in range(n):
+            q = body(i, q)
+        return q[..., :n]
+    return apply_op("householder_product", _hp, x, tau)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    def _pca(a):
+        qq = q if q is not None else min(6, a.shape[-2], a.shape[-1])
+        b = a - jnp.mean(a, axis=-2, keepdims=True) if center else a
+        u, s, vt = jnp.linalg.svd(b, full_matrices=False)
+        return u[..., :qq], s[..., :qq], jnp.swapaxes(vt, -1, -2)[..., :qq]
+    return apply_op("pca_lowrank", _pca, x)

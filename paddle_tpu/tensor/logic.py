@@ -1,0 +1,81 @@
+"""Comparison & logical ops (reference: /root/reference/python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply_op, unwrap
+from ..core.tensor import Tensor
+
+
+def _cmp(name, fn):
+    def op(x, y, name=None):
+        return apply_op(name, fn, x, y)
+    op.__name__ = name
+    return op
+
+
+equal = _cmp("equal", jnp.equal)
+not_equal = _cmp("not_equal", jnp.not_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+logical_and = _cmp("logical_and", jnp.logical_and)
+logical_or = _cmp("logical_or", jnp.logical_or)
+logical_xor = _cmp("logical_xor", jnp.logical_xor)
+bitwise_and = _cmp("bitwise_and", jnp.bitwise_and)
+bitwise_or = _cmp("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _cmp("bitwise_xor", jnp.bitwise_xor)
+
+
+def logical_not(x, out=None, name=None):
+    return apply_op("logical_not", jnp.logical_not, x)
+
+
+def bitwise_not(x, out=None, name=None):
+    return apply_op("bitwise_not", jnp.bitwise_not, x)
+
+
+def bitwise_left_shift(x, y, is_arithmetic=True, out=None, name=None):
+    return apply_op("bitwise_left_shift", jnp.left_shift, x, y)
+
+
+def bitwise_right_shift(x, y, is_arithmetic=True, out=None, name=None):
+    return apply_op("bitwise_right_shift", jnp.right_shift, x, y)
+
+
+def equal_all(x, y, name=None):
+    return apply_op("equal_all", lambda a, b: jnp.array_equal(a, b), x, y)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op("allclose",
+                    lambda a, b: jnp.allclose(a, b, rtol=float(unwrap(rtol)),
+                                              atol=float(unwrap(atol)),
+                                              equal_nan=equal_nan), x, y)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op("isclose",
+                    lambda a, b: jnp.isclose(a, b, rtol=float(unwrap(rtol)),
+                                             atol=float(unwrap(atol)),
+                                             equal_nan=equal_nan), x, y)
+
+
+def all(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    from .math import _axis
+    return apply_op("all", lambda a: jnp.all(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def any(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    from .math import _axis
+    return apply_op("any", lambda a: jnp.any(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def is_empty(x, name=None):
+    return Tensor(np.asarray(x.size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

@@ -1,0 +1,500 @@
+"""Shape / layout manipulation ops
+(reference: /root/reference/python/paddle/tensor/manipulation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply_op, unwrap
+from ..core.tensor import Tensor
+from ..framework import dtype as dtype_mod
+
+
+slice_builtin = slice  # capture the builtin before `slice` op shadows it
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return [int(s) for s in shape.numpy().tolist()]
+    out = []
+    for s in shape:
+        if isinstance(s, Tensor):
+            out.append(int(s.item()))
+        else:
+            out.append(int(s))
+    return out
+
+
+def reshape(x, shape, name=None):
+    s = _shape_list(shape)
+    return apply_op("reshape", lambda a: jnp.reshape(a, s), x)
+
+
+def reshape_(x, shape, name=None):
+    from .math import _inplace
+    return _inplace(x, reshape(x, shape))
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def _flatten(a):
+        nd = a.ndim
+        st = start_axis % nd if nd else 0
+        sp = stop_axis % nd if nd else 0
+        new_shape = list(a.shape[:st]) + [-1] + list(a.shape[sp + 1:])
+        return jnp.reshape(a, new_shape)
+    return apply_op("flatten", _flatten, x)
+
+
+def transpose(x, perm, name=None):
+    return apply_op("transpose", lambda a: jnp.transpose(a, axes=list(perm)), x)
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply_op("moveaxis", lambda a: jnp.moveaxis(a, source, destination), x)
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    return apply_op("swapaxes", lambda a: jnp.swapaxes(a, axis1, axis2), x)
+
+
+transpose_ = transpose
+
+
+def unsqueeze(x, axis, name=None):
+    ax = axis if isinstance(axis, (list, tuple)) else [axis]
+    def _unsq(a):
+        out = a
+        for i in sorted(int(v) if v >= 0 else int(v) for v in ax):
+            out = jnp.expand_dims(out, i)
+        return out
+    return apply_op("unsqueeze", _unsq, x)
+
+
+def squeeze(x, axis=None, name=None):
+    def _sq(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        ax = axis if isinstance(axis, (list, tuple)) else [axis]
+        ax = tuple(int(v) % a.ndim for v in ax if a.shape[int(v) % a.ndim] == 1)
+        return jnp.squeeze(a, axis=ax) if ax else a
+    return apply_op("squeeze", _sq, x)
+
+
+def unsqueeze_(x, axis, name=None):
+    from .math import _inplace
+    return _inplace(x, unsqueeze(x, axis))
+
+
+def squeeze_(x, axis=None, name=None):
+    from .math import _inplace
+    return _inplace(x, squeeze(x, axis))
+
+
+def concat(x, axis=0, name=None):
+    axis = int(unwrap(axis)) if isinstance(axis, Tensor) else int(axis)
+    return apply_op("concat", lambda *xs: jnp.concatenate(xs, axis=axis), *x)
+
+
+def stack(x, axis=0, name=None):
+    return apply_op("stack", lambda *xs: jnp.stack(xs, axis=axis), *x)
+
+
+def hstack(x, name=None):
+    return apply_op("hstack", lambda *xs: jnp.hstack(xs), *x)
+
+
+def vstack(x, name=None):
+    return apply_op("vstack", lambda *xs: jnp.vstack(xs), *x)
+
+
+def dstack(x, name=None):
+    return apply_op("dstack", lambda *xs: jnp.dstack(xs), *x)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(unwrap(axis)) if isinstance(axis, Tensor) else int(axis)
+
+    def _split(a):
+        if isinstance(num_or_sections, int):
+            return tuple(jnp.split(a, num_or_sections, axis=axis))
+        secs = [int(unwrap(s)) for s in num_or_sections]
+        # paddle allows one -1 section
+        total = a.shape[axis]
+        known = sum(s for s in secs if s >= 0)
+        secs = [s if s >= 0 else total - known for s in secs]
+        idx = np.cumsum(secs[:-1]).tolist()
+        return tuple(jnp.split(a, idx, axis=axis))
+    outs = apply_op("split", _split, x)
+    return list(outs)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis, name)
+
+
+def unbind(x, axis=0, name=None):
+    n = x.shape[axis]
+    def _unbind(a):
+        return tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(a, n, axis=axis))
+    return list(apply_op("unbind", _unbind, x))
+
+
+def tile(x, repeat_times, name=None):
+    reps = _shape_list(repeat_times)
+    return apply_op("tile", lambda a: jnp.tile(a, reps), x)
+
+
+def expand(x, shape, name=None):
+    s = _shape_list(shape)
+    def _expand(a):
+        # paddle expand: -1 means keep dim
+        full = []
+        offset = len(s) - a.ndim
+        for i, v in enumerate(s):
+            if v == -1:
+                full.append(a.shape[i - offset] if i >= offset else 1)
+            else:
+                full.append(v)
+        return jnp.broadcast_to(a, full)
+    return apply_op("expand", _expand, x)
+
+
+def expand_as(x, y, name=None):
+    return apply_op("expand_as", lambda a, b: jnp.broadcast_to(a, b.shape), x, y)
+
+
+def broadcast_to(x, shape, name=None):
+    s = _shape_list(shape)
+    return apply_op("broadcast_to", lambda a: jnp.broadcast_to(a, s), x)
+
+
+def broadcast_tensors(inputs, name=None):
+    return list(apply_op("broadcast_tensors",
+                         lambda *xs: tuple(jnp.broadcast_arrays(*xs)), *inputs))
+
+
+def flip(x, axis, name=None):
+    ax = axis if isinstance(axis, (list, tuple)) else [axis]
+    return apply_op("flip", lambda a: jnp.flip(a, axis=tuple(ax)), x)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply_op("rot90", lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x)
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply_op("roll", lambda a: jnp.roll(a, shifts, axis=axis), x)
+
+
+def slice(x, axes, starts, ends):  # noqa: A001
+    axes = [int(a) for a in axes]
+    starts = [int(unwrap(s)) if not isinstance(s, int) else s for s in starts]
+    ends = [int(unwrap(e)) if not isinstance(e, int) else e for e in ends]
+
+    def _slice(a):
+        idx = [slice_builtin(None)] * a.ndim
+        for ax, st, en in zip(axes, starts, ends):
+            idx[ax] = slice_builtin(st, en)
+        return a[tuple(idx)]
+    return apply_op("slice", _slice, x)
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def _ss(a):
+        idx = [slice_builtin(None)] * a.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[int(ax)] = slice_builtin(int(unwrap(st)), int(unwrap(en)),
+                                         int(unwrap(sd)))
+        return a[tuple(idx)]
+    return apply_op("strided_slice", _ss, x)
+
+
+def gather(x, index, axis=0, name=None):
+    axis = int(unwrap(axis)) if isinstance(axis, Tensor) else int(axis)
+    return apply_op("gather", lambda a, i: jnp.take(a, i.reshape(-1), axis=axis),
+                    x, index)
+
+
+def gather_nd(x, index, name=None):
+    def _gather_nd(a, i):
+        idx_depth = i.shape[-1]
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return a[idx]
+    return apply_op("gather_nd", _gather_nd, x, index)
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return apply_op("take_along_axis",
+                    lambda a, i: jnp.take_along_axis(a, i, axis=axis), arr, indices)
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):  # noqa: A002
+    def _put(a, i, v):
+        v = jnp.broadcast_to(jnp.asarray(v, a.dtype), i.shape)
+        if reduce == "assign":
+            return _scatter_along(a, i, v, axis, "set")
+        if reduce == "add":
+            return _scatter_along(a, i, v, axis, "add")
+        if reduce in ("multiply", "mul"):
+            return _scatter_along(a, i, v, axis, "mul")
+        raise ValueError(f"unknown reduce {reduce}")
+    return apply_op("put_along_axis", _put, arr, indices,
+                    values if isinstance(values, Tensor) else values)
+
+
+def _scatter_along(a, i, v, axis, mode):
+    # build full index grids
+    idx = jnp.indices(i.shape)
+    index_list = [idx[d] for d in range(i.ndim)]
+    index_list[axis] = i
+    if mode == "set":
+        return a.at[tuple(index_list)].set(v)
+    if mode == "add":
+        return a.at[tuple(index_list)].add(v)
+    return a.at[tuple(index_list)].multiply(v)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def _scatter(a, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return a.at[i].set(u)
+        base = a.at[i].set(jnp.zeros_like(u))
+        return base.at[i].add(u)
+    return apply_op("scatter", _scatter, x, index, updates)
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    from .math import _inplace
+    return _inplace(x, scatter(x, index, updates, overwrite))
+
+
+def scatter_nd(index, updates, shape, name=None):
+    s = _shape_list(shape)
+    def _scatter_nd(i, u):
+        out = jnp.zeros(s, u.dtype)
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return out.at[idx].add(u)
+    return apply_op("scatter_nd", _scatter_nd, index, updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def _snd(a, i, u):
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return a.at[idx].add(u)
+    return apply_op("scatter_nd_add", _snd, x, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply_op("index_select",
+                    lambda a, i: jnp.take(a, i.reshape(-1), axis=axis), x, index)
+
+
+def index_sample(x, index, name=None):
+    return apply_op("index_sample",
+                    lambda a, i: jnp.take_along_axis(a, i, axis=1), x, index)
+
+
+def index_add(x, index, axis, value, name=None):
+    def _index_add(a, i, v):
+        return jnp.moveaxis(
+            jnp.moveaxis(a, axis, 0).at[i.reshape(-1)].add(jnp.moveaxis(v, axis, 0)),
+            0, axis)
+    return apply_op("index_add", _index_add, x, index, value)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    def _index_put(a, v, *idx):
+        if accumulate:
+            return a.at[tuple(idx)].add(v)
+        return a.at[tuple(idx)].set(v)
+    return apply_op("index_put", _index_put, x, value, *indices)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        return apply_op("repeat_interleave",
+                        lambda a, r: jnp.repeat(a, r, axis=axis,
+                                                total_repeat_length=int(repeats.numpy().sum())),
+                        x, repeats)
+    return apply_op("repeat_interleave",
+                    lambda a: jnp.repeat(a, repeats, axis=axis), x)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
+    def _pad(a, padding):
+        padding = [int(unwrap(p)) for p in padding]
+        if len(padding) == 2 * a.ndim:
+            # paddle order: [dim_i_low, dim_i_high ...] starting from first dim
+            pairs = [(padding[2 * i], padding[2 * i + 1]) for i in range(a.ndim)]
+        else:
+            # partial spec applies to trailing spatial dims (paddle nn.functional.pad)
+            n_spatial = len(padding) // 2
+            pairs = [(0, 0)] * (a.ndim - n_spatial)
+            sp = []
+            for i in range(n_spatial):
+                sp.append((padding[2 * i], padding[2 * i + 1]))
+            if data_format.startswith("NC"):
+                pairs = [(0, 0), (0, 0)] + list(reversed(sp))
+            else:
+                pairs = [(0, 0)] + list(reversed(sp)) + [(0, 0)]
+        jmode = {"constant": "constant", "reflect": "reflect",
+                 "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(a, pairs, mode="constant", constant_values=value)
+        return jnp.pad(a, pairs, mode=jmode)
+    return apply_op("pad", lambda a: _pad(a, pad), x)
+
+
+def cast(x, dtype):
+    jdt = dtype_mod.to_jax_dtype(dtype)
+    return apply_op("cast", lambda a: a.astype(jdt), x)
+
+
+def cast_(x, dtype):
+    from .math import _inplace
+    return _inplace(x, cast(x, dtype))
+
+
+def astype(x, dtype):
+    return cast(x, dtype)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    s = _shape_list(shape)
+    offs = [int(unwrap(o)) for o in (offsets or [0] * len(s))]
+    def _crop(a):
+        idx = tuple(slice_builtin(o, o + d) for o, d in zip(offs, s))
+        return a[idx]
+    return apply_op("crop", _crop, x)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    # Host round-trip: unique has data-dependent output shape (not jittable).
+    arr = np.asarray(unwrap(x))
+    res = np.unique(arr, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(res)
+    outs = [Tensor(res[0])]
+    jdt = dtype_mod.to_jax_dtype(dtype)
+    for extra in res[1:]:
+        outs.append(Tensor(extra.astype(jdt)))
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    arr = np.asarray(unwrap(x))
+    if axis is None:
+        arr = arr.reshape(-1)
+        ax = 0
+    else:
+        ax = axis
+    take = np.ones(arr.shape[ax], dtype=bool)
+    sl = [slice_builtin(None)] * arr.ndim
+    sl_prev = list(sl)
+    sl[ax] = slice_builtin(1, None)
+    sl_prev[ax] = slice_builtin(None, -1)
+    neq = np.any(arr[tuple(sl)] != arr[tuple(sl_prev)],
+                 axis=tuple(i for i in range(arr.ndim) if i != ax)) \
+        if arr.ndim > 1 else arr[1:] != arr[:-1]
+    take[1:] = neq
+    out = np.compress(take, arr, axis=ax)
+    outs = [Tensor(out)]
+    if return_inverse:
+        inv = np.cumsum(take) - 1
+        outs.append(Tensor(inv.astype(np.int64)))
+    if return_counts:
+        idx = np.flatnonzero(take)
+        counts = np.diff(np.append(idx, arr.shape[ax]))
+        outs.append(Tensor(counts.astype(np.int64)))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def masked_select(x, mask, name=None):
+    arr, m = np.asarray(unwrap(x)), np.asarray(unwrap(mask))
+    return Tensor(arr[m])
+
+
+def masked_fill(x, mask, value, name=None):
+    return apply_op("masked_fill",
+                    lambda a, m, v: jnp.where(m, jnp.asarray(v, a.dtype), a),
+                    x, mask, unwrap(value))
+
+
+def masked_fill_(x, mask, value, name=None):
+    from .math import _inplace
+    return _inplace(x, masked_fill(x, mask, value))
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    from .math import _inplace
+    def _fd(a):
+        n = min(a.shape[-2], a.shape[-1])
+        i = jnp.arange(n - abs(offset) if offset else n)
+        r = i + max(-offset, 0)
+        c = i + max(offset, 0)
+        return a.at[..., r, c].set(value)
+    return _inplace(x, apply_op("fill_diagonal", _fd, x))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):  # noqa: A002
+    def _shard(i):
+        shard_size = (index_num + nshards - 1) // nshards
+        lo = shard_id * shard_size
+        in_shard = (i >= lo) & (i < lo + shard_size)
+        return jnp.where(in_shard, i - lo, ignore_value)
+    return apply_op("shard_index", _shard, input)
+
+
+def as_complex(x, name=None):
+    return apply_op("as_complex", lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x)
+
+
+def as_real(x, name=None):
+    return apply_op("as_real",
+                    lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), x)
+
+
+def tensordot(x, y, axes=2, name=None):
+    ax = axes
+    if isinstance(ax, Tensor):
+        ax = ax.numpy().tolist()
+    return apply_op("tensordot", lambda a, b: jnp.tensordot(a, b, axes=ax), x, y)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply_op("atleast_1d", jnp.atleast_1d, x) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply_op("atleast_2d", jnp.atleast_2d, x) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply_op("atleast_3d", jnp.atleast_3d, x) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return apply_op("view_dtype",
+                    lambda a: a.view(dtype_mod.to_jax_dtype(shape_or_dtype)), x)
+
+
+def numel(x, name=None):
+    return Tensor(np.asarray(x.size, dtype=np.int64))
+
+
+def shape(x):
+    return Tensor(np.asarray(x.shape, dtype=np.int32))
+
+
+def rank(x):
+    return Tensor(np.asarray(x.ndim, dtype=np.int32))

@@ -1,0 +1,375 @@
+"""Elementwise & reduction math ops (reference: /root/reference/python/paddle/tensor/math.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply_op, unwrap
+from ..core.tensor import Tensor
+from ..framework import dtype as dtype_mod
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        a = axis.numpy().tolist()
+        return tuple(a) if isinstance(a, list) else int(a)
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _jdt(dtype):
+    return dtype_mod.to_jax_dtype(dtype)
+
+
+def _inplace(x: Tensor, r: Tensor) -> Tensor:
+    """Rebind x to the op result, keeping autograd linkage (paddle `op_`)."""
+    x._data = r._data
+    x._grad_node = r._grad_node
+    x._output_index = r._output_index
+    x.is_leaf = r.is_leaf
+    x.stop_gradient = r.stop_gradient
+    return x
+
+
+def _binop(name, fn):
+    def op(x, y, name=None):
+        return apply_op(name, fn, x, y)
+    op.__name__ = name
+    return op
+
+
+def _unop(name, fn):
+    def op(x, name=None):
+        return apply_op(name, fn, x)
+    op.__name__ = name
+    return op
+
+
+add = _binop("add", jnp.add)
+subtract = _binop("subtract", jnp.subtract)
+multiply = _binop("multiply", jnp.multiply)
+divide = _binop("divide", jnp.divide)
+floor_divide = _binop("floor_divide", jnp.floor_divide)
+remainder = _binop("remainder", jnp.remainder)
+mod = remainder
+floor_mod = remainder
+maximum = _binop("maximum", jnp.maximum)
+minimum = _binop("minimum", jnp.minimum)
+fmax = _binop("fmax", jnp.fmax)
+fmin = _binop("fmin", jnp.fmin)
+atan2 = _binop("atan2", jnp.arctan2)
+heaviside = _binop("heaviside", jnp.heaviside)
+gcd = _binop("gcd", jnp.gcd)
+lcm = _binop("lcm", jnp.lcm)
+logaddexp = _binop("logaddexp", jnp.logaddexp)
+nextafter = _binop("nextafter", jnp.nextafter)
+copysign = _binop("copysign", jnp.copysign)
+hypot = _binop("hypot", jnp.hypot)
+
+
+def pow(x, y, name=None):  # noqa: A001
+    return apply_op("pow", jnp.power, x, y)
+
+
+def divide_no_nan(x, y, name=None):
+    return apply_op("divide_no_nan",
+                    lambda a, b: jnp.where(b == 0, 0.0, a / jnp.where(b == 0, 1.0, b)),
+                    x, y)
+
+
+abs = _unop("abs", jnp.abs)  # noqa: A001
+neg = _unop("neg", jnp.negative)
+exp = _unop("exp", jnp.exp)
+expm1 = _unop("expm1", jnp.expm1)
+log = _unop("log", jnp.log)
+log2 = _unop("log2", jnp.log2)
+log10 = _unop("log10", jnp.log10)
+log1p = _unop("log1p", jnp.log1p)
+sqrt = _unop("sqrt", jnp.sqrt)
+rsqrt = _unop("rsqrt", lambda a: jax.lax.rsqrt(a))
+square = _unop("square", jnp.square)
+sin = _unop("sin", jnp.sin)
+cos = _unop("cos", jnp.cos)
+tan = _unop("tan", jnp.tan)
+asin = _unop("asin", jnp.arcsin)
+acos = _unop("acos", jnp.arccos)
+atan = _unop("atan", jnp.arctan)
+sinh = _unop("sinh", jnp.sinh)
+cosh = _unop("cosh", jnp.cosh)
+tanh = _unop("tanh", jnp.tanh)
+asinh = _unop("asinh", jnp.arcsinh)
+acosh = _unop("acosh", jnp.arccosh)
+atanh = _unop("atanh", jnp.arctanh)
+floor = _unop("floor", jnp.floor)
+ceil = _unop("ceil", jnp.ceil)
+round = _unop("round", jnp.round)  # noqa: A001
+trunc = _unop("trunc", jnp.trunc)
+sign = _unop("sign", jnp.sign)
+reciprocal = _unop("reciprocal", jnp.reciprocal)
+erf = _unop("erf", jax.scipy.special.erf)
+erfinv = _unop("erfinv", jax.scipy.special.erfinv)
+digamma = _unop("digamma", jax.scipy.special.digamma)
+lgamma = _unop("lgamma", jax.scipy.special.gammaln)
+frac = _unop("frac", lambda a: a - jnp.trunc(a))
+deg2rad = _unop("deg2rad", jnp.deg2rad)
+rad2deg = _unop("rad2deg", jnp.rad2deg)
+angle = _unop("angle", jnp.angle)
+conj = _unop("conj", jnp.conj)
+real = _unop("real", jnp.real)
+imag = _unop("imag", jnp.imag)
+i0 = _unop("i0", jax.scipy.special.i0)
+i1 = _unop("i1", jax.scipy.special.i1)
+
+
+def isfinite(x, name=None):
+    return apply_op("isfinite", jnp.isfinite, x)
+
+
+def isnan(x, name=None):
+    return apply_op("isnan", jnp.isnan, x)
+
+
+def isinf(x, name=None):
+    return apply_op("isinf", jnp.isinf, x)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    def _scale(a, s, b):
+        out = a * s + b if bias_after_scale else (a + b) * s
+        return out
+    r = apply_op("scale", _scale, x, scale, bias)
+    if act is not None:
+        from ..nn import functional as F
+        r = getattr(F, act)(r)
+    return r
+
+
+def increment(x, value=1.0, name=None):
+    return _inplace(x, apply_op("increment", lambda a: a + value, x))
+
+
+def clip(x, min=None, max=None, name=None):  # noqa: A002
+    return apply_op("clip", lambda a, lo, hi: jnp.clip(a, lo, hi), x,
+                    unwrap(min) if min is not None else None,
+                    unwrap(max) if max is not None else None)
+
+
+def lerp(x, y, weight, name=None):
+    return apply_op("lerp", lambda a, b, w: a + w * (b - a), x, y, weight)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply_op("stanh", lambda a: scale_b * jnp.tanh(scale_a * a), x)
+
+
+def multiplex(inputs, index, name=None):
+    def _mux(idx, *xs):
+        stacked = jnp.stack(xs, axis=0)
+        return jnp.take_along_axis(
+            stacked, idx.reshape(1, -1, *([1] * (stacked.ndim - 2))), axis=0
+        )[0]
+    return apply_op("multiplex", _mux, index, *inputs)
+
+
+# ---------------- reductions ----------------
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    return apply_op("sum", lambda a: jnp.sum(a, axis=_axis(axis), dtype=_jdt(dtype),
+                                             keepdims=keepdim), x)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return apply_op("mean", lambda a: jnp.mean(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    return apply_op("prod", lambda a: jnp.prod(a, axis=_axis(axis), dtype=_jdt(dtype),
+                                               keepdims=keepdim), x)
+
+
+def max(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return apply_op("max", lambda a: jnp.max(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def min(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return apply_op("min", lambda a: jnp.min(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim, name)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim, name)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return apply_op("nansum", lambda a: jnp.nansum(a, axis=_axis(axis),
+                                                   dtype=_jdt(dtype), keepdims=keepdim), x)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return apply_op("nanmean", lambda a: jnp.nanmean(a, axis=_axis(axis),
+                                                     keepdims=keepdim), x)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply_op("logsumexp",
+                    lambda a: jax.scipy.special.logsumexp(a, axis=_axis(axis),
+                                                          keepdims=keepdim), x)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    def _cumsum(a):
+        if axis is None:
+            return jnp.cumsum(a.reshape(-1), dtype=_jdt(dtype))
+        return jnp.cumsum(a, axis=_axis(axis), dtype=_jdt(dtype))
+    return apply_op("cumsum", _cumsum, x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    def _cumprod(a):
+        if dim is None:
+            return jnp.cumprod(a.reshape(-1), dtype=_jdt(dtype))
+        return jnp.cumprod(a, axis=int(dim), dtype=_jdt(dtype))
+    return apply_op("cumprod", _cumprod, x)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def _cummax(a):
+        ax = 0 if axis is None else _axis(axis)
+        aa = a.reshape(-1) if axis is None else a
+        vals = jax.lax.associative_scan(jnp.maximum, aa, axis=ax)
+        idx = jnp.broadcast_to(jnp.expand_dims(
+            jnp.arange(aa.shape[ax]), tuple(i for i in range(aa.ndim) if i != ax)
+        ), aa.shape)
+        sel = jnp.equal(aa, vals)
+        ind = jax.lax.associative_scan(
+            jnp.maximum, jnp.where(sel, idx, -1), axis=ax)
+        return vals, ind.astype(_jdt(dtype))
+    return apply_op("cummax", _cummax, x)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    def _cummin(a):
+        ax = 0 if axis is None else _axis(axis)
+        aa = a.reshape(-1) if axis is None else a
+        vals = jax.lax.associative_scan(jnp.minimum, aa, axis=ax)
+        idx = jnp.broadcast_to(jnp.expand_dims(
+            jnp.arange(aa.shape[ax]), tuple(i for i in range(aa.ndim) if i != ax)
+        ), aa.shape)
+        sel = jnp.equal(aa, vals)
+        ind = jax.lax.associative_scan(
+            jnp.maximum, jnp.where(sel, idx, -1), axis=ax)
+        return vals, ind.astype(_jdt(dtype))
+    return apply_op("cummin", _cummin, x)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    tensors = [x]
+    if prepend is not None:
+        tensors.append(prepend)
+    if append is not None:
+        tensors.append(append)
+
+    def _diff(a, *rest):
+        pre = rest[0] if prepend is not None else None
+        app = rest[-1] if append is not None and len(rest) > (1 if prepend is not None else 0) else (
+            rest[0] if append is not None and prepend is None else None)
+        return jnp.diff(a, n=n, axis=axis, prepend=pre, append=app)
+    return apply_op("diff", _diff, *tensors)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        return apply_op("trapezoid",
+                        lambda yy, xx: jax.scipy.integrate.trapezoid(yy, xx, axis=axis),
+                        y, x)
+    d = 1.0 if dx is None else dx
+    return apply_op("trapezoid",
+                    lambda yy: jax.scipy.integrate.trapezoid(yy, dx=d, axis=axis), y)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    def _cumtrap(yy, xx=None):
+        d = dx if dx is not None else 1.0
+        y1 = jnp.moveaxis(yy, axis, -1)
+        if xx is not None:
+            x1 = jnp.moveaxis(xx, axis, -1) if xx.ndim == yy.ndim else xx
+            dxs = jnp.diff(x1, axis=-1)
+        else:
+            dxs = d
+        avg = (y1[..., 1:] + y1[..., :-1]) / 2.0
+        out = jnp.cumsum(avg * dxs, axis=-1)
+        return jnp.moveaxis(out, -1, axis)
+    if x is not None:
+        return apply_op("cumulative_trapezoid", _cumtrap, y, x)
+    return apply_op("cumulative_trapezoid", _cumtrap, y)
+
+
+# ---------------- matrix-ish convenience (full linalg in linalg.py) ----------
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
+    return apply_op("addmm", lambda i, a, b: beta * i + alpha * (a @ b), input, x, y)
+
+
+def inner(x, y, name=None):
+    return apply_op("inner", jnp.inner, x, y)
+
+
+def outer(x, y, name=None):
+    return apply_op("outer", lambda a, b: jnp.outer(a.reshape(-1), b.reshape(-1)),
+                    x, y)
+
+
+def kron(x, y, name=None):
+    return apply_op("kron", jnp.kron, x, y)
+
+
+def inverse(x, name=None):
+    return apply_op("inverse", jnp.linalg.inv, x)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op("trace", lambda a: jnp.trace(a, offset=offset, axis1=axis1,
+                                                 axis2=axis2), x)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op("diagonal", lambda a: jnp.diagonal(a, offset=offset, axis1=axis1,
+                                                       axis2=axis2), x)
+
+
+# ---------------- in-place variants ----------------
+
+def _make_inplace(fn):
+    def op_(x, *args, **kwargs):
+        return _inplace(x, fn(x, *args, **kwargs))
+    op_.__name__ = fn.__name__ + "_"
+    return op_
+
+
+add_ = _make_inplace(add)
+subtract_ = _make_inplace(subtract)
+multiply_ = _make_inplace(multiply)
+divide_ = _make_inplace(divide)
+scale_ = _make_inplace(scale)
+clip_ = _make_inplace(clip)
+exp_ = _make_inplace(exp)
+sqrt_ = _make_inplace(sqrt)
+rsqrt_ = _make_inplace(rsqrt)
+reciprocal_ = _make_inplace(reciprocal)
+round_ = _make_inplace(round)
+floor_ = _make_inplace(floor)
+ceil_ = _make_inplace(ceil)
+neg_ = _make_inplace(neg)
+abs_ = _make_inplace(abs)
+tanh_ = _make_inplace(tanh)
+remainder_ = _make_inplace(remainder)
+floor_divide_ = _make_inplace(floor_divide)
+lerp_ = _make_inplace(lerp)
+pow_ = _make_inplace(pow)

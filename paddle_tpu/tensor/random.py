@@ -1,0 +1,150 @@
+"""Random ops (reference: /root/reference/python/paddle/tensor/random.py).
+
+Stateful paddle surface over functional jax PRNG: each call pulls a fresh
+subkey from the global Generator (framework/random.py). Inside jit-traced
+code use the functional forms with explicit seeds instead.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply_op, unwrap
+from ..core.tensor import Tensor
+from ..framework import dtype as dtype_mod
+from ..framework import random as random_mod
+from ..framework.device import current_jax_device
+
+
+def _dt(dtype):
+    if dtype is None:
+        return dtype_mod.to_jax_dtype(dtype_mod.get_default_dtype())
+    return dtype_mod.to_jax_dtype(dtype)
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return [int(s) for s in shape.numpy().tolist()]
+    if isinstance(shape, (int, np.integer)):
+        return [int(shape)]
+    return [int(unwrap(s)) if isinstance(s, Tensor) else int(s) for s in shape]
+
+
+def _put(arr):
+    return Tensor(jax.device_put(arr, current_jax_device()))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):  # noqa: A002
+    key = jax.random.key(seed) if seed else random_mod.next_key()
+    return _put(jax.random.uniform(key, _shape_list(shape), _dt(dtype),
+                                   float(unwrap(min)), float(unwrap(max))))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):  # noqa: A002
+    x._data = uniform(x.shape, x.dtype, min, max, seed)._data
+    return x
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def randn(shape, dtype=None, name=None):
+    return standard_normal(shape, dtype, name)
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return _put(jax.random.normal(random_mod.next_key(), tuple(_shape_list(shape)),
+                                  _dt(dtype)))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        def _normal(m, s):
+            shp = jnp.broadcast_shapes(
+                jnp.shape(m) if not np.isscalar(m) else (),
+                jnp.shape(s) if not np.isscalar(s) else ())
+            return m + s * jax.random.normal(random_mod.next_key(), shp)
+        return apply_op("normal", _normal, mean, std)
+    shp = _shape_list(shape) if shape is not None else []
+    return _put(mean + std * jax.random.normal(random_mod.next_key(), tuple(shp),
+                                               _dt(None)))
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x._data = (mean + std * jax.random.normal(
+        random_mod.next_key(), tuple(x.shape), x._data.dtype))
+    return x
+
+
+def randint(low=0, high=None, shape=[1], dtype="int64", name=None):  # noqa: B006
+    if high is None:
+        low, high = 0, low
+    return _put(jax.random.randint(random_mod.next_key(), tuple(_shape_list(shape)),
+                                   int(low), int(high),
+                                   dtype_mod.to_jax_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    dtype = dtype or x.dtype
+    return randint(low, high, x.shape, dtype, name)
+
+
+def randperm(n, dtype="int64", name=None):
+    return _put(jax.random.permutation(random_mod.next_key(), int(n)).astype(
+        dtype_mod.to_jax_dtype(dtype)))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    key = random_mod.next_key()
+    def _multinomial(probs):
+        logits = jnp.log(jnp.maximum(probs, 1e-30))
+        if replacement:
+            return jax.random.categorical(
+                key, logits, axis=-1,
+                shape=(probs.shape[:-1] + (num_samples,)) if probs.ndim > 1
+                else (num_samples,)).astype(jnp.int64)
+        # without replacement: Gumbel top-k trick
+        g = jax.random.gumbel(key, probs.shape)
+        _, idx = jax.lax.top_k(logits + g, num_samples)
+        return idx.astype(jnp.int64)
+    return apply_op("multinomial", _multinomial, x)
+
+
+def bernoulli(x, name=None):
+    key = random_mod.next_key()
+    return apply_op("bernoulli",
+                    lambda p: jax.random.bernoulli(key, p).astype(p.dtype), x)
+
+
+def bernoulli_(x, p=0.5, name=None):
+    x._data = jax.random.bernoulli(random_mod.next_key(), p,
+                                   tuple(x.shape)).astype(x._data.dtype)
+    return x
+
+
+def poisson(x, name=None):
+    key = random_mod.next_key()
+    return apply_op("poisson",
+                    lambda lam: jax.random.poisson(key, lam).astype(lam.dtype), x)
+
+
+def exponential_(x, lam=1.0, name=None):
+    x._data = (jax.random.exponential(random_mod.next_key(), tuple(x.shape),
+                                      x._data.dtype) / lam)
+    return x
+
+
+def rand_like(x, dtype=None, name=None):
+    return uniform(x.shape, dtype or x.dtype, 0.0, 1.0)
+
+
+def randn_like(x, dtype=None, name=None):
+    return standard_normal(x.shape, dtype or x.dtype)
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    key = jax.random.key(seed) if seed else random_mod.next_key()
+    return _put(mean + std * jax.random.normal(key, tuple(_shape_list(shape)),
+                                               _dt(dtype)))

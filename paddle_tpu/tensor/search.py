@@ -1,0 +1,135 @@
+"""Search/sort ops (reference: /root/reference/python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply_op, unwrap
+from ..core.tensor import Tensor
+from ..framework import dtype as dtype_mod
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    jdt = dtype_mod.to_jax_dtype(dtype)
+    def _argmax(a):
+        if axis is None:
+            return jnp.argmax(a.reshape(-1)).astype(jdt)
+        r = jnp.argmax(a, axis=int(axis)).astype(jdt)
+        return jnp.expand_dims(r, int(axis)) if keepdim else r
+    return apply_op("argmax", _argmax, x)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    jdt = dtype_mod.to_jax_dtype(dtype)
+    def _argmin(a):
+        if axis is None:
+            return jnp.argmin(a.reshape(-1)).astype(jdt)
+        r = jnp.argmin(a, axis=int(axis)).astype(jdt)
+        return jnp.expand_dims(r, int(axis)) if keepdim else r
+    return apply_op("argmin", _argmin, x)
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def _argsort(a):
+        r = jnp.argsort(a, axis=axis, stable=True)
+        return jnp.flip(r, axis=axis) if descending else r
+    return apply_op("argsort", _argsort, x)
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def _sort(a):
+        r = jnp.sort(a, axis=axis, stable=True)
+        return jnp.flip(r, axis=axis) if descending else r
+    return apply_op("sort", _sort, x)
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):  # noqa: A002
+    k = int(unwrap(k))
+    def _topk(a):
+        ax = -1 if axis is None else int(axis)
+        moved = jnp.moveaxis(a, ax, -1)
+        src = moved if largest else -moved
+        vals, idx = jax.lax.top_k(src, k)
+        if not largest:
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, ax),
+                jnp.moveaxis(idx.astype(jnp.int64), -1, ax))
+    return apply_op("topk", _topk, x)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def _kth(a):
+        moved = jnp.moveaxis(a, axis, -1)
+        sorted_vals = jnp.sort(moved, axis=-1)
+        sorted_idx = jnp.argsort(moved, axis=-1)
+        v = sorted_vals[..., k - 1]
+        i = sorted_idx[..., k - 1].astype(jnp.int64)
+        if keepdim:
+            v = jnp.expand_dims(v, axis)
+            i = jnp.expand_dims(i, axis)
+        return v, i
+    return apply_op("kthvalue", _kth, x)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    def _mode(a):
+        moved = jnp.moveaxis(a, axis, -1)
+        sorted_v = jnp.sort(moved, axis=-1)
+        n = sorted_v.shape[-1]
+        # run-length: count equal elements; pick value with max count (last one)
+        eq = sorted_v[..., :, None] == sorted_v[..., None, :]
+        counts = jnp.sum(eq, axis=-1)
+        best = jnp.argmax(counts, axis=-1)
+        vals = jnp.take_along_axis(sorted_v, best[..., None], axis=-1)[..., 0]
+        idx = jnp.argmax((moved == vals[..., None]) *
+                         jnp.arange(1, n + 1), axis=-1).astype(jnp.int64)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            idx = jnp.expand_dims(idx, axis)
+        return vals, idx
+    return apply_op("mode", _mode, x)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply_op("where", jnp.where, condition, x, y)
+
+
+def where_(condition, x, y, name=None):
+    from .math import _inplace
+    return _inplace(x, where(condition, x, y))
+
+
+def nonzero(x, as_tuple=False):
+    arr = np.asarray(unwrap(x))
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(i.astype(np.int64)) for i in nz)
+    return Tensor(np.stack(nz, axis=1).astype(np.int64))
+
+
+def masked_select(x, mask, name=None):
+    from .manipulation import masked_select as _ms
+    return _ms(x, mask, name)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    jdt = jnp.int32 if out_int32 else jnp.int64
+    return apply_op("searchsorted",
+                    lambda s, v: jnp.searchsorted(s, v, side=side).astype(jdt),
+                    sorted_sequence, values)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right, name)
+
+
+def index_fill(x, index, axis, value, name=None):
+    def _if(a, i):
+        moved = jnp.moveaxis(a, axis, 0)
+        moved = moved.at[i.reshape(-1)].set(value)
+        return jnp.moveaxis(moved, 0, axis)
+    return apply_op("index_fill", _if, x, index)

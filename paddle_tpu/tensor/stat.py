@@ -1,0 +1,52 @@
+"""Statistics ops (reference: /root/reference/python/paddle/tensor/stat.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+from .math import _axis
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply_op("var", lambda a: jnp.var(a, axis=_axis(axis),
+                                             ddof=1 if unbiased else 0,
+                                             keepdims=keepdim), x)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply_op("std", lambda a: jnp.std(a, axis=_axis(axis),
+                                             ddof=1 if unbiased else 0,
+                                             keepdims=keepdim), x)
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    def _median(a):
+        if mode == "avg":
+            return jnp.median(a, axis=_axis(axis), keepdims=keepdim)
+        # 'min' mode: lower of the two middle values
+        ax = _axis(axis)
+        if ax is None:
+            flat = jnp.sort(a.reshape(-1))
+            return flat[(flat.shape[0] - 1) // 2]
+        srt = jnp.sort(a, axis=ax)
+        n = srt.shape[ax]
+        val = jnp.take(srt, (n - 1) // 2, axis=ax)
+        return jnp.expand_dims(val, ax) if keepdim else val
+    return apply_op("median", _median, x)
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return apply_op("nanmedian", lambda a: jnp.nanmedian(a, axis=_axis(axis),
+                                                         keepdims=keepdim), x)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    return apply_op("quantile",
+                    lambda a: jnp.quantile(a, jnp.asarray(q), axis=_axis(axis),
+                                           keepdims=keepdim, method=interpolation), x)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    return apply_op("nanquantile",
+                    lambda a: jnp.nanquantile(a, jnp.asarray(q), axis=_axis(axis),
+                                              keepdims=keepdim, method=interpolation), x)

@@ -1,0 +1,52 @@
+"""Synthetic stand-ins for vision datasets (no network egress in this env).
+The reference downloads MNIST etc. (python/paddle/vision/datasets/); here
+FakeMNIST/FakeImageNet generate deterministic data with the same shapes so
+training pipelines and benchmarks run hermetically.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+
+class FakeMNIST(Dataset):
+    def __init__(self, mode="train", n=1024, seed=0, transform=None):
+        rng = np.random.RandomState(seed)
+        self.images = rng.rand(n, 1, 28, 28).astype(np.float32)
+        self.labels = rng.randint(0, 10, (n, 1)).astype(np.int64)
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+MNIST = FakeMNIST
+
+
+class FakeImageNet(Dataset):
+    def __init__(self, n=256, image_size=224, num_classes=1000, seed=0,
+                 transform=None):
+        rng = np.random.RandomState(seed)
+        self.n = n
+        self.image_size = image_size
+        self.num_classes = num_classes
+        self.seed = seed
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self.seed + idx)
+        img = rng.rand(3, self.image_size, self.image_size).astype(np.float32)
+        label = np.asarray([rng.randint(0, self.num_classes)], np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return self.n

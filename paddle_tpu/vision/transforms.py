@@ -1,0 +1,83 @@
+"""Minimal vision transforms (reference: python/paddle/vision/transforms/)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        arr = np.asarray(img, np.float32) / 255.0
+        if arr.ndim == 2:
+            arr = arr[None]
+        elif self.data_format == "CHW" and arr.shape[-1] in (1, 3, 4):
+            arr = arr.transpose(2, 0, 1)
+        return arr
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def __call__(self, img):
+        arr = np.asarray(img, np.float32)
+        shape = (-1, 1, 1) if self.data_format == "CHW" else (1, 1, -1)
+        return (arr - self.mean.reshape(shape)) / self.std.reshape(shape)
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = size if isinstance(size, (list, tuple)) else (size, size)
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        try:
+            import jax
+            import jax.numpy as jnp
+            chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+            target = ((arr.shape[0],) + tuple(self.size)) if chw else \
+                (tuple(self.size) + (arr.shape[-1],) if arr.ndim == 3
+                 else tuple(self.size))
+            return np.asarray(jax.image.resize(jnp.asarray(arr, jnp.float32),
+                                               target, "bilinear"))
+        except Exception:
+            return arr
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return np.ascontiguousarray(np.flip(np.asarray(img), axis=-1))
+        return img
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = size if isinstance(size, (list, tuple)) else (size, size)
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        h, w = arr.shape[-2:] if arr.ndim == 3 and arr.shape[0] in (1, 3) \
+            else arr.shape[:2]
+        th, tw = self.size
+        i, j = (h - th) // 2, (w - tw) // 2
+        if arr.ndim == 3 and arr.shape[0] in (1, 3):
+            return arr[:, i:i + th, j:j + tw]
+        return arr[i:i + th, j:j + tw]
