@@ -7,6 +7,17 @@ hand-tuning are Pallas.
 """
 from __future__ import annotations
 
+import jax as _jax
+
+# float64/int64 are first-class dtypes in the reference (VarType FP64/INT64,
+# /root/reference/paddle/fluid/framework/framework.proto); enable them in XLA.
+# Default dtypes for literals remain paddle-like (float32) — the Tensor
+# constructor and creation ops pass explicit dtypes.
+# NOTE: this is a process-wide jax setting; non-paddle jax code in the same
+# process also gains 64-bit defaults (jnp.arange → int64 etc.). Framework
+# call sites must therefore always pass explicit dtypes.
+_jax.config.update("jax_enable_x64", True)
+
 # Core types
 from .core.tensor import Parameter, Tensor, to_tensor  # noqa: F401
 from .core.autograd import enable_grad, grad  # noqa: F401

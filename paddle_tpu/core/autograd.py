@@ -53,7 +53,12 @@ def enable_grad():
 class no_grad_decorator:
     """paddle.no_grad works both as context manager and decorator."""
 
-    def __call__(self, func):
+    def __call__(self, func=None):
+        # `paddle.no_grad()` (fresh context manager) and `@paddle.no_grad`
+        # (decorator) are both legal in the reference API
+        # (/root/reference/python/paddle/fluid/dygraph/base.py `no_grad_`).
+        if func is None:
+            return no_grad_decorator()
         import functools
 
         @functools.wraps(func)
@@ -64,11 +69,17 @@ class no_grad_decorator:
         return wrapper
 
     def __enter__(self):
-        self._ctx = no_grad()
-        return self._ctx.__enter__()
+        # Stack, not a single slot: paddle.no_grad is a module-level
+        # singleton, so nested `with paddle.no_grad:` blocks re-enter the
+        # same object and must restore state LIFO.
+        if not hasattr(self, "_ctx_stack"):
+            self._ctx_stack = []
+        ctx = no_grad()
+        self._ctx_stack.append(ctx)
+        return ctx.__enter__()
 
     def __exit__(self, *exc):
-        return self._ctx.__exit__(*exc)
+        return self._ctx_stack.pop().__exit__(*exc)
 
 
 class InputRef:
@@ -252,6 +263,14 @@ def _call_vjp(node, cots):
             else:
                 # Integer/bool outputs take float0 cotangents in jax.
                 c = np.zeros(shape, jax.dtypes.float0)
+        elif i < len(node.out_templates):
+            # jax.vjp requires cotangent dtype == primal output dtype; mixed
+            # precision (e.g. fp32 loss-scale times a bf16 autocast output)
+            # would otherwise feed a widened cotangent into the pullback.
+            _, dtype = node.out_templates[i]
+            if (not _is_float0(c) and getattr(c, "dtype", None) != dtype
+                    and jax.numpy.issubdtype(dtype, jax.numpy.inexact)):
+                c = jax.numpy.asarray(c).astype(dtype)
         filled.append(c)
     if node.n_outputs == 1:
         return node.vjp_fn(filled[0])

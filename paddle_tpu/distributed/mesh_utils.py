@@ -68,5 +68,9 @@ def with_constraint(arr, *spec):
     mesh = get_global_mesh()
     if mesh is None:
         return arr
-    return jax.lax.with_sharding_constraint(
-        arr, NamedSharding(mesh, PartitionSpec(*spec)))
+    sharding = NamedSharding(mesh, PartitionSpec(*spec))
+    if isinstance(arr, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(arr, sharding)
+    # Eager path: a committed single-device array can't take a sharding
+    # constraint; reshard by placement instead.
+    return jax.device_put(arr, sharding)

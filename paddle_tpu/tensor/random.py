@@ -65,7 +65,13 @@ def normal(mean=0.0, std=1.0, shape=None, name=None):
             shp = jnp.broadcast_shapes(
                 jnp.shape(m) if not np.isscalar(m) else (),
                 jnp.shape(s) if not np.isscalar(s) else ())
-            return m + s * jax.random.normal(random_mod.next_key(), shp)
+            # explicit dtype: under jax_enable_x64 the sample default is f64,
+            # which would silently promote f32 mean/std
+            dt = jnp.result_type(getattr(m, "dtype", jnp.float32),
+                                 getattr(s, "dtype", jnp.float32))
+            if not jnp.issubdtype(dt, jnp.floating):
+                dt = _dt(None)
+            return m + s * jax.random.normal(random_mod.next_key(), shp, dt)
         return apply_op("normal", _normal, mean, std)
     shp = _shape_list(shape) if shape is not None else []
     return _put(mean + std * jax.random.normal(random_mod.next_key(), tuple(shp),

@@ -1,0 +1,39 @@
+"""Test fixtures: force an 8-device virtual CPU platform BEFORE jax loads.
+
+Mirrors the reference's multiprocess-on-localhost distributed test strategy
+(/root/reference/python/paddle/fluid/tests/unittests/test_dist_base.py:943)
+with XLA's virtual-device simulation instead of spawning ranks.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The container's sitecustomize imports jax at interpreter start (before this
+# conftest), so the env vars above may be too late for platform selection —
+# force it through the live config instead.
+jax.config.update("jax_platforms", "cpu")
+
+# CPU-oracle testing wants exact fp32 matmuls; on TPU the framework default
+# follows FLAGS_tpu_matmul_precision (bf16-pass default, like cublas TF32 in
+# the reference).
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    np.random.seed(0)
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.mesh_utils import set_global_mesh
+    paddle.seed(0)
+    set_global_mesh(None)
+    yield
+    set_global_mesh(None)

@@ -1,0 +1,203 @@
+"""AMP autocast / GradScaler, paddle.save/load, DataLoader, jit tests."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class TestAMP:
+    def test_auto_cast_bf16_matmul(self):
+        a = paddle.to_tensor(np.random.randn(4, 4).astype("float32"))
+        with paddle.amp.auto_cast(dtype="bfloat16"):
+            out = paddle.matmul(a, a)
+        assert "bfloat16" in str(out.dtype)
+
+    def test_auto_cast_keeps_softmax_fp32(self):
+        a = paddle.to_tensor(np.random.randn(4, 4).astype("float32"))
+        with paddle.amp.auto_cast(dtype="bfloat16"):
+            out = F.softmax(a)
+        assert "float32" in str(out.dtype)
+
+    def test_auto_cast_off(self):
+        a = paddle.to_tensor(np.random.randn(4, 4).astype("float32"))
+        with paddle.amp.auto_cast(enable=False):
+            out = paddle.matmul(a, a)
+        assert "float32" in str(out.dtype)
+
+    def test_grad_scaler_roundtrip(self):
+        m = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.01, parameters=m.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=128.0)
+        x = paddle.to_tensor(np.random.randn(2, 4).astype("float32"))
+        with paddle.amp.auto_cast():
+            loss = m(x).sum()
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        scaler.step(opt)
+        scaler.update()
+        assert np.isfinite(m.weight.numpy()).all()
+
+    def test_grad_scaler_skips_inf(self):
+        m = nn.Linear(2, 2)
+        w0 = m.weight.numpy().copy()
+        opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=m.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=64.0)
+        x = paddle.to_tensor(np.array([[np.inf, 1.0]], "float32"))
+        loss = m(x).sum()
+        scaler.scale(loss).backward()
+        scaler.step(opt)
+        scaler.update()
+        np.testing.assert_allclose(m.weight.numpy(), w0)  # step skipped
+
+
+class TestSaveLoad:
+    def test_save_load_state_dict(self, tmp_path):
+        m = nn.Sequential(nn.Linear(3, 4), nn.ReLU(), nn.Linear(4, 2))
+        path = str(tmp_path / "model.pdparams")
+        paddle.save(m.state_dict(), path)
+        loaded = paddle.load(path)
+        m2 = nn.Sequential(nn.Linear(3, 4), nn.ReLU(), nn.Linear(4, 2))
+        m2.set_state_dict(loaded)
+        for (k1, v1), (k2, v2) in zip(sorted(m.state_dict().items()),
+                                      sorted(m2.state_dict().items())):
+            np.testing.assert_allclose(v1.numpy(), v2.numpy())
+
+    def test_save_load_optimizer(self, tmp_path):
+        m = nn.Linear(3, 3)
+        opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=m.parameters())
+        m(paddle.to_tensor(np.ones((1, 3), "float32"))).sum().backward()
+        opt.step()
+        path = str(tmp_path / "opt.pdopt")
+        paddle.save(opt.state_dict(), path)
+        sd = paddle.load(path)
+        opt.set_state_dict(sd)
+
+    def test_save_nested_dict(self, tmp_path):
+        obj = {"a": paddle.to_tensor([1.0, 2.0]), "b": {"c": 3}}
+        path = str(tmp_path / "obj.pd")
+        paddle.save(obj, path)
+        back = paddle.load(path)
+        np.testing.assert_allclose(np.asarray(back["a"]), [1.0, 2.0])
+        assert back["b"]["c"] == 3
+
+
+class TestDataLoader:
+    def test_dataset_and_loader(self):
+        from paddle_tpu.io import Dataset, DataLoader
+
+        class Sq(Dataset):
+            def __len__(self):
+                return 20
+
+            def __getitem__(self, i):
+                return np.float32(i), np.float32(i * i)
+
+        dl = DataLoader(Sq(), batch_size=4, shuffle=False, drop_last=False)
+        batches = list(dl)
+        assert len(batches) == 5
+        x, y = batches[0]
+        np.testing.assert_allclose(np.asarray(x).reshape(-1), [0, 1, 2, 3])
+
+    def test_loader_shuffle_covers_all(self):
+        from paddle_tpu.io import Dataset, DataLoader
+
+        class Ds(Dataset):
+            def __len__(self):
+                return 10
+
+            def __getitem__(self, i):
+                return np.int64(i)
+
+        dl = DataLoader(Ds(), batch_size=2, shuffle=True)
+        seen = sorted(int(v) for b in dl for v in np.asarray(b[0] if isinstance(b, (list, tuple)) else b).reshape(-1))
+        assert seen == list(range(10))
+
+    def test_tensor_dataset_random_sampler(self):
+        from paddle_tpu.io import TensorDataset, DataLoader
+        xs = paddle.to_tensor(np.arange(12, dtype="float32").reshape(6, 2))
+        ys = paddle.to_tensor(np.arange(6, dtype="int64"))
+        ds = TensorDataset([xs, ys])
+        assert len(ds) == 6
+        dl = DataLoader(ds, batch_size=3)
+        n = sum(1 for _ in dl)
+        assert n == 2
+
+
+class TestJit:
+    def test_to_static_matches_eager(self):
+        m = nn.Sequential(nn.Linear(4, 8), nn.GELU(), nn.Linear(8, 2))
+        x = paddle.to_tensor(np.random.randn(3, 4).astype("float32"))
+        eager = m(x).numpy()
+        sm = paddle.jit.to_static(m)
+        static = sm(x).numpy()
+        np.testing.assert_allclose(eager, static, rtol=1e-4, atol=1e-5)
+
+    def test_to_static_function(self):
+        @paddle.jit.to_static
+        def f(a, b):
+            return paddle.matmul(a, b) + 1.0
+
+        a = paddle.to_tensor(np.random.randn(2, 3).astype("float32"))
+        b = paddle.to_tensor(np.random.randn(3, 2).astype("float32"))
+        np.testing.assert_allclose(
+            f(a, b).numpy(), a.numpy() @ b.numpy() + 1.0, rtol=1e-4, atol=1e-5)
+
+    def test_train_step_fused(self):
+        from paddle_tpu.jit import TrainStep
+        m = nn.Linear(4, 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+        step = TrainStep(m, lambda out, y: F.cross_entropy(out, y), opt)
+        x = paddle.to_tensor(np.random.randn(8, 4).astype("float32"))
+        y = paddle.to_tensor(np.random.randint(0, 2, (8,)).astype("int64"))
+        l0 = float(step(x, y).numpy())
+        for _ in range(20):
+            l = float(step(x, y).numpy())
+        assert l < l0
+
+    def test_train_step_matches_eager(self):
+        xs = np.random.randn(8, 4).astype("float32")
+        ys = np.random.randint(0, 2, (8,)).astype("int64")
+
+        def build():
+            paddle.seed(42)
+            m = nn.Linear(4, 2)
+            opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+            return m, opt
+
+        m1, o1 = build()
+        for _ in range(3):
+            loss = F.cross_entropy(m1(paddle.to_tensor(xs)), paddle.to_tensor(ys))
+            loss.backward()
+            o1.step()
+            o1.clear_grad()
+
+        from paddle_tpu.jit import TrainStep
+        m2, o2 = build()
+        step = TrainStep(m2, lambda out, y: F.cross_entropy(out, y), o2)
+        for _ in range(3):
+            step(paddle.to_tensor(xs), paddle.to_tensor(ys))
+        np.testing.assert_allclose(m1.weight.numpy(), m2.weight.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestVisionModels:
+    def test_lenet_forward_backward(self):
+        from paddle_tpu.vision.models import LeNet
+        net = LeNet()
+        x = paddle.to_tensor(np.random.randn(2, 1, 28, 28).astype("float32"))
+        out = net(x)
+        assert out.shape == [2, 10]
+        F.cross_entropy(out, paddle.to_tensor(np.array([1, 2], "int64"))).backward()
+        assert net.parameters()[0].grad is not None
+
+    def test_resnet18_forward(self):
+        from paddle_tpu.vision.models import resnet18
+        net = resnet18(num_classes=10)
+        net.eval()
+        x = paddle.to_tensor(np.random.randn(1, 3, 32, 32).astype("float32"))
+        assert net(x).shape == [1, 10]

@@ -1,0 +1,173 @@
+"""Autograd tests: analytic grads vs finite differences — the reference's
+check_grad pattern (op_test.py:2275) with numeric differentiation as oracle.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def numeric_grad(f, x, eps=1e-3):
+    g = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gf = g.reshape(-1)
+    for i in range(flat.size):
+        old = flat[i]
+        flat[i] = old + eps
+        fp = f(x)
+        flat[i] = old - eps
+        fm = f(x)
+        flat[i] = old
+        gf[i] = (fp - fm) / (2 * eps)
+    return g
+
+
+def check_grad(op, x_np, rtol=1e-2, atol=1e-3):
+    x = paddle.to_tensor(x_np, stop_gradient=False)
+    y = op(x)
+    loss = paddle.sum(y)
+    loss.backward()
+    ana = x.grad.numpy()
+
+    def f(a):
+        return float(paddle.sum(op(paddle.to_tensor(a.astype("float64")))).numpy())
+
+    num = numeric_grad(f, x_np.astype("float64").copy())
+    np.testing.assert_allclose(ana, num, rtol=rtol, atol=atol)
+
+
+class TestGradCheck:
+    def test_elementwise(self):
+        x = np.random.rand(3, 4).astype("float32") + 0.5
+        check_grad(lambda a: paddle.exp(a), x)
+        check_grad(lambda a: paddle.log(a), x)
+        check_grad(lambda a: paddle.sqrt(a), x)
+        check_grad(lambda a: paddle.tanh(a), x)
+        check_grad(lambda a: a * a + 2 * a, x)
+
+    def test_matmul_grad(self):
+        a = np.random.randn(4, 3).astype("float32")
+        b = np.random.randn(3, 5).astype("float32")
+        x = paddle.to_tensor(a, stop_gradient=False)
+        w = paddle.to_tensor(b, stop_gradient=False)
+        loss = paddle.sum(paddle.matmul(x, w))
+        loss.backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.ones((4, 5)) @ b.T, rtol=1e-4)
+        np.testing.assert_allclose(w.grad.numpy(), a.T @ np.ones((4, 5)), rtol=1e-4)
+
+    def test_reduction_grads(self):
+        x = np.random.randn(3, 4).astype("float32")
+        check_grad(lambda a: paddle.mean(a), x)
+        check_grad(lambda a: paddle.max(a), x, rtol=5e-2)
+
+    def test_broadcast_grad(self):
+        a = np.random.randn(3, 1).astype("float32")
+        b = np.random.randn(1, 4).astype("float32")
+        x = paddle.to_tensor(a, stop_gradient=False)
+        y = paddle.to_tensor(b, stop_gradient=False)
+        loss = paddle.sum(x * y)
+        loss.backward()
+        assert x.grad.shape == [3, 1]
+        assert y.grad.shape == [1, 4]
+        np.testing.assert_allclose(x.grad.numpy(), np.full((3, 1), b.sum()), rtol=1e-4)
+        np.testing.assert_allclose(y.grad.numpy(), np.full((1, 4), a.sum()), rtol=1e-4)
+
+
+class TestBackwardSemantics:
+    def test_grad_accumulation(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        (x * 2).sum().backward()
+        g1 = x.grad.numpy().copy()
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), g1 + 3.0)
+
+    def test_clear_grad(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        (x * 2).sum().backward()
+        x.clear_grad()
+        assert x.grad is None or np.all(x.grad.numpy() == 0)
+
+    def test_stop_gradient(self):
+        x = paddle.to_tensor([1.0], stop_gradient=True)
+        y = paddle.to_tensor([2.0], stop_gradient=False)
+        (x * y).sum().backward()
+        assert x.grad is None
+        assert y.grad is not None
+
+    def test_detach(self):
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        d = x.detach()
+        assert d.stop_gradient
+        np.testing.assert_allclose(d.numpy(), [3.0])
+
+    def test_no_grad_context(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient
+
+    def test_chain(self):
+        x = paddle.to_tensor(np.array([0.5, 1.5], "float32"), stop_gradient=False)
+        y = paddle.tanh(x * 2)
+        z = paddle.sum(y * y)
+        z.backward()
+        t = np.tanh(np.array([1.0, 3.0]))
+        expect = 2 * t * (1 - t ** 2) * 2
+        np.testing.assert_allclose(x.grad.numpy(), expect, rtol=1e-4, atol=1e-3)
+
+    def test_paddle_grad_api(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * x
+        (gx,) = paddle.grad([y], [x])
+        np.testing.assert_allclose(gx.numpy(), [4.0], rtol=1e-5)
+
+    def test_backward_with_grad_tensor(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = x * 3
+        y.backward(paddle.to_tensor([1.0, 0.5]))
+        np.testing.assert_allclose(x.grad.numpy(), [3.0, 1.5])
+
+    def test_second_use_of_intermediate(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * x      # used twice below — fan-in accumulation
+        z = y + y * y
+        z.backward()
+        # dz/dx = (1 + 2y) * 2x = (1+8)*4 = 36
+        np.testing.assert_allclose(x.grad.numpy(), [36.0], rtol=1e-5)
+
+
+class TestPyLayer:
+    def test_custom_pylayer(self):
+        from paddle_tpu.autograd import PyLayer
+
+        class Double(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, gy):
+                return gy * 2
+
+        x = paddle.to_tensor([1.5], stop_gradient=False)
+        y = Double.apply(x)
+        y.sum().backward()
+        np.testing.assert_allclose(y.numpy(), [3.0])
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+class TestHooks:
+    def test_register_hook(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        seen = []
+
+        def hook(g):
+            seen.append(g.numpy().copy())
+            return g * 2
+
+        h = x.register_hook(hook)
+        (x * 5).sum().backward()
+        assert len(seen) == 1
+        np.testing.assert_allclose(seen[0], [5.0])
+        np.testing.assert_allclose(x.grad.numpy(), [10.0])

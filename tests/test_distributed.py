@@ -1,0 +1,125 @@
+"""Distributed tests on the virtual 8-device CPU platform — the analog of the
+reference's multiprocess-localhost harness (test_dist_base.py:943) and
+collective tests (unittests/collective/), with XLA SPMD replacing NCCL ranks.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def ndev():
+    import jax
+    return len(jax.devices())
+
+
+class TestMesh:
+    def test_eight_virtual_devices(self):
+        assert ndev() == 8
+
+    def test_build_mesh(self):
+        from paddle_tpu.distributed.mesh_utils import build_mesh
+        mesh = build_mesh({"data": 2, "model": 4})
+        assert mesh.shape["data"] == 2
+        assert mesh.shape["model"] == 4
+
+
+class TestCollectives:
+    def test_all_reduce_world1_identity(self):
+        x = paddle.to_tensor([1.0, 2.0])
+        dist.all_reduce(x)
+        np.testing.assert_allclose(x.numpy(), [1.0, 2.0])
+
+    def test_get_rank_world_size(self):
+        assert dist.get_rank() == 0
+        assert dist.get_world_size() >= 1
+
+
+class TestFleetInit:
+    def test_fleet_hybrid_topology(self):
+        import paddle_tpu.distributed.fleet as fleet
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 4, "pp_degree": 1}
+        fleet.init(is_collective=True, strategy=strategy)
+        hcg = fleet.get_hybrid_communicate_group()
+        assert hcg.get_model_parallel_world_size() == 4
+        assert hcg.get_data_parallel_world_size() == 2
+
+
+class TestTPLayers:
+    def test_column_row_parallel_match_dense(self):
+        """TP layers on a 1-chip mesh must match plain Linear numerics."""
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            ColumnParallelLinear, RowParallelLinear)
+        col = ColumnParallelLinear(6, 8, gather_output=True)
+        row = RowParallelLinear(8, 6, input_is_parallel=False)
+        x = paddle.to_tensor(np.random.randn(2, 6).astype("float32"))
+        h = col(x)
+        assert h.shape == [2, 8]
+        out = row(h)
+        assert out.shape == [2, 6]
+        expect = (x.numpy() @ col.weight.numpy() + col.bias.numpy()) \
+            @ row.weight.numpy() + row.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), expect, rtol=1e-4, atol=1e-4)
+
+    def test_vocab_parallel_embedding(self):
+        from paddle_tpu.distributed.fleet.meta_parallel import VocabParallelEmbedding
+        emb = VocabParallelEmbedding(16, 8)
+        idx = paddle.to_tensor(np.array([[0, 3], [7, 15]], "int64"))
+        out = emb(idx)
+        assert out.shape == [2, 2, 8]
+        np.testing.assert_allclose(out.numpy()[0, 1], emb.weight.numpy()[3])
+
+
+class TestShardedTraining:
+    def test_dp_sharded_train_step_matches_single(self):
+        """A jitted DP train step over mesh(data=8) must match single-device."""
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        from paddle_tpu.jit import TrainStep
+
+        xs = np.random.randn(16, 4).astype("float32")
+        ys = np.random.randint(0, 3, (16,)).astype("int64")
+
+        def run(mesh_axes=None):
+            paddle.seed(7)
+            m = nn.Sequential(nn.Linear(4, 16), nn.Tanh(), nn.Linear(16, 3))
+            opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+            from paddle_tpu.distributed.mesh_utils import build_mesh, set_global_mesh
+            if mesh_axes:
+                set_global_mesh(build_mesh(mesh_axes))
+            else:
+                set_global_mesh(None)
+            step = TrainStep(m, lambda o, y: F.cross_entropy(o, y), opt)
+            for _ in range(3):
+                step(paddle.to_tensor(xs), paddle.to_tensor(ys))
+            set_global_mesh(None)
+            return m.state_dict()
+
+        single = run(None)
+        dp = run({"data": 8})
+        for k in single:
+            np.testing.assert_allclose(single[k].numpy(), dp[k].numpy(),
+                                       rtol=1e-4, atol=1e-5)
+
+
+class TestAutoParallel:
+    def test_process_mesh_api(self):
+        from paddle_tpu.distributed.auto_parallel import ProcessMesh
+        pm = ProcessMesh(mesh=np.arange(8).reshape(2, 4).tolist(),
+                         dim_names=["x", "y"])
+        assert pm.shape == [2, 4]
+
+    def test_shard_tensor(self):
+        import paddle_tpu.distributed as dist2
+        from paddle_tpu.distributed.auto_parallel import ProcessMesh
+        pm = ProcessMesh(mesh=np.arange(8).reshape(2, 4).tolist(),
+                         dim_names=["x", "y"])
+        x = paddle.to_tensor(np.random.randn(8, 8).astype("float32"))
+        if hasattr(dist2, "shard_tensor"):
+            sharded = dist2.shard_tensor(x, pm, [dist2.Shard(0), dist2.Replicate()]) \
+                if hasattr(dist2, "Shard") else x
+            assert sharded.shape == [8, 8]

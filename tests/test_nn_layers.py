@@ -1,0 +1,258 @@
+"""nn.Layer and layer-zoo tests (reference: python/paddle/nn/layer/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def t(a, sg=True):
+    return paddle.to_tensor(a, stop_gradient=sg)
+
+
+class TestLayerBase:
+    def test_parameters_and_state_dict(self):
+        m = nn.Linear(4, 3)
+        params = m.parameters()
+        assert len(params) == 2
+        sd = m.state_dict()
+        assert set(sd.keys()) == {"weight", "bias"}
+        assert sd["weight"].shape == [4, 3]
+
+    def test_nested_state_dict(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(4, 8)
+                self.fc2 = nn.Linear(8, 2)
+
+            def forward(self, x):
+                return self.fc2(F.relu(self.fc1(x)))
+
+        net = Net()
+        sd = net.state_dict()
+        assert "fc1.weight" in sd and "fc2.bias" in sd
+        x = t(np.random.randn(2, 4).astype("float32"))
+        assert net(x).shape == [2, 2]
+
+    def test_set_state_dict(self):
+        m1 = nn.Linear(4, 3)
+        m2 = nn.Linear(4, 3)
+        m2.set_state_dict(m1.state_dict())
+        np.testing.assert_allclose(m1.weight.numpy(), m2.weight.numpy())
+
+    def test_train_eval_mode(self):
+        m = nn.Dropout(0.5)
+        m.eval()
+        x = t(np.ones((10, 10), "float32"))
+        np.testing.assert_allclose(m(x).numpy(), x.numpy())
+        m.train()
+
+    def test_sublayers_named(self):
+        net = nn.Sequential(nn.Linear(2, 2), nn.ReLU())
+        assert len(list(net.sublayers())) >= 2
+
+    def test_apply_fn(self):
+        m = nn.Linear(3, 3)
+        m.apply(lambda layer: None)
+
+
+class TestCommonLayers:
+    def test_linear(self):
+        m = nn.Linear(5, 7)
+        x = np.random.randn(3, 5).astype("float32")
+        out = m(t(x))
+        expect = x @ m.weight.numpy() + m.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), expect, rtol=1e-4, atol=1e-5)
+
+    def test_embedding(self):
+        m = nn.Embedding(10, 4)
+        idx = t(np.array([[1, 2], [3, 4]], "int64"))
+        out = m(idx)
+        assert out.shape == [2, 2, 4]
+        np.testing.assert_allclose(out.numpy()[0, 0], m.weight.numpy()[1])
+
+    def test_dropout_train(self):
+        m = nn.Dropout(0.5)
+        m.train()
+        x = t(np.ones((100, 100), "float32"))
+        y = m(x).numpy()
+        frac = (y == 0).mean()
+        assert 0.3 < frac < 0.7
+
+    def test_flatten_layer(self):
+        m = nn.Flatten()
+        x = t(np.random.randn(2, 3, 4).astype("float32"))
+        assert m(x).shape == [2, 12]
+
+
+class TestActivations:
+    def test_activations_vs_numpy(self):
+        x = np.random.randn(3, 4).astype("float32")
+        np.testing.assert_allclose(F.relu(t(x)).numpy(), np.maximum(x, 0))
+        np.testing.assert_allclose(
+            F.sigmoid(t(x)).numpy(), 1 / (1 + np.exp(-x)), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            F.softmax(t(x), axis=-1).numpy(),
+            np.exp(x) / np.exp(x).sum(-1, keepdims=True), rtol=1e-4, atol=1e-5)
+        gelu = F.gelu(t(x)).numpy()
+        approx = 0.5 * x * (1 + np.tanh(np.sqrt(2 / np.pi) * (x + 0.044715 * x ** 3)))
+        np.testing.assert_allclose(gelu, approx, rtol=1e-2, atol=1e-3)
+        lrelu = F.leaky_relu(t(x), 0.1).numpy()
+        np.testing.assert_allclose(lrelu, np.where(x > 0, x, 0.1 * x), rtol=1e-5)
+        np.testing.assert_allclose(F.silu(t(x)).numpy(), x / (1 + np.exp(-x)),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_activation_layers(self):
+        x = t(np.random.randn(2, 3).astype("float32"))
+        for L in [nn.ReLU(), nn.GELU(), nn.Sigmoid(), nn.Tanh(), nn.Softmax(), nn.Silu()]:
+            assert L(x).shape == [2, 3]
+
+
+class TestConvPool:
+    def test_conv2d_shape_and_value(self):
+        m = nn.Conv2D(3, 8, 3, padding=1)
+        x = t(np.random.randn(2, 3, 16, 16).astype("float32"))
+        out = m(x)
+        assert out.shape == [2, 8, 16, 16]
+
+    def test_conv2d_vs_manual(self):
+        # 1x1 conv equals matmul over channels
+        m = nn.Conv2D(4, 6, 1)
+        x = np.random.randn(1, 4, 5, 5).astype("float32")
+        out = m(t(x)).numpy()
+        w = m.weight.numpy().reshape(6, 4)
+        expect = np.einsum("oc,bchw->bohw", w, x) + m.bias.numpy().reshape(1, 6, 1, 1)
+        np.testing.assert_allclose(out, expect, rtol=1e-3, atol=1e-4)
+
+    def test_conv2d_stride_groups(self):
+        m = nn.Conv2D(4, 4, 3, stride=2, padding=1, groups=2)
+        x = t(np.random.randn(2, 4, 8, 8).astype("float32"))
+        assert m(x).shape == [2, 4, 4, 4]
+
+    def test_maxpool_avgpool(self):
+        x = np.random.randn(1, 2, 4, 4).astype("float32")
+        mp = nn.MaxPool2D(2, 2)(t(x)).numpy()
+        expect = x.reshape(1, 2, 2, 2, 2, 2).max((3, 5))
+        np.testing.assert_allclose(mp, expect)
+        ap = nn.AvgPool2D(2, 2)(t(x)).numpy()
+        np.testing.assert_allclose(ap, x.reshape(1, 2, 2, 2, 2, 2).mean((3, 5)), rtol=1e-5)
+
+    def test_adaptive_avgpool(self):
+        x = t(np.random.randn(1, 3, 8, 8).astype("float32"))
+        out = nn.AdaptiveAvgPool2D(1)(x)
+        assert out.shape == [1, 3, 1, 1]
+        np.testing.assert_allclose(out.numpy().reshape(1, 3), x.numpy().mean((2, 3)), rtol=1e-4)
+
+
+class TestNorm:
+    def test_batchnorm_train_stats(self):
+        m = nn.BatchNorm2D(3)
+        m.train()
+        x = np.random.randn(4, 3, 5, 5).astype("float32") * 2 + 1
+        out = m(t(x)).numpy()
+        np.testing.assert_allclose(out.mean((0, 2, 3)), 0, atol=1e-4)
+        np.testing.assert_allclose(out.std((0, 2, 3)), 1, atol=1e-2)
+
+    def test_batchnorm_eval_running_stats(self):
+        m = nn.BatchNorm2D(3)
+        m.train()
+        x = np.random.randn(4, 3, 5, 5).astype("float32")
+        for _ in range(5):
+            m(t(x))
+        m.eval()
+        out = m(t(x))
+        assert out.shape == [4, 3, 5, 5]
+
+    def test_layernorm(self):
+        m = nn.LayerNorm(8)
+        x = np.random.randn(2, 4, 8).astype("float32")
+        out = m(t(x)).numpy()
+        mu = x.mean(-1, keepdims=True)
+        sd = x.std(-1, keepdims=True)
+        np.testing.assert_allclose(out, (x - mu) / np.sqrt(sd ** 2 + 1e-5), rtol=1e-3, atol=1e-3)
+
+    def test_groupnorm(self):
+        m = nn.GroupNorm(2, 4)
+        x = t(np.random.randn(2, 4, 3, 3).astype("float32"))
+        assert m(x).shape == [2, 4, 3, 3]
+
+
+class TestLoss:
+    def test_cross_entropy(self):
+        logits = np.random.randn(4, 5).astype("float32")
+        labels = np.array([0, 2, 1, 4], "int64")
+        loss = F.cross_entropy(t(logits), t(labels)).numpy()
+        # numpy oracle
+        e = np.exp(logits - logits.max(1, keepdims=True))
+        p = e / e.sum(1, keepdims=True)
+        expect = -np.log(p[np.arange(4), labels]).mean()
+        np.testing.assert_allclose(loss, expect, rtol=1e-4)
+
+    def test_mse_l1(self):
+        a = np.random.randn(3, 4).astype("float32")
+        b = np.random.randn(3, 4).astype("float32")
+        np.testing.assert_allclose(F.mse_loss(t(a), t(b)).numpy(),
+                                   ((a - b) ** 2).mean(), rtol=1e-4)
+        np.testing.assert_allclose(F.l1_loss(t(a), t(b)).numpy(),
+                                   np.abs(a - b).mean(), rtol=1e-4)
+
+    def test_nll_bce(self):
+        p = np.random.rand(4).astype("float32") * 0.8 + 0.1
+        y = np.array([1, 0, 1, 0], "float32")
+        out = F.binary_cross_entropy(t(p), t(y)).numpy()
+        expect = -(y * np.log(p) + (1 - y) * np.log(1 - p)).mean()
+        np.testing.assert_allclose(out, expect, rtol=1e-3)
+
+    def test_loss_layers(self):
+        logits = t(np.random.randn(4, 5).astype("float32"))
+        labels = t(np.array([0, 2, 1, 4], "int64"))
+        loss = nn.CrossEntropyLoss()(logits, labels)
+        assert loss.shape == [] or loss.shape == [1]
+
+
+class TestTransformer:
+    def test_multihead_attention(self):
+        m = nn.MultiHeadAttention(embed_dim=16, num_heads=4)
+        x = t(np.random.randn(2, 5, 16).astype("float32"))
+        out = m(x, x, x)
+        assert out.shape == [2, 5, 16]
+
+    def test_transformer_encoder_layer(self):
+        layer = nn.TransformerEncoderLayer(d_model=16, nhead=4, dim_feedforward=32)
+        x = t(np.random.randn(2, 5, 16).astype("float32"))
+        assert layer(x).shape == [2, 5, 16]
+
+    def test_transformer_encoder(self):
+        layer = nn.TransformerEncoderLayer(d_model=16, nhead=4, dim_feedforward=32)
+        enc = nn.TransformerEncoder(layer, num_layers=2)
+        x = t(np.random.randn(2, 5, 16).astype("float32"))
+        assert enc(x).shape == [2, 5, 16]
+
+
+class TestRNN:
+    def test_lstm_gru_shapes(self):
+        lstm = nn.LSTM(8, 16)
+        x = t(np.random.randn(2, 5, 8).astype("float32"))
+        out, (h, c) = lstm(x)
+        assert out.shape == [2, 5, 16]
+        gru = nn.GRU(8, 16)
+        out2, h2 = gru(x)
+        assert out2.shape == [2, 5, 16]
+
+
+class TestTraining:
+    def test_mlp_learns_xor(self):
+        X = np.array([[0, 0], [0, 1], [1, 0], [1, 1]], "float32")
+        Y = np.array([0, 1, 1, 0], "int64")
+        net = nn.Sequential(nn.Linear(2, 16), nn.Tanh(), nn.Linear(16, 2))
+        opt = paddle.optimizer.Adam(learning_rate=0.05, parameters=net.parameters())
+        for _ in range(150):
+            logits = net(t(X))
+            loss = F.cross_entropy(logits, t(Y))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        pred = net(t(X)).numpy().argmax(1)
+        assert (pred == Y).all(), pred

@@ -1,0 +1,164 @@
+"""Optimizer + LR scheduler tests (reference: python/paddle/optimizer/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def quad_problem():
+    # minimize ||w - target||^2
+    target = np.arange(6, dtype="float32").reshape(2, 3)
+    w = paddle.to_tensor(np.zeros((2, 3), "float32"), stop_gradient=False)
+    w = paddle.framework.io.EagerParamBase.from_tensor(w) if hasattr(
+        paddle.framework, "io") and hasattr(paddle.framework.io, "EagerParamBase") else w
+    return w, target
+
+
+def run_steps(opt_cls, steps=200, lr=0.1, **kw):
+    target = np.array([[1.0, -2.0], [3.0, 0.5]], "float32")
+    w = paddle.create_parameter([2, 2], "float32")
+    opt = opt_cls(learning_rate=lr, parameters=[w], **kw)
+    for _ in range(steps):
+        loss = paddle.sum((w - paddle.to_tensor(target)) ** 2)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return w.numpy(), target
+
+
+class TestOptimizers:
+    def test_sgd_converges(self):
+        w, target = run_steps(paddle.optimizer.SGD, steps=300, lr=0.1)
+        np.testing.assert_allclose(w, target, atol=1e-3)
+
+    def test_momentum_converges(self):
+        w, target = run_steps(paddle.optimizer.Momentum, steps=300, lr=0.05)
+        np.testing.assert_allclose(w, target, atol=1e-3)
+
+    def test_adam_converges(self):
+        w, target = run_steps(paddle.optimizer.Adam, steps=400, lr=0.1)
+        np.testing.assert_allclose(w, target, atol=1e-2)
+
+    def test_adamw_converges(self):
+        w, target = run_steps(paddle.optimizer.AdamW, steps=400, lr=0.1,
+                              weight_decay=0.0)
+        np.testing.assert_allclose(w, target, atol=1e-2)
+
+    def test_rmsprop_adagrad(self):
+        w, target = run_steps(paddle.optimizer.RMSProp, steps=400, lr=0.05)
+        np.testing.assert_allclose(w, target, atol=5e-2)
+        w, target = run_steps(paddle.optimizer.Adagrad, steps=800, lr=0.5)
+        np.testing.assert_allclose(w, target, atol=5e-2)
+
+    def test_sgd_matches_manual(self):
+        # one step of SGD == w - lr*g exactly
+        w = paddle.create_parameter([3], "float32")
+        w0 = w.numpy().copy()
+        opt = paddle.optimizer.SGD(learning_rate=0.5, parameters=[w])
+        loss = paddle.sum(w * 3.0)
+        loss.backward()
+        opt.step()
+        np.testing.assert_allclose(w.numpy(), w0 - 0.5 * 3.0, rtol=1e-5)
+
+    def test_adam_matches_reference_formula(self):
+        w = paddle.create_parameter([2], "float32")
+        w0 = w.numpy().astype("float64").copy()
+        lr, b1, b2, eps = 0.1, 0.9, 0.999, 1e-8
+        opt = paddle.optimizer.Adam(learning_rate=lr, parameters=[w],
+                                    beta1=b1, beta2=b2, epsilon=eps)
+        g = np.array([1.0, -2.0])
+        for step in range(1, 4):
+            loss = paddle.sum(w * paddle.to_tensor(g.astype("float32")))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        m = np.zeros(2)
+        v = np.zeros(2)
+        wref = w0.copy()
+        for step in range(1, 4):
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * g * g
+            mhat = m / (1 - b1 ** step)
+            vhat = v / (1 - b2 ** step)
+            wref -= lr * mhat / (np.sqrt(vhat) + eps)
+        np.testing.assert_allclose(w.numpy(), wref, rtol=1e-3, atol=1e-4)
+
+    def test_weight_decay_l2(self):
+        w = paddle.create_parameter([2], "float32")
+        w0 = w.numpy().copy()
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w],
+                                   weight_decay=0.5)
+        loss = paddle.sum(w * 0.0)
+        loss.backward()
+        opt.step()
+        np.testing.assert_allclose(w.numpy(), w0 - 0.1 * 0.5 * w0, rtol=1e-4)
+
+    def test_grad_clip_global_norm(self):
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        w = paddle.create_parameter([4], "float32")
+        opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[w], grad_clip=clip)
+        w0 = w.numpy().copy()
+        loss = paddle.sum(w * 100.0)
+        loss.backward()
+        opt.step()
+        delta = np.abs(w.numpy() - w0)
+        assert np.linalg.norm(delta) < 1.01  # clipped to norm 1 * lr 1
+
+    def test_optimizer_state_dict(self):
+        w = paddle.create_parameter([2], "float32")
+        opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w])
+        (w * 2.0).sum().backward()
+        opt.step()
+        sd = opt.state_dict()
+        assert sd
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        sched = paddle.optimizer.lr.StepDecay(learning_rate=1.0, step_size=2, gamma=0.5)
+        vals = []
+        for _ in range(5):
+            vals.append(sched())
+            sched.step()
+        np.testing.assert_allclose(vals, [1.0, 1.0, 0.5, 0.5, 0.25])
+
+    def test_cosine_annealing(self):
+        sched = paddle.optimizer.lr.CosineAnnealingDecay(learning_rate=1.0, T_max=10)
+        v0 = sched()
+        for _ in range(10):
+            sched.step()
+        v10 = sched()
+        assert v0 == 1.0 and v10 < 0.01
+
+    def test_warmup(self):
+        sched = paddle.optimizer.lr.LinearWarmup(
+            learning_rate=1.0, warmup_steps=10, start_lr=0.0, end_lr=1.0)
+        assert sched() == 0.0
+        for _ in range(5):
+            sched.step()
+        assert abs(sched() - 0.5) < 1e-6
+
+    def test_scheduler_drives_optimizer(self):
+        sched = paddle.optimizer.lr.StepDecay(learning_rate=0.5, step_size=1, gamma=0.1)
+        w = paddle.create_parameter([1], "float32")
+        opt = paddle.optimizer.SGD(learning_rate=sched, parameters=[w])
+        assert abs(opt.get_lr() - 0.5) < 1e-8
+        sched.step()
+        assert abs(opt.get_lr() - 0.05) < 1e-8
+
+    def test_natural_exp_poly_exp(self):
+        s = paddle.optimizer.lr.ExponentialDecay(learning_rate=1.0, gamma=0.9)
+        s.step()
+        assert abs(s() - 0.9) < 1e-6
+        p = paddle.optimizer.lr.PolynomialDecay(learning_rate=1.0, decay_steps=10)
+        p.step()
+        assert p() < 1.0
+
+    def test_noam_onecycle_exist(self):
+        assert hasattr(paddle.optimizer.lr, "NoamDecay")
+        assert hasattr(paddle.optimizer.lr, "OneCycleLR")
+        assert hasattr(paddle.optimizer.lr, "ReduceOnPlateau")
+        assert hasattr(paddle.optimizer.lr, "MultiStepDecay")
+        assert hasattr(paddle.optimizer.lr, "PiecewiseDecay")
+        assert hasattr(paddle.optimizer.lr, "LambdaDecay")

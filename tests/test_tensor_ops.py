@@ -1,0 +1,250 @@
+"""Numpy-oracle op tests — the analog of the reference OpTest harness
+(/root/reference/python/paddle/fluid/tests/unittests/op_test.py:326):
+numpy computes the expected output, the framework op must match.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def t(a, stop_gradient=True):
+    return paddle.to_tensor(a, stop_gradient=stop_gradient)
+
+
+def check(out, expect, rtol=1e-5, atol=1e-6):
+    np.testing.assert_allclose(out.numpy(), expect, rtol=rtol, atol=atol)
+
+
+class TestCreation:
+    def test_to_tensor_dtypes(self):
+        x = paddle.to_tensor([1, 2, 3])
+        assert x.dtype == paddle.int64 or str(x.dtype).endswith("int64") or "int" in str(x.dtype)
+        y = paddle.to_tensor([1.0, 2.0])
+        assert "float32" in str(y.dtype)
+
+    def test_zeros_ones_full(self):
+        check(paddle.zeros([2, 3]), np.zeros((2, 3), "float32"))
+        check(paddle.ones([4]), np.ones(4, "float32"))
+        check(paddle.full([2, 2], 7.0), np.full((2, 2), 7.0, "float32"))
+
+    def test_arange_linspace(self):
+        check(paddle.arange(0, 10, 2), np.arange(0, 10, 2))
+        check(paddle.linspace(0, 1, 5), np.linspace(0, 1, 5, dtype="float32"))
+
+    def test_eye_tril_triu(self):
+        check(paddle.eye(3), np.eye(3, dtype="float32"))
+        a = np.random.randn(4, 4).astype("float32")
+        check(paddle.tril(t(a)), np.tril(a))
+        check(paddle.triu(t(a)), np.triu(a))
+
+    def test_zeros_like_ones_like(self):
+        a = np.random.randn(2, 3).astype("float32")
+        check(paddle.zeros_like(t(a)), np.zeros_like(a))
+        check(paddle.ones_like(t(a)), np.ones_like(a))
+
+
+class TestMath:
+    def test_binary_elementwise(self):
+        a = np.random.randn(3, 4).astype("float32")
+        b = np.random.randn(3, 4).astype("float32")
+        check(paddle.add(t(a), t(b)), a + b)
+        check(paddle.subtract(t(a), t(b)), a - b)
+        check(paddle.multiply(t(a), t(b)), a * b)
+        check(paddle.divide(t(a), t(b)), a / b, rtol=1e-4)
+        check(paddle.maximum(t(a), t(b)), np.maximum(a, b))
+        check(paddle.minimum(t(a), t(b)), np.minimum(a, b))
+
+    def test_operator_overloads(self):
+        a = np.random.randn(3, 4).astype("float32")
+        b = np.random.randn(3, 4).astype("float32")
+        check(t(a) + t(b), a + b)
+        check(t(a) - t(b), a - b)
+        check(t(a) * 2.0, a * 2.0)
+        check(2.0 * t(a), 2.0 * a)
+        check(t(a) / 2.0, a / 2.0)
+        check(-t(a), -a)
+        check(t(a) ** 2, a ** 2)
+
+    def test_broadcast(self):
+        a = np.random.randn(3, 1, 4).astype("float32")
+        b = np.random.randn(2, 4).astype("float32")
+        check(t(a) + t(b), a + b)
+
+    def test_unary(self):
+        a = np.random.rand(3, 4).astype("float32") + 0.1
+        check(paddle.exp(t(a)), np.exp(a), rtol=1e-4)
+        check(paddle.log(t(a)), np.log(a), rtol=1e-3, atol=1e-4)
+        check(paddle.sqrt(t(a)), np.sqrt(a))
+        check(paddle.abs(t(-a)), a)
+        check(paddle.sin(t(a)), np.sin(a))
+        check(paddle.cos(t(a)), np.cos(a))
+        check(paddle.tanh(t(a)), np.tanh(a), rtol=1e-4)
+        check(paddle.floor(t(a)), np.floor(a))
+        check(paddle.ceil(t(a)), np.ceil(a))
+        check(paddle.round(t(a)), np.round(a))
+        check(paddle.reciprocal(t(a)), 1.0 / a, rtol=1e-4)
+        check(paddle.square(t(a)), a * a)
+        check(paddle.rsqrt(t(a)), 1 / np.sqrt(a), rtol=1e-4)
+
+    def test_reductions(self):
+        a = np.random.randn(3, 4, 5).astype("float32")
+        check(paddle.sum(t(a)), a.sum(), rtol=1e-4)
+        check(paddle.sum(t(a), axis=1), a.sum(1), rtol=1e-4)
+        check(paddle.sum(t(a), axis=[0, 2]), a.sum((0, 2)), rtol=1e-4)
+        check(paddle.mean(t(a)), a.mean(), rtol=1e-4)
+        check(paddle.max(t(a), axis=0), a.max(0))
+        check(paddle.min(t(a), axis=-1), a.min(-1))
+        check(paddle.prod(t(a[:2, :2, 0])), a[:2, :2, 0].prod(), rtol=1e-4)
+        out = paddle.sum(t(a), axis=1, keepdim=True)
+        assert out.shape == [3, 1, 5]
+
+    def test_cumsum_cumprod(self):
+        a = np.random.randn(3, 4).astype("float32")
+        check(paddle.cumsum(t(a), axis=1), np.cumsum(a, 1), rtol=1e-4)
+
+    def test_clip_pow_mod(self):
+        a = np.random.randn(3, 4).astype("float32")
+        check(paddle.clip(t(a), -0.5, 0.5), np.clip(a, -0.5, 0.5))
+        check(paddle.pow(t(np.abs(a) + 1), 2.0), (np.abs(a) + 1) ** 2, rtol=1e-4)
+
+    def test_matmul(self):
+        a = np.random.randn(4, 3).astype("float32")
+        b = np.random.randn(3, 5).astype("float32")
+        check(paddle.matmul(t(a), t(b)), a @ b, rtol=1e-4)
+        # batched
+        a3 = np.random.randn(2, 4, 3).astype("float32")
+        b3 = np.random.randn(2, 3, 5).astype("float32")
+        check(paddle.matmul(t(a3), t(b3)), a3 @ b3, rtol=1e-4)
+        # transpose flags
+        check(paddle.matmul(t(a), t(b.T), transpose_y=True), a @ b, rtol=1e-4)
+
+    def test_addmm_dot(self):
+        x = np.random.randn(4).astype("float32")
+        y = np.random.randn(4).astype("float32")
+        check(paddle.dot(t(x), t(y)), np.dot(x, y), rtol=1e-4)
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        a = np.random.randn(2, 3, 4).astype("float32")
+        check(paddle.reshape(t(a), [6, 4]), a.reshape(6, 4))
+        check(paddle.reshape(t(a), [-1, 4]), a.reshape(-1, 4))
+        check(paddle.transpose(t(a), [2, 0, 1]), a.transpose(2, 0, 1))
+
+    def test_concat_stack_split(self):
+        a = np.random.randn(2, 3).astype("float32")
+        b = np.random.randn(2, 3).astype("float32")
+        check(paddle.concat([t(a), t(b)], axis=0), np.concatenate([a, b], 0))
+        check(paddle.stack([t(a), t(b)], axis=1), np.stack([a, b], 1))
+        parts = paddle.split(t(a), 3, axis=1)
+        assert len(parts) == 3
+        check(parts[0], a[:, :1])
+
+    def test_squeeze_unsqueeze_flatten(self):
+        a = np.random.randn(2, 1, 3).astype("float32")
+        check(paddle.squeeze(t(a), axis=1), a.squeeze(1))
+        check(paddle.unsqueeze(t(a), axis=0), a[None])
+        check(paddle.flatten(t(a)), a.reshape(-1))
+
+    def test_gather_index_select(self):
+        a = np.random.randn(5, 3).astype("float32")
+        idx = np.array([0, 2, 4])
+        check(paddle.gather(t(a), t(idx), axis=0), a[idx])
+
+    def test_slice_and_getitem(self):
+        a = np.random.randn(5, 6).astype("float32")
+        check(t(a)[1:3], a[1:3])
+        check(t(a)[:, 2], a[:, 2])
+        check(t(a)[0], a[0])
+        check(t(a)[..., -1], a[..., -1])
+
+    def test_expand_tile(self):
+        a = np.random.randn(1, 3).astype("float32")
+        check(paddle.expand(t(a), [4, 3]), np.broadcast_to(a, (4, 3)))
+        check(paddle.tile(t(a), [2, 2]), np.tile(a, (2, 2)))
+
+    def test_cast(self):
+        a = np.random.randn(3).astype("float32")
+        out = paddle.cast(t(a), "float64")
+        assert "float64" in str(out.dtype)
+
+    def test_pad_roll_flip(self):
+        a = np.random.randn(2, 3).astype("float32")
+        check(paddle.roll(t(a), 1, axis=0), np.roll(a, 1, 0))
+        check(paddle.flip(t(a), axis=[1]), a[:, ::-1])
+
+
+class TestLogicSearch:
+    def test_comparisons(self):
+        a = np.random.randn(3, 4).astype("float32")
+        b = np.random.randn(3, 4).astype("float32")
+        check(paddle.equal(t(a), t(a)), np.equal(a, a))
+        check(paddle.greater_than(t(a), t(b)), a > b)
+        check(paddle.less_than(t(a), t(b)), a < b)
+        check(paddle.logical_and(t(a > 0), t(b > 0)), (a > 0) & (b > 0))
+        check(paddle.logical_not(t(a > 0)), ~(a > 0))
+
+    def test_where(self):
+        a = np.random.randn(3, 4).astype("float32")
+        b = np.random.randn(3, 4).astype("float32")
+        check(paddle.where(t(a > 0), t(a), t(b)), np.where(a > 0, a, b))
+
+    def test_argmax_argmin_argsort(self):
+        a = np.random.randn(3, 4).astype("float32")
+        check(paddle.argmax(t(a), axis=1), a.argmax(1))
+        check(paddle.argmin(t(a), axis=0), a.argmin(0))
+        check(paddle.sort(t(a), axis=1), np.sort(a, 1))
+
+    def test_topk(self):
+        a = np.random.randn(3, 10).astype("float32")
+        vals, idx = paddle.topk(t(a), k=3, axis=1)
+        expect = np.sort(a, 1)[:, ::-1][:, :3]
+        check(vals, expect)
+
+    def test_nonzero_unique(self):
+        a = np.array([[0, 1], [2, 0]], dtype="float32")
+        nz = paddle.nonzero(t(a))
+        assert nz.numpy().shape[1] == 2
+
+
+class TestStat:
+    def test_var_std_median(self):
+        a = np.random.randn(3, 40).astype("float32")
+        check(paddle.var(t(a)), a.var(ddof=1), rtol=1e-4)
+        check(paddle.std(t(a)), a.std(ddof=1), rtol=1e-4)
+
+    def test_einsum(self):
+        a = np.random.randn(3, 4).astype("float32")
+        b = np.random.randn(4, 5).astype("float32")
+        check(paddle.einsum("ij,jk->ik", t(a), t(b)), np.einsum("ij,jk->ik", a, b), rtol=1e-4)
+
+
+class TestLinalg:
+    def test_norm(self):
+        a = np.random.randn(3, 4).astype("float32")
+        check(paddle.norm(t(a)), np.linalg.norm(a), rtol=1e-4)
+
+    def test_t_property(self):
+        a = np.random.randn(3, 4).astype("float32")
+        check(t(a).T, a.T)
+
+
+class TestInplaceAndMethods:
+    def test_tensor_methods(self):
+        a = np.random.randn(3, 4).astype("float32")
+        x = t(a)
+        check(x.sum(), a.sum(), rtol=1e-4)
+        check(x.mean(), a.mean(), rtol=1e-4)
+        check(x.reshape([4, 3]), a.reshape(4, 3))
+        check(x.exp(), np.exp(a), rtol=1e-4)
+        assert x.numel() == 12
+        assert x.shape == [3, 4]
+
+    def test_item_scalar(self):
+        x = paddle.to_tensor(3.5)
+        assert abs(x.item() - 3.5) < 1e-6
+
+    def test_astype(self):
+        x = t(np.random.randn(3).astype("float32"))
+        assert "int32" in str(x.astype("int32").dtype)
