@@ -1,0 +1,88 @@
+"""Benchmark: GPT causal-LM training throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The reference publishes no in-repo numbers (SURVEY §6/BASELINE.md); the
+headline target is MFU-based (>=45% on the GPT config), so vs_baseline is
+measured_MFU / 0.45.
+
+Usage: python bench.py [--smoke]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny config on CPU for CI/verify")
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--warmup", type=int, default=3)
+    args = ap.parse_args()
+
+    if args.smoke:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models import (GPTForCausalLM, GPTPretrainingCriterion,
+                                   gpt_tiny, gpt2_small)
+
+    paddle.seed(0)
+    if args.smoke:
+        cfg = gpt_tiny(use_flash_attention=False)
+        batch, seq = 2, 64
+    else:
+        cfg = gpt2_small(max_seq_len=512)
+        batch, seq = 8, 512
+
+    model = GPTForCausalLM(cfg)
+    crit = GPTPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    step = TrainStep(model, lambda out, y: crit(out, y), opt)
+
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype("int64"))
+
+    for _ in range(args.warmup):
+        loss = step(ids, ids)
+    float(loss.numpy())  # sync
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        loss = step(ids, ids)
+    final = float(loss.numpy())  # sync
+    dt = time.perf_counter() - t0
+
+    steps_per_sec = args.steps / dt
+    tokens_per_sec = steps_per_sec * batch * seq
+
+    n_params = model.num_params()
+    # 6*N FLOPs/token (fwd+bwd) + attention term 12*L*H*S per token
+    attn_flops = 12 * cfg.num_layers * cfg.hidden_size * seq
+    flops_per_token = 6 * n_params + attn_flops
+    achieved = tokens_per_sec * flops_per_token
+    peak = float(os.environ.get("BENCH_PEAK_FLOPS", 197e12))  # v5e bf16
+    mfu = achieved / peak
+    assert np.isfinite(final), "loss diverged"
+
+    print(json.dumps({
+        "metric": "gpt2s_train_tokens_per_sec" if not args.smoke
+                  else "gpt_tiny_smoke_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.45, 4) if not args.smoke else 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

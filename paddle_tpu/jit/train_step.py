@@ -13,6 +13,7 @@ from typing import Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
 from ..core.tensor import Tensor
@@ -189,11 +190,17 @@ class TrainStep:
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         t = jnp.asarray(self.optimizer._step_count, jnp.int32)
         key = random_mod.next_key()
-        arrays = [b._data if isinstance(b, Tensor) else jnp.asarray(b)
+        # paddle dtype defaulting (python floats → default float dtype), not
+        # jnp.asarray's — which under x64 would yield f64/i64 inputs
+        arrays = [b._data if isinstance(b, Tensor) else Tensor(b)._data
                   for b in batch]
         if getattr(self, "_mesh", None) is not None:
+            nshards = int(np.prod([self._mesh.shape[a]
+                                   for a in _batch_axes(self._mesh)] or [1]))
             arrays = [jax.device_put(a, self._batch_sharding)
-                      if getattr(a, "ndim", 0) >= 1 else a for a in arrays]
+                      if getattr(a, "ndim", 0) >= 1
+                      and a.shape[0] % nshards == 0 else a
+                      for a in arrays]
         loss, new_params, new_state = self._compiled(
             params, buffers, opt_state, lr, t, key, *arrays)
         for n, p in self._named_params.items():

@@ -1,0 +1,7 @@
+"""Flagship model families (the reference keeps GPT/ERNIE in external repos
+driven by fleet; here they ship in-tree as the hybrid-parallel north star —
+SURVEY §3.3 / BASELINE GPT-3 1.3B config)."""
+from .gpt import (  # noqa: F401
+    GPTConfig, GPTModel, GPTForCausalLM, GPTPretrainingCriterion,
+    gpt_tiny, gpt2_small, gpt3_1p3b,
+)

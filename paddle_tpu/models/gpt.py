@@ -1,0 +1,236 @@
+"""GPT decoder-only transformer, TPU-native hybrid-parallel flagship.
+
+Capability target: the GPT models the reference trains through Fleet hybrid
+parallelism (SURVEY §3.3 north-star config; reference TP layers at
+/root/reference/python/paddle/distributed/fleet/layers/mpu/mp_layers.py,
+fused attention ops at /root/reference/paddle/fluid/operators/fused/).
+
+TPU-native design:
+- TP: q/kv/mlp projections are Column/RowParallelLinear — logically-full
+  params carrying `dist_spec` PartitionSpecs; GSPMD shards the matmuls and
+  inserts the Megatron identity/allreduce collectives.
+- SP (sequence parallel / long context): activations carry a sequence-axis
+  sharding constraint over the "sep" mesh axis when present — capability the
+  reference snapshot lacks (SURVEY §5.7).
+- Attention: Pallas flash attention on TPU (paddle_tpu.ops), XLA softmax
+  path elsewhere; always causal, static shapes.
+- PP: the layer stack is an explicit list so PipelineLayer/LayerDesc can
+  segment it (paddle_tpu.distributed.fleet.meta_parallel.pp_layers).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+from ..distributed.fleet.meta_parallel.mp_layers import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
+from ..distributed.mesh_utils import get_global_mesh, with_constraint
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.initializer_utils import create_parameter_with_attr
+from ..nn.layer.common import Dropout, Linear
+from ..nn.layer.container import LayerList
+from ..nn.layer.layers import Layer
+from ..nn.layer.norm import LayerNorm
+
+__all__ = [
+    "GPTConfig", "GPTModel", "GPTForCausalLM", "GPTPretrainingCriterion",
+    "gpt_tiny", "gpt2_small", "gpt3_1p3b",
+]
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304          # multiple of 128 for clean TP splits
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 1024
+    intermediate_size: int = 0       # 0 → 4*hidden
+    dropout: float = 0.0
+    layer_norm_eps: float = 1e-5
+    initializer_range: float = 0.02
+    use_flash_attention: bool = True
+    tie_word_embeddings: bool = True
+
+    def __post_init__(self):
+        if self.intermediate_size == 0:
+            self.intermediate_size = 4 * self.hidden_size
+        assert self.hidden_size % self.num_heads == 0
+
+
+def gpt_tiny(**kw) -> GPTConfig:
+    d = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+             max_seq_len=128)
+    d.update(kw)
+    return GPTConfig(**d)
+
+
+def gpt2_small(**kw) -> GPTConfig:
+    d = dict(vocab_size=50304, hidden_size=768, num_layers=12, num_heads=12,
+             max_seq_len=1024)
+    d.update(kw)
+    return GPTConfig(**d)
+
+
+def gpt3_1p3b(**kw) -> GPTConfig:
+    d = dict(vocab_size=50304, hidden_size=2048, num_layers=24, num_heads=16,
+             max_seq_len=2048)
+    d.update(kw)
+    return GPTConfig(**d)
+
+
+def _seq_constraint(x):
+    """Sequence-parallel activation sharding over the 'sep' mesh axis
+    ([B, S, H] → S sharded). No-op without a mesh or sep axis."""
+    mesh = get_global_mesh()
+    if mesh is None or "sep" not in mesh.axis_names or mesh.shape["sep"] == 1:
+        return x
+    return apply_op("sp_shard",
+                    lambda a: with_constraint(a, "dp", "sep", None), x)
+
+
+class GPTEmbeddings(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.word_embeddings = VocabParallelEmbedding(
+            config.vocab_size, config.hidden_size)
+        init = I.Normal(std=config.initializer_range)
+        self.position_embeddings = create_parameter_with_attr(
+            [config.max_seq_len, config.hidden_size], self._dtype, None,
+            False, default_initializer=init)
+        self.dropout = Dropout(config.dropout)
+
+    def forward(self, input_ids):
+        seq_len = input_ids.shape[-1]
+        from ..tensor import manipulation as M
+        h = self.word_embeddings(input_ids)
+        pos = M.slice_rows(self.position_embeddings, 0, seq_len) if hasattr(
+            M, "slice_rows") else self.position_embeddings[:seq_len]
+        h = h + pos
+        return _seq_constraint(self.dropout(h))
+
+
+class GPTAttention(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.num_heads = config.num_heads
+        self.head_dim = config.hidden_size // config.num_heads
+        self.hidden_size = config.hidden_size
+        self.use_flash = config.use_flash_attention
+        self.qkv_proj = ColumnParallelLinear(
+            config.hidden_size, 3 * config.hidden_size, gather_output=False)
+        self.out_proj = RowParallelLinear(
+            config.hidden_size, config.hidden_size, input_is_parallel=True)
+        self.dropout = Dropout(config.dropout)
+
+    def forward(self, x):
+        b, s, _ = x.shape
+        qkv = self.qkv_proj(x)                       # [B,S,3H]
+        qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
+        from ..tensor import manipulation as M
+        q = qkv[:, :, 0]                             # [B,S,nh,hd]
+        k = qkv[:, :, 1]
+        v = qkv[:, :, 2]
+        from ..nn.functional.attention import scaled_dot_product_attention
+        out = scaled_dot_product_attention(
+            q, k, v, is_causal=True, dropout_p=0.0)  # [B,S,nh,hd]
+        out = out.reshape([b, s, self.hidden_size])
+        return self.dropout(self.out_proj(out))
+
+
+class GPTMLP(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.fc_in = ColumnParallelLinear(
+            config.hidden_size, config.intermediate_size, gather_output=False)
+        self.fc_out = RowParallelLinear(
+            config.intermediate_size, config.hidden_size,
+            input_is_parallel=True)
+        self.dropout = Dropout(config.dropout)
+
+    def forward(self, x):
+        return self.dropout(self.fc_out(F.gelu(self.fc_in(x))))
+
+
+class GPTDecoderLayer(Layer):
+    """Pre-LN decoder block (the MFU-critical fused pattern the reference
+    implements as fused_attention/fused_feedforward CUDA ops —
+    /root/reference/paddle/fluid/operators/fused/; here XLA fuses)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln_1 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+        self.attn = GPTAttention(config)
+        self.ln_2 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+        self.mlp = GPTMLP(config)
+
+    def forward(self, x):
+        x = x + self.attn(self.ln_1(x))
+        x = x + self.mlp(self.ln_2(x))
+        return _seq_constraint(x)
+
+
+class GPTModel(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = GPTEmbeddings(config)
+        self.layers = LayerList([GPTDecoderLayer(config)
+                                 for _ in range(config.num_layers)])
+        self.ln_f = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+
+    def forward(self, input_ids):
+        h = self.embeddings(input_ids)
+        for layer in self.layers:
+            h = layer(h)
+        return self.ln_f(h)
+
+    # -- pipeline segmentation hook (pp_layers.LayerDesc consumers) --
+    def pipeline_stages(self):
+        return [self.embeddings] + list(self.layers) + [self.ln_f]
+
+
+class GPTForCausalLM(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.gpt = GPTModel(config)
+        self.config = config
+        if not config.tie_word_embeddings:
+            self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                                  bias_attr=False)
+
+    def forward(self, input_ids):
+        h = self.gpt(input_ids)
+        if self.config.tie_word_embeddings:
+            from ..tensor import linalg
+            w = self.gpt.embeddings.word_embeddings.weight
+            logits = linalg.matmul(h, w, transpose_y=True)
+        else:
+            logits = self.lm_head(h)
+        return logits
+
+    def num_params(self) -> int:
+        return sum(int(np.prod(p.shape)) for p in self.parameters())
+
+
+class GPTPretrainingCriterion(Layer):
+    """Causal-LM loss: shift-by-one CE over the (vocab-parallel) logits —
+    reference: ParallelCrossEntropy (mp_layers.py:558)."""
+
+    def __init__(self, ignore_index: int = -100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, logits, labels):
+        # logits [B,S,V], labels [B,S] — next-token prediction
+        from ..tensor import manipulation as M
+        lg = logits[:, :-1, :]
+        lb = labels[:, 1:]
+        b, s, v = lg.shape
+        return F.cross_entropy(lg.reshape([b * s, v]), lb.reshape([b * s]),
+                               ignore_index=self.ignore_index)

@@ -21,12 +21,20 @@ DEFAULT_BLOCK_K = 128
 NEG_INF = -1e30
 
 
+def _i0():
+    """Index-map zero as i32: under jax_enable_x64 a bare python 0 traces as
+    i64 and Mosaic refuses the mixed-width index tuple."""
+    return jnp.int32(0)
+
+
 def _mha_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, block_k,
                     kv_len):
     # q_ref: [block_q, d]; k_ref/v_ref: [kv_len, d]; o_ref: [block_q, d]
     block_q = q_ref.shape[0]
     d = q_ref.shape[1]
-    q = q_ref[:].astype(jnp.float32) * sm_scale
+    # all float scalars must be explicit f32: under jax_enable_x64 a python
+    # float is a weak f64 and Mosaic cannot legalize the resulting truncf
+    q = q_ref[:].astype(jnp.float32) * jnp.float32(sm_scale)
     q_idx = pl.program_id(1)
 
     m_init = jnp.full((block_q,), NEG_INF, jnp.float32)
@@ -46,7 +54,7 @@ def _mha_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, block_k,
                 jnp.int32, (block_q, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            s = jnp.where(q_pos >= k_pos, s, jnp.float32(NEG_INF))
         m_cur = jnp.max(s, axis=1)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new[:, None])
@@ -57,15 +65,17 @@ def _mha_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, block_k,
         return m_new, l_new, acc
 
     if causal:
-        # only loop over blocks at/below the diagonal
-        last_kb = jax.lax.div((q_idx + 1) * block_q + block_k - 1, block_k)
-        last_kb = jnp.minimum(last_kb, num_kb)
+        # only loop over blocks at/below the diagonal (int32 literals: under
+        # jax_enable_x64 a bare python int would promote the divisor to i64)
+        last_kb = jax.lax.div(
+            (q_idx + 1) * block_q + block_k - 1, jnp.int32(block_k))
+        last_kb = jnp.minimum(last_kb, jnp.int32(num_kb))
     else:
-        last_kb = num_kb
+        last_kb = jnp.int32(num_kb)
 
-    m, l, acc = jax.lax.fori_loop(0, last_kb, body,
+    m, l, acc = jax.lax.fori_loop(jnp.int32(0), last_kb, body,
                                   (m_init, l_init, acc_init))
-    l = jnp.maximum(l, 1e-30)
+    l = jnp.maximum(l, jnp.float32(1e-30))
     o_ref[:] = (acc / l[:, None]).astype(o_ref.dtype)
 
 
@@ -82,11 +92,12 @@ def _mha_fwd(q, k, v, causal, sm_scale, block_q, block_k):
         kernel,
         grid=(b * h, sq // block_q),
         in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((None, sk, d), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((None, sk, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, _i0())),
+            pl.BlockSpec((None, sk, d), lambda bh, i: (bh, _i0(), _i0())),
+            pl.BlockSpec((None, sk, d), lambda bh, i: (bh, _i0(), _i0())),
         ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, 0)),
+        out_specs=pl.BlockSpec((None, block_q, d),
+                               lambda bh, i: (bh, i, _i0())),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),

@@ -100,7 +100,7 @@ class TestShardedTraining:
             return m.state_dict()
 
         single = run(None)
-        dp = run({"data": 8})
+        dp = run({"dp": 8})
         for k in single:
             np.testing.assert_allclose(single[k].numpy(), dp[k].numpy(),
                                        rtol=1e-4, atol=1e-5)
@@ -114,12 +114,14 @@ class TestAutoParallel:
         assert pm.shape == [2, 4]
 
     def test_shard_tensor(self):
-        import paddle_tpu.distributed as dist2
-        from paddle_tpu.distributed.auto_parallel import ProcessMesh
+        from paddle_tpu.distributed.auto_parallel import ProcessMesh, shard_tensor
         pm = ProcessMesh(mesh=np.arange(8).reshape(2, 4).tolist(),
                          dim_names=["x", "y"])
         x = paddle.to_tensor(np.random.randn(8, 8).astype("float32"))
-        if hasattr(dist2, "shard_tensor"):
-            sharded = dist2.shard_tensor(x, pm, [dist2.Shard(0), dist2.Replicate()]) \
-                if hasattr(dist2, "Shard") else x
-            assert sharded.shape == [8, 8]
+        sharded = shard_tensor(x, pm, ["x", None])
+        assert sharded.shape == [8, 8]
+        assert sharded.dist_spec == ("x", None)
+        # placement really happened: 8 shards of 4 rows each over the 2x4 mesh
+        shard_shapes = {s.data.shape for s in sharded._data.addressable_shards}
+        assert shard_shapes == {(4, 8)}
+        np.testing.assert_allclose(np.asarray(sharded._data), x.numpy())
