@@ -22,15 +22,17 @@ def main():
                     help="tiny config on CPU for CI/verify")
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--no-amp", action="store_true",
+                    help="disable bf16 autocast (default: O1 bf16, the "
+                         "reference's AMP GPT configuration)")
     args = ap.parse_args()
 
+    import jax
+
     if args.smoke:
-        import jax
         jax.config.update("jax_platforms", "cpu")
 
-    import jax
     import paddle_tpu as paddle
-    import paddle_tpu.nn.functional as F
     from paddle_tpu.jit import TrainStep
     from paddle_tpu.models import (GPTForCausalLM, GPTPretrainingCriterion,
                                    gpt_tiny, gpt2_small)
@@ -47,13 +49,16 @@ def main():
     crit = GPTPretrainingCriterion()
     opt = paddle.optimizer.AdamW(learning_rate=1e-4,
                                  parameters=model.parameters())
-    step = TrainStep(model, lambda out, y: crit(out, y), opt)
+    amp_level = None if (args.smoke or args.no_amp) else "O1"
+    step = TrainStep(model, lambda out, y: crit(out, y), opt,
+                     amp_level=amp_level)
 
     rng = np.random.RandomState(0)
     ids = paddle.to_tensor(
         rng.randint(0, cfg.vocab_size, (batch, seq)).astype("int64"))
 
-    for _ in range(args.warmup):
+    loss = step(ids, ids)  # compile + first step
+    for _ in range(max(args.warmup - 1, 0)):
         loss = step(ids, ids)
     float(loss.numpy())  # sync
 

@@ -71,7 +71,7 @@ def maybe_autocast_args(op_name, arrays):
     """Called by dispatch: cast float inputs per AMP state. O1 = allowlist;
     O2 = everything except blacklist."""
     st = amp_state()
-    if st is None:
+    if st is None or op_name is None:
         return arrays
     name = op_name.split("/")[-1]
     target = st["dtype"].np_dtype

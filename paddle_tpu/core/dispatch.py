@@ -127,8 +127,12 @@ def defop(name: str, jax_fn: Callable):
     carry.
     """
 
-    def op(*args, name=None, **kwargs):  # noqa: A002 - paddle API shape
-        return apply_op(name or jax_fn.__name__, jax_fn, *args, **kwargs)
+    op_name = name
 
-    op.__name__ = name
+    def op(*args, name=None, **kwargs):  # noqa: A002 - paddle API shape
+        # `name` here is paddle's user-facing label, NOT the op identity:
+        # AMP allow/deny lists key on the registered op name.
+        return apply_op(op_name, jax_fn, *args, **kwargs)
+
+    op.__name__ = op_name
     return op

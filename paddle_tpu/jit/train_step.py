@@ -72,12 +72,15 @@ class TrainStep:
     """
 
     def __init__(self, model, loss_fn: Callable, optimizer,
-                 donate: bool = True):
+                 donate: bool = True, amp_level: Optional[str] = None,
+                 amp_dtype: str = "bfloat16"):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self._compiled = None
         self._donate = donate
+        self._amp_level = amp_level  # None | "O1" | "O2"
+        self._amp_dtype = amp_dtype
         self._named_params = dict(model.named_parameters())
         self._trainable = {n: p for n, p in self._named_params.items()
                            if not p.stop_gradient}
@@ -107,16 +110,27 @@ class TrainStep:
         lr_mult = {n: getattr(p, "optimize_attr", {"learning_rate": 1.0})[
             "learning_rate"] for n, p in self._trainable.items()}
 
+        amp_level, amp_dtype = self._amp_level, self._amp_dtype
+
         def pure_step(params, buffers, opt_state, lr, t, key, *batch):
             def loss_of(train_params):
                 all_params = {**params, **train_params}
                 from ..core import autograd as ag
+                from ..amp.auto_cast import auto_cast
+                import contextlib
+                amp_ctx = (auto_cast(level=amp_level, dtype=amp_dtype)
+                           if amp_level else contextlib.nullcontext())
+                # AMP under trace: dispatch-level autocast runs inside the
+                # traced forward, so XLA sees bf16 matmuls with f32 master
+                # params (reference O1/O2, auto_cast.py:668) and fuses the
+                # casts away.
                 with _swapped_state(model, all_params, buffers), ag.no_grad(), \
-                        random_mod.traced_key_scope(key):
+                        random_mod.traced_key_scope(key), amp_ctx:
                     t_batch = [Tensor(a, stop_gradient=True) for a in batch]
                     out = model(*t_batch[:self._n_inputs])
                     loss_t = loss_fn(out, *t_batch[self._n_inputs:])
-                return loss_t._data if isinstance(loss_t, Tensor) else loss_t
+                l_arr = loss_t._data if isinstance(loss_t, Tensor) else loss_t
+                return l_arr.astype(jnp.float32)
 
             train_params = {n: params[n] for n in trainable_names}
             loss, grads = jax.value_and_grad(loss_of)(train_params)

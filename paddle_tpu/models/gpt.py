@@ -107,11 +107,8 @@ class GPTEmbeddings(Layer):
 
     def forward(self, input_ids):
         seq_len = input_ids.shape[-1]
-        from ..tensor import manipulation as M
         h = self.word_embeddings(input_ids)
-        pos = M.slice_rows(self.position_embeddings, 0, seq_len) if hasattr(
-            M, "slice_rows") else self.position_embeddings[:seq_len]
-        h = h + pos
+        h = h + self.position_embeddings[:seq_len]
         return _seq_constraint(self.dropout(h))
 
 
@@ -122,6 +119,7 @@ class GPTAttention(Layer):
         self.head_dim = config.hidden_size // config.num_heads
         self.hidden_size = config.hidden_size
         self.use_flash = config.use_flash_attention
+        self.attn_dropout_p = config.dropout
         self.qkv_proj = ColumnParallelLinear(
             config.hidden_size, 3 * config.hidden_size, gather_output=False)
         self.out_proj = RowParallelLinear(
@@ -138,7 +136,8 @@ class GPTAttention(Layer):
         v = qkv[:, :, 2]
         from ..nn.functional.attention import scaled_dot_product_attention
         out = scaled_dot_product_attention(
-            q, k, v, is_causal=True, dropout_p=0.0)  # [B,S,nh,hd]
+            q, k, v, is_causal=True, dropout_p=self.attn_dropout_p,
+            training=self.training, use_flash=self.use_flash)  # [B,S,nh,hd]
         out = out.reshape([b, s, self.hidden_size])
         return self.dropout(self.out_proj(out))
 

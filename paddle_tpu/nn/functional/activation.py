@@ -8,10 +8,10 @@ from ...core.dispatch import apply_op
 from ...framework import random as random_mod
 
 
-def _unop(name, fn):
-    def op(x, name=None):
-        return apply_op(name, fn, x)
-    op.__name__ = name
+def _unop(op_name, fn):
+    def op(x, name=None):  # noqa: A002 - `name` is paddle's user label
+        return apply_op(op_name, fn, x)
+    op.__name__ = op_name
     return op
 
 

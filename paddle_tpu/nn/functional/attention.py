@@ -35,19 +35,22 @@ def _sdpa_reference(q, k, v, mask=None, dropout_p=0.0, causal=False, scale=None)
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False, training=True,
-                                 name=None):
+                                 use_flash=True, name=None):
     """paddle.nn.functional.scaled_dot_product_attention.
 
     Layout [batch, seq, num_heads, head_dim]. Uses the Pallas flash kernel on
-    TPU when shapes allow; falls back to the XLA softmax path.
+    TPU when shapes allow (and ``use_flash``); falls back to the XLA softmax
+    path.
     """
     from ...ops import flash_attention as fa
 
+    p = dropout_p if training else 0.0
+
     def _sdpa(q, k, v, *m):
         mask = m[0] if m else None
-        if fa.supported(q, k, v, mask, is_causal):
+        if use_flash and p == 0.0 and fa.supported(q, k, v, mask, is_causal):
             return fa.flash_attention_bshd(q, k, v, causal=is_causal)
-        return _sdpa_reference(q, k, v, mask, dropout_p, is_causal)
+        return _sdpa_reference(q, k, v, mask, p, is_causal)
 
     if attn_mask is not None:
         return apply_op("scaled_dot_product_attention", _sdpa, query, key,

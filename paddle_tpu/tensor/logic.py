@@ -8,10 +8,10 @@ from ..core.dispatch import apply_op, unwrap
 from ..core.tensor import Tensor
 
 
-def _cmp(name, fn):
-    def op(x, y, name=None):
-        return apply_op(name, fn, x, y)
-    op.__name__ = name
+def _cmp(op_name, fn):
+    def op(x, y, name=None):  # noqa: A002 - `name` is paddle's user label
+        return apply_op(op_name, fn, x, y)
+    op.__name__ = op_name
     return op
 
 

@@ -35,17 +35,17 @@ def _inplace(x: Tensor, r: Tensor) -> Tensor:
     return x
 
 
-def _binop(name, fn):
-    def op(x, y, name=None):
-        return apply_op(name, fn, x, y)
-    op.__name__ = name
+def _binop(op_name, fn):
+    def op(x, y, name=None):  # noqa: A002 - `name` is paddle's user label
+        return apply_op(op_name, fn, x, y)
+    op.__name__ = op_name
     return op
 
 
-def _unop(name, fn):
-    def op(x, name=None):
-        return apply_op(name, fn, x)
-    op.__name__ = name
+def _unop(op_name, fn):
+    def op(x, name=None):  # noqa: A002
+        return apply_op(op_name, fn, x)
+    op.__name__ = op_name
     return op
 
 

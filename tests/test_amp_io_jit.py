@@ -159,6 +159,22 @@ class TestJit:
             l = float(step(x, y).numpy())
         assert l < l0
 
+    def test_train_step_amp_o1(self):
+        m = nn.Sequential(nn.Linear(4, 16), nn.GELU(), nn.Linear(16, 2))
+        opt = paddle.optimizer.AdamW(learning_rate=0.05,
+                                     parameters=m.parameters())
+        from paddle_tpu.jit import TrainStep
+        step = TrainStep(m, lambda o, y: F.cross_entropy(o, y), opt,
+                         amp_level="O1")
+        x = paddle.to_tensor(np.random.randn(8, 4).astype("float32"))
+        y = paddle.to_tensor(np.random.randint(0, 2, (8,)).astype("int64"))
+        l0 = float(step(x, y).numpy())
+        for _ in range(20):
+            l = float(step(x, y).numpy())
+        assert l < l0
+        # master weights stay f32
+        assert all("float32" in str(p.dtype) for p in m.parameters())
+
     def test_train_step_matches_eager(self):
         xs = np.random.randn(8, 4).astype("float32")
         ys = np.random.randint(0, 2, (8,)).astype("int64")
