@@ -22,6 +22,7 @@ from __future__ import annotations
 import math
 from dataclasses import dataclass
 
+import jax
 import numpy as np
 
 from ..core.dispatch import apply_op
@@ -56,6 +57,12 @@ class GPTConfig:
     initializer_range: float = 0.02
     use_flash_attention: bool = True
     tie_word_embeddings: bool = True
+    # stacked=True swaps the per-layer module stack for one scan/pipeline
+    # decoder with layer-stacked params (leading dim = num_layers, sharded
+    # over 'pp') — the manual-SPMD hybrid-parallel path (TP psums, ring SP,
+    # GPipe PP in a single shard_map). Layer dropout is not applied in this
+    # mode (pretraining configs use 0).
+    stacked: bool = False
 
     def __post_init__(self):
         if self.intermediate_size == 0:
@@ -174,19 +181,180 @@ class GPTDecoderLayer(Layer):
         return _seq_constraint(x)
 
 
+def _stacked_layer_fwd(p, x, *, num_heads, head_dim, eps, mp_size, sep_size):
+    """ONE decoder layer, manual SPMD (runs inside shard_map).
+
+    x: [mb, s_local, H] (full hidden; seq sep-sharded). Params are the local
+    TP shards: qkv/fc1 column-split, out/fc2 row-split over 'mp' — the
+    Megatron pattern with the allreduces written out (psum over 'mp'),
+    which is what GSPMD would insert for the module path
+    (mp_layers.py docstring) but explicit here because shard_map is manual.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def ln(h, w, b):
+        h32 = h.astype(jnp.float32)
+        mu = jnp.mean(h32, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(h32 - mu), axis=-1, keepdims=True)
+        out = (h32 - mu) * jax.lax.rsqrt(var + jnp.float32(eps))
+        return (out * w.astype(jnp.float32) + b.astype(jnp.float32)).astype(x.dtype)
+
+    mb, s_loc, hidden = x.shape
+    nh_loc = num_heads // mp_size
+
+    h = ln(x, p["ln1_w"], p["ln1_b"])
+    qkv = h @ p["qkv_w"] + p["qkv_b"]                 # [mb, s, 3*H/mp]
+    qkv = qkv.reshape(mb, s_loc, 3, nh_loc, head_dim)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    sm_scale = 1.0 / math.sqrt(head_dim)
+    if sep_size > 1:
+        from ..ops.ring_attention import _ring_attention_local
+        attn = _ring_attention_local(q, k, v, axis_name="sep",
+                                     axis_size=sep_size, causal=True,
+                                     sm_scale=sm_scale)
+    else:
+        from ..ops.pallas_attention import _mha_reference
+        attn = jnp.transpose(_mha_reference(
+            jnp.transpose(q, (0, 2, 1, 3)), jnp.transpose(k, (0, 2, 1, 3)),
+            jnp.transpose(v, (0, 2, 1, 3)), True, sm_scale), (0, 2, 1, 3))
+    attn = attn.reshape(mb, s_loc, nh_loc * head_dim)
+    o = attn @ p["out_w"]                             # partial over H/mp
+    if mp_size > 1:
+        o = jax.lax.psum(o, "mp")
+    x = x + o + p["out_b"]
+
+    h2 = ln(x, p["ln2_w"], p["ln2_b"])
+    u = jax.nn.gelu(h2 @ p["fc1_w"] + p["fc1_b"], approximate=True)
+    d = u @ p["fc2_w"]
+    if mp_size > 1:
+        d = jax.lax.psum(d, "mp")
+    return x + d + p["fc2_b"]
+
+
+class GPTStackedTransformer(Layer):
+    """Decoder stack with layer-stacked params: lax.scan on one device, and
+    under a fleet mesh ONE shard_map composing PP (GPipe over 'pp'), TP
+    (explicit psums over 'mp') and SP (ring attention over 'sep')."""
+
+    # dist_spec per stacked param (dim 0 = layers → 'pp')
+    SPECS = {
+        "ln1_w": ("pp", None), "ln1_b": ("pp", None),
+        "qkv_w": ("pp", None, "mp"), "qkv_b": ("pp", "mp"),
+        "out_w": ("pp", "mp", None), "out_b": ("pp", None),
+        "ln2_w": ("pp", None), "ln2_b": ("pp", None),
+        "fc1_w": ("pp", None, "mp"), "fc1_b": ("pp", "mp"),
+        "fc2_w": ("pp", "mp", None), "fc2_b": ("pp", None),
+    }
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        L, H, inter = (config.num_layers, config.hidden_size,
+                       config.intermediate_size)
+        std = config.initializer_range
+
+        def mk(shape, init):
+            return create_parameter_with_attr(
+                shape, self._dtype, None, False, default_initializer=init)
+
+        normal = I.Normal(std=std)
+        ones = I.Constant(1.0)
+        zeros = I.Constant(0.0)
+        self.ln1_w = mk([L, H], ones)
+        self.ln1_b = mk([L, H], zeros)
+        self.qkv_w = mk([L, H, 3 * H], normal)
+        self.qkv_b = mk([L, 3 * H], zeros)
+        self.out_w = mk([L, H, H], normal)
+        self.out_b = mk([L, H], zeros)
+        self.ln2_w = mk([L, H], ones)
+        self.ln2_b = mk([L, H], zeros)
+        self.fc1_w = mk([L, H, inter], normal)
+        self.fc1_b = mk([L, inter], zeros)
+        self.fc2_w = mk([L, inter, H], normal)
+        self.fc2_b = mk([L, H], zeros)
+        for name, spec in self.SPECS.items():
+            getattr(self, name).dist_spec = spec
+
+    def _n_micro(self, pp, batch):
+        from ..distributed.fleet.fleet_api import _fleet_state
+        strat = _fleet_state.get("strategy")
+        n = None
+        if strat is not None:
+            n = (strat.pipeline_configs or {}).get("accumulate_steps")
+        if not n:
+            n = 2 * pp if pp > 1 else 1
+        while batch % n != 0 and n > 1:
+            n -= 1
+        return n
+
+    def forward(self, x):
+        import functools
+
+        cfg = self.config
+        names = list(self.SPECS.keys())
+        params = [getattr(self, n) for n in names]
+
+        def fn(x_arr, *param_arrays):
+            from ..distributed.mesh_utils import get_global_mesh
+            p = dict(zip(names, param_arrays))
+            mesh = get_global_mesh()
+            pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+            mp = mesh.shape.get("mp", 1) if mesh is not None else 1
+            sep = mesh.shape.get("sep", 1) if mesh is not None else 1
+            if cfg.num_layers % max(pp, 1) != 0:
+                raise ValueError(
+                    f"num_layers={cfg.num_layers} must be divisible by "
+                    f"pp_degree={pp} for the stacked pipeline decoder")
+            if cfg.num_heads % max(mp, 1) != 0:
+                raise ValueError(
+                    f"num_heads={cfg.num_heads} must be divisible by "
+                    f"mp_degree={mp}")
+            layer = functools.partial(
+                _stacked_layer_fwd, num_heads=cfg.num_heads,
+                head_dim=cfg.hidden_size // cfg.num_heads,
+                eps=cfg.layer_norm_eps, mp_size=mp, sep_size=sep)
+            if mesh is None or (pp == 1 and mp == 1 and sep == 1):
+                def step(c, p_slice):
+                    return jax.checkpoint(layer)(p_slice, c), None
+                out, _ = jax.lax.scan(step, x_arr, p)
+                return out
+            from jax.sharding import PartitionSpec as P
+            from ..distributed.fleet.meta_parallel.pp_spmd import spmd_pipeline
+            param_specs = {n: P(*[a if (a in mesh.axis_names
+                                        and mesh.shape[a] > 1) else None
+                                  for a in self.SPECS[n]]) for n in names}
+            dp_ok = ("dp" in mesh.axis_names and mesh.shape["dp"] > 1)
+            sep_ok = sep > 1
+            n_micro = self._n_micro(pp, x_arr.shape[0])
+            x_spec = P("dp" if dp_ok else None, "sep" if sep_ok else None,
+                       None)
+            return spmd_pipeline(layer, p, x_arr, mesh, n_micro,
+                                 param_specs, x_spec, axis="pp")
+
+        return apply_op("gpt_stacked_decoder", fn, x, *params)
+
+
 class GPTModel(Layer):
     def __init__(self, config: GPTConfig):
         super().__init__()
         self.config = config
         self.embeddings = GPTEmbeddings(config)
-        self.layers = LayerList([GPTDecoderLayer(config)
-                                 for _ in range(config.num_layers)])
+        if config.stacked:
+            self.decoder = GPTStackedTransformer(config)
+            self.layers = LayerList([])
+        else:
+            self.layers = LayerList([GPTDecoderLayer(config)
+                                     for _ in range(config.num_layers)])
         self.ln_f = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
 
     def forward(self, input_ids):
         h = self.embeddings(input_ids)
-        for layer in self.layers:
-            h = layer(h)
+        if self.config.stacked:
+            h = self.decoder(h)
+        else:
+            for layer in self.layers:
+                h = layer(h)
         return self.ln_f(h)
 
     # -- pipeline segmentation hook (pp_layers.LayerDesc consumers) --

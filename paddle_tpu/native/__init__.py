@@ -1,0 +1,101 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference implements its runtime layer natively (SURVEY §2.2: memory,
+platform, distributed bootstrap, profiler, data feed are C++). Here the
+XLA-facing compute path is jax; the host runtime pieces that survive XLA are
+C++ in csrc/ and built on first use with the in-tree toolchain (g++ —
+pybind11 is unavailable, so the ABI is plain C + ctypes):
+
+- tcp_store.cc   — rendezvous KV store (reference tcp_store.cc)
+- host_tracer.cc — profiler span recorder + chrome-trace export
+- shm_ring.cc    — shared-memory DataLoader batch transport
+
+`lib()` returns the loaded CDLL or None (callers must degrade gracefully to
+their pure-Python fallbacks so the framework works without a compiler).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+_SRC = ("tcp_store.cc", "host_tracer.cc", "shm_ring.cc")
+
+
+def _build(src_dir: str, out_path: str) -> bool:
+    srcs = [os.path.join(src_dir, s) for s in _SRC]
+    cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-pthread",
+           "-o", out_path] + srcs + ["-lrt"]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+        return proc.returncode == 0
+    except Exception:
+        return False
+
+
+def lib():
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        here = os.path.dirname(os.path.abspath(__file__))
+        src_dir = os.path.join(here, "csrc")
+        out = os.path.join(here, "libpaddle_tpu_native.so")
+        srcs = [os.path.join(src_dir, s) for s in _SRC]
+        stale = (not os.path.exists(out) or any(
+            os.path.getmtime(s) > os.path.getmtime(out) for s in srcs))
+        if stale and not _build(src_dir, out):
+            return None
+        try:
+            cdll = ctypes.CDLL(out)
+        except OSError:
+            return None
+        _configure(cdll)
+        _lib = cdll
+        return _lib
+
+
+def _configure(l):
+    c = ctypes
+    l.tcp_store_server_start.restype = c.c_void_p
+    l.tcp_store_server_start.argtypes = [c.c_int]
+    l.tcp_store_server_port.restype = c.c_int
+    l.tcp_store_server_port.argtypes = [c.c_void_p]
+    l.tcp_store_server_stop.argtypes = [c.c_void_p]
+    l.tcp_store_client_connect.restype = c.c_void_p
+    l.tcp_store_client_connect.argtypes = [c.c_char_p, c.c_int, c.c_int]
+    l.tcp_store_client_close.argtypes = [c.c_void_p]
+    l.tcp_store_set.restype = c.c_int
+    l.tcp_store_set.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p, c.c_int]
+    l.tcp_store_get.restype = c.c_int
+    l.tcp_store_get.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p, c.c_int]
+    l.tcp_store_add.restype = c.c_longlong
+    l.tcp_store_add.argtypes = [c.c_void_p, c.c_char_p, c.c_longlong]
+    l.tcp_store_wait.restype = c.c_int
+    l.tcp_store_wait.argtypes = [c.c_void_p, c.c_char_p, c.c_int, c.c_char_p,
+                                 c.c_int]
+    l.host_tracer_start.argtypes = []
+    l.host_tracer_stop.restype = c.c_int
+    l.host_tracer_stop.argtypes = [c.c_char_p]
+    l.host_tracer_record.argtypes = [c.c_char_p, c.c_uint64, c.c_uint64]
+    l.host_tracer_now.restype = c.c_uint64
+    l.host_tracer_enabled.restype = c.c_int
+    l.host_tracer_event_count.restype = c.c_int
+    l.shm_ring_open.restype = c.c_void_p
+    l.shm_ring_open.argtypes = [c.c_char_p, c.c_int, c.c_uint64, c.c_uint64]
+    l.shm_ring_push.restype = c.c_int
+    l.shm_ring_push.argtypes = [c.c_void_p, c.c_char_p, c.c_uint64]
+    l.shm_ring_pop.restype = c.c_longlong
+    l.shm_ring_pop.argtypes = [c.c_void_p, c.c_char_p, c.c_uint64, c.c_int]
+    l.shm_ring_size.restype = c.c_uint64
+    l.shm_ring_size.argtypes = [c.c_void_p]
+    l.shm_ring_close.argtypes = [c.c_void_p]
+    l.shm_ring_free.argtypes = [c.c_void_p]

@@ -1,0 +1,100 @@
+// Host tracer: lock-free-ish span recorder with chrome-trace export.
+//
+// Native equivalent of the reference profiler's HostTracer
+// (/root/reference/paddle/fluid/platform/profiler/host_tracer.h:26 and
+// chrometracing_logger.cc): RecordEvent spans are pushed from any thread
+// into per-thread buffers; stop() merges and dumps chrome://tracing JSON.
+// The Python profiler (paddle_tpu.profiler) drives this via ctypes and
+// composes it with jax.profiler for device (XLA) activity.
+
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Event {
+  std::string name;
+  uint64_t begin_ns;
+  uint64_t end_ns;
+  int64_t tid;
+};
+
+struct Tracer {
+  std::vector<Event> events;
+  std::mutex mu;
+  std::atomic<bool> enabled{false};
+  uint64_t start_ns = 0;
+};
+
+Tracer g_tracer;
+
+uint64_t now_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+}  // namespace
+
+extern "C" {
+
+void host_tracer_start() {
+  std::lock_guard<std::mutex> g(g_tracer.mu);
+  g_tracer.events.clear();
+  g_tracer.start_ns = now_ns();
+  g_tracer.enabled.store(true);
+}
+
+int host_tracer_enabled() { return g_tracer.enabled.load() ? 1 : 0; }
+
+uint64_t host_tracer_now() { return now_ns(); }
+
+void host_tracer_record(const char* name, uint64_t begin_ns,
+                        uint64_t end_ns) {
+  if (!g_tracer.enabled.load()) return;
+  std::lock_guard<std::mutex> g(g_tracer.mu);
+  g_tracer.events.push_back(
+      Event{name, begin_ns, end_ns,
+            static_cast<int64_t>(::syscall(SYS_gettid))});
+}
+
+int host_tracer_event_count() {
+  std::lock_guard<std::mutex> g(g_tracer.mu);
+  return static_cast<int>(g_tracer.events.size());
+}
+
+// Stop and write chrome-trace JSON to path. Returns #events or -1.
+int host_tracer_stop(const char* path) {
+  g_tracer.enabled.store(false);
+  std::lock_guard<std::mutex> g(g_tracer.mu);
+  FILE* f = std::fopen(path, "w");
+  if (!f) return -1;
+  std::fputs("{\"traceEvents\":[", f);
+  bool first = true;
+  for (const auto& e : g_tracer.events) {
+    if (!first) std::fputc(',', f);
+    first = false;
+    // chrome trace wants microseconds
+    std::fprintf(f,
+                 "{\"name\":\"%s\",\"ph\":\"X\",\"pid\":%d,\"tid\":%lld,"
+                 "\"ts\":%.3f,\"dur\":%.3f}",
+                 e.name.c_str(), static_cast<int>(::getpid()),
+                 static_cast<long long>(e.tid),
+                 (e.begin_ns - g_tracer.start_ns) / 1000.0,
+                 (e.end_ns - e.begin_ns) / 1000.0);
+  }
+  std::fputs("]}", f);
+  std::fclose(f);
+  return static_cast<int>(g_tracer.events.size());
+}
+
+}  // extern "C"

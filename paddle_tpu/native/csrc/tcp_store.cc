@@ -1,0 +1,321 @@
+// TCPStore: master/worker key-value rendezvous over raw TCP.
+//
+// Native equivalent of the reference's bootstrap store
+// (/root/reference/paddle/phi/core/distributed/store/tcp_store.cc and
+// tcp_utils.cc): one rank runs the master holding a map<string,string>;
+// workers connect and issue SET/GET/WAIT/ADD ops. Used by the Python
+// distributed bootstrap (paddle_tpu.distributed.env) the way the reference
+// exchanges ncclUniqueId — here it carries jax.distributed coordinator
+// addresses and barrier counters.
+//
+// Wire format: [1 byte op][u32 key_len][key][u64 val_len][val]
+//   op: 0=SET 1=GET 2=ADD(i64 delta in val) 3=WAIT 4=COMPARE_SET 5=DELETE
+// Reply: [u8 status][u64 val_len][val]   status: 0=ok 1=missing
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this environment).
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Store {
+  std::map<std::string, std::string> data;
+  std::mutex mu;
+  std::condition_variable cv;
+};
+
+int read_full(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = ::read(fd, p + got, n - got);
+    if (r <= 0) return -1;
+    got += static_cast<size_t>(r);
+  }
+  return 0;
+}
+
+int write_full(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  size_t done = 0;
+  while (done < n) {
+    ssize_t r = ::write(fd, p + done, n - done);
+    if (r <= 0) return -1;
+    done += static_cast<size_t>(r);
+  }
+  return 0;
+}
+
+void reply(int fd, uint8_t status, const std::string& val) {
+  uint64_t len = val.size();
+  write_full(fd, &status, 1);
+  write_full(fd, &len, 8);
+  if (len) write_full(fd, val.data(), len);
+}
+
+struct Server {
+  Store store;
+  int listen_fd = -1;
+  std::thread accept_thread;
+  std::vector<std::thread> workers;
+  std::atomic<bool> stop{false};
+  int port = 0;
+
+  void handle(int fd) {
+    for (;;) {
+      uint8_t op;
+      if (read_full(fd, &op, 1) != 0) break;
+      uint32_t klen;
+      if (read_full(fd, &klen, 4) != 0) break;
+      std::string key(klen, '\0');
+      if (klen && read_full(fd, key.data(), klen) != 0) break;
+      uint64_t vlen;
+      if (read_full(fd, &vlen, 8) != 0) break;
+      std::string val(vlen, '\0');
+      if (vlen && read_full(fd, val.data(), vlen) != 0) break;
+
+      switch (op) {
+        case 0: {  // SET
+          std::lock_guard<std::mutex> g(store.mu);
+          store.data[key] = val;
+          store.cv.notify_all();
+          reply(fd, 0, "");
+          break;
+        }
+        case 1: {  // GET
+          std::lock_guard<std::mutex> g(store.mu);
+          auto it = store.data.find(key);
+          if (it == store.data.end()) {
+            reply(fd, 1, "");
+          } else {
+            reply(fd, 0, it->second);
+          }
+          break;
+        }
+        case 2: {  // ADD (val = ascii delta); returns new value
+          int64_t delta = std::strtoll(val.c_str(), nullptr, 10);
+          std::lock_guard<std::mutex> g(store.mu);
+          int64_t cur = 0;
+          auto it = store.data.find(key);
+          if (it != store.data.end())
+            cur = std::strtoll(it->second.c_str(), nullptr, 10);
+          cur += delta;
+          store.data[key] = std::to_string(cur);
+          store.cv.notify_all();
+          reply(fd, 0, store.data[key]);
+          break;
+        }
+        case 3: {  // WAIT until key exists (val = timeout ms, ascii)
+          int64_t timeout_ms = std::strtoll(val.c_str(), nullptr, 10);
+          std::unique_lock<std::mutex> g(store.mu);
+          bool ok = store.cv.wait_for(
+              g, std::chrono::milliseconds(timeout_ms),
+              [&] { return store.data.count(key) > 0; });
+          if (ok) {
+            reply(fd, 0, store.data[key]);
+          } else {
+            reply(fd, 1, "");
+          }
+          break;
+        }
+        case 4: {  // COMPARE_SET: val = expected \0 desired
+          size_t sep = val.find('\0');
+          std::string expected = val.substr(0, sep);
+          std::string desired = val.substr(sep + 1);
+          std::lock_guard<std::mutex> g(store.mu);
+          auto it = store.data.find(key);
+          std::string cur = (it == store.data.end()) ? "" : it->second;
+          if (cur == expected) {
+            store.data[key] = desired;
+            store.cv.notify_all();
+            reply(fd, 0, desired);
+          } else {
+            reply(fd, 1, cur);
+          }
+          break;
+        }
+        case 5: {  // DELETE
+          std::lock_guard<std::mutex> g(store.mu);
+          store.data.erase(key);
+          reply(fd, 0, "");
+          break;
+        }
+        default:
+          reply(fd, 1, "");
+      }
+    }
+    ::close(fd);
+  }
+
+  int start(int want_port) {
+    listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd < 0) return -1;
+    int one = 1;
+    ::setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(want_port));
+    if (::bind(listen_fd, reinterpret_cast<sockaddr*>(&addr),
+               sizeof(addr)) != 0)
+      return -1;
+    socklen_t alen = sizeof(addr);
+    ::getsockname(listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+    port = ntohs(addr.sin_port);
+    if (::listen(listen_fd, 128) != 0) return -1;
+    accept_thread = std::thread([this] {
+      while (!stop.load()) {
+        int fd = ::accept(listen_fd, nullptr, nullptr);
+        if (fd < 0) break;
+        int one2 = 1;
+        ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one2, sizeof(one2));
+        workers.emplace_back([this, fd] { handle(fd); });
+      }
+    });
+    return port;
+  }
+
+  void shutdown() {
+    stop.store(true);
+    if (listen_fd >= 0) {
+      ::shutdown(listen_fd, SHUT_RDWR);
+      ::close(listen_fd);
+    }
+    if (accept_thread.joinable()) accept_thread.join();
+    for (auto& t : workers)
+      if (t.joinable()) t.join();
+  }
+};
+
+struct Client {
+  int fd = -1;
+  std::mutex mu;
+  std::string last;
+
+  int connect_to(const char* host, int port, int timeout_ms) {
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms);
+    for (;;) {
+      fd = ::socket(AF_INET, SOCK_STREAM, 0);
+      sockaddr_in addr{};
+      addr.sin_family = AF_INET;
+      addr.sin_port = htons(static_cast<uint16_t>(port));
+      ::inet_pton(AF_INET, host, &addr.sin_addr);
+      if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) ==
+          0) {
+        int one = 1;
+        ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        return 0;
+      }
+      ::close(fd);
+      fd = -1;
+      if (std::chrono::steady_clock::now() > deadline) return -1;
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    }
+  }
+
+  // returns status; stores value into this->last
+  int request(uint8_t op, const std::string& key, const std::string& val) {
+    std::lock_guard<std::mutex> g(mu);
+    uint32_t klen = key.size();
+    uint64_t vlen = val.size();
+    if (write_full(fd, &op, 1) || write_full(fd, &klen, 4) ||
+        (klen && write_full(fd, key.data(), klen)) ||
+        write_full(fd, &vlen, 8) ||
+        (vlen && write_full(fd, val.data(), vlen)))
+      return -1;
+    uint8_t status;
+    uint64_t rlen;
+    if (read_full(fd, &status, 1) || read_full(fd, &rlen, 8)) return -1;
+    last.resize(rlen);
+    if (rlen && read_full(fd, last.data(), rlen)) return -1;
+    return status;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* tcp_store_server_start(int port) {
+  auto* s = new Server();
+  int got = s->start(port);
+  if (got < 0) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+int tcp_store_server_port(void* h) { return static_cast<Server*>(h)->port; }
+
+void tcp_store_server_stop(void* h) {
+  auto* s = static_cast<Server*>(h);
+  s->shutdown();
+  delete s;
+}
+
+void* tcp_store_client_connect(const char* host, int port, int timeout_ms) {
+  auto* c = new Client();
+  if (c->connect_to(host, port, timeout_ms) != 0) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+void tcp_store_client_close(void* h) {
+  auto* c = static_cast<Client*>(h);
+  if (c->fd >= 0) ::close(c->fd);
+  delete c;
+}
+
+int tcp_store_set(void* h, const char* key, const char* val, int vlen) {
+  return static_cast<Client*>(h)->request(0, key, std::string(val, vlen));
+}
+
+// Returns value length, or -1 missing / -2 io error. Copy into buf (cap).
+int tcp_store_get(void* h, const char* key, char* buf, int cap) {
+  auto* c = static_cast<Client*>(h);
+  int st = c->request(1, key, "");
+  if (st != 0) return st == 1 ? -1 : -2;
+  int n = static_cast<int>(c->last.size());
+  if (n > cap) n = cap;
+  std::memcpy(buf, c->last.data(), n);
+  return n;
+}
+
+long long tcp_store_add(void* h, const char* key, long long delta) {
+  auto* c = static_cast<Client*>(h);
+  int st = c->request(2, key, std::to_string(delta));
+  if (st != 0) return -1;
+  return std::strtoll(c->last.c_str(), nullptr, 10);
+}
+
+int tcp_store_wait(void* h, const char* key, int timeout_ms, char* buf,
+                   int cap) {
+  auto* c = static_cast<Client*>(h);
+  int st = c->request(3, key, std::to_string(timeout_ms));
+  if (st != 0) return st == 1 ? -1 : -2;
+  int n = static_cast<int>(c->last.size());
+  if (n > cap) n = cap;
+  std::memcpy(buf, c->last.data(), n);
+  return n;
+}
+
+}  // extern "C"

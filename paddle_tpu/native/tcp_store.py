@@ -1,0 +1,224 @@
+"""TCPStore: Python wrapper over the native store, with a pure-Python
+fallback (threading + sockets) so the API always works.
+
+API mirrors the reference's paddle.distributed TCPStore usage
+(/root/reference/paddle/phi/core/distributed/store/tcp_store.h): the rank-0
+host runs the master; every rank gets set/get/add/wait.
+"""
+from __future__ import annotations
+
+import ctypes
+import threading
+import time
+from typing import Optional
+
+from . import lib
+
+
+class TCPStoreServer:
+    def __init__(self, port: int = 0):
+        l = lib()
+        if l is not None:
+            self._h = l.tcp_store_server_start(port)
+            if not self._h:
+                raise RuntimeError(f"TCPStore server failed to bind :{port}")
+            self._l = l
+            self.port = l.tcp_store_server_port(self._h)
+            self._py = None
+        else:  # pure-python fallback
+            self._l = None
+            self._py = _PyServer(port)
+            self.port = self._py.port
+
+    def stop(self):
+        if self._l is not None:
+            if self._h:
+                self._l.tcp_store_server_stop(self._h)
+                self._h = None
+        elif self._py is not None:
+            self._py.stop()
+
+
+class TCPStore:
+    """Client. host_is_master spawns the in-process server (rank 0)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 is_master: bool = False, timeout: float = 30.0,
+                 world_size: Optional[int] = None):
+        self.server = TCPStoreServer(port) if is_master else None
+        real_port = self.server.port if self.server else port
+        self.host, self.port = host, real_port
+        l = lib()
+        self._l = l
+        if l is not None:
+            self._h = l.tcp_store_client_connect(
+                host.encode(), real_port, int(timeout * 1000))
+            if not self._h:
+                raise TimeoutError(
+                    f"TCPStore connect to {host}:{real_port} timed out")
+        else:
+            self._sock = _py_connect(host, real_port, timeout)
+
+    def set(self, key: str, value):
+        if isinstance(value, str):
+            value = value.encode()
+        if self._l is not None:
+            rc = self._l.tcp_store_set(self._h, key.encode(), value,
+                                       len(value))
+            if rc != 0:
+                raise RuntimeError("TCPStore.set failed")
+        else:
+            _py_request(self._sock, 0, key, value)
+
+    def get(self, key: str) -> bytes:
+        if self._l is not None:
+            buf = ctypes.create_string_buffer(1 << 20)
+            n = self._l.tcp_store_get(self._h, key.encode(), buf, len(buf))
+            if n == -1:
+                raise KeyError(key)
+            if n < 0:
+                raise RuntimeError("TCPStore.get io error")
+            return buf.raw[:n]
+        st, val = _py_request(self._sock, 1, key, b"")
+        if st != 0:
+            raise KeyError(key)
+        return val
+
+    def add(self, key: str, delta: int = 1) -> int:
+        if self._l is not None:
+            return int(self._l.tcp_store_add(self._h, key.encode(), delta))
+        _, val = _py_request(self._sock, 2, key, str(delta).encode())
+        return int(val)
+
+    def wait(self, key: str, timeout: float = 30.0) -> bytes:
+        if self._l is not None:
+            buf = ctypes.create_string_buffer(1 << 20)
+            n = self._l.tcp_store_wait(self._h, key.encode(),
+                                       int(timeout * 1000), buf, len(buf))
+            if n == -1:
+                raise TimeoutError(f"TCPStore.wait({key}) timed out")
+            if n < 0:
+                raise RuntimeError("TCPStore.wait io error")
+            return buf.raw[:n]
+        st, val = _py_request(self._sock, 3, key,
+                              str(int(timeout * 1000)).encode())
+        if st != 0:
+            raise TimeoutError(f"TCPStore.wait({key}) timed out")
+        return val
+
+    def barrier(self, name: str, world_size: int, timeout: float = 60.0):
+        n = self.add(f"__barrier/{name}", 1)
+        if n == world_size:
+            self.set(f"__barrier/{name}/done", b"1")
+        self.wait(f"__barrier/{name}/done", timeout)
+
+    def close(self):
+        if self._l is not None and getattr(self, "_h", None):
+            self._l.tcp_store_client_close(self._h)
+            self._h = None
+        if self.server is not None:
+            self.server.stop()
+            self.server = None
+
+
+# ---------------- pure-python fallback (same wire format) ----------------
+import socket
+import struct
+
+
+def _py_connect(host, port, timeout):
+    deadline = time.time() + timeout
+    while True:
+        try:
+            s = socket.create_connection((host, port), timeout=2)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            return s
+        except OSError:
+            if time.time() > deadline:
+                raise TimeoutError(f"connect {host}:{port}")
+            time.sleep(0.05)
+
+
+def _recv_full(s, n):
+    out = b""
+    while len(out) < n:
+        chunk = s.recv(n - len(out))
+        if not chunk:
+            raise ConnectionError("store closed")
+        out += chunk
+    return out
+
+
+def _py_request(s, op, key, val):
+    k = key.encode()
+    s.sendall(struct.pack("<BI", op, len(k)) + k +
+              struct.pack("<Q", len(val)) + val)
+    status = _recv_full(s, 1)[0]
+    (rlen,) = struct.unpack("<Q", _recv_full(s, 8))
+    data = _recv_full(s, rlen) if rlen else b""
+    return status, data
+
+
+class _PyServer:
+    def __init__(self, port=0):
+        self.data = {}
+        self.cv = threading.Condition()
+        self.sock = socket.socket()
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("0.0.0.0", port))
+        self.port = self.sock.getsockname()[1]
+        self.sock.listen(128)
+        self._stop = False
+        self.thread = threading.Thread(target=self._accept, daemon=True)
+        self.thread.start()
+
+    def _accept(self):
+        while not self._stop:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            while True:
+                hdr = _recv_full(conn, 5)
+                op, klen = struct.unpack("<BI", hdr)
+                key = _recv_full(conn, klen).decode()
+                (vlen,) = struct.unpack("<Q", _recv_full(conn, 8))
+                val = _recv_full(conn, vlen) if vlen else b""
+                if op == 0:
+                    with self.cv:
+                        self.data[key] = val
+                        self.cv.notify_all()
+                    reply = (0, b"")
+                elif op == 1:
+                    with self.cv:
+                        reply = (0, self.data[key]) if key in self.data \
+                            else (1, b"")
+                elif op == 2:
+                    with self.cv:
+                        cur = int(self.data.get(key, b"0")) + int(val)
+                        self.data[key] = str(cur).encode()
+                        self.cv.notify_all()
+                        reply = (0, self.data[key])
+                elif op == 3:
+                    tmo = int(val) / 1000.0
+                    with self.cv:
+                        ok = self.cv.wait_for(lambda: key in self.data, tmo)
+                        reply = (0, self.data[key]) if ok else (1, b"")
+                else:
+                    reply = (1, b"")
+                conn.sendall(bytes([reply[0]]) +
+                             struct.pack("<Q", len(reply[1])) + reply[1])
+        except (ConnectionError, OSError):
+            pass
+
+    def stop(self):
+        self._stop = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass

@@ -48,6 +48,17 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
 
     def _sdpa(q, k, v, *m):
         mask = m[0] if m else None
+        # Sequence parallelism: with a live 'sep' mesh axis, compute exact
+        # ring attention (K/V rotate over ICI; O(S/devices) memory) instead
+        # of letting GSPMD all-gather the sequence — SURVEY §5.7.
+        from ...distributed.mesh_utils import get_global_mesh
+        mesh = get_global_mesh()
+        if (mask is None and p == 0.0 and mesh is not None
+                and "sep" in mesh.axis_names and mesh.shape["sep"] > 1
+                and q.ndim == 4 and q.shape[1] % mesh.shape["sep"] == 0):
+            from ...ops.ring_attention import ring_attention
+            return ring_attention(q, k, v, mesh, seq_axis="sep",
+                                  causal=is_causal)
         if use_flash and p == 0.0 and fa.supported(q, k, v, mask, is_causal):
             return fa.flash_attention_bshd(q, k, v, causal=is_causal)
         return _sdpa_reference(q, k, v, mask, p, is_causal)

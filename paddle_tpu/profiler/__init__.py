@@ -51,9 +51,30 @@ class _HostTracer:
         self.events = []
         self._lock = threading.Lock()
         self.enabled = False
+        self._native = None  # lazily resolved C tracer (native/host_tracer.cc)
+
+    def _native_lib(self):
+        if self._native is None:
+            try:
+                from ..native import lib
+                self._native = lib() or False
+            except Exception:
+                self._native = False
+        return self._native or None
+
+    def start(self):
+        self.enabled = True
+        self.events = []
+        n = self._native_lib()
+        if n is not None:
+            n.host_tracer_start()
 
     def add(self, name, start_ns, end_ns, tid):
         if not self.enabled:
+            return
+        n = self._native_lib()
+        if n is not None and n.host_tracer_enabled():
+            n.host_tracer_record(name.encode(), start_ns, end_ns)
             return
         with self._lock:
             self.events.append(
@@ -62,6 +83,10 @@ class _HostTracer:
                  "tid": tid})
 
     def export_chrome_tracing(self, path):
+        n = self._native_lib()
+        if n is not None and n.host_tracer_event_count() > 0:
+            n.host_tracer_stop(path.encode())
+            return
         with open(path, "w") as f:
             json.dump({"traceEvents": self.events}, f)
 
@@ -157,8 +182,7 @@ class Profiler:
         self._last_step_t = None
 
     def start(self):
-        _tracer.enabled = True
-        _tracer.events.clear()
+        _tracer.start()
         self._last_step_t = time.perf_counter()
         if not self.timer_only:
             self._jax_trace_dir = os.environ.get(
