@@ -136,11 +136,14 @@ class GPTAttention(Layer):
     def forward(self, x):
         b, s, _ = x.shape
         qkv = self.qkv_proj(x)                       # [B,S,3H]
-        qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
-        from ..tensor import manipulation as M
-        q = qkv[:, :, 0]                             # [B,S,nh,hd]
-        k = qkv[:, :, 1]
-        v = qkv[:, :, 2]
+        # head-major (nh, 3, hd) layout: the mp-sharded 3H dim factors with
+        # num_heads major, so GSPMD propagates the 'mp' sharding through the
+        # reshape instead of all-gathering, and the layout matches the
+        # stacked decoder (_stacked_layer_fwd) for checkpoint portability.
+        qkv = qkv.reshape([b, s, self.num_heads, 3, self.head_dim])
+        q = qkv[:, :, :, 0]                          # [B,S,nh,hd]
+        k = qkv[:, :, :, 1]
+        v = qkv[:, :, :, 2]
         from ..nn.functional.attention import scaled_dot_product_attention
         out = scaled_dot_product_attention(
             q, k, v, is_causal=True, dropout_p=self.attn_dropout_p,
@@ -189,6 +192,10 @@ def _stacked_layer_fwd(p, x, *, num_heads, head_dim, eps, mp_size, sep_size):
     Megatron pattern with the allreduces written out (psum over 'mp'),
     which is what GSPMD would insert for the module path
     (mp_layers.py docstring) but explicit here because shard_map is manual.
+
+    qkv layout is HEAD-MAJOR: the 3H output dim is (num_heads, 3, head_dim),
+    so a contiguous 'mp' column split hands each rank nh/mp complete heads
+    with their (q,k,v) triples — checkpoints are portable across mp degrees.
     """
     import jax
     import jax.numpy as jnp
@@ -205,8 +212,8 @@ def _stacked_layer_fwd(p, x, *, num_heads, head_dim, eps, mp_size, sep_size):
 
     h = ln(x, p["ln1_w"], p["ln1_b"])
     qkv = h @ p["qkv_w"] + p["qkv_b"]                 # [mb, s, 3*H/mp]
-    qkv = qkv.reshape(mb, s_loc, 3, nh_loc, head_dim)
-    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    qkv = qkv.reshape(mb, s_loc, nh_loc, 3, head_dim)
+    q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]  # [mb,s,nh,hd]
     sm_scale = 1.0 / math.sqrt(head_dim)
     if sep_size > 1:
         from ..ops.ring_attention import _ring_attention_local

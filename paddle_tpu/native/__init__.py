@@ -16,6 +16,7 @@ their pure-Python fallbacks so the framework works without a compiler).
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -25,6 +26,14 @@ _lib = None
 _tried = False
 
 _SRC = ("tcp_store.cc", "host_tracer.cc", "shm_ring.cc")
+
+
+def _src_digest(srcs) -> str:
+    h = hashlib.sha256()
+    for s in srcs:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()
 
 
 def _build(src_dir: str, out_path: str) -> bool:
@@ -50,10 +59,25 @@ def lib():
         src_dir = os.path.join(here, "csrc")
         out = os.path.join(here, "libpaddle_tpu_native.so")
         srcs = [os.path.join(src_dir, s) for s in _SRC]
-        stale = (not os.path.exists(out) or any(
-            os.path.getmtime(s) > os.path.getmtime(out) for s in srcs))
-        if stale and not _build(src_dir, out):
-            return None
+        # staleness is keyed on a content hash of the sources (mtimes are
+        # not preserved by git checkout); the .so is never committed.
+        stamp = out + ".sha256"
+        try:
+            digest = _src_digest(srcs)
+        except OSError:
+            return None  # sources missing: degrade to pure-Python fallbacks
+        stale = not os.path.exists(out)
+        if not stale:
+            try:
+                with open(stamp) as f:
+                    stale = f.read().strip() != digest
+            except OSError:
+                stale = True
+        if stale:
+            if not _build(src_dir, out):
+                return None
+            with open(stamp, "w") as f:
+                f.write(digest)
         try:
             cdll = ctypes.CDLL(out)
         except OSError:

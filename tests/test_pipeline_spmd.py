@@ -18,6 +18,16 @@ def setup_module(m):
 ids_np = np.random.RandomState(0).randint(0, 256, (8, 64)).astype("int64")
 
 
+def _assert_params_match(m_ref, m_test, rtol=1e-4, atol=1e-4):
+    ref = dict(m_ref.named_parameters())
+    test = dict(m_test.named_parameters())
+    assert ref.keys() == test.keys()
+    for name, p in ref.items():
+        np.testing.assert_allclose(
+            np.asarray(p.numpy()), np.asarray(test[name].numpy()),
+            rtol=rtol, atol=atol, err_msg=name)
+
+
 def run(hybrid, steps=3, stacked=True, num_layers=2):
     paddle.seed(0)
     if hybrid:
@@ -60,15 +70,20 @@ class TestStackedDecoder:
                 num_layers=2, steps=1)
 
     def test_full_hybrid_dp_mp_pp_matches(self):
-        single, _ = run(None)
-        hyb, _ = run({"dp_degree": 2, "mp_degree": 2, "pp_degree": 2})
-        np.testing.assert_allclose(single, hyb, rtol=5e-3, atol=5e-3)
+        # tight tolerance on losses AND final params: a head-permuted qkv
+        # split (the mp>1 layout bug class) trains statistically alike but
+        # diverges immediately in exact values.
+        single, m1 = run(None)
+        hyb, m2 = run({"dp_degree": 2, "mp_degree": 2, "pp_degree": 2})
+        np.testing.assert_allclose(single, hyb, rtol=1e-4, atol=1e-4)
+        _assert_params_match(m1, m2)
 
     def test_hybrid_mp_pp_sep_matches(self):
-        single, _ = run(None)
-        hyb, _ = run({"dp_degree": 1, "mp_degree": 2, "pp_degree": 2,
-                      "sep_degree": 2})
-        np.testing.assert_allclose(single, hyb, rtol=5e-3, atol=5e-3)
+        single, m1 = run(None)
+        hyb, m2 = run({"dp_degree": 1, "mp_degree": 2, "pp_degree": 2,
+                       "sep_degree": 2})
+        np.testing.assert_allclose(single, hyb, rtol=1e-4, atol=1e-4)
+        _assert_params_match(m1, m2)
 
     def test_stacked_param_shardings_annotated(self):
         _, m = run(None, steps=1)
