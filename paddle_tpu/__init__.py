@@ -57,6 +57,7 @@ from . import device  # noqa: F401
 from . import distributed  # noqa: F401
 from . import framework  # noqa: F401
 from . import incubate  # noqa: F401
+from . import inference  # noqa: F401
 from . import io  # noqa: F401
 from . import jit  # noqa: F401
 from . import metric  # noqa: F401

@@ -1,0 +1,260 @@
+"""paddle_tpu.inference — the serving API (Config / Predictor).
+
+Reference: AnalysisConfig + AnalysisPredictor
+(/root/reference/paddle/fluid/inference/api/analysis_predictor.h:95; factory
+`CreatePaddlePredictor` at analysis_predictor.cc:1427; Python wrappers in
+/root/reference/python/paddle/inference/). The reference runs a 250-pass IR
+optimization pipeline then executes op-by-op; TPU-native, the saved artifact
+is already a whole-program StableHLO blob, so ``create_predictor`` just
+deserializes and lets XLA AOT-compile it — fusion and memory planning are the
+compiler's job. The Config surface keeps the reference's toggle names as
+accepted no-ops where XLA subsumes them.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["Config", "Predictor", "Tensor", "create_predictor",
+           "PrecisionType", "PlaceType", "get_version"]
+
+
+class PrecisionType:
+    Float32 = 0
+    Half = 1
+    Bfloat16 = 2
+    Int8 = 3
+
+
+class PlaceType:
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    TPU = 3
+    CUSTOM = 4
+
+
+class Config:
+    """AnalysisConfig parity: model path handling + toggles (no-op where the
+    XLA compiler subsumes the reference's IR passes)."""
+
+    def __init__(self, model_path: Optional[str] = None,
+                 params_path: Optional[str] = None):
+        self._prefix = None
+        self._params_path = None
+        self._flags: Dict[str, object] = {}
+        self._precision = PrecisionType.Float32
+        self._device = "tpu"
+        if model_path is not None:
+            self._set_paths(model_path, params_path)
+
+    def _set_paths(self, model_path, params_path=None):
+        if params_path is not None:
+            # pdmodel/pdiparams pair (the params filename is arbitrary)
+            self._prefix = model_path[:-len(".pdmodel")] \
+                if model_path.endswith(".pdmodel") else model_path
+            self._params_path = params_path
+        else:
+            # a directory or a prefix
+            if os.path.isdir(model_path):
+                cands = [f[:-len(".pdmodel")]
+                         for f in os.listdir(model_path)
+                         if f.endswith(".pdmodel")]
+                if not cands:
+                    raise ValueError(
+                        f"no .pdmodel artifact under {model_path}")
+                self._prefix = os.path.join(model_path, sorted(cands)[0])
+            else:
+                self._prefix = model_path
+            self._params_path = None
+
+    # ---- model location ----
+    def set_model(self, model_path, params_path=None):
+        """Set paths only; previously set flags/precision/device survive."""
+        self._set_paths(model_path, params_path)
+
+    def model_dir(self):
+        return os.path.dirname(self._prefix or "")
+
+    def prog_file(self):
+        return (self._prefix or "") + ".pdmodel"
+
+    def params_file(self):
+        return self._params_path or (self._prefix or "") + ".pdiparams"
+
+    # ---- device selection ----
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._device = "tpu"  # accelerator path; TPU is the accelerator here
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def enable_tpu(self):
+        self._device = "tpu"
+
+    def use_gpu(self):
+        return self._device != "cpu"
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._flags["cpu_threads"] = n
+
+    # ---- toggles the XLA compiler subsumes (accepted, recorded, no-op) ----
+    def _noop(self, name, value=True):
+        self._flags[name] = value
+
+    def switch_ir_optim(self, x=True):
+        self._noop("ir_optim", x)
+
+    def switch_ir_debug(self, x=True):
+        self._noop("ir_debug", x)
+
+    def enable_memory_optim(self, x=True):
+        self._noop("memory_optim", x)
+
+    def switch_use_feed_fetch_ops(self, x=True):
+        self._noop("feed_fetch_ops", x)
+
+    def switch_specify_input_names(self, x=True):
+        self._noop("specify_input_names", x)
+
+    def enable_mkldnn(self):
+        self._noop("mkldnn")
+
+    def disable_glog_info(self):
+        self._noop("glog_off")
+
+    def enable_profile(self):
+        self._noop("profile")
+
+    def set_optim_cache_dir(self, d):
+        self._noop("optim_cache_dir", d)
+
+    def enable_tensorrt_engine(self, **kw):
+        # TensorRT has no TPU analog; whole-program XLA replaces it
+        self._noop("tensorrt", kw)
+
+    def enable_low_precision_io(self, x=True):
+        self._noop("low_precision_io", x)
+
+    # ---- precision ----
+    def set_precision(self, precision):
+        self._precision = precision
+
+    def precision(self):
+        return self._precision
+
+    def summary(self) -> str:
+        lines = [f"model prefix: {self._prefix}",
+                 f"device: {self._device}",
+                 f"precision: {self._precision}"]
+        lines += [f"{k}: {v}" for k, v in self._flags.items()]
+        return "\n".join(lines)
+
+
+class Tensor:
+    """Zero-copy-style input/output handle (reference ZeroCopyTensor,
+    analysis_predictor.cc:1809)."""
+
+    def __init__(self, name: str, spec=None):
+        self.name = name
+        self._spec = spec
+        self._value: Optional[np.ndarray] = None
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._value = np.asarray(arr)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._value is None:
+            raise RuntimeError(f"tensor '{self.name}' has no value yet")
+        return np.asarray(self._value)
+
+    def reshape(self, shape):
+        if self._value is not None:
+            self._value = self._value.reshape(shape)
+
+    @property
+    def shape(self):
+        if self._value is not None:
+            return list(self._value.shape)
+        return list(self._spec["shape"]) if self._spec else None
+
+    def type(self):
+        if self._value is not None:
+            return str(self._value.dtype)
+        return self._spec["dtype"] if self._spec else None
+
+
+class Predictor:
+    """AnalysisPredictor parity over a StableHLO artifact."""
+
+    def __init__(self, config: Config):
+        from ..framework.exporting import load_artifact
+
+        if config._prefix is None:
+            raise ValueError("Config has no model path")
+        self._config = config
+        self._artifact = load_artifact(config._prefix, config._params_path)
+        self._inputs = {name: Tensor(name, spec)
+                        for name, spec in zip(self._artifact.feed_names,
+                                              self._artifact.feeds)}
+        self._outputs: List[Tensor] = []
+
+    # ---- reference Predictor API ----
+    def get_input_names(self) -> List[str]:
+        return list(self._artifact.feed_names)
+
+    def get_input_handle(self, name: str) -> Tensor:
+        return self._inputs[name]
+
+    def get_input_tensor(self, name: str) -> Tensor:  # legacy alias
+        return self._inputs[name]
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        if inputs is not None:
+            for name, arr in zip(self._artifact.feed_names, inputs):
+                self._inputs[name].copy_from_cpu(np.asarray(arr))
+        arrays = []
+        for name in self._artifact.feed_names:
+            h = self._inputs[name]
+            if h._value is None:
+                raise RuntimeError(f"input '{name}' not set")
+            arrays.append(h._value)
+        out = self._artifact(*arrays)
+        outs = list(out) if isinstance(out, (list, tuple)) else [out]
+        self._outputs = []
+        for i, o in enumerate(outs):
+            t = Tensor(f"fetch_{i}")
+            t.copy_from_cpu(np.asarray(o))
+            self._outputs.append(t)
+        if inputs is not None:
+            return [t.copy_to_cpu() for t in self._outputs]
+        return True
+
+    def get_output_names(self) -> List[str]:
+        return [t.name for t in self._outputs] or ["fetch_0"]
+
+    def get_output_handle(self, name: str) -> Tensor:
+        for t in self._outputs:
+            if t.name == name:
+                return t
+        raise KeyError(name)
+
+    def get_output_tensor(self, name: str) -> Tensor:  # legacy alias
+        return self.get_output_handle(name)
+
+    def clear_intermediate_tensor(self):
+        pass
+
+    def try_shrink_memory(self):
+        pass
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
+
+
+def get_version() -> str:
+    from .. import __version__
+    return __version__

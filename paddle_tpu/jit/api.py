@@ -126,47 +126,104 @@ def not_to_static(fn):
     return fn
 
 
+def _specs_from_input_spec(input_spec):
+    """InputSpec/Tensor/array list -> jax.ShapeDtypeStructs + names.
+
+    Dynamic dims (None / -1, the paddle variable-batch idiom) become
+    jax.export symbolic dimensions, so the exported StableHLO accepts any
+    extent there (shape polymorphism; one shared SymbolicScope)."""
+    from jax import export as jexport
+
+    from ..framework import dtype as dtype_mod
+
+    specs, names = [], []
+    scope = None
+    n_dyn = 0
+    for i, s in enumerate(input_spec):
+        if isinstance(s, Tensor):
+            specs.append(jax.ShapeDtypeStruct(s._data.shape, s._data.dtype))
+            names.append(getattr(s, "name", None) or f"feed_{i}")
+        elif hasattr(s, "shape"):  # InputSpec or ndarray
+            dims = []
+            for d in s.shape:
+                if d is None or (isinstance(d, int) and d < 0):
+                    dims.append(f"_dyn{n_dyn}")
+                    n_dyn += 1
+                else:
+                    dims.append(str(int(d)))
+            if n_dyn and scope is None:
+                scope = jexport.SymbolicScope()
+            shape = tuple(jexport.symbolic_shape(",".join(dims), scope=scope)
+                          if scope is not None else
+                          tuple(int(d) for d in dims))
+            dt = dtype_mod.to_jax_dtype(getattr(s, "dtype", "float32"))
+            specs.append(jax.ShapeDtypeStruct(shape, dt))
+            names.append(getattr(s, "name", None) or f"feed_{i}")
+        else:
+            raise TypeError(f"unsupported input_spec entry: {s!r}")
+    return specs, names
+
+
 def save(layer, path, input_spec=None, **configs):
-    """paddle.jit.save — persist weights + input spec; the program is re-traced
-    at load (source-of-truth is the Python forward, the jax idiom; the
-    reference persists ProgramDesc instead)."""
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    if isinstance(layer, Layer):
-        state = {k: v.numpy() for k, v in layer.state_dict().items()}
-        meta = {
-            "class": type(layer).__name__,
-            "input_spec": [
-                {"shape": s.shape, "dtype": str(s.dtype), "name": s.name}
-                for s in (input_spec or [])
-            ],
-        }
-        with open(path + ".pdiparams", "wb") as f:
-            pickle.dump(state, f)
-        with open(path + ".pdmodel", "wb") as f:
-            pickle.dump(meta, f)
-        _LIVE_LAYERS[path] = layer
-    else:
+    """paddle.jit.save — export the traced forward as a StableHLO artifact
+    (+ weights) loadable in a fresh process by ``jit.load`` or the inference
+    Predictor. Reference contract: paddle.jit.save → pdmodel/pdiparams
+    (/root/reference/python/paddle/jit/api.py:222, fluid/jit/serializer.cc);
+    here the program format is serialized StableHLO (framework/exporting.py).
+    """
+    if not isinstance(layer, Layer):
         raise TypeError("jit.save expects a Layer")
+    if input_spec is None:
+        raise ValueError(
+            "jit.save requires input_spec=[InputSpec(shape, dtype), ...] "
+            "(or example Tensors) to trace the forward for export")
+    from ..framework.exporting import export_artifact
 
+    specs, names = _specs_from_input_spec(input_spec)
+    params, buffers = state_arrays(layer)
+    weights = {**{f"p.{n}": v for n, v in params.items()},
+               **{f"b.{n}": v for n, v in buffers.items()}}
+    wnames = sorted(weights)
 
-_LIVE_LAYERS = {}
+    def run(weight_list, *inputs):
+        w = dict(zip(wnames, weight_list))
+        p = {n[2:]: a for n, a in w.items() if n.startswith("p.")}
+        b = {n[2:]: a for n, a in w.items() if n.startswith("b.")}
+        return functional_call(layer, p, b, *inputs, training=False)
+
+    export_artifact(path, run, weights, specs, feed_names=names)
 
 
 class TranslatedLayer(Layer):
-    def __init__(self, inner):
-        super().__init__()
-        self._inner = inner
+    """Inference-only layer reconstructed from a saved artifact
+    (reference: TranslatedLayer from paddle.jit.load). Parameters are real
+    so ``state_dict`` works; forward runs the AOT StableHLO program (no
+    autograd through it — retrain from the original Python class)."""
 
-    def forward(self, *args, **kwargs):
-        return self._inner(*args, **kwargs)
+    def __init__(self, artifact):
+        super().__init__()
+        self._artifact = artifact
+        for wname, arr in artifact.weights.items():
+            safe = wname.replace(".", "__")
+            p = Parameter(jax.numpy.asarray(arr), trainable=False)
+            p.name = wname
+            setattr(self, safe, p)
+
+    def forward(self, *args):
+        arrays = [a._data if isinstance(a, Tensor) else Tensor(a)._data
+                  for a in args]
+        # pick up any state_dict mutations since load
+        self._artifact.set_weights(
+            {p.name: p._data for p in self.parameters()})
+        out = self._artifact(*arrays)
+        return jax.tree_util.tree_map(Tensor, out)
 
 
 def load(path, **configs):
-    if path in _LIVE_LAYERS:
-        return _LIVE_LAYERS[path]
-    raise NotImplementedError(
-        "jit.load across processes requires the model class to re-trace; "
-        "load weights with paddle_tpu.load + Layer.set_state_dict instead.")
+    """paddle.jit.load — reconstruct a servable layer in a fresh process."""
+    from ..framework.exporting import load_artifact
+
+    return TranslatedLayer(load_artifact(path))
 
 
 def enable_to_static(flag=True):

@@ -33,6 +33,8 @@ def supported(q, k, v, mask, causal) -> bool:
         return False
     if sq % 128 != 0 or sk % 128 != 0:
         return False
+    if causal and sq != sk:
+        return False  # kernel masks top-left aligned; see _check_mha_args
     return True
 
 

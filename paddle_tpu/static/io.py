@@ -1,14 +1,16 @@
 """Inference-model export/import
 (reference: /root/reference/python/paddle/static/io.py:442,723 —
-save_inference_model emits .pdmodel + .pdiparams). Here the artifact is a
-directory with a pickled graph spec + weights; the serving path
-(paddle_tpu.inference) loads it and AOT-compiles with XLA.
+save_inference_model emits .pdmodel + .pdiparams). TPU-native: the recorded
+Program is replayed into a pure function of the feeds and exported as a
+StableHLO artifact (framework/exporting.py); ``load_inference_model`` works
+in a fresh process and the result runs under ``Executor.run``.
 """
 from __future__ import annotations
 
 import os
 import pickle
 
+import jax
 import numpy as np
 
 from ..core.tensor import Tensor
@@ -16,35 +18,37 @@ from ..core.tensor import Tensor
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
                          program=None, **kwargs):
+    from ..framework.exporting import export_artifact
     from .program import default_main_program
+
     program = program or default_main_program()
     feed_list = feed_vars if isinstance(feed_vars, list) else [feed_vars]
     fetch_list = fetch_vars if isinstance(fetch_vars, list) else [fetch_vars]
-    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
 
-    # weights
-    weights = {}
-    for pid, p in program.params.items():
-        weights[p.name] = p.numpy()
+    feed_names = [getattr(v, "name", None) or f"feed_{i}"
+                  for i, v in enumerate(feed_list)]
+    fetch_ids = [id(v) for v in fetch_list]
 
-    # graph: we persist the op list by replaying closures via pickle of a
-    # compiled-callable spec. Closures aren't picklable in general, so the
-    # exported artifact stores feeds/fetches + a callable built at load time
-    # from the in-memory program when available, else shape metadata.
-    spec = {
-        "feed_names": [getattr(v, "name", f"feed_{i}")
-                       for i, v in enumerate(feed_list)],
-        "feed_shapes": [list(v.shape) for v in feed_list],
-        "feed_dtypes": [v.dtype.name for v in feed_list],
-        "fetch_shapes": [list(v.shape) for v in fetch_list],
-        "fetch_dtypes": [v.dtype.name for v in fetch_list],
-    }
-    with open(path_prefix + ".pdmodel", "wb") as f:
-        pickle.dump(spec, f)
-    with open(path_prefix + ".pdiparams", "wb") as f:
-        pickle.dump(weights, f)
+    # program params keyed by a stable name (params recorded by object id)
+    pnames = {}
+    for i, (pid, p) in enumerate(sorted(program.params.items())):
+        pnames[pid] = getattr(p, "name", None) or f"param_{i}"
+    weights = {pnames[pid]: np.asarray(p._data)
+               for pid, p in program.params.items()}
 
-    # register live program for in-process serving
+    replay = program._replay_fn(fetch_ids, feed_names)
+    id_by_name = {n: pid for pid, n in pnames.items()}
+    wnames = sorted(weights)
+
+    def run(weight_list, *feeds):
+        params_by_id = {id_by_name[n]: a for n, a in zip(wnames, weight_list)}
+        return replay(list(feeds), params_by_id)
+
+    specs = [jax.ShapeDtypeStruct(tuple(v.shape), v._data.dtype)
+             for v in feed_list]
+    export_artifact(path_prefix, run, weights, specs, feed_names=feed_names)
+
+    # keep the live program registered for same-process serving
     _LIVE_MODELS[path_prefix] = (program, feed_list, fetch_list)
     return path_prefix
 
@@ -52,23 +56,40 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
 _LIVE_MODELS = {}
 
 
+class LoadedProgram:
+    """Cross-process inference program (duck-types enough of Program for
+    Executor.run): wraps a deserialized StableHLO artifact."""
+
+    def __init__(self, artifact):
+        self.artifact = artifact
+        self.feed_names = artifact.feed_names
+
+    def run(self, feed: dict):
+        arrays = []
+        for name, spec in zip(self.feed_names, self.artifact.feeds):
+            if name not in feed:
+                raise KeyError(f"missing feed '{name}'")
+            v = feed[name]
+            arr = v._data if isinstance(v, Tensor) else np.asarray(v)
+            arrays.append(arr)
+        out = self.artifact(*arrays)
+        return list(out) if isinstance(out, (list, tuple)) else [out]
+
+
 def load_inference_model(path_prefix, executor=None, **kwargs):
     if path_prefix in _LIVE_MODELS:
         program, feed_list, fetch_list = _LIVE_MODELS[path_prefix]
         feed_names = [v.name for v in feed_list]
         return program, feed_names, fetch_list
-    with open(path_prefix + ".pdmodel", "rb") as f:
-        spec = pickle.load(f)
-    with open(path_prefix + ".pdiparams", "rb") as f:
-        weights = pickle.load(f)
-    raise NotImplementedError(
-        "Loading a serialized inference model in a fresh process requires "
-        "the jit.save path (paddle_tpu.jit.load), which persists the traced "
-        "function. save_inference_model artifacts are servable in-process.")
+    from ..framework.exporting import load_artifact
+
+    prog = LoadedProgram(load_artifact(path_prefix))
+    # fetch placeholders, one per exported output (shapes known at run)
+    n_out = prog.artifact.meta.get("n_outputs", 1)
+    return prog, list(prog.feed_names), [None] * n_out
 
 
 def serialize_program(program=None):
-    import pickle as _p
     from .program import default_main_program
     program = program or default_main_program()
-    return _p.dumps({"n_ops": len(program.ops)})
+    return pickle.dumps({"n_ops": len(program.ops)})

@@ -187,6 +187,12 @@ class Executor:
             return_numpy=True):
         program = program or default_main_program()
         feed = feed or {}
+        from .io import LoadedProgram
+        if isinstance(program, LoadedProgram):
+            outs = program.run(feed)
+            if return_numpy:
+                return [np.asarray(o) for o in outs]
+            return [Tensor(o) for o in outs]
         fetch_list = fetch_list or []
         fetch_tensors = [f for f in fetch_list]
         fetch_ids = [id(f) for f in fetch_tensors]

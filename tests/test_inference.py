@@ -1,0 +1,173 @@
+"""Inference path: StableHLO artifact round-trip + Config/Predictor.
+
+VERDICT r1 #2/#3: save in one process, load+run in a fresh subprocess,
+outputs must match. Reference parity: AnalysisPredictor
+(/root/reference/paddle/fluid/inference/api/analysis_predictor.h:95),
+save/load_inference_model (/root/reference/python/paddle/static/io.py:442,723).
+"""
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision.models import LeNet
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_subprocess(code: str) -> str:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    res = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms','cpu');\n" + code],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert res.returncode == 0, res.stderr[-2000:]
+    return res.stdout
+
+
+class TestJitSaveLoad:
+    def test_same_process_roundtrip(self, tmp_path):
+        paddle.seed(0)
+        net = LeNet().eval()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).rand(2, 1, 28, 28).astype("float32"))
+        ref = net(x).numpy()
+        p = str(tmp_path / "lenet")
+        paddle.jit.save(net, p, input_spec=[
+            paddle.static.InputSpec([2, 1, 28, 28], "float32", "img")])
+        loaded = paddle.jit.load(p)
+        out = loaded(x)
+        np.testing.assert_allclose(np.asarray(out.numpy()), ref,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_cross_process_roundtrip(self, tmp_path):
+        paddle.seed(0)
+        net = LeNet().eval()
+        xn = np.random.RandomState(0).rand(2, 1, 28, 28).astype("float32")
+        ref = net(paddle.to_tensor(xn)).numpy()
+        p = str(tmp_path / "lenet")
+        np.save(str(tmp_path / "x.npy"), xn)
+        np.save(str(tmp_path / "ref.npy"), np.asarray(ref))
+        paddle.jit.save(net, p, input_spec=[
+            paddle.static.InputSpec([2, 1, 28, 28], "float32", "img")])
+        out = _run_subprocess(f"""
+import numpy as np
+import paddle_tpu as paddle
+m = paddle.jit.load({p!r})
+x = np.load({str(tmp_path / 'x.npy')!r})
+out = m(paddle.to_tensor(x)).numpy()
+ref = np.load({str(tmp_path / 'ref.npy')!r})
+np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
+print("SUBPROCESS_OK")
+""")
+        assert "SUBPROCESS_OK" in out
+
+    def test_save_requires_input_spec(self, tmp_path):
+        with pytest.raises(ValueError, match="input_spec"):
+            paddle.jit.save(LeNet(), str(tmp_path / "m"))
+
+    def test_dynamic_batch_dim(self, tmp_path):
+        """None batch dim (paddle idiom) -> shape-polymorphic export."""
+        paddle.seed(0)
+        net = LeNet().eval()
+        p = str(tmp_path / "dyn")
+        paddle.jit.save(net, p, input_spec=[
+            paddle.static.InputSpec([None, 1, 28, 28], "float32", "img")])
+        loaded = paddle.jit.load(p)
+        for bs in (1, 3, 7):
+            xn = np.random.RandomState(bs).rand(
+                bs, 1, 28, 28).astype("float32")
+            ref = net(paddle.to_tensor(xn)).numpy()
+            out = loaded(paddle.to_tensor(xn)).numpy()
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       rtol=1e-5, atol=1e-5)
+
+
+class TestPredictor:
+    def _save(self, tmp_path):
+        paddle.seed(0)
+        net = LeNet().eval()
+        xn = np.random.RandomState(1).rand(4, 1, 28, 28).astype("float32")
+        ref = net(paddle.to_tensor(xn)).numpy()
+        p = str(tmp_path / "model")
+        paddle.jit.save(net, p, input_spec=[
+            paddle.static.InputSpec([4, 1, 28, 28], "float32", "img")])
+        return p, xn, np.asarray(ref)
+
+    def test_predictor_run(self, tmp_path):
+        from paddle_tpu import inference
+        p, xn, ref = self._save(tmp_path)
+        cfg = inference.Config(p + ".pdmodel", p + ".pdiparams")
+        cfg.enable_memory_optim()
+        cfg.switch_ir_optim(True)
+        pred = inference.create_predictor(cfg)
+        names = pred.get_input_names()
+        assert names == ["img"]
+        pred.get_input_handle("img").copy_from_cpu(xn)
+        pred.run()
+        out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_predictor_cross_process(self, tmp_path):
+        p, xn, ref = self._save(tmp_path)
+        np.save(str(tmp_path / "x.npy"), xn)
+        np.save(str(tmp_path / "ref.npy"), ref)
+        out = _run_subprocess(f"""
+import numpy as np
+from paddle_tpu import inference
+cfg = inference.Config({p!r})
+pred = inference.create_predictor(cfg)
+x = np.load({str(tmp_path / 'x.npy')!r})
+outs = pred.run([x])
+ref = np.load({str(tmp_path / 'ref.npy')!r})
+np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-5)
+print("PREDICTOR_OK")
+""")
+        assert "PREDICTOR_OK" in out
+
+    def test_config_dir_discovery(self, tmp_path):
+        p, xn, ref = self._save(tmp_path)
+        from paddle_tpu import inference
+        cfg = inference.Config(str(tmp_path))
+        pred = inference.create_predictor(cfg)
+        outs = pred.run([xn])
+        np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-5)
+
+
+class TestStaticSaveLoad:
+    def test_static_inference_roundtrip(self, tmp_path):
+        paddle.enable_static()
+        try:
+            main = paddle.static.Program()
+            startup = paddle.static.Program()
+            with paddle.static.program_guard(main, startup):
+                x = paddle.static.data("x", [3, 8], "float32")
+                lin = paddle.nn.Linear(8, 4)
+                y = lin(x)
+            exe = paddle.static.Executor()
+            p = str(tmp_path / "static_model")
+            paddle.static.save_inference_model(p, [x], [y], exe, program=main)
+            xn = np.random.RandomState(0).rand(3, 8).astype("float32")
+            ref = exe.run(main, feed={"x": xn}, fetch_list=[y])[0]
+        finally:
+            paddle.disable_static()
+        np.save(str(tmp_path / "x.npy"), xn)
+        np.save(str(tmp_path / "ref.npy"), np.asarray(ref))
+        out = _run_subprocess(f"""
+import numpy as np
+import paddle_tpu as paddle
+prog, feed_names, fetches = paddle.static.load_inference_model({p!r})
+exe = paddle.static.Executor()
+x = np.load({str(tmp_path / 'x.npy')!r})
+outs = exe.run(prog, feed={{feed_names[0]: x}}, fetch_list=fetches)
+ref = np.load({str(tmp_path / 'ref.npy')!r})
+np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-5)
+print("STATIC_OK")
+""")
+        assert "STATIC_OK" in out
