@@ -1,0 +1,56 @@
+"""Megatron f/g collective ops with explicit custom VJPs.
+
+Reference: mp_ops.py (_c_identity / _mp_allreduce at
+/root/reference/python/paddle/distributed/fleet/layers/mpu/mp_ops.py) — the
+tensor-parallel conjugate pair:
+
+- ``mp_identity`` ('f'): identity forward, all-reduce backward. Marks the
+  point where a replicated activation fans out into column-sharded compute;
+  the backward sums the per-rank partial cotangents.
+- ``mp_allreduce`` ('g'): all-reduce forward, identity backward. Closes a
+  row-sharded matmul; the cotangent is already replicated.
+
+These are REQUIRED (not a convenience) inside manual-SPMD bodies that are
+differentiated with in-body ``jax.vjp`` (the 1F1B pipeline backward): the
+raw transpose of ``lax.psum`` there scales cotangents by the axis size,
+whereas these pairs encode the correct Megatron transposes explicitly.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def mp_identity(x, axis: str):
+    """'f': identity fwd; psum over ``axis`` in bwd."""
+    return x
+
+
+def _mp_identity_fwd(x, axis):
+    return x, None
+
+
+def _mp_identity_bwd(axis, _, ct):
+    return (jax.lax.psum(ct, axis),)
+
+
+mp_identity.defvjp(_mp_identity_fwd, _mp_identity_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def mp_allreduce(x, axis: str):
+    """'g': psum over ``axis`` fwd; identity bwd."""
+    return jax.lax.psum(x, axis)
+
+
+def _mp_allreduce_fwd(x, axis):
+    return jax.lax.psum(x, axis), None
+
+
+def _mp_allreduce_bwd(axis, _, ct):
+    return (ct,)
+
+
+mp_allreduce.defvjp(_mp_allreduce_fwd, _mp_allreduce_bwd)

@@ -1,14 +1,27 @@
-"""SPMD pipeline parallelism: GPipe schedule inside shard_map.
+"""SPMD pipeline parallelism: GPipe / 1F1B / interleaved schedules in shard_map.
 
 The reference implements PP as rank-local Python schedules exchanging
 activations over NCCL p2p (1F1B at
 /root/reference/python/paddle/distributed/fleet/meta_parallel/
-pipeline_parallel.py:117, p2p via batched isend/irecv). The TPU-native
-equivalent compiles the WHOLE schedule into one XLA program: stage weights
-live sharded over the 'pp' mesh axis (leading stacked-layer dim), microbatch
-activations flow stage-to-stage with `lax.ppermute` over ICI, and autodiff
-through the schedule yields the reverse pipeline automatically (grad
-accumulation over microbatches falls out of the sum over the unrolled loop).
+pipeline_parallel.py:117, interleaved virtual stages at :461, p2p via batched
+isend/irecv). The TPU-native equivalent compiles the WHOLE schedule into one
+XLA program: stage weights live sharded over the 'pp' mesh axis (leading
+stacked-layer dim), microbatch activations flow stage-to-stage with
+`lax.ppermute` over ICI.
+
+Three schedules:
+- ``spmd_pipeline`` (GPipe / "F-then-B"): forward loop only; autodiff through
+  the unrolled schedule yields the reverse pipeline. Stores every
+  microbatch's stage activations — memory grows with n_micro.
+- ``spmd_pipeline_1f1b`` ("1F1B"): custom-VJP whose backward re-runs the
+  forward interleaved one-forward/one-backward per tick, so each stage keeps
+  at most ~2*pp microbatch inputs alive (memory bounded by pipeline DEPTH,
+  not microbatch count — the property 1F1B exists for). Costs one extra
+  forward of the schedule, the same trade remat makes.
+- ``spmd_pipeline_interleaved``: virtual pipeline stages (Megatron "VPP") —
+  each rank owns ``v`` non-adjacent layer chunks; microbatches cycle the
+  ring v times, shrinking the bubble from (pp-1)/(n+pp-1) toward
+  (pp-1)/(v*pp+pp-1) per group of pp microbatches.
 
 Layout contract inside the body (manual SPMD — all collectives explicit):
 - stacked layer params: leading dim = total layers, sharded over 'pp'
@@ -38,7 +51,7 @@ def spmd_pipeline(layer_fn: Callable, stacked_params, x, mesh: Mesh,
     param_specs: pytree of PartitionSpec matching stacked_params (dim 0 must
     be ``axis``). x_spec: PartitionSpec for x (batch/seq sharding).
     """
-    from jax.experimental.shard_map import shard_map
+    from ...mesh_utils import manual_shard_map as shard_map
 
     pp = mesh.shape[axis]
     batch = x.shape[0]
@@ -80,5 +93,213 @@ def spmd_pipeline(layer_fn: Callable, stacked_params, x, mesh: Mesh,
         return out
 
     y = shard_map(body, mesh=mesh, in_specs=(param_specs, xm_spec),
-                  out_specs=xm_spec, check_rep=False)(stacked_params, x_mb)
+                  out_specs=xm_spec)(stacked_params, x_mb)
+    return y.reshape(x.shape)
+
+
+def _make_stage_fn(layer_fn, remat):
+    one_layer = jax.checkpoint(layer_fn) if remat else layer_fn
+
+    def stage_fn(params_local, h):
+        def step(c, p_slice):
+            return one_layer(p_slice, c), None
+        h, _ = jax.lax.scan(step, h, params_local)
+        return h
+
+    return stage_fn
+
+
+def spmd_pipeline_1f1b(layer_fn: Callable, stacked_params, x, mesh: Mesh,
+                       n_micro: int, param_specs, x_spec,
+                       axis: str = "pp", remat: bool = True):
+    """1F1B pipeline schedule (reference: forward_backward_pipeline,
+    pipeline_parallel.py:117 — startup/steady/cooldown) as a custom-VJP
+    SPMD program.
+
+    Forward = the GPipe loop (nothing saved beyond inputs). Backward
+    re-runs the forward interleaved with the backward: at tick ``t`` stage
+    ``s`` forwards microbatch ``t - s`` and backwards microbatch
+    ``t - 2*(pp-1) + s``; activations live in a circular buffer of depth
+    min(2*pp, n_micro), so peak memory is bounded by pipeline depth while
+    GPipe's grows with n_micro. Gradient math is identical (same sum over
+    microbatches) — only the evaluation order differs.
+    """
+    from ...mesh_utils import manual_shard_map as shard_map
+
+    pp = mesh.shape[axis]
+    batch = x.shape[0]
+    assert batch % n_micro == 0, (batch, n_micro)
+    mb = batch // n_micro
+    xm_shape = (n_micro, mb) + x.shape[1:]
+    xm_spec = P(*((None,) + tuple(x_spec)))
+    stage_fn = _make_stage_fn(layer_fn, remat)
+    perm_dn = [(i, i + 1) for i in range(pp - 1)]
+    perm_up = [(i + 1, i) for i in range(pp - 1)]
+
+    def fwd_body(params_local, xm):
+        stage = jax.lax.axis_index(axis)
+        state = jnp.zeros(xm.shape[1:], xm.dtype)
+        out = jnp.zeros_like(xm)
+        for t in range(n_micro + pp - 1):
+            prev = jax.lax.ppermute(state, axis, perm_dn)
+            feed = xm[min(t, n_micro - 1)]
+            inp = jnp.where(stage == 0, feed, prev)
+            state = stage_fn(params_local, inp)
+            o_idx = t - (pp - 1)
+            if o_idx >= 0:
+                out = out.at[o_idx].set(
+                    jnp.where(stage == pp - 1, state, jnp.zeros_like(state)))
+        return jax.lax.psum(out, axis)
+
+    def bwd_body(params_local, xm, dym):
+        stage = jax.lax.axis_index(axis)
+        D = min(2 * pp, n_micro)
+        ibuf = jnp.zeros((D,) + xm.shape[1:], xm.dtype)
+        h_state = jnp.zeros(xm.shape[1:], xm.dtype)
+        g_state = jnp.zeros(xm.shape[1:], dym.dtype)
+        dxm = jnp.zeros(xm.shape, dym.dtype)
+        dp_acc = jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape, jnp.float32), params_local)
+        for t in range(n_micro + 2 * (pp - 1)):
+            prev = jax.lax.ppermute(h_state, axis, perm_dn)
+            gin = jax.lax.ppermute(g_state, axis, perm_up)
+            # -- forward part: microbatch f = t - stage
+            f = t - stage
+            f_ok = (f >= 0) & (f < n_micro)
+            f_c = jnp.clip(f, 0, n_micro - 1)
+            feed = jax.lax.dynamic_index_in_dim(xm, f_c, 0, keepdims=False)
+            inp = jnp.where(stage == 0, feed, prev)
+            slot = f_c % D
+            old = jax.lax.dynamic_index_in_dim(ibuf, slot, 0, keepdims=False)
+            ibuf = jax.lax.dynamic_update_index_in_dim(
+                ibuf, jnp.where(f_ok, inp, old), slot, 0)
+            h_new = stage_fn(params_local, inp)
+            h_state = jnp.where(f_ok, h_new, h_state)
+            # -- backward part: microbatch g = t - 2*(pp-1) + stage
+            g = t - 2 * (pp - 1) + stage
+            g_ok = (g >= 0) & (g < n_micro)
+            g_c = jnp.clip(g, 0, n_micro - 1)
+            dy_g = jax.lax.dynamic_index_in_dim(dym, g_c, 0, keepdims=False)
+            dout = jnp.where(stage == pp - 1, dy_g, gin).astype(xm.dtype)
+            binp = jax.lax.dynamic_index_in_dim(ibuf, g_c % D, 0,
+                                                keepdims=False)
+            _, vjp_fn = jax.vjp(stage_fn, params_local, binp)
+            dp, dinp = vjp_fn(dout)
+            dp_acc = jax.tree_util.tree_map(
+                lambda a, d: a + jnp.where(g_ok, d.astype(jnp.float32), 0.0),
+                dp_acc, dp)
+            g_state = jnp.where(g_ok, dinp.astype(dym.dtype), g_state)
+            dxm = dxm.at[g_c].add(
+                jnp.where(g_ok & (stage == 0), dinp.astype(dym.dtype), 0.0))
+        # reduce param grads over the batch axes the activations were
+        # sharded on (dp/sep): those axes are unmapped in param_specs, and
+        # with check_rep=False shard_map takes rank-local output values
+        batch_axes = tuple(a for a in x_spec if a is not None)
+        if batch_axes:
+            dp_acc = jax.tree_util.tree_map(
+                lambda a: jax.lax.psum(a, batch_axes), dp_acc)
+        dp_acc = jax.tree_util.tree_map(
+            lambda a, p: a.astype(p.dtype), dp_acc, params_local)
+        return dp_acc, jax.lax.psum(dxm, axis)
+
+    fwd_sm = shard_map(fwd_body, mesh=mesh, in_specs=(param_specs, xm_spec),
+                       out_specs=xm_spec)
+    bwd_sm = shard_map(bwd_body, mesh=mesh,
+                       in_specs=(param_specs, xm_spec, xm_spec),
+                       out_specs=(param_specs, xm_spec))
+
+    @jax.custom_vjp
+    def pipe(params, xx):
+        return fwd_sm(params, xx.reshape(xm_shape)).reshape(x.shape)
+
+    def pipe_fwd(params, xx):
+        return pipe(params, xx), (params, xx)
+
+    def pipe_bwd(res, gy):
+        params, xx = res
+        dp, dxm = bwd_sm(params, xx.reshape(xm_shape),
+                         gy.reshape(xm_shape))
+        return dp, dxm.reshape(x.shape).astype(xx.dtype)
+
+    pipe.defvjp(pipe_fwd, pipe_bwd)
+    return pipe(stacked_params, x)
+
+
+def spmd_pipeline_interleaved(layer_fn: Callable, stacked_params, x,
+                              mesh: Mesh, n_micro: int, v: int, param_specs,
+                              x_spec, axis: str = "pp", remat: bool = True):
+    """Interleaved virtual-stage pipeline (reference:
+    PipelineParallelWithInterleave, pipeline_parallel.py:461).
+
+    Each rank owns ``v`` non-adjacent layer chunks (virtual stage
+    ``c*pp + s`` on rank ``s``); microbatches travel the stage ring ``v``
+    times. Processed in serial groups of ``pp`` microbatches (the reference
+    imposes the same ``accumulate_steps % pp == 0`` constraint); within a
+    group, chunk passes chain seamlessly through the ring wraparound, so
+    the per-group bubble is (pp-1)/(v*pp + pp - 1). Backward is autodiff
+    through the schedule (GPipe memory profile).
+    """
+    from ...mesh_utils import manual_shard_map as shard_map
+
+    pp = mesh.shape[axis]
+    batch = x.shape[0]
+    assert batch % n_micro == 0, (batch, n_micro)
+    if n_micro % pp != 0:
+        raise ValueError(
+            f"interleaved schedule requires n_micro % pp == 0 (got "
+            f"{n_micro} % {pp}); the reference imposes the same constraint "
+            f"(pipeline_parallel.py:492 accumulate_steps % num_stages)")
+    mb = batch // n_micro
+    xm_spec = P(*((None,) + tuple(x_spec)))
+    stage_fn = _make_stage_fn(layer_fn, remat)
+
+    # reshape [L, ...] -> [v, pp, Lc, ...]: virtual stage vs = c*pp + s owns
+    # layers [vs*Lc, (vs+1)*Lc); shard dim 1 over 'pp'
+    def _reshape_param(a):
+        return a.reshape((v, pp, a.shape[0] // (v * pp)) + a.shape[1:])
+
+    vparams = jax.tree_util.tree_map(_reshape_param, stacked_params)
+    vspecs = jax.tree_util.tree_map(
+        lambda s: P(None, axis, None, *tuple(s)[1:]), param_specs,
+        is_leaf=lambda s: isinstance(s, P))
+
+    ring = [(i, (i + 1) % pp) for i in range(pp)]
+
+    def body(params_local, xm):
+        # params_local: [v, 1, Lc, ...] -> [v, Lc, ...]
+        pl = jax.tree_util.tree_map(lambda a: a[:, 0], params_local)
+        stage = jax.lax.axis_index(axis)
+        out = jnp.zeros_like(xm)
+        n_groups = n_micro // pp
+        ticks = v * pp + pp - 1
+        for grp in range(n_groups):
+            state = jnp.zeros(xm.shape[1:], xm.dtype)
+            for r in range(ticks):
+                moved = jax.lax.ppermute(state, axis, ring)
+                q = r - stage                     # flow position
+                ok = (q >= 0) & (q < v * pp)
+                q_c = jnp.clip(q, 0, v * pp - 1)
+                c = q_c // pp                     # chunk index (traced)
+                j = q_c % pp                      # within-group microbatch
+                f = grp * pp + j
+                feed = jax.lax.dynamic_index_in_dim(xm, f, 0, keepdims=False)
+                inp = jnp.where((stage == 0) & (c == 0), feed, moved)
+                chunk_p = jax.tree_util.tree_map(
+                    lambda a: jax.lax.dynamic_index_in_dim(
+                        a, c, 0, keepdims=False), pl)
+                h = stage_fn(chunk_p, inp)
+                state = jnp.where(ok, h, state)
+                done = ok & (stage == pp - 1) & (c == v - 1)
+                out = jax.lax.dynamic_update_index_in_dim(
+                    out,
+                    jnp.where(
+                        done, state,
+                        jax.lax.dynamic_index_in_dim(out, f, 0,
+                                                     keepdims=False)),
+                    f, 0)
+        return jax.lax.psum(out, axis)
+
+    x_mb = x.reshape((n_micro, mb) + x.shape[1:])
+    y = shard_map(body, mesh=mesh, in_specs=(vspecs, xm_spec),
+                  out_specs=xm_spec)(vparams, x_mb)
     return y.reshape(x.shape)

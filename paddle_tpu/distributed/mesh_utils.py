@@ -74,3 +74,16 @@ def with_constraint(arr, *spec):
     # Eager path: a committed single-device array can't take a sharding
     # constraint; reshard by placement instead.
     return jax.device_put(arr, sharding)
+
+
+def manual_shard_map(f, mesh, in_specs, out_specs):
+    """shard_map in fully-manual mode (no varying-mode-agreement checking)
+    across jax versions: the pipeline/ring bodies manage their own
+    collective reductions explicitly, which the vma checker rejects."""
+    try:
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    except TypeError:  # pragma: no cover — older jax
+        from jax.experimental.shard_map import shard_map as _sm
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=False)

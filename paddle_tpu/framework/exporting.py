@@ -89,15 +89,21 @@ class LoadedArtifact:
         self.feed_names = meta["feed_names"]
         self.feeds = meta["feeds"]
         self._exported = jax.export.deserialize(meta["stablehlo"])
-        self._weight_list = [self.weights[n] for n in meta["weight_names"]]
+        self._commit_weights()
+
+    def _commit_weights(self):
+        # device-resident once; otherwise every __call__ would re-transfer
+        # all weights host-to-device (serving hot path)
+        import jax.numpy as jnp
+        self._weight_list = [jnp.asarray(self.weights[n])
+                             for n in self.meta["weight_names"]]
 
     def __call__(self, *inputs):
         return self._exported.call(self._weight_list, *inputs)
 
     def set_weights(self, weights: Dict[str, np.ndarray]):
         self.weights = dict(weights)
-        self._weight_list = [self.weights[n]
-                             for n in self.meta["weight_names"]]
+        self._commit_weights()
 
 
 def load_artifact(path_prefix: str,

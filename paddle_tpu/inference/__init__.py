@@ -57,7 +57,9 @@ class Config:
                 if model_path.endswith(".pdmodel") else model_path
             self._params_path = params_path
         else:
-            # a directory or a prefix
+            # a directory, a prefix, or a bare .pdmodel file path
+            if model_path.endswith(".pdmodel"):
+                model_path = model_path[:-len(".pdmodel")]
             if os.path.isdir(model_path):
                 cands = [f[:-len(".pdmodel")]
                          for f in os.listdir(model_path)

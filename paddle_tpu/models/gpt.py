@@ -210,7 +210,12 @@ def _stacked_layer_fwd(p, x, *, num_heads, head_dim, eps, mp_size, sep_size):
     mb, s_loc, hidden = x.shape
     nh_loc = num_heads // mp_size
 
+    from ..distributed.fleet.meta_parallel.mp_ops import (mp_allreduce,
+                                                          mp_identity)
+
     h = ln(x, p["ln1_w"], p["ln1_b"])
+    if mp_size > 1:
+        h = mp_identity(h, "mp")                      # 'f': psum bwd
     qkv = h @ p["qkv_w"] + p["qkv_b"]                 # [mb, s, 3*H/mp]
     qkv = qkv.reshape(mb, s_loc, nh_loc, 3, head_dim)
     q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]  # [mb,s,nh,hd]
@@ -228,14 +233,16 @@ def _stacked_layer_fwd(p, x, *, num_heads, head_dim, eps, mp_size, sep_size):
     attn = attn.reshape(mb, s_loc, nh_loc * head_dim)
     o = attn @ p["out_w"]                             # partial over H/mp
     if mp_size > 1:
-        o = jax.lax.psum(o, "mp")
+        o = mp_allreduce(o, "mp")                     # 'g': identity bwd
     x = x + o + p["out_b"]
 
     h2 = ln(x, p["ln2_w"], p["ln2_b"])
+    if mp_size > 1:
+        h2 = mp_identity(h2, "mp")
     u = jax.nn.gelu(h2 @ p["fc1_w"] + p["fc1_b"], approximate=True)
     d = u @ p["fc2_w"]
     if mp_size > 1:
-        d = jax.lax.psum(d, "mp")
+        d = mp_allreduce(d, "mp")
     return x + d + p["fc2_b"]
 
 
@@ -295,6 +302,18 @@ class GPTStackedTransformer(Layer):
             n -= 1
         return n
 
+    @staticmethod
+    def _pp_schedule():
+        """(schedule_mode, virtual_pp_degree) from the fleet strategy —
+        reference toggle: pipeline_configs.schedule_mode ('1F1B'/'F-then-B',
+        distributed_strategy.py:1509) + virtual pp for the interleaved
+        schedule (pipeline_parallel.py:461)."""
+        from ..distributed.fleet.fleet_api import _fleet_state
+        strat = _fleet_state.get("strategy")
+        cfg = (strat.pipeline_configs or {}) if strat is not None else {}
+        return (cfg.get("schedule_mode", "1F1B"),
+                int(cfg.get("virtual_pp_degree", 1) or 1))
+
     def forward(self, x):
         import functools
 
@@ -327,7 +346,8 @@ class GPTStackedTransformer(Layer):
                 out, _ = jax.lax.scan(step, x_arr, p)
                 return out
             from jax.sharding import PartitionSpec as P
-            from ..distributed.fleet.meta_parallel.pp_spmd import spmd_pipeline
+            from ..distributed.fleet.meta_parallel.pp_spmd import (
+                spmd_pipeline, spmd_pipeline_1f1b, spmd_pipeline_interleaved)
             param_specs = {n: P(*[a if (a in mesh.axis_names
                                         and mesh.shape[a] > 1) else None
                                   for a in self.SPECS[n]]) for n in names}
@@ -336,6 +356,14 @@ class GPTStackedTransformer(Layer):
             n_micro = self._n_micro(pp, x_arr.shape[0])
             x_spec = P("dp" if dp_ok else None, "sep" if sep_ok else None,
                        None)
+            schedule, vpp = self._pp_schedule()
+            if pp > 1 and vpp > 1:
+                return spmd_pipeline_interleaved(
+                    layer, p, x_arr, mesh, n_micro, vpp, param_specs,
+                    x_spec, axis="pp")
+            if pp > 1 and schedule == "1F1B":
+                return spmd_pipeline_1f1b(layer, p, x_arr, mesh, n_micro,
+                                          param_specs, x_spec, axis="pp")
             return spmd_pipeline(layer, p, x_arr, mesh, n_micro,
                                  param_specs, x_spec, axis="pp")
 

@@ -343,6 +343,14 @@ def _check_mha_args(q, k, causal, block_q, block_k):
             f"block_q/block_k must be multiples of {LANES} (got "
             f"{block_q}/{block_k}); the backward row-stat tiles are "
             f"{LANES}-lane replicated")
+    sq, sk = q.shape[2], k.shape[2]
+    if sq % block_q or sk % block_k:
+        raise ValueError(
+            f"sequence lengths must be multiples of the block sizes (got "
+            f"sq={sq} % block_q={block_q}, sk={sk} % block_k={block_k}); "
+            f"the grid covers whole blocks only — pad the sequence or use "
+            f"the XLA attention path (ops.flash_attention.supported gates "
+            f"this automatically)")
     if causal and q.shape[2] != k.shape[2]:
         raise ValueError(
             f"causal mha requires sq == sk (got {q.shape[2]} vs "

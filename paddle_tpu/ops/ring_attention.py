@@ -66,7 +66,7 @@ def ring_attention(q, k, v, mesh: Mesh, seq_axis: str = "sep",
                    sm_scale: Optional[float] = None):
     """Exact attention with [B, S, H, D] inputs sequence-sharded over
     ``seq_axis``. Call under jit with a mesh; q/k/v are GLOBAL arrays."""
-    from jax.experimental.shard_map import shard_map
+    from ..distributed.mesh_utils import manual_shard_map as shard_map
 
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
@@ -89,4 +89,4 @@ def ring_attention(q, k, v, mesh: Mesh, seq_axis: str = "sep",
                            axis_size=axis_size, causal=causal,
                            sm_scale=sm_scale)
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, check_rep=False)(q, k, v)
+                     out_specs=spec)(q, k, v)
