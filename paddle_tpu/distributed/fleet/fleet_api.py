@@ -31,6 +31,11 @@ def init(role_maker=None, is_collective=False, strategy: Optional[DistributedStr
     if hc.get("sep_degree", 1) > 1:
         dims.insert(3, hc["sep_degree"])
         names.insert(3, "sep")
+    if hc.get("ep_degree", 1) > 1:
+        # expert-parallel mesh axis (the reference routes MoE through its
+        # own NCCL group, moe_layer.py:261; here it is a first-class axis)
+        dims.insert(3, hc["ep_degree"])
+        names.insert(3, "expert")
     topo = CommunicateTopology(names, dims)
     hcg = HybridCommunicateGroup(topo)
     _fleet_state.update(initialized=True, strategy=strategy, hcg=hcg)

@@ -60,14 +60,7 @@ def spmd_pipeline(layer_fn: Callable, stacked_params, x, mesh: Mesh,
     x_mb = x.reshape((n_micro, mb) + x.shape[1:])
     xm_spec = P(*((None,) + tuple(x_spec)))
 
-    one_layer = jax.checkpoint(layer_fn) if remat else layer_fn
-
-    def stage_fn(params_local, h):
-        # scan over this stage's local layers (leading dim L/pp)
-        def step(c, p_slice):
-            return one_layer(p_slice, c), None
-        h, _ = jax.lax.scan(step, h, params_local)
-        return h
+    stage_fn = _make_stage_fn(layer_fn, remat)
 
     def body(params_local, xm):
         # xm: [n_micro, mb_local, s_local, hidden]
@@ -252,6 +245,13 @@ def spmd_pipeline_interleaved(layer_fn: Callable, stacked_params, x,
     mb = batch // n_micro
     xm_spec = P(*((None,) + tuple(x_spec)))
     stage_fn = _make_stage_fn(layer_fn, remat)
+
+    n_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if n_layers % (v * pp) != 0 or n_layers // (v * pp) == 0:
+        raise ValueError(
+            f"interleaved schedule requires num_layers divisible by "
+            f"virtual_pp_degree*pp_degree (got {n_layers} layers, "
+            f"v={v} * pp={pp} = {v * pp})")
 
     # reshape [L, ...] -> [v, pp, Lc, ...]: virtual stage vs = c*pp + s owns
     # layers [vs*Lc, (vs+1)*Lc); shard dim 1 over 'pp'

@@ -19,7 +19,7 @@ from ..group import Group, new_group
 from ..mesh_utils import build_mesh, set_global_mesh
 
 _AXIS_TO_MESH_NAME = {"data": "dp", "pipe": "pp", "sharding": "sharding",
-                      "sep": "sep", "model": "mp"}
+                      "sep": "sep", "expert": "ep", "model": "mp"}
 
 
 class CommunicateTopology:
@@ -96,6 +96,10 @@ class HybridCommunicateGroup:
         self._pp_group = self._make_group("pipe", "pp")
         self._sharding_group = self._make_group("sharding", "sharding")
         self._mp_group = self._make_group("model", "mp")
+        if "expert" in self._topo.get_hybrid_group_names():
+            self._ep_group = self._make_group("expert", "ep")
+        else:
+            self._ep_group = None
 
         # the device mesh for compiled parallelism (only when enough devices)
         try:

@@ -83,7 +83,7 @@ def manual_shard_map(f, mesh, in_specs, out_specs):
     try:
         return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
                              out_specs=out_specs, check_vma=False)
-    except TypeError:  # pragma: no cover — older jax
+    except (TypeError, AttributeError):  # pragma: no cover — older jax
         from jax.experimental.shard_map import shard_map as _sm
         return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                    check_rep=False)

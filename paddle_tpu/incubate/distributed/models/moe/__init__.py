@@ -1,0 +1,203 @@
+"""Mixture-of-Experts with expert parallelism — TPU-native.
+
+Reference: /root/reference/python/paddle/incubate/distributed/models/moe/
+moe_layer.py:261 (MoELayer), gate/gshard_gate.py, gate/switch_gate.py,
+gate/naive_gate.py; dispatch there is global_scatter/global_gather NCCL
+all-to-all ops (moe_layer.py:117,138 → paddle/fluid/operators/collective/
+global_scatter_op.*).
+
+TPU-native design (GShard-style dense dispatch):
+- gating, capacity assignment, and combine are ONE dense einsum program
+  with static shapes: dispatch [T,E,C] x tokens [T,d] -> expert blocks
+  [E,C,d]; XLA fuses the one-hot products, no ragged buffers.
+- expert FFNs are layer-stacked params [E, ...] carrying a
+  ``dist_spec ('ep', ...)`` — under a fleet mesh with ep_degree>1 the
+  expert dim shards over the 'ep' axis and GSPMD inserts the token
+  all-to-all where the [E,C,d] blocks change sharding (the reference's
+  global_scatter/global_gather, compiled instead of hand-issued).
+- capacity overflow drops tokens exactly like the reference (position
+  >= capacity is masked out of combine/dispatch).
+
+Gates: 'gshard' (top-2, load-balance aux loss), 'switch' (top-1),
+'naive' (softmax-weighted dense mixture, no drops; for debugging).
+The layer stores the balance loss in ``self.l_aux`` after each forward.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .....core.dispatch import apply_op
+from .....distributed.mesh_utils import get_global_mesh, with_constraint
+from .....nn import initializer as I
+from .....nn.initializer_utils import create_parameter_with_attr
+from .....nn.layer.layers import Layer
+
+__all__ = ["MoELayer"]
+
+
+def _ep_constraint(arr):
+    """Shard the leading expert dim over the 'ep' mesh axis (no-op without
+    a mesh / ep axis). Marks the all-to-all boundary for GSPMD."""
+    mesh = get_global_mesh()
+    if mesh is None or "ep" not in mesh.axis_names or mesh.shape["ep"] == 1:
+        return arr
+    return with_constraint(arr, "ep", *([None] * (arr.ndim - 1)))
+
+
+def _top1_assign(probs, capacity, prior_count=None):
+    """Greedy top-1 assignment with capacity. Returns (mask [T,E] post-
+    capacity, pos [T] slot index, gate_val [T])."""
+    T, E = probs.shape
+    idx = jnp.argmax(probs, axis=1)
+    mask = jax.nn.one_hot(idx, E, dtype=probs.dtype)
+    # position of each token within its expert queue (0-based, fp cumsum —
+    # token counts are far below fp32 integer precision)
+    pos_in_e = jnp.cumsum(mask, axis=0) - mask
+    if prior_count is not None:
+        pos_in_e = pos_in_e + prior_count[None, :]
+    pos = jnp.sum(pos_in_e * mask, axis=1)
+    keep = (pos < capacity).astype(probs.dtype)
+    mask = mask * keep[:, None]
+    gate_val = jnp.sum(probs * mask, axis=1)
+    return mask, pos, gate_val
+
+
+def _combine_tensor(mask, pos, gate_val, capacity):
+    """[T,E] mask + [T] positions + [T] gate values -> [T,E,C] combine."""
+    loc = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=mask.dtype)
+    return gate_val[:, None, None] * mask[:, :, None] * loc[:, None, :]
+
+
+def _gshard_gate(xt, wg, num_experts, capacity):
+    """Top-2 gating with the GShard load-balance loss
+    (reference gate/gshard_gate.py; aux = E * sum_e(mean_probs_e *
+    frac_tokens_e), Lepikhin et al. eq. (4))."""
+    logits = xt @ wg
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=1)
+    mask1, pos1, g1 = _top1_assign(probs, capacity)
+    # second choice: exclude each token's first expert, queue after ALL
+    # first-choice tokens of that expert (the reference's ordering)
+    count1 = jnp.sum(mask1, axis=0)
+    probs2 = probs * (1.0 - (jax.nn.one_hot(jnp.argmax(probs, 1),
+                                            num_experts,
+                                            dtype=probs.dtype)))
+    mask2, pos2, g2 = _top1_assign(probs2, capacity, prior_count=count1)
+    # renormalize the two gate values
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    c1 = _combine_tensor(mask1, pos1, g1 / denom, capacity)
+    c2 = _combine_tensor(mask2, pos2, g2 / denom, capacity)
+    combine = c1 + c2
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    aux = num_experts * jnp.sum(me * ce)
+    return combine, aux
+
+
+def _switch_gate(xt, wg, num_experts, capacity):
+    """Top-1 gating (reference gate/switch_gate.py; Fedus et al.)."""
+    logits = xt @ wg
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=1)
+    mask1, pos1, g1 = _top1_assign(probs, capacity)
+    combine = _combine_tensor(mask1, pos1, g1, capacity)
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    aux = num_experts * jnp.sum(me * ce)
+    return combine, aux
+
+
+def _naive_gate(xt, wg, num_experts, capacity):
+    """Dense softmax mixture, no capacity drops (reference
+    gate/naive_gate.py semantics: every expert sees every token)."""
+    del capacity
+    probs = jax.nn.softmax((xt @ wg).astype(jnp.float32), axis=1)
+    T = xt.shape[0]
+    # every token occupies slot t of every expert: capacity == T
+    loc = jnp.eye(T, dtype=probs.dtype)
+    combine = probs[:, :, None] * loc[:, None, :]
+    aux = jnp.zeros((), jnp.float32)
+    return combine, aux
+
+
+_GATES = {"gshard": _gshard_gate, "switch": _switch_gate,
+          "naive": _naive_gate}
+
+
+class MoELayer(Layer):
+    """Sparse expert FFN block: ``y = combine(gate(x), experts(dispatch(x)))``.
+
+    Args mirror the reference MoELayer (moe_layer.py:261): ``gate`` is the
+    gate name or a config dict {'type': ..., 'top_k': ...}; expert FFNs are
+    stacked internally ([E, d, dff]/[E, dff, d]) rather than a LayerList so
+    the expert dim is a shardable array axis.
+    """
+
+    def __init__(self, d_model, d_hidden, num_experts, gate="gshard",
+                 capacity_factor=1.2, activation="gelu",
+                 initializer_range=0.02, group=None):
+        super().__init__()
+        if isinstance(gate, dict):
+            gate = gate.get("type", "gshard")
+        if gate not in _GATES:
+            raise ValueError(f"unknown gate {gate!r}; one of {list(_GATES)}")
+        self.d_model = d_model
+        self.d_hidden = d_hidden
+        self.num_experts = num_experts
+        self.gate_type = gate
+        self.capacity_factor = float(capacity_factor)
+        self.activation = activation
+        self.group = group  # accepted for API parity; mesh axis governs
+        E = num_experts
+        normal = I.Normal(std=initializer_range)
+        zeros = I.Constant(0.0)
+
+        def mk(shape, init, spec):
+            p = create_parameter_with_attr(shape, self._dtype, None, False,
+                                           default_initializer=init)
+            p.dist_spec = spec
+            return p
+
+        self.gate_weight = mk([d_model, E], normal, None)
+        self.w1 = mk([E, d_model, d_hidden], normal, ("ep", None, None))
+        self.b1 = mk([E, d_hidden], zeros, ("ep", None))
+        self.w2 = mk([E, d_hidden, d_model], normal, ("ep", None, None))
+        self.b2 = mk([E, d_model], zeros, ("ep", None))
+        self.l_aux = None
+
+    def _capacity(self, tokens):
+        if self.gate_type == "naive":
+            return tokens
+        c = int(math.ceil(tokens / self.num_experts * self.capacity_factor))
+        return max(c, 1)
+
+    def forward(self, x):
+        cfg = dict(num_experts=self.num_experts, gate=self.gate_type,
+                   capacity=self._capacity(int(np.prod(x.shape[:-1]))),
+                   activation=self.activation)
+
+        def fn(x, wg, w1, b1, w2, b2):
+            shape = x.shape
+            d = shape[-1]
+            xt = x.reshape(-1, d)
+            combine, aux = _GATES[cfg["gate"]](
+                xt.astype(jnp.float32), wg.astype(jnp.float32),
+                cfg["num_experts"], cfg["capacity"])
+            combine = combine.astype(x.dtype)
+            dispatch = (combine > 0).astype(x.dtype)
+            disp = jnp.einsum("tec,td->ecd", dispatch, xt)
+            disp = _ep_constraint(disp)
+            act = (jax.nn.gelu if cfg["activation"] == "gelu"
+                   else getattr(jax.nn, cfg["activation"]))
+            h = act(jnp.einsum("ecd,edf->ecf", disp, w1) + b1[:, None, :])
+            eo = jnp.einsum("ecf,efd->ecd", h, w2) + b2[:, None, :]
+            eo = _ep_constraint(eo)
+            out = jnp.einsum("tec,ecd->td", combine, eo)
+            return out.reshape(shape), aux.astype(jnp.float32)
+
+        out, aux = apply_op("moe_layer", fn, x, self.gate_weight,
+                            self.w1, self.b1, self.w2, self.b2)
+        self.l_aux = aux
+        return out

@@ -1,0 +1,152 @@
+"""MoE + expert parallelism tests.
+
+Oracle pattern: the naive gate is a dense softmax mixture, checkable
+against an explicit per-expert loop (reference test analog:
+test_moe_api.py over moe_layer.py:261).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed.fleet as fleet
+from paddle_tpu.distributed.mesh_utils import set_global_mesh
+from paddle_tpu.incubate.distributed.models.moe import MoELayer
+from paddle_tpu.jit import TrainStep
+
+B, S, D, F, E = 4, 8, 16, 32, 4
+
+
+def _x(seed=0):
+    rng = np.random.RandomState(seed)
+    return paddle.to_tensor(rng.randn(B, S, D).astype("float32"))
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+class TestGates:
+    @pytest.mark.parametrize("gate", ["gshard", "switch", "naive"])
+    def test_forward_shapes(self, gate):
+        paddle.seed(0)
+        moe = MoELayer(D, F, E, gate=gate)
+        out = moe(_x())
+        assert out.shape == [B, S, D]
+        assert np.isfinite(_np(out)).all()
+        assert moe.l_aux is not None
+        assert np.isfinite(float(moe.l_aux.numpy()))
+
+    def test_naive_gate_matches_dense_mixture(self):
+        paddle.seed(0)
+        moe = MoELayer(D, F, E, gate="naive")
+        x = _x(1)
+        out = _np(moe(x))
+
+        xt = _np(x).reshape(-1, D)
+        wg = _np(moe.gate_weight)
+        logits = xt @ wg
+        p = np.exp(logits - logits.max(1, keepdims=True))
+        p /= p.sum(1, keepdims=True)
+        ref = np.zeros_like(xt)
+
+        def gelu(a):
+            return 0.5 * a * (1 + np.tanh(
+                np.sqrt(2 / np.pi) * (a + 0.044715 * a ** 3)))
+        for e in range(E):
+            h = gelu(xt @ _np(moe.w1)[e] + _np(moe.b1)[e])
+            fe = h @ _np(moe.w2)[e] + _np(moe.b2)[e]
+            ref += p[:, e:e + 1] * fe
+        np.testing.assert_allclose(out.reshape(-1, D), ref, rtol=2e-4,
+                                   atol=2e-4)
+
+    def test_gshard_top2_combine_renormalized(self):
+        paddle.seed(0)
+        moe = MoELayer(D, F, E, gate="gshard", capacity_factor=100.0)
+        x = _x(2)
+        moe(x)  # no drops at huge capacity
+        # re-derive combine weights: each token's two gate values sum to 1
+        from paddle_tpu.incubate.distributed.models.moe import _gshard_gate
+        import jax.numpy as jnp
+        xt = jnp.asarray(_np(x).reshape(-1, D))
+        wg = jnp.asarray(_np(moe.gate_weight))
+        combine, aux = _gshard_gate(xt, wg, E, moe._capacity(B * S))
+        sums = np.asarray(combine.sum(axis=(1, 2)))
+        np.testing.assert_allclose(sums, np.ones_like(sums), atol=1e-5)
+
+    def test_switch_capacity_drops_tokens(self):
+        paddle.seed(0)
+        # capacity 1 per expert: at most E tokens survive out of B*S
+        moe = MoELayer(D, F, E, gate="switch", capacity_factor=E / (B * S))
+        out = _np(moe(_x(3)))
+        dropped = np.all(out.reshape(-1, D) == 0, axis=1).sum()
+        assert dropped >= B * S - E
+
+    def test_grads_flow_to_experts_and_gate(self):
+        paddle.seed(0)
+        moe = MoELayer(D, F, E, gate="gshard")
+        out = moe(_x(4))
+        out.sum().backward()
+        for p in (moe.gate_weight, moe.w1, moe.w2, moe.b1):
+            assert p.grad is not None
+            assert np.abs(_np(p.grad)).sum() > 0, p.name
+
+    def test_unknown_gate_raises(self):
+        with pytest.raises(ValueError, match="unknown gate"):
+            MoELayer(D, F, E, gate="bogus")
+
+
+class _MoENet(paddle.nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.proj = paddle.nn.Linear(D, D)
+        self.moe = MoELayer(D, F, E, gate="gshard")
+
+    def forward(self, x):
+        return self.moe(self.proj(x))
+
+
+class TestExpertParallel:
+    def _run(self, hybrid, steps=3):
+        paddle.seed(0)
+        if hybrid:
+            s = fleet.DistributedStrategy()
+            s.hybrid_configs = hybrid
+            fleet.init(is_collective=True, strategy=s)
+        else:
+            set_global_mesh(None)
+        net = _MoENet()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=net.parameters())
+        step = TrainStep(net, lambda o, y: ((o - y) ** 2).mean(), opt)
+        x = _x(5)
+        y = _x(6)
+        losses = [float(step(x, y).numpy()) for _ in range(steps)]
+        net_params = {n: _np(p) for n, p in net.named_parameters()}
+        set_global_mesh(None)
+        return losses, net_params, net
+
+    def test_ep4_matches_single(self):
+        import jax
+        jax.config.update("jax_default_matmul_precision", "highest")
+        single, p1, _ = self._run(None)
+        ep, p2, _ = self._run({"dp_degree": 1, "ep_degree": 4})
+        np.testing.assert_allclose(single, ep, rtol=1e-4, atol=1e-4)
+        for n in p1:
+            np.testing.assert_allclose(p1[n], p2[n], rtol=1e-4, atol=1e-4,
+                                       err_msg=n)
+
+    def test_dp2_ep4_matches_single(self):
+        import jax
+        jax.config.update("jax_default_matmul_precision", "highest")
+        single, p1, _ = self._run(None)
+        hyb, p2, _ = self._run({"dp_degree": 2, "ep_degree": 4})
+        np.testing.assert_allclose(single, hyb, rtol=1e-4, atol=1e-4)
+        for n in p1:
+            np.testing.assert_allclose(p1[n], p2[n], rtol=1e-4, atol=1e-4,
+                                       err_msg=n)
+
+    def test_expert_weights_sharded_over_ep(self):
+        _, _, net = self._run({"dp_degree": 1, "ep_degree": 4}, steps=1)
+        w1 = net.moe.w1._data
+        shard_experts = {sh.data.shape[0] for sh in w1.addressable_shards}
+        assert shard_experts == {E // 4}
