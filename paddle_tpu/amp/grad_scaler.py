@@ -89,18 +89,22 @@ class GradScaler:
         self.update()
 
     def state_dict(self):
+        # counters may be device scalars when a TrainStep runs the scaler
+        # in-graph; materialize to python numbers here
         return {
-            "scale": self._scale,
+            "scale": float(np.asarray(self._scale)),
             "incr_ratio": self._incr_ratio,
             "decr_ratio": self._decr_ratio,
-            "incr_count": self._good_steps,
-            "decr_count": self._bad_steps,
+            "incr_count": int(np.asarray(self._good_steps)),
+            "decr_count": int(np.asarray(self._bad_steps)),
         }
 
     def load_state_dict(self, state):
         self._scale = state.get("scale", self._scale)
         self._good_steps = state.get("incr_count", 0)
         self._bad_steps = state.get("decr_count", 0)
+        # invalidate any TrainStep's cached device-side scaler state
+        self._epoch = getattr(self, "_epoch", 0) + 1
 
 
 AmpScaler = GradScaler

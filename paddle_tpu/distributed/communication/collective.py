@@ -2,15 +2,25 @@
 
 Reference surface: /root/reference/python/paddle/distributed/communication/
 (all_reduce.py:19 etc.), backed there by ProcessGroupNCCL. TPU-native
-semantics: inside traced code (shard_map/pjit) use the `inside_shard_map`
-forms (jax.lax collectives over mesh axes); in eager single-process mode the
-collectives operate on the local tensor (world_size==1 ≡ identity, which is
-exactly the reference behavior for a 1-rank group). Multi-host eager
-collectives go through jax.experimental.multihost_utils when initialized.
+semantics, three regimes:
+
+- traced (shard_map/pjit): jax.lax collectives over the group's mesh axis —
+  compiled into the XLA program, riding ICI (the performance path).
+- eager single-process: world_size==1 ≡ identity (reference behavior for a
+  1-rank group).
+- eager multi-process: host-side collectives over the native TCPStore
+  rendezvous (paddle_tpu/native/csrc/tcp_store.cc) — every rank posts its
+  numpy payload under a sequenced key and reads its peers'. Correct and
+  portable (no device interconnect assumptions); traced collectives remain
+  the way to make communication fast. Matches the reference's contract that
+  `paddle.distributed.*` works in eager mode (process_group.h:53).
 """
 from __future__ import annotations
 
+import io
+import itertools
 import types
+from collections import defaultdict
 
 import jax
 import jax.numpy as jnp
@@ -20,6 +30,86 @@ from ...core.dispatch import apply_op
 from ...core.tensor import Tensor
 from .. import env
 from ..group import Group, Task, get_group
+
+# ------------------------------------------------------------------
+# store-backed eager transport
+
+_coll_seq = defaultdict(itertools.count)  # group tag -> counter
+_p2p_seq = defaultdict(itertools.count)   # (src, dst) -> counter
+_TIMEOUT = 120.0
+
+
+def _dumps(arr) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, np.asarray(arr), allow_pickle=False)
+    return buf.getvalue()
+
+
+def _loads(b: bytes) -> np.ndarray:
+    return np.load(io.BytesIO(b), allow_pickle=False)
+
+
+def _group_info(group):
+    """(ranks list, my index, key tag) for a group or the world."""
+    if group is not None and getattr(group, "ranks", None):
+        ranks = list(group.ranks)
+        tag = "g" + "_".join(map(str, ranks))
+    else:
+        ranks = list(range(env.get_world_size()))
+        tag = "w"
+    me = env.global_rank()
+    return ranks, ranks.index(me), tag
+
+
+def _require_store():
+    store = env.get_store()
+    if store is None:
+        raise RuntimeError(
+            "eager multi-rank collectives need paddle.distributed."
+            "init_parallel_env() (TCPStore rendezvous) first")
+    return store
+
+
+def _ckey(tag, op):
+    """Sequenced key. The counter is PER GROUP TAG so subgroup collectives
+    don't desynchronize the world sequence (each group's members issue the
+    same ordered stream of collectives — the standard contract)."""
+    return f"c/{tag}/{op}/{next(_coll_seq[tag])}"
+
+
+def _gc_keys(store, key, payload_keys, n_readers):
+    """Refcounted cleanup: the last reader deletes the payload keys (the
+    C++ store keeps every SET forever otherwise — unbounded rank-0 memory
+    across a long eager loop)."""
+    if store.add(f"{key}/ack", 1) == n_readers:
+        for k in payload_keys:
+            store.delete(k)
+        store.delete(f"{key}/ack")
+
+
+def _exchange(op, arr, group):
+    """Post my payload, collect every group member's, in group-rank order.
+    All ranks must issue collectives in the same order (the standard
+    collective-call contract; the sequence number enforces pairing)."""
+    store = _require_store()
+    ranks, idx, tag = _group_info(group)
+    key = _ckey(tag, op)
+    store.set(f"{key}/{idx}", _dumps(arr))
+    out = [_loads(store.wait(f"{key}/{i}", _TIMEOUT))
+           for i in range(len(ranks))]
+    _gc_keys(store, key, [f"{key}/{i}" for i in range(len(ranks))],
+             len(ranks))
+    return out
+
+
+def _unwrap_np(tensor):
+    a = tensor._data if isinstance(tensor, Tensor) else tensor
+    return np.asarray(a)
+
+
+def _eager_multirank(group) -> bool:
+    n = group.nranks if group else env.get_world_size()
+    return n > 1
 
 
 class ReduceOp:
@@ -66,10 +156,22 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     n = group.nranks if group else env.get_world_size()
     if n <= 1:
         return Task(tensor._data if isinstance(tensor, Tensor) else tensor)
-    raise NotImplementedError(
-        "eager multi-rank all_reduce outside traced code requires "
-        "jax.distributed multi-host mode; wrap the step in shard_map/pjit "
-        "(fleet.distributed_model does this) or use world_size==1")
+    vals = _exchange("ar", _unwrap_np(tensor), group)
+    stacked = np.stack(vals)
+    if op in (ReduceOp.SUM, "sum"):
+        out = stacked.sum(0)
+    elif op in (ReduceOp.MAX, "max"):
+        out = stacked.max(0)
+    elif op in (ReduceOp.MIN, "min"):
+        out = stacked.min(0)
+    elif op in (ReduceOp.AVG, "avg"):
+        out = stacked.mean(0)
+    elif op in (ReduceOp.PROD, "prod"):
+        out = stacked.prod(0)
+    else:
+        raise ValueError(f"unknown reduce op {op}")
+    tensor._data = jnp.asarray(out.astype(_unwrap_np(tensor).dtype))
+    return Task(tensor._data)
 
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True):
@@ -86,15 +188,26 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
     if n <= 1:
         tensor_list.append(tensor)
         return Task()
-    raise NotImplementedError("eager multi-rank all_gather: use traced path")
+    vals = _exchange("ag", _unwrap_np(tensor), group)
+    tensor_list.extend(Tensor(jnp.asarray(v)) for v in vals)
+    return Task()
 
 
 def all_gather_object(object_list, obj, group=None):
+    import pickle
     n = group.nranks if group else env.get_world_size()
     if n <= 1:
         object_list.append(obj)
         return Task()
-    raise NotImplementedError
+    store = _require_store()
+    ranks, idx, tag = _group_info(group)
+    key = _ckey(tag, "ago")
+    store.set(f"{key}/{idx}", pickle.dumps(obj))
+    object_list.extend(pickle.loads(store.wait(f"{key}/{i}", _TIMEOUT))
+                       for i in range(len(ranks)))
+    _gc_keys(store, key, [f"{key}/{i}" for i in range(len(ranks))],
+             len(ranks))
+    return Task()
 
 
 def broadcast(tensor, src, group=None, sync_op=True):
@@ -112,14 +225,32 @@ def broadcast(tensor, src, group=None, sync_op=True):
     n = group.nranks if group else env.get_world_size()
     if n <= 1:
         return Task()
-    raise NotImplementedError("eager multi-rank broadcast: use traced path")
+    store = _require_store()
+    ranks, idx, tag = _group_info(group)
+    src_idx = group.get_group_rank(src) if group else src
+    key = _ckey(tag, "bc")
+    if idx == src_idx:
+        store.set(key, _dumps(_unwrap_np(tensor)))
+    tensor._data = jnp.asarray(_loads(store.wait(key, _TIMEOUT)))
+    _gc_keys(store, key, [key], len(ranks))
+    return Task(tensor._data)
 
 
 def broadcast_object_list(object_list, src=0, group=None):
+    import pickle
     n = group.nranks if group else env.get_world_size()
     if n <= 1:
         return Task()
-    raise NotImplementedError
+    store = _require_store()
+    ranks, idx, tag = _group_info(group)
+    src_idx = group.get_group_rank(src) if group else src
+    key = _ckey(tag, "bco")
+    if idx == src_idx:
+        store.set(key, pickle.dumps(list(object_list)))
+    got = pickle.loads(store.wait(key, _TIMEOUT))
+    object_list[:] = got
+    _gc_keys(store, key, [key], len(ranks))
+    return Task()
 
 
 def reduce(tensor, dst, op=ReduceOp.SUM, group=None, sync_op=True):
@@ -145,7 +276,12 @@ def reduce_scatter(tensor, tensor_list_or_input, op=ReduceOp.SUM, group=None,
     if n <= 1:
         tensor._data = inp._data if isinstance(inp, Tensor) else inp
         return Task()
-    raise NotImplementedError("eager multi-rank reduce_scatter: use traced path")
+    vals = _exchange("rs", _unwrap_np(inp), group)
+    total = np.stack(vals).sum(0)
+    ranks, idx, _ = _group_info(group)
+    chunk = total.shape[0] // len(ranks)
+    tensor._data = jnp.asarray(total[idx * chunk:(idx + 1) * chunk])
+    return Task(tensor._data)
 
 
 def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
@@ -163,7 +299,12 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     if n <= 1:
         out_tensor_list.extend(in_tensor_list)
         return Task()
-    raise NotImplementedError("eager multi-rank all_to_all: use traced path")
+    stacked = np.stack([_unwrap_np(t) for t in in_tensor_list])
+    vals = _exchange("a2a", stacked, group)
+    ranks, idx, _ = _group_info(group)
+    out_tensor_list.extend(Tensor(jnp.asarray(vals[i][idx]))
+                           for i in range(len(ranks)))
+    return Task()
 
 
 def all_to_all_single(out_tensor, in_tensor, out_split_sizes=None,
@@ -180,7 +321,12 @@ def all_to_all_single(out_tensor, in_tensor, out_split_sizes=None,
     if n <= 1:
         out_tensor._data = in_tensor._data
         return Task()
-    raise NotImplementedError
+    vals = _exchange("a2as", _unwrap_np(in_tensor), group)
+    ranks, idx, _ = _group_info(group)
+    chunk = vals[0].shape[0] // len(ranks)
+    out_tensor._data = jnp.asarray(np.concatenate(
+        [v[idx * chunk:(idx + 1) * chunk] for v in vals]))
+    return Task(out_tensor._data)
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
@@ -189,16 +335,36 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
         if tensor_list:
             tensor._data = tensor_list[0]._data
         return Task()
-    raise NotImplementedError("eager multi-rank scatter: use traced path")
+    store = _require_store()
+    ranks, idx, tag = _group_info(group)
+    src_idx = group.get_group_rank(src) if group else src
+    key = _ckey(tag, "sc")
+    if idx == src_idx:
+        for i in range(len(ranks)):
+            store.set(f"{key}/{i}", _dumps(_unwrap_np(tensor_list[i])))
+    tensor._data = jnp.asarray(_loads(store.wait(f"{key}/{idx}", _TIMEOUT)))
+    store.delete(f"{key}/{idx}")  # sole consumer of this slot
+    return Task(tensor._data)
 
 
 def scatter_object_list(out_object_list, in_object_list=None, src=0,
                         group=None):
+    import pickle
     n = group.nranks if group else env.get_world_size()
     if n <= 1:
         out_object_list.extend(in_object_list or [])
         return Task()
-    raise NotImplementedError
+    store = _require_store()
+    ranks, idx, tag = _group_info(group)
+    src_idx = group.get_group_rank(src) if group else src
+    key = _ckey(tag, "sco")
+    if idx == src_idx:
+        for i in range(len(ranks)):
+            store.set(f"{key}/{i}", pickle.dumps(in_object_list[i]))
+    out_object_list.append(pickle.loads(store.wait(f"{key}/{idx}",
+                                                   _TIMEOUT)))
+    store.delete(f"{key}/{idx}")
+    return Task()
 
 
 def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
@@ -207,23 +373,47 @@ def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
         if gather_list is not None:
             gather_list.append(tensor)
         return Task()
-    raise NotImplementedError
+    store = _require_store()
+    ranks, idx, tag = _group_info(group)
+    dst_idx = group.get_group_rank(dst) if group else dst
+    key = _ckey(tag, "ga")
+    store.set(f"{key}/{idx}", _dumps(_unwrap_np(tensor)))
+    if idx == dst_idx:
+        for i in range(len(ranks)):
+            v = _loads(store.wait(f"{key}/{i}", _TIMEOUT))
+            if gather_list is not None:
+                gather_list.append(Tensor(jnp.asarray(v)))
+            store.delete(f"{key}/{i}")
+    return Task()
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
-    """P2P send — inside shard_map this is a ppermute; eager 1-rank no-op."""
+    """P2P send. Inside shard_map this is a ppermute; eager multi-process
+    routes through the store under a per-(src,dst) sequence so repeated
+    sends pair with recvs in order."""
     if env.get_world_size() <= 1 and not _is_traced(tensor):
         return Task()
-    raise NotImplementedError(
-        "eager p2p send: use the pipeline-parallel traced path "
-        "(fleet.meta_parallel.PipelineParallel)")
+    store = env.get_store()
+    if store is None:
+        raise RuntimeError("eager p2p send needs init_parallel_env()")
+    me = env.global_rank()
+    k = next(_p2p_seq[(me, dst)])
+    store.set(f"p2p/{me}to{dst}/{k}", _dumps(_unwrap_np(tensor)))
+    return Task()
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
     if env.get_world_size() <= 1 and not _is_traced(tensor):
         return Task()
-    raise NotImplementedError(
-        "eager p2p recv: use the pipeline-parallel traced path")
+    store = env.get_store()
+    if store is None:
+        raise RuntimeError("eager p2p recv needs init_parallel_env()")
+    me = env.global_rank()
+    k = next(_p2p_seq[(src, me)])
+    tensor._data = jnp.asarray(_loads(
+        store.wait(f"p2p/{src}to{me}/{k}", _TIMEOUT)))
+    store.delete(f"p2p/{src}to{me}/{k}")
+    return Task(tensor._data)
 
 
 def isend(tensor, dst=0, group=None):
@@ -235,6 +425,12 @@ def irecv(tensor, src=0, group=None):
 
 
 def barrier(group=None):
+    store = env.get_store()
+    if store is not None and _eager_multirank(group):
+        ranks, _, tag = _group_info(group)
+        s = next(_coll_seq[tag])
+        store.barrier(f"{tag}/{s}", len(ranks), _TIMEOUT)
+        return Task()
     import jax as _jax
     (_jax.device_put(0.0) + 0).block_until_ready()
     return Task()

@@ -15,6 +15,8 @@ import jax
 
 
 _initialized = False
+_jax_distributed = False
+_store = None
 
 
 def _env_int(name, default=0):
@@ -31,7 +33,7 @@ def get_rank(group=None) -> int:
 
 
 def global_rank() -> int:
-    if _initialized:
+    if _jax_distributed:
         return jax.process_index()
     return _env_int("PADDLE_TRAINER_ID", 0)
 
@@ -39,24 +41,63 @@ def global_rank() -> int:
 def get_world_size(group=None) -> int:
     if group is not None:
         return group.nranks
-    if _initialized:
+    if _jax_distributed:
         return jax.process_count()
     return _env_int("PADDLE_TRAINERS_NUM", 1)
 
 
+def get_store():
+    """The rendezvous TCPStore (native C++ server on rank 0; see
+    paddle_tpu/native/csrc/tcp_store.cc). None in single-process mode."""
+    return _store
+
+
 def init_parallel_env():
     """paddle.distributed.init_parallel_env
-    (reference: python/paddle/distributed/parallel.py:921)."""
-    global _initialized
+    (reference: python/paddle/distributed/parallel.py:921).
+
+    Multi-process bootstrap: every rank rendezvouses through the native
+    TCPStore hosted by rank 0 (the reference's ncclUniqueId-exchange store,
+    phi/core/distributed/store/tcp_store.h). Eager `paddle.distributed.*`
+    collectives then run over the store; optionally (PADDLE_JAX_DISTRIBUTED=1)
+    jax.distributed.initialize is also called so compiled multi-host SPMD
+    sees one global device set.
+    """
+    global _initialized, _jax_distributed, _store
     if _initialized:
         return ParallelEnv()
-    endpoints = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
     n = _env_int("PADDLE_TRAINERS_NUM", 1)
     rank = _env_int("PADDLE_TRAINER_ID", 0)
-    if n > 1 and endpoints:
-        coordinator = endpoints.split(",")[0]
-        jax.distributed.initialize(
-            coordinator_address=coordinator, num_processes=n, process_id=rank)
+    if n > 1:
+        from ..native.tcp_store import TCPStore
+
+        master = os.environ.get("PADDLE_MASTER", "")
+        if not master:
+            eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+            first = eps.split(",")[0] if eps else ""
+            host, _, port = first.partition(":")
+            if not port or not int(port):
+                raise RuntimeError(
+                    "multi-process bootstrap needs PADDLE_MASTER=host:port "
+                    "(or PADDLE_TRAINER_ENDPOINTS with concrete ports) so "
+                    "every rank can find the rank-0 TCPStore; use "
+                    "`python -m paddle_tpu.distributed.launch`, which sets "
+                    "both")
+            # store lives one port above the first trainer endpoint
+            master = f"{host}:{int(port) + 1}"
+        host, _, port = master.partition(":")
+        timeout = float(os.environ.get("PADDLE_STORE_TIMEOUT", "120"))
+        _store = TCPStore(host=host or "127.0.0.1", port=int(port or 0),
+                          is_master=(rank == 0), timeout=timeout,
+                          world_size=n)
+        _store.barrier("init_parallel_env", n, timeout)
+        if os.environ.get("PADDLE_JAX_DISTRIBUTED") == "1":
+            coordinator = os.environ.get(
+                "PADDLE_JAX_COORDINATOR",
+                f"{host or '127.0.0.1'}:{int(port or 0) + 1}")
+            jax.distributed.initialize(coordinator_address=coordinator,
+                                       num_processes=n, process_id=rank)
+            _jax_distributed = True
         _initialized = True
     return ParallelEnv()
 

@@ -54,18 +54,34 @@ _BOOL_TOGGLES = [
     "fuse_grad_merge", "pipeline", "tensor_parallel", "localsgd",
     "adaptive_localsgd", "dgc", "gradient_merge", "lars", "lamb", "elastic",
     "auto", "semi_auto", "auto_search", "qat", "heter_ccl_mode", "a_sync",
-    "fp16_allreduce", "fuse_grad_size_in_MB", "last_comm_group_size_MB",
+    "fp16_allreduce", "adam_d2sum", "is_fl_ps_mode", "is_with_coordinator",
+    "cudnn_exhaustive_search", "cudnn_batchnorm_spatial_persistent",
+    "_calc_comm_same_stream", "split_data",
 ]
+
+# inert numeric/str knobs accepted with reference defaults (the full
+# property surface of distributed_strategy.py:117; XLA subsumes the
+# behavior, the names must not AttributeError — SURVEY §2.6)
+_SCALAR_DEFAULTS = {
+    "nccl_comm_num": 1,
+    "fuse_grad_size_in_MB": 32,
+    "fuse_grad_size_in_num": 8,
+    "last_comm_group_size_MB": 1,
+    "_fuse_grad_size_in_TFLOPS": 50.0,
+    "conv_workspace_size_limit": 512,
+    "hierarchical_allreduce_inter_nranks": 1,
+    "fs_client_param": None,
+    "sparse_table_configs": None,
+    "trainer_desc_configs": None,
+    "gradient_scale_configs": {"scale_strategy": "avg"},
+}
 
 
 class DistributedStrategy:
     def __init__(self):
         self.__dict__["_flags"] = {t: False for t in _BOOL_TOGGLES}
         self.__dict__["_configs"] = copy.deepcopy(_DEFAULT_CONFIGS)
-        self.__dict__["_scalars"] = {
-            "nccl_comm_num": 1,
-            "gradient_scale_configs": {"scale_strategy": "avg"},
-        }
+        self.__dict__["_scalars"] = copy.deepcopy(_SCALAR_DEFAULTS)
         # execution/build strategy accepted for compat
         self.__dict__["execution_strategy"] = None
         self.__dict__["build_strategy"] = None

@@ -1,0 +1,2 @@
+"""paddle.distributed.launch package (reference: python/paddle/distributed/launch)."""
+from .main import launch, parse_args  # noqa: F401

@@ -1,0 +1,149 @@
+"""python -m paddle_tpu.distributed.launch — multi-process trainer launcher.
+
+Reference: /root/reference/python/paddle/distributed/launch/main.py:18
+(collective controller at launch/controllers/collective.py; env contract
+from fleet/base/role_maker.py:848-972). The TPU-native launcher keeps that
+env contract verbatim:
+
+  PADDLE_TRAINER_ID        rank of this process
+  PADDLE_TRAINERS_NUM      world size
+  PADDLE_TRAINER_ENDPOINTS comma list host:port, one per rank
+  PADDLE_CURRENT_ENDPOINT  this rank's endpoint
+  PADDLE_RANK_IN_NODE      local rank
+  PADDLE_MASTER            host:port of the TCPStore rendezvous
+  TRAINING_ROLE            TRAINER
+
+Rendezvous runs over the native C++ TCPStore (rank 0 hosts it inside
+init_parallel_env). On TPU hosts one process per host is the norm (all
+local chips belong to one process); --nproc_per_node exists for CPU
+testing and host-sharded data work.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="spawn one training process per device/worker")
+    ap.add_argument("--nproc_per_node", type=int,
+                    default=int(os.environ.get("PADDLE_NPROC_PER_NODE", 1)))
+    ap.add_argument("--nnodes", type=int, default=1)
+    ap.add_argument("--node_rank", type=int, default=0)
+    ap.add_argument("--master", default=None,
+                    help="host:port of the rendezvous store (rank 0 hosts)")
+    ap.add_argument("--log_dir", default=None)
+    ap.add_argument("--devices", default=None,
+                    help="accepted for reference-CLI parity")
+    ap.add_argument("training_script")
+    ap.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return ap.parse_args(argv)
+
+
+def launch(argv=None) -> int:
+    args = parse_args(argv)
+    nproc = args.nproc_per_node
+    world = nproc * args.nnodes
+    if args.nnodes > 1:
+        # multi-node: rank 0 (node 0) hosts the store; every node must be
+        # told where it is, and must advertise a reachable address
+        if not args.master:
+            raise SystemExit(
+                "--master host:port is required when --nnodes > 1 "
+                "(node 0 hosts the rendezvous store there)")
+        host = os.environ.get("POD_IP") or socket.gethostbyname(
+            socket.gethostname())
+    else:
+        host = "127.0.0.1"
+    master = args.master or f"{host}:{_free_port()}"
+    base_port = _free_port()
+    # single-node endpoints are exact; multi-node lists this node's span
+    # (the env contract only requires PADDLE_MASTER to be globally correct)
+    endpoints = ",".join(f"{host}:{base_port + i}" for i in range(world))
+
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+
+    procs = []
+    for local in range(nproc):
+        rank = args.node_rank * nproc + local
+        env = dict(os.environ)
+        # workers resolve imports against the launch cwd (the script's own
+        # directory is what python puts on sys.path otherwise)
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in (os.getcwd(), env.get("PYTHONPATH", "")) if p)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_TRAINER_ENDPOINTS": endpoints,
+            "PADDLE_CURRENT_ENDPOINT":
+                endpoints.split(",")[rank],
+            "PADDLE_RANK_IN_NODE": str(local),
+            "PADDLE_MASTER": master,
+            "TRAINING_ROLE": "TRAINER",
+            "FLAGS_selected_tpus": str(local),
+        })
+        if args.log_dir:
+            out = open(os.path.join(args.log_dir,
+                                    f"workerlog.{rank}"), "w")
+        else:
+            out = None
+        procs.append((rank, subprocess.Popen(
+            [sys.executable, "-u", args.training_script,
+             *args.training_script_args],
+            env=env, stdout=out, stderr=subprocess.STDOUT if out else None),
+            out))
+
+    rc = 0
+    try:
+        live = {r: p for r, p, _ in procs}
+        while live:
+            for r, p in list(live.items()):
+                code = p.poll()
+                if code is None:
+                    continue
+                del live[r]
+                if code != 0:
+                    print(f"rank {r} exited with code {code}; "
+                          f"terminating peers", file=sys.stderr)
+                    rc = code
+                    for q in live.values():
+                        q.terminate()
+                    deadline = time.time() + 10
+                    for q in live.values():
+                        try:
+                            q.wait(max(0.1, deadline - time.time()))
+                        except subprocess.TimeoutExpired:
+                            q.kill()
+                    live = {}
+                    break
+            time.sleep(0.05)
+    except KeyboardInterrupt:
+        for r, p, _ in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGINT)
+        rc = 130
+    finally:
+        for _, p, out in procs:
+            if out is not None:
+                out.close()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(launch())

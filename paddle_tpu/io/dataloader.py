@@ -63,10 +63,20 @@ def _to_tensor_tree(batch, return_list=True):
 
 
 def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id,
-                 num_workers, seed):
+                 num_workers, seed, ring_name=None):
     global _worker_info
     _worker_info = _WorkerInfo(worker_id, num_workers, dataset, seed)
     np.random.seed((seed + worker_id) % (2 ** 31))
+    ring = None
+    if ring_name is not None:
+        # shared-memory batch transport (csrc/shm_ring.cc): payload rides
+        # the per-worker shm ring, the queue carries only control tuples
+        try:
+            from ..native.shm_ring import ShmRing
+            ring = ShmRing(ring_name, owner=False)
+        except Exception:  # pragma: no cover — fall back to queue payload
+            ring = None
+    import pickle
     while True:
         task = index_queue.get()
         if task is None:
@@ -75,9 +85,19 @@ def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id,
         try:
             samples = [dataset[i] for i in indices]
             data = collate_fn(samples)
+            if ring is not None:
+                payload = pickle.dumps(data, protocol=5)
+                if len(payload) <= ring.payload_capacity and \
+                        ring.push(payload):
+                    data_queue.put((batch_id, (_SHM_SENTINEL, worker_id),
+                                    None))
+                    continue
             data_queue.put((batch_id, data, None))
         except Exception:  # pragma: no cover
             data_queue.put((batch_id, None, traceback.format_exc()))
+
+
+_SHM_SENTINEL = "__shm__"
 
 
 class DataLoader:
@@ -91,6 +111,7 @@ class DataLoader:
         self.return_list = return_list
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = int(num_workers)
+        self.use_shared_memory = use_shared_memory
         self.prefetch_factor = prefetch_factor
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
@@ -135,21 +156,46 @@ class DataLoader:
             yield _to_tensor_tree(self.collate_fn(samples))
 
     def _iter_multiprocess(self):
+        import os
+        import pickle
+
         ctx = mp.get_context("fork")
         index_queues = []
         data_queue = ctx.Queue()
         workers = []
+        rings = []
         seed = np.random.randint(0, 2 ** 31)
+        use_shm = self.use_shared_memory
+        if use_shm:
+            from ..native.shm_ring import ShmRing, available
+            use_shm = available()
         for wid in range(self.num_workers):
             iq = ctx.Queue()
+            ring_name = None
+            ring = None
+            if use_shm:
+                ring_name = f"/pt_dl_{os.getpid()}_{id(self)}_{wid}"
+                try:
+                    ring = ShmRing(ring_name, owner=True)
+                except Exception:
+                    ring_name, ring = None, None
             w = ctx.Process(
                 target=_worker_loop,
                 args=(self.dataset, iq, data_queue, self.collate_fn, wid,
-                      self.num_workers, seed),
+                      self.num_workers, seed, ring_name),
                 daemon=True)
             w.start()
             workers.append(w)
             index_queues.append(iq)
+            rings.append(ring)
+        self._shm_batches = 0
+
+        def _resolve(data):
+            if isinstance(data, tuple) and len(data) == 2 and \
+                    data[0] == _SHM_SENTINEL:
+                self._shm_batches += 1
+                return pickle.loads(rings[data[1]].pop(timeout_ms=60000))
+            return data
 
         try:
             sampler_iter = iter(self.batch_sampler)
@@ -179,6 +225,10 @@ class DataLoader:
                     timeout=self.timeout if self.timeout else None)
                 if err is not None:
                     raise RuntimeError(f"DataLoader worker failed:\n{err}")
+                # pop the shm payload NOW (in control-message order) — the
+                # per-worker ring is FIFO, so deferring pops to yield order
+                # would pair payloads with the wrong batch ids
+                data = _resolve(data)
                 try:
                     indices = next(sampler_iter)
                     index_queues[sent % self.num_workers].put(
@@ -195,3 +245,7 @@ class DataLoader:
                 w.join(timeout=1)
                 if w.is_alive():
                     w.terminate()
+            for r in rings:
+                if r is not None:
+                    r.close()
+                    r.free()

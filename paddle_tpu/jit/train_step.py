@@ -73,7 +73,7 @@ class TrainStep:
 
     def __init__(self, model, loss_fn: Callable, optimizer,
                  donate: bool = True, amp_level: Optional[str] = None,
-                 amp_dtype: str = "bfloat16"):
+                 amp_dtype: str = "bfloat16", scaler=None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -81,6 +81,15 @@ class TrainStep:
         self._donate = donate
         self._amp_level = amp_level  # None | "O1" | "O2"
         self._amp_dtype = amp_dtype
+        # fp16 dynamic loss scaling fused into the compiled step: scale,
+        # found_inf, skip-update branch and the incr/decr schedule are all
+        # in-graph (reference: GradScaler found_inf protocol,
+        # /root/reference/python/paddle/amp/grad_scaler.py:602). The python
+        # GradScaler object mirrors the device state (its counters become
+        # jax scalars; don't call scaler.update() yourself — the step does).
+        self._scaler = scaler if (scaler is not None
+                                  and scaler.is_enable()) else None
+        self._scaler_state = None
         self._named_params = dict(model.named_parameters())
         self._trainable = {n: p for n, p in self._named_params.items()
                            if not p.stop_gradient}
@@ -111,8 +120,16 @@ class TrainStep:
             "learning_rate"] for n, p in self._trainable.items()}
 
         amp_level, amp_dtype = self._amp_level, self._amp_dtype
+        scaler = self._scaler
+        if scaler is not None:
+            sc_cfg = dict(incr_ratio=float(scaler._incr_ratio),
+                          decr_ratio=float(scaler._decr_ratio),
+                          incr_every=int(scaler._incr_every),
+                          decr_every=int(scaler._decr_every),
+                          dynamic=bool(scaler._dynamic))
 
-        def pure_step(params, buffers, opt_state, lr, t, key, *batch):
+        def pure_step(params, buffers, opt_state, sc_state, lr, t, key,
+                      *batch):
             def loss_of(train_params):
                 all_params = {**params, **train_params}
                 from ..core import autograd as ag
@@ -133,7 +150,21 @@ class TrainStep:
                 return l_arr.astype(jnp.float32)
 
             train_params = {n: params[n] for n in trainable_names}
-            loss, grads = jax.value_and_grad(loss_of)(train_params)
+            if scaler is not None:
+                scale = sc_state["scale"]
+                loss_s, grads = jax.value_and_grad(
+                    lambda tp: loss_of(tp) * scale)(train_params)
+                loss = loss_s / scale
+                inv = (1.0 / scale)
+                grads = {k: (g.astype(jnp.float32) * inv).astype(g.dtype)
+                         for k, g in grads.items()}
+                found_inf = functools.reduce(
+                    jnp.logical_or,
+                    [jnp.any(~jnp.isfinite(g.astype(jnp.float32)))
+                     for g in grads.values()])
+            else:
+                loss, grads = jax.value_and_grad(loss_of)(train_params)
+                found_inf = None
             grads = _functional_clip(grad_clip, grads)
             new_params = dict(params)
             new_state = {}
@@ -147,9 +178,32 @@ class TrainStep:
                 p_new, s_new = update_rule(
                     p_arr, g, lr * lr_mult[n], t,
                     jnp.asarray(wd_by_name[n], jnp.float32), opt_state[n])
+                if found_inf is not None:
+                    # skip-update branch: overflowed steps leave params and
+                    # optimizer accumulators untouched
+                    p_new = jnp.where(found_inf, p_arr, p_new)
+                    s_new = {an: jnp.where(found_inf, opt_state[n][an], v)
+                             for an, v in s_new.items()}
                 new_params[n] = p_new
                 new_state[n] = s_new
-            return loss, new_params, new_state
+            if scaler is None:
+                return loss, new_params, new_state, sc_state
+            # dynamic loss-scale schedule, in-graph
+            good, bad = sc_state["good"], sc_state["bad"]
+            if sc_cfg["dynamic"]:
+                good = jnp.where(found_inf, 0, good + 1)
+                bad = jnp.where(found_inf, bad + 1, 0)
+                dec = bad >= sc_cfg["decr_every"]
+                inc = good >= sc_cfg["incr_every"]
+                scale = jnp.where(
+                    dec, jnp.maximum(scale * sc_cfg["decr_ratio"], 1.0),
+                    scale)
+                scale = jnp.where(inc, scale * sc_cfg["incr_ratio"], scale)
+                bad = jnp.where(dec, 0, bad)
+                good = jnp.where(inc, 0, good)
+            new_sc = {"scale": scale, "good": good, "bad": bad,
+                      "found_inf": found_inf}
+            return loss, new_params, new_state, new_sc
 
         donate = (0, 2) if self._donate else ()
         mesh = get_global_mesh()
@@ -222,6 +276,25 @@ class TrainStep:
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         t = jnp.asarray(self.optimizer._step_count, jnp.int32)
         key = random_mod.next_key()
+        if self._scaler is not None:
+            epoch = getattr(self._scaler, "_epoch", 0)
+            if self._scaler_state is None or \
+                    getattr(self, "_scaler_epoch", None) != epoch:
+                # (re)seed from the python GradScaler — including after a
+                # load_state_dict (checkpoint resume bumps _epoch)
+                self._scaler_epoch = epoch
+                self._scaler_state = {
+                    "scale": jnp.asarray(float(self._scaler._scale),
+                                         jnp.float32),
+                    "good": jnp.asarray(int(self._scaler._good_steps),
+                                        jnp.int32),
+                    "bad": jnp.asarray(int(self._scaler._bad_steps),
+                                       jnp.int32),
+                }
+            sc_state = dict(self._scaler_state)
+            sc_state.pop("found_inf", None)
+        else:
+            sc_state = {}
         # paddle dtype defaulting (python floats → default float dtype), not
         # jnp.asarray's — which under x64 would yield f64/i64 inputs
         arrays = [b._data if isinstance(b, Tensor) else Tensor(b)._data
@@ -233,11 +306,19 @@ class TrainStep:
                       if getattr(a, "ndim", 0) >= 1
                       and a.shape[0] % nshards == 0 else a
                       for a in arrays]
-        loss, new_params, new_state = self._compiled(
-            params, buffers, opt_state, lr, t, key, *arrays)
+        loss, new_params, new_state, new_sc = self._compiled(
+            params, buffers, opt_state, sc_state, lr, t, key, *arrays)
         for n, p in self._named_params.items():
             p._data = new_params[n]
         self._writeback_opt_state(new_state)
+        if self._scaler is not None:
+            self._scaler_state = new_sc
+            # mirror device state into the python GradScaler (lazy: these
+            # stay jax scalars until someone reads state_dict / get_*)
+            self._scaler._scale = new_sc["scale"]
+            self._scaler._good_steps = new_sc["good"]
+            self._scaler._bad_steps = new_sc["bad"]
+            self._scaler._found_inf = new_sc["found_inf"]
         if getattr(self, "_mesh", None) is not None:
             # outputs are already correctly sharded; next step reuses them
             # without re-placement (their old donated inputs are dropped)

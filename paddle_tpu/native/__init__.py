@@ -99,6 +99,8 @@ def _configure(l):
     l.tcp_store_client_close.argtypes = [c.c_void_p]
     l.tcp_store_set.restype = c.c_int
     l.tcp_store_set.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p, c.c_int]
+    l.tcp_store_delete.restype = c.c_int
+    l.tcp_store_delete.argtypes = [c.c_void_p, c.c_char_p]
     l.tcp_store_get.restype = c.c_int
     l.tcp_store_get.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p, c.c_int]
     l.tcp_store_add.restype = c.c_longlong

@@ -73,6 +73,8 @@ struct Server {
   int listen_fd = -1;
   std::thread accept_thread;
   std::vector<std::thread> workers;
+  std::vector<int> client_fds;
+  std::mutex fds_mu;
   std::atomic<bool> stop{false};
   int port = 0;
 
@@ -160,6 +162,17 @@ struct Server {
       }
     }
     ::close(fd);
+    // forget this fd so Server::shutdown() can't shutdown() a reused
+    // descriptor number belonging to an unrelated socket
+    {
+      std::lock_guard<std::mutex> g(fds_mu);
+      for (auto it = client_fds.begin(); it != client_fds.end(); ++it) {
+        if (*it == fd) {
+          client_fds.erase(it);
+          break;
+        }
+      }
+    }
   }
 
   int start(int want_port) {
@@ -184,6 +197,10 @@ struct Server {
         if (fd < 0) break;
         int one2 = 1;
         ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one2, sizeof(one2));
+        {
+          std::lock_guard<std::mutex> g(fds_mu);
+          client_fds.push_back(fd);
+        }
         workers.emplace_back([this, fd] { handle(fd); });
       }
     });
@@ -197,6 +214,13 @@ struct Server {
       ::close(listen_fd);
     }
     if (accept_thread.joinable()) accept_thread.join();
+    // unblock handler threads still parked in read_full() on live client
+    // connections (e.g. rank 0 stopping while peers stay connected) —
+    // without this the joins below hang until every client disconnects
+    {
+      std::lock_guard<std::mutex> g(fds_mu);
+      for (int fd : client_fds) ::shutdown(fd, SHUT_RDWR);
+    }
     for (auto& t : workers)
       if (t.joinable()) t.join();
   }
@@ -289,15 +313,20 @@ int tcp_store_set(void* h, const char* key, const char* val, int vlen) {
   return static_cast<Client*>(h)->request(0, key, std::string(val, vlen));
 }
 
-// Returns value length, or -1 missing / -2 io error. Copy into buf (cap).
+// Returns the FULL value length (even when > cap, so callers can detect
+// truncation and refetch with a bigger buffer), or -1 missing / -2 io
+// error. Copies min(len, cap) bytes into buf.
 int tcp_store_get(void* h, const char* key, char* buf, int cap) {
   auto* c = static_cast<Client*>(h);
   int st = c->request(1, key, "");
   if (st != 0) return st == 1 ? -1 : -2;
   int n = static_cast<int>(c->last.size());
-  if (n > cap) n = cap;
-  std::memcpy(buf, c->last.data(), n);
+  std::memcpy(buf, c->last.data(), n > cap ? cap : n);
   return n;
+}
+
+int tcp_store_delete(void* h, const char* key) {
+  return static_cast<Client*>(h)->request(5, key, "");
 }
 
 long long tcp_store_add(void* h, const char* key, long long delta) {
@@ -307,14 +336,14 @@ long long tcp_store_add(void* h, const char* key, long long delta) {
   return std::strtoll(c->last.c_str(), nullptr, 10);
 }
 
+// Same truncation contract as tcp_store_get: returns the full length.
 int tcp_store_wait(void* h, const char* key, int timeout_ms, char* buf,
                    int cap) {
   auto* c = static_cast<Client*>(h);
   int st = c->request(3, key, std::to_string(timeout_ms));
   if (st != 0) return st == 1 ? -1 : -2;
   int n = static_cast<int>(c->last.size());
-  if (n > cap) n = cap;
-  std::memcpy(buf, c->last.data(), n);
+  std::memcpy(buf, c->last.data(), n > cap ? cap : n);
   return n;
 }
 

@@ -1,0 +1,64 @@
+"""ShmRing — ctypes wrapper over the native POSIX shared-memory ring
+(csrc/shm_ring.cc). Single-producer/single-consumer per ring; the
+DataLoader gives each worker its own ring (reference analog:
+fluid/memory/allocation/mmap_allocator.cc + imperative/data_loader.cc
+shared-memory batch transport)."""
+from __future__ import annotations
+
+import ctypes
+
+from . import lib
+
+
+class ShmRing:
+    def __init__(self, name: str, owner: bool, n_slots: int = 4,
+                 slot_bytes: int = 8 << 20):
+        l = lib()
+        if l is None:
+            raise RuntimeError("native library unavailable")
+        self._l = l
+        self._h = l.shm_ring_open(name.encode(), 1 if owner else 0,
+                                  n_slots, slot_bytes)
+        if not self._h:
+            raise RuntimeError(f"shm_ring_open({name!r}) failed")
+        self.name = name
+        self.slot_bytes = slot_bytes
+
+    @property
+    def payload_capacity(self) -> int:
+        return self.slot_bytes - 8
+
+    def push(self, data: bytes) -> bool:
+        """False if the payload exceeds the slot capacity (caller falls
+        back to another transport); raises if the ring is closed."""
+        rc = self._l.shm_ring_push(self._h, data, len(data))
+        if rc == -2:
+            return False
+        if rc == -1:
+            raise BrokenPipeError("shm ring closed")
+        return True
+
+    def pop(self, timeout_ms: int = -1) -> bytes:
+        cap = self.slot_bytes
+        buf = ctypes.create_string_buffer(cap)
+        n = self._l.shm_ring_pop(self._h, buf, cap, timeout_ms)
+        if n == -1:
+            raise BrokenPipeError("shm ring closed")
+        if n == -3:
+            raise TimeoutError("shm ring pop timed out")
+        if n < 0:
+            raise RuntimeError(f"shm_ring_pop error {n}")
+        return buf.raw[:n]
+
+    def close(self):
+        if self._h:
+            self._l.shm_ring_close(self._h)
+
+    def free(self):
+        if self._h:
+            self._l.shm_ring_free(self._h)
+            self._h = None
+
+
+def available() -> bool:
+    return lib() is not None

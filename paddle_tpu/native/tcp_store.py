@@ -70,19 +70,40 @@ class TCPStore:
         else:
             _py_request(self._sock, 0, key, value)
 
+    _CAP0 = 1 << 20
+
+    def _fetch(self, fn, key, *pre_args):
+        """Call a native get/wait entry point, growing the buffer when the
+        value exceeds it (the C side returns the FULL length)."""
+        cap = self._CAP0
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = fn(self._h, key.encode(), *pre_args, buf, cap)
+            if n >= 0 and n <= cap:
+                return buf.raw[:n]
+            if n > cap:
+                cap = n
+                continue
+            return n  # negative status
+
     def get(self, key: str) -> bytes:
         if self._l is not None:
-            buf = ctypes.create_string_buffer(1 << 20)
-            n = self._l.tcp_store_get(self._h, key.encode(), buf, len(buf))
-            if n == -1:
+            out = self._fetch(self._l.tcp_store_get, key)
+            if out == -1:
                 raise KeyError(key)
-            if n < 0:
+            if isinstance(out, int):
                 raise RuntimeError("TCPStore.get io error")
-            return buf.raw[:n]
+            return out
         st, val = _py_request(self._sock, 1, key, b"")
         if st != 0:
             raise KeyError(key)
         return val
+
+    def delete(self, key: str):
+        if self._l is not None:
+            self._l.tcp_store_delete(self._h, key.encode())
+        else:
+            _py_request(self._sock, 5, key, b"")
 
     def add(self, key: str, delta: int = 1) -> int:
         if self._l is not None:
@@ -92,14 +113,13 @@ class TCPStore:
 
     def wait(self, key: str, timeout: float = 30.0) -> bytes:
         if self._l is not None:
-            buf = ctypes.create_string_buffer(1 << 20)
-            n = self._l.tcp_store_wait(self._h, key.encode(),
-                                       int(timeout * 1000), buf, len(buf))
-            if n == -1:
+            out = self._fetch(self._l.tcp_store_wait, key,
+                              int(timeout * 1000))
+            if out == -1:
                 raise TimeoutError(f"TCPStore.wait({key}) timed out")
-            if n < 0:
+            if isinstance(out, int):
                 raise RuntimeError("TCPStore.wait io error")
-            return buf.raw[:n]
+            return out
         st, val = _py_request(self._sock, 3, key,
                               str(int(timeout * 1000)).encode())
         if st != 0:
@@ -204,6 +224,10 @@ class _PyServer:
                         self.data[key] = str(cur).encode()
                         self.cv.notify_all()
                         reply = (0, self.data[key])
+                elif op == 5:
+                    with self.cv:
+                        self.data.pop(key, None)
+                    reply = (0, b"")
                 elif op == 3:
                     tmo = int(val) / 1000.0
                     with self.cv:

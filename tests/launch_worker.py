@@ -1,0 +1,75 @@
+"""Worker script for test_launch.py: exercises the full eager multi-process
+collective surface over the TCPStore rendezvous (launch -> init ->
+collectives -> barrier -> shutdown). Writes '<out_dir>/ok.<rank>' on
+success; any assert kills the job (the launcher propagates the rc)."""
+import os
+import sys
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+
+out_dir = sys.argv[1]
+
+env = dist.init_parallel_env()
+rank = dist.get_rank()
+world = dist.get_world_size()
+assert world == 2, world
+
+# all_reduce
+t = paddle.to_tensor(np.full((4,), float(rank + 1), np.float32))
+dist.all_reduce(t)
+np.testing.assert_allclose(np.asarray(t.numpy()), np.full((4,), 3.0))
+
+# all_gather
+parts = []
+dist.all_gather(parts, paddle.to_tensor(
+    np.full((2,), float(rank), np.float32)))
+assert len(parts) == 2
+np.testing.assert_allclose(np.asarray(parts[0].numpy()), [0.0, 0.0])
+np.testing.assert_allclose(np.asarray(parts[1].numpy()), [1.0, 1.0])
+
+# broadcast from rank 1
+b = paddle.to_tensor(np.full((3,), float(rank * 10), np.float32))
+dist.broadcast(b, src=1)
+np.testing.assert_allclose(np.asarray(b.numpy()), np.full((3,), 10.0))
+
+# reduce_scatter: world-summed input split across ranks
+inp = paddle.to_tensor(np.arange(4, dtype=np.float32) * (rank + 1))
+out = paddle.to_tensor(np.zeros((2,), np.float32))
+dist.reduce_scatter(out, inp)
+expect = (np.arange(4, dtype=np.float32) * 3)[rank * 2:(rank + 1) * 2]
+np.testing.assert_allclose(np.asarray(out.numpy()), expect)
+
+# all_to_all
+outs = []
+ins = [paddle.to_tensor(np.full((2,), float(rank * 2 + j), np.float32))
+       for j in range(2)]
+dist.all_to_all(outs, ins)
+np.testing.assert_allclose(np.asarray(outs[0].numpy()),
+                           np.full((2,), float(rank)))
+np.testing.assert_allclose(np.asarray(outs[1].numpy()),
+                           np.full((2,), float(2 + rank)))
+
+# p2p send/recv: 0 -> 1
+if rank == 0:
+    dist.send(paddle.to_tensor(np.array([42.0], np.float32)), dst=1)
+else:
+    r = paddle.to_tensor(np.zeros((1,), np.float32))
+    dist.recv(r, src=0)
+    np.testing.assert_allclose(np.asarray(r.numpy()), [42.0])
+
+# object collectives
+objs = []
+dist.all_gather_object(objs, {"rank": rank})
+assert objs == [{"rank": 0}, {"rank": 1}]
+
+dist.barrier()
+
+with open(os.path.join(out_dir, f"ok.{rank}"), "w") as f:
+    f.write("ok")
+print(f"rank {rank}: all eager collectives OK")

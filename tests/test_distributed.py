@@ -125,3 +125,50 @@ class TestAutoParallel:
         shard_shapes = {s.data.shape for s in sharded._data.addressable_shards}
         assert shard_shapes == {(4, 8)}
         np.testing.assert_allclose(np.asarray(sharded._data), x.numpy())
+
+
+from paddle_tpu.distributed import fleet
+
+
+class TestDistributedStrategySurface:
+    """The reference's full toggle surface must be accepted (no-op where
+    XLA subsumes) — distributed_strategy.py:117, SURVEY §2.6."""
+
+    REFERENCE_PROPS = [
+        "a_sync", "a_sync_configs", "adam_d2sum", "adaptive_localsgd",
+        "adaptive_localsgd_configs", "amp", "amp_configs", "asp", "auto",
+        "auto_search", "build_strategy", "conv_workspace_size_limit",
+        "cudnn_batchnorm_spatial_persistent", "cudnn_exhaustive_search",
+        "dgc", "dgc_configs", "elastic", "execution_strategy",
+        "find_unused_parameters", "fp16_allreduce", "fs_client_param",
+        "fuse_all_reduce_ops", "fuse_grad_merge", "fuse_grad_size_in_MB",
+        "fuse_grad_size_in_num", "gradient_merge", "gradient_merge_configs",
+        "gradient_scale_configs", "heter_ccl_mode",
+        "hierarchical_allreduce_inter_nranks", "hybrid_configs",
+        "is_fl_ps_mode", "is_with_coordinator", "lamb", "lamb_configs",
+        "lars", "lars_configs", "last_comm_group_size_MB", "localsgd",
+        "localsgd_configs", "nccl_comm_num", "pipeline", "pipeline_configs",
+        "qat", "qat_configs", "recompute", "recompute_configs", "semi_auto",
+        "sharding", "sharding_configs", "sparse_table_configs", "split_data",
+        "sync_batch_norm", "sync_nccl_allreduce", "tensor_parallel",
+        "tensor_parallel_configs", "trainer_desc_configs",
+        "use_hierarchical_allreduce", "without_graph_optimization",
+    ]
+
+    def test_every_reference_property_readable(self):
+        s = fleet.DistributedStrategy()
+        for name in self.REFERENCE_PROPS:
+            getattr(s, name)  # must not AttributeError
+
+    def test_bool_toggles_settable(self):
+        s = fleet.DistributedStrategy()
+        s.recompute = True
+        s.lars = 1
+        assert s.recompute is True
+        assert s.lars is True
+
+    def test_configs_merge(self):
+        s = fleet.DistributedStrategy()
+        s.amp_configs = {"init_loss_scaling": 1024.0}
+        assert s.amp_configs["init_loss_scaling"] == 1024.0
+        assert "incr_ratio" in s.amp_configs  # defaults survive

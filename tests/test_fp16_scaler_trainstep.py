@@ -1,0 +1,147 @@
+"""fp16 + dynamic loss scaling fused into the compiled TrainStep.
+
+Reference protocol: GradScaler found_inf / skip-update / incr-decr schedule
+(/root/reference/python/paddle/amp/grad_scaler.py:602); here all of it is
+in-graph (one XLA program per step).
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.amp import GradScaler
+from paddle_tpu.jit import TrainStep
+
+B, D = 8, 16
+
+
+class Net(paddle.nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.l1 = paddle.nn.Linear(D, 32)
+        self.l2 = paddle.nn.Linear(32, 1)
+
+    def forward(self, x):
+        return self.l2(paddle.nn.functional.relu(self.l1(x)))
+
+
+def _data(seed=0):
+    rng = np.random.RandomState(seed)
+    return (paddle.to_tensor(rng.randn(B, D).astype("float32")),
+            paddle.to_tensor(rng.randn(B, 1).astype("float32")))
+
+
+def _mse(o, y):
+    return ((o - y) ** 2).mean()
+
+
+def _params(net):
+    return {n: np.asarray(p.numpy()) for n, p in net.named_parameters()}
+
+
+def test_fp16_scaler_trains():
+    paddle.seed(0)
+    net = Net()
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+    scaler = GradScaler(init_loss_scaling=2.0 ** 10)
+    step = TrainStep(net, _mse, opt, amp_level="O1", amp_dtype="float16",
+                     scaler=scaler)
+    x, y = _data()
+    losses = [float(step(x, y).numpy()) for _ in range(20)]
+    assert losses[-1] < losses[0]
+    for p in net.parameters():
+        assert np.isfinite(np.asarray(p.numpy())).all()
+    # no overflow happened; scale unchanged (incr_every default 1000)
+    assert scaler.state_dict()["scale"] == 2.0 ** 10
+
+
+def test_overflow_skips_update_and_decreases_scale():
+    paddle.seed(0)
+    net = Net()
+    opt = paddle.optimizer.SGD(learning_rate=1e-2,
+                               parameters=net.parameters())
+    # scale so large the f32 scaled loss overflows -> inf grads on step 1
+    scaler = GradScaler(init_loss_scaling=1e38, decr_every_n_nan_or_inf=1,
+                        decr_ratio=0.5)
+    step = TrainStep(net, _mse, opt, amp_level="O1", amp_dtype="float16",
+                     scaler=scaler)
+    before = _params(net)
+    x, y = _data(1)
+    step(x, y)
+    after = _params(net)
+    for n in before:
+        np.testing.assert_array_equal(before[n], after[n], err_msg=n)
+    sd = scaler.state_dict()
+    assert np.isclose(sd["scale"], 0.5e38, rtol=1e-6)  # f32 rounding
+    assert bool(np.asarray(scaler._found_inf))
+    # keep stepping: scale keeps halving (fp16 cotangents overflow until
+    # it drops below ~2**16) and then updates resume
+    for _ in range(200):
+        step(x, y)
+        if any((_params(net)[n] != before[n]).any() for n in before):
+            break
+    else:
+        raise AssertionError("scale never recovered; updates never applied")
+    assert scaler.state_dict()["scale"] < 1e5
+
+
+def test_scale_increases_after_incr_every_good_steps():
+    paddle.seed(0)
+    net = Net()
+    opt = paddle.optimizer.SGD(learning_rate=1e-3,
+                               parameters=net.parameters())
+    scaler = GradScaler(init_loss_scaling=8.0, incr_every_n_steps=2,
+                        incr_ratio=2.0)
+    step = TrainStep(net, _mse, opt, amp_level="O1", amp_dtype="float16",
+                     scaler=scaler)
+    x, y = _data(2)
+    step(x, y)
+    assert scaler.state_dict()["scale"] == 8.0
+    step(x, y)
+    assert scaler.state_dict()["scale"] == 16.0
+    step(x, y)
+    step(x, y)
+    assert scaler.state_dict()["scale"] == 32.0
+
+
+def test_scaler_matches_unscaled_when_no_overflow():
+    import jax
+    jax.config.update("jax_default_matmul_precision", "highest")
+    x, y = _data(3)
+
+    def run(scaler):
+        paddle.seed(0)
+        net = Net()
+        opt = paddle.optimizer.SGD(learning_rate=1e-2,
+                                   parameters=net.parameters())
+        step = TrainStep(net, _mse, opt, scaler=scaler)  # no AMP: math equal
+        return [float(step(x, y).numpy()) for _ in range(5)]
+
+    plain = run(None)
+    scaled = run(GradScaler(init_loss_scaling=2.0 ** 8))
+    np.testing.assert_allclose(plain, scaled, rtol=1e-5, atol=1e-6)
+
+
+def test_disabled_scaler_is_inert():
+    paddle.seed(0)
+    net = Net()
+    opt = paddle.optimizer.SGD(learning_rate=1e-2,
+                               parameters=net.parameters())
+    step = TrainStep(net, _mse, opt, scaler=GradScaler(enable=False))
+    x, y = _data(4)
+    losses = [float(step(x, y).numpy()) for _ in range(3)]
+    assert losses[-1] < losses[0]
+
+
+def test_scaler_load_state_dict_takes_effect_mid_training():
+    paddle.seed(0)
+    net = Net()
+    opt = paddle.optimizer.SGD(learning_rate=1e-2,
+                               parameters=net.parameters())
+    scaler = GradScaler(init_loss_scaling=256.0)
+    step = TrainStep(net, _mse, opt, scaler=scaler)
+    x, y = _data(5)
+    step(x, y)
+    scaler.load_state_dict({"scale": 1024.0, "incr_count": 0,
+                            "decr_count": 0})
+    step(x, y)
+    assert scaler.state_dict()["scale"] == 1024.0

@@ -1,0 +1,84 @@
+"""Multi-process launch + TCPStore rendezvous + eager collectives.
+
+Mirrors the reference's multiprocess-on-localhost distributed test strategy
+(test_dist_base.py:943: launch trainer subprocesses, env-var rendezvous,
+assert results) — SURVEY §4.4.
+"""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_launch_two_ranks_eager_collectives(tmp_path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PADDLE_TRAINER_ID", None)
+    env.pop("PADDLE_TRAINERS_NUM", None)
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2",
+         os.path.join(REPO, "tests", "launch_worker.py"), str(tmp_path)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    assert (tmp_path / "ok.0").exists()
+    assert (tmp_path / "ok.1").exists()
+
+
+def test_launch_propagates_failure(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import sys; sys.exit(3)\n")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", str(bad)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 3
+
+
+def test_dataloader_shm_transport():
+    """Multiprocess DataLoader batches ride the native shm ring and match
+    the single-process loader exactly."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.vision.datasets import FakeMNIST
+
+    ds = FakeMNIST(n=64)
+    single = [(np.asarray(x.numpy()), np.asarray(y.numpy()))
+              for x, y in DataLoader(ds, batch_size=16, shuffle=False)]
+    dl = DataLoader(ds, batch_size=16, shuffle=False, num_workers=2,
+                    use_shared_memory=True)
+    multi = [(np.asarray(x.numpy()), np.asarray(y.numpy()))
+             for x, y in dl]
+    assert len(single) == len(multi) == 4
+    for (sx, sy), (mx, my) in zip(single, multi):
+        np.testing.assert_array_equal(sx, mx)
+        np.testing.assert_array_equal(sy, my)
+    from paddle_tpu.native.shm_ring import available
+    if available():
+        assert dl._shm_batches == 4  # payloads actually used the ring
+
+
+def test_dataloader_shm_large_batch_falls_back():
+    """Batches beyond the slot capacity fall back to the queue transport."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.io import DataLoader
+
+    class Big:
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, i):
+            return np.full((3, 1024, 1024), i, np.float32)  # 12 MB sample
+
+    dl = DataLoader(Big(), batch_size=1, shuffle=False, num_workers=1,
+                    use_shared_memory=True)
+    out = [np.asarray(x.numpy()) if hasattr(x, "numpy") else np.asarray(x)
+           for x in dl]
+    assert len(out) == 4
+    for i, a in enumerate(out):
+        assert float(a.reshape(-1)[0]) == float(i)
