@@ -54,6 +54,7 @@ from .tensor import creation as _creation  # noqa: F401
 from . import amp  # noqa: F401
 from . import autograd  # noqa: F401
 from . import device  # noqa: F401
+from . import distribution  # noqa: F401
 from . import distributed  # noqa: F401
 from . import framework  # noqa: F401
 from . import incubate  # noqa: F401
@@ -64,6 +65,8 @@ from . import metric  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import profiler  # noqa: F401
+from . import quantization  # noqa: F401
+from . import sparse  # noqa: F401
 from . import static  # noqa: F401
 from . import vision  # noqa: F401
 

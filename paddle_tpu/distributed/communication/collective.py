@@ -58,6 +58,10 @@ def _group_info(group):
         ranks = list(range(env.get_world_size()))
         tag = "w"
     me = env.global_rank()
+    if me not in ranks:
+        raise RuntimeError(
+            f"rank {me} called a collective on a group it is not a member "
+            f"of (group ranks: {ranks})")
     return ranks, ranks.index(me), tag
 
 
@@ -112,6 +116,32 @@ def _eager_multirank(group) -> bool:
     return n > 1
 
 
+def _np_reduce(stacked, op):
+    if op in (ReduceOp.SUM, "sum"):
+        return stacked.sum(0)
+    if op in (ReduceOp.MAX, "max"):
+        return stacked.max(0)
+    if op in (ReduceOp.MIN, "min"):
+        return stacked.min(0)
+    if op in (ReduceOp.AVG, "avg"):
+        return stacked.mean(0)
+    if op in (ReduceOp.PROD, "prod"):
+        return stacked.prod(0)
+    raise ValueError(f"unknown reduce op {op}")
+
+
+def _root_index(group, root):
+    """Group-local index of a root rank, validated (Group.get_group_rank
+    returns -1 for non-members, which would otherwise hang every member
+    in store.wait for the full timeout)."""
+    idx = group.get_group_rank(root) if group else root
+    n = group.nranks if group else env.get_world_size()
+    if idx is None or idx < 0 or idx >= n:
+        raise ValueError(
+            f"root rank {root} is not a member of the group")
+    return idx
+
+
 class ReduceOp:
     SUM = "sum"
     MAX = "max"
@@ -157,19 +187,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     if n <= 1:
         return Task(tensor._data if isinstance(tensor, Tensor) else tensor)
     vals = _exchange("ar", _unwrap_np(tensor), group)
-    stacked = np.stack(vals)
-    if op in (ReduceOp.SUM, "sum"):
-        out = stacked.sum(0)
-    elif op in (ReduceOp.MAX, "max"):
-        out = stacked.max(0)
-    elif op in (ReduceOp.MIN, "min"):
-        out = stacked.min(0)
-    elif op in (ReduceOp.AVG, "avg"):
-        out = stacked.mean(0)
-    elif op in (ReduceOp.PROD, "prod"):
-        out = stacked.prod(0)
-    else:
-        raise ValueError(f"unknown reduce op {op}")
+    out = _np_reduce(np.stack(vals), op)
     tensor._data = jnp.asarray(out.astype(_unwrap_np(tensor).dtype))
     return Task(tensor._data)
 
@@ -227,7 +245,7 @@ def broadcast(tensor, src, group=None, sync_op=True):
         return Task()
     store = _require_store()
     ranks, idx, tag = _group_info(group)
-    src_idx = group.get_group_rank(src) if group else src
+    src_idx = _root_index(group, src)
     key = _ckey(tag, "bc")
     if idx == src_idx:
         store.set(key, _dumps(_unwrap_np(tensor)))
@@ -243,7 +261,7 @@ def broadcast_object_list(object_list, src=0, group=None):
         return Task()
     store = _require_store()
     ranks, idx, tag = _group_info(group)
-    src_idx = group.get_group_rank(src) if group else src
+    src_idx = _root_index(group, src)
     key = _ckey(tag, "bco")
     if idx == src_idx:
         store.set(key, pickle.dumps(list(object_list)))
@@ -277,7 +295,7 @@ def reduce_scatter(tensor, tensor_list_or_input, op=ReduceOp.SUM, group=None,
         tensor._data = inp._data if isinstance(inp, Tensor) else inp
         return Task()
     vals = _exchange("rs", _unwrap_np(inp), group)
-    total = np.stack(vals).sum(0)
+    total = _np_reduce(np.stack(vals), op)
     ranks, idx, _ = _group_info(group)
     chunk = total.shape[0] // len(ranks)
     tensor._data = jnp.asarray(total[idx * chunk:(idx + 1) * chunk])
@@ -321,8 +339,17 @@ def all_to_all_single(out_tensor, in_tensor, out_split_sizes=None,
     if n <= 1:
         out_tensor._data = in_tensor._data
         return Task()
-    vals = _exchange("a2as", _unwrap_np(in_tensor), group)
+    if out_split_sizes or in_split_sizes:
+        raise NotImplementedError(
+            "eager all_to_all_single with explicit split sizes is not "
+            "supported; equal splits only")
+    arr = _unwrap_np(in_tensor)
     ranks, idx, _ = _group_info(group)
+    if arr.shape[0] % len(ranks) != 0:
+        raise ValueError(
+            f"all_to_all_single dim 0 ({arr.shape[0]}) must divide the "
+            f"group size ({len(ranks)})")
+    vals = _exchange("a2as", arr, group)
     chunk = vals[0].shape[0] // len(ranks)
     out_tensor._data = jnp.asarray(np.concatenate(
         [v[idx * chunk:(idx + 1) * chunk] for v in vals]))
@@ -337,7 +364,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
         return Task()
     store = _require_store()
     ranks, idx, tag = _group_info(group)
-    src_idx = group.get_group_rank(src) if group else src
+    src_idx = _root_index(group, src)
     key = _ckey(tag, "sc")
     if idx == src_idx:
         for i in range(len(ranks)):
@@ -356,7 +383,7 @@ def scatter_object_list(out_object_list, in_object_list=None, src=0,
         return Task()
     store = _require_store()
     ranks, idx, tag = _group_info(group)
-    src_idx = group.get_group_rank(src) if group else src
+    src_idx = _root_index(group, src)
     key = _ckey(tag, "sco")
     if idx == src_idx:
         for i in range(len(ranks)):
@@ -375,7 +402,7 @@ def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
         return Task()
     store = _require_store()
     ranks, idx, tag = _group_info(group)
-    dst_idx = group.get_group_rank(dst) if group else dst
+    dst_idx = _root_index(group, dst)
     key = _ckey(tag, "ga")
     store.set(f"{key}/{idx}", _dumps(_unwrap_np(tensor)))
     if idx == dst_idx:
@@ -429,7 +456,15 @@ def barrier(group=None):
     if store is not None and _eager_multirank(group):
         ranks, _, tag = _group_info(group)
         s = next(_coll_seq[tag])
-        store.barrier(f"{tag}/{s}", len(ranks), _TIMEOUT)
+        name = f"__barrier/{tag}/{s}"
+        world = len(ranks)
+        if store.add(name, 1) == world:
+            store.set(f"{name}/done", b"1")
+        store.wait(f"{name}/done", _TIMEOUT)
+        # the last rank to pass the barrier garbage-collects its keys
+        if store.add(f"{name}/ack", 1) == world:
+            for k in (name, f"{name}/done", f"{name}/ack"):
+                store.delete(k)
         return Task()
     import jax as _jax
     (_jax.device_put(0.0) + 0).block_until_ready()

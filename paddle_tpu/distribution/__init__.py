@@ -1,0 +1,703 @@
+"""paddle.distribution — probability distributions + KL registry.
+
+Reference: /root/reference/python/paddle/distribution/ (distribution.py
+Distribution base; normal.py, uniform.py, categorical.py, beta.py,
+dirichlet.py, laplace.py, gumbel.py, lognormal.py, multinomial.py,
+independent.py, transformed_distribution.py, transform.py, kl.py).
+
+TPU-native: every method is a pure jax computation over Tensor data;
+sampling threads the framework's global PRNG key (framework.random), so
+seeded runs are reproducible and traced sampling works under jit.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply_op, wrap
+from ..core.tensor import Tensor
+from ..framework import random as random_mod
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+    "Beta", "Dirichlet", "Exponential", "Laplace", "Gumbel", "LogNormal",
+    "Multinomial", "Independent", "TransformedDistribution",
+    "Transform", "AffineTransform", "ExpTransform", "SigmoidTransform",
+    "AbsTransform", "TanhTransform", "kl_divergence", "register_kl",
+]
+
+
+def _arr(x, dtype=jnp.float32):
+    if isinstance(x, Tensor):
+        a = x._data
+        return a.astype(dtype) if a.dtype != dtype else a
+    return jnp.asarray(x, dtype)
+
+
+def _key():
+    return random_mod.next_key()
+
+
+class Distribution:
+    """Base (reference distribution.py:40): sample/rsample/log_prob/prob/
+    entropy/mean/variance + batch broadcasting."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return wrap(jnp.exp(self.log_prob(value)._data))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+    def _extend(self, shape):
+        return tuple(shape) + self._batch_shape + self._event_shape
+
+
+class Normal(Distribution):
+    """reference normal.py:33."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return wrap(jnp.broadcast_to(self.loc, self._batch_shape))
+
+    @property
+    def variance(self):
+        return wrap(jnp.broadcast_to(jnp.square(self.scale),
+                                     self._batch_shape))
+
+    @property
+    def stddev(self):
+        return wrap(jnp.broadcast_to(self.scale, self._batch_shape))
+
+    def sample(self, shape=(), seed=0):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        eps = jax.random.normal(_key(), self._extend(shape))
+        return wrap(self.loc + eps * self.scale)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        var = jnp.square(self.scale)
+        return wrap(-jnp.square(v - self.loc) / (2 * var)
+                    - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        out = 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+        return wrap(jnp.broadcast_to(out, self._batch_shape))
+
+
+class Uniform(Distribution):
+    """reference uniform.py:32."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low)
+        self.high = _arr(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape,
+                                              self.high.shape))
+
+    @property
+    def mean(self):
+        return wrap((self.low + self.high) / 2)
+
+    @property
+    def variance(self):
+        return wrap(jnp.square(self.high - self.low) / 12)
+
+    def sample(self, shape=(), seed=0):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(_key(), self._extend(shape))
+        return wrap(self.low + u * (self.high - self.low))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return wrap(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return wrap(jnp.log(self.high - self.low))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("pass exactly one of probs/logits")
+        if probs is not None:
+            self.probs = _arr(probs)
+            self.logits = jnp.log(self.probs) - jnp.log1p(-self.probs)
+        else:
+            self.logits = _arr(logits)
+            self.probs = jax.nn.sigmoid(self.logits)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return wrap(self.probs)
+
+    @property
+    def variance(self):
+        return wrap(self.probs * (1 - self.probs))
+
+    def sample(self, shape=(), seed=0):
+        u = jax.random.uniform(_key(), self._extend(shape))
+        return wrap((u < self.probs).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return wrap(v * jax.nn.log_sigmoid(self.logits)
+                    + (1 - v) * jax.nn.log_sigmoid(-self.logits))
+
+    def entropy(self):
+        p = self.probs
+        return wrap(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Categorical(Distribution):
+    """reference categorical.py:30 (logits parameterization)."""
+
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is not None:
+            self.logits = _arr(logits)
+            self._log_p = jax.nn.log_softmax(self.logits, axis=-1)
+        else:
+            p = _arr(probs)
+            p = p / jnp.sum(p, axis=-1, keepdims=True)
+            self._log_p = jnp.log(p)
+            self.logits = self._log_p
+        self.probs = jnp.exp(self._log_p)
+        super().__init__(self.probs.shape[:-1],
+                         ())
+
+    @property
+    def n_categories(self):
+        return self.probs.shape[-1]
+
+    def sample(self, shape=(), seed=0):
+        full = tuple(shape) + self._batch_shape
+        return wrap(jax.random.categorical(
+            _key(), jnp.broadcast_to(
+                self.logits, full + (self.n_categories,))))
+
+    def log_prob(self, value):
+        idx = _arr(value, jnp.int32)
+        lp = jnp.broadcast_to(self._log_p,
+                              idx.shape + self._log_p.shape[-1:])
+        return wrap(jnp.take_along_axis(lp, idx[..., None], axis=-1)[..., 0])
+
+    def probs_of(self, value):
+        return wrap(jnp.exp(self.log_prob(value)._data))
+
+    def entropy(self):
+        return wrap(-jnp.sum(self.probs * self._log_p, axis=-1))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _arr(alpha)
+        self.beta = _arr(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    @property
+    def mean(self):
+        return wrap(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return wrap(self.alpha * self.beta / (jnp.square(s) * (s + 1)))
+
+    def sample(self, shape=(), seed=0):
+        return wrap(jax.random.beta(_key(), self.alpha, self.beta,
+                                    self._extend(shape)))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        from jax.scipy.special import betaln
+        return wrap((self.alpha - 1) * jnp.log(v)
+                    + (self.beta - 1) * jnp.log1p(-v)
+                    - betaln(self.alpha, self.beta))
+
+    def entropy(self):
+        from jax.scipy.special import betaln, digamma
+        a, b = self.alpha, self.beta
+        return wrap(betaln(a, b) - (a - 1) * digamma(a)
+                    - (b - 1) * digamma(b)
+                    + (a + b - 2) * digamma(a + b))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _arr(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        c = self.concentration
+        return wrap(c / jnp.sum(c, -1, keepdims=True))
+
+    @property
+    def variance(self):
+        c = self.concentration
+        c0 = jnp.sum(c, -1, keepdims=True)
+        m = c / c0
+        return wrap(m * (1 - m) / (c0 + 1))
+
+    def sample(self, shape=(), seed=0):
+        return wrap(jax.random.dirichlet(_key(), self.concentration,
+                                         tuple(shape) + self._batch_shape))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = _arr(value)
+        c = self.concentration
+        norm = jnp.sum(gammaln(c), -1) - gammaln(jnp.sum(c, -1))
+        return wrap(jnp.sum((c - 1) * jnp.log(v), -1) - norm)
+
+    def entropy(self):
+        from jax.scipy.special import digamma, gammaln
+        c = self.concentration
+        c0 = jnp.sum(c, -1)
+        k = c.shape[-1]
+        lnB = jnp.sum(gammaln(c), -1) - gammaln(c0)
+        return wrap(lnB + (c0 - k) * digamma(c0)
+                    - jnp.sum((c - 1) * digamma(c), -1))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _arr(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return wrap(1.0 / self.rate)
+
+    @property
+    def variance(self):
+        return wrap(1.0 / jnp.square(self.rate))
+
+    def sample(self, shape=(), seed=0):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        e = jax.random.exponential(_key(), self._extend(shape))
+        return wrap(e / self.rate)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return wrap(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        return wrap(1.0 - jnp.log(self.rate))
+
+
+class Laplace(Distribution):
+    """reference laplace.py:25."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return wrap(jnp.broadcast_to(self.loc, self._batch_shape))
+
+    @property
+    def variance(self):
+        return wrap(jnp.broadcast_to(2 * jnp.square(self.scale),
+                                     self._batch_shape))
+
+    def sample(self, shape=(), seed=0):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(_key(), self._extend(shape),
+                               minval=-0.5, maxval=0.5)
+        return wrap(self.loc - self.scale * jnp.sign(u)
+                    * jnp.log1p(-2 * jnp.abs(u)))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return wrap(-jnp.abs(v - self.loc) / self.scale
+                    - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        out = 1 + jnp.log(2 * self.scale)
+        return wrap(jnp.broadcast_to(out, self._batch_shape))
+
+
+class Gumbel(Distribution):
+    """reference gumbel.py:26."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    _EULER = 0.5772156649015329
+
+    @property
+    def mean(self):
+        return wrap(jnp.broadcast_to(self.loc + self._EULER * self.scale,
+                                     self._batch_shape))
+
+    @property
+    def variance(self):
+        return wrap(jnp.broadcast_to(
+            (math.pi ** 2 / 6) * jnp.square(self.scale),
+            self._batch_shape))
+
+    def sample(self, shape=(), seed=0):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        g = jax.random.gumbel(_key(), self._extend(shape))
+        return wrap(self.loc + g * self.scale)
+
+    def log_prob(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        return wrap(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    def entropy(self):
+        out = jnp.log(self.scale) + 1 + self._EULER
+        return wrap(jnp.broadcast_to(out, self._batch_shape))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        p = _arr(probs)
+        self.probs = p / jnp.sum(p, -1, keepdims=True)
+        super().__init__(self.probs.shape[:-1], self.probs.shape[-1:])
+
+    @property
+    def mean(self):
+        return wrap(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return wrap(self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=(), seed=0):
+        full = tuple(shape) + self._batch_shape
+        logits = jnp.broadcast_to(jnp.log(self.probs),
+                                  full + self.probs.shape[-1:])
+        draws = jax.random.categorical(
+            _key(), logits[..., None, :], axis=-1,
+            shape=full + (self.total_count,))
+        counts = jax.nn.one_hot(draws, self.probs.shape[-1]).sum(-2)
+        return wrap(counts)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = _arr(value)
+        return wrap(gammaln(jnp.asarray(self.total_count + 1.0))
+                    - jnp.sum(gammaln(v + 1), -1)
+                    + jnp.sum(v * jnp.log(self.probs), -1))
+
+
+class Independent(Distribution):
+    """Treat the rightmost batch dims as event dims (reference
+    independent.py:24)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self._r = int(reinterpreted_batch_rank)
+        bs = base.batch_shape
+        super().__init__(bs[:len(bs) - self._r],
+                         bs[len(bs) - self._r:] + base.event_shape)
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=(), seed=0):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)._data
+        return wrap(jnp.sum(lp, axis=tuple(range(-self._r, 0))))
+
+    def entropy(self):
+        e = self.base.entropy()._data
+        return wrap(jnp.sum(e, axis=tuple(range(-self._r, 0))))
+
+
+# ---------------------------------------------------------------- transforms
+
+class Transform:
+    """Bijector (reference transform.py:47): forward/inverse +
+    log-det-Jacobian."""
+
+    def forward(self, x):
+        return wrap(self._forward(_arr(x)))
+
+    def inverse(self, y):
+        return wrap(self._inverse(_arr(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return wrap(self._fldj(_arr(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        return wrap(-self._fldj(self._inverse(_arr(y))))
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _fldj(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        return jax.nn.log_sigmoid(x) + jax.nn.log_sigmoid(-x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _fldj(self, x):
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class AbsTransform(Transform):
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+    def _fldj(self, x):
+        return jnp.zeros_like(x)
+
+
+class TransformedDistribution(Distribution):
+    """reference transformed_distribution.py:23."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = list(transforms)
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=(), seed=0):
+        x = self.base.sample(shape)._data
+        for t in self.transforms:
+            x = t._forward(x)
+        return wrap(x)
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)._data
+        for t in self.transforms:
+            x = t._forward(x)
+        return wrap(x)
+
+    def log_prob(self, value):
+        y = _arr(value)
+        lp = jnp.zeros(jnp.shape(y), jnp.float32)
+        for t in reversed(self.transforms):
+            x = t._inverse(y)
+            lp = lp - t._fldj(x)
+            y = x
+        return wrap(lp + self.base.log_prob(wrap(y))._data)
+
+
+class _LogNormal(TransformedDistribution):
+    """reference lognormal.py:25 — exp-transformed Normal."""
+
+    def __init__(self, loc, scale, name=None):
+        base = Normal(loc, scale)
+        super().__init__(base, [ExpTransform()])
+        self.loc = base.loc
+        self.scale = base.scale
+
+    @property
+    def mean(self):
+        return wrap(jnp.exp(self.loc + jnp.square(self.scale) / 2))
+
+    @property
+    def variance(self):
+        s2 = jnp.square(self.scale)
+        return wrap((jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2))
+
+    def entropy(self):
+        return wrap(self.loc + 0.5 + 0.5 * math.log(2 * math.pi)
+                    + jnp.log(self.scale))
+
+
+LogNormal = _LogNormal
+
+
+# ---------------------------------------------------------------- KL registry
+
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    """Decorator registering a KL(p||q) rule (reference kl.py:45)."""
+
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p, q):
+    """reference kl.py:27 — dispatch on most-derived registered match."""
+    matches = [(pc, qc) for (pc, qc) in _KL_REGISTRY
+               if isinstance(p, pc) and isinstance(q, qc)]
+    if not matches:
+        raise NotImplementedError(
+            f"no KL rule for ({type(p).__name__}, {type(q).__name__})")
+
+    def depth(pair):
+        pc, qc = pair
+        return (type(p).__mro__.index(pc) + type(q).__mro__.index(qc))
+
+    pc, qc = min(matches, key=depth)
+    return _KL_REGISTRY[(pc, qc)](p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    var_ratio = jnp.square(p.scale / q.scale)
+    t1 = jnp.square((p.loc - q.loc) / q.scale)
+    return wrap(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    inside = (q.low <= p.low) & (p.high <= q.high)
+    kl = jnp.log((q.high - q.low) / (p.high - p.low))
+    return wrap(jnp.where(inside, kl, jnp.inf))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bern_bern(p, q):
+    a = p.probs
+    return wrap(a * (jnp.log(a) - jnp.log(q.probs))
+                + (1 - a) * (jnp.log1p(-a) - jnp.log1p(-q.probs)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_cat_cat(p, q):
+    return wrap(jnp.sum(p.probs * (p._log_p - q._log_p), -1))
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    from jax.scipy.special import betaln, digamma
+    a1, b1, a2, b2 = p.alpha, p.beta, q.alpha, q.beta
+    return wrap(betaln(a2, b2) - betaln(a1, b1)
+                + (a1 - a2) * digamma(a1) + (b1 - b2) * digamma(b1)
+                + (a2 - a1 + b2 - b1) * digamma(a1 + b1))
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dir_dir(p, q):
+    from jax.scipy.special import digamma, gammaln
+    c1, c2 = p.concentration, q.concentration
+    s1 = jnp.sum(c1, -1)
+    t1 = gammaln(s1) - jnp.sum(gammaln(c1), -1)
+    t2 = gammaln(jnp.sum(c2, -1)) - jnp.sum(gammaln(c2), -1)
+    return wrap(t1 - t2 + jnp.sum(
+        (c1 - c2) * (digamma(c1) - digamma(s1)[..., None]), -1))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exp_exp(p, q):
+    r = q.rate / p.rate
+    return wrap(jnp.log(p.rate) - jnp.log(q.rate) + r - 1)
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p, q):
+    scale_ratio = p.scale / q.scale
+    loc_diff = jnp.abs(p.loc - q.loc) / q.scale
+    return wrap(-jnp.log(scale_ratio) - 1 + loc_diff
+                + scale_ratio * jnp.exp(-loc_diff / scale_ratio))

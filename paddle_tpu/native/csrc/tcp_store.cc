@@ -161,9 +161,10 @@ struct Server {
           reply(fd, 1, "");
       }
     }
-    ::close(fd);
-    // forget this fd so Server::shutdown() can't shutdown() a reused
-    // descriptor number belonging to an unrelated socket
+    // forget this fd BEFORE closing it: after close the kernel can hand
+    // the same fd number to a new accept(), and an erase-by-value would
+    // then remove the new connection's entry (leaving shutdown() blind
+    // to it) or shutdown() an unrelated descriptor
     {
       std::lock_guard<std::mutex> g(fds_mu);
       for (auto it = client_fds.begin(); it != client_fds.end(); ++it) {
@@ -173,6 +174,7 @@ struct Server {
         }
       }
     }
+    ::close(fd);
   }
 
   int start(int want_port) {

@@ -1,0 +1,237 @@
+"""paddle.quantization — PTQ observers + QAT fake-quant.
+
+Reference: /root/reference/python/paddle/quantization/ (config.py
+QuantConfig, ptq.py PTQ, qat.py QAT, observers/abs_max.py,
+quanters/abs_max.py FakeQuanterWithAbsMaxObserver, wrapper.py).
+
+TPU-native: fake-quant is a pure jax function with a straight-through
+estimator (x + stop_gradient(q(x) - x)), so QAT trains through the
+rounding inside compiled TrainSteps; PTQ observers collect absmax
+statistics eagerly and `convert` bakes scales into quant/dequant pairs.
+Simulated int8 (symmetric, per-tensor) — the XLA graph stays in float,
+matching the reference's fake-quant semantics.
+"""
+from __future__ import annotations
+
+import types
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..nn.layer.common import Linear
+from ..nn.layer.conv import Conv2D
+
+__all__ = ["QuantConfig", "PTQ", "QAT", "AbsmaxObserver",
+           "FakeQuanterWithAbsMaxObserver", "quanters", "observers"]
+
+
+def _fake_quant(x, scale, bits=8):
+    """Symmetric fake quantization with straight-through gradient."""
+    qmax = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax) * s / qmax
+    import jax
+    return x + jax.lax.stop_gradient(q - x)
+
+
+class AbsmaxObserver(Layer):
+    """PTQ observer (reference observers/abs_max.py:30): tracks the running
+    max(|x|) over calibration batches; scale() = absmax."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self._absmax = 0.0
+        self._bits = quant_bits
+
+    def forward(self, x):
+        a = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        self._absmax = max(self._absmax, float(jnp.max(jnp.abs(a))))
+        return x
+
+    def scale(self):
+        return self._absmax
+
+    def quant_bits(self):
+        return self._bits
+
+    def _instance(self, layer):
+        return AbsmaxObserver(self._bits)
+
+
+class FakeQuanterWithAbsMaxObserver(Layer):
+    """QAT quanter (reference quanters/abs_max.py:37): moving-average
+    absmax scale + fake quant with STE."""
+
+    def __init__(self, moving_rate=0.9, quant_bits=8):
+        super().__init__()
+        self._rate = moving_rate
+        self._bits = quant_bits
+        self._scale = None
+
+    def forward(self, x):
+        a = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        cur = float(jnp.max(jnp.abs(a)))
+        if self._scale is None:
+            self._scale = cur
+        else:
+            self._scale = self._rate * self._scale + (1 - self._rate) * cur
+        scale = self._scale
+
+        return apply_op("fake_quant",
+                        lambda arr: _fake_quant(arr, jnp.asarray(
+                            scale, jnp.float32), self._bits), x)
+
+    def scale(self):
+        return self._scale
+
+    def _instance(self, layer):
+        return FakeQuanterWithAbsMaxObserver(self._rate, self._bits)
+
+
+class QuantConfig:
+    """reference config.py:48 — maps layers/types/names to
+    (activation, weight) quanter factories."""
+
+    def __init__(self, activation=None, weight=None):
+        self._global = (activation, weight)
+        self._by_layer = {}
+        self._by_type = {}
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        for l in (layer if isinstance(layer, (list, tuple)) else [layer]):
+            self._by_layer[id(l)] = (activation, weight)
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types_ = (layer_type if isinstance(layer_type, (list, tuple))
+                  else [layer_type])
+        for t in types_:
+            self._by_type[t] = (activation, weight)
+
+    def _config_for(self, layer):
+        if id(layer) in self._by_layer:
+            return self._by_layer[id(layer)]
+        for t, cfg in self._by_type.items():
+            if isinstance(layer, t):
+                return cfg
+        if isinstance(layer, (Linear, Conv2D)) and any(self._global):
+            return self._global
+        return None
+
+
+class _QuantedLayer(Layer):
+    """Wrapper executing weight/activation quanters around the wrapped
+    layer's forward (reference wrapper.py)."""
+
+    def __init__(self, inner, activation_quanter, weight_quanter):
+        super().__init__()
+        self._inner = inner
+        self._act_q = activation_quanter
+        self._w_q = weight_quanter
+
+    def forward(self, x):
+        if self._act_q is not None:
+            x = self._act_q(x)
+        if self._w_q is not None and hasattr(self._inner, "weight"):
+            w = self._inner.weight
+            orig = w._data
+            qw = self._w_q(w)
+            w._data = qw._data if isinstance(qw, Tensor) else qw
+            try:
+                return self._inner(x)
+            finally:
+                w._data = orig
+        return self._inner(x)
+
+    # expose wrapped params so optimizers keep training them
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self.__dict__["_sub_layers"]["_inner"], name)
+
+
+def _walk_and_wrap(model, config, make):
+    wrapped = 0
+
+    def visit(layer):
+        nonlocal wrapped
+        for name, child in list(layer._sub_layers.items()):
+            cfg = config._config_for(child)
+            if cfg is not None and not isinstance(child, _QuantedLayer):
+                aq = cfg[0]._instance(child) if cfg[0] is not None else None
+                wq = cfg[1]._instance(child) if cfg[1] is not None else None
+                layer._sub_layers[name] = make(child, aq, wq)
+                wrapped += 1
+            else:
+                visit(child)
+    visit(model)
+    return wrapped
+
+
+class QAT:
+    """Quantization-aware training (reference qat.py:28)."""
+
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model, inplace=True):
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+        _walk_and_wrap(model, self._config, _QuantedLayer)
+        return model
+
+
+class PTQ:
+    """Post-training quantization (reference ptq.py:28): quantize() wraps
+    with observers; run calibration batches; convert() replaces observers
+    with fixed-scale fake-quant."""
+
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model, inplace=True):
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+        _walk_and_wrap(model, self._config, _QuantedLayer)
+        return model
+
+    def convert(self, model, inplace=True):
+        def visit(layer):
+            for name, child in list(layer._sub_layers.items()):
+                if isinstance(child, _QuantedLayer):
+                    for qn in ("_act_q", "_w_q"):
+                        q = child._sub_layers.get(qn)
+                        if isinstance(q, AbsmaxObserver):
+                            child._sub_layers[qn] = _FixedScaleQuant(
+                                q.scale(), q.quant_bits())
+                else:
+                    visit(child)
+        visit(model)
+        return model
+
+
+class _FixedScaleQuant(Layer):
+    def __init__(self, scale, bits):
+        super().__init__()
+        self._scale = float(scale)
+        self._bits = bits
+
+    def forward(self, x):
+        s = self._scale
+        b = self._bits
+        return apply_op("quant_dequant",
+                       lambda a: _fake_quant(a, jnp.asarray(s, jnp.float32),
+                                             b), x)
+
+    def scale(self):
+        return self._scale
+
+
+quanters = types.SimpleNamespace(
+    FakeQuanterWithAbsMaxObserver=FakeQuanterWithAbsMaxObserver)
+observers = types.SimpleNamespace(AbsmaxObserver=AbsmaxObserver)

@@ -1,0 +1,331 @@
+"""paddle.sparse — COO/CSR sparse tensors + sparse ops.
+
+Reference: /root/reference/python/paddle/sparse/ (creation.py
+sparse_coo_tensor/sparse_csr_tensor, unary.py, binary.py matmul/add/...,
+nn/ sparse ReLU & attention), backed there by phi/kernels/sparse C++/CUDA.
+
+TPU-native: SparseCooTensor wraps jax.experimental.sparse.BCOO (the
+XLA-lowerable sparse format — gathers/scatters compile onto the TPU);
+CSR is kept as an index-format view that converts through COO. Dense
+bridges (`to_dense`) are exact; elementwise unary ops act on stored
+values only (preserving the sparsity pattern), matching the reference's
+sparse-kernel semantics.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+from ..core.dispatch import wrap
+
+__all__ = [
+    "sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+    "SparseCsrTensor", "is_sparse", "is_sparse_coo", "is_sparse_csr",
+    "add", "subtract", "multiply", "divide", "matmul", "masked_matmul",
+    "sin", "tan", "asin", "atan", "sinh", "tanh", "asinh", "atanh",
+    "sqrt", "square", "log1p", "abs", "pow", "neg", "cast", "expm1",
+    "relu", "transpose", "sum",
+]
+
+
+def _coerce(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x)
+
+
+class SparseCooTensor:
+    """COO sparse tensor over BCOO. `indices` is [ndim, nnz] (the reference
+    layout, creation.py:33); BCOO stores [nnz, ndim] — transposed at the
+    boundary."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._bcoo = bcoo
+
+    # -- reference surface
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    @property
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def indices(self):
+        return Tensor(self._bcoo.indices.T)
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense())
+
+    def to_sparse_coo(self, sparse_dim=None):
+        return self
+
+    def to_sparse_csr(self):
+        return SparseCsrTensor._from_coo(self)
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def coalesce(self):
+        return SparseCooTensor(self._bcoo.sum_duplicates())
+
+    def _map_values(self, fn, dtype=None):
+        data = fn(self._bcoo.data)
+        return SparseCooTensor(jsparse.BCOO(
+            (data, self._bcoo.indices), shape=self._bcoo.shape))
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    """CSR view (reference creation.py:160): crows/cols/values for 2-D
+    (or batched 2-D) tensors; computation routes through the COO/BCOO
+    form (CSR↔COO conversion is exact)."""
+
+    def __init__(self, crows, cols, values, shape):
+        self._crows = jnp.asarray(_coerce(crows), jnp.int32)
+        self._cols = jnp.asarray(_coerce(cols), jnp.int32)
+        self._values = _coerce(values)
+        self._shape = tuple(int(s) for s in shape)
+        if len(self._shape) != 2:
+            raise ValueError(
+                f"SparseCsrTensor supports 2-D shapes (got {shape}); use "
+                f"COO for higher rank")
+
+    @classmethod
+    def _from_coo(cls, coo: SparseCooTensor):
+        b = coo.coalesce()._bcoo
+        rows = b.indices[:, 0]
+        order = jnp.argsort(rows, stable=True)
+        rows = rows[order]
+        cols = b.indices[order, 1]
+        vals = b.data[order]
+        n_rows = b.shape[0]
+        counts = jnp.bincount(rows, length=n_rows)
+        crows = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                 jnp.cumsum(counts).astype(jnp.int32)])
+        return cls(crows, cols, vals, b.shape)
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def nnz(self):
+        return int(self._values.shape[0])
+
+    def crows(self):
+        return Tensor(self._crows)
+
+    def cols(self):
+        return Tensor(self._cols)
+
+    def values(self):
+        return Tensor(self._values)
+
+    def to_sparse_coo(self, sparse_dim=None):
+        counts = self._crows[1:] - self._crows[:-1]
+        rows = jnp.repeat(jnp.arange(self._shape[0]), counts,
+                          total_repeat_length=self._values.shape[0])
+        idx = jnp.stack([rows.astype(jnp.int32), self._cols], axis=1)
+        return SparseCooTensor(jsparse.BCOO((self._values, idx),
+                                            shape=self._shape))
+
+    def to_dense(self):
+        return self.to_sparse_coo().to_dense()
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    """reference creation.py:33."""
+    idx = jnp.asarray(_coerce(indices), jnp.int32)
+    vals = _coerce(values)
+    if dtype is not None:
+        from ..framework.dtype import to_jax_dtype
+        vals = vals.astype(to_jax_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in np.asarray(idx.max(axis=1)))
+        shape = shape + vals.shape[1:]
+    return SparseCooTensor(jsparse.BCOO((vals, idx.T),
+                                        shape=tuple(shape)))
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True):
+    vals = _coerce(values)
+    if dtype is not None:
+        from ..framework.dtype import to_jax_dtype
+        vals = vals.astype(to_jax_dtype(dtype))
+    return SparseCsrTensor(crows, cols, vals, shape)
+
+
+def is_sparse(x):
+    return isinstance(x, (SparseCooTensor, SparseCsrTensor))
+
+
+def is_sparse_coo(x):
+    return isinstance(x, SparseCooTensor)
+
+
+def is_sparse_csr(x):
+    return isinstance(x, SparseCsrTensor)
+
+
+def _as_coo(x):
+    return x.to_sparse_coo() if isinstance(x, SparseCsrTensor) else x
+
+
+# ---------------------------------------------------------------- unary
+
+def _unary(name, fn):
+    def op(x, name_=None):
+        if is_sparse(x):
+            was_csr = is_sparse_csr(x)
+            out = _as_coo(x)._map_values(fn)
+            return out.to_sparse_csr() if was_csr else out
+        return wrap(fn(_coerce(x)))
+
+    op.__name__ = name
+    return op
+
+
+sin = _unary("sin", jnp.sin)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+atanh = _unary("atanh", jnp.arctanh)
+sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
+log1p = _unary("log1p", jnp.log1p)
+abs = _unary("abs", jnp.abs)  # noqa: A001 — paddle API name
+expm1 = _unary("expm1", jnp.expm1)
+neg = _unary("neg", jnp.negative)
+relu = _unary("relu", jax.nn.relu)
+
+
+def pow(x, factor, name=None):  # noqa: A001
+    return _unary("pow", lambda a: jnp.power(a, factor))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    from ..framework.dtype import to_jax_dtype
+    coo = _as_coo(x)
+    data = coo._bcoo.data
+    idx = coo._bcoo.indices
+    if value_dtype is not None:
+        data = data.astype(to_jax_dtype(value_dtype))
+    if index_dtype is not None:
+        idx = idx.astype(to_jax_dtype(index_dtype))
+    out = SparseCooTensor(jsparse.BCOO((data, idx), shape=coo._bcoo.shape))
+    return out.to_sparse_csr() if is_sparse_csr(x) else out
+
+
+# ---------------------------------------------------------------- binary
+
+def _binary_coo(x, y, fn):
+    xb = _as_coo(x)._bcoo
+    if is_sparse(y):
+        # same-pattern fast path, else dense bridge (exact)
+        yb = _as_coo(y)._bcoo
+        if xb.indices.shape == yb.indices.shape and bool(
+                jnp.all(xb.indices == yb.indices)):
+            return SparseCooTensor(jsparse.BCOO(
+                (fn(xb.data, yb.data), xb.indices), shape=xb.shape))
+        dense = fn(xb.todense(), yb.todense())
+        return SparseCooTensor(jsparse.bcoo_fromdense(dense))
+    return wrap(fn(xb.todense(), _coerce(y)))
+
+
+def add(x, y, name=None):
+    return _binary_coo(x, y, jnp.add)
+
+
+def subtract(x, y, name=None):
+    return _binary_coo(x, y, jnp.subtract)
+
+
+def multiply(x, y, name=None):
+    return _binary_coo(x, y, jnp.multiply)
+
+
+def divide(x, y, name=None):
+    return _binary_coo(x, y, jnp.divide)
+
+
+def matmul(x, y, name=None):
+    """sparse @ dense (reference binary.py matmul): BCOO dot_general —
+    compiles to XLA gather/segment-sum on TPU."""
+    if not is_sparse(x):
+        raise ValueError("sparse.matmul expects a sparse lhs")
+    xb = _as_coo(x)._bcoo
+    yv = _coerce(y if not is_sparse(y) else y.to_dense())
+    out = jsparse.bcoo_dot_general(
+        xb, yv, dimension_numbers=(((len(xb.shape) - 1,), (0,)), ((), ())))
+    return wrap(out)
+
+
+def masked_matmul(x, y, mask, name=None):
+    """dense @ dense evaluated only at `mask`'s nonzero positions
+    (reference binary.py masked_matmul)."""
+    xd = _coerce(x)
+    yd = _coerce(y)
+    mb = _as_coo(mask)._bcoo
+    rows = mb.indices[:, 0]
+    cols = mb.indices[:, 1]
+    vals = jnp.einsum("nk,nk->n", xd[rows, :], yd[:, cols].T)
+    out = SparseCooTensor(jsparse.BCOO((vals, mb.indices), shape=mb.shape))
+    return out.to_sparse_csr() if is_sparse_csr(mask) else out
+
+
+def transpose(x, perm, name=None):
+    coo = _as_coo(x)._bcoo
+    idx = coo.indices[:, jnp.asarray(perm)]
+    shape = tuple(coo.shape[p] for p in perm)
+    out = SparseCooTensor(jsparse.BCOO((coo.data, idx), shape=shape))
+    return out.to_sparse_csr() if is_sparse_csr(x) else out
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    d = _as_coo(x)._bcoo.todense()
+    return wrap(jnp.sum(d, axis=axis, keepdims=keepdim))
+
+
+from . import nn  # noqa: E402,F401

@@ -1,0 +1,44 @@
+"""paddle.sparse.nn — sparse layers (reference python/paddle/sparse/nn/:
+ReLU layer + functional attention)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import types
+
+from ..core.dispatch import wrap
+from ..nn.layer.layers import Layer
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        from . import relu
+        return relu(x)
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Sparse-masked attention (reference nn/functional/transformer.py):
+    softmax over scores only at the mask's nonzero positions. The dense
+    compute path is used (scores masked to -inf) — on TPU the fused dense
+    form IS the fast path; the sparse mask defines semantics."""
+    from . import _as_coo, is_sparse
+    q = query._data if hasattr(query, "_data") else jnp.asarray(query)
+    k = key._data if hasattr(key, "_data") else jnp.asarray(key)
+    v = value._data if hasattr(value, "_data") else jnp.asarray(value)
+    d = q.shape[-1]
+    scores = jnp.einsum("...qd,...kd->...qk", q, k) / jnp.sqrt(
+        jnp.asarray(d, q.dtype))
+    if is_sparse(sparse_mask):
+        mask = _as_coo(sparse_mask)._bcoo.todense() != 0
+    else:
+        mask = jnp.asarray(sparse_mask._data if hasattr(sparse_mask, "_data")
+                           else sparse_mask) != 0
+    scores = jnp.where(mask, scores, jnp.asarray(-1e30, scores.dtype))
+    p = jax.nn.softmax(scores, axis=-1)
+    p = jnp.where(mask, p, 0)
+    return wrap(jnp.einsum("...qk,...kd->...qd", p, v))
+
+
+functional = types.SimpleNamespace(attention=attention,
+                                   relu=lambda x: ReLU()(x))

@@ -9,6 +9,7 @@ from ..core.tensor import Tensor
 from .program import (  # noqa: F401
     Executor, Program, Scope, data, default_main_program,
     default_startup_program, global_scope, in_static_mode, program_guard,
+    _disable_static, _enable_static,
 )
 from .io import load_inference_model, save_inference_model, serialize_program  # noqa: F401
 
@@ -69,28 +70,134 @@ nn = _types.SimpleNamespace(
 )
 
 
-def _static_cond(pred, true_fn, false_fn=None):
-    """paddle.static.nn.cond → lax.cond in traced mode, python branch in eager
-    (the reference runs sub-blocks via ConditionalBlockOp,
-    /root/reference/paddle/fluid/operators/controlflow/conditional_block_op.cc:43)."""
+def _is_tracer(x):
     import jax
     from ..core.dispatch import unwrap
-    if in_static_mode():
-        # during build, both branches must be traceable; evaluate eagerly with
-        # the placeholder and record — conservative: python branch
-        take = bool(np.asarray(unwrap(pred)).item()) if not hasattr(
-            unwrap(pred), "aval") else True
+    return isinstance(unwrap(x), jax.core.Tracer)
+
+
+def _tensor_leaf(x):
+    return isinstance(x, Tensor)
+
+
+def _tree_unwrap(tree):
+    import jax
+    from ..core.dispatch import unwrap
+    return jax.tree_util.tree_map(unwrap, tree, is_leaf=_tensor_leaf)
+
+
+def _static_cond(pred, true_fn, false_fn=None, name=None,
+                 return_names=None):
+    """paddle.static.nn.cond → ``lax.cond`` when traced (to_static /
+    TrainStep / Program build), python branch selection when the predicate
+    is a concrete eager value. Reference: ConditionalBlockOp running
+    sub-blocks (/root/reference/paddle/fluid/operators/controlflow/
+    conditional_block_op.cc:43); XLA compiles both branches and selects.
+
+    Both branches must return matching structures (same contract as the
+    reference). In lowered mode, tensors the branches capture from the
+    enclosing scope are traced through ``lax.cond`` by the outer program.
+    """
+    import jax
+    import jax.numpy as jnp
+    from ..core.dispatch import apply_op, unwrap
+
+    p = unwrap(pred)
+    if not (_is_tracer(pred) or in_static_mode()):
+        take = bool(np.asarray(p).item())
         return true_fn() if take else (false_fn() if false_fn else None)
-    take = bool(np.asarray(unwrap(pred)).item())
-    return true_fn() if take else (false_fn() if false_fn else None)
+    if false_fn is None:
+        raise ValueError(
+            "static.nn.cond requires false_fn when the predicate is "
+            "traced: XLA evaluates a select between the two branches, so "
+            "a missing branch has no lowering (the reference's "
+            "ConditionalBlockOp skips the block instead)")
+
+    cell = {}
+
+    def fn(p_arr):
+        was = in_static_mode()
+        if was:
+            _disable_static()
+        try:
+            def branch(f):
+                def run():
+                    leaves, treedef = jax.tree_util.tree_flatten(
+                        _tree_unwrap(f()), is_leaf=lambda x: x is None)
+                    cell["treedef"] = treedef
+                    return tuple(leaves)
+                return run
+
+            out = jax.lax.cond(
+                jnp.reshape(jnp.asarray(p_arr).astype(bool), ()),
+                branch(true_fn), branch(false_fn))
+        finally:
+            if was:
+                _enable_static()
+        return out
+
+    outs = apply_op("cond", fn, pred)
+    if not isinstance(outs, tuple):
+        outs = (outs,)
+    return jax.tree_util.tree_unflatten(cell["treedef"], list(outs))
 
 
 def _static_while_loop(cond, body, loop_vars, is_test=False, name=None):
-    vars_ = list(loop_vars)
-    while bool(np.asarray(cond(*vars_).numpy()).item()):
-        out = body(*vars_)
-        vars_ = list(out) if isinstance(out, (list, tuple)) else [out]
-    return vars_
+    """paddle.static.nn.while_loop → ``lax.while_loop`` when traced,
+    python loop in eager. Reference: WhileOp
+    (/root/reference/paddle/fluid/operators/controlflow/while_op.cc:86).
+    Shapes must be loop-invariant in lowered mode (XLA requirement; the
+    reference imposes the same on while_op sub-blocks in practice).
+    """
+    import jax
+    import jax.numpy as jnp
+    from ..core.dispatch import apply_op
+
+    traced = in_static_mode() or any(_is_tracer(v) for v in
+                                     jax.tree_util.tree_leaves(
+                                         loop_vars, is_leaf=_tensor_leaf))
+    if not traced:
+        vars_ = list(loop_vars)
+        while bool(np.asarray(cond(*vars_).numpy()).item()):
+            out = body(*vars_)
+            vars_ = list(out) if isinstance(out, (list, tuple)) else [out]
+        return vars_
+
+    leaves, treedef = jax.tree_util.tree_flatten(list(loop_vars),
+                                                 is_leaf=_tensor_leaf)
+
+    def fn(*arrs):
+        was = in_static_mode()
+        if was:
+            _disable_static()
+        try:
+            def rewrap(carry):
+                ts = [Tensor(a, stop_gradient=True) for a in carry]
+                return jax.tree_util.tree_unflatten(treedef, ts)
+
+            def c(carry):
+                r = cond(*rewrap(carry))
+                return jnp.reshape(jnp.asarray(
+                    r._data if isinstance(r, Tensor) else r).astype(bool),
+                    ())
+
+            def b(carry):
+                out = body(*rewrap(carry))
+                out = list(out) if isinstance(out, (list, tuple)) else [out]
+                new_leaves = jax.tree_util.tree_leaves(
+                    _tree_unwrap(out), is_leaf=lambda x: x is None)
+                return tuple(new_leaves)
+
+            out = jax.lax.while_loop(c, b, tuple(arrs))
+        finally:
+            if was:
+                _enable_static()
+        return out
+
+    outs = apply_op("while_loop", fn, *leaves)
+    if not isinstance(outs, tuple):
+        outs = (outs,)
+    return jax.tree_util.tree_unflatten(treedef, list(outs))
 
 
 nn.cond = _static_cond

@@ -1,0 +1,250 @@
+"""distribution / sparse / quantization packages — numpy-oracle tests
+(reference test analogs: test_distribution_*.py, test_sparse_*.py,
+test_quant_*.py under fluid/tests/unittests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+from paddle_tpu import sparse as S
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+class TestDistributions:
+    def test_normal_log_prob_oracle(self):
+        n = D.Normal(1.5, 2.0)
+        v = np.array([0.0, 1.5, 4.0], np.float32)
+        lp = _np(n.log_prob(paddle.to_tensor(v)))
+        oracle = -((v - 1.5) ** 2) / (2 * 4.0) - np.log(2.0) \
+            - 0.5 * np.log(2 * np.pi)
+        np.testing.assert_allclose(lp, oracle, rtol=1e-5)
+
+    def test_normal_sampling_moments(self):
+        paddle.seed(0)
+        n = D.Normal(2.0, 3.0)
+        s = _np(n.sample((20000,)))
+        assert abs(s.mean() - 2.0) < 0.1
+        assert abs(s.std() - 3.0) < 0.1
+
+    def test_uniform_entropy_and_bounds(self):
+        u = D.Uniform(1.0, 3.0)
+        assert np.isclose(float(u.entropy().numpy()), np.log(2.0))
+        paddle.seed(0)
+        s = _np(u.sample((1000,)))
+        assert s.min() >= 1.0 and s.max() < 3.0
+        assert np.isneginf(_np(u.log_prob(paddle.to_tensor(5.0))))
+
+    def test_categorical_log_prob_entropy(self):
+        logits = np.log(np.array([0.2, 0.3, 0.5], np.float32))
+        c = D.Categorical(logits=logits)
+        np.testing.assert_allclose(
+            _np(c.log_prob(paddle.to_tensor(np.array([2])))),
+            [np.log(0.5)], rtol=1e-5)
+        oracle_h = -(0.2 * np.log(0.2) + 0.3 * np.log(0.3)
+                     + 0.5 * np.log(0.5))
+        np.testing.assert_allclose(float(c.entropy().numpy()), oracle_h,
+                                   rtol=1e-5)
+
+    def test_bernoulli(self):
+        b = D.Bernoulli(probs=0.7)
+        np.testing.assert_allclose(float(b.mean.numpy()), 0.7)
+        np.testing.assert_allclose(
+            float(b.log_prob(paddle.to_tensor(1.0)).numpy()),
+            np.log(0.7), rtol=1e-5)
+
+    def test_beta_dirichlet_moments(self):
+        be = D.Beta(2.0, 3.0)
+        np.testing.assert_allclose(float(be.mean.numpy()), 0.4, rtol=1e-6)
+        d = D.Dirichlet(np.array([1.0, 2.0, 3.0], np.float32))
+        np.testing.assert_allclose(_np(d.mean), [1 / 6, 2 / 6, 3 / 6],
+                                   rtol=1e-5)
+
+    def test_laplace_gumbel_lognormal(self):
+        l = D.Laplace(0.0, 1.0)
+        np.testing.assert_allclose(
+            float(l.log_prob(paddle.to_tensor(0.0)).numpy()),
+            np.log(0.5), rtol=1e-5)
+        g = D.Gumbel(0.0, 1.0)
+        np.testing.assert_allclose(float(g.mean.numpy()), 0.57721566,
+                                   rtol=1e-4)
+        ln = D.LogNormal(0.0, 0.5)
+        np.testing.assert_allclose(float(ln.mean.numpy()),
+                                   np.exp(0.125), rtol=1e-5)
+        # TransformedDistribution log_prob: lognormal pdf oracle
+        v = 1.7
+        lp = float(ln.log_prob(paddle.to_tensor(v)).numpy())
+        oracle = -np.log(v * 0.5 * np.sqrt(2 * np.pi)) \
+            - (np.log(v)) ** 2 / (2 * 0.25)
+        np.testing.assert_allclose(lp, oracle, rtol=1e-4)
+
+    def test_independent_sums_event_dims(self):
+        n = D.Normal(np.zeros((3, 4), np.float32),
+                     np.ones((3, 4), np.float32))
+        ind = D.Independent(n, 1)
+        v = paddle.to_tensor(np.zeros((3, 4), np.float32))
+        lp = _np(ind.log_prob(v))
+        assert lp.shape == (3,)
+        np.testing.assert_allclose(lp, _np(n.log_prob(v)).sum(-1),
+                                   rtol=1e-6)
+
+    def test_kl_normal_oracle(self):
+        kl = float(D.kl_divergence(D.Normal(0.0, 1.0),
+                                   D.Normal(1.0, 2.0)).numpy())
+        vr = (1 / 2) ** 2
+        oracle = 0.5 * (vr + (1 / 2) ** 2 - 1 - np.log(vr))
+        np.testing.assert_allclose(kl, oracle, rtol=1e-5)
+
+    def test_kl_registry_dispatch_and_missing(self):
+        assert float(D.kl_divergence(D.Bernoulli(probs=0.5),
+                                     D.Bernoulli(probs=0.5)).numpy()) == \
+            pytest.approx(0.0, abs=1e-6)
+        with pytest.raises(NotImplementedError):
+            D.kl_divergence(D.Normal(0.0, 1.0), D.Beta(1.0, 1.0))
+
+    def test_multinomial_counts(self):
+        paddle.seed(0)
+        m = D.Multinomial(20, np.array([0.5, 0.5], np.float32))
+        s = _np(m.sample((100,)))
+        assert s.shape == (100, 2)
+        np.testing.assert_array_equal(s.sum(-1), np.full(100, 20.0))
+
+
+class TestSparse:
+    def _coo(self):
+        idx = np.array([[0, 1, 2], [1, 0, 2]])
+        vals = np.array([1.0, 2.0, 3.0], np.float32)
+        return S.sparse_coo_tensor(idx, vals, shape=[3, 3])
+
+    def test_coo_roundtrip(self):
+        sp = self._coo()
+        dense = _np(sp.to_dense())
+        oracle = np.zeros((3, 3), np.float32)
+        oracle[0, 1], oracle[1, 0], oracle[2, 2] = 1, 2, 3
+        np.testing.assert_array_equal(dense, oracle)
+        assert sp.nnz == 3
+        assert S.is_sparse_coo(sp)
+
+    def test_csr_conversion(self):
+        csr = self._coo().to_sparse_csr()
+        assert S.is_sparse_csr(csr)
+        np.testing.assert_array_equal(_np(csr.crows()), [0, 1, 2, 3])
+        np.testing.assert_array_equal(_np(csr.to_dense()),
+                                      _np(self._coo().to_dense()))
+
+    def test_csr_creation(self):
+        csr = S.sparse_csr_tensor([0, 2, 3, 5], [1, 3, 2, 0, 1],
+                                  [1., 2., 3., 4., 5.], [3, 4])
+        d = _np(csr.to_dense())
+        oracle = np.array([[0, 1, 0, 2], [0, 0, 3, 0], [4, 5, 0, 0]],
+                          np.float32)
+        np.testing.assert_array_equal(d, oracle)
+
+    def test_unary_preserves_pattern(self):
+        sp = S.sin(self._coo())
+        oracle = np.sin(_np(self._coo().to_dense()))
+        np.testing.assert_allclose(_np(sp.to_dense()), oracle, rtol=1e-6)
+        assert sp.nnz == 3
+
+    def test_binary_same_pattern(self):
+        out = S.add(self._coo(), self._coo())
+        np.testing.assert_allclose(_np(out.to_dense()),
+                                   2 * _np(self._coo().to_dense()))
+
+    def test_matmul_dense_rhs(self):
+        rng = np.random.RandomState(0)
+        y = rng.randn(3, 5).astype(np.float32)
+        out = _np(S.matmul(self._coo(), y))
+        oracle = _np(self._coo().to_dense()) @ y
+        np.testing.assert_allclose(out, oracle, rtol=1e-5)
+
+    def test_masked_matmul(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(3, 4).astype(np.float32)
+        y = rng.randn(4, 3).astype(np.float32)
+        mask = self._coo()
+        out = S.masked_matmul(paddle.to_tensor(x), paddle.to_tensor(y),
+                              mask)
+        dense = _np(out.to_dense())
+        full = x @ y
+        oracle = np.where(_np(mask.to_dense()) != 0, full, 0)
+        np.testing.assert_allclose(dense, oracle, rtol=1e-5)
+
+    def test_transpose(self):
+        t = S.transpose(self._coo(), [1, 0])
+        np.testing.assert_array_equal(_np(t.to_dense()),
+                                      _np(self._coo().to_dense()).T)
+
+    def test_sparse_attention(self):
+        rng = np.random.RandomState(2)
+        q = rng.randn(2, 4, 8).astype(np.float32)
+        mask = S.sparse_coo_tensor(
+            np.array([[0, 1, 2, 3], [0, 1, 2, 3]]),
+            np.ones(4, np.float32), shape=[4, 4])
+        out = S.nn.functional.attention(
+            paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+            mask)
+        # identity mask -> each position attends only itself -> out == v
+        np.testing.assert_allclose(_np(out), q, rtol=1e-5)
+
+
+class TestQuantization:
+    def _model(self):
+        class Net(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = paddle.nn.Linear(8, 16)
+                self.fc2 = paddle.nn.Linear(16, 4)
+
+            def forward(self, x):
+                return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+        paddle.seed(0)
+        return Net()
+
+    def test_qat_fake_quant_wraps_and_trains(self):
+        from paddle_tpu.quantization import (FakeQuanterWithAbsMaxObserver,
+                                             QAT, QuantConfig)
+        q = QuantConfig(activation=FakeQuanterWithAbsMaxObserver(),
+                        weight=FakeQuanterWithAbsMaxObserver())
+        model = QAT(q).quantize(self._model())
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(4, 8).astype("float32"))
+        y = paddle.to_tensor(rng.randn(4, 4).astype("float32"))
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=model.parameters())
+        losses = []
+        for _ in range(10):
+            loss = ((model(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]  # STE gradient flows
+
+    def test_fake_quant_rounding_oracle(self):
+        from paddle_tpu.quantization import _fake_quant
+        import jax.numpy as jnp
+        x = jnp.asarray(np.array([0.0, 0.05, -1.0, 0.99], np.float32))
+        out = np.asarray(_fake_quant(x, jnp.asarray(1.0), bits=8))
+        oracle = np.round(np.clip(np.asarray(x) * 127, -127, 127)) / 127
+        np.testing.assert_allclose(out, oracle, rtol=1e-6)
+
+    def test_ptq_observe_then_convert(self):
+        from paddle_tpu.quantization import (AbsmaxObserver, PTQ,
+                                             QuantConfig)
+        q = QuantConfig(activation=AbsmaxObserver(), weight=None)
+        model = PTQ(q).quantize(self._model())
+        rng = np.random.RandomState(1)
+        for _ in range(3):
+            model(paddle.to_tensor(rng.randn(4, 8).astype("float32") * 3))
+        ptq = PTQ(q)
+        ptq.convert(model)
+        from paddle_tpu.quantization import _FixedScaleQuant
+        fixed = [l for l in model.sublayers()
+                 if isinstance(l, _FixedScaleQuant)]
+        assert len(fixed) == 2
+        assert all(f.scale() > 0 for f in fixed)
+        out = model(paddle.to_tensor(rng.randn(4, 8).astype("float32")))
+        assert np.isfinite(_np(out)).all()
