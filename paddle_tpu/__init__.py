@@ -54,6 +54,8 @@ from .tensor import creation as _creation  # noqa: F401
 from . import amp  # noqa: F401
 from . import autograd  # noqa: F401
 from . import device  # noqa: F401
+from . import fft  # noqa: F401
+from .hapi import callbacks  # noqa: F401
 from . import distribution  # noqa: F401
 from . import distributed  # noqa: F401
 from . import framework  # noqa: F401
@@ -68,6 +70,7 @@ from . import profiler  # noqa: F401
 from . import quantization  # noqa: F401
 from . import sparse  # noqa: F401
 from . import static  # noqa: F401
+from . import utils  # noqa: F401
 from . import vision  # noqa: F401
 
 from .framework.io import load, save  # noqa: F401

@@ -1,6 +1,11 @@
 """paddle.device equivalent (+ cuda-compat namespace that lands on TPU)."""
 import types as _types
 
+from ..framework.memory import (  # noqa: F401
+    empty_cache, max_memory_allocated, max_memory_reserved,
+    memory_allocated, memory_reserved, reset_peak_memory_stats,
+)
+
 from ..framework.device import (  # noqa: F401
     device_count, device_guard, get_device, is_compiled_with_cuda,
     is_compiled_with_rocm, is_compiled_with_tpu, is_compiled_with_xpu,
@@ -80,26 +85,6 @@ def _mem_stats():
         return stats
     except Exception:
         return {}
-
-
-def max_memory_allocated(device=None):
-    return _mem_stats().get("peak_bytes_in_use", 0)
-
-
-def max_memory_reserved(device=None):
-    return _mem_stats().get("peak_bytes_in_use", 0)
-
-
-def memory_allocated(device=None):
-    return _mem_stats().get("bytes_in_use", 0)
-
-
-def memory_reserved(device=None):
-    return _mem_stats().get("bytes_limit", 0)
-
-
-def empty_cache():
-    pass
 
 
 cuda = _types.SimpleNamespace(

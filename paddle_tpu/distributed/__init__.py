@@ -48,3 +48,5 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
         for p in procs:
             p.join()
     return procs
+
+from . import rpc  # noqa: F401

@@ -14,3 +14,4 @@ PaddleCloudRoleMaker = None
 class UserDefinedRoleMaker:
     def __init__(self, *a, **k):
         pass
+from . import elastic  # noqa: F401

@@ -1,0 +1,40 @@
+"""fleet.elastic (reference: python/paddle/distributed/fleet/elastic/
+manager.py:126 — etcd-watched membership, scale in/out, restart).
+
+TPU-native stance (SURVEY §5.3): mid-program ICI failures are not
+survivable, so elasticity = job-level restart + checkpoint resume. The
+launcher implements the restart loop (`--elastic_level`/`--max_restarts`,
+paddle_tpu.distributed.launch); ElasticManager is the thin status surface
+over it.
+"""
+from __future__ import annotations
+
+import os
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    def __init__(self, args=None, etcd_client=None):
+        self.args = args
+        self.restarts = int(os.environ.get("PADDLE_ELASTIC_RESTARTS", 0))
+
+    def enabled(self) -> bool:
+        return int(os.environ.get("PADDLE_ELASTIC_LEVEL", 0)) > 0
+
+    def exit(self, completed=True):
+        return ElasticStatus.COMPLETED if completed else ElasticStatus.ERROR
+
+
+def launch_elastic(args=None, distribute_mode=None):
+    """reference elastic/__init__.py:49 — delegate to the launcher's
+    restart loop."""
+    from ..launch.main import launch
+    argv = ["--elastic_level", "1"] + (args or [])
+    return launch(argv)

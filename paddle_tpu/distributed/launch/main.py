@@ -50,6 +50,11 @@ def parse_args(argv=None):
     ap.add_argument("--log_dir", default=None)
     ap.add_argument("--devices", default=None,
                     help="accepted for reference-CLI parity")
+    ap.add_argument("--elastic_level", type=int, default=0,
+                    help=">0 enables restart-on-failure (reference "
+                         "elastic/manager.py; TPU-native = full-job "
+                         "restart + checkpoint resume, SURVEY §5.3)")
+    ap.add_argument("--max_restarts", type=int, default=3)
     ap.add_argument("training_script")
     ap.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return ap.parse_args(argv)
@@ -57,6 +62,19 @@ def parse_args(argv=None):
 
 def launch(argv=None) -> int:
     args = parse_args(argv)
+    attempts = 1 + (args.max_restarts if args.elastic_level > 0 else 0)
+    rc = 1
+    for attempt in range(attempts):
+        rc = _launch_once(args)
+        if rc == 0 or args.elastic_level <= 0:
+            return rc
+        if attempt + 1 < attempts:
+            print(f"elastic: job failed (rc={rc}); restart "
+                  f"{attempt + 1}/{args.max_restarts}", file=sys.stderr)
+    return rc
+
+
+def _launch_once(args) -> int:
     nproc = args.nproc_per_node
     world = nproc * args.nnodes
     if args.nnodes > 1:

@@ -1,0 +1,156 @@
+"""paddle.distributed.rpc — minimal RPC over the TCPStore rendezvous.
+
+Reference: /root/reference/python/paddle/distributed/rpc/rpc.py
+(init_rpc/rpc_sync/rpc_async/shutdown, brpc-backed). TPU-native: requests
+ride the same native TCPStore the collectives use — each worker runs a
+server thread polling its request mailbox; callables must be picklable
+(module-level functions), the reference's contract too.
+"""
+from __future__ import annotations
+
+import itertools
+import pickle
+import threading
+import time
+from typing import Any, Optional
+
+from . import env
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_current_worker_info", "get_worker_info", "get_all_worker_infos"]
+
+_state = {"running": False, "thread": None, "name": None, "names": {}}
+_req_seq = itertools.count()
+_TIMEOUT = 120.0
+
+
+class WorkerInfo:
+    def __init__(self, name, rank):
+        self.name = name
+        self.rank = rank
+
+    def __repr__(self):
+        return f"WorkerInfo(name={self.name}, rank={self.rank})"
+
+
+def _store():
+    s = env.get_store()
+    if s is None:
+        raise RuntimeError("init_rpc requires the multi-process bootstrap "
+                           "(init_parallel_env / the launcher)")
+    return s
+
+
+def _serve(rank, start):
+    # The server thread gets its OWN store connection: a blocking wait()
+    # holds a connection's request mutex for the full round-trip, so
+    # sharing the main thread's connection deadlocks when two ranks
+    # rpc_sync at each other (both mains parked in wait, both servers
+    # starved behind that mutex).
+    from ..native.tcp_store import TCPStore
+    main = _store()
+    store = TCPStore(host=main.host, port=main.port, is_master=False)
+    n = start
+    while _state["running"]:
+        key = f"rpc/req/{rank}/{n}"
+        try:
+            payload = store.wait(key, 1.0)
+        except TimeoutError:
+            continue
+        except Exception:
+            return
+        caller, seq, fn, args, kwargs = pickle.loads(payload)
+        try:
+            result = (True, fn(*args, **kwargs))
+        except Exception as e:  # noqa: BLE001 — marshalled to caller
+            result = (False, repr(e))
+        store.set(f"rpc/res/{caller}/{seq}", pickle.dumps(result))
+        store.delete(key)
+        n += 1
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None, master_endpoint=None):
+    env.init_parallel_env()
+    rank = env.global_rank() if rank is None else rank
+    store = _store()
+    store.set(f"rpc/name/{rank}", name.encode())
+    _state.update(running=True, name=name)
+    # resume the mailbox where a previous rpc session left it (the
+    # rpc/next counter persists in the store across init/shutdown cycles)
+    start = int(store.add(f"rpc/next/{rank}", 0))
+    t = threading.Thread(target=_serve, args=(rank, start), daemon=True)
+    _state["thread"] = t
+    t.start()
+    # resolve peer names
+    world = env.get_world_size() if world_size is None else world_size
+    for r in range(world):
+        _state["names"][store.wait(f"rpc/name/{r}", _TIMEOUT).decode()] = r
+
+
+class _Future:
+    def __init__(self, caller_rank, seq):
+        self._key = f"rpc/res/{caller_rank}/{seq}"
+        self._value = None
+        self._done = False
+
+    def wait(self, timeout=_TIMEOUT) -> Any:
+        if self._done:
+            return self._value
+        store = _store()
+        ok, value = pickle.loads(store.wait(self._key, timeout))
+        store.delete(self._key)
+        if not ok:
+            raise RuntimeError(f"rpc target raised: {value}")
+        self._value = value
+        self._done = True
+        return value
+
+
+def _target_rank(to: str) -> int:
+    if to in _state["names"]:
+        return _state["names"][to]
+    try:
+        return int(to)
+    except ValueError:
+        raise ValueError(f"unknown rpc worker {to!r}")
+
+
+def rpc_async(to: str, fn, args=(), kwargs=None, timeout=_TIMEOUT):
+    store = _store()
+    me = env.global_rank()
+    seq = next(_req_seq)
+    dst = _target_rank(to)
+    # per-destination mailbox index: the server consumes in order
+    idx = store.add(f"rpc/next/{dst}", 1) - 1
+    store.set(f"rpc/req/{dst}/{idx}",
+              pickle.dumps((me, seq, fn, tuple(args), kwargs or {})))
+    return _Future(me, seq)
+
+
+def rpc_sync(to: str, fn, args=(), kwargs=None, timeout=_TIMEOUT):
+    return rpc_async(to, fn, args, kwargs, timeout).wait(timeout)
+
+
+def shutdown(graceful=True):
+    if graceful:
+        from .communication.collective import barrier
+        barrier()
+    _state["running"] = False
+    t = _state.get("thread")
+    if t is not None:
+        t.join(timeout=3)
+    _state["thread"] = None
+
+
+def get_current_worker_info() -> WorkerInfo:
+    return WorkerInfo(_state["name"], env.global_rank())
+
+
+def get_worker_info(name: str) -> WorkerInfo:
+    return WorkerInfo(name, _target_rank(name))
+
+
+def get_all_worker_infos():
+    return [WorkerInfo(n, r) for n, r in sorted(_state["names"].items(),
+                                                key=lambda kv: kv[1])]

@@ -1,0 +1,76 @@
+"""paddle.fft — spectral ops over jnp.fft (XLA FFT on TPU).
+
+Reference: python/paddle/fft.py backed by the fft_c2c/fft_r2c/fft_c2r
+yaml ops (/root/reference/paddle/phi/api/yaml/ops.yaml) with cuFFT/oneMKL
+kernels; XLA lowers the same decompositions natively.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.dispatch import apply_op, wrap
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2",
+    "fftn", "ifftn", "rfftn", "irfftn",
+    "fftshift", "ifftshift", "fftfreq", "rfftfreq",
+]
+
+
+def _op1(name, fn):
+    def op(x, n=None, axis=-1, norm="backward", name_=None, name__=None,
+           **kw):
+        return apply_op(name, lambda a: fn(a, n=n, axis=axis, norm=norm), x)
+
+    op.__name__ = name
+    return op
+
+
+def _op2(name, fn):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name_=None, **kw):
+        return apply_op(name, lambda a: fn(a, s=s, axes=axes, norm=norm), x)
+
+    op.__name__ = name
+    return op
+
+
+def _opn(name, fn):
+    def op(x, s=None, axes=None, norm="backward", name_=None, **kw):
+        return apply_op(name, lambda a: fn(a, s=s, axes=axes, norm=norm), x)
+
+    op.__name__ = name
+    return op
+
+
+fft = _op1("fft", jnp.fft.fft)
+ifft = _op1("ifft", jnp.fft.ifft)
+rfft = _op1("rfft", jnp.fft.rfft)
+irfft = _op1("irfft", jnp.fft.irfft)
+hfft = _op1("hfft", jnp.fft.hfft)
+ihfft = _op1("ihfft", jnp.fft.ihfft)
+fft2 = _op2("fft2", jnp.fft.fft2)
+ifft2 = _op2("ifft2", jnp.fft.ifft2)
+rfft2 = _op2("rfft2", jnp.fft.rfft2)
+irfft2 = _op2("irfft2", jnp.fft.irfft2)
+fftn = _opn("fftn", jnp.fft.fftn)
+ifftn = _opn("ifftn", jnp.fft.ifftn)
+rfftn = _opn("rfftn", jnp.fft.rfftn)
+irfftn = _opn("irfftn", jnp.fft.irfftn)
+
+
+def fftshift(x, axes=None, name=None):
+    return apply_op("fftshift", lambda a: jnp.fft.fftshift(a, axes=axes), x)
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply_op("ifftshift",
+                    lambda a: jnp.fft.ifftshift(a, axes=axes), x)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return wrap(jnp.fft.fftfreq(n, d))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return wrap(jnp.fft.rfftfreq(n, d))

@@ -72,6 +72,7 @@ def flag_value(name: str):
 
 # Core flags (the subset of the reference's flags.cc that has TPU meaning;
 # others are accepted as inert toggles so reference scripts don't break).
+define_flag("FLAGS_use_autotune", True, "kernel block-size autotuning (phi/kernels/autotune analog)")
 define_flag("FLAGS_check_nan_inf", False, "check outputs for nan/inf after every op")
 define_flag("FLAGS_benchmark", False, "synchronize after every op (for timing)")
 define_flag("FLAGS_eager_op_jit_cache", True, "cache per-op compiled executables in eager mode")

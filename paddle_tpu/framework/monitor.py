@@ -1,0 +1,33 @@
+"""Named stat registry (reference: paddle/fluid/platform/monitor.cc —
+STAT_ADD/STAT_RESET int64 counters exported for observability)."""
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+_lock = threading.Lock()
+_stats: Dict[str, int] = {}
+
+
+def stat_add(name: str, value: int = 1) -> int:
+    with _lock:
+        _stats[name] = _stats.get(name, 0) + int(value)
+        return _stats[name]
+
+
+def stat_get(name: str) -> int:
+    with _lock:
+        return _stats.get(name, 0)
+
+
+def stat_reset(name: str = None):
+    with _lock:
+        if name is None:
+            _stats.clear()
+        else:
+            _stats.pop(name, None)
+
+
+def stat_names():
+    with _lock:
+        return sorted(_stats)

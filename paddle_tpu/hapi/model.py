@@ -68,35 +68,63 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             **kwargs):
+        from .callbacks import config_callbacks
         loader = self._loader(train_data, batch_size, shuffle)
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs,
+                                log_freq=log_freq, verbose=verbose,
+                                save_freq=save_freq, save_dir=save_dir,
+                                metrics=[m.name() for m in self._metrics])
         history = {"loss": []}
+        self.stop_training = False
+        cbks.on_train_begin()
         for epoch in range(epochs):
             for m in self._metrics:
                 m.reset()
+            cbks.on_epoch_begin(epoch)
             for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
                 x, y = batch[0], batch[1] if len(batch) > 1 else None
                 loss, metrics = self.train_batch(x, y)
                 history["loss"].append(loss[0])
-                if verbose and step % log_freq == 0:
-                    print(f"Epoch {epoch + 1}/{epochs} step {step}: "
-                          f"loss={loss[0]:.4f}")
+                logs = {"loss": loss[0]}
+                for m, v in zip(self._metrics, metrics):
+                    logs[m.name()] = v
+                cbks.on_train_batch_end(step, logs)
+            cbks.on_epoch_end(epoch, {"loss": history["loss"][-1]
+                                      if history["loss"] else None})
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
-                self.evaluate(eval_data, batch_size=batch_size, verbose=verbose)
+                self.evaluate(eval_data, batch_size=batch_size,
+                              verbose=verbose, callbacks=cbks)
+            if self.stop_training:
+                break
+        cbks.on_train_end()
         return history
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, **kwargs):
+        from .callbacks import CallbackList
+        if isinstance(callbacks, CallbackList):
+            cbks = callbacks
+        else:
+            cbks = CallbackList(callbacks or [])
+            cbks.set_model(self)
         loader = self._loader(eval_data, batch_size, False)
         for m in self._metrics:
             m.reset()
+        cbks.on_eval_begin()
         losses = []
-        for batch in loader:
+        for step, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step)
             x, y = batch[0], batch[1] if len(batch) > 1 else None
             loss, _ = self.eval_batch(x, y)
             losses.extend(loss)
+            cbks.on_eval_batch_end(step, {"loss": loss[0] if loss else None})
         result = {"loss": [float(np.mean(losses))] if losses else []}
         for m in self._metrics:
             result[m.name()] = m.accumulate()
+        cbks.on_eval_end({"loss": result["loss"][0] if result["loss"]
+                          else None, **{m.name(): result[m.name()]
+                                        for m in self._metrics}})
         if verbose:
             print("Eval:", result)
         return result

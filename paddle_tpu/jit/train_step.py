@@ -206,6 +206,7 @@ class TrainStep:
             return loss, new_params, new_state, new_sc
 
         donate = (0, 2) if self._donate else ()
+        self._pure_step = pure_step
         mesh = get_global_mesh()
         if mesh is None:
             self._compiled = jax.jit(pure_step, donate_argnums=donate)
@@ -236,6 +237,58 @@ class TrainStep:
             # Shardings are applied by committed placement (device_put) in
             # __call__; jit then compiles one SPMD program over the mesh.
             self._compiled = jax.jit(pure_step, donate_argnums=donate)
+
+    def run_steps(self, n_steps: int, *batch,
+                  n_inputs: Optional[int] = None):
+        """Run ``n_steps`` training steps on the SAME batch inside ONE
+        compiled program (``lax.scan`` over the step body). This is the
+        dispatch-amortized path: per-call host/runtime overhead is paid
+        once for the whole window instead of per step — the analog of the
+        reference executing a multi-iteration Program in one
+        InterpreterCore run. Dropout keys advance per step (fold_in);
+        the LR is held for the window. Returns the final step's loss.
+        """
+        self._n_inputs = n_inputs if n_inputs is not None else \
+            getattr(self, "_n_inputs", len(batch) - 1)
+        if self._compiled is None:
+            self._build()
+        if getattr(self, "_compiled_multi", None) is None or \
+                self._multi_n != n_steps:
+            ps = self._pure_step
+            self._multi_n = n_steps
+
+            def multi(params, buffers, opt_state, sc_state, lr, t0, key,
+                      *batch):
+                def body(carry, i):
+                    params, opt_state, sc_state = carry
+                    k = jax.random.fold_in(key, i)
+                    loss, p2, s2, sc2 = ps(params, buffers, opt_state,
+                                           sc_state, lr, t0 + i, k, *batch)
+                    # the step ADDS found_inf to the scaler state; keep the
+                    # carry structure fixed and thread it as an output
+                    fi = sc2.get("found_inf", jnp.zeros((), jnp.bool_)) \
+                        if sc2 else jnp.zeros((), jnp.bool_)
+                    sc_carry = {k2: v for k2, v in sc2.items()
+                                if k2 != "found_inf"}
+                    return (p2, s2, sc_carry), (loss, fi)
+
+                (p, s, sc), (losses, fis) = jax.lax.scan(
+                    body, (params, opt_state, sc_state),
+                    jnp.arange(n_steps, dtype=jnp.int32))
+                if sc:
+                    sc = dict(sc, found_inf=fis[-1])
+                return losses[-1], p, s, sc
+
+            self._compiled_multi = jax.jit(
+                multi, donate_argnums=(0, 2) if self._donate else ())
+        saved = self._compiled
+        self._compiled = self._compiled_multi
+        try:
+            out = self.__call__(*batch, n_inputs=self._n_inputs)
+        finally:
+            self._compiled = saved
+        self.optimizer._step_count += n_steps - 1
+        return out
 
     def __call__(self, *batch, n_inputs: Optional[int] = None):
         """batch = model inputs followed by loss_fn extra args (labels)."""

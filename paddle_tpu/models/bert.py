@@ -1,0 +1,220 @@
+"""BERT encoder family — the text model zoo entry.
+
+Capability target: the reference trains BERT through its Fleet DP configs
+(SURVEY §6 BASELINE "BERT-base pretraining, DP allreduce over ICI");
+PaddleNLP-style BertModel API shape (encoder over
+nn.TransformerEncoderLayer, pooler, MLM/NSP heads).
+
+TPU-native: bidirectional flash attention via the shared
+scaled_dot_product_attention path (Pallas kernel on TPU), bf16-friendly
+pre-LN-free classic BERT blocks, TP-able projections via the same
+Column/RowParallelLinear layers the GPT flagship uses.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..distributed.fleet.meta_parallel.mp_layers import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.initializer_utils import create_parameter_with_attr
+from ..nn.layer.common import Dropout, Linear
+from ..nn.layer.container import LayerList
+from ..nn.layer.layers import Layer
+from ..nn.layer.norm import LayerNorm
+
+__all__ = ["BertConfig", "BertModel", "BertForPretraining",
+           "BertForSequenceClassification", "BertPretrainingCriterion",
+           "bert_tiny", "bert_base"]
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30528          # multiple of 64
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
+    use_flash_attention: bool = True
+
+    def __post_init__(self):
+        assert self.hidden_size % self.num_heads == 0
+
+
+def bert_tiny(**kw) -> BertConfig:
+    d = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+             intermediate_size=128, max_position_embeddings=128,
+             hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    d.update(kw)
+    return BertConfig(**d)
+
+
+def bert_base(**kw) -> BertConfig:
+    return BertConfig(**kw)
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.word_embeddings = VocabParallelEmbedding(cfg.vocab_size,
+                                                      cfg.hidden_size)
+        init = I.Normal(std=cfg.initializer_range)
+        self.position_embeddings = create_parameter_with_attr(
+            [cfg.max_position_embeddings, cfg.hidden_size], self._dtype,
+            None, False, default_initializer=init)
+        self.token_type_embeddings = create_parameter_with_attr(
+            [cfg.type_vocab_size, cfg.hidden_size], self._dtype, None,
+            False, default_initializer=init)
+        self.layer_norm = LayerNorm(cfg.hidden_size,
+                                    epsilon=cfg.layer_norm_eps)
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None):
+        seq = input_ids.shape[-1]
+        h = self.word_embeddings(input_ids)
+        h = h + self.position_embeddings[:seq]
+        if token_type_ids is not None:
+            from ..nn.functional.common import embedding as F_embedding
+            h = h + F_embedding(token_type_ids,
+                                self.token_type_embeddings)
+        return self.dropout(self.layer_norm(h))
+
+
+class BertSelfAttention(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.num_heads = cfg.num_heads
+        self.head_dim = cfg.hidden_size // cfg.num_heads
+        self.hidden = cfg.hidden_size
+        self.use_flash = cfg.use_flash_attention
+        self.attn_dropout_p = cfg.attention_probs_dropout_prob
+        self.qkv_proj = ColumnParallelLinear(cfg.hidden_size,
+                                             3 * cfg.hidden_size,
+                                             gather_output=False)
+        self.out_proj = RowParallelLinear(cfg.hidden_size, cfg.hidden_size,
+                                          input_is_parallel=True)
+
+    def forward(self, x, attn_mask=None):
+        b, s, _ = x.shape
+        qkv = self.qkv_proj(x).reshape([b, s, self.num_heads, 3,
+                                        self.head_dim])
+        q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
+        from ..nn.functional.attention import scaled_dot_product_attention
+        out = scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, is_causal=False,
+            dropout_p=self.attn_dropout_p, training=self.training,
+            use_flash=self.use_flash)
+        return self.out_proj(out.reshape([b, s, self.hidden]))
+
+
+class BertLayer(Layer):
+    """Post-LN encoder block (classic BERT ordering)."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.attn = BertSelfAttention(cfg)
+        self.ln1 = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.fc_in = ColumnParallelLinear(cfg.hidden_size,
+                                          cfg.intermediate_size,
+                                          gather_output=False)
+        self.fc_out = RowParallelLinear(cfg.intermediate_size,
+                                        cfg.hidden_size,
+                                        input_is_parallel=True)
+        self.ln2 = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, x, attn_mask=None):
+        x = self.ln1(x + self.dropout(self.attn(x, attn_mask)))
+        h = self.fc_out(F.gelu(self.fc_in(x)))
+        return self.ln2(x + self.dropout(h))
+
+
+class BertPooler(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.dense = Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, h):
+        from ..tensor import math as M
+        return M.tanh(self.dense(h[:, 0]))
+
+
+class BertModel(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        self.encoder = LayerList([BertLayer(config)
+                                  for _ in range(config.num_layers)])
+        self.pooler = BertPooler(config)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        h = self.embeddings(input_ids, token_type_ids)
+        for layer in self.encoder:
+            h = layer(h, attention_mask)
+        return h, self.pooler(h)
+
+
+class BertForPretraining(Layer):
+    """MLM + NSP heads, embeddings tied to the MLM decoder."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.config = config
+        self.transform = Linear(config.hidden_size, config.hidden_size)
+        self.transform_ln = LayerNorm(config.hidden_size,
+                                      epsilon=config.layer_norm_eps)
+        self.nsp_head = Linear(config.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq_out, pooled = self.bert(input_ids, token_type_ids,
+                                    attention_mask)
+        h = self.transform_ln(F.gelu(self.transform(seq_out)))
+        from ..tensor import linalg
+        w = self.bert.embeddings.word_embeddings.weight
+        mlm_logits = linalg.matmul(h, w, transpose_y=True)
+        nsp_logits = self.nsp_head(pooled)
+        return mlm_logits, nsp_logits
+
+    def num_params(self) -> int:
+        return sum(int(np.prod(p.shape)) for p in self.parameters())
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, config: BertConfig, num_classes: int = 2):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+        self.classifier = Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+class BertPretrainingCriterion(Layer):
+    """MLM CE (ignore_index for unmasked tokens) + NSP CE."""
+
+    def __init__(self, ignore_index: int = -100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, outputs, mlm_labels, nsp_labels=None):
+        mlm_logits, nsp_logits = outputs
+        b, s, v = mlm_logits.shape
+        loss = F.cross_entropy(mlm_logits.reshape([b * s, v]),
+                               mlm_labels.reshape([b * s]),
+                               ignore_index=self.ignore_index)
+        if nsp_labels is not None:
+            loss = loss + F.cross_entropy(nsp_logits, nsp_labels)
+        return loss

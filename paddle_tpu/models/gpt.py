@@ -40,7 +40,7 @@ from ..nn.layer.norm import LayerNorm
 
 __all__ = [
     "GPTConfig", "GPTModel", "GPTForCausalLM", "GPTPretrainingCriterion",
-    "gpt_tiny", "gpt2_small", "gpt3_1p3b",
+    "gpt_tiny", "gpt2_small", "gpt2_medium", "gpt3_1p3b",
 ]
 
 
@@ -80,6 +80,13 @@ def gpt_tiny(**kw) -> GPTConfig:
 def gpt2_small(**kw) -> GPTConfig:
     d = dict(vocab_size=50304, hidden_size=768, num_layers=12, num_heads=12,
              max_seq_len=1024)
+    d.update(kw)
+    return GPTConfig(**d)
+
+
+def gpt2_medium(**kw) -> GPTConfig:
+    d = dict(vocab_size=50304, hidden_size=1024, num_layers=24,
+             num_heads=16, max_seq_len=1024)
     d.update(kw)
     return GPTConfig(**d)
 

@@ -283,3 +283,19 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         return _reduce(nll, reduction)
     return apply_op("ctc_loss", _ctc, log_probs, labels, input_lengths,
                     label_lengths)
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):  # noqa: A002
+    """reference ops.yaml huber_loss (the op behind smooth-l1-style
+    robust regression)."""
+    def _huber(a, b):
+        d = a - b
+        ad = jnp.abs(d)
+        q = jnp.minimum(ad, delta)
+        out = 0.5 * q * q + delta * (ad - q)
+        if reduction == "mean":
+            return jnp.mean(out)
+        if reduction == "sum":
+            return jnp.sum(out)
+        return out
+    return apply_op("huber_loss", _huber, input, label)

@@ -1,0 +1,104 @@
+"""Kernel autotuning — block-size search with a persistent cache.
+
+Reference: paddle/phi/kernels/autotune/ (gpu-timer based algo selection +
+cache for conv algos / layout). TPU-native: Pallas grid/block choices are
+the tunable axis; candidates are timed on the real device at first use
+per (kernel, shape-key) and the winner is cached (in-process + on-disk
+json so later processes skip the search).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, Dict, List, Sequence, Tuple
+
+import jax
+
+_CACHE_ENV = "PADDLE_TPU_AUTOTUNE_CACHE"
+_cache: Dict[str, list] = {}
+_loaded = False
+
+
+def _cache_path() -> str:
+    return os.environ.get(
+        _CACHE_ENV, os.path.join(os.path.expanduser("~"),
+                                 ".paddle_tpu_autotune.json"))
+
+
+def _load():
+    global _loaded
+    if _loaded:
+        return
+    _loaded = True
+    try:
+        with open(_cache_path()) as f:
+            _cache.update(json.load(f))
+    except Exception:
+        pass
+
+
+def _save():
+    try:
+        with open(_cache_path(), "w") as f:
+            json.dump(_cache, f)
+    except Exception:  # pragma: no cover — read-only home
+        pass
+
+
+def enabled() -> bool:
+    """Autotuning only makes sense on a real accelerator (interpret-mode
+    timings are meaningless) and is opt-out via FLAGS."""
+    from ..framework.flags import flag_value
+    if not flag_value("FLAGS_use_autotune"):
+        return False
+    try:
+        return jax.devices()[0].platform.lower() != "cpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+def _cache_key(kernel: str, key: Sequence) -> str:
+    return f"{kernel}/{'_'.join(map(str, key))}"
+
+
+def cached(kernel: str, key: Sequence):
+    """Prior tuning result for (kernel, key), or None — usable from traced
+    code where timing is impossible."""
+    _load()
+    hit = _cache.get(_cache_key(kernel, key))
+    return tuple(hit) if hit else None
+
+
+def pick(kernel: str, key: Sequence, candidates: List[Tuple],
+         make_fn: Callable[[Tuple], Callable], args,
+         warmup: int = 1, iters: int = 3) -> Tuple:
+    """Return the fastest candidate configuration for ``kernel`` at
+    ``key``, timing each with ``make_fn(cand)(*args)`` on first use."""
+    _load()
+    ck = _cache_key(kernel, key)
+    if ck in _cache:
+        return tuple(_cache[ck])
+    if not enabled() or len(candidates) == 1:
+        return candidates[0]
+    best, best_t = candidates[0], float("inf")
+    for cand in candidates:
+        try:
+            fn = make_fn(cand)
+            out = fn(*args)
+            jax.block_until_ready(out)     # compile + warm
+            for _ in range(max(warmup - 1, 0)):
+                out = fn(*args)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn(*args)
+            jax.block_until_ready(out)
+            dt = (time.perf_counter() - t0) / iters
+        except Exception:
+            continue
+        if dt < best_t:
+            best, best_t = cand, dt
+    _cache[ck] = list(best)
+    _save()
+    return best

@@ -38,13 +38,43 @@ def supported(q, k, v, mask, causal) -> bool:
     return True
 
 
+def _block_candidates(sq, sk):
+    """Valid (block_q, block_k) choices for the autotuner (multiples of
+    128 that divide the sequence lengths)."""
+    cands = []
+    for bq in (128, 256, 512):
+        for bk in (128, 256, 512):
+            if sq % bq == 0 and sk % bk == 0:
+                cands.append((bq, bk))
+    return cands or [(128, 128)]
+
+
 def flash_attention_bshd(q, k, v, causal=False, scale=None):
-    """[B,S,H,D] layout wrapper over the BHSD pallas kernel."""
+    """[B,S,H,D] layout wrapper over the BHSD pallas kernel; block sizes
+    are autotuned per shape on the first real-device call
+    (ops/autotune.py — the reference's phi/kernels/autotune analog)."""
+    from . import autotune
     from .pallas_attention import mha
 
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
     s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
-    out = mha(qt, kt, vt, causal=causal, sm_scale=s)
+    b, h, sq, d = qt.shape
+    sk = kt.shape[2]
+    cands = _block_candidates(sq, sk)
+    if len(cands) > 1 and autotune.enabled() and not isinstance(
+            qt, jax.core.Tracer):
+        bq, bk = autotune.pick(
+            "mha_fwd", (b, h, sq, sk, d, str(qt.dtype), causal), cands,
+            lambda c: jax.jit(lambda a, x, y: mha(
+                a, x, y, causal, s, c[0], c[1])),
+            (qt, kt, vt))
+    else:
+        # traced call: can't time here — use a prior (possibly on-disk)
+        # tuning result for this shape, else the default blocks
+        hit = autotune.cached("mha_fwd", (b, h, sq, sk, d, str(qt.dtype),
+                                          causal))
+        bq, bk = hit if hit else (128, 128)
+    out = mha(qt, kt, vt, causal=causal, sm_scale=s, block_q=bq, block_k=bk)
     return jnp.swapaxes(out, 1, 2)

@@ -1,0 +1,72 @@
+"""Op registry — loads ops.yaml (the declarative source of truth).
+
+Reference analog: paddle/phi/api/yaml/ops.yaml + op_compat.yaml driving
+codegen (SURVEY §2.1); here the yaml drives lookup/aliasing: every public
+op is declared with its implementation path and legacy-name aliases, so
+model importers can resolve old fluid op names (elementwise_add,
+reduce_sum, lookup_table_v2, ...) to live callables.
+"""
+from __future__ import annotations
+
+import importlib
+import os
+import re
+from typing import Callable, Dict, List, Optional
+
+_YAML = os.path.join(os.path.dirname(__file__), "ops.yaml")
+
+_ops: Optional[Dict[str, dict]] = None
+_alias: Dict[str, str] = {}
+
+
+def _load():
+    global _ops
+    if _ops is not None:
+        return _ops
+    ops = {}
+    cur = None
+    with open(_YAML) as f:
+        for line in f:
+            line = line.rstrip("\n")
+            if line.startswith("- op: "):
+                cur = {"name": line[len("- op: "):].strip(), "compat": []}
+                ops[cur["name"]] = cur
+            elif line.startswith("  impl: ") and cur is not None:
+                cur["impl"] = line[len("  impl: "):].strip()
+            elif line.startswith("  args: ") and cur is not None:
+                cur["args"] = line[len("  args: "):].strip().strip('"')
+            elif line.startswith("  compat: ") and cur is not None:
+                inner = re.match(r"\s*compat:\s*\[(.*)\]", line).group(1)
+                cur["compat"] = [a.strip() for a in inner.split(",")
+                                 if a.strip()]
+    _ops = ops
+    for name, e in ops.items():
+        for old in e["compat"]:
+            _alias[old] = name
+    return ops
+
+
+def op_names() -> List[str]:
+    return sorted(_load())
+
+
+def resolve(name: str) -> Callable:
+    """Look an op up by registry name OR legacy compat alias."""
+    ops = _load()
+    if name not in ops and name in _alias:
+        name = _alias[name]
+    if name not in ops:
+        raise KeyError(f"op {name!r} is not in the registry "
+                       f"(paddle_tpu/ops/ops.yaml)")
+    impl = ops[name]["impl"]
+    modname, _, attr = impl.rpartition(".")
+    return getattr(importlib.import_module(modname), attr)
+
+
+def compat_name(old: str) -> Optional[str]:
+    _load()
+    return _alias.get(old)
+
+
+def signature(name: str) -> str:
+    return _load()[name].get("args", "(...)")

@@ -498,3 +498,37 @@ def shape(x):
 
 def rank(x):
     return Tensor(np.asarray(x.ndim, dtype=np.int32))
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    """Batched diagonal embedding (reference ops.yaml diag_embed)."""
+    def _de(a):
+        n = a.shape[-1] + abs(offset)
+        out_shape = a.shape[:-1] + (n, n)
+        out = jnp.zeros(out_shape, a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        out = out.at[..., r, c].set(a)
+        # move the two new axes to dim1/dim2
+        nd = out.ndim
+        d1 = dim1 % nd
+        d2 = dim2 % nd
+        perm = [d for d in range(nd) if d not in (nd - 2, nd - 1)]
+        order = list(perm)
+        lo, hi = sorted((d1, d2))
+        order.insert(lo, nd - 2 if d1 < d2 else nd - 1)
+        order.insert(hi, nd - 1 if d1 < d2 else nd - 2)
+        return jnp.transpose(out, order)
+    return apply_op("diag_embed", _de, x)
+
+
+def reverse(x, axis, name=None):
+    """Alias of flip (reference legacy_ops.yaml reverse)."""
+    return flip(x, axis)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    """Split along `axis` into unit slices (reference legacy_ops.yaml
+    unstack); same result as unbind."""
+    return unbind(x, axis)

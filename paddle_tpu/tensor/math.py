@@ -373,3 +373,45 @@ remainder_ = _make_inplace(remainder)
 floor_divide_ = _make_inplace(floor_divide)
 lerp_ = _make_inplace(lerp)
 pow_ = _make_inplace(pow)
+
+
+def sgn(x, name=None):
+    """Complex-aware sign (reference ops.yaml sgn): x/|x| for complex,
+    jnp.sign for real."""
+    def _sgn(a):
+        if jnp.iscomplexobj(a):
+            mag = jnp.abs(a)
+            return jnp.where(mag == 0, 0, a / jnp.where(mag == 0, 1, mag))
+        return jnp.sign(a)
+    return apply_op("sgn", _sgn, x)
+
+
+def logit(x, eps=None, name=None):
+    def _logit(a):
+        if eps is not None:
+            a = jnp.clip(a, eps, 1.0 - eps)
+        return jnp.log(a) - jnp.log1p(-a)
+    return apply_op("logit", _logit, x)
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    def _lcse(a):
+        ax = -1 if axis is None else int(axis)
+        if axis is None:
+            a = a.reshape(-1)
+        m = jnp.max(a, axis=ax, keepdims=True)
+        return m + jnp.log(jnp.cumsum(jnp.exp(a - m), axis=ax))
+    return apply_op("logcumsumexp", _lcse, x)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Renormalize slices along `axis` whose p-norm exceeds max_norm
+    (reference ops.yaml renorm)."""
+    def _renorm(a):
+        ax = axis % a.ndim
+        dims = tuple(d for d in range(a.ndim) if d != ax)
+        norms = jnp.sum(jnp.abs(a) ** p, axis=dims, keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm,
+                           max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        return a * factor
+    return apply_op("renorm", _renorm, x)

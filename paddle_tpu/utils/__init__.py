@@ -1,0 +1,11 @@
+"""paddle.utils equivalent."""
+from . import cpp_extension  # noqa: F401
+from .cpp_extension import custom_op  # noqa: F401
+
+
+def try_import(name):
+    import importlib
+    try:
+        return importlib.import_module(name)
+    except ImportError:
+        return None

@@ -1,0 +1,9 @@
+"""Wheel build (reference: /root/reference/setup.py building the
+paddlepaddle wheel embedding libpaddle.so). The native C++ components
+(TCPStore, shm ring, host tracer — paddle_tpu/native/csrc) are compiled
+on first use against the host toolchain rather than shipped as a binary,
+so the wheel is pure-python + sources; `python -m build` or
+`pip install .` both work from this file alone."""
+from setuptools import setup
+
+setup()

@@ -73,3 +73,22 @@ dist.barrier()
 with open(os.path.join(out_dir, f"ok.{rank}"), "w") as f:
     f.write("ok")
 print(f"rank {rank}: all eager collectives OK")
+
+# RPC over the same store
+def _double(v):
+    return v * 2
+
+
+dist.rpc.init_rpc(name=f"worker{rank}", rank=rank, world_size=world)
+peer = f"worker{1 - rank}"
+out = dist.rpc.rpc_sync(peer, _double, args=(21,))
+assert out == 42, out
+fut = dist.rpc.rpc_async(peer, _double, args=(5,))
+assert fut.wait() == 10
+infos = dist.rpc.get_all_worker_infos()
+assert [w.name for w in infos] == ["worker0", "worker1"], infos
+dist.rpc.shutdown()
+
+with open(os.path.join(out_dir, f"rpc_ok.{rank}"), "w") as f:
+    f.write("ok")
+print(f"rank {rank}: rpc OK")

@@ -145,3 +145,45 @@ def test_scaler_load_state_dict_takes_effect_mid_training():
                             "decr_count": 0})
     step(x, y)
     assert scaler.state_dict()["scale"] == 1024.0
+
+
+def test_run_steps_matches_loop():
+    """Multi-step scanned TrainStep (run_steps) computes the same params
+    and loss as N separate step calls."""
+    import jax
+    jax.config.update("jax_default_matmul_precision", "highest")
+    x, y = _data(7)
+
+    def build():
+        paddle.seed(0)
+        net = Net()
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=net.parameters())
+        return net, TrainStep(net, _mse, opt)
+
+    n1, s1 = build()
+    for _ in range(5):
+        l1 = s1(x, y)
+    n2, s2 = build()
+    l2 = s2.run_steps(5, x, y)
+    np.testing.assert_allclose(float(l1.numpy()), float(l2.numpy()),
+                               rtol=2e-5)
+    for (n, p), (_, q) in zip(n1.named_parameters(), n2.named_parameters()):
+        np.testing.assert_allclose(np.asarray(p.numpy()),
+                                   np.asarray(q.numpy()), rtol=2e-5,
+                                   err_msg=n)
+    # optimizer step counter advanced by the full window
+    assert s2.optimizer._step_count == 5
+
+
+def test_run_steps_with_scaler():
+    paddle.seed(0)
+    net = Net()
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+    scaler = GradScaler(init_loss_scaling=2.0 ** 8, incr_every_n_steps=4)
+    step = TrainStep(net, _mse, opt, scaler=scaler)
+    x, y = _data(8)
+    step.run_steps(8, x, y)
+    # 8 good steps with incr_every=4 -> scale doubled twice
+    assert scaler.state_dict()["scale"] == 2.0 ** 10

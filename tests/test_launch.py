@@ -24,6 +24,8 @@ def test_launch_two_ranks_eager_collectives(tmp_path):
     assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
     assert (tmp_path / "ok.0").exists()
     assert (tmp_path / "ok.1").exists()
+    assert (tmp_path / "rpc_ok.0").exists()
+    assert (tmp_path / "rpc_ok.1").exists()
 
 
 def test_launch_propagates_failure(tmp_path):

@@ -1,0 +1,144 @@
+"""Op registry: yaml source of truth + compat aliasing + coverage target
+(reference: ops.yaml/op_compat.yaml; SURVEY §7.2 ~350-op target)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.ops import registry
+
+
+def test_coverage_meets_target():
+    assert len(registry.op_names()) >= 350
+
+
+def test_every_entry_resolves_to_callable():
+    bad = []
+    for name in registry.op_names():
+        try:
+            fn = registry.resolve(name)
+            if not callable(fn):
+                bad.append(name)
+        except Exception as e:  # noqa: BLE001
+            bad.append(f"{name}: {e}")
+    assert not bad, bad[:20]
+
+
+def test_compat_aliases_resolve():
+    # op_compat.yaml rename pairs must round-trip to live callables
+    for old, new in [("elementwise_add", "add"),
+                     ("reduce_sum", "sum"),
+                     ("lookup_table_v2", "embedding"),
+                     ("fill_constant", "full"),
+                     ("expand_v2", "expand"),
+                     ("hard_sigmoid", "hardsigmoid")]:
+        assert registry.compat_name(old) == new, old
+        assert callable(registry.resolve(old))
+
+
+def test_resolved_op_computes():
+    add = registry.resolve("elementwise_add")
+    out = add(paddle.to_tensor(np.array([1.0], np.float32)),
+              paddle.to_tensor(np.array([2.0], np.float32)))
+    assert float(out.numpy()) == 3.0
+
+
+def test_unknown_op_raises():
+    import pytest
+    with pytest.raises(KeyError, match="not in the registry"):
+        registry.resolve("definitely_not_an_op")
+
+
+class TestMiscCoverage:
+    """Memory stats, monitor registry, callbacks, BERT, autotune cache,
+    custom-op toolchain — VERDICT coverage rows 2, 12, 15, 44, 48."""
+
+    def test_memory_stats_surface(self):
+        cur = paddle.device.memory_allocated()
+        peak = paddle.device.max_memory_allocated()
+        assert peak >= cur >= 0
+        assert paddle.device.cuda.memory_allocated() >= 0
+        paddle.device.reset_peak_memory_stats()
+
+    def test_monitor_registry(self):
+        from paddle_tpu.framework import monitor
+        monitor.stat_reset()
+        monitor.stat_add("x", 2)
+        monitor.stat_add("x", 3)
+        assert monitor.stat_get("x") == 5
+        assert "x" in monitor.stat_names()
+        monitor.stat_reset("x")
+        assert monitor.stat_get("x") == 0
+
+    def test_hapi_callbacks_early_stopping(self, tmp_path):
+        from paddle_tpu.vision.datasets import FakeMNIST
+        from paddle_tpu.vision.models import LeNet
+        from paddle_tpu.hapi.callbacks import EarlyStopping, ModelCheckpoint
+        paddle.seed(0)
+        m = paddle.Model(LeNet())
+        m.prepare(paddle.optimizer.Adam(learning_rate=1e-3,
+                                        parameters=m.parameters()),
+                  paddle.nn.CrossEntropyLoss())
+        es = EarlyStopping(monitor="loss", patience=0, verbose=0)
+        ck = ModelCheckpoint(save_freq=1, save_dir=str(tmp_path))
+        ds = FakeMNIST(n=32)
+        m.fit(ds, eval_data=ds, epochs=4, batch_size=16, verbose=0,
+              callbacks=[es, ck])
+        assert (tmp_path / "final.pdparams").exists()
+
+    def test_bert_family_trains(self):
+        from paddle_tpu.models import (BertForSequenceClassification,
+                                       bert_tiny)
+        import numpy as np
+        paddle.seed(0)
+        m = BertForSequenceClassification(bert_tiny(), num_classes=3)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=m.parameters())
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(rng.randint(0, 256, (4, 32)).astype("int64"))
+        y = paddle.to_tensor(rng.randint(0, 3, (4,)).astype("int64"))
+        lossf = paddle.nn.CrossEntropyLoss()
+        losses = []
+        for _ in range(5):
+            loss = lossf(m(ids), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+    def test_autotune_cache_roundtrip(self, tmp_path, monkeypatch):
+        from paddle_tpu.ops import autotune
+        monkeypatch.setenv("PADDLE_TPU_AUTOTUNE_CACHE",
+                           str(tmp_path / "at.json"))
+        autotune._cache.clear()
+        autotune._loaded = False
+        autotune._cache["mha_fwd/test"] = [256, 128]
+        autotune._save()
+        autotune._cache.clear()
+        autotune._loaded = False
+        autotune._load()
+        assert autotune._cache["mha_fwd/test"] == [256, 128]
+
+    def test_custom_op_decorator(self):
+        import numpy as np
+        from paddle_tpu.utils import custom_op
+
+        @custom_op("quad", backward=lambda res, g: (g * 4.0,))
+        def quad(x):
+            return x * 4.0
+
+        x = paddle.to_tensor(np.array([1.5], np.float32),
+                             stop_gradient=False)
+        y = quad(x)
+        y.sum().backward()
+        assert float(y.numpy()) == 6.0
+        assert float(x.grad.numpy()) == 4.0
+
+    def test_fft_module(self):
+        import numpy as np
+        x = np.random.RandomState(0).randn(8).astype(np.float32)
+        out = paddle.fft.fft(paddle.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   np.fft.fft(x), rtol=1e-4, atol=1e-4)
+        out2 = paddle.fft.irfft(paddle.fft.rfft(paddle.to_tensor(x)))
+        np.testing.assert_allclose(np.asarray(out2.numpy()), x, rtol=1e-4,
+                                   atol=1e-4)
