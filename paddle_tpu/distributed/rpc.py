@@ -59,12 +59,20 @@ def _serve(rank, start):
             continue
         except Exception:
             return
-        caller, seq, fn, args, kwargs = pickle.loads(payload)
+        # a malformed/unpicklable message must not kill the serve loop —
+        # every later RPC to this rank would then hang to timeout
+        caller = seq = None
         try:
+            caller, seq, fn, args, kwargs = pickle.loads(payload)
             result = (True, fn(*args, **kwargs))
         except Exception as e:  # noqa: BLE001 — marshalled to caller
             result = (False, repr(e))
-        store.set(f"rpc/res/{caller}/{seq}", pickle.dumps(result))
+        try:
+            blob = pickle.dumps(result)
+        except Exception as e:  # noqa: BLE001 — unpicklable return value
+            blob = pickle.dumps((False, f"unpicklable rpc result: {e!r}"))
+        if caller is not None:
+            store.set(f"rpc/res/{caller}/{seq}", blob)
         store.delete(key)
         n += 1
 
@@ -74,18 +82,22 @@ def init_rpc(name: str, rank: Optional[int] = None,
     env.init_parallel_env()
     rank = env.global_rank() if rank is None else rank
     store = _store()
-    store.set(f"rpc/name/{rank}", name.encode())
-    _state.update(running=True, name=name)
-    # resume the mailbox where a previous rpc session left it (the
-    # rpc/next counter persists in the store across init/shutdown cycles)
+    # read the mailbox resume point BEFORE becoming addressable (name
+    # publish / end-of-init barrier): a peer's first send must not land
+    # between the read and the server start, or its index gets skipped
     start = int(store.add(f"rpc/next/{rank}", 0))
+    _state.update(running=True, name=name)
     t = threading.Thread(target=_serve, args=(rank, start), daemon=True)
     _state["thread"] = t
     t.start()
+    store.set(f"rpc/name/{rank}", name.encode())
     # resolve peer names
     world = env.get_world_size() if world_size is None else world_size
     for r in range(world):
         _state["names"][store.wait(f"rpc/name/{r}", _TIMEOUT).decode()] = r
+    # all servers live before anyone issues an rpc
+    from .communication.collective import barrier
+    barrier()
 
 
 class _Future:

@@ -15,6 +15,7 @@ import jax
 
 _peak = {}
 _reserved_peak = {}
+_reset_floor = {}  # device -> PJRT peak_bytes_in_use at last reset
 
 
 def _device(device=None):
@@ -51,7 +52,17 @@ def max_memory_allocated(device=None) -> int:
     dev = _device(device)
     stats = dev.memory_stats() if hasattr(dev, "memory_stats") else None
     if stats and "peak_bytes_in_use" in stats:
-        return int(stats["peak_bytes_in_use"])
+        dev_peak = int(stats["peak_bytes_in_use"])
+        floor = _reset_floor.get(id(dev))
+        if floor is None:
+            return dev_peak
+        # PJRT's peak is monotonic; after a reset, report the device peak
+        # only once it exceeds the value at reset time, else the observed
+        # current-usage peak since the reset
+        if dev_peak > floor:
+            return dev_peak
+        memory_allocated(device)
+        return int(_peak.get(id(dev), 0))
     memory_allocated(device)  # refresh observed peak
     return int(_peak.get(id(dev), 0))
 
@@ -70,7 +81,11 @@ def max_memory_reserved(device=None) -> int:
 
 
 def reset_peak_memory_stats(device=None):
-    _peak.pop(id(_device(device)), None)
+    dev = _device(device)
+    _peak.pop(id(dev), None)
+    stats = dev.memory_stats() if hasattr(dev, "memory_stats") else None
+    if stats and "peak_bytes_in_use" in stats:
+        _reset_floor[id(dev)] = int(stats["peak_bytes_in_use"])
 
 
 def empty_cache():

@@ -194,13 +194,23 @@ class EarlyStopping(Callback):
         if self._op(v, self.best):
             self.best = v
             self.wait = 0
+            if self.save_best_model and self.model is not None:
+                net = getattr(self.model, "network", None)
+                if net is not None:
+                    self._best_state = {
+                        k: np.asarray(t.numpy()).copy()
+                        for k, t in net.state_dict().items()}
         else:
             self.wait += 1
             if self.wait > self.patience:
                 self.model.stop_training = True
+                if self.save_best_model and \
+                        getattr(self, "_best_state", None) is not None:
+                    self.model.network.set_state_dict(self._best_state)
                 if self.verbose:
                     print(f"EarlyStopping: no {self.monitor} improvement "
-                          f"for {self.wait} evals; stopping")
+                          f"for {self.wait} evals; stopping (best "
+                          f"{self.monitor}={self.best:.4f} restored)")
 
 
 def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,

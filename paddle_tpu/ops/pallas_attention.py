@@ -249,7 +249,12 @@ def _mha_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
         dv_ref[:] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _mha_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k):
+def _mha_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
+             lse_ct=None):
+    """dq/dk/dv via the blocked kernels. ``lse_ct`` (optional [b,h,sq])
+    is a cotangent on the logsumexp output: since ds = p*(dp - di), a
+    cotangent g_lse on lse contributes ds += p*g_lse, which folds in
+    exactly as di -= g_lse (used by the ring-attention chunk combine)."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
     qr = q.reshape(b * h, sq, d)
@@ -259,6 +264,8 @@ def _mha_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k):
     # delta_i = rowsum(dO * O): cheap elementwise reduce, leave it to XLA,
     # replicate across the 128-lane stat layout
     di = jnp.sum(out.astype(jnp.float32) * g.astype(jnp.float32), axis=-1)
+    if lse_ct is not None:
+        di = di - lse_ct.astype(jnp.float32).reshape(b * h, sq)
     di = jnp.broadcast_to(di.reshape(b * h, sq, 1), (b * h, sq, LANES))
 
     dq_kernel = functools.partial(_mha_bwd_dq_kernel, sm_scale=sm_scale,
