@@ -54,7 +54,9 @@ from .tensor import creation as _creation  # noqa: F401
 from . import amp  # noqa: F401
 from . import autograd  # noqa: F401
 from . import device  # noqa: F401
+from . import audio  # noqa: F401
 from . import fft  # noqa: F401
+from . import geometric  # noqa: F401
 from .hapi import callbacks  # noqa: F401
 from . import distribution  # noqa: F401
 from . import distributed  # noqa: F401

@@ -1,0 +1,12 @@
+"""paddle.audio — spectral feature layers + functional windows/mels.
+
+Reference: /root/reference/python/paddle/audio/ (features/layers.py
+Spectrogram/MelSpectrogram/LogMelSpectrogram/MFCC over functional/
+window.py get_window + functional.py compute_fbank_matrix, backed by
+paddle's fft ops). TPU-native: framing is a strided gather and the STFT
+is jnp.fft — everything jits and fuses on the accelerator.
+"""
+from . import functional  # noqa: F401
+from .features import (  # noqa: F401
+    LogMelSpectrogram, MelSpectrogram, MFCC, Spectrogram,
+)

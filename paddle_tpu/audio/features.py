@@ -1,0 +1,112 @@
+"""audio.features — Spectrogram / MelSpectrogram / LogMelSpectrogram /
+MFCC layers (reference: audio/features/layers.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+from ..nn.layer.layers import Layer
+from . import functional as AF
+
+
+def _frame(x, frame_length, hop_length):
+    """[..., T] -> [..., n_frames, frame_length] via strided gather."""
+    n = (x.shape[-1] - frame_length) // hop_length + 1
+    starts = jnp.arange(n) * hop_length
+    idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+    return x[..., idx]
+
+
+def _stft_power(x, n_fft, hop_length, win, power, center):
+    if center:
+        pad = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        x = jnp.pad(x, pad, mode="reflect")
+    frames = _frame(x, n_fft, hop_length) * win
+    spec = jnp.fft.rfft(frames, n=n_fft, axis=-1)
+    mag = jnp.abs(spec)
+    out = mag if power == 1.0 else mag ** power
+    return jnp.swapaxes(out, -1, -2)  # [..., n_freqs, n_frames]
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.power = power
+        self.center = center
+        wl = win_length or n_fft
+        w = AF.get_window(window, wl, dtype=dtype)._data
+        if wl < n_fft:  # center-pad the window to n_fft
+            lp = (n_fft - wl) // 2
+            w = jnp.pad(w, (lp, n_fft - wl - lp))
+        self._win = w
+
+    def forward(self, x):
+        cfg = dict(n_fft=self.n_fft, hop=self.hop_length, power=self.power,
+                   center=self.center)
+        win = self._win
+        return apply_op(
+            "spectrogram",
+            lambda a: _stft_power(a, cfg["n_fft"], cfg["hop"], win,
+                                  cfg["power"], cfg["center"]), x)
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 n_mels=64, f_min=50.0, f_max=None, htk=False,
+                 norm="slaney", dtype="float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                       window, power, center, dtype=dtype)
+        self._fbank = AF.compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm, dtype)._data
+
+    def forward(self, x):
+        spec = self.spectrogram(x)
+        fb = self._fbank
+        return apply_op("mel_spectrogram",
+                        lambda s: jnp.einsum("mf,...ft->...mt", fb, s),
+                        spec)
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 n_mels=64, f_min=50.0, f_max=None, htk=False,
+                 norm="slaney", ref_value=1.0, amin=1e-10, top_db=None,
+                 dtype="float32"):
+        super().__init__()
+        self.mel = MelSpectrogram(sr, n_fft, hop_length, win_length,
+                                  window, power, center, n_mels, f_min,
+                                  f_max, htk, norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return AF.power_to_db(self.mel(x), self.ref_value, self.amin,
+                              self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 n_mels=64, f_min=50.0, f_max=None, htk=False,
+                 norm="slaney", ref_value=1.0, amin=1e-10, top_db=None,
+                 dtype="float32"):
+        super().__init__()
+        self.log_mel = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            n_mels, f_min, f_max, htk, norm, ref_value, amin, top_db,
+            dtype)
+        self._dct = AF.create_dct(n_mfcc, n_mels, dtype=dtype)._data
+
+    def forward(self, x):
+        lm = self.log_mel(x)
+        dct = self._dct
+        return apply_op("mfcc",
+                        lambda s: jnp.einsum("mk,...mt->...kt", dct, s), lm)

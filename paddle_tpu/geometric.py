@@ -1,0 +1,109 @@
+"""paddle.geometric — graph message passing + segment reductions.
+
+Reference: /root/reference/python/paddle/geometric/ (message_passing/
+send_recv.py send_u_recv/send_ue_recv/send_uv backed by the
+graph_send_recv C++/CUDA ops; math.py segment_sum/mean/max/min over
+phi segment_pool kernels). TPU-native: jax.ops.segment_* — XLA lowers
+segment reductions to sorted scatter-adds that run on-chip.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .core.dispatch import apply_op
+
+__all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
+           "segment_mean", "segment_max", "segment_min"]
+
+_SEG = {
+    "sum": jax.ops.segment_sum,
+    "mean": None,  # composed from sum / count
+    "max": jax.ops.segment_max,
+    "min": jax.ops.segment_min,
+}
+
+
+def _num_segments(count, ids):
+    if count is None:
+        raise ValueError(
+            "out_size/num_segments is required on TPU (static shapes); "
+            "pass out_size=<number of destination nodes>")
+    return int(count)
+
+
+def _segment(data, ids, pool, n):
+    if pool == "mean":
+        s = jax.ops.segment_sum(data, ids, n)
+        c = jax.ops.segment_sum(jnp.ones((data.shape[0],), data.dtype),
+                                ids, n)
+        return s / jnp.maximum(c, 1)[(...,) + (None,) * (data.ndim - 1)]
+    return _SEG[pool](data, ids, n)
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather source-node features along edges, reduce at destinations
+    (reference send_recv.py:30 / graph_send_recv op)."""
+    n = _num_segments(out_size, dst_index)
+
+    def fn(x, si, di):
+        return _segment(x[si.astype(jnp.int32)], di.astype(jnp.int32),
+                        reduce_op, n)
+
+    return apply_op("send_u_recv", fn, x, src_index, dst_index)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Like send_u_recv but combines node features with EDGE features
+    first (reference send_recv.py:141)."""
+    n = _num_segments(out_size, dst_index)
+    combine = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+               "div": jnp.divide}[message_op]
+
+    def fn(x, e, si, di):
+        msg = combine(x[si.astype(jnp.int32)], e)
+        return _segment(msg, di.astype(jnp.int32), reduce_op, n)
+
+    return apply_op("send_ue_recv", fn, x, y, src_index, dst_index)
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message from source AND destination node features
+    (reference send_recv.py:260)."""
+    combine = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+               "div": jnp.divide}[message_op]
+
+    def fn(x, y, si, di):
+        return combine(x[si.astype(jnp.int32)], y[di.astype(jnp.int32)])
+
+    return apply_op("send_uv", fn, x, y, src_index, dst_index)
+
+
+def _segment_api(pool):
+    def op(data, segment_ids, num_segments=None, name=None):
+        if num_segments is None:
+            ids = segment_ids._data if hasattr(segment_ids, "_data") \
+                else segment_ids
+            if isinstance(ids, jax.core.Tracer):
+                raise ValueError(
+                    f"segment_{pool} needs num_segments under jit "
+                    f"(static shapes on TPU); pass num_segments=<count>")
+            import numpy as np
+            num_segments = int(np.max(np.asarray(ids))) + 1
+        n = int(num_segments)
+
+        def fn(d, ids):
+            return _segment(d, ids.astype(jnp.int32), pool, n)
+
+        return apply_op(f"segment_{pool}", fn, data, segment_ids)
+
+    op.__name__ = f"segment_{pool}"
+    return op
+
+
+segment_sum = _segment_api("sum")
+segment_mean = _segment_api("mean")
+segment_max = _segment_api("max")
+segment_min = _segment_api("min")

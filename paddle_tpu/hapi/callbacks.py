@@ -182,6 +182,14 @@ class EarlyStopping(Callback):
             v = float(np.asarray(v).reshape(-1)[0])
         return v
 
+    def _snapshot(self):
+        if self.save_best_model and self.model is not None:
+            net = getattr(self.model, "network", None)
+            if net is not None:
+                self._best_state = {
+                    k: np.asarray(t.numpy()).copy()
+                    for k, t in net.state_dict().items()}
+
     def on_eval_end(self, logs=None):
         v = self._value(logs)
         if v is None:
@@ -189,17 +197,13 @@ class EarlyStopping(Callback):
         if self.best is None:
             # first eval establishes the baseline; it is not a "wait"
             self.best = v if self.baseline is None else self.baseline
+            self._snapshot()
             if self.baseline is None:
                 return
         if self._op(v, self.best):
             self.best = v
             self.wait = 0
-            if self.save_best_model and self.model is not None:
-                net = getattr(self.model, "network", None)
-                if net is not None:
-                    self._best_state = {
-                        k: np.asarray(t.numpy()).copy()
-                        for k, t in net.state_dict().items()}
+            self._snapshot()
         else:
             self.wait += 1
             if self.wait > self.patience:

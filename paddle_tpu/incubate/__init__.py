@@ -3,3 +3,4 @@ MoE). """
 from . import autograd  # noqa: F401
 from . import nn  # noqa: F401
 from . import distributed  # noqa: F401
+from . import asp  # noqa: F401

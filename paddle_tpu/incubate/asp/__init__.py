@@ -1,0 +1,101 @@
+"""incubate.asp — automatic structured (n:m) sparsity.
+
+Reference: /root/reference/python/paddle/incubate/asp/ (asp.py
+decorate/prune_model/set_excluded_layers, utils.py n:m mask generation
+get_mask_1d/get_mask_2d_best, supported_layers_and_prune_func_map).
+
+TPU-native: the pruning mask is computed host-side per weight (keep the
+n largest-|w| of every m consecutive elements along the input dim),
+applied once by prune_model and re-applied after each optimizer step by
+the decorated optimizer — the reference's masking semantics without the
+sparse tensor-core execution path (XLA treats the zeros as dense; the
+capability is training-time sparsification parity).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...nn.layer.common import Linear
+from ...nn.layer.conv import Conv2D
+
+__all__ = ["decorate", "prune_model", "set_excluded_layers",
+           "reset_excluded_layers", "calculate_density", "get_mask_1d"]
+
+_excluded = set()
+_masks = {}  # id(param) -> np mask
+
+
+def set_excluded_layers(param_names, main_program=None):
+    for n in (param_names if isinstance(param_names, (list, tuple))
+              else [param_names]):
+        _excluded.add(n)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.clear()
+
+
+def get_mask_1d(weight, n=2, m=4):
+    """Keep the ``n`` largest-magnitude entries of every ``m`` consecutive
+    elements along the last axis (reference utils.py:get_mask_1d)."""
+    w = np.asarray(weight)
+    flat = w.reshape(-1, m) if w.size % m == 0 else None
+    if flat is None:
+        return np.ones_like(w, dtype=bool)
+    order = np.argsort(-np.abs(flat), axis=1)
+    mask = np.zeros_like(flat, dtype=bool)
+    rows = np.arange(flat.shape[0])[:, None]
+    mask[rows, order[:, :n]] = True
+    return mask.reshape(w.shape)
+
+
+def calculate_density(weight) -> float:
+    w = np.asarray(weight.numpy() if hasattr(weight, "numpy") else weight)
+    return float(np.count_nonzero(w)) / max(w.size, 1)
+
+
+def _prunable_params(model):
+    for layer in model.sublayers(include_self=True):
+        if isinstance(layer, (Linear, Conv2D)) and \
+                hasattr(layer, "weight"):
+            p = layer.weight
+            if getattr(p, "name", None) in _excluded:
+                continue
+            yield p
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Compute + apply n:m masks on every supported layer's weight
+    (reference asp.py:prune_model)."""
+    import jax.numpy as jnp
+    pruned = {}
+    for p in _prunable_params(model):
+        mask = get_mask_1d(np.asarray(p.numpy()), n, m)
+        _masks[id(p)] = mask
+        p._data = (p._data * jnp.asarray(mask, p._data.dtype))
+        pruned[getattr(p, "name", str(id(p)))] = float(mask.mean())
+    return pruned
+
+
+class _ASPOptimizer:
+    """Re-applies the sparsity masks after every step (reference
+    OptimizerWithSparsityGuarantee)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def step(self):
+        self._inner.step()
+        import jax.numpy as jnp
+        for p in (self._inner._parameters or []):
+            mask = _masks.get(id(p))
+            if mask is not None:
+                p._data = p._data * jnp.asarray(mask, p._data.dtype)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def decorate(optimizer):
+    return _ASPOptimizer(optimizer)

@@ -181,6 +181,13 @@ def save(layer, path, input_spec=None, **configs):
 
     specs, names = _specs_from_input_spec(input_spec)
     params, buffers = state_arrays(layer)
+    # materialize to host: weights trained under a mesh are committed to
+    # multi-device shardings, and any such array reaching the export trace
+    # (e.g. as a closure constant) conflicts with the single-device serving
+    # arguments; np.asarray gathers the global value
+    import numpy as _np
+    params = {n: _np.asarray(v) for n, v in params.items()}
+    buffers = {n: _np.asarray(v) for n, v in buffers.items()}
     weights = {**{f"p.{n}": v for n, v in params.items()},
                **{f"b.{n}": v for n, v in buffers.items()}}
     wnames = sorted(weights)

@@ -10,3 +10,7 @@ from .bert import (  # noqa: F401
     BertForSequenceClassification, BertPretrainingCriterion, bert_tiny,
     bert_base,
 )
+from .ernie import (  # noqa: F401
+    ErnieConfig, ErnieModel, ErnieForSequenceClassification, ernie_tiny,
+    ernie_base, ernie_3_0_10b,
+)

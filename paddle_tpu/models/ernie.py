@@ -1,0 +1,89 @@
+"""ERNIE encoder family — the BASELINE config-5 model
+("ERNIE-3.0 10B sharded training + static-graph inference serve").
+
+Reference: the reference repo keeps ERNIE in external repos driven by
+fleet sharded training (SURVEY §6); architecturally ERNIE is a BERT-style
+encoder with task-id embeddings. It reuses the TP-able BERT blocks here;
+the 10B preset carries the dist_spec sharding (ZeRO over the 'sharding'
+axis + TP over 'mp') through the same TrainStep SPMD path the GPT
+flagship uses.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .bert import (BertConfig, BertEmbeddings, BertLayer, BertPooler)
+from ..nn import initializer as I
+from ..nn.initializer_utils import create_parameter_with_attr
+from ..nn.layer.common import Dropout, Linear
+from ..nn.layer.container import LayerList
+from ..nn.layer.layers import Layer
+
+__all__ = ["ErnieConfig", "ErnieModel", "ErnieForSequenceClassification",
+           "ernie_tiny", "ernie_base", "ernie_3_0_10b"]
+
+ErnieConfig = BertConfig
+
+
+def ernie_tiny(**kw) -> ErnieConfig:
+    d = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+             intermediate_size=128, max_position_embeddings=128,
+             hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    d.update(kw)
+    return ErnieConfig(**d)
+
+
+def ernie_base(**kw) -> ErnieConfig:
+    return ErnieConfig(**kw)
+
+
+def ernie_3_0_10b(**kw) -> ErnieConfig:
+    """~10B-parameter preset (BASELINE config 5 scale)."""
+    d = dict(vocab_size=50304, hidden_size=4096, num_layers=48,
+             num_heads=32, intermediate_size=16384,
+             max_position_embeddings=2048)
+    d.update(kw)
+    return ErnieConfig(**d)
+
+
+class ErnieModel(Layer):
+    """BERT-style encoder + ERNIE task-type embedding."""
+
+    def __init__(self, config: ErnieConfig, task_type_vocab_size: int = 16):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        init = I.Normal(std=config.initializer_range)
+        self.task_type_embeddings = create_parameter_with_attr(
+            [task_type_vocab_size, config.hidden_size], self._dtype, None,
+            False, default_initializer=init)
+        self.encoder = LayerList([BertLayer(config)
+                                  for _ in range(config.num_layers)])
+        self.pooler = BertPooler(config)
+
+    def forward(self, input_ids, token_type_ids=None, task_type_ids=None,
+                attention_mask=None):
+        h = self.embeddings(input_ids, token_type_ids)
+        if task_type_ids is not None:
+            from ..nn.functional.common import embedding as F_embedding
+            h = h + F_embedding(task_type_ids, self.task_type_embeddings)
+        for layer in self.encoder:
+            h = layer(h, attention_mask)
+        return h, self.pooler(h)
+
+    def num_params(self) -> int:
+        return sum(int(np.prod(p.shape)) for p in self.parameters())
+
+
+class ErnieForSequenceClassification(Layer):
+    def __init__(self, config: ErnieConfig, num_classes: int = 2):
+        super().__init__()
+        self.ernie = ErnieModel(config)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+        self.classifier = Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, task_type_ids=None,
+                attention_mask=None):
+        _, pooled = self.ernie(input_ids, token_type_ids, task_type_ids,
+                               attention_mask)
+        return self.classifier(self.dropout(pooled))

@@ -15,8 +15,10 @@ import jax.numpy as jnp
 
 
 def _on_tpu() -> bool:
+    """True only on an actual TPU backend — the Pallas kernels carry
+    pltpu compiler params that no other platform can compile."""
     try:
-        return jax.devices()[0].platform.lower() not in ("cpu",)
+        return jax.devices()[0].platform.lower() == "tpu"
     except Exception:  # pragma: no cover
         return False
 

@@ -119,7 +119,10 @@ def _flash_chunk(q, k, v, causal, sm_scale):
 
 
 def _flash_chunk_fwd(q, k, v, causal, sm_scale):
-    return _flash_chunk(q, k, v, causal, sm_scale), (q, k, v)
+    out, lse = _flash_chunk(q, k, v, causal, sm_scale)
+    # out/lse are O(s_loc*d)/O(s_loc) — saving them beats re-running the
+    # forward kernel in the backward (the standard flash residual set)
+    return (out, lse), (q, k, v, out, lse)
 
 
 def _flash_chunk_bwd(causal, sm_scale, res, cts):
@@ -128,13 +131,16 @@ def _flash_chunk_bwd(causal, sm_scale, res, cts):
     exactly the long-sequence regime ring attention exists for). The lse
     cotangent from the chunk-combine folds into the kernels' di row
     statistic (see _mha_bwd lse_ct)."""
-    from .pallas_attention import _mha_bwd, _mha_fwd
+    from .pallas_attention import LANES, _mha_bwd
 
-    q, k, v = res
+    q, k, v, out, lse_rows = res
     g_out, g_lse = cts
-    out, lse = _mha_fwd(q, k, v, causal, sm_scale, 128, 128)
-    dq, dk, dv = _mha_bwd(q, k, v, out, lse, g_out.astype(q.dtype),
-                          causal, sm_scale, 128, 128, lse_ct=g_lse)
+    b, h, s, d = q.shape
+    # rebuild the kernels' lane-replicated lse layout from the row stat
+    lse = jnp.broadcast_to(lse_rows.reshape(b * h, s, 1), (b * h, s, LANES))
+    dq, dk, dv = _mha_bwd(q, k, v, out.astype(q.dtype), lse,
+                          g_out.astype(q.dtype), causal, sm_scale, 128,
+                          128, lse_ct=g_lse)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
@@ -164,11 +170,8 @@ def ring_attention(q, k, v, mesh: Mesh, seq_axis: str = "sep",
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     axis_size = mesh.shape[seq_axis]
     if use_flash is None:
-        try:
-            on_tpu = jax.devices()[0].platform.lower() != "cpu"
-        except Exception:  # pragma: no cover
-            on_tpu = False
-        use_flash = on_tpu and flash_ring_supported(q, axis_size)
+        from .flash_attention import _on_tpu
+        use_flash = _on_tpu() and flash_ring_supported(q, axis_size)
     baxes = tuple(a for a in batch_axes
                   if a in mesh.axis_names and mesh.shape[a] > 1)
     nb = 1

@@ -5,3 +5,5 @@ from .resnet import (  # noqa: F401
 )
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 from .mobilenetv2 import MobileNetV2, mobilenet_v2  # noqa: F401
+from .alexnet import AlexNet, alexnet  # noqa: F401
+from .vit import VisionTransformer, vit_b_16, vit_s_16  # noqa: F401

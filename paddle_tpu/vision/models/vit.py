@@ -1,0 +1,86 @@
+"""Vision Transformer (reference vision zoo ViT; TPU-native: patch
+embedding is one conv, encoder blocks share the flash-attention path)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import nn
+from ...nn import functional as F
+from ...nn.initializer_utils import create_parameter_with_attr
+from ...nn import initializer as I
+
+
+class PatchEmbed(nn.Layer):
+    def __init__(self, img_size=224, patch_size=16, in_chans=3, embed_dim=768):
+        super().__init__()
+        self.num_patches = (img_size // patch_size) ** 2
+        self.proj = nn.Conv2D(in_chans, embed_dim, patch_size,
+                              stride=patch_size)
+
+    def forward(self, x):
+        x = self.proj(x)                       # [B, E, H/ps, W/ps]
+        b, e = x.shape[0], x.shape[1]
+        return x.reshape([b, e, -1]).transpose([0, 2, 1])  # [B, N, E]
+
+
+class ViTBlock(nn.Layer):
+    def __init__(self, dim, num_heads, mlp_ratio=4.0, dropout=0.0):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(dim)
+        self.attn = nn.MultiHeadAttention(dim, num_heads, dropout=dropout)
+        self.ln2 = nn.LayerNorm(dim)
+        hidden = int(dim * mlp_ratio)
+        self.mlp = nn.Sequential(nn.Linear(dim, hidden), nn.GELU(),
+                                 nn.Dropout(dropout),
+                                 nn.Linear(hidden, dim),
+                                 nn.Dropout(dropout))
+
+    def forward(self, x):
+        x = x + self.attn(self.ln1(x))
+        return x + self.mlp(self.ln2(x))
+
+
+class VisionTransformer(nn.Layer):
+    def __init__(self, img_size=224, patch_size=16, in_chans=3,
+                 num_classes=1000, embed_dim=768, depth=12, num_heads=12,
+                 mlp_ratio=4.0, dropout=0.0):
+        super().__init__()
+        self.patch_embed = PatchEmbed(img_size, patch_size, in_chans,
+                                      embed_dim)
+        n = self.patch_embed.num_patches
+        init = I.Normal(std=0.02)
+        self.cls_token = create_parameter_with_attr(
+            [1, 1, embed_dim], self._dtype, None, False,
+            default_initializer=init)
+        self.pos_embed = create_parameter_with_attr(
+            [1, n + 1, embed_dim], self._dtype, None, False,
+            default_initializer=init)
+        self.blocks = nn.LayerList([
+            ViTBlock(embed_dim, num_heads, mlp_ratio, dropout)
+            for _ in range(depth)])
+        self.norm = nn.LayerNorm(embed_dim)
+        self.head = nn.Linear(embed_dim, num_classes)
+
+    def forward(self, x):
+        from ...tensor.manipulation import concat, expand
+        x = self.patch_embed(x)
+        b = x.shape[0]
+        cls = expand(self.cls_token, [b, 1, x.shape[-1]])
+        x = concat([cls, x], axis=1) + self.pos_embed
+        for blk in self.blocks:
+            x = blk(x)
+        return self.head(self.norm(x)[:, 0])
+
+
+def vit_b_16(pretrained=False, **kwargs):
+    kwargs.setdefault("embed_dim", 768)
+    kwargs.setdefault("depth", 12)
+    kwargs.setdefault("num_heads", 12)
+    return VisionTransformer(patch_size=16, **kwargs)
+
+
+def vit_s_16(pretrained=False, **kwargs):
+    kwargs.setdefault("embed_dim", 384)
+    kwargs.setdefault("depth", 12)
+    kwargs.setdefault("num_heads", 6)
+    return VisionTransformer(patch_size=16, **kwargs)

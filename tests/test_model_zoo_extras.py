@@ -1,0 +1,188 @@
+"""Audio features, geometric ops, ASP, AlexNet/ViT, ERNIE e2e
+(BASELINE config 5: sharded training + inference serve)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+class TestAudio:
+    def test_spectrogram_parseval_and_shapes(self):
+        t = np.linspace(0, 1, 2048, endpoint=False)
+        x = np.sin(2 * np.pi * 64 * t).astype(np.float32)
+        spec = paddle.audio.Spectrogram(n_fft=256, hop_length=64)(
+            paddle.to_tensor(x))
+        s = _np(spec)
+        assert s.shape[0] == 129
+        # energy concentrates at the tone's bin
+        assert s.mean(axis=1).argmax() == round(64 * 256 / 2048)
+
+    def test_mel_mfcc_shapes(self):
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 2048).astype("float32"))
+        mel = paddle.audio.MelSpectrogram(n_fft=256, n_mels=32)(x)
+        assert list(mel.shape)[:2] == [2, 32]
+        mfcc = paddle.audio.MFCC(n_fft=256, n_mels=32, n_mfcc=13)(x)
+        assert list(mfcc.shape)[:2] == [2, 13]
+
+    def test_fbank_matrix_rows_nonnegative(self):
+        from paddle_tpu.audio.functional import compute_fbank_matrix
+        fb = _np(compute_fbank_matrix(16000, 512, n_mels=40))
+        assert fb.shape == (40, 257)
+        assert (fb >= 0).all()
+        assert (fb.sum(axis=1) > 0).all()
+
+
+class TestGeometric:
+    def test_send_u_recv_oracle(self):
+        x = paddle.to_tensor(np.arange(8, dtype=np.float32).reshape(4, 2))
+        si = paddle.to_tensor(np.array([0, 1, 2, 3]))
+        di = paddle.to_tensor(np.array([1, 1, 0, 0]))
+        out = _np(paddle.geometric.send_u_recv(x, si, di, "sum",
+                                               out_size=2))
+        np.testing.assert_array_equal(out, [[10, 12], [2, 4]])
+        out = _np(paddle.geometric.send_u_recv(x, si, di, "max",
+                                               out_size=2))
+        np.testing.assert_array_equal(out, [[6, 7], [2, 3]])
+
+    def test_send_ue_recv_and_uv(self):
+        x = paddle.to_tensor(np.ones((3, 2), np.float32))
+        e = paddle.to_tensor(np.full((3, 2), 2.0, np.float32))
+        si = paddle.to_tensor(np.array([0, 1, 2]))
+        di = paddle.to_tensor(np.array([0, 0, 1]))
+        out = _np(paddle.geometric.send_ue_recv(x, e, si, di, "mul", "sum",
+                                                out_size=2))
+        np.testing.assert_array_equal(out, [[4, 4], [2, 2]])
+        uv = _np(paddle.geometric.send_uv(x, x, si, di, "add"))
+        np.testing.assert_array_equal(uv, np.full((3, 2), 2.0))
+
+    def test_segment_ops_grad(self):
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(3, 2),
+                             stop_gradient=False)
+        ids = paddle.to_tensor(np.array([0, 0, 1]))
+        out = paddle.geometric.segment_sum(x, ids, num_segments=2)
+        out.sum().backward()
+        np.testing.assert_array_equal(_np(x.grad), np.ones((3, 2)))
+
+
+class TestASP:
+    def test_prune_then_train_keeps_sparsity(self):
+        from paddle_tpu.incubate import asp
+        paddle.seed(0)
+        net = paddle.nn.Linear(16, 16)
+        asp.prune_model(net)
+        assert abs(asp.calculate_density(net.weight) - 0.5) < 1e-6
+        opt = asp.decorate(paddle.optimizer.SGD(
+            learning_rate=0.1, parameters=net.parameters()))
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 16).astype("float32"))
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        # mask survives the update
+        assert abs(asp.calculate_density(net.weight) - 0.5) < 1e-6
+
+    def test_mask_keeps_largest(self):
+        from paddle_tpu.incubate.asp import get_mask_1d
+        w = np.array([[1.0, -5.0, 0.1, 3.0]])
+        m = get_mask_1d(w, 2, 4)
+        np.testing.assert_array_equal(m, [[False, True, False, True]])
+
+
+class TestVisionExtras:
+    def test_alexnet_forward(self):
+        paddle.seed(0)
+        m = paddle.vision.models.alexnet(num_classes=7)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(1, 3, 224, 224).astype("float32"))
+        assert list(m(x).shape) == [1, 7]
+
+    def test_vit_trains(self):
+        paddle.seed(0)
+        from paddle_tpu.vision.models import vit_s_16
+        m = vit_s_16(num_classes=4, img_size=32, depth=2)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=m.parameters())
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(4, 3, 32, 32).astype("float32"))
+        y = paddle.to_tensor(rng.randint(0, 4, (4,)).astype("int64"))
+        lossf = paddle.nn.CrossEntropyLoss()
+        losses = []
+        for _ in range(4):
+            loss = lossf(m(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+
+class TestErnieEndToEnd:
+    def test_ernie_sharded_train_then_serve(self, tmp_path):
+        """BASELINE config 5 shape: ERNIE sharded training (ZeRO axis +
+        mp) then an inference artifact served in a fresh process."""
+        import subprocess
+        import sys
+        import jax
+        jax.config.update("jax_default_matmul_precision", "highest")
+        import paddle_tpu.distributed.fleet as fleet
+        from paddle_tpu.distributed.mesh_utils import set_global_mesh
+        from paddle_tpu.jit import TrainStep
+        from paddle_tpu.models import (ErnieForSequenceClassification,
+                                       ernie_tiny)
+
+        paddle.seed(0)
+        s = fleet.DistributedStrategy()
+        s.hybrid_configs = {"dp_degree": 2, "mp_degree": 2,
+                            "sharding_degree": 2}
+        fleet.init(is_collective=True, strategy=s)
+        m = ErnieForSequenceClassification(ernie_tiny(), num_classes=3)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=m.parameters())
+        lossf = paddle.nn.CrossEntropyLoss()
+        step = TrainStep(m, lambda o, y: lossf(o, y), opt)
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(rng.randint(0, 256, (8, 32)).astype("int64"))
+        y = paddle.to_tensor(rng.randint(0, 3, (8,)).astype("int64"))
+        l0 = float(step(ids, y).numpy())
+        l1 = float(step(ids, y).numpy())
+        assert np.isfinite(l1)
+        set_global_mesh(None)
+        m.to("cpu")  # gather mesh-sharded params for single-device serving
+
+        # export + serve in a fresh process (static inference path)
+        from paddle_tpu.static import InputSpec
+        prefix = str(tmp_path / "ernie")
+        paddle.jit.save(m, prefix,
+                        input_spec=[InputSpec([1, 32], "int64")])
+        probe = paddle.to_tensor(rng.randint(0, 256, (1, 32))
+                                 .astype("int64"))
+        expect = _np(m(probe))
+        code = f"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import sys
+sys.path.insert(0, {repr(str(tmp_path))})
+import paddle_tpu
+from paddle_tpu.inference import Config, create_predictor
+cfg = Config({prefix!r} + ".pdmodel")
+pred = create_predictor(cfg)
+name = pred.get_input_names()[0]
+h = pred.get_input_handle(name)
+h.copy_from_cpu(np.load({repr(str(tmp_path / 'probe.npy'))}))
+pred.run()
+out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+np.save({repr(str(tmp_path / 'served.npy'))}, out)
+"""
+        np.save(tmp_path / "probe.npy", _np(probe))
+        r = subprocess.run([sys.executable, "-c", code],
+                           cwd="/root/repo", capture_output=True,
+                           text=True, timeout=300)
+        assert r.returncode == 0, r.stderr[-2000:]
+        served = np.load(tmp_path / "served.npy")
+        np.testing.assert_allclose(served, expect, rtol=1e-4, atol=1e-4)
