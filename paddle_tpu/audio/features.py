@@ -17,10 +17,11 @@ def _frame(x, frame_length, hop_length):
     return x[..., idx]
 
 
-def _stft_power(x, n_fft, hop_length, win, power, center):
+def _stft_power(x, n_fft, hop_length, win, power, center,
+                pad_mode="reflect"):
     if center:
         pad = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
-        x = jnp.pad(x, pad, mode="reflect")
+        x = jnp.pad(x, pad, mode=pad_mode)
     frames = _frame(x, n_fft, hop_length) * win
     spec = jnp.fft.rfft(frames, n=n_fft, axis=-1)
     mag = jnp.abs(spec)
@@ -37,6 +38,7 @@ class Spectrogram(Layer):
         self.hop_length = hop_length or n_fft // 4
         self.power = power
         self.center = center
+        self.pad_mode = pad_mode
         wl = win_length or n_fft
         w = AF.get_window(window, wl, dtype=dtype)._data
         if wl < n_fft:  # center-pad the window to n_fft
@@ -48,20 +50,22 @@ class Spectrogram(Layer):
         cfg = dict(n_fft=self.n_fft, hop=self.hop_length, power=self.power,
                    center=self.center)
         win = self._win
+        pm = self.pad_mode
         return apply_op(
             "spectrogram",
             lambda a: _stft_power(a, cfg["n_fft"], cfg["hop"], win,
-                                  cfg["power"], cfg["center"]), x)
+                                  cfg["power"], cfg["center"], pm), x)
 
 
 class MelSpectrogram(Layer):
     def __init__(self, sr=22050, n_fft=512, hop_length=None,
                  win_length=None, window="hann", power=2.0, center=True,
-                 n_mels=64, f_min=50.0, f_max=None, htk=False,
-                 norm="slaney", dtype="float32"):
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", dtype="float32"):
         super().__init__()
         self.spectrogram = Spectrogram(n_fft, hop_length, win_length,
-                                       window, power, center, dtype=dtype)
+                                       window, power, center, pad_mode,
+                                       dtype=dtype)
         self._fbank = AF.compute_fbank_matrix(
             sr, n_fft, n_mels, f_min, f_max, htk, norm, dtype)._data
 
@@ -76,13 +80,13 @@ class MelSpectrogram(Layer):
 class LogMelSpectrogram(Layer):
     def __init__(self, sr=22050, n_fft=512, hop_length=None,
                  win_length=None, window="hann", power=2.0, center=True,
-                 n_mels=64, f_min=50.0, f_max=None, htk=False,
-                 norm="slaney", ref_value=1.0, amin=1e-10, top_db=None,
-                 dtype="float32"):
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
         super().__init__()
         self.mel = MelSpectrogram(sr, n_fft, hop_length, win_length,
-                                  window, power, center, n_mels, f_min,
-                                  f_max, htk, norm, dtype)
+                                  window, power, center, pad_mode, n_mels,
+                                  f_min, f_max, htk, norm, dtype)
         self.ref_value = ref_value
         self.amin = amin
         self.top_db = top_db
@@ -95,14 +99,14 @@ class LogMelSpectrogram(Layer):
 class MFCC(Layer):
     def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
                  win_length=None, window="hann", power=2.0, center=True,
-                 n_mels=64, f_min=50.0, f_max=None, htk=False,
-                 norm="slaney", ref_value=1.0, amin=1e-10, top_db=None,
-                 dtype="float32"):
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
         super().__init__()
         self.log_mel = LogMelSpectrogram(
             sr, n_fft, hop_length, win_length, window, power, center,
-            n_mels, f_min, f_max, htk, norm, ref_value, amin, top_db,
-            dtype)
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db, dtype)
         self._dct = AF.create_dct(n_mfcc, n_mels, dtype=dtype)._data
 
     def forward(self, x):

@@ -26,9 +26,14 @@ _SEG = {
 
 def _num_segments(count, ids):
     if count is None:
-        raise ValueError(
-            "out_size/num_segments is required on TPU (static shapes); "
-            "pass out_size=<number of destination nodes>")
+        arr = ids._data if hasattr(ids, "_data") else ids
+        if isinstance(arr, jax.core.Tracer):
+            raise ValueError(
+                "out_size/num_segments is required under jit (static "
+                "shapes on TPU); pass out_size=<number of destination "
+                "nodes>")
+        import numpy as np
+        return int(np.max(np.asarray(arr))) + 1
     return int(count)
 
 

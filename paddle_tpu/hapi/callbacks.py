@@ -195,10 +195,12 @@ class EarlyStopping(Callback):
         if v is None:
             return
         if self.best is None:
-            # first eval establishes the baseline; it is not a "wait"
+            # first eval establishes the baseline; it is not a "wait".
+            # With an explicit baseline, the current weights only become
+            # the restore candidate once a later eval BEATS the baseline.
             self.best = v if self.baseline is None else self.baseline
-            self._snapshot()
             if self.baseline is None:
+                self._snapshot()
                 return
         if self._op(v, self.best):
             self.best = v
@@ -208,13 +210,15 @@ class EarlyStopping(Callback):
             self.wait += 1
             if self.wait > self.patience:
                 self.model.stop_training = True
+                restored = ""
                 if self.save_best_model and \
                         getattr(self, "_best_state", None) is not None:
                     self.model.network.set_state_dict(self._best_state)
+                    restored = (f" (best {self.monitor}={self.best:.4f} "
+                                f"restored)")
                 if self.verbose:
                     print(f"EarlyStopping: no {self.monitor} improvement "
-                          f"for {self.wait} evals; stopping (best "
-                          f"{self.monitor}={self.best:.4f} restored)")
+                          f"for {self.wait} evals; stopping{restored}")
 
 
 def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,

@@ -38,11 +38,15 @@ def reset_excluded_layers(main_program=None):
 
 def get_mask_1d(weight, n=2, m=4):
     """Keep the ``n`` largest-magnitude entries of every ``m`` consecutive
-    elements along the last axis (reference utils.py:get_mask_1d)."""
+    elements along the last axis (reference utils.py:get_mask_1d).
+    Raises for shapes that don't tile into groups of ``m`` (silently
+    returning a dense mask would fake sparsification)."""
     w = np.asarray(weight)
-    flat = w.reshape(-1, m) if w.size % m == 0 else None
-    if flat is None:
-        return np.ones_like(w, dtype=bool)
+    if w.size % m != 0:
+        raise ValueError(
+            f"weight with {w.size} elements cannot be {n}:{m}-pruned "
+            f"(size must divide by {m})")
+    flat = w.reshape(-1, m)
     order = np.argsort(-np.abs(flat), axis=1)
     mask = np.zeros_like(flat, dtype=bool)
     rows = np.arange(flat.shape[0])[:, None]
@@ -67,12 +71,22 @@ def _prunable_params(model):
 
 def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
     """Compute + apply n:m masks on every supported layer's weight
-    (reference asp.py:prune_model)."""
+    (reference asp.py:prune_model). Layers whose weight size doesn't
+    tile into groups of ``m`` are skipped with a warning. The mask is
+    also attached to the parameter (``_asp_mask``) so the compiled
+    TrainStep re-applies it after every in-graph update."""
+    import warnings
+
     import jax.numpy as jnp
     pruned = {}
     for p in _prunable_params(model):
-        mask = get_mask_1d(np.asarray(p.numpy()), n, m)
+        try:
+            mask = get_mask_1d(np.asarray(p.numpy()), n, m)
+        except ValueError as e:
+            warnings.warn(f"asp: skipping {getattr(p, 'name', '?')}: {e}")
+            continue
         _masks[id(p)] = mask
+        p._asp_mask = mask
         p._data = (p._data * jnp.asarray(mask, p._data.dtype))
         pruned[getattr(p, "name", str(id(p)))] = float(mask.mean())
     return pruned
@@ -94,7 +108,15 @@ class _ASPOptimizer:
                 p._data = p._data * jnp.asarray(mask, p._data.dtype)
 
     def __getattr__(self, name):
-        return getattr(self._inner, name)
+        return getattr(self.__dict__["_inner"], name)
+
+    def __setattr__(self, name, value):
+        # writes (e.g. TrainStep's optimizer._step_count bump) must land
+        # on the inner optimizer, not shadow it on the wrapper
+        if name == "_inner":
+            self.__dict__[name] = value
+        else:
+            setattr(self.__dict__["_inner"], name, value)
 
 
 def decorate(optimizer):

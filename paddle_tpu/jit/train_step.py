@@ -120,6 +120,12 @@ class TrainStep:
             "learning_rate"] for n, p in self._trainable.items()}
 
         amp_level, amp_dtype = self._amp_level, self._amp_dtype
+        # ASP n:m sparsity masks (incubate.asp.prune_model attaches them):
+        # re-applied in-graph after every update so the compiled path keeps
+        # the sparsity guarantee the eager decorated optimizer provides
+        asp_masks = {n: jnp.asarray(p._asp_mask)
+                     for n, p in self._trainable.items()
+                     if getattr(p, "_asp_mask", None) is not None}
         scaler = self._scaler
         if scaler is not None:
             sc_cfg = dict(incr_ratio=float(scaler._incr_ratio),
@@ -178,6 +184,8 @@ class TrainStep:
                 p_new, s_new = update_rule(
                     p_arr, g, lr * lr_mult[n], t,
                     jnp.asarray(wd_by_name[n], jnp.float32), opt_state[n])
+                if n in asp_masks:
+                    p_new = p_new * asp_masks[n].astype(p_new.dtype)
                 if found_inf is not None:
                     # skip-update branch: overflowed steps leave params and
                     # optimizer accumulators untouched

@@ -186,3 +186,22 @@ np.save({repr(str(tmp_path / 'served.npy'))}, out)
         assert r.returncode == 0, r.stderr[-2000:]
         served = np.load(tmp_path / "served.npy")
         np.testing.assert_allclose(served, expect, rtol=1e-4, atol=1e-4)
+
+
+def test_asp_mask_survives_trainstep():
+    """ASP masks are re-applied inside the COMPILED train step (not just
+    eager optimizer.step)."""
+    from paddle_tpu.incubate import asp
+    from paddle_tpu.jit import TrainStep
+    paddle.seed(0)
+    net = paddle.nn.Linear(16, 16)
+    asp.prune_model(net)
+    opt = asp.decorate(paddle.optimizer.Adam(
+        learning_rate=1e-2, parameters=net.parameters()))
+    step = TrainStep(net, lambda o, y: ((o - y) ** 2).mean(), opt)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(4, 16).astype("float32"))
+    y = paddle.to_tensor(rng.randn(4, 16).astype("float32"))
+    for _ in range(3):
+        step(x, y)
+    assert abs(asp.calculate_density(net.weight) - 0.5) < 1e-6

@@ -1,0 +1,233 @@
+"""OpTest-harness parity battery: numpy-oracle forward + finite-difference
+gradient checks across the op surface (reference test strategy §4.1)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import OpTest
+
+R = np.random.RandomState
+
+
+def _small(seed=0, shape=(3, 4)):
+    return R(seed).randn(*shape).astype("float32")
+
+
+class TestAdd(OpTest):
+    def setUpOp(self):
+        self.op = paddle.add
+        self.inputs = {"x": _small(0), "y": _small(1)}
+        self.expected = lambda x, y: x + y
+
+
+class TestMultiply(OpTest):
+    def setUpOp(self):
+        self.op = paddle.multiply
+        self.inputs = {"x": _small(2), "y": _small(3)}
+        self.expected = lambda x, y: x * y
+
+
+class TestMatmul(OpTest):
+    def setUpOp(self):
+        self.op = paddle.matmul
+        self.inputs = {"x": _small(4, (3, 5)), "y": _small(5, (5, 2))}
+        self.expected = lambda x, y: x @ y
+
+
+class TestTanh(OpTest):
+    def setUpOp(self):
+        self.op = paddle.tanh
+        self.inputs = {"x": _small(6)}
+        self.expected = np.tanh
+
+
+class TestSigmoid(OpTest):
+    def setUpOp(self):
+        import paddle_tpu.nn.functional as F
+        self.op = F.sigmoid
+        self.inputs = {"x": _small(7)}
+        self.expected = lambda x: 1 / (1 + np.exp(-x))
+
+
+class TestExp(OpTest):
+    def setUpOp(self):
+        self.op = paddle.exp
+        self.inputs = {"x": _small(8) * 0.5}
+        self.expected = np.exp
+
+
+class TestLog(OpTest):
+    def setUpOp(self):
+        self.op = paddle.log
+        self.inputs = {"x": np.abs(_small(9)) + 0.5}
+        self.expected = np.log
+
+
+class TestSqrt(OpTest):
+    def setUpOp(self):
+        self.op = paddle.sqrt
+        self.inputs = {"x": np.abs(_small(10)) + 0.1}
+        self.expected = np.sqrt
+
+
+class TestSoftmax(OpTest):
+    def setUpOp(self):
+        import paddle_tpu.nn.functional as F
+        self.op = F.softmax
+        self.inputs = {"x": _small(11)}
+
+        def oracle(x):
+            e = np.exp(x - x.max(-1, keepdims=True))
+            return e / e.sum(-1, keepdims=True)
+        self.expected = oracle
+
+
+class TestMeanReduce(OpTest):
+    def setUpOp(self):
+        self.op = paddle.mean
+        self.inputs = {"x": _small(12)}
+        self.expected = lambda x: np.mean(x)
+
+
+class TestSumAxis(OpTest):
+    def setUpOp(self):
+        self.op = paddle.sum
+        self.attrs = {"axis": 1}
+        self.inputs = {"x": _small(13)}
+        self.expected = lambda x: x.sum(1)
+
+
+class TestTranspose(OpTest):
+    def setUpOp(self):
+        self.op = paddle.transpose
+        self.attrs = {"perm": [1, 0]}
+        self.inputs = {"x": _small(14)}
+        self.expected = lambda x: x.T
+
+
+class TestConcatPair(OpTest):
+    def setUpOp(self):
+        def op(x, y):
+            return paddle.concat([x, y], axis=0)
+        self.op = op
+        self.inputs = {"x": _small(15), "y": _small(16)}
+        self.expected = lambda x, y: np.concatenate([x, y], 0)
+
+
+class TestWhere(OpTest):
+    def setUpOp(self):
+        cond = _small(17) > 0
+
+        def op(x, y):
+            return paddle.where(paddle.to_tensor(cond), x, y)
+        self.op = op
+        self.inputs = {"x": _small(18), "y": _small(19)}
+        self.expected = lambda x, y: np.where(cond, x, y)
+
+
+class TestGelu(OpTest):
+    grad_rtol = 5e-2
+
+    def setUpOp(self):
+        import math
+        import paddle_tpu.nn.functional as F
+        self.op = F.gelu
+        self.inputs = {"x": _small(20)}
+        erf = np.vectorize(math.erf)
+        self.expected = lambda x: (x * 0.5 *
+                                   (1 + erf(x / np.sqrt(2)))).astype(
+                                       np.float32)
+
+
+class TestLayerNormF(OpTest):
+    grad_rtol = 5e-2
+    grad_atol = 5e-3
+
+    def setUpOp(self):
+        import paddle_tpu.nn.functional as F
+
+        def op(x, w, b):
+            return F.layer_norm(x, normalized_shape=[4], weight=w, bias=b)
+        self.op = op
+        self.inputs = {"x": _small(21), "w": np.abs(_small(22, (4,))) + 0.5,
+                       "b": _small(23, (4,))}
+
+        def oracle(x, w, b):
+            mu = x.mean(-1, keepdims=True)
+            var = x.var(-1, keepdims=True)
+            return (x - mu) / np.sqrt(var + 1e-5) * w + b
+        self.expected = oracle
+
+
+class TestLogSoftmax(OpTest):
+    def setUpOp(self):
+        import paddle_tpu.nn.functional as F
+        self.op = F.log_softmax
+        self.inputs = {"x": _small(24)}
+
+        def oracle(x):
+            m = x.max(-1, keepdims=True)
+            return x - m - np.log(np.exp(x - m).sum(-1, keepdims=True))
+        self.expected = oracle
+
+
+class TestPow(OpTest):
+    def setUpOp(self):
+        def op(x):
+            return paddle.pow(x, 3.0)
+        self.op = op
+        self.inputs = {"x": _small(25)}
+        self.expected = lambda x: x ** 3
+
+
+class TestClip(OpTest):
+    grad_atol = 5e-2   # kink at the clip boundary; fd is noisy there
+
+    def setUpOp(self):
+        self.op = paddle.clip
+        self.attrs = {"min": -0.5, "max": 0.5}
+        self.inputs = {"x": _small(26)}
+        self.expected = lambda x: np.clip(x, -0.5, 0.5)
+
+
+class TestEinsumMatmul(OpTest):
+    def setUpOp(self):
+        def op(x, y):
+            return paddle.einsum("ij,jk->ik", x, y)
+        self.op = op
+        self.inputs = {"x": _small(27, (3, 5)), "y": _small(28, (5, 2))}
+        self.expected = lambda x, y: x @ y
+
+
+class TestStackPair(OpTest):
+    def setUpOp(self):
+        def op(x, y):
+            return paddle.stack([x, y], axis=0)
+        self.op = op
+        self.inputs = {"x": _small(29), "y": _small(30)}
+        self.expected = lambda x, y: np.stack([x, y], 0)
+
+
+class TestSquare(OpTest):
+    def setUpOp(self):
+        self.op = paddle.square
+        self.inputs = {"x": _small(31)}
+        self.expected = np.square
+
+
+class TestAbsGrad(OpTest):
+    grad_atol = 5e-2   # |x| kink
+
+    def setUpOp(self):
+        self.op = paddle.abs
+        self.inputs = {"x": _small(32) + 0.3}
+        self.expected = np.abs
+
+
+class TestMaximum(OpTest):
+    grad_atol = 5e-2
+
+    def setUpOp(self):
+        self.op = paddle.maximum
+        self.inputs = {"x": _small(33), "y": _small(34)}
+        self.expected = np.maximum
