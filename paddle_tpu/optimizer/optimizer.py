@@ -204,14 +204,21 @@ class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-08, parameters=None, weight_decay=None,
                  grad_clip=None, lazy_mode=False, multi_precision=False,
-                 use_multi_tensor=False, name=None):
+                 use_multi_tensor=False, moment_dtype="float32", name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip)
         self._beta1 = beta1
         self._beta2 = beta2
         self._eps = epsilon
+        # moment_dtype="bfloat16" halves optimizer-state HBM (8 bytes ->
+        # 4 bytes per param): the update math still runs in f32 (states are
+        # upcast inside the rule), enabling billion-parameter single-chip
+        # training that f32 moments cannot fit
+        self._moment_dtype = jnp.dtype(moment_dtype)
 
     def _update_rule(self, p, g, lr, t, wd, state):
-        m, v = state["moment1"], state["moment2"]
+        md = self._moment_dtype
+        m = state["moment1"].astype(jnp.float32)
+        v = state["moment2"].astype(jnp.float32)
         g32 = g.astype(jnp.float32)
         m = self._beta1 * m + (1 - self._beta1) * g32
         v = self._beta2 * v + (1 - self._beta2) * jnp.square(g32)
@@ -220,13 +227,13 @@ class Adam(Optimizer):
         v_hat = v / (1 - self._beta2 ** tf)
         upd = lr * m_hat / (jnp.sqrt(v_hat) + self._eps)
         return (p.astype(jnp.float32) - upd).astype(p.dtype), \
-            {"moment1": m, "moment2": v}
+            {"moment1": m.astype(md), "moment2": v.astype(md)}
 
     def _get_accum(self, name, p, init=None):
         store = self._accumulators.setdefault(name, {})
         pid = id(p)
         if pid not in store:
-            store[pid] = jnp.zeros(p._data.shape, jnp.float32)
+            store[pid] = jnp.zeros(p._data.shape, self._moment_dtype)
         return store[pid]
 
 
@@ -234,9 +241,10 @@ class AdamW(Adam):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-08, parameters=None, weight_decay=0.01,
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
-                 lazy_mode=False, multi_precision=False, name=None):
+                 lazy_mode=False, multi_precision=False,
+                 moment_dtype="float32", name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
-                         None, grad_clip)
+                         None, grad_clip, moment_dtype=moment_dtype)
         self._wd = float(weight_decay) if not hasattr(weight_decay, "coeff") \
             else weight_decay.coeff
         self._apply_decay_fn = apply_decay_param_fun
@@ -251,7 +259,9 @@ class AdamW(Adam):
         return self._wd
 
     def _update_rule(self, p, g, lr, t, wd, state):
-        m, v = state["moment1"], state["moment2"]
+        md = self._moment_dtype
+        m = state["moment1"].astype(jnp.float32)
+        v = state["moment2"].astype(jnp.float32)
         g32 = g.astype(jnp.float32)
         m = self._beta1 * m + (1 - self._beta1) * g32
         v = self._beta2 * v + (1 - self._beta2) * jnp.square(g32)
@@ -261,7 +271,8 @@ class AdamW(Adam):
         p32 = p.astype(jnp.float32)
         p32 = p32 * (1.0 - lr * wd)
         upd = lr * m_hat / (jnp.sqrt(v_hat) + self._eps)
-        return (p32 - upd).astype(p.dtype), {"moment1": m, "moment2": v}
+        return (p32 - upd).astype(p.dtype), \
+            {"moment1": m.astype(md), "moment2": v.astype(md)}
 
 
 class Adagrad(Optimizer):

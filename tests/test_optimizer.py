@@ -162,3 +162,37 @@ class TestLRSchedulers:
         assert hasattr(paddle.optimizer.lr, "MultiStepDecay")
         assert hasattr(paddle.optimizer.lr, "PiecewiseDecay")
         assert hasattr(paddle.optimizer.lr, "LambdaDecay")
+
+
+def test_adamw_bf16_moments_close_to_f32():
+    """moment_dtype='bfloat16' halves optimizer-state memory; trajectories
+    stay close to f32 moments (enables billion-param single-chip configs
+    — see PERF.md)."""
+    import numpy as np
+    import paddle_tpu as paddle
+
+    def run(md):
+        paddle.seed(0)
+        net = paddle.nn.Linear(16, 4)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=net.parameters(),
+                                     moment_dtype=md)
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(8, 16).astype("float32"))
+        y = paddle.to_tensor(rng.randn(8, 4).astype("float32"))
+        losses = []
+        for _ in range(10):
+            loss = ((net(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        return losses, opt
+
+    f32, _ = run("float32")
+    bf16, opt = run("bfloat16")
+    assert bf16[-1] < bf16[0]
+    np.testing.assert_allclose(f32, bf16, rtol=0.05)
+    import jax.numpy as jnp
+    accum = next(iter(opt._accumulators["moment1"].values()))
+    assert accum.dtype == jnp.bfloat16
