@@ -9,15 +9,7 @@ batch sharded over "dp"-like first mesh axis when a mesh is present.
 """
 from __future__ import annotations
 
-from typing import Optional
-
-import jax
-import jax.numpy as jnp
 import numpy as np
-
-from ...core.tensor import Tensor
-from ...framework import random as random_mod
-from ...jit.functional import _swapped_state, state_arrays
 
 
 class Engine:
@@ -32,35 +24,41 @@ class Engine:
         self.history = {"loss": []}
 
     def _build_step(self):
-        model, loss_fn, opt = self.model, self.loss, self.optimizer
-        trainable = {n: p for n, p in model.named_parameters()
-                     if not p.stop_gradient}
-        names = list(trainable.keys())
+        """One compiled SPMD step: delegate to TrainStep, which already
+        does mesh placement from dist_spec/opt_state_spec annotations,
+        AMP, grad clip and weight decay — the Completer/Partitioner/
+        Resharder stack collapses into these annotations + GSPMD."""
+        from ...jit.train_step import TrainStep
 
-        def pure_step(params, buffers, opt_state, lr, t, key, x, y):
-            def loss_of(tp):
-                allp = {**params, **tp}
-                from ...core import autograd as ag
-                with _swapped_state(model, allp, buffers), ag.no_grad(), \
-                        random_mod.traced_key_scope(key):
-                    out = model(Tensor(x, stop_gradient=True))
-                    l = loss_fn(out, Tensor(y, stop_gradient=True))
-                return l._data if isinstance(l, Tensor) else l
-
-            tp = {n: params[n] for n in names}
-            loss, grads = jax.value_and_grad(loss_of)(tp)
-            new_params = dict(params)
-            new_state = {}
-            for n in names:
-                g = grads[n].astype(params[n].dtype)
-                p_new, s_new = opt._update_rule(
-                    params[n], g, lr, t, jnp.asarray(0.0, jnp.float32),
-                    opt_state[n])
-                new_params[n] = p_new
-                new_state[n] = s_new
-            return loss, new_params, new_state
-
-        self._step_fn = jax.jit(pure_step, donate_argnums=(0, 2))
+        amp_level = None
+        scaler = None
+        strat = self.strategy
+        if strat is not None and getattr(strat, "amp", False):
+            cfg = getattr(strat, "amp_configs", {}) or {}
+            amp_level = "O2" if cfg.get("use_pure_fp16") else "O1"
+            amp_dtype = "bfloat16" if cfg.get("use_bf16", True) \
+                else "float16"
+            if amp_dtype == "float16":
+                # fp16 always gets a scaler: static scaling (dynamic off)
+                # still multiplies the loss by init_loss_scaling — no
+                # scaler at all would underflow small grads
+                from ...amp.grad_scaler import GradScaler
+                scaler = GradScaler(
+                    init_loss_scaling=cfg.get("init_loss_scaling",
+                                              2.0 ** 15),
+                    incr_ratio=cfg.get("incr_ratio", 2.0),
+                    decr_ratio=cfg.get("decr_ratio", 0.5),
+                    incr_every_n_steps=cfg.get("incr_every_n_steps", 1000),
+                    decr_every_n_nan_or_inf=cfg.get(
+                        "decr_every_n_nan_or_inf", 2),
+                    use_dynamic_loss_scaling=cfg.get(
+                        "use_dynamic_loss_scaling", True))
+            self._amp_dtype = amp_dtype
+        else:
+            self._amp_dtype = "bfloat16"
+        self._step_fn = TrainStep(self.model, self.loss, self.optimizer,
+                                  amp_level=amp_level,
+                                  amp_dtype=self._amp_dtype, scaler=scaler)
 
     def fit(self, train_data=None, train_sample_split=None, batch_size=1,
             epochs=1, steps_per_epoch=None, log_freq=10, valid_data=None,
@@ -73,32 +71,13 @@ class Engine:
                                 shuffle=True, drop_last=True)
         if self._step_fn is None:
             self._build_step()
-        model, opt = self.model, self.optimizer
-        trainable = {n: p for n, p in model.named_parameters()
-                     if not p.stop_gradient}
         for epoch in range(epochs):
             for step, batch in enumerate(loader):
                 if steps_per_epoch and step >= steps_per_epoch:
                     break
                 x, y = batch[0], batch[1]
-                params, buffers = state_arrays(model)
-                opt_state = {n: {an: opt._get_accum(an, p)
-                                 for an in opt._accum_names}
-                             for n, p in trainable.items()}
-                opt._step_count += 1
-                loss, new_params, new_state = self._step_fn(
-                    params, buffers, opt_state,
-                    jnp.asarray(opt.get_lr(), jnp.float32),
-                    jnp.asarray(opt._step_count, jnp.int32),
-                    random_mod.next_key(),
-                    x._data if isinstance(x, Tensor) else jnp.asarray(x),
-                    y._data if isinstance(y, Tensor) else jnp.asarray(y))
-                for n, p in model.named_parameters():
-                    p._data = new_params[n]
-                for n, p in trainable.items():
-                    for an in opt._accum_names:
-                        opt._set_accum(an, p, new_state[n][an])
-                self.history["loss"].append(float(np.asarray(loss)))
+                loss = self._step_fn(x, y)
+                self.history["loss"].append(float(np.asarray(loss.numpy())))
         return self.history
 
     def evaluate(self, valid_data=None, batch_size=1, steps=None, **kwargs):

@@ -31,9 +31,14 @@ class GroupShardedStage2(Layer):
                  buffer_max_size=2 ** 23, auto_refresh_trainable=True,
                  device="tpu", dp_group=None):
         super().__init__()
-        self._layer = layer
+        # bypass Layer.__setattr__ for the private ref: assigning a Layer
+        # attribute auto-registers it as a sublayer, and together with the
+        # explicit add_sublayer the SAME parameters would appear twice in
+        # named_parameters() — the compiled TrainStep then donates each
+        # underlying buffer twice (Execute() error)
+        object.__setattr__(self, "_layer", layer)
         self.add_sublayer("layer", layer)
-        self._optimizer = optimizer
+        object.__setattr__(self, "_optimizer", optimizer)
         # mark optimizer state sharding: the TrainStep builder reads
         # p.opt_state_spec when laying out accumulators
         for p in layer.parameters():
@@ -49,9 +54,9 @@ class GroupShardedStage3(Layer):
                  offload=False, sync_comm=False, dp_group=None,
                  exclude_layer=None):
         super().__init__()
-        self._layer = layer
+        object.__setattr__(self, "_layer", layer)  # see GroupShardedStage2
         self.add_sublayer("layer", layer)
-        self._optimizer = optimizer
+        object.__setattr__(self, "_optimizer", optimizer)
         for p in layer.parameters():
             spec = _flat_axis_spec(p)
             p.dist_spec = spec

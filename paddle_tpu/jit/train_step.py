@@ -23,14 +23,20 @@ from ..nn.clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
 from .functional import _swapped_state, state_arrays
 
 
+def _norm_spec(mesh, spec):
+    """Degrade axes absent from (or trivial in) the mesh to replication so
+    single-chip runs are unchanged."""
+    return tuple(s if (s in mesh.axis_names and mesh.shape[s] > 1) else None
+                 for s in spec or ())
+
+
 def _param_sharding(mesh, p):
     """NamedSharding for a parameter from its ``dist_spec`` annotation
-    (set by TP layers / sharding stages); axes absent from the mesh degrade
-    to replication so single-chip runs are unchanged."""
-    spec = getattr(p, "dist_spec", None) or ()
-    spec = tuple(s if (s in mesh.axis_names and mesh.shape[s] > 1) else None
-                 for s in spec)
-    return NamedSharding(mesh, PartitionSpec(*spec))
+    (set by TP layers / sharding stages)."""
+    return NamedSharding(mesh,
+                         PartitionSpec(*_norm_spec(mesh,
+                                                   getattr(p, "dist_spec",
+                                                           None))))
 
 
 def _batch_axes(mesh):
@@ -195,6 +201,12 @@ class TrainStep:
                 new_params[n] = p_new
                 new_state[n] = s_new
             if scaler is None:
+                # optimization_barrier: numerically-identical outputs (e.g.
+                # both Adam moments of a zero-grad param) must NOT be CSE'd
+                # into one buffer — the next call feeds outputs back as
+                # DONATED inputs, and XLA rejects donating a buffer twice
+                loss, new_params, new_state = jax.lax.optimization_barrier(
+                    (loss, new_params, new_state))
                 return loss, new_params, new_state, sc_state
             # dynamic loss-scale schedule, in-graph
             good, bad = sc_state["good"], sc_state["bad"]
@@ -211,6 +223,9 @@ class TrainStep:
                 good = jnp.where(inc, 0, good)
             new_sc = {"scale": scale, "good": good, "bad": bad,
                       "found_inf": found_inf}
+            loss, new_params, new_state, new_sc = \
+                jax.lax.optimization_barrier(
+                    (loss, new_params, new_state, new_sc))
             return loss, new_params, new_state, new_sc
 
         donate = (0, 2) if self._donate else ()
@@ -231,11 +246,21 @@ class TrainStep:
             opt_sh = {}
             for n, p in self._trainable.items():
                 per = {}
+                # ZeRO stage-1/2: optimizer state shards over the
+                # 'sharding' axis even when the param itself is replicated
+                # (GroupShardedStage2 sets p.opt_state_spec)
+                os_spec = getattr(p, "opt_state_spec", None)
+                if os_spec is not None:
+                    state_sh = NamedSharding(
+                        mesh, PartitionSpec(*_norm_spec(mesh, os_spec)))
+                else:
+                    state_sh = p_sh[n]
                 for an in self.optimizer._accum_names:
                     acc = self.optimizer._get_accum(an, p)
-                    per[an] = p_sh[n] if getattr(acc, "ndim", 0) == len(
+                    per[an] = state_sh if getattr(acc, "ndim", 0) == len(
                         p.shape) and len(p.shape) > 0 else repl
                 opt_sh[n] = per
+
             baxes = _batch_axes(mesh)
             bspec = PartitionSpec(baxes if baxes else None)
             self._batch_sharding = NamedSharding(mesh, bspec)

@@ -172,3 +172,104 @@ class TestDistributedStrategySurface:
         s.amp_configs = {"init_loss_scaling": 1024.0}
         assert s.amp_configs["init_loss_scaling"] == 1024.0
         assert "incr_ratio" in s.amp_configs  # defaults survive
+
+
+class TestZeROSharding:
+    """ZeRO stages as sharding specs (reference group_sharded_*): stage 2
+    shards OPTIMIZER STATE over the 'sharding' axis while params stay
+    replicated; stage 3 shards params too. GSPMD inserts the gathers the
+    reference issues by hand."""
+
+    def _train(self, level, steps=3):
+        import paddle_tpu.distributed.fleet as fleet
+        from paddle_tpu.distributed.mesh_utils import set_global_mesh
+        from paddle_tpu.distributed.fleet.meta_parallel.sharding import (
+            group_sharded_parallel)
+        from paddle_tpu.jit import TrainStep
+        import jax
+        jax.config.update("jax_default_matmul_precision", "highest")
+        paddle.seed(0)
+        if level:
+            s = fleet.DistributedStrategy()
+            s.hybrid_configs = {"dp_degree": 2, "sharding_degree": 4}
+            fleet.init(is_collective=True, strategy=s)
+        else:
+            set_global_mesh(None)
+        net = nn.Sequential(nn.Linear(16, 64), nn.ReLU(),
+                            nn.Linear(64, 16))
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=net.parameters())
+        if level:
+            net, opt, _ = group_sharded_parallel(net, opt, level)
+        step = TrainStep(net, lambda o, y: ((o - y) ** 2).mean(), opt)
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(8, 16).astype("float32"))
+        y = paddle.to_tensor(rng.randn(8, 16).astype("float32"))
+        losses = [float(step(x, y).numpy()) for _ in range(steps)]
+        params = {n: np.asarray(p.numpy())
+                  for n, p in net.named_parameters()}
+        out = (losses, params, net, opt, step)
+        set_global_mesh(None)
+        return out
+
+    def test_stage2_opt_state_sharded_and_matches_single(self):
+        single, p1, _, _, _ = self._train(None)
+        zs, p2, net, opt, step = self._train("os_g")
+        np.testing.assert_allclose(single, zs, rtol=1e-4, atol=1e-4)
+        for n in p1:
+            np.testing.assert_allclose(
+                p1[n], p2.get("layer." + n, p2.get(n)),
+                rtol=1e-4, atol=1e-4, err_msg=n)
+        # optimizer moments actually sharded over the 'sharding' axis
+        inner = net._layer if hasattr(net, "_layer") else net
+        p = next(q for q in inner.parameters() if len(q.shape) == 2)
+        acc = opt._accumulators["moment1"][id(p)]
+        shard_rows = {sh.data.shape[0] for sh in acc.addressable_shards}
+        assert shard_rows == {p.shape[0] // 4}
+        # param placement: enters replicated (stage-2 semantics); GSPMD
+        # may legitimately return it 'sharding'-sharded after the update
+        # (strictly less memory than the reference's replicated params)
+        pshards = {sh.data.shape for sh in p._data.addressable_shards}
+        assert pshards in ({tuple(p.shape)},
+                           {(p.shape[0] // 4, p.shape[1])})
+
+    def test_stage3_params_sharded_and_matches_single(self):
+        single, p1, _, _, _ = self._train(None)
+        zs, p2, net, opt, _ = self._train("p_g_os")
+        np.testing.assert_allclose(single, zs, rtol=1e-4, atol=1e-4)
+        inner = net._layer if hasattr(net, "_layer") else net
+        p = next(q for q in inner.parameters() if len(q.shape) == 2)
+        shard_rows = {sh.data.shape[0] for sh in p._data.addressable_shards}
+        assert shard_rows == {p.shape[0] // 4}
+
+
+class TestAutoEngine:
+    def test_engine_fit_sharded(self):
+        import paddle_tpu.distributed.fleet as fleet
+        from paddle_tpu.distributed import auto
+        from paddle_tpu.distributed.mesh_utils import set_global_mesh
+        from paddle_tpu.io import Dataset
+
+        paddle.seed(0)
+        s = fleet.DistributedStrategy()
+        s.hybrid_configs = {"dp_degree": 2, "mp_degree": 2}
+        fleet.init(is_collective=True, strategy=s)
+
+        class DS(Dataset):
+            def __len__(self):
+                return 32
+
+            def __getitem__(self, i):
+                rng = np.random.RandomState(i)
+                return (rng.randn(16).astype("float32"),
+                        rng.randn(4).astype("float32"))
+
+        net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=net.parameters())
+        eng = auto.Engine(net, loss=lambda o, y: ((o - y) ** 2).mean(),
+                          optimizer=opt)
+        hist = eng.fit(DS(), batch_size=8, epochs=2)
+        assert len(hist["loss"]) == 8
+        assert hist["loss"][-1] < hist["loss"][0]
+        set_global_mesh(None)
