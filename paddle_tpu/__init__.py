@@ -57,6 +57,8 @@ from . import device  # noqa: F401
 from . import audio  # noqa: F401
 from . import fft  # noqa: F401
 from . import geometric  # noqa: F401
+from . import onnx  # noqa: F401
+from . import text  # noqa: F401
 from .hapi import callbacks  # noqa: F401
 from . import distribution  # noqa: F401
 from . import distributed  # noqa: F401

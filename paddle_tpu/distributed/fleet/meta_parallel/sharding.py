@@ -17,12 +17,18 @@ from ....nn.layer.layers import Layer
 
 
 def _flat_axis_spec(p, axis="sharding"):
-    """Shard the largest dim of the param over the sharding axis when it
-    divides evenly; fall back to replicated."""
+    """Shard dim 0 of the param over the sharding axis when it divides
+    evenly; fall back to replicated (scalars and non-divisible dims would
+    otherwise fail placement)."""
+    from ...mesh_utils import get_global_mesh
     shape = p.shape
     if not shape:
-        return (None,)
-    # pick dim 0 (paddle's sharding also flattens; dim0 is fine for GSPMD)
+        return None
+    mesh = get_global_mesh()
+    size = mesh.shape.get(axis, 1) if mesh is not None and         axis in mesh.axis_names else 1
+    if size <= 1 or shape[0] % size != 0:
+        return (None,) * len(shape)
+    # dim 0 (paddle's sharding also flattens; dim0 is fine for GSPMD)
     return (axis,) + (None,) * (len(shape) - 1)
 
 
@@ -38,7 +44,7 @@ class GroupShardedStage2(Layer):
         # underlying buffer twice (Execute() error)
         object.__setattr__(self, "_layer", layer)
         self.add_sublayer("layer", layer)
-        object.__setattr__(self, "_optimizer", optimizer)
+        self._optimizer = optimizer
         # mark optimizer state sharding: the TrainStep builder reads
         # p.opt_state_spec when laying out accumulators
         for p in layer.parameters():
@@ -56,7 +62,7 @@ class GroupShardedStage3(Layer):
         super().__init__()
         object.__setattr__(self, "_layer", layer)  # see GroupShardedStage2
         self.add_sublayer("layer", layer)
-        object.__setattr__(self, "_optimizer", optimizer)
+        self._optimizer = optimizer
         for p in layer.parameters():
             spec = _flat_axis_spec(p)
             p.dist_spec = spec
@@ -75,6 +81,14 @@ class GroupShardedOptimizerStage2:
 
     def __getattr__(self, item):
         return getattr(self.__dict__["_optim"], item)
+
+    def __setattr__(self, item, value):
+        # writes (TrainStep's _step_count bump, LR changes) must reach the
+        # inner optimizer, or its serialized state drifts from reality
+        if item == "_optim":
+            self.__dict__[item] = value
+        else:
+            setattr(self.__dict__["_optim"], item, value)
 
     def step(self):
         self._optim.step()

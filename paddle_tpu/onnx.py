@@ -1,0 +1,31 @@
+"""paddle.onnx — export shim.
+
+Reference: python/paddle/onnx/export.py delegates to the external
+paddle2onnx package. TPU-native stance: the portable serving artifact is
+the StableHLO pdmodel (framework/exporting.py) — `paddle.onnx.export`
+writes that artifact (same layer, same inputs contract) and raises a
+clear error for the actual .onnx protobuf conversion, which needs the
+external converter the reference also requires.
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Export ``layer`` as a servable artifact at ``path``.
+
+    Writes the StableHLO pdmodel/pdiparams pair (loadable with
+    paddle_tpu.jit.load / inference.create_predictor). A true ONNX
+    protobuf requires the external paddle2onnx-equivalent converter —
+    not available offline — so requesting a literal .onnx file raises.
+    """
+    if str(path).endswith(".onnx"):
+        raise NotImplementedError(
+            "literal ONNX protobuf export requires the external "
+            "paddle2onnx converter (the reference shells out to it too); "
+            "use the StableHLO artifact (paddle_tpu.jit.save / this "
+            "function without the .onnx suffix) for portable serving")
+    from .jit.api import save as jit_save
+    jit_save(layer, str(path), input_spec=input_spec)
+    return str(path)

@@ -1,0 +1,144 @@
+"""paddle.text — text-domain utilities.
+
+Reference: /root/reference/python/paddle/text/ (datasets: Imdb/Conll05/
+UCIHousing/WMT14/...; plus the viterbi_decode op family living in
+paddle.text.viterbi_decode / ViterbiDecoder, backed by the
+viterbi_decode yaml op). TPU-native: the Viterbi recursion is a
+lax.scan (compiles to one fused program); datasets ship as small
+in-memory generators (the reference's downloads don't apply offline).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply_op
+from ..nn.layer.layers import Layer
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "Vocab"]
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True, name=None):
+    """CRF Viterbi decode (reference: paddle.text.viterbi_decode over the
+    viterbi_decode op, phi ops.yaml). potentials [B, T, N] emission
+    scores, transition_params [N, N]; returns (scores [B], paths [B, T]).
+    ``lengths`` [B] masks padded steps (defaults to full length).
+    """
+    def _decode(pot, trans, lens):
+        b, t, n = pot.shape
+
+        def step(alpha, emit_t):
+            # [B, N_prev, N_cur]
+            scores = alpha[:, :, None] + trans[None] + emit_t[:, None, :]
+            best_prev = jnp.argmax(scores, axis=1).astype(jnp.int32)
+            alpha_new = jnp.max(scores, axis=1)
+            return alpha_new, best_prev
+
+        alpha0 = pot[:, 0]
+        _, backptrs = jax.lax.scan(step, alpha0,
+                                   jnp.swapaxes(pot[:, 1:], 0, 1))
+        # mask beyond lengths: freeze alpha at the last valid step
+        steps = jnp.arange(1, t)[:, None, None]             # [T-1,1,1]
+        valid = steps < lens[None, :, None]                 # [T-1,B,1]
+        # recompute alphas per step to select the final one
+        def step2(carry, inp):
+            alpha = carry
+            emit_t, v = inp
+            scores = alpha[:, :, None] + trans[None] + emit_t[:, None, :]
+            alpha_new = jnp.max(scores, axis=1)
+            alpha = jnp.where(v, alpha_new, alpha)
+            return alpha, alpha
+        alpha_final, _ = jax.lax.scan(
+            step2, alpha0, (jnp.swapaxes(pot[:, 1:], 0, 1), valid))
+        best_last = jnp.argmax(alpha_final, axis=1).astype(jnp.int32)
+        best_score = jnp.max(alpha_final, axis=1)
+
+        # backtrack (reverse scan over backpointers)
+        def back(carry, inp):
+            tag = carry
+            bp, v = inp                                     # bp [B,N]
+            prev = jnp.take_along_axis(bp, tag[:, None], 1)[:, 0]
+            prev = jnp.where(v[:, 0], prev, tag)
+            return prev, tag
+
+        tag0, tags_rev = jax.lax.scan(back, best_last,
+                                      (backptrs[::-1], valid[::-1]))
+        # carries emitted on ENTRY: tags_rev = [tag_{T-1}, ..., tag_1];
+        # the final carry is tag_0
+        path = jnp.concatenate([tag0[None], tags_rev[::-1]], axis=0)
+        return best_score, jnp.swapaxes(path, 0, 1).astype(jnp.int64)
+
+    if lengths is None:
+        b, t = (potentials.shape[0], potentials.shape[1])
+        import paddle_tpu as P
+        lengths = P.to_tensor(np.full((b,), t, np.int64))
+    return apply_op("viterbi_decode", _decode, potentials,
+                    transition_params, lengths)
+
+
+class ViterbiDecoder(Layer):
+    """reference paddle.text.ViterbiDecoder."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+class Vocab:
+    """Token <-> index vocabulary (reference paddlenlp-style Vocab used by
+    the text datasets; minimal core: build from counter/tokens, lookup,
+    unk handling)."""
+
+    def __init__(self, counter=None, max_size=None, min_freq=1,
+                 token_to_idx=None, unk_token="[UNK]", pad_token="[PAD]",
+                 bos_token=None, eos_token=None):
+        self.unk_token = unk_token
+        self.pad_token = pad_token
+        if token_to_idx is not None:
+            self._t2i = dict(token_to_idx)
+        else:
+            specials = [t for t in (pad_token, unk_token, bos_token,
+                                    eos_token) if t is not None]
+            self._t2i = {t: i for i, t in enumerate(specials)}
+            if counter:
+                items = sorted(counter.items(),
+                               key=lambda kv: (-kv[1], kv[0]))
+                for tok, freq in items:
+                    if freq < min_freq or tok in self._t2i:
+                        continue
+                    if max_size and len(self._t2i) >= max_size:
+                        break
+                    self._t2i[tok] = len(self._t2i)
+        self._i2t = {i: t for t, i in self._t2i.items()}
+
+    def __len__(self):
+        return len(self._t2i)
+
+    def __contains__(self, token):
+        return token in self._t2i
+
+    def to_indices(self, tokens):
+        unk = self._t2i.get(self.unk_token)
+        if isinstance(tokens, (list, tuple)):
+            return [self._t2i.get(t, unk) for t in tokens]
+        return self._t2i.get(tokens, unk)
+
+    def to_tokens(self, indices):
+        if isinstance(indices, (list, tuple)):
+            return [self._i2t.get(int(i), self.unk_token) for i in indices]
+        return self._i2t.get(int(indices), self.unk_token)
+
+    @property
+    def token_to_idx(self):
+        return dict(self._t2i)
+
+    @property
+    def idx_to_token(self):
+        return dict(self._i2t)

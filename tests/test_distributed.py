@@ -273,3 +273,31 @@ class TestAutoEngine:
         assert len(hist["loss"]) == 8
         assert hist["loss"][-1] < hist["loss"][0]
         set_global_mesh(None)
+
+
+def test_zero_non_divisible_dims_fall_back_to_replicated():
+    """Params whose dim 0 doesn't divide the sharding degree (and scalar
+    params) must train instead of failing placement."""
+    import paddle_tpu.distributed.fleet as fleet
+    from paddle_tpu.distributed.fleet.meta_parallel.sharding import (
+        group_sharded_parallel)
+    from paddle_tpu.distributed.mesh_utils import set_global_mesh
+    from paddle_tpu.jit import TrainStep
+    paddle.seed(0)
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 2, "sharding_degree": 4}
+    fleet.init(is_collective=True, strategy=s)
+    net = nn.Linear(16, 30)   # 30 % 4 != 0 for the bias
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=net.parameters())
+    net, opt, _ = group_sharded_parallel(net, opt, "os_g")
+    step = TrainStep(net, lambda o, y: ((o - y) ** 2).mean(), opt)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(8, 16).astype("float32"))
+    y = paddle.to_tensor(rng.randn(8, 30).astype("float32"))
+    for _ in range(2):
+        loss = step(x, y)
+    assert np.isfinite(float(loss.numpy()))
+    # step counter reaches the INNER optimizer (checkpoint correctness)
+    assert opt._optim._step_count == 2
+    set_global_mesh(None)

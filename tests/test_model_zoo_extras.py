@@ -205,3 +205,60 @@ def test_asp_mask_survives_trainstep():
     for _ in range(3):
         step(x, y)
     assert abs(asp.calculate_density(net.weight) - 0.5) < 1e-6
+
+
+class TestText:
+    def test_viterbi_matches_brute_force(self):
+        import itertools
+        rng = np.random.RandomState(3)
+        B, T, N = 2, 5, 3
+        pot = rng.randn(B, T, N).astype("float32")
+        trans = rng.randn(N, N).astype("float32")
+        scores, paths = paddle.text.viterbi_decode(
+            paddle.to_tensor(pot), paddle.to_tensor(trans))
+        for b in range(B):
+            best, bp = -1e30, None
+            for seq in itertools.product(range(N), repeat=T):
+                s = pot[b, 0, seq[0]] + sum(
+                    trans[seq[i - 1], seq[i]] + pot[b, i, seq[i]]
+                    for i in range(1, T))
+                if s > best:
+                    best, bp = s, seq
+            assert abs(float(_np(scores)[b]) - best) < 1e-4
+            assert tuple(_np(paths)[b]) == bp
+
+    def test_viterbi_decoder_layer_and_lengths(self):
+        rng = np.random.RandomState(4)
+        pot = paddle.to_tensor(rng.randn(2, 6, 4).astype("float32"))
+        trans = paddle.to_tensor(rng.randn(4, 4).astype("float32"))
+        dec = paddle.text.ViterbiDecoder(trans)
+        lens = paddle.to_tensor(np.array([4, 6], np.int64))
+        scores, paths = dec(pot, lens)
+        assert list(paths.shape) == [2, 6]
+        assert np.isfinite(_np(scores)).all()
+
+    def test_vocab_roundtrip(self):
+        v = paddle.text.Vocab(counter={"cat": 5, "dog": 3, "rare": 1},
+                              min_freq=2)
+        idx = v.to_indices(["cat", "dog", "unseen"])
+        assert v.to_tokens(idx[:2]) == ["cat", "dog"]
+        assert idx[2] == v.to_indices(v.unk_token)
+        assert "cat" in v and "unseen" not in v
+
+
+class TestOnnxShim:
+    def test_export_writes_servable_artifact(self, tmp_path):
+        from paddle_tpu.static import InputSpec
+        paddle.seed(0)
+        net = paddle.nn.Linear(4, 2)
+        path = str(tmp_path / "m")
+        out = paddle.onnx.export(net, path,
+                                 input_spec=[InputSpec([1, 4], "float32")])
+        loaded = paddle.jit.load(out)
+        x = paddle.to_tensor(np.ones((1, 4), np.float32))
+        np.testing.assert_allclose(_np(loaded(x)), _np(net(x)), rtol=1e-5)
+
+    def test_literal_onnx_raises(self, tmp_path):
+        with pytest.raises(NotImplementedError, match="paddle2onnx"):
+            paddle.onnx.export(paddle.nn.Linear(2, 2),
+                               str(tmp_path / "m.onnx"))
