@@ -92,12 +92,26 @@ def init_parallel_env():
                           world_size=n)
         _store.barrier("init_parallel_env", n, timeout)
         if os.environ.get("PADDLE_JAX_DISTRIBUTED") == "1":
-            coordinator = os.environ.get(
-                "PADDLE_JAX_COORDINATOR",
-                f"{host or '127.0.0.1'}:{int(port or 0) + 1}")
-            jax.distributed.initialize(coordinator_address=coordinator,
-                                       num_processes=n, process_id=rank)
-            _jax_distributed = True
+            from jax._src import distributed as _jd
+            if getattr(_jd.global_state, "client", None) is not None:
+                # the launcher's --jax_distributed bootstrap initialized
+                # the coordination service before any framework import
+                # (mandatory: initialize() must precede backend use)
+                _jax_distributed = True
+            else:
+                coordinator = os.environ.get(
+                    "PADDLE_JAX_COORDINATOR",
+                    f"{host or '127.0.0.1'}:{int(port or 0) + 1}")
+                jax.distributed.initialize(coordinator_address=coordinator,
+                                           num_processes=n,
+                                           process_id=rank)
+                _jax_distributed = True
+            # AFTER distributed init (local_devices touches the backend):
+            # fresh host tensors must land on a PROCESS-LOCAL device — the
+            # global default (jax.devices()[0]) belongs to process 0, and
+            # arrays created there from other ranks can't feed compiled
+            # multi-host steps (cross-host reshard is unsupported)
+            jax.config.update("jax_default_device", jax.local_devices()[0])
         _initialized = True
     return ParallelEnv()
 

@@ -50,6 +50,12 @@ def parse_args(argv=None):
     ap.add_argument("--log_dir", default=None)
     ap.add_argument("--devices", default=None,
                     help="accepted for reference-CLI parity")
+    ap.add_argument("--jax_distributed", action="store_true",
+                    help="initialize jax.distributed in each worker BEFORE "
+                         "the script runs (required for compiled multi-host "
+                         "SPMD: the coordinator handshake must precede any "
+                         "XLA backend use, which importing the framework "
+                         "already triggers)")
     ap.add_argument("--elastic_level", type=int, default=0,
                     help=">0 enables restart-on-failure (reference "
                          "elastic/manager.py; TPU-native = full-job "
@@ -89,6 +95,10 @@ def _launch_once(args) -> int:
     else:
         host = "127.0.0.1"
     master = args.master or f"{host}:{_free_port()}"
+    # the jax coordination service gets its own PROBED port (master+1 was
+    # assumed free before — sequential kernel port handout made collisions
+    # with base_port likely)
+    jax_coord_port = _free_port()
     base_port = _free_port()
     # single-node endpoints are exact; multi-node lists this node's span
     # (the env contract only requires PADDLE_MASTER to be globally correct)
@@ -121,10 +131,30 @@ def _launch_once(args) -> int:
                                     f"workerlog.{rank}"), "w")
         else:
             out = None
+        if args.jax_distributed:
+            mhost = master.partition(":")[0]
+            env["PADDLE_JAX_COORDINATOR"] = \
+                f"{mhost}:{jax_coord_port}"
+            env["PADDLE_JAX_DISTRIBUTED"] = "1"
+            boot = (
+                "import os, sys, runpy, jax\n"
+                "plat = os.environ.get('JAX_PLATFORMS')\n"
+                "if plat:\n"
+                "    jax.config.update('jax_platforms', plat)\n"
+                "jax.distributed.initialize(\n"
+                "    coordinator_address=os.environ['PADDLE_JAX_COORDINATOR'],\n"
+                "    num_processes=int(os.environ['PADDLE_TRAINERS_NUM']),\n"
+                "    process_id=int(os.environ['PADDLE_TRAINER_ID']))\n"
+                "sys.argv = sys.argv[1:]\n"
+                "runpy.run_path(sys.argv[0], run_name='__main__')\n")
+            cmd = [sys.executable, "-u", "-c", boot,
+                   args.training_script, *args.training_script_args]
+        else:
+            cmd = [sys.executable, "-u", args.training_script,
+                   *args.training_script_args]
         procs.append((rank, subprocess.Popen(
-            [sys.executable, "-u", args.training_script,
-             *args.training_script_args],
-            env=env, stdout=out, stderr=subprocess.STDOUT if out else None),
+            cmd, env=env, stdout=out,
+            stderr=subprocess.STDOUT if out else None),
             out))
 
     rc = 0

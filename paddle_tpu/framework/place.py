@@ -35,11 +35,20 @@ class Place:
         return hash((self.device_type, self._device_id))
 
     def jax_device(self):
-        """Resolve to a concrete jax.Device."""
-        devs = [d for d in jax.devices() if _matches(d, self.device_type)]
+        """Resolve to a concrete jax.Device — PROCESS-LOCAL ones only: in
+        multi-controller SPMD jax.devices() lists every process's devices,
+        and host data committed to another process's device cannot feed
+        compiled steps (cross-host reshard is unsupported)."""
+        devs = [d for d in jax.local_devices()
+                if _matches(d, self.device_type)]
         if not devs:
-            # Fall back to host CPU devices (always present).
-            devs = jax.devices("cpu")
+            # Fall back to PROCESS-LOCAL host CPU devices (always present;
+            # the global jax.devices("cpu") list would hand other
+            # processes' devices back on rank > 0)
+            try:
+                devs = jax.local_devices(backend="cpu")
+            except RuntimeError:  # pragma: no cover — no cpu backend
+                devs = jax.devices("cpu")
         return devs[self._device_id % len(devs)]
 
 

@@ -39,6 +39,22 @@ def _param_sharding(mesh, p):
                                                            None))))
 
 
+def _global_put(a, sharding):
+    """device_put that also works when ``sharding`` spans processes
+    (multi-host SPMD): each process contributes its addressable shards
+    from the full host value via make_array_from_callback. Arrays that
+    are already global stay on device (host fetch would be illegal)."""
+    if getattr(sharding, "is_fully_addressable", True):
+        return jax.device_put(a, sharding)
+    if isinstance(a, jax.Array) and not a.is_fully_addressable:
+        if a.sharding == sharding:
+            return a
+        return jax.device_put(a, sharding)
+    arr = np.asarray(a)
+    return jax.make_array_from_callback(arr.shape, sharding,
+                                        lambda idx: arr[idx])
+
+
 def _batch_axes(mesh):
     """Mesh axes the input batch dim is sharded over: dp and (ZeRO) sharding."""
     axes = [a for a in ("dp", "sharding") if a in mesh.axis_names
@@ -346,7 +362,7 @@ class TrainStep:
                 hit = cache.get(key)
                 if hit is not None and hit[0] is a:
                     return hit[1]
-                placed = jax.device_put(a, sharding)
+                placed = _global_put(a, sharding)
                 cache[key] = (a, placed)
                 return placed
 
@@ -388,12 +404,16 @@ class TrainStep:
         if getattr(self, "_mesh", None) is not None:
             nshards = int(np.prod([self._mesh.shape[a]
                                    for a in _batch_axes(self._mesh)] or [1]))
-            arrays = [jax.device_put(a, self._batch_sharding)
+            arrays = [_global_put(a, self._batch_sharding)
                       if getattr(a, "ndim", 0) >= 1
                       and a.shape[0] % nshards == 0 else a
                       for a in arrays]
         loss, new_params, new_state, new_sc = self._compiled(
             params, buffers, opt_state, sc_state, lr, t, key, *arrays)
+        if not getattr(loss, "is_fully_addressable", True):
+            # multi-host mesh: the scalar loss is replicated; hand back the
+            # process-local copy so .numpy()/float() work on every rank
+            loss = jnp.asarray(loss.addressable_shards[0].data)
         for n, p in self._named_params.items():
             p._data = new_params[n]
         self._writeback_opt_state(new_state)

@@ -1,0 +1,44 @@
+"""Worker for test_launch.py multi-host SPMD test: fleet dp mesh spanning
+TWO PROCESSES (1 device each), full compiled TrainStep with cross-process
+collectives (Gloo over the jax coordination service). The reference's
+equivalent is NCCL dp across ranks (test_dist_base.py pattern)."""
+import os
+import sys
+
+import numpy as np
+import jax
+
+out_dir = sys.argv[1]
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+world = int(os.environ["PADDLE_TRAINERS_NUM"])
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.distributed.fleet as fleet
+
+dist.init_parallel_env()
+assert jax.process_count() == world
+
+paddle.seed(0)
+s = fleet.DistributedStrategy()
+s.hybrid_configs = {"dp_degree": world}
+fleet.init(is_collective=True, strategy=s)
+from paddle_tpu.distributed.mesh_utils import get_global_mesh
+mesh = get_global_mesh()
+assert mesh is not None and mesh.devices.size == world, mesh
+
+from paddle_tpu.jit import TrainStep
+
+net = paddle.nn.Linear(8, 4)
+opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+step = TrainStep(net, lambda o, y: ((o - y) ** 2).mean(), opt)
+rng = np.random.RandomState(0)    # same data on all ranks; dp shards it
+x = paddle.to_tensor(rng.randn(4 * world, 8).astype("float32"))
+y = paddle.to_tensor(rng.randn(4 * world, 4).astype("float32"))
+losses = [float(step(x, y).numpy()) for _ in range(3)]
+assert losses[-1] < losses[0], losses
+assert all(np.isfinite(losses)), losses
+
+with open(os.path.join(out_dir, f"mh_ok.{rank}"), "w") as f:
+    f.write(repr(losses))
+print(f"rank {rank}: multi-process TrainStep OK {losses}", flush=True)
