@@ -1,0 +1,161 @@
+"""paddle.signal — frame / overlap_add / stft / istft.
+
+Reference: python/paddle/signal.py (frame/overlap_add backed by the
+frame/overlap_add PHI ops, stft/istft composed from them + fft). Here the
+whole pipeline is expressed as gather/scatter + jnp.fft so XLA fuses the
+framing with the FFT; no custom kernels are needed on TPU.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.dispatch import apply_op
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _frame_last(x, frame_length, hop_length):
+    """x: (..., N) -> (..., frame_length, num_frames)."""
+    n = x.shape[-1]
+    num_frames = 1 + (n - frame_length) // hop_length
+    idx = (jnp.arange(frame_length)[:, None]
+           + hop_length * jnp.arange(num_frames)[None, :])  # (fl, nf)
+    return x[..., idx]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice a signal into overlapping frames.
+
+    axis=-1: (..., seq_len) -> (..., frame_length, num_frames)
+    axis=0:  (seq_len, ...) -> (num_frames, frame_length, ...)
+    """
+    if frame_length <= 0 or hop_length <= 0:
+        raise ValueError("frame_length and hop_length must be positive")
+
+    def fn(a):
+        if a.shape[axis if axis >= 0 else a.ndim + axis] < frame_length:
+            raise ValueError(
+                f"frame_length ({frame_length}) exceeds signal length")
+        if axis in (-1, a.ndim - 1):
+            return _frame_last(a, frame_length, hop_length)
+        if axis == 0:
+            moved = jnp.moveaxis(a, 0, -1)
+            f = _frame_last(moved, frame_length, hop_length)
+            # (..., fl, nf) -> (nf, fl, ...)
+            return jnp.moveaxis(jnp.moveaxis(f, -1, 0), -1, 1)
+        raise ValueError("axis must be 0 or -1")
+
+    return apply_op("frame", fn, x)
+
+
+def _overlap_add_last(x, hop_length):
+    """x: (..., frame_length, num_frames) -> (..., output_len)."""
+    fl, nf = x.shape[-2], x.shape[-1]
+    out_len = (nf - 1) * hop_length + fl
+    idx = (jnp.arange(fl)[:, None]
+           + hop_length * jnp.arange(nf)[None, :])  # (fl, nf)
+    out = jnp.zeros(x.shape[:-2] + (out_len,), dtype=x.dtype)
+    return out.at[..., idx].add(x)
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of :func:`frame` (sums overlapping regions)."""
+    if hop_length <= 0:
+        raise ValueError("hop_length must be positive")
+
+    def fn(a):
+        if a.ndim < 2:
+            raise ValueError("overlap_add expects rank >= 2")
+        if axis in (-1, a.ndim - 1):
+            return _overlap_add_last(a, hop_length)
+        if axis == 0:
+            # (nf, fl, ...) -> (..., fl, nf)
+            moved = jnp.moveaxis(jnp.moveaxis(a, 0, -1), 0, -2)
+            return jnp.moveaxis(_overlap_add_last(moved, hop_length), -1, 0)
+        raise ValueError("axis must be 0 or -1")
+
+    return apply_op("overlap_add", fn, x)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Short-time Fourier transform.
+
+    x: (batch?, seq_len) real or complex -> (batch?, n_freq, num_frames).
+    """
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is not None:
+        win = window._data if hasattr(window, "_data") else jnp.asarray(window)
+    else:
+        win = jnp.ones((win_length,), dtype=jnp.float32)
+    if win.shape[-1] != win_length:
+        raise ValueError("window length must equal win_length")
+    # center-pad the window out to n_fft, as the reference does.
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+
+    def fn(a):
+        is_complex = jnp.issubdtype(a.dtype, jnp.complexfloating)
+        if is_complex and onesided:
+            raise ValueError(
+                "stft: onesided is not supported for complex inputs")
+        if center:
+            pad = [(0, 0)] * (a.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            a = jnp.pad(a, pad, mode=pad_mode)
+        frames = _frame_last(a, n_fft, hop_length)  # (..., n_fft, nf)
+        frames = frames * win[:, None].astype(frames.dtype)
+        if onesided and not is_complex:
+            spec = jnp.fft.rfft(frames, axis=-2)
+        else:
+            spec = jnp.fft.fft(frames, axis=-2)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return spec
+
+    return apply_op("stft", fn, x)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT with least-squares window compensation."""
+    if onesided and return_complex:
+        raise ValueError(
+            "istft: onesided=True cannot produce a complex output")
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is not None:
+        win = window._data if hasattr(window, "_data") else jnp.asarray(window)
+    else:
+        win = jnp.ones((win_length,), dtype=jnp.float32)
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+
+    def fn(spec):
+        s = spec
+        if normalized:
+            s = s * jnp.sqrt(jnp.asarray(n_fft, s.real.dtype))
+        if onesided:
+            frames = jnp.fft.irfft(s, n=n_fft, axis=-2)
+        else:
+            frames = jnp.fft.ifft(s, axis=-2)
+            if not return_complex:
+                frames = frames.real
+        frames = frames * win[:, None].astype(frames.dtype)
+        y = _overlap_add_last(frames, hop_length)
+        # window-envelope normalization (sum of squared windows per sample)
+        nf = spec.shape[-1]
+        wsq = jnp.broadcast_to((win * win)[:, None], (n_fft, nf))
+        env = _overlap_add_last(wsq, hop_length)
+        y = y / jnp.maximum(env, 1e-11).astype(y.dtype)
+        if center:
+            y = y[..., n_fft // 2: y.shape[-1] - n_fft // 2]
+        if length is not None:
+            y = y[..., :length]
+        return y
+
+    return apply_op("istft", fn, x)

@@ -1,0 +1,735 @@
+"""paddle.vision.ops — detection / region ops.
+
+Reference: python/paddle/vision/ops.py (yolo_box, prior_box, box_coder,
+deform_conv2d, roi_pool/roi_align/psroi_pool, nms) backed by PHI CUDA
+kernels. TPU-native design: the dense, differentiable ops (roi_align,
+deform_conv2d, box decode) are vectorized gather/interp compositions that
+XLA fuses; greedy NMS is data-dependent and sequential, so the
+suppression scan runs as a bounded `lax.fori_loop` over a precomputed IoU
+matrix, then syncs the kept mask to the host to build the
+variable-length index result (these post-processing ops are eager-only,
+as in the reference's detection heads).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.dispatch import apply_op, wrap
+from ..core.tensor import Tensor
+from .. import nn
+
+__all__ = [
+    "yolo_box", "prior_box", "box_coder", "deform_conv2d", "DeformConv2D",
+    "roi_pool", "RoIPool", "roi_align", "RoIAlign", "psroi_pool", "PSRoIPool",
+    "nms", "matrix_nms", "distribute_fpn_proposals",
+]
+
+
+# ---------------------------------------------------------------- box utils
+
+def _iou_matrix(boxes, offset=0.0):
+    """boxes (N,4) xyxy -> (N,N) IoU. offset=1 for pixel (unnormalized)
+    coordinates, as in the reference kernels' `normalized=False` mode."""
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = jnp.maximum(x2 - x1 + offset, 0) * jnp.maximum(y2 - y1 + offset, 0)
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    inter = (jnp.maximum(ix2 - ix1 + offset, 0)
+             * jnp.maximum(iy2 - iy1 + offset, 0))
+    union = area[:, None] + area[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """Greedy hard NMS; returns kept indices sorted by descending score.
+
+    Matches reference python/paddle/vision/ops.py:nms — supports
+    category-aware batched NMS via the coordinate-offset trick.
+    """
+    b = boxes._data if isinstance(boxes, Tensor) else jnp.asarray(boxes)
+    n = b.shape[0]
+    if n == 0:
+        return wrap(jnp.zeros((0,), dtype=jnp.int64))
+    if scores is None:
+        s = jnp.arange(n, 0, -1, dtype=jnp.float32)
+    else:
+        s = scores._data if isinstance(scores, Tensor) else jnp.asarray(scores)
+    if category_idxs is not None:
+        cidx = (category_idxs._data if isinstance(category_idxs, Tensor)
+                else jnp.asarray(category_idxs))
+        # offset every category into a disjoint coordinate range so one
+        # global NMS never suppresses across categories
+        span = jnp.max(b) - jnp.min(b) + 1.0
+        b = b + (cidx.astype(b.dtype) * span)[:, None]
+
+    order = jnp.argsort(-s)
+    bs = b[order]
+    iou = _iou_matrix(bs)
+
+    def body(i, keep):
+        # drop i if it overlaps any higher-scoring kept box
+        sup = jnp.any((iou[i] > iou_threshold) & keep & (jnp.arange(n) < i))
+        return keep.at[i].set(~sup & keep[i])
+
+    keep = lax.fori_loop(0, n, body, jnp.ones((n,), dtype=bool))
+    kept_sorted = np.asarray(order)[np.asarray(keep)]
+    if top_k is not None:
+        kept_sorted = kept_sorted[:top_k]
+    return wrap(jnp.asarray(kept_sorted, dtype=jnp.int64))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Parallel (matrix) soft-NMS — decay each score by worst overlap
+    with any higher-scoring box of the same class.
+
+    Reference: python/paddle/vision/ops.py matrix_nms (PHI matrix_nms op).
+    Single-image, fully vectorized.
+    """
+    bb = bboxes._data if isinstance(bboxes, Tensor) else jnp.asarray(bboxes)
+    sc = scores._data if isinstance(scores, Tensor) else jnp.asarray(scores)
+    # bb: (1, M, 4); sc: (1, C, M)
+    bb2, sc2 = bb[0], sc[0]
+    C, M = sc2.shape
+    rows = []  # (decayed_score, class, box, orig_idx)
+    for c in range(C):
+        if c == background_label:
+            continue
+        s = np.asarray(sc2[c])
+        sel = np.nonzero(s > score_threshold)[0]
+        if sel.size == 0:
+            continue
+        sel = sel[np.argsort(-s[sel])][:nms_top_k]
+        boxes_c = bb2[sel]
+        sc_c = jnp.asarray(s[sel])
+        n = sel.shape[0]
+        iou = _iou_matrix(boxes_c, offset=0.0 if normalized else 1.0)
+        ntri = jnp.tril(iou, -1)  # row i: overlaps with higher-scored j<i
+        comp = jnp.max(ntri, axis=1)  # worst overlap of each box w/ its preds
+        if use_gaussian:
+            dec = jnp.exp(-(ntri ** 2 - comp[None, :] ** 2) * gaussian_sigma)
+        else:
+            dec = (1 - ntri) / jnp.maximum(1 - comp[None, :], 1e-9)
+        lower = jnp.tril(jnp.ones((n, n), dtype=bool), -1)
+        decay = jnp.min(jnp.where(lower, dec, 1.0), axis=1)
+        decay = jnp.minimum(decay, 1.0)  # never increase a score
+        dec_scores = sc_c * decay
+        keep = np.asarray(dec_scores) > post_threshold
+        for k, orig in zip(np.asarray(dec_scores)[keep], sel[keep]):
+            rows.append((float(k), float(c), np.asarray(bb2[orig]),
+                         int(orig)))
+    rows.sort(key=lambda r: -r[0])
+    rows = rows[:keep_top_k]
+    outs = [[r[1], r[0]] + list(r[2]) for r in rows]
+    idxs = [r[3] for r in rows]
+    out = wrap(jnp.asarray(outs, dtype=jnp.float32).reshape(-1, 6))
+    rois_num = wrap(jnp.asarray([len(outs)], dtype=jnp.int32))
+    res = [out]
+    if return_rois_num:
+        res.append(rois_num)
+    if return_index:
+        res.append(wrap(jnp.asarray(idxs, dtype=jnp.int32)))
+    return tuple(res) if len(res) > 1 else out
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, name=None, scale_x_y=1.0, iou_aware=False,
+             iou_aware_factor=0.5):
+    """Decode YOLOv3 head output into boxes + scores.
+
+    Reference: python/paddle/vision/ops.py yolo_box (PHI yolo_box kernel).
+    x: (N, S*(5+class_num), H, W) -> boxes (N, H*W*S, 4), scores
+    (N, H*W*S, class_num).
+    """
+    s = len(anchors) // 2
+    anc = jnp.asarray(anchors, dtype=jnp.float32).reshape(s, 2)
+
+    def fn(a, imgs):
+        n, _, h, w = a.shape
+        a = a.reshape(n, s, 5 + class_num + (1 if iou_aware else 0), h, w)
+        if iou_aware:
+            ioup, a = a[:, :, :1], a[:, :, 1:]
+        gx = (jnp.arange(w, dtype=jnp.float32))[None, None, None, :]
+        gy = (jnp.arange(h, dtype=jnp.float32))[None, None, :, None]
+        sig = jax.nn.sigmoid
+        bx = (sig(a[:, :, 0]) * scale_x_y - 0.5 * (scale_x_y - 1) + gx) / w
+        by = (sig(a[:, :, 1]) * scale_x_y - 0.5 * (scale_x_y - 1) + gy) / h
+        input_h = downsample_ratio * h
+        input_w = downsample_ratio * w
+        bw = jnp.exp(a[:, :, 2]) * anc[None, :, 0, None, None] / input_w
+        bh = jnp.exp(a[:, :, 3]) * anc[None, :, 1, None, None] / input_h
+        conf = sig(a[:, :, 4])
+        if iou_aware:
+            conf = conf ** (1 - iou_aware_factor) * \
+                sig(ioup[:, :, 0]) ** iou_aware_factor
+        prob = sig(a[:, :, 5:]) * conf[:, :, None]
+        imgh = imgs[:, 0].astype(jnp.float32)[:, None, None, None]
+        imgw = imgs[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (bx - bw / 2) * imgw
+        y1 = (by - bh / 2) * imgh
+        x2 = (bx + bw / 2) * imgw
+        y2 = (by + bh / 2) * imgh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0)
+            y1 = jnp.clip(y1, 0)
+            x2 = jnp.minimum(x2, imgw - 1)
+            y2 = jnp.minimum(y2, imgh - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1)  # (n,s,h,w,4)
+        boxes = boxes.transpose(0, 2, 3, 1, 4).reshape(n, h * w * s, 4)
+        scores = prob.transpose(0, 3, 4, 1, 2).reshape(
+            n, h * w * s, class_num)
+        mask = conf.transpose(0, 2, 3, 1).reshape(n, h * w * s) > conf_thresh
+        boxes = boxes * mask[..., None].astype(boxes.dtype)
+        scores = scores * mask[..., None].astype(scores.dtype)
+        return boxes, scores
+
+    return apply_op("yolo_box", fn, x, img_size)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior (anchor) box generation.
+
+    Reference: python/paddle/vision/ops.py prior_box (PHI prior_box).
+    """
+    inp = input._data if isinstance(input, Tensor) else jnp.asarray(input)
+    img = image._data if isinstance(image, Tensor) else jnp.asarray(image)
+    h, w = inp.shape[2], inp.shape[3]
+    img_h, img_w = img.shape[2], img.shape[3]
+    step_w = steps[0] or img_w / w
+    step_h = steps[1] or img_h / h
+
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+
+    whs = []  # (box_w, box_h) in pixels, ordering per reference kernel
+    for k, ms in enumerate(min_sizes):
+        ms = float(ms)
+        if min_max_aspect_ratios_order:
+            whs.append((ms, ms))
+            if max_sizes:
+                sq = float(np.sqrt(ms * float(max_sizes[k])))
+                whs.append((sq, sq))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        else:
+            for ar in ars:
+                whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+            if max_sizes:
+                sq = float(np.sqrt(ms * float(max_sizes[k])))
+                whs.append((sq, sq))
+    whs = np.asarray(whs, dtype=np.float32)  # (P, 2)
+    P = whs.shape[0]
+
+    cx = (np.arange(w, dtype=np.float32) + offset) * step_w
+    cy = (np.arange(h, dtype=np.float32) + offset) * step_h
+    cxg, cyg = np.meshgrid(cx, cy)  # (h, w)
+    out = np.zeros((h, w, P, 4), dtype=np.float32)
+    out[..., 0] = (cxg[:, :, None] - whs[None, None, :, 0] / 2) / img_w
+    out[..., 1] = (cyg[:, :, None] - whs[None, None, :, 1] / 2) / img_h
+    out[..., 2] = (cxg[:, :, None] + whs[None, None, :, 0] / 2) / img_w
+    out[..., 3] = (cyg[:, :, None] + whs[None, None, :, 1] / 2) / img_h
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, dtype=np.float32),
+                          out.shape).copy()
+    return wrap(jnp.asarray(out)), wrap(jnp.asarray(var))
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, axis=0, name=None):
+    """Encode/decode boxes against priors (R-CNN style deltas).
+
+    Reference: python/paddle/vision/ops.py box_coder (PHI box_coder).
+    """
+    pb = prior_box._data if isinstance(prior_box, Tensor) else jnp.asarray(prior_box)
+    pbv = None
+    if prior_box_var is not None and not isinstance(prior_box_var, (list, tuple)):
+        pbv = (prior_box_var._data if isinstance(prior_box_var, Tensor)
+               else jnp.asarray(prior_box_var))
+    elif isinstance(prior_box_var, (list, tuple)):
+        pbv = jnp.asarray(prior_box_var, dtype=jnp.float32)
+
+    norm = 0.0 if box_normalized else 1.0
+
+    def fn(tb):
+        pw = pb[:, 2] - pb[:, 0] + norm
+        ph = pb[:, 3] - pb[:, 1] + norm
+        pcx = pb[:, 0] + pw / 2
+        pcy = pb[:, 1] + ph / 2
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + norm
+            th = tb[:, 3] - tb[:, 1] + norm
+            tcx = tb[:, 0] + tw / 2
+            tcy = tb[:, 1] + th / 2
+            dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+            dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+            dw = jnp.log(tw[:, None] / pw[None, :])
+            dh = jnp.log(th[:, None] / ph[None, :])
+            out = jnp.stack([dx, dy, dw, dh], axis=-1)  # (T, P, 4)
+            if pbv is not None:
+                out = out / (pbv if pbv.ndim == 1 else pbv[None, :, :])
+            return out
+        # decode_center_size: tb (T, P, 4) deltas against priors
+        if axis == 0:
+            pw_, ph_, pcx_, pcy_ = (pw[None, :], ph[None, :],
+                                    pcx[None, :], pcy[None, :])
+        else:
+            pw_, ph_, pcx_, pcy_ = (pw[:, None], ph[:, None],
+                                    pcx[:, None], pcy[:, None])
+        d = tb
+        if pbv is not None:
+            v = pbv
+            if v.ndim == 1:
+                d = d * v
+            else:
+                d = d * (v[None, :, :] if axis == 0 else v[:, None, :])
+        ocx = d[..., 0] * pw_ + pcx_
+        ocy = d[..., 1] * ph_ + pcy_
+        ow = jnp.exp(d[..., 2]) * pw_
+        oh = jnp.exp(d[..., 3]) * ph_
+        return jnp.stack([ocx - ow / 2, ocy - oh / 2,
+                          ocx + ow / 2 - norm, ocy + oh / 2 - norm], axis=-1)
+
+    return apply_op("box_coder", fn, target_box)
+
+
+# ------------------------------------------------------------- roi pooling
+
+def _rois_to_batch(boxes, boxes_num):
+    bn = np.asarray(boxes_num._data if isinstance(boxes_num, Tensor)
+                    else boxes_num)
+    batch_idx = np.repeat(np.arange(bn.shape[0]), bn)
+    return jnp.asarray(batch_idx)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign with bilinear sampling (Mask R-CNN).
+
+    Reference: python/paddle/vision/ops.py roi_align (PHI roi_align).
+    """
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    batch_idx = _rois_to_batch(boxes, boxes_num)
+    # Per-roi adaptive sampling density (reference roi_align kernel:
+    # sampling_ratio<=0 -> ceil(roi_size/output_size) per roi). Boxes are
+    # host data on this eager path, so group rois that share a density and
+    # vmap within each group.
+    bnp = np.asarray(boxes._data if isinstance(boxes, Tensor) else boxes,
+                     dtype=np.float64)
+    rh_np = bnp[:, 3] * spatial_scale - bnp[:, 1] * spatial_scale
+    rw_np = bnp[:, 2] * spatial_scale - bnp[:, 0] * spatial_scale
+    if not aligned:
+        rh_np = np.maximum(rh_np, 1.0)
+        rw_np = np.maximum(rw_np, 1.0)
+    if sampling_ratio > 0:
+        sr_h = np.full(bnp.shape[0], sampling_ratio, dtype=np.int64)
+        sr_w = sr_h
+    else:
+        sr_h = np.maximum(np.ceil(rh_np / ph), 1).astype(np.int64)
+        sr_w = np.maximum(np.ceil(rw_np / pw), 1).astype(np.int64)
+
+    def fn(feat, bx):
+        off = 0.5 if aligned else 0.0
+        x1 = bx[:, 0] * spatial_scale - off
+        y1 = bx[:, 1] * spatial_scale - off
+        x2 = bx[:, 2] * spatial_scale - off
+        y2 = bx[:, 3] * spatial_scale - off
+        rw = x2 - x1
+        rh = y2 - y1
+        if not aligned:
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        H, W = feat.shape[2], feat.shape[3]
+
+        def bilinear(img, ys, xs):
+            # img (C,H,W); ys (ny,), xs (nx,) -> (C, ny, nx). Samples
+            # farther than 1px outside the map contribute 0 (reference
+            # kernel's y < -1 || y > height rule).
+            vy = (ys >= -1.0) & (ys <= H)
+            vx = (xs >= -1.0) & (xs <= W)
+            ys = jnp.clip(ys, 0.0, H - 1.0)
+            xs = jnp.clip(xs, 0.0, W - 1.0)
+            y0 = jnp.floor(ys).astype(jnp.int32)
+            x0 = jnp.floor(xs).astype(jnp.int32)
+            y1_ = jnp.minimum(y0 + 1, H - 1)
+            x1_ = jnp.minimum(x0 + 1, W - 1)
+            wy = ys - y0
+            wx = xs - x0
+            v00 = img[:, y0][:, :, x0]
+            v01 = img[:, y0][:, :, x1_]
+            v10 = img[:, y1_][:, :, x0]
+            v11 = img[:, y1_][:, :, x1_]
+            out = (v00 * (1 - wy)[None, :, None] * (1 - wx)[None, None, :]
+                   + v01 * (1 - wy)[None, :, None] * wx[None, None, :]
+                   + v10 * wy[None, :, None] * (1 - wx)[None, None, :]
+                   + v11 * wy[None, :, None] * wx[None, None, :])
+            return out * (vy[:, None] & vx[None, :])[None].astype(out.dtype)
+
+        def roi_group(ridx, sh, sw):
+            # sample grids for rois in ridx, all sharing density (sh, sw)
+            iy = (jnp.arange(sh, dtype=feat.dtype) + 0.5) / sh
+            ix = (jnp.arange(sw, dtype=feat.dtype) + 0.5) / sw
+            yy = (y1[ridx][:, None, None]
+                  + (jnp.arange(ph, dtype=feat.dtype)[None, :, None]
+                     + iy[None, None, :]) * bin_h[ridx][:, None, None])
+            xx = (x1[ridx][:, None, None]
+                  + (jnp.arange(pw, dtype=feat.dtype)[None, :, None]
+                     + ix[None, None, :]) * bin_w[ridx][:, None, None])
+
+            def per_roi(r):
+                img = feat[batch_idx[ridx][r]]
+                s = bilinear(img, yy[r].reshape(-1), xx[r].reshape(-1))
+                C = s.shape[0]
+                return s.reshape(C, ph, sh, pw, sw).mean(axis=(2, 4))
+
+            return jax.vmap(per_roi)(jnp.arange(len(ridx)))
+
+        R = bnp.shape[0]
+        if R == 0:
+            return jnp.zeros((0, feat.shape[1], ph, pw), feat.dtype)
+        groups = {}
+        for r in range(R):
+            groups.setdefault((int(sr_h[r]), int(sr_w[r])), []).append(r)
+        pieces = [None] * R
+        for (sh, sw), ridx in groups.items():
+            out_g = roi_group(jnp.asarray(ridx), sh, sw)
+            for k, r in enumerate(ridx):
+                pieces[r] = out_g[k]
+        return jnp.stack(pieces)
+
+    return apply_op("roi_align", fn, x, boxes)
+
+
+def _bin_masks(starts, lens, P, D, quantize):
+    """Per-roi bin membership masks (R, P, D) computed host-side.
+
+    starts/lens: (R,) float roi start + extent; bin i of roi r covers
+    [start + floor/… , …) rows per the reference's quantization rule.
+    """
+    R = starts.shape[0]
+    m = np.zeros((R, P, D), dtype=bool)
+    for r in range(R):
+        for i in range(P):
+            if quantize == "inner":  # roi_pool: integer start + floor(len)
+                lo = starts[r] + np.floor(i * lens[r] / P)
+                hi = starts[r] + np.ceil((i + 1) * lens[r] / P)
+            else:  # psroi_pool: floor/ceil applied to the float boundary
+                lo = np.floor(starts[r] + i * lens[r] / P)
+                hi = np.ceil(starts[r] + (i + 1) * lens[r] / P)
+            lo, hi = int(max(lo, 0)), int(min(hi, D))
+            if hi > lo:
+                m[r, i, lo:hi] = True
+    return m
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """RoIPool: max over quantized bins (Fast R-CNN).
+
+    Reference: python/paddle/vision/ops.py roi_pool (PHI roi_pool).
+    Vectorized as two masked max-reductions (over W then H) so all rois
+    resolve in a handful of XLA ops instead of per-bin slicing.
+    """
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    batch_idx = np.asarray(_rois_to_batch(boxes, boxes_num))
+    bx = np.asarray(boxes._data if isinstance(boxes, Tensor) else boxes)
+    R = bx.shape[0]
+
+    def fn(feat):
+        H, W = feat.shape[2], feat.shape[3]
+        if R == 0:
+            return jnp.zeros((0, feat.shape[1], ph, pw), feat.dtype)
+        x1 = np.round(bx[:, 0] * spatial_scale)
+        y1 = np.round(bx[:, 1] * spatial_scale)
+        x2 = np.round(bx[:, 2] * spatial_scale)
+        y2 = np.round(bx[:, 3] * spatial_scale)
+        rh = np.maximum(y2 - y1 + 1, 1)
+        rw = np.maximum(x2 - x1 + 1, 1)
+        mh = jnp.asarray(_bin_masks(y1, rh, ph, H, "inner"))  # (R, ph, H)
+        mw = jnp.asarray(_bin_masks(x1, rw, pw, W, "inner"))  # (R, pw, W)
+        fr = feat[jnp.asarray(batch_idx)]  # (R, C, H, W)
+        neg = jnp.asarray(-jnp.inf, feat.dtype)
+        # max over W within each w-bin -> (R, C, H, pw)
+        t1 = jnp.max(jnp.where(mw[:, None, None, :, :],
+                               fr[:, :, :, None, :], neg), axis=-1)
+        # max over H within each h-bin -> (R, C, ph, pw)
+        t2 = jnp.max(jnp.where(mh[:, None, :, None, :],
+                               jnp.moveaxis(t1, 2, 3)[:, :, None], neg),
+                     axis=-1)
+        return jnp.where(jnp.isfinite(t2), t2, 0.0)
+
+    return apply_op("roi_pool", fn, x)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI average pooling (R-FCN).
+
+    Reference: python/paddle/vision/ops.py psroi_pool (PHI psroi_pool).
+    Channels C must equal out_c * ph * pw.
+    """
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    batch_idx = np.asarray(_rois_to_batch(boxes, boxes_num))
+    bx = np.asarray(boxes._data if isinstance(boxes, Tensor) else boxes)
+    R = bx.shape[0]
+
+    def fn(feat):
+        N, C, H, W = feat.shape
+        out_c = C // (ph * pw)
+        if R == 0:
+            return jnp.zeros((0, out_c, ph, pw), feat.dtype)
+        x1 = bx[:, 0] * spatial_scale
+        y1 = bx[:, 1] * spatial_scale
+        rh = np.maximum(bx[:, 3] * spatial_scale - y1, 0.1)
+        rw = np.maximum(bx[:, 2] * spatial_scale - x1, 0.1)
+        mh = jnp.asarray(_bin_masks(y1, rh, ph, H, "outer"),
+                         dtype=feat.dtype)  # (R, ph, H)
+        mw = jnp.asarray(_bin_masks(x1, rw, pw, W, "outer"),
+                         dtype=feat.dtype)  # (R, pw, W)
+        # position-sensitive channel layout: channel (c*ph + i)*pw + j
+        fr = feat[jnp.asarray(batch_idx)].reshape(R, out_c, ph, pw, H, W)
+        s = jnp.einsum("rcijhw,rih,rjw->rcij", fr, mh, mw)
+        cnt = jnp.einsum("rih,rjw->rij", mh, mw)[:, None]
+        return jnp.where(cnt > 0, s / jnp.maximum(cnt, 1.0), 0.0)
+
+    return apply_op("psroi_pool", fn, x)
+
+
+# ------------------------------------------------------- deformable conv
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1/v2 as bilinear gather + dense matmul.
+
+    Reference: python/paddle/vision/ops.py deform_conv2d (PHI
+    deformable_conv kernel). The im2col+offset sampling is expressed as a
+    vectorized bilinear interpolation so XLA maps the contraction on the
+    MXU; mask!=None selects v2 (modulated).
+    """
+    stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    padding = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dilation = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+
+    def fn(*arrs):
+        if mask is not None:
+            a, off, w_, m = arrs[0], arrs[1], arrs[2], arrs[3]
+            rest = arrs[4:]
+        else:
+            a, off, w_ = arrs[0], arrs[1], arrs[2]
+            m = None
+            rest = arrs[3:]
+        b_ = rest[0] if rest else None
+        N, C, H, W = a.shape
+        Cout, Cin_g, kh, kw = w_.shape
+        pad_a = jnp.pad(a, ((0, 0), (0, 0), (padding[0], padding[0]),
+                            (padding[1], padding[1])))
+        Hp, Wp = pad_a.shape[2], pad_a.shape[3]
+        Ho = (H + 2 * padding[0] - dilation[0] * (kh - 1) - 1) // stride[0] + 1
+        Wo = (W + 2 * padding[1] - dilation[1] * (kw - 1) - 1) // stride[1] + 1
+        # base sampling grid (kh*kw, Ho, Wo)
+        oy = jnp.arange(Ho) * stride[0]
+        ox = jnp.arange(Wo) * stride[1]
+        ky = jnp.arange(kh) * dilation[0]
+        kx = jnp.arange(kw) * dilation[1]
+        base_y = (oy[None, :, None] + ky[:, None, None]).astype(jnp.float32)
+        base_x = (ox[None, None, :] + kx[:, None, None]).astype(jnp.float32)
+        base_y = jnp.broadcast_to(base_y[:, None], (kh, kw, Ho, Wo)).reshape(
+            kh * kw, Ho, Wo)
+        base_x = jnp.broadcast_to(base_x[None, :], (kh, kw, Ho, Wo)).reshape(
+            kh * kw, Ho, Wo)
+        # offsets: (N, dg*2*kh*kw, Ho, Wo) ordered (y, x) per kernel point
+        off = off.reshape(N, deformable_groups, kh * kw, 2, Ho, Wo)
+        sy = base_y[None, None] + off[:, :, :, 0]
+        sx = base_x[None, None] + off[:, :, :, 1]
+
+        cg = C // deformable_groups
+
+        def bilinear_nc(img, ys, xs):
+            # img (cg, Hp, Wp), ys/xs (kk, Ho, Wo). Corner-wise zero
+            # padding like the reference dmcn_im2col_bilinear: weights come
+            # from the UNclamped fractional coords, and each corner only
+            # contributes if that corner index is inside the map.
+            valid = ((ys > -1) & (ys < Hp) & (xs > -1) & (xs < Wp))
+            y0f = jnp.floor(ys)
+            x0f = jnp.floor(xs)
+            wy = (ys - y0f).astype(img.dtype)
+            wx = (xs - x0f).astype(img.dtype)
+            y0 = y0f.astype(jnp.int32)
+            x0 = x0f.astype(jnp.int32)
+            y1 = y0 + 1
+            x1 = x0 + 1
+
+            def corner(yy, xx):
+                ok = (yy >= 0) & (yy < Hp) & (xx >= 0) & (xx < Wp)
+                v = img[:, jnp.clip(yy, 0, Hp - 1), jnp.clip(xx, 0, Wp - 1)]
+                return v * ok.astype(img.dtype)
+
+            v = (corner(y0, x0) * (1 - wy) * (1 - wx)
+                 + corner(y0, x1) * (1 - wy) * wx
+                 + corner(y1, x0) * wy * (1 - wx)
+                 + corner(y1, x1) * wy * wx)
+            return v * valid.astype(img.dtype)
+
+        def per_n(img_n, sy_n, sx_n, m_n):
+            # img_n (C,Hp,Wp) -> cols (C, kk, Ho, Wo)
+            cols = []
+            for dg in range(deformable_groups):
+                cols.append(bilinear_nc(
+                    img_n[dg * cg:(dg + 1) * cg], sy_n[dg], sx_n[dg]))
+            col = jnp.concatenate(cols, axis=0)
+            if m_n is not None:
+                # m_n (dg, kk, Ho, Wo) -> broadcast over channels in group
+                mm = jnp.concatenate([jnp.broadcast_to(
+                    m_n[dgi][None], (cg,) + m_n.shape[1:])
+                    for dgi in range(deformable_groups)], axis=0)
+                col = col * mm
+            return col
+
+        if m is not None:
+            m = m.reshape(N, deformable_groups, kh * kw, Ho, Wo)
+            cols = jax.vmap(per_n)(pad_a, sy, sx, m)
+        else:
+            cols = jax.vmap(lambda i, y, x_: per_n(i, y, x_, None))(
+                pad_a, sy, sx)
+        # cols (N, C, kk, Ho, Wo); weight (Cout, C/groups, kh, kw)
+        cpg_out = Cout // groups
+        outs = []
+        for g_ in range(groups):
+            cs = cols[:, g_ * Cin_g:(g_ + 1) * Cin_g].reshape(
+                N, Cin_g * kh * kw, Ho * Wo)
+            wg = w_[g_ * cpg_out:(g_ + 1) * cpg_out].reshape(
+                cpg_out, Cin_g * kh * kw)
+            outs.append(jnp.einsum("ok,nkp->nop", wg, cs))
+        out = jnp.concatenate(outs, axis=1).reshape(N, Cout, Ho, Wo)
+        if b_ is not None:
+            out = out + b_[None, :, None, None]
+        return out
+
+    args = [x, offset, weight] + ([mask] if mask is not None else []) + \
+        ([bias] if bias is not None else [])
+    return apply_op("deformable_conv", fn, *args)
+
+
+class DeformConv2D(nn.Layer):
+    """Deformable convolution layer (reference vision/ops.py DeformConv2D)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._deformable_groups = deformable_groups
+        self._groups = groups
+        self.weight = self.create_parameter(
+            shape=[out_channels, in_channels // groups, ks[0], ks[1]])
+        self.bias = None if bias_attr is False else self.create_parameter(
+            shape=[out_channels], is_bias=True)
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(
+            x, offset, self.weight, self.bias, stride=self._stride,
+            padding=self._padding, dilation=self._dilation,
+            deformable_groups=self._deformable_groups, groups=self._groups,
+            mask=mask)
+
+
+class RoIPool(nn.Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._output_size,
+                        self._spatial_scale)
+
+
+class RoIAlign(nn.Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self._output_size,
+                         self._spatial_scale, aligned=aligned)
+
+
+class PSRoIPool(nn.Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._output_size,
+                          self._spatial_scale)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Assign RoIs to FPN levels by scale (reference vision/ops.py)."""
+    rois = np.asarray(fpn_rois._data if isinstance(fpn_rois, Tensor)
+                      else fpn_rois)
+    off = 1.0 if pixel_offset else 0.0
+    ws = rois[:, 2] - rois[:, 0] + off
+    hs = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.maximum(ws * hs, 1e-12))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    if rois_num is not None:
+        rn = np.asarray(rois_num._data if isinstance(rois_num, Tensor)
+                        else rois_num)
+        img_of_roi = np.repeat(np.arange(rn.shape[0]), rn)
+    else:
+        rn = None
+        img_of_roi = np.zeros(rois.shape[0], dtype=np.int64)
+    multi_rois, nums = [], []
+    order = []
+    for L in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == L)[0]
+        order.append(idx)
+        multi_rois.append(wrap(jnp.asarray(rois[idx])))
+        if rn is not None:
+            # per-image counts at this level, shape (num_images,)
+            per_img = np.bincount(img_of_roi[idx], minlength=rn.shape[0])
+            nums.append(wrap(jnp.asarray(per_img.astype(np.int32))))
+        else:
+            nums.append(wrap(jnp.asarray([idx.shape[0]], dtype=jnp.int32)))
+    cat = np.concatenate(order) if order else np.zeros((0,), np.int64)
+    restore_ind = np.empty_like(cat)
+    restore_ind[cat] = np.arange(cat.shape[0])
+    restore = wrap(jnp.asarray(restore_ind.reshape(-1, 1)))
+    if rois_num is not None:
+        return multi_rois, restore, nums
+    return multi_rois, restore

@@ -1,0 +1,421 @@
+"""paddle.signal + paddle.vision.ops vs numpy / torch oracles.
+
+Mirrors the reference OpTest pattern (numpy as the oracle); torch (CPU,
+baked into the image) provides oracles for stft/roi_align/deform_conv2d
+exactly as the reference's tests use scipy/opencv-computed references.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import to_tensor
+from paddle_tpu.vision import ops as vops
+
+torch = pytest.importorskip("torch")
+
+
+# ------------------------------------------------------------------ signal
+
+def test_frame_last_axis():
+    x = np.arange(16, dtype=np.float32)
+    out = paddle.signal.frame(to_tensor(x), frame_length=4, hop_length=2)
+    ref = np.stack([x[i * 2:i * 2 + 4] for i in range(7)], axis=-1)
+    np.testing.assert_allclose(out.numpy(), ref)
+
+
+def test_frame_axis0_batched():
+    x = np.random.randn(16, 3).astype(np.float32)
+    out = paddle.signal.frame(to_tensor(x), frame_length=8, hop_length=4,
+                              axis=0)
+    assert out.shape == [3, 8, 3]
+    np.testing.assert_allclose(out.numpy()[1, :, 2], x[4:12, 2], rtol=1e-6)
+
+
+def test_overlap_add_inverts_frame_non_overlapping():
+    x = np.random.randn(2, 12).astype(np.float32)
+    f = paddle.signal.frame(to_tensor(x), frame_length=4, hop_length=4)
+    y = paddle.signal.overlap_add(f, hop_length=4)
+    np.testing.assert_allclose(y.numpy(), x, rtol=1e-6)
+
+
+def test_overlap_add_matches_torch():
+    frames = np.random.randn(6, 5).astype(np.float32)  # (frame_len, n)
+    y = paddle.signal.overlap_add(to_tensor(frames), hop_length=2)
+    ref = torch.nn.functional.fold(
+        torch.tensor(frames)[None], output_size=(1, 4 * 2 + 6),
+        kernel_size=(1, 6), stride=(1, 2))[0, 0, 0].numpy()
+    np.testing.assert_allclose(y.numpy(), ref, rtol=1e-5)
+
+
+@pytest.mark.parametrize("onesided", [True, False])
+def test_stft_matches_torch(onesided):
+    np.random.seed(0)
+    x = np.random.randn(2, 256).astype(np.float32)
+    win = np.hanning(64).astype(np.float32)
+    out = paddle.signal.stft(to_tensor(x), n_fft=64, hop_length=16,
+                             window=to_tensor(win), center=True,
+                             onesided=onesided)
+    ref = torch.stft(torch.tensor(x), n_fft=64, hop_length=16,
+                     window=torch.tensor(win), center=True,
+                     onesided=onesided, return_complex=True).numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_istft_roundtrip():
+    np.random.seed(1)
+    x = np.random.randn(1, 512).astype(np.float32)
+    win = np.hanning(128).astype(np.float32)
+    spec = paddle.signal.stft(to_tensor(x), n_fft=128, hop_length=32,
+                              window=to_tensor(win))
+    y = paddle.signal.istft(spec, n_fft=128, hop_length=32,
+                            window=to_tensor(win), length=512)
+    np.testing.assert_allclose(y.numpy(), x, rtol=1e-3, atol=1e-4)
+
+
+# ------------------------------------------------------------- vision ops
+
+def test_nms_matches_torchvision_algorithm():
+    np.random.seed(2)
+    n = 40
+    wh = np.random.rand(n, 2).astype(np.float32) * 20 + 1
+    xy = np.random.rand(n, 2).astype(np.float32) * 60
+    boxes = np.concatenate([xy, xy + wh], axis=1)
+    scores = np.random.rand(n).astype(np.float32)
+
+    keep = vops.nms(to_tensor(boxes), 0.5, to_tensor(scores)).numpy()
+
+    # greedy numpy oracle
+    order = np.argsort(-scores)
+    kept = []
+    supp = np.zeros(n, bool)
+    for i in order:
+        if supp[i]:
+            continue
+        kept.append(i)
+        x1 = np.maximum(boxes[i, 0], boxes[:, 0])
+        y1 = np.maximum(boxes[i, 1], boxes[:, 1])
+        x2 = np.minimum(boxes[i, 2], boxes[:, 2])
+        y2 = np.minimum(boxes[i, 3], boxes[:, 3])
+        inter = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+        a = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+        iou = inter / (a[i] + a - inter)
+        supp |= iou > 0.5
+    np.testing.assert_array_equal(np.sort(keep), np.sort(np.array(kept)))
+
+
+def test_nms_categories():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [0, 0, 10, 10]],
+                     dtype=np.float32)
+    scores = np.array([0.9, 0.8, 0.7], dtype=np.float32)
+    cats = np.array([0, 0, 1], dtype=np.int64)
+    keep = vops.nms(to_tensor(boxes), 0.5, to_tensor(scores),
+                    category_idxs=to_tensor(cats),
+                    categories=[0, 1]).numpy()
+    # box1 suppressed by box0 (same cat); box2 survives (different cat)
+    assert set(keep.tolist()) == {0, 2}
+
+
+def test_roi_align_matches_torchvision():
+    tv = pytest.importorskip("torchvision")
+    np.random.seed(3)
+    x = np.random.randn(2, 3, 16, 16).astype(np.float32)
+    boxes = np.array([[1.0, 1.0, 9.0, 9.0], [0.0, 0.0, 15.0, 15.0],
+                      [2.0, 3.0, 12.0, 10.0]], dtype=np.float32)
+    boxes_num = np.array([2, 1], dtype=np.int32)
+    out = vops.roi_align(to_tensor(x), to_tensor(boxes),
+                         to_tensor(boxes_num), output_size=4,
+                         spatial_scale=1.0, sampling_ratio=2,
+                         aligned=True).numpy()
+    rois = torch.tensor(
+        np.concatenate([[[0], [0], [1]], boxes], axis=1).astype(np.float32))
+    ref = tv.ops.roi_align(torch.tensor(x), rois, output_size=4,
+                           spatial_scale=1.0, sampling_ratio=2,
+                           aligned=True).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_roi_pool_matches_torchvision():
+    tv = pytest.importorskip("torchvision")
+    np.random.seed(4)
+    x = np.random.randn(1, 2, 12, 12).astype(np.float32)
+    boxes = np.array([[0.0, 0.0, 11.0, 11.0], [2.0, 2.0, 8.0, 9.0]],
+                     dtype=np.float32)
+    boxes_num = np.array([2], dtype=np.int32)
+    out = vops.roi_pool(to_tensor(x), to_tensor(boxes),
+                        to_tensor(boxes_num), output_size=3).numpy()
+    rois = torch.tensor(
+        np.concatenate([[[0], [0]], boxes], axis=1).astype(np.float32))
+    ref = tv.ops.roi_pool(torch.tensor(x), rois, output_size=3).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_deform_conv2d_matches_torchvision():
+    tv = pytest.importorskip("torchvision")
+    np.random.seed(5)
+    x = np.random.randn(2, 4, 8, 8).astype(np.float32)
+    w = np.random.randn(6, 4, 3, 3).astype(np.float32) * 0.2
+    b = np.random.randn(6).astype(np.float32) * 0.1
+    off = np.random.randn(2, 2 * 9, 8, 8).astype(np.float32) * 0.5
+    mask = np.random.rand(2, 9, 8, 8).astype(np.float32)
+    out = vops.deform_conv2d(
+        to_tensor(x), to_tensor(off), to_tensor(w), to_tensor(b),
+        stride=1, padding=1, mask=to_tensor(mask)).numpy()
+    ref = tv.ops.deform_conv2d(
+        torch.tensor(x), torch.tensor(off), torch.tensor(w),
+        torch.tensor(b), stride=1, padding=1,
+        mask=torch.tensor(mask)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-4)
+
+
+def _roi_align_numpy(x, boxes, batch_idx, out_size, scale, sr, aligned):
+    """Loop-based RoIAlign oracle."""
+    R = boxes.shape[0]
+    C, H, W = x.shape[1:]
+    out = np.zeros((R, C, out_size, out_size), np.float32)
+
+    def bil(img, y, xx):
+        if y < -1.0 or y > H or xx < -1.0 or xx > W:
+            return np.zeros(img.shape[0], np.float32)
+        y = min(max(y, 0.0), H - 1)
+        xx = min(max(xx, 0.0), W - 1)
+        y0, x0 = int(np.floor(y)), int(np.floor(xx))
+        y1, x1 = min(y0 + 1, H - 1), min(x0 + 1, W - 1)
+        wy, wx = y - y0, xx - x0
+        return (img[:, y0, x0] * (1 - wy) * (1 - wx)
+                + img[:, y0, x1] * (1 - wy) * wx
+                + img[:, y1, x0] * wy * (1 - wx)
+                + img[:, y1, x1] * wy * wx)
+
+    off = 0.5 if aligned else 0.0
+    for r in range(R):
+        img = x[batch_idx[r]]
+        x1, y1, x2, y2 = boxes[r] * scale
+        x1, y1, x2, y2 = x1 - off, y1 - off, x2 - off, y2 - off
+        rw, rh = x2 - x1, y2 - y1
+        if not aligned:
+            rw, rh = max(rw, 1.0), max(rh, 1.0)
+        bw, bh = rw / out_size, rh / out_size
+        for i in range(out_size):
+            for j in range(out_size):
+                acc = np.zeros(C, np.float32)
+                for iy in range(sr):
+                    for ix in range(sr):
+                        yy = y1 + (i + (iy + 0.5) / sr) * bh
+                        xx = x1 + (j + (ix + 0.5) / sr) * bw
+                        acc += bil(img, yy, xx)
+                out[r, :, i, j] = acc / (sr * sr)
+    return out
+
+
+def test_roi_align_matches_numpy_oracle():
+    np.random.seed(9)
+    x = np.random.randn(2, 3, 16, 16).astype(np.float32)
+    # last box extends past the image (proposals can) — exercises the
+    # "contribute 0 beyond 1px outside" rule
+    boxes = np.array([[1.0, 1.0, 9.0, 9.0], [0.0, 0.0, 15.0, 15.0],
+                      [-6.0, -4.0, 12.0, 10.0]], dtype=np.float32)
+    boxes_num = np.array([2, 1], dtype=np.int32)
+    for aligned in (True, False):
+        out = vops.roi_align(to_tensor(x), to_tensor(boxes),
+                             to_tensor(boxes_num), output_size=4,
+                             spatial_scale=0.5, sampling_ratio=2,
+                             aligned=aligned).numpy()
+        ref = _roi_align_numpy(x, boxes, [0, 0, 1], 4, 0.5, 2, aligned)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_roi_align_adaptive_sampling_ratio():
+    """sampling_ratio=-1 -> per-roi ceil(roi_size/output) density."""
+    np.random.seed(10)
+    x = np.random.randn(1, 2, 32, 32).astype(np.float32)
+    # rois of very different sizes -> different adaptive densities
+    boxes = np.array([[0.0, 0.0, 31.0, 31.0], [4.0, 4.0, 8.0, 8.0]],
+                     dtype=np.float32)
+    boxes_num = np.array([2], dtype=np.int32)
+    out = vops.roi_align(to_tensor(x), to_tensor(boxes),
+                         to_tensor(boxes_num), output_size=4,
+                         sampling_ratio=-1, aligned=True).numpy()
+
+    def oracle_one(box, sr):
+        return _roi_align_numpy(x, box[None], [0], 4, 1.0, sr, True)[0]
+
+    # roi0: 31/4 -> sr=8 ; roi1: 4/4 -> sr=1
+    np.testing.assert_allclose(out[0], oracle_one(boxes[0], 8),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(out[1], oracle_one(boxes[1], 1),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_deform_conv2d_zero_offset_equals_conv():
+    np.random.seed(6)
+    x = np.random.randn(1, 3, 6, 6).astype(np.float32)
+    w = np.random.randn(5, 3, 3, 3).astype(np.float32) * 0.3
+    off = np.zeros((1, 18, 6, 6), dtype=np.float32)
+    out = vops.deform_conv2d(to_tensor(x), to_tensor(off), to_tensor(w),
+                             padding=1).numpy()
+    ref = torch.nn.functional.conv2d(
+        torch.tensor(x), torch.tensor(w), padding=1).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_deform_conv2d_layer_and_grad():
+    layer = vops.DeformConv2D(3, 4, 3, padding=1)
+    x = to_tensor(np.random.randn(1, 3, 5, 5).astype(np.float32),
+                  stop_gradient=False)
+    off = to_tensor(np.zeros((1, 18, 5, 5), dtype=np.float32))
+    y = layer(x, off)
+    loss = y.sum()
+    loss.backward()
+    assert x.grad is not None
+    assert layer.weight.grad is not None
+
+
+def test_yolo_box_shapes_and_range():
+    np.random.seed(7)
+    s, cls = 3, 5
+    x = np.random.randn(2, s * (5 + cls), 4, 4).astype(np.float32)
+    img = np.array([[608, 608], [416, 416]], dtype=np.int32)
+    boxes, scores = vops.yolo_box(
+        to_tensor(x), to_tensor(img), anchors=[10, 13, 16, 30, 33, 23],
+        class_num=cls, conf_thresh=0.01, downsample_ratio=32)
+    assert boxes.shape == [2, 4 * 4 * s, 4]
+    assert scores.shape == [2, 4 * 4 * s, cls]
+    b = boxes.numpy()
+    assert (b[0, :, 2] <= 608).all() and (b.min() >= 0)
+
+
+def test_prior_box_basic():
+    inp = np.zeros((1, 8, 4, 4), dtype=np.float32)
+    img = np.zeros((1, 3, 32, 32), dtype=np.float32)
+    boxes, var = vops.prior_box(
+        to_tensor(inp), to_tensor(img), min_sizes=[8.0], max_sizes=[16.0],
+        aspect_ratios=[2.0], flip=True, clip=True)
+    # priors per location: 1 (ar=1,min) + 1 (sqrt(min*max)) + 2 (ar 2, 1/2)
+    assert boxes.shape == [4, 4, 4, 4]
+    bn = boxes.numpy()
+    assert bn.min() >= 0.0 and bn.max() <= 1.0
+    # center of cell (0,0) is at (4, 4) px -> normalized 0.125
+    ctr = (bn[0, 0, 0, :2] + bn[0, 0, 0, 2:]) / 2
+    np.testing.assert_allclose(ctr, [0.125, 0.125], atol=1e-6)
+    assert var.shape == [4, 4, 4, 4]
+
+
+def test_box_coder_decode_encode_roundtrip():
+    np.random.seed(8)
+    priors = np.array([[10, 10, 30, 30], [5, 5, 15, 25]], dtype=np.float32)
+    var = [0.1, 0.1, 0.2, 0.2]
+    targets = np.array([[12, 11, 28, 32], [4, 6, 18, 22]], dtype=np.float32)
+    enc = vops.box_coder(to_tensor(priors), var, to_tensor(targets),
+                         code_type="encode_center_size").numpy()
+    # decode back the diagonal (target i vs prior i)
+    deltas = np.stack([enc[i, i] for i in range(2)])[None]  # (1?,)
+    deltas = np.broadcast_to(
+        np.stack([enc[i, i] for i in range(2)])[:, None, :], (2, 2, 4))
+    dec = vops.box_coder(to_tensor(priors), var,
+                         to_tensor(np.ascontiguousarray(deltas)),
+                         code_type="decode_center_size", axis=0).numpy()
+    np.testing.assert_allclose(np.stack([dec[i, i] for i in range(2)]),
+                               targets, rtol=1e-4, atol=1e-3)
+
+
+def test_empty_inputs():
+    empty_boxes = to_tensor(np.zeros((0, 4), np.float32))
+    keep = vops.nms(empty_boxes, 0.5,
+                    to_tensor(np.zeros((0,), np.float32)))
+    assert keep.shape == [0]
+    x = to_tensor(np.random.randn(1, 4, 8, 8).astype(np.float32))
+    zero_num = to_tensor(np.array([0], np.int32))
+    assert vops.roi_align(x, empty_boxes, zero_num, 2).shape == [0, 4, 2, 2]
+    assert vops.roi_pool(x, empty_boxes, zero_num, 2).shape == [0, 4, 2, 2]
+
+
+def test_roi_pool_matches_numpy_oracle():
+    np.random.seed(11)
+    x = np.random.randn(1, 2, 12, 12).astype(np.float32)
+    boxes = np.array([[0.0, 0.0, 11.0, 11.0], [2.0, 2.0, 8.0, 9.0]],
+                     dtype=np.float32)
+    out = vops.roi_pool(to_tensor(x), to_tensor(boxes),
+                        to_tensor(np.array([2], np.int32)),
+                        output_size=3).numpy()
+    # loop oracle (quantized-bin max, reference rule)
+    ref = np.zeros((2, 2, 3, 3), np.float32)
+    for r in range(2):
+        xx1, yy1, xx2, yy2 = np.round(boxes[r]).astype(int)
+        rh, rw = max(yy2 - yy1 + 1, 1), max(xx2 - xx1 + 1, 1)
+        for i in range(3):
+            hs = min(max(yy1 + int(np.floor(i * rh / 3)), 0), 12)
+            he = min(max(yy1 + int(np.ceil((i + 1) * rh / 3)), 0), 12)
+            for j in range(3):
+                ws = min(max(xx1 + int(np.floor(j * rw / 3)), 0), 12)
+                we = min(max(xx1 + int(np.ceil((j + 1) * rw / 3)), 0), 12)
+                if he > hs and we > ws:
+                    ref[r, :, i, j] = x[0, :, hs:he, ws:we].max(axis=(1, 2))
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+def test_deform_conv2d_border_zero_padding():
+    """A sample point in (-1, 0) must blend with zeros, not clamp."""
+    x = np.full((1, 1, 1, 1), 2.0, np.float32)
+    w = np.ones((1, 1, 1, 1), np.float32)
+    off = np.zeros((1, 2, 1, 1), np.float32)
+    off[0, 0, 0, 0] = -0.5  # dy: sample at y=-0.5
+    out = vops.deform_conv2d(to_tensor(x), to_tensor(off),
+                             to_tensor(w)).numpy()
+    np.testing.assert_allclose(out, [[[[1.0]]]], rtol=1e-6)
+
+
+def test_psroi_pool_shape():
+    x = np.random.randn(1, 2 * 2 * 3, 8, 8).astype(np.float32)
+    boxes = np.array([[0.0, 0.0, 7.0, 7.0]], dtype=np.float32)
+    out = vops.psroi_pool(to_tensor(x), to_tensor(boxes),
+                          to_tensor(np.array([1], np.int32)),
+                          output_size=2).numpy()
+    assert out.shape == (1, 3, 2, 2)
+
+
+def test_distribute_fpn_proposals():
+    rois = np.array([[0, 0, 16, 16], [0, 0, 224, 224], [0, 0, 448, 448]],
+                    dtype=np.float32)
+    multi, restore = vops.distribute_fpn_proposals(
+        to_tensor(rois), 2, 5, 4, 224)
+    assert len(multi) == 4
+    total = sum(m.shape[0] for m in multi)
+    assert total == 3
+    r = restore.numpy().reshape(-1)
+    cat = np.concatenate([m.numpy() for m in multi if m.shape[0]])
+    np.testing.assert_allclose(cat[r], rois)
+
+
+def test_matrix_nms_decays_scores():
+    boxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]]],
+                     dtype=np.float32)
+    scores = np.array([[[0.1, 0.1, 0.1], [0.9, 0.8, 0.6]]], dtype=np.float32)
+    out, num, idx = vops.matrix_nms(
+        to_tensor(boxes), to_tensor(scores), score_threshold=0.3,
+        background_label=0, return_index=True)
+    o = out.numpy()
+    assert o.shape[1] == 6 and int(num.numpy()[0]) == o.shape[0]
+    assert o.shape[0] == 3
+    # rows sorted by decayed score; top box keeps its score exactly
+    assert o[0, 1] == pytest.approx(0.9)
+    assert (o[:, 1] <= np.array([0.9, 0.8, 0.6]) + 1e-6).all()
+    # the overlapping lower-scored box (orig idx 1) must be decayed
+    i = idx.numpy().tolist().index(1)
+    assert o[i, 1] < 0.8
+    # indices correspond row-by-row: idx row i is the box in out row i
+    np.testing.assert_allclose(o[:, 2:],
+                               boxes[0][idx.numpy()], rtol=1e-6)
+
+
+def test_distribute_fpn_proposals_per_image_counts():
+    rois = np.array([[0, 0, 16, 16], [0, 0, 224, 224], [0, 0, 448, 448],
+                     [0, 0, 20, 20]], dtype=np.float32)
+    rois_num = np.array([3, 1], dtype=np.int32)
+    multi, restore, nums = vops.distribute_fpn_proposals(
+        to_tensor(rois), 2, 5, 4, 224, rois_num=to_tensor(rois_num))
+    assert all(n.shape[0] == 2 for n in nums)  # per-image counts
+    # level of roi 0 (scale 16) == level of roi 3 (scale 20) == level 2
+    lvl2 = nums[0].numpy()
+    np.testing.assert_array_equal(lvl2, [1, 1])
+    total = np.stack([n.numpy() for n in nums]).sum(axis=0)
+    np.testing.assert_array_equal(total, rois_num)
