@@ -1,0 +1,124 @@
+"""DenseNet 121/161/169/201/264 (reference: python/paddle/vision/models/densenet.py)."""
+from __future__ import annotations
+
+from ... import nn
+from ...tensor.manipulation import concat
+
+
+class DenseLayer(nn.Layer):
+    """BN-ReLU-Conv1x1 -> BN-ReLU-Conv3x3, output concatenated to input."""
+
+    def __init__(self, cin, growth_rate, bn_size, dropout):
+        super().__init__()
+        self.dropout = dropout
+        inter = bn_size * growth_rate
+        self.norm1 = nn.BatchNorm2D(cin)
+        self.conv1 = nn.Conv2D(cin, inter, 1, bias_attr=False)
+        self.norm2 = nn.BatchNorm2D(inter)
+        self.conv2 = nn.Conv2D(inter, growth_rate, 3, padding=1,
+                               bias_attr=False)
+        self.relu = nn.ReLU()
+        self.drop = nn.Dropout(dropout) if dropout else None
+
+    def forward(self, x):
+        y = self.conv1(self.relu(self.norm1(x)))
+        y = self.conv2(self.relu(self.norm2(y)))
+        if self.drop is not None:
+            y = self.drop(y)
+        return concat([x, y], axis=1)
+
+
+class DenseBlock(nn.Layer):
+    def __init__(self, cin, num_layers, growth_rate, bn_size, dropout):
+        super().__init__()
+        layers = []
+        for i in range(num_layers):
+            layers.append(DenseLayer(cin + i * growth_rate, growth_rate,
+                                     bn_size, dropout))
+        self.layers = nn.Sequential(*layers)
+
+    def forward(self, x):
+        return self.layers(x)
+
+
+class TransitionLayer(nn.Layer):
+    def __init__(self, cin, cout):
+        super().__init__()
+        self.norm = nn.BatchNorm2D(cin)
+        self.conv = nn.Conv2D(cin, cout, 1, bias_attr=False)
+        self.pool = nn.AvgPool2D(2, stride=2)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.norm(x))))
+
+
+_CFG = {
+    121: (64, 32, [6, 12, 24, 16]),
+    161: (96, 48, [6, 12, 36, 24]),
+    169: (64, 32, [6, 12, 32, 32]),
+    201: (64, 32, [6, 12, 48, 32]),
+    264: (64, 32, [6, 12, 64, 48]),
+}
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        if layers not in _CFG:
+            raise ValueError(f"unsupported DenseNet depth {layers}")
+        num_init, growth, block_cfg = _CFG[layers]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv1 = nn.Conv2D(3, num_init, 7, stride=2, padding=3,
+                               bias_attr=False)
+        self.norm1 = nn.BatchNorm2D(num_init)
+        self.relu = nn.ReLU()
+        self.pool1 = nn.MaxPool2D(3, stride=2, padding=1)
+
+        blocks = []
+        c = num_init
+        for i, n in enumerate(block_cfg):
+            blocks.append(DenseBlock(c, n, growth, bn_size, dropout))
+            c = c + n * growth
+            if i != len(block_cfg) - 1:
+                blocks.append(TransitionLayer(c, c // 2))
+                c = c // 2
+        self.blocks = nn.Sequential(*blocks)
+        self.norm_final = nn.BatchNorm2D(c)
+        if with_pool:
+            self.pool_final = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(c, num_classes)
+
+    def forward(self, x):
+        x = self.pool1(self.relu(self.norm1(self.conv1(x))))
+        x = self.blocks(x)
+        x = self.relu(self.norm_final(x))
+        if self.with_pool:
+            x = self.pool_final(x)
+        if self.num_classes > 0:
+            x = x.reshape([x.shape[0], -1])
+            x = self.fc(x)
+        return x
+
+
+def densenet121(pretrained=False, **kwargs):
+    return DenseNet(layers=121, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return DenseNet(layers=161, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return DenseNet(layers=169, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return DenseNet(layers=201, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return DenseNet(layers=264, **kwargs)

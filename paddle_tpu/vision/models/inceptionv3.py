@@ -1,0 +1,154 @@
+"""InceptionV3 (reference: python/paddle/vision/models/inceptionv3.py)."""
+from __future__ import annotations
+
+from ... import nn
+from ...tensor.manipulation import concat
+
+
+class ConvBNReLU(nn.Layer):
+    def __init__(self, cin, cout, kernel, stride=1, padding=0):
+        super().__init__()
+        self.conv = nn.Conv2D(cin, cout, kernel, stride=stride,
+                              padding=padding, bias_attr=False)
+        self.bn = nn.BatchNorm2D(cout)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))
+
+
+class InceptionA(nn.Layer):
+    def __init__(self, cin, pool_features):
+        super().__init__()
+        self.b1 = ConvBNReLU(cin, 64, 1)
+        self.b5 = nn.Sequential(ConvBNReLU(cin, 48, 1),
+                                ConvBNReLU(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(ConvBNReLU(cin, 64, 1),
+                                ConvBNReLU(64, 96, 3, padding=1),
+                                ConvBNReLU(96, 96, 3, padding=1))
+        self.pool = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                  ConvBNReLU(cin, pool_features, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b5(x), self.b3(x), self.pool(x)],
+                      axis=1)
+
+
+class InceptionB(nn.Layer):
+    """grid reduction 35->17"""
+
+    def __init__(self, cin):
+        super().__init__()
+        self.b3 = ConvBNReLU(cin, 384, 3, stride=2)
+        self.b3d = nn.Sequential(ConvBNReLU(cin, 64, 1),
+                                 ConvBNReLU(64, 96, 3, padding=1),
+                                 ConvBNReLU(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return concat([self.b3(x), self.b3d(x), self.pool(x)], axis=1)
+
+
+class InceptionC(nn.Layer):
+    def __init__(self, cin, c7):
+        super().__init__()
+        self.b1 = ConvBNReLU(cin, 192, 1)
+        self.b7 = nn.Sequential(
+            ConvBNReLU(cin, c7, 1),
+            ConvBNReLU(c7, c7, (1, 7), padding=(0, 3)),
+            ConvBNReLU(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7d = nn.Sequential(
+            ConvBNReLU(cin, c7, 1),
+            ConvBNReLU(c7, c7, (7, 1), padding=(3, 0)),
+            ConvBNReLU(c7, c7, (1, 7), padding=(0, 3)),
+            ConvBNReLU(c7, c7, (7, 1), padding=(3, 0)),
+            ConvBNReLU(c7, 192, (1, 7), padding=(0, 3)))
+        self.pool = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                  ConvBNReLU(cin, 192, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b7(x), self.b7d(x), self.pool(x)],
+                      axis=1)
+
+
+class InceptionD(nn.Layer):
+    """grid reduction 17->8"""
+
+    def __init__(self, cin):
+        super().__init__()
+        self.b3 = nn.Sequential(ConvBNReLU(cin, 192, 1),
+                                ConvBNReLU(192, 320, 3, stride=2))
+        self.b7 = nn.Sequential(
+            ConvBNReLU(cin, 192, 1),
+            ConvBNReLU(192, 192, (1, 7), padding=(0, 3)),
+            ConvBNReLU(192, 192, (7, 1), padding=(3, 0)),
+            ConvBNReLU(192, 192, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return concat([self.b3(x), self.b7(x), self.pool(x)], axis=1)
+
+
+class InceptionE(nn.Layer):
+    def __init__(self, cin):
+        super().__init__()
+        self.b1 = ConvBNReLU(cin, 320, 1)
+        self.b3_stem = ConvBNReLU(cin, 384, 1)
+        self.b3_a = ConvBNReLU(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = ConvBNReLU(384, 384, (3, 1), padding=(1, 0))
+        self.b3d_stem = nn.Sequential(ConvBNReLU(cin, 448, 1),
+                                      ConvBNReLU(448, 384, 3, padding=1))
+        self.b3d_a = ConvBNReLU(384, 384, (1, 3), padding=(0, 1))
+        self.b3d_b = ConvBNReLU(384, 384, (3, 1), padding=(1, 0))
+        self.pool = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                  ConvBNReLU(cin, 192, 1))
+
+    def forward(self, x):
+        s = self.b3_stem(x)
+        b3 = concat([self.b3_a(s), self.b3_b(s)], axis=1)
+        d = self.b3d_stem(x)
+        b3d = concat([self.b3d_a(d), self.b3d_b(d)], axis=1)
+        return concat([self.b1(x), b3, b3d, self.pool(x)], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            ConvBNReLU(3, 32, 3, stride=2),
+            ConvBNReLU(32, 32, 3),
+            ConvBNReLU(32, 64, 3, padding=1),
+            nn.MaxPool2D(3, stride=2),
+            ConvBNReLU(64, 80, 1),
+            ConvBNReLU(80, 192, 3),
+            nn.MaxPool2D(3, stride=2),
+        )
+        self.blocks = nn.Sequential(
+            InceptionA(192, 32), InceptionA(256, 64), InceptionA(288, 64),
+            InceptionB(288),
+            InceptionC(768, 128), InceptionC(768, 160), InceptionC(768, 160),
+            InceptionC(768, 192),
+            InceptionD(768),
+            InceptionE(1280), InceptionE(2048),
+        )
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.drop = nn.Dropout(0.5)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.drop(x)
+            x = x.reshape([x.shape[0], -1])
+            x = self.fc(x)
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    return InceptionV3(**kwargs)

@@ -1,0 +1,81 @@
+"""MobileNetV1 (reference: python/paddle/vision/models/mobilenetv1.py).
+
+Depthwise-separable conv stacks; on TPU the depthwise convs lower to
+XLA's feature-group convolutions.
+"""
+from __future__ import annotations
+
+from ... import nn
+
+
+class ConvBNLayer(nn.Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, num_groups=1):
+        super().__init__()
+        self._conv = nn.Conv2D(in_channels, out_channels, kernel_size,
+                               stride=stride, padding=padding,
+                               groups=num_groups, bias_attr=False)
+        self._norm = nn.BatchNorm2D(out_channels)
+        self._act = nn.ReLU()
+
+    def forward(self, x):
+        return self._act(self._norm(self._conv(x)))
+
+
+class DepthwiseSeparable(nn.Layer):
+    def __init__(self, in_channels, out_channels1, out_channels2, num_groups,
+                 stride, scale):
+        super().__init__()
+        self._depthwise = ConvBNLayer(
+            in_channels, int(out_channels1 * scale), 3, stride=stride,
+            padding=1, num_groups=int(num_groups * scale))
+        self._pointwise = ConvBNLayer(
+            int(out_channels1 * scale), int(out_channels2 * scale), 1)
+
+    def forward(self, x):
+        return self._pointwise(self._depthwise(x))
+
+
+class MobileNetV1(nn.Layer):
+    """scale: width multiplier applied to every channel count."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.scale = scale
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        self.conv1 = ConvBNLayer(3, int(32 * scale), 3, stride=2, padding=1)
+        # (in, dw_out, pw_out, groups, stride)
+        cfg = [
+            (32, 32, 64, 32, 1), (64, 64, 128, 64, 2),
+            (128, 128, 128, 128, 1), (128, 128, 256, 128, 2),
+            (256, 256, 256, 256, 1), (256, 256, 512, 256, 2),
+            (512, 512, 512, 512, 1), (512, 512, 512, 512, 1),
+            (512, 512, 512, 512, 1), (512, 512, 512, 512, 1),
+            (512, 512, 512, 512, 1), (512, 512, 1024, 512, 2),
+            (1024, 1024, 1024, 1024, 1),
+        ]
+        blocks = []
+        for cin, dw, pw, g, s in cfg:
+            blocks.append(DepthwiseSeparable(
+                int(cin * scale), dw, pw, g, s, scale))
+        self.blocks = nn.Sequential(*blocks)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(int(1024 * scale), num_classes)
+
+    def forward(self, x):
+        x = self.conv1(x)
+        x = self.blocks(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.reshape([x.shape[0], -1])
+            x = self.fc(x)
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV1(scale=scale, **kwargs)

@@ -1,0 +1,155 @@
+"""MobileNetV3 small/large (reference: python/paddle/vision/models/mobilenetv3.py).
+
+Inverted residuals with squeeze-excitation and hardswish.
+"""
+from __future__ import annotations
+
+from ... import nn
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    if min_value is None:
+        min_value = divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class SqueezeExcitation(nn.Layer):
+    def __init__(self, input_channels, squeeze_channels):
+        super().__init__()
+        self.avgpool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(input_channels, squeeze_channels, 1)
+        self.fc2 = nn.Conv2D(squeeze_channels, input_channels, 1)
+        self.relu = nn.ReLU()
+        self.hsig = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.avgpool(x)
+        s = self.relu(self.fc1(s))
+        s = self.hsig(self.fc2(s))
+        return x * s
+
+
+class ConvNormActivation(nn.Layer):
+    def __init__(self, cin, cout, kernel, stride=1, groups=1, act="relu"):
+        super().__init__()
+        pad = (kernel - 1) // 2
+        self.conv = nn.Conv2D(cin, cout, kernel, stride=stride, padding=pad,
+                              groups=groups, bias_attr=False)
+        self.norm = nn.BatchNorm2D(cout)
+        self.act = {"relu": nn.ReLU, "hardswish": nn.Hardswish,
+                    None: nn.Identity}[act]()
+
+    def forward(self, x):
+        return self.act(self.norm(self.conv(x)))
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, cin, exp, cout, kernel, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and cin == cout
+        layers = []
+        if exp != cin:
+            layers.append(ConvNormActivation(cin, exp, 1, act=act))
+        layers.append(ConvNormActivation(exp, exp, kernel, stride=stride,
+                                         groups=exp, act=act))
+        if use_se:
+            layers.append(SqueezeExcitation(exp, _make_divisible(exp // 4)))
+        layers.append(ConvNormActivation(exp, cout, 1, act=None))
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+# (kernel, exp, out, use_se, act, stride)
+_LARGE_CFG = [
+    (3, 16, 16, False, "relu", 1),
+    (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1),
+    (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1),
+    (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2),
+    (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1),
+    (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2),
+    (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+_SMALL_CFG = [
+    (3, 16, 16, True, "relu", 2),
+    (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1),
+    (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1),
+    (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1),
+    (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2),
+    (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+
+
+class MobileNetV3(nn.Layer):
+    def __init__(self, config, last_channel, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        sc = lambda c: _make_divisible(c * scale)
+
+        first = sc(16)
+        layers = [ConvNormActivation(3, first, 3, stride=2, act="hardswish")]
+        cin = first
+        for kernel, exp, cout, use_se, act, stride in config:
+            layers.append(InvertedResidual(cin, sc(exp), sc(cout), kernel,
+                                           stride, use_se, act))
+            cin = sc(cout)
+        lastconv = _make_divisible(sc(config[-1][2]) * 6)
+        layers.append(ConvNormActivation(cin, lastconv, 1, act="hardswish"))
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(lastconv, last_channel), nn.Hardswish(),
+                nn.Dropout(0.2), nn.Linear(last_channel, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = x.reshape([x.shape[0], -1])
+            x = self.classifier(x)
+        return x
+
+
+class MobileNetV3Large(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_LARGE_CFG, _make_divisible(1280 * scale),
+                         scale=scale, num_classes=num_classes,
+                         with_pool=with_pool)
+
+
+class MobileNetV3Small(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_SMALL_CFG, _make_divisible(1024 * scale),
+                         scale=scale, num_classes=num_classes,
+                         with_pool=with_pool)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Large(scale=scale, **kwargs)

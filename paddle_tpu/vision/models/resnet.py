@@ -153,3 +153,30 @@ def wide_resnet50_2(pretrained=False, **kwargs):
 
 def wide_resnet101_2(pretrained=False, **kwargs):
     return _resnet(BottleneckBlock, 101, width=128, **kwargs)
+
+
+# ResNeXt family (reference: vision/models/resnet.py resnext50_32x4d:476):
+# grouped bottlenecks, width = per-group base width.
+
+def resnext50_32x4d(pretrained=False, **kwargs):
+    return _resnet(BottleneckBlock, 50, width=4, groups=32, **kwargs)
+
+
+def resnext50_64x4d(pretrained=False, **kwargs):
+    return _resnet(BottleneckBlock, 50, width=4, groups=64, **kwargs)
+
+
+def resnext101_32x4d(pretrained=False, **kwargs):
+    return _resnet(BottleneckBlock, 101, width=4, groups=32, **kwargs)
+
+
+def resnext101_64x4d(pretrained=False, **kwargs):
+    return _resnet(BottleneckBlock, 101, width=4, groups=64, **kwargs)
+
+
+def resnext152_32x4d(pretrained=False, **kwargs):
+    return _resnet(BottleneckBlock, 152, width=4, groups=32, **kwargs)
+
+
+def resnext152_64x4d(pretrained=False, **kwargs):
+    return _resnet(BottleneckBlock, 152, width=4, groups=64, **kwargs)

@@ -1,0 +1,143 @@
+"""ShuffleNetV2 (reference: python/paddle/vision/models/shufflenetv2.py).
+
+Channel-split inverted residuals with channel shuffle.
+"""
+from __future__ import annotations
+
+from ... import nn
+from ...tensor.manipulation import concat, split
+
+
+def _act(act):
+    return nn.Swish() if act == "swish" else nn.ReLU()
+
+
+class ConvBNAct(nn.Layer):
+    def __init__(self, cin, cout, kernel, stride=1, groups=1, act="relu",
+                 use_act=True):
+        super().__init__()
+        self.conv = nn.Conv2D(cin, cout, kernel, stride=stride,
+                              padding=(kernel - 1) // 2, groups=groups,
+                              bias_attr=False)
+        self.bn = nn.BatchNorm2D(cout)
+        self.act = _act(act) if use_act else nn.Identity()
+
+    def forward(self, x):
+        return self.act(self.bn(self.conv(x)))
+
+
+class InvertedResidual(nn.Layer):
+    """stride-1 unit: split channels, transform one half, shuffle."""
+
+    def __init__(self, channels, act):
+        super().__init__()
+        c = channels // 2
+        self.branch = nn.Sequential(
+            ConvBNAct(c, c, 1, act=act),
+            ConvBNAct(c, c, 3, groups=c, use_act=False, act=act),
+            ConvBNAct(c, c, 1, act=act),
+        )
+        self.shuffle = nn.ChannelShuffle(2)
+
+    def forward(self, x):
+        x1, x2 = split(x, 2, axis=1)
+        out = concat([x1, self.branch(x2)], axis=1)
+        return self.shuffle(out)
+
+
+class InvertedResidualDS(nn.Layer):
+    """stride-2 downsampling unit: both halves transformed."""
+
+    def __init__(self, cin, cout, act):
+        super().__init__()
+        c = cout // 2
+        self.branch1 = nn.Sequential(
+            ConvBNAct(cin, cin, 3, stride=2, groups=cin, use_act=False,
+                      act=act),
+            ConvBNAct(cin, c, 1, act=act),
+        )
+        self.branch2 = nn.Sequential(
+            ConvBNAct(cin, c, 1, act=act),
+            ConvBNAct(c, c, 3, stride=2, groups=c, use_act=False, act=act),
+            ConvBNAct(c, c, 1, act=act),
+        )
+        self.shuffle = nn.ChannelShuffle(2)
+
+    def forward(self, x):
+        out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return self.shuffle(out)
+
+
+_STAGE_OUT = {
+    0.25: [24, 24, 48, 96, 512],
+    0.33: [24, 32, 64, 128, 512],
+    0.5: [24, 48, 96, 192, 1024],
+    1.0: [24, 116, 232, 464, 1024],
+    1.5: [24, 176, 352, 704, 1024],
+    2.0: [24, 244, 488, 976, 2048],
+}
+_STAGE_REPEATS = [4, 8, 4]
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        chans = _STAGE_OUT[scale]
+        self.conv1 = ConvBNAct(3, chans[0], 3, stride=2, act=act)
+        self.max_pool = nn.MaxPool2D(3, stride=2, padding=1)
+        blocks = []
+        cin = chans[0]
+        for stage, reps in enumerate(_STAGE_REPEATS):
+            cout = chans[stage + 1]
+            blocks.append(InvertedResidualDS(cin, cout, act))
+            for _ in range(reps - 1):
+                blocks.append(InvertedResidual(cout, act))
+            cin = cout
+        self.blocks = nn.Sequential(*blocks)
+        self.conv_last = ConvBNAct(cin, chans[-1], 1, act=act)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(chans[-1], num_classes)
+
+    def forward(self, x):
+        x = self.max_pool(self.conv1(x))
+        x = self.blocks(x)
+        x = self.conv_last(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.reshape([x.shape[0], -1])
+            x = self.fc(x)
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.25, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.33, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.5, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.0, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.5, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=2.0, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.0, act="swish", **kwargs)

@@ -182,11 +182,13 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
             y1 = jnp.clip(y1, 0)
             x2 = jnp.minimum(x2, imgw - 1)
             y2 = jnp.minimum(y2, imgh - 1)
+        # anchor-major flattening (reference kernel box_idx =
+        # anchor*h*w + row*w + col)
         boxes = jnp.stack([x1, y1, x2, y2], axis=-1)  # (n,s,h,w,4)
-        boxes = boxes.transpose(0, 2, 3, 1, 4).reshape(n, h * w * s, 4)
-        scores = prob.transpose(0, 3, 4, 1, 2).reshape(
-            n, h * w * s, class_num)
-        mask = conf.transpose(0, 2, 3, 1).reshape(n, h * w * s) > conf_thresh
+        boxes = boxes.reshape(n, s * h * w, 4)
+        scores = prob.transpose(0, 1, 3, 4, 2).reshape(
+            n, s * h * w, class_num)
+        mask = conf.reshape(n, s * h * w) > conf_thresh
         boxes = boxes * mask[..., None].astype(boxes.dtype)
         scores = scores * mask[..., None].astype(scores.dtype)
         return boxes, scores
@@ -461,10 +463,11 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
         H, W = feat.shape[2], feat.shape[3]
         if R == 0:
             return jnp.zeros((0, feat.shape[1], ph, pw), feat.dtype)
-        x1 = np.round(bx[:, 0] * spatial_scale)
-        y1 = np.round(bx[:, 1] * spatial_scale)
-        x2 = np.round(bx[:, 2] * spatial_scale)
-        y2 = np.round(bx[:, 3] * spatial_scale)
+        # half-away-from-zero rounding (C round), not numpy's half-to-even
+        x1 = np.floor(bx[:, 0] * spatial_scale + 0.5)
+        y1 = np.floor(bx[:, 1] * spatial_scale + 0.5)
+        x2 = np.floor(bx[:, 2] * spatial_scale + 0.5)
+        y2 = np.floor(bx[:, 3] * spatial_scale + 0.5)
         rh = np.maximum(y2 - y1 + 1, 1)
         rw = np.maximum(x2 - x1 + 1, 1)
         mh = jnp.asarray(_bin_masks(y1, rh, ph, H, "inner"))  # (R, ph, H)
@@ -502,10 +505,14 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
         out_c = C // (ph * pw)
         if R == 0:
             return jnp.zeros((0, out_c, ph, pw), feat.dtype)
-        x1 = bx[:, 0] * spatial_scale
-        y1 = bx[:, 1] * spatial_scale
-        rh = np.maximum(bx[:, 3] * spatial_scale - y1, 0.1)
-        rw = np.maximum(bx[:, 2] * spatial_scale - x1, 0.1)
+        # reference kernel: roi_start = round(c)*scale, roi_end =
+        # round(c+1)*scale, extent floored at 0.1
+        x1 = np.floor(bx[:, 0] + 0.5) * spatial_scale
+        y1 = np.floor(bx[:, 1] + 0.5) * spatial_scale
+        x2 = np.floor(bx[:, 2] + 1 + 0.5) * spatial_scale
+        y2 = np.floor(bx[:, 3] + 1 + 0.5) * spatial_scale
+        rh = np.maximum(y2 - y1, 0.1)
+        rw = np.maximum(x2 - x1, 0.1)
         mh = jnp.asarray(_bin_masks(y1, rh, ph, H, "outer"),
                          dtype=feat.dtype)  # (R, ph, H)
         mw = jnp.asarray(_bin_masks(x1, rw, pw, W, "outer"),

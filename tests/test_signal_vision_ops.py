@@ -364,13 +364,55 @@ def test_deform_conv2d_border_zero_padding():
     np.testing.assert_allclose(out, [[[[1.0]]]], rtol=1e-6)
 
 
-def test_psroi_pool_shape():
+def test_psroi_pool_matches_numpy_oracle():
+    np.random.seed(12)
     x = np.random.randn(1, 2 * 2 * 3, 8, 8).astype(np.float32)
-    boxes = np.array([[0.0, 0.0, 7.0, 7.0]], dtype=np.float32)
+    boxes = np.array([[0.0, 0.0, 7.0, 7.0], [1.2, 0.7, 5.4, 6.1]],
+                     dtype=np.float32)
     out = vops.psroi_pool(to_tensor(x), to_tensor(boxes),
-                          to_tensor(np.array([1], np.int32)),
-                          output_size=2).numpy()
-    assert out.shape == (1, 3, 2, 2)
+                          to_tensor(np.array([2], np.int32)),
+                          output_size=2, spatial_scale=0.5).numpy()
+    assert out.shape == (2, 3, 2, 2)
+    # loop oracle following the reference kernel's quantization
+    H = W = 8
+    ref = np.zeros((2, 3, 2, 2), np.float32)
+    for r in range(2):
+        sx = np.floor(boxes[r, 0] + 0.5) * 0.5
+        sy = np.floor(boxes[r, 1] + 0.5) * 0.5
+        ex = (np.floor(boxes[r, 2] + 0.5) + 1.0) * 0.5
+        ey = (np.floor(boxes[r, 3] + 0.5) + 1.0) * 0.5
+        rh, rw = max(ey - sy, 0.1), max(ex - sx, 0.1)
+        bh, bw = rh / 2, rw / 2
+        for c in range(3):
+            for i in range(2):
+                for j in range(2):
+                    hs = min(max(int(np.floor(i * bh + sy)), 0), H)
+                    he = min(max(int(np.ceil((i + 1) * bh + sy)), 0), H)
+                    ws = min(max(int(np.floor(j * bw + sx)), 0), W)
+                    we = min(max(int(np.ceil((j + 1) * bw + sx)), 0), W)
+                    ch = (c * 2 + i) * 2 + j
+                    if he > hs and we > ws:
+                        ref[r, c, i, j] = x[0, ch, hs:he, ws:we].mean()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_yolo_box_anchor_major_order():
+    """Row k of the output is anchor k//(h*w), cell (k%(h*w))//w, k%w."""
+    s, cls, h, w = 2, 1, 2, 2
+    x = np.zeros((1, s * (5 + cls), h, w), dtype=np.float32)
+    xr = x.reshape(1, s, 5 + cls, h, w)
+    # make anchor 1 cell (0,1) uniquely identifiable via a huge tw
+    xr[0, 1, 2, 0, 1] = 2.0  # tw
+    xr[0, :, 4] = 5.0  # all confident
+    img = np.array([[64, 64]], dtype=np.int32)
+    boxes, scores = vops.yolo_box(
+        to_tensor(xr.reshape(1, -1, h, w)), to_tensor(img),
+        anchors=[4, 4, 8, 8], class_num=cls, conf_thresh=0.01,
+        downsample_ratio=16, clip_bbox=False)
+    b = boxes.numpy()[0]
+    widths = b[:, 2] - b[:, 0]
+    # anchor-major row index: anchor1,row0,col1 -> 1*4 + 0*2 + 1 = 5
+    assert widths.argmax() == 5
 
 
 def test_distribute_fpn_proposals():
