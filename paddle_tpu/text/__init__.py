@@ -16,7 +16,9 @@ import numpy as np
 from ..core.dispatch import apply_op
 from ..nn.layer.layers import Layer
 
-__all__ = ["viterbi_decode", "ViterbiDecoder", "Vocab"]
+__all__ = ["viterbi_decode", "ViterbiDecoder", "Vocab", "datasets"]
+
+from . import datasets  # noqa: E402,F401
 
 
 def viterbi_decode(potentials, transition_params, lengths=None,

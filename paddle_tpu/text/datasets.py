@@ -1,0 +1,503 @@
+"""paddle.text.datasets — text corpus parsers with hermetic fallbacks.
+
+Reference: python/paddle/text/datasets/{uci_housing,imdb,imikolov,
+movielens,conll05,wmt14,wmt16}.py. Those auto-download; here each class
+parses a local archive passed via ``data_file`` and, where a corpus is
+small and synthesizable, generates deterministic stand-in data when no
+file is given (so DataLoader pipelines run without egress). Item tuple
+shapes match the reference loaders.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import re
+import tarfile
+
+import numpy as np
+
+from ..io.dataset import Dataset
+from ..utils.download import require_local_file as _require
+
+__all__ = ["UCIHousing", "Imdb", "Imikolov", "Movielens", "Conll05",
+           "WMT14", "WMT16", "MovieInfo", "UserInfo"]
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression (reference: uci_housing.py).
+
+    data_file: whitespace-separated housing.data (506 rows x 14 cols).
+    Without a file, deterministic synthetic rows with the same
+    normalization contract are generated.
+    """
+
+    FEATURE_NUM = 14
+    TRAIN_RATIO = 0.8
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        self.dtype = "float32"
+        if data_file is not None:
+            data_file = _require(data_file, "uci housing data")
+            raw = np.fromfile(data_file, sep=" ", dtype=np.float32)
+        else:
+            rng = np.random.RandomState(0)
+            raw = rng.rand(506 * self.FEATURE_NUM).astype(np.float32)
+        data = raw.reshape(-1, self.FEATURE_NUM)
+        # feature normalization exactly as the reference: (x - avg) / range
+        maxs = data.max(axis=0)
+        mins = data.min(axis=0)
+        avgs = data.mean(axis=0)
+        for i in range(self.FEATURE_NUM - 1):
+            rng_ = maxs[i] - mins[i]
+            data[:, i] = (data[:, i] - avgs[i]) / (rng_ if rng_ else 1.0)
+        split = int(data.shape[0] * self.TRAIN_RATIO)
+        self.data = data[:split] if mode == "train" else data[split:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return (np.asarray(row[:-1], self.dtype),
+                np.asarray(row[-1:], self.dtype))
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imdb(Dataset):
+    """IMDB sentiment (reference: imdb.py). Parses the aclImdb tar:
+    train/pos, train/neg document files -> word-id docs + 0/1 labels."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=True):
+        if data_file is None:
+            # deterministic synthetic corpus with a learnable signal
+            rng = np.random.RandomState(1)
+            vocab = 200
+            self.word_idx = {f"w{i}": i for i in range(vocab)}
+            self.docs, self.labels = [], []
+            for k in range(256):
+                label = k % 2
+                base = rng.randint(0, vocab // 2, size=rng.randint(5, 30))
+                bias = np.full(4, vocab // 2 + label, dtype=np.int64)
+                self.docs.append(np.concatenate([base, bias]))
+                self.labels.append(label)
+            return
+        data_file = _require(data_file, "aclImdb_v1.tar.gz")
+        pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        # vocab covers BOTH splits (reference imdb.py builds word_idx over
+        # train|test) so train/test ids are compatible
+        vocab_pat = re.compile(r"aclImdb/(train|test)/(pos|neg)/.*\.txt$")
+        self.word_idx = self._build_vocab(data_file, vocab_pat, cutoff)
+        self.docs, self.labels = [], []
+        unk = self.word_idx["<unk>"]
+        with tarfile.open(data_file, "r:*") as tf:
+            for member in tf.getmembers():
+                m = pat.match(member.name)
+                if not m:
+                    continue
+                words = self._tokenize(tf.extractfile(member).read())
+                self.docs.append(np.asarray(
+                    [self.word_idx.get(w, unk) for w in words]))
+                self.labels.append(0 if m.group(1) == "pos" else 1)
+
+    @staticmethod
+    def _tokenize(raw):
+        return raw.decode("latin1").lower().replace("<br />", " ").split()
+
+    def _build_vocab(self, data_file, pat, cutoff):
+        from collections import Counter
+        freq = Counter()
+        with tarfile.open(data_file, "r:*") as tf:
+            for member in tf.getmembers():
+                if pat.match(member.name):
+                    freq.update(self._tokenize(tf.extractfile(member).read()))
+        words = [w for w, c in freq.most_common() if c > cutoff]
+        word_idx = {w: i for i, w in enumerate(words)}
+        word_idx["<unk>"] = len(words)
+        return word_idx
+
+    def __getitem__(self, idx):
+        return np.asarray(self.docs[idx]), np.asarray([self.labels[idx]])
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB n-gram / seq dataset (reference: imikolov.py).
+
+    data_type='NGRAM' yields window tuples; 'SEQ' yields (src, trg)
+    shifted sequences.
+    """
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
+                 mode="train", min_word_freq=50, download=True):
+        self.data_type = data_type.upper()
+        self.window_size = window_size
+        if self.data_type == "NGRAM" and window_size < 1:
+            raise ValueError("NGRAM mode requires window_size >= 1")
+        lines = self._load_lines(data_file, mode)
+        self.word_idx = self._build_vocab(
+            self._load_lines(data_file, "train"), min_word_freq)
+        self.data = []
+        unk = self.word_idx["<unk>"]
+        for line in lines:
+            if self.data_type == "NGRAM":
+                toks = ["<s>"] + line + ["<e>"]
+                ids = [self.word_idx.get(w, unk) for w in toks]
+                if len(ids) >= window_size:
+                    for i in range(window_size, len(ids) + 1):
+                        self.data.append(tuple(ids[i - window_size:i]))
+            else:
+                toks = ["<s>"] + line + ["<e>"]
+                ids = [self.word_idx.get(w, unk) for w in toks]
+                self.data.append((ids[:-1], ids[1:]))
+
+    def _load_lines(self, data_file, mode):
+        if data_file is None:
+            rng = np.random.RandomState(2)
+            words = [f"t{i}" for i in range(64)]
+            return [[words[rng.randint(0, 64)] for _ in range(
+                rng.randint(3, 12))] for _ in range(200)]
+        data_file = _require(data_file, "simple-examples.tgz (PTB)")
+        name = f"./simple-examples/data/ptb.{'train' if mode == 'train' else 'valid'}.txt"
+        with tarfile.open(data_file, "r:*") as tf:
+            for member in tf.getmembers():
+                if member.name.lstrip("./").endswith(name.lstrip("./")):
+                    raw = tf.extractfile(member).read().decode()
+                    return [ln.strip().split() for ln in raw.splitlines()
+                            if ln.strip()]
+        raise ValueError(f"{name} not found in archive")
+
+    @staticmethod
+    def _build_vocab(lines, min_word_freq):
+        from collections import Counter
+        freq = Counter()
+        for ln in lines:
+            freq.update(ln)
+        freq.pop("<unk>", None)
+        # reference rule: strictly > min_word_freq, ordered by
+        # (-frequency, word)
+        kept = sorted(((w, c) for w, c in freq.items() if c > min_word_freq),
+                      key=lambda wc: (-wc[1], wc[0]))
+        words = [w for w, _ in kept]
+        word_idx = {w: i for i, w in enumerate(words)}
+        word_idx["<unk>"] = len(words)
+        word_idx.setdefault("<s>", len(word_idx))
+        word_idx.setdefault("<e>", len(word_idx))
+        return word_idx
+
+    def __getitem__(self, idx):
+        return tuple(np.asarray(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+class MovieInfo:
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self, categories_dict, movie_title_dict):
+        return [
+            [self.index],
+            [categories_dict[c] for c in self.categories],
+            [movie_title_dict[w.lower()] for w in self.title.split()],
+        ]
+
+
+class UserInfo:
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = [1, 18, 25, 35, 45, 50, 56].index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [[self.index], [0 if self.is_male else 1], [self.age],
+                [self.job_id]]
+
+
+class Movielens(Dataset):
+    """MovieLens-1M ratings (reference: movielens.py). Parses ml-1m.zip;
+    item tuple = user fields + movie fields + [score]."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True):
+        import zipfile
+        if data_file is None:
+            self._synth(mode, test_ratio, rand_seed)
+            return
+        data_file = _require(data_file, "ml-1m.zip")
+        self.movie_info, self.user_info = {}, {}
+        self.categories_dict, self.movie_title_dict = {}, {}
+        with zipfile.ZipFile(data_file) as zf:
+            movies = zf.read("ml-1m/movies.dat").decode("latin1")
+            users = zf.read("ml-1m/users.dat").decode("latin1")
+            ratings = zf.read("ml-1m/ratings.dat").decode("latin1")
+        for ln in movies.splitlines():
+            if not ln.strip():
+                continue
+            idx, title, cats = ln.strip().split("::")
+            cats = cats.split("|")
+            title = re.sub(r"\(\d{4}\)$", "", title).strip()
+            for c in cats:
+                self.categories_dict.setdefault(c, len(self.categories_dict))
+            for w in title.split():
+                self.movie_title_dict.setdefault(
+                    w.lower(), len(self.movie_title_dict))
+            self.movie_info[int(idx)] = MovieInfo(idx, cats, title)
+        for ln in users.splitlines():
+            if not ln.strip():
+                continue
+            idx, gender, age, job, _ = ln.strip().split("::")
+            self.user_info[int(idx)] = UserInfo(idx, gender, age, job)
+        rng = np.random.RandomState(rand_seed)
+        self.data = []
+        for ln in ratings.splitlines():
+            if not ln.strip():
+                continue
+            uid, mid, rating, _ = ln.strip().split("::")
+            uid, mid = int(uid), int(mid)
+            is_test = rng.rand() < test_ratio
+            if (mode == "test") != is_test:
+                continue
+            if uid not in self.user_info or mid not in self.movie_info:
+                continue
+            self.data.append(
+                self.user_info[uid].value()
+                + self.movie_info[mid].value(self.categories_dict,
+                                             self.movie_title_dict)
+                + [[float(rating)]])
+
+    def _synth(self, mode, test_ratio, rand_seed):
+        rng = np.random.RandomState(rand_seed)
+        self.data = []
+        n = 512
+        for i in range(n):
+            is_test = rng.rand() < test_ratio
+            if (mode == "test") != is_test:
+                continue
+            self.data.append([
+                [rng.randint(1, 100)], [rng.randint(0, 2)],
+                [rng.randint(0, 7)], [rng.randint(0, 20)],
+                [rng.randint(1, 200)], list(rng.randint(0, 18, 2)),
+                list(rng.randint(0, 500, 3)), [float(rng.randint(1, 6))]])
+
+    def __getitem__(self, idx):
+        return tuple(np.asarray(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Conll05(Dataset):
+    """CoNLL-2005 SRL (reference: conll05.py). Requires local data_file
+    (test.wsj tar), word/verb/target dict files."""
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, emb_file=None,
+                 mode="train", download=True):
+        data_file = _require(data_file, "conll05st-tests tar")
+        word_dict_file = _require(word_dict_file, "wordDict.txt")
+        verb_dict_file = _require(verb_dict_file, "verbDict.txt")
+        target_dict_file = _require(target_dict_file, "targetDict.txt")
+        self.word_dict = self._load_dict(word_dict_file)
+        self.predicate_dict = self._load_dict(verb_dict_file)
+        self.label_dict = self._load_label_dict(target_dict_file)
+        self.data = self._parse(data_file)
+
+    @staticmethod
+    def _load_dict(path):
+        d = {}
+        with open(path) as f:
+            for i, ln in enumerate(f):
+                d[ln.strip()] = i
+        return d
+
+    @staticmethod
+    def _load_label_dict(path):
+        d = {}
+        tag_dict = set()
+        with open(path) as f:
+            for ln in f:
+                ln = ln.strip()
+                if ln.startswith("B-"):
+                    tag_dict.add(ln[2:])
+        index = 0
+        for tag in sorted(tag_dict):
+            d["B-" + tag] = index
+            index += 1
+            d["I-" + tag] = index
+            index += 1
+        d["O"] = index
+        return d
+
+    def _parse(self, data_file):
+        """Extract (words, predicate, labels) triples from the archive's
+        words/props files."""
+        sentences, props = [], []
+        with tarfile.open(data_file, "r:*") as tf:
+            wfile = pfile = None
+            for m in tf.getmembers():
+                if m.name.endswith("words.gz"):
+                    wfile = gzip.decompress(tf.extractfile(m).read()).decode()
+                elif m.name.endswith("props.gz"):
+                    pfile = gzip.decompress(tf.extractfile(m).read()).decode()
+            if wfile is None or pfile is None:
+                raise ValueError("words.gz/props.gz not found in archive")
+        cur_w, cur_p = [], []
+        for wl, pl in zip(wfile.splitlines(), pfile.splitlines()):
+            if not wl.strip():
+                if cur_w:
+                    sentences.append(cur_w)
+                    props.append(cur_p)
+                cur_w, cur_p = [], []
+                continue
+            cur_w.append(wl.strip())
+            cur_p.append(pl.strip().split())
+        if cur_w:
+            sentences.append(cur_w)
+            props.append(cur_p)
+        data = []
+        unk = self.word_dict.get("<unk>", 0)
+        for words, prop in zip(sentences, props):
+            if not prop or len(prop[0]) < 2:
+                continue
+            n_preds = len(prop[0]) - 1
+            for p in range(n_preds):
+                verb = next((prop[i][0] for i in range(len(prop))
+                             if prop[i][p + 1].startswith("(V")), None)
+                if verb is None or verb == "-":
+                    continue
+                labels = self._spans_to_iob([r[p + 1] for r in prop])
+                wids = np.asarray([self.word_dict.get(w.lower(), unk)
+                                   for w in words])
+                vid = self.predicate_dict.get(verb, 0)
+                lids = np.asarray([self.label_dict.get(l, self.label_dict["O"])
+                                   for l in labels])
+                data.append((wids, np.asarray([vid]), lids))
+        return data
+
+    @staticmethod
+    def _spans_to_iob(col):
+        out, state = [], None
+        for tok in col:
+            label = "O"
+            m = re.match(r"\(([^*()]+)", tok)
+            if m:
+                state = m.group(1)
+                label = "B-" + state
+            elif state is not None:
+                label = "I-" + state
+            out.append(label)
+            if ")" in tok:
+                state = None
+        return out
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class _WMTBase(Dataset):
+    """Shared src/trg id-sequence contract: item = (src_ids, trg_ids,
+    trg_ids_next) (reference: wmt14.py/wmt16.py)."""
+
+    BOS, EOS, UNK = 0, 1, 2
+
+    def _synth(self, seed, dict_size):
+        rng = np.random.RandomState(seed)
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        for _ in range(128):
+            n = rng.randint(3, 15)
+            src = rng.randint(3, dict_size, n).tolist()
+            trg = rng.randint(3, dict_size, n).tolist()
+            self.src_ids.append([self.BOS] + src + [self.EOS])
+            self.trg_ids.append([self.BOS] + trg)
+            self.trg_ids_next.append(trg + [self.EOS])
+        self.src_dict = {i: f"s{i}" for i in range(dict_size)}
+        self.trg_dict = {i: f"t{i}" for i in range(dict_size)}
+
+    def __getitem__(self, idx):
+        return (np.asarray(self.src_ids[idx]), np.asarray(self.trg_ids[idx]),
+                np.asarray(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)
+
+
+class WMT14(_WMTBase):
+    """WMT14 en-fr (reference: wmt14.py). data_file: wmt14 tar with
+    train/test token files ('src \\t trg' per line)."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=30000,
+                 download=True):
+        if data_file is None:
+            self._synth(3, min(dict_size, 64))
+            return
+        data_file = _require(data_file, "wmt14 archive")
+        self._parse_tar(data_file, "train" if mode == "train" else "test",
+                        dict_size, dict_size)
+
+    def _parse_tar(self, data_file, split, src_dict_size, trg_dict_size,
+                   swap_columns=False):
+        """Parse 'src \\t trg' token files under ``split``/ in the tar.
+        swap_columns=True reads the pair as (col1, col0) — WMT16's
+        lang='de' direction."""
+        from collections import Counter
+        sub = split.rstrip("/") + "/"
+        pairs = []
+        with tarfile.open(data_file, "r:*") as tf:
+            for m in tf.getmembers():
+                if sub not in m.name or not m.isfile():
+                    continue
+                for ln in tf.extractfile(m).read().decode(
+                        "latin1").splitlines():
+                    parts = ln.split("\t")
+                    if len(parts) >= 2:
+                        s, t = parts[0].split(), parts[1].split()
+                        pairs.append((t, s) if swap_columns else (s, t))
+        sfreq, tfreq = Counter(), Counter()
+        for s, t in pairs:
+            sfreq.update(s)
+            tfreq.update(t)
+
+        def build(freq, size):
+            words = [w for w, _ in freq.most_common(size - 3)]
+            d = {"<s>": self.BOS, "<e>": self.EOS, "<unk>": self.UNK}
+            for i, w in enumerate(words):
+                d[w] = i + 3
+            return d
+
+        self.src_dict = build(sfreq, src_dict_size)
+        self.trg_dict = build(tfreq, trg_dict_size)
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        for s, t in pairs:
+            sid = [self.src_dict.get(w, self.UNK) for w in s]
+            tid = [self.trg_dict.get(w, self.UNK) for w in t]
+            self.src_ids.append([self.BOS] + sid + [self.EOS])
+            self.trg_ids.append([self.BOS] + tid)
+            self.trg_ids_next.append(tid + [self.EOS])
+
+
+class WMT16(_WMTBase):
+    """WMT16 en-de (reference: wmt16.py); same item contract, tar layout
+    wmt16/{train,val,test}. lang='en' reads en->de, lang='de' the
+    reverse."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=30000,
+                 trg_dict_size=30000, lang="en", download=True):
+        if src_dict_size <= 0 or trg_dict_size <= 0:
+            raise ValueError("src_dict_size/trg_dict_size must be positive")
+        if mode not in ("train", "test", "val"):
+            raise ValueError(f"unknown WMT16 mode {mode!r}")
+        if data_file is None:
+            self._synth(4, min(src_dict_size, 64))
+            return
+        data_file = _require(data_file, "wmt16 archive")
+        WMT14._parse_tar(self, data_file, mode, src_dict_size,
+                         trg_dict_size, swap_columns=(lang == "de"))

@@ -1,5 +1,6 @@
 """paddle.utils equivalent."""
 from . import cpp_extension  # noqa: F401
+from . import download  # noqa: F401
 from .cpp_extension import custom_op  # noqa: F401
 
 

@@ -1,0 +1,30 @@
+"""paddle.utils.download — local-file resolution (no-egress environment).
+
+Reference: python/paddle/utils/download.py get_path_from_url downloads and
+caches archives; this environment has no network, so the equivalent
+surface resolves local paths and raises a uniform, actionable error when
+an archive is absent.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["require_local_file", "get_path_from_url"]
+
+
+def require_local_file(path, what):
+    """Return ``path`` if it exists, else raise the standard no-egress
+    error used by every dataset loader."""
+    if path is None or not os.path.exists(path):
+        raise ValueError(
+            f"{what}: file {path!r} not found. This environment has no "
+            "network egress; download the archive elsewhere and pass its "
+            "local path (the reference would auto-download here).")
+    return path
+
+
+def get_path_from_url(url, root_dir, md5sum=None, check_exist=True):
+    """Reference-compatible name: resolves an already-downloaded archive
+    under ``root_dir``; never downloads."""
+    fname = os.path.join(root_dir, os.path.basename(url))
+    return require_local_file(fname, f"archive for {url}")

@@ -1,13 +1,31 @@
-"""Synthetic stand-ins for vision datasets (no network egress in this env).
-The reference downloads MNIST etc. (python/paddle/vision/datasets/); here
-FakeMNIST/FakeImageNet generate deterministic data with the same shapes so
-training pipelines and benchmarks run hermetically.
+"""paddle.vision.datasets — dataset parsers + hermetic synthetic stand-ins.
+
+Reference: python/paddle/vision/datasets/{mnist,cifar,folder,flowers,
+voc2012}.py. The reference downloads archives on first use; this
+environment has no egress, so every real dataset class takes explicit
+local file paths (``data_file=``/``image_path=``...) and raises a clear
+error when they are absent, while FakeMNIST/FakeImageNet generate
+deterministic data with the right shapes so pipelines and benchmarks run
+hermetically. File-format parsing (idx, cifar pickle, VOC tar layout,
+image folders) matches the reference loaders.
 """
 from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
 
 import numpy as np
 
 from ..io.dataset import Dataset
+from ..utils.download import require_local_file as _require
+
+__all__ = [
+    "FakeMNIST", "FakeImageNet", "MNIST", "FashionMNIST", "Cifar10",
+    "Cifar100", "DatasetFolder", "ImageFolder", "Flowers", "VOC2012",
+]
 
 
 class FakeMNIST(Dataset):
@@ -27,13 +45,9 @@ class FakeMNIST(Dataset):
         return len(self.images)
 
 
-MNIST = FakeMNIST
-
-
 class FakeImageNet(Dataset):
     def __init__(self, n=256, image_size=224, num_classes=1000, seed=0,
                  transform=None):
-        rng = np.random.RandomState(seed)
         self.n = n
         self.image_size = image_size
         self.num_classes = num_classes
@@ -50,3 +64,318 @@ class FakeImageNet(Dataset):
 
     def __len__(self):
         return self.n
+
+
+class MNIST(Dataset):
+    """Parses the idx-ubyte format (reference: vision/datasets/mnist.py).
+
+    Pass image_path/label_path to local (optionally .gz) idx files; with
+    no paths, falls back to FakeMNIST-style synthetic data so smoke
+    pipelines run hermetically.
+    """
+
+    NAME = "mnist"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None, **fake_kwargs):
+        self.mode = mode
+        self.transform = transform
+        if image_path is None and label_path is None:
+            fake = FakeMNIST(mode=mode, **fake_kwargs)
+            self.images = (fake.images[:, 0] * 255).astype(np.uint8)
+            self.labels = fake.labels
+            return
+        image_path = _require(image_path, f"{self.NAME} images")
+        label_path = _require(label_path, f"{self.NAME} labels")
+        self.images = self._read_images(image_path)
+        self.labels = self._read_labels(label_path)
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") else \
+            open(path, "rb")
+
+    def _read_images(self, path):
+        with self._open(path) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            if magic != 2051:
+                raise ValueError(f"bad idx image magic {magic}")
+            data = np.frombuffer(f.read(n * rows * cols), dtype=np.uint8)
+            return data.reshape(n, rows, cols)
+
+    def _read_labels(self, path):
+        with self._open(path) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            if magic != 2049:
+                raise ValueError(f"bad idx label magic {magic}")
+            return np.frombuffer(f.read(n), dtype=np.uint8).astype(
+                np.int64).reshape(-1, 1)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)[None] / 255.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    NAME = "fashion-mnist"
+
+
+class _CifarBase(Dataset):
+    """Parses the python-pickle cifar tar archive (reference: cifar.py)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.transform = transform
+        if data_file is None:
+            rng = np.random.RandomState(0)
+            n = 512
+            self.data = (rng.rand(n, 3, 32, 32) * 255).astype(np.uint8)
+            self.labels = rng.randint(0, self._num_classes, (n,)).astype(
+                np.int64)
+            return
+        data_file = _require(data_file, self._archive)
+        datas, labels = [], []
+        with tarfile.open(data_file, "r:*") as tf:
+            for member in tf.getmembers():
+                base = os.path.basename(member.name)
+                if not member.isfile() or not self._member_matches(base, mode):
+                    continue
+                batch = pickle.load(tf.extractfile(member),
+                                    encoding="latin1")
+                datas.append(np.asarray(batch["data"], dtype=np.uint8))
+                labels.extend(batch[self._label_key])
+        if not datas:
+            raise ValueError(f"no {mode} batches found in {data_file}")
+        self.data = np.concatenate(datas).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, dtype=np.int64)
+
+    def __getitem__(self, idx):
+        img = self.data[idx].astype(np.float32) / 255.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Cifar10(_CifarBase):
+    _num_classes = 10
+    _archive = "cifar-10-python.tar.gz"
+    _label_key = "labels"
+
+    @staticmethod
+    def _member_matches(name, mode):
+        return name.startswith("data_batch") if mode == "train" \
+            else name == "test_batch"
+
+
+class Cifar100(_CifarBase):
+    _num_classes = 100
+    _archive = "cifar-100-python.tar.gz"
+    _label_key = "fine_labels"
+
+    @staticmethod
+    def _member_matches(name, mode):
+        return name == ("train" if mode == "train" else "test")
+
+
+_IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                   ".tiff", ".webp")
+
+
+def _pil_loader(path):
+    from PIL import Image
+    with open(path, "rb") as f:
+        img = Image.open(f)
+        return img.convert("RGB")
+
+
+def has_valid_extension(filename, extensions=_IMG_EXTENSIONS):
+    return filename.lower().endswith(tuple(extensions))
+
+
+def make_dataset(directory, class_to_idx, extensions=None,
+                 is_valid_file=None):
+    """(path, class_index) samples from a class-per-subdir tree
+    (reference: vision/datasets/folder.py make_dataset)."""
+    samples = []
+    if is_valid_file is None:
+        def is_valid_file(p):
+            return has_valid_extension(p, extensions or _IMG_EXTENSIONS)
+    for cls in sorted(class_to_idx):
+        d = os.path.join(directory, cls)
+        if not os.path.isdir(d):
+            continue
+        for root, _, fnames in sorted(os.walk(d, followlinks=True)):
+            for fname in sorted(fnames):
+                path = os.path.join(root, fname)
+                if is_valid_file(path):
+                    samples.append((path, class_to_idx[cls]))
+    return samples
+
+
+class DatasetFolder(Dataset):
+    """Generic class-per-subdirectory image dataset
+    (reference: vision/datasets/folder.py DatasetFolder)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or _pil_loader
+        classes = sorted(e.name for e in os.scandir(root) if e.is_dir())
+        if not classes:
+            raise ValueError(f"no class subdirectories found under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = make_dataset(root, self.class_to_idx, extensions,
+                                    is_valid_file)
+        if not self.samples:
+            raise ValueError(f"no valid image files found under {root}")
+        self.targets = [s[1] for s in self.samples]
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Flat (unlabelled) image folder (reference: folder.py ImageFolder)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or _pil_loader
+        exts = extensions or _IMG_EXTENSIONS
+
+        def valid(p):
+            return is_valid_file(p) if is_valid_file else \
+                has_valid_extension(p, exts)
+
+        samples = []
+        for r, _, fnames in sorted(os.walk(root, followlinks=True)):
+            for fname in sorted(fnames):
+                p = os.path.join(r, fname)
+                if valid(p):
+                    samples.append(p)
+        if not samples:
+            raise ValueError(f"no valid image files found under {root}")
+        self.samples = samples
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class _LazyTarMixin:
+    """Per-process tar handle: forked DataLoader workers must not share
+    one fd/offset (the reference avoids this by extracting to disk)."""
+
+    def _tar_init(self, path):
+        self._tar_path = path
+        self._tar_handles = {}
+        with tarfile.open(path, "r:*") as tf:
+            members = tf.getmembers()
+        return members
+
+    @property
+    def _tar(self):
+        pid = os.getpid()
+        tf = self._tar_handles.get(pid)
+        if tf is None:
+            tf = tarfile.open(self._tar_path, "r:*")
+            self._tar_handles = {pid: tf}  # drop inherited handles
+        return tf
+
+
+class Flowers(_LazyTarMixin, Dataset):
+    """Oxford 102 flowers (reference: vision/datasets/flowers.py).
+
+    Requires local archives: data_file (102flowers.tgz), label_file
+    (imagelabels.mat), setid_file (setid.mat); .mat parsing via scipy as
+    in the reference.
+    """
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        self.transform = transform
+        data_file = _require(data_file, "flowers images (102flowers.tgz)")
+        label_file = _require(label_file, "flowers labels (imagelabels.mat)")
+        setid_file = _require(setid_file, "flowers split ids (setid.mat)")
+        import scipy.io as sio
+        self.labels = sio.loadmat(label_file)["labels"][0]
+        setid = sio.loadmat(setid_file)
+        key = {"train": "trnid", "valid": "valid", "test": "tstid"}[mode]
+        self.indexes = setid[key][0]
+        members = self._tar_init(data_file)
+        self._names = {os.path.basename(m.name): m
+                       for m in members if m.isfile()}
+
+    def __getitem__(self, idx):
+        from PIL import Image
+        flower_id = int(self.indexes[idx])
+        member = self._names[f"image_{flower_id:05d}.jpg"]
+        img = Image.open(self._tar.extractfile(member)).convert("RGB")
+        label = np.asarray([self.labels[flower_id - 1]], dtype=np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.indexes)
+
+
+class VOC2012(_LazyTarMixin, Dataset):
+    """Pascal VOC2012 segmentation pairs (reference: voc2012.py).
+
+    data_file: local VOCtrainval tar archive.
+    """
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.transform = transform
+        data_file = _require(data_file, "VOCtrainval_11-May-2012.tar")
+        names = {m.name: m for m in self._tar_init(data_file)}
+        split = "trainval" if mode == "trainval" else mode
+        seg_list = None
+        for n in names:
+            if n.endswith(f"ImageSets/Segmentation/{split}.txt"):
+                seg_list = n
+                break
+        if seg_list is None:
+            raise ValueError(f"no segmentation split '{mode}' in archive")
+        ids = self._tar.extractfile(names[seg_list]).read().decode().split()
+        root = seg_list.split("ImageSets/")[0]
+        self._pairs = [
+            (names[f"{root}JPEGImages/{i}.jpg"],
+             names[f"{root}SegmentationClass/{i}.png"]) for i in ids]
+
+    def __getitem__(self, idx):
+        from PIL import Image
+        im, lm = self._pairs[idx]
+        img = Image.open(self._tar.extractfile(im)).convert("RGB")
+        label = Image.open(self._tar.extractfile(lm))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(label, dtype=np.int64)
+
+    def __len__(self):
+        return len(self._pairs)

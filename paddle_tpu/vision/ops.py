@@ -146,8 +146,9 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
     """Decode YOLOv3 head output into boxes + scores.
 
     Reference: python/paddle/vision/ops.py yolo_box (PHI yolo_box kernel).
-    x: (N, S*(5+class_num), H, W) -> boxes (N, H*W*S, 4), scores
-    (N, H*W*S, class_num).
+    x: (N, S*(5+class_num), H, W) -> boxes (N, S*H*W, 4), scores
+    (N, S*H*W, class_num); rows are anchor-major (row k is anchor
+    k//(H*W), cell ((k%(H*W))//W, k%W)), matching the reference layout.
     """
     s = len(anchors) // 2
     anc = jnp.asarray(anchors, dtype=jnp.float32).reshape(s, 2)
