@@ -57,6 +57,7 @@ from . import device  # noqa: F401
 from . import audio  # noqa: F401
 from . import fft  # noqa: F401
 from . import signal  # noqa: F401
+from . import hub  # noqa: F401
 from . import geometric  # noqa: F401
 from . import onnx  # noqa: F401
 from . import text  # noqa: F401

@@ -1,0 +1,84 @@
+"""paddle.hub — hubconf.py-driven model discovery.
+
+Reference: python/paddle/hapi/hub.py (list/help/load at :175/:223/:268)
+supporting github/gitee/local sources. No network egress here, so only
+``source='local'`` is functional; remote sources raise with a clear
+message. The hubconf contract matches the reference: a repo directory
+containing ``hubconf.py`` whose public callables are the entrypoints and
+whose ``dependencies`` list is checked before loading.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _check_source(source):
+    if source not in ("github", "gitee", "local"):
+        raise ValueError(
+            f"unknown source {source!r}: expected github/gitee/local")
+    if source != "local":
+        raise RuntimeError(
+            "paddle.hub: remote sources (github/gitee) need network "
+            "access, which this environment does not have. Clone the "
+            "repo locally and use source='local'.")
+
+
+def _import_hubconf(repo_dir):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no {_HUBCONF} found in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    module = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(module)
+    finally:
+        sys.path.remove(repo_dir)
+    deps = getattr(module, "dependencies", [])
+    missing = []
+    for d in deps:
+        if importlib.util.find_spec(d) is None:
+            missing.append(d)
+    if missing:
+        raise RuntimeError(
+            f"hubconf dependencies not installed: {missing}")
+    return module
+
+
+def _entrypoints(module):
+    return {
+        name: fn for name, fn in vars(module).items()
+        if callable(fn) and not name.startswith("_")
+    }
+
+
+def list(repo_dir, source="local", force_reload=False):
+    """Names of all entrypoints exposed by the repo's hubconf.py."""
+    _check_source(source)
+    return sorted(_entrypoints(_import_hubconf(repo_dir)))
+
+
+def help(repo_dir, model, source="local", force_reload=False):
+    """Docstring of one hubconf entrypoint."""
+    _check_source(source)
+    eps = _entrypoints(_import_hubconf(repo_dir))
+    if model not in eps:
+        raise RuntimeError(
+            f"entrypoint {model!r} not found; available: {sorted(eps)}")
+    return eps[model].__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    """Instantiate one hubconf entrypoint with **kwargs."""
+    _check_source(source)
+    eps = _entrypoints(_import_hubconf(repo_dir))
+    if model not in eps:
+        raise RuntimeError(
+            f"entrypoint {model!r} not found; available: {sorted(eps)}")
+    return eps[model](**kwargs)

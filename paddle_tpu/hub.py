@@ -1,0 +1,4 @@
+"""paddle.hub (reference: python/paddle/hub.py re-exporting hapi.hub)."""
+from .hapi.hub import help, list, load  # noqa: F401
+
+__all__ = ["list", "help", "load"]

@@ -4,3 +4,4 @@ from . import autograd  # noqa: F401
 from . import nn  # noqa: F401
 from . import distributed  # noqa: F401
 from . import asp  # noqa: F401
+from . import optimizer  # noqa: F401

@@ -1,0 +1,175 @@
+"""paddle.incubate.optimizer — LookAhead + ModelAverage.
+
+Reference: python/paddle/incubate/optimizer/{lookahead.py:25,
+modelaverage.py}. Both wrap an inner optimizer; the slow-weight /
+averaging math is plain jnp over parameter arrays (XLA fuses the
+elementwise sweeps), and state lives in numpy-backed Tensor accumulators
+like every other optimizer here.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.autograd import no_grad
+from ...core.dispatch import wrap
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """k-step lookahead: inner optimizer updates fast weights every step;
+    every k steps slow <- slow + alpha*(fast - slow), fast <- slow."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if inner_optimizer is None:
+            raise ValueError("inner optimizer cannot be None")
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha must be in [0, 1]")
+        if not (isinstance(k, int) and k > 0):
+            raise ValueError("k must be a positive integer")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._global_step = 0
+        self._slow = None
+
+    @property
+    def _parameters(self):
+        return self.inner_optimizer._parameters
+
+    def _params(self):
+        ps = self.inner_optimizer._parameters
+        if ps is None:
+            raise ValueError("inner optimizer has no parameter list")
+        return ps
+
+    @no_grad()
+    def step(self):
+        params = self._params()
+        if self._slow is None:
+            # slow weights seed from the pre-update params (the reference
+            # copies param into the slow_param accumulator on creation)
+            self._slow = [p._data for p in params]
+        self.inner_optimizer.step()
+        self._global_step += 1
+        if self._global_step % self.k == 0:
+            for i, p in enumerate(params):
+                slow = self._slow[i] + self.alpha * (p._data - self._slow[i])
+                self._slow[i] = slow
+                p._data = slow
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["lookahead_step"] = self._global_step
+        if self._slow is not None:
+            for i, s in enumerate(self._slow):
+                sd[f"lookahead_slow_{i}"] = wrap(s)
+        return sd
+
+    def set_state_dict(self, sd):
+        sd = dict(sd)  # don't mutate the caller's dict
+        self._global_step = int(sd.pop("lookahead_step", 0))
+        slows = {}
+        for key in [k for k in sd if k.startswith("lookahead_slow_")]:
+            slows[int(key.rsplit("_", 1)[1])] = sd.pop(key)._data
+        if slows:
+            self._slow = [slows[i] for i in sorted(slows)]
+        self.inner_optimizer.set_state_dict(sd)
+
+    def __getattr__(self, name):
+        if name == "inner_optimizer":  # not set yet (deepcopy/unpickle)
+            raise AttributeError(name)
+        return getattr(self.inner_optimizer, name)
+
+
+class ModelAverage:
+    """Maintains running parameter sums; apply()/restore() swap averaged
+    weights in and out for evaluation (reference: modelaverage.py).
+
+    average window = max(min_average_window,
+                         min(max_average_window,
+                             num_updates * average_window_rate))
+    """
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        if parameters is None:
+            raise ValueError(
+                "ModelAverage requires an explicit parameters list in "
+                "dygraph mode (there is no default program to scan)")
+        self.average_window = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        self._parameters = list(parameters)
+        self._sum_1 = [jnp.zeros_like(p._data) for p in self._parameters]
+        self._sum_2 = [jnp.zeros_like(p._data) for p in self._parameters]
+        self._sum_3 = [jnp.zeros_like(p._data) for p in self._parameters]
+        self._num_accumulates = 0
+        self._old_num_accumulates = 0
+        self._num_updates = 0
+        self._backup = None
+
+    _MAX_NUM_ACCUMULATES = 16384  # precision cascade, as in the reference
+
+    @no_grad()
+    def step(self):
+        """Accumulate current parameter values (call after optimizer.step).
+
+        Rotation rule matches the reference average_accumulates kernel
+        (paddle/phi/kernels/impl/average_accumulates_kernel_impl.h:116-135):
+        every 16384 updates sum_2 += sum_1 (precision); when the window is
+        exceeded, sum_3 = sum_1 + sum_2, both reset, counts rotate.
+        """
+        self._num_updates += 1
+        self._num_accumulates += 1
+        for i, p in enumerate(self._parameters):
+            self._sum_1[i] = self._sum_1[i] + p._data
+        if self._num_updates % self._MAX_NUM_ACCUMULATES == 0:
+            for i in range(len(self._parameters)):
+                self._sum_2[i] = self._sum_2[i] + self._sum_1[i]
+                self._sum_1[i] = jnp.zeros_like(self._sum_1[i])
+        if (self._num_accumulates >= self.min_average_window
+                and self._num_accumulates >= min(
+                    self.max_average_window,
+                    self._num_updates * self.average_window)):
+            for i in range(len(self._parameters)):
+                self._sum_3[i] = self._sum_1[i] + self._sum_2[i]
+                self._sum_1[i] = jnp.zeros_like(self._sum_1[i])
+                self._sum_2[i] = jnp.zeros_like(self._sum_2[i])
+            self._old_num_accumulates = self._num_accumulates
+            self._num_accumulates = 0
+
+    @no_grad()
+    def apply(self, executor=None, need_restore=True):
+        """Swap in averaged parameters; call :meth:`restore` afterwards to
+        return to the live weights."""
+        denom = self._num_accumulates + self._old_num_accumulates
+        if denom == 0:
+            return
+        self._backup = [p._data for p in self._parameters]
+        for i, p in enumerate(self._parameters):
+            s = self._sum_1[i] + self._sum_2[i] + self._sum_3[i]
+            p._data = (s / denom).astype(p._data.dtype)
+
+    @no_grad()
+    def restore(self, executor=None):
+        """Swap original parameters back after apply()."""
+        if self._backup is None:
+            return
+        for p, b in zip(self._parameters, self._backup):
+            p._data = b
+        self._backup = None
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        self.step()

@@ -1,0 +1,47 @@
+"""paddle.hub local-source tests (reference: hapi/hub.py)."""
+import os
+
+import pytest
+
+import paddle_tpu as paddle
+
+
+@pytest.fixture
+def hub_repo(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "dependencies = ['numpy']\n"
+        "def linear_model(n=4):\n"
+        "    '''A linear model entrypoint.'''\n"
+        "    import paddle_tpu as paddle\n"
+        "    return paddle.nn.Linear(n, n)\n"
+        "def _private():\n"
+        "    pass\n")
+    return str(tmp_path)
+
+
+def test_list_excludes_private(hub_repo):
+    assert paddle.hub.list(hub_repo) == ["linear_model"]
+
+
+def test_help_and_load(hub_repo):
+    assert "linear model" in paddle.hub.help(hub_repo, "linear_model")
+    m = paddle.hub.load(hub_repo, "linear_model", n=6)
+    assert list(m.weight.shape) == [6, 6]
+
+
+def test_unknown_entrypoint(hub_repo):
+    with pytest.raises(RuntimeError, match="not found"):
+        paddle.hub.load(hub_repo, "nope")
+
+
+def test_remote_source_gated(hub_repo):
+    with pytest.raises(RuntimeError, match="network"):
+        paddle.hub.list("owner/repo", source="github")
+
+
+def test_missing_dependency(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "dependencies = ['not_a_real_pkg_xyz']\n"
+        "def m():\n    return 1\n")
+    with pytest.raises(RuntimeError, match="dependencies"):
+        paddle.hub.list(str(tmp_path))

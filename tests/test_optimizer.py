@@ -196,3 +196,60 @@ def test_adamw_bf16_moments_close_to_f32():
     import jax.numpy as jnp
     accum = next(iter(opt._accumulators["moment1"].values()))
     assert accum.dtype == jnp.bfloat16
+
+
+class TestIncubateOptimizers:
+    def test_lookahead_slow_weights(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        paddle.seed(0)
+        net = paddle.nn.Linear(4, 4)
+        inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=net.parameters())
+        la = paddle.incubate.optimizer.LookAhead(inner, alpha=0.5, k=2)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        w0 = net.weight.numpy().copy()
+        fast = w0.copy()
+        slow = w0.copy()
+        for step in range(4):
+            loss = net(x).sum()
+            loss.backward()
+            g = net.weight.grad.numpy()
+            la.step()
+            la.clear_grad()
+            fast = fast - 0.1 * g
+            if (step + 1) % 2 == 0:
+                slow = slow + 0.5 * (fast - slow)
+                fast = slow.copy()
+            np.testing.assert_allclose(net.weight.numpy(), fast, rtol=1e-5)
+
+    def test_model_average_apply_restore(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        paddle.seed(1)
+        net = paddle.nn.Linear(3, 3)
+        opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                   parameters=net.parameters())
+        ma = paddle.incubate.optimizer.ModelAverage(
+            0.15, parameters=net.parameters(), min_average_window=2,
+            max_average_window=10)
+        x = paddle.to_tensor(np.random.RandomState(0).randn(2, 3)
+                             .astype(np.float32))
+        seen = []
+        for _ in range(5):
+            net(x).sum().backward()
+            opt.step()
+            opt.clear_grad()
+            ma.step()
+            seen.append(net.weight.numpy().copy())
+        cur = net.weight.numpy().copy()
+        ma.apply()
+        avg = net.weight.numpy()
+        assert not np.allclose(avg, cur)
+        # with min_window=2 and rate=0.15 the kernel rotates at steps 2 and
+        # 4 (sum_3 <- sum_1+sum_2, counts: old=2), so the window at apply
+        # holds steps 3..5: avg = (w3+w4+w5) / (1 + 2)
+        window_mean = np.mean(seen[2:], axis=0)
+        np.testing.assert_allclose(avg, window_mean, rtol=1e-4, atol=1e-5)
+        ma.restore()
+        np.testing.assert_allclose(net.weight.numpy(), cur, rtol=1e-6)
