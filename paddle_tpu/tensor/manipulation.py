@@ -532,3 +532,15 @@ def unstack(x, axis=0, num=None, name=None):
     """Split along `axis` into unit slices (reference legacy_ops.yaml
     unstack); same result as unbind."""
     return unbind(x, axis)
+
+
+def vsplit(x, num_or_sections, name=None):
+    """Split along dim 0 (rank must be >= 2), reference manipulation.py."""
+    if len(x.shape) < 2:
+        raise ValueError("vsplit expects a tensor of rank >= 2")
+    return split(x, num_or_sections, axis=0)
+
+
+def tolist(x):
+    """Nested python list of the tensor's values."""
+    return x.numpy().tolist()

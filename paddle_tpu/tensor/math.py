@@ -415,3 +415,92 @@ def renorm(x, p, axis, max_norm, name=None):
                            max_norm / jnp.maximum(norms, 1e-12), 1.0)
         return a * factor
     return apply_op("renorm", _renorm, x)
+
+
+def add_n(inputs, name=None):
+    """Elementwise sum of a list of tensors (reference math.py add_n)."""
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    if not inputs:
+        raise ValueError("add_n expects at least one input")
+
+    def fn(*arrs):
+        out = arrs[0]
+        for a in arrs[1:]:
+            out = out + a
+        return out
+
+    return apply_op("add_n", fn, *inputs)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply_op("count_nonzero",
+                    lambda a: jnp.count_nonzero(
+                        a, axis=_axis(axis), keepdims=keepdim).astype(
+                            jnp.int64), x)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply_op("nan_to_num", lambda a: jnp.nan_to_num(
+        a, nan=nan, posinf=posinf, neginf=neginf), x)
+
+
+def take(x, index, mode="raise", name=None):
+    """Flat-index gather (reference math.py take): negative indices wrap;
+    mode 'raise'/'wrap'/'clip' handle out-of-range like numpy.take."""
+    if mode not in ("raise", "wrap", "clip"):
+        raise ValueError(f"unknown take mode {mode}")
+
+    def fn(a, idx):
+        flat = a.reshape(-1)
+        n = flat.shape[0]
+        ii = idx.astype(jnp.int64)
+        if mode == "wrap":
+            ii = ii % n
+        elif mode == "clip":
+            # reference clips to [0, n-1]: negative indexing is disabled
+            ii = jnp.clip(ii, 0, n - 1)
+        else:
+            ii = jnp.where(ii < 0, ii + n, ii)  # 'raise' checked eagerly
+        return flat[ii]
+
+    if mode == "raise":
+        try:  # concrete (eager) indices only; traced values can't be checked
+            inp = index.numpy() if isinstance(index, Tensor) \
+                else np.asarray(index)
+            n = int(np.prod(x.shape)) if x.shape else 1
+            if inp.size and (inp.min() < -n or inp.max() >= n):
+                raise ValueError("take: index out of range")
+        except jax.errors.TracerArrayConversionError:
+            pass
+    return apply_op("take", fn, x, index)
+
+
+def frexp(x, name=None):
+    """Mantissa/exponent decomposition: x = m * 2**e, 0.5<=|m|<1."""
+    def fn(a):
+        e = jnp.where(a == 0, 0,
+                      jnp.floor(jnp.log2(jnp.abs(
+                          jnp.where(a == 0, 1.0, a)))) + 1)
+        # scale by 2^-e in two halves: a single exp2(-e) is subnormal (or
+        # flushed to 0) for the top binade, and exp2(e) overflows
+        e1 = jnp.ceil(e / 2)
+        m = (a * jnp.exp2(-e1)) * jnp.exp2(-(e - e1))
+        return m, e.astype(a.dtype)
+
+    return apply_op("frexp", fn, x)
+
+
+def polar(abs, angle, name=None):  # noqa: A002
+    """Complex tensor from magnitude+phase (reference math.py polar):
+    float32 -> complex64, float64 -> complex128."""
+    def fn(r, t):
+        cdt = jnp.complex128 if r.dtype == jnp.float64 else jnp.complex64
+        return (r * jnp.cos(t)).astype(cdt) + 1j * (r * jnp.sin(t)).astype(cdt)
+
+    return apply_op("polar", fn, abs, angle)
+
+
+def broadcast_shape(x_shape, y_shape):
+    """Static shape broadcast (no tensors involved)."""
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))

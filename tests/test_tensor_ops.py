@@ -248,3 +248,84 @@ class TestInplaceAndMethods:
     def test_astype(self):
         x = t(np.random.randn(3).astype("float32"))
         assert "int32" in str(x.astype("int32").dtype)
+
+
+class TestTopLevelSurface:
+    """Reference __init__ __all__ parity + the misc ops added for it."""
+
+    def test_all_reference_toplevel_names_present(self):
+        import re
+        src = open("/root/reference/python/paddle/__init__.py").read()
+        m = re.search(r"__all__ = \[(.*?)\]", src, re.S)
+        names = re.findall(r"'([^']+)'", m.group(1))
+        missing = [n for n in names if not hasattr(paddle, n)]
+        assert not missing, missing
+
+    def test_take_modes(self):
+        x = paddle.to_tensor(np.arange(6).reshape(2, 3).astype("float32"))
+        np.testing.assert_allclose(
+            paddle.take(x, paddle.to_tensor(np.array([0, -1]))).numpy(),
+            [0.0, 5.0])
+        np.testing.assert_allclose(
+            paddle.take(x, paddle.to_tensor(np.array([7])),
+                        mode="wrap").numpy(), [1.0])
+        np.testing.assert_allclose(
+            paddle.take(x, paddle.to_tensor(np.array([99])),
+                        mode="clip").numpy(), [5.0])
+        # clip disables negative indexing (reference semantics): -2 -> 0
+        np.testing.assert_allclose(
+            paddle.take(x, paddle.to_tensor(np.array([-2, -1])),
+                        mode="clip").numpy(), [0.0, 0.0])
+        with pytest.raises(ValueError):
+            paddle.take(x, paddle.to_tensor(np.array([6])))
+
+    def test_frexp_polar_nan_to_num(self):
+        m, e = paddle.frexp(paddle.to_tensor(
+            np.array([4.0, -3.0, 0.0], "float32")))
+        vals = m.numpy() * np.exp2(e.numpy())
+        np.testing.assert_allclose(vals, [4.0, -3.0, 0.0], rtol=1e-6)
+        assert (np.abs(m.numpy()[:2]) >= 0.5).all()
+        assert (np.abs(m.numpy()[:2]) < 1.0).all()
+        c = paddle.polar(paddle.to_tensor(np.array([2.0], "float32")),
+                         paddle.to_tensor(np.array([0.0], "float32")))
+        assert complex(c.numpy()[0]) == 2 + 0j
+        out = paddle.nan_to_num(paddle.to_tensor(
+            np.array([np.nan, -np.inf], "float32")), nan=1.5)
+        assert out.numpy()[0] == 1.5 and np.isfinite(out.numpy()).all()
+
+    def test_frexp_top_binade(self):
+        m, e = paddle.frexp(paddle.to_tensor(np.array([3e38], "float32")))
+        assert np.isfinite(m.numpy()).all() and abs(m.numpy()[0]) >= 0.5
+        recon = m.numpy().astype(np.float64) * np.exp2(
+            e.numpy().astype(np.float64))
+        np.testing.assert_allclose(recon, [3e38], rtol=1e-6)
+
+    def test_polar_float64_promotes(self):
+        c = paddle.polar(paddle.to_tensor(np.array([1.0])),
+                         paddle.to_tensor(np.array([0.0])))
+        assert c.numpy().dtype == np.complex128
+
+    def test_add_n_single_returns_new_tensor(self):
+        x = paddle.to_tensor(np.ones(3, "float32"))
+        y = paddle.add_n(x)
+        assert y is not x
+        np.testing.assert_allclose(y.numpy(), x.numpy())
+
+    def test_batch_size_validated(self):
+        with pytest.raises(ValueError):
+            paddle.batch(lambda: iter(range(3)), 0)
+
+    def test_add_n_grad(self):
+        a = paddle.to_tensor(np.ones(3, "float32"), stop_gradient=False)
+        b = paddle.to_tensor(np.full(3, 2.0, "float32"), stop_gradient=False)
+        s = paddle.add_n([a, b]).sum()
+        s.backward()
+        np.testing.assert_allclose(a.grad.numpy(), np.ones(3))
+        np.testing.assert_allclose(b.grad.numpy(), np.ones(3))
+
+    def test_flops_counts_linear_and_conv(self):
+        net = paddle.nn.Sequential(
+            paddle.nn.Conv2D(1, 2, 3, padding=1), paddle.nn.Flatten(),
+            paddle.nn.Linear(2 * 4 * 4, 5))
+        fl = paddle.flops(net, (1, 1, 4, 4))
+        assert fl == 2 * 2 * 16 * 1 * 9 + 2 * 1 * 5 * 32
