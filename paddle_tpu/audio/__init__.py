@@ -6,6 +6,8 @@ window.py get_window + functional.py compute_fbank_matrix, backed by
 paddle's fft ops). TPU-native: framing is a strided gather and the STFT
 is jnp.fft — everything jits and fuses on the accelerator.
 """
+from . import backends  # noqa: F401
+from . import datasets  # noqa: F401
 from . import functional  # noqa: F401
 from .features import (  # noqa: F401
     LogMelSpectrogram, MelSpectrogram, MFCC, Spectrogram,

@@ -1,0 +1,111 @@
+"""paddle.audio.backends — wav IO via the stdlib wave module.
+
+Reference: python/paddle/audio/backends/wave_backend.py (info/load/save
+:37/:89/:168) with optional soundfile backend. Only the wave backend is
+shipped (soundfile isn't in this image); PCM 8/16/32-bit wavs round-trip.
+"""
+from __future__ import annotations
+
+import wave as _wave
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = ["AudioInfo", "info", "load", "save", "list_available_backends",
+           "get_current_backend", "set_backend"]
+
+
+@dataclass
+class AudioInfo:
+    sample_rate: int
+    num_samples: int
+    num_channels: int
+    bits_per_sample: int
+    encoding: str
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def get_current_backend():
+    return "wave_backend"
+
+
+def set_backend(backend_name):
+    if backend_name != "wave_backend":
+        raise NotImplementedError(
+            f"backend {backend_name!r} unavailable: only the stdlib wave "
+            "backend is shipped (soundfile is not in this environment)")
+
+
+_WIDTH_DTYPE = {1: np.uint8, 2: np.int16, 4: np.int32}
+
+
+def info(filepath):
+    with _wave.open(str(filepath), "rb") as f:
+        return AudioInfo(
+            sample_rate=f.getframerate(),
+            num_samples=f.getnframes(),
+            num_channels=f.getnchannels(),
+            bits_per_sample=f.getsampwidth() * 8,
+            encoding=f"PCM_{'U' if f.getsampwidth() == 1 else 'S'}",
+        )
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """Returns (Tensor [C, N] (channels_first) or [N, C], sample_rate).
+
+    normalize=True maps PCM ints to float32 in [-1, 1] (as the reference
+    wave backend does); normalize=False returns raw integer samples.
+    """
+    import paddle_tpu as paddle
+    with _wave.open(str(filepath), "rb") as f:
+        sr = f.getframerate()
+        nch = f.getnchannels()
+        width = f.getsampwidth()
+        if width not in _WIDTH_DTYPE:
+            raise ValueError(f"unsupported sample width {width}")
+        f.setpos(min(frame_offset, f.getnframes()))
+        n = f.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(n)
+    data = np.frombuffer(raw, dtype=_WIDTH_DTYPE[width]).reshape(-1, nch)
+    if normalize:
+        if width == 1:  # unsigned 8-bit
+            data = (data.astype(np.float32) - 128.0) / 128.0
+        else:
+            data = data.astype(np.float32) / float(2 ** (8 * width - 1))
+    out = data.T if channels_first else data
+    return paddle.to_tensor(np.ascontiguousarray(out)), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True, encoding=None,
+         bits_per_sample=16):
+    """Write a float (-1..1) or integer tensor as PCM wav."""
+    if bits_per_sample not in (8, 16, 32):
+        raise ValueError("bits_per_sample must be 8, 16 or 32")
+    arr = src.numpy() if hasattr(src, "numpy") else np.asarray(src)
+    if arr.ndim == 1:
+        arr = arr[None] if channels_first else arr[:, None]
+    if channels_first:
+        arr = arr.T  # -> [N, C]
+    if not np.issubdtype(arr.dtype, np.floating):
+        # normalize integer input to float first so any source width can
+        # be re-encoded at the requested bits_per_sample
+        if arr.dtype == np.uint8:
+            arr = (arr.astype(np.float32) - 128.0) / 128.0
+        else:
+            src_bits = arr.dtype.itemsize * 8
+            arr = arr.astype(np.float32) / float(2 ** (src_bits - 1))
+    if bits_per_sample == 8:
+        arr = ((arr * 127.0) + 128.0).clip(0, 255).astype(np.uint8)
+    else:
+        scale = float(2 ** (bits_per_sample - 1) - 1)
+        dt = np.int16 if bits_per_sample == 16 else np.int32
+        arr = (arr * scale).clip(-scale - 1, scale).astype(dt)
+    with _wave.open(str(filepath), "wb") as f:
+        f.setnchannels(arr.shape[1])
+        f.setsampwidth(bits_per_sample // 8)
+        f.setframerate(int(sample_rate))
+        f.writeframes(np.ascontiguousarray(arr).tobytes())

@@ -1,0 +1,126 @@
+"""paddle.audio.datasets — TESS / ESC50 audio-classification datasets.
+
+Reference: python/paddle/audio/datasets/{dataset,tess,esc50}.py. The
+reference downloads archives; here the classes scan a local directory of
+wav files (``data_dir=``) laid out like the extracted archives, with
+feature extraction (raw/melspectrogram/mfcc/spectrogram/logmelspectrogram)
+shared through AudioClassificationDataset exactly as the reference does.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..io.dataset import Dataset
+from ..utils.download import require_local_file
+from . import features as _features
+from .backends import load as _load_wav
+
+__all__ = ["AudioClassificationDataset", "TESS", "ESC50"]
+
+
+_FEAT = {
+    "raw": None,
+    "melspectrogram": "MelSpectrogram",
+    "mfcc": "MFCC",
+    "logmelspectrogram": "LogMelSpectrogram",
+    "spectrogram": "Spectrogram",
+}
+
+
+class AudioClassificationDataset(Dataset):
+    """(wav file, label) list + on-the-fly feature extraction
+    (reference: audio/datasets/dataset.py)."""
+
+    def __init__(self, files, labels, feat_type="raw", sample_rate=None,
+                 **kwargs):
+        if feat_type not in _FEAT:
+            raise ValueError(
+                f"unknown feat_type {feat_type!r}; one of {sorted(_FEAT)}")
+        self.files = files
+        self.labels = labels
+        self.feat_type = feat_type
+        self.sample_rate = sample_rate
+        self.feat_config = kwargs
+        self._extractor = None
+
+    def _extract(self, waveform):
+        import paddle_tpu as paddle
+        if self.feat_type == "raw":
+            return waveform
+        if self._extractor is None:
+            cls = getattr(_features, _FEAT[self.feat_type])
+            cfg = dict(self.feat_config)
+            if self.sample_rate is not None:
+                cfg.setdefault("sr", self.sample_rate)
+            self._extractor = cls(**cfg)
+        return self._extractor(paddle.to_tensor(waveform))
+
+    def __getitem__(self, idx):
+        wav, sr = _load_wav(self.files[idx])
+        mono = wav.numpy().mean(axis=0).astype(np.float32)
+        feat = self._extract(mono)
+        if not isinstance(feat, np.ndarray):
+            feat = np.asarray(feat.numpy())
+        return feat, np.asarray([self.labels[idx]], dtype=np.int64)
+
+    def __len__(self):
+        return len(self.files)
+
+
+class TESS(AudioClassificationDataset):
+    """Toronto emotional speech set: 7 emotions encoded in filenames
+    (reference: tess.py). data_dir: directory containing the extracted
+    ``*_<emotion>.wav`` files (searched recursively)."""
+
+    labels_list = ["angry", "disgust", "fear", "happy", "neutral",
+                   "ps", "sad"]
+
+    def __init__(self, mode="train", n_folds=5, split=1, feat_type="raw",
+                 data_dir=None, archive=None, **kwargs):
+        if not (isinstance(n_folds, int) and 1 <= split <= n_folds):
+            raise ValueError("require 1 <= split <= n_folds")
+        data_dir = require_local_file(data_dir, "TESS data directory")
+        wavs = []
+        for root, _, names in sorted(os.walk(data_dir)):
+            for nm in sorted(names):
+                if nm.lower().endswith(".wav"):
+                    wavs.append(os.path.join(root, nm))
+        files, labels = [], []
+        for i, w in enumerate(wavs):
+            emotion = os.path.basename(w).rsplit(".", 1)[0] \
+                .split("_")[-1].lower()
+            if emotion not in self.labels_list:
+                continue
+            fold = i % n_folds + 1
+            keep = (fold != split) if mode == "train" else (fold == split)
+            if keep:
+                files.append(w)
+                labels.append(self.labels_list.index(emotion))
+        super().__init__(files, labels, feat_type=feat_type, **kwargs)
+
+
+class ESC50(AudioClassificationDataset):
+    """ESC-50 environmental sounds: 50 classes, fold encoded in the
+    filename ``<fold>-<src>-<take>-<target>.wav`` (reference: esc50.py)."""
+
+    def __init__(self, mode="train", split=1, feat_type="raw",
+                 data_dir=None, archive=None, **kwargs):
+        data_dir = require_local_file(data_dir, "ESC-50 audio directory")
+        files, labels = [], []
+        for root, _, names in sorted(os.walk(data_dir)):
+            for nm in sorted(names):
+                if not nm.lower().endswith(".wav"):
+                    continue
+                parts = nm.rsplit(".", 1)[0].split("-")
+                if len(parts) != 4 or not (parts[0].isdigit()
+                                           and parts[3].isdigit()):
+                    continue  # skip non-conforming filenames (readmes etc.)
+                fold, target = int(parts[0]), int(parts[3])
+                keep = (fold != split) if mode == "train" \
+                    else (fold == split)
+                if keep:
+                    files.append(os.path.join(root, nm))
+                    labels.append(target)
+        super().__init__(files, labels, feat_type=feat_type, **kwargs)

@@ -190,12 +190,14 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
 
     was_training = net.training
     net.eval()
-    x = P.to_tensor(np.zeros(input_size, dtype=np.float32))
-    net(x)
-    if was_training:
-        net.train()
-    for h in hooks:
-        h.remove()
+    try:
+        x = P.to_tensor(np.zeros(input_size, dtype=np.float32))
+        net(x)
+    finally:
+        if was_training:
+            net.train()
+        for h in hooks:
+            h.remove()
     if print_detail:
         for name, fl in rows:
             print(f"{name:>16}: {fl:,}")

@@ -63,11 +63,22 @@ class GPTConfig:
     # GPipe PP in a single shard_map). Layer dropout is not applied in this
     # mode (pretraining configs use 0).
     stacked: bool = False
+    # activation recompute inside the scanned decoder (reference:
+    # DistributedStrategy.recompute):
+    #   "full"  — jax.checkpoint every layer (min memory, +~33% FLOPs)
+    #   "dots"  — save matmul outputs, recompute elementwise (near-zero
+    #             extra matmul FLOPs, bounded memory)
+    #   "none"  — save everything XLA wants (max memory, max speed)
+    recompute: str = "full"
 
     def __post_init__(self):
         if self.intermediate_size == 0:
             self.intermediate_size = 4 * self.hidden_size
         assert self.hidden_size % self.num_heads == 0
+        if self.recompute not in ("full", "dots", "none"):
+            raise ValueError(
+                f"recompute must be 'full', 'dots' or 'none', "
+                f"got {self.recompute!r}")
 
 
 def gpt_tiny(**kw) -> GPTConfig:
@@ -348,8 +359,18 @@ class GPTStackedTransformer(Layer):
                 head_dim=cfg.hidden_size // cfg.num_heads,
                 eps=cfg.layer_norm_eps, mp_size=mp, sep_size=sep)
             if mesh is None or (pp == 1 and mp == 1 and sep == 1):
+                if cfg.recompute == "none":
+                    wrapped = layer
+                elif cfg.recompute == "dots":
+                    wrapped = jax.checkpoint(
+                        layer,
+                        policy=jax.checkpoint_policies
+                        .dots_with_no_batch_dims_saveable)
+                else:  # "full"
+                    wrapped = jax.checkpoint(layer)
+
                 def step(c, p_slice):
-                    return jax.checkpoint(layer)(p_slice, c), None
+                    return wrapped(p_slice, c), None
                 out, _ = jax.lax.scan(step, x_arr, p)
                 return out
             from jax.sharding import PartitionSpec as P

@@ -286,3 +286,84 @@ def test_synthetic_fallbacks_loadable():
     for ds in [tds.UCIHousing(), tds.WMT14(), vds.Cifar10()]:
         assert len(ds) > 0
         ds[0]
+
+
+# ------------------------------------------------------------------- audio
+
+def test_audio_wav_roundtrip_and_info(tmp_path):
+    import paddle_tpu as paddle
+    from paddle_tpu.audio import backends as ab
+    t = np.linspace(0, 1, 8000, endpoint=False)
+    sig = (0.5 * np.sin(2 * np.pi * 440 * t)).astype(np.float32)
+    p = str(tmp_path / "tone.wav")
+    ab.save(p, paddle.to_tensor(sig[None]), 8000)
+    inf = ab.info(p)
+    assert inf.sample_rate == 8000 and inf.num_channels == 1
+    assert inf.bits_per_sample == 16
+    wav, sr = ab.load(p)
+    assert sr == 8000 and wav.shape == [1, 8000]
+    np.testing.assert_allclose(wav.numpy()[0], sig, atol=2e-4)
+    # offset/num_frames window
+    part, _ = ab.load(p, frame_offset=100, num_frames=50)
+    np.testing.assert_allclose(part.numpy()[0], wav.numpy()[0, 100:150])
+
+
+def test_audio_esc50_layout(tmp_path):
+    import paddle_tpu as paddle
+    from paddle_tpu.audio import backends as ab
+    from paddle_tpu.audio.datasets import ESC50
+    rng = np.random.RandomState(0)
+    for fold in (1, 2):
+        for target in (0, 7):
+            sig = rng.randn(1600).astype(np.float32) * 0.1
+            ab.save(str(tmp_path / f"{fold}-1001-A-{target}.wav"),
+                    paddle.to_tensor(sig[None]), 16000)
+    train = ESC50(mode="train", split=1, data_dir=str(tmp_path))
+    dev = ESC50(mode="dev", split=1, data_dir=str(tmp_path))
+    assert len(train) == 2 and len(dev) == 2
+    feat, label = train[0]
+    assert int(label[0]) in (0, 7)
+    mel = ESC50(mode="train", split=1, data_dir=str(tmp_path),
+                feat_type="mfcc", n_mfcc=13, n_fft=256)
+    f2, _ = mel[0]
+    assert f2.shape[0] == 13
+
+
+def test_audio_tess_layout(tmp_path):
+    import paddle_tpu as paddle
+    from paddle_tpu.audio import backends as ab
+    from paddle_tpu.audio.datasets import TESS
+    rng = np.random.RandomState(1)
+    for i, emo in enumerate(["angry", "happy", "sad", "fear", "neutral"]):
+        sig = rng.randn(800).astype(np.float32) * 0.1
+        ab.save(str(tmp_path / f"OAF_word_{emo}.wav"),
+                paddle.to_tensor(sig[None]), 8000)
+    train = TESS(mode="train", n_folds=5, split=1, data_dir=str(tmp_path))
+    dev = TESS(mode="dev", n_folds=5, split=1, data_dir=str(tmp_path))
+    assert len(train) + len(dev) == 5 and len(dev) == 1
+    _, label = train[0]
+    assert 0 <= int(label[0]) < 7
+
+
+def test_audio_save_integer_input(tmp_path):
+    from paddle_tpu.audio import backends as ab
+    sig32 = (np.random.RandomState(2).randn(100) * 1e8).astype(np.int32)
+    p = str(tmp_path / "i32.wav")
+    ab.save(p, sig32, 8000)  # int32 -> 16-bit PCM re-encode
+    inf = ab.info(p)
+    assert inf.num_samples == 100 and inf.bits_per_sample == 16
+    wav, _ = ab.load(p)
+    ref = sig32.astype(np.float64) / 2**31
+    np.testing.assert_allclose(wav.numpy()[0], ref, atol=1e-3)
+
+
+def test_audio_esc50_skips_nonconforming(tmp_path):
+    import paddle_tpu as paddle
+    from paddle_tpu.audio import backends as ab
+    from paddle_tpu.audio.datasets import ESC50
+    sig = np.zeros(100, np.float32)
+    ab.save(str(tmp_path / "1-1-A-0.wav"), paddle.to_tensor(sig[None]), 8000)
+    ab.save(str(tmp_path / "esc-50-read-me.wav"),
+            paddle.to_tensor(sig[None]), 8000)
+    ds = ESC50(mode="dev", split=1, data_dir=str(tmp_path))
+    assert len(ds) == 1

@@ -1,0 +1,146 @@
+"""Per-component timing decomposition for the bench config on the real
+chip. Each component is one compiled jax program timed over K inner
+iterations via lax.scan (dispatch overhead amortized), best of 3.
+
+Usage: python tools/perf_probe.py [--h 1024 --layers 24 --b 16 --s 512]
+"""
+import argparse
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timed(fn, *args, iters=10, reps=3):
+    """fn must be jittable taking (*args); scan it iters times."""
+    @jax.jit
+    def loop(*a):
+        def body(c, _):
+            out = fn(*c)
+            # thread the first arg through to defeat CSE
+            return (out[0] if isinstance(out, tuple) else out,) + c[1:], None
+        c, _ = jax.lax.scan(body, a, None, length=iters)
+        return c[0]
+
+    r = loop(*args)
+    r.block_until_ready()
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        loop(*args).block_until_ready()
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--h", type=int, default=1024)
+    ap.add_argument("--layers", type=int, default=24)
+    ap.add_argument("--b", type=int, default=16)
+    ap.add_argument("--s", type=int, default=512)
+    ap.add_argument("--vocab", type=int, default=50304)
+    ap.add_argument("--dtype", default="bfloat16")
+    args = ap.parse_args()
+    H, L, B, S, V = args.h, args.layers, args.b, args.s, args.vocab
+    dt = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(B, S, H), dtype=dt)
+    ids = jnp.asarray(rng.randint(0, V, (B, S)))
+
+    def report(name, sec, flops=None):
+        line = f"{name:>28}: {sec*1e3:8.2f} ms"
+        if flops:
+            line += f"  ({flops/sec/1e12:6.1f} TF/s)"
+        print(line, flush=True)
+
+    # 1. pure matmul ceiling at model shapes
+    w1 = jnp.asarray(rng.randn(H, 4 * H) * 0.02, dtype=dt)
+    t = timed(lambda a: (a.reshape(B * S, H) @ w1)[:, :H].reshape(B, S, H), x)
+    report("ffn1-shaped matmul", t, 2 * B * S * H * 4 * H)
+
+    # 2. one full decoder layer fwd (attention + ffn, bf16)
+    def layer_fwd(a):
+        nh, hd = 16, H // 16
+        qkv_w = w_qkv
+        qkv = a.reshape(B * S, H) @ qkv_w
+        q, k, v = jnp.split(qkv.reshape(B, S, 3, nh, hd), 3, axis=2)
+        q, k, v = [t_.squeeze(2).transpose(0, 2, 1, 3) for t_ in (q, k, v)]
+        sc = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(hd)
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        sc = jnp.where(mask, sc, -1e9)
+        p = jax.nn.softmax(sc.astype(jnp.float32), axis=-1).astype(a.dtype)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+        o = o.transpose(0, 2, 1, 3).reshape(B * S, H) @ w_o
+        h1 = o.reshape(B, S, H) + a
+        f = jax.nn.gelu(h1.reshape(B * S, H) @ w_f1) @ w_f2
+        return h1 + f.reshape(B, S, H)
+
+    w_qkv = jnp.asarray(rng.randn(H, 3 * H) * 0.02, dtype=dt)
+    w_o = jnp.asarray(rng.randn(H, H) * 0.02, dtype=dt)
+    w_f1 = jnp.asarray(rng.randn(H, 4 * H) * 0.02, dtype=dt)
+    w_f2 = jnp.asarray(rng.randn(4 * H, H) * 0.02, dt)
+    lf = 2 * B * S * H * (3 * H + H + 8 * H) + 4 * B * 16 * S * S * (H // 16)
+    t = timed(layer_fwd, x)
+    report("decoder layer fwd", t, lf)
+
+    # 3. layer fwd+bwd
+    def layer_loss(a):
+        return layer_fwd(a).astype(jnp.float32).sum()
+    g = jax.grad(layer_loss)
+    t = timed(g, x)
+    report("decoder layer fwd+bwd", t, 3 * lf)
+    report(f"  x{L} layers fwd+bwd", t * L, 3 * lf * L)
+
+    # 4. head + cross entropy fwd+bwd
+    w_head = jnp.asarray(rng.randn(H, V) * 0.02, dtype=jnp.float32)
+
+    def head_loss(a, w):
+        logits = a.astype(jnp.float32).reshape(B * S, H) @ w
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, ids.reshape(-1, 1), axis=1)[:, 0]
+        return (lse - gold).mean()
+
+    gh = jax.grad(head_loss, argnums=(0, 1))
+    t = timed(lambda a, w: gh(a, w)[0], x, w_head)
+    report("head+CE fwd+bwd", t, 6 * B * S * H * V)
+
+    # 5. embedding gather fwd + scatter bwd
+    emb = jnp.asarray(rng.randn(V, H) * 0.02, dtype=jnp.float32)
+
+    def emb_loss(e):
+        return e[ids].astype(jnp.float32).sum()
+    t = timed(jax.grad(emb_loss), emb)
+    report("embedding fwd+scatter-bwd", t)
+
+    # 6. AdamW update sweep over ~350M params
+    n = L * 12 * H * H + 2 * V * H
+    p1 = jnp.asarray(rng.randn(n // 1000, 1000) * 0.02, dtype=jnp.float32)
+    m1 = jnp.zeros_like(p1)
+    v1 = jnp.zeros_like(p1)
+    gr = jnp.asarray(rng.randn(n // 1000, 1000) * 0.001, jnp.float32)
+
+    def adamw(p, m, v):
+        m2 = 0.9 * m + 0.1 * gr
+        v2 = 0.999 * v + 0.001 * gr * gr
+        up = m2 / (jnp.sqrt(v2) + 1e-8) + 0.01 * p
+        return p - 1e-4 * up, m2, v2
+
+    @jax.jit
+    def adamw_loop(p, m, v):
+        def body(c, _):
+            return adamw(*c), None
+        c, _ = jax.lax.scan(body, (p, m, v), None, length=10)
+        return c[0]
+    r = adamw_loop(p1, m1, v1); r.block_until_ready()
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        adamw_loop(p1, m1, v1).block_until_ready()
+        best = min(best, (time.perf_counter() - t0) / 10)
+    report(f"AdamW sweep {n/1e6:.0f}M params", best)
+
+
+if __name__ == "__main__":
+    main()
