@@ -12,7 +12,9 @@ final loss fetch, and the best of several windows is reported: the runtime
 tunnel on this host adds multi-ms, high-variance per-dispatch overhead
 that would otherwise dominate the measurement.
 
-Usage: python bench.py [--smoke] [--config small|medium]
+Usage: python bench.py [--smoke] [--config small|medium|large]
+       [--batch N] [--moment-dtype float32|bfloat16]
+       [--recompute full|dots|none] [--steps K] [--windows W] [--no-amp]
 """
 import argparse
 import json
@@ -28,7 +30,14 @@ def main():
     ap.add_argument("--smoke", action="store_true",
                     help="tiny config on CPU for CI/verify")
     ap.add_argument("--config", default="medium",
-                    choices=["small", "medium"])
+                    choices=["small", "medium", "large"])
+    ap.add_argument("--batch", type=int, default=0,
+                    help="override batch size (0 = config default)")
+    ap.add_argument("--moment-dtype", default=None,
+                    choices=["float32", "bfloat16"])
+    ap.add_argument("--recompute", default=None,
+                    choices=["full", "dots", "none"],
+                    help="stacked-decoder recompute policy (large config)")
     ap.add_argument("--steps", type=int, default=10,
                     help="steps per compiled window")
     ap.add_argument("--windows", type=int, default=3)
@@ -45,7 +54,8 @@ def main():
     import paddle_tpu as paddle
     from paddle_tpu.jit import TrainStep
     from paddle_tpu.models import (GPTForCausalLM, GPTPretrainingCriterion,
-                                   gpt_tiny, gpt2_medium, gpt2_small)
+                                   gpt_tiny, gpt2_large, gpt2_medium,
+                                   gpt2_small)
 
     paddle.seed(0)
     if args.smoke:
@@ -56,15 +66,30 @@ def main():
         cfg = gpt2_small(max_seq_len=512)
         batch, seq = 8, 512
         metric = "gpt2s_train_tokens_per_sec"
+    elif args.config == "large":
+        # 774M: stacked scan decoder; at b=8 s=1024 only full recompute +
+        # bf16 optimizer moments fit the 15.75 GB chip ("dots" saves ~7.5GB
+        # of matmul outputs across 36 layers and OOMs). Measured 25.5% MFU
+        # vs medium's 30.6% — the +33% recompute FLOPs outweigh the better
+        # H=1280 matmul shapes, which is why medium stays the default.
+        cfg = gpt2_large(stacked=True,
+                         recompute=args.recompute or "full")
+        batch, seq = 8, 1024
+        metric = "gpt2l_train_tokens_per_sec"
+        if args.moment_dtype is None:
+            args.moment_dtype = "bfloat16"
     else:
         cfg = gpt2_medium(max_seq_len=512)
         batch, seq = 16, 512
         metric = "gpt2m_train_tokens_per_sec"
+    if args.batch:
+        batch = args.batch
 
     model = GPTForCausalLM(cfg)
     crit = GPTPretrainingCriterion()
     opt = paddle.optimizer.AdamW(learning_rate=1e-4,
-                                 parameters=model.parameters())
+                                 parameters=model.parameters(),
+                                 moment_dtype=args.moment_dtype or "float32")
     amp_level = None if (args.smoke or args.no_amp) else "O1"
     step = TrainStep(model, lambda out, y: crit(out, y), opt,
                      amp_level=amp_level)

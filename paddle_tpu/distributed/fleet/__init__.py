@@ -15,3 +15,4 @@ class UserDefinedRoleMaker:
     def __init__(self, *a, **k):
         pass
 from . import elastic  # noqa: F401
+from . import utils  # noqa: F401

@@ -1,0 +1,223 @@
+"""fleet.utils — filesystem helpers, recompute, DP grad fusion.
+
+Reference: python/paddle/distributed/fleet/utils/{fs.py (LocalFS:113,
+HDFSClient:424), hybrid_parallel_util.py (fused_allreduce_gradients:211),
+__init__.py recompute:30}. TPU-native: recompute is jax.checkpoint on the
+traced segment; fused DP grad sync is a single batched all_reduce sweep
+(XLA fuses it; the reference's Reducer bucketing exists to overlap NCCL,
+which GSPMD handles inside compiled steps).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+
+import numpy as _np
+
+__all__ = ["LocalFS", "HDFSClient", "recompute", "recompute_sequential",
+           "fused_allreduce_gradients"]
+
+
+class ExecuteError(Exception):
+    pass
+
+
+class FSFileExistsError(Exception):
+    pass
+
+
+class FSFileNotExistsError(Exception):
+    pass
+
+
+class LocalFS:
+    """Local filesystem with the reference FS interface (fs.py:113)."""
+
+    def ls_dir(self, fs_path):
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for e in os.listdir(fs_path):
+            (dirs if os.path.isdir(os.path.join(fs_path, e))
+             else files).append(e)
+        return dirs, files
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def delete(self, fs_path):
+        if self.is_file(fs_path):
+            os.remove(fs_path)
+        elif self.is_dir(fs_path):
+            shutil.rmtree(fs_path)
+
+    def rename(self, fs_src_path, fs_dst_path):
+        os.rename(fs_src_path, fs_dst_path)
+
+    def need_upload_download(self):
+        return False
+
+    def upload(self, local_path, fs_path):
+        if not self.is_exist(local_path):
+            raise FSFileNotExistsError(local_path)
+        shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        if not self.is_exist(fs_path):
+            raise FSFileNotExistsError(fs_path)
+        shutil.copy(fs_path, local_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if exist_ok:
+                return
+            raise FSFileExistsError(fs_path)
+        open(fs_path, "a").close()
+
+    def mv(self, src_path, dst_path, overwrite=False, test_exists=False):
+        if not self.is_exist(src_path):
+            raise FSFileNotExistsError(src_path)
+        if not overwrite and self.is_exist(dst_path):
+            raise FSFileExistsError(dst_path)
+        shutil.move(src_path, dst_path)
+
+    def list_dirs(self, fs_path):
+        if not self.is_exist(fs_path):
+            return []
+        return [e for e in os.listdir(fs_path)
+                if os.path.isdir(os.path.join(fs_path, e))]
+
+    def cat(self, fs_path=None):
+        with open(fs_path, "rb") as f:
+            return f.read().decode()
+
+
+class HDFSClient:
+    """HDFS interface placeholder: requires a hadoop client binary the
+    reference shells out to (fs.py:424); not available here."""
+
+    def __init__(self, hadoop_home=None, configs=None, *a, **kw):
+        raise RuntimeError(
+            "HDFSClient needs a local hadoop installation (the reference "
+            "shells out to `hadoop fs`); none exists in this environment. "
+            "Use LocalFS, or mount the data locally.")
+
+
+def recompute(function, *args, **kwargs):
+    """Activation-recompute wrapper (reference recompute.py:330): inside
+    traced/jit execution the segment is wrapped in jax.checkpoint; eager
+    calls just run the function (eager autograd stores activations
+    per-op, there is nothing to discard ahead of time)."""
+    import jax
+
+    from ....core.tensor import Tensor
+
+    preserve = kwargs.pop("preserve_rng_state", True)  # noqa: F841
+    use_reentrant = kwargs.pop("use_reentrant", True)  # noqa: F841
+
+    def _traced(v):
+        return isinstance(v, jax.core.Tracer) or (
+            isinstance(v, Tensor) and isinstance(v._data, jax.core.Tracer))
+
+    if not any(_traced(v) for v in list(args) + list(kwargs.values())):
+        # eager: per-op autograd stores activations anyway, just run it
+        return function(*args, **kwargs)
+
+    # Tensor is not a jax pytree: pass raw arrays through checkpoint and
+    # rewrap at the boundary so the segment sees Tensors again. Only
+    # array leaves (positional or keyword) become checkpoint operands;
+    # everything else (flags, scalars, strings) is closed over as a
+    # static — a bool operand would become a tracer and break `if flag:`
+    # control flow inside the segment.
+    def _arrayish(v):
+        return isinstance(v, (Tensor, jax.Array, jax.core.Tracer,
+                              _np.ndarray))
+    arr_pos = [i for i, v in enumerate(args) if _arrayish(v)]
+    arr_keys = [k for k, v in kwargs.items() if _arrayish(v)]
+    leaves = [args[i] for i in arr_pos] + [kwargs[k] for k in arr_keys]
+    out_meta = []
+
+    def seg(*raw):
+        pos = list(args)
+        for j, i in enumerate(arr_pos):
+            pos[i] = (Tensor(raw[j]) if isinstance(args[i], Tensor)
+                      else raw[j])
+        kw = dict(kwargs)
+        for j, k in enumerate(arr_keys):
+            r = raw[len(arr_pos) + j]
+            kw[k] = Tensor(r) if isinstance(kwargs[k], Tensor) else r
+        out = function(*pos, **kw)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        out_meta[:] = [(isinstance(out, (tuple, list)),
+                        [isinstance(o, Tensor) for o in outs])]
+        return tuple(o._data if isinstance(o, Tensor) else o for o in outs)
+
+    raw = [v._data if isinstance(v, Tensor) else v for v in leaves]
+    res = jax.checkpoint(seg)(*raw)
+    is_seq, tensor_flags = out_meta[0]
+    wrapped = tuple(Tensor(r) if f else r
+                    for r, f in zip(res, tensor_flags))
+    return wrapped if is_seq else wrapped[0]
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Segmented sequential recompute (reference recompute.py:454):
+    splits a Sequential into `segments` chunks, recomputing each."""
+    segments = max((ctx or {}).get("segments", 1), 1)
+    fns = list(functions)
+    seg_len = max(-(-len(fns) // segments), 1)   # ceil: exactly `segments`
+    out = args
+    for i in range(0, len(fns), seg_len):
+        chunk = fns[i:i + seg_len]
+
+        def seg(*a, _chunk=chunk):
+            for f in _chunk:
+                a = (f(*a),)
+            return a[0]
+        out = (recompute(seg, *out if isinstance(out, tuple) else (out,),
+                         **kwargs),)
+    return out[0]
+
+
+def fused_allreduce_gradients(parameter_list, hcg=None):
+    """Sum-allreduce every parameter gradient over the data-parallel
+    group in one sweep (reference hybrid_parallel_util.py:211, used by PP
+    to sync grads after the microbatch loop). With an hcg the reduction
+    stays inside the dp group — never across tp/pp ranks — and grads are
+    AVERAGED over the group, matching the reference's 1/nranks scaling
+    around its sum-allreduce (_apply_collective_grads)."""
+    from ...communication.collective import ReduceOp, all_reduce
+
+    group = hcg.get_data_parallel_group() if hcg is not None else None
+    if group is not None and group.nranks <= 1:
+        return
+    pairs = [(p, p.grad) for p in parameter_list
+             if getattr(p, "grad", None) is not None]
+    if not pairs:
+        return
+    import jax.numpy as jnp
+
+    from ....core.tensor import Tensor
+
+    # one fused sweep per dtype: flatten+concat, one collective, split
+    by_dtype = {}
+    for p, g in pairs:
+        by_dtype.setdefault(str(g._data.dtype), []).append((p, g))
+    for grp in by_dtype.values():
+        flat = Tensor(jnp.concatenate([g._data.reshape(-1)
+                                       for _, g in grp]))
+        all_reduce(flat, op=ReduceOp.AVG, group=group)
+        off = 0
+        for _, g in grp:
+            n = int(_np.prod(g._data.shape)) if g._data.shape else 1
+            g._data = flat._data[off:off + n].reshape(g._data.shape)
+            off += n

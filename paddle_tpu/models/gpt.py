@@ -39,6 +39,7 @@ from ..nn.layer.layers import Layer
 from ..nn.layer.norm import LayerNorm
 
 __all__ = [
+    "gpt2_large",
     "GPTConfig", "GPTModel", "GPTForCausalLM", "GPTPretrainingCriterion",
     "gpt_tiny", "gpt2_small", "gpt2_medium", "gpt3_1p3b",
 ]
@@ -98,6 +99,13 @@ def gpt2_small(**kw) -> GPTConfig:
 def gpt2_medium(**kw) -> GPTConfig:
     d = dict(vocab_size=50304, hidden_size=1024, num_layers=24,
              num_heads=16, max_seq_len=1024)
+    d.update(kw)
+    return GPTConfig(**d)
+
+
+def gpt2_large(**kw) -> GPTConfig:
+    d = dict(vocab_size=50304, hidden_size=1280, num_layers=36,
+             num_heads=20, max_seq_len=1024)
     d.update(kw)
     return GPTConfig(**d)
 

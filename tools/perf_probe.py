@@ -19,8 +19,11 @@ def timed(fn, *args, iters=10, reps=3):
     def loop(*a):
         def body(c, _):
             out = fn(*c)
-            # thread the first arg through to defeat CSE
-            return (out[0] if isinstance(out, tuple) else out,) + c[1:], None
+            first = out[0] if isinstance(out, tuple) else out
+            # thread the first arg through to defeat CSE (cast/reshape in
+            # case fn returns a different dtype/shape, e.g. grads)
+            return (first.astype(c[0].dtype).reshape(c[0].shape),) + c[1:], \
+                None
         c, _ = jax.lax.scan(body, a, None, length=iters)
         return c[0]
 
@@ -32,6 +35,13 @@ def timed(fn, *args, iters=10, reps=3):
         loop(*args).block_until_ready()
         best = min(best, (time.perf_counter() - t0) / iters)
     return best
+
+
+def report(name, sec, flops=None):
+    line = f"{name:>34}: {sec*1e3:8.2f} ms"
+    if flops:
+        line += f"  ({flops/sec/1e12:6.1f} TF/s)"
+    print(line, flush=True)
 
 
 def main():
@@ -48,12 +58,6 @@ def main():
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.randn(B, S, H), dtype=dt)
     ids = jnp.asarray(rng.randint(0, V, (B, S)))
-
-    def report(name, sec, flops=None):
-        line = f"{name:>28}: {sec*1e3:8.2f} ms"
-        if flops:
-            line += f"  ({flops/sec/1e12:6.1f} TF/s)"
-        print(line, flush=True)
 
     # 1. pure matmul ceiling at model shapes
     w1 = jnp.asarray(rng.randn(H, 4 * H) * 0.02, dtype=dt)

@@ -1,0 +1,95 @@
+"""Second-stage probe: where do the 11ms of decoder layer fwd+bwd go?
+
+Components at bench shapes (b=16 s=512 h=1024 nh=16):
+  - FFN only (2 matmuls + gelu) fwd+bwd
+  - qkv/proj matmuls only fwd+bwd
+  - dense attention core (einsum + f32 softmax) fwd+bwd
+  - flash-fwd + XLA-recompute-bwd attention core (the model's path)
+  - layernorm x2 fwd+bwd
+Run: python -u tools/perf_probe2.py
+"""
+import os
+import sys
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_here))   # repo root (paddle_tpu)
+sys.path.insert(0, _here)                    # tools/ (perf_probe helpers)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from perf_probe import report, timed  # shared scan-timing harness
+
+B, S, H, NH = 16, 512, 1024, 16
+HD = H // NH
+DT = jnp.bfloat16
+
+
+def main():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(B, S, H), dtype=DT)
+    w_f1 = jnp.asarray(rng.randn(H, 4 * H) * 0.02, DT)
+    w_f2 = jnp.asarray(rng.randn(4 * H, H) * 0.02, DT)
+    w_qkv = jnp.asarray(rng.randn(H, 3 * H) * 0.02, DT)
+    w_o = jnp.asarray(rng.randn(H, H) * 0.02, DT)
+    q = jnp.asarray(rng.randn(B, NH, S, HD), DT)
+    k = jnp.asarray(rng.randn(B, NH, S, HD), DT)
+    v = jnp.asarray(rng.randn(B, NH, S, HD), DT)
+
+    # FFN fwd+bwd
+    def ffn(a):
+        f = jax.nn.gelu(a.reshape(B * S, H) @ w_f1) @ w_f2
+        return f.astype(jnp.float32).sum()
+    fl = 2 * B * S * 8 * H * H
+    t = timed(jax.grad(ffn), x)
+    report("FFN (8H^2) fwd+bwd", t, 3 * fl)
+
+    # qkv + proj matmuls fwd+bwd
+    def qkvp(a):
+        z = a.reshape(B * S, H) @ w_qkv
+        o = z[:, :H] @ w_o
+        return o.astype(jnp.float32).sum()
+    fl = 2 * B * S * 4 * H * H
+    t = timed(jax.grad(qkvp), x)
+    report("qkv+proj (4H^2) fwd+bwd", t, 3 * fl)
+
+    # dense attention core fwd+bwd (f32 softmax like the module path)
+    def dense_attn(qq, kk, vv):
+        sc = jnp.einsum("bhqd,bhkd->bhqk", qq, kk) / np.sqrt(HD)
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        sc = jnp.where(mask, sc, -1e9)
+        p = jax.nn.softmax(sc.astype(jnp.float32), -1).astype(qq.dtype)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, vv).astype(jnp.float32).sum()
+    fl = 4 * B * NH * S * S * HD
+    t = timed(jax.grad(dense_attn, argnums=(0, 1, 2)), q, k, v)
+    report("dense attn core fwd+bwd", t, 3 * fl)
+
+    # Pallas flash kernels, fwd + dq/dkv bwd (custom_vjp)
+    from paddle_tpu.ops.pallas_attention import mha
+
+    def flash_loss(qq, kk, vv):
+        return mha(qq, kk, vv, True, 1.0 / np.sqrt(HD), 128,
+                   128).astype(jnp.float32).sum()
+    try:
+        t = timed(jax.grad(flash_loss, argnums=(0, 1, 2)), q, k, v)
+        report("flash attn core fwd+bwd", t, 3 * fl)
+    except Exception as e:
+        print("flash probe unavailable:", type(e).__name__, str(e)[:160])
+
+    # layernorm pair fwd+bwd
+    g = jnp.ones((H,), jnp.float32)
+
+    def lns(a):
+        af = a.astype(jnp.float32)
+        y = (af - af.mean(-1, keepdims=True)) / jnp.sqrt(
+            af.var(-1, keepdims=True) + 1e-5) * g
+        z = (y.astype(a.dtype).astype(jnp.float32)
+             - y.mean(-1, keepdims=True)) * g
+        return z.sum()
+    t = timed(jax.grad(lns), x)
+    report("2x layernorm fwd+bwd", t)
+
+
+if __name__ == "__main__":
+    main()
