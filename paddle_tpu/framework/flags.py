@@ -84,3 +84,7 @@ define_flag("FLAGS_new_executor_serial_run", False, "run static programs op-seri
 define_flag("FLAGS_enable_pir_api", False, "compat no-op")
 define_flag("FLAGS_log_memory_stats", False, "log live/peak buffer stats on allocation")
 define_flag("FLAGS_tpu_matmul_precision", "default", "jax matmul precision: default|high|highest")
+define_flag("FLAGS_flash_min_seqlen", 2048,
+            "below this query length attention uses the XLA softmax path "
+            "(faster end-to-end, PERF.md); the Pallas flash kernel kicks "
+            "in at/above it where O(S^2) memory stops fitting")

@@ -23,6 +23,22 @@ def _on_tpu() -> bool:
         return False
 
 
+def preferred(q, k, v, mask, causal) -> bool:
+    """supported() AND long enough that the kernel beats XLA attention.
+
+    Below FLAGS_flash_min_seqlen (default 2048, framework/flags.py) the
+    XLA softmax path wins end-to-end on this chip (measured, PERF.md:
+    gpt2-medium s=512 trains at 40.8% vs 30.6% MFU, s=1024 at 33.2% vs
+    24.3%); the kernel's O(S) memory only pays for itself once the
+    sq*sk materialization stops fitting HBM (dense s=2048 b=4 OOMs) —
+    hence the gate uses the longer of the two sequence lengths."""
+    if not supported(q, k, v, mask, causal):
+        return False
+    from ..framework.flags import flag_value
+    return max(q.shape[1], k.shape[1]) >= int(
+        flag_value("FLAGS_flash_min_seqlen"))
+
+
 def supported(q, k, v, mask, causal) -> bool:
     if mask is not None:
         return False

@@ -101,3 +101,21 @@ def test_lse_residual_shape():
     # lanes are replicated copies of the row statistic
     np.testing.assert_allclose(np.asarray(lse[:, :, 0]),
                                np.asarray(lse[:, :, 64]), rtol=0, atol=0)
+
+
+def test_preferred_gates_by_seq_length(monkeypatch):
+    # measured policy (PERF.md): XLA softmax path below FLAGS_flash_min_seqlen,
+    # Pallas kernel at/above it — preferred() implements the routing
+    from paddle_tpu.ops import flash_attention as fa
+    import paddle_tpu
+
+    monkeypatch.setattr(fa, "_on_tpu", lambda: True)
+    mk = lambda s: jnp.zeros((2, s, 4, 64), jnp.bfloat16)
+    assert fa.supported(mk(512), mk(512), mk(512), None, True)
+    assert not fa.preferred(mk(512), mk(512), mk(512), None, True)
+    assert fa.preferred(mk(2048), mk(2048), mk(2048), None, True)
+    paddle_tpu.set_flags({"FLAGS_flash_min_seqlen": 512})
+    try:
+        assert fa.preferred(mk(512), mk(512), mk(512), None, True)
+    finally:
+        paddle_tpu.set_flags({"FLAGS_flash_min_seqlen": 2048})
