@@ -1,22 +1,91 @@
 """Fused layers (reference: python/paddle/incubate/nn/layer/fused_transformer.py).
 On TPU, 'fused' is what XLA does to the plain layers; these classes preserve
-the API and route to the standard implementations + Pallas attention.
+the reference API (pre/post layer-norm, activation choice, the two dropout
+sites) and route the compute to the standard implementations, which XLA
+fuses into the surrounding matmuls.
 """
 from ...nn.layer.transformer import (  # noqa: F401
     TransformerEncoderLayer as FusedTransformerEncoderLayer,
 )
 from ...nn.layer.transformer import MultiHeadAttention as FusedMultiHeadAttention  # noqa: F401
 
-
-class FusedFeedForward:
-    def __new__(cls, d_model, dim_feedforward, dropout_rate=0.1, **kw):
-        from ...nn import Dropout, Linear, Sequential, ReLU
-        return Sequential(Linear(d_model, dim_feedforward), ReLU(),
-                          Dropout(dropout_rate),
-                          Linear(dim_feedforward, d_model))
+from ...nn.layer.layers import Layer
 
 
-class FusedLinear:
-    def __new__(cls, in_features, out_features, **kw):
-        from ...nn import Linear
-        return Linear(in_features, out_features)
+class FusedFeedForward(Layer):
+    """Transformer FFN block with residual + layer-norm, matching the
+    reference FusedFeedForward semantics (fused_transformer.py:391):
+    pre-LN normalizes the input, post-LN normalizes after the residual;
+    dropout after the activation and after the second projection."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        from ...nn import Dropout, LayerNorm, Linear
+        from ...nn import functional as F
+
+        self.normalize_before = normalize_before
+        self._act = getattr(F, activation)
+        act_dropout_rate = (dropout_rate if act_dropout_rate is None
+                            else act_dropout_rate)
+        self.linear1 = Linear(d_model, dim_feedforward,
+                              weight_attr=linear1_weight_attr,
+                              bias_attr=linear1_bias_attr)
+        self.linear2 = Linear(dim_feedforward, d_model,
+                              weight_attr=linear2_weight_attr,
+                              bias_attr=linear2_bias_attr)
+        self.dropout1 = Dropout(act_dropout_rate)
+        self.dropout2 = Dropout(dropout_rate)
+        # pre-LN uses ln1 attrs, post-LN uses ln2 attrs (only one norm is
+        # ever applied — reference fused_feedforward semantics)
+        scale_attr = ln1_scale_attr if normalize_before else ln2_scale_attr
+        bias_attr = ln1_bias_attr if normalize_before else ln2_bias_attr
+        self.norm = LayerNorm(d_model, epsilon=epsilon,
+                              weight_attr=scale_attr, bias_attr=bias_attr)
+
+    def forward(self, src):
+        residual = src
+        if self.normalize_before:
+            src = self.norm(src)
+        src = self.dropout1(self._act(self.linear1(src)))
+        out = residual + self.dropout2(self.linear2(src))
+        if not self.normalize_before:
+            out = self.norm(out)
+        return out
+
+
+from ...nn import Linear as _Linear
+
+
+class FusedLinear(_Linear):
+    """Subclasses Linear so state_dict keys stay 'weight'/'bias'
+    (checkpoint-compatible with the reference and with plain Linear).
+    transpose_weight stores the weight as [out, in] and transposes in
+    the matmul, matching the reference's fused_linear option."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        if transpose_weight:
+            from ...nn.layer.layers import Layer as _L
+            _L.__init__(self)
+            from ...nn.layer.common import create_parameter_with_attr
+            self.weight = create_parameter_with_attr(
+                [out_features, in_features], self._dtype, weight_attr,
+                False)
+            self.bias = create_parameter_with_attr(
+                [out_features], self._dtype, bias_attr, True)
+        else:
+            super().__init__(in_features, out_features,
+                             weight_attr=weight_attr, bias_attr=bias_attr)
+        self._transpose_weight = transpose_weight
+
+    def forward(self, x):
+        if self._transpose_weight:
+            from ...nn import functional as F
+            return F.linear(x, self.weight.t(), self.bias)
+        return super().forward(x)

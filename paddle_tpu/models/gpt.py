@@ -189,7 +189,11 @@ class GPTMLP(Layer):
         self.dropout = Dropout(config.dropout)
 
     def forward(self, x):
-        return self.dropout(self.fc_out(F.gelu(self.fc_in(x))))
+        # tanh-approximate gelu: GPT-2's canonical "gelu_new", and the
+        # same form the stacked decoder uses (keeps the two paths
+        # numerically consistent)
+        return self.dropout(self.fc_out(F.gelu(self.fc_in(x),
+                                               approximate=True)))
 
 
 class GPTDecoderLayer(Layer):

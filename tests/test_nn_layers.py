@@ -256,3 +256,59 @@ class TestTraining:
             opt.clear_grad()
         pred = net(t(X)).numpy().argmax(1)
         assert (pred == Y).all(), pred
+
+
+class TestIncubateFused:
+    """incubate.nn Fused* layers keep the reference API surface
+    (fused_transformer.py) while routing compute to plain layers."""
+
+    def test_fused_feedforward_pre_ln_matches_manual(self):
+        from paddle_tpu.incubate.nn import FusedFeedForward
+        paddle.seed(0)
+        ff = FusedFeedForward(8, 32, dropout_rate=0.0, activation="gelu",
+                              normalize_before=True)
+        x = t(np.random.RandomState(0).randn(2, 4, 8).astype("float32"))
+        manual = x + ff.linear2(F.gelu(ff.linear1(ff.norm(x))))
+        np.testing.assert_allclose(ff(x).numpy(), manual.numpy(),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_fused_feedforward_post_ln(self):
+        from paddle_tpu.incubate.nn import FusedFeedForward
+        paddle.seed(0)
+        ff = FusedFeedForward(8, 16, dropout_rate=0.0,
+                              normalize_before=False)
+        x = t(np.ones((2, 3, 8), "float32"))
+        manual = ff.norm(x + ff.linear2(F.relu(ff.linear1(x))))
+        np.testing.assert_allclose(ff(x).numpy(), manual.numpy(),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_fused_linear_trains(self):
+        from paddle_tpu.incubate.nn import FusedLinear
+        paddle.seed(0)
+        fl = FusedLinear(4, 2)
+        x = t(np.ones((3, 4), "float32"))
+        fl(x).sum().backward()
+        assert fl.weight.grad is not None
+        assert fl(x).shape == [3, 2]
+        # checkpoint keys match plain Linear (no wrapper prefix)
+        assert set(fl.state_dict().keys()) == {"weight", "bias"}
+
+    def test_fused_linear_transpose_weight(self):
+        from paddle_tpu.incubate.nn import FusedLinear
+        paddle.seed(0)
+        fl = FusedLinear(4, 2, transpose_weight=True)
+        assert fl.weight.shape == [2, 4]
+        x = t(np.random.RandomState(0).randn(3, 4).astype("float32"))
+        ref = x.numpy() @ fl.weight.numpy().T + fl.bias.numpy()
+        np.testing.assert_allclose(fl(x).numpy(), ref, rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_fused_feedforward_ln_attrs_honored(self):
+        from paddle_tpu.incubate.nn import FusedFeedForward
+        from paddle_tpu import ParamAttr
+        from paddle_tpu.nn.initializer import Constant
+        ff = FusedFeedForward(
+            8, 16, dropout_rate=0.0, normalize_before=True,
+            ln1_scale_attr=ParamAttr(initializer=Constant(2.0)))
+        np.testing.assert_allclose(ff.norm.weight.numpy(),
+                                   np.full(8, 2.0, "float32"))
