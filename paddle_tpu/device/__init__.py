@@ -77,6 +77,49 @@ def stream_guard(stream):
     return _guard()
 
 
+class CUDAGraph:
+    """Compat for paddle.device.cuda.graphs.CUDAGraph
+    (reference device/cuda/graphs.py:43). On TPU the compiled XLA
+    executable IS the captured-and-replayable graph — every jitted call
+    replays a cached executable — so capture/replay are no-ops that
+    preserve the call protocol (SURVEY §2.6: 'expose as no-op compat')."""
+
+    def __init__(self, place=None, mode="thread_local"):
+        self._captured = False
+
+    def capture_begin(self):
+        self._captured = True
+
+    def capture_end(self):
+        pass
+
+    def replay(self):
+        if not self._captured:
+            raise RuntimeError("CUDAGraph.replay() before capture")
+
+    def reset(self):
+        self._captured = False
+
+    def print_to_dot_files(self, dirname, flags=None):
+        pass
+
+
+def wrap_cuda_graph(function, mode="thread_local", memory_pool="default"):
+    """Reference wraps a layer for graph capture; under XLA the jit cache
+    already provides capture-once-replay-many, so the callable is
+    returned unchanged."""
+    return function
+
+
+def is_cuda_graph_supported():
+    return False
+
+
+graphs = _types.SimpleNamespace(
+    CUDAGraph=CUDAGraph, wrap_cuda_graph=wrap_cuda_graph,
+    is_cuda_graph_supported=is_cuda_graph_supported)
+
+
 def _mem_stats():
     import jax
     try:
@@ -97,6 +140,7 @@ cuda = _types.SimpleNamespace(
     empty_cache=empty_cache,
     get_device_properties=lambda *a: _types.SimpleNamespace(
         name="TPU", total_memory=_mem_stats().get("bytes_limit", 0)),
+    graphs=graphs,
 )
 
 tpu = cuda

@@ -15,7 +15,10 @@ import shutil
 import numpy as _np
 
 __all__ = ["LocalFS", "HDFSClient", "recompute", "recompute_sequential",
-           "fused_allreduce_gradients"]
+           "fused_allreduce_gradients", "HybridParallelInferenceHelper"]
+
+from .hybrid_parallel_inference import HybridParallelInferenceHelper  # noqa: F401,E402
+from . import tensor_parallel_utils  # noqa: F401,E402
 
 
 class ExecuteError(Exception):

@@ -1,0 +1,71 @@
+"""Tensor-parallel checkpoint conversion utilities.
+
+Reference: python/paddle/distributed/fleet/utils/tensor_parallel_utils.py
+(parameter conversion between tensor-parallel degrees). TPU-native: a
+state_dict trained at one mp degree is resharded to another by splitting
+or concatenating each parameter along its 'mp'-annotated dimension from
+the layer's dist_spec (mp_layers.py / GPTStackedTransformer.SPECS). The
+head-major qkv layout guarantees contiguous splits are per-head, so these
+conversions are exact.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["mp_axis_of", "split_mp_state_dict", "merge_mp_state_dicts"]
+
+
+def mp_axis_of(spec, axis_name: str = "mp"):
+    """Index of the dimension sharded over ``axis_name`` in a dist_spec
+    tuple (e.g. ('pp', None, 'mp') -> 2), or None if replicated."""
+    if spec is None:
+        return None
+    for i, a in enumerate(spec):
+        if a == axis_name:
+            return i
+    return None
+
+
+def split_mp_state_dict(state_dict, specs, mp_degree: int,
+                        axis_name: str = "mp"):
+    """Split a full (mp=1) state_dict into ``mp_degree`` per-rank dicts.
+
+    ``specs`` maps param name -> dist_spec tuple; names missing from it
+    (or replicated over ``axis_name``) are shared by every rank.
+    """
+    if mp_degree < 1:
+        raise ValueError(f"mp_degree must be >= 1, got {mp_degree}")
+    shards = [dict() for _ in range(mp_degree)]
+    for name, value in state_dict.items():
+        arr = np.asarray(value.numpy() if hasattr(value, "numpy") else value)
+        dim = mp_axis_of(specs.get(name), axis_name)
+        # copies throughout: per-rank checkpoints must not alias each
+        # other or the source (np.split returns views)
+        if dim is None or mp_degree == 1:
+            for s in shards:
+                s[name] = arr.copy()
+            continue
+        if arr.shape[dim] % mp_degree != 0:
+            raise ValueError(
+                f"{name}: dim {dim} ({arr.shape[dim]}) not divisible by "
+                f"mp_degree={mp_degree}")
+        for rank, piece in enumerate(np.split(arr, mp_degree, axis=dim)):
+            shards[rank][name] = piece.copy()
+    return shards
+
+
+def merge_mp_state_dicts(shards, specs, axis_name: str = "mp"):
+    """Inverse of split_mp_state_dict: concatenate per-rank dicts back
+    into the full (mp=1) state_dict."""
+    if not shards:
+        raise ValueError("no shards given")
+    merged = {}
+    for name in shards[0]:
+        arrs = [np.asarray(s[name].numpy() if hasattr(s[name], "numpy")
+                           else s[name]) for s in shards]
+        dim = mp_axis_of(specs.get(name), axis_name)
+        if dim is None or len(shards) == 1:
+            merged[name] = arrs[0]
+        else:
+            merged[name] = np.concatenate(arrs, axis=dim)
+    return merged

@@ -415,3 +415,80 @@ class TestFleetUtils:
             fu.recompute = orig
         assert out == 6.0 and len(segs) == 2  # ceil(5/2)=3,2 → 2 segments
         assert calls == [0, 1, 2, 3, 4]       # layers run once, in order
+
+
+class TestTensorParallelUtils:
+    def test_split_merge_roundtrip_gpt_specs(self):
+        # head-major qkv layout: mp split/merge of a trained state_dict is
+        # exact for every param in the stacked decoder SPECS
+        from paddle_tpu.distributed.fleet.utils.tensor_parallel_utils import (
+            merge_mp_state_dicts, split_mp_state_dict)
+        from paddle_tpu.models.gpt import GPTStackedTransformer, gpt_tiny
+
+        paddle.seed(0)
+        m = GPTStackedTransformer(gpt_tiny(stacked=True))
+        state = {k: v.numpy() for k, v in m.state_dict().items()}
+        specs = GPTStackedTransformer.SPECS
+        shards = split_mp_state_dict(state, specs, 2)
+        assert len(shards) == 2
+        # mp-sharded dims halved, replicated params identical
+        assert shards[0]["qkv_w"].shape[-1] * 2 == state["qkv_w"].shape[-1]
+        np.testing.assert_array_equal(shards[0]["ln1_w"], state["ln1_w"])
+        merged = merge_mp_state_dicts(shards, specs)
+        for k in state:
+            np.testing.assert_array_equal(merged[k], state[k])
+
+    def test_split_indivisible_raises(self):
+        from paddle_tpu.distributed.fleet.utils.tensor_parallel_utils import (
+            split_mp_state_dict)
+        with pytest.raises(ValueError, match="not divisible"):
+            split_mp_state_dict({"w": np.ones((4, 3))}, {"w": (None, "mp")},
+                                2)
+
+
+class TestHybridParallelInference:
+    def test_greedy_generate_gpt_tiny(self):
+        from paddle_tpu.distributed.fleet.utils import (
+            HybridParallelInferenceHelper)
+        from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+
+        paddle.seed(0)
+        model = GPTForCausalLM(gpt_tiny(use_flash_attention=False))
+        helper = HybridParallelInferenceHelper(model, max_length=12)
+        prompt = np.array([[5, 7, 9]], "int64")
+        out = helper.generate(prompt, max_new_tokens=4)
+        assert out.shape == (1, 7)
+        np.testing.assert_array_equal(out[:, :3], prompt)
+        # greedy decode is deterministic
+        out2 = helper.generate(prompt, max_new_tokens=4)
+        np.testing.assert_array_equal(out, out2)
+
+    def test_cuda_graph_compat(self):
+        from paddle_tpu.device import graphs
+        g = graphs.CUDAGraph()
+        with pytest.raises(RuntimeError):
+            g.replay()
+        g.capture_begin(); g.capture_end(); g.replay(); g.reset()
+        assert graphs.wrap_cuda_graph(abs) is abs
+        assert graphs.is_cuda_graph_supported() is False
+
+
+def test_generate_prompt_too_long_raises():
+    from paddle_tpu.distributed.fleet.utils import (
+        HybridParallelInferenceHelper)
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+    helper = HybridParallelInferenceHelper(
+        GPTForCausalLM(gpt_tiny(use_flash_attention=False)), max_length=2)
+    with pytest.raises(ValueError, match="no room"):
+        helper.generate(np.array([[5, 7, 9]], "int64"), max_new_tokens=4)
+
+
+def test_split_shards_do_not_alias():
+    from paddle_tpu.distributed.fleet.utils.tensor_parallel_utils import (
+        split_mp_state_dict)
+    state = {"w": np.ones((4, 4), "float32"), "g": np.ones(4, "float32")}
+    shards = split_mp_state_dict(state, {"w": (None, "mp")}, 2)
+    shards[0]["g"] += 1.0
+    shards[0]["w"] += 1.0
+    np.testing.assert_array_equal(shards[1]["g"], np.ones(4))
+    np.testing.assert_array_equal(state["w"], np.ones((4, 4)))
