@@ -214,7 +214,8 @@ class GPTDecoderLayer(Layer):
         return _seq_constraint(x)
 
 
-def _stacked_layer_fwd(p, x, *, num_heads, head_dim, eps, mp_size, sep_size):
+def _stacked_layer_fwd(p, x, *, num_heads, head_dim, eps, mp_size, sep_size,
+                       use_flash=True):
     """ONE decoder layer, manual SPMD (runs inside shard_map).
 
     x: [mb, s_local, H] (full hidden; seq sep-sharded). Params are the local
@@ -256,10 +257,12 @@ def _stacked_layer_fwd(p, x, *, num_heads, head_dim, eps, mp_size, sep_size):
                                      axis_size=sep_size, causal=True,
                                      sm_scale=sm_scale)
     else:
-        from ..ops.pallas_attention import _mha_reference
-        attn = jnp.transpose(_mha_reference(
-            jnp.transpose(q, (0, 2, 1, 3)), jnp.transpose(k, (0, 2, 1, 3)),
-            jnp.transpose(v, (0, 2, 1, 3)), True, sm_scale), (0, 2, 1, 3))
+        # shared flash-or-dense selection (ops/flash_attention.py):
+        # long-seq Pallas kernel's O(S) memory is what lets 1.3B s=2048
+        # fit one chip (dense S^2 materialization OOMs)
+        from ..ops.flash_attention import attention_bshd
+        attn = attention_bshd(q, k, v, causal=True, scale=sm_scale,
+                              use_flash=use_flash)
     attn = attn.reshape(mb, s_loc, nh_loc * head_dim)
     o = attn @ p["out_w"]                             # partial over H/mp
     if mp_size > 1:
@@ -369,7 +372,8 @@ class GPTStackedTransformer(Layer):
             layer = functools.partial(
                 _stacked_layer_fwd, num_heads=cfg.num_heads,
                 head_dim=cfg.hidden_size // cfg.num_heads,
-                eps=cfg.layer_norm_eps, mp_size=mp, sep_size=sep)
+                eps=cfg.layer_norm_eps, mp_size=mp, sep_size=sep,
+                use_flash=cfg.use_flash_attention)
             if mesh is None or (pp == 1 and mp == 1 and sep == 1):
                 if cfg.recompute == "none":
                     wrapped = layer

@@ -59,8 +59,10 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
             from ...ops.ring_attention import ring_attention
             return ring_attention(q, k, v, mesh, seq_axis="sep",
                                   causal=is_causal)
-        if use_flash and p == 0.0 and fa.preferred(q, k, v, mask, is_causal):
-            return fa.flash_attention_bshd(q, k, v, causal=is_causal)
+        if mask is None and p == 0.0:
+            # shared flash-or-dense selection (ops/flash_attention.py)
+            return fa.attention_bshd(q, k, v, causal=is_causal,
+                                     use_flash=use_flash)
         return _sdpa_reference(q, k, v, mask, p, is_causal)
 
     if attn_mask is not None:

@@ -96,3 +96,19 @@ def flash_attention_bshd(q, k, v, causal=False, scale=None):
         bq, bk = hit if hit else (128, 128)
     out = mha(qt, kt, vt, causal=causal, sm_scale=s, block_q=bq, block_k=bk)
     return jnp.swapaxes(out, 1, 2)
+
+
+def attention_bshd(q, k, v, causal=False, scale=None, use_flash=True):
+    """THE flash-or-dense selection point for maskless attention in
+    [B,S,H,D] layout: Pallas kernel when ``use_flash`` and preferred()
+    (supported shapes AND seq >= FLAGS_flash_min_seqlen — the measured
+    win/loss boundary, PERF.md), else the XLA softmax reference. Both
+    the module attention path and the stacked SPMD decoder route here
+    so the gating can never diverge between them."""
+    if use_flash and preferred(q, k, v, None, causal):
+        return flash_attention_bshd(q, k, v, causal=causal, scale=scale)
+    from .pallas_attention import _mha_reference
+    s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    out = _mha_reference(jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                         jnp.swapaxes(v, 1, 2), causal, s)
+    return jnp.swapaxes(out, 1, 2)
