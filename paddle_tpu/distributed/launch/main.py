@@ -71,7 +71,7 @@ def launch(argv=None) -> int:
     attempts = 1 + (args.max_restarts if args.elastic_level > 0 else 0)
     rc = 1
     for attempt in range(attempts):
-        rc = _launch_once(args)
+        rc = _launch_once(args, attempt)
         if rc == 0 or args.elastic_level <= 0:
             return rc
         if attempt + 1 < attempts:
@@ -80,7 +80,7 @@ def launch(argv=None) -> int:
     return rc
 
 
-def _launch_once(args) -> int:
+def _launch_once(args, attempt: int = 0) -> int:
     nproc = args.nproc_per_node
     world = nproc * args.nnodes
     if args.nnodes > 1:
@@ -126,6 +126,13 @@ def _launch_once(args) -> int:
             "TRAINING_ROLE": "TRAINER",
             "FLAGS_selected_tpus": str(local),
         })
+        if args.elastic_level > 0:
+            # which elastic attempt this is — workers use it to decide
+            # whether to resume from checkpoint (ElasticManager.restarts).
+            # Only set when THIS launcher owns the restart loop, so an
+            # outer orchestrator's values are never clobbered.
+            env["PADDLE_ELASTIC_RESTARTS"] = str(attempt)
+            env["PADDLE_ELASTIC_LEVEL"] = str(args.elastic_level)
         if args.log_dir:
             out = open(os.path.join(args.log_dir,
                                     f"workerlog.{rank}"), "w")

@@ -1,0 +1,47 @@
+"""Worker for the elastic restart + checkpoint-resume e2e test
+(test_launch.py). Trains 6 steps, checkpointing each; on the FIRST
+attempt it crashes after step 3, and the relaunched attempt must resume
+from the checkpoint (not step 0) and finish. The reference's elastic
+manager restarts jobs the same way (manager.py:126); the TPU stance is
+job-level restart + resume (SURVEY §5.3)."""
+import os
+import sys
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import nn  # noqa: E402
+from paddle_tpu.distributed.fleet.elastic import ElasticManager  # noqa: E402
+
+out_dir = sys.argv[1]
+ckpt = os.path.join(out_dir, "state.pdparams")
+TOTAL = 6
+
+mgr = ElasticManager()
+assert mgr.enabled(), "launcher must export PADDLE_ELASTIC_LEVEL > 0"
+
+paddle.seed(0)
+model = nn.Linear(4, 4)
+opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+
+start = 0
+if mgr.restarts > 0 and os.path.exists(ckpt):
+    saved = paddle.load(ckpt)
+    model.set_state_dict(saved["model"])
+    start = int(saved["step"])
+
+x = paddle.to_tensor(np.ones((2, 4), "float32"))
+for step in range(start, TOTAL):
+    loss = (model(x) ** 2).sum()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    paddle.save({"model": model.state_dict(), "step": step + 1}, ckpt)
+    if mgr.restarts == 0 and step == 2:
+        os._exit(17)  # simulated mid-training failure on the first attempt
+
+with open(os.path.join(out_dir, "resume_info"), "w") as f:
+    f.write(f"{mgr.restarts} {start} {TOTAL}")

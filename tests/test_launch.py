@@ -103,3 +103,26 @@ def test_multiprocess_spmd_trainstep(tmp_path):
     l0 = (tmp_path / "mh_ok.0").read_text()
     l1 = (tmp_path / "mh_ok.1").read_text()
     assert l0 == l1  # both ranks observed the identical loss trajectory
+
+
+def test_elastic_restart_resumes_from_checkpoint(tmp_path):
+    """Job crashes mid-training on attempt 0; --elastic_level 1 relaunches
+    it, the worker resumes from its checkpoint (not step 0) and finishes
+    — the TPU elastic stance (SURVEY §5.3) end-to-end."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    for k in ("PADDLE_TRAINER_ID", "PADDLE_TRAINERS_NUM",
+              "PADDLE_ELASTIC_LEVEL", "PADDLE_ELASTIC_RESTARTS"):
+        env.pop(k, None)
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "1", "--elastic_level", "1",
+         "--max_restarts", "2",
+         os.path.join(REPO, "tests", "elastic_worker.py"), str(tmp_path)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    assert "elastic: job failed" in r.stderr
+    restarts, start, total = (tmp_path / "resume_info").read_text().split()
+    assert restarts == "1"      # finished on the second attempt
+    assert start == "3"         # resumed at the checkpointed step, not 0
+    assert total == "6"
