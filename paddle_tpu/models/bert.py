@@ -134,7 +134,9 @@ class BertLayer(Layer):
 
     def forward(self, x, attn_mask=None):
         x = self.ln1(x + self.dropout(self.attn(x, attn_mask)))
-        h = self.fc_out(F.gelu(self.fc_in(x)))
+        # tanh-approximate gelu — what original BERT ships; measured +12%
+        # step throughput vs the erf form on this model (PERF.md table)
+        h = self.fc_out(F.gelu(self.fc_in(x), approximate=True))
         return self.ln2(x + self.dropout(h))
 
 
@@ -179,7 +181,7 @@ class BertForPretraining(Layer):
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):
         seq_out, pooled = self.bert(input_ids, token_type_ids,
                                     attention_mask)
-        h = self.transform_ln(F.gelu(self.transform(seq_out)))
+        h = self.transform_ln(F.gelu(self.transform(seq_out), approximate=True))
         from ..tensor import linalg
         w = self.bert.embeddings.word_embeddings.weight
         mlm_logits = linalg.matmul(h, w, transpose_y=True)
