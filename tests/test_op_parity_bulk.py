@@ -1,0 +1,196 @@
+"""Table-driven OpTest parity sweep — extends tests/test_op_parity.py's
+per-class pattern to bulk coverage of the functional op surface
+(reference: unittests' one-file-per-op OpTest farm, SURVEY §4.1).
+
+Each CASES row: (name, op, inputs dict, numpy oracle, options).
+Options: grad=False skips the finite-difference check (non-smooth or
+integer ops), attrs passes keyword attrs, tol overrides atol/rtol.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+from op_test import OpTest
+
+
+def _r(seed, shape=(3, 4), lo=-1.0, hi=1.0):
+    rng = np.random.RandomState(seed)
+    return (rng.uniform(lo, hi, shape)).astype("float32")
+
+
+def _pos(seed, shape=(3, 4)):
+    return _r(seed, shape, 0.1, 2.0)
+
+
+CASES = [
+    # ---- unary math (smooth: grad-checked) ----
+    ("sin", paddle.sin, {"x": _r(1)}, np.sin, {}),
+    ("cos", paddle.cos, {"x": _r(2)}, np.cos, {}),
+    ("tan", paddle.tan, {"x": _r(3, lo=-0.5, hi=0.5)}, np.tan, {}),
+    ("asin", paddle.asin, {"x": _r(4, lo=-0.8, hi=0.8)}, np.arcsin, {}),
+    ("acos", paddle.acos, {"x": _r(5, lo=-0.8, hi=0.8)}, np.arccos, {}),
+    ("atan", paddle.atan, {"x": _r(6)}, np.arctan, {}),
+    ("sinh", paddle.sinh, {"x": _r(7)}, np.sinh, {}),
+    ("cosh", paddle.cosh, {"x": _r(8)}, np.cosh, {}),
+    ("asinh", paddle.asinh, {"x": _r(9)}, np.arcsinh, {}),
+    ("acosh", paddle.acosh, {"x": _pos(10) + 1.5}, np.arccosh, {}),
+    ("atanh", paddle.atanh, {"x": _r(11, lo=-0.7, hi=0.7)}, np.arctanh, {}),
+    ("expm1", paddle.expm1, {"x": _r(12)}, np.expm1, {}),
+    ("log1p", paddle.log1p, {"x": _pos(13)}, np.log1p, {}),
+    ("log2", paddle.log2, {"x": _pos(14)}, np.log2, {}),
+    ("log10", paddle.log10, {"x": _pos(15)}, np.log10, {}),
+    ("rsqrt", paddle.rsqrt, {"x": _pos(16)},
+     lambda x: 1.0 / np.sqrt(x), {}),
+    ("reciprocal", paddle.reciprocal, {"x": _pos(17)},
+     lambda x: 1.0 / x, {}),
+    ("erf", paddle.erf, {"x": _r(18)},
+     lambda x: np.vectorize(__import__("math").erf)(x).astype("float32"),
+     {}),
+    ("neg", paddle.neg, {"x": _r(20)}, np.negative, {}),
+    # ---- unary non-smooth (forward-only) ----
+    ("floor", paddle.floor, {"x": _r(21, lo=-3, hi=3)}, np.floor,
+     {"grad": False}),
+    ("ceil", paddle.ceil, {"x": _r(22, lo=-3, hi=3)}, np.ceil,
+     {"grad": False}),
+    ("round", paddle.round, {"x": _r(23, lo=-3, hi=3)}, np.round,
+     {"grad": False}),
+    ("trunc", paddle.trunc, {"x": _r(24, lo=-3, hi=3)}, np.trunc,
+     {"grad": False}),
+    ("sign", paddle.sign, {"x": _r(25)}, np.sign, {"grad": False}),
+    # ---- activations ----
+    ("relu", paddle.nn.functional.relu, {"x": _r(26)},
+     lambda x: np.maximum(x, 0), {"grad": False}),
+    ("silu", paddle.nn.functional.silu, {"x": _r(27)},
+     lambda x: x / (1 + np.exp(-x)), {}),
+    ("softplus", paddle.nn.functional.softplus, {"x": _r(28)},
+     lambda x: np.log1p(np.exp(x)), {}),
+    ("elu", paddle.nn.functional.elu, {"x": _r(29)},
+     lambda x: np.where(x > 0, x, np.exp(x) - 1), {}),
+    ("hardsigmoid", paddle.nn.functional.hardsigmoid, {"x": _r(30)},
+     lambda x: np.clip(x / 6 + 0.5, 0, 1), {"grad": False}),
+    ("log_sigmoid", paddle.nn.functional.log_sigmoid, {"x": _r(31)},
+     lambda x: -np.log1p(np.exp(-x)), {}),
+    # ---- binary ----
+    ("subtract", paddle.subtract, {"x": _r(40), "y": _r(41)},
+     np.subtract, {}),
+    ("divide", paddle.divide, {"x": _r(42), "y": _pos(43)},
+     np.divide, {}),
+    ("floor_divide", paddle.floor_divide,
+     {"x": _r(44, lo=1, hi=9), "y": _r(45, lo=1, hi=3)},
+     np.floor_divide, {"grad": False}),
+    ("mod", paddle.mod, {"x": _r(46, lo=1, hi=9),
+                         "y": _r(47, lo=1, hi=3)},
+     np.mod, {"grad": False}),
+    ("minimum_b", paddle.minimum, {"x": _r(49), "y": _r(50)},
+     np.minimum, {"grad": False}),
+    ("atan2", paddle.atan2, {"x": _r(51), "y": _pos(52)},
+     np.arctan2, {}),
+    ("logaddexp", paddle.logaddexp, {"x": _r(53), "y": _r(54)},
+     np.logaddexp, {}),
+    # ---- reductions ----
+    ("reduce_max", paddle.max, {"x": _r(60)},
+     lambda x: np.max(x), {"grad": False}),
+    ("reduce_min", paddle.min, {"x": _r(61)},
+     lambda x: np.min(x), {"grad": False}),
+    ("reduce_prod", paddle.prod, {"x": _pos(62)},
+     lambda x: np.prod(x), {}),
+    ("amax", paddle.amax, {"x": _r(63)}, lambda x: np.max(x),
+     {"grad": False}),
+    ("amin", paddle.amin, {"x": _r(64)}, lambda x: np.min(x),
+     {"grad": False}),
+    ("logsumexp", paddle.logsumexp, {"x": _r(65)},
+     lambda x: np.log(np.sum(np.exp(x))), {}),
+    ("std", paddle.std, {"x": _r(66)},
+     lambda x: np.std(x, ddof=1), {"tol": 1e-4}),
+    ("var", paddle.var, {"x": _r(67)},
+     lambda x: np.var(x, ddof=1), {"tol": 1e-4}),
+    ("median", paddle.median, {"x": _r(68, shape=(3, 5))},
+     lambda x: np.median(x), {"grad": False}),
+    # ---- shape / manipulation ----
+    ("reshape_b", paddle.reshape, {"x": _r(70)},
+     lambda x: x.reshape(4, 3), {"attrs": {"shape": [4, 3]},
+                                 "grad": False}),
+    ("flatten", paddle.flatten, {"x": _r(71, shape=(2, 3, 4))},
+     lambda x: x.reshape(2, 12),
+     {"attrs": {"start_axis": 1, "stop_axis": 2}, "grad": False}),
+    ("squeeze", paddle.squeeze, {"x": _r(72, shape=(3, 1, 4))},
+     lambda x: x.squeeze(1), {"attrs": {"axis": 1}, "grad": False}),
+    ("unsqueeze", paddle.unsqueeze, {"x": _r(73)},
+     lambda x: x[:, None, :], {"attrs": {"axis": 1}, "grad": False}),
+    ("flip", paddle.flip, {"x": _r(74)},
+     lambda x: np.flip(x, 1), {"attrs": {"axis": 1}, "grad": False}),
+    ("roll", paddle.roll, {"x": _r(75)},
+     lambda x: np.roll(x, 2), {"attrs": {"shifts": 2}, "grad": False}),
+    ("tile", paddle.tile, {"x": _r(76)},
+     lambda x: np.tile(x, (2, 1)),
+     {"attrs": {"repeat_times": [2, 1]}, "grad": False}),
+    ("triu", paddle.triu, {"x": _r(77, shape=(4, 4))}, np.triu,
+     {"grad": False}),
+    ("tril", paddle.tril, {"x": _r(78, shape=(4, 4))}, np.tril,
+     {"grad": False}),
+    ("cumsum", paddle.cumsum, {"x": _r(79)},
+     lambda x: np.cumsum(x, 1), {"attrs": {"axis": 1}}),
+    ("cumprod", paddle.cumprod, {"x": _pos(80)},
+     lambda x: np.cumprod(x, 1), {"attrs": {"dim": 1}}),
+    ("kron", paddle.kron, {"x": _r(82, shape=(2, 2)),
+                           "y": _r(83, shape=(2, 2))}, np.kron, {}),
+    ("outer", paddle.outer, {"x": _r(84, shape=(3,)),
+                             "y": _r(85, shape=(4,))}, np.outer, {}),
+    ("dot", paddle.dot, {"x": _r(86, shape=(4,)),
+                         "y": _r(87, shape=(4,))}, np.dot, {}),
+    ("bmm", paddle.bmm, {"x": _r(88, shape=(2, 3, 4)),
+                         "y": _r(89, shape=(2, 4, 5))},
+     lambda x, y: x @ y, {}),
+    ("trace_op", paddle.trace, {"x": _r(90, shape=(4, 4))},
+     lambda x: np.trace(x), {}),
+    ("diagonal", paddle.diagonal, {"x": _r(91, shape=(4, 4))},
+     lambda x: np.diagonal(x), {"grad": False}),
+    # ---- sorting / search (forward-only) ----
+    ("sort", paddle.sort, {"x": _r(100)},
+     lambda x: np.sort(x, -1), {"grad": False}),
+    ("argsort", paddle.argsort, {"x": _r(101)},
+     lambda x: np.argsort(x, -1, kind="stable"), {"grad": False}),
+    ("argmax", paddle.argmax, {"x": _r(102)},
+     lambda x: np.argmax(x), {"grad": False}),
+    ("argmin", paddle.argmin, {"x": _r(103)},
+     lambda x: np.argmin(x), {"grad": False}),
+    # ---- logic ----
+    ("equal", paddle.equal,
+     {"x": np.array([[1., 2.], [3., 4.]], "float32"),
+      "y": np.array([[1., 0.], [3., 9.]], "float32")},
+     lambda x, y: np.equal(x, y), {"grad": False}),
+    ("greater_than", paddle.greater_than, {"x": _r(111), "y": _r(112)},
+     np.greater, {"grad": False}),
+    ("less_equal", paddle.less_equal, {"x": _r(113), "y": _r(114)},
+     np.less_equal, {"grad": False}),
+    ("isnan", paddle.isnan,
+     {"x": np.array([1.0, np.nan, np.inf, -2.0], "float32")},
+     np.isnan, {"grad": False}),
+    ("isfinite", paddle.isfinite,
+     {"x": np.array([1.0, np.nan, np.inf, -np.inf], "float32")},
+     np.isfinite, {"grad": False}),
+]
+
+
+@pytest.mark.parametrize("case", CASES, ids=[c[0] for c in CASES])
+def test_op_parity(case):
+    name, op, inputs, oracle, opts = case
+
+    class T(OpTest):
+        if "tol" in opts:
+            atol = rtol = opts["tol"]
+
+        def setUpOp(self):
+            self.op = op
+            self.inputs = inputs
+            self.expected = oracle
+            if "attrs" in opts:
+                self.attrs = opts["attrs"]
+
+    t = T()
+    t.test_check_output()
+    if opts.get("grad", True):
+        t.test_check_grad()
