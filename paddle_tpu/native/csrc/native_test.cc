@@ -1,0 +1,174 @@
+// Native-layer unit tests (SURVEY §4.6: the reference ships colocated
+// C++ gtests per library; no gtest is available in this image, so these
+// are assert-style checks with a main() — built and run by
+// tests/test_native_cc.py). Covers the TCPStore client/server protocol,
+// the shm ring SPSC transport, and the host tracer event buffer.
+//
+// Build: g++ -O1 -std=c++17 -pthread native_test.cc tcp_store.cc \
+//            shm_ring.cc host_tracer.cc -lrt -o native_test
+#include <cassert>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+extern "C" {
+void* tcp_store_server_start(int port);
+int tcp_store_server_port(void* h);
+void tcp_store_server_stop(void* h);
+void* tcp_store_client_connect(const char* host, int port, int timeout_ms);
+void tcp_store_client_close(void* h);
+int tcp_store_set(void* h, const char* key, const char* val, int vlen);
+int tcp_store_get(void* h, const char* key, char* buf, int cap);
+int tcp_store_delete(void* h, const char* key);
+long long tcp_store_add(void* h, const char* key, long long delta);
+int tcp_store_wait(void* h, const char* key, int timeout_ms, char* buf,
+                   int cap);
+
+void* shm_ring_open(const char* name, int owner, uint64_t n_slots,
+                    uint64_t slot_bytes);
+int shm_ring_push(void* h, const char* data, uint64_t len);
+long long shm_ring_pop(void* h, char* buf, uint64_t cap, int timeout_ms);
+void shm_ring_close(void* h);
+void shm_ring_free(void* h);
+
+void host_tracer_start();
+int host_tracer_enabled();
+uint64_t host_tracer_now();
+void host_tracer_record(const char* name, uint64_t begin_ns,
+                        uint64_t end_ns);
+int host_tracer_event_count();
+int host_tracer_stop(const char* path);
+}
+
+static int tests_run = 0;
+#define CHECK(cond)                                              \
+  do {                                                           \
+    if (!(cond)) {                                               \
+      std::fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__,         \
+                   __LINE__, #cond);                             \
+      return 1;                                                  \
+    }                                                            \
+  } while (0)
+
+static int test_tcp_store() {
+  ++tests_run;
+  void* srv = tcp_store_server_start(0);  // ephemeral port
+  CHECK(srv != nullptr);
+  int port = tcp_store_server_port(srv);
+  CHECK(port > 0);
+  void* cli = tcp_store_client_connect("127.0.0.1", port, 2000);
+  CHECK(cli != nullptr);
+
+  CHECK(tcp_store_set(cli, "k", "hello", 5) == 0);
+  char buf[64];
+  CHECK(tcp_store_get(cli, "k", buf, sizeof buf) == 5);
+  CHECK(std::memcmp(buf, "hello", 5) == 0);
+  CHECK(tcp_store_get(cli, "missing", buf, sizeof buf) == -1);
+
+  // truncation contract: full length returned even when cap is small
+  std::string big(100, 'x');
+  CHECK(tcp_store_set(cli, "big", big.data(), 100) == 0);
+  char tiny[8];
+  CHECK(tcp_store_get(cli, "big", tiny, 8) == 100);
+
+  CHECK(tcp_store_add(cli, "ctr", 2) == 2);
+  CHECK(tcp_store_add(cli, "ctr", 3) == 5);
+
+  CHECK(tcp_store_delete(cli, "k") == 0);
+  CHECK(tcp_store_get(cli, "k", buf, sizeof buf) == -1);
+
+  // wait: a second client sets the key after a delay
+  std::thread setter([port] {
+    void* c2 = tcp_store_client_connect("127.0.0.1", port, 2000);
+    if (!c2) return;
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    tcp_store_set(c2, "later", "v", 1);
+    tcp_store_client_close(c2);
+  });
+  int wait_rc = tcp_store_wait(cli, "later", 5000, buf, sizeof buf);
+  setter.join();  // join BEFORE any CHECK can return with it joinable
+  CHECK(wait_rc == 1);
+  CHECK(buf[0] == 'v');
+
+  tcp_store_client_close(cli);
+  // server stop must not hang even though a client connected earlier
+  tcp_store_server_stop(srv);
+  return 0;
+}
+
+static int test_shm_ring() {
+  ++tests_run;
+  char name[64];
+  std::snprintf(name, sizeof name, "/pt_native_test_ring_%d",
+                static_cast<int>(::getpid()));
+  void* w = shm_ring_open(name, 1, 4, 64);
+  CHECK(w != nullptr);
+  void* r = shm_ring_open(name, 0, 4, 64);
+  CHECK(r != nullptr);
+
+  CHECK(shm_ring_push(w, "abc", 3) == 0);
+  char buf[64];
+  CHECK(shm_ring_pop(r, buf, sizeof buf, 1000) == 3);
+  CHECK(std::memcmp(buf, "abc", 3) == 0);
+
+  // payload larger than a slot is rejected, not corrupted
+  std::string big(200, 'y');
+  CHECK(shm_ring_push(w, big.data(), big.size()) == -2);
+
+  // wrap-around: push/pop more records than slots
+  for (int i = 0; i < 10; ++i) {
+    char msg[16];
+    int n = std::snprintf(msg, sizeof msg, "m%d", i);
+    CHECK(shm_ring_push(w, msg, n) == 0);
+    long long got = shm_ring_pop(r, buf, sizeof buf, 1000);
+    CHECK(got == n);
+    CHECK(std::memcmp(buf, msg, n) == 0);
+  }
+
+  // pop on empty times out
+  CHECK(shm_ring_pop(r, buf, sizeof buf, 10) == -3);
+
+  // closed + empty -> -1 for consumers
+  shm_ring_close(w);
+  CHECK(shm_ring_pop(r, buf, sizeof buf, 1000) == -1);
+  shm_ring_free(r);
+  shm_ring_free(w);
+  return 0;
+}
+
+static int test_host_tracer() {
+  ++tests_run;
+  host_tracer_start();
+  CHECK(host_tracer_enabled() == 1);
+  uint64_t t0 = host_tracer_now();
+  host_tracer_record("evt_a", t0, t0 + 1000);
+  host_tracer_record("evt_b", t0 + 2000, t0 + 3000);
+  CHECK(host_tracer_event_count() == 2);
+  char path[96];
+  std::snprintf(path, sizeof path, "/tmp/pt_native_test_trace_%d.json",
+                static_cast<int>(::getpid()));
+  CHECK(host_tracer_stop(path) == 2);  // returns #events
+  FILE* f = std::fopen(path, "rb");
+  CHECK(f != nullptr);
+  char content[4096];
+  size_t n = std::fread(content, 1, sizeof content - 1, f);
+  std::fclose(f);
+  content[n] = 0;
+  CHECK(std::strstr(content, "evt_a") != nullptr);
+  CHECK(std::strstr(content, "evt_b") != nullptr);
+  std::remove(path);
+  return 0;
+}
+
+int main() {
+  if (test_tcp_store()) return 1;
+  if (test_shm_ring()) return 1;
+  if (test_host_tracer()) return 1;
+  std::printf("native_test: %d suites passed\n", tests_run);
+  return 0;
+}

@@ -1,0 +1,28 @@
+"""Build and run the native C++ unit tests (SURVEY §4.6: the reference
+colocates C++ gtests with each native library; here assert-style checks
+in native/csrc/native_test.cc cover TCPStore, shm_ring, host tracer)."""
+import os
+import shutil
+import subprocess
+
+import pytest
+
+CSRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "paddle_tpu", "native", "csrc")
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
+def test_native_cc_suites(tmp_path):
+    exe = str(tmp_path / "native_test")
+    build = subprocess.run(
+        ["g++", "-O1", "-std=c++17", "-pthread",
+         os.path.join(CSRC, "native_test.cc"),
+         os.path.join(CSRC, "tcp_store.cc"),
+         os.path.join(CSRC, "shm_ring.cc"),
+         os.path.join(CSRC, "host_tracer.cc"),
+         "-lrt", "-o", exe],
+        capture_output=True, text=True, timeout=180)
+    assert build.returncode == 0, build.stderr[-2000:]
+    run = subprocess.run([exe], capture_output=True, text=True, timeout=120)
+    assert run.returncode == 0, (run.stdout[-1000:], run.stderr[-2000:])
+    assert "3 suites passed" in run.stdout
