@@ -1,7 +1,10 @@
-"""paddle.utils equivalent."""
+"""paddle.utils equivalent (reference __all__: deprecated, run_check,
+require_version, try_import — python/paddle/utils/__init__.py:59)."""
 from . import cpp_extension  # noqa: F401
 from . import download  # noqa: F401
 from .cpp_extension import custom_op  # noqa: F401
+
+__all__ = ["deprecated", "run_check", "require_version", "try_import"]
 
 
 def try_import(name):
@@ -10,3 +13,80 @@ def try_import(name):
         return importlib.import_module(name)
     except ImportError:
         return None
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Mark an API deprecated (reference utils/deprecated.py): warns at
+    level<2 (filter forced open so the warning is actually visible,
+    as the reference does), raises at level 2."""
+    import functools
+    import warnings
+
+    def decorator(fn):
+        msg = f"API '{fn.__module__}.{fn.__name__}' is deprecated"
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f", use '{update_to}' instead"
+        if reason:
+            msg += f". Reason: {reason}"
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if level == 2:
+                raise RuntimeError(msg)
+            warnings.simplefilter("always", DeprecationWarning)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        return wrapper
+    return decorator
+
+
+def require_version(min_version, max_version=None):
+    """Check the installed framework version against [min, max]
+    (reference utils/__init__.py require_version)."""
+    import paddle_tpu
+
+    def parse(v):
+        parts = [int(x) for x in str(v).split(".")[:3] if x.isdigit()]
+        return tuple(parts + [0] * (3 - len(parts)))   # zero-pad: 0.1 == 0.1.0
+
+    cur_str = getattr(paddle_tpu, "__version__", "0.0.0")
+    cur = parse(cur_str)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"installed version {cur_str} < required minimum {min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"installed version {cur_str} > required maximum {max_version}")
+    return True
+
+
+def run_check():
+    """Install sanity check (reference utils/install_check.py:232): run
+    a tiny compiled train step on the default device, and when several
+    devices are visible, a psum across all of them — then report."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    devs = jax.devices()
+    kind = devs[0].platform
+    print(f"Running verify on 1 {kind} device.")
+    a = jnp.asarray(np.random.RandomState(0).randn(8, 8), jnp.float32)
+    out = jax.jit(lambda x: (x @ x.T).sum())(a)
+    if not bool(jnp.isfinite(out)):   # not assert: must survive python -O
+        raise RuntimeError("single-device compiled matmul failed")
+    print(f"PaddleTPU works well on 1 {kind}.")
+    if len(devs) > 1:
+        n = len(devs)
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        mesh = Mesh(np.array(devs), ("d",))
+        x = jax.device_put(jnp.ones((n, 4)),
+                           NamedSharding(mesh, P("d", None)))
+        total = jax.jit(lambda v: v.sum())(x)
+        if float(total) != n * 4.0:
+            raise RuntimeError("multi-device reduction failed")
+        print(f"PaddleTPU works well on {n} {kind}s.")
+    print("PaddleTPU is installed successfully! Let's start deep "
+          "learning with PaddleTPU now.")

@@ -217,3 +217,35 @@ class TestVisionModels:
         net.eval()
         x = paddle.to_tensor(np.random.randn(1, 3, 32, 32).astype("float32"))
         assert net(x).shape == [1, 10]
+
+
+class TestUtilsSurface:
+    def test_run_check_multidevice(self, capsys):
+        import jax
+        paddle.utils.run_check()
+        out = capsys.readouterr().out
+        n = jax.device_count()
+        plat = jax.devices()[0].platform
+        if n > 1:
+            assert f"works well on {n} {plat}s" in out
+        assert "installed successfully" in out
+
+    def test_deprecated_and_require_version(self):
+        import warnings
+        paddle.utils.require_version("0.0.1")
+        with pytest.raises(Exception, match="minimum"):
+            paddle.utils.require_version("99.0.0")
+
+        @paddle.utils.deprecated(update_to="paddle.x", since="2.0")
+        def old():
+            return 1
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            assert old() == 1
+            assert len(w) == 1 and "paddle.x" in str(w[0].message)
+
+        @paddle.utils.deprecated(level=2)
+        def gone():
+            return 1
+        with pytest.raises(RuntimeError):
+            gone()
