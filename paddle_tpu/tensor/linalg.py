@@ -266,3 +266,98 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
         u, s, vt = jnp.linalg.svd(b, full_matrices=False)
         return u[..., :qq], s[..., :qq], jnp.swapaxes(vt, -1, -2)[..., :qq]
     return apply_op("pca_lowrank", _pca, x)
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """(P, L, U) from lu() results (reference tensor/linalg.py lu_unpack;
+    pivots are 1-based LAPACK ipiv as lu() returns them). Batched inputs
+    are vmapped over leading dims. With unpack_ludata=False L/U are None;
+    with unpack_pivots=False P is None (reference contract)."""
+    def _unpack2d(lu_mat, piv):
+        m, n = lu_mat.shape[-2], lu_mat.shape[-1]
+        k = min(m, n)
+        L = jnp.tril(lu_mat[:, :k], -1) + jnp.eye(m, k, dtype=lu_mat.dtype)
+        U = jnp.triu(lu_mat[:k, :])
+        perm = jnp.arange(m)
+        for i in range(piv.shape[-1]):
+            j = piv[i] - 1
+            pi, pj = perm[i], perm[j]
+            perm = perm.at[i].set(pj).at[j].set(pi)
+        P = jnp.eye(m, dtype=lu_mat.dtype)[perm].T
+        return P, L, U
+
+    def _unpack(lu_mat, piv):
+        fn = _unpack2d
+        for _ in range(lu_mat.ndim - 2):
+            fn = jax.vmap(fn)
+        return fn(lu_mat, piv)
+
+    P, L, U = apply_op("lu_unpack", _unpack, x, y)
+    return (P if unpack_pivots else None,
+            L if unpack_ludata else None,
+            U if unpack_ludata else None)
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    """reference linalg.vector_norm: entrywise p-norm over ``axis`` (the
+    whole tensor when None). Same p-branch logic as norm(), which already
+    computes the entrywise norm for every vector case — delegate."""
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    """reference linalg.matrix_norm: fro / nuc / +-1 / +-2 / +-inf over
+    the trailing two axes."""
+    def _mn(a):
+        return jnp.linalg.norm(a, ord=p, axis=tuple(axis),
+                               keepdims=keepdim)
+    return apply_op("matrix_norm", _mn, x)
+
+
+def svd_lowrank(x, q=None, niter=2, M=None, name=None):
+    """reference linalg.svd_lowrank: randomized-SVD API; computed via
+    exact thin SVD (single compiled op on TPU) truncated to q
+    (default 6, like pca_lowrank above)."""
+    def _svdl(*args):
+        a = args[0]
+        b = a - args[1] if len(args) > 1 else a
+        u, s, vt = jnp.linalg.svd(b, full_matrices=False)
+        k = min(q if q is not None else 6, s.shape[-1])
+        return (u[..., :k], s[..., :k],
+                jnp.swapaxes(vt, -1, -2)[..., :k])
+    if M is not None:
+        return apply_op("svd_lowrank", _svdl, x, M)
+    return apply_op("svd_lowrank", _svdl, x)
+
+
+def ormqr(x, tau, y, left=True, transpose=False, name=None):
+    """reference linalg.ormqr: multiply ``y`` by the orthogonal Q encoded
+    as householder reflectors (x, tau) from a QR factorization (LAPACK
+    semantics: Q is the implicit m x m product H1..Hn). Reflectors are
+    applied directly to ``y`` — O(n*m*cols), no m x m Q materialized.
+    Batched inputs are vmapped over leading dims."""
+    def _apply2d(a, t, other):
+        m, n = a.shape[-2], a.shape[-1]
+        # Q @ y applies Hn..H1 to y bottom-up; Q^T @ y applies H1..Hn.
+        # y @ Q applies H1..Hn from the right; y @ Q^T the reverse.
+        idxs = list(range(n))
+        apply_head_first = (left and transpose) or (not left and
+                                                    not transpose)
+        if not apply_head_first:
+            idxs = idxs[::-1]
+        z = other
+        for i in idxs:
+            v = jnp.where(jnp.arange(m) > i, a[:, i], 0.0)
+            v = v.at[i].set(1.0)
+            if left:
+                z = z - t[i] * v[:, None] * (v @ z)[None, :]
+            else:
+                z = z - t[i] * (z @ v)[:, None] * v[None, :]
+        return z
+
+    def _ormqr(a, t, other):
+        fn = _apply2d
+        for _ in range(a.ndim - 2):
+            fn = jax.vmap(fn)
+        return fn(a, t, other)
+    return apply_op("ormqr", _ormqr, x, tau, y)

@@ -329,3 +329,76 @@ class TestTopLevelSurface:
             paddle.nn.Linear(2 * 4 * 4, 5))
         fl = paddle.flops(net, (1, 1, 4, 4))
         assert fl == 2 * 2 * 16 * 1 * 9 + 2 * 1 * 5 * 32
+
+
+class TestLinalgExtras:
+    """linalg completions: lu_unpack / vector_norm / matrix_norm /
+    svd_lowrank / ormqr (reference python/paddle/tensor/linalg.py)."""
+
+    def test_lu_unpack_reconstructs(self):
+        A = np.random.RandomState(0).randn(4, 4).astype("float32")
+        lu, piv = paddle.linalg.lu(paddle.to_tensor(A))
+        P, L, U = paddle.linalg.lu_unpack(lu, piv)
+        np.testing.assert_allclose(P.numpy() @ L.numpy() @ U.numpy(), A,
+                                   atol=1e-5)
+
+    def test_vector_and_matrix_norm(self):
+        x = paddle.to_tensor(np.array([[3.0, 4.0], [0.0, 0.0]], "float32"))
+        assert abs(float(paddle.linalg.vector_norm(x).numpy()) - 5) < 1e-5
+        assert abs(float(paddle.linalg.matrix_norm(x).numpy()) - 5) < 1e-5
+        v1 = paddle.linalg.vector_norm(x, p=1, axis=1)
+        np.testing.assert_allclose(v1.numpy(), [7.0, 0.0], atol=1e-6)
+        vinf = paddle.linalg.vector_norm(x, p=float("inf"))
+        assert float(vinf.numpy()) == 4.0
+
+    def test_svd_lowrank_truncates(self):
+        B = np.random.RandomState(1).randn(6, 5).astype("float32")
+        u, s, v = paddle.linalg.svd_lowrank(paddle.to_tensor(B), q=3)
+        assert u.shape == [6, 3] and s.shape == [3] and v.shape == [5, 3]
+        # best rank-3 approximation error matches full-SVD truncation
+        full_s = np.linalg.svd(B, compute_uv=False)
+        approx = u.numpy() @ np.diag(s.numpy()) @ v.numpy().T
+        np.testing.assert_allclose(
+            np.linalg.norm(B - approx), np.sqrt((full_s[3:] ** 2).sum()),
+            rtol=1e-4)
+
+    def test_ormqr_matches_scipy_q(self):
+        import scipy.linalg as sla
+        B = np.random.RandomState(1).randn(6, 4).astype("float64")
+        (h, tau), _ = sla.qr(B, mode="raw")
+        Q = sla.qr(B, mode="full")[0]
+        y = np.random.RandomState(2).randn(6, 3)
+        t = lambda a: paddle.to_tensor(
+            np.ascontiguousarray(a.astype("float32")))
+        out = paddle.linalg.ormqr(t(h), t(tau), t(y))
+        np.testing.assert_allclose(out.numpy(), Q @ y, atol=2e-4)
+        out_t = paddle.linalg.ormqr(t(h), t(tau), t(y), transpose=True)
+        np.testing.assert_allclose(out_t.numpy(), Q.T @ y, atol=2e-4)
+
+    def test_ormqr_right_and_batched(self):
+        import scipy.linalg as sla
+        B = np.random.RandomState(1).randn(6, 4)
+        (h, tau), _ = sla.qr(B, mode="raw")
+        Q = sla.qr(B, mode="full")[0]
+        t = lambda a: paddle.to_tensor(
+            np.ascontiguousarray(np.asarray(a, "float32")))
+        yr = np.random.RandomState(3).randn(3, 6)
+        np.testing.assert_allclose(
+            paddle.linalg.ormqr(t(h), t(tau), t(yr), left=False).numpy(),
+            yr @ Q, atol=2e-4)
+        hb, taub = np.stack([h, h]), np.stack([tau, tau])
+        y = np.random.RandomState(2).randn(6, 3)
+        out = paddle.linalg.ormqr(t(hb), t(taub), t(np.stack([y, y])))
+        np.testing.assert_allclose(out.numpy()[0], Q @ y, atol=2e-4)
+
+    def test_lu_unpack_batched_and_flags(self):
+        A = np.random.RandomState(0).randn(2, 4, 4).astype("float32")
+        lu, piv = paddle.linalg.lu(paddle.to_tensor(A))
+        P, L, U = paddle.linalg.lu_unpack(lu, piv)
+        for i in range(2):
+            np.testing.assert_allclose(
+                P.numpy()[i] @ L.numpy()[i] @ U.numpy()[i], A[i],
+                atol=1e-5)
+        Pn, Ln, Un = paddle.linalg.lu_unpack(lu, piv,
+                                             unpack_ludata=False)
+        assert Ln is None and Un is None and Pn is not None
