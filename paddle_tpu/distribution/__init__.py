@@ -22,7 +22,8 @@ from ..core.tensor import Tensor
 from ..framework import random as random_mod
 
 __all__ = [
-    "Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+    "Distribution", "ExponentialFamily", "Normal", "Uniform",
+    "Categorical", "Bernoulli",
     "Beta", "Dirichlet", "Exponential", "Laplace", "Gumbel", "LogNormal",
     "Multinomial", "Independent", "TransformedDistribution",
     "Transform", "AffineTransform", "ExpTransform", "SigmoidTransform",
@@ -442,6 +443,41 @@ class Multinomial(Distribution):
         return wrap(gammaln(jnp.asarray(self.total_count + 1.0))
                     - jnp.sum(gammaln(v + 1), -1)
                     + jnp.sum(v * jnp.log(self.probs), -1))
+
+
+class ExponentialFamily(Distribution):
+    """reference distribution/exponential_family.py:23: distributions of
+    the form p(x|theta) = h(x) exp(eta(theta) . t(x) - A(eta)). entropy()
+    is derived from the log-normalizer via the Bregman identity
+    H = A(eta) - eta . grad A(eta) - E[log h(x)] — the reference computes
+    the gradient with paddle.grad; here jax.grad, same math."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        import jax
+        nat = tuple(jnp.asarray(p, jnp.float32)
+                    for p in self._natural_parameters)
+
+        def log_norm_sum(*params):
+            return jnp.sum(self._log_normalizer(*params))
+
+        grads = jax.grad(log_norm_sum,
+                         argnums=tuple(range(len(nat))))(*nat)
+        ent = -jnp.asarray(self._mean_carrier_measure, jnp.float32) \
+            + self._log_normalizer(*nat)
+        for eta, g in zip(nat, grads):
+            ent = ent - eta * g
+        return Tensor(ent)
 
 
 class Independent(Distribution):

@@ -12,8 +12,8 @@ from .core.dispatch import apply_op, wrap
 
 __all__ = [
     "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
-    "fft2", "ifft2", "rfft2", "irfft2",
-    "fftn", "ifftn", "rfftn", "irfftn",
+    "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+    "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
     "fftshift", "ifftshift", "fftfreq", "rfftfreq",
 ]
 
@@ -57,6 +57,44 @@ fftn = _opn("fftn", jnp.fft.fftn)
 ifftn = _opn("ifftn", jnp.fft.ifftn)
 rfftn = _opn("rfftn", jnp.fft.rfftn)
 irfftn = _opn("irfftn", jnp.fft.irfftn)
+
+
+def _hfftn_impl(a, s, axes, norm):
+    # jnp has no hfftn: hermitian transform along the LAST axis composed
+    # with a complex fftn over the preceding axes (scipy.fft.hfftn
+    # semantics; per-stage norm composes to the overall scaling)
+    if axes is None:
+        axes = tuple(range(a.ndim)) if s is None else \
+            tuple(range(a.ndim - len(s), a.ndim))
+    axes = tuple(axes)
+    s_list = list(s) if s is not None else None
+    pre_axes, last = axes[:-1], axes[-1]
+    if pre_axes:
+        pre_s = s_list[:-1] if s_list else None
+        a = jnp.fft.fftn(a, s=pre_s, axes=pre_axes, norm=norm)
+    n_last = s_list[-1] if s_list else None
+    return jnp.fft.hfft(a, n=n_last, axis=last, norm=norm)
+
+
+def _ihfftn_impl(a, s, axes, norm):
+    if axes is None:
+        axes = tuple(range(a.ndim)) if s is None else \
+            tuple(range(a.ndim - len(s), a.ndim))
+    axes = tuple(axes)
+    s_list = list(s) if s is not None else None
+    pre_axes, last = axes[:-1], axes[-1]
+    n_last = s_list[-1] if s_list else None
+    a = jnp.fft.ihfft(a, n=n_last, axis=last, norm=norm)
+    if pre_axes:
+        pre_s = s_list[:-1] if s_list else None
+        a = jnp.fft.ifftn(a, s=pre_s, axes=pre_axes, norm=norm)
+    return a
+
+
+hfft2 = _op2("hfft2", _hfftn_impl)
+ihfft2 = _op2("ihfft2", _ihfftn_impl)
+hfftn = _opn("hfftn", _hfftn_impl)
+ihfftn = _opn("ihfftn", _ihfftn_impl)
 
 
 def fftshift(x, axes=None, name=None):

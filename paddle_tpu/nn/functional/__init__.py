@@ -1,5 +1,7 @@
 from .activation import *  # noqa: F401,F403
-from .attention import flash_attention, scaled_dot_product_attention  # noqa: F401
+from .attention import (  # noqa: F401
+    flash_attention, scaled_dot_product_attention, sparse_attention,
+)
 from .common import *  # noqa: F401,F403
 from .conv import (  # noqa: F401
     conv1d, conv1d_transpose, conv2d, conv2d_transpose, conv3d,
@@ -11,3 +13,5 @@ from .norm import (  # noqa: F401
     normalize, spectral_norm,
 )
 from .pooling import *  # noqa: F401,F403
+from ...tensor.manipulation import diag_embed  # noqa: F401,E402 (reference exports it in nn.functional too)
+from ...tensor.math import tanh_  # noqa: F401,E402 (reference nn.functional exports the inplace form)

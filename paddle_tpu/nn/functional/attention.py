@@ -80,3 +80,63 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     if return_softmax:
         return out, None
     return out, None
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """reference sparse_attention (CUDA block-sparse kernel,
+    ops.yaml sparse_attention): on TPU the same result is computed by
+    masked dense attention — positions absent from the CSR pattern get
+    -inf before softmax. Layout [B, H, S, D] like the reference."""
+    import numpy as np
+
+    from ...core.dispatch import apply_op as _apply
+
+    def _sa(q, k, v, offs, cols):
+        if isinstance(offs, jax.core.Tracer):
+            raise NotImplementedError(
+                "sparse_attention needs a concrete CSR pattern (the "
+                "mask is built host-side); call it eagerly or close "
+                "over the pattern")
+        s = q.shape[-2]
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) / \
+            jnp.sqrt(jnp.asarray(q.shape[-1], q.dtype))
+        # CSR (offsets, columns) -> dense allow-mask, built host-side
+        offs_np = np.asarray(offs)
+        cols_np = np.asarray(cols)
+
+        def row_mask(off, col):
+            m = np.zeros((s, s), bool)
+            for r in range(s):
+                m[r, col[off[r]:off[r + 1]]] = True
+            return m
+        if offs_np.ndim == 3:
+            B, H = offs_np.shape[:2]
+            masks = np.stack([
+                np.stack([row_mask(offs_np[b, h], cols_np[b, h])
+                          for h in range(H)]) for b in range(B)])
+        else:
+            masks = row_mask(offs_np, cols_np)[None, None]
+        logits = jnp.where(jnp.asarray(masks), logits, -1e30)
+        if extra_masks:
+            kpm = extra_masks.get("key_padding_mask")
+            if kpm is not None:
+                # [B, S]: zero/False = padded key, excluded everywhere
+                keep = jnp.asarray(kpm).astype(bool)
+                logits = jnp.where(keep[:, None, None, :], logits, -1e30)
+            am = extra_masks.get("attn_mask")
+            if am is not None:
+                logits = logits + jnp.asarray(am).astype(logits.dtype)
+        probs = jax.nn.softmax(logits.astype(jnp.float32),
+                               -1).astype(q.dtype)
+        return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+    extra_masks = {
+        "key_padding_mask": key_padding_mask._data
+        if hasattr(key_padding_mask, "_data") else key_padding_mask,
+        "attn_mask": attn_mask._data
+        if hasattr(attn_mask, "_data") else attn_mask,
+    }
+    return _apply("sparse_attention", _sa, query, key, value,
+                  sparse_csr_offset, sparse_csr_columns)

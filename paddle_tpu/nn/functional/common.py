@@ -328,3 +328,49 @@ def affine_grid(theta, out_shape, align_corners=True, name=None):
         base = jnp.stack([gx, gy, ones], axis=-1)  # [h,w,3]
         return jnp.einsum("hwk,njk->nhwj", base, th)
     return apply_op("affine_grid", _ag, theta)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """reference common.py sequence_mask: [..., maxlen] with 1 where
+    position < length."""
+    def _sm(lengths):
+        m = maxlen if maxlen is not None else int(jnp.max(lengths))
+        rng = jnp.arange(m)
+        return (rng < lengths[..., None]).astype(dtype)
+    return apply_op("sequence_mask", _sm, x)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """reference distance.py pairwise_distance: ||x - y + eps||_p over
+    the last dim."""
+    def _pd(a, b):
+        d = a - b + epsilon
+        if p == float("inf"):
+            out = jnp.max(jnp.abs(d), axis=-1, keepdims=keepdim)
+        elif p == float("-inf"):
+            out = jnp.min(jnp.abs(d), axis=-1, keepdims=keepdim)
+        else:
+            out = jnp.sum(jnp.abs(d) ** p, axis=-1,
+                          keepdims=keepdim) ** (1.0 / p)
+        return out
+    return apply_op("pairwise_distance", _pd, x, y)
+
+
+def gather_tree(ids, parents, name=None):
+    """reference gather_tree: backtrack beam-search parent pointers so
+    every time step holds the full best path ([T, B, beam] layout)."""
+    def _gt(seq, par):
+        T = seq.shape[0]
+
+        def step(beams, t):
+            # beams: [B, beam] beam indices at time t+1; gather ids at t
+            tok = jnp.take_along_axis(seq[t], beams, axis=-1)
+            prev = jnp.take_along_axis(par[t], beams, axis=-1)
+            return prev, tok
+
+        init = jnp.broadcast_to(jnp.arange(seq.shape[2]),
+                                seq.shape[1:]).astype(seq.dtype)
+        _, toks = jax.lax.scan(step, init,
+                               jnp.arange(T - 1, -1, -1, dtype=jnp.int32))
+        return toks[::-1]
+    return apply_op("gather_tree", _gt, ids, parents)

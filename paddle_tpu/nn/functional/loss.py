@@ -3,6 +3,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ...core.dispatch import apply_op
 from ...core.tensor import Tensor
@@ -299,3 +300,222 @@ def huber_loss(input, label, delta=1.0, reduction="mean", name=None):  # noqa: A
             return jnp.sum(out)
         return out
     return apply_op("huber_loss", _huber, input, label)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    """reference loss.py soft_margin_loss: log(1+exp(-y*x))."""
+    def _sml(x, y):
+        return _reduce(jnp.log1p(jnp.exp(-y.astype(x.dtype) * x)),
+                       reduction)
+    return apply_op("soft_margin_loss", _sml, input, label)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,  # noqa: A002
+                      reduction="mean", name=None):
+    """reference multi_margin_loss: mean_j max(0, margin - x_y + x_j)^p
+    over j != y, per sample."""
+    def _mml(x, y, *w):
+        C = x.shape[-1]
+        xy = jnp.take_along_axis(x, y[:, None], axis=-1)
+        viol = jnp.maximum(0.0, margin - xy + x) ** p
+        if w:
+            viol = viol * jnp.take(w[0], y)[:, None]
+        viol = viol * (1.0 - jax.nn.one_hot(y, C, dtype=x.dtype))
+        return _reduce(jnp.sum(viol, -1) / C, reduction)
+    if weight is not None:
+        return apply_op("multi_margin_loss", _mml, input, label, weight)
+    return apply_op("multi_margin_loss", _mml, input, label)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,  # noqa: A002
+                                 reduction="mean", name=None):
+    """reference multi_label_soft_margin_loss: per-class binary CE with
+    logits, averaged over classes."""
+    def _mlsml(x, y, *w):
+        y = y.astype(x.dtype)
+        per = y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x)
+        if w:
+            per = per * w[0]
+        return _reduce(-jnp.mean(per, axis=-1), reduction)
+    if weight is not None:
+        return apply_op("multi_label_soft_margin_loss", _mlsml, input,
+                        label, weight)
+    return apply_op("multi_label_soft_margin_loss", _mlsml, input, label)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):  # noqa: A002
+    """reference dice_loss: 1 - 2*intersection/(total + eps), label is
+    class ids with trailing dim 1, input probabilities over classes."""
+    def _dice(x, y):
+        oh = jax.nn.one_hot(jnp.squeeze(y, -1), x.shape[-1],
+                            dtype=x.dtype)
+        axes = tuple(range(1, x.ndim))
+        inse = jnp.sum(x * oh, axis=axes)
+        denom = jnp.sum(x, axis=axes) + jnp.sum(oh, axis=axes)
+        return jnp.mean(1.0 - 2.0 * inse / (denom + epsilon))
+    return apply_op("dice_loss", _dice, input, label)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """reference npair_loss: soft-label CE over the anchor-positive
+    similarity matrix + l2 on the embeddings (Beta=0.25 as reference)."""
+    def _npair(a, pos, lab):
+        B = lab.shape[0]
+        same = jnp.equal(lab[:, None], lab[None, :]).astype(a.dtype)
+        soft = same / jnp.sum(same, axis=1, keepdims=True)
+        l2 = (jnp.mean(jnp.sum(a * a, 1))
+              + jnp.mean(jnp.sum(pos * pos, 1))) * 0.25 * l2_reg
+        sim = a @ pos.T
+        ce_rows = -jnp.sum(soft * jax.nn.log_softmax(sim, -1), -1)
+        # reference: sum over axis 0 of labels*softmax_ce then mean
+        ce = jnp.mean(jnp.sum(soft * ce_rows[:, None], 0))
+        return ce + l2
+    return apply_op("npair_loss", _npair, anchor, positive, labels)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,  # noqa: A002
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """reference hsigmoid_loss: hierarchical sigmoid. Default path is the
+    complete binary tree; a custom tree is honored via path_table
+    ([N, L] internal-node ids, negatives = padding) + path_code
+    ([N, L] 0/1 left/right). weight: [num_classes-1, D]."""
+    def _hs(x, y, w, *extra):
+        b = extra[0] if bias is not None else None
+        if path_table is not None:
+            pt = extra[-2] if path_code is not None else extra[-1]
+            pc = extra[-1]
+            nodes = pt.astype(jnp.int32)
+            codes = pc.astype(x.dtype)
+            valid = nodes >= 0
+        else:
+            depth = max(1, int(np.ceil(np.log2(max(num_classes, 2)))))
+            # complete-tree path: node ids and left/right codes from
+            # label bits, root-first (the reference's default path)
+            codes_l, nodes_l = [], []
+            node = y + num_classes          # leaf position, heap layout
+            for _ in range(depth):
+                parent = node // 2
+                codes_l.append((node % 2).astype(x.dtype))  # 1 = right
+                nodes_l.append(parent - 1)  # internal idx 0-based
+                node = parent
+            nodes = jnp.stack(nodes_l[::-1], -1)   # [N, L] root-first
+            codes = jnp.stack(codes_l[::-1], -1)
+            valid = (nodes >= 0) & (nodes < num_classes - 1)
+        nid = jnp.clip(nodes, 0, num_classes - 2)
+        wv = w[nid]                                   # [N, L, D]
+        logit = jnp.einsum("nd,nkd->nk", x, wv)
+        if b is not None:
+            logit = logit + b[nid].reshape(logit.shape)
+        # sigmoid CE per node: code==1 -> target 1
+        per = jnp.where(valid,
+                        -codes * jax.nn.log_sigmoid(logit)
+                        - (1 - codes) * jax.nn.log_sigmoid(-logit), 0.0)
+        return jnp.sum(per, -1, keepdims=True)
+    args = [input, label, weight]
+    if bias is not None:
+        args.append(bias)
+    if path_table is not None:
+        args.append(path_table)
+        if path_code is None:
+            raise ValueError("path_code is required with path_table")
+        args.append(path_code)
+    return apply_op("hsigmoid_loss", _hs, *args)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean",
+                         name=None):
+    """reference margin_cross_entropy (ArcFace combined margin):
+    logit_y <- cos(m1*theta + m2) - m3, all logits scaled by s, then
+    softmax CE. Single-rank path (group collectives subsumed by GSPMD)."""
+    def _mce(z, y):
+        C = z.shape[-1]
+        oh = jax.nn.one_hot(y, C, dtype=z.dtype)
+        theta = jnp.arccos(jnp.clip(z, -1.0 + 1e-7, 1.0 - 1e-7))
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        zm = jnp.where(oh > 0, target, z) * scale
+        logp = jax.nn.log_softmax(zm, -1)
+        loss = _reduce(-jnp.sum(oh * logp, -1), reduction)
+        return loss, jnp.exp(logp)
+    loss, sm = apply_op("margin_cross_entropy", _mce, logits, label)
+    return (loss, sm) if return_softmax else loss
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,  # noqa: A002
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """reference rnnt_loss (warprnnt binding): transducer forward-alpha
+    recursion in log space over the (T, U) lattice, lax.scan over T with
+    an inner scan over U — differentiable through logsumexp, no custom
+    backward needed."""
+    def _rnnt(logits, labels, in_len, lab_len):
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        B, T, U, V = lp.shape      # U = max_label_len + 1
+        NEG = -1e30
+
+        def one(lpb, lab, t_len, u_len):
+            blank_lp = lpb[:, :, blank]                     # [T, U]
+            lab_idx = jnp.concatenate(
+                [lab, jnp.zeros((1,), lab.dtype)])[:U]
+            emit_lp = jnp.take_along_axis(
+                lpb, lab_idx[None, :, None].astype(jnp.int32),
+                axis=-1)[..., 0]                             # [T, U]
+            if fastemit_lambda:
+                # FastEmit (arXiv:2010.11148) as warprnnt implements it:
+                # emit-branch GRADIENTS scaled by (1+lambda), forward
+                # value unchanged — value-preserving gradient scale
+                lam = float(fastemit_lambda)
+                emit_lp = emit_lp * (1.0 + lam) \
+                    - jax.lax.stop_gradient(emit_lp) * lam
+
+            def row(alpha_prev, t):
+                # alpha[t, u] from alpha[t-1, u] (blank) and
+                # alpha[t, u-1] (emit) — inner scan over u
+                from_blank = jnp.where(
+                    t == 0,
+                    jnp.where(jnp.arange(U) == 0, 0.0, NEG),
+                    alpha_prev + blank_lp[jnp.maximum(t - 1, 0)])
+
+                def ucell(carry, u):
+                    emit = jnp.where(
+                        u == 0, NEG,
+                        carry + emit_lp[t, jnp.maximum(u - 1, 0)])
+                    base = jnp.where(t == 0,
+                                     jnp.where(u == 0, 0.0, NEG),
+                                     from_blank[u])
+                    a = jnp.logaddexp(base, emit)
+                    a = jnp.where((t == 0) & (u == 0), 0.0, a)
+                    return a, a
+                _, alpha_t = jax.lax.scan(ucell, NEG,
+                                          jnp.arange(U, dtype=jnp.int32))
+                return alpha_t, alpha_t
+            _, alphas = jax.lax.scan(row, jnp.full((U,), NEG),
+                                     jnp.arange(T, dtype=jnp.int32))
+            tl = jnp.maximum(t_len - 1, 0)
+            ul = jnp.clip(u_len, 0, U - 1)
+            final = alphas[tl, ul] + blank_lp[tl, ul]
+            return -final
+        losses = jax.vmap(one)(lp, labels, in_len, lab_len)
+        return _reduce(losses, reduction)
+    return apply_op("rnnt_loss", _rnnt, input, label, input_lengths,
+                    label_lengths)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,  # noqa: A002
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    """reference loss.py triplet_margin_with_distance_loss (functional
+    form of the layer)."""
+    from .common import pairwise_distance
+    dist = distance_function or pairwise_distance
+    d_pos = dist(input, positive)
+    d_neg = dist(input, negative)
+    if swap:
+        from ...tensor.math import minimum
+        d_neg = minimum(d_neg, dist(positive, negative))
+    def _final(dp, dn):
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+    return apply_op("triplet_margin_with_distance_loss", _final, d_pos,
+                    d_neg)

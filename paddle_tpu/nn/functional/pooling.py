@@ -82,10 +82,101 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
 
 
 def _pool_mask(n, x, out, kernel_size, stride, padding, data_format):
-    # indices of max within each window (flattened spatial index), computed by
-    # comparing against the pooled output
-    import paddle_tpu as P
-    return P.zeros(out.shape, dtype="int64")  # placeholder mask (rarely used)
+    """Flat-spatial argmax index per pooling window (what max_unpool
+    consumes — reference pooling.py max_poolXd return_mask=True). NC*
+    layouts only (the layer zoo's default)."""
+    if not data_format.startswith("NC"):
+        raise NotImplementedError(
+            f"return_mask supports NC* layouts only, got {data_format}")
+    k = _tuplize(kernel_size, n)
+    s = _tuplize(stride, n) if stride is not None else k
+
+    def _mask(a, o):
+        spatial = a.shape[2:]
+        out_sp = o.shape[2:]
+        pads = _pad_spec(padding, n, s, spatial, k, (1,) * n)
+        # window start coordinates per output position, then full index
+        # grids of shape out_sp + k per spatial dim
+        grids = []
+        for d in range(n):
+            starts = np.arange(out_sp[d]) * s[d] - pads[d][0]
+            idx = starts[:, None] + np.arange(k[d])[None, :]  # [out_d, k_d]
+            shape = [1] * (2 * n)
+            shape[d] = out_sp[d]
+            shape[n + d] = k[d]
+            grids.append(idx.reshape(shape))
+        # broadcast to out_sp + k, clip and mark out-of-range
+        full = np.broadcast_shapes(*[g.shape for g in grids])
+        valid = np.ones(full, bool)
+        flat = np.zeros(full, np.int64)
+        for d in range(n):
+            g = np.broadcast_to(grids[d], full)
+            valid &= (g >= 0) & (g < spatial[d])
+            flat = flat * spatial[d] + np.clip(g, 0, spatial[d] - 1)
+        gather = jnp.asarray(flat.reshape(-1))          # [P*K]
+        a_flat = a.reshape(a.shape[0], a.shape[1], -1)  # [N, C, S]
+        vals = a_flat[:, :, gather].reshape(
+            a.shape[:2] + (int(np.prod(out_sp)), int(np.prod(k))))
+        vals = jnp.where(jnp.asarray(valid.reshape(1, 1, -1, int(np.prod(k)))),
+                         vals, -jnp.inf)
+        win_arg = jnp.argmax(vals, axis=-1)             # [N, C, P]
+        flat_idx = jnp.take_along_axis(
+            jnp.asarray(flat.reshape(1, 1, -1, int(np.prod(k)))),
+            win_arg[..., None].astype(jnp.int64), axis=-1)[..., 0]
+        return flat_idx.reshape(o.shape).astype(jnp.int64)
+
+    return apply_op(f"max_pool{n}d_mask", _mask, x, out)
+
+
+def _unpool_nd(n, x, indices, kernel_size, stride, padding, output_size,
+               data_format, name):
+    """Scatter pooled values back to their argmax positions (reference
+    pooling.py max_unpoolXd); non-indexed positions are zero."""
+    if not data_format.startswith("NC"):
+        raise NotImplementedError(
+            f"max_unpool supports NC* layouts only, got {data_format}")
+    k = _tuplize(kernel_size, n)
+    s = _tuplize(stride, n) if stride is not None else k
+    p = _tuplize(padding, n)
+
+    def _unpool(a, idx):
+        in_sp = a.shape[2:]
+        if output_size is not None:
+            out_sp = tuple(int(d) for d in output_size[-n:])
+        else:
+            out_sp = tuple((in_sp[d] - 1) * s[d] - 2 * p[d] + k[d]
+                           for d in range(n))
+        N, C = a.shape[0], a.shape[1]
+        flat = jnp.zeros((N, C, int(np.prod(out_sp))), a.dtype)
+        ii = idx.reshape(N, C, -1)
+        vv = a.reshape(N, C, -1)
+        # .set, not .add: overlapping windows can report the SAME max
+        # position twice; unpool must place the value once (torch/paddle
+        # semantics), not sum duplicates
+        out = flat.at[
+            jnp.arange(N)[:, None, None],
+            jnp.arange(C)[None, :, None], ii].set(vv)
+        return out.reshape((N, C) + out_sp)
+
+    return apply_op(f"max_unpool{n}d", _unpool, x, indices)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _unpool_nd(1, x, indices, kernel_size, stride, padding,
+                      output_size, data_format, name)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _unpool_nd(2, x, indices, kernel_size, stride, padding,
+                      output_size, data_format, name)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _unpool_nd(3, x, indices, kernel_size, stride, padding,
+                      output_size, data_format, name)
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,

@@ -182,3 +182,16 @@ class GLU(Layer):
 
     def forward(self, x):
         return F.glu(x, self._axis)
+
+
+class Softmax2D(Layer):
+    """reference activation.py Softmax2D: softmax over the channel dim of
+    NCHW (or CHW) inputs."""
+
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        assert x.ndim in (3, 4), \
+            f"Softmax2D expects 3D/4D input, got {x.ndim}D"
+        return F.softmax(x, axis=-3)

@@ -149,3 +149,97 @@ class CTCLoss(Layer):
                 norm_by_times=False):
         return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
                           self.blank, self.reduction, norm_by_times)
+
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):  # noqa: A002
+        return F.soft_margin_loss(input, label, self.reduction)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.p, self.margin = p, margin
+        self.weight, self.reduction = weight, reduction
+
+    def forward(self, input, label):  # noqa: A002
+        return F.multi_margin_loss(input, label, self.p, self.margin,
+                                   self.weight, self.reduction)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self.weight, self.reduction = weight, reduction
+
+    def forward(self, input, label):  # noqa: A002
+        return F.multi_label_soft_margin_loss(input, label, self.weight,
+                                              self.reduction)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    """reference loss.py TripletMarginWithDistanceLoss: triplet loss with
+    a user-supplied distance callable (default: pairwise_distance)."""
+
+    def __init__(self, distance_function=None, margin=1.0,
+                 swap=False, reduction="mean", name=None):
+        super().__init__()
+        self.distance_function = distance_function
+        self.margin, self.swap, self.reduction = margin, swap, reduction
+
+    def forward(self, input, positive, negative):  # noqa: A002
+        from ...tensor.math import minimum
+        dist = self.distance_function or F.pairwise_distance
+        d_pos = dist(input, positive)
+        d_neg = dist(input, negative)
+        if self.swap:
+            d_neg = minimum(d_neg, dist(positive, negative))
+        viol = F.relu(d_pos - d_neg + self.margin)
+        if self.reduction == "mean":
+            return viol.mean()
+        if self.reduction == "sum":
+            return viol.sum()
+        return viol
+
+
+class HSigmoidLoss(Layer):
+    """reference loss.py HSigmoidLoss: holds the internal-node weight
+    [num_classes-1, feature_size] (+ optional bias) for
+    F.hsigmoid_loss's default complete-binary-tree path."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        from ..initializer_utils import create_parameter_with_attr
+        self.num_classes = num_classes
+        self.weight = create_parameter_with_attr(
+            [num_classes - 1, feature_size], self._dtype, weight_attr,
+            False)
+        self.bias = None if bias_attr is False else \
+            create_parameter_with_attr([num_classes - 1, 1], self._dtype,
+                                       bias_attr, True)
+
+    def forward(self, input, label, path_table=None, path_code=None):  # noqa: A002
+        return F.hsigmoid_loss(input, label, self.num_classes,
+                               self.weight, self.bias, path_table,
+                               path_code)
+
+
+class RNNTLoss(Layer):
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.blank = blank
+        self.fastemit_lambda = fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):  # noqa: A002
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           self.blank, self.fastemit_lambda,
+                           self.reduction)

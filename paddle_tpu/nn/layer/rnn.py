@@ -216,7 +216,21 @@ class GRU(_RNNBase):
 
 
 class _CellBase(Layer):
-    pass
+    """Cell protocol base (reference rnn.py RNNCellBase:77): subclasses
+    implement forward(inputs, states) -> (outputs, new_states)."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        import numpy as _np
+
+        import paddle_tpu as _paddle
+        b = batch_ref.shape[batch_dim_idx]
+        h = shape[-1] if shape is not None else self.hidden_size
+        return _paddle.to_tensor(
+            _np.full((b, h), init_value, dtype or "float32"))
+
+
+RNNCellBase = _CellBase
 
 
 class SimpleRNNCell(_CellBase):
@@ -253,6 +267,14 @@ class SimpleRNNCell(_CellBase):
 
 
 class LSTMCell(_CellBase):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        h = super().get_initial_states(batch_ref, shape, dtype,
+                                       init_value, batch_dim_idx)
+        c = super().get_initial_states(batch_ref, shape, dtype,
+                                       init_value, batch_dim_idx)
+        return (h, c)
+
     def __init__(self, input_size, hidden_size, weight_ih_attr=None,
                  weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
                  name=None):

@@ -27,7 +27,8 @@ __all__ = [
     "add", "subtract", "multiply", "divide", "matmul", "masked_matmul",
     "sin", "tan", "asin", "atan", "sinh", "tanh", "asinh", "atanh",
     "sqrt", "square", "log1p", "abs", "pow", "neg", "cast", "expm1",
-    "relu", "transpose", "sum",
+    "relu", "transpose", "sum", "coalesce", "is_same_shape",
+    "deg2rad", "rad2deg", "reshape", "mv", "addmm",
 ]
 
 
@@ -329,3 +330,47 @@ def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
 
 
 from . import nn  # noqa: E402,F401
+
+
+def coalesce(x, name=None):
+    """Sum duplicate coordinates (reference unary.py coalesce)."""
+    coo = _as_coo(x)
+    return SparseCooTensor(coo._bcoo.sum_duplicates())
+
+
+def is_same_shape(x, y, name=None):
+    sx = x.shape if hasattr(x, "shape") else list(jnp.shape(x))
+    sy = y.shape if hasattr(y, "shape") else list(jnp.shape(y))
+    return list(sx) == list(sy)
+
+
+def deg2rad(x, name=None):
+    return _unary("sparse_deg2rad", jnp.deg2rad)(x)
+
+
+def rad2deg(x, name=None):
+    return _unary("sparse_rad2deg", jnp.rad2deg)(x)
+
+
+def reshape(x, shape, name=None):
+    """reference unary.py reshape: reshape a sparse tensor (dense-dim
+    semantics preserved via BCOO reshape)."""
+    coo = _as_coo(x)._bcoo
+    out = SparseCooTensor(coo.reshape(tuple(int(s) for s in shape)))
+    return out.to_sparse_csr() if is_sparse_csr(x) else out
+
+
+def mv(x, vec, name=None):
+    """Sparse matrix x dense vector (reference binary.py mv)."""
+    coo = _as_coo(x)._bcoo
+    v = vec._data if hasattr(vec, "_data") else jnp.asarray(vec)
+    return wrap(coo @ v)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
+    """beta*input + alpha*(x @ y) with sparse x (reference binary.py
+    addmm)."""
+    coo = _as_coo(x)._bcoo
+    yd = _coerce(y)
+    ind = input._data if hasattr(input, "_data") else jnp.asarray(input)
+    return wrap(beta * ind + alpha * (coo @ yd))
