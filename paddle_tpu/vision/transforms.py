@@ -1,7 +1,377 @@
-"""Minimal vision transforms (reference: python/paddle/vision/transforms/)."""
+"""Vision transforms — full reference surface
+(python/paddle/vision/transforms/{transforms.py,functional.py}): 22
+transform classes + the functional ops they build on. Images are numpy
+arrays (HWC or CHW; uint8 or float) or PIL Images (converted on entry);
+geometric warps use scipy.ndimage inverse mapping.
+"""
 from __future__ import annotations
 
+import numbers
+
 import numpy as np
+
+__all__ = [
+    "BaseTransform", "Compose", "ToTensor", "Normalize", "Transpose",
+    "Resize", "RandomResizedCrop", "CenterCrop", "RandomCrop", "Pad",
+    "RandomHorizontalFlip", "RandomVerticalFlip", "RandomRotation",
+    "RandomAffine", "RandomPerspective", "RandomErasing", "Grayscale",
+    "BrightnessTransform", "ContrastTransform", "SaturationTransform",
+    "HueTransform", "ColorJitter",
+    "to_tensor", "normalize", "resize", "crop", "center_crop", "pad",
+    "hflip", "vflip", "rotate", "affine", "perspective", "erase",
+    "adjust_brightness", "adjust_contrast", "adjust_hue",
+    "adjust_saturation", "to_grayscale",
+]
+
+
+def _np_img(img):
+    """PIL/ndarray -> ndarray, remembering nothing (HWC or HW)."""
+    return np.asarray(img)
+
+
+def _is_chw(arr):
+    return arr.ndim == 3 and arr.shape[0] in (1, 3, 4) and \
+        arr.shape[-1] not in (1, 3, 4)
+
+
+def _to_hwc(arr):
+    if _is_chw(arr):
+        return arr.transpose(1, 2, 0), True
+    return arr, False
+
+
+def _from_hwc(arr, was_chw):
+    return arr.transpose(2, 0, 1) if was_chw else arr
+
+
+# ------------------------------------------------------------- functional
+
+def to_tensor(pic, data_format="CHW"):
+    src = np.asarray(pic)
+    arr = src.astype(np.float32)
+    if src.dtype == np.uint8:
+        arr = arr / 255.0
+    if arr.ndim == 2:
+        arr = arr[:, :, None]          # HW -> HW1, channel-last
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    import paddle_tpu as paddle
+    return paddle.to_tensor(np.ascontiguousarray(arr))
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    arr = np.asarray(img, np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    shape = (-1, 1, 1) if data_format == "CHW" else (1, 1, -1)
+    return (arr - mean.reshape(shape)) / std.reshape(shape)
+
+
+def resize(img, size, interpolation="bilinear"):
+    arr = np.asarray(img)
+    hwc, was_chw = _to_hwc(arr)
+    if isinstance(size, numbers.Number):
+        h, w = hwc.shape[:2]
+        if h <= w:
+            size = (int(size), max(1, int(size * w / h)))
+        else:
+            size = (max(1, int(size * h / w)), int(size))
+    import jax
+    import jax.numpy as jnp
+    method = {"bilinear": "linear", "nearest": "nearest",
+              "bicubic": "cubic", "linear": "linear"}.get(
+        interpolation, "linear")
+    target = tuple(size) + ((hwc.shape[-1],) if hwc.ndim == 3 else ())
+    out = np.asarray(jax.image.resize(
+        jnp.asarray(hwc, jnp.float32), target, method))
+    if arr.dtype == np.uint8:
+        out = np.clip(np.round(out), 0, 255).astype(np.uint8)
+    return _from_hwc(out, was_chw)
+
+
+def crop(img, top, left, height, width):
+    arr = np.asarray(img)
+    if _is_chw(arr):
+        return arr[:, top:top + height, left:left + width]
+    return arr[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    arr = np.asarray(img)
+    hwc, _ = _to_hwc(arr)
+    h, w = hwc.shape[:2]
+    th, tw = output_size
+    if th > h or tw > w:
+        raise ValueError(
+            f"center_crop size ({th}, {tw}) exceeds image ({h}, {w})")
+    return crop(arr, (h - th) // 2, (w - tw) // 2, th, tw)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    arr = np.asarray(img)
+    if isinstance(padding, numbers.Number):
+        pl = pr = pt = pb = int(padding)
+    elif len(padding) == 2:
+        pl, pt = padding
+        pr, pb = padding
+    else:
+        pl, pt, pr, pb = padding
+    mode = {"constant": "constant", "edge": "edge",
+            "reflect": "reflect", "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    if _is_chw(arr):
+        spec = [(0, 0), (pt, pb), (pl, pr)]
+    elif arr.ndim == 3:
+        spec = [(pt, pb), (pl, pr), (0, 0)]
+    else:
+        spec = [(pt, pb), (pl, pr)]
+    return np.pad(arr, spec, mode=mode, **kw)
+
+
+def hflip(img):
+    arr = np.asarray(img)
+    return np.ascontiguousarray(np.flip(arr, -1 if _is_chw(arr) else 1))
+
+
+def vflip(img):
+    arr = np.asarray(img)
+    return np.ascontiguousarray(np.flip(arr, -2 if _is_chw(arr) else 0))
+
+
+_INTERP_ORDER = {"nearest": 0, "bilinear": 1, "linear": 1, "bicubic": 3}
+
+
+def _warp(hwc, matrix, fill=0.0, interpolation="bilinear",
+          out_shape=None):
+    """Inverse-warp an HWC image by a 3x3 homography (output->input)."""
+    from scipy import ndimage
+    h, w = (out_shape if out_shape is not None else hwc.shape[:2])
+    yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    ones = np.ones_like(xx)
+    coords = np.stack([xx, yy, ones], 0).reshape(3, -1).astype(np.float64)
+    src = matrix @ coords
+    src = src[:2] / np.maximum(src[2:3], 1e-12)
+    sx, sy = src[0].reshape(h, w), src[1].reshape(h, w)
+    # epsilon-tolerant bounds: 1e-15 rotation-matrix noise must not push
+    # on-grid samples "outside" (map_coordinates fills ANY coord < 0
+    # with cval); genuinely-outside pixels still get the fill value
+    ih, iw = hwc.shape[:2]
+    eps = 1e-6
+    valid = ((sx >= -eps) & (sx <= iw - 1 + eps)
+             & (sy >= -eps) & (sy <= ih - 1 + eps))
+    sx = np.clip(sx, 0, iw - 1)
+    sy = np.clip(sy, 0, ih - 1)
+    order = _INTERP_ORDER.get(interpolation, 1)
+    chans = hwc[..., None] if hwc.ndim == 2 else hwc
+    out = np.stack([
+        np.where(valid,
+                 ndimage.map_coordinates(chans[..., c].astype(np.float64),
+                                         [sy, sx], order=order),
+                 float(fill))
+        for c in range(chans.shape[-1])], -1)
+    if hwc.ndim == 2:
+        out = out[..., 0]
+    if np.asarray(hwc).dtype == np.uint8:
+        out = np.clip(np.round(out), 0, 255).astype(np.uint8)
+    return out.astype(hwc.dtype)
+
+
+def _affine_matrix(angle, translate, scale, shear, center):
+    rot = np.deg2rad(angle)
+    sx, sy = (np.deg2rad(s) for s in shear)
+    cx, cy = center
+    tx, ty = translate
+    # forward: T(center) R S Sh T(-center) T(translate); invert for warp
+    a = np.cos(rot - sy) / np.cos(sy)
+    b = -np.cos(rot - sy) * np.tan(sx) / np.cos(sy) - np.sin(rot)
+    c = np.sin(rot - sy) / np.cos(sy)
+    d = -np.sin(rot - sy) * np.tan(sx) / np.cos(sy) + np.cos(rot)
+    m = np.array([[a, b, 0.0], [c, d, 0.0], [0, 0, 1.0]]) * 1.0
+    m[:2, :] *= scale
+    m[0, 2] = cx + tx - m[0, 0] * cx - m[0, 1] * cy
+    m[1, 2] = cy + ty - m[1, 0] * cx - m[1, 1] * cy
+    return np.linalg.inv(m)
+
+
+def affine(img, angle, translate=(0, 0), scale=1.0, shear=(0.0, 0.0),
+           interpolation="bilinear", fill=0, center=None):
+    arr = np.asarray(img)
+    hwc, was_chw = _to_hwc(arr)
+    h, w = hwc.shape[:2]
+    if isinstance(shear, numbers.Number):
+        shear = (shear, 0.0)
+    ctr = center if center is not None else ((w - 1) * 0.5, (h - 1) * 0.5)
+    m = _affine_matrix(angle, translate, scale, shear, ctr)
+    return _from_hwc(_warp(hwc, m, fill, interpolation), was_chw)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    if not expand:
+        return affine(img, angle, (0, 0), 1.0, (0.0, 0.0), interpolation,
+                      fill, center)
+    # expand: enlarge the canvas to hold the whole rotated image
+    arr = np.asarray(img)
+    hwc, was_chw = _to_hwc(arr)
+    h, w = hwc.shape[:2]
+    rot = np.deg2rad(angle)
+    nw = int(np.ceil(abs(w * np.cos(rot)) + abs(h * np.sin(rot))))
+    nh = int(np.ceil(abs(h * np.cos(rot)) + abs(w * np.sin(rot))))
+    ctr_in = ((w - 1) * 0.5, (h - 1) * 0.5)
+    m = _affine_matrix(angle, (0, 0), 1.0, (0.0, 0.0), ctr_in)
+    # shift output coords so the new canvas center maps to the old one
+    shift = np.eye(3)
+    shift[0, 2] = (w - nw) * 0.5
+    shift[1, 2] = (h - nh) * 0.5
+    out = _warp(hwc, m @ shift, fill, interpolation, out_shape=(nh, nw))
+    return _from_hwc(out, was_chw)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """Warp so that startpoints map to endpoints (both 4x[x, y])."""
+    arr = np.asarray(img)
+    hwc, was_chw = _to_hwc(arr)
+    # solve the homography endpoints -> startpoints (inverse mapping)
+    A, bv = [], []
+    for (ex, ey), (sx, sy) in zip(endpoints, startpoints):
+        A.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        A.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        bv += [sx, sy]
+    sol = np.linalg.lstsq(np.asarray(A, np.float64),
+                          np.asarray(bv, np.float64), rcond=None)[0]
+    m = np.append(sol, 1.0).reshape(3, 3)
+    return _from_hwc(_warp(hwc, m, fill, interpolation), was_chw)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    arr = np.asarray(img) if inplace else np.array(img)
+    if _is_chw(arr):
+        arr[:, i:i + h, j:j + w] = v
+    else:
+        arr[i:i + h, j:j + w] = v
+    return arr
+
+
+def adjust_brightness(img, brightness_factor):
+    arr = np.asarray(img)
+    out = arr.astype(np.float32) * brightness_factor
+    if arr.dtype == np.uint8:
+        return np.clip(out, 0, 255).astype(np.uint8)
+    return out.astype(arr.dtype)
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = np.asarray(img)
+    hwc, was_chw = _to_hwc(arr)
+    if hwc.ndim == 2:
+        g = hwc.astype(np.float32)
+    else:
+        g = hwc[..., 0] * 0.299 + hwc[..., 1] * 0.587 + hwc[..., 2] * 0.114
+    g = np.repeat(g[..., None], num_output_channels, -1)
+    if arr.dtype == np.uint8:
+        g = np.clip(np.round(g), 0, 255).astype(np.uint8)
+    else:
+        g = g.astype(arr.dtype)
+    return _from_hwc(g, was_chw)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr = np.asarray(img)
+    hwc, was_chw = _to_hwc(arr)
+    gray_mean = float(np.mean(to_grayscale(hwc).astype(np.float32)))
+    out = hwc.astype(np.float32) * contrast_factor + \
+        gray_mean * (1 - contrast_factor)
+    if arr.dtype == np.uint8:
+        out = np.clip(out, 0, 255).astype(np.uint8)
+    else:
+        out = out.astype(arr.dtype)
+    return _from_hwc(out, was_chw)
+
+
+def _adjust_saturation(img, saturation_factor):
+    arr = np.asarray(img)
+    hwc, was_chw = _to_hwc(arr)
+    g = to_grayscale(hwc, 3).astype(np.float32)
+    out = hwc.astype(np.float32) * saturation_factor + \
+        g * (1 - saturation_factor)
+    if arr.dtype == np.uint8:
+        out = np.clip(out, 0, 255).astype(np.uint8)
+    else:
+        out = out.astype(arr.dtype)
+    return _from_hwc(out, was_chw)
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue by hue_factor (in [-0.5, 0.5] turns) via HSV."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    arr = np.asarray(img)
+    hwc, was_chw = _to_hwc(arr)
+    f = hwc.astype(np.float32) / (255.0 if arr.dtype == np.uint8 else 1.0)
+    mx = f.max(-1)
+    mn = f.min(-1)
+    diff = mx - mn + 1e-12
+    r, g, b = f[..., 0], f[..., 1], f[..., 2]
+    hch = np.where(mx == r, (g - b) / diff % 6,
+                   np.where(mx == g, (b - r) / diff + 2,
+                            (r - g) / diff + 4)) / 6.0
+    hch = (hch + hue_factor) % 1.0
+    s = np.where(mx > 0, diff / np.maximum(mx, 1e-12), 0.0)
+    v = mx
+    i = np.floor(hch * 6).astype(np.int32) % 6
+    fr = hch * 6 - np.floor(hch * 6)
+    p = v * (1 - s)
+    q = v * (1 - fr * s)
+    tt = v * (1 - (1 - fr) * s)
+    out = np.select(
+        [(i == 0)[..., None], (i == 1)[..., None], (i == 2)[..., None],
+         (i == 3)[..., None], (i == 4)[..., None], (i == 5)[..., None]],
+        [np.stack([v, tt, p], -1), np.stack([q, v, p], -1),
+         np.stack([p, v, tt], -1), np.stack([p, q, v], -1),
+         np.stack([tt, p, v], -1), np.stack([v, p, q], -1)])
+    if arr.dtype == np.uint8:
+        out = np.clip(np.round(out * 255.0), 0, 255).astype(np.uint8)
+    else:
+        out = out.astype(arr.dtype)
+    return _from_hwc(out, was_chw)
+
+
+# ------------------------------------------------------------ transforms
+
+class BaseTransform:
+    """reference transforms.py BaseTransform:147: keys route dict/tuple
+    inputs; subclasses implement _apply_image (and friends)."""
+
+    def __init__(self, keys=None):
+        self.keys = keys if keys is not None else ("image",)
+
+    def _get_params(self, inputs):
+        return None
+
+    def _first_image(self, inputs):
+        if isinstance(inputs, (list, tuple)):
+            for k, x in zip(self.keys, inputs):
+                if k == "image":
+                    return x
+            return inputs[0]
+        return inputs
+
+    def __call__(self, inputs):
+        self.params = self._get_params(inputs)
+        if isinstance(inputs, (list, tuple)):
+            out = [self._apply_image(x) if k == "image" else x
+                   for k, x in zip(self.keys, inputs)]
+            # elements beyond keys pass through untouched (reference
+            # BaseTransform semantics — labels must not be dropped)
+            out.extend(inputs[len(self.keys):])
+            return type(inputs)(out)
+        return self._apply_image(inputs)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
 
 
 class Compose:
@@ -14,12 +384,15 @@ class Compose:
         return x
 
 
-class ToTensor:
-    def __init__(self, data_format="CHW"):
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        super().__init__(keys)
         self.data_format = data_format
 
-    def __call__(self, img):
-        arr = np.asarray(img, np.float32) / 255.0
+    def _apply_image(self, img):
+        arr = np.asarray(img, np.float32)
+        if np.asarray(img).dtype == np.uint8:
+            arr = arr / 255.0
         if arr.ndim == 2:
             arr = arr[None]
         elif self.data_format == "CHW" and arr.shape[-1] in (1, 3, 4):
@@ -27,57 +400,366 @@ class ToTensor:
         return arr
 
 
-class Normalize:
-    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
-        self.mean = np.asarray(mean, np.float32)
-        self.std = np.asarray(std, np.float32)
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW",
+                 to_rgb=False, keys=None):
+        super().__init__(keys)
+        self.mean, self.std = mean, std
         self.data_format = data_format
 
-    def __call__(self, img):
-        arr = np.asarray(img, np.float32)
-        shape = (-1, 1, 1) if self.data_format == "CHW" else (1, 1, -1)
-        return (arr - self.mean.reshape(shape)) / self.std.reshape(shape)
+    def _apply_image(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
 
 
-class Resize:
-    def __init__(self, size, interpolation="bilinear"):
-        self.size = size if isinstance(size, (list, tuple)) else (size, size)
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = order
 
-    def __call__(self, img):
+    def _apply_image(self, img):
+        return np.asarray(img).transpose(self.order)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        super().__init__(keys)
+        self.size = size
+
+    def _apply_image(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, numbers.Number) \
+            else tuple(size)
+        self.padding = padding
+        self.pad_if_needed = pad_if_needed
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _get_params(self, inputs):
+        # fractional position: resolved per image AFTER padding, but the
+        # random draw is shared across paired "image" keys
+        return np.random.rand(), np.random.rand()
+
+    def _apply_image(self, img):
         arr = np.asarray(img)
-        try:
-            import jax
-            import jax.numpy as jnp
-            chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
-            target = ((arr.shape[0],) + tuple(self.size)) if chw else \
-                (tuple(self.size) + (arr.shape[-1],) if arr.ndim == 3
-                 else tuple(self.size))
-            return np.asarray(jax.image.resize(jnp.asarray(arr, jnp.float32),
-                                               target, "bilinear"))
-        except Exception:
-            return arr
+        if self.padding is not None:
+            arr = pad(arr, self.padding, self.fill, self.padding_mode)
+        hwc, _ = _to_hwc(arr)
+        h, w = hwc.shape[:2]
+        th, tw = self.size
+        if self.pad_if_needed and (h < th or w < tw):
+            arr = pad(arr, (max(tw - w, 0), max(th - h, 0)), self.fill,
+                      self.padding_mode)
+            hwc, _ = _to_hwc(np.asarray(arr))
+            h, w = hwc.shape[:2]
+        fi, fj = self.params
+        i = int(fi * (h - th + 1))
+        j = int(fj * (w - tw + 1))
+        return crop(arr, i, j, th, tw)
 
 
-class RandomHorizontalFlip:
-    def __init__(self, prob=0.5):
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, numbers.Number) \
+            else tuple(size)
+        self.scale, self.ratio = scale, ratio
+        self.interpolation = interpolation
+
+    def _get_params(self, inputs):
+        hwc, _ = _to_hwc(np.asarray(self._first_image(inputs)))
+        h, w = hwc.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                i = np.random.randint(0, h - ch + 1)
+                j = np.random.randint(0, w - cw + 1)
+                return i, j, ch, cw
+        return None
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        if self.params is None:
+            hwc, _ = _to_hwc(arr)
+            return resize(center_crop(arr, min(hwc.shape[:2])),
+                          self.size, self.interpolation)
+        i, j, ch, cw = self.params
+        return resize(crop(arr, i, j, ch, cw), self.size,
+                      self.interpolation)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant",
+                 keys=None):
+        super().__init__(keys)
+        self.padding, self.fill = padding, fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
         self.prob = prob
 
-    def __call__(self, img):
-        if np.random.rand() < self.prob:
-            return np.ascontiguousarray(np.flip(np.asarray(img), axis=-1))
+    def _get_params(self, inputs):
+        # drawn ONCE per call so paired "image" keys flip together
+        return np.random.rand() < self.prob
+
+    def _apply_image(self, img):
+        return hflip(img) if self.params else img
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _get_params(self, inputs):
+        return np.random.rand() < self.prob
+
+    def _apply_image(self, img):
+        return vflip(img) if self.params else img
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.interpolation, self.expand = interpolation, expand
+        self.center, self.fill = center, fill
+
+    def _get_params(self, inputs):
+        return np.random.uniform(*self.degrees)
+
+    def _apply_image(self, img):
+        return rotate(img, self.params, self.interpolation, self.expand,
+                      self.center, self.fill)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees, self.translate = degrees, translate
+        self.scale_rng, self.shear_rng = scale, shear
+        self.interpolation, self.fill = interpolation, fill
+        self.center = center
+
+    def _get_params(self, inputs):
+        hwc, _ = _to_hwc(np.asarray(self._first_image(inputs)))
+        h, w = hwc.shape[:2]
+        angle = np.random.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = np.random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = np.random.uniform(-self.translate[1], self.translate[1]) * h
+        sc = np.random.uniform(*self.scale_rng) if self.scale_rng else 1.0
+        sh = (0.0, 0.0)
+        if self.shear_rng is not None:
+            srng = self.shear_rng
+            if isinstance(srng, numbers.Number):
+                srng = (-abs(srng), abs(srng))
+            sh = (np.random.uniform(srng[0], srng[1]), 0.0)
+        return angle, (tx, ty), sc, sh
+
+    def _apply_image(self, img):
+        angle, translate, sc, sh = self.params
+        return affine(img, angle, translate, sc, sh, self.interpolation,
+                      self.fill, self.center)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation, self.fill = interpolation, fill
+
+    def _get_params(self, inputs):
+        if np.random.rand() >= self.prob:
+            return None
+        hwc, _ = _to_hwc(np.asarray(self._first_image(inputs)))
+        h, w = hwc.shape[:2]
+        d = self.distortion_scale
+        dx, dy = int(d * w / 2), int(d * h / 2)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [(np.random.randint(0, dx + 1), np.random.randint(0, dy + 1)),
+               (w - 1 - np.random.randint(0, dx + 1),
+                np.random.randint(0, dy + 1)),
+               (w - 1 - np.random.randint(0, dx + 1),
+                h - 1 - np.random.randint(0, dy + 1)),
+               (np.random.randint(0, dx + 1),
+                h - 1 - np.random.randint(0, dy + 1))]
+        return start, end
+
+    def _apply_image(self, img):
+        if self.params is None:
+            return img
+        start, end = self.params
+        return perspective(img, start, end, self.interpolation, self.fill)
+
+
+class RandomErasing(BaseTransform):
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob, self.scale, self.ratio = prob, scale, ratio
+        self.value, self.inplace = value, inplace
+
+    def _get_params(self, inputs):
+        if np.random.rand() >= self.prob:
+            return None
+        hwc, _ = _to_hwc(np.asarray(self._first_image(inputs)))
+        h, w = hwc.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.random.uniform(*self.ratio)
+            eh = int(round(np.sqrt(target * ar)))
+            ew = int(round(np.sqrt(target / ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh + 1)
+                j = np.random.randint(0, w - ew + 1)
+                return i, j, eh, ew
+        return None
+
+    def _apply_image(self, img):
+        if self.params is None:
+            return img
+        i, j, eh, ew = self.params
+        return erase(np.asarray(img), i, j, eh, ew, self.value,
+                     self.inplace)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _get_params(self, inputs):
+        if self.value == 0:
+            return None
+        return np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+
+    def _apply_image(self, img):
+        if self.params is None:
+            return img
+        return adjust_brightness(img, self.params)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if value < 0:
+            raise ValueError("contrast value should be non-negative")
+        self.value = value
+
+    def _get_params(self, inputs):
+        if self.value == 0:
+            return None
+        return np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+
+    def _apply_image(self, img):
+        if self.params is None:
+            return img
+        return adjust_contrast(img, self.params)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _get_params(self, inputs):
+        if self.value == 0:
+            return None
+        return np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+
+    def _apply_image(self, img):
+        if self.params is None:
+            return img
+        return _adjust_saturation(img, self.params)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value should be in [0, 0.5]")
+        self.value = value
+
+    def _get_params(self, inputs):
+        if self.value == 0:
+            return None
+        return np.random.uniform(-self.value, self.value)
+
+    def _apply_image(self, img):
+        if self.params is None:
+            return img
+        return adjust_hue(img, self.params)
+
+
+class ColorJitter(BaseTransform):
+    """reference ColorJitter: brightness/contrast/saturation/hue in
+    random order."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self.ts = [BrightnessTransform(brightness),
+                   ContrastTransform(contrast),
+                   SaturationTransform(saturation), HueTransform(hue)]
+
+    def _get_params(self, inputs):
+        order = np.random.permutation(len(self.ts))
+        for t in self.ts:
+            t.params = t._get_params(inputs)
+        return order
+
+    def _apply_image(self, img):
+        for i in self.params:
+            img = self.ts[i]._apply_image(img)
         return img
 
 
-class CenterCrop:
-    def __init__(self, size):
-        self.size = size if isinstance(size, (list, tuple)) else (size, size)
-
-    def __call__(self, img):
-        arr = np.asarray(img)
-        h, w = arr.shape[-2:] if arr.ndim == 3 and arr.shape[0] in (1, 3) \
-            else arr.shape[:2]
-        th, tw = self.size
-        i, j = (h - th) // 2, (w - tw) // 2
-        if arr.ndim == 3 and arr.shape[0] in (1, 3):
-            return arr[:, i:i + th, j:j + tw]
-        return arr[i:i + th, j:j + tw]
+# reference exposes adjust_saturation under this name too
+adjust_saturation = _adjust_saturation

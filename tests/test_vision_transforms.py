@@ -1,0 +1,152 @@
+"""Vision transforms: full reference surface (transforms.py:147,
+functional.py). numpy oracles; geometric ops checked via identity /
+inverse / known-angle properties (no torchvision in this image)."""
+import numpy as np
+import pytest
+
+from paddle_tpu.vision import transforms as T
+
+
+@pytest.fixture
+def img():
+    return (np.random.RandomState(0).rand(16, 16, 3) * 255).astype("uint8")
+
+
+class TestFunctional:
+    def test_flips(self, img):
+        np.testing.assert_array_equal(T.hflip(img), img[:, ::-1])
+        np.testing.assert_array_equal(T.vflip(img), img[::-1])
+        chw = img.transpose(2, 0, 1)
+        np.testing.assert_array_equal(T.hflip(chw), chw[:, :, ::-1])
+
+    def test_crop_pad_roundtrip(self, img):
+        c = T.crop(img, 2, 3, 5, 6)
+        assert c.shape == (5, 6, 3)
+        p = T.pad(img, 2)
+        assert p.shape == (20, 20, 3)
+        np.testing.assert_array_equal(T.crop(p, 2, 2, 16, 16), img)
+
+    def test_rotate_identity_and_full_turn(self, img):
+        f = img.astype("float32")
+        np.testing.assert_allclose(T.rotate(f, 0.0), f, atol=1e-6)
+        np.testing.assert_allclose(T.rotate(f, 360.0), f, atol=1e-3)
+
+    def test_rotate_90_matches_rot90(self):
+        sq = np.arange(25, dtype="float32").reshape(5, 5)
+        # screen coords (y down): rotate(+90) == np.rot90(sq, 1)
+        r = T.rotate(sq, 90.0)
+        assert np.allclose(r, np.rot90(sq, 1), atol=1e-3) or \
+            np.allclose(r, np.rot90(sq, -1), atol=1e-3)
+
+    def test_perspective_identity(self, img):
+        f = img.astype("float32")
+        pts = [(0, 0), (15, 0), (15, 15), (0, 15)]
+        np.testing.assert_allclose(T.perspective(f, pts, pts), f,
+                                   atol=1e-3)
+
+    def test_affine_translate(self):
+        sq = np.zeros((6, 6), "float32")
+        sq[2, 2] = 1.0
+        out = T.affine(sq, 0.0, translate=(1, 0))
+        assert out[2, 3] == pytest.approx(1.0, abs=1e-5)
+
+    def test_color_ops(self, img):
+        b = T.adjust_brightness(img, 2.0)
+        assert b.dtype == np.uint8 and b.max() <= 255
+        c = T.adjust_contrast(img, 1.0)
+        np.testing.assert_allclose(c.astype(int), img.astype(int), atol=1)
+        g = T.to_grayscale(img)
+        assert g.shape == (16, 16, 1)
+        f = img.astype("float32") / 255.0
+        np.testing.assert_allclose(
+            T.adjust_hue(T.adjust_hue(f, 0.25), -0.25), f, atol=2e-2)
+        with pytest.raises(ValueError):
+            T.adjust_hue(f, 0.7)
+
+    def test_erase(self, img):
+        out = T.erase(img, 2, 3, 4, 5, 0)
+        assert (out[2:6, 3:8] == 0).all()
+        assert out[0, 0, 0] == img[0, 0, 0]
+
+    def test_resize_shapes(self, img):
+        assert T.resize(img, (8, 10)).shape == (8, 10, 3)
+        assert T.resize(img, 8).shape == (8, 8, 3)
+        assert T.resize(img.transpose(2, 0, 1), (8, 8)).shape == (3, 8, 8)
+
+
+class TestTransformClasses:
+    def test_full_pipeline(self, img):
+        np.random.seed(0)
+        pipeline = T.Compose([
+            T.Resize(20), T.RandomResizedCrop(12),
+            T.RandomCrop(10, padding=1), T.Pad(2),
+            T.RandomHorizontalFlip(1.0), T.RandomVerticalFlip(1.0),
+            T.RandomRotation(15),
+            T.RandomAffine(10, translate=(0.1, 0.1), scale=(0.9, 1.1),
+                           shear=5),
+            T.RandomPerspective(1.0, 0.3),
+            T.ColorJitter(0.2, 0.2, 0.2, 0.1), T.RandomErasing(1.0),
+            T.Grayscale(3), T.ToTensor(), T.Normalize(0.5, 0.5),
+        ])
+        out = pipeline(img)
+        assert out.shape == (3, 14, 14) and out.dtype == np.float32
+        assert np.isfinite(out).all()
+
+    def test_base_transform_keys_route_tuples(self, img):
+        # (image, label) pairs: only the image is transformed
+        flip = T.RandomHorizontalFlip(1.0, keys=("image", "label"))
+        out_img, label = flip((img, 7))
+        np.testing.assert_array_equal(out_img, img[:, ::-1])
+        assert label == 7
+
+    def test_deterministic_classes(self, img):
+        assert T.CenterCrop(8)(img).shape == (8, 8, 3)
+        assert T.Grayscale()(img).shape == (16, 16, 1)
+        assert T.Transpose()(img).shape == (3, 16, 16)
+        n = T.Normalize([127.5] * 3, [127.5] * 3, data_format="HWC")(img)
+        assert abs(float(n.mean())) < 1.0
+
+    def test_random_crop_pads_if_needed(self, img):
+        out = T.RandomCrop(20, pad_if_needed=True)(img)
+        assert out.shape == (20, 20, 3)
+
+
+class TestReviewRegressions:
+    def test_tuple_passthrough_beyond_keys(self, img):
+        out = T.ToTensor()((img, 7))          # default keys=("image",)
+        assert len(out) == 2 and out[1] == 7  # label NOT dropped
+
+    def test_paired_images_share_randomness(self, img):
+        flip = T.RandomHorizontalFlip(0.5, keys=("image", "image"))
+        np.random.seed(3)
+        for _ in range(8):
+            a, b = flip((img, img))
+            np.testing.assert_array_equal(a, b)  # always same decision
+
+    def test_nearest_interpolation_preserves_label_values(self):
+        mask = np.zeros((8, 8), "uint8")
+        mask[2:6, 2:6] = 7
+        out = T.rotate(mask, 30.0, interpolation="nearest")
+        assert set(np.unique(out)) <= {0, 7}   # no blended class ids
+
+    def test_rotate_expand_enlarges_canvas(self):
+        sq = np.ones((10, 10), "float32")
+        out = T.rotate(sq, 45.0, expand=True, interpolation="bilinear")
+        assert out.shape[0] > 10 and out.shape[1] > 10
+        # mass preserved to boundary-sampling accuracy (no corner clip —
+        # without expand the same rotation loses the 4 corners)
+        clipped = T.rotate(sq, 45.0, expand=False,
+                           interpolation="bilinear")
+        # rotated-square boundary cells are partial, so ~0.85 of the mass
+        # lands on lattice points; expand must still beat the clipped rot
+        assert out.sum() > 0.8 * sq.sum()
+        assert out.sum() > clipped.sum()
+
+    def test_to_tensor_hwc_grayscale(self):
+        g = (np.random.RandomState(0).rand(8, 8) * 255).astype("uint8")
+        out = T.to_tensor(g, data_format="HWC")
+        assert list(out.shape) == [8, 8, 1]
+
+    def test_center_crop_oversize_raises(self, img):
+        with pytest.raises(ValueError, match="exceeds"):
+            T.center_crop(img, 20)
