@@ -50,3 +50,122 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
     return procs
 
 from . import rpc  # noqa: F401
+
+# ---- reference __all__ completions (python/paddle/distributed/__init__.py)
+from .communication.collective import (  # noqa: F401,E402
+    all_to_all as alltoall, all_to_all_single as alltoall_single,
+)
+from . import launch  # noqa: F401,E402  (the runnable launcher package)
+
+
+class ParallelMode:
+    """reference parallel.py ParallelMode constants."""
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+def is_available():
+    """reference: whether the distributed package can be used. Always
+    true here — single-process SPMD works without any env setup."""
+    return True
+
+
+def get_backend(group=None):
+    """reference parallel.py get_backend: the communication backend
+    name. XLA collectives ride ICI/DCN; the store-backed eager path is
+    the gloo analog."""
+    return "xla"
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """reference: bootstrap the gloo CPU barrier backend. Subsumed by
+    init_parallel_env's TCPStore rendezvous; provided for API parity."""
+    import os
+    # explicit arguments OVERRIDE the environment — a stale
+    # PADDLE_TRAINER_ID from a prior launch must not win over the
+    # caller's rank
+    os.environ["PADDLE_TRAINER_ID"] = str(rank_id)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(rank_num)
+    os.environ["PADDLE_MASTER"] = server_endpoint
+    return init_parallel_env()
+
+
+def gloo_barrier():
+    from .communication.collective import barrier
+    return barrier()
+
+
+def gloo_release():
+    """Tear down the rendezvous resources (reference gloo_release)."""
+    return None
+
+
+# PS / recsys dataset surface: out of core scope (SURVEY §2.3 excludes
+# the parameter-server stack); names exist and fail loudly with the
+# reason rather than silently missing.
+def _ps_out_of_scope(name):
+    class _PS:
+        def __init__(self, *a, **k):
+            raise NotImplementedError(
+                f"{name} belongs to the parameter-server/recsys stack, "
+                "which SURVEY §2.3 excludes from the TPU core scope; "
+                "use paddle.io.Dataset/DataLoader for data feeding")
+    _PS.__name__ = name
+    return _PS
+
+
+InMemoryDataset = _ps_out_of_scope("InMemoryDataset")
+QueueDataset = _ps_out_of_scope("QueueDataset")
+CountFilterEntry = _ps_out_of_scope("CountFilterEntry")
+ProbabilityEntry = _ps_out_of_scope("ProbabilityEntry")
+ShowClickEntry = _ps_out_of_scope("ShowClickEntry")
+
+from . import io  # noqa: F401,E402
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """reference communication wait: block until the tensor's pending
+    collective lands. XLA orders by data dependence; a device sync is
+    the strongest equivalent."""
+    arr = tensor._data if hasattr(tensor, "_data") else tensor
+    try:
+        arr.block_until_ready()
+    except AttributeError:
+        pass
+    return tensor
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """reference distributed.split (parallel layers helper): run a
+    linear/embedding with its weight split over model-parallel ranks.
+    GSPMD subsumes the manual partitioning — the fleet TP layers
+    (Column/RowParallelLinear, VocabParallelEmbedding) are the
+    TPU-native implementation; this wrapper instantiates the right one."""
+    from .fleet.meta_parallel import (ColumnParallelLinear,
+                                      RowParallelLinear,
+                                      VocabParallelEmbedding)
+    if operation == "embedding" and axis != 0:
+        raise ValueError(
+            "split(operation='embedding') only supports axis=0 "
+            "(vocab-dimension partitioning), matching the reference")
+    has_bias = bias_attr is not False
+    if operation == "linear":
+        if axis == 0:
+            layer = RowParallelLinear(size[0], size[1],
+                                      input_is_parallel=False,
+                                      weight_attr=weight_attr,
+                                      has_bias=has_bias)
+        else:
+            layer = ColumnParallelLinear(size[0], size[1],
+                                         gather_output=gather_out,
+                                         weight_attr=weight_attr,
+                                         has_bias=has_bias)
+        return layer(x)
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1],
+                                       weight_attr=weight_attr)
+        return layer(x)
+    raise ValueError(f"unsupported split operation {operation!r}")

@@ -1,0 +1,30 @@
+"""paddle.distributed.io (reference distributed/io.py): save/load for
+distributed training — on TPU the sharded checkpoint module
+(framework/checkpoint.py) is the real mechanism; these wrappers keep the
+reference entry points."""
+from __future__ import annotations
+
+
+def save_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    """reference io.py save_persistables: persist trainable state. The
+    static Program tracks its layers; delegate to paddle.save."""
+    import paddle_tpu as paddle
+    if main_program is None or not hasattr(main_program, "state_dict"):
+        raise TypeError(
+            "save_persistables needs a program/layer exposing "
+            "state_dict(); got "
+            f"{type(main_program).__name__} (silently writing an empty "
+            "checkpoint would lose the training state)")
+    state = main_program.state_dict()
+    paddle.save(state, (dirname or ".") + "/" + (filename or "__params__"))
+
+
+def load_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    import paddle_tpu as paddle
+    return paddle.load((dirname or ".") + "/" + (filename or "__params__"))
+
+
+def is_persistable(var):
+    return getattr(var, "persistable", True)

@@ -523,3 +523,62 @@ class TestEngineAPI:
         hist = eng.fit(loader, epochs=1)
         assert len(hist["loss"]) == 2        # 32/16 batches
         assert all(np.isfinite(l) for l in hist["loss"])
+
+
+class TestDistributedNamespaceCompletions:
+    def test_alltoall_aliases(self):
+        assert dist.alltoall is dist.all_to_all
+        assert dist.alltoall_single is dist.all_to_all_single
+
+    def test_backend_and_availability(self):
+        assert dist.is_available() is True
+        assert dist.get_backend() == "xla"
+        assert dist.ParallelMode.TENSOR_PARALLEL == 1
+
+    def test_wait_syncs(self):
+        x = paddle.to_tensor([1.0, 2.0])
+        assert dist.wait(x) is x
+
+    def test_split_linear_matches_dense(self):
+        # value-level: on a 1-rank mesh the parallel layer must equal a
+        # plain dense linear with the SAME weights
+        paddle.seed(0)
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(2, 6).astype("float32"))
+        from unittest import mock
+        captured = {}
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            ColumnParallelLinear)
+        orig_call = ColumnParallelLinear.forward
+
+        def spy(self, inp):
+            captured["layer"] = self
+            return orig_call(self, inp)
+        with mock.patch.object(ColumnParallelLinear, "forward", spy):
+            out = dist.split(x, (6, 8), "linear", axis=1)
+        lyr = captured["layer"]
+        expect = x.numpy() @ lyr.weight.numpy() + lyr.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), expect, rtol=1e-5,
+                                   atol=1e-5)
+        out_e = dist.split(paddle.to_tensor(np.array([[1, 3]], "int64")),
+                           (16, 4), "embedding")
+        assert out_e.shape == [1, 2, 4]
+        with pytest.raises(ValueError, match="axis=0"):
+            dist.split(x, (16, 4), "embedding", axis=1)
+
+    def test_ps_surface_fails_loudly(self):
+        with pytest.raises(NotImplementedError, match="parameter-server"):
+            dist.InMemoryDataset()
+
+    def test_io_persistables_roundtrip(self, tmp_path):
+        import paddle_tpu as P
+        state = {"w": paddle.to_tensor(np.ones(3, "float32"))}
+
+        class FakeProg:
+            def state_dict(self):
+                return state
+        dist.io.save_persistables(None, str(tmp_path), FakeProg())
+        loaded = dist.io.load_persistables(None, str(tmp_path))
+        np.testing.assert_allclose(np.asarray(loaded["w"].numpy()
+                                   if hasattr(loaded["w"], "numpy")
+                                   else loaded["w"]), [1, 1, 1])
