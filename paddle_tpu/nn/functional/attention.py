@@ -17,6 +17,12 @@ from ...core.dispatch import apply_op
 
 def _sdpa_reference(q, k, v, mask=None, dropout_p=0.0, causal=False, scale=None):
     # q,k,v: [batch, seq, heads, head_dim] (paddle flash-attn layout)
+    if mask is None and dropout_p == 0.0:
+        # the maskless dense math lives in ONE place —
+        # ops/flash_attention.attention_bshd (bf16 matmuls, f32 softmax)
+        from ...ops.flash_attention import attention_bshd
+        return attention_bshd(q, k, v, causal=causal, scale=scale,
+                              use_flash=False)
     s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     qt = jnp.swapaxes(q, 1, 2)  # [b, h, sq, d]
     kt = jnp.swapaxes(k, 1, 2)

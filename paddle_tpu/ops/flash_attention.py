@@ -107,8 +107,21 @@ def attention_bshd(q, k, v, causal=False, scale=None, use_flash=True):
     so the gating can never diverge between them."""
     if use_flash and preferred(q, k, v, None, causal):
         return flash_attention_bshd(q, k, v, causal=causal, scale=scale)
-    from .pallas_attention import _mha_reference
+    # dense path: matmuls stay in the INPUT dtype (bf16 under AMP — the
+    # MXU fast path; _mha_reference is the f32-matmul test oracle and
+    # routing production traffic through it cost 24% of the train step),
+    # only the softmax accumulates in f32
     s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
-    out = _mha_reference(jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
-                         jnp.swapaxes(v, 1, 2), causal, s)
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * \
+        jnp.asarray(s, qt.dtype)
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(cm, logits, jnp.asarray(-1e30, logits.dtype))
+    probs = jax.nn.softmax(logits.astype(jnp.float32),
+                           axis=-1).astype(qt.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
     return jnp.swapaxes(out, 1, 2)
