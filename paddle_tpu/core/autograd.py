@@ -105,11 +105,13 @@ class GradNode:
 
     __slots__ = (
         "vjp_fn", "input_refs", "n_outputs", "name", "_hooks",
-        "out_templates", "__weakref__",
+        "out_templates", "primal_fn", "primal_args", "multi_out",
+        "__weakref__",
     )
 
     def __init__(self, vjp_fn, inputs, n_outputs: int, name: str = "op",
-                 out_templates=None):
+                 out_templates=None, primal_fn=None, primal_args=None,
+                 multi_out=None):
         self.vjp_fn = vjp_fn
         self.input_refs = [InputRef(t) for t in inputs]
         self.n_outputs = n_outputs
@@ -118,6 +120,16 @@ class GradNode:
         # (shape, dtype) per output — used to build zero cotangents for
         # outputs never consumed downstream.
         self.out_templates = out_templates or []
+        # Primal op + input-array snapshot. Enables (a) forward-mode JVP
+        # over the recorded tape (incubate.autograd.forward_grad) and
+        # (b) create_graph=True: backward re-runs jax.vjp(primal_fn)
+        # through dispatch so the pullback application is itself recorded
+        # (the reference's double-grad nodes, eager/backward.cc:404).
+        self.primal_fn = primal_fn
+        self.primal_args = primal_args
+        # Whether the primal returned a tuple/list (a 1-tuple op must get a
+        # 1-tuple cotangent — n_outputs alone cannot distinguish it).
+        self.multi_out = (n_outputs > 1) if multi_out is None else multi_out
 
     def next_nodes(self):
         return [r.node for r in self.input_refs if r.node is not None]
@@ -125,6 +137,8 @@ class GradNode:
     def release(self):
         self.vjp_fn = None
         self.input_refs = []
+        self.primal_fn = None
+        self.primal_args = None
 
 
 def _is_float0(x) -> bool:
@@ -140,7 +154,7 @@ def _accum(a, b):
 
 
 def backward(tensors, grad_tensors=None, retain_graph: bool = False,
-             accumulate_only=None):
+             accumulate_only=None, create_graph: bool = False):
     """Run reverse accumulation from ``tensors`` (paddle.autograd.backward).
 
     Mirrors RunBackward (/root/reference/paddle/fluid/eager/backward.cc:104):
@@ -151,6 +165,13 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False,
     ``accumulate_only``: optional set of tensor ids — when given, only those
     leaves receive ``.grad`` (used by paddle.grad so unrelated parameters'
     ``.grad`` is never touched).
+
+    ``create_graph``: when True the pullback of every node is re-executed
+    through dispatch (``_call_vjp_rerecord``) with the original inputs and
+    the cotangents as differentiable Tensors, so the backward computation
+    itself records GradNodes — grads carry a graph and can be differentiated
+    again (the reference's double-grad nodes, eager/backward.cc:404 +
+    generated higher-order *GradNode classes).
     """
     from .tensor import Tensor
 
@@ -161,7 +182,8 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False,
     elif isinstance(grad_tensors, Tensor):
         grad_tensors = [grad_tensors]
 
-    # Seed cotangents.
+    # Seed cotangents. In create_graph mode cotangents stay Tensors end to
+    # end (so `_accum`'s `a + b` dispatches and is itself recorded).
     node_cots = {}  # id(node) -> list of cotangents per output index
     roots = []
     for t, g in zip(tensors, grad_tensors):
@@ -175,6 +197,11 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False,
                     f"got shape {t.shape}"
                 )
             gval = jax.numpy.ones_like(t._data)
+            if create_graph:
+                gval = Tensor(gval, stop_gradient=True)
+        elif create_graph:
+            gval = g if isinstance(g, Tensor) else Tensor(
+                jax.numpy.asarray(g), stop_gradient=True)
         else:
             gval = g._data if isinstance(g, Tensor) else jax.numpy.asarray(g)
         nid = id(node)
@@ -222,7 +249,10 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False,
             # without computing, so downstream in-degrees still drain.
             in_cots = [None] * len(node.input_refs)
         else:
-            in_cots = _call_vjp(node, cots)
+            if create_graph:
+                in_cots = _call_vjp_rerecord(node, cots)
+            else:
+                in_cots = _call_vjp(node, cots)
             if node._hooks:
                 for hook in node._hooks:
                     in_cots = hook(in_cots)
@@ -240,7 +270,7 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False,
                 )
             if usable and nxt is None and not t.stop_gradient:
                 if accumulate_only is None or id(t) in accumulate_only:
-                    _accumulate_leaf_grad(t, c)
+                    _accumulate_leaf_grad(t, c, keep_graph=create_graph)
             if nxt is not None:
                 # ALWAYS drain the edge, even for None/float0 cotangents —
                 # otherwise nodes with a non-diff consumer never fire.
@@ -248,7 +278,7 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False,
                 indeg[xid] -= 1
                 if indeg[xid] <= 0:
                     ready.append(nxt)
-        if not retain_graph:
+        if not retain_graph and not create_graph:
             node.release()
 
 
@@ -272,15 +302,129 @@ def _call_vjp(node, cots):
                     and jax.numpy.issubdtype(dtype, jax.numpy.inexact)):
                 c = jax.numpy.asarray(c).astype(dtype)
         filled.append(c)
-    if node.n_outputs == 1:
+    if not node.multi_out:
         return node.vjp_fn(filled[0])
     return node.vjp_fn(tuple(filled))
 
 
-def _accumulate_leaf_grad(t, cot):
+def _call_vjp_rerecord(node, cots):
+    """create_graph path: rebuild the node's pullback from ``primal_fn`` and
+    apply it THROUGH dispatch, with the original input Tensors and the
+    cotangent Tensors as differentiable args. The produced grads therefore
+    carry GradNodes of their own — including the dependence of the pullback
+    on the primal inputs (residuals), which pure pullback-of-cotangent
+    differentiation would miss (that term is exactly ∂²L/∂x²)."""
+    from .dispatch import apply_op
     from .tensor import Tensor
 
-    cot = jax.numpy.asarray(cot)
+    if node.primal_fn is None:
+        if node.vjp_fn is not None:
+            # node exists but was built without a primal (PyLayer / custom
+            # ops construct GradNode directly) — name the actual limitation
+            raise NotImplementedError(
+                f"create_graph=True through op '{node.name}' is not "
+                f"supported: its GradNode has no primal record (custom "
+                f"PyLayer/op backward). Use jax-transform composition "
+                f"(autograd.functional) for higher-order grads of custom "
+                f"ops.")
+        raise RuntimeError(
+            "Trying to backward with create_graph=True through a released "
+            "graph; the forward must run with grad enabled in this process."
+        )
+    n_in = len(node.input_refs)
+    # Record-time value snapshots: an in-place op or optimizer step may have
+    # rebound tensor._data since the forward (the InputRef/TensorWrapper
+    # hazard). When the tensor still holds the recorded array, pass it
+    # directly so second-order grads connect to its graph; when mutated,
+    # substitute a shadow tensor wrapping the snapshot with the ORIGINAL
+    # producer edge, so the pullback evaluates at the correct point.
+    from .tensor import Tensor as _T
+    primal_tensors = []
+    for r, snap in zip(node.input_refs, node.primal_args):
+        t = r.tensor
+        if t._data is not snap:
+            t = _T(snap, stop_gradient=r.tensor.stop_gradient)
+            t._grad_node = r.node
+            t._output_index = r.output_index
+            t.is_leaf = r.node is None
+        primal_tensors.append(t)
+    templates = node.out_templates
+    # Output slots that take real (inexact) cotangents; int/bool outputs get
+    # static float0 zeros inside the traced bwd fn.
+    cot_slots = [i for i, (_, dt) in enumerate(templates)
+                 if jax.numpy.issubdtype(dt, jax.numpy.inexact)]
+    cot_tensors = []
+    for i in cot_slots:
+        shape, dtype = templates[i]
+        c = cots[i]
+        if c is None:
+            cot_tensors.append(Tensor(jax.numpy.zeros(shape, dtype),
+                                      stop_gradient=True))
+        elif isinstance(c, Tensor):
+            cot_tensors.append(c)
+        else:
+            cot_tensors.append(Tensor(jax.numpy.asarray(c),
+                                      stop_gradient=True))
+    in_dtypes = [getattr(a, "dtype", None) for a in node.primal_args]
+    keep = [i for i, dt in enumerate(in_dtypes)
+            if dt is not None and jax.numpy.issubdtype(dt, jax.numpy.inexact)]
+    if not keep:
+        return [None] * n_in
+    fn = node.primal_fn
+    cot_slot_set = set(cot_slots)
+
+    def node_bwd(*args):
+        xs = args[:n_in]
+        cs = list(args[n_in:])
+        out, pull = jax.vjp(fn, *xs)
+        multi = isinstance(out, (tuple, list))
+        full = []
+        k = 0
+        for i, (shape, dtype) in enumerate(templates):
+            if i in cot_slot_set:
+                c = cs[k]
+                k += 1
+                if c.dtype != dtype:
+                    c = c.astype(dtype)
+                full.append(c)
+            else:
+                full.append(np.zeros(shape, jax.dtypes.float0))
+        grads = pull(tuple(full) if multi else full[0])
+        return tuple(grads[i] for i in keep)
+
+    outs = apply_op(node.name + "_grad", node_bwd,
+                    *primal_tensors, *cot_tensors)
+    if isinstance(outs, Tensor):
+        outs = (outs,)
+    in_cots = [None] * n_in
+    for j, i in enumerate(keep):
+        in_cots[i] = outs[j]
+    return in_cots
+
+
+def _accumulate_leaf_grad(t, cot, keep_graph: bool = False):
+    from .tensor import Tensor
+
+    if keep_graph and isinstance(cot, Tensor):
+        # create_graph: .grad keeps its GradNode so it can be differentiated
+        # again (paddle semantics: grads have grad_fn under create_graph).
+        if cot._data.dtype != t._data.dtype and jax.numpy.issubdtype(
+                t._data.dtype, jax.numpy.inexact):
+            # dispatch-level cast keeps the graph (matches the non-graph
+            # branch's dtype contract: .grad has the leaf's dtype)
+            from .dispatch import apply_op
+            cot = apply_op("cast", lambda a: a.astype(t._data.dtype), cot)
+        for h in (t._grad_hooks or []):
+            out = h(cot)
+            if out is not None:
+                cot = out
+        if t.grad is None:
+            t.grad = cot
+            t.grad.name = (t.name or "tensor") + "@GRAD"
+        else:
+            t.grad = t.grad + cot
+        return
+    cot = cot._data if isinstance(cot, Tensor) else jax.numpy.asarray(cot)
     if cot.dtype != t._data.dtype and hasattr(cot, "astype"):
         cot = cot.astype(t._data.dtype)
     if t._grad_hooks:
@@ -314,11 +458,9 @@ def grad(
         outputs = [outputs]
     if isinstance(inputs, Tensor):
         inputs = [inputs]
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True (higher-order eager grad) is not supported yet; "
-            "use paddle_tpu.incubate.autograd or jax.grad composition."
-        )
+    if retain_graph is None:
+        # paddle semantics: retain_graph defaults to create_graph.
+        retain_graph = create_graph
     saved = [t.grad for t in inputs]
     saved_stop = [t.stop_gradient for t in inputs]
     for t in inputs:
@@ -327,7 +469,8 @@ def grad(
     try:
         backward(outputs, grad_tensors=grad_outputs,
                  retain_graph=bool(retain_graph),
-                 accumulate_only={id(t) for t in inputs})
+                 accumulate_only={id(t) for t in inputs},
+                 create_graph=create_graph)
         results = []
         for t in inputs:
             g = t.grad

@@ -100,6 +100,7 @@ def apply_op(name: str, jax_fn: Callable, *args, _outputs_stop_grad=None,
         node = GradNode(
             vjp_fn, tensors, n_outputs=len(out_leaves), name=name,
             out_templates=[(o.shape, o.dtype) for o in out_leaves],
+            primal_fn=f, primal_args=arrays, multi_out=multi,
         )
         wrapped = []
         for i, o in enumerate(out_leaves):

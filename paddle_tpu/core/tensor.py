@@ -42,7 +42,12 @@ class Tensor:
         if isinstance(data, Tensor):
             data = data._data
         jdt = dtype_mod.to_jax_dtype(dtype)
-        if isinstance(data, jax.Array):
+        if isinstance(data, jax.ShapeDtypeStruct):
+            # Lazy (abstract) tensor: shape/dtype only, no buffer — created
+            # under paddle.LazyGuard for AOT planning of configs too big to
+            # materialize (reference: fluid/lazy_init.py deferred init).
+            self._data = data
+        elif isinstance(data, jax.Array):
             if jdt is not None and data.dtype != jdt:
                 data = data.astype(jdt)
             self._data = data

@@ -46,6 +46,13 @@ def get_world_size(group=None) -> int:
     return _env_int("PADDLE_TRAINERS_NUM", 1)
 
 
+def jax_distributed_active() -> bool:
+    """True when jax.distributed.initialize ran for this world — eager
+    collectives can then execute as compiled XLA collectives over the
+    global device set instead of host TCPStore exchanges."""
+    return _jax_distributed
+
+
 def get_store():
     """The rendezvous TCPStore (native C++ server on rank 0; see
     paddle_tpu/native/csrc/tcp_store.cc). None in single-process mode."""
@@ -112,8 +119,51 @@ def init_parallel_env():
             # arrays created there from other ranks can't feed compiled
             # multi-host steps (cross-host reshard is unsupported)
             jax.config.update("jax_default_device", jax.local_devices()[0])
+        if int(os.environ.get("PADDLE_ELASTIC_LEVEL", "0") or 0) > 0:
+            _start_heartbeat(_store, rank)
         _initialized = True
     return ParallelEnv()
+
+
+def _start_heartbeat(store, rank):
+    """Elastic fault DETECTION, worker half (reference: ElasticManager's
+    etcd heartbeat, fleet/elastic/manager.py:126): a daemon thread bumps
+    ``hb/<rank>`` every interval, preferably in the LAUNCHER-owned
+    heartbeat store (PADDLE_ELASTIC_HB_ENDPOINT — independent of any
+    worker's life), else the rank-0 rendezvous store. The launcher
+    watches the keys and restarts the job when one goes silent — which
+    catches hangs and SIGSTOP-style silent deaths that the exit-code
+    monitor cannot see."""
+    import threading
+    import time as _time
+
+    hb_ep = os.environ.get("PADDLE_ELASTIC_HB_ENDPOINT")
+    if hb_ep:
+        try:
+            from ..native.tcp_store import TCPStore
+            host, _, port = hb_ep.partition(":")
+            store = TCPStore(host=host or "127.0.0.1", port=int(port),
+                             is_master=False, timeout=10.0)
+        except Exception:
+            pass  # fall back to the rendezvous store (may be None)
+    if store is None:
+        return
+    interval = float(os.environ.get(
+        "PADDLE_ELASTIC_HEARTBEAT_INTERVAL", "2"))
+
+    def beat():
+        n = 0
+        while True:
+            try:
+                store.set(f"hb/{rank}", str(n))
+            except Exception:
+                return  # store gone (teardown) — stop quietly
+            n += 1
+            _time.sleep(interval)
+
+    t = threading.Thread(target=beat, daemon=True,
+                         name=f"paddle-elastic-hb-{rank}")
+    t.start()
 
 
 class ParallelEnv:

@@ -131,7 +131,19 @@ def recompute(function, *args, **kwargs):
         return isinstance(v, jax.core.Tracer) or (
             isinstance(v, Tensor) and isinstance(v._data, jax.core.Tracer))
 
-    if not any(_traced(v) for v in list(args) + list(kwargs.values())):
+    def _in_trace_context():
+        # A segment can close over traced values while every explicit arg
+        # is concrete (e.g. a module whose params are traced by TrainStep);
+        # checking only the args would silently skip jax.checkpoint and
+        # lose the memory savings. The trace context catches that case.
+        try:
+            from jax._src.core import EvalTrace
+            return not isinstance(jax.core.trace_ctx.trace, EvalTrace)
+        except (AttributeError, ImportError, TypeError):  # pragma: no cover
+            return False
+
+    if not any(_traced(v) for v in list(args) + list(kwargs.values())) \
+            and not _in_trace_context():
         # eager: per-op autograd stores activations anyway, just run it
         return function(*args, **kwargs)
 

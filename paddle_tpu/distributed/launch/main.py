@@ -80,6 +80,62 @@ def launch(argv=None) -> int:
     return rc
 
 
+class _HeartbeatWatcher:
+    """Launcher half of elastic fault detection: hosts a LAUNCHER-owned
+    TCPStore (so its life doesn't depend on any worker — the rank-0
+    rendezvous store dies with rank 0) and reads the ``hb/<rank>`` keys
+    workers bump (distributed/env.py ``_start_heartbeat``). Reports a
+    rank whose beat has not advanced for ``timeout`` seconds: a
+    SIGSTOPped or livelocked worker never exits, so exit-code monitoring
+    alone misses it (reference: ElasticManager watchdog,
+    fleet/elastic/manager.py:126). Ranks are armed only after their first
+    beat — startup/compile time cannot false-trigger. Scope is per node:
+    each node's launcher watches its own workers."""
+
+    def __init__(self, ranks):
+        from ...native.tcp_store import TCPStore
+        self.ranks = list(ranks)
+        self.timeout = float(os.environ.get(
+            "PADDLE_ELASTIC_HEARTBEAT_TIMEOUT", "30"))
+        self.interval = max(0.5, float(os.environ.get(
+            "PADDLE_ELASTIC_HEARTBEAT_INTERVAL", "2")))
+        self._store = TCPStore(host="127.0.0.1", port=_free_port(),
+                               is_master=True, timeout=10.0)
+        self.endpoint = f"127.0.0.1:{self._store.port}"
+        self._last = {}       # rank -> (value, wall time it changed)
+        self._next_check = 0.0
+
+    def poll(self, live_ranks=None):
+        """Return a stale rank id among ``live_ranks`` (default: all), or
+        None. A rank that already exited keeps a frozen key — only ranks
+        still running can be declared silent."""
+        now = time.time()
+        if now < self._next_check:
+            return None
+        self._next_check = now + self.interval
+        ranks = self.ranks if live_ranks is None else \
+            [r for r in self.ranks if r in live_ranks]
+        for r in ranks:
+            try:
+                val = self._store.get(f"hb/{r}")
+            except KeyError:
+                continue  # rank hasn't started heartbeating yet
+            except Exception:
+                return None  # transient store error; retry next round
+            prev = self._last.get(r)
+            if prev is None or prev[0] != val:
+                self._last[r] = (val, now)
+            elif now - prev[1] > self.timeout:
+                return r
+        return None
+
+    def close(self):
+        try:
+            self._store.close()
+        except Exception:
+            pass
+
+
 def _launch_once(args, attempt: int = 0) -> int:
     nproc = args.nproc_per_node
     world = nproc * args.nnodes
@@ -107,6 +163,15 @@ def _launch_once(args, attempt: int = 0) -> int:
     if args.log_dir:
         os.makedirs(args.log_dir, exist_ok=True)
 
+    watcher = None
+    if args.elastic_level > 0:
+        try:
+            watcher = _HeartbeatWatcher(
+                [args.node_rank * nproc + i for i in range(nproc)])
+        except Exception as e:  # heartbeat is best-effort; restarts still
+            print(f"elastic: heartbeat store unavailable ({e}); "
+                  f"exit-code monitoring only", file=sys.stderr)
+
     procs = []
     for local in range(nproc):
         rank = args.node_rank * nproc + local
@@ -133,6 +198,8 @@ def _launch_once(args, attempt: int = 0) -> int:
             # outer orchestrator's values are never clobbered.
             env["PADDLE_ELASTIC_RESTARTS"] = str(attempt)
             env["PADDLE_ELASTIC_LEVEL"] = str(args.elastic_level)
+            if watcher is not None:
+                env["PADDLE_ELASTIC_HB_ENDPOINT"] = watcher.endpoint
         if args.log_dir:
             out = open(os.path.join(args.log_dir,
                                     f"workerlog.{rank}"), "w")
@@ -167,6 +234,26 @@ def _launch_once(args, attempt: int = 0) -> int:
     rc = 0
     try:
         live = {r: p for r, p, _ in procs}
+
+        def _kill_all(reason, code, force=False):
+            """Stop remaining ranks. Default: SIGTERM + 10s grace then
+            SIGKILL (peers get to flush checkpoints/logs). ``force``
+            SIGKILLs immediately — required for the heartbeat path, where
+            a SIGSTOPped process ignores SIGTERM forever."""
+            nonlocal rc, live
+            print(reason, file=sys.stderr)
+            rc = code
+            for q in live.values():
+                if q.poll() is None:
+                    q.kill() if force else q.terminate()
+            deadline = time.time() + 10
+            for q in live.values():
+                try:
+                    q.wait(max(0.1, deadline - time.time()))
+                except subprocess.TimeoutExpired:
+                    q.kill()
+            live = {}
+
         while live:
             for r, p in list(live.items()):
                 code = p.poll()
@@ -174,19 +261,16 @@ def _launch_once(args, attempt: int = 0) -> int:
                     continue
                 del live[r]
                 if code != 0:
-                    print(f"rank {r} exited with code {code}; "
-                          f"terminating peers", file=sys.stderr)
-                    rc = code
-                    for q in live.values():
-                        q.terminate()
-                    deadline = time.time() + 10
-                    for q in live.values():
-                        try:
-                            q.wait(max(0.1, deadline - time.time()))
-                        except subprocess.TimeoutExpired:
-                            q.kill()
-                    live = {}
+                    _kill_all(f"rank {r} exited with code {code}; "
+                              f"terminating peers", code)
                     break
+            if live and watcher is not None:
+                stale = watcher.poll(set(live))
+                if stale is not None:
+                    _kill_all(
+                        f"elastic: rank {stale} heartbeat silent for "
+                        f">{watcher.timeout:.0f}s (hung or stopped); "
+                        f"restarting job", 1, force=True)
             time.sleep(0.05)
     except KeyboardInterrupt:
         for r, p, _ in procs:
@@ -194,6 +278,8 @@ def _launch_once(args, attempt: int = 0) -> int:
                 p.send_signal(signal.SIGINT)
         rc = 130
     finally:
+        if watcher is not None:
+            watcher.close()
         for _, p, out in procs:
             if out is not None:
                 out.close()

@@ -88,3 +88,9 @@ define_flag("FLAGS_flash_min_seqlen", 2048,
             "below this query length attention uses the XLA softmax path "
             "(faster end-to-end, PERF.md); the Pallas flash kernel kicks "
             "in at/above it where O(S^2) memory stops fitting")
+define_flag("FLAGS_flash_block_q", 0,
+            "flash-attention q block size override (0 = autotune/default); "
+            "applies when the call is traced and no autotune cache entry "
+            "exists for the shape")
+define_flag("FLAGS_flash_block_k", 0,
+            "flash-attention k block size override (0 = autotune/default)")

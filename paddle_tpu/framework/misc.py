@@ -49,10 +49,12 @@ class LazyGuard:
     """Context manager for deferred parameter initialization.
 
     The reference (fluid/lazy_init.py) skips initializer kernels inside
-    the guard and materializes on first access; here initializers are
-    cheap numpy/jax calls, so the guard simply marks the scope (layers
-    built inside still initialize eagerly — semantically equivalent since
-    materialization is on-construction either way)."""
+    the guard and materializes later. Here, layers built inside the guard
+    get ABSTRACT parameters (``jax.ShapeDtypeStruct`` — shape/dtype, no
+    buffer): the model can be traced, sharded and AOT-compiled (e.g. the
+    ERNIE-10B memory plan in ``__graft_entry__``) without materializing
+    tens of GB. Materialize with ``layer.to_static``-style export or by
+    re-building the layer outside the guard and loading a checkpoint."""
 
     _active = False
 

@@ -14,32 +14,84 @@ class Model:
         self._loss = None
         self._optimizer = None
         self._metrics = []
+        self._inputs = inputs if inputs is None or isinstance(
+            inputs, (list, tuple)) else [inputs]
+        self._labels = labels
+        self._amp_level = None
+        self._scaler = None
         self.stop_training = False
 
-    def prepare(self, optimizer=None, loss=None, metrics=None, **kwargs):
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None, **kwargs):
+        """reference Model.prepare (hapi/model.py:1565): wires optimizer,
+        loss, metrics and AMP. ``amp_configs`` accepts "O1"/"O2" or a dict
+        with "level" (+ optional GradScaler init args)."""
         self._optimizer = optimizer
         self._loss = loss
         if metrics is not None:
             self._metrics = metrics if isinstance(metrics, (list, tuple)) \
                 else [metrics]
+        if amp_configs is not None:
+            if isinstance(amp_configs, str):
+                amp_configs = {"level": amp_configs}
+            self._amp_level = amp_configs.get("level", "O1")
+            if self._amp_level not in ("O0", None):
+                from ..amp import GradScaler
+                scaler_kw = {k: v for k, v in amp_configs.items()
+                             if k in ("init_loss_scaling", "incr_ratio",
+                                      "decr_ratio", "incr_every_n_steps",
+                                      "decr_every_n_nan_or_inf")}
+                self._scaler = GradScaler(enable=True, **scaler_kw)
 
-    def _loader(self, data, batch_size, shuffle):
+    def _loader(self, data, batch_size, shuffle, num_workers=0,
+                distributed=True):
         if data is None or isinstance(data, DataLoader):
             return data
+        from ..distributed import get_world_size
+        if distributed and get_world_size() > 1:
+            # distributed fit: each rank consumes its own shard of the
+            # dataset (reference fit() builds a DistributedBatchSampler,
+            # hapi/model.py:1774)
+            from ..io import DistributedBatchSampler
+            sampler = DistributedBatchSampler(
+                data, batch_size=batch_size, shuffle=shuffle,
+                drop_last=shuffle)
+            return DataLoader(data, batch_sampler=sampler,
+                              num_workers=num_workers)
         return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
-                          drop_last=shuffle)
+                          drop_last=shuffle, num_workers=num_workers)
 
     def train_batch(self, inputs, labels=None, update=True):
         self.network.train()
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         labels = labels if labels is None or isinstance(labels, (list, tuple)) \
             else [labels]
-        outputs = self.network(*inputs)
-        losses = self._loss(outputs, *labels) if labels else self._loss(outputs)
-        losses.backward()
-        if update:
-            self._optimizer.step()
-            self._optimizer.clear_grad()
+        if self._amp_level and self._amp_level != "O0":
+            from ..amp import auto_cast
+            with auto_cast(level=self._amp_level):
+                outputs = self.network(*inputs)
+                losses = self._loss(outputs, *labels) if labels \
+                    else self._loss(outputs)
+            if self._scaler is not None:
+                scaled = self._scaler.scale(losses)
+                scaled.backward()
+                if update:
+                    self._scaler.step(self._optimizer)
+                    self._scaler.update()
+                    self._optimizer.clear_grad()
+            else:
+                losses.backward()
+                if update:
+                    self._optimizer.step()
+                    self._optimizer.clear_grad()
+        else:
+            outputs = self.network(*inputs)
+            losses = self._loss(outputs, *labels) if labels \
+                else self._loss(outputs)
+            losses.backward()
+            if update:
+                self._optimizer.step()
+                self._optimizer.clear_grad()
         metrics = []
         for m in self._metrics:
             m.update(m.compute(outputs, *labels))
@@ -69,7 +121,7 @@ class Model:
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             **kwargs):
         from .callbacks import config_callbacks
-        loader = self._loader(train_data, batch_size, shuffle)
+        loader = self._loader(train_data, batch_size, shuffle, num_workers)
         cbks = config_callbacks(callbacks, model=self, epochs=epochs,
                                 log_freq=log_freq, verbose=verbose,
                                 save_freq=save_freq, save_dir=save_dir,
@@ -90,11 +142,24 @@ class Model:
                 for m, v in zip(self._metrics, metrics):
                     logs[m.name()] = v
                 cbks.on_train_batch_end(step, logs)
-            cbks.on_epoch_end(epoch, {"loss": history["loss"][-1]
-                                      if history["loss"] else None})
+            epoch_logs = {"loss": history["loss"][-1]
+                          if history["loss"] else None}
+            for m, v in zip(self._metrics,
+                            [m.accumulate() for m in self._metrics]):
+                epoch_logs[m.name()] = v
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
-                self.evaluate(eval_data, batch_size=batch_size,
-                              verbose=verbose, callbacks=cbks)
+                eval_result = self.evaluate(eval_data,
+                                            batch_size=batch_size,
+                                            verbose=verbose, callbacks=cbks)
+                # thread eval metrics into the epoch logs (reference fit
+                # reports eval_<metric> per epoch) so EarlyStopping /
+                # ReduceLROnPlateau callbacks can monitor them
+                for k, v in eval_result.items():
+                    epoch_logs[f"eval_{k}"] = v[0] if isinstance(
+                        v, (list, tuple)) and v else v
+                history.setdefault("eval_loss", []).extend(
+                    eval_result.get("loss", []))
+            cbks.on_epoch_end(epoch, epoch_logs)
             if self.stop_training:
                 break
         cbks.on_train_end()
@@ -108,7 +173,11 @@ class Model:
         else:
             cbks = CallbackList(callbacks or [])
             cbks.set_model(self)
-        loader = self._loader(eval_data, batch_size, False)
+        # evaluation runs the FULL dataset on every rank (not a shard):
+        # rank-local metrics feed callbacks (EarlyStopping) whose decisions
+        # must agree across ranks, or collective training hangs
+        loader = self._loader(eval_data, batch_size, False,
+                              distributed=False)
         for m in self._metrics:
             m.reset()
         cbks.on_eval_begin()
@@ -140,8 +209,19 @@ class Model:
 
     def save(self, path, training=True):
         import paddle_tpu as P
+        if not training:
+            # inference export (reference Model.save(training=False) →
+            # save_inference_model): requires the input spec given at
+            # construction, exports through paddle.jit.save
+            if not self._inputs:
+                raise ValueError(
+                    "Model.save(training=False) needs inputs= InputSpec "
+                    "at Model() construction to trace the export")
+            from ..jit.api import save as jit_save
+            jit_save(self.network, path, input_spec=list(self._inputs))
+            return
         P.save(self.network.state_dict(), path + ".pdparams")
-        if training and self._optimizer is not None:
+        if self._optimizer is not None:
             P.save(self._optimizer.state_dict(), path + ".pdopt")
 
     def load(self, path, skip_mismatch=False, reset_optimizer=False):

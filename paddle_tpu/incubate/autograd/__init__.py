@@ -1,5 +1,13 @@
-"""Forward/reverse prim autodiff (reference: python/paddle/incubate/autograd/)
-— on TPU these are jax transforms directly."""
+"""Forward/reverse prim autodiff (reference:
+/root/reference/python/paddle/incubate/autograd/primapi.py).
+
+``forward_grad`` is a real forward-mode JVP over the eager tape: every
+GradNode stores its primal fn + input-array snapshot (core/autograd.py),
+so tangents propagate producer→consumer with one ``jax.jvp`` per recorded
+op — the TPU-native analog of the reference's linearize prim pass
+(primapi.py ``forward_grad`` orig2prim→linearize). ``enable_prim`` /
+``disable_prim`` are no-ops by design: jax IS the primitive system.
+"""
 from ...autograd.functional import hessian, jacobian, jvp, vjp  # noqa: F401
 
 
@@ -16,7 +24,107 @@ def prim_enabled():
 
 
 def forward_grad(outputs, inputs, grad_inputs=None):
-    return jvp(lambda *xs: outputs, inputs, grad_inputs)[1]
+    """Tangents of ``outputs`` w.r.t. ``inputs`` seeded by ``grad_inputs``
+    (defaults to ones), computed forward-mode over the recorded tape.
+
+    Requires the computation producing ``outputs`` to have run with grad
+    recording enabled (so the tape exists) and not yet released by a
+    ``backward()`` without ``retain_graph``.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ...core.tensor import Tensor
+
+    single = isinstance(outputs, Tensor)
+    outs = [outputs] if single else list(outputs)
+    ins = [inputs] if isinstance(inputs, Tensor) else list(inputs)
+    if grad_inputs is None:
+        seeds = [jnp.ones_like(t._data) for t in ins]
+    else:
+        gi = [grad_inputs] if isinstance(grad_inputs, Tensor) else list(grad_inputs)
+        seeds = [g._data if isinstance(g, Tensor) else jnp.asarray(g)
+                 for g in gi]
+    seed_of = {id(t): s for t, s in zip(ins, seeds)}
+
+    # Collect the reachable tape (walk producer edges back from outputs).
+    nodes = {}
+    stack = [t._grad_node for t in outs if t._grad_node is not None]
+    while stack:
+        node = stack.pop()
+        if id(node) in nodes:
+            continue
+        nodes[id(node)] = node
+        for nxt in node.next_nodes():
+            if id(nxt) not in nodes:
+                stack.append(nxt)
+
+    # Topological order, producers first (Kahn over producer→consumer deps).
+    dep = {}
+    consumers = {nid: [] for nid in nodes}
+    for nid, node in nodes.items():
+        cnt = 0
+        for r in node.input_refs:
+            # A seed on the tensor cuts the edge: the input IS the variable
+            # being perturbed, not a function of its producer.
+            if r.node is not None and id(r.node) in nodes \
+                    and id(r.tensor) not in seed_of:
+                cnt += 1
+                consumers[id(r.node)].append(nid)
+        dep[nid] = cnt
+    ready = [nid for nid, c in dep.items() if c == 0]
+    order = []
+    while ready:
+        nid = ready.pop()
+        order.append(nid)
+        for c in consumers[nid]:
+            dep[c] -= 1
+            if dep[c] == 0:
+                ready.append(c)
+    if len(order) != len(nodes):
+        raise RuntimeError("forward_grad: cycle in recorded tape")
+
+    def _zero_tangent(x):
+        if jnp.issubdtype(x.dtype, jnp.inexact):
+            return jnp.zeros(x.shape, x.dtype)
+        return np.zeros(x.shape, jax.dtypes.float0)
+
+    node_tan = {}  # (id(node), out_idx) -> tangent array
+    for nid in order:
+        node = nodes[nid]
+        if node.primal_fn is None:
+            raise RuntimeError(
+                "forward_grad: tape was released (a backward() without "
+                "retain_graph ran); recompute the outputs first.")
+        in_tans = []
+        for r, x in zip(node.input_refs, node.primal_args):
+            if id(r.tensor) in seed_of:
+                t = seed_of[id(r.tensor)]
+                t = t.astype(x.dtype) if t.dtype != x.dtype else t
+            elif r.node is not None and (id(r.node), r.output_index) in node_tan:
+                t = node_tan[(id(r.node), r.output_index)]
+            else:
+                t = _zero_tangent(x)
+            in_tans.append(t)
+        _, out_t = jax.jvp(node.primal_fn, tuple(node.primal_args),
+                           tuple(in_tans))
+        if isinstance(out_t, (tuple, list)):
+            for i, ot in enumerate(out_t):
+                node_tan[(nid, i)] = ot
+        else:
+            node_tan[(nid, 0)] = out_t
+
+    results = []
+    for t in outs:
+        if id(t) in seed_of:
+            tan = seed_of[id(t)]
+        elif t._grad_node is not None:
+            tan = node_tan[(id(t._grad_node), t._output_index)]
+        else:
+            tan = jnp.zeros_like(t._data)
+        results.append(Tensor(tan, stop_gradient=True))
+    return results[0] if single else results
 
 
 def grad(outputs, inputs, grad_outputs=None):

@@ -76,6 +76,25 @@ class StaticFunction:
     def __call__(self, *args, **kwargs):
         if self._jit_fn is None:
             self._build_jit()
+        try:
+            return self._invoke(*args, **kwargs)
+        except Exception as e:  # noqa: BLE001 - inspect & re-raise below
+            from .dy2static import ast_transform, convert_call_guard
+            if not convert_call_guard(e) or \
+                    getattr(self._function, "__dy2static_transformed__",
+                            False):
+                raise
+            # tensor-dependent Python control flow broke the trace: rewrite
+            # the source (if/while → lax-able cond/while_loop) and retrace —
+            # the reference's AST-transformer path
+            # (/root/reference/python/paddle/jit/dy2static/), applied lazily
+            # only when the fast trace path cannot convert.
+            self._function = ast_transform(self._function)
+            self._jit_fn = None
+            self._build_jit()
+            return self._invoke(*args, **kwargs)
+
+    def _invoke(self, *args, **kwargs):
         arrays = [a._data if isinstance(a, Tensor) else a for a in args]
         if self._layer is not None:
             params, buffers = state_arrays(self._layer)

@@ -1,0 +1,279 @@
+"""dy2static AST fallback: tensor-dependent Python control flow → lax.
+
+The reference converts dygraph code with ~20 AST transformers
+(/root/reference/python/paddle/jit/dy2static/, e.g. ifelse_transformer.py
+rewriting ``if``/``else`` into cond(), while_transformer.py into while_loop
+ops). TPU-native version: ``to_static`` traces first (the fast path — most
+models have no tensor-dependent branching); when tracing raises
+``TracerBoolConversionError``, the function source is AST-rewritten so that
+
+    if <pred>: A            →  def __t(): A; return outs
+    else: B                    def __f(): B; return outs
+                               outs = _dy2s_cond(<pred>, __t, __f)
+
+    while <pred>: body      →  carry = _dy2s_while(cond_fn, body_fn, carry)
+
+and re-traced. The runtime helpers dispatch dynamically: a concrete
+(python/eager) predicate takes the plain Python branch, a traced Tensor
+predicate lowers through ``static.nn.cond`` / ``static.nn.while_loop``
+(→ ``lax.cond`` / ``lax.while_loop``), so the SAME rewritten function runs
+eagerly and compiled — the dy2static contract.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+from typing import Callable
+
+__all__ = ["ast_transform", "convert_call_guard", "_dy2s_cond",
+           "_dy2s_while"]
+
+
+def _is_traced(x):
+    import jax
+
+    from ..core.tensor import Tensor
+    return isinstance(x, jax.core.Tracer) or (
+        isinstance(x, Tensor) and isinstance(x._data, jax.core.Tracer))
+
+
+def _dy2s_cond(pred, true_fn, false_fn):
+    """Runtime dispatch for a rewritten ``if``: python branch when the
+    predicate is concrete, ``static.nn.cond`` when traced."""
+    if not _is_traced(pred):
+        import numpy as np
+
+        from ..core.tensor import Tensor
+        p = pred._data if isinstance(pred, Tensor) else pred
+        return true_fn() if bool(np.asarray(p).item()) else false_fn()
+    from ..static import nn as static_nn
+    return static_nn.cond(pred, true_fn, false_fn)
+
+
+def _dy2s_while(cond_fn, body_fn, carry):
+    """Runtime dispatch for a rewritten ``while`` over a tuple carry."""
+    probe = cond_fn(*carry)
+    if not _is_traced(probe) and not any(_is_traced(c) for c in carry):
+        while True:
+            import numpy as np
+
+            from ..core.tensor import Tensor
+            p = probe._data if isinstance(probe, Tensor) else probe
+            if not bool(np.asarray(p).item()):
+                return tuple(carry)
+            carry = tuple(body_fn(*carry))
+            probe = cond_fn(*carry)
+    from ..static import nn as static_nn
+    out = static_nn.while_loop(cond_fn, body_fn, list(carry))
+    return tuple(out)
+
+
+class _AssignedNames(ast.NodeVisitor):
+    """Names bound by a statement list (no descent into nested defs)."""
+
+    def __init__(self):
+        self.names = set()
+        self.unsupported = None
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self.names.add(node.id)
+
+    def visit_AugAssign(self, node):
+        if isinstance(node.target, ast.Name):
+            self.names.add(node.target.id)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        self.names.add(node.name)  # the def binds its name; don't descend
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+    def visit_Return(self, node):
+        self.unsupported = "return"
+
+    def visit_Break(self, node):
+        self.unsupported = "break"
+
+    def visit_Continue(self, node):
+        self.unsupported = "continue"
+
+    def visit_Global(self, node):
+        self.unsupported = "global"
+
+    def visit_Yield(self, node):
+        self.unsupported = "yield"
+
+
+def _assigned(stmts):
+    v = _AssignedNames()
+    for s in stmts:
+        v.visit(s)
+    return v.names, v.unsupported
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    """Rewrite if/while statements into _dy2s_cond/_dy2s_while calls.
+
+    Conservative: statements whose bodies contain constructs the lowering
+    cannot represent (return/break/continue/global/yield) are left as
+    plain Python — they keep working for concrete predicates and raise
+    the original tracer error for traced ones.
+    """
+
+    _uid = 0
+
+    @classmethod
+    def _fresh(cls, stem):
+        cls._uid += 1
+        return f"__dy2s_{stem}_{cls._uid}"
+
+    # -- if/else → cond ----------------------------------------------------
+    def visit_If(self, node):
+        self.generic_visit(node)
+        body_names, bad1 = _assigned(node.body)
+        else_names, bad2 = _assigned(node.orelse)
+        if bad1 or bad2:
+            return node
+        outs = sorted(body_names | else_names)
+        t_name = self._fresh("true")
+        f_name = self._fresh("false")
+        ret = ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=n, ctx=ast.Load()) for n in outs],
+            ctx=ast.Load()))
+        true_def = _make_fn(t_name, _empty_args(), list(node.body) + [ret])
+        false_body = list(node.orelse) if node.orelse else []
+        false_def = _make_fn(f_name, _empty_args(),
+                             false_body + [_copy_ret(ret)])
+        call = ast.Call(
+            func=ast.Name(id="_dy2s_cond", ctx=ast.Load()),
+            args=[node.test,
+                  ast.Name(id=t_name, ctx=ast.Load()),
+                  ast.Name(id=f_name, ctx=ast.Load())],
+            keywords=[])
+        if outs:
+            assign = ast.Assign(
+                targets=[ast.Tuple(
+                    elts=[ast.Name(id=n, ctx=ast.Store()) for n in outs],
+                    ctx=ast.Store())],
+                value=call)
+        else:
+            assign = ast.Expr(value=call)
+        return [true_def, false_def, assign]
+
+    # -- while → while_loop ------------------------------------------------
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse:
+            return node
+        carry_names, bad = _assigned(node.body)
+        if bad:
+            return node
+        carry = sorted(carry_names)
+        if not carry:
+            return node
+        c_name = self._fresh("cond")
+        b_name = self._fresh("body")
+        cond_def = _make_fn(c_name, _named_args(carry),
+                            [ast.Return(value=node.test)])
+        body_ret = ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=n, ctx=ast.Load()) for n in carry],
+            ctx=ast.Load()))
+        body_def = _make_fn(b_name, _named_args(carry),
+                            list(node.body) + [body_ret])
+        call = ast.Call(
+            func=ast.Name(id="_dy2s_while", ctx=ast.Load()),
+            args=[ast.Name(id=c_name, ctx=ast.Load()),
+                  ast.Name(id=b_name, ctx=ast.Load()),
+                  ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Load())
+                                  for n in carry], ctx=ast.Load())],
+            keywords=[])
+        assign = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Store()) for n in carry],
+                ctx=ast.Store())],
+            value=call)
+        return [cond_def, body_def, assign]
+
+
+def _make_fn(name, args, body):
+    fn = ast.FunctionDef(name=name, args=args, body=body,
+                         decorator_list=[], returns=None,
+                         type_comment=None)
+    if "type_params" in ast.FunctionDef._fields:  # py3.12+
+        fn.type_params = []
+    return fn
+
+
+def _empty_args():
+    return ast.arguments(posonlyargs=[], args=[], vararg=None,
+                         kwonlyargs=[], kw_defaults=[], kwarg=None,
+                         defaults=[])
+
+
+def _named_args(names):
+    return ast.arguments(posonlyargs=[],
+                         args=[ast.arg(arg=n) for n in names],
+                         vararg=None, kwonlyargs=[], kw_defaults=[],
+                         kwarg=None, defaults=[])
+
+
+def _copy_ret(ret):
+    import copy
+    return copy.deepcopy(ret)
+
+
+@functools.lru_cache(maxsize=256)
+def _transform_code(src_key, filename):
+    tree = ast.parse(src_key)
+    fn_def = tree.body[0]
+    fn_def.decorator_list = []  # don't re-apply to_static on exec
+    new_tree = _ControlFlowTransformer().visit(tree)
+    ast.fix_missing_locations(new_tree)
+    return compile(new_tree, filename or "<dy2static>", "exec")
+
+
+def ast_transform(fn: Callable) -> Callable:
+    """Return ``fn`` with tensor-dependent if/while rewritten to lax-able
+    control flow. Raises OSError if the source is unavailable (REPL)."""
+    bound_self = getattr(fn, "__self__", None)
+    if bound_self is not None:
+        fn = fn.__func__
+    src = textwrap.dedent(inspect.getsource(fn))
+    code = _transform_code(src, inspect.getsourcefile(fn))
+    glb = dict(fn.__globals__)
+    glb["_dy2s_cond"] = _dy2s_cond
+    glb["_dy2s_while"] = _dy2s_while
+    # rebuild the closure environment as globals (the re-exec'd def has no
+    # closure cells; free variables become module-level lookups)
+    if fn.__closure__:
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                glb[name] = cell.cell_contents
+            except ValueError:  # empty cell (self-reference)
+                pass
+    loc = {}
+    exec(code, glb, loc)
+    new_fn = loc[fn.__name__]
+    new_fn = functools.wraps(fn)(new_fn)
+    new_fn.__dy2static_transformed__ = True
+    if bound_self is not None:
+        return functools.partial(new_fn, bound_self)
+    return new_fn
+
+
+def convert_call_guard(e: BaseException) -> bool:
+    """True when a tracing failure is the tensor-dependent-control-flow
+    kind the AST fallback can fix. TracerArrayConversionError is included
+    because Tensor.__bool__ reaches the tracer via .numpy() (``if t:``
+    surfaces as an array conversion, not a bool conversion)."""
+    import jax
+
+    return isinstance(e, (jax.errors.TracerBoolConversionError,
+                          jax.errors.TracerArrayConversionError,
+                          jax.errors.ConcretizationTypeError))

@@ -31,6 +31,13 @@ __all__ = ["BertConfig", "BertModel", "BertForPretraining",
            "bert_tiny", "bert_base"]
 
 
+def _batch_constraint(h):
+    """ZeRO activation batch-sharding pin — shared GSPMD plumbing, see
+    distributed/mesh_utils.batch_axis_constraint."""
+    from ..distributed.mesh_utils import batch_axis_constraint
+    return batch_axis_constraint(h)
+
+
 @dataclass
 class BertConfig:
     vocab_size: int = 30528          # multiple of 64
@@ -45,6 +52,11 @@ class BertConfig:
     layer_norm_eps: float = 1e-12
     initializer_range: float = 0.02
     use_flash_attention: bool = True
+    # per-layer activation recompute (reference:
+    # DistributedStrategy.recompute over BERT encoder layers) — jax.checkpoint
+    # around each encoder block when traced; required to fit 10B-class
+    # ERNIE configs in HBM
+    recompute: bool = False
 
     def __post_init__(self):
         assert self.hidden_size % self.num_heads == 0
@@ -160,9 +172,14 @@ class BertModel(Layer):
         self.pooler = BertPooler(config)
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):
-        h = self.embeddings(input_ids, token_type_ids)
+        h = _batch_constraint(self.embeddings(input_ids, token_type_ids))
         for layer in self.encoder:
-            h = layer(h, attention_mask)
+            if self.config.recompute:
+                from ..distributed.fleet.utils import recompute as _rc
+                h = _rc(layer, h, attention_mask)
+            else:
+                h = layer(h, attention_mask)
+            h = _batch_constraint(h)
         return h, self.pooler(h)
 
 

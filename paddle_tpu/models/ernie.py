@@ -63,12 +63,19 @@ class ErnieModel(Layer):
 
     def forward(self, input_ids, token_type_ids=None, task_type_ids=None,
                 attention_mask=None):
+        from .bert import _batch_constraint
         h = self.embeddings(input_ids, token_type_ids)
         if task_type_ids is not None:
             from ..nn.functional.common import embedding as F_embedding
             h = h + F_embedding(task_type_ids, self.task_type_embeddings)
+        h = _batch_constraint(h)
         for layer in self.encoder:
-            h = layer(h, attention_mask)
+            if self.config.recompute:
+                from ..distributed.fleet.utils import recompute as _rc
+                h = _rc(layer, h, attention_mask)
+            else:
+                h = layer(h, attention_mask)
+            h = _batch_constraint(h)
         return h, self.pooler(h)
 
     def num_params(self) -> int:

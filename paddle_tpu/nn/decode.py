@@ -87,9 +87,13 @@ def dynamic_decode(decoder, inits=None, max_step_num=32, **kwargs):
         def reindex(s):
             if isinstance(s, (tuple, list)):
                 return type(s)(reindex(x) for x in s)
-            arr = s.numpy().reshape(B, K, -1)
-            arr = np.take_along_axis(arr, parents[:, :, None], 1)
-            return paddle.to_tensor(arr.reshape(B * K, -1))
+            # preserve trailing dims: a rank>=3 cell state [B*K, h, d]
+            # must come back [B*K, h, d], not flattened to [B*K, h*d]
+            trail = tuple(s.shape[1:])
+            arr = s.numpy().reshape((B, K) + trail)
+            idx = parents.reshape((B, K) + (1,) * len(trail))
+            arr = np.take_along_axis(arr, idx, 1)
+            return paddle.to_tensor(arr.reshape((B * K,) + trail))
 
         state = reindex(state)
         if finished.all():

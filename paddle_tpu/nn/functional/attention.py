@@ -35,6 +35,14 @@ def _sdpa_reference(q, k, v, mask=None, dropout_p=0.0, causal=False, scale=None)
     if mask is not None:
         logits = logits + mask.astype(logits.dtype)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_p > 0.0:
+        # attention-probability dropout (the reference's CUDA kernel drops
+        # probs before the value matmul, flash_attn dropout_p semantics)
+        from ...framework import random as random_mod
+        keep = jax.random.bernoulli(random_mod.next_key(), 1.0 - dropout_p,
+                                    probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p),
+                          jnp.zeros_like(probs))
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
     return jnp.swapaxes(out, 1, 2)
 

@@ -35,7 +35,16 @@ def create_parameter_with_attr(shape, dtype, attr=None, is_bias=False,
     if init is None:
         init = I.Constant(0.0) if is_bias else I.XavierNormal()
     jdt = dtype_mod.to_jax_dtype(dtype or "float32")
-    data = init(tuple(int(s) for s in shape), jdt)
+    from ..framework.misc import LazyGuard
+    if LazyGuard._active:
+        # deferred init (paddle.LazyGuard, reference fluid/lazy_init.py):
+        # abstract parameter — shape/dtype only. Used to build 10B-class
+        # models for AOT sharding/memory planning without 40+GB of host
+        # buffers; jax transforms swap tracers in, so tracing still works.
+        import jax
+        data = jax.ShapeDtypeStruct(tuple(int(s) for s in shape), jdt)
+    else:
+        data = init(tuple(int(s) for s in shape), jdt)
     p = Parameter(data, name=attr.name, trainable=attr.trainable)
     p.optimize_attr = {"learning_rate": attr.learning_rate}
     p.regularizer = attr.regularizer

@@ -60,9 +60,12 @@ def _mha_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
     # lse_ref: [block_q, LANES] (row logsumexp replicated across lanes)
     block_q = q_ref.shape[0]
     d = q_ref.shape[1]
-    # all float scalars must be explicit f32: under jax_enable_x64 a python
-    # float is a weak f64 and Mosaic cannot legalize the resulting truncf
-    q = q_ref[:].astype(jnp.float32) * jnp.float32(sm_scale)
+    # MXU fast path: keep q/k/v in their native (bf16) dtype and let
+    # ``preferred_element_type=f32`` give bf16×bf16→f32 accumulation; an
+    # upfront .astype(f32) would force 3-pass f32 matmuls (~4× slower on
+    # v5e — measured as the round-2 kernel's whole-step loss vs XLA).
+    # The softmax statistics still run in f32.
+    q = q_ref[:]
     q_idx = pl.program_id(1)
 
     m_init = jnp.full((block_q,), NEG_INF, jnp.float32)
@@ -73,10 +76,13 @@ def _mha_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
 
     def body(kb, carry):
         m_prev, l_prev, acc = carry
-        k = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[pl.ds(kb * block_k, block_k), :]
+        v = v_ref[pl.ds(kb * block_k, block_k), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        # scalars must be explicit f32: under jax_enable_x64 a python float
+        # is a weak f64 and Mosaic cannot legalize the resulting truncf
+        s = s * jnp.float32(sm_scale)
         if causal:
             q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -89,7 +95,8 @@ def _mha_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
         alpha = jnp.exp(m_prev - m_new)
         l_new = alpha * l_prev + jnp.sum(p, axis=1)
         acc = acc * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         return m_new, l_new, acc
 
     if causal:
@@ -165,10 +172,11 @@ def _mha_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, dq_ref,
 
     @pl.when(needed)
     def _acc():
-        q = q_ref[:].astype(jnp.float32)
-        k = k_ref[:].astype(jnp.float32)
-        v = v_ref[:].astype(jnp.float32)
-        do = do_ref[:].astype(jnp.float32)
+        # native-dtype (bf16) matmul inputs, f32 accumulation — see fwd
+        q = q_ref[:]
+        k = k_ref[:]
+        v = v_ref[:]
+        do = do_ref[:]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * jnp.float32(sm_scale)
@@ -187,7 +195,7 @@ def _mha_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, dq_ref,
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - di) * jnp.float32(sm_scale)
         acc_ref[:] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(k_idx == nk - 1)
@@ -216,10 +224,11 @@ def _mha_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
 
     @pl.when(needed)
     def _acc():
-        q = q_ref[:].astype(jnp.float32)
-        k = k_ref[:].astype(jnp.float32)
-        v = v_ref[:].astype(jnp.float32)
-        do = do_ref[:].astype(jnp.float32)
+        # native-dtype (bf16) matmul inputs, f32 accumulation — see fwd
+        q = q_ref[:]
+        k = k_ref[:]
+        v = v_ref[:]
+        do = do_ref[:]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * jnp.float32(sm_scale)
@@ -234,13 +243,13 @@ def _mha_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
         di = jnp.tile(di_ref[:], (1, reps))
         p = jnp.exp(s - lse)                              # [block_q, block_k]
         dv_acc[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)           # p^T @ do
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - di) * jnp.float32(sm_scale)
         dk_acc[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)           # ds^T @ q
 
     @pl.when(q_idx == nq - 1)

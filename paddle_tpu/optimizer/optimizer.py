@@ -68,6 +68,14 @@ class Optimizer:
     def _set_accum(self, name, p, value):
         self._accumulators[name][id(p)] = value
 
+    def _accum_spec(self, name, p):
+        """(shape, dtype) of accumulator ``name`` for ``p`` WITHOUT
+        materializing it — used by TrainStep.aot_lower for abstract
+        (LazyGuard) planning of huge configs."""
+        import numpy as _np
+        dt = getattr(p._data, "dtype", _np.float32)
+        return tuple(p.shape), dt
+
     # ------------- the update -------------
     def _update_rule(self, p_data, grad, lr, t, wd, state: dict) -> tuple:
         """Return (new_p, new_state). Pure function of arrays; ``wd`` is the
@@ -82,6 +90,14 @@ class Optimizer:
                 "Optimizer created without parameters; pass parameters=")
         params_grads = [(p, p.grad) for p in params
                         if not p.stop_gradient and p.grad is not None]
+        self.apply_gradients(params_grads)
+
+    @no_grad()
+    def apply_gradients(self, params_grads):
+        """Apply explicit (param, grad) pairs — the update half of ``step``.
+        Used by ``step`` and by static-mode ``Executor.run`` replaying a
+        ``minimize``d Program (reference: apply_gradients,
+        /root/reference/python/paddle/optimizer/optimizer.py:969)."""
         if self._grad_clip is not None:
             params_grads = self._grad_clip(params_grads)
         lr_val = self.get_lr()
@@ -127,6 +143,23 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        from ..static import program as static_program
+        if static_program.in_static_mode():
+            # Static mode: register the train step on the Program;
+            # Executor.run computes jax.grad of the replay and applies this
+            # optimizer's update rule to the parameters (the analog of the
+            # optimize ops minimize() appends to the ProgramDesc,
+            # /root/reference/python/paddle/optimizer/optimizer.py:1115).
+            program = static_program.default_main_program()
+            params = list(parameters or self._parameters
+                          or program.all_parameters())
+            if self._parameters is None:
+                self._parameters = params
+            for p in params:
+                program.params.setdefault(id(p), p)
+                program.var_by_id.setdefault(id(p), p)
+            program.train_spec = (id(loss), self, [id(p) for p in params])
+            return None, [(p, None) for p in params]
         loss.backward()
         self.step()
         return None, [(p, p.grad) for p in (parameters or self._parameters)]
@@ -235,6 +268,9 @@ class Adam(Optimizer):
         if pid not in store:
             store[pid] = jnp.zeros(p._data.shape, self._moment_dtype)
         return store[pid]
+
+    def _accum_spec(self, name, p):
+        return tuple(p.shape), self._moment_dtype
 
 
 class AdamW(Adam):

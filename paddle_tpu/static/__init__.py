@@ -36,22 +36,31 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
                     callbacks=None):
     """Static autodiff (reference: python/paddle/fluid/backward.py:1826).
 
-    TPU-native: gradients are obtained by jax.grad over the recorded program
-    replay at Executor.run time; here we mark the program for grad building
-    and return (param, grad_placeholder) pairs.
+    TPU-native: each returned grad var is a placeholder registered in
+    ``program.grad_map``; fetching it through ``Executor.run`` computes
+    ``jax.grad`` of the whole-program replay w.r.t. that parameter (one
+    compiled XLA program for forward+backward — the analog of the appended
+    backward ops the reference inserts into the ProgramDesc).
     """
     program = default_main_program()
     params = parameter_list or program.all_parameters()
+    no_grad = set(id(t) for t in (no_grad_set or []))
     pairs = []
     for p in params:
+        if id(p) in no_grad:
+            continue
         g = Tensor(np.zeros(p.shape, p.dtype.np_dtype), name=p.name + "@GRAD")
+        g.stop_gradient = True
+        program.grad_map[id(g)] = (id(loss), id(p))
+        program.var_by_id[id(g)] = g
+        program.params.setdefault(id(p), p)
         pairs.append((p, g))
-    program._loss_for_backward = loss
-    program._param_grads = pairs
     return pairs
 
 
-# static.nn namespace
+# static.nn namespace (reference: python/paddle/static/nn/common.py) —
+# layer-builder style: each call creates the layer (parameters recorded
+# into the Program via dispatch) and applies it.
 def _fc(x, size, num_flatten_dims=1, activation=None, name=None, **kw):
     from .. import nn
     layer = nn.Linear(x.shape[-1], size)
@@ -62,9 +71,145 @@ def _fc(x, size, num_flatten_dims=1, activation=None, name=None, **kw):
     return out
 
 
+def _act(out, activation):
+    if activation:
+        from ..nn import functional as F
+        out = getattr(F, activation)(out)
+    return out
+
+
+def _channels(input, data_format):
+    """Channel count under the given layout ('C' position in the format
+    string, e.g. NCHW→1, NHWC→last)."""
+    return input.shape[data_format.index("C")]
+
+
+def _conv2d(input, num_filters, filter_size, stride=1, padding=0,
+            dilation=1, groups=1, param_attr=None, bias_attr=None,
+            use_cudnn=True, act=None, name=None, data_format="NCHW"):
+    from .. import nn
+    layer = nn.Conv2D(_channels(input, data_format), num_filters,
+                      filter_size, stride=stride, padding=padding,
+                      dilation=dilation, groups=groups,
+                      weight_attr=param_attr,
+                      bias_attr=bias_attr, data_format=data_format)
+    return _act(layer(input), act)
+
+
+def _conv3d(input, num_filters, filter_size, stride=1, padding=0,
+            dilation=1, groups=1, param_attr=None, bias_attr=None,
+            use_cudnn=True, act=None, name=None, data_format="NCDHW"):
+    from .. import nn
+    layer = nn.Conv3D(_channels(input, data_format), num_filters,
+                      filter_size, stride=stride, padding=padding,
+                      dilation=dilation, groups=groups,
+                      weight_attr=param_attr,
+                      bias_attr=bias_attr, data_format=data_format)
+    return _act(layer(input), act)
+
+
+def _conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                      padding=0, stride=1, dilation=1, groups=1,
+                      param_attr=None, bias_attr=None, use_cudnn=True,
+                      act=None, name=None, data_format="NCHW"):
+    from .. import nn
+    layer = nn.Conv2DTranspose(_channels(input, data_format), num_filters,
+                               filter_size,
+                               stride=stride, padding=padding,
+                               dilation=dilation, groups=groups,
+                               weight_attr=param_attr, bias_attr=bias_attr,
+                               data_format=data_format)
+    return _act(layer(input), act)
+
+
+def _batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-05,
+                param_attr=None, bias_attr=None, data_layout="NCHW",
+                name=None, **kw):
+    from .. import nn
+    layer = nn.BatchNorm(_channels(input, data_layout), momentum=momentum,
+                         epsilon=epsilon,
+                         weight_attr=param_attr, bias_attr=bias_attr,
+                         data_format=data_layout)
+    if is_test:
+        layer.eval()
+    return _act(layer(input), act)
+
+
+def _layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+                epsilon=1e-05, param_attr=None, bias_attr=None, act=None,
+                name=None):
+    from .. import nn
+    layer = nn.LayerNorm(list(input.shape[begin_norm_axis:]),
+                         epsilon=epsilon,
+                         weight_attr=param_attr if scale else False,
+                         bias_attr=bias_attr if shift else False)
+    return _act(layer(input), act)
+
+
+def _embedding(input, size, is_sparse=False, is_distributed=False,
+               padding_idx=None, param_attr=None, dtype="float32"):
+    from .. import nn
+    layer = nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                         weight_attr=param_attr)
+    return layer(input)
+
+
+def _group_norm(input, groups, epsilon=1e-05, param_attr=None,
+                bias_attr=None, act=None, data_layout="NCHW", name=None):
+    from .. import nn
+    layer = nn.GroupNorm(groups, _channels(input, data_layout),
+                         epsilon=epsilon,
+                         weight_attr=param_attr, bias_attr=bias_attr)
+    return _act(layer(input), act)
+
+
+def _prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    from .. import nn
+    num = 1 if mode == "all" else _channels(x, data_format)
+    layer = nn.PReLU(num_parameters=num, weight_attr=param_attr,
+                     data_format=data_format)
+    return layer(x)
+
+
+def _case(pred_fn_pairs, default=None, name=None):
+    """reference static.nn.case: first true predicate wins."""
+    def chain(pairs):
+        if not pairs:
+            if default is None:
+                raise ValueError("static.nn.case: no default and no "
+                                 "predicate matched")
+            return default()
+        pred, fn = pairs[0]
+        return _static_cond(pred, fn, lambda: chain(pairs[1:]))
+    return chain(list(pred_fn_pairs))
+
+
+def _switch_case(branch_index, branch_fns, default=None, name=None):
+    fns = dict(branch_fns) if not isinstance(branch_fns, dict) else branch_fns
+
+    def chain(keys):
+        if not keys:
+            if default is None:
+                raise ValueError("static.nn.switch_case: missing default")
+            return default()
+        k = keys[0]
+        return _static_cond(branch_index == k, fns[k],
+                            lambda: chain(keys[1:]))
+    return chain(sorted(fns.keys()))
+
+
 nn = _types.SimpleNamespace(
     fc=_fc,
-    conv2d=None,
+    conv2d=_conv2d,
+    conv3d=_conv3d,
+    conv2d_transpose=_conv2d_transpose,
+    batch_norm=_batch_norm,
+    layer_norm=_layer_norm,
+    embedding=_embedding,
+    group_norm=_group_norm,
+    prelu=_prelu,
+    case=_case,
+    switch_case=_switch_case,
     cond=None,
     while_loop=None,
 )

@@ -81,6 +81,28 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
         program, feed_list, fetch_list = _LIVE_MODELS[path_prefix]
         feed_names = [v.name for v in feed_list]
         return program, feed_names, fetch_list
+
+    # reference-format artifacts: <prefix>.pdmodel is a protobuf
+    # ProgramDesc (written by the reference's save_inference_model,
+    # /root/reference/python/paddle/static/io.py:442) — parsed and executed
+    # natively (static/pdmodel.py), so reference model-zoo exports load
+    # without the reference installed.
+    pd_path = path_prefix if str(path_prefix).endswith(".pdmodel") \
+        else str(path_prefix) + ".pdmodel"
+    if os.path.exists(pd_path):
+        from .pdmodel import is_pdmodel_bytes, load_pdmodel
+
+        with open(pd_path, "rb") as f:
+            model_bytes = f.read()
+        if is_pdmodel_bytes(model_bytes):
+            params_path = pd_path[:-len(".pdmodel")] + ".pdiparams"
+            params_bytes = None
+            if os.path.exists(params_path):
+                with open(params_path, "rb") as f:
+                    params_bytes = f.read()
+            prog = load_pdmodel(model_bytes, params_bytes)
+            return prog, list(prog.feed_names), [None] * len(prog.fetch_names)
+
     from ..framework.exporting import load_artifact
 
     prog = LoadedProgram(load_artifact(path_prefix))

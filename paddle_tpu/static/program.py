@@ -61,6 +61,14 @@ class Program:
         self.params: Dict[int, Parameter] = {}
         self.random_seed = None
         self._compile_cache = {}
+        # append_backward registrations: id(grad_placeholder) ->
+        # (id(loss_var), id(param)).  Executor.run resolves fetched grad
+        # placeholders through jax.grad over the replay (the TPU-native
+        # analog of the reference's appended backward ops,
+        # python/paddle/fluid/backward.py:1826).
+        self.grad_map: Dict[int, tuple] = {}
+        # optimizer.minimize registration: (id(loss), optimizer, [param_ids]).
+        self.train_spec = None
 
     def global_block(self):
         return self
@@ -72,6 +80,9 @@ class Program:
         p.feed_vars = dict(self.feed_vars)
         p.var_by_id = dict(self.var_by_id)
         p.params = dict(self.params)
+        if not for_test:
+            p.grad_map = dict(self.grad_map)
+            p.train_spec = self.train_spec
         return p
 
     # ---- recording (called from dispatch) ----
@@ -90,8 +101,8 @@ class Program:
         self.var_by_id[id(tensor)] = tensor
 
     # ---- execution ----
-    def _replay_fn(self, fetch_ids, feed_names):
-        """Build a pure function (feeds, params) -> fetches replaying ops."""
+    def _forward_fn(self, feed_names):
+        """Pure (feed_arrays, param_arrays) -> values-dict replay of ops."""
         ops = self.ops
         feed_ids = [id(self.feed_vars[n]) for n in feed_names]
         const_vals = {}
@@ -100,7 +111,7 @@ class Program:
                     var._data, np.ndarray):
                 const_vals[vid] = var._data
 
-        def run(feed_arrays, param_arrays):
+        def forward(feed_arrays, param_arrays):
             values = dict(const_vals)
             values.update(param_arrays)
             for fid, arr in zip(feed_ids, feed_arrays):
@@ -111,14 +122,53 @@ class Program:
                 outs = out if isinstance(out, (tuple, list)) else [out]
                 for oid, o in zip(op.output_ids, outs):
                     values[oid] = o
+            return values
+
+        return forward
+
+    def _replay_fn(self, fetch_ids, feed_names):
+        """Build a pure function (feeds, params) -> fetches replaying ops."""
+        forward = self._forward_fn(feed_names)
+
+        def run(feed_arrays, param_arrays):
+            values = forward(feed_arrays, param_arrays)
             return [values[fid] for fid in fetch_ids]
 
         return run
 
-    def compiled(self, fetch_ids, feed_names, feed_shapes):
-        key = (tuple(fetch_ids), tuple(feed_names), tuple(feed_shapes))
+    def _replay_with_grads_fn(self, fetch_ids, feed_names, grad_specs):
+        """Like ``_replay_fn`` but additionally returns, per grad_spec
+        ``(loss_id, param_ids)``, the dict ``{param_id: dL/dparam}`` via
+        ``jax.grad`` over the whole-program replay — whole-program XLA
+        autodiff standing in for the reference's appended backward ops."""
+        forward = self._forward_fn(feed_names)
+
+        def run(feed_arrays, param_arrays):
+            values = forward(feed_arrays, param_arrays)
+            fetches = [values[fid] for fid in fetch_ids]
+            gradsets = []
+            for loss_id, param_ids in grad_specs:
+                def loss_fn(sub_params, _lid=loss_id):
+                    pa = dict(param_arrays)
+                    pa.update(sub_params)
+                    v = forward(feed_arrays, pa)
+                    return jnp.sum(v[_lid])
+                sub = {pid: param_arrays[pid] for pid in param_ids}
+                gradsets.append(jax.grad(loss_fn)(sub))
+            return fetches, gradsets
+
+        return run
+
+    def compiled(self, fetch_ids, feed_names, feed_shapes, grad_specs=None):
+        key = (tuple(fetch_ids), tuple(feed_names), tuple(feed_shapes),
+               None if grad_specs is None else tuple(
+                   (lid, tuple(pids)) for lid, pids in grad_specs))
         if key not in self._compile_cache:
-            fn = self._replay_fn(fetch_ids, feed_names)
+            if grad_specs is None:
+                fn = self._replay_fn(fetch_ids, feed_names)
+            else:
+                fn = self._replay_with_grads_fn(fetch_ids, feed_names,
+                                                grad_specs)
             self._compile_cache[key] = jax.jit(fn)
         return self._compile_cache[key]
 
@@ -185,10 +235,25 @@ class Executor:
 
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             return_numpy=True):
+        # Execution must not RECORD: users typically keep static mode
+        # enabled while calling exe.run, and anything dispatched here
+        # (e.g. the optimizer's grad-clip ops in a minimize()d step) would
+        # otherwise be appended to the Program being executed.
+        was_static = in_static_mode()
+        if was_static:
+            _disable_static()
+        try:
+            return self._run(program, feed, fetch_list, scope, return_numpy)
+        finally:
+            if was_static:
+                _enable_static()
+
+    def _run(self, program, feed, fetch_list, scope, return_numpy):
         program = program or default_main_program()
         feed = feed or {}
         from .io import LoadedProgram
-        if isinstance(program, LoadedProgram):
+        from .pdmodel import PdProgram
+        if isinstance(program, (LoadedProgram, PdProgram)):
             outs = program.run(feed)
             if return_numpy:
                 return [np.asarray(o) for o in outs]
@@ -204,8 +269,57 @@ class Executor:
             feed_arrays.append(arr)
         param_arrays = {pid: p._data for pid, p in program.params.items()}
         shapes = [tuple(a.shape) + (str(a.dtype),) for a in feed_arrays]
-        fn = program.compiled(fetch_ids, feed_names, shapes)
-        outs = fn(feed_arrays, param_arrays)
+
+        # Resolve grad placeholders (append_backward) and a minimize()d
+        # train step: both differentiate the whole-program replay.
+        grad_fetch_pos = [i for i, fid in enumerate(fetch_ids)
+                          if fid in program.grad_map]
+        train = program.train_spec
+        if not grad_fetch_pos and train is None:
+            fn = program.compiled(fetch_ids, feed_names, shapes)
+            outs = fn(feed_arrays, param_arrays)
+        else:
+            plain_fetch_ids = [fid for fid in fetch_ids
+                               if fid not in program.grad_map]
+            # Group requested grads by loss var; train adds its own group.
+            specs = []          # [(loss_id, [param_ids...])]
+            spec_index = {}     # loss_id -> index into specs
+            where = {}          # fetch position -> (spec_idx, param_id)
+            for i in grad_fetch_pos:
+                loss_id, param_id = program.grad_map[fetch_ids[i]]
+                if loss_id not in spec_index:
+                    spec_index[loss_id] = len(specs)
+                    specs.append((loss_id, []))
+                si = spec_index[loss_id]
+                if param_id not in specs[si][1]:
+                    specs[si][1].append(param_id)
+                where[i] = (si, param_id)
+            train_si = None
+            if train is not None:
+                loss_id, optimizer, param_ids = train
+                if loss_id in spec_index:
+                    si = spec_index[loss_id]
+                    merged = specs[si][1] + [p for p in param_ids
+                                             if p not in specs[si][1]]
+                    specs[si] = (loss_id, merged)
+                    train_si = si
+                else:
+                    train_si = len(specs)
+                    specs.append((loss_id, list(param_ids)))
+            fn = program.compiled(plain_fetch_ids, feed_names, shapes,
+                                  grad_specs=specs)
+            plain_outs, gradsets = fn(feed_arrays, param_arrays)
+            plain_iter = iter(plain_outs)
+            outs = [gradsets[where[i][0]][where[i][1]]
+                    if i in where else next(plain_iter)
+                    for i in range(len(fetch_ids))]
+            if train is not None:
+                _, optimizer, param_ids = train
+                gset = gradsets[train_si]
+                pairs = [(program.params[pid], Tensor(gset[pid],
+                                                      stop_gradient=True))
+                         for pid in param_ids if pid in program.params]
+                optimizer.apply_gradients(pairs)
         if return_numpy:
             return [np.asarray(o) for o in outs]
         return [Tensor(o) for o in outs]

@@ -101,7 +101,15 @@ class Imdb(Dataset):
 
     @staticmethod
     def _tokenize(raw):
-        return raw.decode("latin1").lower().replace("<br />", " ").split()
+        # byte-exact mirror of the reference tokenizer
+        # (/root/reference/python/paddle/text/datasets/imdb.py:112):
+        # rstrip newlines, DELETE all punctuation ("don't"→"dont",
+        # "<br />"→"br "), lowercase, split — so vocab contents and word
+        # ids line up with reference-built checkpoints
+        import string
+        return raw.rstrip(b"\n\r") \
+            .translate(None, string.punctuation.encode("latin-1")) \
+            .decode("latin-1").lower().split()
 
     def _build_vocab(self, data_file, pat, cutoff):
         from collections import Counter

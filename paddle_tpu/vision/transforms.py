@@ -61,6 +61,10 @@ def to_tensor(pic, data_format="CHW"):
 
 def normalize(img, mean, std, data_format="CHW", to_rgb=False):
     arr = np.asarray(img, np.float32)
+    if to_rgb:
+        # reference semantics: input is BGR, flip the channel axis first
+        arr = arr[::-1].copy() if data_format == "CHW" \
+            else arr[..., ::-1].copy()
     mean = np.asarray(mean, np.float32)
     std = np.asarray(std, np.float32)
     shape = (-1, 1, 1) if data_format == "CHW" else (1, 1, -1)

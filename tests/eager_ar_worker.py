@@ -1,0 +1,32 @@
+"""Worker: eager all_reduce over the jax.distributed device path
+(test_launch.py::test_eager_allreduce_device_path). Launched with
+--jax_distributed so the XLA-collective path is eligible; asserts the
+reduction value AND that the device path (not the TCPStore host
+exchange) actually served it."""
+import os
+import sys
+
+import numpy as np
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+
+out_dir = sys.argv[1]
+env = dist.init_parallel_env()
+rank, world = env.rank, env.world_size
+
+x = paddle.to_tensor(np.full((4, 8), float(rank + 1), "float32"))
+dist.all_reduce(x)
+expect = np.full((4, 8), sum(range(1, world + 1)), "float32")
+np.testing.assert_array_equal(np.asarray(x.numpy()), expect)
+
+xmax = paddle.to_tensor(np.full((3,), float(rank), "float32"))
+dist.all_reduce(xmax, op=dist.ReduceOp.MAX)
+np.testing.assert_array_equal(np.asarray(xmax.numpy()),
+                              np.full((3,), world - 1, "float32"))
+
+from paddle_tpu.distributed.communication import collective  # noqa: E402
+used_device_path = len(collective._device_ar_cache) > 0
+
+with open(os.path.join(out_dir, f"ar_ok.{rank}"), "w") as f:
+    f.write(f"{used_device_path}")

@@ -1,0 +1,53 @@
+"""Worker for the elastic HEARTBEAT fault-detection e2e test
+(test_launch.py). Two ranks train with checkpoints; on the FIRST attempt
+rank 1 SIGSTOPs itself mid-training — a silent death the exit-code
+monitor can never see. The launcher's heartbeat watcher must notice the
+frozen ``hb/1`` key, SIGKILL the job and relaunch it; the second attempt
+resumes from the checkpoint and finishes. Reference analog: the etcd
+heartbeat watchdog in ElasticManager (fleet/elastic/manager.py:126)."""
+import os
+import signal
+import sys
+import time
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu import nn  # noqa: E402
+
+out_dir = sys.argv[1]
+env = dist.init_parallel_env()
+rank = env.rank
+restarts = int(os.environ.get("PADDLE_ELASTIC_RESTARTS", 0))
+ckpt = os.path.join(out_dir, f"state_{rank}.pdparams")
+TOTAL = 8
+
+paddle.seed(0)
+model = nn.Linear(4, 4)
+opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+
+start = 0
+if restarts > 0 and os.path.exists(ckpt):
+    saved = paddle.load(ckpt)
+    model.set_state_dict(saved["model"])
+    start = int(saved["step"])
+
+x = paddle.to_tensor(np.ones((2, 4), "float32"))
+for step in range(start, TOTAL):
+    loss = (model(x) ** 2).sum()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    paddle.save({"model": model.state_dict(), "step": step + 1}, ckpt)
+    if restarts == 0 and rank == 1 and step == 2:
+        # silent death: stopped, not exited — only a heartbeat watcher
+        # can detect this
+        os.kill(os.getpid(), signal.SIGSTOP)
+    time.sleep(0.6)  # keep rank 0 alive long enough for detection
+
+with open(os.path.join(out_dir, f"done_{rank}"), "w") as f:
+    f.write(f"{restarts} {start} {TOTAL}")

@@ -1,0 +1,118 @@
+"""dy2static AST fallback (round-3 verdict item 5): tensor-dependent
+Python if/while converts via AST rewrite when tracing fails.
+Reference analog: python/paddle/jit/dy2static/ifelse_transformer.py."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+class BranchyNet(nn.Layer):
+    """Data-dependent branch + data-dependent while loop."""
+
+    def __init__(self):
+        super().__init__()
+        self.lin = nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.lin(x)
+        if h.sum() > 0:
+            out = h * 2.0
+        else:
+            out = h - 1.0
+        return out
+
+
+class LoopNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.lin = nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.lin(x)
+        # double h until its norm exceeds 100 (tensor-dependent while)
+        while (h * h).sum() < 100.0:
+            h = h * 2.0
+        return h
+
+
+def _eager_branchy(lin, x):
+    h = lin(x)
+    if float(h.sum().numpy()) > 0:
+        return h * 2.0
+    return h - 1.0
+
+
+class TestAstFallback:
+    def test_if_matches_eager(self):
+        paddle.seed(0)
+        net = BranchyNet()
+        for sign in (+1.0, -1.0):
+            x = paddle.to_tensor(
+                sign * np.abs(np.random.RandomState(0)
+                              .randn(2, 4)).astype("float32"))
+            want = _eager_branchy(net.lin, x).numpy()
+            snet = paddle.jit.to_static(BranchyNet())
+            snet.set_state_dict(net.state_dict())
+            got = snet(x).numpy()
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       rtol=1e-5)
+
+    def test_while_matches_eager(self):
+        paddle.seed(1)
+        net = LoopNet()
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(2, 4).astype("float32"))
+        h = net.lin(x)
+        while float((h * h).sum().numpy()) < 100.0:
+            h = h * 2.0
+        want = np.asarray(h.numpy())
+        snet = paddle.jit.to_static(LoopNet())
+        snet.set_state_dict(net.state_dict())
+        got = np.asarray(snet(x).numpy())
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_transform_preserves_concrete_semantics(self):
+        # the rewritten function must behave identically when called
+        # eagerly (python branch selection, no lax)
+        from paddle_tpu.jit.dy2static import ast_transform
+
+        def f(a, flag):
+            if flag:
+                b = a + 1
+            else:
+                b = a - 1
+            return b
+
+        g = ast_transform(f)
+        assert g(5, True) == 6 and g(5, False) == 4
+
+    def test_trains_through_branch(self):
+        # converted model must be differentiable end-to-end
+        paddle.seed(0)
+        snet = paddle.jit.to_static(BranchyNet())
+        opt = paddle.optimizer.SGD(learning_rate=0.01,
+                                   parameters=snet.parameters())
+        x = paddle.to_tensor(
+            np.random.RandomState(2).randn(2, 4).astype("float32"))
+        losses = []
+        for _ in range(5):
+            loss = (snet(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+    def test_unsupported_constructs_left_alone(self):
+        from paddle_tpu.jit.dy2static import ast_transform
+
+        def f(a):
+            # `return` inside the branch: transformer must leave this as
+            # plain python (still fine for concrete predicates)
+            if a > 0:
+                return a * 2
+            return a - 1
+
+        g = ast_transform(f)
+        assert g(3) == 6 and g(-3) == -4

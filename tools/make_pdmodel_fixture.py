@@ -1,0 +1,162 @@
+"""Generate a GENUINE reference-format .pdmodel/.pdiparams fixture.
+
+The ProgramDesc bytes are produced by Google protobuf (protoc --python_out
+on the reference's framework.proto) — an implementation independent of the
+hand-rolled wire decoder in paddle_tpu/static/pdmodel.py — so the interop
+test is not circular. The parameter stream follows the save_combine layout
+(lod_tensor.cc SerializeToStream): u32 version | u64 lod levels | u32
+tensor version | i32 desc_len | TensorDesc proto | raw data, tensors in
+sorted-name order.
+
+Run:  python tools/make_pdmodel_fixture.py
+Writes tests/fixtures/mlp.pdmodel, mlp.pdiparams, mlp_expected.npz
+"""
+import os
+import struct
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PROTO = "/root/reference/paddle/fluid/framework/framework.proto"
+FIXDIR = os.path.join(REPO, "tests", "fixtures")
+
+FP32 = 5
+INT64 = 3
+LOD_TENSOR = 7
+FEED_MINIBATCH = 9
+FETCH_LIST = 10
+
+
+def gen_pb2():
+    tmp = tempfile.mkdtemp()
+    import shutil
+    shutil.copy(PROTO, os.path.join(tmp, "framework.proto"))
+    subprocess.run(["protoc", "--python_out=.", "framework.proto"],
+                   cwd=tmp, check=True)
+    sys.path.insert(0, tmp)
+    import framework_pb2
+    return framework_pb2
+
+
+def add_var(block, name, vtype, dtype=FP32, dims=None, persistable=False):
+    v = block.vars.add()
+    v.name = name
+    v.type.type = vtype
+    if dims is not None:
+        v.type.lod_tensor.tensor.data_type = dtype
+        v.type.lod_tensor.tensor.dims.extend(dims)
+    v.persistable = persistable
+    return v
+
+
+def add_op(block, fp, op_type, inputs, outputs, attrs=None):
+    op = block.ops.add()
+    op.type = op_type
+    for slot, args in inputs.items():
+        iv = op.inputs.add()
+        iv.parameter = slot
+        iv.arguments.extend(args)
+    for slot, args in outputs.items():
+        ov = op.outputs.add()
+        ov.parameter = slot
+        ov.arguments.extend(args)
+    for aname, aval in (attrs or {}).items():
+        a = op.attrs.add()
+        a.name = aname
+        if isinstance(aval, bool):
+            a.type = fp.BOOLEAN
+            a.b = aval
+        elif isinstance(aval, int):
+            a.type = fp.INT
+            a.i = aval
+        elif isinstance(aval, float):
+            a.type = fp.FLOAT
+            a.f = aval
+        elif isinstance(aval, str):
+            a.type = fp.STRING
+            a.s = aval
+        elif isinstance(aval, list) and all(
+                isinstance(x, int) for x in aval):
+            a.type = fp.INTS
+            a.ints.extend(aval)
+        else:
+            raise TypeError(f"attr {aname}: {aval!r}")
+    return op
+
+
+def serialize_tensor(fp, arr: np.ndarray) -> bytes:
+    """save_combine per-tensor layout (tensor_util.cc TensorToStream)."""
+    desc = fp.VarType.TensorDesc()
+    desc.data_type = FP32 if arr.dtype == np.float32 else INT64
+    desc.dims.extend(arr.shape)
+    desc_bytes = desc.SerializeToString()
+    out = struct.pack("<I", 0)            # LoDTensor version
+    out += struct.pack("<Q", 0)           # lod levels
+    out += struct.pack("<I", 0)           # tensor version
+    out += struct.pack("<i", len(desc_bytes))
+    out += desc_bytes
+    out += arr.tobytes()
+    return out
+
+
+def main():
+    fp = gen_pb2()
+    rng = np.random.RandomState(42)
+    params = {
+        "fc_0.w_0": rng.randn(4, 8).astype(np.float32),
+        "fc_0.b_0": rng.randn(8).astype(np.float32),
+        "fc_1.w_0": rng.randn(8, 3).astype(np.float32),
+        "fc_1.b_0": rng.randn(3).astype(np.float32),
+    }
+
+    prog = fp.ProgramDesc()
+    block = prog.blocks.add()
+    block.idx = 0
+    block.parent_idx = -1
+
+    add_var(block, "feed", FEED_MINIBATCH)
+    add_var(block, "x", LOD_TENSOR, FP32, [-1, 4])
+    for n, a in params.items():
+        add_var(block, n, LOD_TENSOR, FP32, list(a.shape), persistable=True)
+    for n in ("t0", "t1", "t2", "t3", "t4", "softmax_out"):
+        add_var(block, n, LOD_TENSOR, FP32, [-1, 8])
+    add_var(block, "fetch", FETCH_LIST)
+
+    add_op(block, fp, "feed", {"X": ["feed"]}, {"Out": ["x"]}, {"col": 0})
+    add_op(block, fp, "mul", {"X": ["x"], "Y": ["fc_0.w_0"]},
+           {"Out": ["t0"]}, {"x_num_col_dims": 1, "y_num_col_dims": 1})
+    add_op(block, fp, "elementwise_add", {"X": ["t0"], "Y": ["fc_0.b_0"]},
+           {"Out": ["t1"]}, {"axis": 1})
+    add_op(block, fp, "relu", {"X": ["t1"]}, {"Out": ["t2"]})
+    add_op(block, fp, "matmul_v2", {"X": ["t2"], "Y": ["fc_1.w_0"]},
+           {"Out": ["t3"]}, {"trans_x": False, "trans_y": False})
+    add_op(block, fp, "elementwise_add", {"X": ["t3"], "Y": ["fc_1.b_0"]},
+           {"Out": ["t4"]}, {"axis": 1})
+    add_op(block, fp, "softmax", {"X": ["t4"]}, {"Out": ["softmax_out"]},
+           {"axis": -1})
+    add_op(block, fp, "fetch", {"X": ["softmax_out"]}, {"Out": ["fetch"]},
+           {"col": 0})
+    prog.version.version = 1
+
+    os.makedirs(FIXDIR, exist_ok=True)
+    with open(os.path.join(FIXDIR, "mlp.pdmodel"), "wb") as f:
+        f.write(prog.SerializeToString())
+    with open(os.path.join(FIXDIR, "mlp.pdiparams"), "wb") as f:
+        for name in sorted(params):
+            f.write(serialize_tensor(fp, params[name]))
+
+    # expected output with plain numpy
+    x = np.linspace(-1, 1, 8, dtype=np.float32).reshape(2, 4)
+    h = np.maximum(x @ params["fc_0.w_0"] + params["fc_0.b_0"], 0)
+    logits = h @ params["fc_1.w_0"] + params["fc_1.b_0"]
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    probs = e / e.sum(-1, keepdims=True)
+    np.savez(os.path.join(FIXDIR, "mlp_expected.npz"), x=x, probs=probs)
+    print("fixture written:", sorted(os.listdir(FIXDIR)))
+
+
+if __name__ == "__main__":
+    main()
