@@ -120,7 +120,7 @@ def main():
     ap.add_argument("--moment-dtype", default=None,
                     choices=["float32", "bfloat16"])
     ap.add_argument("--recompute", default=None,
-                    choices=["full", "dots", "none"],
+                    choices=["full", "dots", "attn", "none"],
                     help="stacked-decoder recompute policy (large and "
                          "1.3b configs; their default 'full' is the only "
                          "policy that fits HBM)")

@@ -228,9 +228,12 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     # Eligibility must be decided from WORLD-GLOBAL facts only (every rank
     # computes the same branch) — a per-rank try/except fallback would
     # leave peers blocked inside the compiled collective while one rank
-    # silently switched to the host exchange (desync/deadlock).
+    # silently switched to the host exchange (desync/deadlock). The exact
+    # one-device-per-process requirement (==, not >=) keeps devs[:world]
+    # aligned with process ranks; multi-device-per-process worlds would
+    # place host-1's shard on a host-0 device and error on one rank only.
     if env.jax_distributed_active() and n == world \
-            and len(jax.devices()) >= world:
+            and len(jax.devices()) == world:
         out = _device_allreduce(_unwrap_np(tensor), op, world)
         if isinstance(tensor, Tensor):
             tensor._data = out.astype(tensor._data.dtype)

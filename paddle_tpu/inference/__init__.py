@@ -188,8 +188,55 @@ class Tensor:
         return self._spec["dtype"] if self._spec else None
 
 
+class _PdModelArtifact:
+    """Duck-types the StableHLO artifact interface over a parsed
+    reference-format ProgramDesc (static/pdmodel.py) — a reference user's
+    exported .pdmodel/.pdiparams pair serves directly on TPU through the
+    same Predictor surface they used with the reference runtime."""
+
+    def __init__(self, model_bytes, params_path=None, prefix=None):
+        from ..static.pdmodel import PROTO_DTYPES, load_pdmodel
+
+        ppath = params_path or (prefix + ".pdiparams")
+        params_bytes = None
+        if os.path.exists(ppath):
+            with open(ppath, "rb") as f:
+                params_bytes = f.read()
+        self._prog = load_pdmodel(model_bytes, params_bytes)
+        self.feed_names = list(self._prog.feed_names)
+        # same dict spec shape the StableHLO artifact path produces
+        # (framework/exporting._spec_of) — inference.Tensor subscripts it
+        self.feeds = []
+        for name in self.feed_names:
+            var = self._prog.vars.get(name, {})
+            vt = var.get("type", {})
+            dims = [1 if d < 0 else int(d)
+                    for d in vt.get("dims", []) or (1,)]
+            np_dt = PROTO_DTYPES.get(vt.get("dtype", 5), np.float32)
+            self.feeds.append({"shape": dims,
+                               "dtype": str(np.dtype(np_dt))
+                               if not isinstance(np_dt, str) else np_dt})
+
+    def __call__(self, *arrays):
+        return self._prog.run(dict(zip(self.feed_names, arrays)))
+
+
+def _sniff_reference_pdmodel(prefix):
+    """Return the raw ProgramDesc bytes when <prefix>.pdmodel is a
+    reference-format protobuf, else None (read+parse once; the bytes are
+    handed to _PdModelArtifact so large models aren't decoded twice)."""
+    path = str(prefix) + ".pdmodel"
+    if not os.path.exists(path):
+        return None
+    from ..static.pdmodel import is_pdmodel_bytes
+    with open(path, "rb") as f:
+        data = f.read()
+    return data if is_pdmodel_bytes(data) else None
+
+
 class Predictor:
-    """AnalysisPredictor parity over a StableHLO artifact."""
+    """AnalysisPredictor parity over a StableHLO artifact — or directly
+    over a reference-format protobuf .pdmodel (see _PdModelArtifact)."""
 
     def __init__(self, config: Config):
         from ..framework.exporting import load_artifact
@@ -197,7 +244,14 @@ class Predictor:
         if config._prefix is None:
             raise ValueError("Config has no model path")
         self._config = config
-        self._artifact = load_artifact(config._prefix, config._params_path)
+        pd_bytes = _sniff_reference_pdmodel(config._prefix)
+        if pd_bytes is not None:
+            self._artifact = _PdModelArtifact(pd_bytes,
+                                              config._params_path,
+                                              prefix=config._prefix)
+        else:
+            self._artifact = load_artifact(config._prefix,
+                                           config._params_path)
         self._inputs = {name: Tensor(name, spec)
                         for name, spec in zip(self._artifact.feed_names,
                                               self._artifact.feeds)}

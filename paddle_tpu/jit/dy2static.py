@@ -31,6 +31,37 @@ __all__ = ["ast_transform", "convert_call_guard", "_dy2s_cond",
            "_dy2s_while"]
 
 
+class _Undefined:
+    """Sentinel for a name not bound on the taken path (the reference's
+    dy2static UndefinedVar). Binding it is harmless; USING it in traced
+    control flow raises with a clear message instead of a confusing
+    pytree mismatch."""
+
+    __slots__ = ()
+
+    def __repr__(self):
+        return "<dy2static undefined>"
+
+    def __bool__(self):
+        raise NameError(
+            "dy2static: variable is not defined on every control-flow "
+            "path that reaches this use (assign it in both branches / "
+            "before the loop)")
+
+
+_UNDEF = _Undefined()
+
+
+def _dy2s_get(thunk):
+    """Evaluate a name capture; unbound names become the _UNDEF sentinel
+    so rewriting extra (concrete) branches never introduces NameErrors
+    the original code didn't have."""
+    try:
+        return thunk()
+    except (NameError, UnboundLocalError):
+        return _UNDEF
+
+
 def _is_traced(x):
     import jax
 
@@ -143,12 +174,19 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         outs = sorted(body_names | else_names)
         t_name = self._fresh("true")
         f_name = self._fresh("false")
+        # branch-assigned names become PARAMETERS defaulted to their
+        # enclosing-scope values (defaults evaluate at def time, i.e.
+        # right before the cond): this pre-binds read-modify-write
+        # locals (`out = out + x` inside the branch) and names the other
+        # branch never assigns, with _dy2s_get turning genuinely unbound
+        # ones into a sentinel instead of a NameError.
         ret = ast.Return(value=ast.Tuple(
             elts=[ast.Name(id=n, ctx=ast.Load()) for n in outs],
             ctx=ast.Load()))
-        true_def = _make_fn(t_name, _empty_args(), list(node.body) + [ret])
+        true_def = _make_fn(t_name, _defaulted_args(outs),
+                            list(node.body) + [ret])
         false_body = list(node.orelse) if node.orelse else []
-        false_def = _make_fn(f_name, _empty_args(),
+        false_def = _make_fn(f_name, _defaulted_args(outs),
                              false_body + [_copy_ret(ret)])
         call = ast.Call(
             func=ast.Name(id="_dy2s_cond", ctx=ast.Load()),
@@ -182,7 +220,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         cond_def = _make_fn(c_name, _named_args(carry),
                             [ast.Return(value=node.test)])
         body_ret = ast.Return(value=ast.Tuple(
-            elts=[ast.Name(id=n, ctx=ast.Load()) for n in carry],
+            elts=[_capture(n) for n in carry],
             ctx=ast.Load()))
         body_def = _make_fn(b_name, _named_args(carry),
                             list(node.body) + [body_ret])
@@ -190,8 +228,8 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             func=ast.Name(id="_dy2s_while", ctx=ast.Load()),
             args=[ast.Name(id=c_name, ctx=ast.Load()),
                   ast.Name(id=b_name, ctx=ast.Load()),
-                  ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Load())
-                                  for n in carry], ctx=ast.Load())],
+                  ast.Tuple(elts=[_capture(n) for n in carry],
+                            ctx=ast.Load())],
             keywords=[])
         assign = ast.Assign(
             targets=[ast.Tuple(
@@ -199,6 +237,24 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 ctx=ast.Store())],
             value=call)
         return [cond_def, body_def, assign]
+
+
+def _capture(n):
+    """``_dy2s_get(lambda: n)`` — a late-bound, NameError-safe read of an
+    enclosing-scope variable (see _dy2s_get)."""
+    return ast.Call(
+        func=ast.Name(id="_dy2s_get", ctx=ast.Load()),
+        args=[ast.Lambda(args=_empty_args(),
+                         body=ast.Name(id=n, ctx=ast.Load()))],
+        keywords=[])
+
+
+def _defaulted_args(names):
+    """Parameters ``(n=_dy2s_get(lambda: n), ...)`` pre-bound from the
+    enclosing scope (defaults evaluate at def time, in that scope)."""
+    a = _named_args(names)
+    a.defaults = [_capture(n) for n in names]
+    return a
 
 
 def _make_fn(name, args, body):
@@ -249,6 +305,7 @@ def ast_transform(fn: Callable) -> Callable:
     glb = dict(fn.__globals__)
     glb["_dy2s_cond"] = _dy2s_cond
     glb["_dy2s_while"] = _dy2s_while
+    glb["_dy2s_get"] = _dy2s_get
     # rebuild the closure environment as globals (the re-exec'd def has no
     # closure cells; free variables become module-level lookups)
     if fn.__closure__:

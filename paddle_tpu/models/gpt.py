@@ -76,9 +76,9 @@ class GPTConfig:
         if self.intermediate_size == 0:
             self.intermediate_size = 4 * self.hidden_size
         assert self.hidden_size % self.num_heads == 0
-        if self.recompute not in ("full", "dots", "none"):
+        if self.recompute not in ("full", "dots", "attn", "none"):
             raise ValueError(
-                f"recompute must be 'full', 'dots' or 'none', "
+                f"recompute must be 'full', 'dots', 'attn' or 'none', "
                 f"got {self.recompute!r}")
 
 
@@ -263,6 +263,11 @@ def _stacked_layer_fwd(p, x, *, num_heads, head_dim, eps, mp_size, sep_size,
         from ..ops.flash_attention import attention_bshd
         attn = attention_bshd(q, k, v, causal=True, scale=sm_scale,
                               use_flash=use_flash)
+    # named for the "attn" recompute policy: saving ONLY this tensor
+    # (~hidden-sized, bf16) lets the backward skip re-running the
+    # attention forward while everything else still rematerializes
+    from jax.ad_checkpoint import checkpoint_name
+    attn = checkpoint_name(attn, "attn_out")
     attn = attn.reshape(mb, s_loc, nh_loc * head_dim)
     o = attn @ p["out_w"]                             # partial over H/mp
     if mp_size > 1:
@@ -382,6 +387,15 @@ class GPTStackedTransformer(Layer):
                         layer,
                         policy=jax.checkpoint_policies
                         .dots_with_no_batch_dims_saveable)
+                elif cfg.recompute == "attn":
+                    # middle ground: save just the attention outputs
+                    # (bf16, hidden-sized — ~16 MB/layer at 1.3B) so the
+                    # bwd never re-runs the flash forward kernel; all
+                    # other activations rematerialize as in "full"
+                    wrapped = jax.checkpoint(
+                        layer,
+                        policy=jax.checkpoint_policies
+                        .save_only_these_names("attn_out"))
                 else:  # "full"
                     wrapped = jax.checkpoint(layer)
 

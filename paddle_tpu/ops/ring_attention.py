@@ -108,12 +108,26 @@ def _ring_attention_local_flash(q, k, v, *, axis_name: str, axis_size: int,
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
 
 
+def _chunk_blocks(sq, sk):
+    """Per-chunk kernel tiles: the large-block policy that took the 1.3B
+    config from 33.8% to 49.9% MFU (ops/flash_attention._default_blocks),
+    clipped to divisors of the chunk length."""
+    from .flash_attention import _default_blocks
+    bq, bk = _default_blocks(sq, sk)
+    while sq % bq:
+        bq //= 2
+    while sk % bk:
+        bk //= 2
+    return bq, bk
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash_chunk(q, k, v, causal, sm_scale):
     """(out f32, lse f32[b,h,s]) for one chunk via the Pallas kernel."""
     from .pallas_attention import _mha_fwd
 
-    out, lse = _mha_fwd(q, k, v, causal, sm_scale, 128, 128)
+    bq, bk = _chunk_blocks(q.shape[2], k.shape[2])
+    out, lse = _mha_fwd(q, k, v, causal, sm_scale, bq, bk)
     b, h, s, d = q.shape
     return out.astype(jnp.float32), lse[:, :, 0].reshape(b, h, s)
 
@@ -138,9 +152,10 @@ def _flash_chunk_bwd(causal, sm_scale, res, cts):
     b, h, s, d = q.shape
     # rebuild the kernels' lane-replicated lse layout from the row stat
     lse = jnp.broadcast_to(lse_rows.reshape(b * h, s, 1), (b * h, s, LANES))
+    bq, bk = _chunk_blocks(q.shape[2], k.shape[2])
     dq, dk, dv = _mha_bwd(q, k, v, out.astype(q.dtype), lse,
-                          g_out.astype(q.dtype), causal, sm_scale, 128,
-                          128, lse_ct=g_lse)
+                          g_out.astype(q.dtype), causal, sm_scale, bq,
+                          bk, lse_ct=g_lse)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 

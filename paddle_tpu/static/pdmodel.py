@@ -499,7 +499,16 @@ def _pool2d(jnp, ins, attrs):
                                     strides, pad)
     else:
         s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, pad)
-        out = s / (ks[0] * ks[1])
+        if attrs.get("exclusive", True) and any(p for p in pads):
+            # reference avg-pool default excludes padding from the divisor
+            # (exclusive=True): divide by the count of VALID elements in
+            # each window, not the full window size
+            ones = jnp.ones((1, 1) + x.shape[2:], x.dtype)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                        strides, pad)
+            out = s / cnt
+        else:
+            out = s / (ks[0] * ks[1])
     return {"Out": [out]}
 
 

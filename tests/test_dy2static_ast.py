@@ -104,6 +104,35 @@ class TestAstFallback:
             losses.append(float(loss.numpy()))
         assert losses[-1] < losses[0]
 
+    def test_branch_local_names_do_not_break_concrete_branches(self):
+        # a concrete `if` whose branch binds a name used only inside it
+        # must keep working after the rewrite (no NameError from the
+        # generated return of branch-local vars — _dy2s_get sentinel)
+        from paddle_tpu.jit.dy2static import ast_transform
+
+        def f(a, flag):
+            out = a * 2
+            if flag:
+                extra = a + 10
+                out = out + extra
+            return out
+
+        g = ast_transform(f)
+        assert g(5, True) == 25 and g(5, False) == 10
+
+    def test_loop_local_name_in_while(self):
+        from paddle_tpu.jit.dy2static import ast_transform
+
+        def f(n):
+            i = 0
+            while i < n:
+                tmp = i * 2  # loop-local, unbound before the loop
+                i = tmp // 2 + 1
+            return i
+
+        g = ast_transform(f)
+        assert g(3) == 3 and g(0) == 0
+
     def test_unsupported_constructs_left_alone(self):
         from paddle_tpu.jit.dy2static import ast_transform
 

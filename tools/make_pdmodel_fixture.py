@@ -155,7 +155,89 @@ def main():
     e = np.exp(logits - logits.max(-1, keepdims=True))
     probs = e / e.sum(-1, keepdims=True)
     np.savez(os.path.join(FIXDIR, "mlp_expected.npz"), x=x, probs=probs)
+
+    make_cnn_fixture(fp)
     print("fixture written:", sorted(os.listdir(FIXDIR)))
+
+
+def make_cnn_fixture(fp):
+    """Second fixture: conv2d → batch_norm (inference) → relu → pool2d →
+    flatten → layer_norm → scale, exercising the structural converters
+    beyond the MLP's matmul family."""
+    rng = np.random.RandomState(7)
+    params = {
+        "bn.b": rng.randn(4).astype(np.float32),
+        "bn.m": rng.rand(4).astype(np.float32),
+        "bn.v": (rng.rand(4) + 0.5).astype(np.float32),
+        "bn.w": rng.randn(4).astype(np.float32),
+        "conv.w": (rng.randn(4, 2, 3, 3) * 0.5).astype(np.float32),
+    }
+
+    prog = fp.ProgramDesc()
+    block = prog.blocks.add()
+    block.idx = 0
+    block.parent_idx = -1
+    add_var(block, "feed", FEED_MINIBATCH)
+    add_var(block, "img", LOD_TENSOR, FP32, [-1, 2, 8, 8])
+    for n, a in params.items():
+        add_var(block, n, LOD_TENSOR, FP32, list(a.shape), persistable=True)
+    for n in ("c0", "b0", "r0", "p0", "f0", "l0", "out"):
+        add_var(block, n, LOD_TENSOR, FP32, [-1, 4])
+    add_var(block, "fetch", FETCH_LIST)
+
+    add_op(block, fp, "feed", {"X": ["feed"]}, {"Out": ["img"]}, {"col": 0})
+    add_op(block, fp, "conv2d", {"Input": ["img"], "Filter": ["conv.w"]},
+           {"Output": ["c0"]},
+           {"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1],
+            "groups": 1})
+    add_op(block, fp, "batch_norm",
+           {"X": ["c0"], "Scale": ["bn.w"], "Bias": ["bn.b"],
+            "Mean": ["bn.m"], "Variance": ["bn.v"]},
+           {"Y": ["b0"], "MeanOut": ["bn.m"], "VarianceOut": ["bn.v"],
+            "SavedMean": [], "SavedVariance": []},
+           {"epsilon": 1e-5, "data_layout": "NCHW", "is_test": True})
+    add_op(block, fp, "relu", {"X": ["b0"]}, {"Out": ["r0"]})
+    add_op(block, fp, "pool2d", {"X": ["r0"]}, {"Out": ["p0"]},
+           {"pooling_type": "avg", "global_pooling": True})
+    add_op(block, fp, "flatten_contiguous_range", {"X": ["p0"]},
+           {"Out": ["f0"], "XShape": []},
+           {"start_axis": 1, "stop_axis": 3})
+    add_op(block, fp, "layer_norm", {"X": ["f0"]},
+           {"Y": ["l0"], "Mean": [], "Variance": []},
+           {"epsilon": 1e-5, "begin_norm_axis": 1})
+    add_op(block, fp, "scale", {"X": ["l0"]}, {"Out": ["out"]},
+           {"scale": 2.0, "bias": 1.0, "bias_after_scale": True})
+    add_op(block, fp, "fetch", {"X": ["out"]}, {"Out": ["fetch"]},
+           {"col": 0})
+    prog.version.version = 1
+
+    with open(os.path.join(FIXDIR, "cnn.pdmodel"), "wb") as f:
+        f.write(prog.SerializeToString())
+    with open(os.path.join(FIXDIR, "cnn.pdiparams"), "wb") as f:
+        for name in sorted(params):
+            f.write(serialize_tensor(fp, params[name]))
+
+    # expected with plain numpy
+    img = rng.randn(2, 2, 8, 8).astype(np.float32)
+    pad = np.pad(img, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    c = np.zeros((2, 4, 8, 8), np.float32)
+    for o in range(4):
+        for i in range(2):
+            for y in range(8):
+                for xx in range(8):
+                    c[:, o, y, xx] += np.einsum(
+                        "bij,ij->b", pad[:, i, y:y + 3, xx:xx + 3],
+                        params["conv.w"][o, i])
+    shape = (1, 4, 1, 1)
+    b = (c - params["bn.m"].reshape(shape)) / np.sqrt(
+        params["bn.v"].reshape(shape) + 1e-5) * \
+        params["bn.w"].reshape(shape) + params["bn.b"].reshape(shape)
+    r = np.maximum(b, 0)
+    p = r.mean(axis=(2, 3), keepdims=True).reshape(2, 4)
+    ln = (p - p.mean(-1, keepdims=True)) / np.sqrt(
+        p.var(-1, keepdims=True) + 1e-5)
+    out = ln * 2.0 + 1.0
+    np.savez(os.path.join(FIXDIR, "cnn_expected.npz"), img=img, out=out)
 
 
 if __name__ == "__main__":
