@@ -111,6 +111,24 @@ def _unwrap_np(tensor):
     return np.asarray(a)
 
 
+def _check_consistent(op, vals, ranks):
+    """Cross-rank dtype/shape agreement check at dispatch time — the
+    reference's CommDynamicCheck (phi/core/distributed/check/
+    nccl_dynamic_check.cc): a rank calling a collective with a mismatched
+    tensor gets a clear diagnostic naming the offending ranks instead of
+    a downstream np.stack/reshape error."""
+    shapes = [getattr(v, "shape", None) for v in vals]
+    dtypes = [getattr(v, "dtype", None) for v in vals]
+    if len(set(shapes)) > 1 or len(set(map(str, dtypes))) > 1:
+        detail = ", ".join(
+            f"rank {r}: shape={s} dtype={d}"
+            for r, s, d in zip(ranks, shapes, dtypes))
+        raise RuntimeError(
+            f"collective '{op}' called with mismatched tensors across "
+            f"ranks ({detail}); every member of the group must pass the "
+            f"same shape/dtype")
+
+
 def _eager_multirank(group) -> bool:
     n = group.nranks if group else env.get_world_size()
     return n > 1
@@ -240,6 +258,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
             return Task(tensor._data)
         return Task(out)
     vals = _exchange("ar", _unwrap_np(tensor), group)
+    _check_consistent("ar", vals, _group_info(group)[0])
     out = _np_reduce(np.stack(vals), op)
     tensor._data = jnp.asarray(out.astype(_unwrap_np(tensor).dtype))
     return Task(tensor._data)
@@ -260,6 +279,7 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
         tensor_list.append(tensor)
         return Task()
     vals = _exchange("ag", _unwrap_np(tensor), group)
+    _check_consistent("ag", vals, _group_info(group)[0])
     tensor_list.extend(Tensor(jnp.asarray(v)) for v in vals)
     return Task()
 
@@ -348,6 +368,7 @@ def reduce_scatter(tensor, tensor_list_or_input, op=ReduceOp.SUM, group=None,
         tensor._data = inp._data if isinstance(inp, Tensor) else inp
         return Task()
     vals = _exchange("rs", _unwrap_np(inp), group)
+    _check_consistent("rs", vals, _group_info(group)[0])
     total = _np_reduce(np.stack(vals), op)
     ranks, idx, _ = _group_info(group)
     chunk = total.shape[0] // len(ranks)
@@ -372,6 +393,7 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
         return Task()
     stacked = np.stack([_unwrap_np(t) for t in in_tensor_list])
     vals = _exchange("a2a", stacked, group)
+    _check_consistent("a2a", vals, _group_info(group)[0])
     ranks, idx, _ = _group_info(group)
     out_tensor_list.extend(Tensor(jnp.asarray(vals[i][idx]))
                            for i in range(len(ranks)))

@@ -202,6 +202,12 @@ class _PdModelArtifact:
         if os.path.exists(ppath):
             with open(ppath, "rb") as f:
                 params_bytes = f.read()
+        elif params_path is not None:
+            # an EXPLICIT params path that doesn't exist is a user error —
+            # degrading to a weightless program would only surface later
+            # as an opaque missing-var KeyError at the first predict
+            raise FileNotFoundError(
+                f"params file not found: {params_path}")
         self._prog = load_pdmodel(model_bytes, params_bytes)
         self.feed_names = list(self._prog.feed_names)
         # same dict spec shape the StableHLO artifact path produces

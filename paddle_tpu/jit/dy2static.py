@@ -33,20 +33,31 @@ __all__ = ["ast_transform", "convert_call_guard", "_dy2s_cond",
 
 class _Undefined:
     """Sentinel for a name not bound on the taken path (the reference's
-    dy2static UndefinedVar). Binding it is harmless; USING it in traced
-    control flow raises with a clear message instead of a confusing
-    pytree mismatch."""
+    dy2static UndefinedVar). Binding it is harmless; USING it raises
+    UnboundLocalError (a NameError subclass — `except NameError` handlers
+    written against the original code keep working) with a message that
+    names the actual problem."""
 
     __slots__ = ()
 
     def __repr__(self):
         return "<dy2static undefined>"
 
-    def __bool__(self):
-        raise NameError(
+    def _fail(self, *a, **k):
+        raise UnboundLocalError(
             "dy2static: variable is not defined on every control-flow "
             "path that reaches this use (assign it in both branches / "
             "before the loop)")
+
+    __bool__ = __float__ = __int__ = __len__ = __iter__ = _fail
+    __add__ = __radd__ = __sub__ = __rsub__ = _fail
+    __mul__ = __rmul__ = __truediv__ = __rtruediv__ = _fail
+    __matmul__ = __rmatmul__ = __getitem__ = __call__ = _fail
+    __lt__ = __le__ = __gt__ = __ge__ = _fail
+    __neg__ = __pos__ = __abs__ = __array__ = _fail
+
+    def __getattr__(self, name):
+        self._fail()
 
 
 _UNDEF = _Undefined()
@@ -183,6 +194,13 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         ret = ast.Return(value=ast.Tuple(
             elts=[ast.Name(id=n, ctx=ast.Load()) for n in outs],
             ctx=ast.Load()))
+        # evaluate the TEST first (a side-effecting test — e.g. a walrus
+        # binding one of the outs — must run before the branch defs
+        # snapshot enclosing values via their parameter defaults)
+        p_name = self._fresh("pred")
+        pred_stmt = ast.Assign(
+            targets=[ast.Name(id=p_name, ctx=ast.Store())],
+            value=node.test)
         true_def = _make_fn(t_name, _defaulted_args(outs),
                             list(node.body) + [ret])
         false_body = list(node.orelse) if node.orelse else []
@@ -190,7 +208,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                              false_body + [_copy_ret(ret)])
         call = ast.Call(
             func=ast.Name(id="_dy2s_cond", ctx=ast.Load()),
-            args=[node.test,
+            args=[ast.Name(id=p_name, ctx=ast.Load()),
                   ast.Name(id=t_name, ctx=ast.Load()),
                   ast.Name(id=f_name, ctx=ast.Load())],
             keywords=[])
@@ -202,7 +220,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 value=call)
         else:
             assign = ast.Expr(value=call)
-        return [true_def, false_def, assign]
+        return [pred_stmt, true_def, false_def, assign]
 
     # -- while → while_loop ------------------------------------------------
     def visit_While(self, node):

@@ -66,6 +66,17 @@ def _default_blocks(sq, sk):
     return bq, bk
 
 
+def clip_blocks(bq, bk, sq, sk):
+    """Shrink (bq, bk) to divisors of the sequence lengths, flooring at
+    the 128-lane tile. Shared by the main flash dispatch and the ring
+    chunks so block-selection constraints can't diverge."""
+    while sq % bq and bq > 128:
+        bq //= 2
+    while sk % bk and bk > 128:
+        bk //= 2
+    return bq, bk
+
+
 def _block_candidates(sq, sk):
     """Valid (block_q, block_k) choices for the autotuner (multiples of
     128 that divide the sequence lengths)."""
@@ -115,10 +126,7 @@ def flash_attention_bshd(q, k, v, causal=False, scale=None):
             bq, bk = _default_blocks(sq, sk)
         # shrink to divisors of the sequence (supported() guarantees
         # seq % 128 == 0, so the halving bottoms out at >= 128)
-        while sq % bq:
-            bq //= 2
-        while sk % bk:
-            bk //= 2
+        bq, bk = clip_blocks(bq, bk, sq, sk)
     out = mha(qt, kt, vt, causal=causal, sm_scale=s, block_q=bq, block_k=bk)
     return jnp.swapaxes(out, 1, 2)
 

@@ -112,13 +112,8 @@ def _chunk_blocks(sq, sk):
     """Per-chunk kernel tiles: the large-block policy that took the 1.3B
     config from 33.8% to 49.9% MFU (ops/flash_attention._default_blocks),
     clipped to divisors of the chunk length."""
-    from .flash_attention import _default_blocks
-    bq, bk = _default_blocks(sq, sk)
-    while sq % bq:
-        bq //= 2
-    while sk % bk:
-        bk //= 2
-    return bq, bk
+    from .flash_attention import _default_blocks, clip_blocks
+    return clip_blocks(*_default_blocks(sq, sk), sq, sk)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))

@@ -133,6 +133,34 @@ class TestAstFallback:
         g = ast_transform(f)
         assert g(3) == 3 and g(0) == 0
 
+    def test_side_effecting_test_evaluates_before_capture(self):
+        # a walrus in the if-test rebinding an output name must run
+        # BEFORE the branch functions snapshot enclosing values
+        from paddle_tpu.jit.dy2static import ast_transform
+
+        def f(x):
+            out = 0
+            if (out := x + 1) > 0:
+                out = out * 2
+            return out
+
+        g = ast_transform(f)
+        assert g(3) == f(3) == 8
+
+    def test_unbound_use_raises_nameerror_family(self):
+        from paddle_tpu.jit.dy2static import ast_transform
+
+        def h(a, flag):
+            if flag:
+                extra = a + 10
+            return extra + 1
+
+        g = ast_transform(h)
+        assert g(5, True) == 16
+        import pytest as _pytest
+        with _pytest.raises(NameError):  # UnboundLocalError ⊂ NameError
+            g(5, False)
+
     def test_unsupported_constructs_left_alone(self):
         from paddle_tpu.jit.dy2static import ast_transform
 
