@@ -367,10 +367,10 @@ def reduce_scatter(tensor, tensor_list_or_input, op=ReduceOp.SUM, group=None,
     if n <= 1:
         tensor._data = inp._data if isinstance(inp, Tensor) else inp
         return Task()
-    vals = _exchange("rs", _unwrap_np(inp), group)
-    _check_consistent("rs", vals, _group_info(group)[0])
-    total = _np_reduce(np.stack(vals), op)
     ranks, idx, _ = _group_info(group)
+    vals = _exchange("rs", _unwrap_np(inp), group)
+    _check_consistent("rs", vals, ranks)
+    total = _np_reduce(np.stack(vals), op)
     chunk = total.shape[0] // len(ranks)
     tensor._data = jnp.asarray(total[idx * chunk:(idx + 1) * chunk])
     return Task(tensor._data)
@@ -392,9 +392,9 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
         out_tensor_list.extend(in_tensor_list)
         return Task()
     stacked = np.stack([_unwrap_np(t) for t in in_tensor_list])
-    vals = _exchange("a2a", stacked, group)
-    _check_consistent("a2a", vals, _group_info(group)[0])
     ranks, idx, _ = _group_info(group)
+    vals = _exchange("a2a", stacked, group)
+    _check_consistent("a2a", vals, ranks)
     out_tensor_list.extend(Tensor(jnp.asarray(vals[i][idx]))
                            for i in range(len(ranks)))
     return Task()

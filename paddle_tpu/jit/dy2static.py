@@ -52,8 +52,14 @@ class _Undefined:
     __bool__ = __float__ = __int__ = __len__ = __iter__ = _fail
     __add__ = __radd__ = __sub__ = __rsub__ = _fail
     __mul__ = __rmul__ = __truediv__ = __rtruediv__ = _fail
+    __floordiv__ = __rfloordiv__ = __mod__ = __rmod__ = _fail
+    __pow__ = __rpow__ = __and__ = __or__ = __xor__ = _fail
     __matmul__ = __rmatmul__ = __getitem__ = __call__ = _fail
     __lt__ = __le__ = __gt__ = __ge__ = _fail
+    # == / != would otherwise silently fall back to identity comparison —
+    # the one place silent wrongness is worst
+    __eq__ = __ne__ = _fail
+    __hash__ = None  # eq without hash: keep it out of dicts/sets quietly
     __neg__ = __pos__ = __abs__ = __array__ = _fail
 
     def __getattr__(self, name):

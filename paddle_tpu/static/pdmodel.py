@@ -598,6 +598,119 @@ def _sum(jnp, ins, attrs):
     return {"Out": [out]}
 
 
+def _stack(jnp, ins, attrs):
+    return {"Y": [jnp.stack(ins["X"], axis=attrs.get("axis", 0))]}
+
+
+def _split(jnp, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    num = attrs.get("num", 0)
+    sections = list(attrs.get("sections", []))
+    if sections:
+        if -1 in sections:  # one inferred section (fluid semantics)
+            known = sum(s for s in sections if s != -1)
+            sections[sections.index(-1)] = x.shape[axis] - known
+        idx = np.cumsum(sections[:-1]).tolist()
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, num, axis=axis)
+    return {"Out": list(outs)}
+
+
+def _expand_v2(jnp, ins, attrs):
+    x = ins["X"][0]
+    shape = [int(s) for s in attrs.get("shape", [])]
+    # fluid semantics: when shape is longer than x.ndim, x's dims align to
+    # the TRAILING positions; -1 keeps the corresponding input dim
+    off = len(shape) - x.ndim
+    tgt = [(x.shape[i - off] if s == -1 else s)
+           for i, s in enumerate(shape)]
+    return {"Out": [jnp.broadcast_to(x, tgt)]}
+
+
+def _fill_any_like(jnp, ins, attrs):
+    x = ins["X"][0]
+    dt = attrs.get("dtype", -1)
+    dtype = x.dtype if dt in (-1, None) else PROTO_DTYPES[dt]
+    return {"Out": [jnp.full_like(x, attrs.get("value", 0.0), dtype)]}
+
+
+def _gather(jnp, ins, attrs):
+    idx = ins["Index"][0]
+    if idx.ndim == 2 and idx.shape[-1] == 1:
+        idx = idx.squeeze(-1)
+    return {"Out": [jnp.take(ins["X"][0], idx,
+                             axis=attrs.get("axis", 0))]}
+
+
+def _pow(jnp, ins, attrs):
+    return {"Out": [jnp.power(ins["X"][0], attrs.get("factor", 1.0))]}
+
+
+def _mean(jnp, ins, attrs):
+    return {"Out": [jnp.mean(ins["X"][0])]}
+
+
+def _leaky_relu(jnp, ins, attrs):
+    import jax
+    return {"Out": [jax.nn.leaky_relu(ins["X"][0],
+                                      attrs.get("alpha", 0.02))]}
+
+
+def _elu(jnp, ins, attrs):
+    import jax
+    return {"Out": [jax.nn.elu(ins["X"][0], attrs.get("alpha", 1.0))]}
+
+
+def _swish(jnp, ins, attrs):
+    import jax
+    x = ins["X"][0]
+    beta = attrs.get("beta", 1.0)  # fluid swish: x * sigmoid(beta * x)
+    return {"Out": [x * jax.nn.sigmoid(beta * x)]}
+
+
+def _hard_sigmoid(jnp, ins, attrs):
+    sl = attrs.get("slope", 0.2)
+    off = attrs.get("offset", 0.5)
+    return {"Out": [jnp.clip(ins["X"][0] * sl + off, 0.0, 1.0)]}
+
+
+def _hard_swish(jnp, ins, attrs):
+    x = ins["X"][0]
+    th = attrs.get("threshold", 6.0)
+    return {"Out": [x * jnp.clip(x + attrs.get("offset", 3.0), 0.0, th)
+                    / attrs.get("scale", 6.0)]}
+
+
+def _softplus(jnp, ins, attrs):
+    import jax
+    return {"Out": [jax.nn.softplus(ins["X"][0])]}
+
+
+def _log_softmax(jnp, ins, attrs):
+    import jax
+    return {"Out": [jax.nn.log_softmax(ins["X"][0],
+                                       axis=attrs.get("axis", -1))]}
+
+
+def _interp(method):
+    def run(jnp, ins, attrs):
+        import jax
+        x = ins["X"][0]
+        oh = attrs.get("out_h", 0)
+        ow = attrs.get("out_w", 0)
+        scale = attrs.get("scale", [])
+        if (not oh or oh <= 0) and scale:
+            s = scale if isinstance(scale, (list, tuple)) else [scale, scale]
+            oh = int(x.shape[2] * s[0])
+            ow = int(x.shape[3] * s[-1])
+        out = jax.image.resize(x, (x.shape[0], x.shape[1], oh, ow),
+                               method=method)
+        return {"Out": [out]}
+    return run
+
+
 _CONVERTERS = {
     "matmul_v2": _matmul_v2, "matmul": _matmul_v1, "mul": _mul,
     "elementwise_add": _elementwise(lambda a, b: a + b),
@@ -616,6 +729,16 @@ _CONVERTERS = {
     "flatten2": _flatten, "flatten_contiguous_range": _flatten,
     "slice": _slice, "arg_max": _arg_max, "assign": _assign,
     "clip": _clip, "sum": _sum,
+    "stack": _stack, "split": _split, "expand_v2": _expand_v2,
+    "fill_any_like": _fill_any_like, "gather": _gather, "pow": _pow,
+    "mean": _mean, "leaky_relu": _leaky_relu, "elu": _elu,
+    "swish": _swish, "hard_sigmoid": _hard_sigmoid,
+    "hard_swish": _hard_swish, "softplus": _softplus,
+    "log_softmax": _log_softmax,
+    "nearest_interp_v2": _interp("nearest"),
+    "nearest_interp": _interp("nearest"),
+    "bilinear_interp_v2": _interp("bilinear"),
+    "bilinear_interp": _interp("bilinear"),
 }
 for _name in ("relu", "sigmoid", "tanh", "sqrt", "abs", "exp", "log",
               "floor", "ceil", "square", "reciprocal", "silu", "relu6"):
