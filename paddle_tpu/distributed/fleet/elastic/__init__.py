@@ -2,10 +2,20 @@
 manager.py:126 — etcd-watched membership, scale in/out, restart).
 
 TPU-native stance (SURVEY §5.3): mid-program ICI failures are not
-survivable, so elasticity = job-level restart + checkpoint resume. The
-launcher implements the restart loop (`--elastic_level`/`--max_restarts`,
-paddle_tpu.distributed.launch); ElasticManager is the thin status surface
-over it.
+survivable, so elasticity = job-level restart + checkpoint resume,
+with FAULT DETECTION split across:
+
+- the launcher's restart loop (`--elastic_level`/`--max_restarts`,
+  distributed/launch/main.py) catching non-zero exits;
+- the heartbeat watchdog (the reference's etcd heartbeat analog):
+  workers bump ``hb/<rank>`` in a LAUNCHER-owned TCPStore
+  (distributed/env.py ``_start_heartbeat``) and the launcher's
+  ``_HeartbeatWatcher`` SIGKILLs + relaunches when a rank goes silent
+  (catches hangs/SIGSTOP that never exit; e2e:
+  tests/test_launch.py::test_elastic_heartbeat_detects_silent_hang).
+
+ElasticManager is the thin status surface workers read (attempt count →
+checkpoint-resume decision).
 """
 from __future__ import annotations
 

@@ -1,0 +1,117 @@
+"""Round-3 hapi Model additions: AMP prepare, eval-metric threading into
+epoch logs, inference export, and the static.nn builder namespace.
+Reference: hapi/model.py prepare(amp_configs)/fit/save(training=False)."""
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.vision.datasets import FakeMNIST
+
+
+def _net():
+    return nn.Sequential(nn.Flatten(), nn.Linear(784, 10))
+
+
+class TestModelExtras:
+    def test_fit_with_amp_configs(self):
+        paddle.seed(0)
+        net = _net()
+        m = paddle.Model(net)
+        m.prepare(paddle.optimizer.Adam(learning_rate=1e-3,
+                                        parameters=net.parameters()),
+                  nn.CrossEntropyLoss(), paddle.metric.Accuracy(),
+                  amp_configs="O1")
+        hist = m.fit(FakeMNIST(n=64), epochs=1, batch_size=32, verbose=0)
+        assert all(np.isfinite(v) for v in hist["loss"])
+        assert m._scaler is not None  # GradScaler engaged
+
+    def test_fit_threads_eval_metrics_into_history(self):
+        paddle.seed(0)
+        net = _net()
+        m = paddle.Model(net)
+        m.prepare(paddle.optimizer.Adam(learning_rate=1e-3,
+                                        parameters=net.parameters()),
+                  nn.CrossEntropyLoss(), paddle.metric.Accuracy())
+        ds = FakeMNIST(n=64)
+        hist = m.fit(ds, eval_data=ds, epochs=2, batch_size=32, verbose=0)
+        assert "eval_loss" in hist and len(hist["eval_loss"]) == 2
+
+    def test_save_inference_export(self, tmp_path):
+        paddle.seed(0)
+        net = _net()
+        m = paddle.Model(net, inputs=[
+            paddle.static.InputSpec([None, 1, 28, 28], "float32")])
+        m.prepare(paddle.optimizer.Adam(learning_rate=1e-3,
+                                        parameters=net.parameters()),
+                  nn.CrossEntropyLoss())
+        prefix = os.path.join(str(tmp_path), "infer")
+        m.save(prefix, training=False)
+        assert os.path.exists(prefix + ".pdmodel")
+        # exported artifact serves through load_inference_model
+        prog, feeds, fetches = paddle.static.load_inference_model(prefix)
+        exe = paddle.static.Executor()
+        x = np.random.RandomState(0).randn(2, 1, 28, 28).astype("float32")
+        out = exe.run(prog, feed={feeds[0]: x}, fetch_list=fetches)
+        want = np.asarray(net(paddle.to_tensor(x)).numpy())
+        np.testing.assert_allclose(out[0], want, rtol=1e-4, atol=1e-5)
+
+    def test_save_inference_requires_input_spec(self, tmp_path):
+        import pytest
+        m = paddle.Model(_net())
+        with pytest.raises(ValueError):
+            m.save(os.path.join(str(tmp_path), "x"), training=False)
+
+
+class TestStaticNnBuilders:
+    def test_conv_bn_stack_executes(self):
+        paddle.enable_static()
+        main = paddle.static.Program()
+        try:
+            with paddle.static.program_guard(main):
+                x = paddle.static.data("x", [2, 3, 8, 8])
+                c = paddle.static.nn.conv2d(x, 4, 3, padding=1, act="relu")
+                b = paddle.static.nn.batch_norm(c, is_test=True)
+                g = paddle.static.nn.group_norm(b, 2)
+                f = paddle.static.nn.fc(paddle.flatten(g, 1), 6,
+                                        activation="relu")
+                out = paddle.static.nn.layer_norm(f).sum()
+        finally:
+            paddle.disable_static()
+        exe = paddle.static.Executor()
+        res = exe.run(main,
+                      feed={"x": np.random.rand(2, 3, 8, 8)
+                            .astype("float32")},
+                      fetch_list=[out])
+        assert np.isfinite(res[0]).all()
+
+    def test_nhwc_channel_inference(self):
+        paddle.enable_static()
+        main = paddle.static.Program()
+        try:
+            with paddle.static.program_guard(main):
+                x = paddle.static.data("x", [2, 8, 8, 3])
+                c = paddle.static.nn.conv2d(x, 4, 3, padding=1,
+                                            data_format="NHWC")
+                out = c.sum()
+        finally:
+            paddle.disable_static()
+        exe = paddle.static.Executor()
+        res = exe.run(main,
+                      feed={"x": np.random.rand(2, 8, 8, 3)
+                            .astype("float32")},
+                      fetch_list=[out])
+        assert np.isfinite(res[0]).all()
+
+    def test_case_and_switch_case(self):
+        r = paddle.static.nn.case(
+            [(paddle.to_tensor(False), lambda: paddle.to_tensor(1.0))],
+            default=lambda: paddle.to_tensor(2.0))
+        assert float(r.numpy()) == 2.0
+        s = paddle.static.nn.switch_case(
+            paddle.to_tensor(1),
+            {0: lambda: paddle.to_tensor(10.0),
+             1: lambda: paddle.to_tensor(20.0)},
+            default=lambda: paddle.to_tensor(0.0))
+        assert float(s.numpy()) == 20.0
