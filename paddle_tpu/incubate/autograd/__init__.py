@@ -40,6 +40,16 @@ def forward_grad(outputs, inputs, grad_inputs=None):
     single = isinstance(outputs, Tensor)
     outs = [outputs] if single else list(outputs)
     ins = [inputs] if isinstance(inputs, Tensor) else list(inputs)
+
+    # Static mode (the reference's primary forward_grad surface,
+    # primapi.py operating on the ProgramDesc): jax.jvp over the
+    # whole-Program replay from the input vars to the output vars.
+    from ...static import program as static_program
+    prog = static_program.default_main_program()
+    if static_program.in_static_mode() and any(
+            id(t) in prog.var_by_id for t in outs):
+        return _static_forward_grad(prog, outs, ins, grad_inputs, single)
+
     if grad_inputs is None:
         seeds = [jnp.ones_like(t._data) for t in ins]
     else:
@@ -124,6 +134,52 @@ def forward_grad(outputs, inputs, grad_inputs=None):
         else:
             tan = jnp.zeros_like(t._data)
         results.append(Tensor(tan, stop_gradient=True))
+    return results[0] if single else results
+
+
+_jvp_call_counter = [0]
+
+
+def _static_forward_grad(prog, outs, ins, grad_inputs, single):
+    """forward_grad over a recorded static Program: register tangent
+    placeholder vars whose values Executor.run computes by ``jax.jvp`` of
+    the Program replay w.r.t. the input vars.
+
+    Seeds resolve at RUN time (not registration): ``None`` → ones matching
+    the fed primal (so dynamic batch dims work); a symbolic Program var
+    (e.g. a feed) → its run-time value; a concrete tensor → its array.
+    All outputs of one call share a token so the Executor computes them
+    in a single jvp of the replay."""
+    import numpy as np
+
+    from ...core.tensor import Tensor
+
+    seed_specs = []
+    if grad_inputs is None:
+        seed_specs = [("ones", None)] * len(ins)
+    else:
+        gi = [grad_inputs] if isinstance(grad_inputs, Tensor) \
+            else list(grad_inputs)
+        for g in gi:
+            if isinstance(g, Tensor) and id(g) in prog.var_by_id:
+                # symbolic var (a feed or computed var): resolve per run
+                seed_specs.append(("var", id(g)))
+            elif isinstance(g, Tensor):
+                seed_specs.append(("arr", np.asarray(g._data)))
+            else:
+                seed_specs.append(("arr", np.asarray(g)))
+
+    _jvp_call_counter[0] += 1
+    token = _jvp_call_counter[0]
+    results = []
+    for t in outs:
+        g = Tensor(np.zeros(t.shape, t._data.dtype),
+                   name=(t.name or "out") + "@FWDGRAD")
+        g.stop_gradient = True
+        prog.jvp_map[id(g)] = (token, id(t), [id(i) for i in ins],
+                               seed_specs)
+        prog.var_by_id[id(g)] = g
+        results.append(g)
     return results[0] if single else results
 
 

@@ -159,7 +159,13 @@ class Optimizer:
                 program.params.setdefault(id(p), p)
                 program.var_by_id.setdefault(id(p), p)
             program.train_spec = (id(loss), self, [id(p) for p in params])
-            return None, [(p, None) for p in params]
+            # fetchable grad vars, like the reference's returned
+            # params_grads (append_backward registers them in grad_map
+            # on the current default program)
+            from ..static import append_backward
+            pairs = append_backward(loss, parameter_list=params,
+                                    no_grad_set=no_grad_set)
+            return None, pairs
         loss.backward()
         self.step()
         return None, [(p, p.grad) for p in (parameters or self._parameters)]

@@ -69,6 +69,9 @@ class Program:
         self.grad_map: Dict[int, tuple] = {}
         # optimizer.minimize registration: (id(loss), optimizer, [param_ids]).
         self.train_spec = None
+        # incubate.autograd.forward_grad registrations:
+        # id(tangent_placeholder) -> (id(out_var), [input var ids], seeds).
+        self.jvp_map: Dict[int, tuple] = {}
 
     def global_block(self):
         return self
@@ -83,11 +86,21 @@ class Program:
         if not for_test:
             p.grad_map = dict(self.grad_map)
             p.train_spec = self.train_spec
+            p.jvp_map = dict(self.jvp_map)
         return p
 
     # ---- recording (called from dispatch) ----
     def record(self, name, fn, in_tensors, out_tensors):
         for t in in_tensors:
+            if id(t) in self.jvp_map:
+                # a forward_grad tangent placeholder is resolved by the
+                # Executor at FETCH time only; letting an op consume it
+                # would silently replay its zero placeholder value
+                raise NotImplementedError(
+                    "composing ops on a static forward_grad tangent is "
+                    "not supported yet: fetch the tangent via "
+                    "Executor.run and continue in a second program, or "
+                    "use eager forward_grad")
             if isinstance(t, Parameter):
                 self.params[id(t)] = t
             self.var_by_id.setdefault(id(t), t)
@@ -101,27 +114,37 @@ class Program:
         self.var_by_id[id(tensor)] = tensor
 
     # ---- execution ----
-    def _forward_fn(self, feed_names):
-        """Pure (feed_arrays, param_arrays) -> values-dict replay of ops."""
+    def _forward_fn(self, feed_names, override_ids=()):
+        """Pure (feed_arrays, param_arrays[, overrides]) -> values-dict
+        replay of ops. ``override_ids``: var ids whose values are INJECTED
+        (extra positional list) and protected from being re-written by
+        their producing ops — the differentiation points of the static
+        forward_grad path (an intermediate var's op would otherwise sever
+        the jvp dependency by overwriting the injected primal)."""
         ops = self.ops
         feed_ids = [id(self.feed_vars[n]) for n in feed_names]
+        override_ids = tuple(override_ids)
+        oset = set(override_ids)
         const_vals = {}
         for vid, var in self.var_by_id.items():
             if isinstance(var._data, jax.Array) or isinstance(
                     var._data, np.ndarray):
                 const_vals[vid] = var._data
 
-        def forward(feed_arrays, param_arrays):
+        def forward(feed_arrays, param_arrays, overrides=()):
             values = dict(const_vals)
             values.update(param_arrays)
             for fid, arr in zip(feed_ids, feed_arrays):
                 values[fid] = arr
+            for vid, v in zip(override_ids, overrides):
+                values[vid] = v
             for op in ops:
                 args = [values[i] for i in op.input_ids]
                 out = op.fn(*args)
                 outs = out if isinstance(out, (tuple, list)) else [out]
                 for oid, o in zip(op.output_ids, outs):
-                    values[oid] = o
+                    if oid not in oset:
+                        values[oid] = o
             return values
 
         return forward
@@ -156,6 +179,29 @@ class Program:
                 sub = {pid: param_arrays[pid] for pid in param_ids}
                 gradsets.append(jax.grad(loss_fn)(sub))
             return fetches, gradsets
+
+        return run
+
+    def _jvp_fn(self, feed_names, out_ids, input_ids):
+        """Forward-mode tangents of ``out_ids`` w.r.t. ``input_ids``
+        (feeds/params/consts/intermediates) via ONE ``jax.jvp`` over the
+        override-aware replay — the static half of
+        incubate.autograd.forward_grad (reference primapi.py linearize
+        over the ProgramDesc)."""
+        forward = self._forward_fn(feed_names, override_ids=input_ids)
+
+        def run(feed_arrays, param_arrays, in_vals, seeds):
+            def outs_of(*vals):
+                values = forward(feed_arrays, param_arrays, vals)
+                return tuple(values[oid] for oid in out_ids)
+
+            primals = tuple(in_vals)
+            tangents = tuple(
+                jnp.asarray(s).astype(p.dtype)
+                if jnp.asarray(s).dtype != p.dtype else jnp.asarray(s)
+                for s, p in zip(seeds, primals))
+            _, tangents_out = jax.jvp(outs_of, primals, tangents)
+            return tangents_out
 
         return run
 
@@ -270,14 +316,84 @@ class Executor:
         param_arrays = {pid: p._data for pid, p in program.params.items()}
         shapes = [tuple(a.shape) + (str(a.dtype),) for a in feed_arrays]
 
+        # Resolve forward-mode tangent placeholders (forward_grad): ONE
+        # jitted jax.jvp over the replay per forward_grad CALL (outputs of
+        # the same call share a token and compute together).
+        jvp_vals = {}
+        jvp_groups = {}  # token -> (out_ids, positions, input_ids, specs)
+        for i, fid in enumerate(fetch_ids):
+            spec = program.jvp_map.get(fid)
+            if spec is None:
+                continue
+            token, out_id, input_ids, seed_specs = spec
+            g = jvp_groups.setdefault(
+                token, ([], [], input_ids, seed_specs))
+            g[0].append(out_id)
+            g[1].append(i)
+
+        produced = {oid for op in program.ops for oid in op.output_ids} \
+            if jvp_groups else set()
+        _runtime_cache = {}
+
+        def _value_of(iid):
+            if iid in param_arrays:
+                return param_arrays[iid]
+            hit = next(
+                (feed_arrays[j] for j, n in enumerate(feed_names)
+                 if id(program.feed_vars[n]) == iid), None)
+            if hit is not None:
+                return hit
+            if iid in produced:
+                # INTERMEDIATE var: its build-time placeholder value is
+                # stale — compute the run-time value from the current
+                # feeds via the plain replay (jitted, cached per shape)
+                if iid not in _runtime_cache:
+                    fn = program.compiled((iid,), feed_names, shapes)
+                    _runtime_cache[iid] = fn(feed_arrays, param_arrays)[0]
+                return _runtime_cache[iid]
+            return program.var_by_id[iid]._data
+
+        for token, (out_ids, positions, input_ids, seed_specs) in \
+                jvp_groups.items():
+            key = ("jvp", token, tuple(out_ids), tuple(feed_names),
+                   tuple(shapes))
+            fn = program._compile_cache.get(key)
+            if fn is None:
+                fn = jax.jit(program._jvp_fn(feed_names, tuple(out_ids),
+                                             tuple(input_ids)))
+                program._compile_cache[key] = fn
+            in_vals = [_value_of(iid) for iid in input_ids]
+            # seeds resolve at RUN time: ones matching the fed primal
+            # (dynamic batch), a symbolic var's current value, or a
+            # concrete array
+            seeds = []
+            for (kind, payload), p in zip(seed_specs, in_vals):
+                if kind == "ones":
+                    seeds.append(jnp.ones_like(p))
+                elif kind == "var":
+                    seeds.append(jnp.asarray(_value_of(payload)))
+                else:
+                    seeds.append(jnp.asarray(payload))
+            tangents = fn(feed_arrays, param_arrays, in_vals, seeds)
+            for pos, t in zip(positions, tangents):
+                jvp_vals[pos] = t
+
         # Resolve grad placeholders (append_backward) and a minimize()d
         # train step: both differentiate the whole-program replay.
         grad_fetch_pos = [i for i, fid in enumerate(fetch_ids)
-                          if fid in program.grad_map]
+                          if fid in program.grad_map and i not in jvp_vals]
         train = program.train_spec
         if not grad_fetch_pos and train is None:
-            fn = program.compiled(fetch_ids, feed_names, shapes)
-            outs = fn(feed_arrays, param_arrays)
+            plain = [fid for i, fid in enumerate(fetch_ids)
+                     if i not in jvp_vals]
+            if plain or not jvp_vals:
+                fn = program.compiled(tuple(plain), feed_names, shapes)
+                plain_outs = iter(fn(feed_arrays, param_arrays))
+            else:
+                plain_outs = iter(())  # everything fetched was a tangent
+            outs = [jvp_vals[i] if i in jvp_vals else next(plain_outs)
+                    for i in range(len(fetch_ids))]
+            jvp_vals = {}
         else:
             plain_fetch_ids = [fid for fid in fetch_ids
                                if fid not in program.grad_map]
@@ -320,6 +436,10 @@ class Executor:
                                                       stop_gradient=True))
                          for pid in param_ids if pid in program.params]
                 optimizer.apply_gradients(pairs)
+        if jvp_vals:
+            outs = list(outs)
+            for i, v in jvp_vals.items():
+                outs[i] = v
         if return_numpy:
             return [np.asarray(o) for o in outs]
         return [Tensor(o) for o in outs]

@@ -218,6 +218,78 @@ class TestStaticAutodiff:
         np.testing.assert_allclose(fetches[1], dW, rtol=1e-4, atol=1e-5)
         np.testing.assert_allclose(fetches[2], db, rtol=1e-4, atol=1e-5)
 
+    def test_static_forward_grad(self):
+        # reference primapi.forward_grad operates on the static Program;
+        # the tangent var must be fetchable through Executor.run
+        paddle.enable_static()
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data("x", [3])
+            y = x * x + paddle.sin(x)
+            t = paddle.incubate.autograd.forward_grad(y, (x,))
+        paddle.disable_static()
+        exe = paddle.static.Executor()
+        xv = np.array([1.0, 2.0, 3.0], np.float32)
+        out = exe.run(main, feed={"x": xv}, fetch_list=[y, t])
+        np.testing.assert_allclose(out[1], 2 * xv + np.cos(xv), atol=1e-5)
+
+    def test_static_forward_grad_intermediate_input(self):
+        # JVP w.r.t. an INTERMEDIATE var: the producing op must not
+        # overwrite the injected primal (would sever the dependency)
+        paddle.enable_static()
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data("x", [3])
+            y = x * x
+            z = paddle.sin(y)
+            t = paddle.incubate.autograd.forward_grad(z, (y,))
+        paddle.disable_static()
+        exe = paddle.static.Executor()
+        xv = np.array([0.5, 1.0, 1.5], np.float32)
+        (tv,) = exe.run(main, feed={"x": xv}, fetch_list=[t])
+        np.testing.assert_allclose(tv, np.cos(xv * xv), atol=1e-5)
+
+    def test_static_forward_grad_dynamic_batch_and_var_seed(self):
+        # default seeds resolve against the FED shape (dynamic batch),
+        # and a symbolic var seed takes its run-time value
+        paddle.enable_static()
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data("x", [None, 3])
+            v = paddle.static.data("v", [None, 3])
+            y = x * x
+            t_ones = paddle.incubate.autograd.forward_grad(y, (x,))
+            t_var = paddle.incubate.autograd.forward_grad(y, (x,), (v,))
+        paddle.disable_static()
+        exe = paddle.static.Executor()
+        xv = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+        vv = np.random.RandomState(1).randn(4, 3).astype(np.float32)
+        out = exe.run(main, feed={"x": xv, "v": vv},
+                      fetch_list=[t_ones, t_var])
+        np.testing.assert_allclose(out[0], 2 * xv, atol=1e-5)
+        np.testing.assert_allclose(out[1], 2 * xv * vv, atol=1e-5)
+
+    def test_static_minimize_returns_fetchable_grads(self):
+        paddle.enable_static()
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data("x", [4, 2])
+            lin = paddle.nn.Linear(2, 1)
+            loss = (lin(x) ** 2).mean()
+            opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                       parameters=lin.parameters())
+            _, pairs = opt.minimize(loss)
+        paddle.disable_static()
+        exe = paddle.static.Executor()
+        xv = np.ones((4, 2), np.float32)
+        w = np.asarray(lin.weight.numpy()).copy()
+        b = np.asarray(lin.bias.numpy()).copy()
+        res = exe.run(main, feed={"x": xv}, fetch_list=[loss, pairs[0][1]])
+        # dL/dW for L = mean((xW+b)^2): closed form at step-start params
+        pred = xv @ w + b
+        dW = 2.0 / pred.size * xv.T @ pred
+        np.testing.assert_allclose(res[1], dW, rtol=1e-4, atol=1e-5)
+
     def test_grad_fetch_without_minimize_does_not_update_params(self):
         paddle.enable_static()
         main = paddle.static.Program()
