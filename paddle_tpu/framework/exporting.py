@@ -1,17 +1,18 @@
-"""Serializable program artifact — the pdmodel/pdiparams equivalent.
+"""Serializable compiled-program artifact (the fast serving path).
 
 Reference: paddle's inference artifact is a ProgramDesc protobuf + packed
 params (/root/reference/python/paddle/static/io.py:442,723 and
 paddle/fluid/jit/serializer.cc). TPU-native design: the traced program is
 serialized as StableHLO bytes via ``jax.export`` (portable across processes
-and compiled AOT by XLA at load), weights ride next to it. Artifacts are
+and compiled AOT by XLA at load), weights ride inside it. Artifacts are
 exported for both cpu and tpu platforms so a model saved on a TPU host can
 be smoke-tested on CPU and vice versa.
 
-Artifact layout (``<prefix>.pdmodel`` + ``<prefix>.pdiparams``):
-- pdmodel:  pickled dict {format, stablehlo bytes, weight_names,
-            feed specs (name/shape/dtype), nr outputs}
-- pdiparams: pickled dict name -> np.ndarray
+The REFERENCE wire format (.pdmodel ProgramDesc protobuf + .pdiparams
+tensor stream) is written separately by static/pdmodel_export.py; this
+module's artifact is the whole-program-compiled twin, stored as ONE pickle
+file ``<prefix>.pdexec`` {format, stablehlo bytes, weight_names, weights,
+feed specs (name/shape/dtype), nr outputs}.
 """
 from __future__ import annotations
 
@@ -22,7 +23,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 import jax
 import numpy as np
 
-FORMAT = "paddle_tpu.export.v1"
+FORMAT = "paddle_tpu.export.v2"  # v2: single .pdexec file, weights embedded
 
 
 def _spec_of(a) -> dict:
@@ -60,16 +61,15 @@ def export_artifact(path_prefix: str, fn: Callable,
         "format": FORMAT,
         "stablehlo": exp.serialize(),
         "weight_names": names,
+        "weights": {n: np.asarray(weights[n]) for n in names},
         "feed_names": feed_names or [f"feed_{i}"
                                      for i in range(len(input_specs))],
         "feeds": [_spec_of(s) for s in input_specs],
         "n_outputs": len(exp.out_avals),
         "platforms": list(exp.platforms),
     }
-    with open(path_prefix + ".pdmodel", "wb") as f:
+    with open(path_prefix + ".pdexec", "wb") as f:
         pickle.dump(meta, f)
-    with open(path_prefix + ".pdiparams", "wb") as f:
-        pickle.dump({n: np.asarray(weights[n]) for n in names}, f)
     return path_prefix
 
 
@@ -78,13 +78,38 @@ class LoadedArtifact:
 
     def __init__(self, path_prefix: str,
                  params_path: Optional[str] = None):
-        with open(path_prefix + ".pdmodel", "rb") as f:
+        with open(path_prefix + ".pdexec", "rb") as f:
             meta = pickle.load(f)
         if meta.get("format") != FORMAT:
             raise ValueError(
-                f"{path_prefix}.pdmodel is not a {FORMAT} artifact")
-        with open(params_path or path_prefix + ".pdiparams", "rb") as f:
-            self.weights = pickle.load(f)
+                f"{path_prefix}.pdexec is not a {FORMAT} artifact")
+        self.weights = meta["weights"]
+        if params_path is not None:
+            # explicit weight override: a pickle dict, or a reference
+            # save_combine tensor stream (same sorted-name order as
+            # weight_names)
+            with open(params_path, "rb") as f:
+                raw = f.read()
+            if raw[:1] == b"\x80":
+                self.weights = pickle.loads(raw)
+            else:
+                from ..static.pdmodel import parse_combined_params
+                try:
+                    parsed = parse_combined_params(
+                        raw, meta["weight_names"])
+                except ValueError as e:
+                    raise ValueError(
+                        f"{params_path} does not match this artifact's "
+                        f"weight list (a co-exported .pdiparams may carry "
+                        f"extra folded constants — serve via the "
+                        f".pdmodel/.pdiparams pair instead): {e}") from e
+                for n, arr in parsed.items():
+                    want = np.shape(meta["weights"][n])
+                    if tuple(arr.shape) != tuple(want):
+                        raise ValueError(
+                            f"{params_path}: tensor {n!r} has shape "
+                            f"{arr.shape}, artifact expects {want}")
+                self.weights = parsed
         self.meta = meta
         self.feed_names = meta["feed_names"]
         self.feeds = meta["feeds"]

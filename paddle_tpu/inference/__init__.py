@@ -61,12 +61,15 @@ class Config:
             if model_path.endswith(".pdmodel"):
                 model_path = model_path[:-len(".pdmodel")]
             if os.path.isdir(model_path):
-                cands = [f[:-len(".pdmodel")]
+                cands = {f[:-len(".pdmodel")]
                          for f in os.listdir(model_path)
-                         if f.endswith(".pdmodel")]
+                         if f.endswith(".pdmodel")}
+                cands |= {f[:-len(".pdexec")]
+                          for f in os.listdir(model_path)
+                          if f.endswith(".pdexec")}
                 if not cands:
                     raise ValueError(
-                        f"no .pdmodel artifact under {model_path}")
+                        f"no .pdmodel/.pdexec artifact under {model_path}")
                 self._prefix = os.path.join(model_path, sorted(cands)[0])
             else:
                 self._prefix = model_path
@@ -251,7 +254,13 @@ class Predictor:
             raise ValueError("Config has no model path")
         self._config = config
         pd_bytes = _sniff_reference_pdmodel(config._prefix)
-        if pd_bytes is not None:
+        # routing: an explicit params file belongs to the proto pair (the
+        # self-consistent combination); otherwise the pre-compiled .pdexec
+        # twin is the fast path when present
+        use_proto = pd_bytes is not None and (
+            config._params_path is not None
+            or not os.path.exists(str(config._prefix) + ".pdexec"))
+        if use_proto:
             self._artifact = _PdModelArtifact(pd_bytes,
                                               config._params_path,
                                               prefix=config._prefix)

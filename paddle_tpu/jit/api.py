@@ -219,6 +219,18 @@ def save(layer, path, input_spec=None, **configs):
 
     export_artifact(path, run, weights, specs, feed_names=names)
 
+    # reference wire format (.pdmodel ProgramDesc + .pdiparams) so models
+    # trained here deploy to Paddle Inference / paddle2onnx consumers
+    if configs.get("pdmodel_format", True):
+        from ..static.pdmodel_export import save_pdmodel
+        try:
+            save_pdmodel(path, run, weights, specs, names)
+        except NotImplementedError as e:
+            import warnings
+            warnings.warn(
+                f"reference-format .pdmodel export skipped for {path}: "
+                f"{e} (the .pdexec artifact was still written)")
+
 
 class TranslatedLayer(Layer):
     """Inference-only layer reconstructed from a saved artifact

@@ -1,9 +1,13 @@
 """Inference-model export/import
 (reference: /root/reference/python/paddle/static/io.py:442,723 —
 save_inference_model emits .pdmodel + .pdiparams). TPU-native: the recorded
-Program is replayed into a pure function of the feeds and exported as a
-StableHLO artifact (framework/exporting.py); ``load_inference_model`` works
-in a fresh process and the result runs under ``Executor.run``.
+Program is replayed into a pure function of the feeds and exported BOTH as
+the reference wire format (.pdmodel ProgramDesc protobuf + .pdiparams
+save_combine stream, static/pdmodel_export.py — consumable by Paddle
+Inference / paddle2onnx / this repo's own loader) and as a StableHLO
+artifact (<prefix>.pdexec, framework/exporting.py — the pre-compiled fast
+serving path). ``load_inference_model`` works in a fresh process and the
+result runs under ``Executor.run``.
 """
 from __future__ import annotations
 
@@ -48,6 +52,20 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
              for v in feed_list]
     export_artifact(path_prefix, run, weights, specs, feed_names=feed_names)
 
+    # reference wire format: .pdmodel ProgramDesc + .pdiparams stream
+    # (skippable only when a program uses a jax primitive with no fluid-op
+    # lowering — loudly, never silently)
+    if kwargs.get("pdmodel_format", True):
+        from .pdmodel_export import save_pdmodel
+        try:
+            save_pdmodel(path_prefix, run, weights, specs, feed_names)
+        except NotImplementedError as e:
+            import warnings
+            warnings.warn(
+                f"reference-format .pdmodel export skipped for "
+                f"{path_prefix}: {e} (the .pdexec StableHLO artifact was "
+                f"still written and serves via Predictor)")
+
     # keep the live program registered for same-process serving
     _LIVE_MODELS[path_prefix] = (program, feed_list, fetch_list)
     return path_prefix
@@ -82,11 +100,23 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
         feed_names = [v.name for v in feed_list]
         return program, feed_names, fetch_list
 
+    # the pre-compiled StableHLO twin is the fast path when present
+    exec_prefix = str(path_prefix)
+    if exec_prefix.endswith(".pdmodel"):
+        exec_prefix = exec_prefix[:-len(".pdmodel")]
+    if os.path.exists(exec_prefix + ".pdexec"):
+        from ..framework.exporting import load_artifact
+
+        prog = LoadedProgram(load_artifact(exec_prefix))
+        n_out = prog.artifact.meta.get("n_outputs", 1)
+        return prog, list(prog.feed_names), [None] * n_out
+
     # reference-format artifacts: <prefix>.pdmodel is a protobuf
     # ProgramDesc (written by the reference's save_inference_model,
-    # /root/reference/python/paddle/static/io.py:442) — parsed and executed
-    # natively (static/pdmodel.py), so reference model-zoo exports load
-    # without the reference installed.
+    # /root/reference/python/paddle/static/io.py:442 — or by this repo's
+    # own pdmodel_export writer) — parsed and executed natively
+    # (static/pdmodel.py), so reference model-zoo exports load without
+    # the reference installed.
     pd_path = path_prefix if str(path_prefix).endswith(".pdmodel") \
         else str(path_prefix) + ".pdmodel"
     if os.path.exists(pd_path):
@@ -103,12 +133,17 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
             prog = load_pdmodel(model_bytes, params_bytes)
             return prog, list(prog.feed_names), [None] * len(prog.fetch_names)
 
-    from ..framework.exporting import load_artifact
-
-    prog = LoadedProgram(load_artifact(path_prefix))
-    # fetch placeholders, one per exported output (shapes known at run)
-    n_out = prog.artifact.meta.get("n_outputs", 1)
-    return prog, list(prog.feed_names), [None] * n_out
+    if os.path.exists(pd_path):
+        with open(pd_path, "rb") as f:
+            head = f.read(2)
+        if head[:1] == b"\x80":
+            raise ValueError(
+                f"{pd_path} is a legacy pickle artifact from a previous "
+                f"paddle_tpu version (the StableHLO artifact now lives in "
+                f"<prefix>.pdexec and .pdmodel is the reference protobuf "
+                f"format) — re-export the model")
+    raise FileNotFoundError(
+        f"no inference model at {path_prefix} (.pdexec or .pdmodel)")
 
 
 def serialize_program(program=None):

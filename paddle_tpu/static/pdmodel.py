@@ -154,6 +154,11 @@ def _parse_attr(buf: bytes) -> Tuple[str, Any]:
     for k in order:
         if k in vals:
             return name, vals[k]
+    # no value fields on the wire: repeated attr types mean "empty list"
+    # (enum AttrType: INTS=3 FLOATS=4 STRINGS=5 BOOLEANS=7 BLOCKS=10
+    # LONGS=11 FLOAT64S=12 VARS=14)
+    if atype in (3, 4, 5, 7, 10, 11, 12, 14):
+        return name, []
     return name, None
 
 
@@ -466,7 +471,12 @@ def _conv2d(jnp, ins, attrs):
     x, w = ins["Input"][0], ins["Filter"][0]
     strides = tuple(attrs.get("strides", [1, 1]))
     pads = attrs.get("paddings", [0, 0])
-    if len(pads) == 2:
+    algo = attrs.get("padding_algorithm", "EXPLICIT")
+    if algo == "SAME":
+        padding = "SAME"
+    elif algo == "VALID":
+        padding = "VALID"
+    elif len(pads) == 2:
         padding = [(pads[0], pads[0]), (pads[1], pads[1])]
     else:
         padding = [(pads[0], pads[1]), (pads[2], pads[3])]
@@ -488,6 +498,21 @@ def _pool2d(jnp, ins, attrs):
             and list(attrs.get("ksize", [])) == [1, 1]):
         fn = jnp.max if ptype == "max" else jnp.mean
         return {"Out": [fn(x, axis=(2, 3), keepdims=True)]}
+    if attrs.get("adaptive", False):
+        # adaptive with output (oh, ow): evenly-divisible inputs reduce
+        # over exact windows; ragged cases have no static-window form
+        oh, ow = attrs.get("ksize", [1, 1])
+        h, w = x.shape[2], x.shape[3]
+        if h % oh or w % ow:
+            raise NotImplementedError(
+                f"adaptive pool2d with non-divisible input {h}x{w} -> "
+                f"{oh}x{ow} (pdmodel interop table)")
+        r = x.reshape(x.shape[0], x.shape[1], oh, h // oh, ow, w // ow)
+        fn = jnp.max if ptype == "max" else jnp.mean
+        return {"Out": [fn(r, axis=(3, 5))]}
+    if attrs.get("ceil_mode", False):
+        raise NotImplementedError(
+            "pool2d ceil_mode=True (pdmodel interop table)")
     ks = tuple(attrs.get("ksize", [2, 2]))
     st = tuple(attrs.get("strides", ks))
     pads = attrs.get("paddings", [0, 0])
@@ -571,6 +596,19 @@ def _slice(jnp, ins, attrs):
     idx = [slice(None)] * x.ndim
     for a, s, e in zip(axes, starts, ends):
         idx[a] = slice(s, e)
+    out = x[tuple(idx)]
+    dec = attrs.get("decrease_axis", []) or []
+    for a in sorted(dec, reverse=True):
+        out = jnp.squeeze(out, axis=a)
+    return {"Out": [out]}
+
+
+def _strided_slice(jnp, ins, attrs):
+    x = ins["Input"][0]
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(attrs.get("axes", []), attrs.get("starts", []),
+                           attrs.get("ends", []), attrs.get("strides", [])):
+        idx[a] = slice(s, e, st)
     return {"Out": [x[tuple(idx)]]}
 
 
@@ -711,6 +749,169 @@ def _interp(method):
     return run
 
 
+def _compare(fn):
+    def run(jnp, ins, attrs):
+        x, y = ins["X"][0], ins["Y"][0]
+        return {"Out": [fn(x, _bcast_to(y, x.ndim, attrs.get("axis", -1)))]}
+    return run
+
+
+def _logical(fn, binary=True):
+    def run(jnp, ins, attrs):
+        if binary:
+            return {"Out": [fn(ins["X"][0], ins["Y"][0])]}
+        return {"Out": [fn(ins["X"][0])]}
+    return run
+
+
+def _where(jnp, ins, attrs):
+    return {"Out": [jnp.where(ins["Condition"][0], ins["X"][0],
+                              ins["Y"][0])]}
+
+
+def _arg_min(jnp, ins, attrs):
+    axis = attrs.get("axis", -1)
+    out = jnp.argmin(ins["X"][0], axis=int(axis))
+    if attrs.get("keepdims", False):
+        out = jnp.expand_dims(out, int(axis))
+    return {"Out": [out.astype(PROTO_DTYPES[attrs.get("dtype", 3)])]}
+
+
+def _cumsum_op(jnp, ins, attrs):
+    x = ins["X"][0]
+    if attrs.get("flatten", False):
+        x = x.reshape(-1)
+    axis = attrs.get("axis", -1)
+    if attrs.get("reverse", False):
+        x = jnp.flip(x, axis=axis)
+    out = jnp.cumsum(x, axis=axis)
+    if attrs.get("exclusive", False):
+        out = jnp.roll(out, 1, axis=axis)
+        out = out.at[(slice(None),) * (axis % out.ndim) + (0,)].set(0)
+    if attrs.get("reverse", False):
+        out = jnp.flip(out, axis=axis)
+    return {"Out": [out]}
+
+
+def _pad_op(jnp, ins, attrs):
+    flat = attrs.get("paddings", [])
+    pairs = [(flat[i], flat[i + 1]) for i in range(0, len(flat), 2)]
+    return {"Out": [jnp.pad(ins["X"][0], pairs, mode="constant",
+                            constant_values=attrs.get("pad_value", 0.0))]}
+
+
+def _flip(jnp, ins, attrs):
+    return {"Out": [jnp.flip(ins["X"][0],
+                             axis=tuple(attrs.get("axis", [0])))]}
+
+
+def _top_k(jnp, ins, attrs):
+    import jax
+    x = ins["X"][0]
+    k = attrs.get("k", 1)
+    if "K" in ins and ins["K"]:
+        k = int(np.asarray(ins["K"][0]).reshape(()))
+    axis = attrs.get("axis", -1)
+    if axis not in (-1, x.ndim - 1):
+        x = jnp.swapaxes(x, axis, -1)
+    vals, idxs = jax.lax.top_k(x, k)
+    if not attrs.get("largest", True):
+        nvals, nidxs = jax.lax.top_k(-x, k)
+        vals, idxs = -nvals, nidxs
+    if axis not in (-1, x.ndim - 1):
+        vals = jnp.swapaxes(vals, axis, -1)
+        idxs = jnp.swapaxes(idxs, axis, -1)
+    return {"Out": [vals], "Indices": [idxs.astype(np.int64)]}
+
+
+def _shape_op(jnp, ins, attrs):
+    x = ins.get("Input", ins.get("X"))[0]
+    return {"Out": [jnp.asarray(x.shape, np.int32)]}
+
+
+def _range_op(jnp, ins, attrs):
+    s = np.asarray(ins["Start"][0]).reshape(())
+    e = np.asarray(ins["End"][0]).reshape(())
+    st = np.asarray(ins["Step"][0]).reshape(())
+    return {"Out": [jnp.arange(s, e, st)]}
+
+
+def _tile(jnp, ins, attrs):
+    return {"Out": [jnp.tile(ins["X"][0],
+                             tuple(attrs.get("repeat_times", [1])))]}
+
+
+def _one_hot(jnp, ins, attrs):
+    import jax
+    ids = ins["X"][0]
+    if ids.ndim and ids.shape[-1] == 1:
+        ids = ids.squeeze(-1)
+    return {"Out": [jax.nn.one_hot(ids, attrs.get("depth", 1),
+                                   dtype=np.float32)]}
+
+
+def _gather_nd(jnp, ins, attrs):
+    x, idx = ins["X"][0], ins["Index"][0]
+    return {"Out": [x[tuple(jnp.moveaxis(idx, -1, 0))]]}
+
+
+def _index_select(jnp, ins, attrs):
+    idx = ins["Index"][0]
+    if idx.ndim == 2 and idx.shape[-1] == 1:
+        idx = idx.squeeze(-1)
+    return {"Out": [jnp.take(ins["X"][0], idx,
+                             axis=attrs.get("dim", 0))]}
+
+
+def _p_norm(jnp, ins, attrs):
+    x = ins["X"][0]
+    p = attrs.get("porder", 2.0)
+    kd = attrs.get("keepdim", False)
+    if attrs.get("asvector", False):
+        # flatten-then-norm (reference p_norm asvector path)
+        out = jnp.sum(jnp.abs(x) ** p) ** (1.0 / p)
+        if kd:
+            out = out.reshape((1,) * x.ndim)
+        return {"Out": [out]}
+    axis = attrs.get("axis", -1)
+    out = jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=kd) ** (1.0 / p)
+    return {"Out": [out]}
+
+
+def _squared_l2_norm(jnp, ins, attrs):
+    return {"Out": [jnp.sum(jnp.square(ins["X"][0]))]}
+
+
+def _grid_float(name):
+    fns = {"rsqrt": lambda jnp, a: 1.0 / jnp.sqrt(a),
+           "round": lambda jnp, a: jnp.round(a),
+           "sin": lambda jnp, a: jnp.sin(a),
+           "cos": lambda jnp, a: jnp.cos(a),
+           "tan": lambda jnp, a: jnp.tan(a),
+           "asin": lambda jnp, a: jnp.arcsin(a),
+           "acos": lambda jnp, a: jnp.arccos(a),
+           "atan": lambda jnp, a: jnp.arctan(a),
+           "sinh": lambda jnp, a: jnp.sinh(a),
+           "cosh": lambda jnp, a: jnp.cosh(a),
+           "asinh": lambda jnp, a: jnp.arcsinh(a),
+           "acosh": lambda jnp, a: jnp.arccosh(a),
+           "atanh": lambda jnp, a: jnp.arctanh(a),
+           "log1p": lambda jnp, a: jnp.log1p(a),
+           "expm1": lambda jnp, a: jnp.expm1(a),
+           "log2": lambda jnp, a: jnp.log2(a),
+           "log10": lambda jnp, a: jnp.log10(a),
+           "sign": lambda jnp, a: jnp.sign(a),
+           "erf": lambda jnp, a: __import__("jax").lax.erf(a),
+           "isfinite_v2": lambda jnp, a: jnp.isfinite(a),
+           "isinf_v2": lambda jnp, a: jnp.isinf(a),
+           "isnan_v2": lambda jnp, a: jnp.isnan(a)}
+    fn = fns[name]
+
+    def run(jnp, ins, attrs):
+        return {"Out": [fn(jnp, ins["X"][0])]}
+    return run
+
+
 _CONVERTERS = {
     "matmul_v2": _matmul_v2, "matmul": _matmul_v1, "mul": _mul,
     "elementwise_add": _elementwise(lambda a, b: a + b),
@@ -754,6 +955,72 @@ def _ew_max(jnp, ins, attrs):
 _CONVERTERS["elementwise_max"] = _ew_max
 _CONVERTERS["reduce_mean"] = _reduce("mean")
 _CONVERTERS["reduce_sum"] = _reduce("sum")
+_CONVERTERS["reduce_max"] = _reduce("max")
+_CONVERTERS["reduce_min"] = _reduce("min")
+_CONVERTERS["reduce_prod"] = _reduce("prod")
+_CONVERTERS["reduce_all"] = _reduce("all")
+_CONVERTERS["reduce_any"] = _reduce("any")
+def _ew_jnp(fname):
+    def run(jnp, ins, attrs):
+        x, y = ins["X"][0], ins["Y"][0]
+        return {"Out": [getattr(jnp, fname)(
+            x, _bcast_to(y, x.ndim, attrs.get("axis", -1)))]}
+    return run
+
+
+_CONVERTERS["elementwise_min"] = _ew_jnp("minimum")
+_CONVERTERS["elementwise_pow"] = _elementwise(lambda a, b: a ** b)
+_CONVERTERS["elementwise_mod"] = _ew_jnp("fmod")
+_CONVERTERS["elementwise_floordiv"] = _elementwise(lambda a, b: a // b)
+_CONVERTERS["atan2"] = _ew_jnp("arctan2")
+for _nm, _f in (("equal", lambda a, b: a == b),
+                ("not_equal", lambda a, b: a != b),
+                ("less_than", lambda a, b: a < b),
+                ("less_equal", lambda a, b: a <= b),
+                ("greater_than", lambda a, b: a > b),
+                ("greater_equal", lambda a, b: a >= b)):
+    _CONVERTERS[_nm] = _compare(_f)
+for _nm, _f in (("logical_and", lambda a, b: a & b),
+                ("logical_or", lambda a, b: a | b),
+                ("logical_xor", lambda a, b: a ^ b),
+                ("bitwise_and", lambda a, b: a & b),
+                ("bitwise_or", lambda a, b: a | b),
+                ("bitwise_xor", lambda a, b: a ^ b)):
+    _CONVERTERS[_nm] = _logical(_f)
+_CONVERTERS["logical_not"] = _logical(lambda a: ~a, binary=False)
+_CONVERTERS["bitwise_not"] = _logical(lambda a: ~a, binary=False)
+_CONVERTERS["where"] = _where
+_CONVERTERS["arg_min"] = _arg_min
+_CONVERTERS["cumsum"] = _cumsum_op
+_CONVERTERS["pad"] = _pad_op
+_CONVERTERS["flip"] = _flip
+_CONVERTERS["strided_slice"] = _strided_slice
+_CONVERTERS["top_k"] = _top_k
+_CONVERTERS["top_k_v2"] = _top_k
+_CONVERTERS["shape"] = _shape_op
+_CONVERTERS["range"] = _range_op
+_CONVERTERS["tile"] = _tile
+_CONVERTERS["one_hot_v2"] = _one_hot
+_CONVERTERS["one_hot"] = _one_hot
+_CONVERTERS["gather_nd"] = _gather_nd
+_CONVERTERS["index_select"] = _index_select
+_CONVERTERS["p_norm"] = _p_norm
+_CONVERTERS["squared_l2_norm"] = _squared_l2_norm
+for _name in ("rsqrt", "round", "sin", "cos", "tan", "asin", "acos",
+              "atan", "sinh", "cosh", "asinh", "acosh", "atanh",
+              "log1p", "expm1", "log2", "log10", "sign", "erf",
+              "isfinite_v2", "isinf_v2", "isnan_v2"):
+    _CONVERTERS[_name] = _grid_float(_name)
+_CONVERTERS["isfinite"] = _grid_float("isfinite_v2")
+
+
+def _mish(jnp, ins, attrs):
+    import jax
+    x = ins["X"][0]
+    return {"Out": [x * jnp.tanh(jax.nn.softplus(x))]}
+
+
+_CONVERTERS["mish"] = _mish
 
 
 # --------------------------------------------------------------- executable

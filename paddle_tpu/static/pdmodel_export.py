@@ -1,0 +1,1051 @@
+"""Reference-format ``.pdmodel`` / ``.pdiparams`` WRITER.
+
+The reference exports inference models by serializing its ProgramDesc
+protobuf (/root/reference/python/paddle/static/io.py:442 ``serialize_program``
+over the wire schema /root/reference/paddle/fluid/framework/framework.proto)
+plus a ``save_combine`` packed parameter stream
+(/root/reference/paddle/fluid/framework/lod_tensor.cc:206).
+
+TPU-native design: this framework's programs are jax traces, so the writer
+does not shadow a fluid op graph during construction — it traces the export
+function to a **jaxpr** and translates jax primitives into fluid OpDescs
+(``dot_general``→``matmul_v2``, ``reduce_window_max``→``pool2d``, …), with
+constant folding for index/iota subgraphs. The resulting artifact is a
+genuine ProgramDesc: it round-trips through this repo's own wire decoder
+(static/pdmodel.py) and through ``protoc --decode`` against the reference
+schema, and is consumable by Paddle Inference deployments / paddle2onnx.
+
+The encoder below is the exact inverse of ``static/pdmodel.py``'s decoder
+(same field numbers, from the published framework.proto wire contract).
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .pdmodel import LOD_TENSOR, FEED_MINIBATCH, FETCH_LIST, PROTO_DTYPES
+
+__all__ = ["serialize_program_desc", "serialize_params",
+           "trace_to_pdmodel", "save_pdmodel"]
+
+
+# ------------------------------------------------------- protobuf encoding
+
+def _varint(n: int) -> bytes:
+    if n < 0:
+        n += 1 << 64  # two's-complement int32/int64 varints
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _key(field: int, wt: int) -> bytes:
+    return _varint((field << 3) | wt)
+
+
+def _vi(field: int, n: int) -> bytes:
+    return _key(field, 0) + _varint(int(n))
+
+
+def _ld(field: int, payload: bytes) -> bytes:
+    return _key(field, 2) + _varint(len(payload)) + payload
+
+
+def _ls(field: int, s: str) -> bytes:
+    return _ld(field, s.encode("utf-8"))
+
+
+def _f32(field: int, x: float) -> bytes:
+    return _key(field, 5) + struct.pack("<f", x)
+
+
+def _f64(field: int, x: float) -> bytes:
+    return _key(field, 1) + struct.pack("<d", x)
+
+
+# enum AttrType (framework.proto:25)
+_INT, _FLOAT, _STRING, _INTS, _FLOATS, _STRINGS, _BOOLEAN, _BOOLEANS = range(8)
+_LONG, _LONGS = 9, 11
+_FLOAT64 = 15
+
+_I32 = 1 << 31
+
+
+def _encode_attr(name: str, val: Any) -> bytes:
+    """OpDesc.Attr: infer the AttrType from the python value (the same
+    collapse the decoder applies in reverse)."""
+    out = _ls(1, name)
+    if isinstance(val, bool) or isinstance(val, np.bool_):
+        return out + _vi(2, _BOOLEAN) + _vi(10, int(val))
+    if isinstance(val, (int, np.integer)):
+        v = int(val)
+        if -_I32 <= v < _I32:
+            return out + _vi(2, _INT) + _vi(3, v)
+        return out + _vi(2, _LONG) + _vi(13, v)
+    if isinstance(val, (float, np.floating)):
+        v = float(val)
+        # FLOAT is f32 on the wire; values outside f32 range need FLOAT64
+        if np.isfinite(v) and (v == 0 or 1e-37 < abs(v) < 3e38):
+            return out + _vi(2, _FLOAT) + _f32(4, v)
+        return out + _vi(2, _FLOAT64) + _f64(19, v)
+    if isinstance(val, str):
+        return out + _vi(2, _STRING) + _ls(5, val)
+    if isinstance(val, (list, tuple)):
+        vals = list(val)
+        if vals and all(isinstance(v, (bool, np.bool_)) for v in vals):
+            return out + _vi(2, _BOOLEANS) + b"".join(
+                _vi(11, int(v)) for v in vals)
+        if all(isinstance(v, (int, np.integer)) for v in vals):
+            if all(-_I32 <= int(v) < _I32 for v in vals):
+                return out + _vi(2, _INTS) + b"".join(
+                    _vi(6, int(v)) for v in vals)
+            return out + _vi(2, _LONGS) + b"".join(
+                _vi(15, int(v)) for v in vals)
+        if all(isinstance(v, (int, float, np.integer, np.floating))
+               for v in vals):
+            return out + _vi(2, _FLOATS) + b"".join(
+                _f32(7, float(v)) for v in vals)
+        if all(isinstance(v, str) for v in vals):
+            return out + _vi(2, _STRINGS) + b"".join(_ls(8, v) for v in vals)
+    raise NotImplementedError(
+        f"cannot encode attr {name!r} of type {type(val).__name__}")
+
+
+def _encode_op_var(param: str, args: Sequence[str]) -> bytes:
+    return _ls(1, param) + b"".join(_ls(2, a) for a in args)
+
+
+def _encode_op(op: Dict[str, Any]) -> bytes:
+    out = b""
+    for k, args in op.get("inputs", {}).items():
+        out += _ld(1, _encode_op_var(k, args))
+    for k, args in op.get("outputs", {}).items():
+        out += _ld(2, _encode_op_var(k, args))
+    out += _ls(3, op["type"])
+    for k in sorted(op.get("attrs", {})):
+        out += _ld(4, _encode_attr(k, op["attrs"][k]))
+    return out
+
+
+def _encode_tensor_desc(dtype_id: int, dims: Sequence[int]) -> bytes:
+    return _vi(1, dtype_id) + b"".join(_vi(2, int(d)) for d in dims)
+
+
+def _encode_var(var: Dict[str, Any]) -> bytes:
+    vt = var.get("type", {})
+    type_id = vt.get("type", LOD_TENSOR)
+    tbuf = _vi(1, type_id)
+    if type_id == LOD_TENSOR:
+        lod = _ld(1, _encode_tensor_desc(vt.get("dtype", 5),
+                                         vt.get("dims", [])))
+        if vt.get("lod_level"):
+            lod += _vi(2, vt["lod_level"])
+        tbuf += _ld(3, lod)
+    out = _ls(1, var["name"]) + _ld(2, tbuf)
+    if var.get("persistable"):
+        out += _vi(3, 1)
+    if var.get("is_parameter"):
+        out += _vi(5, 1)
+    if var.get("stop_gradient"):
+        out += _vi(6, 1)
+    return out
+
+
+def serialize_program_desc(desc: Dict[str, Any]) -> bytes:
+    """Inverse of ``pdmodel.parse_program_desc`` (same dict schema)."""
+    out = b""
+    for block in desc["blocks"]:
+        buf = _vi(1, block.get("idx", 0)) + _vi(2, block.get("parent_idx", -1))
+        for var in block["vars"]:
+            buf += _ld(3, _encode_var(var))
+        for op in block["ops"]:
+            buf += _ld(4, _encode_op(op))
+        out += _ld(1, buf)
+    out += _ld(4, _vi(1, desc.get("version", 0)))
+    return out
+
+
+# ---------------------------------------------------- .pdiparams writer
+
+_NP_TO_PROTO = {}
+for _pid, _dt in PROTO_DTYPES.items():
+    if _dt == "bfloat16":
+        _NP_TO_PROTO["bfloat16"] = _pid
+    else:
+        _NP_TO_PROTO[str(np.dtype(_dt))] = _pid
+
+
+def _proto_dtype(dt) -> int:
+    key = str(dt)
+    if key not in _NP_TO_PROTO:
+        raise NotImplementedError(f"dtype {key} has no VarType::Type id")
+    return _NP_TO_PROTO[key]
+
+
+def serialize_params(params: Dict[str, np.ndarray]) -> bytes:
+    """save_combine stream: tensors in SORTED name order, each
+    ``u32 0 | u64 n_lod(0) | u32 0 | i32 desc_len | TensorDesc | raw``
+    (lod_tensor.cc:206 layout; inverse of parse_combined_params)."""
+    out = bytearray()
+    for name in sorted(params):
+        arr = params[name]
+        desc = _encode_tensor_desc(_proto_dtype(arr.dtype), arr.shape)
+        out += struct.pack("<I", 0)    # lod version
+        out += struct.pack("<Q", 0)    # no lod levels
+        out += struct.pack("<I", 0)    # tensor version
+        out += struct.pack("<i", len(desc))
+        out += desc
+        out += np.ascontiguousarray(arr).tobytes()
+    return bytes(out)
+
+
+# ------------------------------------------------ jaxpr -> ProgramDesc
+
+class _Unsupported(NotImplementedError):
+    pass
+
+
+_INT32_MAX = 2 ** 31 - 1
+
+
+class _Translator:
+    """Walks a jaxpr, emitting fluid ops + var descs + materialized consts."""
+
+    def __init__(self, dyn_samples: Sequence[int] = ()):
+        self.ops: List[Dict[str, Any]] = []
+        self.vars: Dict[str, Dict[str, Any]] = {}
+        self.params: Dict[str, np.ndarray] = {}
+        self._n = 0
+        self._const_names: Dict[int, str] = {}
+        # env maps jaxpr Var -> ("var", name) | ("const", np value)
+        self.env: Dict[Any, Tuple[str, Any]] = {}
+        # dynamic-dim sample extents (large primes standing in for -1
+        # feed dims during the trace); multiples of a sample are
+        # dynamic-derived dims (e.g. batch*seq after a flatten)
+        self.dyn = tuple(dyn_samples)
+
+    def _is_dyn(self, s: int) -> bool:
+        return s != 0 and any(s % p == 0 for p in self.dyn)
+
+    def _near_dyn(self, s: int) -> bool:
+        """Arithmetically derived from a dynamic dim but NOT an exact
+        multiple of its prime sample (e.g. seq-1, batch*seq+1): such an
+        extent cannot be written as -1, and baking the sample value would
+        be silently wrong at serving time. Flag anything within 64 of a
+        multiple of a sample prime (static layer dims never land there)."""
+        if s <= 256:
+            return False
+        return any(min(s % p, p - s % p) <= 64 and s % p != 0
+                   for p in self.dyn)
+
+    def dims_meta(self, shape) -> List[int]:
+        """Var-desc dims: dynamic extents written as -1 (the reference's
+        [-1, 640, 480] idiom, framework.proto TensorDesc comment)."""
+        return [-1 if self._is_dyn(int(d)) else int(d) for d in shape]
+
+    def shape_attr(self, shape, what="reshape") -> List[int]:
+        """Shape attr for reshape-like ops: ONE dynamic-derived entry may
+        be -1 (inferred); more cannot be expressed in a static attr."""
+        out, used = [], False
+        for s in shape:
+            s = int(s)
+            if self._is_dyn(s):
+                if used:
+                    raise _Unsupported(
+                        f"{what} with more than one dynamic dim")
+                out.append(-1)
+                used = True
+            elif self._near_dyn(s):
+                raise _Unsupported(
+                    f"{what} extent {s} is derived from a dynamic dim by "
+                    f"an offset and cannot be expressed statically")
+            else:
+                out.append(s)
+        return out
+
+    # ---- naming / declaration ----
+    def fresh(self, hint: str = "tmp") -> str:
+        self._n += 1
+        return f"{hint}_{self._n}"
+
+    def declare(self, name: str, shape, dtype, persistable=False,
+                is_parameter=False):
+        self.vars[name] = {
+            "name": name, "persistable": persistable,
+            "is_parameter": is_parameter, "stop_gradient": True,
+            "type": {"type": LOD_TENSOR, "dtype": _proto_dtype(dtype),
+                     "dims": self.dims_meta(shape), "lod_level": 0}}
+
+    def emit(self, op_type: str, inputs: Dict[str, List[str]],
+             outputs: Dict[str, List[str]], attrs: Dict[str, Any]):
+        self.ops.append({"type": op_type, "inputs": inputs,
+                         "outputs": outputs, "attrs": attrs})
+
+    def out_for(self, outvar, hint="tmp") -> str:
+        name = self.fresh(hint)
+        self.declare(name, outvar.aval.shape, outvar.aval.dtype)
+        self.env[outvar] = ("var", name)
+        return name
+
+    # ---- value resolution ----
+    def resolve(self, atom) -> Tuple[str, Any]:
+        import jax
+        from jax.extend import core as jex_core
+        if isinstance(atom, (jex_core.Literal,)) or hasattr(atom, "val"):
+            return ("const", np.asarray(atom.val))
+        return self.env[atom]
+
+    def const_array(self, val) -> np.ndarray:
+        return np.asarray(val)
+
+    def name_of(self, atom, hint="c") -> str:
+        """Graph-var name for an atom, materializing consts as needed:
+        scalars become fill_constant ops, arrays become persistable params
+        (the analog of the reference's parameter/Constant folding)."""
+        kind, v = self.resolve(atom)
+        if kind == "var":
+            return v
+        arr = self.const_array(v)
+        key = id(atom) if not np.isscalar(v) else None
+        if arr.ndim == 0:
+            name = self.fresh("fillc")
+            self.declare(name, (), arr.dtype)
+            self.emit("fill_constant", {}, {"Out": [name]},
+                      {"shape": [], "value": float(arr) if
+                       np.issubdtype(arr.dtype, np.floating) else int(arr),
+                       "dtype": _proto_dtype(arr.dtype)})
+            return name
+        if key is not None and key in self._const_names:
+            return self._const_names[key]
+        name = self.fresh("const")
+        self.declare(name, arr.shape, arr.dtype, persistable=True)
+        self.params[name] = arr
+        if key is not None:
+            self._const_names[key] = name
+        return name
+
+
+def _all_const(tr: _Translator, eqn) -> Optional[list]:
+    vals = []
+    for a in eqn.invars:
+        kind, v = tr.resolve(a)
+        if kind != "const":
+            return None
+        vals.append(v)
+    return vals
+
+
+_FOLD_BLOCKLIST = {"jit", "pjit", "custom_jvp_call", "custom_vjp_call",
+                   "custom_vjp_call_jaxpr", "remat", "remat2", "checkpoint",
+                   "closed_call", "core_call", "xla_call"}
+
+
+def _try_fold(tr: _Translator, eqn) -> bool:
+    """Constant-fold an eqn whose inputs are all concrete (iota, index
+    arithmetic, masks) — they become params instead of op chains."""
+    if eqn.primitive.name in _FOLD_BLOCKLIST:
+        return False
+    vals = _all_const(tr, eqn)
+    if vals is None and eqn.invars:
+        return False
+    try:
+        out = eqn.primitive.bind(*[np.asarray(v) for v in (vals or [])],
+                                 **eqn.params)
+    except Exception:
+        return False
+    outs = out if eqn.primitive.multiple_results else [out]
+    for ov, o in zip(eqn.outvars, outs):
+        tr.env[ov] = ("const", np.asarray(o))
+    return True
+
+
+# ---- primitive handlers ------------------------------------------------
+
+_EW_BINARY = {"add": "elementwise_add", "sub": "elementwise_sub",
+              "mul": "elementwise_mul", "div": "elementwise_div",
+              "max": "elementwise_max", "min": "elementwise_min",
+              "pow": "elementwise_pow", "rem": "elementwise_mod",
+              "atan2": "atan2"}
+
+_UNARY = {"exp": "exp", "log": "log", "tanh": "tanh", "logistic": "sigmoid",
+          "sqrt": "sqrt", "rsqrt": "rsqrt", "abs": "abs", "sign": "sign",
+          "floor": "floor", "ceil": "ceil", "round": "round", "erf": "erf",
+          "sin": "sin", "cos": "cos", "tan": "tan", "asin": "asin",
+          "acos": "acos", "atan": "atan", "sinh": "sinh", "cosh": "cosh",
+          "asinh": "asinh", "acosh": "acosh", "atanh": "atanh",
+          "log1p": "log1p", "expm1": "expm1", "square": "square",
+          "is_finite": "isfinite", "not": "logical_not"}
+
+_CMP = {"eq": "equal", "ne": "not_equal", "lt": "less_than",
+        "le": "less_equal", "gt": "greater_than", "ge": "greater_equal"}
+
+_REDUCE = {"reduce_sum": "reduce_sum", "reduce_max": "reduce_max",
+           "reduce_min": "reduce_min", "reduce_prod": "reduce_prod",
+           "reduce_and": "reduce_all", "reduce_or": "reduce_any"}
+
+
+def _is_scalar_const(tr, atom):
+    kind, v = tr.resolve(atom)
+    if kind != "const":
+        return None
+    arr = np.asarray(v)
+    return arr if arr.ndim == 0 else None
+
+
+def _handle_binary(tr, eqn, fluid_name):
+    x, y = eqn.invars
+    out = eqn.outvars[0]
+    fdt = out.aval.dtype
+    # scalar-const operand on a float op folds into `scale` (one fused
+    # axpy op instead of fill_constant + elementwise)
+    if fluid_name in ("elementwise_add", "elementwise_sub",
+                      "elementwise_mul") and np.issubdtype(fdt, np.floating):
+        sx = _is_scalar_const(tr, x)
+        sy = _is_scalar_const(tr, y)
+        if sy is not None and _is_scalar_const(tr, x) is None:
+            s, b = {"elementwise_add": (1.0, float(sy)),
+                    "elementwise_sub": (1.0, -float(sy)),
+                    "elementwise_mul": (float(sy), 0.0)}[fluid_name]
+            tr.emit("scale", {"X": [tr.name_of(x)]},
+                    {"Out": [tr.out_for(out)]},
+                    {"scale": s, "bias": b, "bias_after_scale": True})
+            return
+        if sx is not None and fluid_name != "elementwise_sub":
+            s, b = {"elementwise_add": (1.0, float(sx)),
+                    "elementwise_mul": (float(sx), 0.0)}[fluid_name]
+            tr.emit("scale", {"X": [tr.name_of(y)]},
+                    {"Out": [tr.out_for(out)]},
+                    {"scale": s, "bias": b, "bias_after_scale": True})
+            return
+        if sx is not None and fluid_name == "elementwise_sub":
+            tr.emit("scale", {"X": [tr.name_of(y)]},
+                    {"Out": [tr.out_for(out)]},
+                    {"scale": -1.0, "bias": float(sx),
+                     "bias_after_scale": True})
+            return
+    tr.emit(fluid_name, {"X": [tr.name_of(x)], "Y": [tr.name_of(y)]},
+            {"Out": [tr.out_for(out)]}, {"axis": -1})
+
+
+def _handle_logical(tr, eqn):
+    name = {"and": "and", "or": "or", "xor": "xor"}[eqn.primitive.name]
+    dt = eqn.invars[0].aval.dtype
+    fluid = ("logical_" if dt == np.bool_ else "bitwise_") + name
+    tr.emit(fluid, {"X": [tr.name_of(eqn.invars[0])],
+                    "Y": [tr.name_of(eqn.invars[1])]},
+            {"Out": [tr.out_for(eqn.outvars[0])]}, {})
+
+
+def _handle_dot_general(tr, eqn):
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    lhs, rhs = eqn.invars
+    out = eqn.outvars[0]
+    pref = eqn.params.get("preferred_element_type")
+    ln = tr.name_of(lhs)
+    rn = tr.name_of(rhs)
+    lsh = list(lhs.aval.shape)
+    rsh = list(rhs.aval.shape)
+    ldt = lhs.aval.dtype
+    if pref is not None and np.dtype(pref) != np.dtype(ldt):
+        # matmul accumulating wider than its inputs: cast up so the fluid
+        # graph computes in the accumulate dtype
+        ln2 = tr.fresh("cast")
+        tr.declare(ln2, lsh, pref)
+        tr.emit("cast", {"X": [ln]}, {"Out": [ln2]},
+                {"in_dtype": _proto_dtype(ldt),
+                 "out_dtype": _proto_dtype(pref)})
+        rn2 = tr.fresh("cast")
+        tr.declare(rn2, rsh, pref)
+        tr.emit("cast", {"X": [rn]}, {"Out": [rn2]},
+                {"in_dtype": _proto_dtype(rhs.aval.dtype),
+                 "out_dtype": _proto_dtype(pref)})
+        ln, rn = ln2, rn2
+    lnd, rnd = len(lsh), len(rsh)
+    lfree = [d for d in range(lnd) if d not in lc and d not in lb]
+    rfree = [d for d in range(rnd) if d not in rc and d not in rb]
+    # fast path: plain (batched) matmul already in layout
+    if (not lb and list(lc) == [lnd - 1] and list(rc) == [0] and rnd == 2):
+        tr.emit("matmul_v2", {"X": [ln], "Y": [rn]},
+                {"Out": [tr.out_for(out)]},
+                {"trans_x": False, "trans_y": False})
+        return
+    # general: permute to (batch..., free..., contract) x
+    # (batch..., contract, free...) and 3-D batch matmul
+    def _perm_reshape(name, shape, perm, newshape):
+        if list(perm) != list(range(len(shape))):
+            pname = tr.fresh("tr")
+            tr.declare(pname, [shape[p] for p in perm],
+                       pref or ldt)
+            tr.emit("transpose2", {"X": [name]},
+                    {"Out": [pname], "XShape": []}, {"axis": list(perm)})
+            name = pname
+            shape = [shape[p] for p in perm]
+        if list(newshape) != list(shape):
+            rname = tr.fresh("rs")
+            tr.declare(rname, newshape, pref or ldt)
+            tr.emit("reshape2", {"X": [name]},
+                    {"Out": [rname], "XShape": []},
+                    {"shape": tr.shape_attr(newshape)})
+            name = rname
+        return name
+
+    B = int(np.prod([lsh[d] for d in lb])) if lb else 1
+    M = int(np.prod([lsh[d] for d in lfree])) if lfree else 1
+    K = int(np.prod([lsh[d] for d in lc])) if lc else 1
+    N = int(np.prod([rsh[d] for d in rfree])) if rfree else 1
+    lperm = list(lb) + lfree + list(lc)
+    rperm = list(rb) + list(rc) + rfree
+    ln = _perm_reshape(ln, lsh, lperm, [B, M, K])
+    rn = _perm_reshape(rn, rsh, rperm, [B, K, N])
+    mm = tr.fresh("mm")
+    tr.declare(mm, [B, M, N], out.aval.dtype)
+    tr.emit("matmul_v2", {"X": [ln], "Y": [rn]}, {"Out": [mm]},
+            {"trans_x": False, "trans_y": False})
+    oname = tr.out_for(out)
+    tr.emit("reshape2", {"X": [mm]}, {"Out": [oname], "XShape": []},
+            {"shape": tr.shape_attr(out.aval.shape)})
+
+
+def _handle_conv(tr, eqn):
+    p = eqn.params
+    dn = p["dimension_numbers"]
+    lhs, rhs = eqn.invars
+    out = eqn.outvars[0]
+    if len(lhs.aval.shape) != 4:
+        raise _Unsupported("only 2-D convolutions export to pdmodel")
+    if tuple(p.get("lhs_dilation", (1, 1))) != (1, 1):
+        raise _Unsupported("conv lhs_dilation (transposed conv) export")
+    if p.get("batch_group_count", 1) != 1:
+        raise _Unsupported("conv batch_group_count export")
+    lspec, rspec, ospec = dn.lhs_spec, dn.rhs_spec, dn.out_spec
+    ln, rn = tr.name_of(lhs), tr.name_of(rhs)
+    # permute operands to NCHW / OIHW when traced in another layout
+    if tuple(lspec) != (0, 1, 2, 3):
+        perm = list(lspec)
+        nm = tr.fresh("tr")
+        tr.declare(nm, [lhs.aval.shape[i] for i in perm], lhs.aval.dtype)
+        tr.emit("transpose2", {"X": [ln]}, {"Out": [nm], "XShape": []},
+                {"axis": perm})
+        ln = nm
+    if tuple(rspec) != (0, 1, 2, 3):
+        perm = list(rspec)
+        nm = tr.fresh("tr")
+        tr.declare(nm, [rhs.aval.shape[i] for i in perm], rhs.aval.dtype)
+        tr.emit("transpose2", {"X": [rn]}, {"Out": [nm], "XShape": []},
+                {"axis": perm})
+        rn = nm
+    pads = list(p["padding"])
+    paddings = [int(pads[0][0]), int(pads[0][1]),
+                int(pads[1][0]), int(pads[1][1])]
+    groups = int(p.get("feature_group_count", 1))
+    attrs = {"strides": [int(s) for s in p["window_strides"]],
+             "paddings": paddings,
+             "dilations": [int(d) for d in p.get("rhs_dilation", (1, 1))],
+             "groups": groups, "data_format": "NCHW",
+             "padding_algorithm": "EXPLICIT"}
+    if tuple(ospec) == (0, 1, 2, 3):
+        oname = tr.out_for(out)
+        tr.emit("conv2d", {"Input": [ln], "Filter": [rn]},
+                {"Output": [oname]}, attrs)
+    else:
+        nchw_shape = [out.aval.shape[i] for i in ospec]
+        nm = tr.fresh("conv")
+        tr.declare(nm, nchw_shape, out.aval.dtype)
+        tr.emit("conv2d", {"Input": [ln], "Filter": [rn]},
+                {"Output": [nm]}, attrs)
+        inv = [0] * 4
+        for i, s in enumerate(ospec):
+            inv[s] = i
+        oname = tr.out_for(out)
+        tr.emit("transpose2", {"X": [nm]}, {"Out": [oname], "XShape": []},
+                {"axis": inv})
+
+
+def _handle_reduce_window(tr, eqn, kind):
+    p = eqn.params
+    x = eqn.invars[0]
+    out = eqn.outvars[0]
+    wd = tuple(p["window_dimensions"])
+    st = tuple(p["window_strides"])
+    pad = [tuple(q) for q in p["padding"]]
+    bd = tuple(p.get("base_dilation", (1,) * len(wd)))
+    wdl = tuple(p.get("window_dilation", (1,) * len(wd)))
+    if len(wd) != 4 or wd[:2] != (1, 1) or st[:2] != (1, 1) or \
+            pad[0] != (0, 0) or pad[1] != (0, 0) or \
+            any(d != 1 for d in bd) or any(d != 1 for d in wdl):
+        raise _Unsupported(
+            f"reduce_window {kind} with window {wd} is not an NCHW pool2d")
+    ph, pw = pad[2], pad[3]
+    if ph[0] != ph[1] or pw[0] != pw[1]:
+        raise _Unsupported("asymmetric pool padding export")
+    attrs = {"pooling_type": "max" if kind == "max" else "avg",
+             "ksize": [int(wd[2]), int(wd[3])],
+             "strides": [int(st[2]), int(st[3])],
+             "paddings": [int(ph[0]), int(pw[0])],
+             "global_pooling": False, "adaptive": False,
+             "ceil_mode": False, "exclusive": False,
+             "data_format": "NCHW", "padding_algorithm": "EXPLICIT"}
+    if kind == "max":
+        tr.emit("pool2d", {"X": [tr.name_of(x)]},
+                {"Out": [tr.out_for(out)]}, attrs)
+    else:  # sum pool = avg(exclusive=False) * window_size
+        nm = tr.fresh("pool")
+        tr.declare(nm, out.aval.shape, out.aval.dtype)
+        tr.emit("pool2d", {"X": [tr.name_of(x)]}, {"Out": [nm]}, attrs)
+        tr.emit("scale", {"X": [nm]}, {"Out": [tr.out_for(out)]},
+                {"scale": float(wd[2] * wd[3]), "bias": 0.0,
+                 "bias_after_scale": True})
+
+
+def _handle_gather(tr, eqn):
+    p = eqn.params
+    dn = p["dimension_numbers"]
+    operand, indices = eqn.invars
+    out = eqn.outvars[0]
+    osh = operand.aval.shape
+    ish = indices.aval.shape
+    ssz = tuple(p["slice_sizes"])
+    # the jnp.take(..., axis=0) embedding pattern: collapse dim 0,
+    # full slices elsewhere, index vector depth 1
+    if (tuple(dn.start_index_map) == (0,)
+            and tuple(dn.collapsed_slice_dims) == (0,)
+            and not dn.operand_batching_dims
+            and ssz[0] == 1 and tuple(ssz[1:]) == tuple(osh[1:])
+            and ish and ish[-1] == 1):
+        idx = tr.name_of(indices)
+        # drop the index-vector depth dim: lookup_table_v2 output dims are
+        # ids.dims + [D], so (B,1) ids would give (B,1,D) downstream in the
+        # reference runtime while the graph expects (B,D)
+        nm = tr.fresh("ids")
+        tr.declare(nm, ish[:-1], indices.aval.dtype)
+        tr.emit("reshape2", {"X": [idx]},
+                {"Out": [nm], "XShape": []},
+                {"shape": tr.shape_attr(ish[:-1])})
+        idx = nm
+        tr.emit("lookup_table_v2",
+                {"Ids": [idx], "W": [tr.name_of(operand)]},
+                {"Out": [tr.out_for(out)]}, {"padding_idx": -1})
+        return
+    raise _Unsupported(
+        f"gather pattern (dims {dn}, slice_sizes {ssz}) export")
+
+
+def _handle_broadcast_in_dim(tr, eqn):
+    x = eqn.invars[0]
+    out = eqn.outvars[0]
+    shape = [int(s) for s in eqn.params["shape"]]
+    bdims = list(eqn.params["broadcast_dimensions"])
+    xsh = list(x.aval.shape)
+    mid = [1] * len(shape)
+    for i, d in enumerate(bdims):
+        mid[d] = xsh[i]
+    name = tr.name_of(x)
+    if mid != xsh:
+        nm = tr.fresh("rs")
+        tr.declare(nm, mid, x.aval.dtype)
+        tr.emit("reshape2", {"X": [name]}, {"Out": [nm], "XShape": []},
+                {"shape": tr.shape_attr(mid)})
+        name = nm
+    if mid == shape:
+        tr.env[out] = ("var", name)
+        return
+    # expand_v2's -1 means "keep the input dim", so a 1 -> dynamic
+    # expansion cannot be written as a static shape attr
+    exp_shape = []
+    for i, s in enumerate(shape):
+        if tr._is_dyn(s):
+            if mid[i] == s:
+                exp_shape.append(-1)
+            else:
+                raise _Unsupported("broadcast to a dynamic extent")
+        else:
+            exp_shape.append(int(s))
+    tr.emit("expand_v2", {"X": [name]}, {"Out": [tr.out_for(out)]},
+            {"shape": exp_shape})
+
+
+def _handle_select_n(tr, eqn):
+    pred = eqn.invars[0]
+    cases = eqn.invars[1:]
+    out = eqn.outvars[0]
+    if len(cases) != 2:
+        raise _Unsupported("select_n with more than 2 cases")
+    if pred.aval.dtype != np.bool_:
+        raise _Unsupported("integer select_n export")
+    # select_n picks cases[pred]: False->cases[0], True->cases[1];
+    # fluid where(Condition, X, Y) = Condition ? X : Y
+    tr.emit("where", {"Condition": [tr.name_of(pred)],
+                      "X": [tr.name_of(cases[1])],
+                      "Y": [tr.name_of(cases[0])]},
+            {"Out": [tr.out_for(out)]}, {})
+
+
+def _handle_pad(tr, eqn):
+    x, val = eqn.invars
+    out = eqn.outvars[0]
+    cfg = eqn.params["padding_config"]
+    if any(i != 0 for _, _, i in cfg):
+        raise _Unsupported("interior (dilating) pad export")
+    if any(lo < 0 or hi < 0 for lo, hi, _ in cfg):
+        raise _Unsupported("negative pad export")
+    kind, v = tr.resolve(val)
+    if kind != "const":
+        raise _Unsupported("non-constant pad value export")
+    flat = []
+    for lo, hi, _ in cfg:
+        flat += [int(lo), int(hi)]
+    tr.emit("pad", {"X": [tr.name_of(x)]}, {"Out": [tr.out_for(out)]},
+            {"paddings": flat, "pad_value": float(np.asarray(v))})
+
+
+def _handle_slice(tr, eqn):
+    x = eqn.invars[0]
+    out = eqn.outvars[0]
+    starts = [int(s) for s in eqn.params["start_indices"]]
+    limits = [int(s) for s in eqn.params["limit_indices"]]
+    strides = eqn.params.get("strides")
+    strides = [1] * len(starts) if strides is None else \
+        [int(s) for s in strides]
+    xsh = x.aval.shape
+    axes = [i for i in range(len(starts))
+            if not (starts[i] == 0 and limits[i] == xsh[i]
+                    and strides[i] == 1)]
+    if not axes:
+        tr.env[out] = ("var", tr.name_of(x))
+        return
+    if any(tr._is_dyn(starts[i]) or tr._near_dyn(starts[i])
+           for i in axes):
+        raise _Unsupported("slice start at a dynamic offset")
+    if any(tr._near_dyn(limits[i]) for i in axes):
+        # e.g. x[:, :-1] on a dynamic axis: the limit (seq-1) has no
+        # static encoding — baking the sample would silently over-slice
+        raise _Unsupported("slice end at a dynamic-relative offset")
+    # a dynamic end means "to the end of that axis": the reference's
+    # INT32_MAX clamp idiom
+    ends = [(_INT32_MAX if tr._is_dyn(limits[i]) else limits[i])
+            for i in axes]
+    if all(strides[i] == 1 for i in axes):
+        tr.emit("slice", {"Input": [tr.name_of(x)]},
+                {"Out": [tr.out_for(out)]},
+                {"axes": axes, "starts": [starts[i] for i in axes],
+                 "ends": ends, "decrease_axis": []})
+    else:
+        tr.emit("strided_slice", {"Input": [tr.name_of(x)]},
+                {"Out": [tr.out_for(out)]},
+                {"axes": axes, "starts": [starts[i] for i in axes],
+                 "ends": ends,
+                 "strides": [strides[i] for i in axes]})
+
+
+def _handle_clamp(tr, eqn):
+    lo, x, hi = eqn.invars
+    out = eqn.outvars[0]
+    slo = _is_scalar_const(tr, lo)
+    shi = _is_scalar_const(tr, hi)
+    if slo is not None and shi is not None:
+        tr.emit("clip", {"X": [tr.name_of(x)]}, {"Out": [tr.out_for(out)]},
+                {"min": float(slo), "max": float(shi)})
+        return
+    nm = tr.fresh("clip")
+    tr.declare(nm, out.aval.shape, out.aval.dtype)
+    tr.emit("elementwise_max", {"X": [tr.name_of(x)], "Y": [tr.name_of(lo)]},
+            {"Out": [nm]}, {"axis": -1})
+    tr.emit("elementwise_min", {"X": [nm], "Y": [tr.name_of(hi)]},
+            {"Out": [tr.out_for(out)]}, {"axis": -1})
+
+
+def _handle_eqn(tr: _Translator, eqn):
+    name = eqn.primitive.name
+    out = eqn.outvars[0] if eqn.outvars else None
+
+    if name in ("jit", "pjit", "closed_call", "core_call", "custom_jvp_call",
+                "custom_vjp_call", "custom_vjp_call_jaxpr", "remat",
+                "remat2", "checkpoint", "custom_lin"):
+        inner = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr") \
+            or eqn.params.get("fun_jaxpr")
+        if inner is None:
+            raise _Unsupported(f"call primitive {name} without a jaxpr")
+        consts = []
+        if hasattr(inner, "jaxpr"):  # ClosedJaxpr
+            consts = inner.consts
+            inner = inner.jaxpr
+        sub_invars = list(inner.constvars) + list(inner.invars)
+        sub_invals = [("const", np.asarray(c)) for c in consts]
+        # custom_vjp/jvp pass extra callable args first; align from the END
+        outer_atoms = list(eqn.invars)[-len(inner.invars):] \
+            if len(inner.invars) else []
+        for cv, cval in zip(inner.constvars, sub_invals):
+            tr.env[cv] = cval
+        for iv, atom in zip(inner.invars, outer_atoms):
+            tr.env[iv] = tr.resolve(atom)
+        for sub_eqn in inner.eqns:
+            if not _try_fold(tr, sub_eqn):
+                _handle_eqn(tr, sub_eqn)
+        for ov, sub_out in zip(eqn.outvars, inner.outvars):
+            tr.env[ov] = tr.resolve(sub_out)
+        return
+
+    if name in ("stop_gradient", "copy", "device_put", "copy_p",
+                "sharding_constraint", "reduce_precision",
+                "optimization_barrier"):
+        # identity at inference; reduce_precision only appears around
+        # bf16 emulation which the serving dtype rewrite owns
+        for ov, iv in zip(eqn.outvars, eqn.invars):
+            tr.env[ov] = tr.resolve(iv)
+        return
+
+    if name in _EW_BINARY:
+        return _handle_binary(tr, eqn, _EW_BINARY[name])
+    if name in _CMP:
+        tr.emit(_CMP[name], {"X": [tr.name_of(eqn.invars[0])],
+                             "Y": [tr.name_of(eqn.invars[1])]},
+                {"Out": [tr.out_for(out)]}, {})
+        return
+    if name in ("and", "or", "xor"):
+        return _handle_logical(tr, eqn)
+    if name in _UNARY:
+        tr.emit(_UNARY[name], {"X": [tr.name_of(eqn.invars[0])]},
+                {"Out": [tr.out_for(out)]}, {})
+        return
+    if name == "neg":
+        tr.emit("scale", {"X": [tr.name_of(eqn.invars[0])]},
+                {"Out": [tr.out_for(out)]},
+                {"scale": -1.0, "bias": 0.0, "bias_after_scale": True})
+        return
+    if name == "integer_pow":
+        tr.emit("pow", {"X": [tr.name_of(eqn.invars[0])]},
+                {"Out": [tr.out_for(out)]},
+                {"factor": float(eqn.params["y"])})
+        return
+    if name == "convert_element_type":
+        src = eqn.invars[0]
+        if np.dtype(eqn.params["new_dtype"]) == np.dtype(src.aval.dtype):
+            tr.env[out] = tr.resolve(src)
+            return
+        tr.emit("cast", {"X": [tr.name_of(src)]},
+                {"Out": [tr.out_for(out)]},
+                {"in_dtype": _proto_dtype(src.aval.dtype),
+                 "out_dtype": _proto_dtype(eqn.params["new_dtype"])})
+        return
+    if name == "dot_general":
+        return _handle_dot_general(tr, eqn)
+    if name == "conv_general_dilated":
+        return _handle_conv(tr, eqn)
+    if name == "reduce_window_max":
+        return _handle_reduce_window(tr, eqn, "max")
+    if name == "reduce_window_sum":
+        return _handle_reduce_window(tr, eqn, "sum")
+    if name in _REDUCE:
+        axes = [int(a) for a in eqn.params["axes"]]
+        x = eqn.invars[0]
+        tr.emit(_REDUCE[name], {"X": [tr.name_of(x)]},
+                {"Out": [tr.out_for(out)]},
+                {"dim": axes, "keep_dim": False,
+                 "reduce_all": len(axes) == len(x.aval.shape)})
+        return
+    if name in ("argmax", "argmin"):
+        axes = eqn.params["axes"]
+        if len(axes) != 1:
+            raise _Unsupported(f"{name} over multiple axes")
+        tr.emit("arg_max" if name == "argmax" else "arg_min",
+                {"X": [tr.name_of(eqn.invars[0])]},
+                {"Out": [tr.out_for(out)]},
+                {"axis": int(axes[0]), "keepdims": False,
+                 "dtype": _proto_dtype(eqn.params["index_dtype"])})
+        return
+    if name == "cumsum":
+        if eqn.params.get("reverse"):
+            raise _Unsupported("reverse cumsum export")
+        tr.emit("cumsum", {"X": [tr.name_of(eqn.invars[0])]},
+                {"Out": [tr.out_for(out)]},
+                {"axis": int(eqn.params["axis"]), "flatten": False,
+                 "exclusive": False, "reverse": False})
+        return
+    if name == "cumlogsumexp" or name == "cumprod" or name == "cummax":
+        raise _Unsupported(f"{name} export")
+    if name == "reshape":
+        if eqn.params.get("dimensions") is not None:
+            raise _Unsupported("reshape with dimensions (fused transpose)")
+        tr.emit("reshape2", {"X": [tr.name_of(eqn.invars[0])]},
+                {"Out": [tr.out_for(out)], "XShape": []},
+                {"shape": tr.shape_attr(eqn.params["new_sizes"])})
+        return
+    if name == "transpose":
+        tr.emit("transpose2", {"X": [tr.name_of(eqn.invars[0])]},
+                {"Out": [tr.out_for(out)], "XShape": []},
+                {"axis": [int(p) for p in eqn.params["permutation"]]})
+        return
+    if name == "squeeze":
+        tr.emit("squeeze2", {"X": [tr.name_of(eqn.invars[0])]},
+                {"Out": [tr.out_for(out)], "XShape": []},
+                {"axes": [int(d) for d in eqn.params["dimensions"]]})
+        return
+    if name == "expand_dims":
+        tr.emit("unsqueeze2", {"X": [tr.name_of(eqn.invars[0])]},
+                {"Out": [tr.out_for(out)], "XShape": []},
+                {"axes": [int(d) for d in eqn.params["dimensions"]]})
+        return
+    if name == "broadcast_in_dim":
+        return _handle_broadcast_in_dim(tr, eqn)
+    if name == "concatenate":
+        tr.emit("concat", {"X": [tr.name_of(v) for v in eqn.invars]},
+                {"Out": [tr.out_for(out)]},
+                {"axis": int(eqn.params["dimension"])})
+        return
+    if name == "select_n":
+        return _handle_select_n(tr, eqn)
+    if name == "gather":
+        return _handle_gather(tr, eqn)
+    if name == "slice":
+        return _handle_slice(tr, eqn)
+    if name == "rev":
+        tr.emit("flip", {"X": [tr.name_of(eqn.invars[0])]},
+                {"Out": [tr.out_for(out)]},
+                {"axis": [int(d) for d in eqn.params["dimensions"]]})
+        return
+    if name == "pad":
+        return _handle_pad(tr, eqn)
+    if name == "clamp":
+        return _handle_clamp(tr, eqn)
+    if name == "dynamic_slice":
+        starts = [tr.resolve(a) for a in eqn.invars[1:]]
+        if all(k == "const" for k, _ in starts):
+            x = eqn.invars[0]
+            sizes = eqn.params["slice_sizes"]
+            xsh = x.aval.shape
+            sv = [int(np.clip(int(v), 0, xsh[i] - sizes[i]))
+                  for i, (_, v) in enumerate(starts)]
+            axes = [i for i in range(len(sv))
+                    if not (sv[i] == 0 and sizes[i] == xsh[i])]
+            if not axes:
+                tr.env[out] = ("var", tr.name_of(x))
+                return
+            tr.emit("slice", {"Input": [tr.name_of(x)]},
+                    {"Out": [tr.out_for(out)]},
+                    {"axes": axes, "starts": [sv[i] for i in axes],
+                     "ends": [sv[i] + int(sizes[i]) for i in axes],
+                     "decrease_axis": []})
+            return
+        raise _Unsupported("dynamic_slice with traced start indices")
+    if name == "iota":
+        # no inputs: always folds; reaching here means folding failed
+        raise _Unsupported("iota that failed constant folding")
+    raise _Unsupported(f"jax primitive {name!r} has no fluid-op lowering")
+
+
+# --------------------------------------------------------------- driver
+
+def trace_to_pdmodel(run, weight_arrays: Dict[str, np.ndarray],
+                     input_specs: Sequence, feed_names: Sequence[str],
+                     ) -> Tuple[bytes, bytes]:
+    """Trace ``run(weight_list, *feeds)`` (weight_list ordered by sorted
+    name) and translate the jaxpr into (.pdmodel bytes, .pdiparams bytes)."""
+    import jax
+
+    # Dynamic (None/-1/symbolic) feed dims: trace with large-prime sample
+    # extents and write them back as -1 in var descs / shape attrs (the
+    # reference's [-1, ...] dynamic-batch idiom). Primes are chosen far
+    # above real layer extents so "multiple of the sample" reliably marks
+    # dynamic-derived dims (e.g. batch*seq after a flatten).
+    _PRIMES = (9973, 9967, 9949, 9941, 9931, 9929, 9923, 9907)
+    sym_to_prime: Dict[str, int] = {}
+    concrete_specs = []
+    for spec in input_specs:
+        dims = []
+        for d in spec.shape:
+            if isinstance(d, (int, np.integer)):
+                dims.append(int(d))
+                continue
+            key = str(d)
+            if key not in sym_to_prime:
+                if len(sym_to_prime) >= len(_PRIMES):
+                    raise _Unsupported(
+                        "more than 8 distinct dynamic dims")
+                sym_to_prime[key] = _PRIMES[len(sym_to_prime)]
+            dims.append(sym_to_prime[key])
+        concrete_specs.append(jax.ShapeDtypeStruct(tuple(dims), spec.dtype))
+    input_specs = concrete_specs
+
+    wnames = sorted(weight_arrays)
+    w_specs = [jax.ShapeDtypeStruct(np.shape(weight_arrays[n]),
+                                    np.asarray(weight_arrays[n]).dtype)
+               for n in wnames]
+    try:
+        closed = jax.make_jaxpr(run)(w_specs, *input_specs)
+    except _Unsupported:
+        raise
+    except Exception as e:  # trace rejected the sample extents
+        raise _Unsupported(f"abstract trace failed: {e}") from e
+    jaxpr = closed.jaxpr
+
+    tr = _Translator(dyn_samples=tuple(sym_to_prime.values()))
+    for cv, cval in zip(jaxpr.constvars, closed.consts):
+        tr.env[cv] = ("const", np.asarray(cval))
+
+    n_w = len(wnames)
+    if len(jaxpr.invars) != n_w + len(input_specs):
+        raise _Unsupported(
+            f"trace arity mismatch: {len(jaxpr.invars)} invars vs "
+            f"{n_w} weights + {len(input_specs)} feeds")
+    for name, iv in zip(wnames, jaxpr.invars[:n_w]):
+        tr.declare(name, iv.aval.shape, iv.aval.dtype,
+                   persistable=True, is_parameter=True)
+        tr.params[name] = np.asarray(weight_arrays[name])
+        tr.env[iv] = ("var", name)
+
+    # feed plumbing (reference load_inference_model derives the feed
+    # contract from these ops)
+    tr.vars["feed"] = {"name": "feed", "persistable": True,
+                       "type": {"type": FEED_MINIBATCH, "dtype": 5,
+                                "dims": []}}
+    tr.vars["fetch"] = {"name": "fetch", "persistable": True,
+                        "type": {"type": FETCH_LIST, "dtype": 5,
+                                 "dims": []}}
+    for col, (name, iv) in enumerate(zip(feed_names, jaxpr.invars[n_w:])):
+        tr.declare(name, iv.aval.shape, iv.aval.dtype)
+        tr.env[iv] = ("var", name)
+        tr.emit("feed", {"X": ["feed"]}, {"Out": [name]}, {"col": col})
+
+    for eqn in jaxpr.eqns:
+        if not _try_fold(tr, eqn):
+            _handle_eqn(tr, eqn)
+
+    feed_set = set(feed_names)
+    for col, ov in enumerate(jaxpr.outvars):
+        name = tr.name_of(ov, hint="out")
+        if name in feed_set or name in tr.params or \
+                tr.vars.get(name, {}).get("persistable"):
+            # fetch through an assign so outputs are compute-produced vars
+            nm = tr.fresh("out")
+            v = tr.vars[name]
+            tr.declare(nm, v["type"]["dims"], PROTO_DTYPES[
+                v["type"]["dtype"]])
+            tr.emit("assign", {"X": [name]}, {"Out": [nm]}, {})
+            name = nm
+        tr.emit("fetch", {"X": [name]}, {"Out": ["fetch"]}, {"col": col})
+
+    desc = {"version": 0,
+            "blocks": [{"idx": 0, "parent_idx": -1,
+                        "vars": list(tr.vars.values()),
+                        "ops": tr.ops}]}
+    return serialize_program_desc(desc), serialize_params(tr.params)
+
+
+def save_pdmodel(path_prefix: str, run, weight_arrays, input_specs,
+                 feed_names) -> None:
+    """Write <prefix>.pdmodel + <prefix>.pdiparams in the reference wire
+    format (static/io.py:442 contract)."""
+    model, params = trace_to_pdmodel(run, weight_arrays, input_specs,
+                                     feed_names)
+    with open(str(path_prefix) + ".pdmodel", "wb") as f:
+        f.write(model)
+    with open(str(path_prefix) + ".pdiparams", "wb") as f:
+        f.write(params)

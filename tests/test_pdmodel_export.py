@@ -1,0 +1,250 @@
+"""Reference-format .pdmodel/.pdiparams WRITER (round-4 verdict item 1).
+
+The exporter (static/pdmodel_export.py) traces the serving function to a
+jaxpr and translates jax primitives into fluid OpDescs; these tests close
+the loop: export -> this repo's own wire decoder -> numerics, plus a
+``protoc --decode`` structural check against the reference schema
+(/root/reference/paddle/fluid/framework/framework.proto) when available.
+"""
+import os
+import shutil
+import subprocess
+import tempfile
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.static.pdmodel import (load_pdmodel, parse_combined_params,
+                                       parse_program_desc)
+from paddle_tpu.static.pdmodel_export import (serialize_params,
+                                              serialize_program_desc,
+                                              trace_to_pdmodel)
+
+_REF_PROTO = "/root/reference/paddle/fluid/framework/framework.proto"
+
+
+class TestWireEncoder:
+    def test_desc_round_trip(self):
+        desc = {"version": 0, "blocks": [{
+            "idx": 0, "parent_idx": -1,
+            "vars": [{"name": "x", "persistable": False,
+                      "is_parameter": False, "stop_gradient": True,
+                      "type": {"type": 7, "dtype": 5, "dims": [-1, 4],
+                               "lod_level": 0}}],
+            "ops": [{"type": "scale", "inputs": {"X": ["x"]},
+                     "outputs": {"Out": ["y"]},
+                     "attrs": {"scale": 2.0, "bias": 0.5,
+                               "bias_after_scale": True,
+                               "axes": [0, 2], "name": "s",
+                               "big": 2 ** 40, "empty": []}}]}]}
+        got = parse_program_desc(serialize_program_desc(desc))
+        blk = got["blocks"][0]
+        assert blk["vars"][0]["name"] == "x"
+        assert blk["vars"][0]["type"]["dims"] == [-1, 4]
+        op = blk["ops"][0]
+        assert op["type"] == "scale"
+        assert op["inputs"]["X"] == ["x"]
+        assert op["attrs"]["scale"] == pytest.approx(2.0)
+        assert op["attrs"]["bias_after_scale"] is True
+        assert op["attrs"]["axes"] == [0, 2]
+        assert op["attrs"]["big"] == 2 ** 40
+        assert op["attrs"]["empty"] == []
+
+    def test_params_round_trip(self):
+        params = {"w": np.arange(12, dtype=np.float32).reshape(3, 4),
+                  "ids": np.array([1, -2, 3], dtype=np.int64),
+                  "m": np.array([True, False]),
+                  "h": np.ones((2, 2), dtype=jnp.bfloat16)}
+        data = serialize_params(params)
+        got = parse_combined_params(data, sorted(params))
+        for k in params:
+            np.testing.assert_array_equal(np.asarray(got[k], np.float32),
+                                          np.asarray(params[k], np.float32))
+
+
+class TestJaxprTranslation:
+    def _round_trip(self, run, weights, specs, feeds, feed_vals,
+                    rtol=1e-5, atol=1e-5):
+        model, params = trace_to_pdmodel(run, weights, specs, feeds)
+        prog = load_pdmodel(model, params)
+        assert prog.missing_ops() == []
+        outs = prog.run(dict(zip(feeds, feed_vals)))
+        wl = [weights[n] for n in sorted(weights)]
+        want = run(wl, *[jnp.asarray(v) for v in feed_vals])
+        want = want if isinstance(want, (tuple, list)) else [want]
+        for o, r in zip(outs, want):
+            np.testing.assert_allclose(np.asarray(o), np.asarray(r),
+                                       rtol=rtol, atol=atol)
+        return model
+
+    def test_mlp_embedding_layernorm(self):
+        def run(wlist, x, ids):
+            b, emb, w = wlist
+            h = jax.nn.relu(x @ w + b)
+            sm = jax.nn.softmax(h, axis=-1)
+            e = jnp.take(emb, ids, axis=0)
+            mu = jnp.mean(h, -1, keepdims=True)
+            ln = (h - mu) / jnp.sqrt(jnp.var(h, -1, keepdims=True) + 1e-5)
+            return sm, e, ln * 2.0
+
+        rng = np.random.RandomState(0)
+        weights = {"b": rng.randn(16).astype(np.float32),
+                   "emb": rng.randn(50, 16).astype(np.float32),
+                   "w": rng.randn(8, 16).astype(np.float32)}
+        specs = [jax.ShapeDtypeStruct((4, 8), np.float32),
+                 jax.ShapeDtypeStruct((4, 3), np.int32)]
+        self._round_trip(run, weights, specs, ["x", "ids"],
+                         [rng.randn(4, 8).astype(np.float32),
+                          rng.randint(0, 50, (4, 3)).astype(np.int32)])
+
+    def test_cnn_pool(self):
+        def run(wlist, x):
+            cw, = wlist
+            h = jax.lax.conv_general_dilated(
+                x, cw, (1, 1), [(1, 1), (1, 1)],
+                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+            h = jax.nn.relu(h)
+            h = jax.lax.reduce_window(
+                h, -jnp.inf, jax.lax.max, (1, 1, 2, 2), (1, 1, 2, 2),
+                [(0, 0), (0, 0), (0, 0), (0, 0)])
+            return jnp.mean(h, axis=(2, 3))
+
+        rng = np.random.RandomState(1)
+        weights = {"cw": (rng.randn(4, 3, 3, 3) * 0.1).astype(np.float32)}
+        specs = [jax.ShapeDtypeStruct((2, 3, 8, 8), np.float32)]
+        self._round_trip(run, weights, specs, ["im"],
+                         [rng.randn(2, 3, 8, 8).astype(np.float32)])
+
+    def test_attention_block(self):
+        # batched dot_general + transpose + masking: the transformer shapes
+        def run(wlist, x):
+            wq, wk, wv = wlist
+            q = x @ wq
+            k = x @ wk
+            v = x @ wv
+            s = jnp.einsum("bsd,btd->bst", q, k) / np.sqrt(16.0)
+            mask = jnp.tril(jnp.ones((x.shape[1], x.shape[1])))
+            s = jnp.where(mask > 0, s, -1e9)
+            return jnp.einsum("bst,btd->bsd", jax.nn.softmax(s, -1), v)
+
+        rng = np.random.RandomState(2)
+        weights = {f"w{c}": (rng.randn(16, 16) * 0.2).astype(np.float32)
+                   for c in "qkv"}
+        weights = {"wq": weights["wq"], "wk": weights["wk"],
+                   "wv": weights["wv"]}
+        specs = [jax.ShapeDtypeStruct((2, 6, 16), np.float32)]
+        self._round_trip(run, weights, specs, ["x"],
+                         [rng.randn(2, 6, 16).astype(np.float32)],
+                         rtol=1e-4, atol=1e-4)
+
+    def test_protoc_structural_decode(self, tmp_path):
+        if shutil.which("protoc") is None or not os.path.exists(_REF_PROTO):
+            pytest.skip("protoc or reference framework.proto unavailable")
+
+        def run(wlist, x):
+            w, = wlist
+            return jax.nn.softmax(x @ w, axis=-1)
+
+        weights = {"w": np.eye(4, dtype=np.float32)}
+        specs = [jax.ShapeDtypeStruct((2, 4), np.float32)]
+        model, _ = trace_to_pdmodel(run, weights, specs, ["x"])
+        p = tmp_path / "m.pdmodel"
+        p.write_bytes(model)
+        with open(p, "rb") as f:
+            res = subprocess.run(
+                ["protoc", "--decode=paddle.framework.proto.ProgramDesc",
+                 "-I", os.path.dirname(_REF_PROTO), _REF_PROTO],
+                stdin=f, capture_output=True)
+        assert res.returncode == 0, res.stderr.decode()
+        txt = res.stdout.decode()
+        # softmax decomposes into exp / reduce_sum / elementwise_div
+        assert "matmul_v2" in txt and "reduce_sum" in txt and "exp" in txt
+        assert 'parameter: "X"' in txt
+
+
+class TestFrameworkIntegration:
+    def _lenet(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.conv = nn.Conv2D(1, 4, 3, padding=1)
+                self.pool = nn.MaxPool2D(2, 2)
+                self.flat = nn.Flatten()
+                self.fc = nn.Linear(4 * 14 * 14, 10)
+
+            def forward(self, x):
+                h = self.pool(nn.functional.relu(self.conv(x)))
+                return nn.functional.softmax(self.fc(self.flat(h)))
+        return Net()
+
+    def test_jit_save_emits_reference_format(self, tmp_path):
+        paddle.seed(0)
+        net = self._lenet()
+        prefix = os.path.join(str(tmp_path), "m")
+        paddle.jit.save(net, prefix, input_spec=[
+            paddle.static.InputSpec([None, 1, 28, 28], "float32")])
+        assert os.path.exists(prefix + ".pdmodel")
+        assert os.path.exists(prefix + ".pdiparams")
+        assert os.path.exists(prefix + ".pdexec")
+        # the .pdmodel is a genuine protobuf, not a pickle
+        with open(prefix + ".pdmodel", "rb") as f:
+            data = f.read()
+        assert data[0] == 0x0A
+        prog = load_pdmodel(data, open(prefix + ".pdiparams", "rb").read())
+        assert prog.missing_ops() == []
+        # dynamic batch: serves at extents never seen at export time
+        for bs in (2, 5):
+            x = np.random.RandomState(bs).randn(
+                bs, 1, 28, 28).astype(np.float32)
+            out = np.asarray(prog.run({prog.feed_names[0]: x})[0])
+            want = net(paddle.to_tensor(x)).numpy()
+            np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+    def test_predictor_serves_proto_pair(self, tmp_path):
+        from paddle_tpu import inference
+
+        paddle.seed(1)
+        net = self._lenet()
+        prefix = os.path.join(str(tmp_path), "m")
+        paddle.jit.save(net, prefix, input_spec=[
+            paddle.static.InputSpec([2, 1, 28, 28], "float32")])
+        # explicit params path routes to the proto pair
+        cfg = inference.Config(prefix + ".pdmodel", prefix + ".pdiparams")
+        pred = inference.create_predictor(cfg)
+        x = np.random.RandomState(0).randn(2, 1, 28, 28).astype(np.float32)
+        out = pred.run([x])[0]
+        want = net(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+    def test_static_save_inference_model_round_trip(self, tmp_path):
+        paddle.enable_static()
+        try:
+            import paddle_tpu.static as static
+
+            x = static.data("x", [4, 8], "float32")
+            y = paddle.matmul(x, paddle.to_tensor(
+                np.random.RandomState(0).randn(8, 4).astype(np.float32)))
+            z = nn.functional.relu(y)
+            prefix = os.path.join(str(tmp_path), "sm")
+            static.save_inference_model(prefix, [x], [z],
+                                        executor=static.Executor())
+            assert os.path.exists(prefix + ".pdmodel")
+            with open(prefix + ".pdmodel", "rb") as f:
+                data = f.read()
+            assert data[0] == 0x0A
+            prog = load_pdmodel(
+                data, open(prefix + ".pdiparams", "rb").read())
+            xs = np.random.RandomState(1).randn(4, 8).astype(np.float32)
+            out = np.asarray(prog.run({"x": xs})[0])
+            assert out.shape == (4, 4)
+            np.testing.assert_allclose(
+                out, np.maximum(
+                    xs @ np.random.RandomState(0).randn(8, 4).astype(
+                        np.float32), 0), rtol=1e-5, atol=1e-5)
+        finally:
+            paddle.disable_static()
