@@ -222,14 +222,8 @@ def save(layer, path, input_spec=None, **configs):
     # reference wire format (.pdmodel ProgramDesc + .pdiparams) so models
     # trained here deploy to Paddle Inference / paddle2onnx consumers
     if configs.get("pdmodel_format", True):
-        from ..static.pdmodel_export import save_pdmodel
-        try:
-            save_pdmodel(path, run, weights, specs, names)
-        except NotImplementedError as e:
-            import warnings
-            warnings.warn(
-                f"reference-format .pdmodel export skipped for {path}: "
-                f"{e} (the .pdexec artifact was still written)")
+        from ..static.pdmodel_export import save_pdmodel_or_warn
+        save_pdmodel_or_warn(path, run, weights, specs, names)
 
 
 class TranslatedLayer(Layer):

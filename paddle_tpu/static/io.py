@@ -56,15 +56,8 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
     # (skippable only when a program uses a jax primitive with no fluid-op
     # lowering — loudly, never silently)
     if kwargs.get("pdmodel_format", True):
-        from .pdmodel_export import save_pdmodel
-        try:
-            save_pdmodel(path_prefix, run, weights, specs, feed_names)
-        except NotImplementedError as e:
-            import warnings
-            warnings.warn(
-                f"reference-format .pdmodel export skipped for "
-                f"{path_prefix}: {e} (the .pdexec StableHLO artifact was "
-                f"still written and serves via Predictor)")
+        from .pdmodel_export import save_pdmodel_or_warn
+        save_pdmodel_or_warn(path_prefix, run, weights, specs, feed_names)
 
     # keep the live program registered for same-process serving
     _LIVE_MODELS[path_prefix] = (program, feed_list, fetch_list)

@@ -749,13 +749,6 @@ def _interp(method):
     return run
 
 
-def _compare(fn):
-    def run(jnp, ins, attrs):
-        x, y = ins["X"][0], ins["Y"][0]
-        return {"Out": [fn(x, _bcast_to(y, x.ndim, attrs.get("axis", -1)))]}
-    return run
-
-
 def _logical(fn, binary=True):
     def run(jnp, ins, attrs):
         if binary:
@@ -940,7 +933,28 @@ _CONVERTERS = {
     "nearest_interp": _interp("nearest"),
     "bilinear_interp_v2": _interp("bilinear"),
     "bilinear_interp": _interp("bilinear"),
+    "bicubic_interp_v2": _interp("cubic"),
 }
+
+
+def _linear_interp(jnp, ins, attrs):
+    """linear_interp_v2: rank-3 [N, C, W] 1-D resize (out_w/scale only)."""
+    import jax
+    x = ins["X"][0]
+    ow = attrs.get("out_w", 0)
+    scale = attrs.get("scale", [])
+    if (not ow or ow <= 0) and scale:
+        s = scale if isinstance(scale, (list, tuple)) else [scale]
+        ow = int(x.shape[2] * s[-1])
+    out = jax.image.resize(x, (x.shape[0], x.shape[1], ow), method="linear")
+    return {"Out": [out]}
+
+
+_CONVERTERS["linear_interp_v2"] = _linear_interp
+
+# op types whose output extent is data-dependent: the program containing
+# them replays eagerly instead of under one jit (see PdProgram.run)
+_EAGER_ONLY_OPS = set()
 for _name in ("relu", "sigmoid", "tanh", "sqrt", "abs", "exp", "log",
               "floor", "ceil", "square", "reciprocal", "silu", "relu6"):
     _CONVERTERS[_name] = _unary(_name)
@@ -960,26 +974,20 @@ _CONVERTERS["reduce_min"] = _reduce("min")
 _CONVERTERS["reduce_prod"] = _reduce("prod")
 _CONVERTERS["reduce_all"] = _reduce("all")
 _CONVERTERS["reduce_any"] = _reduce("any")
-def _ew_jnp(fname):
-    def run(jnp, ins, attrs):
-        x, y = ins["X"][0], ins["Y"][0]
-        return {"Out": [getattr(jnp, fname)(
-            x, _bcast_to(y, x.ndim, attrs.get("axis", -1)))]}
-    return run
-
-
-_CONVERTERS["elementwise_min"] = _ew_jnp("minimum")
+# numpy ufuncs dispatch to jnp on jax arrays, so _elementwise covers the
+# jnp-function binaries too
+_CONVERTERS["elementwise_min"] = _elementwise(np.minimum)
 _CONVERTERS["elementwise_pow"] = _elementwise(lambda a, b: a ** b)
-_CONVERTERS["elementwise_mod"] = _ew_jnp("fmod")
+_CONVERTERS["elementwise_mod"] = _elementwise(np.fmod)
 _CONVERTERS["elementwise_floordiv"] = _elementwise(lambda a, b: a // b)
-_CONVERTERS["atan2"] = _ew_jnp("arctan2")
+_CONVERTERS["atan2"] = _elementwise(np.arctan2)
 for _nm, _f in (("equal", lambda a, b: a == b),
                 ("not_equal", lambda a, b: a != b),
                 ("less_than", lambda a, b: a < b),
                 ("less_equal", lambda a, b: a <= b),
                 ("greater_than", lambda a, b: a > b),
                 ("greater_equal", lambda a, b: a >= b)):
-    _CONVERTERS[_nm] = _compare(_f)
+    _CONVERTERS[_nm] = _elementwise(_f)
 for _nm, _f in (("logical_and", lambda a, b: a & b),
                 ("logical_or", lambda a, b: a | b),
                 ("logical_xor", lambda a, b: a ^ b),
@@ -1110,6 +1118,9 @@ class PdProgram:
         import jax.numpy as jnp
 
         arrays = [jnp.asarray(np.asarray(feed[n])) for n in self.feed_names]
+        if any(op["type"] in _EAGER_ONLY_OPS for op in self.ops):
+            # data-dependent output extents (NMS) cannot live under jit
+            return self._execute(*arrays)
         if self._jitted is None:
             self._jitted = jax.jit(self._execute)
         return self._jitted(*arrays)
@@ -1123,3 +1134,8 @@ def load_pdmodel(model_bytes: bytes,
         prog.params = parse_combined_params(params_bytes,
                                             prog.persistable_names())
     return prog
+
+
+# extended model-zoo converter families (fused transformer, detection,
+# normalization, activation tail) register themselves into _CONVERTERS
+from . import pdmodel_zoo_ops  # noqa: E402,F401  (import-time registration)

@@ -314,6 +314,14 @@ class _Translator:
         if kind == "var":
             return v
         arr = self.const_array(v)
+        if any(self._is_dyn(int(d)) or self._near_dyn(int(d))
+               for d in arr.shape):
+            # a folded constant sized by the dynamic-dim sample (e.g. a
+            # seq x seq causal mask built from x.shape) would bake the
+            # prime extent into the params — unexportable statically
+            raise _Unsupported(
+                f"constant of shape {tuple(arr.shape)} is sized by a "
+                f"dynamic dim; export with concrete input shapes")
         key = id(atom) if not np.isscalar(v) else None
         if arr.ndim == 0:
             name = self.fresh("fillc")
@@ -1049,3 +1057,21 @@ def save_pdmodel(path_prefix: str, run, weight_arrays, input_specs,
         f.write(model)
     with open(str(path_prefix) + ".pdiparams", "wb") as f:
         f.write(params)
+
+
+def save_pdmodel_or_warn(path_prefix, run, weight_arrays, input_specs,
+                         feed_names) -> bool:
+    """save_pdmodel, degrading a program with no fluid-op lowering to a
+    loud warning (the .pdexec StableHLO artifact still serves). The shared
+    skip policy for static.save_inference_model and jit.save."""
+    try:
+        save_pdmodel(path_prefix, run, weight_arrays, input_specs,
+                     feed_names)
+        return True
+    except NotImplementedError as e:
+        import warnings
+        warnings.warn(
+            f"reference-format .pdmodel export skipped for {path_prefix}: "
+            f"{e} (the .pdexec StableHLO artifact was still written and "
+            f"serves via Predictor)")
+        return False

@@ -1,0 +1,823 @@
+"""Extended pdmodel op-converter library: the op families real model-zoo
+exports contain beyond the core table in ``pdmodel.py``.
+
+Covers (reference sources cited per group):
+- fused transformer ops (fused_attention / fused_feedforward /
+  fused_multi_transformer / fused_bias_dropout_residual_layer_norm,
+  /root/reference/python/paddle/incubate/nn/functional/fused_transformer.py
+  and paddle/fluid/operators/fused/fused_attention_op.cc:56 for the
+  [3, num_head, dim_head, dim_embed] QKVW layout)
+- ERNIE-inference fusions (fused_embedding_eltwise_layernorm,
+  skip_layernorm, fc — paddle/fluid/operators/fused/)
+- detection (yolo_box / multiclass_nms3 / prior_box / box_coder /
+  roi_align — /root/reference/python/paddle/vision/ops.py; NMS runs
+  eagerly since its output extent is data-dependent)
+- normalization (group_norm / instance_norm / l2 norm / clip_by_norm)
+- the long tail of zoo activations, shape ops, and conv2d_transpose.
+
+Converters registered here follow the same ``(jnp, ins, attrs) -> outs``
+contract as pdmodel.py's core table.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .pdmodel import (_CONVERTERS, _EAGER_ONLY_OPS, PROTO_DTYPES,
+                      _bcast_to)
+
+
+def _t(x):
+    """Unwrap a framework Tensor return to its jax array."""
+    from ..core.tensor import Tensor
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _layer_norm_last(jnp, x, scale, bias, eps):
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    out = (x - mean) / jnp.sqrt(var + eps)
+    if scale is not None:
+        out = out * scale
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def _infer_dropout(jnp, x, rate, mode):
+    # is_test semantics: upscale_in_train passes through; the legacy mode
+    # downscales by (1 - p) (reference dropout op inference path)
+    if mode == "downgrade_in_infer":
+        return x * (1.0 - rate)
+    return x
+
+
+def _act_by_name(jnp, name):
+    import jax
+    # reference fused-op "gelu" is the exact erf formulation (phi gelu
+    # default approximate=False)
+    return {"relu": jax.nn.relu,
+            "gelu": lambda a: jax.nn.gelu(a, approximate=False),
+            "none": lambda a: a, "": lambda a: a}[name]
+
+
+# ------------------------------------------------- fused transformer ops
+
+def _fused_attention(jnp, ins, attrs):
+    """fused_attention (inference): optional pre-LN -> qkv proj -> MHA with
+    additive mask -> out proj -> residual (+ post-LN)."""
+    import jax
+
+    x = ins["X"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    ln_eps = attrs.get("ln_epsilon", 1e-5)
+    pre_ln = attrs.get("pre_layer_norm", False)
+    if attrs.get("transpose_qkv_wb", False):
+        qkv_w = ins["QKVW"][0]             # [D, 3D]
+        num_heads = attrs["num_heads"]
+        d = qkv_w.shape[0]
+        dim_head = d // num_heads
+    else:
+        qkv_w = ins["QKVW"][0]             # [3, H, dh, D]
+        _, num_heads, dim_head, d = qkv_w.shape
+
+    h = x
+    if pre_ln:
+        h = _layer_norm_last(jnp, x,
+                             ins.get("LnScale", [None])[0] if ins.get("LnScale") else None,
+                             ins.get("LnBias", [None])[0] if ins.get("LnBias") else None,
+                             eps)
+    if attrs.get("transpose_qkv_wb", False):
+        qkv = jnp.einsum("bsd,de->bse", h, qkv_w)
+        if ins.get("QKVBias"):
+            qkv = qkv + ins["QKVBias"][0]
+        qkv = qkv.reshape(x.shape[0], x.shape[1], 3, num_heads, dim_head)
+    else:
+        qkv = jnp.einsum("bsd,thed->bsthe", h, qkv_w)
+        if ins.get("QKVBias"):
+            qkv = qkv + ins["QKVBias"][0]  # [3, H, dh]
+    q, k, v = (qkv[:, :, i] for i in range(3))   # [B, S, H, dh]
+    q = jnp.swapaxes(q, 1, 2)  # [B, H, S, dh]
+    k = jnp.swapaxes(k, 1, 2)
+    v = jnp.swapaxes(v, 1, 2)
+    s = jnp.einsum("bhsd,bhtd->bhst", q, k) / np.sqrt(dim_head)
+    if ins.get("SrcMask"):
+        s = s + ins["SrcMask"][0]
+    p = jax.nn.softmax(s, axis=-1)
+    p = _infer_dropout(jnp, p, attrs.get("attn_dropout_rate", 0.0),
+                       attrs.get("attn_dropout_implementation",
+                                 "upscale_in_train"))
+    o = jnp.einsum("bhst,bhtd->bhsd", p, v)
+    o = jnp.swapaxes(o, 1, 2).reshape(x.shape[0], x.shape[1], d)
+    o = jnp.matmul(o, ins["OutLinearW"][0])
+    if ins.get("OutLinearBias"):
+        o = o + ins["OutLinearBias"][0]
+    o = _infer_dropout(jnp, o, attrs.get("dropout_rate", 0.0),
+                       attrs.get("dropout_implementation",
+                                 "upscale_in_train"))
+    if attrs.get("add_residual", True):
+        o = x + o
+    if not pre_ln:
+        o = _layer_norm_last(jnp, o,
+                             ins.get("Ln2Scale", [None])[0] if ins.get("Ln2Scale") else None,
+                             ins.get("Ln2Bias", [None])[0] if ins.get("Ln2Bias") else None,
+                             ln_eps)
+    return {"Y": [o]}
+
+
+def _fused_feedforward(jnp, ins, attrs):
+    x = ins["X"][0]
+    pre_ln = attrs.get("pre_layer_norm", False)
+    act = _act_by_name(jnp, attrs.get("act_method", "relu"))
+    h = x
+    if pre_ln:
+        h = _layer_norm_last(
+            jnp, x,
+            ins["Ln1Scale"][0] if ins.get("Ln1Scale") else None,
+            ins["Ln1Bias"][0] if ins.get("Ln1Bias") else None,
+            attrs.get("ln1_epsilon", 1e-5))
+    h = jnp.matmul(h, ins["Linear1Weight"][0])
+    if ins.get("Linear1Bias"):
+        h = h + ins["Linear1Bias"][0]
+    h = act(h)
+    h = _infer_dropout(jnp, h, attrs.get("dropout1_rate", 0.0),
+                       attrs.get("dropout1_implementation",
+                                 "upscale_in_train"))
+    h = jnp.matmul(h, ins["Linear2Weight"][0])
+    if ins.get("Linear2Bias"):
+        h = h + ins["Linear2Bias"][0]
+    h = _infer_dropout(jnp, h, attrs.get("dropout2_rate", 0.0),
+                       attrs.get("dropout2_implementation",
+                                 "upscale_in_train"))
+    out = x + h
+    if not pre_ln:
+        out = _layer_norm_last(
+            jnp, out,
+            ins["Ln2Scale"][0] if ins.get("Ln2Scale") else None,
+            ins["Ln2Bias"][0] if ins.get("Ln2Bias") else None,
+            attrs.get("ln2_epsilon", 1e-5))
+    return {"Out": [out]}
+
+
+def _fused_bias_dropout_residual_ln(jnp, ins, attrs):
+    x = ins["X"][0]
+    res = ins["Residual"][0]
+    if ins.get("Bias"):
+        x = x + ins["Bias"][0]
+    x = _infer_dropout(jnp, x, attrs.get("dropout_rate", 0.0),
+                       attrs.get("dropout_implementation",
+                                 "upscale_in_train"))
+    out = _layer_norm_last(
+        jnp, x + res,
+        ins["LnScale"][0] if ins.get("LnScale") else None,
+        ins["LnBias"][0] if ins.get("LnBias") else None,
+        attrs.get("ln_epsilon", 1e-5))
+    return {"Y": [out]}
+
+
+def _fused_multi_transformer(jnp, ins, attrs):
+    """Whole decoder stack (inference, no cache): per layer
+    ln -> qkv -> MHA -> out proj -> residual -> ln -> ffn -> residual.
+    List inputs carry one tensor per layer."""
+    import jax
+
+    x = ins["X"][0]
+    n_layers = len(ins["QKVW"])
+    pre_ln = attrs.get("pre_layer_norm", True)
+    eps = attrs.get("epsilon", 1e-5)
+    act = _act_by_name(jnp, attrs.get("act_method", "gelu"))
+    if ins.get("CacheKV") or ins.get("TimeStep"):
+        raise NotImplementedError(
+            "fused_multi_transformer with KV cache (generation loop) "
+            "(pdmodel interop table)")
+    if attrs.get("rotary_emb_dims", 0):
+        raise NotImplementedError(
+            "fused_multi_transformer rotary embeddings "
+            "(pdmodel interop table)")
+    mask = ins["SrcMask"][0] if ins.get("SrcMask") else None
+    trans_qkvw = attrs.get("trans_qkvw", True)
+
+    def opt(key, i):
+        seq = ins.get(key)
+        return seq[i] if seq and i < len(seq) and seq[i] is not None else None
+
+    h = x
+    for i in range(n_layers):
+        qkv_w = ins["QKVW"][i]
+        if trans_qkvw:
+            _, num_heads, dim_head, d = qkv_w.shape   # [3, H, dh, D]
+        else:
+            d, _, num_heads, dim_head = qkv_w.shape   # [D, 3, H, dh]
+        residual = h
+        z = _layer_norm_last(jnp, h, opt("LnScale", i), opt("LnBias", i),
+                             eps) if pre_ln else h
+        if trans_qkvw:
+            qkv = jnp.einsum("bsd,thed->bsthe", z, qkv_w)
+        else:
+            qkv = jnp.einsum("bsd,dthe->bsthe", z, qkv_w)
+        b = opt("QKVBias", i)
+        if b is not None:
+            qkv = qkv + b
+        q, k, v = (qkv[:, :, j] for j in range(3))
+        q, k, v = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+        s = jnp.einsum("bhsd,bhtd->bhst", q, k) / np.sqrt(dim_head)
+        if mask is not None:
+            s = s + mask
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhst,bhtd->bhsd", p, v)
+        o = jnp.swapaxes(o, 1, 2).reshape(z.shape[0], z.shape[1], d)
+        o = jnp.matmul(o, ins["OutLinearW"][i])
+        ob = opt("OutLinearBias", i)
+        if ob is not None:
+            o = o + ob
+        h = residual + o
+        if not pre_ln:
+            h = _layer_norm_last(jnp, h, opt("LnScale", i),
+                                 opt("LnBias", i), eps)
+        # ffn
+        residual = h
+        z = _layer_norm_last(jnp, h, opt("FFNLnScale", i),
+                             opt("FFNLnBias", i), eps) if pre_ln else h
+        z = jnp.matmul(z, ins["FFN1Weight"][i])
+        fb = opt("FFN1Bias", i)
+        if fb is not None:
+            z = z + fb
+        z = act(z)
+        z = jnp.matmul(z, ins["FFN2Weight"][i])
+        fb2 = opt("FFN2Bias", i)
+        if fb2 is not None:
+            z = z + fb2
+        h = residual + z
+        if not pre_ln:
+            h = _layer_norm_last(jnp, h, opt("FFNLnScale", i),
+                                 opt("FFNLnBias", i), eps)
+    return {"Out": [h]}
+
+
+def _fused_embedding_eltwise_layernorm(jnp, ins, attrs):
+    """sum of embedding lookups + layer_norm (ERNIE/BERT inference fusion,
+    paddle/fluid/operators/fused/fused_embedding_eltwise_layernorm_op.cc)."""
+    ids_list = ins["Ids"]
+    embs = ins["Embs"]
+    acc = None
+    for ids, emb in zip(ids_list, embs):
+        if ids.ndim and ids.shape[-1] == 1:
+            ids = ids.squeeze(-1)
+        e = jnp.take(emb, ids, axis=0)
+        acc = e if acc is None else acc + e
+    out = _layer_norm_last(jnp, acc, ins["Scale"][0], ins["Bias"][0],
+                           attrs.get("epsilon", 1e-5))
+    return {"Out": [out]}
+
+
+def _skip_layernorm(jnp, ins, attrs):
+    out = _layer_norm_last(jnp, ins["X"][0] + ins["Y"][0],
+                           ins["Scale"][0], ins["Bias"][0],
+                           attrs.get("epsilon", 1e-5))
+    return {"Out": [out]}
+
+
+def _fc(jnp, ins, attrs):
+    x = ins["Input"][0]
+    w = ins["W"][0]
+    ncol = attrs.get("in_num_col_dims", 1)
+    xm = x.reshape(tuple(x.shape[:ncol]) + (-1,))
+    out = jnp.matmul(xm, w)
+    if ins.get("Bias"):
+        out = out + ins["Bias"][0]
+    act = attrs.get("activation_type", "")
+    if act:
+        import jax
+        out = {"relu": jax.nn.relu, "tanh": jnp.tanh,
+               "sigmoid": jax.nn.sigmoid}[act](out)
+    return {"Out": [out]}
+
+
+# ----------------------------------------------------------- detection
+
+def _yolo_box(jnp, ins, attrs):
+    from ..vision.ops import yolo_box as _impl
+    boxes, scores = _impl(
+        ins["X"][0], ins["ImgSize"][0],
+        anchors=list(attrs["anchors"]), class_num=attrs["class_num"],
+        conf_thresh=attrs.get("conf_thresh", 0.01),
+        downsample_ratio=attrs.get("downsample_ratio", 32),
+        clip_bbox=attrs.get("clip_bbox", True),
+        scale_x_y=attrs.get("scale_x_y", 1.0),
+        iou_aware=attrs.get("iou_aware", False),
+        iou_aware_factor=attrs.get("iou_aware_factor", 0.5))
+    return {"Boxes": [_t(boxes)], "Scores": [_t(scores)]}
+
+
+def _prior_box(jnp, ins, attrs):
+    from ..vision.ops import prior_box as _impl
+    boxes, variances = _impl(
+        ins["Input"][0], ins["Image"][0],
+        min_sizes=list(attrs["min_sizes"]),
+        max_sizes=list(attrs.get("max_sizes", []) or []) or None,
+        aspect_ratios=list(attrs.get("aspect_ratios", [1.0])),
+        variance=list(attrs.get("variances", [0.1, 0.1, 0.2, 0.2])),
+        flip=attrs.get("flip", False), clip=attrs.get("clip", False),
+        steps=[attrs.get("step_w", 0.0), attrs.get("step_h", 0.0)],
+        offset=attrs.get("offset", 0.5),
+        min_max_aspect_ratios_order=attrs.get(
+            "min_max_aspect_ratios_order", False))
+    return {"Boxes": [_t(boxes)], "Variances": [_t(variances)]}
+
+
+def _box_coder(jnp, ins, attrs):
+    from ..vision.ops import box_coder as _impl
+    pb_var = ins["PriorBoxVar"][0] if ins.get("PriorBoxVar") else \
+        list(attrs.get("variance", [])) or None
+    out = _impl(ins["PriorBox"][0], pb_var, ins["TargetBox"][0],
+                code_type=attrs.get("code_type", "encode_center_size"),
+                box_normalized=attrs.get("box_normalized", True),
+                axis=attrs.get("axis", 0))
+    return {"OutputBox": [_t(out)]}
+
+
+def _roi_align(jnp, ins, attrs):
+    from ..vision.ops import roi_align as _impl
+    rois = ins["ROIs"][0]
+    n = ins["RoisNum"][0] if ins.get("RoisNum") else \
+        jnp.asarray([rois.shape[0]], np.int32)
+    out = _impl(ins["X"][0], rois, n,
+                output_size=(attrs["pooled_height"], attrs["pooled_width"]),
+                spatial_scale=attrs.get("spatial_scale", 1.0),
+                sampling_ratio=attrs.get("sampling_ratio", -1),
+                aligned=attrs.get("aligned", True))
+    return {"Out": [_t(out)]}
+
+
+def _multiclass_nms3(jnp, ins, attrs):
+    """Per-class NMS with data-dependent output extent — runs EAGERLY
+    (numpy), never inside the whole-program jit (reference:
+    paddle/fluid/operators/detection/multiclass_nms_op.cc)."""
+    bboxes = np.asarray(ins["BBoxes"][0])    # [N, M, 4]
+    scores = np.asarray(ins["Scores"][0])    # [N, C, M]
+    score_th = attrs.get("score_threshold", 0.0)
+    nms_th = attrs.get("nms_threshold", 0.3)
+    nms_top_k = int(attrs.get("nms_top_k", -1))
+    keep_top_k = int(attrs.get("keep_top_k", -1))
+    background = int(attrs.get("background_label", 0))
+    normalized = attrs.get("normalized", True)
+    offset = 0.0 if normalized else 1.0
+
+    def _iou(b, rest):
+        xx1 = np.maximum(b[0], rest[:, 0])
+        yy1 = np.maximum(b[1], rest[:, 1])
+        xx2 = np.minimum(b[2], rest[:, 2])
+        yy2 = np.minimum(b[3], rest[:, 3])
+        w = np.maximum(0.0, xx2 - xx1 + offset)
+        h = np.maximum(0.0, yy2 - yy1 + offset)
+        inter = w * h
+        a1 = (b[2] - b[0] + offset) * (b[3] - b[1] + offset)
+        a2 = (rest[:, 2] - rest[:, 0] + offset) * \
+             (rest[:, 3] - rest[:, 1] + offset)
+        return inter / np.maximum(a1 + a2 - inter, 1e-10)
+
+    all_dets, all_idx, rois_num = [], [], []
+    for n in range(bboxes.shape[0]):
+        dets = []
+        for c in range(scores.shape[1]):
+            if c == background:
+                continue
+            sc = scores[n, c]
+            keep = np.where(sc > score_th)[0]
+            if keep.size == 0:
+                continue
+            order = keep[np.argsort(-sc[keep])]
+            if nms_top_k > 0:
+                order = order[:nms_top_k]
+            picked = []
+            while order.size:
+                i = order[0]
+                picked.append(i)
+                if order.size == 1:
+                    break
+                ious = _iou(bboxes[n, i], bboxes[n, order[1:]])
+                order = order[1:][ious <= nms_th]
+            for i in picked:
+                dets.append((c, sc[i], *bboxes[n, i], n * scores.shape[2] + i))
+        dets.sort(key=lambda r: -r[1])
+        if keep_top_k > 0:
+            dets = dets[:keep_top_k]
+        rois_num.append(len(dets))
+        for r in dets:
+            all_dets.append(r[:6])
+            all_idx.append(r[6])
+    if all_dets:
+        out = np.asarray(all_dets, np.float32)
+        idx = np.asarray(all_idx, np.int32).reshape(-1, 1)
+    else:
+        out = np.full((1, 6), -1.0, np.float32)  # reference empty marker
+        idx = np.zeros((0, 1), np.int32)
+    return {"Out": [jnp.asarray(out)], "Index": [jnp.asarray(idx)],
+            "NmsRoisNum": [jnp.asarray(np.asarray(rois_num, np.int32))]}
+
+
+# ------------------------------------------------------- normalization
+
+def _group_norm(jnp, ins, attrs):
+    x = ins["X"][0]
+    g = attrs.get("groups", 1)
+    eps = attrs.get("epsilon", 1e-5)
+    if attrs.get("data_layout", "NCHW") != "NCHW":
+        raise NotImplementedError("group_norm NHWC (pdmodel interop table)")
+    n, c = x.shape[0], x.shape[1]
+    r = x.reshape((n, g, c // g) + tuple(x.shape[2:]))
+    axes = tuple(range(2, r.ndim))
+    mean = jnp.mean(r, axis=axes, keepdims=True)
+    var = jnp.var(r, axis=axes, keepdims=True)
+    y = ((r - mean) / jnp.sqrt(var + eps)).reshape(x.shape)
+    shape = (1, c) + (1,) * (x.ndim - 2)
+    if ins.get("Scale"):
+        y = y * ins["Scale"][0].reshape(shape)
+    if ins.get("Bias"):
+        y = y + ins["Bias"][0].reshape(shape)
+    return {"Y": [y], "Mean": [None], "Variance": [None]}
+
+
+def _instance_norm(jnp, ins, attrs):
+    x = ins["X"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) / jnp.sqrt(var + eps)
+    shape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+    if ins.get("Scale"):
+        y = y * ins["Scale"][0].reshape(shape)
+    if ins.get("Bias"):
+        y = y + ins["Bias"][0].reshape(shape)
+    return {"Y": [y], "SavedMean": [None], "SavedVariance": [None]}
+
+
+def _l2_norm(jnp, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 1)
+    eps = attrs.get("epsilon", 1e-10)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return {"Out": [x / norm], "Norm": [norm]}
+
+
+def _clip_by_norm(jnp, ins, attrs):
+    x = ins["X"][0]
+    mx = attrs.get("max_norm", 1.0)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    return {"Out": [jnp.where(norm > mx, x * (mx / norm), x)]}
+
+
+def _lrn(jnp, ins, attrs):
+    import jax
+    x = ins["X"][0]
+    n = attrs.get("n", 5)
+    k = attrs.get("k", 2.0)
+    alpha = attrs.get("alpha", 1e-4)
+    beta = attrs.get("beta", 0.75)
+    sq = jnp.square(x)
+    pad = n // 2
+    acc = jax.lax.reduce_window(sq, 0.0, jax.lax.add, (1, n, 1, 1),
+                                (1, 1, 1, 1),
+                                [(0, 0), (pad, n - 1 - pad), (0, 0), (0, 0)])
+    return {"Out": [x / jnp.power(k + alpha * acc, beta)],
+            "MidOut": [None]}
+
+
+# -------------------------------------------------- activations (tail)
+
+def _act(fn):
+    def run(jnp, ins, attrs):
+        return {"Out": [fn(jnp, ins["X"][0], attrs)]}
+    return run
+
+
+def _prelu(jnp, ins, attrs):
+    x = ins["X"][0]
+    a = ins["Alpha"][0]
+    mode = attrs.get("mode", "all")
+    if mode == "channel":
+        if attrs.get("data_format", "NCHW") == "NCHW":
+            a = a.reshape((1, -1) + (1,) * (x.ndim - 2))
+        else:
+            a = a.reshape((1,) * (x.ndim - 1) + (-1,))
+    elif mode == "element":
+        a = a.reshape((1,) + tuple(x.shape[1:]))
+    else:
+        a = a.reshape(())
+    return {"Out": [jnp.where(x > 0, x, a * x)]}
+
+
+def _maxout(jnp, ins, attrs):
+    x = ins["X"][0]
+    g = attrs["groups"]
+    axis = attrs.get("axis", 1)
+    if axis < 0:
+        axis += x.ndim
+    c = x.shape[axis]
+    shp = x.shape[:axis] + (c // g, g) + x.shape[axis + 1:]
+    return {"Out": [jnp.max(x.reshape(shp), axis=axis + 1)]}
+
+
+# ----------------------------------------------------- shape / tensor
+
+def _meshgrid(jnp, ins, attrs):
+    outs = jnp.meshgrid(*ins["X"], indexing="ij")
+    return {"Out": list(outs)}
+
+
+def _argsort(jnp, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    desc = attrs.get("descending", False)
+    idx = jnp.argsort(-x if desc else x, axis=axis)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    return {"Out": [out], "Indices": [idx.astype(np.int64)]}
+
+
+def _bmm(jnp, ins, attrs):
+    return {"Out": [jnp.matmul(ins["X"][0], ins["Y"][0])]}
+
+
+def _dot(jnp, ins, attrs):
+    return {"Out": [jnp.sum(ins["X"][0] * ins["Y"][0], axis=-1)]}
+
+
+def _tril_triu(jnp, ins, attrs):
+    x = ins["X"][0]
+    d = attrs.get("diagonal", 0)
+    fn = jnp.tril if attrs.get("lower", True) else jnp.triu
+    return {"Out": [fn(x, k=d)]}
+
+
+def _expand_as_v2(jnp, ins, attrs):
+    x = ins["X"][0]
+    tgt = list(attrs.get("target_shape", []))
+    if not tgt and ins.get("Y"):
+        tgt = list(ins["Y"][0].shape)
+    off = len(tgt) - x.ndim
+    shape = [(x.shape[i - off] if s == -1 else s)
+             for i, s in enumerate(tgt)]
+    return {"Out": [jnp.broadcast_to(x, shape)]}
+
+
+def _roll(jnp, ins, attrs):
+    ax = attrs.get("axis", [])
+    return {"Out": [jnp.roll(ins["X"][0], tuple(attrs.get("shifts", [0])),
+                             axis=tuple(ax) if ax else None)]}
+
+
+def _unstack(jnp, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    parts = jnp.split(x, x.shape[axis], axis=axis)
+    return {"Y": [jnp.squeeze(p, axis=axis) for p in parts]}
+
+
+def _unbind(jnp, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    parts = jnp.split(x, x.shape[axis], axis=axis)
+    return {"Out": [jnp.squeeze(p, axis=axis) for p in parts]}
+
+
+def _fill_constant_batch_size_like(jnp, ins, attrs):
+    x = ins["Input"][0]
+    shape = [int(s) for s in attrs["shape"]]
+    shape[attrs.get("output_dim_idx", 0)] = \
+        x.shape[attrs.get("input_dim_idx", 0)]
+    dt = PROTO_DTYPES[attrs.get("dtype", 5)]
+    return {"Out": [jnp.full(shape, attrs.get("value", 0.0), dt)]}
+
+
+def _assign_value(jnp, ins, attrs):
+    dt = PROTO_DTYPES[attrs.get("dtype", 5)]
+    for key in ("fp32_values", "int32_values", "int64_values",
+                "bool_values", "values"):
+        vals = attrs.get(key)
+        if vals:
+            break
+    arr = np.asarray(vals if vals else [],
+                     np.dtype(dt) if not isinstance(dt, str) else dt)
+    return {"Out": [jnp.asarray(arr.reshape(
+        [int(s) for s in attrs.get("shape", [len(arr)])]))]}
+
+
+def _pixel_shuffle(jnp, ins, attrs):
+    x = ins["X"][0]
+    r = attrs.get("upscale_factor", 1)
+    if attrs.get("data_format", "NCHW") != "NCHW":
+        raise NotImplementedError("pixel_shuffle NHWC")
+    n, c, h, w = x.shape
+    y = x.reshape(n, c // (r * r), r, r, h, w)
+    y = jnp.transpose(y, (0, 1, 4, 2, 5, 3))
+    return {"Out": [y.reshape(n, c // (r * r), h * r, w * r)]}
+
+
+def _shuffle_channel(jnp, ins, attrs):
+    x = ins["X"][0]
+    g = attrs.get("group", 1)
+    n, c, h, w = x.shape
+    y = x.reshape(n, g, c // g, h, w)
+    return {"Out": [jnp.swapaxes(y, 1, 2).reshape(n, c, h, w)]}
+
+
+def _pad_nd(w_first):
+    """pad2d's paddings attr is [top, bottom, left, right] (H first,
+    pad2d_op.cc: out_h = x_h + paddings[0] + paddings[1]); pad3d's is
+    [left, right, top, bottom, front, back] (W innermost first)."""
+    def run(jnp, ins, attrs):
+        x = ins["X"][0]
+        pads = list(attrs.get("paddings", []))
+        mode = attrs.get("mode", "constant")
+        val = attrs.get("value", attrs.get("pad_value", 0.0))
+        fmt = attrs.get("data_format", "NCHW")
+        nsp = len(pads) // 2
+        sp = [(pads[2 * i], pads[2 * i + 1]) for i in range(nsp)]
+        if w_first:
+            sp = sp[::-1]  # np.pad wants outermost spatial dim first
+        if fmt.startswith("NC"):
+            cfg = [(0, 0), (0, 0)] + sp
+        else:
+            cfg = [(0, 0)] + sp + [(0, 0)]
+        np_mode = {"constant": "constant", "reflect": "reflect",
+                   "replicate": "edge", "circular": "wrap"}[mode]
+        if np_mode == "constant":
+            return {"Out": [jnp.pad(x, cfg, mode="constant",
+                                    constant_values=val)]}
+        return {"Out": [jnp.pad(x, cfg, mode=np_mode)]}
+    return run
+
+
+def _grid_sampler(jnp, ins, attrs):
+    import jax
+    x = ins["X"][0]          # [N, C, H, W]
+    grid = ins["Grid"][0]    # [N, Ho, Wo, 2] in [-1, 1]
+    if attrs.get("mode", "bilinear") != "bilinear" or \
+            attrs.get("padding_mode", "zeros") != "zeros":
+        raise NotImplementedError(
+            "grid_sampler mode/padding variant (pdmodel interop table)")
+    n, c, h, w = x.shape
+    align = attrs.get("align_corners", True)
+    gx, gy = grid[..., 0], grid[..., 1]
+    if align:
+        fx = (gx + 1) * 0.5 * (w - 1)
+        fy = (gy + 1) * 0.5 * (h - 1)
+    else:
+        fx = ((gx + 1) * w - 1) * 0.5
+        fy = ((gy + 1) * h - 1) * 0.5
+    x0 = jnp.floor(fx)
+    y0 = jnp.floor(fy)
+    wx = fx - x0
+    wy = fy - y0
+
+    def sample(xi, yi):
+        inb = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+        xi_c = jnp.clip(xi, 0, w - 1).astype(np.int32)
+        yi_c = jnp.clip(yi, 0, h - 1).astype(np.int32)
+        # batch-wise gather: v[n, c, ho, wo] = x[n, c, yi[n,ho,wo], xi[..]]
+        v = jax.vmap(lambda img, yy, xx: img[:, yy, xx])(x, yi_c, xi_c)
+        return jnp.where(inb[:, None], v, 0.0)
+
+    v00 = sample(x0, y0)
+    v01 = sample(x0 + 1, y0)
+    v10 = sample(x0, y0 + 1)
+    v11 = sample(x0 + 1, y0 + 1)
+    wx_ = wx[:, None]
+    wy_ = wy[:, None]
+    out = (v00 * (1 - wx_) * (1 - wy_) + v01 * wx_ * (1 - wy_) +
+           v10 * (1 - wx_) * wy_ + v11 * wx_ * wy_)
+    return {"Output": [out]}
+
+
+def _conv2d_transpose(jnp, ins, attrs):
+    import jax
+    x, w = ins["Input"][0], ins["Filter"][0]  # w: [Cin, Cout/g, kh, kw]
+    strides = tuple(attrs.get("strides", [1, 1]))
+    pads = attrs.get("paddings", [0, 0])
+    if len(pads) == 2:
+        pads = [pads[0], pads[0], pads[1], pads[1]]
+    dil = tuple(attrs.get("dilations", [1, 1]))
+    groups = attrs.get("groups", 1)
+    outpad = attrs.get("output_padding", []) or [0, 0]
+    if attrs.get("padding_algorithm", "EXPLICIT") != "EXPLICIT":
+        raise NotImplementedError("conv2d_transpose SAME/VALID")
+    kh = (w.shape[2] - 1) * dil[0] + 1
+    kw = (w.shape[3] - 1) * dil[1] + 1
+    # transposed conv = conv over stride-dilated input with flipped,
+    # io-swapped kernel
+    wt = jnp.flip(w, axis=(2, 3))
+    if groups > 1:
+        ci, cog = w.shape[0], w.shape[1]
+        wt = wt.reshape(groups, ci // groups, cog, w.shape[2], w.shape[3])
+        wt = jnp.swapaxes(wt, 1, 2).reshape(groups * cog, ci // groups,
+                                            w.shape[2], w.shape[3])
+    else:
+        wt = jnp.swapaxes(wt, 0, 1)
+    pad = [(kh - 1 - pads[0], kh - 1 - pads[1] + outpad[0]),
+           (kw - 1 - pads[2], kw - 1 - pads[3] + outpad[1])]
+    out = jax.lax.conv_general_dilated(
+        x, wt, (1, 1), pad, lhs_dilation=strides, rhs_dilation=dil,
+        feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return {"Output": [out]}
+
+
+def _softmax_with_cross_entropy(jnp, ins, attrs):
+    import jax
+    logits = ins["Logits"][0]
+    label = ins["Label"][0]
+    axis = attrs.get("axis", -1)
+    sm = jax.nn.softmax(logits, axis=axis)
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        lab = label
+        if lab.ndim == logits.ndim and lab.shape[axis] == 1:
+            lab = jnp.squeeze(lab, axis=axis)
+        loss = -jnp.take_along_axis(
+            logp, lab[..., None].astype(np.int32), axis=axis)
+    return {"Softmax": [sm], "Loss": [loss]}
+
+
+def _sigmoid_cross_entropy_with_logits(jnp, ins, attrs):
+    import jax
+    x = ins["X"][0]
+    lab = ins["Label"][0]
+    loss = jnp.maximum(x, 0) - x * lab + jax.nn.softplus(-jnp.abs(x))
+    return {"Out": [loss]}
+
+
+def _register():
+    C = _CONVERTERS
+    C["fused_attention"] = _fused_attention
+    C["fused_feedforward"] = _fused_feedforward
+    C["fused_bias_dropout_residual_layer_norm"] = \
+        _fused_bias_dropout_residual_ln
+    C["fused_multi_transformer"] = _fused_multi_transformer
+    C["fused_embedding_eltwise_layernorm"] = \
+        _fused_embedding_eltwise_layernorm
+    C["skip_layernorm"] = _skip_layernorm
+    C["fc"] = _fc
+    # detection
+    C["yolo_box"] = _yolo_box
+    C["prior_box"] = _prior_box
+    C["box_coder"] = _box_coder
+    C["roi_align"] = _roi_align
+    C["multiclass_nms3"] = _multiclass_nms3
+    C["multiclass_nms2"] = _multiclass_nms3
+    C["multiclass_nms"] = _multiclass_nms3
+    _EAGER_ONLY_OPS.update({"multiclass_nms3", "multiclass_nms2",
+                            "multiclass_nms"})
+    # normalization
+    C["group_norm"] = _group_norm
+    C["instance_norm"] = _instance_norm
+    C["norm"] = _l2_norm
+    C["clip_by_norm"] = _clip_by_norm
+    C["lrn"] = _lrn
+    # activations tail
+    C["prelu"] = _prelu
+    C["maxout"] = _maxout
+    C["selu"] = _act(lambda jnp, x, a: a.get("scale", 1.0507009873554805)
+                     * jnp.where(x > 0, x, a.get("alpha", 1.6732632423543772)
+                                 * (jnp.exp(x) - 1)))
+    C["celu"] = _act(lambda jnp, x, a: jnp.maximum(x, 0) + jnp.minimum(
+        0, a.get("alpha", 1.0) * (jnp.exp(x / a.get("alpha", 1.0)) - 1)))
+    C["logsigmoid"] = _act(
+        lambda jnp, x, a: -__import__("jax").nn.softplus(-x))
+    C["softsign"] = _act(lambda jnp, x, a: x / (1 + jnp.abs(x)))
+    C["tanh_shrink"] = _act(lambda jnp, x, a: x - jnp.tanh(x))
+    C["hard_shrink"] = _act(lambda jnp, x, a: jnp.where(
+        jnp.abs(x) > a.get("threshold", 0.5), x, 0.0))
+    C["softshrink"] = _act(lambda jnp, x, a: jnp.where(
+        x > a.get("lambda", 0.5), x - a.get("lambda", 0.5),
+        jnp.where(x < -a.get("lambda", 0.5), x + a.get("lambda", 0.5), 0.0)))
+    C["thresholded_relu"] = _act(lambda jnp, x, a: jnp.where(
+        x > a.get("threshold", 1.0), x, 0.0))
+    C["brelu"] = _act(lambda jnp, x, a: jnp.clip(
+        x, a.get("t_min", 0.0), a.get("t_max", 24.0)))
+    # shape / tensor tail
+    C["meshgrid"] = _meshgrid
+    C["argsort"] = _argsort
+    C["bmm"] = _bmm
+    C["dot"] = _dot
+    C["tril_triu"] = _tril_triu
+    C["expand_as_v2"] = _expand_as_v2
+    C["roll"] = _roll
+    C["unstack"] = _unstack
+    C["unbind"] = _unbind
+    C["fill_constant_batch_size_like"] = _fill_constant_batch_size_like
+    C["assign_value"] = _assign_value
+    C["pixel_shuffle"] = _pixel_shuffle
+    C["shuffle_channel"] = _shuffle_channel
+    C["pad2d"] = _pad_nd(w_first=False)
+    C["pad3d"] = _pad_nd(w_first=True)
+    C["grid_sampler"] = _grid_sampler
+    C["conv2d_transpose"] = _conv2d_transpose
+    C["depthwise_conv2d_transpose"] = _conv2d_transpose
+    C["softmax_with_cross_entropy"] = _softmax_with_cross_entropy
+    C["sigmoid_cross_entropy_with_logits"] = \
+        _sigmoid_cross_entropy_with_logits
+
+
+_register()
