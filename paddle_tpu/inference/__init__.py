@@ -197,7 +197,8 @@ class _PdModelArtifact:
     exported .pdmodel/.pdiparams pair serves directly on TPU through the
     same Predictor surface they used with the reference runtime."""
 
-    def __init__(self, model_bytes, params_path=None, prefix=None):
+    def __init__(self, model_bytes, params_path=None, prefix=None,
+                 precision="float32"):
         from ..static.pdmodel import PROTO_DTYPES, load_pdmodel
 
         ppath = params_path or (prefix + ".pdiparams")
@@ -211,7 +212,8 @@ class _PdModelArtifact:
             # as an opaque missing-var KeyError at the first predict
             raise FileNotFoundError(
                 f"params file not found: {params_path}")
-        self._prog = load_pdmodel(model_bytes, params_bytes)
+        self._prog = load_pdmodel(model_bytes, params_bytes,
+                                  precision=precision)
         self.feed_names = list(self._prog.feed_names)
         # same dict spec shape the StableHLO artifact path produces
         # (framework/exporting._spec_of) — inference.Tensor subscripts it
@@ -253,18 +255,35 @@ class Predictor:
         if config._prefix is None:
             raise ValueError("Config has no model path")
         self._config = config
+        precision = {PrecisionType.Float32: "float32",
+                     PrecisionType.Half: "float16",
+                     PrecisionType.Bfloat16: "bfloat16"}.get(
+                         config.precision())
+        if precision is None:
+            raise NotImplementedError(
+                "Int8 serving goes through the static PTQ pipeline "
+                "(paddle_tpu.quantization), not Config.set_precision")
         pd_bytes = _sniff_reference_pdmodel(config._prefix)
         # routing: an explicit params file belongs to the proto pair (the
-        # self-consistent combination); otherwise the pre-compiled .pdexec
-        # twin is the fast path when present
+        # self-consistent combination); a reduced-precision request needs
+        # the re-lowerable program form (the .pdexec StableHLO is compiled
+        # with baked dtypes); otherwise the pre-compiled .pdexec twin is
+        # the fast path
         use_proto = pd_bytes is not None and (
             config._params_path is not None
+            or precision != "float32"
             or not os.path.exists(str(config._prefix) + ".pdexec"))
         if use_proto:
             self._artifact = _PdModelArtifact(pd_bytes,
                                               config._params_path,
-                                              prefix=config._prefix)
+                                              prefix=config._prefix,
+                                              precision=precision)
         else:
+            if precision != "float32":
+                raise ValueError(
+                    f"set_precision({precision!r}) needs the reference-"
+                    f"format program ({config._prefix}.pdmodel) to "
+                    f"re-lower; only a .pdexec artifact was found")
             self._artifact = load_artifact(config._prefix,
                                            config._params_path)
         self._inputs = {name: Tensor(name, spec)

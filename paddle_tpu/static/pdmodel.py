@@ -736,14 +736,16 @@ def _interp(method):
     def run(jnp, ins, attrs):
         import jax
         x = ins["X"][0]
-        oh = attrs.get("out_h", 0)
-        ow = attrs.get("out_w", 0)
-        scale = attrs.get("scale", [])
-        if (not oh or oh <= 0) and scale:
+        size = _interp_size(ins, attrs, 2)
+        if size is None:
+            scale = attrs.get("scale", [])
+            if not scale:
+                raise NotImplementedError(
+                    f"{method}_interp without out_h/out_w/scale/OutSize "
+                    f"(pdmodel interop table)")
             s = scale if isinstance(scale, (list, tuple)) else [scale, scale]
-            oh = int(x.shape[2] * s[0])
-            ow = int(x.shape[3] * s[-1])
-        out = jax.image.resize(x, (x.shape[0], x.shape[1], oh, ow),
+            size = [int(x.shape[2] * s[0]), int(x.shape[3] * s[-1])]
+        out = jax.image.resize(x, (x.shape[0], x.shape[1], *size),
                                method=method)
         return {"Out": [out]}
     return run
@@ -937,16 +939,39 @@ _CONVERTERS = {
 }
 
 
+def _interp_size(ins, attrs, dims_needed):
+    """Resolve the target spatial size: attrs (out_h/out_w), scale, or the
+    OutSize/SizeTensor inputs (must be concrete — raise under jit)."""
+    keys = ("out_d", "out_h", "out_w")[-dims_needed:]
+    size = [attrs.get(k, 0) or 0 for k in keys]
+    if all(s > 0 for s in size):
+        return size
+    for inp in ("OutSize", "SizeTensor"):
+        if ins.get(inp):
+            vals = np.concatenate([np.atleast_1d(np.asarray(v))
+                                   for v in ins[inp]])
+            return [int(v) for v in vals[-dims_needed:]]
+    return None
+
+
 def _linear_interp(jnp, ins, attrs):
-    """linear_interp_v2: rank-3 [N, C, W] 1-D resize (out_w/scale only)."""
+    """linear_interp_v2: rank-3 [N, C, W] 1-D resize."""
     import jax
     x = ins["X"][0]
-    ow = attrs.get("out_w", 0)
-    scale = attrs.get("scale", [])
-    if (not ow or ow <= 0) and scale:
+    if attrs.get("align_corners", False):
+        raise NotImplementedError(
+            "linear_interp align_corners=True (pdmodel interop table)")
+    size = _interp_size(ins, attrs, 1)
+    if size is None:
+        scale = attrs.get("scale", [])
+        if not scale:
+            raise NotImplementedError(
+                "linear_interp without out_w/scale/OutSize "
+                "(pdmodel interop table)")
         s = scale if isinstance(scale, (list, tuple)) else [scale]
-        ow = int(x.shape[2] * s[-1])
-    out = jax.image.resize(x, (x.shape[0], x.shape[1], ow), method="linear")
+        size = [int(x.shape[2] * s[-1])]
+    out = jax.image.resize(x, (x.shape[0], x.shape[1], size[0]),
+                           method="linear")
     return {"Out": [out]}
 
 
@@ -1034,11 +1059,19 @@ _CONVERTERS["mish"] = _mish
 # --------------------------------------------------------------- executable
 
 class PdProgram:
-    """An executable reference-format program (inference block 0)."""
+    """An executable reference-format program (inference block 0).
+
+    ``precision`` rewrites the serving dtype at lowering: float params and
+    feeds are cast to bf16/fp16 before the whole-program jit traces, so
+    XLA compiles the entire graph in the target dtype (the TPU analog of
+    the reference's convert_to_mixed_precision.cc graph pass); fetched
+    outputs are cast back to float32."""
 
     def __init__(self, desc: Dict[str, Any],
-                 params: Optional[Dict[str, np.ndarray]] = None):
+                 params: Optional[Dict[str, np.ndarray]] = None,
+                 precision: str = "float32"):
         self.desc = desc
+        self.precision = precision
         block = desc["blocks"][0]
         self.vars = {v["name"]: v for v in block["vars"]}
         self.ops = block["ops"]
@@ -1061,6 +1094,8 @@ class PdProgram:
                     self.fetch_names.append(None)
                 self.fetch_names[col] = name
         self._jitted = None
+        self._has_eager = any(op["type"] in _EAGER_ONLY_OPS
+                              for op in self.ops)
 
     def persistable_names(self) -> List[str]:
         return sorted(n for n, v in self.vars.items()
@@ -1081,14 +1116,58 @@ class PdProgram:
                 missing.append(t)
         return missing
 
-    def _execute(self, *feed_arrays):
+    def set_precision(self, precision: str):
+        """'float32' | 'bfloat16' | 'float16' — takes effect on the next
+        run (re-lowers the whole program in the new dtype)."""
+        if precision not in ("float32", "bfloat16", "float16"):
+            raise ValueError(f"unsupported serving precision {precision!r}")
+        self.precision = precision
+        self._jitted = None
+
+    def _serve_dtype(self, jnp):
+        return {"float32": None, "bfloat16": jnp.bfloat16,
+                "float16": jnp.float16}[self.precision]
+
+    def _committed_params(self):
+        """Params as device-resident arrays in the serving dtype, in
+        sorted-name order. Passed to the jitted program as ARGUMENTS (not
+        closure constants) so weights are not inlined into the HLO — an
+        ERNIE-base program with inlined weights is a quarter-GB compile
+        payload, and weight swaps would force recompiles."""
+        import jax.numpy as jnp
+        tgt = self._serve_dtype(jnp)
+        # key on the identity of every value so both dict replacement and
+        # per-item assignment invalidate (in-place np mutation of an array
+        # is NOT detected — rebind the entry instead)
+        key = (self.precision, tuple(map(id, self.params.values())))
+        if getattr(self, "_param_cache_key", None) != key:
+            names = sorted(self.params)
+            vals = []
+            for n in names:
+                a = jnp.asarray(self.params[n])
+                if tgt is not None and jnp.issubdtype(a.dtype,
+                                                      jnp.floating):
+                    a = a.astype(tgt)
+                vals.append(a)
+            self._param_cache = (tuple(names), tuple(vals))
+            self._param_cache_key = key
+        return self._param_cache
+
+    def _execute(self, feed_arrays, param_names, param_vals):
         import jax.numpy as jnp
 
+        tgt = self._serve_dtype(jnp)
+
+        def lower(a):
+            if tgt is not None and jnp.issubdtype(a.dtype, jnp.floating):
+                return a.astype(tgt)
+            return a
+
         values: Dict[str, Any] = {}
-        for name, arr in self.params.items():
-            values[name] = jnp.asarray(arr)
-        for name, arr in zip(self.feed_names, feed_arrays):
+        for name, arr in zip(param_names, param_vals):
             values[name] = arr
+        for name, arr in zip(self.feed_names, feed_arrays):
+            values[name] = lower(arr)
         from ..ops import registry
         for op in self.ops:
             t = op["type"]
@@ -1110,26 +1189,40 @@ class PdProgram:
                 produced = outs.get(k, [])
                 for n, val in zip(args, produced):
                     if val is not None:
-                        values[n] = val
-        return [values[n] for n in self.fetch_names]
+                        # keep the graph uniformly in the serving dtype:
+                        # a stray f32 producer (fill_constant, cast) would
+                        # otherwise promote everything downstream back up
+                        values[n] = lower(val) if hasattr(val, "dtype") \
+                            else val
+        outs = [values[n] for n in self.fetch_names]
+        if tgt is not None:
+            outs = [o.astype(jnp.float32)
+                    if jnp.issubdtype(o.dtype, jnp.floating) else o
+                    for o in outs]
+        return outs
 
     def run(self, feed: Dict[str, Any]):
         import jax
         import jax.numpy as jnp
 
-        arrays = [jnp.asarray(np.asarray(feed[n])) for n in self.feed_names]
-        if any(op["type"] in _EAGER_ONLY_OPS for op in self.ops):
+        arrays = [v if isinstance(v, jax.Array)
+                  else jnp.asarray(np.asarray(v))
+                  for v in (feed[n] for n in self.feed_names)]
+        names, vals = self._committed_params()
+        if self._has_eager:
             # data-dependent output extents (NMS) cannot live under jit
-            return self._execute(*arrays)
+            return self._execute(arrays, names, vals)
         if self._jitted is None:
-            self._jitted = jax.jit(self._execute)
-        return self._jitted(*arrays)
+            self._jitted = jax.jit(self._execute,
+                                   static_argnames=("param_names",))
+        return self._jitted(arrays, names, vals)
 
 
 def load_pdmodel(model_bytes: bytes,
-                 params_bytes: Optional[bytes] = None) -> PdProgram:
+                 params_bytes: Optional[bytes] = None,
+                 precision: str = "float32") -> PdProgram:
     desc = parse_program_desc(model_bytes)
-    prog = PdProgram(desc)
+    prog = PdProgram(desc, precision=precision)
     if params_bytes:
         prog.params = parse_combined_params(params_bytes,
                                             prog.persistable_names())

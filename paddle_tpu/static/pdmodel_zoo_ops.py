@@ -735,8 +735,8 @@ def _softmax_with_cross_entropy(jnp, ins, attrs):
         lab = label
         if lab.ndim == logits.ndim and lab.shape[axis] == 1:
             lab = jnp.squeeze(lab, axis=axis)
-        loss = -jnp.take_along_axis(
-            logp, lab[..., None].astype(np.int32), axis=axis)
+        idx = jnp.expand_dims(lab.astype(np.int32), axis)
+        loss = -jnp.take_along_axis(logp, idx, axis=axis)
     return {"Softmax": [sm], "Loss": [loss]}
 
 

@@ -159,6 +159,7 @@ class TestJit:
             l = float(step(x, y).numpy())
         assert l < l0
 
+    @pytest.mark.slow
     def test_train_step_amp_o1(self):
         m = nn.Sequential(nn.Linear(4, 16), nn.GELU(), nn.Linear(16, 2))
         opt = paddle.optimizer.AdamW(learning_rate=0.05,
@@ -201,6 +202,7 @@ class TestJit:
                                    rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 class TestVisionModels:
     def test_lenet_forward_backward(self):
         from paddle_tpu.vision.models import LeNet

@@ -97,6 +97,7 @@ class TestShardedCheckpoint:
         step(ids, ids)  # places params sharded per dist_spec
         return m
 
+    @pytest.mark.slow
     def test_roundtrip_under_mesh(self, tmp_path):
         from paddle_tpu.framework.checkpoint import (load_sharded,
                                                      save_sharded)

@@ -94,6 +94,7 @@ class TestASP:
 
 
 class TestVisionExtras:
+    @pytest.mark.slow
     def test_alexnet_forward(self):
         paddle.seed(0)
         m = paddle.vision.models.alexnet(num_classes=7)
@@ -101,6 +102,7 @@ class TestVisionExtras:
             np.random.RandomState(0).randn(1, 3, 224, 224).astype("float32"))
         assert list(m(x).shape) == [1, 7]
 
+    @pytest.mark.slow
     def test_vit_trains(self):
         paddle.seed(0)
         from paddle_tpu.vision.models import vit_s_16
@@ -122,6 +124,7 @@ class TestVisionExtras:
 
 
 class TestErnieEndToEnd:
+    @pytest.mark.slow
     def test_ernie_sharded_train_then_serve(self, tmp_path):
         """BASELINE config 5 shape: ERNIE sharded training (ZeRO axis +
         mp) then an inference artifact served in a fresh process."""

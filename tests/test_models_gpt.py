@@ -77,6 +77,7 @@ class TestCriterion:
 
 
 class TestGPTTrain:
+    @pytest.mark.slow
     def test_train_step_decreases_loss(self):
         m, cfg, ids = make(seq=32)
         crit = GPTPretrainingCriterion()

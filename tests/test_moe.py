@@ -125,6 +125,7 @@ class TestExpertParallel:
         set_global_mesh(None)
         return losses, net_params, net
 
+    @pytest.mark.slow
     def test_ep4_matches_single(self):
         import jax
         jax.config.update("jax_default_matmul_precision", "highest")
@@ -135,6 +136,7 @@ class TestExpertParallel:
             np.testing.assert_allclose(p1[n], p2[n], rtol=1e-4, atol=1e-4,
                                        err_msg=n)
 
+    @pytest.mark.slow
     def test_dp2_ep4_matches_single(self):
         import jax
         jax.config.update("jax_default_matmul_precision", "highest")

@@ -318,6 +318,7 @@ class TestReviewRegressions:
         out, (h2, c2) = cell(x, (h, c))
         assert h2.shape == [3, 8]
 
+    @pytest.mark.slow
     def test_rnnt_fastemit_changes_grads_not_value(self):
         import jax
         import jax.numpy as jnp

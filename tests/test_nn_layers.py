@@ -219,6 +219,7 @@ class TestTransformer:
         out = m(x, x, x)
         assert out.shape == [2, 5, 16]
 
+    @pytest.mark.slow
     def test_transformer_encoder_layer(self):
         layer = nn.TransformerEncoderLayer(d_model=16, nhead=4, dim_feedforward=32)
         x = t(np.random.randn(2, 5, 16).astype("float32"))
@@ -232,6 +233,7 @@ class TestTransformer:
 
 
 class TestRNN:
+    @pytest.mark.slow
     def test_lstm_gru_shapes(self):
         lstm = nn.LSTM(8, 16)
         x = t(np.random.randn(2, 5, 8).astype("float32"))
@@ -243,6 +245,7 @@ class TestRNN:
 
 
 class TestTraining:
+    @pytest.mark.slow
     def test_mlp_learns_xor(self):
         X = np.array([[0, 0], [0, 1], [1, 0], [1, 1]], "float32")
         Y = np.array([0, 1, 1, 0], "int64")

@@ -1,6 +1,7 @@
 """Op registry: yaml source of truth + compat aliasing + coverage target
 (reference: ops.yaml/op_compat.yaml; SURVEY §7.2 ~350-op target)."""
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu.ops import registry
@@ -68,6 +69,7 @@ class TestMiscCoverage:
         monitor.stat_reset("x")
         assert monitor.stat_get("x") == 0
 
+    @pytest.mark.slow
     def test_hapi_callbacks_early_stopping(self, tmp_path):
         from paddle_tpu.vision.datasets import FakeMNIST
         from paddle_tpu.vision.models import LeNet
@@ -84,6 +86,7 @@ class TestMiscCoverage:
               callbacks=[es, ck])
         assert (tmp_path / "final.pdparams").exists()
 
+    @pytest.mark.slow
     def test_bert_family_trains(self):
         from paddle_tpu.models import (BertForSequenceClassification,
                                        bert_tiny)

@@ -9,6 +9,8 @@ single-device run, for n_micro > pp and composed dp/mp parallelism.
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 import paddle_tpu.distributed.fleet as fleet
 from paddle_tpu.distributed.mesh_utils import set_global_mesh

@@ -2,6 +2,8 @@
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 import paddle_tpu.distributed.fleet as fleet
 from paddle_tpu.distributed.mesh_utils import set_global_mesh

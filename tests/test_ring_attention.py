@@ -5,6 +5,8 @@ Capability beyond the reference snapshot (SURVEY §5.7: no SP/CP exists there).
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 
 

@@ -224,6 +224,7 @@ def test_roi_align_matches_numpy_oracle():
         np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_roi_align_adaptive_sampling_ratio():
     """sampling_ratio=-1 -> per-roi ceil(roi_size/output) density."""
     np.random.seed(10)

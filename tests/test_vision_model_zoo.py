@@ -3,6 +3,8 @@ and one backward pass per family (reference: vision/models/*)."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 from paddle_tpu.vision import models as M
 
