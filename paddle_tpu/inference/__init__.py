@@ -260,9 +260,13 @@ class Predictor:
                      PrecisionType.Bfloat16: "bfloat16"}.get(
                          config.precision())
         if precision is None:
-            raise NotImplementedError(
-                "Int8 serving goes through the static PTQ pipeline "
-                "(paddle_tpu.quantization), not Config.set_precision")
+            if config.precision() == PrecisionType.Int8:
+                raise NotImplementedError(
+                    "Int8 serving goes through the static PTQ pipeline "
+                    "(paddle_tpu.quantization), not Config.set_precision")
+            raise ValueError(
+                f"set_precision expects a PrecisionType member, got "
+                f"{config.precision()!r}")
         pd_bytes = _sniff_reference_pdmodel(config._prefix)
         # routing: an explicit params file belongs to the proto pair (the
         # self-consistent combination); a reduced-precision request needs

@@ -18,6 +18,20 @@ and re-traced. The runtime helpers dispatch dynamically: a concrete
 predicate lowers through ``static.nn.cond`` / ``static.nn.while_loop``
 (→ ``lax.cond`` / ``lax.while_loop``), so the SAME rewritten function runs
 eagerly and compiled — the dy2static contract.
+
+Statement coverage (the reference's dedicated transformers):
+- early ``return`` → return-flag + value slot + guarded trailing code
+  (return_transformer.py analog, ``_ReturnTransformer``)
+- ``break``/``continue`` → loop flags + guarded trailing statements +
+  augmented loop test (break_continue_transformer.py analog)
+- ``for i in range(<tensor>)`` → counter while-loop
+- nested tensor-dependent if/while compose (inner rewrites are re-created
+  inside the outer branch functions, never carried through cond)
+
+Known limit: reverse-mode autograd through a TRACED while (dynamic trip
+count) is unsupported by XLA/jax (lax.while_loop has no transpose rule);
+converted loops serve forward/inference, and gradient flows through every
+converted ``if``. ``return`` inside a loop body stays plain Python.
 """
 from __future__ import annotations
 
@@ -28,7 +42,7 @@ import textwrap
 from typing import Callable
 
 __all__ = ["ast_transform", "convert_call_guard", "_dy2s_cond",
-           "_dy2s_while"]
+           "_dy2s_while", "_dy2s_not", "_dy2s_and"]
 
 
 class _Undefined:
@@ -87,9 +101,45 @@ def _is_traced(x):
         isinstance(x, Tensor) and isinstance(x._data, jax.core.Tracer))
 
 
-def _dy2s_cond(pred, true_fn, false_fn):
+def _dy2s_not(x):
+    """``not x`` that stays traced for Tensor/tracer operands (plain
+    ``not`` would force __bool__ and kill the trace)."""
+    if _is_traced(x):
+        from ..tensor.logic import logical_not
+        return logical_not(x)
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    p = x._data if isinstance(x, Tensor) else x
+    return not bool(np.asarray(p).item())
+
+
+def _dy2s_and(a, b_thunk):
+    """Short-circuit ``a and b()`` for concrete ``a``; logical_and of both
+    for traced (loop-guard composition: the rewritten test is pure)."""
+    if not _is_traced(a):
+        import numpy as np
+
+        from ..core.tensor import Tensor
+        p = a._data if isinstance(a, Tensor) else a
+        if not bool(np.asarray(p).item()):
+            return False
+        return b_thunk()
+    from ..tensor.logic import logical_and
+    return logical_and(a, b_thunk())
+
+
+def _dy2s_cond(pred, true_fn, false_fn, names=None):
     """Runtime dispatch for a rewritten ``if``: python branch when the
-    predicate is concrete, ``static.nn.cond`` when traced."""
+    predicate is concrete, ``static.nn.cond`` when traced.
+
+    Traced predicates with a value bound on only ONE path (the other
+    side yields the _UNDEF sentinel) fall back to
+    compute-both-and-select. Internal early-return slots (``__dy2s_*``
+    names) borrow the defined side's value as a placeholder — correct
+    because the return-flag discipline guards every later use; a USER
+    variable bound on only one path raises UnboundLocalError (using it
+    after a traced if would be undefined behavior)."""
     if not _is_traced(pred):
         import numpy as np
 
@@ -97,7 +147,41 @@ def _dy2s_cond(pred, true_fn, false_fn):
         p = pred._data if isinstance(pred, Tensor) else pred
         return true_fn() if bool(np.asarray(p).item()) else false_fn()
     from ..static import nn as static_nn
-    return static_nn.cond(pred, true_fn, false_fn)
+    try:
+        return static_nn.cond(pred, true_fn, false_fn)
+    except (TypeError, ValueError):
+        t_out = true_fn()
+        f_out = false_fn()
+        single = not isinstance(t_out, tuple)
+        if single:
+            t_out, f_out = (t_out,), (f_out,)
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+
+        def pick(i, t, f):
+            # None = the rewrite's initial value for a not-yet-bound slot
+            t_undef = isinstance(t, _Undefined) or t is None
+            f_undef = isinstance(f, _Undefined) or f is None
+            if t_undef and f_undef:
+                return t
+            if t_undef or f_undef:
+                name = names[i] if names and i < len(names) else ""
+                if not str(name).startswith("__dy2s_"):
+                    raise UnboundLocalError(
+                        f"dy2static: {name or 'a variable'} is bound on "
+                        f"only one branch of a tensor-dependent if — "
+                        f"assign it on both paths (or before the if)")
+                return f if t_undef else t
+            ta = t._data if isinstance(t, Tensor) else t
+            fa = f._data if isinstance(f, Tensor) else f
+            out = jnp.where(pred._data if isinstance(pred, Tensor)
+                            else pred, ta, fa)
+            return Tensor(out, stop_gradient=True) \
+                if isinstance(t, Tensor) or isinstance(f, Tensor) else out
+        outs = tuple(pick(i, t, f)
+                     for i, (t, f) in enumerate(zip(t_out, f_out)))
+        return outs[0] if single else outs
 
 
 def _dy2s_while(cond_fn, body_fn, carry):
@@ -113,6 +197,28 @@ def _dy2s_while(cond_fn, body_fn, carry):
                 return tuple(carry)
             carry = tuple(body_fn(*carry))
             probe = cond_fn(*carry)
+    if any(isinstance(c, _Undefined) for c in carry):
+        # a loop-local name (e.g. the for-range induction var) has no
+        # value before the loop; one abstract body pass discovers the
+        # slot's type so it can enter lax.while_loop as a placeholder.
+        # A body that USES the slot before assigning trips the sentinel
+        # (honest use-before-bind error).
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+        out = body_fn(*carry)
+        patched = []
+        for c, o in zip(carry, out):
+            if isinstance(c, _Undefined):
+                if isinstance(o, Tensor):
+                    c = Tensor(jnp.zeros_like(o._data),
+                               stop_gradient=True)
+                elif isinstance(o, _Undefined):
+                    pass  # never assigned either: keep the sentinel
+                else:
+                    c = jnp.zeros_like(jnp.asarray(o))
+            patched.append(c)
+        carry = tuple(patched)
     from ..static import nn as static_nn
     out = static_nn.while_loop(cond_fn, body_fn, list(carry))
     return tuple(out)
@@ -165,13 +271,162 @@ def _assigned(stmts):
     return v.names, v.unsupported
 
 
+def _contains(stmts, kinds, stop_at_loops=False):
+    """Any node of the given ast types in the statement list (not
+    descending into nested function defs; optionally not into loops)."""
+    found = []
+
+    class V(ast.NodeVisitor):
+        def generic_visit(self, n):
+            if isinstance(n, kinds):
+                found.append(n)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                return
+            if stop_at_loops and isinstance(n, (ast.While, ast.For)):
+                return
+            super().generic_visit(n)
+    for s in stmts:
+        V().visit(s)
+    return bool(found)
+
+
+def _not_call(name):
+    return ast.Call(func=ast.Name(id="_dy2s_not", ctx=ast.Load()),
+                    args=[ast.Name(id=name, ctx=ast.Load())],
+                    keywords=[])
+
+
+def _bool_const(v):
+    return ast.Constant(value=v)
+
+
+def _assign_name(name, value):
+    return ast.Assign(targets=[ast.Name(id=name, ctx=ast.Store())],
+                      value=value)
+
+
+class _ReturnTransformer:
+    """Early-return flattening (the reference's return_transformer.py):
+    every ``return X`` becomes ``__dy2s_rflag, __dy2s_rval = True, X``,
+    statements after a maybe-returning ``if`` are guarded behind
+    ``if _dy2s_not(__dy2s_rflag)``, and the function ends with
+    ``return __dy2s_rval``. Returns inside loops are not representable
+    this way — functions containing them are left untouched."""
+
+    FLAG = "__dy2s_rflag"
+    VAL = "__dy2s_rval"
+
+    def apply(self, fn_def):
+        has_nested_return = _contains(
+            fn_def.body, (ast.Return,)) and any(
+            isinstance(s, (ast.If, ast.While, ast.For)) and
+            _contains([s], (ast.Return,)) for s in fn_def.body)
+        if not has_nested_return:
+            return fn_def
+        # returns inside loops can't be expressed with a flag alone
+        for s in ast.walk(fn_def):
+            if isinstance(s, (ast.While, ast.For)) and \
+                    _contains(s.body + s.orelse, (ast.Return,)):
+                return fn_def
+        body, _may = self._rewrite(fn_def.body)
+        fn_def.body = [
+            _assign_name(self.FLAG, _bool_const(False)),
+            _assign_name(self.VAL, ast.Constant(value=None)),
+        ] + body + [ast.Return(value=ast.Name(id=self.VAL,
+                                              ctx=ast.Load()))]
+        return fn_def
+
+    def _rewrite(self, stmts):
+        out = []
+        may_return = False
+        for i, s in enumerate(stmts):
+            if isinstance(s, ast.Return):
+                out.append(ast.Assign(
+                    targets=[ast.Tuple(
+                        elts=[ast.Name(id=self.FLAG, ctx=ast.Store()),
+                              ast.Name(id=self.VAL, ctx=ast.Store())],
+                        ctx=ast.Store())],
+                    value=ast.Tuple(
+                        elts=[_bool_const(True),
+                              s.value or ast.Constant(value=None)],
+                        ctx=ast.Load())))
+                return out, True  # rest of the block is unreachable
+            if isinstance(s, ast.If) and _contains([s], (ast.Return,)):
+                s.body, r1 = self._rewrite(s.body)
+                s.orelse, r2 = self._rewrite(s.orelse)
+                out.append(s)
+                if r1 or r2:
+                    may_return = True
+                    rest, r3 = self._rewrite(stmts[i + 1:])
+                    if rest:
+                        out.append(ast.If(test=_not_call(self.FLAG),
+                                          body=rest, orelse=[]))
+                    return out, True
+                continue
+            out.append(s)
+        return out, may_return
+
+
+class _BreakContinueRewriter:
+    """break/continue flattening for one loop body (the reference's
+    break_continue_transformer.py): ``break``/``continue`` set a flag,
+    trailing statements are guarded, and the loop test gains
+    ``and not break_flag``."""
+
+    def __init__(self, brk_name, cont_name):
+        self.brk = brk_name
+        self.cont = cont_name
+        self.used_brk = False
+        self.used_cont = False
+
+    def _guard(self):
+        flags = []
+        if self.used_brk:
+            flags.append(self.brk)
+        if self.used_cont:
+            flags.append(self.cont)
+        test = _not_call(flags[0])
+        for f in flags[1:]:
+            test = ast.BoolOp(op=ast.And(),
+                              values=[test, _not_call(f)])
+        return test
+
+    def rewrite(self, stmts):
+        out = []
+        for i, s in enumerate(stmts):
+            if isinstance(s, ast.Break):
+                self.used_brk = True
+                out.append(_assign_name(self.brk, _bool_const(True)))
+                return out, True
+            if isinstance(s, ast.Continue):
+                self.used_cont = True
+                out.append(_assign_name(self.cont, _bool_const(True)))
+                return out, True
+            if isinstance(s, ast.If) and _contains(
+                    [s], (ast.Break, ast.Continue), stop_at_loops=True):
+                s.body, e1 = self.rewrite(s.body)
+                s.orelse, e2 = self.rewrite(s.orelse)
+                out.append(s)
+                if e1 or e2:
+                    rest, _ = self.rewrite(stmts[i + 1:])
+                    if rest:
+                        out.append(ast.If(test=self._guard(), body=rest,
+                                          orelse=[]))
+                    return out, True
+                continue
+            out.append(s)
+        return out, False
+
+
 class _ControlFlowTransformer(ast.NodeTransformer):
-    """Rewrite if/while statements into _dy2s_cond/_dy2s_while calls.
+    """Rewrite if/while/for statements into _dy2s_cond/_dy2s_while calls.
 
     Conservative: statements whose bodies contain constructs the lowering
-    cannot represent (return/break/continue/global/yield) are left as
-    plain Python — they keep working for concrete predicates and raise
-    the original tracer error for traced ones.
+    cannot represent after the return/break/continue flattening passes
+    (return-in-loop, global, yield) are left as plain Python — they keep
+    working for concrete predicates and raise the original tracer error
+    for traced ones.
     """
 
     _uid = 0
@@ -188,7 +443,16 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         else_names, bad2 = _assigned(node.orelse)
         if bad1 or bad2:
             return node
-        outs = sorted(body_names | else_names)
+        # transformer-generated defs/preds are re-created inside the
+        # branch functions on every trace; carrying the function objects
+        # through cond would hand lax non-array leaves
+        outs = sorted(n for n in (body_names | else_names)
+                      if not n.startswith("__dy2s_")
+                      or n in (_ReturnTransformer.FLAG,
+                               _ReturnTransformer.VAL)
+                      or n.startswith("__dy2s_brk")
+                      or n.startswith("__dy2s_cont")
+                      or n.startswith("__dy2s_it"))
         t_name = self._fresh("true")
         f_name = self._fresh("false")
         # branch-assigned names become PARAMETERS defaulted to their
@@ -216,7 +480,9 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             func=ast.Name(id="_dy2s_cond", ctx=ast.Load()),
             args=[ast.Name(id=p_name, ctx=ast.Load()),
                   ast.Name(id=t_name, ctx=ast.Load()),
-                  ast.Name(id=f_name, ctx=ast.Load())],
+                  ast.Name(id=f_name, ctx=ast.Load()),
+                  ast.Tuple(elts=[ast.Constant(value=n) for n in outs],
+                            ctx=ast.Load())],
             keywords=[])
         if outs:
             assign = ast.Assign(
@@ -230,13 +496,46 @@ class _ControlFlowTransformer(ast.NodeTransformer):
 
     # -- while → while_loop ------------------------------------------------
     def visit_While(self, node):
+        # break/continue flattening BEFORE conversion (flags + guards);
+        # must run before generic_visit so nested ifs convert the guarded
+        # form
+        if _contains(node.body, (ast.Break, ast.Continue),
+                     stop_at_loops=True) and not node.orelse:
+            brk = self._fresh("brk")
+            cont = self._fresh("cont")
+            rw = _BreakContinueRewriter(brk, cont)
+            new_body, _ = rw.rewrite(node.body)
+            if rw.used_cont:
+                # continue: per-iteration flag, reset at body start
+                new_body = [_assign_name(cont, _bool_const(False))] + \
+                    new_body
+            pre = []
+            if rw.used_cont:
+                pre.append(_assign_name(cont, _bool_const(False)))
+            if rw.used_brk:
+                pre.append(_assign_name(brk, _bool_const(False)))
+                # test := not brk and <orig test> (lazy rhs)
+                node.test = ast.Call(
+                    func=ast.Name(id="_dy2s_and", ctx=ast.Load()),
+                    args=[_not_call(brk),
+                          ast.Lambda(args=_empty_args(), body=node.test)],
+                    keywords=[])
+            node.body = new_body
+            out = self.visit_While(node)
+            return pre + (out if isinstance(out, list) else [out])
         self.generic_visit(node)
         if node.orelse:
             return node
         carry_names, bad = _assigned(node.body)
         if bad:
             return node
-        carry = sorted(carry_names)
+        carry = sorted(n for n in carry_names
+                       if not n.startswith("__dy2s_")
+                       or n.startswith("__dy2s_brk")
+                       or n.startswith("__dy2s_cont")
+                       or n.startswith("__dy2s_it")
+                       or n in (_ReturnTransformer.FLAG,
+                                _ReturnTransformer.VAL))
         if not carry:
             return node
         c_name = self._fresh("cond")
@@ -261,6 +560,52 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 ctx=ast.Store())],
             value=call)
         return [cond_def, body_def, assign]
+
+    # -- for over range() → while -----------------------------------------
+    def visit_For(self, node):
+        """``for i in range(...)`` (the tensor-bounded loop idiom) becomes
+        an explicit counter while-loop, then converts through
+        visit_While. Other iterables stay plain Python."""
+        if node.orelse or not isinstance(node.target, ast.Name):
+            self.generic_visit(node)
+            return node
+        it = node.iter
+        if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "range" and not it.keywords
+                and 1 <= len(it.args) <= 3):
+            self.generic_visit(node)
+            return node
+        args = it.args
+        start = args[0] if len(args) >= 2 else ast.Constant(value=0)
+        stop = args[1] if len(args) >= 2 else args[0]
+        step = args[2] if len(args) == 3 else ast.Constant(value=1)
+        if isinstance(step, ast.Constant) and isinstance(step.value, int):
+            if step.value == 0:
+                self.generic_visit(node)
+                return node
+            cmp_op = ast.Lt() if step.value > 0 else ast.Gt()
+        else:
+            # unknown step sign: not statically expressible
+            self.generic_visit(node)
+            return node
+        counter = self._fresh("it")
+        stop_n = self._fresh("it_stop")
+        pre = [_assign_name(counter, start), _assign_name(stop_n, stop)]
+        test = ast.Compare(left=ast.Name(id=counter, ctx=ast.Load()),
+                           ops=[cmp_op],
+                           comparators=[ast.Name(id=stop_n,
+                                                 ctx=ast.Load())])
+        # increment BEFORE the user body: a `continue` inside the body
+        # (whose guard wraps everything after it) must still advance the
+        # counter, or the loop spins forever
+        body = [_assign_name(node.target.id,
+                             ast.Name(id=counter, ctx=ast.Load())),
+                _assign_name(counter, ast.BinOp(
+                    left=ast.Name(id=counter, ctx=ast.Load()),
+                    op=ast.Add(), right=step))] + list(node.body)
+        wh = ast.While(test=test, body=body, orelse=[])
+        out = self.visit_While(wh)
+        return pre + (out if isinstance(out, list) else [out])
 
 
 def _capture(n):
@@ -313,6 +658,7 @@ def _transform_code(src_key, filename):
     tree = ast.parse(src_key)
     fn_def = tree.body[0]
     fn_def.decorator_list = []  # don't re-apply to_static on exec
+    _ReturnTransformer().apply(fn_def)  # before control-flow conversion
     new_tree = _ControlFlowTransformer().visit(tree)
     ast.fix_missing_locations(new_tree)
     return compile(new_tree, filename or "<dy2static>", "exec")
@@ -330,6 +676,8 @@ def ast_transform(fn: Callable) -> Callable:
     glb["_dy2s_cond"] = _dy2s_cond
     glb["_dy2s_while"] = _dy2s_while
     glb["_dy2s_get"] = _dy2s_get
+    glb["_dy2s_not"] = _dy2s_not
+    glb["_dy2s_and"] = _dy2s_and
     # rebuild the closure environment as globals (the re-exec'd def has no
     # closure cells; free variables become module-level lookups)
     if fn.__closure__:
@@ -352,9 +700,13 @@ def convert_call_guard(e: BaseException) -> bool:
     """True when a tracing failure is the tensor-dependent-control-flow
     kind the AST fallback can fix. TracerArrayConversionError is included
     because Tensor.__bool__ reaches the tracer via .numpy() (``if t:``
-    surfaces as an array conversion, not a bool conversion)."""
+    surfaces as an array conversion, not a bool conversion); the TypeError
+    is ``range(<traced Tensor>)`` (for-over-tensor-range)."""
     import jax
 
+    if isinstance(e, TypeError) and \
+            "cannot be interpreted as an integer" in str(e):
+        return True
     return isinstance(e, (jax.errors.TracerBoolConversionError,
                           jax.errors.TracerArrayConversionError,
                           jax.errors.ConcretizationTypeError))

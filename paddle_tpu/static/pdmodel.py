@@ -732,6 +732,27 @@ def _log_softmax(jnp, ins, attrs):
                                        axis=attrs.get("axis", -1))]}
 
 
+def _resize_align_corners(jnp, x, oh, ow, method):
+    """align_corners=True resize (src = dst * (in-1)/(out-1)); jax.image
+    .resize is half-pixel-only, so gather explicitly."""
+    h, w = x.shape[2], x.shape[3]
+    ry = jnp.linspace(0.0, h - 1.0, oh)
+    rx = jnp.linspace(0.0, w - 1.0, ow)
+    if method == "nearest":
+        yi = jnp.round(ry).astype(np.int32)
+        xi = jnp.round(rx).astype(np.int32)
+        return x[:, :, yi][:, :, :, xi]
+    y0 = jnp.clip(jnp.floor(ry).astype(np.int32), 0, h - 1)
+    x0 = jnp.clip(jnp.floor(rx).astype(np.int32), 0, w - 1)
+    y1 = jnp.clip(y0 + 1, 0, h - 1)
+    x1 = jnp.clip(x0 + 1, 0, w - 1)
+    wy = (ry - y0)[None, None, :, None]
+    wx = (rx - x0)[None, None, None, :]
+    g = lambda yi, xi: x[:, :, yi][:, :, :, xi]
+    return (g(y0, x0) * (1 - wy) * (1 - wx) + g(y0, x1) * (1 - wy) * wx +
+            g(y1, x0) * wy * (1 - wx) + g(y1, x1) * wy * wx)
+
+
 def _interp(method):
     def run(jnp, ins, attrs):
         import jax
@@ -745,6 +766,15 @@ def _interp(method):
                     f"(pdmodel interop table)")
             s = scale if isinstance(scale, (list, tuple)) else [scale, scale]
             size = [int(x.shape[2] * s[0]), int(x.shape[3] * s[-1])]
+        if attrs.get("align_corners", False):
+            if method not in ("nearest", "bilinear", "linear"):
+                raise NotImplementedError(
+                    f"{method}_interp align_corners=True "
+                    f"(pdmodel interop table)")
+            out = _resize_align_corners(
+                jnp, x, size[0], size[1],
+                "nearest" if method == "nearest" else "bilinear")
+            return {"Out": [out]}
         out = jax.image.resize(x, (x.shape[0], x.shape[1], *size),
                                method=method)
         return {"Out": [out]}
@@ -1136,11 +1166,18 @@ class PdProgram:
         payload, and weight swaps would force recompiles."""
         import jax.numpy as jnp
         tgt = self._serve_dtype(jnp)
-        # key on the identity of every value so both dict replacement and
-        # per-item assignment invalidate (in-place np mutation of an array
-        # is NOT detected — rebind the entry instead)
-        key = (self.precision, tuple(map(id, self.params.values())))
-        if getattr(self, "_param_cache_key", None) != key:
+        # invalidation compares by identity against STRONG references to
+        # the keyed host arrays: holding them pins their ids, so CPython
+        # cannot reuse a freed address and alias an old entry to a new
+        # array. Both dict replacement and per-item rebinding invalidate;
+        # in-place np mutation of an array is NOT detected — rebind the
+        # entry instead.
+        cur = list(self.params.values())
+        cached = getattr(self, "_param_cache_src", None)
+        if (cached is None
+                or getattr(self, "_param_cache_prec", None) != self.precision
+                or len(cached) != len(cur)
+                or any(a is not b for a, b in zip(cached, cur))):
             names = sorted(self.params)
             vals = []
             for n in names:
@@ -1150,7 +1187,8 @@ class PdProgram:
                     a = a.astype(tgt)
                 vals.append(a)
             self._param_cache = (tuple(names), tuple(vals))
-            self._param_cache_key = key
+            self._param_cache_src = cur
+            self._param_cache_prec = self.precision
         return self._param_cache
 
     def _execute(self, feed_arrays, param_names, param_vals):

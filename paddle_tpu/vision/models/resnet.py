@@ -18,7 +18,8 @@ class BasicBlock(nn.Layer):
                  base_width=64, dilation=1, norm_layer=None,
                  data_format="NCHW"):
         super().__init__()
-        norm_layer = norm_layer or nn.BatchNorm2D
+        norm_layer = norm_layer or functools.partial(
+            nn.BatchNorm2D, data_format=data_format)
         Conv = functools.partial(nn.Conv2D, data_format=data_format)
         self.conv1 = Conv(inplanes, planes, 3, padding=1, stride=stride,
                           bias_attr=False)
@@ -45,7 +46,8 @@ class BottleneckBlock(nn.Layer):
                  base_width=64, dilation=1, norm_layer=None,
                  data_format="NCHW"):
         super().__init__()
-        norm_layer = norm_layer or nn.BatchNorm2D
+        norm_layer = norm_layer or functools.partial(
+            nn.BatchNorm2D, data_format=data_format)
         Conv = functools.partial(nn.Conv2D, data_format=data_format)
         width = int(planes * (base_width / 64.0)) * groups
         self.conv1 = Conv(inplanes, width, 1, bias_attr=False)

@@ -173,3 +173,203 @@ class TestAstFallback:
 
         g = ast_transform(f)
         assert g(3) == 6 and g(-3) == -4
+
+
+# ---- round-4 verdict item 6: return / break / continue / for-range ----
+
+class EarlyReturnNet(nn.Layer):
+    """Early return from a tensor-dependent branch (the reference's
+    return_transformer.py case)."""
+
+    def __init__(self):
+        super().__init__()
+        self.lin = nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.lin(x)
+        if h.sum() > 0:
+            return h * 2.0
+        return h - 1.0
+
+
+class BreakNet(nn.Layer):
+    """break out of a tensor-bounded loop (break_continue_transformer)."""
+
+    def __init__(self):
+        super().__init__()
+        self.lin = nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.lin(x)
+        n = paddle.to_tensor(np.float32(0.0))
+        while n < 10.0:
+            h = h * 1.5
+            n = n + 1.0
+            if (h * h).sum() > 50.0:
+                break
+        return h, n
+
+
+class ContinueNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.lin = nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.lin(x)
+        n = paddle.to_tensor(np.float32(0.0))
+        acc = paddle.zeros_like(h)
+        while n < 6.0:
+            n = n + 1.0
+            if n.sum() % 2.0 < 0.5:
+                continue
+            acc = acc + h * n
+        return acc
+
+
+class NestedIfNet(nn.Layer):
+    """Nested tensor-dependent if inside if (round-3 ADVICE: inner
+    rewrites leaked __dy2s_* function objects into the outer carry)."""
+
+    def __init__(self):
+        super().__init__()
+        self.lin = nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.lin(x)
+        if h.sum() > 0:
+            if (h * h).sum() > 10.0:
+                out = h * 3.0
+            else:
+                out = h * 2.0
+        else:
+            out = h - 1.0
+        return out
+
+
+class ForRangeNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.lin = nn.Linear(4, 4)
+
+    def forward(self, x, steps):
+        h = self.lin(x)
+        for i in range(steps):
+            h = h + float(1.0)
+        return h
+
+
+class TestStatementCoverage:
+    def _compare(self, net_cls, eager_fn, xs, atol=1e-5):
+        paddle.seed(0)
+        net = net_cls()
+        for x in xs:
+            want = eager_fn(net, x)
+            snet = paddle.jit.to_static(net_cls())
+            snet.set_state_dict(net.state_dict())
+            got = snet(x)
+            want_t = want if isinstance(want, tuple) else (want,)
+            got_t = got if isinstance(got, tuple) else (got,)
+            for w, g in zip(want_t, got_t):
+                np.testing.assert_allclose(np.asarray(g.numpy()),
+                                           np.asarray(w.numpy()),
+                                           rtol=1e-5, atol=atol)
+
+    def test_early_return(self):
+        def eager(net, x):
+            h = net.lin(x)
+            if float(h.sum().numpy()) > 0:
+                return h * 2.0
+            return h - 1.0
+        rng = np.random.RandomState(0)
+        xs = [paddle.to_tensor(s * np.abs(rng.randn(2, 4))
+                               .astype("float32")) for s in (1.0, -1.0)]
+        self._compare(EarlyReturnNet, eager, xs)
+
+    def test_break(self):
+        def eager(net, x):
+            h = net.lin(x)
+            n = 0.0
+            while n < 10.0:
+                h = h * 1.5
+                n = n + 1.0
+                if float((h * h).sum().numpy()) > 50.0:
+                    break
+            return h, paddle.to_tensor(np.float32(n))
+        rng = np.random.RandomState(1)
+        xs = [paddle.to_tensor(rng.randn(2, 4).astype("float32"))]
+        self._compare(BreakNet, eager, xs)
+
+    def test_continue(self):
+        def eager(net, x):
+            h = net.lin(x)
+            n = 0.0
+            acc = paddle.zeros_like(h)
+            while n < 6.0:
+                n = n + 1.0
+                if n % 2.0 < 0.5:
+                    continue
+                acc = acc + h * n
+            return acc
+        rng = np.random.RandomState(2)
+        xs = [paddle.to_tensor(rng.randn(2, 4).astype("float32"))]
+        self._compare(ContinueNet, eager, xs)
+
+    def test_nested_if(self):
+        def eager(net, x):
+            h = net.lin(x)
+            if float(h.sum().numpy()) > 0:
+                if float((h * h).sum().numpy()) > 10.0:
+                    return h * 3.0
+                return h * 2.0
+            return h - 1.0
+        rng = np.random.RandomState(3)
+        xs = [paddle.to_tensor(s * np.abs(rng.randn(2, 4))
+                               .astype("float32"))
+              for s in (1.0, -1.0, 3.0)]
+        self._compare(NestedIfNet, eager, xs)
+
+    def test_for_range_tensor_bound(self):
+        paddle.seed(0)
+        net = ForRangeNet()
+        x = paddle.to_tensor(
+            np.random.RandomState(4).randn(2, 4).astype("float32"))
+        want = net.lin(x).numpy() + 5.0
+        snet = paddle.jit.to_static(ForRangeNet())
+        snet.set_state_dict(net.state_dict())
+        got = snet(x, paddle.to_tensor(np.int32(5))).numpy()
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5)
+
+
+class ForContinueNet(nn.Layer):
+    """continue inside for-range: the counter increment must advance
+    even on skipped iterations (review regression: infinite loop)."""
+
+    def __init__(self):
+        super().__init__()
+        self.lin = nn.Linear(4, 4)
+
+    def forward(self, x, steps):
+        h = self.lin(x)
+        acc = paddle.zeros_like(h)
+        for i in range(steps):
+            if paddle.to_tensor(np.float32(1.0)) * i % 2.0 < 0.5:
+                continue
+            acc = acc + h
+        return acc
+
+
+class TestForContinue:
+    def test_for_continue_terminates_and_matches(self):
+        paddle.seed(0)
+        net = ForContinueNet()
+        x = paddle.to_tensor(
+            np.random.RandomState(5).randn(2, 4).astype("float32"))
+        # odd i in 0..5 -> 3 additions
+        want = net.lin(x).numpy() * 3.0
+        snet = paddle.jit.to_static(ForContinueNet())
+        snet.set_state_dict(net.state_dict())
+        got = snet(x, paddle.to_tensor(np.int32(6))).numpy()
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5)

@@ -75,26 +75,19 @@ def build_step(paddle, batch, amp, bn_identity=False, fwd_only=False,
             def forward(self, x):
                 return x
 
-        # walk and replace every BatchNorm2D
+        # walk _sub_layers (Layer.__setattr__ stores sublayers there, NOT
+        # in __dict__) and replace every BatchNorm2D
         def walk(layer):
-            for name in list(vars(layer)):
-                sub = getattr(layer, name)
+            subs = getattr(layer, "_sub_layers", {})
+            for name, sub in list(subs.items()):
                 if isinstance(sub, nn.BatchNorm2D):
-                    setattr(layer, name, _Id())
+                    subs[name] = _Id()
                 elif isinstance(sub, nn.Layer):
                     walk(sub)
-                elif isinstance(sub, (list, tuple)):
-                    for s in sub:
-                        if isinstance(s, nn.Layer):
-                            walk(s)
-            from paddle_tpu.nn.layer.container import LayerList, Sequential
-            if isinstance(layer, (LayerList, Sequential)):
-                for i, s in enumerate(layer):
-                    if isinstance(s, nn.BatchNorm2D):
-                        layer[i] = _Id()
-                    elif isinstance(s, nn.Layer):
-                        walk(s)
         walk(model)
+        n_bn = sum(isinstance(m, nn.BatchNorm2D)
+                   for m in model.sublayers())
+        assert n_bn == 0, f"{n_bn} BatchNorm2D layers survived the swap"
 
     rng = np.random.RandomState(0)
     shape = (batch, 224, 224, 3) if nhwc else (batch, 3, 224, 224)
