@@ -193,40 +193,88 @@ def _apply_reduce(arr, op, axis_name):
     raise ValueError(f"unknown reduce op {op}")
 
 
-_device_ar_cache = {}
+_device_ar_cache = {}  # (kind, ...) -> jitted collective
 
 
-def _device_allreduce(arr, op, world):
-    """Eager WORLD all-reduce as a compiled XLA collective over the
-    jax.distributed global device set — data rides ICI/DCN, not the
-    host TCPStore (which remains the control/bootstrap path; round-2
-    verdict weak #4). Every rank calls this in lockstep (standard
-    collective contract), forming one global array with one shard per
-    process and reducing it with a replicated-output jit."""
+def _np_red_fn(op):
+    return {ReduceOp.SUM: jnp.sum, "sum": jnp.sum,
+            ReduceOp.MAX: jnp.max, "max": jnp.max,
+            ReduceOp.MIN: jnp.min, "min": jnp.min,
+            ReduceOp.AVG: jnp.mean, "avg": jnp.mean,
+            ReduceOp.PROD: jnp.prod, "prod": jnp.prod}[op]
+
+
+def _device_eligible(arr_np, group) -> bool:
+    """Whether the eager XLA device path can carry this collective.
+    Decided from WORLD-GLOBAL facts only (every member computes the same
+    branch; a per-rank fallback would desync/deadlock): jax.distributed
+    liveness, the one-device-per-process world shape, and the tensor's
+    dtype/shape — which the collective contract requires to agree across
+    ranks. float64 routes to the host exchange so it reduces in full
+    precision (XLA:TPU has no f64; a silent downcast would give the same
+    call different numerics depending on eligibility)."""
+    return (env.jax_distributed_active()
+            and len(jax.devices()) == env.get_world_size()
+            and arr_np.dtype != np.float64)
+
+
+def _device_collective(kind, arr, group, op=None, src_idx=None):
+    """Eager collective as a compiled XLA operation over the GROUP's
+    device subset (one device per process; the submesh is the group's
+    global ranks) — data rides ICI/DCN, not the host TCPStore (which
+    remains the control/bootstrap path). Every group member calls in
+    lockstep, forming one group-global array with one shard per member:
+
+      ar: reduce over the member axis, replicated out
+      ag: identity, replicated out (each member reads all shards)
+      bc: member src_idx's shard, replicated out
+      rs: reduce over members then re-shard rows back to members
+      a2a: transpose (member, piece) -> (piece, member), sharded out
+    """
     from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-    devs = jax.devices()
+    ranks, idx, tag = _group_info(group)
+    n = len(ranks)
+    devs = [jax.devices()[r] for r in ranks]
     local = arr if isinstance(arr, jax.Array) else jnp.asarray(arr)
-    if local.dtype == jnp.float64:
-        local = local.astype(jnp.float32)
-    mesh = Mesh(np.array(devs[:world]), ("w",))
-    gshape = (world,) + tuple(local.shape)
-    sh = NamedSharding(mesh, PartitionSpec("w"))
+    mesh = Mesh(np.array(devs), ("g",))
+    gshape = (n,) + tuple(local.shape)
+    sh = NamedSharding(mesh, PartitionSpec("g"))
     garr = jax.make_array_from_single_device_arrays(
         gshape, sh, [jax.device_put(local[None], jax.local_devices()[0])])
-    key = (gshape, str(local.dtype), str(op), world)
+    key = (kind, gshape, str(local.dtype), str(op), tag, src_idx)
     fn = _device_ar_cache.get(key)
     if fn is None:
-        red = {ReduceOp.SUM: jnp.sum, "sum": jnp.sum,
-               ReduceOp.MAX: jnp.max, "max": jnp.max,
-               ReduceOp.MIN: jnp.min, "min": jnp.min,
-               ReduceOp.AVG: jnp.mean, "avg": jnp.mean,
-               ReduceOp.PROD: jnp.prod, "prod": jnp.prod}[op]
-        fn = jax.jit(lambda x: red(x, axis=0),
-                     out_shardings=NamedSharding(mesh, PartitionSpec()))
+        rep = NamedSharding(mesh, PartitionSpec())
+        if kind == "ar":
+            red = _np_red_fn(op)
+            fn = jax.jit(lambda x: red(x, axis=0), out_shardings=rep)
+        elif kind == "ag":
+            fn = jax.jit(lambda x: x + 0, out_shardings=rep)
+        elif kind == "bc":
+            fn = jax.jit(lambda x: x[src_idx], out_shardings=rep)
+        elif kind == "rs":
+            red = _np_red_fn(op)
+            chunk = local.shape[0] // n
+
+            def _rs(x):
+                total = red(x, axis=0)
+                return total.reshape((n, chunk) + total.shape[1:])
+            fn = jax.jit(_rs, out_shardings=sh)
+        elif kind == "a2a":
+            fn = jax.jit(lambda x: jnp.swapaxes(x, 0, 1),
+                         out_shardings=sh)
+        else:
+            raise ValueError(kind)
         _device_ar_cache[key] = fn
     out = fn(garr)
-    return jnp.asarray(out.addressable_shards[0].data)
+    shard = jnp.asarray(out.addressable_shards[0].data)
+    if kind in ("ar", "bc"):
+        return shard
+    if kind == "ag":
+        return shard  # replicated (n, ...) — full gather
+    # rs / a2a: my row of the resharded output
+    return shard[0]
 
 
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
@@ -242,25 +290,17 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     n = group.nranks if group else env.get_world_size()
     if n <= 1:
         return Task(tensor._data if isinstance(tensor, Tensor) else tensor)
-    world = env.get_world_size()
-    # Eligibility must be decided from WORLD-GLOBAL facts only (every rank
-    # computes the same branch) — a per-rank try/except fallback would
-    # leave peers blocked inside the compiled collective while one rank
-    # silently switched to the host exchange (desync/deadlock). The exact
-    # one-device-per-process requirement (==, not >=) keeps devs[:world]
-    # aligned with process ranks; multi-device-per-process worlds would
-    # place host-1's shard on a host-0 device and error on one rank only.
-    if env.jax_distributed_active() and n == world \
-            and len(jax.devices()) == world:
-        out = _device_allreduce(_unwrap_np(tensor), op, world)
+    arr = _unwrap_np(tensor)
+    if _device_eligible(arr, group):
+        out = _device_collective("ar", arr, group, op=op)
         if isinstance(tensor, Tensor):
             tensor._data = out.astype(tensor._data.dtype)
             return Task(tensor._data)
         return Task(out)
-    vals = _exchange("ar", _unwrap_np(tensor), group)
+    vals = _exchange("ar", arr, group)
     _check_consistent("ar", vals, _group_info(group)[0])
     out = _np_reduce(np.stack(vals), op)
-    tensor._data = jnp.asarray(out.astype(_unwrap_np(tensor).dtype))
+    tensor._data = jnp.asarray(out.astype(arr.dtype))
     return Task(tensor._data)
 
 
@@ -278,7 +318,12 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
     if n <= 1:
         tensor_list.append(tensor)
         return Task()
-    vals = _exchange("ag", _unwrap_np(tensor), group)
+    arr = _unwrap_np(tensor)
+    if _device_eligible(arr, group):
+        full = _device_collective("ag", arr, group)
+        tensor_list.extend(Tensor(full[i]) for i in range(n))
+        return Task()
+    vals = _exchange("ag", arr, group)
     _check_consistent("ag", vals, _group_info(group)[0])
     tensor_list.extend(Tensor(jnp.asarray(v)) for v in vals)
     return Task()
@@ -316,12 +361,18 @@ def broadcast(tensor, src, group=None, sync_op=True):
     n = group.nranks if group else env.get_world_size()
     if n <= 1:
         return Task()
+    arr = _unwrap_np(tensor)
+    src_idx = _root_index(group, src)
+    if _device_eligible(arr, group):
+        out = _device_collective("bc", arr, group, src_idx=src_idx)
+        tensor._data = out.astype(tensor._data.dtype) \
+            if isinstance(tensor, Tensor) else out
+        return Task(tensor._data)
     store = _require_store()
     ranks, idx, tag = _group_info(group)
-    src_idx = _root_index(group, src)
     key = _ckey(tag, "bc")
     if idx == src_idx:
-        store.set(key, _dumps(_unwrap_np(tensor)))
+        store.set(key, _dumps(arr))
     tensor._data = jnp.asarray(_loads(store.wait(key, _TIMEOUT)))
     _gc_keys(store, key, [key], len(ranks))
     return Task(tensor._data)
@@ -367,8 +418,12 @@ def reduce_scatter(tensor, tensor_list_or_input, op=ReduceOp.SUM, group=None,
     if n <= 1:
         tensor._data = inp._data if isinstance(inp, Tensor) else inp
         return Task()
+    arr = _unwrap_np(inp)
+    if arr.shape[0] % n == 0 and _device_eligible(arr, group):
+        tensor._data = _device_collective("rs", arr, group, op=op)
+        return Task(tensor._data)
     ranks, idx, _ = _group_info(group)
-    vals = _exchange("rs", _unwrap_np(inp), group)
+    vals = _exchange("rs", arr, group)
     _check_consistent("rs", vals, ranks)
     total = _np_reduce(np.stack(vals), op)
     chunk = total.shape[0] // len(ranks)
@@ -392,6 +447,10 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
         out_tensor_list.extend(in_tensor_list)
         return Task()
     stacked = np.stack([_unwrap_np(t) for t in in_tensor_list])
+    if _device_eligible(stacked, group):
+        mine = _device_collective("a2a", stacked, group)
+        out_tensor_list.extend(Tensor(mine[i]) for i in range(n))
+        return Task()
     ranks, idx, _ = _group_info(group)
     vals = _exchange("a2a", stacked, group)
     _check_consistent("a2a", vals, ranks)
@@ -424,6 +483,12 @@ def all_to_all_single(out_tensor, in_tensor, out_split_sizes=None,
         raise ValueError(
             f"all_to_all_single dim 0 ({arr.shape[0]}) must divide the "
             f"group size ({len(ranks)})")
+    if _device_eligible(arr, group):
+        chunk = arr.shape[0] // len(ranks)
+        stacked = arr.reshape((len(ranks), chunk) + arr.shape[1:])
+        mine = _device_collective("a2a", stacked, group)
+        out_tensor._data = mine.reshape((-1,) + tuple(arr.shape[1:]))
+        return Task(out_tensor._data)
     vals = _exchange("a2as", arr, group)
     chunk = vals[0].shape[0] // len(ranks)
     out_tensor._data = jnp.asarray(np.concatenate(

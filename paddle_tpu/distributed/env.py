@@ -120,12 +120,14 @@ def init_parallel_env():
             # multi-host steps (cross-host reshard is unsupported)
             jax.config.update("jax_default_device", jax.local_devices()[0])
         if int(os.environ.get("PADDLE_ELASTIC_LEVEL", "0") or 0) > 0:
-            _start_heartbeat(_store, rank)
+            _start_heartbeat(_store, rank,
+                             rendezvous=(host or "127.0.0.1",
+                                         int(port or 0)))
         _initialized = True
     return ParallelEnv()
 
 
-def _start_heartbeat(store, rank):
+def _start_heartbeat(store, rank, rendezvous=None):
     """Elastic fault DETECTION, worker half (reference: ElasticManager's
     etcd heartbeat, fleet/elastic/manager.py:126): a daemon thread bumps
     ``hb/<rank>`` every interval, preferably in the LAUNCHER-owned
@@ -145,7 +147,18 @@ def _start_heartbeat(store, rank):
             store = TCPStore(host=host or "127.0.0.1", port=int(port),
                              is_master=False, timeout=10.0)
         except Exception:
-            pass  # fall back to the rendezvous store (may be None)
+            store = None  # fall through to a dedicated rendezvous client
+    if (hb_ep is None or store is None) and rendezvous is not None:
+        # open a DEDICATED connection for the heartbeat thread: the main
+        # thread's client has one unsynchronized socket, and interleaved
+        # set()/wait() framing from two threads corrupts the protocol
+        # (round-3 advisor finding)
+        try:
+            from ..native.tcp_store import TCPStore
+            store = TCPStore(host=rendezvous[0], port=rendezvous[1],
+                             is_master=False, timeout=10.0)
+        except Exception:
+            pass  # last resort: the shared client (single-threaded risk)
     if store is None:
         return
     interval = float(os.environ.get(

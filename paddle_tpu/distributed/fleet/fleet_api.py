@@ -65,13 +65,65 @@ def distributed_model(model):
     return model
 
 
+def _apply_meta_optimizers(optimizer, strategy):
+    """Algorithm toggles with a real implementation are applied; ones
+    without one WARN loudly (reference meta-optimizer zoo,
+    python/paddle/distributed/fleet/meta_optimizers/)."""
+    if strategy is None:
+        return optimizer
+    import warnings
+
+    if getattr(strategy, "lars", False):
+        from ...optimizer import LarsMomentum, Momentum
+        if isinstance(optimizer, LarsMomentum):
+            pass
+        elif isinstance(optimizer, Momentum):
+            cfg = strategy.lars_configs or {}
+            if getattr(optimizer, "_nesterov", False):
+                warnings.warn(
+                    "strategy.lars replaces Momentum with LarsMomentum, "
+                    "which has no Nesterov variant (reference "
+                    "lars_momentum op) — use_nesterov is dropped")
+            if getattr(optimizer, "_l2_coeff", 0.0) or \
+                    getattr(optimizer, "_wd_obj", None) is not None:
+                warnings.warn(
+                    "strategy.lars supersedes the inner Momentum's "
+                    "weight_decay with lars_configs['lars_weight_decay'] "
+                    "(the LARS trust ratio folds decay into local_lr)")
+            optimizer = LarsMomentum(
+                learning_rate=optimizer._lr,
+                momentum=optimizer._momentum,
+                lars_coeff=cfg.get("lars_coeff", 0.001),
+                lars_weight_decay=cfg.get("lars_weight_decay", 0.0005),
+                parameters=optimizer._parameters,
+                grad_clip=optimizer._grad_clip,
+                exclude_from_weight_decay=cfg.get(
+                    "exclude_from_weight_decay", []),
+                epsilon=cfg.get("epsilon", 0.0),
+                rescale_grad=getattr(optimizer, "_rescale", 1.0))
+        else:
+            warnings.warn(
+                "DistributedStrategy.lars applies to a Momentum "
+                f"optimizer (reference lars_optimizer.py contract); got "
+                f"{type(optimizer).__name__} — running it unchanged")
+    for toggle in ("dgc", "localsgd", "adaptive_localsgd"):
+        if getattr(strategy, toggle, False):
+            warnings.warn(
+                f"DistributedStrategy.{toggle} is accepted but INERT in "
+                f"paddle_tpu: gradient compression / local-SGD step "
+                f"skipping has no implementation here (gradients ride "
+                f"XLA collectives at full precision every step)")
+    return optimizer
+
+
 def distributed_optimizer(optimizer, strategy=None):
+    strategy = strategy or _fleet_state["strategy"]
+    optimizer = _apply_meta_optimizers(optimizer, strategy)
     hcg = _fleet_state["hcg"]
     if hcg is None:
         return optimizer
     from .meta_parallel.hybrid_optimizer import HybridParallelOptimizer
-    return HybridParallelOptimizer(optimizer, hcg,
-                                   strategy or _fleet_state["strategy"])
+    return HybridParallelOptimizer(optimizer, hcg, strategy)
 
 
 def worker_index():

@@ -52,7 +52,18 @@ class Generator:
             self._key = jax.random.wrap_key_data(np.asarray(key_data))
 
 
-_default_generator = Generator(seed=np.random.randint(0, 2**31 - 1))
+# LAZY: jax.random.key touches the device backend, and `import paddle_tpu`
+# must not (launcher/tooling processes import the package without ever
+# running an op; an unreachable accelerator would hang them at import)
+_default_generator = None
+
+
+def _default():
+    global _default_generator
+    if _default_generator is None:
+        _default_generator = Generator(
+            seed=np.random.randint(0, 2 ** 31 - 1))
+    return _default_generator
 
 # When tracing a whole training step (paddle_tpu.jit.TrainStep), random ops
 # must derive keys from a per-call traced base key instead of host state, so
@@ -77,12 +88,11 @@ class traced_key_scope:
 
 def seed(s: int) -> Generator:
     """paddle.seed equivalent: reseed the global generator."""
-    _default_generator.manual_seed(s)
-    return _default_generator
+    return _default().manual_seed(s)
 
 
 def default_generator() -> Generator:
-    return _default_generator
+    return _default()
 
 
 def next_key():
@@ -90,12 +100,12 @@ def next_key():
     if st is not None:
         st["counter"] += 1
         return jax.random.fold_in(st["base"], st["counter"])
-    return _default_generator.next_key()
+    return _default().next_key()
 
 
 def get_rng_state():
-    return [_default_generator.get_state()]
+    return [_default().get_state()]
 
 
 def set_rng_state(states):
-    _default_generator.set_state(states[0])
+    _default().set_state(states[0])

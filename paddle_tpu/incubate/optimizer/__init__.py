@@ -173,3 +173,7 @@ class ModelAverage:
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
         self.step()
+
+
+# reference compat: paddle.incubate.optimizer.LarsMomentumOptimizer
+from ...optimizer import LarsMomentum as LarsMomentumOptimizer  # noqa: F401,E402

@@ -114,6 +114,37 @@ def _dy2s_not(x):
     return not bool(np.asarray(p).item())
 
 
+def _dy2s_int(v):
+    """range()-argument semantics for the for→while rewrite: concrete
+    values must be integers (float args raise TypeError exactly like
+    ``range`` would — the rewrite must not silently run a loop eager
+    Python rejects); traced values pass through, requiring an integer
+    dtype."""
+    if _is_traced(v):
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+        arr = v._data if isinstance(v, Tensor) else v
+        if not jnp.issubdtype(arr.dtype, jnp.integer):
+            raise TypeError(
+                f"'{arr.dtype}' tensor cannot be interpreted as an "
+                f"integer (range bound)")
+        return v
+    import operator
+
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    if isinstance(v, Tensor):
+        arr = np.asarray(v._data)
+        if not np.issubdtype(arr.dtype, np.integer):
+            raise TypeError(
+                f"'{arr.dtype}' tensor cannot be interpreted as an "
+                f"integer (range bound)")
+        return int(arr.item())
+    return operator.index(v)
+
+
 def _dy2s_and(a, b_thunk):
     """Short-circuit ``a and b()`` for concrete ``a``; logical_and of both
     for traced (loop-guard composition: the rewritten test is pure)."""
@@ -149,7 +180,10 @@ def _dy2s_cond(pred, true_fn, false_fn, names=None):
     from ..static import nn as static_nn
     try:
         return static_nn.cond(pred, true_fn, false_fn)
-    except (TypeError, ValueError):
+    except (TypeError, ValueError, UnboundLocalError):
+        # UnboundLocalError: the cond wrapper touched a _UNDEF sentinel
+        # structurally (e.g. unwrapping ._data); a GENUINE use-before-
+        # assign still raises from inside true_fn()/false_fn() below
         t_out = true_fn()
         f_out = false_fn()
         single = not isinstance(t_out, tuple)
@@ -168,10 +202,11 @@ def _dy2s_cond(pred, true_fn, false_fn, names=None):
             if t_undef or f_undef:
                 name = names[i] if names and i < len(names) else ""
                 if not str(name).startswith("__dy2s_"):
-                    raise UnboundLocalError(
-                        f"dy2static: {name or 'a variable'} is bound on "
-                        f"only one branch of a tensor-dependent if — "
-                        f"assign it on both paths (or before the if)")
+                    # user name bound on one path only: bind the sentinel
+                    # — harmless if never read again (e.g. a local inside
+                    # a return-guard block), honest UnboundLocalError at
+                    # the first later USE
+                    return _UNDEF
                 return f if t_undef else t
             ta = t._data if isinstance(t, Tensor) else t
             fa = f._data if isinstance(f, Tensor) else f
@@ -590,7 +625,12 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             return node
         counter = self._fresh("it")
         stop_n = self._fresh("it_stop")
-        pre = [_assign_name(counter, start), _assign_name(stop_n, stop)]
+
+        def _as_int(expr):
+            return ast.Call(func=ast.Name(id="_dy2s_int", ctx=ast.Load()),
+                            args=[expr], keywords=[])
+        pre = [_assign_name(counter, _as_int(start)),
+               _assign_name(stop_n, _as_int(stop))]
         test = ast.Compare(left=ast.Name(id=counter, ctx=ast.Load()),
                            ops=[cmp_op],
                            comparators=[ast.Name(id=stop_n,
@@ -678,6 +718,7 @@ def ast_transform(fn: Callable) -> Callable:
     glb["_dy2s_get"] = _dy2s_get
     glb["_dy2s_not"] = _dy2s_not
     glb["_dy2s_and"] = _dy2s_and
+    glb["_dy2s_int"] = _dy2s_int
     # rebuild the closure environment as globals (the re-exec'd def has no
     # closure cells; free variables become module-level lookups)
     if fn.__closure__:

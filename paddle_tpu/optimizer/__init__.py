@@ -1,5 +1,8 @@
 from . import lr  # noqa: F401
 from .optimizer import (  # noqa: F401
-    SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum, Optimizer,
-    RMSProp,
+    SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, LarsMomentum,
+    Momentum, Optimizer, RMSProp,
 )
+
+# reference compat name (fluid/optimizer.py:1786)
+LarsMomentumOptimizer = LarsMomentum

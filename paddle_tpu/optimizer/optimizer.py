@@ -237,6 +237,53 @@ class Momentum(Optimizer):
         return p_new, {"velocity": v_new}
 
 
+class LarsMomentum(Optimizer):
+    """Layer-wise Adaptive Rate Scaling momentum (reference:
+    /root/reference/python/paddle/fluid/optimizer.py:1786
+    LarsMomentumOptimizer):
+
+        local_lr = lr * lars_coeff * ||p|| / (||g|| + wd * ||p|| + eps)
+        v        = mu * v + local_lr * (g + wd * p)
+        p        = p - v
+
+    The trust ratio falls back to the plain lr when either norm is zero
+    (the kernel's guard for freshly-initialized or frozen layers)."""
+
+    _accum_names = ["velocity"]
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay=None, epsilon=0.0,
+                 multi_precision=False, rescale_grad=1.0, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._momentum = momentum
+        self._coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._exclude = list(exclude_from_weight_decay or [])
+        self._eps = epsilon
+        self._rescale = rescale_grad
+
+    def _wd_for(self, p) -> float:
+        pname = getattr(p, "name", "") or ""
+        if any(tag in pname for tag in self._exclude):
+            return 0.0
+        return self._lars_wd
+
+    def _update_rule(self, p, g, lr, t, wd, state):
+        g = g * self._rescale
+        lr = lr.astype(jnp.float32)
+        wd = wd.astype(jnp.float32)
+        p_norm = jnp.sqrt(jnp.sum(jnp.square(p.astype(jnp.float32))))
+        g_norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+        local_lr = jnp.where(
+            (p_norm > 0) & (g_norm > 0),
+            lr * self._coeff * p_norm / (g_norm + wd * p_norm + self._eps),
+            lr)
+        v = self._momentum * state["velocity"] + \
+            local_lr.astype(p.dtype) * (g + wd.astype(p.dtype) * p)
+        return p - v, {"velocity": v}
+
+
 class Adam(Optimizer):
     _accum_names = ["moment1", "moment2"]
 

@@ -739,8 +739,10 @@ def _resize_align_corners(jnp, x, oh, ow, method):
     ry = jnp.linspace(0.0, h - 1.0, oh)
     rx = jnp.linspace(0.0, w - 1.0, ow)
     if method == "nearest":
-        yi = jnp.round(ry).astype(np.int32)
-        xi = jnp.round(rx).astype(np.int32)
+        # reference kernel rounds half UP (static_cast<int>(v + 0.5)),
+        # not half-to-even
+        yi = jnp.floor(ry + 0.5).astype(np.int32)
+        xi = jnp.floor(rx + 0.5).astype(np.int32)
         return x[:, :, yi][:, :, :, xi]
     y0 = jnp.clip(jnp.floor(ry).astype(np.int32), 0, h - 1)
     x0 = jnp.clip(jnp.floor(rx).astype(np.int32), 0, w - 1)
@@ -749,8 +751,9 @@ def _resize_align_corners(jnp, x, oh, ow, method):
     wy = (ry - y0)[None, None, :, None]
     wx = (rx - x0)[None, None, None, :]
     g = lambda yi, xi: x[:, :, yi][:, :, :, xi]
-    return (g(y0, x0) * (1 - wy) * (1 - wx) + g(y0, x1) * (1 - wy) * wx +
-            g(y1, x0) * wy * (1 - wx) + g(y1, x1) * wy * wx)
+    out = (g(y0, x0) * (1 - wy) * (1 - wx) + g(y0, x1) * (1 - wy) * wx +
+           g(y1, x0) * wy * (1 - wx) + g(y1, x1) * wy * wx)
+    return out.astype(x.dtype)  # f32 weights must not upcast bf16 serving
 
 
 def _interp(method):

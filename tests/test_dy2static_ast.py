@@ -373,3 +373,59 @@ class TestForContinue:
         got = snet(x, paddle.to_tensor(np.int32(6))).numpy()
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=1e-5)
+
+
+class ReturnThenBindNet(nn.Layer):
+    """Early return followed by trailing code that BINDS a local (the
+    guard-if carries it one-sided; review regression)."""
+
+    def __init__(self):
+        super().__init__()
+        self.lin = nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.lin(x)
+        if h.sum() > 0:
+            return h * 2.0
+        y = h + 1.0
+        z = y * 3.0
+        return z
+
+
+class RangeFloatNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.lin = nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.lin(x)
+        for i in range(h.sum()):  # float bound: must raise like range()
+            h = h + 1.0
+        return h
+
+
+class TestReviewRegressions2:
+    def test_return_then_local_binding(self):
+        paddle.seed(0)
+        net = ReturnThenBindNet()
+        rng = np.random.RandomState(0)
+        for s in (1.0, -1.0):
+            x = paddle.to_tensor(s * np.abs(rng.randn(2, 4))
+                                 .astype("float32"))
+            h = net.lin(x)
+            want = (h * 2.0 if float(h.sum().numpy()) > 0
+                    else (h + 1.0) * 3.0).numpy()
+            snet = paddle.jit.to_static(ReturnThenBindNet())
+            snet.set_state_dict(net.state_dict())
+            got = snet(x).numpy()
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       rtol=1e-5)
+
+    def test_range_float_bound_raises(self):
+        import pytest
+        paddle.seed(0)
+        snet = paddle.jit.to_static(RangeFloatNet())
+        x = paddle.to_tensor(
+            np.abs(np.random.RandomState(0).randn(2, 4)).astype("float32"))
+        with pytest.raises(TypeError, match="integer"):
+            snet(x)
