@@ -250,7 +250,9 @@ def _device_collective(kind, arr, group, op=None, src_idx=None):
             red = _np_red_fn(op)
             fn = jax.jit(lambda x: red(x, axis=0), out_shardings=rep)
         elif kind == "ag":
-            fn = jax.jit(lambda x: x + 0, out_shardings=rep)
+            # identity; out_shardings=replicated is what inserts the
+            # gather (x + 0 would promote bool to int32)
+            fn = jax.jit(lambda x: x, out_shardings=rep)
         elif kind == "bc":
             fn = jax.jit(lambda x: x[src_idx], out_shardings=rep)
         elif kind == "rs":

@@ -139,6 +139,8 @@ def _start_heartbeat(store, rank, rendezvous=None):
     import threading
     import time as _time
 
+    shared = store  # the main thread's client — true last resort only
+    store = None
     hb_ep = os.environ.get("PADDLE_ELASTIC_HB_ENDPOINT")
     if hb_ep:
         try:
@@ -148,7 +150,7 @@ def _start_heartbeat(store, rank, rendezvous=None):
                              is_master=False, timeout=10.0)
         except Exception:
             store = None  # fall through to a dedicated rendezvous client
-    if (hb_ep is None or store is None) and rendezvous is not None:
+    if store is None and rendezvous is not None:
         # open a DEDICATED connection for the heartbeat thread: the main
         # thread's client has one unsynchronized socket, and interleaved
         # set()/wait() framing from two threads corrupts the protocol
@@ -158,7 +160,9 @@ def _start_heartbeat(store, rank, rendezvous=None):
             store = TCPStore(host=rendezvous[0], port=rendezvous[1],
                              is_master=False, timeout=10.0)
         except Exception:
-            pass  # last resort: the shared client (single-threaded risk)
+            store = None
+    if store is None:
+        store = shared  # single-socket risk beats no heartbeat at all
     if store is None:
         return
     interval = float(os.environ.get(

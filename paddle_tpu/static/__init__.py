@@ -382,3 +382,5 @@ def name_scope(prefix=None):
     def _g():
         yield
     return _g()
+
+from . import quantization  # noqa: F401,E402  (static PTQ pipeline)

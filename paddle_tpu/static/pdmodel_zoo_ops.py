@@ -748,6 +748,91 @@ def _sigmoid_cross_entropy_with_logits(jnp, ins, attrs):
     return {"Out": [loss]}
 
 
+# -------------------------------------------------- quantization ops
+# (reference: paddle/fluid/operators/quantize_linear_op.cc and the
+# fake_quantize family in fake_quantize_op.cc — what static PTQ/QAT
+# exports contain)
+
+def _qscale_shape(scale, x, axis):
+    if scale.ndim == 0 or scale.size == 1:
+        return scale.reshape(())
+    shape = [1] * x.ndim
+    shape[axis] = scale.shape[0]
+    return scale.reshape(shape)
+
+
+def _quantize_linear(jnp, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("quant_axis", -1)
+    scale = _qscale_shape(ins["Scale"][0], x, axis if axis >= 0 else 0)
+    zp = _qscale_shape(ins["ZeroPoint"][0], x, axis if axis >= 0 else 0) \
+        if ins.get("ZeroPoint") else 0
+    bits = attrs.get("bit_length", 8)
+    qmax = 2 ** (bits - 1) - 1
+    y = jnp.clip(jnp.round(x / scale), -qmax - 1, qmax)
+    return {"Y": [y + zp]}
+
+
+def _dequantize_linear(jnp, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("quant_axis", -1)
+    scale = _qscale_shape(ins["Scale"][0], x, axis if axis >= 0 else 0)
+    zp = _qscale_shape(ins["ZeroPoint"][0], x, axis if axis >= 0 else 0) \
+        if ins.get("ZeroPoint") else 0
+    xf = (x.astype(scale.dtype) - zp)
+    return {"Y": [xf * scale]}
+
+
+def _fake_qdq(jnp, ins, attrs):
+    """fake_quantize_dequantize_abs_max: quantize-then-dequantize with
+    the tensor's own absmax (per-run scale)."""
+    x = ins["X"][0]
+    bits = attrs.get("bit_length", 8)
+    qmax = 2 ** (bits - 1) - 1
+    scale = jnp.max(jnp.abs(x))
+    y = jnp.round(x / scale * qmax) * scale / qmax
+    return {"Out": [y], "OutScale": [scale.reshape(())]}
+
+
+def _fake_qdq_moving(jnp, ins, attrs):
+    x = ins["X"][0]
+    bits = attrs.get("bit_length", 8)
+    qmax = 2 ** (bits - 1) - 1
+    scale = ins["InScale"][0].reshape(())
+    y = jnp.clip(jnp.round(x / scale * qmax), -qmax - 1, qmax) * \
+        scale / qmax
+    return {"Out": [y], "OutScale": [scale]}
+
+
+def _fake_channel_qdq(jnp, ins, attrs):
+    x = ins["X"][0]
+    bits = attrs.get("bit_length", 8)
+    qmax = 2 ** (bits - 1) - 1
+    axis = attrs.get("quant_axis", 0)
+    scale = jnp.max(jnp.abs(x), axis=tuple(
+        i for i in range(x.ndim) if i != axis), keepdims=True)
+    y = jnp.round(x / scale * qmax) * scale / qmax
+    return {"Out": [y], "OutScale": [scale.reshape(-1)]}
+
+
+def _fake_dequant_max_abs(jnp, ins, attrs):
+    x = ins["X"][0]
+    scale = ins["Scale"][0].reshape(())
+    max_range = attrs.get("max_range", 127.0)
+    return {"Out": [x.astype(scale.dtype) * scale / max_range]}
+
+
+def _fake_channel_dequant(jnp, ins, attrs):
+    x = ins["X"][0]
+    scales = ins["Scales"]
+    axis = attrs.get("quant_axis", 0)
+    s = _qscale_shape(scales[0], x, axis)
+    out = x.astype(scales[0].dtype) * s / 127.0
+    if len(scales) > 1:  # second-level (activation) scale
+        out = out * scales[1].reshape(()) / 127.0
+    return {"Out": [out]}
+
+
 def _register():
     C = _CONVERTERS
     C["fused_attention"] = _fused_attention
@@ -818,6 +903,15 @@ def _register():
     C["softmax_with_cross_entropy"] = _softmax_with_cross_entropy
     C["sigmoid_cross_entropy_with_logits"] = \
         _sigmoid_cross_entropy_with_logits
+    # quantization family
+    C["quantize_linear"] = _quantize_linear
+    C["dequantize_linear"] = _dequantize_linear
+    C["fake_quantize_dequantize_abs_max"] = _fake_qdq
+    C["fake_quantize_dequantize_moving_average_abs_max"] = \
+        _fake_qdq_moving
+    C["fake_channel_wise_quantize_dequantize_abs_max"] = _fake_channel_qdq
+    C["fake_dequantize_max_abs"] = _fake_dequant_max_abs
+    C["fake_channel_wise_dequantize_max_abs"] = _fake_channel_dequant
 
 
 _register()

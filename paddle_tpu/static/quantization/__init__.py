@@ -1,0 +1,351 @@
+"""Static-graph post-training quantization (round-4 verdict item 8).
+
+Reference: /root/reference/python/paddle/static/quantization/
+post_training_quantization.py — PTQ loads an inference ProgramDesc, feeds
+calibration batches to collect activation ranges, quantizes weights, and
+saves a deployable quantized program.
+
+TPU-native design: the program is the parsed desc dict (static/pdmodel.py)
+rather than a C++ graph; calibration replays it EAGERLY with per-op
+observers; the rewrite inserts ONNX-format ``quantize_linear`` /
+``dequantize_linear`` pairs (the modern reference export,
+quantize_linear_op.cc) with int8 channel-wise weights stored in the
+.pdiparams stream — the artifact serves through this repo's Predictor
+(whose converter table executes the quant ops) and is consumable by
+paddle2onnx-style toolchains.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..pdmodel import (PROTO_DTYPES, PdProgram, _CONVERTERS,
+                       parse_combined_params, parse_program_desc)
+from ..pdmodel_export import serialize_params, serialize_program_desc
+
+__all__ = ["PostTrainingQuantization", "quant_post_static"]
+
+# ops whose float inputs get activation observers + weight quantization
+_DEFAULT_QUANTIZABLE = ["matmul_v2", "matmul", "mul", "conv2d",
+                        "depthwise_conv2d", "fc"]
+
+# weight input slot + channel axis per op type (OIHW convs quantize per
+# output channel 0; matmul weights per column)
+_WEIGHT_SLOT = {"matmul_v2": ("Y", 1), "matmul": ("Y", 1), "mul": ("Y", 1),
+                "conv2d": ("Filter", 0), "depthwise_conv2d": ("Filter", 0),
+                "fc": ("W", 1)}
+_ACT_SLOT = {"matmul_v2": "X", "matmul": "X", "mul": "X", "conv2d": "Input",
+             "depthwise_conv2d": "Input", "fc": "Input"}
+
+
+class _Observer:
+    """Running activation-range statistics for one tensor."""
+
+    def __init__(self, algo, hist_percent):
+        self.algo = algo
+        self.hist_percent = hist_percent
+        self.absmaxes = []
+        self.samples = []
+
+    def collect(self, arr):
+        a = np.abs(np.asarray(arr, np.float32))
+        self.absmaxes.append(float(a.max()))
+        if self.algo == "hist":
+            # subsample magnitudes for the percentile estimate
+            flat = a.reshape(-1)
+            if flat.size > 4096:
+                idx = np.linspace(0, flat.size - 1, 4096).astype(np.int64)
+                flat = np.sort(flat)[idx]
+            self.samples.append(flat)
+
+    def scale(self) -> float:
+        if not self.absmaxes:
+            raise RuntimeError("observer saw no calibration data")
+        if self.algo in ("abs_max", "min_max"):
+            s = max(self.absmaxes)
+        elif self.algo == "avg":
+            s = float(np.mean(self.absmaxes))
+        elif self.algo == "hist":
+            s = float(np.quantile(np.concatenate(self.samples),
+                                  self.hist_percent))
+        else:
+            raise ValueError(f"unsupported PTQ algo {self.algo!r} "
+                             f"(abs_max | min_max | avg | hist)")
+        return s if s > 0 else 1e-8
+
+
+class PostTrainingQuantization:
+    """Reference-shaped PTQ driver (post_training_quantization.py:117).
+
+    ``data_loader`` yields feed dicts (or lists matching feed order);
+    ``quantize()`` calibrates and rewrites; ``save_quantized_model(path)``
+    writes the quantized .pdmodel/.pdiparams pair."""
+
+    def __init__(self, executor=None, model_dir=None, model_filename=None,
+                 params_filename=None, data_loader=None,
+                 sample_generator=None, batch_nums=8, algo="abs_max",
+                 hist_percent=0.99999, quantizable_op_type=None,
+                 weight_bits=8, activation_bits=8, skip_tensor_list=None,
+                 onnx_format=True, **kwargs):
+        import os
+
+        prefix = model_dir or ""
+        if model_filename:
+            model_path = os.path.join(prefix, model_filename)
+        elif os.path.exists(prefix + ".pdmodel"):
+            model_path = prefix + ".pdmodel"
+        else:
+            cands = [f for f in os.listdir(prefix)
+                     if f.endswith(".pdmodel")]
+            if not cands:
+                raise FileNotFoundError(
+                    f"no .pdmodel under {prefix!r}")
+            model_path = os.path.join(prefix, sorted(cands)[0])
+        if params_filename:
+            params_path = os.path.join(prefix, params_filename)
+        elif model_path.endswith(".pdmodel"):
+            params_path = model_path[:-len(".pdmodel")] + ".pdiparams"
+        else:
+            raise ValueError(
+                f"cannot derive the params file from "
+                f"{model_path!r}; pass params_filename")
+        with open(model_path, "rb") as f:
+            self._desc = parse_program_desc(f.read())
+        self._prog = PdProgram(self._desc)
+        with open(params_path, "rb") as f:
+            self._params = parse_combined_params(
+                f.read(), self._prog.persistable_names())
+        self._prog.params = dict(self._params)
+        self._loader = data_loader or sample_generator
+        if self._loader is None:
+            raise ValueError("PTQ needs data_loader/sample_generator "
+                             "yielding calibration feeds")
+        self._batch_nums = batch_nums
+        self._algo = algo
+        self._hist = hist_percent
+        self._qops = list(quantizable_op_type or _DEFAULT_QUANTIZABLE)
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._skip = set(skip_tensor_list or [])
+        self._quantized_desc = None
+        self._quantized_params = None
+
+    # ---- calibration ------------------------------------------------
+    def _calibrate(self):
+        """Eager instrumented replay: run each calibration batch through
+        the op list, feeding observers with every quantizable op's
+        activation input."""
+        import jax.numpy as jnp
+
+        from ...ops import registry
+
+        observers = {}  # activation var name -> _Observer
+        block = self._desc["blocks"][0]
+        for op in block["ops"]:
+            if op["type"] in self._qops:
+                slot = _ACT_SLOT.get(op["type"])
+                args = op["inputs"].get(slot, [])
+                if args and args[0] not in self._params \
+                        and args[0] not in self._skip:
+                    observers.setdefault(
+                        args[0], _Observer(self._algo, self._hist))
+
+        n = 0
+        for batch in self._loader() if callable(self._loader) \
+                else self._loader:
+            if n >= self._batch_nums:
+                break
+            if isinstance(batch, dict):
+                feed = batch
+            else:
+                feed = dict(zip(self._prog.feed_names, batch))
+            values = {name: jnp.asarray(arr)
+                      for name, arr in self._params.items()}
+            for name in self._prog.feed_names:
+                values[name] = jnp.asarray(np.asarray(feed[name]))
+            for op in self._prog.ops:
+                t = op["type"]
+                if t in ("feed", "fetch"):
+                    continue
+                conv = _CONVERTERS.get(t) or _CONVERTERS.get(
+                    registry.compat_name(t))
+                if conv is None:
+                    raise NotImplementedError(
+                        f"no converter for op {t!r} during calibration")
+                ins = {k: [values[a] for a in args if a in values]
+                       for k, args in op["inputs"].items()}
+                if t in self._qops:
+                    slot = _ACT_SLOT.get(t)
+                    args = op["inputs"].get(slot, [])
+                    if args and args[0] in observers:
+                        observers[args[0]].collect(values[args[0]])
+                outs = conv(jnp, ins, op["attrs"])
+                for k, args in op["outputs"].items():
+                    for a, val in zip(args, outs.get(k, [])):
+                        if val is not None:
+                            values[a] = val
+            n += 1
+        if n == 0:
+            raise RuntimeError("calibration loader yielded no batches")
+        return {name: obs.scale() for name, obs in observers.items()}
+
+    # ---- rewrite ----------------------------------------------------
+    def quantize(self):
+        act_scales = self._calibrate()
+        block = self._desc["blocks"][0]
+        new_ops = []
+        new_vars = {v["name"]: v for v in block["vars"]}
+        new_params = dict(self._params)
+        qmax_w = 2 ** (self._wbits - 1) - 1
+        dequanted_acts = {}  # act var -> dequantized twin name
+        counter = [0]
+
+        def fresh(stem):
+            counter[0] += 1
+            return f"__ptq_{stem}_{counter[0]}"
+
+        def declare(name, shape, proto_dtype, persistable=False):
+            new_vars[name] = {
+                "name": name, "persistable": persistable,
+                "is_parameter": persistable, "stop_gradient": True,
+                "type": {"type": 7, "dtype": proto_dtype,
+                         "dims": list(shape), "lod_level": 0}}
+
+        def add_param(name, arr):
+            new_params[name] = arr
+            dt = {"int8": 21, "float32": 5, "int32": 2}[str(arr.dtype)]
+            declare(name, arr.shape, dt, persistable=True)
+
+        # a weight consumed by several ops (shared embeddings) must keep
+        # its float original for the non-quantized consumers
+        use_count = {}
+        for op in block["ops"]:
+            for args in op["inputs"].values():
+                for a in args:
+                    use_count[a] = use_count.get(a, 0) + 1
+
+        quantized_weights = {}  # wname -> dequantized twin
+        for op in block["ops"]:
+            t = op["type"]
+            if t not in self._qops:
+                new_ops.append(op)
+                continue
+            wslot, waxis = _WEIGHT_SLOT[t]
+            aslot = _ACT_SLOT[t]
+            wargs = op["inputs"].get(wslot, [])
+            aargs = op["inputs"].get(aslot, [])
+            wname = wargs[0] if wargs else None
+            aname = aargs[0] if aargs else None
+            if wname not in self._params or wname in self._skip:
+                new_ops.append(op)
+                continue
+
+            # ---- weight: int8 channel-wise + dequantize_linear ----
+            if wname in quantized_weights:
+                wdq = quantized_weights[wname]
+            else:
+                w = np.asarray(self._params[wname], np.float32)
+                axis = waxis if w.ndim > 1 else 0
+                red = tuple(i for i in range(w.ndim) if i != axis)
+                wscale = np.maximum(np.abs(w).max(axis=red),
+                                    1e-8).astype(np.float32)
+                shape = [1] * w.ndim
+                shape[axis] = wscale.shape[0]
+                wq = np.clip(np.round(w / wscale.reshape(shape) * qmax_w),
+                             -qmax_w - 1, qmax_w).astype(np.int8)
+                qname = wname + "@quantized"
+                sname = wname + "@scale"
+                zname = wname + "@zero_point"
+                add_param(qname, wq)
+                add_param(sname, (wscale / qmax_w).astype(np.float32))
+                add_param(zname, np.zeros(wscale.shape, np.int32))
+                if use_count.get(wname, 0) <= 1:
+                    del new_params[wname]
+                    new_vars.pop(wname, None)
+                wdq = fresh("wdq")
+                declare(wdq, list(w.shape), 5)
+                new_ops.append({
+                    "type": "dequantize_linear",
+                    "inputs": {"X": [qname], "Scale": [sname],
+                               "ZeroPoint": [zname]},
+                    "outputs": {"Y": [wdq]},
+                    "attrs": {"quant_axis": axis,
+                              "bit_length": self._wbits}})
+                quantized_weights[wname] = wdq
+
+            # ---- activation: per-tensor quant/dequant pair ----
+            new_in = dict(op["inputs"])
+            new_in[wslot] = [wdq]
+            if aname in act_scales:
+                if aname not in dequanted_acts:
+                    s = act_scales[aname] / (2 ** (self._abits - 1) - 1)
+                    asname = fresh("act_scale")
+                    azname = fresh("act_zp")
+                    add_param(asname, np.asarray([s], np.float32))
+                    add_param(azname, np.zeros(1, np.int32))
+                    aq = fresh("aq")
+                    adq = fresh("adq")
+                    declare(aq, [], 5)
+                    declare(adq, [], 5)
+                    new_ops.append({
+                        "type": "quantize_linear",
+                        "inputs": {"X": [aname], "Scale": [asname],
+                                   "ZeroPoint": [azname]},
+                        "outputs": {"Y": [aq]},
+                        "attrs": {"quant_axis": -1,
+                                  "bit_length": self._abits}})
+                    new_ops.append({
+                        "type": "dequantize_linear",
+                        "inputs": {"X": [aq], "Scale": [asname],
+                                   "ZeroPoint": [azname]},
+                        "outputs": {"Y": [adq]},
+                        "attrs": {"quant_axis": -1,
+                                  "bit_length": self._abits}})
+                    dequanted_acts[aname] = adq
+                new_in[aslot] = [dequanted_acts[aname]]
+            new_ops.append({"type": t, "inputs": new_in,
+                            "outputs": op["outputs"],
+                            "attrs": op["attrs"]})
+
+        # drop originals no op references anymore (a shared weight whose
+        # consumers were ALL quantized would otherwise ship fp32 + int8)
+        referenced = {a for op in new_ops
+                      for args in op["inputs"].values() for a in args}
+        for name in list(new_params):
+            if name not in referenced:
+                del new_params[name]
+                new_vars.pop(name, None)
+
+        self._quantized_desc = {
+            "version": self._desc.get("version", 0),
+            "blocks": [{"idx": 0, "parent_idx": -1,
+                        "vars": list(new_vars.values()),
+                        "ops": new_ops}]}
+        self._quantized_params = new_params
+        return self
+
+    def save_quantized_model(self, save_model_path, model_filename=None,
+                             params_filename=None):
+        import os
+
+        if self._quantized_desc is None:
+            self.quantize()
+        os.makedirs(os.path.dirname(save_model_path) or ".",
+                    exist_ok=True)
+        prefix = save_model_path
+        if prefix.endswith(".pdmodel"):
+            prefix = prefix[:-len(".pdmodel")]
+        with open(prefix + ".pdmodel", "wb") as f:
+            f.write(serialize_program_desc(self._quantized_desc))
+        with open(prefix + ".pdiparams", "wb") as f:
+            f.write(serialize_params(self._quantized_params))
+        return prefix
+
+
+def quant_post_static(executor=None, model_dir=None, quantize_model_path
+                      =None, **kwargs):
+    """Functional wrapper (reference quant_post_static)."""
+    ptq = PostTrainingQuantization(executor=executor, model_dir=model_dir,
+                                   **kwargs)
+    ptq.quantize()
+    return ptq.save_quantized_model(quantize_model_path or
+                                    (model_dir or ".") + "/quantized")
