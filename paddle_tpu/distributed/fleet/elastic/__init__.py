@@ -31,12 +31,56 @@ class ElasticStatus:
 
 
 class ElasticManager:
+    """Worker-side elastic surface: attempt count (checkpoint-resume
+    decision), the current membership view, and scale requests. The
+    launcher-owned heartbeat TCPStore plays the reference's etcd:
+    workers register liveness there (``hb/<rank>``), the launcher
+    publishes ``elastic/world``, and an operator (or a worker) sets
+    ``elastic/scale_to`` to resize — the launcher checkpoints-stops the
+    job and relaunches on the new mesh (--np MIN:MAX)."""
+
     def __init__(self, args=None, etcd_client=None):
         self.args = args
         self.restarts = int(os.environ.get("PADDLE_ELASTIC_RESTARTS", 0))
+        self._client = None
 
     def enabled(self) -> bool:
         return int(os.environ.get("PADDLE_ELASTIC_LEVEL", 0)) > 0
+
+    @property
+    def world_size(self) -> int:
+        return int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+
+    def _store(self):
+        if self._client is None:
+            ep = os.environ.get("PADDLE_ELASTIC_HB_ENDPOINT")
+            if not ep:
+                raise RuntimeError(
+                    "no elastic membership store (launch with "
+                    "--elastic_level/--np so the launcher hosts one)")
+            from ....native.tcp_store import TCPStore
+            host, _, port = ep.partition(":")
+            self._client = TCPStore(host=host or "127.0.0.1",
+                                    port=int(port), is_master=False,
+                                    timeout=10.0)
+        return self._client
+
+    def members(self):
+        """Ranks with a registered heartbeat (the etcd node-list analog)."""
+        store = self._store()
+        out = []
+        for r in range(self.world_size):
+            try:
+                store.get(f"hb/{r}")
+                out.append(r)
+            except Exception:
+                pass
+        return out
+
+    def scale_to(self, n: int):
+        """Request a resize: the launcher checkpoints-stops the job and
+        relaunches with ``n`` workers (clamped to its --np MIN:MAX)."""
+        self._store().set("elastic/scale_to", str(int(n)).encode())
 
     def exit(self, completed=True):
         return ElasticStatus.COMPLETED if completed else ElasticStatus.ERROR
@@ -45,6 +89,6 @@ class ElasticManager:
 def launch_elastic(args=None, distribute_mode=None):
     """reference elastic/__init__.py:49 — delegate to the launcher's
     restart loop."""
-    from ..launch.main import launch
+    from ...launch.main import launch
     argv = ["--elastic_level", "1"] + (args or [])
     return launch(argv)

@@ -61,23 +61,84 @@ def parse_args(argv=None):
                          "elastic/manager.py; TPU-native = full-job "
                          "restart + checkpoint resume, SURVEY §5.3)")
     ap.add_argument("--max_restarts", type=int, default=3)
+    ap.add_argument("--np", dest="np_range", default=None,
+                    help="elastic world-size range MIN:MAX (reference "
+                         "elastic --np syntax). Starts at MAX; scales IN "
+                         "when a rank fails repeatedly (lost resource) "
+                         "and honors operator elastic/scale_to requests "
+                         "— each relaunch re-lowers onto the new mesh "
+                         "via checkpoint resume (SURVEY §5.3)")
     ap.add_argument("training_script")
     ap.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return ap.parse_args(argv)
 
 
+SCALE_RC = -1000  # sentinel: attempt ended by a scale request, not failure
+
+
 def launch(argv=None) -> int:
     args = parse_args(argv)
-    attempts = 1 + (args.max_restarts if args.elastic_level > 0 else 0)
+    min_np = max_np = None
+    if args.np_range:
+        lo, _, hi = str(args.np_range).partition(":")
+        min_np = int(lo)
+        max_np = int(hi or lo)
+        if args.elastic_level <= 0:
+            args.elastic_level = 1
+    max_failures = args.max_restarts if args.elastic_level > 0 else 0
+    current_np = max_np or args.nproc_per_node
     rc = 1
-    for attempt in range(attempts):
-        rc = _launch_once(args, attempt)
+    attempt = 0       # counts every relaunch (workers' resume signal)
+    failures = 0      # only genuine failures consume restart budget
+    scale_events = 0  # bounded so a misbehaving operator can't loop us
+    last_failed_rank = None
+    while True:
+        rc, failed_rank, scale_to = _launch_once(args, attempt,
+                                                 nproc=current_np)
         if rc == 0 or args.elastic_level <= 0:
             return rc
-        if attempt + 1 < attempts:
-            print(f"elastic: job failed (rc={rc}); restart "
-                  f"{attempt + 1}/{args.max_restarts}", file=sys.stderr)
-    return rc
+        if rc == SCALE_RC:
+            # operator-requested resize: relaunch on the new mesh without
+            # consuming restart budget (membership change, not failure)
+            scale_events += 1
+            if scale_events > 16:
+                print("elastic: too many resize requests; giving up",
+                      file=sys.stderr)
+                return 1
+            new_np = max(min_np or 1, min(scale_to, max_np or scale_to))
+            if args.nnodes > 1:
+                print("elastic: live resize is single-node only; "
+                      "ignoring request", file=sys.stderr)
+                new_np = current_np
+            if new_np == current_np:
+                print(f"elastic: resize request {scale_to} clamps to the "
+                      f"current world {current_np}; continuing unchanged",
+                      file=sys.stderr)
+            else:
+                print(f"elastic: scaling {current_np} -> {new_np} "
+                      f"workers (operator request); re-lowering onto "
+                      f"the new mesh", file=sys.stderr)
+                current_np = new_np
+            attempt += 1  # workers read RESTARTS>0 to resume checkpoints
+            continue
+        failures += 1
+        if failures > max_failures:
+            return rc
+        if min_np is not None and failed_rank is not None \
+                and failed_rank == last_failed_rank \
+                and current_np - 1 >= min_np:
+            # the same rank died twice in a row: treat its slot as a lost
+            # resource and scale in (the reference's membership-shrink on
+            # node loss, elastic/manager.py:126)
+            current_np -= 1
+            print(f"elastic: rank {failed_rank} failed repeatedly; "
+                  f"scaling in to {current_np} workers", file=sys.stderr)
+            last_failed_rank = None
+        else:
+            last_failed_rank = failed_rank
+        attempt += 1
+        print(f"elastic: job failed (rc={rc}); restart "
+              f"{failures}/{max_failures}", file=sys.stderr)
 
 
 class _HeartbeatWatcher:
@@ -99,11 +160,42 @@ class _HeartbeatWatcher:
             "PADDLE_ELASTIC_HEARTBEAT_TIMEOUT", "30"))
         self.interval = max(0.5, float(os.environ.get(
             "PADDLE_ELASTIC_HEARTBEAT_INTERVAL", "2")))
-        self._store = TCPStore(host="127.0.0.1", port=_free_port(),
+        # a pinned port lets operators/tooling connect for membership
+        # queries and elastic/scale_to requests
+        port = int(os.environ.get("PADDLE_ELASTIC_HB_PORT", 0) or 0) \
+            or _free_port()
+        self._store = TCPStore(host="127.0.0.1", port=port,
                                is_master=True, timeout=10.0)
         self.endpoint = f"127.0.0.1:{self._store.port}"
         self._last = {}       # rank -> (value, wall time it changed)
         self._next_check = 0.0
+
+    def publish_world(self, world):
+        """Membership view for operators (reference: etcd node list)."""
+        try:
+            self._store.set("elastic/world", str(world).encode())
+        except Exception:
+            pass
+
+    def scale_request(self, current_world):
+        """An operator-set elastic/scale_to value != current world, or
+        None. Throttled to the heartbeat interval — an unthrottled call
+        would hammer the store ~20x/sec from the 50ms monitor loop."""
+        now = time.time()
+        if now < getattr(self, "_next_scale_check", 0.0):
+            return None
+        self._next_scale_check = now + self.interval
+        try:
+            val = int(self._store.get("elastic/scale_to").decode())
+        except Exception:
+            return None
+        if val and val != current_world:
+            try:
+                self._store.delete("elastic/scale_to")
+            except Exception:
+                pass
+            return val
+        return None
 
     def poll(self, live_ranks=None):
         """Return a stale rank id among ``live_ranks`` (default: all), or
@@ -136,8 +228,11 @@ class _HeartbeatWatcher:
             pass
 
 
-def _launch_once(args, attempt: int = 0) -> int:
-    nproc = args.nproc_per_node
+def _launch_once(args, attempt: int = 0, nproc=None):
+    """Run one job attempt. Returns (rc, failed_rank, scale_to):
+    rc==SCALE_RC means the attempt was stopped by an operator resize
+    request (scale_to holds the target world)."""
+    nproc = nproc or args.nproc_per_node
     world = nproc * args.nnodes
     if args.nnodes > 1:
         # multi-node: rank 0 (node 0) hosts the store; every node must be
@@ -232,6 +327,10 @@ def _launch_once(args, attempt: int = 0) -> int:
             out))
 
     rc = 0
+    failed_rank = None
+    scale_to = None
+    if watcher is not None:
+        watcher.publish_world(world)
     try:
         live = {r: p for r, p, _ in procs}
 
@@ -261,16 +360,25 @@ def _launch_once(args, attempt: int = 0) -> int:
                     continue
                 del live[r]
                 if code != 0:
+                    failed_rank = r
                     _kill_all(f"rank {r} exited with code {code}; "
                               f"terminating peers", code)
                     break
             if live and watcher is not None:
                 stale = watcher.poll(set(live))
                 if stale is not None:
+                    failed_rank = stale
                     _kill_all(
                         f"elastic: rank {stale} heartbeat silent for "
                         f">{watcher.timeout:.0f}s (hung or stopped); "
                         f"restarting job", 1, force=True)
+                elif args.np_range:
+                    req = watcher.scale_request(world)
+                    if req is not None:
+                        scale_to = req
+                        _kill_all(
+                            f"elastic: resize to {req} requested; "
+                            f"checkpoint-stop for mesh change", SCALE_RC)
             time.sleep(0.05)
     except KeyboardInterrupt:
         for r, p, _ in procs:
@@ -283,7 +391,7 @@ def _launch_once(args, attempt: int = 0) -> int:
         for _, p, out in procs:
             if out is not None:
                 out.close()
-    return rc
+    return rc, failed_rank, scale_to
 
 
 if __name__ == "__main__":

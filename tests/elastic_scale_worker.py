@@ -1,0 +1,68 @@
+"""Worker for the elastic scale-in/out e2e tests (test_launch.py).
+
+Mode 'request': every rank paces its attempt-0 steps (so the launcher's
+checkpoint-stop always lands before free-running peers finish) and rank 0
+requests a resize to 2 after its first step; the relaunched attempt (now
+world=2) trains to completion and records the world it ran with.
+
+Mode 'lostrank': rank 2 crashes immediately on every attempt where it
+exists — the launcher must scale in to 2 after the repeated failure and
+the surviving mesh completes.
+
+Mode 'slow': paced steps with NO in-worker request — the window for an
+EXTERNAL operator client (PADDLE_ELASTIC_HB_PORT + elastic/scale_to) to
+drive a live resize, as the verify flow does.
+"""
+import os
+import sys
+import time
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import nn  # noqa: E402
+from paddle_tpu.distributed.fleet.elastic import ElasticManager  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+
+out_dir, mode = sys.argv[1], sys.argv[2]
+mgr = ElasticManager()
+env = dist.init_parallel_env()
+rank, world = env.rank, env.world_size
+
+if mode == "lostrank" and rank == 2:
+    sys.exit(7)  # this slot is a permanently lost resource
+
+ckpt = os.path.join(out_dir, f"state.{rank}.pdparams")
+paddle.seed(0)
+model = nn.Linear(4, 4)
+opt = paddle.optimizer.SGD(learning_rate=0.1,
+                           parameters=model.parameters())
+start = 0
+if mgr.restarts > 0 and os.path.exists(ckpt):
+    saved = paddle.load(ckpt)
+    model.set_state_dict(saved["model"])
+    start = int(saved["step"])
+
+x = paddle.to_tensor(np.ones((2, 4), "float32"))
+TOTAL = 4
+for step in range(start, TOTAL):
+    loss = (model(x) ** 2).sum()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    paddle.save({"model": model.state_dict(), "step": step + 1}, ckpt)
+    if mode in ("slow", "request") and mgr.restarts == 0:
+        # pacing: in 'request' it keeps peers from finishing before the
+        # scale-stop lands; in 'slow' it is the external-operator window
+        time.sleep(6 if mode == "slow" else 2)
+    if mode == "request" and mgr.restarts == 0 and rank == 0 and step == 0:
+        mgr.scale_to(2)
+        time.sleep(60)  # wait for the launcher's checkpoint-stop SIGTERM
+        sys.exit(3)     # must not be reached
+
+with open(os.path.join(out_dir, f"scale_ok.{rank}"), "w") as f:
+    f.write(f"world={world} restarts={mgr.restarts} "
+            f"members={len(mgr.members()) if mgr.enabled() else -1}")
