@@ -762,25 +762,44 @@ def _qscale_shape(scale, x, axis):
 
 
 def _quantize_linear(jnp, ins, attrs):
+    """Reference convention (quantize_linear_op.h:61-126): Scale holds the
+    ABSMAX, quantize is ClipAndFakeQuant — y = round(clip(x,-s,s)/s *
+    bin_cnt) with bin_cnt = 2^(bit_length-1)-1 — NOT the ONNX
+    y = round(x/scale) form (the two differ by a factor of bin_cnt)."""
     x = ins["X"][0]
+    if attrs.get("only_observer"):
+        # reference kernel TensorCopy's the input through unchanged when
+        # only_observer (quantize_linear_op.h:88-97) — the pass that
+        # inserts activation q/dq pairs defaults only_observer=True
+        # (quantization_pass.py AddQuantDequantForInferencePass)
+        return {"Y": [x]}
     axis = attrs.get("quant_axis", -1)
     scale = _qscale_shape(ins["Scale"][0], x, axis if axis >= 0 else 0)
     zp = _qscale_shape(ins["ZeroPoint"][0], x, axis if axis >= 0 else 0) \
         if ins.get("ZeroPoint") else 0
     bits = attrs.get("bit_length", 8)
     qmax = 2 ** (bits - 1) - 1
-    y = jnp.clip(jnp.round(x / scale), -qmax - 1, qmax)
+    y = jnp.clip(jnp.round(x / scale * qmax), -qmax, qmax)
     return {"Y": [y + zp]}
 
 
 def _dequantize_linear(jnp, ins, attrs):
+    """Reference convention (quantize_linear_op.cc:39 DequantizeFunctor):
+    out = in * scale / max_range, max_range = 2^(bit_length-1)-1, with the
+    stored Scale being the absmax."""
     x = ins["X"][0]
+    if attrs.get("only_observer"):
+        # pass-through, same as the quantize side
+        # (quantize_linear_op.h:154-157)
+        return {"Y": [x]}
     axis = attrs.get("quant_axis", -1)
     scale = _qscale_shape(ins["Scale"][0], x, axis if axis >= 0 else 0)
     zp = _qscale_shape(ins["ZeroPoint"][0], x, axis if axis >= 0 else 0) \
         if ins.get("ZeroPoint") else 0
+    bits = attrs.get("bit_length", 8)
+    max_range = 2 ** (bits - 1) - 1
     xf = (x.astype(scale.dtype) - zp)
-    return {"Y": [xf * scale]}
+    return {"Y": [xf * scale / max_range]}
 
 
 def _fake_qdq(jnp, ins, attrs):

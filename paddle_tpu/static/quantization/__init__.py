@@ -255,8 +255,11 @@ class PostTrainingQuantization:
                 qname = wname + "@quantized"
                 sname = wname + "@scale"
                 zname = wname + "@zero_point"
+                # Scale holds the ABSMAX (reference convention,
+                # quantize_linear_op.cc:39 divides by max_range at
+                # dequant) — NOT absmax/qmax (ONNX convention)
                 add_param(qname, wq)
-                add_param(sname, (wscale / qmax_w).astype(np.float32))
+                add_param(sname, wscale.astype(np.float32))
                 add_param(zname, np.zeros(wscale.shape, np.int32))
                 if use_count.get(wname, 0) <= 1:
                     del new_params[wname]
@@ -277,15 +280,22 @@ class PostTrainingQuantization:
             new_in[wslot] = [wdq]
             if aname in act_scales:
                 if aname not in dequanted_acts:
-                    s = act_scales[aname] / (2 ** (self._abits - 1) - 1)
+                    # absmax scale (reference convention, see weights)
+                    s = act_scales[aname]
                     asname = fresh("act_scale")
                     azname = fresh("act_zp")
                     add_param(asname, np.asarray([s], np.float32))
                     add_param(azname, np.zeros(1, np.int32))
                     aq = fresh("aq")
                     adq = fresh("adq")
-                    declare(aq, [], 5)
-                    declare(adq, [], 5)
+                    # external consumers (Paddle Inference shape/dtype
+                    # inference, paddle2onnx) read TensorDesc: the quant
+                    # output is int8 (proto 21) with the activation's
+                    # dims, its dequantized twin fp32 (proto 5)
+                    adims = list(new_vars.get(aname, {}).get(
+                        "type", {}).get("dims", []))
+                    declare(aq, adims, 21)
+                    declare(adq, adims, 5)
                     new_ops.append({
                         "type": "quantize_linear",
                         "inputs": {"X": [aname], "Scale": [asname],

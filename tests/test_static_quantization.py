@@ -115,3 +115,123 @@ class TestPostTrainingQuantization:
         ops = [o["type"] for o in
                ptq._quantized_desc["blocks"][0]["ops"]]
         assert "dequantize_linear" in ops
+
+
+class TestReferenceScaleConvention:
+    """Lock in the reference kernel semantics (round-4 advisor high):
+    Scale params hold the ABSMAX and dequant divides by
+    max_range = 2^(bit_length-1)-1 (quantize_linear_op.cc:39), NOT the
+    ONNX scale=absmax/qmax convention. Expected values here are
+    hand-computed with the reference formulas so repo-vs-repo agreement
+    cannot mask a convention drift."""
+
+    def test_dequantize_linear_matches_reference_kernel(self):
+        import jax.numpy as jnp
+        from paddle_tpu.static.pdmodel import _CONVERTERS
+
+        # per-channel (quant_axis=0) int8 weights with absmax scales —
+        # exactly what a reference onnx_format PTQ export contains
+        xq = np.array([[-127, 64, 0], [127, -32, 5]], np.int8)
+        scale = np.array([0.5, 2.0], np.float32)  # absmax per row
+        zp = np.zeros(2, np.int32)
+        out = _CONVERTERS["dequantize_linear"](
+            jnp, {"X": [jnp.asarray(xq)], "Scale": [jnp.asarray(scale)],
+                  "ZeroPoint": [jnp.asarray(zp)]},
+            {"quant_axis": 0, "bit_length": 8})["Y"][0]
+        # reference: out = in * scale / max_range, max_range = 127
+        want = xq.astype(np.float32) * scale.reshape(2, 1) / 127.0
+        np.testing.assert_allclose(np.asarray(out), want, rtol=1e-6)
+
+    def test_quantize_linear_matches_reference_kernel(self):
+        import jax.numpy as jnp
+        from paddle_tpu.static.pdmodel import _CONVERTERS
+
+        x = np.array([[0.5, -0.25, 0.1], [-0.5, 0.49, 0.0]], np.float32)
+        scale = np.array([0.5], np.float32)  # per-tensor absmax
+        out = _CONVERTERS["quantize_linear"](
+            jnp, {"X": [jnp.asarray(x)], "Scale": [jnp.asarray(scale)],
+                  "ZeroPoint": [jnp.asarray(np.zeros(1, np.int32))]},
+            {"quant_axis": -1, "bit_length": 8})["Y"][0]
+        # reference ClipAndFakeQuant: round(clip(x,-s,s)/s * 127)
+        want = np.round(np.clip(x, -0.5, 0.5) / 0.5 * 127.0)
+        np.testing.assert_allclose(np.asarray(out), want, rtol=0, atol=0)
+
+    def test_only_observer_passes_through(self):
+        """Reference onnx_format exports insert activation q/dq pairs
+        with only_observer=True (quantization_pass.py:3261); the kernel
+        TensorCopy's the input through (quantize_linear_op.h:154)."""
+        import jax.numpy as jnp
+        from paddle_tpu.static.pdmodel import _CONVERTERS
+
+        x = np.array([[0.3, -0.7]], np.float32)
+        ins = {"X": [jnp.asarray(x)],
+               "Scale": [jnp.asarray(np.array([0.7], np.float32))],
+               "ZeroPoint": [jnp.asarray(np.zeros(1, np.int32))]}
+        attrs = {"quant_axis": -1, "bit_length": 8, "only_observer": True}
+        for op in ("quantize_linear", "dequantize_linear"):
+            out = _CONVERTERS[op](jnp, ins, attrs)["Y"][0]
+            np.testing.assert_array_equal(np.asarray(out), x)
+
+    def test_ptq_writer_stores_absmax_scales(self, tmp_path):
+        """A reference runtime loading our artifact divides Scale by
+        max_range — so our Scale params must BE the absmax."""
+        from paddle_tpu.vision.models import LeNet
+        from paddle_tpu.static.pdmodel import (parse_combined_params,
+                                               parse_program_desc)
+
+        paddle.seed(0)
+        net = LeNet()
+        prefix = os.path.join(str(tmp_path), "lenet")
+        paddle.jit.save(net, prefix, input_spec=[
+            paddle.static.InputSpec([2, 1, 28, 28], "float32")])
+        rng = np.random.RandomState(0)
+        ptq = PostTrainingQuantization(
+            model_dir=str(tmp_path), model_filename="lenet.pdmodel",
+            data_loader=lambda: iter(
+                [[rng.randn(2, 1, 28, 28).astype("float32")]]),
+            batch_nums=1)
+        qprefix = ptq.quantize().save_quantized_model(
+            os.path.join(str(tmp_path), "lenet_int8"))
+
+        from paddle_tpu.static.pdmodel import PdProgram
+
+        with open(qprefix + ".pdmodel", "rb") as f:
+            desc = parse_program_desc(f.read())
+        block = desc["blocks"][0]
+        with open(qprefix + ".pdiparams", "rb") as f:
+            params = parse_combined_params(
+                f.read(), PdProgram(desc).persistable_names())
+        # float originals under their exported var names
+        with open(prefix + ".pdmodel", "rb") as f:
+            odesc = parse_program_desc(f.read())
+        with open(prefix + ".pdiparams", "rb") as f:
+            oparams = parse_combined_params(
+                f.read(), PdProgram(odesc).persistable_names())
+        # reconstruct each quantized weight by the REFERENCE dequant rule
+        # and check it approximates the float original within 1 lsb
+        for op in block["ops"]:
+            if op["type"] != "dequantize_linear":
+                continue
+            qname = op["inputs"]["X"][0]
+            sname = op["inputs"]["Scale"][0]
+            if "@quantized" not in qname:
+                continue
+            wq = np.asarray(params[qname], np.float32)
+            s = np.asarray(params[sname], np.float32)
+            axis = op["attrs"]["quant_axis"]
+            shape = [1] * wq.ndim
+            shape[axis] = s.shape[0]
+            wref = wq * s.reshape(shape) / 127.0
+            orig = np.asarray(oparams[qname.replace("@quantized", "")])
+            lsb = s.reshape(shape) / 127.0
+            assert np.all(np.abs(wref - orig) <= lsb * 0.5 + 1e-8), qname
+            # the scale itself is the absmax, not absmax/127
+            red = tuple(i for i in range(wq.ndim) if i != axis)
+            np.testing.assert_allclose(
+                s, np.abs(orig).max(axis=red), rtol=1e-5)
+        # int8 var metadata: quant outputs declare proto dtype 21
+        aq_vars = {v["name"]: v for v in block["vars"]
+                   if v["name"].startswith("__ptq_aq")}
+        assert aq_vars, "no activation quant vars declared"
+        for v in aq_vars.values():
+            assert v["type"]["dtype"] == 21, v
