@@ -106,13 +106,54 @@ def _apply_meta_optimizers(optimizer, strategy):
                 "DistributedStrategy.lars applies to a Momentum "
                 f"optimizer (reference lars_optimizer.py contract); got "
                 f"{type(optimizer).__name__} — running it unchanged")
-    for toggle in ("dgc", "localsgd", "adaptive_localsgd"):
-        if getattr(strategy, toggle, False):
+    from .meta_parallel.dgc_localsgd import (DGCMomentum, _dp_mesh,
+                                             make_localsgd_optimizer)
+
+    if getattr(strategy, "dgc", False):
+        from ...optimizer import Momentum
+        if _dp_mesh() is None:
             warnings.warn(
-                f"DistributedStrategy.{toggle} is accepted but INERT in "
-                f"paddle_tpu: gradient compression / local-SGD step "
-                f"skipping has no implementation here (gradients ride "
-                f"XLA collectives at full precision every step)")
+                "strategy.dgc: no dp>1 mesh active — gradient compression "
+                "needs data-parallel replicas (reference _can_apply "
+                "worker_num>1 gate); running the optimizer unchanged")
+        elif isinstance(optimizer, DGCMomentum):
+            pass
+        elif isinstance(optimizer, Momentum) and \
+                not getattr(optimizer, "_nesterov", False):
+            cfg = strategy.dgc_configs or {}
+            optimizer = DGCMomentum(
+                learning_rate=optimizer._lr,
+                momentum=optimizer._momentum,
+                parameters=optimizer._parameters,
+                rampup_begin_step=cfg.get("rampup_begin_step", 0),
+                rampup_step=cfg.get("rampup_step", 1),
+                sparsity=cfg.get("sparsity", [0.999]),
+                weight_decay=getattr(optimizer, "_l2_coeff", 0.0) or None,
+                grad_clip=optimizer._grad_clip)
+        else:
+            warnings.warn(
+                "DistributedStrategy.dgc applies to a (non-Nesterov) "
+                "Momentum optimizer (reference DGCMomentumOptimizer "
+                f"contract); got {type(optimizer).__name__} — running it "
+                f"unchanged")
+    for toggle, adaptive in (("localsgd", False),
+                             ("adaptive_localsgd", True)):
+        if getattr(strategy, toggle, False):
+            if _dp_mesh() is None:
+                warnings.warn(
+                    f"strategy.{toggle}: no dp>1 mesh active — local SGD "
+                    f"needs data-parallel replicas (reference _can_apply "
+                    f"worker_num>1 gate); running the optimizer unchanged")
+                continue
+            cfg = (strategy.adaptive_localsgd_configs if adaptive
+                   else strategy.localsgd_configs) or {}
+            optimizer = make_localsgd_optimizer(
+                optimizer,
+                k_steps=cfg.get("k_steps", 1),
+                begin_step=cfg.get("begin_step", 1),
+                adaptive=adaptive,
+                init_k_steps=cfg.get("init_k_steps", 1))
+            break
     return optimizer
 
 

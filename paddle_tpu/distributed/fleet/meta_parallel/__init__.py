@@ -1,3 +1,4 @@
+from .dgc_localsgd import DGCMomentum, make_localsgd_optimizer  # noqa: F401
 from .hybrid_optimizer import HybridParallelGradScaler, HybridParallelOptimizer  # noqa: F401
 from .mp_layers import (  # noqa: F401
     ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,

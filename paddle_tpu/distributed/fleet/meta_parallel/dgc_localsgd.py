@@ -1,0 +1,497 @@
+"""DGC and LocalSGD — communication-reducing DP training schedules.
+
+Reference:
+- DGC: /root/reference/python/paddle/distributed/fleet/meta_optimizers/
+  dgc_optimizer.py (DGCMomentumOptimizer wrapping the dgc/dgc_momentum ops,
+  paddle/fluid/operators/dgc_op.h) — Deep Gradient Compression (Lin et al.
+  2018): per-worker top-k gradient sparsification with momentum correction
+  and momentum factor masking; transmitted mass is the error-feedback
+  accumulator, untransmitted mass stays local.
+- LocalSGD: /root/reference/python/paddle/distributed/fleet/meta_optimizers/
+  localsgd_optimizer.py (param snapshots + allreduce of param deltas every
+  k steps; AdaptiveLocalSGDOptimizer adapts k from the loss ratio,
+  localsgd_optimizer.py:452-479).
+
+TPU-native design. The reference implements both as NCCL-op program
+rewrites. Here they are alternative *compiled step structures* built by
+TrainStep when the fleet strategy toggle is on:
+
+- DGC wraps the grad computation in ``shard_map`` over the 'dp' mesh axis
+  so each data-parallel shard materializes its own LOCAL gradient (plain
+  GSPMD fuses the cross-replica sum into the backward, so no local grad
+  exists to compress). Per-rank u/v accumulators ride the optimizer state
+  as (D, *shape) arrays sharded over 'dp'. The transmitted tensor is the
+  error accumulator masked by a |v|-quantile threshold (== top-k selection,
+  and the ramping sparsity schedule stays jit-static because the threshold
+  is data-dependent rather than a shape), reduced with a single pmean —
+  numerically identical to sparse aggregation, and the masked reduction is
+  what XLA can actually ship over ICI.
+- LocalSGD keeps each dp rank's params (and velocity) as (D, *shape)
+  'dp'-sharded optimizer state, runs the whole local update inside
+  shard_map, and only pays a cross-replica pmean of the parameters at sync
+  steps — the canonical (user-visible) params update at syncs and stay
+  stale in between, exactly the LocalSGD contract. k_steps is adapted
+  in-graph for adaptive_localsgd with the reference's
+  ceil(sqrt(lr0*loss/(lr*loss0))*k0) rule clipped to [1, 16].
+
+Both require an active dp>1 mesh (the reference's _can_apply worker_num>1
+gate lives in fleet._apply_meta_optimizers, which declines the swap and
+warns when there is none).
+"""
+from __future__ import annotations
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ....optimizer.optimizer import SGD, Momentum
+from ...mesh_utils import get_global_mesh, manual_shard_map
+
+__all__ = ["DGCMomentum", "make_localsgd_optimizer",
+           "build_dgc_pure_step", "build_localsgd_pure_step"]
+
+
+def _dp_mesh():
+    """The active mesh when it has a non-trivial 'dp' axis, else None."""
+    mesh = get_global_mesh()
+    if mesh is not None and "dp" in mesh.axis_names and \
+            mesh.shape["dp"] > 1:
+        return mesh
+    return None
+
+
+def _dp_degree():
+    mesh = _dp_mesh()
+    return mesh.shape["dp"] if mesh is not None else 1
+
+
+def _require_pure_dp(mesh, what):
+    if mesh is None:
+        raise RuntimeError(
+            f"{what} requires an active dp>1 mesh (fleet.init with "
+            f"dp_degree>1); none is set — the fleet strategy gate should "
+            f"have declined the optimizer swap")
+    if any(mesh.shape[a] > 1 for a in mesh.axis_names if a != "dp"):
+        raise NotImplementedError(
+            f"{what} composes with pure data parallelism only (reference "
+            f"meta-optimizer black/white lists); found non-trivial mesh "
+            f"axes {dict(mesh.shape)}")
+
+
+class DGCMomentum(Momentum):
+    """Momentum whose post-rampup update is plain SGD — the momentum lives
+    in the per-worker DGC ``u`` accumulator (momentum correction), matching
+    the reference dgc_momentum kernel's step<rampup?momentum:sgd branch
+    (dgc_optimizer.py:143-166, dgc_momentum_op.h)."""
+
+    _accum_names = ["velocity", "dgc_u", "dgc_v"]
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 rampup_begin_step=0, rampup_step=1, sparsity=(0.999,),
+                 weight_decay=None, grad_clip=None, **kw):
+        from ....nn.clip import ClipGradByNorm
+        if grad_clip is not None and not isinstance(grad_clip,
+                                                    ClipGradByNorm):
+            # reference contract (dgc_optimizer.py:83-91): only
+            # ClipGradByNorm composes with sparsified grads
+            raise ValueError(
+                "DGC only supports ClipGradByNorm (reference "
+                "DGCMomentumOptimizer contract); got "
+                f"{type(grad_clip).__name__}")
+        super().__init__(learning_rate, momentum, parameters,
+                         weight_decay=weight_decay, grad_clip=grad_clip)
+        self._dgc_cfg = {
+            "momentum": float(momentum),
+            "rampup_begin_step": int(rampup_begin_step),
+            "rampup_step": int(rampup_step),
+            "sparsity": [float(s) for s in sparsity],
+        }
+
+    def _accum_spec(self, name, p):
+        if name in ("dgc_u", "dgc_v"):
+            return (_dp_degree(),) + tuple(p.shape), jnp.float32
+        return super()._accum_spec(name, p)
+
+    def _get_accum(self, name, p, init=None):
+        if name in ("dgc_u", "dgc_v") and init is None:
+            shape, dtype = self._accum_spec(name, p)
+            init = jnp.zeros(shape, dtype)
+        return super()._get_accum(name, p, init)
+
+    def step(self):
+        raise RuntimeError(
+            "DGCMomentum runs through the compiled TrainStep (gradient "
+            "compression needs the shard_mapped per-rank grads); eager "
+            ".step() would silently train uncompressed SGD")
+
+    def _update_rule(self, p, g, lr, t, wd, state):
+        begin = self._dgc_cfg["rampup_begin_step"]
+        lr = lr.astype(p.dtype)
+        v = state["velocity"]
+        v_new = self._momentum * v + g
+        in_dgc = t >= begin
+        p_out = jnp.where(in_dgc, p - lr * g, p - lr * v_new)
+        out = {"velocity": jnp.where(in_dgc, v, v_new)}
+        # u/v are updated by the shard_mapped gradient transform; the
+        # update rule threads them through unchanged
+        for k in ("dgc_u", "dgc_v"):
+            if k in state:
+                out[k] = state[k]
+        return p_out, out
+
+
+def _sparsity_at(t, cfg):
+    """Ramping sparsity schedule: step through cfg['sparsity'] stages over
+    rampup_step steps starting at rampup_begin_step (reference dgc op's
+    rampup_begin_step/rampup_step/sparsity attrs). Traced scalar in
+    [0, 1)."""
+    sched = jnp.asarray(cfg["sparsity"], jnp.float32)
+    n_stage = len(cfg["sparsity"])
+    span = max(cfg["rampup_step"], 1)
+    rel = jnp.maximum(t - cfg["rampup_begin_step"], 0)
+    stage = jnp.clip((rel * n_stage) // span, 0, n_stage - 1)
+    return sched[stage]
+
+
+def _local_clip(gf, clip_thr):
+    """Per-tensor local-grad clip at clip_norm * D^-0.5 — the reference
+    applies it in BOTH phases (dgc_optimizer.py:91 _append_clip_norm runs
+    unconditionally in apply_gradients)."""
+    if clip_thr is None:
+        return gf
+    norm = jnp.sqrt(jnp.sum(jnp.square(gf)))
+    return jnp.where(norm > clip_thr,
+                     gf * (clip_thr / jnp.maximum(norm, 1e-12)), gf)
+
+
+def _dgc_compress(g, u, v, t, cfg):
+    """One DGC step for ONE parameter on ONE dp rank (runs inside
+    shard_map; u/v enter as the (1, *shape) local slice of the stacked
+    accumulator, g arrives already locally clipped).
+
+    Lin et al. 2018 with momentum correction + momentum factor masking:
+        u <- m*u + g_local ; v <- v + u
+        mask = |v| >= quantile(|v|, sparsity)       (== top-k)
+        send = v*mask ; v <- v*(1-mask) ; u <- u*(1-mask)
+        G = pmean over ranks of send
+    """
+    m = cfg["momentum"]
+    u0, v0 = u[0], v[0]
+    gf = g.astype(jnp.float32)
+    u1 = m * u0 + gf
+    v1 = v0 + u1
+    s = _sparsity_at(t, cfg)
+    absv = jnp.abs(v1)
+    thr = jnp.quantile(absv.reshape(-1), jnp.clip(s, 0.0, 1.0 - 1e-7))
+    mask = (absv >= thr).astype(jnp.float32)
+    send = v1 * mask
+    g_agg = jax.lax.pmean(send, "dp")
+    return g_agg, (u1 * (1.0 - mask))[None], (v1 * (1.0 - mask))[None]
+
+
+def build_dgc_pure_step(ts):
+    """DGC variant of TrainStep._make_pure_step: shard_map'd local grads +
+    compressed aggregation over the 'dp' axis."""
+    from ....nn.clip import ClipGradByNorm
+
+    mesh = _dp_mesh()
+    _require_pure_dp(mesh, "DGC")
+    if ts._scaler is not None:
+        raise NotImplementedError("DGC + dynamic loss scaling is not "
+                                  "supported (use AMP without a scaler)")
+
+    opt = ts.optimizer
+    cfg = opt._dgc_cfg
+    grad_clip = getattr(opt, "_grad_clip", None)
+    clip_thr = (grad_clip.clip_norm * mesh.shape["dp"] ** -0.5
+                if isinstance(grad_clip, ClipGradByNorm) else None)
+    trainable_names = list(ts._trainable.keys())
+    loss_of = _make_loss_of(ts)
+    wd_by_name = {n: opt._wd_for(p) for n, p in ts._trainable.items()}
+    lr_mult = {n: getattr(p, "optimize_attr", {"learning_rate": 1.0})[
+        "learning_rate"] for n, p in ts._trainable.items()}
+    update_rule = opt._update_rule
+
+    def pure_step(params, buffers, opt_state, sc_state, lr, t, key, *batch):
+        train_params = {n: params[n] for n in trainable_names}
+        u = {n: opt_state[n]["dgc_u"] for n in trainable_names}
+        v = {n: opt_state[n]["dgc_v"] for n in trainable_names}
+        bspecs = tuple(P("dp") if getattr(b, "ndim", 0) >= 1 else P()
+                       for b in batch)
+
+        def local(tp, allp, bufs, u, v, key, t, *batch_local):
+            loss_r, g = jax.value_and_grad(
+                lambda q: loss_of(q, allp, bufs, key, batch_local))(tp)
+            loss = jax.lax.pmean(loss_r, "dp")
+            # local clip runs in BOTH phases (reference _append_clip_norm)
+            g = {n: _local_clip(g[n].astype(jnp.float32), clip_thr)
+                 for n in g}
+
+            def dense(g, u, v):
+                return ({n: jax.lax.pmean(g[n], "dp") for n in g}, u, v)
+
+            def dgc(g, u, v):
+                out_g, out_u, out_v = {}, {}, {}
+                for n in g:
+                    out_g[n], out_u[n], out_v[n] = _dgc_compress(
+                        g[n], u[n], v[n], t, cfg)
+                return out_g, out_u, out_v
+
+            return (loss,) + jax.lax.cond(
+                t >= cfg["rampup_begin_step"], dgc, dense, g, u, v)
+
+        loss, g_agg, u2, v2 = manual_shard_map(
+            local, mesh=mesh,
+            in_specs=(P(), P(), P(), P("dp"), P("dp"), P(), P()) + bspecs,
+            out_specs=(P(), P(), P("dp"), P("dp")))(
+            train_params, params, buffers, u, v, key, t, *batch)
+
+        new_params = dict(params)
+        new_state = {}
+        for n in trainable_names:
+            g = g_agg[n]
+            p_arr = params[n]
+            if g.dtype != p_arr.dtype:
+                g = g.astype(p_arr.dtype)
+            if opt._l2_coeff and not opt._decoupled_wd():
+                g = g + opt._l2_coeff * p_arr
+            state_n = dict(opt_state[n], dgc_u=u2[n], dgc_v=v2[n])
+            p_new, s_new = update_rule(
+                p_arr, g, lr * lr_mult[n], t,
+                jnp.asarray(wd_by_name[n], jnp.float32), state_n)
+            new_params[n] = p_new
+            new_state[n] = s_new
+        loss, new_params, new_state = jax.lax.optimization_barrier(
+            (loss, new_params, new_state))
+        return loss, new_params, new_state, sc_state
+
+    return pure_step
+
+
+# ---------------------------------------------------------------- LocalSGD
+
+def make_localsgd_optimizer(inner, k_steps=1, begin_step=1, adaptive=False,
+                            init_k_steps=1):
+    """Swap a SGD/Momentum optimizer for its LocalSGD variant (reference
+    LocalSGDOptimizer._can_apply restricts to exactly these two,
+    localsgd_optimizer.py:47-53). The returned optimizer carries
+    ``_localsgd_cfg`` which TrainStep reads to build the k-step-sync
+    compiled schedule; params and velocity become per-dp-rank state."""
+    if not isinstance(inner, (SGD, Momentum)):
+        warnings.warn(
+            "DistributedStrategy.localsgd applies to SGD/Momentum "
+            f"optimizers only (reference _can_apply contract); got "
+            f"{type(inner).__name__} — running it unchanged")
+        return inner
+    if isinstance(inner, DGCMomentum):
+        # reference meta-optimizer black lists forbid this composition
+        # (LocalSGDOptimizer.meta_optimizers_black_list)
+        warnings.warn(
+            "strategy.localsgd cannot compose with strategy.dgc "
+            "(reference meta-optimizer black list); keeping DGC")
+        return inner
+    wd = inner._wd_obj if inner._wd_obj is not None else \
+        (inner._l2_coeff or None)
+    if isinstance(inner, Momentum):
+        opt = _LocalSGDMomentum(
+            learning_rate=inner._lr, momentum=inner._momentum,
+            parameters=inner._parameters,
+            use_nesterov=getattr(inner, "_nesterov", False),
+            weight_decay=wd, grad_clip=inner._grad_clip)
+    else:
+        opt = _LocalSGDSGD(learning_rate=inner._lr,
+                           parameters=inner._parameters,
+                           weight_decay=wd, grad_clip=inner._grad_clip)
+    opt._localsgd_cfg = {
+        "k_steps": int(k_steps), "begin_step": int(begin_step),
+        "adaptive": bool(adaptive), "init_k_steps": int(init_k_steps),
+    }
+    opt._ls_scalars = None      # persisted {"k","last","loss0","lr0"}
+    return opt
+
+
+class _LocalSGDStateMixin:
+    """Per-rank stacked (D, *shape) accumulators for the LocalSGD step."""
+
+    def _accum_spec(self, name, p):
+        if name == "ls_p":
+            return ((_dp_degree(),) + tuple(p.shape),
+                    getattr(p._data, "dtype", jnp.float32))
+        shape, dtype = super()._accum_spec(name, p)
+        return (_dp_degree(),) + tuple(shape), dtype
+
+    def _get_accum(self, name, p, init=None):
+        if init is None:
+            if name == "ls_p":
+                init = jnp.broadcast_to(
+                    p._data, (_dp_degree(),) + tuple(p.shape))
+            else:
+                shape, dtype = self._accum_spec(name, p)
+                init = jnp.zeros(shape, dtype)
+        return super()._get_accum(name, p, init)
+
+    def step(self):
+        raise RuntimeError(
+            "LocalSGD optimizers run through the compiled TrainStep "
+            "(their state is per-dp-rank); eager .step() has no local "
+            "rank to act on")
+
+    # the sync-schedule scalars (k / last-sync / loss0 / lr0) must survive
+    # checkpoint save/resume or an adaptive run resumes on fabricated
+    # baselines and fires syncs off-schedule
+    def state_dict(self):
+        sd = super().state_dict()
+        if getattr(self, "_ls_scalars", None) is not None:
+            for k, val in self._ls_scalars.items():
+                sd[f"@localsgd_{k}"] = jnp.asarray(val)
+        return sd
+
+    def set_state_dict(self, state_dict):
+        super().set_state_dict(state_dict)
+        keys = ("k", "last", "loss0", "lr0")
+        if all(f"@localsgd_{k}" in state_dict for k in keys):
+            self._ls_scalars = {
+                k: jnp.asarray(getattr(state_dict[f"@localsgd_{k}"],
+                                       "_data",
+                                       state_dict[f"@localsgd_{k}"]))
+                for k in keys}
+
+    set_dict = set_state_dict
+
+
+class _LocalSGDSGD(_LocalSGDStateMixin, SGD):
+    _accum_names = ["ls_p"]
+
+
+class _LocalSGDMomentum(_LocalSGDStateMixin, Momentum):
+    _accum_names = ["velocity", "ls_p"]
+
+
+def localsgd_scalar_init(cfg):
+    k0 = cfg["init_k_steps"] if cfg["adaptive"] else cfg["k_steps"]
+    return {"k": jnp.asarray(k0, jnp.int32),
+            "last": jnp.asarray(0, jnp.int32),
+            "loss0": jnp.asarray(1.0, jnp.float32),
+            "lr0": jnp.asarray(1.0, jnp.float32)}
+
+
+def build_localsgd_pure_step(ts):
+    """LocalSGD variant of TrainStep._make_pure_step: every dp rank updates
+    its own parameter copy inside shard_map; a cross-replica pmean of the
+    params runs only at sync steps. Canonical (user-visible) params update
+    at syncs and stay stale in between (the reference's per-worker params
+    likewise diverge between snapshot allreduces)."""
+    mesh = _dp_mesh()
+    opt = ts.optimizer
+    _require_pure_dp(mesh, "LocalSGD")
+    if ts._scaler is not None:
+        raise NotImplementedError("LocalSGD + dynamic loss scaling is "
+                                  "not supported")
+
+    cfg = opt._localsgd_cfg
+    trainable_names = list(ts._trainable.keys())
+    loss_of = _make_loss_of(ts)
+    wd_by_name = {n: opt._wd_for(p) for n, p in ts._trainable.items()}
+    lr_mult = {n: getattr(p, "optimize_attr", {"learning_rate": 1.0})[
+        "learning_rate"] for n, p in ts._trainable.items()}
+    update_rule = opt._update_rule
+    accum_names = [a for a in opt._accum_names if a != "ls_p"]
+    from ....jit.train_step import _functional_clip
+    grad_clip = getattr(opt, "_grad_clip", None)
+
+    def pure_step(params, buffers, opt_state, sc_state, lr, t, key, *batch):
+        ls = opt_state["__ls__"]
+        bspecs = tuple(P("dp") if getattr(b, "ndim", 0) >= 1 else P()
+                       for b in batch)
+
+        def local(allp, bufs, stacked_p, stacked_acc, ls, key, lr, t,
+                  *batch_local):
+            p_loc = {n: stacked_p[n][0] for n in trainable_names}
+            loss_r, g = jax.value_and_grad(
+                lambda q: loss_of(q, allp, bufs, key, batch_local))(p_loc)
+            avg_loss = jax.lax.pmean(loss_r, "dp")
+            g = _functional_clip(grad_clip, g)
+            p2, acc2 = {}, {}
+            for n in trainable_names:
+                gn = g[n]
+                if gn.dtype != p_loc[n].dtype:
+                    gn = gn.astype(p_loc[n].dtype)
+                if opt._l2_coeff and not opt._decoupled_wd():
+                    gn = gn + opt._l2_coeff * p_loc[n]
+                state_n = {a: stacked_acc[n][a][0] for a in accum_names}
+                p2[n], acc2[n] = update_rule(
+                    p_loc[n], gn, lr * lr_mult[n], t,
+                    jnp.asarray(wd_by_name[n], jnp.float32), state_n)
+
+            # sync schedule (reference: communicate() every step until
+            # begin_step, then every k; adaptive re-derives k from the
+            # loss ratio at syncs, localsgd_optimizer.py:481-488)
+            begin = cfg["begin_step"]
+            do_sync = jnp.where(t <= begin, True,
+                                (t - ls["last"]) >= ls["k"])
+
+            def sync(p2):
+                avg = {n: jax.lax.pmean(p2[n], "dp")
+                       for n in trainable_names}
+                return avg, avg
+
+            def nosync(p2):
+                return p2, {n: allp[n] for n in trainable_names}
+
+            p_next, canon_next = jax.lax.cond(do_sync, sync, nosync, p2)
+
+            if cfg["adaptive"]:
+                # next_k = ceil(sqrt(lr0*loss/(lr*loss0) * k0)) in [1,16]
+                # (localsgd_optimizer.py:456-479)
+                next_k = jnp.clip(jnp.ceil(jnp.sqrt(
+                    ls["lr0"] * avg_loss
+                    / jnp.maximum(lr * ls["loss0"], 1e-12)
+                    * float(cfg["init_k_steps"]))), 1, 16).astype(jnp.int32)
+                in_warmup = t <= begin
+                k_new = jnp.where(
+                    in_warmup, jnp.int32(cfg["init_k_steps"]),
+                    jnp.where(do_sync, next_k, ls["k"]))
+                loss0 = jnp.where(in_warmup, avg_loss, ls["loss0"])
+                lr0 = jnp.where(in_warmup, lr, ls["lr0"])
+            else:
+                k_new = jnp.asarray(cfg["k_steps"], jnp.int32)
+                loss0, lr0 = ls["loss0"], ls["lr0"]
+            ls_new = {"k": k_new,
+                      "last": jnp.where(do_sync, t, ls["last"]),
+                      "loss0": loss0, "lr0": lr0}
+            stacked_p2 = {n: p_next[n][None] for n in trainable_names}
+            stacked_acc2 = {n: {a: acc2[n][a][None] for a in accum_names}
+                            for n in trainable_names}
+            return avg_loss, canon_next, stacked_p2, stacked_acc2, ls_new
+
+        stacked_p = {n: opt_state[n]["ls_p"] for n in trainable_names}
+        stacked_acc = {n: {a: opt_state[n][a] for a in accum_names}
+                       for n in trainable_names}
+        loss, canon, sp2, sa2, ls2 = manual_shard_map(
+            local, mesh=mesh,
+            in_specs=(P(), P(), P("dp"), P("dp"), P(), P(), P(), P())
+            + bspecs,
+            out_specs=(P(), P(), P("dp"), P("dp"), P()))(
+            params, buffers, stacked_p, stacked_acc, ls, key, lr, t,
+            *batch)
+
+        new_params = dict(params)
+        new_state = {"__ls__": ls2}
+        for n in trainable_names:
+            new_params[n] = canon[n]
+            new_state[n] = dict({a: sa2[n][a] for a in accum_names},
+                                ls_p=sp2[n])
+        loss, new_params, new_state = jax.lax.optimization_barrier(
+            (loss, new_params, new_state))
+        return loss, new_params, new_state, sc_state
+
+    return pure_step
+
+
+def _make_loss_of(ts):
+    """Shared with the plain step (train_step._make_loss_of) so the AMP /
+    functional-state / key semantics cannot drift between schedules."""
+    from ....jit.train_step import _make_loss_of as make
+    return make(ts)

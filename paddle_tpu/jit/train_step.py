@@ -84,6 +84,38 @@ def _functional_clip(grad_clip, grads: dict) -> dict:
     return grads
 
 
+def _make_loss_of(ts):
+    """The model+loss closure of a TrainStep: functional state swap, AMP
+    autocast, traced dropout keys, n_inputs batch slicing. Shared by the
+    plain pure step and the DGC/LocalSGD shard_map bodies so their
+    semantics cannot drift."""
+    import contextlib
+
+    from ..amp.auto_cast import auto_cast
+    from ..core import autograd as ag
+    from ..framework import random as random_mod
+
+    model, loss_fn = ts.model, ts.loss_fn
+    amp_level, amp_dtype = ts._amp_level, ts._amp_dtype
+
+    def loss_of(train_params, all_params, buffers, key, batch):
+        full = {**all_params, **train_params}
+        amp_ctx = (auto_cast(level=amp_level, dtype=amp_dtype)
+                   if amp_level else contextlib.nullcontext())
+        # AMP under trace: dispatch-level autocast runs inside the traced
+        # forward, so XLA sees bf16 matmuls with f32 master params
+        # (reference O1/O2, auto_cast.py:668) and fuses the casts away.
+        with _swapped_state(model, full, buffers), ag.no_grad(), \
+                random_mod.traced_key_scope(key), amp_ctx:
+            t_batch = [Tensor(a, stop_gradient=True) for a in batch]
+            out = model(*t_batch[:ts._n_inputs])
+            loss_t = loss_fn(out, *t_batch[ts._n_inputs:])
+        l_arr = loss_t._data if isinstance(loss_t, Tensor) else loss_t
+        return l_arr.astype(jnp.float32)
+
+    return loss_of
+
+
 class TrainStep:
     """Compile model.forward + loss + optimizer into one donated XLA step.
 
@@ -122,22 +154,51 @@ class TrainStep:
         for name, p in self._trainable.items():
             state[name] = {an: opt._get_accum(an, p)
                            for an in opt._accum_names}
+        if getattr(opt, "_localsgd_cfg", None) is not None:
+            # k/last-sync/loss0/lr0 scalars of the LocalSGD schedule ride
+            # the opt_state tree under a reserved key
+            sc = getattr(opt, "_ls_scalars", None)
+            if sc is None:
+                from ..distributed.fleet.meta_parallel.dgc_localsgd import (
+                    localsgd_scalar_init)
+                sc = localsgd_scalar_init(opt._localsgd_cfg)
+            state["__ls__"] = sc
         return state
 
     def _writeback_opt_state(self, state):
         opt = self.optimizer
+        ls = state.get("__ls__")
+        if ls is not None:
+            # write through any HybridParallelOptimizer wrapper: the inner
+            # optimizer owns the schedule scalars (state_dict serializes
+            # them from there)
+            getattr(opt, "_inner_opt", opt)._ls_scalars = ls
         for name, p in self._trainable.items():
             for an in opt._accum_names:
                 opt._set_accum(an, p, state[name][an])
 
     def _make_pure_step(self):
+        """Dispatch to the step-structure builder: the plain GSPMD step,
+        or the DGC / LocalSGD communication-reducing variants when the
+        fleet strategy swapped in an optimizer carrying their config."""
+        opt = self.optimizer
+        if getattr(opt, "_dgc_cfg", None) is not None:
+            from ..distributed.fleet.meta_parallel.dgc_localsgd import (
+                build_dgc_pure_step)
+            return build_dgc_pure_step(self)
+        if getattr(opt, "_localsgd_cfg", None) is not None:
+            from ..distributed.fleet.meta_parallel.dgc_localsgd import (
+                build_localsgd_pure_step)
+            return build_localsgd_pure_step(self)
+        return self._make_pure_step_plain()
+
+    def _make_pure_step_plain(self):
         """Construct the pure (params, buffers, opt_state, sc_state, lr, t,
         key, *batch) -> (loss, params', opt_state', sc_state') function.
         Shared by the jit path (_build) and the AOT planning path
         (aot_lower), which traces it with abstract operands only."""
-        model = self.model
-        loss_fn = self.loss_fn
         opt = self.optimizer
+        loss_closure = _make_loss_of(self)
         trainable_names = list(self._trainable.keys())
         grad_clip = getattr(opt, "_grad_clip", None)
         update_rule = opt._update_rule
@@ -145,7 +206,6 @@ class TrainStep:
         lr_mult = {n: getattr(p, "optimize_attr", {"learning_rate": 1.0})[
             "learning_rate"] for n, p in self._trainable.items()}
 
-        amp_level, amp_dtype = self._amp_level, self._amp_dtype
         # ASP n:m sparsity masks (incubate.asp.prune_model attaches them):
         # re-applied in-graph after every update so the compiled path keeps
         # the sparsity guarantee the eager decorated optimizer provides
@@ -163,23 +223,8 @@ class TrainStep:
         def pure_step(params, buffers, opt_state, sc_state, lr, t, key,
                       *batch):
             def loss_of(train_params):
-                all_params = {**params, **train_params}
-                from ..core import autograd as ag
-                from ..amp.auto_cast import auto_cast
-                import contextlib
-                amp_ctx = (auto_cast(level=amp_level, dtype=amp_dtype)
-                           if amp_level else contextlib.nullcontext())
-                # AMP under trace: dispatch-level autocast runs inside the
-                # traced forward, so XLA sees bf16 matmuls with f32 master
-                # params (reference O1/O2, auto_cast.py:668) and fuses the
-                # casts away.
-                with _swapped_state(model, all_params, buffers), ag.no_grad(), \
-                        random_mod.traced_key_scope(key), amp_ctx:
-                    t_batch = [Tensor(a, stop_gradient=True) for a in batch]
-                    out = model(*t_batch[:self._n_inputs])
-                    loss_t = loss_fn(out, *t_batch[self._n_inputs:])
-                l_arr = loss_t._data if isinstance(loss_t, Tensor) else loss_t
-                return l_arr.astype(jnp.float32)
+                return loss_closure(train_params, params, buffers, key,
+                                    batch)
 
             train_params = {n: params[n] for n in trainable_names}
             if scaler is not None:
@@ -288,6 +333,13 @@ class TrainStep:
                     for n, p in self._named_params.items()}
             repl = NamedSharding(mesh, PartitionSpec())
             opt_sh = {}
+            # DGC u/v and LocalSGD per-rank params/accums are stacked
+            # (D, *shape) with the rank dim sharded over 'dp'
+            dp_stacked = (
+                (getattr(self.optimizer, "_dgc_cfg", None) is not None
+                 or getattr(self.optimizer, "_localsgd_cfg", None)
+                 is not None)
+                and "dp" in mesh.axis_names and mesh.shape["dp"] > 1)
             for n, p in self._trainable.items():
                 per = {}
                 # ZeRO stage-1/2: optimizer state shards over the
@@ -301,9 +353,16 @@ class TrainStep:
                     state_sh = p_sh[n]
                 for an in self.optimizer._accum_names:
                     acc = self.optimizer._get_accum(an, p)
-                    per[an] = state_sh if getattr(acc, "ndim", 0) == len(
-                        p.shape) and len(p.shape) > 0 else repl
+                    if dp_stacked and getattr(acc, "ndim", 0) == \
+                            len(p.shape) + 1:
+                        per[an] = NamedSharding(mesh, PartitionSpec("dp"))
+                    else:
+                        per[an] = state_sh if getattr(acc, "ndim", 0) == \
+                            len(p.shape) and len(p.shape) > 0 else repl
                 opt_sh[n] = per
+            if getattr(self.optimizer, "_localsgd_cfg", None) is not None:
+                opt_sh["__ls__"] = {k: repl
+                                    for k in ("k", "last", "loss0", "lr0")}
 
             baxes = _batch_axes(mesh)
             bspec = PartitionSpec(baxes if baxes else None)
@@ -383,7 +442,12 @@ class TrainStep:
         """
         self._n_inputs = n_inputs if n_inputs is not None else \
             max(len(batch) - 1, 1)
-        pure_step = self._make_pure_step()
+        if getattr(self.optimizer, "_dgc_cfg", None) is not None or \
+                getattr(self.optimizer, "_localsgd_cfg", None) is not None:
+            raise NotImplementedError(
+                "aot_lower plans the plain GSPMD step; DGC/LocalSGD "
+                "schedules are not supported there")
+        pure_step = self._make_pure_step_plain()
         repl = NamedSharding(mesh, PartitionSpec())
 
         def sds(shape, dtype, sh):

@@ -331,6 +331,8 @@ class TestLarsMomentum:
         with warnings.catch_warnings(record=True) as w:
             warnings.simplefilter("always")
             _apply_meta_optimizers(opt, strategy)
+        # round-5: dgc/localsgd are REAL schedules now; without a dp>1
+        # mesh they decline the swap with the reference _can_apply gate
         msgs = " ".join(str(x.message) for x in w)
-        assert "dgc" in msgs and "INERT" in msgs
+        assert "dgc" in msgs and "no dp>1 mesh" in msgs
         assert "localsgd" in msgs
