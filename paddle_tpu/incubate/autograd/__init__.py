@@ -6,21 +6,13 @@ GradNode stores its primal fn + input-array snapshot (core/autograd.py),
 so tangents propagate producer→consumer with one ``jax.jvp`` per recorded
 op — the TPU-native analog of the reference's linearize prim pass
 (primapi.py ``forward_grad`` orig2prim→linearize). ``enable_prim`` /
-``disable_prim`` are no-ops by design: jax IS the primitive system.
+``orig2prim`` / ``to_prim`` perform a VISIBLE program rewrite into
+primitive op nodes (see primx.py).
 """
 from ...autograd.functional import hessian, jacobian, jvp, vjp  # noqa: F401
-
-
-def enable_prim():
-    pass
-
-
-def disable_prim():
-    pass
-
-
-def prim_enabled():
-    return True
+from .primx import (  # noqa: F401
+    disable_prim, enable_prim, orig2prim, prim2orig, prim_enabled, to_prim,
+)
 
 
 def forward_grad(outputs, inputs, grad_inputs=None):

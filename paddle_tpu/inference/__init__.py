@@ -273,9 +273,13 @@ class Predictor:
         # the re-lowerable program form (the .pdexec StableHLO is compiled
         # with baked dtypes); otherwise the pre-compiled .pdexec twin is
         # the fast path
+        from ..static.io import pdexec_is_stale
+        stale_exec = pd_bytes is not None and \
+            pdexec_is_stale(config._prefix)
         use_proto = pd_bytes is not None and (
             config._params_path is not None
             or precision != "float32"
+            or stale_exec
             or not os.path.exists(str(config._prefix) + ".pdexec"))
         if use_proto:
             self._artifact = _PdModelArtifact(pd_bytes,

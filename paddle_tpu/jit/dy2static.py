@@ -208,12 +208,18 @@ def _dy2s_cond(pred, true_fn, false_fn, names=None):
                     # the first later USE
                     return _UNDEF
                 return f if t_undef else t
-            ta = t._data if isinstance(t, Tensor) else t
-            fa = f._data if isinstance(f, Tensor) else f
-            out = jnp.where(pred._data if isinstance(pred, Tensor)
-                            else pred, ta, fa)
-            return Tensor(out, stop_gradient=True) \
-                if isinstance(t, Tensor) or isinstance(f, Tensor) else out
+            if isinstance(t, Tensor) or isinstance(f, Tensor):
+                # route through the DISPATCHED where so the autograd tape
+                # records the select: gradient flows through the surviving
+                # branch (the docstring's contract) instead of being cut
+                # by a raw stop_gradient Tensor wrap
+                from ..tensor.search import where as _where
+                tt = t if isinstance(t, Tensor) else Tensor(t)
+                ff = f if isinstance(f, Tensor) else Tensor(f)
+                pr = pred if isinstance(pred, Tensor) else Tensor(pred)
+                return _where(pr, tt, ff)
+            return jnp.where(pred._data if isinstance(pred, Tensor)
+                             else pred, t, f)
         outs = tuple(pick(i, t, f)
                      for i, (t, f) in enumerate(zip(t_out, f_out)))
         return outs[0] if single else outs

@@ -87,17 +87,41 @@ class LoadedProgram:
         return list(out) if isinstance(out, (list, tuple)) else [out]
 
 
+def pdexec_is_stale(prefix) -> bool:
+    """True (with a warning) when <prefix>.pdexec is OLDER than the
+    .pdmodel next to it — a regenerated protobuf pair must win over the
+    stale pre-compiled artifact. Shared by load_inference_model and the
+    inference Predictor routing."""
+    exec_path = str(prefix) + ".pdexec"
+    pdm_path = str(prefix) + ".pdmodel"
+    if not (os.path.exists(exec_path) and os.path.exists(pdm_path)):
+        return False
+    if os.path.getmtime(exec_path) >= os.path.getmtime(pdm_path):
+        return False
+    import warnings
+    warnings.warn(
+        f"{exec_path} is OLDER than {pdm_path} — using the regenerated "
+        f"protobuf pair instead of the stale pre-compiled artifact")
+    return True
+
+
 def load_inference_model(path_prefix, executor=None, **kwargs):
     if path_prefix in _LIVE_MODELS:
         program, feed_list, fetch_list = _LIVE_MODELS[path_prefix]
         feed_names = [v.name for v in feed_list]
         return program, feed_names, fetch_list
 
-    # the pre-compiled StableHLO twin is the fast path when present
+    # the pre-compiled StableHLO twin is the fast path — but an EXPLICIT
+    # .pdmodel path means the caller wants the protobuf pair, and a
+    # .pdexec older than the .pdmodel next to it is a stale artifact
+    # (a regenerated proto pair would otherwise be silently ignored)
     exec_prefix = str(path_prefix)
-    if exec_prefix.endswith(".pdmodel"):
+    explicit_pdmodel = exec_prefix.endswith(".pdmodel")
+    if explicit_pdmodel:
         exec_prefix = exec_prefix[:-len(".pdmodel")]
-    if os.path.exists(exec_prefix + ".pdexec"):
+    use_exec = os.path.exists(exec_prefix + ".pdexec") and \
+        not explicit_pdmodel and not pdexec_is_stale(exec_prefix)
+    if use_exec:
         from ..framework.exporting import load_artifact
 
         prog = LoadedProgram(load_artifact(exec_prefix))

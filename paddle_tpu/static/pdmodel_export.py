@@ -962,8 +962,26 @@ def trace_to_pdmodel(run, weight_arrays: Dict[str, np.ndarray],
     # extents and write them back as -1 in var descs / shape attrs (the
     # reference's [-1, ...] dynamic-batch idiom). Primes are chosen far
     # above real layer extents so "multiple of the sample" reliably marks
-    # dynamic-derived dims (e.g. batch*seq after a flatten).
-    _PRIMES = (9973, 9967, 9949, 9941, 9931, 9929, 9923, 9907)
+    # dynamic-derived dims (e.g. batch*seq after a flatten) — and are
+    # screened against every KNOWN static extent (weight dims + static
+    # feed dims) so a genuine model dimension can never be mistaken for a
+    # dynamic-derived one (round-4 advisor low: a 2*9973 vocab would
+    # otherwise silently export as -1).
+    _POOL = (9973, 9967, 9949, 9941, 9931, 9929, 9923, 9907, 9901,
+             9887, 9883, 9871, 9859, 9851, 9839, 9833, 9829, 9817)
+    protected = {int(d) for arr in weight_arrays.values()
+                 for d in np.shape(arr) if int(d) > 256}
+    for spec in input_specs:
+        protected |= {int(d) for d in spec.shape
+                      if isinstance(d, (int, np.integer)) and int(d) > 256}
+
+    def _clear(p):
+        # a protected static dim within the _is_dyn/_near_dyn bands of
+        # this prime would misclassify — skip the prime
+        return all(d % p != 0 and min(d % p, p - d % p) > 64
+                   for d in protected)
+
+    _PRIMES = tuple(p for p in _POOL if _clear(p))
     sym_to_prime: Dict[str, int] = {}
     concrete_specs = []
     for spec in input_specs:
@@ -976,7 +994,8 @@ def trace_to_pdmodel(run, weight_arrays: Dict[str, np.ndarray],
             if key not in sym_to_prime:
                 if len(sym_to_prime) >= len(_PRIMES):
                     raise _Unsupported(
-                        "more than 8 distinct dynamic dims")
+                        f"no clash-free sample primes left for "
+                        f"{len(sym_to_prime) + 1} distinct dynamic dims")
                 sym_to_prime[key] = _PRIMES[len(sym_to_prime)]
             dims.append(sym_to_prime[key])
         concrete_specs.append(jax.ShapeDtypeStruct(tuple(dims), spec.dtype))

@@ -87,6 +87,12 @@ class Program:
             p.grad_map = dict(self.grad_map)
             p.train_spec = self.train_spec
             p.jvp_map = dict(self.jvp_map)
+        # prim-decomposition state travels with the ops it describes:
+        # a clone of a decomposed program must not be re-decomposed, and
+        # prim2orig on the clone must restore the true originals
+        if getattr(self, "_prim_decomposed", False):
+            p._prim_decomposed = True
+            p._orig_ops_backup = list(self._orig_ops_backup)
         return p
 
     # ---- recording (called from dispatch) ----
@@ -297,6 +303,15 @@ class Executor:
     def _run(self, program, feed, fetch_list, scope, return_numpy):
         program = program or default_main_program()
         feed = feed or {}
+        if isinstance(program, Program):
+            # prim mode (incubate.autograd.enable_prim): lower the program
+            # to its visible primitive decomposition before compiling —
+            # the analog of the reference running orig2prim ahead of
+            # execution (primx.py orig2prim)
+            from ..incubate.autograd import primx
+            if primx.prim_enabled() and not getattr(
+                    program, "_prim_decomposed", False):
+                primx.orig2prim(program)
         from .io import LoadedProgram
         from .pdmodel import PdProgram
         if isinstance(program, (LoadedProgram, PdProgram)):

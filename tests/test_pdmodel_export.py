@@ -167,6 +167,65 @@ class TestJaxprTranslation:
         assert 'parameter: "X"' in txt
 
 
+class TestDynDimPrimeScreening:
+    def test_static_dim_colliding_with_default_prime_stays_static(self):
+        """Round-4 advisor low: a genuine static extent that is an exact
+        multiple of a sample prime (2*9973=19946) must NOT be written as
+        -1 — the prime screen picks a clash-free sample instead."""
+        from paddle_tpu.static.pdmodel import parse_program_desc
+
+        class Spec:
+            def __init__(self, shape, dtype="float32"):
+                self.shape, self.dtype = shape, np.dtype(dtype)
+
+        w = np.random.RandomState(0).randn(19946, 4).astype("float32")
+
+        def run(wlist, ids):
+            return jnp.take(wlist[0], ids, axis=0)
+
+        model, params = trace_to_pdmodel(
+            run, {"emb": w}, [Spec([None, 8], "int64")], ["ids"])
+        desc = parse_program_desc(model)
+        dims_by_var = {v["name"]: v["type"]["dims"]
+                       for v in desc["blocks"][0]["vars"]}
+        assert list(dims_by_var["emb"]) == [19946, 4], dims_by_var["emb"]
+        # the dynamic batch dim is still -1 somewhere in the feed var
+        feed_dims = [d for v in desc["blocks"][0]["vars"]
+                     if not v.get("persistable")
+                     for d in v["type"].get("dims", [])]
+        assert -1 in feed_dims
+
+
+class TestStalePdexecRouting:
+    def test_explicit_pdmodel_path_skips_pdexec(self, tmp_path):
+        paddle.seed(0)
+        net = nn.Linear(4, 3)
+        prefix = os.path.join(str(tmp_path), "m")
+        paddle.jit.save(net, prefix, input_spec=[
+            paddle.static.InputSpec([2, 4], "float32")])
+        from paddle_tpu.static.io import load_inference_model
+        from paddle_tpu.static.pdmodel import PdProgram
+        prog, feeds, fetches = load_inference_model(prefix + ".pdmodel")
+        # explicit .pdmodel path loads the protobuf program, not the
+        # StableHLO twin
+        assert isinstance(prog, PdProgram), type(prog)
+
+    def test_stale_pdexec_warns_and_loads_proto(self, tmp_path):
+        paddle.seed(0)
+        net = nn.Linear(4, 3)
+        prefix = os.path.join(str(tmp_path), "m")
+        paddle.jit.save(net, prefix, input_spec=[
+            paddle.static.InputSpec([2, 4], "float32")])
+        # make the .pdexec look stale next to a regenerated .pdmodel
+        old = os.path.getmtime(prefix + ".pdexec") - 1000
+        os.utime(prefix + ".pdexec", (old, old))
+        from paddle_tpu.static.io import load_inference_model
+        from paddle_tpu.static.pdmodel import PdProgram
+        with pytest.warns(UserWarning, match="OLDER"):
+            prog, _, _ = load_inference_model(prefix)
+        assert isinstance(prog, PdProgram), type(prog)
+
+
 class TestFrameworkIntegration:
     def _lenet(self):
         class Net(nn.Layer):

@@ -1,0 +1,131 @@
+"""Prim decomposition layer (round-4 verdict item 8): orig2prim /
+prim2orig / to_prim / enable_prim as VISIBLE static-Program rewrites.
+
+Reference: python/paddle/incubate/autograd/primx.py (orig2prim:702,
+prim2orig:727), primrules.py op families. Here each recorded op node is
+traced to its jaxpr and spliced back as primitive nodes named after the
+reference's *_p set (matmul_p, exp_p, reduce_sum_p, ...)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+from paddle_tpu.incubate.autograd import (disable_prim, enable_prim,
+                                          orig2prim, prim2orig,
+                                          prim_enabled, to_prim)
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    paddle.enable_static()
+    yield
+    disable_prim()
+    paddle.disable_static()
+
+
+def _build_mlp_program():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4, 8], "float32")
+        w = paddle.create_parameter([8, 6], "float32", name="w_prim")
+        h = paddle.tanh(paddle.matmul(x, w))
+        y = paddle.nn.functional.softmax(h)
+        loss = paddle.mean(y * y)
+    return main, startup, loss
+
+
+class TestOrig2Prim:
+    def test_decomposition_is_visible_and_numerically_identical(self):
+        main, startup, loss = _build_mlp_program()
+        names_before = [op.name for op in main.ops]
+        exe = static.Executor()
+        exe.run(startup)
+        xv = np.random.RandomState(0).randn(4, 8).astype("float32")
+        want = exe.run(main, feed={"x": xv}, fetch_list=[loss])[0]
+
+        orig2prim(main)
+        names = [op.name for op in main.ops]
+        # every node is a primitive, the program got longer, and the
+        # documented families decomposed (softmax -> exp/sum/div chain)
+        assert all(n.endswith("_p") for n in names), names
+        assert len(names) > len(names_before)
+        for expected in ("matmul_p", "tanh_p", "exp_p", "reduce_sum_p",
+                         "div_p", "mul_p"):
+            assert expected in names, (expected, names)
+        got = exe.run(main, feed={"x": xv}, fetch_list=[loss])[0]
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_idempotent(self):
+        main, startup, loss = _build_mlp_program()
+        orig2prim(main)
+        n1 = [op.name for op in main.ops]
+        to_prim(main)               # alias, second call is a no-op
+        assert [op.name for op in main.ops] == n1
+
+    def test_prim2orig_restores(self):
+        main, startup, loss = _build_mlp_program()
+        names_before = [op.name for op in main.ops]
+        orig2prim(main)
+        prim2orig(main)
+        assert [op.name for op in main.ops] == names_before
+
+    def test_gelu_decomposes_to_erf_or_tanh_family(self):
+        """Reference orig2prim 'gelu' rule (primrules.py:477) decomposes
+        into erf- or tanh-approximation primitives."""
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 8], "float32")
+            y = paddle.nn.functional.gelu(x)
+        orig2prim(main)
+        names = [op.name for op in main.ops]
+        assert all(n.endswith("_p") for n in names)
+        assert any(n in names for n in ("erf_p", "erfc_p", "tanh_p")), \
+            names
+        assert "mul_p" in names
+
+    def test_decomposed_program_still_trains(self):
+        """The verdict's acceptance bar: minimize over the decomposed
+        program converges identically to the original."""
+        def build_and_train(decompose):
+            paddle.seed(0)
+            main, startup = static.Program(), static.Program()
+            with static.program_guard(main, startup):
+                x = static.data("x", [8, 4], "float32")
+                lbl = static.data("lbl", [8, 2], "float32")
+                w = paddle.create_parameter([4, 2], "float32",
+                                            name="w_train")
+                pred = paddle.tanh(paddle.matmul(x, w))
+                loss = paddle.mean((pred - lbl) ** 2)
+                opt = paddle.optimizer.SGD(learning_rate=0.5)
+                opt.minimize(loss)
+            exe = static.Executor()
+            exe.run(startup)
+            if decompose:
+                orig2prim(main)
+                assert all(op.name.endswith("_p") for op in main.ops)
+            rng = np.random.RandomState(0)
+            xv = rng.randn(8, 4).astype("float32")
+            yv = rng.randn(8, 2).astype("float32")
+            return [float(exe.run(main, feed={"x": xv, "lbl": yv},
+                                  fetch_list=[loss])[0])
+                    for _ in range(5)]
+
+        plain = build_and_train(False)
+        prim = build_and_train(True)
+        assert prim[-1] < prim[0], prim
+        np.testing.assert_allclose(prim, plain, rtol=1e-5)
+
+    def test_enable_prim_lowers_at_executor_run(self):
+        main, startup, loss = _build_mlp_program()
+        exe = static.Executor()
+        exe.run(startup)
+        assert not prim_enabled()
+        enable_prim()
+        assert prim_enabled()
+        xv = np.random.RandomState(1).randn(4, 8).astype("float32")
+        exe.run(main, feed={"x": xv}, fetch_list=[loss])
+        # the decomposition is VISIBLE on the program after the run
+        assert getattr(main, "_prim_decomposed", False)
+        assert all(op.name.endswith("_p") for op in main.ops)
+        disable_prim()
+        assert not prim_enabled()

@@ -1,0 +1,75 @@
+"""Regenerate the API-compatibility golden (tests/fixtures/api_golden.json).
+
+Reference analog: /root/reference/tools/check_api_compatible.py — the CI
+gate that fails when a public symbol disappears or an op loses its
+registration. Run this ONLY when an API addition/removal is intentional:
+
+    python tools/gen_api_golden.py
+
+The paired gate is tests/test_api_gate.py: it fails when any golden
+symbol, registry op, or pdmodel converter is missing from the current
+tree (additions are allowed — regenerate to lock them in).
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+SURFACES = [
+    "paddle_tpu",
+    "paddle_tpu.nn",
+    "paddle_tpu.nn.functional",
+    "paddle_tpu.tensor",
+    "paddle_tpu.static",
+    "paddle_tpu.distributed",
+    "paddle_tpu.distributed.fleet",
+    "paddle_tpu.optimizer",
+    "paddle_tpu.inference",
+    "paddle_tpu.io",
+    "paddle_tpu.amp",
+    "paddle_tpu.jit",
+    "paddle_tpu.vision",
+    "paddle_tpu.incubate.autograd",
+]
+
+
+def public_names(mod):
+    allv = getattr(mod, "__all__", None)
+    if allv:
+        return sorted(allv)
+    return sorted(n for n in dir(mod) if not n.startswith("_"))
+
+
+def main():
+    import importlib
+
+    golden = {"surfaces": {}, "ops": [], "converters": []}
+    for name in SURFACES:
+        mod = importlib.import_module(name)
+        golden["surfaces"][name] = public_names(mod)
+
+    from paddle_tpu.ops import registry
+    golden["ops"] = sorted(registry.op_names())
+
+    from paddle_tpu.static.pdmodel import _CONVERTERS
+    golden["converters"] = sorted(_CONVERTERS.keys())
+
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tests", "fixtures",
+        "api_golden.json")
+    with open(out, "w") as f:
+        json.dump(golden, f, indent=1, sort_keys=True)
+    n = sum(len(v) for v in golden["surfaces"].values())
+    print(f"wrote {out}: {n} symbols over {len(SURFACES)} surfaces, "
+          f"{len(golden['ops'])} registry ops, "
+          f"{len(golden['converters'])} converters")
+
+
+if __name__ == "__main__":
+    main()
