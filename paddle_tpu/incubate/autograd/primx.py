@@ -131,6 +131,9 @@ def orig2prim(program=None):
     if getattr(prog, "_prim_decomposed", False):
         return prog
     prog._orig_ops_backup = list(prog.ops)
+    # ids of placeholder vars this decomposition registers, so prim2orig
+    # can drop them again (var_by_id stays bounded across round-trips)
+    prog._prim_var_ids = set()
 
     new_ops: List[_OpNode] = []
     for op in prog.ops:
@@ -157,6 +160,7 @@ def orig2prim(program=None):
                 return _env[id(var)]
             t = Tensor(placeholder_val, stop_gradient=True)
             prog.var_by_id[id(t)] = t
+            prog._prim_var_ids.add(id(t))
             _env[id(var)] = id(t)
             return id(t)
 
@@ -264,6 +268,9 @@ def prim2orig(program=None, blacklist=None):
     if backup is not None:
         prog.ops = list(backup)
         prog._prim_decomposed = False
+        for vid in getattr(prog, "_prim_var_ids", ()):
+            prog.var_by_id.pop(vid, None)
+        prog._prim_var_ids = set()
         prog._compile_cache.clear()
     return prog
 

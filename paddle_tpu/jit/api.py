@@ -217,13 +217,15 @@ def save(layer, path, input_spec=None, **configs):
         b = {n[2:]: a for n, a in w.items() if n.startswith("b.")}
         return functional_call(layer, p, b, *inputs, training=False)
 
-    export_artifact(path, run, weights, specs, feed_names=names)
-
-    # reference wire format (.pdmodel ProgramDesc + .pdiparams) so models
-    # trained here deploy to Paddle Inference / paddle2onnx consumers
+    # reference wire format (.pdmodel ProgramDesc + .pdiparams) FIRST so
+    # models trained here deploy to Paddle Inference / paddle2onnx
+    # consumers — and so the .pdexec written after it is never older than
+    # the .pdmodel of the same export (pdexec_is_stale would otherwise
+    # flag every fresh save)
     if configs.get("pdmodel_format", True):
         from ..static.pdmodel_export import save_pdmodel_or_warn
         save_pdmodel_or_warn(path, run, weights, specs, names)
+    export_artifact(path, run, weights, specs, feed_names=names)
 
 
 class TranslatedLayer(Layer):

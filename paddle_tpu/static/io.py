@@ -50,14 +50,14 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
 
     specs = [jax.ShapeDtypeStruct(tuple(v.shape), v._data.dtype)
              for v in feed_list]
-    export_artifact(path_prefix, run, weights, specs, feed_names=feed_names)
-
-    # reference wire format: .pdmodel ProgramDesc + .pdiparams stream
-    # (skippable only when a program uses a jax primitive with no fluid-op
-    # lowering — loudly, never silently)
+    # .pdmodel pair first, .pdexec second: the fast-path artifact of one
+    # export must never be older than its own .pdmodel (pdexec_is_stale)
     if kwargs.get("pdmodel_format", True):
+        # reference wire format (skippable only when a program uses a jax
+        # primitive with no fluid-op lowering — loudly, never silently)
         from .pdmodel_export import save_pdmodel_or_warn
         save_pdmodel_or_warn(path_prefix, run, weights, specs, feed_names)
+    export_artifact(path_prefix, run, weights, specs, feed_names=feed_names)
 
     # keep the live program registered for same-process serving
     _LIVE_MODELS[path_prefix] = (program, feed_list, fetch_list)

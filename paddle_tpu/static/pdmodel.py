@@ -256,6 +256,21 @@ def parse_program_desc(data: bytes) -> Dict[str, Any]:
             for f2, _w2, v2 in _iter_fields(v):
                 if f2 == 1:
                     prog["version"] = _signed(v2)
+        elif field == 5:  # OpVersionMap{pair=1: {op_name=1, op_version=2}}
+            ovm = prog.setdefault("op_version_map", {})
+            for f2, _w2, v2 in _iter_fields(v):
+                if f2 != 1:
+                    continue
+                oname, over = "", 0
+                for f3, _w3, v3 in _iter_fields(v2):
+                    if f3 == 1:
+                        oname = v3.decode("utf-8")
+                    elif f3 == 2:  # OpVersion{version=1}
+                        for f4, _w4, v4 in _iter_fields(v3):
+                            if f4 == 1:
+                                over = _signed(v4)
+                if oname:
+                    ovm[oname] = over
     if not prog["blocks"]:
         raise ValueError("no blocks in ProgramDesc (corrupt pdmodel)")
     return prog

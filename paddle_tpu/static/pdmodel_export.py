@@ -170,6 +170,16 @@ def serialize_program_desc(desc: Dict[str, Any]) -> bytes:
             buf += _ld(4, _encode_op(op))
         out += _ld(1, buf)
     out += _ld(4, _vi(1, desc.get("version", 0)))
+    # OpVersionMap (framework.proto:229) — the reference writer stamps the
+    # version of every op kind it emitted (op_version_registry.h)
+    ovm = desc.get("op_version_map") or {}
+    if ovm:
+        pairs = b""
+        for oname in sorted(ovm):
+            pair = _ld(1, oname.encode("utf-8")) + \
+                _ld(2, _vi(1, int(ovm[oname])))
+            pairs += _ld(1, pair)
+        out += _ld(5, pairs)
     return out
 
 

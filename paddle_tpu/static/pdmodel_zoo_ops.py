@@ -253,6 +253,129 @@ def _fused_multi_transformer(jnp, ins, attrs):
     return {"Out": [h]}
 
 
+def _fused_multi_transformer_int8(jnp, ins, attrs):
+    """Int8-weight decoder stack (reference
+    fused_multi_transformer_int8_op.cc): per gemm, the input is quantized
+    as clip(round(max_bound * in_scale * x)) (quant_dequant_kernel.h:37,
+    round ties-away-from-zero by default), multiplied in int8 with int32
+    accumulation, and dequantized by the per-output-channel OutScale
+    input (dequantize_kernel:123 out = i32 * out_scale[col]). Attention
+    math stays float, as in the reference kernel. On TPU the int8 x int8
+    -> int32 einsum maps straight onto the MXU's int8 path."""
+    import jax
+
+    x = ins["X"][0]
+    n_layers = len(ins["QKVW"])
+    pre_ln = attrs.get("pre_layer_norm", True)
+    eps = attrs.get("epsilon", 1e-5)
+    act = _act_by_name(jnp, attrs.get("act_method", "gelu"))
+    if ins.get("CacheKV") or ins.get("TimeStep"):
+        raise NotImplementedError(
+            "fused_multi_transformer_int8 with KV cache (generation "
+            "loop) (pdmodel interop table)")
+    mask = ins["SrcMask"][0] if ins.get("SrcMask") else None
+    trans_qkvw = attrs.get("trans_qkvw", True)
+    max_b = attrs.get("quant_max_bound", 127.0)
+    min_b = attrs.get("quant_min_bound", -127.0)
+    round_type = attrs.get("quant_round_type", 1)
+    for req in ("QKVOutScale", "OutLinearOutScale", "FFN1OutScale",
+                "FFN2OutScale"):
+        if not ins.get(req):
+            raise NotImplementedError(
+                f"fused_multi_transformer_int8 without {req} "
+                f"(dequant scales are required)")
+    for req in ("qkv_in_scale", "out_linear_in_scale", "ffn1_in_scale",
+                "ffn2_in_scale"):
+        if len(attrs.get(req, [])) < n_layers:
+            raise NotImplementedError(
+                f"fused_multi_transformer_int8: attr {req} has "
+                f"{len(attrs.get(req, []))} entries for {n_layers} "
+                f"layers (quant scales are required per layer)")
+
+    def rnd(v):
+        if round_type == 0:         # ties to even
+            return jnp.round(v)
+        # ties away from zero (kernel default)
+        return jnp.sign(v) * jnp.floor(jnp.abs(v) + 0.5)
+
+    def q8(v, in_scale):
+        qv = rnd(max_b * in_scale * v.astype(jnp.float32))
+        return jnp.clip(qv, min_b, max_b).astype(jnp.int8)
+
+    def scl(name, i):
+        return float(attrs.get(name, [])[i])
+
+    def opt(key, i):
+        seq = ins.get(key)
+        return seq[i] if seq and i < len(seq) and seq[i] is not None \
+            else None
+
+    h = x
+    for i in range(n_layers):
+        qkv_w = ins["QKVW"][i]
+        if trans_qkvw:
+            _, num_heads, dim_head, d = qkv_w.shape   # [3, H, dh, D]
+        else:
+            d, _, num_heads, dim_head = qkv_w.shape   # [D, 3, H, dh]
+        residual = h
+        z = _layer_norm_last(jnp, h, opt("LnScale", i), opt("LnBias", i),
+                             eps) if pre_ln else h
+        zq = q8(z, scl("qkv_in_scale", i))
+        spec = "bsd,thed->bsthe" if trans_qkvw else "bsd,dthe->bsthe"
+        qkv32 = jnp.einsum(spec, zq, qkv_w.astype(jnp.int8),
+                           preferred_element_type=jnp.int32)
+        oscale = ins["QKVOutScale"][i].reshape(3, num_heads, dim_head)
+        qkv = qkv32.astype(jnp.float32) * oscale
+        b = opt("QKVBias", i)
+        if b is not None:
+            qkv = qkv + b
+        q, k, v = (qkv[:, :, j] for j in range(3))
+        q, k, v = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+        s = jnp.einsum("bhsd,bhtd->bhst", q, k) / np.sqrt(dim_head)
+        if mask is not None:
+            s = s + mask
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhst,bhtd->bhsd", p, v)
+        o = jnp.swapaxes(o, 1, 2).reshape(z.shape[0], z.shape[1], d)
+        oq = q8(o, scl("out_linear_in_scale", i))
+        o32 = jnp.einsum("bsd,de->bse", oq,
+                         ins["OutLinearW"][i].astype(jnp.int8),
+                         preferred_element_type=jnp.int32)
+        o = o32.astype(jnp.float32) * ins["OutLinearOutScale"][i]
+        ob = opt("OutLinearBias", i)
+        if ob is not None:
+            o = o + ob
+        h = residual + o
+        if not pre_ln:
+            h = _layer_norm_last(jnp, h, opt("LnScale", i),
+                                 opt("LnBias", i), eps)
+        residual = h
+        z = _layer_norm_last(jnp, h, opt("FFNLnScale", i),
+                             opt("FFNLnBias", i), eps) if pre_ln else h
+        zq = q8(z, scl("ffn1_in_scale", i))
+        f32_1 = jnp.einsum("bsd,de->bse", zq,
+                           ins["FFN1Weight"][i].astype(jnp.int8),
+                           preferred_element_type=jnp.int32)
+        z = f32_1.astype(jnp.float32) * ins["FFN1OutScale"][i]
+        fb = opt("FFN1Bias", i)
+        if fb is not None:
+            z = z + fb
+        z = act(z)
+        zq = q8(z, scl("ffn2_in_scale", i))
+        f32_2 = jnp.einsum("bsd,de->bse", zq,
+                           ins["FFN2Weight"][i].astype(jnp.int8),
+                           preferred_element_type=jnp.int32)
+        z = f32_2.astype(jnp.float32) * ins["FFN2OutScale"][i]
+        fb2 = opt("FFN2Bias", i)
+        if fb2 is not None:
+            z = z + fb2
+        h = residual + z
+        if not pre_ln:
+            h = _layer_norm_last(jnp, h, opt("FFNLnScale", i),
+                                 opt("FFNLnBias", i), eps)
+    return {"Out": [h]}
+
+
 def _fused_embedding_eltwise_layernorm(jnp, ins, attrs):
     """sum of embedding lookups + layer_norm (ERNIE/BERT inference fusion,
     paddle/fluid/operators/fused/fused_embedding_eltwise_layernorm_op.cc)."""
@@ -859,6 +982,7 @@ def _register():
     C["fused_bias_dropout_residual_layer_norm"] = \
         _fused_bias_dropout_residual_ln
     C["fused_multi_transformer"] = _fused_multi_transformer
+    C["fused_multi_transformer_int8"] = _fused_multi_transformer_int8
     C["fused_embedding_eltwise_layernorm"] = \
         _fused_embedding_eltwise_layernorm
     C["skip_layernorm"] = _skip_layernorm

@@ -93,6 +93,7 @@ class Program:
         if getattr(self, "_prim_decomposed", False):
             p._prim_decomposed = True
             p._orig_ops_backup = list(self._orig_ops_backup)
+            p._prim_var_ids = set(getattr(self, "_prim_var_ids", ()))
         return p
 
     # ---- recording (called from dispatch) ----

@@ -1,0 +1,321 @@
+"""Decoder/encoder round-trip fuzz against Google protobuf (round-4
+verdict item 9: interop realism).
+
+The oracle is protoc-generated python protobuf over the reference's own
+framework.proto — an implementation independent of the hand-rolled wire
+codec in static/pdmodel.py / pdmodel_export.py. Randomized ProgramDescs
+cover the fields the reference writer actually emits: every attr type,
+LoD levels, need_check_feed/stop_gradient var flags, op_callstack /
+op_namescope attrs, and the OpVersionMap
+(paddle/fluid/framework/op_version_registry.h)."""
+import os
+import struct
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow      # needs protoc + pb2 codegen
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+@pytest.fixture(scope="module")
+def fp():
+    from make_pdmodel_fixture import gen_pb2
+    try:
+        return gen_pb2()
+    except Exception as e:          # pragma: no cover
+        pytest.skip(f"protoc unavailable: {e}")
+
+
+_DTYPES = [0, 2, 3, 5, 6, 21, 22]
+_OPNAMES = ["matmul_v2", "relu", "elementwise_add", "conv2d", "scale",
+            "reshape2", "softmax", "fused_multi_transformer",
+            "quantize_linear", "custom_op_xyz"]
+
+
+def _rand_attrs(rng, fp, a_container):
+    """Attach 0-6 random attrs of every wire-relevant type."""
+    picks = rng.randint(0, 7)
+    for j in range(picks):
+        a = a_container.attrs.add()
+        a.name = f"attr_{j}"
+        kind = rng.randint(0, 9)
+        if kind == 0:
+            a.type = fp.INT
+            a.i = int(rng.randint(-1000, 1000))
+        elif kind == 1:
+            a.type = fp.FLOAT
+            a.f = float(np.float32(rng.randn()))
+        elif kind == 2:
+            a.type = fp.STRING
+            a.s = f"s{rng.randint(0, 100)}"
+        elif kind == 3:
+            a.type = fp.INTS
+            a.ints.extend(int(x) for x in rng.randint(-50, 50, 3))
+        elif kind == 4:
+            a.type = fp.FLOATS
+            a.floats.extend(float(np.float32(x)) for x in rng.randn(3))
+        elif kind == 5:
+            a.type = fp.STRINGS
+            a.strings.extend([f"t{i}" for i in range(3)])
+        elif kind == 6:
+            a.type = fp.BOOLEAN
+            a.b = bool(rng.randint(0, 2))
+        elif kind == 7:
+            a.type = fp.LONG
+            a.l = int(rng.randint(-2**40, 2**40))
+        else:
+            a.type = fp.LONGS
+            a.longs.extend(int(x) for x in
+                           rng.randint(-2**40, 2**40, 3))
+
+
+def _rand_program(rng, fp):
+    prog = fp.ProgramDesc()
+    prog.version.version = int(rng.choice([0, 2007000, 2600000]))
+    block = prog.blocks.add()
+    block.idx = 0
+    block.parent_idx = -1
+    n_vars = rng.randint(1, 6)
+    names = [f"var_{i}" for i in range(n_vars)]
+    for name in names:
+        v = block.vars.add()
+        v.name = name
+        v.type.type = 7  # LOD_TENSOR
+        v.type.lod_tensor.tensor.data_type = int(rng.choice(_DTYPES))
+        dims = [int(d) for d in rng.randint(1, 64, rng.randint(1, 4))]
+        if rng.rand() < 0.3:
+            dims[0] = -1
+        v.type.lod_tensor.tensor.dims.extend(dims)
+        v.type.lod_tensor.lod_level = int(rng.randint(0, 3))
+        v.persistable = bool(rng.randint(0, 2))
+        v.need_check_feed = bool(rng.randint(0, 2))
+        v.stop_gradient = bool(rng.randint(0, 2))
+    for i in range(rng.randint(1, 5)):
+        op = block.ops.add()
+        op.type = str(rng.choice(_OPNAMES))
+        for slot in ("X", "Y")[:rng.randint(1, 3)]:
+            iv = op.inputs.add()
+            iv.parameter = slot
+            iv.arguments.extend(
+                [str(rng.choice(names)) for _ in range(rng.randint(1, 3))])
+        ov = op.outputs.add()
+        ov.parameter = "Out"
+        ov.arguments.append(str(rng.choice(names)))
+        _rand_attrs(rng, fp, op)
+        # the reference writer stamps these on every op
+        cs = op.attrs.add()
+        cs.name = "op_callstack"
+        cs.type = fp.STRINGS
+        cs.strings.extend(['File "train.py", line 10', "  loss = net(x)"])
+        ns = op.attrs.add()
+        ns.name = "op_namescope"
+        ns.type = fp.STRING
+        ns.s = "/fuzz/"
+    if rng.rand() < 0.7:
+        for oname in set(str(rng.choice(_OPNAMES))
+                         for _ in range(rng.randint(1, 4))):
+            pair = prog.op_version_map.pair.add()
+            pair.op_name = oname
+            pair.op_version.version = int(rng.randint(0, 5))
+    return prog
+
+
+def _attr_value(fp, a):
+    t = a.type
+    if t == fp.INT:
+        return a.i
+    if t == fp.FLOAT:
+        return pytest.approx(a.f, rel=1e-6)
+    if t == fp.STRING:
+        return a.s
+    if t == fp.INTS:
+        return list(a.ints)
+    if t == fp.FLOATS:
+        return [pytest.approx(x, rel=1e-6) for x in a.floats]
+    if t == fp.STRINGS:
+        return list(a.strings)
+    if t == fp.BOOLEAN:
+        return a.b
+    if t == fp.LONG:
+        return a.l
+    if t == fp.LONGS:
+        return list(a.longs)
+    raise AssertionError(f"unhandled attr type {t}")
+
+
+class TestDecodeFuzz:
+    def test_random_programs_decode_exactly(self, fp):
+        from paddle_tpu.static.pdmodel import parse_program_desc
+
+        rng = np.random.RandomState(0)
+        for trial in range(25):
+            prog = _rand_program(rng, fp)
+            desc = parse_program_desc(prog.SerializeToString())
+            assert desc["version"] == prog.version.version, trial
+            got_ovm = desc.get("op_version_map", {})
+            want_ovm = {p.op_name: p.op_version.version
+                        for p in prog.op_version_map.pair}
+            assert got_ovm == want_ovm, trial
+            block = desc["blocks"][0]
+            pv = {v.name: v for v in prog.blocks[0].vars}
+            assert {v["name"] for v in block["vars"]} == set(pv)
+            for v in block["vars"]:
+                w = pv[v["name"]]
+                assert v["type"]["dtype"] == \
+                    w.type.lod_tensor.tensor.data_type
+                assert list(v["type"]["dims"]) == \
+                    list(w.type.lod_tensor.tensor.dims)
+                assert v["type"]["lod_level"] == \
+                    w.type.lod_tensor.lod_level
+                assert v["persistable"] == w.persistable
+            for op_d, op_p in zip(block["ops"], prog.blocks[0].ops):
+                assert op_d["type"] == op_p.type
+                for iv in op_p.inputs:
+                    assert op_d["inputs"][iv.parameter] == \
+                        list(iv.arguments)
+                for a in op_p.attrs:
+                    assert op_d["attrs"][a.name] == _attr_value(fp, a), \
+                        (trial, a.name, a.type)
+
+
+class TestEncodeFuzz:
+    def test_reencoded_programs_parse_identically_by_protobuf(self, fp):
+        """our-decode -> our-encode -> GOOGLE-protobuf-decode must agree
+        with the original message on every supported field."""
+        from paddle_tpu.static.pdmodel import parse_program_desc
+        from paddle_tpu.static.pdmodel_export import serialize_program_desc
+
+        rng = np.random.RandomState(1)
+        for trial in range(25):
+            orig = _rand_program(rng, fp)
+            desc = parse_program_desc(orig.SerializeToString())
+            back = fp.ProgramDesc()
+            back.ParseFromString(serialize_program_desc(desc))
+            assert back.version.version == orig.version.version
+            assert {p.op_name: p.op_version.version
+                    for p in back.op_version_map.pair} == \
+                {p.op_name: p.op_version.version
+                 for p in orig.op_version_map.pair}, trial
+            ob, bb = orig.blocks[0], back.blocks[0]
+            bv = {v.name: v for v in bb.vars}
+            for w in ob.vars:
+                v = bv[w.name]
+                assert v.type.lod_tensor.tensor.data_type == \
+                    w.type.lod_tensor.tensor.data_type
+                assert list(v.type.lod_tensor.tensor.dims) == \
+                    list(w.type.lod_tensor.tensor.dims)
+                assert v.type.lod_tensor.lod_level == \
+                    w.type.lod_tensor.lod_level
+                assert v.persistable == w.persistable
+            for op_b, op_o in zip(bb.ops, ob.ops):
+                assert op_b.type == op_o.type
+                b_in = {x.parameter: list(x.arguments) for x in op_b.inputs}
+                o_in = {x.parameter: list(x.arguments) for x in op_o.inputs}
+                assert b_in == o_in
+                b_at = {a.name: a for a in op_b.attrs}
+                for a in op_o.attrs:
+                    assert a.name in b_at, (trial, a.name)
+                    assert _attr_value(fp, b_at[a.name]) == \
+                        _attr_value(fp, a), (trial, a.name)
+
+
+class TestStampedFixture:
+    def test_lod_and_op_version_stamped_model_serves(self, fp, tmp_path):
+        """A fixture carrying the fields a GENUINE reference export has —
+        lod_level on sequence inputs, op_callstack/op_namescope attrs,
+        OpVersionMap — must load, surface the metadata, and serve."""
+        import jax.numpy as jnp
+        from paddle_tpu.static.pdmodel import load_pdmodel
+
+        prog = fp.ProgramDesc()
+        prog.version.version = 2600000
+        block = prog.blocks.add()
+        block.idx, block.parent_idx = 0, -1
+
+        def add_var(name, dims, dtype=5, persistable=False, lod=0,
+                    vtype=7):
+            v = block.vars.add()
+            v.name = name
+            v.type.type = vtype
+            if vtype == 7:
+                v.type.lod_tensor.tensor.data_type = dtype
+                v.type.lod_tensor.tensor.dims.extend(dims)
+                v.type.lod_tensor.lod_level = lod
+            v.persistable = persistable
+            if not persistable and vtype == 7:
+                v.need_check_feed = True
+            return v
+
+        add_var("feed", [], vtype=9)
+        add_var("fetch", [], vtype=10)
+        add_var("x", [-1, 4], lod=1)          # LoD-bearing input
+        add_var("w", [4, 3], persistable=True)
+        add_var("y", [-1, 3])
+
+        def add_op(op_type, ins, outs, attrs=None, stamp=True):
+            op = block.ops.add()
+            op.type = op_type
+            for k, args in ins.items():
+                iv = op.inputs.add()
+                iv.parameter = k
+                iv.arguments.extend(args)
+            for k, args in outs.items():
+                ov = op.outputs.add()
+                ov.parameter = k
+                ov.arguments.extend(args)
+            for name, val in (attrs or {}).items():
+                a = op.attrs.add()
+                a.name = name
+                if isinstance(val, bool):
+                    a.type = fp.BOOLEAN
+                    a.b = val
+                elif isinstance(val, int):
+                    a.type = fp.INT
+                    a.i = val
+            if stamp:
+                cs = op.attrs.add()
+                cs.name = "op_callstack"
+                cs.type = fp.STRINGS
+                cs.strings.extend(['File "export.py", line 3'])
+                ns = op.attrs.add()
+                ns.name = "op_namescope"
+                ns.type = fp.STRING
+                ns.s = "/"
+
+        add_op("feed", {"X": ["feed"]}, {"Out": ["x"]}, {"col": 0},
+               stamp=False)
+        add_op("matmul_v2", {"X": ["x"], "Y": ["w"]}, {"Out": ["y"]},
+               {"trans_x": False, "trans_y": False})
+        add_op("fetch", {"X": ["y"]}, {"Out": ["fetch"]}, {"col": 0},
+               stamp=False)
+        pair = prog.op_version_map.pair.add()
+        pair.op_name = "matmul_v2"
+        pair.op_version.version = 8
+
+        rng = np.random.RandomState(0)
+        w = rng.randn(4, 3).astype("float32")
+        # save_combine stream with ONE real LoD level on the weight entry
+        # exercising the lod-skipping branch of parse_combined_params
+        from paddle_tpu.static.pdmodel_export import _encode_tensor_desc
+        stream = bytearray()
+        stream += struct.pack("<I", 0)
+        lod = np.asarray([0, 2, 4], np.uint64).tobytes()
+        stream += struct.pack("<Q", 1)
+        stream += struct.pack("<Q", len(lod)) + lod
+        stream += struct.pack("<I", 0)
+        desc_b = _encode_tensor_desc(5, w.shape)
+        stream += struct.pack("<i", len(desc_b)) + desc_b
+        stream += w.tobytes()
+
+        pd = load_pdmodel(prog.SerializeToString(), bytes(stream))
+        assert pd.desc.get("op_version_map") == {"matmul_v2": 8}
+        xvar = next(v for v in pd.desc["blocks"][0]["vars"]
+                    if v["name"] == "x")
+        assert xvar["type"]["lod_level"] == 1
+        x = rng.randn(2, 4).astype("float32")
+        out = pd.run({"x": x})[0]
+        np.testing.assert_allclose(np.asarray(out), x @ w, rtol=1e-5)
