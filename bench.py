@@ -260,6 +260,23 @@ def main():
             metric = f"{metric[:metric.index('_train')]}_s{seq}" \
                      "_train_tokens_per_sec"
 
+    if not args.smoke and seq >= 2048:
+        # flash kicks in at FLAGS_flash_min_seqlen (2048): autotune the
+        # block sizes for THIS attention shape eagerly (fwd+bwd timing,
+        # persisted) — the traced TrainStep picks the winner up through
+        # the "mha_step" cache instead of the static 512x1024 default
+        from paddle_tpu.ops import flash_attention
+        # key the tuning on the dtype attention will actually run in
+        # (bf16 under AMP autocast, f32 under --no-amp) or the cache
+        # entry can never be hit by the traced dispatch
+        tune_dtype = "float32" if args.no_amp else "bfloat16"
+        picked = flash_attention.pretune(
+            batch, cfg.num_heads, seq, cfg.hidden_size // cfg.num_heads,
+            dtype=tune_dtype)
+        if picked:
+            print(f"# flash pretune s={seq}: block_q={picked[0]} "
+                  f"block_k={picked[1]}", file=sys.stderr)
+
     model = GPTForCausalLM(cfg)
     crit = GPTPretrainingCriterion()
     opt = paddle.optimizer.AdamW(learning_rate=1e-4,

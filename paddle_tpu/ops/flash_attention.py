@@ -79,13 +79,56 @@ def clip_blocks(bq, bk, sq, sk):
 
 def _block_candidates(sq, sk):
     """Valid (block_q, block_k) choices for the autotuner (multiples of
-    128 that divide the sequence lengths)."""
-    cands = []
-    for bq in (128, 256, 512):
-        for bk in (128, 256, 512):
-            if sq % bq == 0 and sk % bk == 0:
-                cands.append((bq, bk))
+    128 that divide the sequence lengths). Long sequences admit larger
+    tiles — at s=4096/8192 the online-softmax rescaling amortizes over
+    bigger K spans and the 512x1024 global default stops being optimal
+    (round-4 verdict item 3); VMEM-infeasible candidates fail to compile
+    and are skipped by autotune.pick."""
+    bqs = [128, 256, 512] + ([1024] if sq >= 4096 else [])
+    bks = [128, 256, 512, 1024] + ([2048] if sk >= 8192 else [])
+    cands = [(bq, bk) for bq in bqs for bk in bks
+             if sq % bq == 0 and sk % bk == 0]
     return cands or [(128, 128)]
+
+
+def pretune(batch, num_heads, seq_len, head_dim, dtype="bfloat16",
+            causal=True, kv_len=None):
+    """Eagerly autotune flash block sizes for one attention shape by
+    timing the WHOLE fwd+bwd step per candidate on the real device, and
+    persist the winner ("mha_step" cache) where the traced dispatch will
+    find it. Call before compiling a TrainStep on a long-context config —
+    the autotuner cannot time inside a trace (perf-lessons), so without
+    pre-tuning traced calls fall back to the static default."""
+    from . import autotune
+    from .pallas_attention import mha
+
+    if not _on_tpu() or not autotune.enabled():
+        return None
+    sk = kv_len or seq_len
+    cands = _block_candidates(seq_len, sk)
+    if len(cands) <= 1:
+        return cands[0]
+    key = jax.random.PRNGKey(0)
+    shape = (batch, num_heads, seq_len, head_dim)
+    qt = jax.random.normal(key, shape, jnp.float32).astype(dtype)
+    kt = jax.random.normal(key, (batch, num_heads, sk, head_dim),
+                           jnp.float32).astype(dtype)
+    vt = kt
+    s = 1.0 / math.sqrt(head_dim)
+
+    def make_fn(c):
+        def step(a, x, y):
+            def loss(a, x, y):
+                return jnp.sum(mha(a, x, y, causal, s, c[0], c[1])
+                               .astype(jnp.float32))
+            g = jax.grad(loss, argnums=(0, 1, 2))(a, x, y)
+            return g
+        return jax.jit(step)
+
+    return autotune.pick(
+        "mha_step",
+        (batch, num_heads, seq_len, sk, head_dim, str(qt.dtype), causal),
+        cands, make_fn, (qt, kt, vt))
 
 
 def flash_attention_bshd(q, k, v, causal=False, scale=None):
@@ -111,11 +154,13 @@ def flash_attention_bshd(q, k, v, causal=False, scale=None):
             (qt, kt, vt))
     else:
         # traced call: can't time here — use a prior (possibly on-disk)
-        # tuning result for this shape, an explicit flag override, else
-        # the measured-good default (512, 1024 capped to the sequence)
+        # tuning result for this shape (fwd+bwd "mha_step" pretune wins
+        # over a fwd-only result), an explicit flag override, else the
+        # measured-good default (512, 1024 capped to the sequence)
         from ..framework.flags import flag_value
-        hit = autotune.cached("mha_fwd", (b, h, sq, sk, d, str(qt.dtype),
-                                          causal))
+        shape_key = (b, h, sq, sk, d, str(qt.dtype), causal)
+        hit = autotune.cached("mha_step", shape_key) or \
+            autotune.cached("mha_fwd", shape_key)
         fq = int(flag_value("FLAGS_flash_block_q"))
         fk = int(flag_value("FLAGS_flash_block_k"))
         if fq or fk:

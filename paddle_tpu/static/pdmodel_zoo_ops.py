@@ -273,6 +273,10 @@ def _fused_multi_transformer_int8(jnp, ins, attrs):
         raise NotImplementedError(
             "fused_multi_transformer_int8 with KV cache (generation "
             "loop) (pdmodel interop table)")
+    if attrs.get("rotary_emb_dims", 0):
+        raise NotImplementedError(
+            "fused_multi_transformer_int8 rotary embeddings "
+            "(pdmodel interop table)")
     mask = ins["SrcMask"][0] if ins.get("SrcMask") else None
     trans_qkvw = attrs.get("trans_qkvw", True)
     max_b = attrs.get("quant_max_bound", 127.0)
