@@ -56,13 +56,19 @@ def _probe_backend(timeout=None, retries=None, sleep_s=20):
 
 
 def _bench_resnet(args, paddle, TrainStep):
-    """BASELINE config 2: ResNet-50 training images/s (measured ~2,240
-    at b=128 AMP O2; vs_baseline is images/s / 2000 — a round v5e
-    single-chip waypoint, no published reference number exists)."""
+    """BASELINE config 2: ResNet-50 training images/s (vs_baseline is
+    images/s / 2000 — a round v5e single-chip waypoint, no published
+    reference number exists). Default layout is NHWC, the MXU-native
+    fast path (round-4 measured +11% over NCHW; the input pipeline
+    produces channels-last directly — a real TPU training setup decodes
+    HWC images anyway). ``--layout nchw`` re-measures the reference's
+    layout. The extra "mfu" key uses 3x the 4.089 GFLOP/img fwd cost
+    (fwd + 2x bwd, conv-dominated)."""
     import paddle_tpu.nn.functional as F
     from paddle_tpu.vision.models import resnet50
 
-    model = resnet50(num_classes=1000)
+    layout = (args.layout or "nhwc").upper()
+    model = resnet50(num_classes=1000, data_format=layout)
     opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
                                     parameters=model.parameters())
     amp = None if args.no_amp else (args.amp or "O2")
@@ -70,7 +76,9 @@ def _bench_resnet(args, paddle, TrainStep):
                      amp_level=amp)
     batch = args.batch or 128
     rng = np.random.RandomState(0)
-    x = paddle.to_tensor(rng.randn(batch, 3, 224, 224).astype("float32"))
+    shape = (batch, 3, 224, 224) if layout == "NCHW" \
+        else (batch, 224, 224, 3)
+    x = paddle.to_tensor(rng.randn(*shape).astype("float32"))
     y = paddle.to_tensor(rng.randint(0, 1000, (batch,)).astype("int64"))
     K = max(args.steps, 1)
     loss = step.run_steps(K, x, y)
@@ -81,9 +89,12 @@ def _bench_resnet(args, paddle, TrainStep):
         loss = step.run_steps(K, x, y)
         float(loss.numpy())
         best = max(best, K * batch / (time.perf_counter() - t0))
+    peak = float(os.environ.get("BENCH_PEAK_FLOPS", 197e12))
+    mfu = best * 3 * 4.089e9 / peak
     print(json.dumps({"metric": "resnet50_train_images_per_sec",
                       "value": round(best, 1), "unit": "images/s",
-                      "vs_baseline": round(best / 2000.0, 4)}))
+                      "vs_baseline": round(best / 2000.0, 4),
+                      "mfu": round(mfu, 4), "layout": layout}))
 
 
 def _bench_bert(args, paddle, TrainStep):
@@ -148,6 +159,9 @@ def main():
                          "--config medium --seq 4096 --batch 2")
     ap.add_argument("--moment-dtype", default=None,
                     choices=["float32", "bfloat16"])
+    ap.add_argument("--layout", default=None, choices=["nhwc", "nchw"],
+                    help="resnet50 activation layout (default nhwc, the "
+                         "MXU-native fast path)")
     ap.add_argument("--recompute", default=None,
                     choices=["full", "dots", "attn", "none"],
                     help="stacked-decoder recompute policy (large and "
@@ -260,7 +274,9 @@ def main():
             metric = f"{metric[:metric.index('_train')]}_s{seq}" \
                      "_train_tokens_per_sec"
 
-    if not args.smoke and seq >= 2048:
+    from paddle_tpu.framework.flags import flag_value
+    if not args.smoke and getattr(cfg, "use_flash_attention", True) and \
+            seq >= int(flag_value("FLAGS_flash_min_seqlen")):
         # flash kicks in at FLAGS_flash_min_seqlen (2048): autotune the
         # block sizes for THIS attention shape eagerly (fwd+bwd timing,
         # persisted) — the traced TrainStep picks the winner up through

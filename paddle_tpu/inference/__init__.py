@@ -181,8 +181,10 @@ class Tensor:
         # COPY on the way out too (reference ZeroCopyTensor memcpys):
         # handing out an alias of the committed buffer would let callers
         # mutate it in place under the identity-keyed device-feed cache
-        v = self._value
-        return np.array(v) if isinstance(v, np.ndarray) else np.asarray(v)
+        # np.array (not asarray) in BOTH branches: asarray on a jax CPU
+        # array returns a read-only zero-copy view, breaking the
+        # writable-copy contract
+        return np.array(self._value)
 
     def reshape(self, shape):
         if self._value is not None:
@@ -354,7 +356,9 @@ class Predictor:
                 t = Tensor(f"fetch_{i}")
                 t.copy_from_cpu(o)
                 self._outputs.append(t)
-            return [t._value for t in self._outputs]
+            # copies, not aliases of the committed buffers (same
+            # invariant copy_to_cpu documents)
+            return [t.copy_to_cpu() for t in self._outputs]
         # handle-based flow: outputs stay DEVICE-RESIDENT in the handles;
         # copy_to_cpu transfers on demand (np.asarray on a jax array)
         for i, o in enumerate(outs):

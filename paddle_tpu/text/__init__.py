@@ -16,9 +16,15 @@ import numpy as np
 from ..core.dispatch import apply_op
 from ..nn.layer.layers import Layer
 
-__all__ = ["viterbi_decode", "ViterbiDecoder", "Vocab", "datasets"]
+__all__ = ["viterbi_decode", "ViterbiDecoder", "Vocab", "datasets",
+           "StringTensor", "strings_empty", "strings_lower",
+           "strings_upper", "faster_tokenizer", "BertTokenizerKernel"]
 
 from . import datasets  # noqa: E402,F401
+from .strings import (  # noqa: E402,F401
+    BertTokenizerKernel, StringTensor, faster_tokenizer, strings_empty,
+    strings_lower, strings_upper,
+)
 
 
 def viterbi_decode(potentials, transition_params, lengths=None,

@@ -1,0 +1,312 @@
+"""String tensor type + strings ops + the faster_tokenizer kernel.
+
+Reference:
+- StringTensor: /root/reference/paddle/phi/core/string_tensor.h — a
+  tensor of variable-length utf8 strings (pstring elements).
+- strings kernels: /root/reference/paddle/phi/kernels/strings/
+  (strings_empty_kernel.cc, strings_lower_upper_kernel.h with
+  ``use_utf8_encoding``: ASCII mode maps only A-Z/a-z, utf8 mode applies
+  the full unicode case mapping via unicode.h's tables).
+- faster_tokenizer: /root/reference/paddle/fluid/operators/string/
+  faster_tokenizer_op.{h,cc} — BERT BasicTokenizer (whitespace cleanup,
+  CJK spacing, accent stripping under do_lower_case, punctuation split)
+  + WordpieceTokenizer ("##" continuations, [UNK] fallback) + pair
+  encoding with [CLS]/[SEP] framing, segment ids, max_seq_len
+  truncation and optional padding.
+
+TPU-native design: strings never touch the device — they are host-side
+preprocessing exactly as in the reference (its kernels are CPU-only
+too); the tokenizer's OUTPUT (input_ids/segment_ids int64 arrays) is
+what crosses onto the TPU. Python's str type IS the unicode layer, so
+the ~2k-line unicode.cc table machinery collapses into str.lower()/
+unicodedata — same mapping, maintained by CPython.
+"""
+from __future__ import annotations
+
+import unicodedata
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+__all__ = ["StringTensor", "strings_empty", "strings_lower",
+           "strings_upper", "BasicTokenizer", "WordpieceTokenizer",
+           "BertTokenizerKernel", "faster_tokenizer"]
+
+
+class StringTensor:
+    """A shaped container of utf8 strings (phi::StringTensor analog).
+
+    Backed by a numpy object array; supports the same surface the
+    reference exposes through pybind (shape/numel/indexing) without
+    pretending strings live on device."""
+
+    def __init__(self, data, name: str = ""):
+        arr = np.asarray(data, dtype=object)
+        bad = [x for x in arr.reshape(-1) if not isinstance(x, str)]
+        if bad:
+            raise TypeError(
+                f"StringTensor holds utf8 strings; got {type(bad[0])}")
+        self._data = arr
+        self.name = name
+
+    @property
+    def shape(self) -> List[int]:
+        return list(self._data.shape)
+
+    def numel(self) -> int:
+        return int(self._data.size)
+
+    def numpy(self) -> np.ndarray:
+        return self._data
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        return out if isinstance(out, str) else StringTensor(out)
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, {self._data!r})"
+
+    def tolist(self):
+        return self._data.tolist()
+
+
+def _as_string_array(x) -> np.ndarray:
+    if isinstance(x, StringTensor):
+        return x._data
+    return np.asarray(x, dtype=object)
+
+
+def strings_empty(shape: Sequence[int]) -> StringTensor:
+    """strings_empty_kernel.cc: a StringTensor of empty strings."""
+    arr = np.full(tuple(shape), "", dtype=object)
+    return StringTensor(arr)
+
+
+def _case_map(s: str, lower: bool, use_utf8_encoding: bool) -> str:
+    if use_utf8_encoding:
+        # full unicode case mapping (reference unicode.h tables ==
+        # CPython's unicode database)
+        return s.lower() if lower else s.upper()
+    # ASCII mode (reference case_utils.h AsciiToLower/Upper): only A-Z
+    # and a-z move; every other byte passes through untouched
+    delta = 32 if lower else -32
+    lo, hi = ("A", "Z") if lower else ("a", "z")
+    return "".join(chr(ord(c) + delta) if lo <= c <= hi else c
+                   for c in s)
+
+
+def strings_lower(x, use_utf8_encoding: bool = False) -> StringTensor:
+    arr = _as_string_array(x)
+    out = np.frompyfunc(
+        lambda s: _case_map(s, True, use_utf8_encoding), 1, 1)(arr)
+    return StringTensor(out.astype(object))
+
+
+def strings_upper(x, use_utf8_encoding: bool = False) -> StringTensor:
+    arr = _as_string_array(x)
+    out = np.frompyfunc(
+        lambda s: _case_map(s, False, use_utf8_encoding), 1, 1)(arr)
+    return StringTensor(out.astype(object))
+
+
+# ------------------------------------------------------------ tokenizer
+
+def _is_whitespace(ch: str) -> bool:
+    if ch in (" ", "\t", "\n", "\r"):
+        return True
+    return unicodedata.category(ch) == "Zs"
+
+
+def _is_control(ch: str) -> bool:
+    if ch in ("\t", "\n", "\r"):
+        return False
+    return unicodedata.category(ch).startswith("C")
+
+
+def _is_punctuation(ch: str) -> bool:
+    cp = ord(ch)
+    # ASCII punctuation ranges + unicode P* (faster_tokenizer_op.h
+    # IsPunctuation == BERT's convention)
+    if (33 <= cp <= 47) or (58 <= cp <= 64) or (91 <= cp <= 96) or \
+            (123 <= cp <= 126):
+        return True
+    return unicodedata.category(ch).startswith("P")
+
+
+def _is_chinese_char(cp: int) -> bool:
+    return ((0x4E00 <= cp <= 0x9FFF) or (0x3400 <= cp <= 0x4DBF) or
+            (0x20000 <= cp <= 0x2A6DF) or (0x2A700 <= cp <= 0x2B73F) or
+            (0x2B740 <= cp <= 0x2B81F) or (0x2B820 <= cp <= 0x2CEAF) or
+            (0xF900 <= cp <= 0xFAFF) or (0x2F800 <= cp <= 0x2FA1F))
+
+
+class BasicTokenizer:
+    """faster_tokenizer_op.h BasicTokenizer: unicode cleanup, CJK
+    spacing, optional lowercase + accent stripping, punctuation split."""
+
+    def __init__(self, do_lower_case: bool = True):
+        self.do_lower_case = do_lower_case
+
+    def tokenize(self, text: str) -> List[str]:
+        out = []
+        for ch in text:
+            cp = ord(ch)
+            if cp == 0 or cp == 0xFFFD or _is_control(ch):
+                continue
+            if _is_chinese_char(cp):
+                out.append(f" {ch} ")
+            elif _is_whitespace(ch):
+                out.append(" ")
+            else:
+                out.append(ch)
+        tokens = []
+        for tok in "".join(out).split():
+            if self.do_lower_case:
+                tok = tok.lower()
+                tok = "".join(c for c in unicodedata.normalize("NFD", tok)
+                              if unicodedata.category(c) != "Mn")
+            cur = []
+            for ch in tok:
+                if _is_punctuation(ch):
+                    if cur:
+                        tokens.append("".join(cur))
+                        cur = []
+                    tokens.append(ch)
+                else:
+                    cur.append(ch)
+            if cur:
+                tokens.append("".join(cur))
+        return tokens
+
+
+class WordpieceTokenizer:
+    """Greedy longest-match-first wordpiece with "##" continuations
+    (faster_tokenizer_op.h WordPieceTokenizer)."""
+
+    def __init__(self, vocab: Dict[str, int], unk_token: str = "[UNK]",
+                 max_input_chars_per_word: int = 100):
+        self.vocab = vocab
+        self.unk_token = unk_token
+        self.max_chars = max_input_chars_per_word
+
+    def tokenize(self, token: str) -> List[str]:
+        if len(token) > self.max_chars:
+            return [self.unk_token]
+        out, start = [], 0
+        while start < len(token):
+            end = len(token)
+            cur = None
+            while start < end:
+                piece = token[start:end]
+                if start > 0:
+                    piece = "##" + piece
+                if piece in self.vocab:
+                    cur = piece
+                    break
+                end -= 1
+            if cur is None:
+                return [self.unk_token]
+            out.append(cur)
+            start = end
+        return out
+
+
+class BertTokenizerKernel:
+    """The faster_tokenizer op body: Basic + Wordpiece + pair framing.
+
+    Matches the reference kernel contract (faster_tokenizer_op.h
+    BertTokenizer::Encode/BatchEncode): [CLS] A [SEP] (B [SEP]),
+    segment ids 0/0/1, longest-first truncation to max_seq_len, optional
+    right-padding with [PAD]."""
+
+    def __init__(self, vocab: Dict[str, int], do_lower_case: bool = False,
+                 unk_token: str = "[UNK]", pad_token: str = "[PAD]",
+                 cls_token: str = "[CLS]", mask_token: str = "[MASK]",
+                 sep_token: str = "[SEP]"):
+        self.vocab = dict(vocab)
+        self.basic = BasicTokenizer(do_lower_case)
+        self.wordpiece = WordpieceTokenizer(self.vocab, unk_token)
+        for tok in (unk_token, pad_token, cls_token, sep_token):
+            if tok not in self.vocab:
+                raise ValueError(f"vocab is missing special token {tok!r}")
+        self.cls_id = self.vocab[cls_token]
+        self.sep_id = self.vocab[sep_token]
+        self.pad_id = self.vocab[pad_token]
+
+    def _ids(self, text: str) -> List[int]:
+        ids = []
+        for tok in self.basic.tokenize(text):
+            for piece in self.wordpiece.tokenize(tok):
+                ids.append(self.vocab[piece])
+        return ids
+
+    def encode(self, text: str, text_pair: Optional[str] = None,
+               max_seq_len: int = 0, pad_to_max_seq_len: bool = False,
+               ) -> Tuple[List[int], List[int]]:
+        a = self._ids(text)
+        b = self._ids(text_pair) if text_pair is not None else None
+        n_special = 3 if b is not None else 2
+        if max_seq_len > 0:
+            # floor at 0: max_seq_len < n_special would send the budget
+            # negative and the pop-loop could never satisfy it
+            budget = max(max_seq_len - n_special, 0)
+            # longest-first truncation; ties pop from the PAIR side
+            # (faster_tokenizer_op.cc:307 TruncateSequence)
+            while b is not None and len(a) + len(b) > budget:
+                if len(a) > len(b):
+                    a = a[:-1]
+                else:
+                    b = b[:-1]
+            if b is None and len(a) > budget:
+                a = a[:budget]
+        ids = [self.cls_id] + a + [self.sep_id]
+        seg = [0] * len(ids)
+        if b is not None:
+            ids += b + [self.sep_id]
+            seg += [1] * (len(b) + 1)
+        if max_seq_len > 0 and pad_to_max_seq_len and \
+                len(ids) < max_seq_len:
+            pad = max_seq_len - len(ids)
+            ids += [self.pad_id] * pad
+            seg += [0] * pad
+        return ids, seg
+
+    def batch_encode(self, texts: Sequence[str],
+                     text_pairs: Optional[Sequence[str]] = None,
+                     max_seq_len: int = 0,
+                     pad_to_max_seq_len: bool = False,
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+        pairs = text_pairs if text_pairs is not None else [None] * len(texts)
+        encoded = [self.encode(t, p, max_seq_len, pad_to_max_seq_len)
+                   for t, p in zip(texts, pairs)]
+        width = max(len(ids) for ids, _ in encoded)
+        input_ids = np.full((len(encoded), width), self.pad_id, np.int64)
+        seg_ids = np.zeros((len(encoded), width), np.int64)
+        for i, (ids, seg) in enumerate(encoded):
+            input_ids[i, :len(ids)] = ids
+            seg_ids[i, :len(seg)] = seg
+        return input_ids, seg_ids
+
+
+def faster_tokenizer(vocab: Dict[str, int],
+                     text: Union[StringTensor, Sequence[str]],
+                     text_pair=None, do_lower_case: bool = False,
+                     is_split_into_words: bool = False,
+                     max_seq_len: int = 0,
+                     pad_to_max_seq_len: bool = False):
+    """The faster_tokenizer op surface (faster_tokenizer_op.cc): returns
+    (InputIds, SegmentIds) as int64 arrays."""
+    if is_split_into_words:
+        raise NotImplementedError(
+            "faster_tokenizer is_split_into_words (pre-tokenized input) "
+            "is not supported yet")
+    texts = list(_as_string_array(text).reshape(-1))
+    pairs = None
+    if text_pair is not None:
+        pairs = list(_as_string_array(text_pair).reshape(-1))
+        if len(pairs) != len(texts):
+            raise ValueError(
+                f"Text has {len(texts)} entries but TextPair has "
+                f"{len(pairs)} (faster_tokenizer_op.cc pair contract)")
+    kern = BertTokenizerKernel(vocab, do_lower_case=do_lower_case)
+    return kern.batch_encode(texts, pairs, max_seq_len, pad_to_max_seq_len)

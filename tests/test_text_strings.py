@@ -1,0 +1,129 @@
+"""StringTensor + strings ops + faster_tokenizer (round-4 verdict
+missing item 5: the phi strings op family).
+
+Oracle: huggingface transformers' BertTokenizer (an independent
+implementation of the same BasicTokenizer/WordPiece spec the reference
+faster_tokenizer_op.h implements) over a local vocab file — no network.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.text import (BertTokenizerKernel, StringTensor,
+                             faster_tokenizer, strings_empty,
+                             strings_lower, strings_upper)
+
+VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+         "the", "quick", "brown", "fox", "jump", "##ed", "##s", "over",
+         "lazy", "dog", "un", "##want", "here", "runn", "##ing", ",",
+         ".", "!", "?", "hello", "world", "中", "国", "a", "b", "c"]
+
+
+@pytest.fixture(scope="module")
+def vocab():
+    return {tok: i for i, tok in enumerate(VOCAB)}
+
+
+@pytest.fixture(scope="module")
+def hf_tokenizer(tmp_path_factory, vocab):
+    transformers = pytest.importorskip("transformers")
+    path = tmp_path_factory.mktemp("vocab") / "vocab.txt"
+    with open(path, "w") as f:
+        f.write("\n".join(VOCAB))
+    return transformers.BertTokenizer(
+        str(path), do_lower_case=True, do_basic_tokenize=True)
+
+
+class TestStringsOps:
+    def test_string_tensor_shape_and_indexing(self):
+        st = StringTensor([["ab", "CD"], ["ef", "GH"]])
+        assert st.shape == [2, 2]
+        assert st.numel() == 4
+        assert st[0, 1] == "CD"
+        assert st[1].tolist() == ["ef", "GH"]
+
+    def test_strings_empty(self):
+        st = strings_empty([2, 3])
+        assert st.shape == [2, 3]
+        assert all(s == "" for s in st.numpy().reshape(-1))
+
+    def test_ascii_mode_only_moves_ascii_letters(self):
+        """case_utils.h AsciiToLower: non-ASCII passes through."""
+        st = strings_lower(StringTensor(["AbC", "ÄÖÜ", "Hello!"]),
+                           use_utf8_encoding=False)
+        assert st.tolist() == ["abc", "ÄÖÜ", "hello!"]
+        st = strings_upper(StringTensor(["abc", "äöü"]),
+                           use_utf8_encoding=False)
+        assert st.tolist() == ["ABC", "äöü"]
+
+    def test_utf8_mode_full_unicode_mapping(self):
+        st = strings_lower(StringTensor(["ÄÖÜ", "ΣΟΦΙΑ"]),
+                           use_utf8_encoding=True)
+        assert st.tolist() == ["äöü", "σοφια"]
+        st = strings_upper(StringTensor(["straße"]),
+                           use_utf8_encoding=True)
+        assert st.tolist() == ["STRASSE"]
+
+    def test_rejects_non_strings(self):
+        with pytest.raises(TypeError):
+            StringTensor([1, 2])
+
+
+class TestFasterTokenizer:
+    def test_matches_hf_bert_tokenizer(self, vocab, hf_tokenizer):
+        texts = ["The quick brown fox jumped over the lazy dog!",
+                 "unwanted running",
+                 "Hello, 中国 world.",
+                 "unknownword here"]
+        for text in texts:
+            ids, seg = BertTokenizerKernel(
+                vocab, do_lower_case=True).encode(text)
+            want = hf_tokenizer(text)
+            assert ids == want["input_ids"], text
+            assert seg == want["token_type_ids"], text
+
+    def test_pair_encoding_matches_hf(self, vocab, hf_tokenizer):
+        a, b = "the quick brown fox", "hello world"
+        ids, seg = BertTokenizerKernel(
+            vocab, do_lower_case=True).encode(a, b)
+        want = hf_tokenizer(a, b)
+        assert ids == want["input_ids"]
+        assert seg == want["token_type_ids"]
+
+    def test_truncation_and_padding_match_hf(self, vocab, hf_tokenizer):
+        a, b = "the quick brown fox jumped over", "the lazy dog hello"
+        ids, seg = BertTokenizerKernel(vocab, do_lower_case=True).encode(
+            a, b, max_seq_len=10, pad_to_max_seq_len=True)
+        want = hf_tokenizer(a, b, max_length=10, truncation="longest_first",
+                            padding="max_length")
+        assert ids == want["input_ids"]
+        assert seg == want["token_type_ids"]
+
+    def test_batch_op_surface(self, vocab):
+        st = StringTensor(["hello world", "the quick fox"])
+        input_ids, seg_ids = faster_tokenizer(vocab, st,
+                                              do_lower_case=True,
+                                              max_seq_len=8,
+                                              pad_to_max_seq_len=True)
+        assert input_ids.shape == (2, 8)
+        assert input_ids.dtype == np.int64
+        assert seg_ids.shape == (2, 8)
+        # row 0: [CLS] hello world [SEP] [PAD]*4
+        assert list(input_ids[0][:4]) == [vocab["[CLS]"], vocab["hello"],
+                                          vocab["world"], vocab["[SEP]"]]
+        assert all(x == vocab["[PAD]"] for x in input_ids[0][4:])
+
+    def test_tiny_max_seq_len_terminates(self, vocab):
+        """max_seq_len < specials must not hang (negative budget)."""
+        ids, seg = BertTokenizerKernel(vocab, do_lower_case=True).encode(
+            "hello world", "the fox", max_seq_len=2)
+        assert ids == [vocab["[CLS]"], vocab["[SEP]"], vocab["[SEP]"]]
+        ids, _ = BertTokenizerKernel(vocab, do_lower_case=True).encode(
+            "hello world", max_seq_len=1)
+        assert ids == [vocab["[CLS]"], vocab["[SEP]"]]
+
+    def test_unknown_word_maps_to_unk(self, vocab):
+        ids, _ = BertTokenizerKernel(vocab, do_lower_case=True).encode(
+            "zzzqqq")
+        assert ids == [vocab["[CLS]"], vocab["[UNK]"], vocab["[SEP]"]]

@@ -56,7 +56,7 @@ def run(variant, steps=20, windows=3, batch=32, seq=512):
     elif variant == "nopooler":
         cls = bert_mod.BertPooler
         patch(cls, "forward", lambda self, h: h[:, 0])
-    elif variant in ("nomlm", "notransform", "nonsp"):
+    elif variant in ("nomlm", "notransform"):
         cls = BertForPretraining
 
         def fwd(self, input_ids, token_type_ids=None, attention_mask=None,

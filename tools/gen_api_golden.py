@@ -36,6 +36,7 @@ SURFACES = [
     "paddle_tpu.jit",
     "paddle_tpu.vision",
     "paddle_tpu.incubate.autograd",
+    "paddle_tpu.text",
 ]
 
 
