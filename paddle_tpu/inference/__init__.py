@@ -187,8 +187,18 @@ class Tensor:
         return np.array(self._value)
 
     def reshape(self, shape):
-        if self._value is not None:
+        # declare the input shape ahead of copy_from_cpu (the capi_exp
+        # flow: GetInputHandle -> Reshape -> CopyFromCpu reads .shape to
+        # size the incoming buffer). Like the reference
+        # ZeroCopyTensor::Reshape, a NEW shape always wins — a numel
+        # change (e.g. a different batch) drops the stale value rather
+        # than raising and leaving the old shape to mis-size the copy.
+        if self._value is not None and \
+                int(np.prod(shape)) == self._value.size:
             self._value = self._value.reshape(shape)
+            return
+        self._value = None
+        self._spec = dict(self._spec or {}, shape=list(shape))
 
     @property
     def shape(self):

@@ -87,6 +87,52 @@ def lib():
         return _lib
 
 
+def build_capi() -> str | None:
+    """Build libpaddle_inference_c.so — the C serving ABI (reference
+    capi_exp PD_* surface) over the Python Predictor via an embedded
+    CPython interpreter (csrc/pd_capi.cc). Returns the .so path or None.
+
+    Separate from the main native lib because it links libpython; host
+    apps dlopen it, include csrc/pd_inference_c.h, and must export
+    PYTHONPATH so `import paddle_tpu` resolves inside the embedded
+    interpreter."""
+    import sysconfig
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    src = os.path.join(here, "csrc", "pd_capi.cc")
+    header = os.path.join(here, "csrc", "pd_inference_c.h")
+    out = os.path.join(here, "libpaddle_inference_c.so")
+    stamp = out + ".sha256"
+    try:
+        digest = _src_digest([src, header])
+    except OSError:
+        return None
+    if os.path.exists(out):
+        try:
+            with open(stamp) as f:
+                if f.read().strip() == digest:
+                    return out
+        except OSError:
+            pass
+    inc = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR")
+    pyver = sysconfig.get_config_var("LDVERSION") or \
+        sysconfig.get_config_var("VERSION")
+    cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-pthread",
+           f"-I{inc}", "-o", out, src, f"-L{libdir}",
+           f"-Wl,-rpath,{libdir}", f"-lpython{pyver}"]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=180)
+        if proc.returncode != 0:
+            return None
+    except Exception:
+        return None
+    with open(stamp, "w") as f:
+        f.write(digest)
+    return out
+
+
 def _configure(l):
     c = ctypes
     l.tcp_store_server_start.restype = c.c_void_p

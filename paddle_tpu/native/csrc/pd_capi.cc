@@ -1,0 +1,435 @@
+// C serving ABI — the reference's capi_exp surface over the TPU-native
+// Predictor (reference: paddle/fluid/inference/capi_exp/pd_config.h,
+// pd_predictor.h, pd_tensor.h; implemented there over AnalysisPredictor,
+// here over paddle_tpu.inference via an embedded CPython interpreter —
+// the XLA executable IS the inference engine, the C ABI is the serving
+// shell, exactly as capi_exp shells the C++ predictor).
+//
+// Build: paddle_tpu.native.build_capi() → libpaddle_inference_c.so
+// Host app contract: set PYTHONPATH so `import paddle_tpu` resolves
+// (and JAX_PLATFORMS if a specific backend is wanted) before the first
+// PD_PredictorCreate.
+//
+// Memory discipline mirrors the reference's __pd_give/__pd_keep:
+// *Create/GetInputHandle/GetOutputHandle/GetInputNames give ownership,
+// released with the matching *Destroy.
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+// the PUBLIC header is the single source of truth for the ABI — any
+// signature drift between it and these definitions is a compile error
+#include "pd_inference_c.h"
+
+// opaque types from the header, defined here
+struct PD_Config {
+  std::string model_path;
+  std::string params_path;
+};
+
+struct PD_Predictor {
+  PyObject* pred;  // paddle_tpu.inference.Predictor
+};
+
+struct PD_Tensor {
+  PyObject* handle;  // paddle_tpu.inference.Tensor
+};
+
+static std::mutex g_init_mu;
+static bool g_we_initialized = false;
+
+static void ensure_python() {
+  std::lock_guard<std::mutex> lk(g_init_mu);
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    g_we_initialized = true;
+    // release the GIL the init thread holds, or PyGILState_Ensure from
+    // any OTHER host thread (the norm in a serving shell) deadlocks
+    PyEval_SaveThread();
+  }
+}
+
+// Run fn with the GIL held (works both embedded and when the host app
+// is itself a Python process that loaded us via ctypes).
+template <typename F>
+static auto with_gil(F fn) -> decltype(fn()) {
+  ensure_python();
+  PyGILState_STATE st = PyGILState_Ensure();
+  auto out = fn();
+  PyGILState_Release(st);
+  return out;
+}
+
+static void print_and_clear() {
+  if (PyErr_Occurred()) PyErr_Print();
+}
+
+static PyObject* inference_module() {
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.inference");
+  if (!mod) print_and_clear();
+  return mod;
+}
+
+// ----------------------------------------------------------- Config
+
+PD_Config* PD_ConfigCreate() { return new PD_Config(); }
+
+void PD_ConfigDestroy(PD_Config* c) { delete c; }
+
+void PD_ConfigSetModel(PD_Config* c, const char* model,
+                       const char* params) {
+  c->model_path = model ? model : "";
+  c->params_path = params ? params : "";
+}
+
+void PD_ConfigSetProgFile(PD_Config* c, const char* model) {
+  c->model_path = model ? model : "";
+}
+
+void PD_ConfigSetParamsFile(PD_Config* c, const char* params) {
+  c->params_path = params ? params : "";
+}
+
+const char* PD_ConfigGetProgFile(PD_Config* c) {
+  return c->model_path.c_str();
+}
+
+const char* PD_ConfigGetParamsFile(PD_Config* c) {
+  return c->params_path.c_str();
+}
+
+// -------------------------------------------------------- Predictor
+
+PD_Predictor* PD_PredictorCreate(PD_Config* config) {
+  return with_gil([&]() -> PD_Predictor* {
+    PyObject* mod = inference_module();
+    if (!mod) return nullptr;
+    PyObject* cfg = nullptr;
+    if (!config->params_path.empty()) {
+      cfg = PyObject_CallMethod(mod, "Config", "ss",
+                                config->model_path.c_str(),
+                                config->params_path.c_str());
+    } else {
+      cfg = PyObject_CallMethod(mod, "Config", "s",
+                                config->model_path.c_str());
+    }
+    if (!cfg) { print_and_clear(); Py_DECREF(mod); return nullptr; }
+    PyObject* pred = PyObject_CallMethod(mod, "create_predictor", "O", cfg);
+    Py_DECREF(cfg);
+    Py_DECREF(mod);
+    if (!pred) { print_and_clear(); return nullptr; }
+    PD_Predictor* out = new PD_Predictor();
+    out->pred = pred;
+    return out;
+  });
+}
+
+void PD_PredictorDestroy(PD_Predictor* p) {
+  if (!p) return;
+  with_gil([&]() -> int { Py_XDECREF(p->pred); return 0; });
+  delete p;
+}
+
+static PD_OneDimArrayCstr* names_from_list(PyObject* list) {
+  if (!list) { print_and_clear(); return nullptr; }
+  Py_ssize_t n = PyList_Size(list);
+  PD_OneDimArrayCstr* arr = new PD_OneDimArrayCstr();
+  arr->size = static_cast<size_t>(n);
+  arr->data = new char*[n];
+  for (Py_ssize_t i = 0; i < n; i++) {
+    const char* s = PyUnicode_AsUTF8(PyList_GetItem(list, i));
+    arr->data[i] = strdup(s ? s : "");
+  }
+  Py_DECREF(list);
+  return arr;
+}
+
+PD_OneDimArrayCstr* PD_PredictorGetInputNames(PD_Predictor* p) {
+  return with_gil([&]() {
+    return names_from_list(
+        PyObject_CallMethod(p->pred, "get_input_names", nullptr));
+  });
+}
+
+PD_OneDimArrayCstr* PD_PredictorGetOutputNames(PD_Predictor* p) {
+  return with_gil([&]() {
+    return names_from_list(
+        PyObject_CallMethod(p->pred, "get_output_names", nullptr));
+  });
+}
+
+void PD_OneDimArrayCstrDestroy(PD_OneDimArrayCstr* arr) {
+  if (!arr) return;
+  for (size_t i = 0; i < arr->size; i++) free(arr->data[i]);
+  delete[] arr->data;
+  delete arr;
+}
+
+size_t PD_PredictorGetInputNum(PD_Predictor* p) {
+  PD_OneDimArrayCstr* n = PD_PredictorGetInputNames(p);
+  size_t out = n ? n->size : 0;
+  PD_OneDimArrayCstrDestroy(n);
+  return out;
+}
+
+size_t PD_PredictorGetOutputNum(PD_Predictor* p) {
+  PD_OneDimArrayCstr* n = PD_PredictorGetOutputNames(p);
+  size_t out = n ? n->size : 0;
+  PD_OneDimArrayCstrDestroy(n);
+  return out;
+}
+
+static PD_Tensor* tensor_from(PyObject* h) {
+  if (!h) { print_and_clear(); return nullptr; }
+  PD_Tensor* t = new PD_Tensor();
+  t->handle = h;
+  return t;
+}
+
+PD_Tensor* PD_PredictorGetInputHandle(PD_Predictor* p, const char* name) {
+  return with_gil([&]() {
+    return tensor_from(
+        PyObject_CallMethod(p->pred, "get_input_handle", "s", name));
+  });
+}
+
+PD_Tensor* PD_PredictorGetOutputHandle(PD_Predictor* p, const char* name) {
+  return with_gil([&]() {
+    return tensor_from(
+        PyObject_CallMethod(p->pred, "get_output_handle", "s", name));
+  });
+}
+
+PD_Bool PD_PredictorRun(PD_Predictor* p) {
+  return with_gil([&]() -> PD_Bool {
+    PyObject* r = PyObject_CallMethod(p->pred, "run", nullptr);
+    if (!r) { print_and_clear(); return 0; }
+    PD_Bool ok = PyObject_IsTrue(r) ? 1 : 0;
+    Py_DECREF(r);
+    return ok;
+  });
+}
+
+void PD_PredictorClearIntermediateTensor(PD_Predictor* p) {
+  with_gil([&]() -> int {
+    PyObject* r = PyObject_CallMethod(p->pred,
+                                      "clear_intermediate_tensor", nullptr);
+    Py_XDECREF(r);
+    return 0;
+  });
+}
+
+// ----------------------------------------------------------- Tensor
+
+void PD_TensorDestroy(PD_Tensor* t) {
+  if (!t) return;
+  with_gil([&]() -> int { Py_XDECREF(t->handle); return 0; });
+  delete t;
+}
+
+void PD_TensorReshape(PD_Tensor* t, size_t shape_size, int32_t* shape) {
+  with_gil([&]() -> int {
+    PyObject* lst = PyList_New(shape_size);
+    for (size_t i = 0; i < shape_size; i++)
+      PyList_SetItem(lst, i, PyLong_FromLong(shape[i]));
+    PyObject* r = PyObject_CallMethod(t->handle, "reshape", "O", lst);
+    Py_DECREF(lst);
+    if (!r) print_and_clear();
+    Py_XDECREF(r);
+    return 0;
+  });
+}
+
+PD_OneDimArrayInt32* PD_TensorGetShape(PD_Tensor* t) {
+  return with_gil([&]() -> PD_OneDimArrayInt32* {
+    PyObject* shape = PyObject_GetAttrString(t->handle, "shape");
+    if (!shape || shape == Py_None) {
+      Py_XDECREF(shape);
+      print_and_clear();
+      return nullptr;
+    }
+    Py_ssize_t n = PySequence_Size(shape);
+    PD_OneDimArrayInt32* arr = new PD_OneDimArrayInt32();
+    arr->size = static_cast<size_t>(n);
+    arr->data = new int32_t[n];
+    for (Py_ssize_t i = 0; i < n; i++) {
+      PyObject* it = PySequence_GetItem(shape, i);
+      arr->data[i] = static_cast<int32_t>(PyLong_AsLong(it));
+      Py_DECREF(it);
+    }
+    Py_DECREF(shape);
+    return arr;
+  });
+}
+
+void PD_OneDimArrayInt32Destroy(PD_OneDimArrayInt32* arr) {
+  if (!arr) return;
+  delete[] arr->data;
+  delete arr;
+}
+
+static const char* np_dtype_for(int pd_dtype) {
+  switch (pd_dtype) {
+    case PD_DATA_FLOAT32: return "float32";
+    case PD_DATA_INT32: return "int32";
+    case PD_DATA_INT64: return "int64";
+    case PD_DATA_UINT8: return "uint8";
+    case PD_DATA_INT8: return "int8";
+  }
+  return nullptr;
+}
+
+static size_t dtype_size(int pd_dtype) {
+  switch (pd_dtype) {
+    case PD_DATA_FLOAT32: case PD_DATA_INT32: return 4;
+    case PD_DATA_INT64: return 8;
+    default: return 1;
+  }
+}
+
+// copy_from: build a numpy array from the C buffer using the handle's
+// current shape (set via PD_TensorReshape first — the capi_exp flow).
+static void copy_from_cpu(PD_Tensor* t, const void* data, int pd_dtype) {
+  with_gil([&]() -> int {
+    PyObject* np = PyImport_ImportModule("numpy");
+    if (!np) { print_and_clear(); return 0; }
+    PyObject* shape = PyObject_GetAttrString(t->handle, "shape");
+    if (!shape || shape == Py_None) {
+      Py_XDECREF(shape);
+      Py_DECREF(np);
+      PyErr_Clear();
+      return 0;
+    }
+    // numel from shape
+    Py_ssize_t n = PySequence_Size(shape);
+    size_t numel = 1;
+    for (Py_ssize_t i = 0; i < n; i++) {
+      PyObject* it = PySequence_GetItem(shape, i);
+      numel *= static_cast<size_t>(PyLong_AsLong(it));
+      Py_DECREF(it);
+    }
+    PyObject* buf = PyBytes_FromStringAndSize(
+        static_cast<const char*>(data), numel * dtype_size(pd_dtype));
+    PyObject* flat = PyObject_CallMethod(np, "frombuffer", "Os", buf,
+                                         np_dtype_for(pd_dtype));
+    PyObject* arr = flat ? PyObject_CallMethod(flat, "reshape", "O", shape)
+                         : nullptr;
+    if (arr) {
+      PyObject* r = PyObject_CallMethod(t->handle, "copy_from_cpu", "O",
+                                        arr);
+      if (!r) print_and_clear();
+      Py_XDECREF(r);
+      Py_DECREF(arr);
+    } else {
+      print_and_clear();
+    }
+    Py_XDECREF(flat);
+    Py_XDECREF(buf);
+    Py_DECREF(shape);
+    Py_DECREF(np);
+    return 0;
+  });
+}
+
+static void copy_to_cpu(PD_Tensor* t, void* data, int pd_dtype) {
+  with_gil([&]() -> int {
+    PyObject* arr = PyObject_CallMethod(t->handle, "copy_to_cpu", nullptr);
+    if (!arr) { print_and_clear(); return 0; }
+    PyObject* np = PyImport_ImportModule("numpy");
+    PyObject* cast = PyObject_CallMethod(
+        np, "ascontiguousarray", "Os", arr, np_dtype_for(pd_dtype));
+    if (cast) {
+      PyObject* bytes = PyObject_CallMethod(cast, "tobytes", nullptr);
+      if (bytes) {
+        char* src = nullptr;
+        Py_ssize_t len = 0;
+        PyBytes_AsStringAndSize(bytes, &src, &len);
+        memcpy(data, src, static_cast<size_t>(len));
+        Py_DECREF(bytes);
+      }
+      Py_DECREF(cast);
+    } else {
+      print_and_clear();
+    }
+    Py_DECREF(np);
+    Py_DECREF(arr);
+    return 0;
+  });
+}
+
+void PD_TensorCopyFromCpuFloat(PD_Tensor* t, const float* data) {
+  copy_from_cpu(t, data, PD_DATA_FLOAT32);
+}
+void PD_TensorCopyFromCpuInt32(PD_Tensor* t, const int32_t* data) {
+  copy_from_cpu(t, data, PD_DATA_INT32);
+}
+void PD_TensorCopyFromCpuInt64(PD_Tensor* t, const int64_t* data) {
+  copy_from_cpu(t, data, PD_DATA_INT64);
+}
+void PD_TensorCopyFromCpuInt8(PD_Tensor* t, const int8_t* data) {
+  copy_from_cpu(t, data, PD_DATA_INT8);
+}
+void PD_TensorCopyFromCpuUint8(PD_Tensor* t, const uint8_t* data) {
+  copy_from_cpu(t, data, PD_DATA_UINT8);
+}
+
+void PD_TensorCopyToCpuFloat(PD_Tensor* t, float* data) {
+  copy_to_cpu(t, data, PD_DATA_FLOAT32);
+}
+void PD_TensorCopyToCpuInt32(PD_Tensor* t, int32_t* data) {
+  copy_to_cpu(t, data, PD_DATA_INT32);
+}
+void PD_TensorCopyToCpuInt64(PD_Tensor* t, int64_t* data) {
+  copy_to_cpu(t, data, PD_DATA_INT64);
+}
+void PD_TensorCopyToCpuInt8(PD_Tensor* t, int8_t* data) {
+  copy_to_cpu(t, data, PD_DATA_INT8);
+}
+void PD_TensorCopyToCpuUint8(PD_Tensor* t, uint8_t* data) {
+  copy_to_cpu(t, data, PD_DATA_UINT8);
+}
+
+int32_t PD_TensorGetDataType(PD_Tensor* t) {
+  return with_gil([&]() -> int32_t {
+    PyObject* r = PyObject_CallMethod(t->handle, "type", nullptr);
+    if (!r || r == Py_None) { Py_XDECREF(r); PyErr_Clear();
+                              return PD_DATA_UNK; }
+    const char* s = PyUnicode_AsUTF8(r);
+    int32_t out = PD_DATA_UNK;
+    if (s) {
+      if (!strcmp(s, "float32")) out = PD_DATA_FLOAT32;
+      else if (!strcmp(s, "int32")) out = PD_DATA_INT32;
+      else if (!strcmp(s, "int64")) out = PD_DATA_INT64;
+      else if (!strcmp(s, "uint8")) out = PD_DATA_UINT8;
+      else if (!strcmp(s, "int8")) out = PD_DATA_INT8;
+    }
+    Py_DECREF(r);
+    return out;
+  });
+}
+
+const char* PD_GetVersion(void) {
+  // one-shot: concurrent callers must not race on (or dangle into) a
+  // mutating buffer; the process-lifetime string never changes
+  static std::once_flag once;
+  static std::string version;
+  std::call_once(once, []() {
+    with_gil([&]() -> int {
+      PyObject* mod = inference_module();
+      if (!mod) return 0;
+      PyObject* r = PyObject_CallMethod(mod, "get_version", nullptr);
+      Py_DECREF(mod);
+      if (!r) { print_and_clear(); return 0; }
+      const char* s = PyUnicode_AsUTF8(r);
+      version = s ? s : "";
+      Py_DECREF(r);
+      return 0;
+    });
+  });
+  return version.c_str();
+}

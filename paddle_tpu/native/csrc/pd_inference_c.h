@@ -1,0 +1,82 @@
+/* C serving ABI for paddle_tpu — the reference capi_exp surface
+ * (paddle/fluid/inference/capi_exp/pd_inference_api.h) over the
+ * TPU-native Predictor. Link against libpaddle_inference_c.so (built by
+ * paddle_tpu.native.build_capi()); set PYTHONPATH so `import paddle_tpu`
+ * resolves before the first PD_PredictorCreate.
+ *
+ * Ownership follows the reference's __pd_give convention: everything a
+ * *Create/Get*Handle/Get*Names call returns is released with the
+ * matching *Destroy. */
+#ifndef PADDLE_TPU_PD_INFERENCE_C_H_
+#define PADDLE_TPU_PD_INFERENCE_C_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef int32_t PD_Bool;
+typedef struct PD_Config PD_Config;
+typedef struct PD_Predictor PD_Predictor;
+typedef struct PD_Tensor PD_Tensor;
+
+typedef struct PD_OneDimArrayCstr {
+  size_t size;
+  char** data;
+} PD_OneDimArrayCstr;
+
+typedef struct PD_OneDimArrayInt32 {
+  size_t size;
+  int32_t* data;
+} PD_OneDimArrayInt32;
+
+/* PD_DataType (pd_types.h subset) */
+enum { PD_DATA_UNK = -1, PD_DATA_FLOAT32 = 0, PD_DATA_INT32 = 2,
+       PD_DATA_INT64 = 3, PD_DATA_UINT8 = 4, PD_DATA_INT8 = 5 };
+
+PD_Config* PD_ConfigCreate(void);
+void PD_ConfigDestroy(PD_Config* c);
+void PD_ConfigSetModel(PD_Config* c, const char* model_path,
+                       const char* params_path);
+void PD_ConfigSetProgFile(PD_Config* c, const char* model_path);
+void PD_ConfigSetParamsFile(PD_Config* c, const char* params_path);
+const char* PD_ConfigGetProgFile(PD_Config* c);
+const char* PD_ConfigGetParamsFile(PD_Config* c);
+
+PD_Predictor* PD_PredictorCreate(PD_Config* config);
+void PD_PredictorDestroy(PD_Predictor* p);
+PD_OneDimArrayCstr* PD_PredictorGetInputNames(PD_Predictor* p);
+PD_OneDimArrayCstr* PD_PredictorGetOutputNames(PD_Predictor* p);
+size_t PD_PredictorGetInputNum(PD_Predictor* p);
+size_t PD_PredictorGetOutputNum(PD_Predictor* p);
+PD_Tensor* PD_PredictorGetInputHandle(PD_Predictor* p, const char* name);
+PD_Tensor* PD_PredictorGetOutputHandle(PD_Predictor* p, const char* name);
+PD_Bool PD_PredictorRun(PD_Predictor* p);
+void PD_PredictorClearIntermediateTensor(PD_Predictor* p);
+void PD_OneDimArrayCstrDestroy(PD_OneDimArrayCstr* arr);
+
+void PD_TensorDestroy(PD_Tensor* t);
+void PD_TensorReshape(PD_Tensor* t, size_t shape_size, int32_t* shape);
+PD_OneDimArrayInt32* PD_TensorGetShape(PD_Tensor* t);
+void PD_OneDimArrayInt32Destroy(PD_OneDimArrayInt32* arr);
+int32_t PD_TensorGetDataType(PD_Tensor* t);
+
+void PD_TensorCopyFromCpuFloat(PD_Tensor* t, const float* data);
+void PD_TensorCopyFromCpuInt32(PD_Tensor* t, const int32_t* data);
+void PD_TensorCopyFromCpuInt64(PD_Tensor* t, const int64_t* data);
+void PD_TensorCopyFromCpuInt8(PD_Tensor* t, const int8_t* data);
+void PD_TensorCopyFromCpuUint8(PD_Tensor* t, const uint8_t* data);
+void PD_TensorCopyToCpuFloat(PD_Tensor* t, float* data);
+void PD_TensorCopyToCpuInt32(PD_Tensor* t, int32_t* data);
+void PD_TensorCopyToCpuInt64(PD_Tensor* t, int64_t* data);
+void PD_TensorCopyToCpuInt8(PD_Tensor* t, int8_t* data);
+void PD_TensorCopyToCpuUint8(PD_Tensor* t, uint8_t* data);
+
+const char* PD_GetVersion(void);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* PADDLE_TPU_PD_INFERENCE_C_H_ */

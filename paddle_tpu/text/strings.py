@@ -279,6 +279,10 @@ class BertTokenizerKernel:
         pairs = text_pairs if text_pairs is not None else [None] * len(texts)
         encoded = [self.encode(t, p, max_seq_len, pad_to_max_seq_len)
                    for t, p in zip(texts, pairs)]
+        if not encoded:     # empty shard: (0, w) int64 outputs
+            w = max_seq_len if (max_seq_len > 0 and pad_to_max_seq_len) \
+                else 0
+            return (np.zeros((0, w), np.int64), np.zeros((0, w), np.int64))
         width = max(len(ids) for ids, _ in encoded)
         input_ids = np.full((len(encoded), width), self.pad_id, np.int64)
         seg_ids = np.zeros((len(encoded), width), np.int64)

@@ -123,6 +123,14 @@ class TestFasterTokenizer:
             "hello world", max_seq_len=1)
         assert ids == [vocab["[CLS]"], vocab["[SEP]"]]
 
+    def test_empty_batch(self, vocab):
+        ids, seg = faster_tokenizer(vocab, StringTensor([]),
+                                    do_lower_case=True)
+        assert ids.shape == (0, 0) and ids.dtype == np.int64
+        ids, seg = faster_tokenizer(vocab, [], max_seq_len=8,
+                                    pad_to_max_seq_len=True)
+        assert ids.shape == (0, 8)
+
     def test_unknown_word_maps_to_unk(self, vocab):
         ids, _ = BertTokenizerKernel(vocab, do_lower_case=True).encode(
             "zzzqqq")
