@@ -875,6 +875,186 @@ def _sigmoid_cross_entropy_with_logits(jnp, ins, attrs):
     return {"Out": [loss]}
 
 
+def _rnn_op(jnp, ins, attrs):
+    """The unified `rnn` op (reference paddle/fluid/operators/rnn_op.cc,
+    phi/kernels/cpu/rnn_kernel.cc:819): what nn.LSTM/GRU/SimpleRNN
+    export. Input is TIME-MAJOR [T,B,I] (the python layer transposes
+    before the op, python/paddle/nn/layer/rnn.py:1466); WeightList is
+    all (w_ih, w_hh) pairs in layer-major (layer, direction) order
+    followed by all (b_ih, b_hh) pairs (rnn.py:1408-1416). Gate orders:
+    LSTM i,f,g,o; GRU r,u(z),c — both the cudnn convention."""
+    import jax
+    from jax import lax
+
+    x = ins["Input"][0]
+    mode = attrs.get("mode", "LSTM")
+    num_layers = int(attrs.get("num_layers", 1))
+    bidi = bool(attrs.get("is_bidirec", False))
+    hidden = int(attrs.get("hidden_size", 0))
+    num_dir = 2 if bidi else 1
+    if ins.get("SequenceLength"):
+        raise NotImplementedError(
+            "rnn op with SequenceLength (variable-length batches) "
+            "(pdmodel interop table)")
+    wl = ins["WeightList"]
+    n_units = num_layers * num_dir
+    if len(wl) != 4 * n_units:
+        raise NotImplementedError(
+            f"rnn WeightList has {len(wl)} entries, expected "
+            f"{4 * n_units} (weights then biases, rnn.py:1408)")
+    quads = []
+    for u in range(n_units):
+        quads.append((wl[2 * u], wl[2 * u + 1],
+                      wl[2 * n_units + 2 * u], wl[2 * n_units + 2 * u + 1]))
+    is_lstm = mode == "LSTM"
+    pre = ins.get("PreState") or []
+    B = x.shape[1]
+    h0_all = pre[0] if pre else jnp.zeros((n_units, B, hidden), x.dtype)
+    c0_all = (pre[1] if len(pre) > 1 else
+              jnp.zeros((n_units, B, hidden), x.dtype)) if is_lstm else None
+
+    def cell(mode):
+        def rnn_tanh(x_t, st, w_ih, w_hh, b_ih, b_hh):
+            h = jnp.tanh(x_t @ w_ih.T + b_ih + st[0] @ w_hh.T + b_hh)
+            return h, (h,)
+
+        def rnn_relu(x_t, st, w_ih, w_hh, b_ih, b_hh):
+            h = jax.nn.relu(x_t @ w_ih.T + b_ih + st[0] @ w_hh.T + b_hh)
+            return h, (h,)
+
+        def lstm(x_t, st, w_ih, w_hh, b_ih, b_hh):
+            h_prev, c_prev = st
+            z = x_t @ w_ih.T + b_ih + h_prev @ w_hh.T + b_hh
+            i, f, g, o = jnp.split(z, 4, axis=-1)
+            i, f, o = (jax.nn.sigmoid(v) for v in (i, f, o))
+            c = f * c_prev + i * jnp.tanh(g)
+            h = o * jnp.tanh(c)
+            return h, (h, c)
+
+        def gru(x_t, st, w_ih, w_hh, b_ih, b_hh):
+            (h_prev,) = st
+            zi = x_t @ w_ih.T + b_ih
+            zh = h_prev @ w_hh.T + b_hh
+            ri, ui, ci = jnp.split(zi, 3, axis=-1)
+            rh, uh, ch = jnp.split(zh, 3, axis=-1)
+            r = jax.nn.sigmoid(ri + rh)
+            u = jax.nn.sigmoid(ui + uh)
+            c = jnp.tanh(ci + r * ch)
+            h = (1 - u) * c + u * h_prev
+            return h, (h,)
+
+        return {"LSTM": lstm, "GRU": gru, "RNN_TANH": rnn_tanh,
+                "RNN_RELU": rnn_relu}[mode]
+
+    step = cell(mode)
+    layer_in = x
+    last_h, last_c = [], []
+    for layer in range(num_layers):
+        outs = []
+        for d in range(num_dir):
+            u = layer * num_dir + d
+            w_ih, w_hh, b_ih, b_hh = quads[u]
+            st0 = (h0_all[u], c0_all[u]) if is_lstm else (h0_all[u],)
+            seq = layer_in if d == 0 else jnp.flip(layer_in, axis=0)
+
+            def scan_body(st, x_t, _s=step, _w=(w_ih, w_hh, b_ih, b_hh)):
+                h, st2 = _s(x_t, st, *_w)
+                return st2, h
+
+            fstate, out = lax.scan(scan_body, st0, seq)
+            if d == 1:
+                out = jnp.flip(out, axis=0)
+            outs.append(out)
+            last_h.append(fstate[0])
+            if is_lstm:
+                last_c.append(fstate[1])
+        layer_in = outs[0] if num_dir == 1 else jnp.concatenate(
+            outs, axis=-1)
+    h_stack = jnp.stack(last_h, axis=0)
+    state = [h_stack] + ([jnp.stack(last_c, axis=0)] if is_lstm else [])
+    reserve = jnp.zeros((0,), x.dtype)
+    return {"Out": [layer_in], "State": state, "Reserve": [reserve],
+            "DropoutState": [jnp.zeros((0,), "uint8")]}
+
+
+def _multihead_matmul(jnp, ins, attrs):
+    """TensorRT-style fused attention (reference
+    paddle/fluid/operators/fused/multihead_matmul_op.cc): Input [B,S,3H]
+    already holds the fused QKV projection; W/Bias fold the projection
+    when the pass did not pre-apply it; BiasQK is the additive mask."""
+    import jax
+
+    x = ins["Input"][0]
+    n_head = int(attrs.get("head_number", 1))
+    alpha = float(attrs.get("alpha", 1.0))
+    # the einsum below assumes the default layout: K transposed in the
+    # score matmul, Q/V not (multihead_matmul_op.cc attr defaults) —
+    # decline non-default combinations loudly
+    if not attrs.get("transpose_K", True) or \
+            attrs.get("transpose_Q", False) or \
+            attrs.get("transpose_V", False):
+        raise NotImplementedError(
+            "multihead_matmul with non-default transpose_Q/K/V "
+            "(pdmodel interop table)")
+    if ins.get("W"):
+        w = ins["W"][0]          # [H, 3, N, H/N] per the op doc
+        h_in = x.shape[-1]
+        qkv = jnp.matmul(x, w.reshape(h_in, -1))
+        if ins.get("Bias"):
+            qkv = qkv + ins["Bias"][0].reshape(-1)
+    else:
+        qkv = x
+    b, s = qkv.shape[0], qkv.shape[1]
+    d = qkv.shape[-1] // 3
+    dh = d // n_head
+    qkv = qkv.reshape(b, s, 3, n_head, dh)
+    q, k, v = (jnp.swapaxes(qkv[:, :, j], 1, 2) for j in range(3))
+    scores = jnp.einsum("bhsd,bhtd->bhst", q, k) * alpha
+    if ins.get("BiasQK"):
+        scores = scores + ins["BiasQK"][0]
+    p = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(q.dtype)
+    o = jnp.einsum("bhst,bhtd->bhsd", p, v)
+    o = jnp.swapaxes(o, 1, 2).reshape(b, s, d)
+    return {"Out": [o]}
+
+
+def _fused_fc_elementwise_layernorm(jnp, ins, attrs):
+    """fc + residual add + layer_norm fusion (reference
+    paddle/fluid/operators/fused/fused_fc_elementwise_layernorm_op.cc)."""
+    import jax
+
+    x = ins["X"][0]
+    w = ins["W"][0]
+    y = ins["Y"][0]
+    bna = attrs.get("begin_norm_axis", y.ndim - 1)
+    if bna not in (-1, y.ndim - 1):
+        raise NotImplementedError(
+            f"fused_fc_elementwise_layernorm begin_norm_axis={bna} "
+            f"over rank-{y.ndim} (only last-axis norm implemented; "
+            f"pdmodel interop table)")
+    x2 = x.reshape(-1, w.shape[0]) if x.ndim > 2 else x
+    out = jnp.matmul(x2, w)
+    if ins.get("Bias0"):
+        out = out + ins["Bias0"][0]
+    act = attrs.get("activation_type", "")
+    if act:
+        fn = {"relu": jax.nn.relu, "gelu": jax.nn.gelu,
+              "tanh": jnp.tanh}.get(act)
+        if fn is None:
+            raise NotImplementedError(
+                f"fused_fc_elementwise_layernorm activation "
+                f"{act!r} (pdmodel interop table)")
+        out = fn(out)
+    out = out.reshape(y.shape)
+    out = out + y
+    ln = _layer_norm_last(
+        jnp, out,
+        ins["Scale"][0] if ins.get("Scale") else None,
+        ins["Bias1"][0] if ins.get("Bias1") else None,
+        attrs.get("epsilon", 1e-5))
+    return {"Out": [ln]}
+
+
 # -------------------------------------------------- quantization ops
 # (reference: paddle/fluid/operators/quantize_linear_op.cc and the
 # fake_quantize family in fake_quantize_op.cc — what static PTQ/QAT
@@ -987,6 +1167,9 @@ def _register():
         _fused_bias_dropout_residual_ln
     C["fused_multi_transformer"] = _fused_multi_transformer
     C["fused_multi_transformer_int8"] = _fused_multi_transformer_int8
+    C["rnn"] = _rnn_op
+    C["multihead_matmul"] = _multihead_matmul
+    C["fused_fc_elementwise_layernorm"] = _fused_fc_elementwise_layernorm
     C["fused_embedding_eltwise_layernorm"] = \
         _fused_embedding_eltwise_layernorm
     C["skip_layernorm"] = _skip_layernorm
