@@ -258,18 +258,37 @@ def zeropad2d(x, padding, data_format="NCHW", name=None):
     return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
 
 
+def _temporal_shift_impl(jnp, a, seg_num, shift_ratio, data_format):
+    """TSM channel shift, shared by the dygraph op and the pdmodel
+    converter (reference phi/kernels/cpu/temporal_shift_kernel.cc:39-43:
+    the FIRST c*ratio channels read segment t-1, the next c*ratio read
+    t+1, the rest pass through; zero at the segment boundaries)."""
+    nt = a.shape[0]
+    n = nt // seg_num
+    v = a.reshape((n, seg_num) + tuple(a.shape[1:]))
+    caxis = 2 if data_format == "NCHW" else v.ndim - 1
+    c = v.shape[caxis]
+    c1, c2 = int(c * shift_ratio), int(c * 2 * shift_ratio)
+
+    def chan(lo, hi):
+        sl = [slice(None)] * v.ndim
+        sl[caxis] = slice(lo, hi)
+        return v[tuple(sl)]
+
+    fold1 = chan(0, c1)          # out[t] = in[t-1]
+    fold1 = jnp.concatenate(
+        [jnp.zeros_like(fold1[:, :1]), fold1[:, :-1]], axis=1)
+    fold2 = chan(c1, c2)         # out[t] = in[t+1]
+    fold2 = jnp.concatenate(
+        [fold2[:, 1:], jnp.zeros_like(fold2[:, :1])], axis=1)
+    out = jnp.concatenate([fold1, fold2, chan(c2, None)], axis=caxis)
+    return out.reshape(a.shape)
+
+
 def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
     def _ts(a):
-        nt, c, h, w = a.shape
-        n = nt // seg_num
-        v = a.reshape(n, seg_num, c, h, w)
-        fold_c = int(c * shift_ratio)
-        left = jnp.concatenate([v[:, 1:, :fold_c],
-                                jnp.zeros_like(v[:, :1, :fold_c])], axis=1)
-        mid = jnp.concatenate([jnp.zeros_like(v[:, :1, fold_c:2 * fold_c]),
-                               v[:, :-1, fold_c:2 * fold_c]], axis=1)
-        rest = v[:, :, 2 * fold_c:]
-        return jnp.concatenate([left, mid, rest], axis=2).reshape(nt, c, h, w)
+        return _temporal_shift_impl(jnp, a, seg_num, shift_ratio,
+                                    data_format)
     return apply_op("temporal_shift", _ts, x)
 
 

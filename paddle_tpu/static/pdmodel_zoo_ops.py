@@ -1055,6 +1055,80 @@ def _fused_fc_elementwise_layernorm(jnp, ins, attrs):
     return {"Out": [ln]}
 
 
+def _affine_channel(jnp, ins, attrs):
+    """out = x * Scale[C] + Bias[C] along the channel axis (reference
+    paddle/fluid/operators/affine_channel_op.cc; the BN-fold form many
+    detection exports carry)."""
+    x = ins["X"][0]
+    layout = attrs.get("data_layout", "NCHW")
+    axis = 1 if layout == "NCHW" else x.ndim - 1
+    shape = [1] * x.ndim
+    shape[axis] = -1
+    return {"Out": [x * ins["Scale"][0].reshape(shape)
+                    + ins["Bias"][0].reshape(shape)]}
+
+
+def _index_sample(jnp, ins, attrs):
+    """out[b, m] = X[b, Index[b, m]] (reference
+    paddle/phi/kernels/cpu/index_sample_kernel.cc)."""
+    x = ins["X"][0]
+    idx = ins["Index"][0]
+    return {"Out": [jnp.take_along_axis(x, idx.astype("int32"), axis=1)]}
+
+
+def _temporal_shift(jnp, ins, attrs):
+    """TSM channel shift along the segment axis — delegates to the
+    shared slice-concat implementation in nn/functional/common.py (one
+    source of truth for the t-1/t+1 fold directions, which only touches
+    the shifted folds instead of padding full-tensor copies)."""
+    from ..nn.functional.common import _temporal_shift_impl
+
+    return {"Out": [_temporal_shift_impl(
+        jnp, ins["X"][0], int(attrs.get("seg_num", 1)),
+        float(attrs.get("shift_ratio", 0.25)),
+        attrs.get("data_format", "NCHW"))]}
+
+
+def _anchor_generator(jnp, ins, attrs):
+    """SSD/Faster-RCNN anchors per feature-map cell (reference
+    paddle/fluid/operators/detection/anchor_generator_op.h:48-95):
+    centers at (i*stride + offset*(stride-1)), box sides
+    round(sqrt(stride_area/ar)) scaled by anchor_size/stride, corners
+    at ctr -/+ 0.5*(side-1). Outputs [H,W,num,4] + tiled Variances."""
+    x = ins["Input"][0]
+    fh, fw = int(x.shape[2]), int(x.shape[3])
+    sizes = [float(s) for s in attrs.get("anchor_sizes", [])]
+    ars = [float(a) for a in attrs.get("aspect_ratios", [])]
+    stride = [float(s) for s in attrs.get("stride", [16.0, 16.0])]
+    variances = [float(v) for v in attrs.get("variances",
+                                             [0.1, 0.1, 0.2, 0.2])]
+    offset = float(attrs.get("offset", 0.5))
+    sw, sh = stride[0], stride[1]
+    boxes = []
+    area = sw * sh
+    for ar in ars:
+        base_w = np.round(np.sqrt(area / ar))
+        base_h = np.round(base_w * ar)
+        for size in sizes:
+            w = (size / sw) * base_w
+            h = (size / sh) * base_h
+            boxes.append((w, h))
+    num = len(boxes)
+    wh = np.asarray(boxes, np.float32)            # [num, 2]
+    xc = (np.arange(fw, dtype=np.float32) * sw + offset * (sw - 1))
+    yc = (np.arange(fh, dtype=np.float32) * sh + offset * (sh - 1))
+    xg, yg = np.meshgrid(xc, yc)                  # [H, W]
+    half_w = 0.5 * (wh[:, 0] - 1)
+    half_h = 0.5 * (wh[:, 1] - 1)
+    anchors = np.stack([
+        xg[:, :, None] - half_w, yg[:, :, None] - half_h,
+        xg[:, :, None] + half_w, yg[:, :, None] + half_h], axis=-1)
+    var = np.broadcast_to(np.asarray(variances, np.float32),
+                          (fh, fw, num, 4))
+    return {"Anchors": [jnp.asarray(anchors.astype(np.float32))],
+            "Variances": [jnp.asarray(var.copy())]}
+
+
 # -------------------------------------------------- quantization ops
 # (reference: paddle/fluid/operators/quantize_linear_op.cc and the
 # fake_quantize family in fake_quantize_op.cc — what static PTQ/QAT
@@ -1170,6 +1244,10 @@ def _register():
     C["rnn"] = _rnn_op
     C["multihead_matmul"] = _multihead_matmul
     C["fused_fc_elementwise_layernorm"] = _fused_fc_elementwise_layernorm
+    C["affine_channel"] = _affine_channel
+    C["index_sample"] = _index_sample
+    C["temporal_shift"] = _temporal_shift
+    C["anchor_generator"] = _anchor_generator
     C["fused_embedding_eltwise_layernorm"] = \
         _fused_embedding_eltwise_layernorm
     C["skip_layernorm"] = _skip_layernorm
