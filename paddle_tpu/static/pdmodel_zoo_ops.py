@@ -1089,6 +1089,58 @@ def _temporal_shift(jnp, ins, attrs):
         attrs.get("data_format", "NCHW"))]}
 
 
+def _set_value(jnp, ins, attrs):
+    """Strided-slice assignment (reference
+    paddle/fluid/operators/set_value_op.cc — what `x[1:3] = v` exports
+    via dy2static). Value comes from ValueTensor or the typed *_values
+    attrs with `shape`; slice spec from axes/starts/ends/steps attrs
+    (tensor-list start/end inputs decline loudly: the traced program
+    needs static extents)."""
+    x = ins["Input"][0]
+    if ins.get("StartsTensorList") or ins.get("EndsTensorList") or \
+            ins.get("StepsTensorList"):
+        raise NotImplementedError(
+            "set_value with tensor-list slice bounds "
+            "(pdmodel interop table)")
+    axes = [int(a) for a in attrs.get("axes", [])]
+    starts = [int(s) for s in attrs.get("starts", [])]
+    ends = [int(e) for e in attrs.get("ends", [])]
+    steps = [int(s) for s in attrs.get("steps", [1] * len(axes))]
+    if attrs.get("none_axes"):
+        raise NotImplementedError(
+            "set_value with none_axes (newaxis insertion) "
+            "(pdmodel interop table)")
+    if ins.get("ValueTensor"):
+        val = ins["ValueTensor"][0]
+    else:
+        shape = [int(s) for s in attrs.get("shape", [])]
+        for key, dt in (("fp32_values", "float32"),
+                        ("fp64_values", "float64"),
+                        ("int32_values", "int32"),
+                        ("int64_values", "int64"),
+                        ("bool_values", "bool")):
+            vals = attrs.get(key)
+            if vals:
+                val = jnp.asarray(np.asarray(vals, dt).reshape(shape))
+                break
+        else:
+            raise NotImplementedError(
+                "set_value without ValueTensor or *_values attrs")
+    idx = [slice(None)] * x.ndim
+    for ax, st, en, sp in zip(axes, starts, ends, steps):
+        # raw bounds straight into slice(): Python's clamping IS the
+        # Paddle semantics (same pattern as the _slice/_strided_slice
+        # converters — manual normalization double-maps out-of-range
+        # negatives)
+        idx[ax] = slice(st, en, sp)
+    # decrease_axes: the python x[:, i] = v form squeezed those dims
+    # from the VALUE; re-insert them so broadcasting aligns (trailing
+    # alignment alone fails for non-trailing squeezed axes)
+    for ax in sorted(int(a) for a in attrs.get("decrease_axes", [])):
+        val = jnp.expand_dims(val, ax)
+    return {"Out": [x.at[tuple(idx)].set(val.astype(x.dtype))]}
+
+
 def _anchor_generator(jnp, ins, attrs):
     """SSD/Faster-RCNN anchors per feature-map cell (reference
     paddle/fluid/operators/detection/anchor_generator_op.h:48-95):
@@ -1248,6 +1300,7 @@ def _register():
     C["index_sample"] = _index_sample
     C["temporal_shift"] = _temporal_shift
     C["anchor_generator"] = _anchor_generator
+    C["set_value"] = _set_value
     C["fused_embedding_eltwise_layernorm"] = \
         _fused_embedding_eltwise_layernorm
     C["skip_layernorm"] = _skip_layernorm
