@@ -1089,6 +1089,71 @@ def _temporal_shift(jnp, ins, attrs):
         attrs.get("data_format", "NCHW"))]}
 
 
+def _density_prior_box(jnp, ins, attrs):
+    """Density prior boxes for SSD-style face detectors (reference
+    paddle/fluid/operators/detection/density_prior_box_op.h:60-125):
+    per fixed_size a density x density grid of shifted centers, per
+    fixed_ratio a sqrt-ratio-scaled box, coords normalized by the image
+    extent with the kernel's asymmetric clamping (x1/y1 floored at 0,
+    x2/y2 capped at 1 inside the loop; `clip` clamps everything). The
+    integer step_average/shift arithmetic is replicated exactly."""
+    x = ins["Input"][0]
+    img = ins["Image"][0]
+    fh, fw = int(x.shape[2]), int(x.shape[3])
+    ih, iw = int(img.shape[2]), int(img.shape[3])
+    fixed_sizes = [float(s) for s in attrs.get("fixed_sizes", [])]
+    fixed_ratios = [float(r) for r in attrs.get("fixed_ratios", [])]
+    densities = [int(d) for d in attrs.get("densities", [])]
+    variances = [float(v) for v in attrs.get("variances",
+                                             [0.1, 0.1, 0.2, 0.2])]
+    step_w = float(attrs.get("step_w", 0.0))
+    step_h = float(attrs.get("step_h", 0.0))
+    offset = float(attrs.get("offset", 0.5))
+    clip = bool(attrs.get("clip", False))
+    if step_w == 0.0 or step_h == 0.0:
+        # kernel replaces BOTH axes together when either attr is 0
+        # (density_prior_box_op.h:56-59)
+        sw, sh = iw / fw, ih / fh
+    else:
+        sw, sh = step_w, step_h
+    step_average = int((sw + sh) * 0.5)          # C++ int truncation
+    # per-box offsets from the cell center are the same for every cell:
+    # build them once, then broadcast over the [H, W] center grid
+    offs = []
+    for size, density in zip(fixed_sizes, densities):
+        shift = step_average // density          # C++ int / int
+        base = -step_average / 2.0 + shift / 2.0
+        for ratio in fixed_ratios:
+            bw = size * np.sqrt(ratio)
+            bh = size / np.sqrt(ratio)
+            for di in range(density):
+                for dj in range(density):
+                    offs.append((base + dj * shift, base + di * shift,
+                                 bw, bh))
+    offs = np.asarray(offs, np.float32)          # [num, 4]
+    num = offs.shape[0]
+    xg, yg = np.meshgrid((np.arange(fw, dtype=np.float32) + offset) * sw,
+                         (np.arange(fh, dtype=np.float32) + offset) * sh)
+    cxt = xg[:, :, None] + offs[:, 0]            # [H, W, num]
+    cyt = yg[:, :, None] + offs[:, 1]
+    boxes = np.stack([
+        np.maximum((cxt - offs[:, 2] / 2.0) / iw, 0.0),
+        np.maximum((cyt - offs[:, 3] / 2.0) / ih, 0.0),
+        np.minimum((cxt + offs[:, 2] / 2.0) / iw, 1.0),
+        np.minimum((cyt + offs[:, 3] / 2.0) / ih, 1.0)],
+        axis=-1).astype(np.float32)
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variances, np.float32),
+                          (fh, fw, num, 4)).copy()
+    if attrs.get("flatten_to_2d"):
+        # InferShape flattens to [fh*fw*num, 4] when set
+        # (density_prior_box_op.cc)
+        boxes = boxes.reshape(-1, 4)
+        var = var.reshape(-1, 4)
+    return {"Boxes": [jnp.asarray(boxes)], "Variances": [jnp.asarray(var)]}
+
+
 def _set_value(jnp, ins, attrs):
     """Strided-slice assignment (reference
     paddle/fluid/operators/set_value_op.cc — what `x[1:3] = v` exports
@@ -1301,6 +1366,7 @@ def _register():
     C["temporal_shift"] = _temporal_shift
     C["anchor_generator"] = _anchor_generator
     C["set_value"] = _set_value
+    C["density_prior_box"] = _density_prior_box
     C["fused_embedding_eltwise_layernorm"] = \
         _fused_embedding_eltwise_layernorm
     C["skip_layernorm"] = _skip_layernorm
