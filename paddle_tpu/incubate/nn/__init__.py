@@ -10,6 +10,7 @@ from ...nn.layer.transformer import (  # noqa: F401
 from ...nn.layer.transformer import MultiHeadAttention as FusedMultiHeadAttention  # noqa: F401
 
 from ...nn.layer.layers import Layer
+from . import functional  # noqa: F401
 
 
 class FusedFeedForward(Layer):
@@ -89,3 +90,166 @@ class FusedLinear(_Linear):
             from ...nn import functional as F
             return F.linear(x, self.weight.t(), self.bias)
         return super().forward(x)
+
+
+class FusedDropoutAdd(Layer):
+    """(reference incubate/nn/layer/fused_dropout_add.py)."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        return functional.fused_dropout_add(
+            x, y, p=self.p, training=self.training, mode=self.mode)
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """(reference incubate/nn/layer/fused_transformer.py
+    FusedBiasDropoutResidualLayerNorm)."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        from ...nn.layer.common import create_parameter_with_attr
+        from ...nn import initializer as I
+
+        # bias_attr governs BOTH bias parameters (reference
+        # FusedBiasDropoutResidualLayerNorm: bias_attr=False drops them)
+        self.linear_bias = create_parameter_with_attr(
+            [embed_dim], self._dtype, bias_attr, True)
+        self.ln_scale = create_parameter_with_attr(
+            [embed_dim], self._dtype, weight_attr, False,
+            default_initializer=I.Constant(1.0))
+        self.ln_bias = create_parameter_with_attr(
+            [embed_dim], self._dtype, bias_attr, True)
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+
+    def forward(self, x, residual):
+        return functional.fused_bias_dropout_residual_layer_norm(
+            x, residual, bias=self.linear_bias, ln_scale=self.ln_scale,
+            ln_bias=self.ln_bias, dropout_rate=self.dropout_rate,
+            ln_epsilon=self.epsilon, training=self.training)
+
+
+class FusedEcMoe(Layer):
+    """Expert-choice MoE layer (reference incubate/nn/layer/
+    fused_ec_moe.py): gate projection + the fused_ec_moe kernel."""
+
+    def __init__(self, hidden_size, inter_size, num_experts,
+                 act_type="gelu", weight_attr=None, bias_attr=None):
+        super().__init__()
+        from ...nn.layer.common import create_parameter_with_attr
+
+        e, d, f = num_experts, hidden_size, inter_size
+        self.bmm_weight0 = create_parameter_with_attr(
+            [e, d, f], self._dtype, weight_attr, False)
+        self.bmm_bias0 = create_parameter_with_attr(
+            [e, 1, f], self._dtype, bias_attr, True)
+        self.bmm_weight1 = create_parameter_with_attr(
+            [e, f, d], self._dtype, weight_attr, False)
+        self.bmm_bias1 = create_parameter_with_attr(
+            [e, 1, d], self._dtype, bias_attr, True)
+        self.act_type = act_type
+
+    def forward(self, x, gate):
+        return functional.fused_ec_moe(
+            x, gate, self.bmm_weight0, self.bmm_bias0,
+            self.bmm_weight1, self.bmm_bias1, self.act_type)
+
+
+class FusedMultiTransformer(Layer):
+    """Whole decoder stack layer (reference incubate/nn/layer/
+    fused_transformer.py FusedMultiTransformer) — per-layer parameter
+    lists driving functional.fused_multi_transformer."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu",
+                 normalize_before=True, ln_scale_attrs=None,
+                 ln_bias_attrs=None, qkv_weight_attrs=None,
+                 qkv_bias_attrs=None, linear_weight_attrs=None,
+                 linear_bias_attrs=None, ffn_ln_scale_attrs=None,
+                 ffn_ln_bias_attrs=None, ffn1_weight_attrs=None,
+                 ffn1_bias_attrs=None, ffn2_weight_attrs=None,
+                 ffn2_bias_attrs=None, epsilon=1e-5, num_layers=-1,
+                 nranks=1, trans_qkvw=True, ring_id=-1, name=None):
+        super().__init__()
+        from ...nn.layer.common import create_parameter_with_attr
+        from ...nn import initializer as I
+
+        if num_layers < 0:
+            num_layers = len(qkv_weight_attrs) \
+                if isinstance(qkv_weight_attrs, (list, tuple)) else 1
+        if not normalize_before:
+            raise NotImplementedError(
+                "FusedMultiTransformer post-LN variant (the reference "
+                "kernel is pre-LN only too)")
+        self.num_layers = num_layers
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self._epsilon = epsilon
+        self._trans_qkvw = trans_qkvw
+        self._act = activation
+        self._dropout = dropout_rate
+        head_dim = embed_dim // num_heads
+
+        def attr(attrs, i):
+            return attrs[i] if isinstance(attrs, (list, tuple)) else attrs
+
+        def plist(name, shape, attrs, is_bias, init=None):
+            out = []
+            for i in range(num_layers):
+                p = create_parameter_with_attr(
+                    shape, self._dtype, attr(attrs, i), is_bias,
+                    default_initializer=init)
+                self.add_parameter(f"{name}_{i}", p)
+                out.append(p)
+            return out
+
+        one = I.Constant(1.0)
+        self.ln_scales = plist("ln_scale", [embed_dim], ln_scale_attrs,
+                               False, one)
+        self.ln_biases = plist("ln_bias", [embed_dim], ln_bias_attrs,
+                               True)
+        qkv_shape = [3, num_heads, head_dim, embed_dim] if trans_qkvw \
+            else [embed_dim, 3, num_heads, head_dim]
+        self.qkv_weights = plist("qkv_weight", qkv_shape,
+                                 qkv_weight_attrs, False)
+        self.qkv_biases = plist("qkv_bias", [3, num_heads, head_dim],
+                                qkv_bias_attrs, True)
+        self.linear_weights = plist("linear_weight",
+                                    [embed_dim, embed_dim],
+                                    linear_weight_attrs, False)
+        self.linear_biases = plist("linear_bias", [embed_dim],
+                                   linear_bias_attrs, True)
+        self.ffn_ln_scales = plist("ffn_ln_scale", [embed_dim],
+                                   ffn_ln_scale_attrs, False, one)
+        self.ffn_ln_biases = plist("ffn_ln_bias", [embed_dim],
+                                   ffn_ln_bias_attrs, True)
+        self.ffn1_weights = plist("ffn1_weight",
+                                  [embed_dim, dim_feedforward],
+                                  ffn1_weight_attrs, False)
+        self.ffn1_biases = plist("ffn1_bias", [dim_feedforward],
+                                 ffn1_bias_attrs, True)
+        self.ffn2_weights = plist("ffn2_weight",
+                                  [dim_feedforward, embed_dim],
+                                  ffn2_weight_attrs, False)
+        self.ffn2_biases = plist("ffn2_bias", [embed_dim],
+                                 ffn2_bias_attrs, True)
+
+    def forward(self, src, attn_mask=None, caches=None, pre_caches=None,
+                rotary_embs=None, rotary_emb_dims=0, seq_lens=None,
+                time_step=None):
+        return functional.fused_multi_transformer(
+            src, self.ln_scales, self.ln_biases, self.qkv_weights,
+            self.qkv_biases, self.linear_weights, self.linear_biases,
+            self.ffn_ln_scales, self.ffn_ln_biases, self.ffn1_weights,
+            self.ffn1_biases, self.ffn2_weights, self.ffn2_biases,
+            pre_layer_norm=True, epsilon=self._epsilon,
+            cache_kvs=caches, pre_caches=pre_caches,
+            rotary_embs=rotary_embs, rotary_emb_dims=rotary_emb_dims,
+            seq_lens=seq_lens, time_step=time_step, attn_mask=attn_mask,
+            dropout_rate=self._dropout, activation=self._act,
+            training=self.training, trans_qkvw=self._trans_qkvw)

@@ -1,0 +1,268 @@
+"""paddle.incubate.nn.functional — fused-op functional forms.
+
+Reference: python/paddle/incubate/nn/functional/ (fused_transformer.py,
+fused_matmul_bias.py, fused_ec_moe.py, fused_dropout_add.py). The
+reference routes these to hand-written CUDA kernels; on TPU the same
+compositions are expressed with the framework's dispatched ops and XLA
+fuses them — the API contract (signatures, pre/post-LN semantics, the
+two dropout sites, residual adds) is what carries over.
+"""
+from __future__ import annotations
+
+from ...core.dispatch import apply_op
+from ...core.tensor import Tensor
+
+__all__ = [
+    "fused_matmul_bias", "fused_linear", "fused_dropout_add",
+    "fused_bias_dropout_residual_layer_norm", "fused_feedforward",
+    "fused_multi_head_attention", "fused_multi_transformer",
+    "fused_ec_moe",
+]
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False,
+                      transpose_y=False, name=None):
+    """(fused_matmul_bias.py:21) matmul + optional bias add."""
+    from ...tensor.linalg import matmul
+
+    out = matmul(x, y, transpose_x=transpose_x, transpose_y=transpose_y)
+    return out if bias is None else out + bias
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """(fused_matmul_bias.py:72)."""
+    return fused_matmul_bias(x, weight, bias,
+                             transpose_y=transpose_weight)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True,
+                      mode="upscale_in_train", name=None):
+    """(fused_dropout_add.py:23) dropout(x) + y."""
+    from ...nn import functional as F
+
+    return F.dropout(x, p=p, training=training, mode=mode) + y
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        mode="upscale_in_train", name=None):
+    """(fused_transformer.py fused_bias_dropout_residual_layer_norm):
+    layer_norm(residual + dropout(x + bias))."""
+    from ...nn import functional as F
+
+    if bias is not None:
+        x = x + bias
+    h = residual + F.dropout(x, p=dropout_rate, training=training,
+                             mode=mode)
+    return F.layer_norm(h, h.shape[-1], weight=ln_scale, bias=ln_bias,
+                        epsilon=ln_epsilon)
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight,
+                      linear1_bias=None, linear2_bias=None,
+                      ln1_scale=None, ln1_bias=None, ln2_scale=None,
+                      ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True,
+                      mode="upscale_in_train", ring_id=-1,
+                      add_residual=True, name=None):
+    """(fused_transformer.py fused_feedforward) — residual + the two
+    dropout sites + pre/post layer-norm placement of the reference."""
+    from ...nn import functional as F
+
+    residual = x
+    d = x.shape[-1]
+    if pre_layer_norm:
+        x = F.layer_norm(x, d, weight=ln1_scale, bias=ln1_bias,
+                         epsilon=ln1_epsilon)
+    h = F.linear(x, linear1_weight, linear1_bias)
+    h = getattr(F, activation)(h)
+    h = F.dropout(h, p=dropout1_rate, training=training, mode=mode)
+    h = F.linear(h, linear2_weight, linear2_bias)
+    h = F.dropout(h, p=dropout2_rate, training=training, mode=mode)
+    out = residual + h if add_residual else h
+    if not pre_layer_norm:
+        out = F.layer_norm(out, d, weight=ln2_scale, bias=ln2_bias,
+                           epsilon=ln2_epsilon)
+    return out
+
+
+def fused_multi_head_attention(
+        x, qkv_weight, linear_weight, pre_layer_norm=False,
+        pre_ln_scale=None, pre_ln_bias=None, ln_scale=None, ln_bias=None,
+        pre_ln_epsilon=1e-5, qkv_bias=None, linear_bias=None,
+        cache_kv=None, attn_mask=None, dropout_rate=0.5,
+        attn_dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        mode="upscale_in_train", ring_id=-1, add_residual=True,
+        num_heads=-1, transpose_qkv_wb=False, name=None):
+    """(fused_transformer.py fused_multi_head_attention) — qkv proj,
+    scaled-dot-product attention with mask + attention dropout, output
+    proj, dropout, residual, pre/post layer-norm. ``qkv_weight`` is
+    [3, num_heads, head_dim, embed_dim] (or [embed_dim, 3*embed_dim]
+    with ``transpose_qkv_wb``)."""
+    import math
+
+    from ...nn import functional as F
+    from ...tensor.linalg import matmul
+
+    if cache_kv is not None:
+        raise NotImplementedError(
+            "fused_multi_head_attention with cache_kv (generation loop)")
+    residual = x
+    d = x.shape[-1]
+    if pre_layer_norm:
+        x = F.layer_norm(x, d, weight=pre_ln_scale, bias=pre_ln_bias,
+                         epsilon=pre_ln_epsilon)
+    if transpose_qkv_wb:
+        if num_heads <= 0:
+            raise ValueError("transpose_qkv_wb needs num_heads")
+        nh = num_heads
+        dh = d // nh
+        qkv = matmul(x, qkv_weight)                # [B,S,3D]
+        if qkv_bias is not None:
+            qkv = qkv + qkv_bias
+        b, s = qkv.shape[0], qkv.shape[1]
+        qkv = qkv.reshape([b, s, 3, nh, dh])
+    else:
+        _, nh, dh, _ = qkv_weight.shape
+        w2d = qkv_weight.reshape([3 * nh * dh, d])
+        qkv = matmul(x, w2d, transpose_y=True)     # [B,S,3*nh*dh]
+        if qkv_bias is not None:
+            qkv = qkv + qkv_bias.reshape([-1])
+        b, s = qkv.shape[0], qkv.shape[1]
+        qkv = qkv.reshape([b, s, 3, nh, dh])
+    q = qkv[:, :, 0].transpose([0, 2, 1, 3])       # [B,H,S,dh]
+    k = qkv[:, :, 1].transpose([0, 2, 1, 3])
+    v = qkv[:, :, 2].transpose([0, 2, 1, 3])
+    scores = matmul(q, k, transpose_y=True) * (1.0 / math.sqrt(dh))
+    if attn_mask is not None:
+        scores = scores + attn_mask
+    p = F.softmax(scores, axis=-1)
+    p = F.dropout(p, p=attn_dropout_rate, training=training, mode=mode)
+    o = matmul(p, v).transpose([0, 2, 1, 3]).reshape([b, s, nh * dh])
+    o = matmul(o, linear_weight)
+    if linear_bias is not None:
+        o = o + linear_bias
+    o = F.dropout(o, p=dropout_rate, training=training, mode=mode)
+    out = residual + o if add_residual else o
+    if not pre_layer_norm:
+        out = F.layer_norm(out, d, weight=ln_scale, bias=ln_bias,
+                           epsilon=ln_epsilon)
+    return out
+
+
+def fused_multi_transformer(
+        x, ln_scales, ln_biases, qkv_weights, qkv_biases, linear_weights,
+        linear_biases, ffn_ln_scales, ffn_ln_biases, ffn1_weights,
+        ffn1_biases, ffn2_weights, ffn2_biases, pre_layer_norm=True,
+        epsilon=1e-5, cache_kvs=None, pre_caches=None, seq_lens=None,
+        rotary_embs=None, rotary_emb_dims=0, time_step=None,
+        attn_mask=None, dropout_rate=0.0, activation="gelu",
+        training=False, mode="upscale_in_train", trans_qkvw=True,
+        ring_id=-1, name=None):
+    """(fused_transformer.py fused_multi_transformer) — whole decoder
+    stack; delegates to the same pure math the pdmodel converter
+    executes (one source of truth), wrapped in a dispatched op so the
+    autograd tape records it."""
+    import jax.numpy as jnp
+
+    from ...static.pdmodel_zoo_ops import _fused_multi_transformer
+
+    if cache_kvs is not None or time_step is not None:
+        raise NotImplementedError(
+            "fused_multi_transformer with KV cache (generation loop)")
+    if seq_lens is not None or pre_caches is not None or \
+            rotary_embs is not None:
+        raise NotImplementedError(
+            "fused_multi_transformer seq_lens/pre_caches/rotary_embs "
+            "(silently ignoring them would mis-serve padded batches)")
+    if dropout_rate and training:
+        raise NotImplementedError(
+            "fused_multi_transformer training-mode dropout (the "
+            "reference op is inference-first; use the unfused decoder "
+            "for training)")
+
+    def run(x_arr, *flat):
+        it = iter(flat)
+
+        def take(n):
+            return [next(it) for _ in range(n)]
+
+        L = len(qkv_weights)
+        ins = {"X": [x_arr],
+               "LnScale": take(len(ln_scales or [])),
+               "LnBias": take(len(ln_biases or [])),
+               "QKVW": take(L),
+               "QKVBias": take(len(qkv_biases or [])),
+               "OutLinearW": take(L),
+               "OutLinearBias": take(len(linear_biases or [])),
+               "FFNLnScale": take(len(ffn_ln_scales or [])),
+               "FFNLnBias": take(len(ffn_ln_biases or [])),
+               "FFN1Weight": take(L),
+               "FFN1Bias": take(len(ffn1_biases or [])),
+               "FFN2Weight": take(L),
+               "FFN2Bias": take(len(ffn2_biases or [])),
+               }
+        if attn_mask is not None:
+            ins["SrcMask"] = [next(it)]
+        attrs = {"pre_layer_norm": pre_layer_norm, "epsilon": epsilon,
+                 "act_method": activation, "trans_qkvw": trans_qkvw,
+                 "rotary_emb_dims": rotary_emb_dims}
+        return _fused_multi_transformer(jnp, ins, attrs)["Out"][0]
+
+    # pass the ORIGINAL Tensor objects so apply_op's tape differentiates
+    # into the layer weights (stripping to arrays would sever them)
+    flat = []
+    for seq in (ln_scales, ln_biases, qkv_weights, qkv_biases,
+                linear_weights, linear_biases, ffn_ln_scales,
+                ffn_ln_biases, ffn1_weights, ffn1_biases, ffn2_weights,
+                ffn2_biases):
+        flat.extend(t if isinstance(t, Tensor) else Tensor(t)
+                    for t in (seq or []))
+    if attn_mask is not None:
+        flat.append(attn_mask if isinstance(attn_mask, Tensor)
+                    else Tensor(attn_mask))
+    return apply_op("fused_multi_transformer", run, x, *flat)
+
+
+def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight,
+                 bmm1_bias, act_type):
+    """Expert-choice MoE (fused_ec_moe.py:18; semantics from the op's
+    own baseline, test_fused_ec_moe_op.py:85-136): each expert picks its
+    top-(seq_len//16) tokens by gate logit, runs them through its FFN,
+    scales by the softmax gate prob, scatter-adds back, residual +x."""
+    import jax
+    import jax.numpy as jnp
+
+    if act_type not in ("gelu", "relu"):
+        raise ValueError(f"fused_ec_moe act_type {act_type!r} "
+                         f"(gelu | relu)")
+
+    def run(xv, gv, w0, b0, w1, b1):
+        bsz, s, d = xv.shape
+        e = gv.shape[-1]
+        cap = max(s // 16, 1)
+        gates = jax.nn.softmax(gv.astype(jnp.float32), -1)
+        # per (batch, expert): top-cap token indices by LOGIT
+        logits_t = jnp.swapaxes(gv, 1, 2)              # [B,E,S]
+        _, tok_idx = jax.lax.top_k(logits_t, cap)      # [B,E,cap]
+        sel = jnp.take_along_axis(
+            xv[:, None], tok_idx[..., None], axis=2)   # [B,E,cap,D]
+        prob = jnp.take_along_axis(
+            jnp.swapaxes(gates, 1, 2), tok_idx, axis=2)  # [B,E,cap]
+        h = jnp.einsum("becd,edf->becf", sel, w0) + b0[None]
+        h = (jax.nn.gelu(h, approximate=False) if act_type == "gelu"
+             else jax.nn.relu(h))
+        h = jnp.einsum("becf,efd->becd", h, w1) + b1[None]
+        h = h * prob[..., None].astype(h.dtype)
+        out = jnp.zeros_like(xv)
+        bidx = jnp.arange(bsz)[:, None, None]
+        bidx = jnp.broadcast_to(bidx, tok_idx.shape)
+        out = out.at[bidx.reshape(-1),
+                     tok_idx.reshape(-1)].add(h.reshape(-1, d))
+        return out + xv
+
+    return apply_op("fused_ec_moe", run, x, gate, bmm0_weight, bmm0_bias,
+                    bmm1_weight, bmm1_bias)

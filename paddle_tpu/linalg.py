@@ -1,0 +1,15 @@
+"""paddle.linalg namespace (reference: python/paddle/linalg.py — a
+re-export facade over tensor.linalg).
+
+Everything tensor.linalg DEFINES is re-exported (the framework's linalg
+surface includes completions like vector_norm/matrix_norm/svd_lowrank/
+ormqr beyond the reference facade list); internal helpers imported into
+that module (Tensor, apply_op, ...) are filtered out by module of
+origin so they never become public API the golden gate would bless."""
+from .tensor.linalg import *  # noqa: F401,F403
+from .tensor import linalg as _tl
+
+__all__ = sorted(
+    n for n in dir(_tl)
+    if not n.startswith("_") and callable(getattr(_tl, n))
+    and getattr(getattr(_tl, n), "__module__", "") == _tl.__name__)

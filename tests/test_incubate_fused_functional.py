@@ -1,0 +1,178 @@
+"""paddle.incubate.nn.functional + linalg namespace + the four fused
+layer classes added in round 5 (reference: python/paddle/incubate/nn/
+functional/, python/paddle/linalg.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate.nn as inn
+import paddle_tpu.incubate.nn.functional as FF
+
+
+class TestLinalgNamespace:
+    def test_reference_surface_present_and_working(self):
+        import paddle_tpu.linalg as L
+
+        for n in ("cholesky", "svd", "qr", "eigh", "pinv", "solve",
+                  "lstsq", "norm", "det", "inv", "lu", "cond"):
+            assert hasattr(L, n), n
+        rng = np.random.RandomState(0)
+        a = rng.randn(4, 4).astype("float32")
+        spd = a @ a.T + 4 * np.eye(4, dtype="float32")
+        c = L.cholesky(paddle.to_tensor(spd)).numpy()
+        np.testing.assert_allclose(c @ c.T, spd, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(
+            L.inv(paddle.to_tensor(spd)).numpy() @ spd, np.eye(4),
+            rtol=1e-3, atol=1e-4)
+
+
+class TestFusedFunctional:
+    def test_fused_matmul_bias_and_linear(self):
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(3, 4).astype("float32"))
+        w = paddle.to_tensor(rng.randn(4, 5).astype("float32"))
+        b = paddle.to_tensor(rng.randn(5).astype("float32"))
+        out = FF.fused_matmul_bias(x, w, b).numpy()
+        np.testing.assert_allclose(
+            out, x.numpy() @ w.numpy() + b.numpy(), rtol=1e-5)
+        wt = paddle.to_tensor(w.numpy().T.copy())
+        out2 = FF.fused_linear(x, wt, b, transpose_weight=True).numpy()
+        np.testing.assert_allclose(out2, out, rtol=1e-5)
+
+    def test_fused_dropout_add_eval_is_plain_add(self):
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(2, 3).astype("float32"))
+        y = paddle.to_tensor(rng.randn(2, 3).astype("float32"))
+        out = FF.fused_dropout_add(x, y, p=0.5, training=False).numpy()
+        np.testing.assert_allclose(out, x.numpy() + y.numpy(), rtol=1e-6)
+
+    def test_fused_bias_dropout_residual_layer_norm(self):
+        rng = np.random.RandomState(0)
+        d = 8
+        x = paddle.to_tensor(rng.randn(2, 5, d).astype("float32"))
+        res = paddle.to_tensor(rng.randn(2, 5, d).astype("float32"))
+        bias = paddle.to_tensor(rng.randn(d).astype("float32"))
+        out = FF.fused_bias_dropout_residual_layer_norm(
+            x, res, bias=bias, dropout_rate=0.0).numpy()
+        z = x.numpy() + bias.numpy() + res.numpy()
+        mu = z.mean(-1, keepdims=True)
+        var = z.var(-1, keepdims=True)
+        np.testing.assert_allclose(out, (z - mu) / np.sqrt(var + 1e-5),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_fused_feedforward_matches_layer(self):
+        paddle.seed(0)
+        layer = inn.FusedFeedForward(8, 16, dropout_rate=0.0,
+                                     act_dropout_rate=0.0,
+                                     normalize_before=True)
+        layer.eval()
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(2, 4, 8).astype("float32"))
+        want = layer(x).numpy()
+        got = FF.fused_feedforward(
+            x, layer.linear1.weight, layer.linear2.weight,
+            linear1_bias=layer.linear1.bias,
+            linear2_bias=layer.linear2.bias,
+            ln1_scale=layer.norm.weight, ln1_bias=layer.norm.bias,
+            dropout1_rate=0.0, dropout2_rate=0.0,
+            pre_layer_norm=True, training=False).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_fused_multi_head_attention_matches_dense(self):
+        rng = np.random.RandomState(0)
+        b, s, nh, dh = 2, 5, 2, 4
+        d = nh * dh
+        x = rng.randn(b, s, d).astype("float32")
+        qkv_w = rng.randn(3, nh, dh, d).astype("float32") * 0.3
+        qkv_b = rng.randn(3, nh, dh).astype("float32") * 0.05
+        lw = rng.randn(d, d).astype("float32") * 0.3
+        lb = rng.randn(d).astype("float32") * 0.05
+        out = FF.fused_multi_head_attention(
+            paddle.to_tensor(x), paddle.to_tensor(qkv_w),
+            paddle.to_tensor(lw), qkv_bias=paddle.to_tensor(qkv_b),
+            linear_bias=paddle.to_tensor(lb), dropout_rate=0.0,
+            attn_dropout_rate=0.0, training=False,
+            pre_layer_norm=True).numpy()
+        # independent numpy sim (pre-LN, residual, no post-LN)
+        mu = x.mean(-1, keepdims=True)
+        xv = (x - mu) / np.sqrt(x.var(-1, keepdims=True) + 1e-5)
+        qkv = (xv @ qkv_w.reshape(3 * nh * dh, d).T
+               + qkv_b.reshape(-1)).reshape(b, s, 3, nh, dh)
+        q, k, v = (np.swapaxes(qkv[:, :, j], 1, 2) for j in range(3))
+        sc = np.einsum("bhsd,bhtd->bhst", q, k) / np.sqrt(dh)
+        p = np.exp(sc - sc.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        o = np.swapaxes(np.einsum("bhst,bhtd->bhsd", p, v),
+                        1, 2).reshape(b, s, d)
+        want = x + (o @ lw + lb)
+        np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+    def test_fused_multi_transformer_matches_layer(self):
+        paddle.seed(0)
+        d, nh, dff, L = 8, 2, 16, 2
+        layer = inn.FusedMultiTransformer(d, nh, dff, num_layers=L)
+        layer.eval()
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(2, 5, d).astype("float32"))
+        out = layer(x)
+        assert list(out.shape) == [2, 5, d]
+        assert np.isfinite(out.numpy()).all()
+        # parameters are registered per layer (state_dict round-trips)
+        sd = layer.state_dict()
+        assert f"qkv_weight_{L - 1}" in sd and "ffn2_bias_0" in sd
+
+    def test_fused_multi_transformer_gradients_flow(self):
+        """The functional wraps raw math in a dispatched op — the tape
+        must differentiate into the LAYER weights (round-5 review)."""
+        paddle.seed(0)
+        layer = inn.FusedMultiTransformer(8, 2, 16, num_layers=1)
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(2, 4, 8).astype("float32"))
+        out = layer(x)
+        loss = (out * out).mean()
+        loss.backward()
+        got = [(n, p.grad) for n, p in layer.named_parameters()]
+        with_grad = [n for n, g in got if g is not None
+                     and float(np.abs(np.asarray(g._data)).max()) > 0]
+        assert any("qkv_weight" in n for n in with_grad), with_grad
+        assert any("ffn1_weight" in n for n in with_grad), with_grad
+        assert any("ln_scale" in n for n in with_grad), with_grad
+
+    def test_fused_ec_moe_matches_reference_baseline(self):
+        """Independent numpy sim of the op's own baseline
+        (test_fused_ec_moe_op.py:85-136)."""
+        rng = np.random.RandomState(0)
+        b, s, d, f, e = 2, 32, 4, 8, 2
+        x = rng.randn(b, s, d).astype("float32")
+        gate = rng.randn(b, s, e).astype("float32")
+        w0 = (rng.randn(e, d, f) * 0.3).astype("float32")
+        b0 = (rng.randn(e, 1, f) * 0.05).astype("float32")
+        w1 = (rng.randn(e, f, d) * 0.3).astype("float32")
+        b1 = (rng.randn(e, 1, d) * 0.05).astype("float32")
+        out = FF.fused_ec_moe(
+            paddle.to_tensor(x), paddle.to_tensor(gate),
+            paddle.to_tensor(w0), paddle.to_tensor(b0),
+            paddle.to_tensor(w1), paddle.to_tensor(b1), "relu").numpy()
+
+        cap = s // 16
+        gates = np.exp(gate - gate.max(-1, keepdims=True))
+        gates /= gates.sum(-1, keepdims=True)
+        want = x.copy()
+        for bi in range(b):
+            for ei in range(e):
+                tok = np.argsort(-gate[bi, :, ei], kind="stable")[:cap]
+                sel = x[bi, tok]                          # [cap, d]
+                h = np.maximum(sel @ w0[ei] + b0[ei], 0.0)
+                h = h @ w1[ei] + b1[ei]
+                h = h * gates[bi, tok, ei][:, None]
+                np.add.at(want[bi], tok, h)
+        np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+    def test_fused_layers_exist(self):
+        for cls in ("FusedMultiTransformer", "FusedEcMoe",
+                    "FusedDropoutAdd",
+                    "FusedBiasDropoutResidualLayerNorm"):
+            assert hasattr(inn, cls), cls
+        lay = inn.FusedDropoutAdd(p=0.0)
+        x = paddle.to_tensor(np.ones((2, 2), "float32"))
+        np.testing.assert_allclose(lay(x, x).numpy(), 2 * np.ones((2, 2)))

@@ -36,6 +36,9 @@ SURFACES = [
     "paddle_tpu.jit",
     "paddle_tpu.vision",
     "paddle_tpu.incubate.autograd",
+    "paddle_tpu.incubate.nn",
+    "paddle_tpu.incubate.nn.functional",
+    "paddle_tpu.linalg",
     "paddle_tpu.text",
 ]
 
