@@ -1,7 +1,67 @@
 """paddle.incubate equivalent (autograd prims via jax transforms, fused ops,
-MoE). """
+MoE). Top-level surface follows the reference incubate/__init__.py
+__all__: LookAhead/ModelAverage, the softmax-mask fusions, and the graph
+message-passing + segment family (re-exported from paddle.geometric,
+where the jax segment_* implementations live)."""
 from . import autograd  # noqa: F401
 from . import nn  # noqa: F401
 from . import distributed  # noqa: F401
 from . import asp  # noqa: F401
 from . import optimizer  # noqa: F401
+from .optimizer import LookAhead, ModelAverage  # noqa: F401
+from ..geometric import (  # noqa: F401
+    segment_max, segment_mean, segment_min, segment_sum,
+)
+from ..geometric import send_u_recv as graph_send_recv  # noqa: F401
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) (reference incubate fused_softmax_mask op —
+    one fused kernel there; one XLA fusion here)."""
+    from ..nn import functional as F
+
+    return F.softmax(x + mask, axis=-1)
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Causal-masked softmax over the last two dims (reference
+    fused_softmax_mask_upper_triangle op)."""
+    from ..core.dispatch import apply_op
+
+    def _fn(a):
+        import jax
+        import jax.numpy as jnp
+
+        s_q, s_k = a.shape[-2], a.shape[-1]
+        causal = jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
+        masked = jnp.where(causal, a, jnp.asarray(-1e4, a.dtype))
+        return jax.nn.softmax(masked.astype(jnp.float32),
+                              axis=-1).astype(a.dtype)
+
+    return apply_op("fused_softmax_mask_upper_triangle", _fn, x)
+
+
+def graph_khop_sampler(*a, **k):
+    raise NotImplementedError(
+        "graph_khop_sampler: host-side graph sampling is not "
+        "implemented (the message-passing compute family lives in "
+        "paddle_tpu.geometric)")
+
+
+graph_sample_neighbors = graph_khop_sampler
+graph_reindex = graph_khop_sampler
+
+
+def identity_loss(x, reduction="none"):
+    """(reference incubate.identity_loss): marks a var as loss;
+    reduction in sum(0) | mean(1) | none(2)."""
+    from ..tensor import math as M
+
+    if reduction in (0, "sum"):
+        return M.sum(x)
+    if reduction in (1, "mean"):
+        return M.mean(x)
+    if reduction in (2, "none"):
+        return x
+    raise ValueError(f"identity_loss reduction must be sum(0), mean(1) "
+                     f"or none(2); got {reduction!r}")

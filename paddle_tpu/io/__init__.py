@@ -2,5 +2,6 @@ from .dataloader import DataLoader, get_worker_info  # noqa: F401
 from .dataset import (  # noqa: F401
     BatchSampler, ChainDataset, ComposeDataset, ConcatDataset, Dataset,
     DistributedBatchSampler, IterableDataset, RandomSampler, Sampler,
+    WeightedRandomSampler,
     SequenceSampler, Subset, TensorDataset, random_split,
 )

@@ -144,9 +144,22 @@ class RandomSampler(Sampler):
 
 
 class WeightedRandomSampler(Sampler):
+    """Indices drawn with probability proportional to ``weights``
+    (reference io/sampler.py WeightedRandomSampler)."""
+
     def __init__(self, weights, num_samples, replacement=True):
-        self.weights = np.asarray(weights, np.float64)
-        self.num_samples = num_samples
+        w = np.asarray(weights, np.float64)
+        if w.ndim != 1 or (w < 0).any():
+            raise ValueError("weights must be a 1-D non-negative list")
+        if w.sum() == 0:
+            raise ValueError("weights sum to zero — no index can be "
+                             "drawn")
+        if not replacement and num_samples > w.size:
+            raise ValueError(
+                "num_samples cannot exceed len(weights) when drawing "
+                "without replacement")
+        self.weights = w
+        self.num_samples = int(num_samples)
         self.replacement = replacement
 
     def __iter__(self):

@@ -12,6 +12,17 @@ from .program import (  # noqa: F401
     _disable_static, _enable_static,
 )
 from .io import load_inference_model, save_inference_model, serialize_program  # noqa: F401
+from .compat import (  # noqa: F401
+    BuildStrategy, CompiledProgram, ExecutionStrategy,
+    ExponentialMovingAverage, IpuCompiledProgram, IpuStrategy,
+    ParallelExecutor, Print, Variable, WeightNormParamAttr, accuracy,
+    auc, create_global_var, create_parameter, ctr_metric_bundle,
+    deserialize_persistables, deserialize_program, exponential_decay,
+    gradients, ipu_shard_guard, load, load_from_file,
+    load_program_state, mlu_places, normalize_program, npu_places,
+    py_func, save, save_to_file, scope_guard, serialize_persistables,
+    set_ipu_shard, set_program_state, xpu_places,
+)
 
 
 class InputSpec:

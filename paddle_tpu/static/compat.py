@@ -1,0 +1,516 @@
+"""Remaining paddle.static surface (reference: python/paddle/static/
+__init__.py __all__): serialization helpers, legacy execution-strategy
+shims, debug ops, and hardware-specific entries.
+
+Grouping:
+- REAL implementations: gradients, scope_guard, Print (host callback),
+  py_func (jax.pure_callback), create_global_var / create_parameter /
+  Variable, save/load + the (de)serialize/program-state family,
+  accuracy/auc, exponential_decay, ExponentialMovingAverage,
+  WeightedRandomSampler lives in io.
+- COMPAT shims whose job XLA subsumes: BuildStrategy, ExecutionStrategy,
+  CompiledProgram, ParallelExecutor — attribute bags / pass-throughs;
+  the reference uses them to steer its graph passes and multi-stream
+  executor, both of which the XLA pipeline replaces (SURVEY §2.2).
+- FAITHFULLY-RAISING hardware entries: xpu/npu/mlu_places and the ipu_*
+  family raise like the reference does when not compiled with that
+  hardware; ctr_metric_bundle raises with the PS scope-out.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import warnings
+
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+
+__all__ = [
+    "gradients", "scope_guard", "BuildStrategy", "CompiledProgram",
+    "ipu_shard_guard", "IpuCompiledProgram", "IpuStrategy", "Print",
+    "py_func", "ExecutionStrategy", "ParallelExecutor",
+    "WeightNormParamAttr", "ExponentialMovingAverage", "save", "load",
+    "serialize_persistables", "save_to_file", "deserialize_program",
+    "deserialize_persistables", "load_from_file", "normalize_program",
+    "load_program_state", "set_program_state", "xpu_places",
+    "npu_places", "mlu_places", "Variable", "create_global_var",
+    "accuracy", "auc", "create_parameter", "set_ipu_shard",
+    "ctr_metric_bundle", "exponential_decay",
+]
+
+Variable = Tensor      # static vars ARE Tensors in this design
+
+
+# ------------------------------------------------------------ autodiff
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Static gradient vars of ``targets`` w.r.t. ``inputs`` (reference
+    python/paddle/fluid/backward.py gradients): placeholders resolved by
+    Executor.run as jax.grad over the whole-program replay. ``inputs``
+    must be Parameters (non-parameter inputs would need the override
+    replay; decline loudly rather than return zeros)."""
+    from .program import default_main_program
+
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if target_gradients is not None:
+        raise NotImplementedError(
+            "static.gradients with target_gradients (custom output "
+            "seeds)")
+    bad = [v for v in inputs if not isinstance(v, Parameter)]
+    if bad:
+        raise NotImplementedError(
+            f"static.gradients w.r.t. non-parameter vars "
+            f"({[getattr(b, 'name', '?') for b in bad]}): fetch the "
+            f"forward values and differentiate eagerly, or make them "
+            f"parameters")
+    program = default_main_program()
+    no_grad = set(id(t) for t in (no_grad_set or []))
+    if len(targets) != 1:
+        raise NotImplementedError(
+            "static.gradients with multiple targets (sum the targets "
+            "into one loss var first)")
+    loss = targets[0]
+    outs = []
+    for p in inputs:
+        if id(p) in no_grad:
+            outs.append(None)
+            continue
+        g = Tensor(np.zeros(p.shape, p.dtype.np_dtype),
+                   name=(p.name or "var") + "@GRAD")
+        g.stop_gradient = True
+        program.grad_map[id(g)] = (id(loss), id(p))
+        program.var_by_id[id(g)] = g
+        program.params.setdefault(id(p), p)
+        outs.append(g)
+    return outs
+
+
+# ----------------------------------------------------- scopes / places
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    """Scopes are a C++-executor concept the XLA replay replaces; the
+    guard keeps API compatibility for code structured around it."""
+    yield scope
+
+
+def _hw_places(kind):
+    def places(device_ids=None):
+        raise RuntimeError(
+            f"paddle_tpu is a TPU-native build: not compiled with "
+            f"{kind.upper()} support (reference {kind}_places raises "
+            f"the same way on unsupported builds)")
+    places.__name__ = f"{kind}_places"
+    return places
+
+
+xpu_places = _hw_places("xpu")
+npu_places = _hw_places("npu")
+mlu_places = _hw_places("mlu")
+
+
+def _ipu_unsupported(*_a, **_k):
+    raise RuntimeError(
+        "paddle_tpu is a TPU-native build: not compiled with IPU "
+        "support")
+
+
+ipu_shard_guard = _ipu_unsupported
+IpuCompiledProgram = _ipu_unsupported
+IpuStrategy = _ipu_unsupported
+set_ipu_shard = _ipu_unsupported
+
+
+def ctr_metric_bundle(*_a, **_k):
+    raise NotImplementedError(
+        "ctr_metric_bundle belongs to the parameter-server training "
+        "stack, which is out of scope (SURVEY §7)")
+
+
+# ------------------------------------------------- execution strategies
+
+class BuildStrategy:
+    """Attribute bag (reference BuildStrategy steers C++ graph passes;
+    XLA's pipeline subsumes them, so every knob is accepted and
+    recorded but has no effect)."""
+
+    def __init__(self):
+        self.__dict__["_opts"] = {}
+
+    def __setattr__(self, k, v):
+        self._opts[k] = v
+
+    def __getattr__(self, k):
+        try:
+            return self.__dict__["_opts"][k]
+        except KeyError:
+            raise AttributeError(k) from None
+
+
+class ExecutionStrategy(BuildStrategy):
+    """Same contract as BuildStrategy (multi-stream executor knobs)."""
+
+
+class CompiledProgram:
+    """Pass-through wrapper: Executor.run accepts the underlying Program
+    directly (whole-program XLA compilation replaces the reference's
+    graph-compilation step)."""
+
+    def __init__(self, program_or_graph, build_strategy=None):
+        self._program = program_or_graph
+        self._build_strategy = build_strategy
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, share_vars_from=None,
+                           places=None):
+        warnings.warn(
+            "CompiledProgram.with_data_parallel: single-process data "
+            "parallelism is expressed through the device mesh "
+            "(fleet.init hybrid_configs) in paddle_tpu; running the "
+            "program as-is")
+        return self
+
+    def __getattr__(self, k):
+        return getattr(self.__dict__["_program"], k)
+
+
+class ParallelExecutor:
+    """Legacy multi-card executor; delegates to the plain Executor (the
+    mesh handles multi-device)."""
+
+    def __init__(self, use_cuda=False, loss_name=None,
+                 main_program=None, build_strategy=None,
+                 exec_strategy=None, scope=None, share_vars_from=None):
+        from . import Executor
+
+        self._exe = Executor()
+        self._program = main_program
+
+    def run(self, fetch_list=None, feed=None, return_numpy=True):
+        return self._exe.run(self._program, feed=feed,
+                             fetch_list=fetch_list,
+                             return_numpy=return_numpy)
+
+
+class WeightNormParamAttr:
+    """The reference reparameterizes W = g * V/||V|| through graph
+    rewrite. The dygraph route (nn.utils.weight_norm) is implemented;
+    the static-graph rewrite is not — constructing this attr raises
+    rather than silently training without the reparameterization."""
+
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "WeightNormParamAttr (static-graph weight norm): use "
+            "paddle_tpu.nn.utils.weight_norm on the layer instead")
+
+
+# ------------------------------------------------------------ debug ops
+
+def Print(input, first_n=-1, message=None, summarize=20,  # noqa: N802
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    """Debug print op (reference print_op.cc): identity on data flow,
+    host-side print as a side effect — jax.debug.print survives the
+    traced replay."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply_op
+
+    prefix = message or getattr(input, "name", "") or "var"
+
+    def _p(a):
+        jax.debug.print(prefix + ": {}", a)
+        return jnp.asarray(a)
+
+    return apply_op("print", _p, input)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """User python callback as an op (reference py_func_op.cc) — mapped
+    onto jax.pure_callback so it runs in the compiled replay; ``out``
+    is the shape/dtype template (a Tensor or list of Tensors)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply_op
+
+    if backward_func is not None:
+        raise NotImplementedError(
+            "py_func backward_func (custom python gradients run through "
+            "PyLayer in this framework)")
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    templates = [jax.ShapeDtypeStruct(tuple(o.shape), o.dtype.np_dtype)
+                 for o in outs]
+
+    def _cb(*arrays):
+        res = func(*[np.asarray(a) for a in arrays])
+        res = res if isinstance(res, (list, tuple)) else [res]
+        return tuple(np.asarray(r, t.dtype).reshape(t.shape)
+                     for r, t in zip(res, templates))
+
+    def _run(*arrays):
+        result = jax.pure_callback(_cb, tuple(templates), *arrays)
+        return result if len(result) > 1 else result[0]
+
+    return apply_op("py_func", _run, *xs)
+
+
+# ------------------------------------------------------------- metrics
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):  # noqa: A002
+    from ..metric import accuracy as _acc
+
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,  # noqa: A002
+        slide_steps=1):
+    """Batch AUC over the score/label vars (reference
+    static/nn/metric.py auc: returns (auc_out, batch_auc_out,
+    [state vars]) — the same trapezoidal threshold sweep the Auc metric
+    class uses; batch and global AUC coincide for one batch)."""
+    from ..metric import Auc
+
+    m = Auc(curve=curve, num_thresholds=num_thresholds)
+    preds = input if not isinstance(input, Tensor) else input.numpy()
+    labels = label if not isinstance(label, Tensor) else label.numpy()
+    preds = np.asarray(preds)
+    if preds.ndim == 1 or preds.shape[-1] == 1:
+        preds = np.stack([1.0 - preds.reshape(-1),
+                          preds.reshape(-1)], axis=1)
+    m.update(preds, np.asarray(labels).reshape(-1, 1))
+    out = Tensor(np.asarray(m.accumulate(), np.float32))
+    states = [Tensor(np.asarray(s)) for s in
+              (m._stat_pos, m._stat_neg)] if hasattr(m, "_stat_pos") \
+        else []
+    return out, out, states
+
+
+# ------------------------------------------------------ lr / EMA compat
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """Legacy alias for optimizer.lr.ExponentialDecay stepped per
+    ``decay_steps`` (reference fluid layers.exponential_decay)."""
+    from ..optimizer.lr import ExponentialDecay
+
+    gamma = decay_rate ** (1.0 / decay_steps) if not staircase \
+        else decay_rate
+    sched = ExponentialDecay(learning_rate=learning_rate, gamma=gamma)
+    if staircase:
+        warnings.warn("staircase exponential_decay steps the scheduler "
+                      "once per decay_steps calls of step()")
+    return sched
+
+
+class ExponentialMovingAverage:
+    """EMA of parameter values (reference static
+    ExponentialMovingAverage): ``update()`` after each step;
+    ``apply(exe)`` swaps shadows in (context manager), ``restore``
+    swaps back."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._shadow = {}
+        self._backup = {}
+
+    def update(self, program=None):
+        from .program import default_main_program
+
+        program = program or default_main_program()
+        for pid, p in program.params.items():
+            cur = np.asarray(p._data, np.float32)
+            if pid not in self._shadow:
+                self._shadow[pid] = cur.copy()
+            else:
+                self._shadow[pid] = (self._decay * self._shadow[pid]
+                                     + (1.0 - self._decay) * cur)
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        from .program import default_main_program
+
+        program = default_main_program()
+        for pid, p in program.params.items():
+            if pid in self._shadow:
+                self._backup[pid] = p._data
+                p._data = self._shadow[pid].astype(p._data.dtype)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore(executor)
+
+    def restore(self, executor=None):
+        from .program import default_main_program
+
+        program = default_main_program()
+        for pid, p in program.params.items():
+            if pid in self._backup:
+                p._data = self._backup.pop(pid)
+
+
+# --------------------------------------------------- vars / params
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """A persistable var initialized to ``value`` (reference
+    fluid.layers.create_global_var)."""
+    from ..framework.dtype import convert_dtype
+
+    arr = np.full(tuple(shape), value, convert_dtype(dtype).np_dtype)
+    t = Parameter(arr, name=name)
+    t.stop_gradient = True
+    from .program import default_main_program, in_static_mode
+
+    if in_static_mode():
+        prog = default_main_program()
+        prog.params[id(t)] = t
+        prog.var_by_id[id(t)] = t
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    from ..nn.initializer_utils import create_parameter_with_attr
+
+    p = create_parameter_with_attr(shape, dtype, attr, is_bias,
+                                   default_initializer=default_initializer)
+    if name:
+        p.name = name
+    from .program import default_main_program, in_static_mode
+
+    if in_static_mode():
+        prog = default_main_program()
+        prog.params[id(p)] = p
+        prog.var_by_id[id(p)] = p
+    return p
+
+
+# --------------------------------------------------- (de)serialization
+
+def serialize_persistables(feed_vars=None, fetch_vars=None, program=None):
+    """Params as a save_combine stream (reference static.serialize_
+    persistables)."""
+    from .pdmodel_export import serialize_params
+    from .program import default_main_program
+
+    program = program or default_main_program()
+    names = {}
+    for i, (pid, p) in enumerate(sorted(program.params.items())):
+        names[p.name or f"param_{i}"] = np.asarray(p._data)
+    return serialize_params(names)
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def deserialize_program(data):
+    """Bytes -> executable program: reference-format protobuf pairs load
+    through the pdmodel decoder."""
+    from .pdmodel import is_pdmodel_bytes, parse_program_desc, PdProgram
+
+    if is_pdmodel_bytes(data):
+        return PdProgram(parse_program_desc(data))
+    raise ValueError("deserialize_program expects ProgramDesc protobuf "
+                     "bytes (.pdmodel payload)")
+
+
+def deserialize_persistables(program, data, executor=None):
+    from .pdmodel import parse_combined_params
+
+    params = parse_combined_params(data, program.persistable_names())
+    program.params = dict(params)
+    return params
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """The reference prunes to the feed->fetch subgraph; the replay
+    executor already prunes at compile time, so the program passes
+    through."""
+    return program
+
+
+def load_program_state(model_path, var_list=None):
+    """state dict from a saved model prefix (reference
+    static.load_program_state). Reads either a reference-format
+    protobuf .pdmodel pair or the static.save payload (which records
+    the param-name order the .pdiparams stream was written in)."""
+    import pickle
+
+    from .pdmodel import (PdProgram, is_pdmodel_bytes,
+                          parse_combined_params, parse_program_desc)
+
+    if os.path.exists(model_path + ".pdmodel"):
+        with open(model_path + ".pdmodel", "rb") as f:
+            model_bytes = f.read()
+        with open(model_path + ".pdiparams", "rb") as f:
+            params_bytes = f.read()
+        if is_pdmodel_bytes(model_bytes):
+            prog = PdProgram(parse_program_desc(model_bytes))
+            return dict(parse_combined_params(
+                params_bytes, prog.persistable_names()))
+        meta = pickle.loads(model_bytes)
+        names = meta.get("param_names")
+        if names is None:
+            raise ValueError(
+                f"{model_path}.pdmodel carries no param-name order; "
+                f"re-save with static.save")
+        return dict(parse_combined_params(params_bytes, sorted(names)))
+    from .. import load as _load
+
+    return _load(model_path)
+
+
+def set_program_state(program, state_dict):
+    by_name = {p.name: p for p in program.all_parameters()}
+    missing = [n for n in state_dict if n not in by_name]
+    for n, arr in state_dict.items():
+        if n in by_name:
+            t = by_name[n]
+            t._data = np.asarray(arr, dtype=t._data.dtype) \
+                if hasattr(arr, "dtype") else np.asarray(arr)
+    if missing:
+        warnings.warn(f"set_program_state: {len(missing)} entries had "
+                      f"no matching parameter: {missing[:5]}")
+
+
+def save(program, model_path, protocol=4, **kwargs):
+    """<prefix>.pdmodel + .pdiparams (reference static.save writes
+    .pdmodel/.pdiparams/.pdopt). The .pdmodel payload records the
+    param-name order so load_program_state can decode the
+    save_combine stream without the protobuf desc."""
+    import pickle
+
+    from .pdmodel_export import serialize_params
+    from .program import Program
+
+    if isinstance(program, Program):
+        params = {(p.name or f"param_{i}"): np.asarray(p._data)
+                  for i, p in enumerate(program.all_parameters())}
+        with open(model_path + ".pdiparams", "wb") as f:
+            f.write(serialize_params(params))
+        with open(model_path + ".pdmodel", "wb") as f:
+            f.write(pickle.dumps({"n_ops": len(program.ops),
+                                  "param_names": sorted(params)}))
+        return model_path
+    raise TypeError(f"static.save expects a Program, got {type(program)}")
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """Counterpart of static.save."""
+    state = load_program_state(model_path)
+    set_program_state(program, state)
+    return program

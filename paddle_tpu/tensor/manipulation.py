@@ -304,6 +304,14 @@ def index_add(x, index, axis, value, name=None):
     return apply_op("index_add", _index_add, x, index, value)
 
 
+def index_add_(x, index, axis, value, name=None):
+    """Inplace variant of index_add (reference tensor/manipulation.py
+    index_add_)."""
+    from .math import _inplace
+
+    return _inplace(x, index_add(x, index, axis, value))
+
+
 def index_put(x, indices, value, accumulate=False, name=None):
     def _index_put(a, v, *idx):
         if accumulate:

@@ -2,3 +2,33 @@ from . import models  # noqa: F401
 from . import transforms  # noqa: F401
 from . import datasets  # noqa: F401
 from . import ops  # noqa: F401
+
+_image_backend = "pil"
+
+
+def set_image_backend(backend):
+    """(reference vision/image.py): 'pil' or 'cv2' — this build ships
+    PIL; cv2 is not in the image."""
+    global _image_backend
+    if backend not in ("pil", "cv2"):
+        raise ValueError(f"backend must be 'pil' or 'cv2', got "
+                         f"{backend!r}")
+    if backend == "cv2":
+        raise RuntimeError("cv2 is not available in this environment; "
+                           "the PIL backend is")
+    _image_backend = backend
+
+
+def get_image_backend():
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image file via the selected backend (reference
+    vision/image.py image_load returns a PIL Image for 'pil')."""
+    backend = backend or _image_backend
+    if backend != "pil":
+        raise RuntimeError(f"backend {backend!r} unavailable (PIL only)")
+    from PIL import Image
+
+    return Image.open(path)

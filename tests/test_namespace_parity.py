@@ -1,0 +1,158 @@
+"""Namespace __all__ parity gate vs the reference's own package lists —
+every symbol the reference exports at these surfaces must exist here
+(round-5 sweep closed the last 52; this keeps them closed)."""
+import importlib
+import os
+import re
+
+import numpy as np
+import pytest
+
+REF = "/root/reference/python/paddle"
+
+PAIRS = [
+    ("__init__.py", "paddle_tpu"),
+    ("nn/__init__.py", "paddle_tpu.nn"),
+    ("nn/functional/__init__.py", "paddle_tpu.nn.functional"),
+    ("distributed/__init__.py", "paddle_tpu.distributed"),
+    ("static/__init__.py", "paddle_tpu.static"),
+    ("incubate/__init__.py", "paddle_tpu.incubate"),
+    ("io/__init__.py", "paddle_tpu.io"),
+    ("optimizer/__init__.py", "paddle_tpu.optimizer"),
+    ("vision/__init__.py", "paddle_tpu.vision"),
+]
+
+
+def _ref_all(path):
+    with open(path) as f:
+        src = f.read()
+    m = re.search(r"__all__ = \[(.*?)\]", src, re.S)
+    if not m:
+        return []
+    return re.findall(r"'([^']+)'", m.group(1)) + \
+        re.findall(r'"([^"]+)"', m.group(1))
+
+
+@pytest.mark.parametrize("ref_file,mod_name", PAIRS,
+                         ids=[p[1] for p in PAIRS])
+def test_reference_all_symbols_present(ref_file, mod_name):
+    path = os.path.join(REF, ref_file)
+    if not os.path.exists(path):
+        pytest.skip("reference tree unavailable")
+    want = _ref_all(path)
+    assert want, f"no __all__ parsed from {path}"
+    mod = importlib.import_module(mod_name)
+    missing = [n for n in want if not hasattr(mod, n)]
+    assert not missing, f"{mod_name} missing reference symbols: {missing}"
+
+
+class TestNewSurfaceFunctionality:
+    def test_weighted_random_sampler(self):
+        from paddle_tpu.io import WeightedRandomSampler
+
+        np.random.seed(0)
+        s = WeightedRandomSampler([0.0, 0.0, 1.0], 8, replacement=True)
+        idx = list(s)
+        assert len(idx) == 8 and all(i == 2 for i in idx)
+        with pytest.raises(ValueError):
+            WeightedRandomSampler([1.0], 5, replacement=False)
+
+    def test_index_add_inplace(self):
+        import paddle_tpu as paddle
+
+        x = paddle.to_tensor(np.zeros((3, 2), "float32"))
+        idx = paddle.to_tensor(np.array([0, 2], "int64"))
+        v = paddle.to_tensor(np.ones((2, 2), "float32"))
+        out = paddle.index_add_(x, idx, 0, v)
+        np.testing.assert_allclose(
+            x.numpy(), [[1, 1], [0, 0], [1, 1]])
+        assert out is x or np.allclose(out.numpy(), x.numpy())
+
+    def test_softmax_mask_fuse_upper_triangle(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate import softmax_mask_fuse_upper_triangle
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 2, 4, 4).astype("float32")
+        out = softmax_mask_fuse_upper_triangle(
+            paddle.to_tensor(x)).numpy()
+        # strictly-upper entries get ~0 probability; rows sum to 1
+        assert np.triu(out[0, 0], k=1).max() < 1e-4
+        np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-5)
+
+    def test_static_gradients_and_compat(self):
+        import paddle_tpu as paddle
+        import paddle_tpu.static as static
+
+        paddle.enable_static()
+        try:
+            main, startup = static.Program(), static.Program()
+            with static.program_guard(main, startup):
+                x = static.data("x", [4, 3], "float32")
+                w = static.create_parameter([3, 2], "float32",
+                                            name="w_cgrad")
+                gv = static.create_global_var([1], 2.0, "float32")
+                y = paddle.matmul(x, w) * gv
+                loss = paddle.mean(y)
+                (g,) = static.gradients(loss, [w])
+            exe = static.Executor()
+            exe.run(startup)
+            rng = np.random.RandomState(0)
+            xv = rng.randn(4, 3).astype("float32")
+            gval, = exe.run(main, feed={"x": xv}, fetch_list=[g])
+            # closed form: d(mean(2*x@w))/dw = 2 * x^T @ ones/(N*M)
+            want = 2.0 * xv.T @ np.full((4, 2), 1.0 / 8, "float32")
+            np.testing.assert_allclose(gval, want, rtol=1e-4, atol=1e-5)
+        finally:
+            paddle.disable_static()
+
+    def test_static_save_load_roundtrip(self, tmp_path):
+        import paddle_tpu as paddle
+        import paddle_tpu.static as static
+
+        paddle.enable_static()
+        try:
+            main, startup = static.Program(), static.Program()
+            with static.program_guard(main, startup):
+                x = static.data("x", [2, 3], "float32")
+                w = static.create_parameter([3, 2], "float32",
+                                            name="w_sv")
+                y = paddle.matmul(x, w)
+            exe = static.Executor()
+            exe.run(startup)
+            orig = np.asarray(w._data).copy()
+            prefix = str(tmp_path / "m")
+            static.save(main, prefix)
+            state = static.load_program_state(prefix)
+            assert "w_sv" in state
+            w._data = np.zeros_like(orig)
+            static.set_program_state(main, state)
+            np.testing.assert_allclose(np.asarray(w._data), orig)
+        finally:
+            paddle.disable_static()
+
+    def test_compat_shims_and_hw_raisers(self):
+        import paddle_tpu.static as static
+
+        bs = static.BuildStrategy()
+        bs.fuse_bn_act_ops = True
+        assert bs.fuse_bn_act_ops is True
+        with pytest.raises(RuntimeError, match="XPU"):
+            static.xpu_places()
+        with pytest.raises(RuntimeError, match="IPU"):
+            static.IpuStrategy()
+        with pytest.raises(NotImplementedError):
+            static.WeightNormParamAttr(dim=0)
+
+    def test_vision_image_backend(self, tmp_path):
+        import paddle_tpu.vision as V
+
+        assert V.get_image_backend() == "pil"
+        with pytest.raises(RuntimeError):
+            V.set_image_backend("cv2")
+        from PIL import Image
+
+        p = tmp_path / "t.png"
+        Image.fromarray(np.zeros((4, 4, 3), "uint8")).save(p)
+        img = V.image_load(str(p))
+        assert img.size == (4, 4)
