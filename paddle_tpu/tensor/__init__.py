@@ -111,7 +111,8 @@ Tensor.__setitem__ = _setitem
 
 _METHOD_SOURCES = [creation, linalg, logic, manipulation, math, random, search,
                    stat, einsum_mod]
-_SKIP = {"to_tensor", "create_parameter", "arange", "linspace", "logspace",
+_SKIP = {"to_tensor", "create_parameter", "create_tensor", "arange",
+         "linspace", "logspace",
          "eye", "zeros", "ones", "full", "empty", "meshgrid", "tril_indices",
          "triu_indices", "rand", "randn", "randint", "randperm", "uniform",
          "normal", "standard_normal", "gaussian", "assign"}
@@ -143,6 +144,28 @@ Tensor.norm = linalg.norm
 Tensor.dim = lambda self: self.ndim
 Tensor.ndimension = lambda self: self.ndim
 Tensor.element_size = lambda self: self.dtype.itemsize
+
+
+def _sigmoid_method(self, name=None):
+    from ..nn.functional import sigmoid as _sg
+
+    return _sg(self)
+
+
+def _sigmoid_method_(self, name=None):
+    from ..nn.functional import sigmoid as _sg
+
+    return math._inplace(self, _sg(self))
+
+
+# reference tensor_method_func entries not sourced from the tensor
+# modules: sigmoid lives in nn.functional; create_parameter /
+# create_tensor are module-level factories the reference also patches
+# onto Tensor (callable as attributes, not via an instance)
+Tensor.sigmoid = _sigmoid_method
+Tensor.sigmoid_ = _sigmoid_method_
+Tensor.create_parameter = staticmethod(creation.create_parameter)
+Tensor.create_tensor = staticmethod(creation.create_tensor)
 Tensor.is_floating_point = lambda self: self.dtype.is_floating
 Tensor.is_integer = lambda self: self.dtype.is_integer
 Tensor.is_complex = lambda self: self.dtype.is_complex

@@ -155,6 +155,17 @@ def complex(real, imag, name=None):
     return apply_op("complex", lambda r, i: r + 1j * i, real, imag)
 
 
+def create_tensor(dtype, name=None, persistable=False):
+    """An empty var holding a Tensor of ``dtype`` (reference
+    tensor/creation.py:229 — a static-graph placeholder; here an empty
+    array the caller assigns into)."""
+    t = Tensor(np.zeros((0,), dtype_mod.convert_dtype(dtype).np_dtype),
+               name=name)
+    t.stop_gradient = True
+    t.persistable = persistable
+    return t
+
+
 def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
                      default_initializer=None):
     from ..core.tensor import Parameter

@@ -304,6 +304,22 @@ def index_add(x, index, axis, value, name=None):
     return apply_op("index_add", _index_add, x, index, value)
 
 
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    """Inplace flatten (reference tensor/manipulation.py flatten_)."""
+    from .math import _inplace
+
+    return _inplace(x, flatten(x, start_axis, stop_axis))
+
+
+def put_along_axis_(arr, indices, values, axis, reduce="assign",  # noqa: A002
+                    name=None):
+    """Inplace put_along_axis."""
+    from .math import _inplace
+
+    return _inplace(arr, put_along_axis(arr, indices, values, axis,
+                                        reduce))
+
+
 def index_add_(x, index, axis, value, name=None):
     """Inplace variant of index_add (reference tensor/manipulation.py
     index_add_)."""

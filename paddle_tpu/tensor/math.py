@@ -369,6 +369,7 @@ ceil_ = _make_inplace(ceil)
 neg_ = _make_inplace(neg)
 abs_ = _make_inplace(abs)
 tanh_ = _make_inplace(tanh)
+erfinv_ = _make_inplace(erfinv)
 remainder_ = _make_inplace(remainder)
 floor_divide_ = _make_inplace(floor_divide)
 lerp_ = _make_inplace(lerp)
