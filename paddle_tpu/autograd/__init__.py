@@ -1,5 +1,6 @@
 """paddle.autograd equivalent (reference: /root/reference/python/paddle/autograd/)."""
 from ..core.autograd import backward, grad, no_grad, enable_grad  # noqa: F401
+from ..core.autograd import saved_tensors_hooks  # noqa: F401
 from .py_layer import PyLayer, PyLayerContext  # noqa: F401
 from .functional import jacobian, hessian, vjp, jvp  # noqa: F401
 

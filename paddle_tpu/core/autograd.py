@@ -106,7 +106,7 @@ class GradNode:
     __slots__ = (
         "vjp_fn", "input_refs", "n_outputs", "name", "_hooks",
         "out_templates", "primal_fn", "primal_args", "multi_out",
-        "__weakref__",
+        "unpack_fn", "primal_orig_refs", "__weakref__",
     )
 
     def __init__(self, vjp_fn, inputs, n_outputs: int, name: str = "op",
@@ -127,9 +127,27 @@ class GradNode:
         # (the reference's double-grad nodes, eager/backward.cc:404).
         self.primal_fn = primal_fn
         self.primal_args = primal_args
+        # saved_tensors_hooks: when set, primal_args hold PACKED values
+        # and unpack_fn restores them on use (backward recomputes the
+        # pullback from the unpacked snapshot — remat-style, so pack
+        # genuinely controls what stays resident)
+        self.unpack_fn = None
+        # weakrefs to the ORIGINAL input arrays, kept only for hook
+        # nodes (identity-based mutation detection in create_graph)
+        self.primal_orig_refs = None
         # Whether the primal returned a tuple/list (a 1-tuple op must get a
         # 1-tuple cotangent — n_outputs alone cannot distinguish it).
         self.multi_out = (n_outputs > 1) if multi_out is None else multi_out
+
+    def primal_values(self):
+        """primal_args with any saved_tensors_hooks unpack applied."""
+        if self.unpack_fn is None:
+            return self.primal_args
+        out = []
+        for a in self.primal_args:
+            v = self.unpack_fn(a)
+            out.append(v._data if hasattr(v, "_data") else v)
+        return out
 
     def next_nodes(self):
         return [r.node for r in self.input_refs if r.node is not None]
@@ -139,6 +157,38 @@ class GradNode:
         self.input_refs = []
         self.primal_fn = None
         self.primal_args = None
+
+
+_hooks_state = threading.local()
+
+
+class saved_tensors_hooks:
+    """Context manager transforming what the tape keeps for backward
+    (reference python/paddle/autograd/saved_tensors_hooks.py). Ops
+    recorded inside store pack_hook(snapshot) INSTEAD of jax's residual
+    closure; backward unpacks and REBUILDS the pullback from the
+    restored primals (remat-style), so pack genuinely controls resident
+    memory — e.g. pack to host numpy for activation offload."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        stack = getattr(_hooks_state, "stack", None)
+        if stack is None:
+            stack = _hooks_state.stack = []
+        stack.append((self.pack_hook, self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        _hooks_state.stack.pop()
+        return False
+
+
+def current_saved_tensors_hooks():
+    stack = getattr(_hooks_state, "stack", None)
+    return stack[-1] if stack else None
 
 
 def _is_float0(x) -> bool:
@@ -236,7 +286,9 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False,
             continue
         processed.add(nid)
         cots = node_cots.pop(nid, None)
-        if node.vjp_fn is None:
+        if node.vjp_fn is None and node.primal_fn is None:
+            # released node (nodes recorded under saved_tensors_hooks
+            # legitimately have vjp_fn None but keep their primal record)
             if cots is not None:
                 raise RuntimeError(
                     "Trying to backward through the graph a second time; "
@@ -283,7 +335,12 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False,
 
 
 def _call_vjp(node, cots):
-    """Invoke the stored pullback, substituting zeros for unused outputs."""
+    """Invoke the stored pullback, substituting zeros for unused outputs.
+    Nodes recorded under saved_tensors_hooks store NO pullback closure —
+    the vjp is rebuilt here from the unpacked primal snapshot."""
+    vjp_fn = node.vjp_fn
+    if vjp_fn is None and node.primal_fn is not None:
+        _, vjp_fn = jax.vjp(node.primal_fn, *node.primal_values())
     filled = []
     for i, c in enumerate(cots):
         if c is None:
@@ -303,8 +360,8 @@ def _call_vjp(node, cots):
                 c = jax.numpy.asarray(c).astype(dtype)
         filled.append(c)
     if not node.multi_out:
-        return node.vjp_fn(filled[0])
-    return node.vjp_fn(tuple(filled))
+        return vjp_fn(filled[0])
+    return vjp_fn(tuple(filled))
 
 
 def _call_vjp_rerecord(node, cots):
@@ -339,10 +396,23 @@ def _call_vjp_rerecord(node, cots):
     # substitute a shadow tensor wrapping the snapshot with the ORIGINAL
     # producer edge, so the pullback evaluates at the correct point.
     from .tensor import Tensor as _T
+    primal_vals = node.primal_values()
     primal_tensors = []
-    for r, snap in zip(node.input_refs, node.primal_args):
+    for i, (r, snap) in enumerate(zip(node.input_refs, primal_vals)):
         t = r.tensor
-        if t._data is not snap:
+        if node.unpack_fn is not None:
+            # hook nodes: identity against the unpacked copy never
+            # matches — compare against the recorded original through
+            # the weakref kept at record time so second-order graphs
+            # stay connected when the tensor was not rebound
+            orig = None
+            refs = node.primal_orig_refs
+            if refs is not None and refs[i] is not None:
+                orig = refs[i]()
+            mutated = orig is None or t._data is not orig
+        else:
+            mutated = t._data is not snap
+        if mutated:
             t = _T(snap, stop_gradient=r.tensor.stop_gradient)
             t._grad_node = r.node
             t._output_index = r.output_index
@@ -365,7 +435,7 @@ def _call_vjp_rerecord(node, cots):
         else:
             cot_tensors.append(Tensor(jax.numpy.asarray(c),
                                       stop_gradient=True))
-    in_dtypes = [getattr(a, "dtype", None) for a in node.primal_args]
+    in_dtypes = [getattr(a, "dtype", None) for a in primal_vals]
     keep = [i for i, dt in enumerate(in_dtypes)
             if dt is not None and jax.numpy.issubdtype(dt, jax.numpy.inexact)]
     if not keep:

@@ -84,9 +84,12 @@ def apply_op(name: str, jax_fn: Callable, *args, _outputs_stop_grad=None,
         not t.stop_gradient for t in tensors
     )
 
-    if record:
+    hooks = autograd.current_saved_tensors_hooks() if record else None
+    if record and hooks is None:
         out, vjp_fn = jax.vjp(f, *arrays)
     else:
+        # under saved_tensors_hooks the residual closure is NOT kept —
+        # backward rebuilds the vjp from the packed+unpacked snapshot
         out = f(*arrays)
         vjp_fn = None
 
@@ -97,11 +100,28 @@ def apply_op(name: str, jax_fn: Callable, *args, _outputs_stop_grad=None,
         _check_nan_inf(name, [o for o in out_leaves if isinstance(o, jax.Array)])
 
     if record:
+        stored_args = arrays
+        if hooks is not None:
+            from .tensor import Tensor as _T
+            pack, _unpack = hooks
+            stored_args = [pack(_T(a, stop_gradient=True))
+                           for a in arrays]
         node = GradNode(
             vjp_fn, tensors, n_outputs=len(out_leaves), name=name,
             out_templates=[(o.shape, o.dtype) for o in out_leaves],
-            primal_fn=f, primal_args=arrays, multi_out=multi,
+            primal_fn=f, primal_args=stored_args, multi_out=multi,
         )
+        if hooks is not None:
+            import weakref
+
+            node.unpack_fn = hooks[1]
+
+            def _ref(a):
+                try:
+                    return weakref.ref(a)
+                except TypeError:
+                    return None
+            node.primal_orig_refs = [_ref(a) for a in arrays]
         wrapped = []
         for i, o in enumerate(out_leaves):
             sg = False

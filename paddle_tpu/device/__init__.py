@@ -144,3 +144,50 @@ cuda = _types.SimpleNamespace(
 )
 
 tpu = cuda
+
+
+# ---- other-hardware compat (reference device/__init__.py surface):
+# the is_compiled_with_* probes answer False on a build without that
+# hardware, exactly as the reference does; the Place constructors raise
+# the reference's not-compiled error.
+
+def get_cudnn_version():
+    """None when not compiled with CUDA (reference contract)."""
+    return None
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_npu():
+    return False
+
+
+def is_compiled_with_mlu():
+    return False
+
+
+def is_compiled_with_cinn():
+    # XLA is the whole-graph compiler on this stack; the CINN bridge
+    # does not exist (SURVEY: compiler rows subsumed by design)
+    return False
+
+
+def is_compiled_with_custom_device(device_type):
+    return False
+
+
+def get_all_custom_device_type():
+    return []
+
+
+def get_available_custom_device():
+    return []
+
+
+# Place classes follow the package-wide compat philosophy (place.py):
+# reference scripts constructing other-accelerator places land on TPU,
+# the same way CUDAPlace does — and both import paths (paddle.XPUPlace /
+# paddle.device.XPUPlace) resolve to the SAME class.
+from ..framework.place import IPUPlace, MLUPlace, XPUPlace  # noqa: F401,E402

@@ -7,6 +7,11 @@ from .fleet_api import (  # noqa: F401
     save_inference_model, save_persistables, worker_index, worker_num,
 )
 from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
+from .util_data import (  # noqa: F401
+    DataGenerator, MultiSlotDataGenerator, MultiSlotStringDataGenerator,
+    Role, UtilBase,
+)
+from .fleet_api import _FleetAPIType as Fleet  # noqa: F401
 
 PaddleCloudRoleMaker = None
 

@@ -1,0 +1,207 @@
+"""fleet UtilBase + MultiSlot data generators + Role.
+
+Reference: python/paddle/distributed/fleet/utils/fleet_util.py
+(UtilBase), fleet/data_generator/data_generator.py, base/role_maker.py
+(Role). The data generators are PS-feed TEXT formatters — standalone
+logic with no server dependency, so they are implemented faithfully
+(slot lines readable by MultiSlotDataFeed); UtilBase's collective
+helpers ride this framework's collective layer.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+
+class Role:
+    """(role_maker.py:31)."""
+
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class UtilBase:
+    """(fleet_util.py UtilBase): small cross-worker utilities."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):  # noqa: A002
+        import numpy as np
+
+        from .. import all_reduce as _ar
+        from ..communication.collective import ReduceOp
+        from ... import to_tensor
+
+        op = {"sum": ReduceOp.SUM, "max": ReduceOp.MAX,
+              "min": ReduceOp.MIN}.get(mode)
+        if op is None:
+            raise ValueError(f"all_reduce mode {mode!r} (sum|max|min)")
+        t = to_tensor(np.asarray(input))
+        _ar(t, op=op)
+        return t.numpy()
+
+    def barrier(self, comm_world="worker"):
+        from ..communication.collective import barrier as _barrier
+
+        _barrier()
+
+    def all_gather(self, input, comm_world="worker"):  # noqa: A002
+        import numpy as np
+
+        from .. import all_gather as _ag
+        from ... import to_tensor
+
+        out = []
+        _ag(out, to_tensor(np.asarray(input)))
+        return [o.numpy() for o in out]
+
+    def get_file_shard(self, files):
+        """Split ``files`` contiguously over workers, earlier workers
+        taking the remainder (fleet_util.py get_file_shard)."""
+        if not isinstance(files, list):
+            raise TypeError("files should be a list of file paths")
+        from .. import env
+
+        trainer_id = env.global_rank()
+        trainers = env.get_world_size()
+        remainder = len(files) % trainers
+        blocksize = len(files) // trainers
+        begin = trainer_id * blocksize + min(trainer_id, remainder)
+        end = begin + blocksize + (1 if trainer_id < remainder else 0)
+        return files[begin:end]
+
+    def print_on_rank(self, message, rank_id):
+        from .. import env
+
+        if env.global_rank() == rank_id:
+            print(message)
+
+
+class DataGenerator:
+    """(data_generator.py DataGenerator): user overrides generate();
+    run_from_stdin/run_from_memory stream formatted slot lines."""
+
+    def __init__(self):
+        self.batch_size_ = 1
+        self._proto_info = None
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = batch_size
+
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "Please rewrite this function to return a list or tuple: "
+            "[(name, [feasign, ...]), ...]")
+
+    generate = generate_sample
+
+    def generate_batch(self, samples):
+        def local_iter():
+            for s in samples:
+                yield s
+        return local_iter
+
+    def run_from_stdin(self):
+        for line in sys.stdin:
+            line_iter = self.generate_sample(line)
+            for user_parsed_line in line_iter():
+                if user_parsed_line is None:
+                    continue
+                sys.stdout.write(self._gen_str(user_parsed_line))
+
+    def run_from_memory(self):
+        batch_samples = []
+        line_iter = self.generate_sample(None)
+        for user_parsed_line in line_iter():
+            if user_parsed_line is None:
+                continue
+            batch_samples.append(user_parsed_line)
+            if len(batch_samples) == self.batch_size_:
+                batch_iter = self.generate_batch(batch_samples)
+                for sample in batch_iter():
+                    sys.stdout.write(self._gen_str(sample))
+                batch_samples = []
+        if batch_samples:
+            batch_iter = self.generate_batch(batch_samples)
+            for sample in batch_iter():
+                sys.stdout.write(self._gen_str(sample))
+
+    def _gen_str(self, line):
+        raise NotImplementedError
+
+
+def _validate_slots(line):
+    if isinstance(line, zip):
+        line = list(line)
+    if not isinstance(line, (list, tuple)):
+        raise ValueError(
+            "the output of process() must be in list or tuple type, "
+            "Example: [('words', [1926, 8, 17]), ('label', [1])]")
+    return line
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Formats [(name, [feasign...]), ...] into the MultiSlotDataFeed
+    line ``<n> id1 .. idn <m> id1 .. idm`` (data_generator.py:285)."""
+
+    def _gen_str(self, line):
+        line = _validate_slots(line)
+        out = []
+        if self._proto_info is None:
+            self._proto_info = []
+            first = True
+        else:
+            first = False
+            if len(line) != len(self._proto_info):
+                raise ValueError(
+                    f"the complete field set of two given line are "
+                    f"inconsistent: {len(line)} vs "
+                    f"{len(self._proto_info)}")
+        for i, (name, elements) in enumerate(line):
+            if not isinstance(name, str):
+                raise ValueError(f"name {type(name)} must be in str type")
+            if not isinstance(elements, list):
+                raise ValueError(
+                    f"elements {type(elements)} must be in list type")
+            if not elements:
+                raise ValueError(
+                    "the elements of each field can not be empty; pad "
+                    "it in process()")
+            dtype = "uint64"
+            for e in elements:
+                if isinstance(e, float):
+                    dtype = "float"
+                elif not isinstance(e, int):
+                    raise ValueError(
+                        "the type of element must be int or float")
+            if first:
+                self._proto_info.append((name, dtype))
+            else:
+                if self._proto_info[i][0] != name:
+                    raise ValueError(
+                        f"the field name of two given line are not "
+                        f"matched: {name} vs {self._proto_info[i][0]}")
+                if dtype == "float" and self._proto_info[i][1] == "uint64":
+                    self._proto_info[i] = (name, "float")
+            out.append(str(len(elements)))
+            out.extend(str(e) for e in elements)
+        return " ".join(out) + "\n"
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    """String-feasign variant (data_generator.py
+    MultiSlotStringDataGenerator): no proto typing, plain join."""
+
+    def _gen_str(self, line):
+        line = _validate_slots(line)
+        out = []
+        for name, elements in line:
+            if not isinstance(name, str):
+                raise ValueError(f"name {type(name)} must be in str type")
+            if not isinstance(elements, (list, tuple)):
+                raise ValueError(
+                    f"elements {type(elements)} must be list/tuple")
+            out.append(str(len(elements)))
+            out.extend(str(e) for e in elements)
+        return " ".join(out) + "\n"

@@ -94,6 +94,14 @@ class XPUPlace(TPUPlace):
     pass
 
 
+class IPUPlace(TPUPlace):
+    """Compat alias: lands on TPU like CUDAPlace/XPUPlace."""
+
+
+class MLUPlace(TPUPlace):
+    """Compat alias: lands on TPU like CUDAPlace/XPUPlace."""
+
+
 class NPUPlace(TPUPlace):
     pass
 

@@ -10,6 +10,43 @@ op — the TPU-native analog of the reference's linearize prim pass
 primitive op nodes (see primx.py).
 """
 from ...autograd.functional import hessian, jacobian, jvp, vjp  # noqa: F401
+
+
+class Jacobian:
+    """Lazy Jacobian object (reference incubate/autograd/functional.py
+    Jacobian): J[i, j] indexes d out_i / d in_j; the full matrix is
+    computed once on first access via the functional jacobian."""
+
+    def __init__(self, func, xs, is_batched=False):
+        if is_batched:
+            raise NotImplementedError(
+                "batched Jacobian/Hessian objects: vmap the functional "
+                "jacobian/hessian instead")
+        self._func = func
+        self._xs = xs
+        self._mat = None
+
+    def _materialize(self):
+        if self._mat is None:
+            self._mat = jacobian(self._func, self._xs)
+        return self._mat
+
+    def __getitem__(self, idx):
+        return self._materialize()[idx]
+
+    @property
+    def shape(self):
+        return self._materialize().shape
+
+
+class Hessian(Jacobian):
+    """Lazy Hessian (reference incubate/autograd/functional.py
+    Hessian)."""
+
+    def _materialize(self):
+        if self._mat is None:
+            self._mat = hessian(self._func, self._xs)
+        return self._mat
 from .primx import (  # noqa: F401
     disable_prim, enable_prim, orig2prim, prim2orig, prim_enabled, to_prim,
 )
@@ -99,8 +136,9 @@ def forward_grad(outputs, inputs, grad_inputs=None):
             raise RuntimeError(
                 "forward_grad: tape was released (a backward() without "
                 "retain_graph ran); recompute the outputs first.")
+        primal_vals = node.primal_values()
         in_tans = []
-        for r, x in zip(node.input_refs, node.primal_args):
+        for r, x in zip(node.input_refs, primal_vals):
             if id(r.tensor) in seed_of:
                 t = seed_of[id(r.tensor)]
                 t = t.astype(x.dtype) if t.dtype != x.dtype else t
@@ -109,7 +147,7 @@ def forward_grad(outputs, inputs, grad_inputs=None):
             else:
                 t = _zero_tangent(x)
             in_tans.append(t)
-        _, out_t = jax.jvp(node.primal_fn, tuple(node.primal_args),
+        _, out_t = jax.jvp(node.primal_fn, tuple(primal_vals),
                            tuple(in_tans))
         if isinstance(out_t, (tuple, list)):
             for i, ot in enumerate(out_t):

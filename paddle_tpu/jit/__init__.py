@@ -4,3 +4,20 @@ from .api import (  # noqa: F401
 )
 from .functional import functional_call, state_arrays  # noqa: F401
 from .train_step import TrainStep  # noqa: F401
+
+_verbosity = 0
+_code_level = 0
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """dy2static transcription log verbosity (reference
+    jit/dy2static/logging_utils.py set_verbosity)."""
+    global _verbosity
+    _verbosity = int(level)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """How much transformed code dy2static prints (reference
+    logging_utils.set_code_level)."""
+    global _code_level
+    _code_level = int(level)

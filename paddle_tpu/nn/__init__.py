@@ -26,4 +26,4 @@ from .layer.transformer import (  # noqa: F401
     MultiHeadAttention, Transformer, TransformerDecoder,
     TransformerDecoderLayer, TransformerEncoder, TransformerEncoderLayer,
 )
-from .utils_helpers import utils  # noqa: F401
+from . import utils  # noqa: F401

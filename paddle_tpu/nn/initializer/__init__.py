@@ -40,6 +40,33 @@ class Constant(Initializer):
         return jnp.full(tuple(shape), self.value, dtype)
 
 
+class Bilinear(Initializer):
+    """Bilinear-upsample kernel init for conv-transpose weights
+    (reference nn/initializer/Bilinear over bilinear_init): weight
+    shape [C_out, C_in, kH, kW] gets the separable triangle kernel."""
+
+    def __call__(self, shape, dtype):
+        import numpy as _np
+
+        shape = tuple(shape)
+        if len(shape) != 4:
+            raise ValueError(
+                f"Bilinear initializer expects a 4-D conv weight, got "
+                f"rank {len(shape)}")
+        kh, kw = shape[2], shape[3]
+        f_h, f_w = (kh + 1) // 2, (kw + 1) // 2
+        c_h = f_h - 1 if kh % 2 == 1 else f_h - 0.5
+        c_w = f_w - 1 if kw % 2 == 1 else f_w - 0.5
+        og = _np.ogrid[:kh, :kw]
+        filt = (1 - _np.abs(og[0] - c_h) / f_h) * \
+            (1 - _np.abs(og[1] - c_w) / f_w)
+        # the reference fills EVERY (out, in) channel pair with the
+        # kernel (nn/initializer/Bilinear writes weight[i] for every
+        # flat index), not just matched channels
+        w = _np.broadcast_to(filt.astype(_np.float32), shape).copy()
+        return jnp.asarray(w).astype(dtype)
+
+
 class Normal(Initializer):
     def __init__(self, mean=0.0, std=1.0):
         self.mean, self.std = mean, std

@@ -1,0 +1,10 @@
+"""paddle.nn.utils as a REAL importable module (reference
+python/paddle/nn/utils/ is a package; `import paddle.nn.utils` must
+work, not just attribute access on nn)."""
+from .utils_helpers import (  # noqa: F401
+    parameters_to_vector, remove_weight_norm, spectral_norm,
+    vector_to_parameters, weight_norm,
+)
+
+__all__ = ["parameters_to_vector", "remove_weight_norm",
+           "spectral_norm", "vector_to_parameters", "weight_norm"]

@@ -242,3 +242,45 @@ class Profiler:
     def __exit__(self, *exc):
         self.stop()
         return False
+
+
+class SortedKeys(Enum):
+    """Summary-table sort keys (reference profiler_statistic.py:49).
+    GPU* members name the accelerator columns — device time on this
+    stack."""
+
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class _LoadedProfilerResult:
+    """Events loaded back from an exported chrome trace."""
+
+    def __init__(self, events):
+        self.events = events
+
+    def time_range_summary(self):
+        total = sum(e.get("dur", 0.0) for e in self.events)
+        return {"total_us": total, "n_events": len(self.events)}
+
+
+def load_profiler_result(filepath):
+    """Read a chrome-trace json written by export_chrome_tracing back
+    into a result object (reference profiler.py load_profiler_result
+    reads its protobuf dump)."""
+    import json
+
+    with open(filepath) as f:
+        data = json.load(f)
+    if isinstance(data, list):       # bare-array chrome trace form
+        events = data
+    else:
+        events = data.get("traceEvents", [])
+    return _LoadedProfilerResult(
+        [e for e in events if isinstance(e, dict)])

@@ -213,6 +213,14 @@ def _as_coo(x):
 
 # ---------------------------------------------------------------- unary
 
+def _unary_apply(x, fn):
+    """Apply ``fn`` to the stored values of a sparse tensor (zeros
+    untouched) — the building block nn-layer activations use."""
+    was_csr = is_sparse_csr(x)
+    out = _as_coo(x)._map_values(fn)
+    return out.to_sparse_csr() if was_csr else out
+
+
 def _unary(name, fn):
     def op(x, name_=None):
         if is_sparse(x):

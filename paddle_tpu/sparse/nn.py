@@ -42,3 +42,148 @@ def attention(query, key, value, sparse_mask, key_padding_mask=None,
 
 functional = types.SimpleNamespace(attention=attention,
                                    relu=lambda x: ReLU()(x))
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        from . import _unary_apply
+        return _unary_apply(x, lambda v: jnp.clip(v, 0.0, 6.0))
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        from . import _unary_apply
+        s = self._slope
+        return _unary_apply(x, lambda v: jnp.where(v >= 0, v, s * v))
+
+
+class Softmax(Layer):
+    """Sparse softmax over the last dim's NONZERO entries (reference
+    sparse/nn/layer/activation.py Softmax: softmax restricted to the
+    stored elements, zeros stay zero)."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+        if axis != -1:
+            raise NotImplementedError("sparse Softmax supports axis=-1")
+
+    def forward(self, x):
+        from . import _as_coo, is_sparse_csr, sparse_coo_tensor
+        was_csr = is_sparse_csr(x)
+        coo = _as_coo(x)
+        dense = coo.to_dense()._data           # raw jnp array
+        idx = tuple(coo.indices()._data)       # per-sparse-dim rows
+        mask = jnp.zeros(dense.shape, bool).at[idx].set(True)
+        masked = jnp.where(mask, dense, -jnp.inf)
+        sm = jax.nn.softmax(masked, axis=-1)
+        sm = jnp.where(mask, sm, 0.0)
+        out = sparse_coo_tensor(coo.indices(), sm[idx], dense.shape)
+        return out.to_sparse_csr() if was_csr else out
+
+
+class BatchNorm(Layer):
+    """BatchNorm over the sparse values' channel (last) dim (reference
+    sparse/nn/layer/norm.py BatchNorm: statistics over stored values
+    only)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        from ..nn import BatchNorm1D
+        self._bn = BatchNorm1D(num_features, momentum=momentum,
+                               epsilon=epsilon, weight_attr=weight_attr,
+                               bias_attr=bias_attr)
+
+    def forward(self, x):
+        from . import _as_coo, sparse_coo_tensor
+        coo = _as_coo(x)
+        out = self._bn(coo.values())
+        return sparse_coo_tensor(coo.indices(), out._data, coo.shape)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-replica statistics ride the mesh on this stack (GSPMD
+    reduces the batch axis), so the layer body is BatchNorm."""
+
+
+def _dense_roundtrip_conv(x, fn, subm=False):
+    from . import _as_coo, sparse_coo_tensor
+    coo = _as_coo(x)
+    dense = coo.to_dense()._data               # raw jnp array
+    out = fn(dense)
+    if subm:
+        # submanifold: output sparsity pattern == input pattern
+        idx = tuple(coo.indices()._data)
+        return sparse_coo_tensor(coo.indices(), out[idx], out.shape)
+    nz = jnp.nonzero(jnp.any(out != 0, axis=-1))
+    idx = jnp.stack(nz)
+    vals = out[nz]
+    return sparse_coo_tensor(idx, vals, out.shape)
+
+
+class Conv3D(Layer):
+    """Sparse 3-D conv via dense lowering (reference
+    sparse/nn/layer/conv.py Conv3D over gather-scatter kernels; on TPU
+    the MXU path is dense and XLA has no sparse conv — to_dense →
+    conv3d → re-sparsify keeps the semantics; NDHWC layout)."""
+
+    SUBM = False
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__()
+        from ..nn import Conv3D as DenseConv3D
+        if data_format != "NDHWC":
+            raise NotImplementedError("sparse Conv3D is NDHWC (reference "
+                                      "contract)")
+        self._conv = DenseConv3D(in_channels, out_channels, kernel_size,
+                                 stride=stride, padding=padding,
+                                 dilation=dilation, groups=groups,
+                                 weight_attr=weight_attr,
+                                 bias_attr=bias_attr,
+                                 data_format="NDHWC")
+
+    def forward(self, x):
+        from ..core.tensor import Tensor as _T
+        return _dense_roundtrip_conv(
+            x, lambda d: self._conv(_T(d))._data, subm=self.SUBM)
+
+
+class SubmConv3D(Conv3D):
+    """Submanifold variant: output nonzeros only where the input has
+    nonzeros (reference SubmConv3D)."""
+
+    SUBM = True
+
+
+class MaxPool3D(Layer):
+    """Sparse max pool via dense lowering (reference
+    sparse/nn/layer/pooling.py MaxPool3D; NDHWC)."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, return_mask=False, data_format="NDHWC",
+                 name=None):
+        super().__init__()
+        from ..nn import MaxPool3D as DenseMaxPool3D
+        if data_format != "NDHWC":
+            raise NotImplementedError("sparse MaxPool3D is NDHWC")
+        self._pool = DenseMaxPool3D(kernel_size, stride=stride,
+                                    padding=padding, ceil_mode=ceil_mode)
+
+    def forward(self, x):
+        from ..core.tensor import Tensor as _T
+        import numpy as _np
+
+        def run(dense):
+            # dense pool wants NCDHW; sparse layout is NDHWC
+            d = jnp.moveaxis(dense, -1, 1)
+            out = self._pool(_T(d))._data
+            return jnp.moveaxis(out, 1, -1)
+
+        return _dense_roundtrip_conv(x, run, subm=False)

@@ -18,9 +18,14 @@ from ..nn.layer.layers import Layer
 
 __all__ = ["viterbi_decode", "ViterbiDecoder", "Vocab", "datasets",
            "StringTensor", "strings_empty", "strings_lower",
-           "strings_upper", "faster_tokenizer", "BertTokenizerKernel"]
+           "strings_upper", "faster_tokenizer", "BertTokenizerKernel",
+           "Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+           "WMT14", "WMT16"]
 
 from . import datasets  # noqa: E402,F401
+from .datasets import (  # noqa: E402,F401
+    Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16,
+)
 from .strings import (  # noqa: E402,F401
     BertTokenizerKernel, StringTensor, faster_tokenizer, strings_empty,
     strings_lower, strings_upper,

@@ -19,8 +19,66 @@ import numpy as np
 from ..io.dataset import Dataset
 from ..utils.download import require_local_file as _require
 
-__all__ = ["UCIHousing", "Imdb", "Imikolov", "Movielens", "Conll05",
+__all__ = ["UCIHousing", "Imdb", "Imikolov", "Movielens", "Conll05", "Conll05st",
            "WMT14", "WMT16", "MovieInfo", "UserInfo"]
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 semantic-role-labeling dataset (reference
+    text/datasets/conll05.py). Samples are 8 aligned int64 sequences
+    (word, 5 context predicates, mark) + the label sequence. Hermetic:
+    without data files, deterministic synthetic sentences over the same
+    field layout are generated (the reference's download path does not
+    apply offline)."""
+
+    WORD_DICT_LEN = 44068
+    LABEL_DICT_LEN = 3257
+    PRED_DICT_LEN = 3162
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, mode="train",
+                 download=True, n_samples=200):
+        self._inner = None
+        if data_file is not None:
+            # real data: the Conll05 tar/dict parser below already
+            # handles the reference layout — delegate
+            self._inner = Conll05(data_file, word_dict_file,
+                                  verb_dict_file, target_dict_file,
+                                  mode=mode, download=download)
+            return
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        self._samples = []
+        for _ in range(n_samples):
+            ln = int(rng.randint(5, 30))
+            word = rng.randint(0, self.WORD_DICT_LEN, ln)
+            ctxs = [rng.randint(0, self.WORD_DICT_LEN, ln)
+                    for _ in range(5)]
+            pred = np.full(ln, rng.randint(0, self.PRED_DICT_LEN))
+            mark = (rng.rand(ln) < 0.2).astype(np.int64)
+            label = rng.randint(0, self.LABEL_DICT_LEN, ln)
+            self._samples.append(tuple(
+                np.asarray(a, np.int64)
+                for a in (word, *ctxs, pred, mark, label)))
+
+    def get_dict(self):
+        word_dict = {f"w{i}": i for i in range(100)}
+        verb_dict = {f"v{i}": i for i in range(50)}
+        label_dict = {f"l{i}": i for i in range(50)}
+        return word_dict, verb_dict, label_dict
+
+    def get_embedding(self):
+        raise NotImplementedError(
+            "Conll05st.get_embedding needs the emb file download")
+
+    def __getitem__(self, idx):
+        if self._inner is not None:
+            return self._inner[idx]
+        return self._samples[idx]
+
+    def __len__(self):
+        if self._inner is not None:
+            return len(self._inner)
+        return len(self._samples)
 
 
 class UCIHousing(Dataset):

@@ -741,3 +741,71 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
     if rois_num is not None:
         return multi_rois, restore, nums
     return multi_rois, restore
+
+
+def read_file(filename, name=None):
+    """File bytes as a uint8 1-D Tensor (reference vision/ops.py
+    read_file over the read_file CPU op)."""
+    import numpy as _np
+
+    from .. import to_tensor
+
+    with open(filename, "rb") as f:
+        data = f.read()
+    return to_tensor(_np.frombuffer(data, _np.uint8))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to CHW uint8 (reference vision/ops.py
+    decode_jpeg; nvjpeg there, PIL here — strings/images decode on the
+    host, only the pixel tensor crosses to the TPU)."""
+    import io as _io
+
+    import numpy as _np
+    from PIL import Image
+
+    from .. import to_tensor
+
+    data = bytes(_np.asarray(x._data if hasattr(x, "_data") else x,
+                             _np.uint8).tobytes())
+    img = Image.open(_io.BytesIO(data))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode in ("rgb",):
+        img = img.convert("RGB")
+    arr = _np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]                    # [1, H, W]
+    else:
+        arr = arr.transpose(2, 0, 1)       # [C, H, W]
+    return to_tensor(arr.copy())
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """NOT IMPLEMENTED — the yolov3_loss op's target-assignment protocol
+    (per-anchor responsibility, ignore_thresh objectness masking, label
+    smoothing) is not reproduced here yet; raising loudly instead of
+    returning silently-wrong losses (pdmodel interop table lists the
+    inference-side yolo_box, which IS implemented)."""
+    raise NotImplementedError(
+        "paddle.vision.ops.yolo_loss is not implemented in paddle_tpu "
+        "(training-side YOLOv3 target assignment); yolo_box serving is "
+        "supported")
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                      pre_nms_top_n=6000, post_nms_top_n=1000,
+                      nms_thresh=0.5, min_size=0.1, eta=1.0,
+                      pixel_offset=False, return_rois_num=False,
+                      name=None):
+    """NOT IMPLEMENTED — RPN proposal generation produces
+    variable-length per-image outputs (LoD RpnRois) that do not fit the
+    traced executor; raising loudly until an eager padded-output
+    implementation lands (distribute_fpn_proposals / roi_align /
+    box_coder / nms around it ARE implemented)."""
+    raise NotImplementedError(
+        "paddle.vision.ops.generate_proposals is not implemented in "
+        "paddle_tpu (variable-length RPN outputs); compose box_coder + "
+        "nms for a fixed-size proposal path")

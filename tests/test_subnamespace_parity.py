@@ -1,0 +1,191 @@
+"""Round-5 sub-namespace closures: profiler SortedKeys/load_profiler_
+result, text dataset re-exports + Conll05st, device hardware compat,
+jit verbosity, initializer.Bilinear, incubate.autograd Jacobian/Hessian,
+fleet Role/UtilBase/data generators, vision read_file/decode_jpeg,
+sparse.nn activation/norm/conv additions, nn.utils as a real module."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_nn_utils_importable_module():
+    import importlib
+
+    m = importlib.import_module("paddle_tpu.nn.utils")
+    assert hasattr(m, "weight_norm") and hasattr(m, "spectral_norm")
+
+
+def test_profiler_sortedkeys_and_load(tmp_path):
+    import json
+
+    from paddle_tpu.profiler import SortedKeys, load_profiler_result
+
+    assert SortedKeys.CPUTotal.value == 0
+    p = tmp_path / "trace.json"
+    p.write_text(json.dumps({"traceEvents": [
+        {"name": "op", "dur": 12.5}, {"name": "op2", "dur": 2.5}]}))
+    r = load_profiler_result(str(p))
+    s = r.time_range_summary()
+    assert s["n_events"] == 2 and abs(s["total_us"] - 15.0) < 1e-9
+
+
+def test_text_datasets_reexported_and_conll():
+    import paddle_tpu.text as text
+
+    for n in ("Conll05st", "Imdb", "UCIHousing", "WMT14"):
+        assert hasattr(text, n), n
+    ds = text.Conll05st(n_samples=5)
+    sample = ds[0]
+    assert len(sample) == 9          # word, 5 ctx, predicate, mark, label
+    assert all(a.dtype == np.int64 for a in sample)
+    assert len({a.shape[0] for a in sample}) == 1   # aligned lengths
+
+
+def test_device_hw_compat():
+    import paddle_tpu.device as device
+
+    assert device.get_cudnn_version() is None
+    assert device.is_compiled_with_ipu() is False
+    assert device.get_all_custom_device_type() == []
+    # compat philosophy: other-accelerator places land on TPU like
+    # CUDAPlace, and BOTH import paths resolve to the same class
+    assert device.XPUPlace is paddle.XPUPlace
+    p = device.XPUPlace(0)
+    assert "tpu" in repr(p).lower() or "Place" in repr(p)
+
+
+def test_jit_verbosity_settable():
+    import paddle_tpu.jit as jit
+
+    jit.set_verbosity(3)
+    jit.set_code_level(2)
+
+
+def test_bilinear_initializer_kernel():
+    import jax.numpy as jnp
+
+    from paddle_tpu.nn.initializer import Bilinear
+
+    w = np.asarray(Bilinear()((2, 2, 4, 4), jnp.float32))
+    # separable triangle: symmetric, peak at center 2x2 block, and the
+    # SAME kernel in every (out, in) channel pair (reference fills all)
+    np.testing.assert_allclose(w[0, 0], w[0, 0][::-1, ::-1], rtol=1e-6)
+    assert w[0, 0][1, 1] == w[0, 0].max()
+    np.testing.assert_allclose(w[0, 1], w[0, 0], rtol=1e-6)
+    np.testing.assert_allclose(w[1, 0], w[0, 0], rtol=1e-6)
+
+
+def test_incubate_jacobian_hessian_objects():
+    from paddle_tpu.incubate.autograd import Hessian, Jacobian
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], "float32"),
+                         stop_gradient=False)
+
+    def f(v):
+        return (v * v).sum()
+
+    j = Jacobian(f, x)
+    np.testing.assert_allclose(np.asarray(j[:]._data
+                                          if hasattr(j[:], "_data")
+                                          else j[:]),
+                               [2.0, 4.0], rtol=1e-5)
+    h = Hessian(f, x)
+    hv = h[:]
+    hv = np.asarray(hv._data if hasattr(hv, "_data") else hv)
+    np.testing.assert_allclose(hv, 2 * np.eye(2), rtol=1e-5)
+
+
+def test_fleet_role_util_generators(capsys):
+    import paddle_tpu.distributed.fleet as fleet
+
+    assert fleet.Role.WORKER == 1 and fleet.Role.SERVER == 2
+    u = fleet.UtilBase()
+    np.testing.assert_allclose(
+        u.all_reduce(np.array([1.0, 2.0], "float32")), [1.0, 2.0])
+    assert u.get_file_shard(["a", "b", "c"]) == ["a", "b", "c"]
+    g = fleet.MultiSlotDataGenerator()
+    line = g._gen_str([("words", [19, 8, 17]), ("label", [1])])
+    assert line == "3 19 8 17 1 1\n"
+    with pytest.raises(ValueError):
+        g._gen_str([("words", [1])])      # field-count mismatch vs first
+    gs = fleet.MultiSlotStringDataGenerator()
+    assert gs._gen_str([("q", ["a", "b"])]) == "2 a b\n"
+    from paddle_tpu.distributed.fleet.fleet_api import _FleetAPI
+
+    assert isinstance(_FleetAPI, fleet.Fleet)
+
+
+def test_vision_read_decode_jpeg(tmp_path):
+    from PIL import Image
+
+    from paddle_tpu.vision.ops import decode_jpeg, read_file
+
+    p = tmp_path / "t.jpg"
+    Image.fromarray((np.arange(64 * 64 * 3) % 255).astype("uint8")
+                    .reshape(64, 64, 3)).save(p, "JPEG")
+    data = read_file(str(p))
+    assert data.dtype == paddle.uint8 and data.numpy()[:2].tolist() == \
+        [0xFF, 0xD8]                      # JPEG SOI marker
+    img = decode_jpeg(data)
+    assert list(img.shape) == [3, 64, 64]
+
+
+def test_vision_training_stubs_raise_loudly():
+    from paddle_tpu.vision import ops
+
+    with pytest.raises(NotImplementedError, match="yolo_loss"):
+        ops.yolo_loss(None, None, None, [], [], 80, 0.7, 32)
+    with pytest.raises(NotImplementedError, match="generate_proposals"):
+        ops.generate_proposals(None, None, None, None, None)
+
+
+class TestSparseNN:
+    def _coo(self):
+        import paddle_tpu.sparse as sparse
+
+        return sparse.sparse_coo_tensor(
+            np.array([[0, 0, 1], [0, 2, 1]]),
+            np.array([[1.0, -2.0], [3.0, 7.0], [-8.0, 0.5]], "float32"),
+            (2, 3, 2))
+
+    def test_activations(self):
+        import paddle_tpu.sparse.nn as snn
+
+        v = np.asarray(snn.ReLU6()(self._coo()).values()._data)
+        np.testing.assert_allclose(v, [[1, 0], [3, 6], [0, 0.5]])
+        v = np.asarray(snn.LeakyReLU(0.1)(self._coo()).values()._data)
+        np.testing.assert_allclose(
+            v, [[1, -0.2], [3, 7], [-0.8, 0.5]], rtol=1e-6)
+
+    def test_softmax_rows_sum_to_one_over_nonzeros(self):
+        import paddle_tpu.sparse.nn as snn
+
+        sm = snn.Softmax()(self._coo())
+        v = np.asarray(sm.values()._data)
+        np.testing.assert_allclose(v.sum(-1), 1.0, rtol=1e-5)
+
+    def test_batchnorm_and_sync(self):
+        import paddle_tpu.sparse.nn as snn
+
+        bn = snn.BatchNorm(2)
+        bn.eval()
+        out = bn(self._coo())
+        assert np.asarray(out.values()._data).shape == (3, 2)
+        assert issubclass(snn.SyncBatchNorm, snn.BatchNorm)
+
+    def test_subm_conv_preserves_pattern(self):
+        import paddle_tpu.sparse as sparse
+        import paddle_tpu.sparse.nn as snn
+
+        paddle.seed(0)
+        idx = np.stack([np.zeros(3, np.int64), np.array([0, 1, 2]),
+                        np.array([1, 0, 2]), np.array([2, 1, 0])])
+        x = sparse.sparse_coo_tensor(
+            idx, np.random.RandomState(0).randn(3, 2).astype("float32"),
+            (1, 4, 4, 4, 2))
+        out = snn.SubmConv3D(2, 4, 3, padding=1)(x)
+        np.testing.assert_array_equal(
+            np.asarray(out.indices()._data), idx)
+        pooled = snn.MaxPool3D(2, stride=2)(x)
+        assert list(pooled.shape) == [1, 2, 2, 2, 2]
