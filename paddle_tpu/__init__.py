@@ -83,6 +83,7 @@ from . import linalg  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import profiler  # noqa: F401
 from . import quantization  # noqa: F401
+from . import serving  # noqa: F401
 from . import sparse  # noqa: F401
 from . import static  # noqa: F401
 from . import utils  # noqa: F401

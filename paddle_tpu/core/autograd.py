@@ -168,7 +168,14 @@ class saved_tensors_hooks:
     recorded inside store pack_hook(snapshot) INSTEAD of jax's residual
     closure; backward unpacks and REBUILDS the pullback from the
     restored primals (remat-style), so pack genuinely controls resident
-    memory — e.g. pack to host numpy for activation offload."""
+    memory — e.g. pack to host numpy for activation offload.
+
+    Divergence from the reference contract: because backward replays the
+    whole op from its primals, pack/unpack fire for EVERY recorded op's
+    tensor INPUTS — including ops whose vjp needs no residuals — whereas
+    the reference invokes the hooks only for tensors actually saved for
+    backward. User hooks therefore fire more often (and offload more)
+    here; hooks with side effects should be idempotent per tensor."""
 
     def __init__(self, pack_hook, unpack_hook):
         self.pack_hook = pack_hook

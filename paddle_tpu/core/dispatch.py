@@ -102,6 +102,11 @@ def apply_op(name: str, jax_fn: Callable, *args, _outputs_stop_grad=None,
     if record:
         stored_args = arrays
         if hooks is not None:
+            # pack EVERY tensor input of the recorded op (remat-style:
+            # backward rebuilds the vjp from these primals) — a
+            # documented divergence from the reference, which packs only
+            # tensors saved for backward; see the saved_tensors_hooks
+            # docstring (core/autograd.py)
             from .tensor import Tensor as _T
             pack, _unpack = hooks
             stored_args = [pack(_T(a, stop_gradient=True))

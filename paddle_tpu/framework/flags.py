@@ -94,3 +94,24 @@ define_flag("FLAGS_flash_block_q", 0,
             "exists for the shape")
 define_flag("FLAGS_flash_block_k", 0,
             "flash-attention k block size override (0 = autotune/default)")
+
+# Serving knobs (paddle_tpu.serving — the dynamic-batching layer).
+define_flag("FLAGS_serving_max_batch_size", 8,
+            "rows coalesced into one device batch before dispatch")
+define_flag("FLAGS_serving_max_wait_ms", 2.0,
+            "coalescing window: a batch dispatches when full or this "
+            "many ms after its oldest request, whichever first")
+define_flag("FLAGS_serving_queue_capacity", 64,
+            "bounded request queue; submit raises QueueFullError beyond "
+            "this (backpressure)")
+define_flag("FLAGS_serving_default_timeout_ms", 0.0,
+            "per-request deadline applied when submit() passes none "
+            "(0 = no deadline); expired requests are dropped unrun")
+define_flag("FLAGS_serving_pad_batch_pow2", True,
+            "pad coalesced batches up to power-of-two row buckets so "
+            "the XLA compile cache stays bounded under variable load")
+define_flag("FLAGS_serving_capi_batching", False,
+            "route PD_* C-ABI predictors through a shared "
+            "InferenceServer so C hosts get request coalescing")
+define_flag("FLAGS_serving_latency_window", 2048,
+            "latency samples kept for the serving p50/p95/p99 metrics")

@@ -318,7 +318,12 @@ class Predictor:
         self._inputs = {name: Tensor(name, spec)
                         for name, spec in zip(self._artifact.feed_names,
                                               self._artifact.feeds)}
+        # output handles are STABLE per fetch name (reference capi_exp
+        # semantics: handles are scope-var bound — a C host that hoists
+        # PD_PredictorGetOutputHandle out of its serving loop must read
+        # the CURRENT iteration's result); run() updates _value in place
         self._outputs: List[Tensor] = []
+        self._output_handles: Dict[str, Tensor] = {}
 
     # ---- reference Predictor API ----
     def get_input_names(self) -> List[str]:
@@ -363,7 +368,7 @@ class Predictor:
             # np.asarray would pay the dispatch round-trip N times)
             host = jax.device_get(outs)
             for i, o in enumerate(host):
-                t = Tensor(f"fetch_{i}")
+                t = self._fetch_handle(f"fetch_{i}")
                 t.copy_from_cpu(o)
                 self._outputs.append(t)
             # copies, not aliases of the committed buffers (same
@@ -372,10 +377,51 @@ class Predictor:
         # handle-based flow: outputs stay DEVICE-RESIDENT in the handles;
         # copy_to_cpu transfers on demand (np.asarray on a jax array)
         for i, o in enumerate(outs):
-            t = Tensor(f"fetch_{i}")
+            t = self._fetch_handle(f"fetch_{i}")
             t._value = o
             self._outputs.append(t)
         return True
+
+    def run_many(self, feeds_list):
+        """Batched fast path for the serving layer: ``feeds_list`` is a
+        list of per-request feed lists (each ordered like feed_names,
+        identical non-batch shapes); the requests are concatenated along
+        axis 0, run as ONE device dispatch, fetched with ONE batched
+        device_get, and sliced back per request by their row counts.
+        Outputs without a leading batch axis matching the total rows
+        (pooled scalars etc.) are handed to every request whole."""
+        import jax
+
+        if not feeds_list:
+            return []
+        names = self._artifact.feed_names
+        per_req = [[np.asarray(a) for a in feeds] for feeds in feeds_list]
+        rows = [int(r[0].shape[0]) if r[0].ndim else 1 for r in per_req]
+        arrays = []
+        for i in range(len(names)):
+            parts = [r[i] for r in per_req]
+            joined = parts[0] if len(parts) == 1 \
+                else np.concatenate(parts, axis=0)
+            arrays.append(jax.device_put(joined))
+        out = self._artifact(*arrays)
+        outs = list(out) if isinstance(out, (list, tuple)) else [out]
+        host = jax.device_get(outs)     # one batched fetch (r5 discipline)
+        total = sum(rows)
+        results = []
+        ofs = 0
+        for r in rows:
+            results.append([h[ofs:ofs + r]
+                            if getattr(h, "ndim", 0) and
+                            h.shape[0] == total else np.asarray(h)
+                            for h in host])
+            ofs += r
+        return results
+
+    def _fetch_handle(self, name: str) -> Tensor:
+        t = self._output_handles.get(name)
+        if t is None:
+            t = self._output_handles[name] = Tensor(name)
+        return t
 
     def get_output_names(self) -> List[str]:
         return [t.name for t in self._outputs] or ["fetch_0"]
@@ -384,7 +430,9 @@ class Predictor:
         for t in self._outputs:
             if t.name == name:
                 return t
-        raise KeyError(name)
+        # pre-first-run fetch: hand out the persistent handle that run()
+        # will fill in place (reference capi_exp hoisted-handle pattern)
+        return self._fetch_handle(name)
 
     def get_output_tensor(self, name: str) -> Tensor:  # legacy alias
         return self.get_output_handle(name)

@@ -17,6 +17,7 @@
 #include <Python.h>
 
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <mutex>
 #include <string>
@@ -123,6 +124,23 @@ PD_Predictor* PD_PredictorCreate(PD_Config* config) {
     Py_DECREF(cfg);
     Py_DECREF(mod);
     if (!pred) { print_and_clear(); return nullptr; }
+    // route through the serving layer: with FLAGS_serving_capi_batching
+    // enabled, wrap_capi returns a facade whose run() submits to a
+    // shared dynamic-batching InferenceServer (C hosts get request
+    // coalescing for free); otherwise it returns pred unchanged.
+    PyObject* sv = PyImport_ImportModule("paddle_tpu.serving");
+    if (sv) {
+      PyObject* wrapped = PyObject_CallMethod(sv, "wrap_capi", "O", pred);
+      if (wrapped) {
+        Py_DECREF(pred);
+        pred = wrapped;
+      } else {
+        PyErr_Clear();  // serving-layer failure degrades to plain pred
+      }
+      Py_DECREF(sv);
+    } else {
+      PyErr_Clear();
+    }
     PD_Predictor* out = new PD_Predictor();
     out->pred = pred;
     return out;
@@ -137,6 +155,15 @@ void PD_PredictorDestroy(PD_Predictor* p) {
 
 static PD_OneDimArrayCstr* names_from_list(PyObject* list) {
   if (!list) { print_and_clear(); return nullptr; }
+  if (!PyList_Check(list)) {
+    // PyList_Size on a non-list returns -1, which would wrap around in
+    // arr->size (size_t) and make new char*[-1] UB
+    fprintf(stderr,
+            "paddle_tpu capi: expected a list of names, got %s\n",
+            Py_TYPE(list)->tp_name);
+    Py_DECREF(list);
+    return nullptr;
+  }
   Py_ssize_t n = PyList_Size(list);
   PD_OneDimArrayCstr* arr = new PD_OneDimArrayCstr();
   arr->size = static_cast<size_t>(n);
@@ -300,6 +327,13 @@ static void copy_from_cpu(PD_Tensor* t, const void* data, int pd_dtype) {
     if (!np) { print_and_clear(); return 0; }
     PyObject* shape = PyObject_GetAttrString(t->handle, "shape");
     if (!shape || shape == Py_None) {
+      // diagnose instead of silently no-opping: the C caller would
+      // otherwise run inference on stale/zero inputs with no signal
+      fprintf(stderr,
+              "paddle_tpu capi: PD_TensorCopyFromCpu* on a handle with "
+              "no shape — call PD_TensorReshape first (the capi_exp "
+              "Reshape -> CopyFromCpu flow); the copy was skipped\n");
+      print_and_clear();
       Py_XDECREF(shape);
       Py_DECREF(np);
       PyErr_Clear();

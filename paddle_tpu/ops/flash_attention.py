@@ -108,12 +108,17 @@ def pretune(batch, num_heads, seq_len, head_dim, dtype="bfloat16",
     cands = _block_candidates(seq_len, sk)
     if len(cands) <= 1:
         return cands[0]
-    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
     shape = (batch, num_heads, seq_len, head_dim)
-    qt = jax.random.normal(key, shape, jnp.float32).astype(dtype)
-    kt = jax.random.normal(key, (batch, num_heads, sk, head_dim),
+    qt = jax.random.normal(kq, shape, jnp.float32).astype(dtype)
+    kt = jax.random.normal(kk, (batch, num_heads, sk, head_dim),
                            jnp.float32).astype(dtype)
-    vt = kt
+    # V must be a DISTINCT buffer: vt = kt would let each candidate read
+    # one K/V array instead of two, so the timed memory traffic (and the
+    # measured ranking, on bandwidth-bound long-context shapes) would
+    # diverge from real two-buffer workloads
+    vt = jax.random.normal(kv, (batch, num_heads, sk, head_dim),
+                           jnp.float32).astype(dtype)
     s = 1.0 / math.sqrt(head_dim)
 
     def make_fn(c):

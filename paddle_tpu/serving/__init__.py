@@ -1,0 +1,45 @@
+"""paddle_tpu.serving — dynamic-batching inference serving.
+
+The request-level layer above ``paddle_tpu.inference``: the reference
+ships a full serving stack around its engine (capi_exp / Paddle
+Inference, SURVEY §1/§2.4); TPU-native, the engine is the AOT-compiled
+XLA program and THIS package is the serving shell around it.
+
+Pieces:
+
+- ``InferenceServer`` (server.py): owns a Predictor; ``submit(feed) ->
+  Future`` / ``submit_many`` / synchronous ``serve_forever``; graceful
+  ``shutdown(drain=True)``; ``warmup(bucket_specs)`` pre-compiles the
+  shape lattice.
+- ``DynamicBatcher`` (batcher.py): bounded queue with backpressure
+  (``QueueFullError``), per-request deadlines
+  (``DeadlineExceededError``), max_batch_size/max_wait_ms coalescing.
+- ``ShapeBucketPolicy`` / ``BucketSpec`` (bucketing.py): power-of-two
+  batch + sequence-length buckets with zero padding and
+  unpad-on-fetch, keeping the XLA compile cache bounded and warm.
+- ``ServingMetrics`` (metrics.py): queue depth, batch-size histogram,
+  padding-waste ratio, latency percentiles, compile-cache hit rate —
+  JSON-exportable, mirrored into framework.monitor, batch spans on the
+  host tracer's chrome export.
+- ``wrap_capi`` (capi.py): the hook pd_capi.cc calls so C clients get
+  request batching behind ``FLAGS_serving_capi_batching``.
+
+Knobs: ``FLAGS_serving_*`` in framework/flags.py.
+"""
+from __future__ import annotations
+
+from . import metrics  # noqa: F401  (the registry sub-namespace)
+from .batcher import DynamicBatcher
+from .bucketing import BucketSpec, ShapeBucketPolicy, next_pow2
+from .capi import wrap_capi
+from .metrics import ServingMetrics
+from .request import (DeadlineExceededError, QueueFullError, Request,
+                      ServerClosedError)
+from .server import InferenceServer
+
+__all__ = [
+    "InferenceServer", "DynamicBatcher", "ShapeBucketPolicy",
+    "BucketSpec", "ServingMetrics", "Request", "QueueFullError",
+    "DeadlineExceededError", "ServerClosedError", "wrap_capi",
+    "next_pow2", "metrics",
+]

@@ -1,0 +1,150 @@
+"""Dynamic batcher: bounded queue + compatible-request coalescing.
+
+The Orca-style (OSDI '22) serving discipline adapted to whole-program
+XLA execution: requests queue up, the worker drains the queue and
+coalesces shape-compatible requests (same ``ShapeBucketPolicy``
+signature) into one device batch, dispatching when either
+``max_batch_size`` rows are gathered or ``max_wait_ms`` elapsed since
+the oldest gathered request — whichever comes first. Incompatible
+requests stay queued in order for a later cycle, so one odd shape
+cannot head-of-line-block its own group forever but does not pollute a
+running batch either.
+
+The queue is bounded: ``put`` raises ``QueueFullError`` at capacity
+(backpressure), and expired/cancelled requests are resolved and skipped
+at drain time, never run.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import List, Optional
+
+from .request import DeadlineExceededError, QueueFullError, Request
+
+__all__ = ["DynamicBatcher"]
+
+
+class DynamicBatcher:
+    def __init__(self, max_batch_size: int = 8, max_wait_ms: float = 2.0,
+                 capacity: int = 64, metrics=None):
+        self.max_batch_size = int(max_batch_size)
+        self.max_wait_ms = float(max_wait_ms)
+        self.capacity = int(capacity)
+        self.metrics = metrics
+        self._q: deque = deque()
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._stopping = False
+
+    def __len__(self):
+        with self._lock:
+            return len(self._q)
+
+    def _note_depth(self):
+        if self.metrics is not None:
+            self.metrics.queue_depth(len(self._q), self.capacity)
+
+    # ---- producer side ----
+    def put(self, req: Request):
+        with self._lock:
+            if len(self._q) >= self.capacity:
+                raise QueueFullError(
+                    f"serving queue at capacity ({self.capacity}); "
+                    f"shed load or raise FLAGS_serving_queue_capacity")
+            self._q.append(req)
+            self._note_depth()
+            self._not_empty.notify()
+
+    def stop(self):
+        with self._lock:
+            self._stopping = True
+            self._not_empty.notify_all()
+
+    def cancel_pending(self, exc: Exception):
+        """Resolve every queued request with ``exc`` (non-drain
+        shutdown)."""
+        with self._lock:
+            pending = list(self._q)
+            self._q.clear()
+            self._note_depth()
+        for r in pending:
+            if r.future.set_running_or_notify_cancel():
+                r.future.set_exception(exc)
+            if self.metrics is not None:
+                self.metrics.count("cancelled")
+
+    # ---- consumer side ----
+    def _reap(self, now: float) -> None:
+        """Drop expired / caller-cancelled requests in place (lock
+        held). Expired ones get DeadlineExceededError — they are never
+        run; the deadline covers queueing, the stage that actually grows
+        under load."""
+        keep = deque()
+        for r in self._q:
+            if r.future.cancelled():
+                if self.metrics is not None:
+                    self.metrics.count("cancelled")
+                continue
+            if r.expired(now):
+                if r.future.set_running_or_notify_cancel():
+                    r.future.set_exception(DeadlineExceededError(
+                        f"request waited {r.latency_ms():.1f}ms in queue, "
+                        f"past its deadline"))
+                if self.metrics is not None:
+                    self.metrics.count("timed_out")
+                continue
+            keep.append(r)
+        self._q = keep
+        self._note_depth()
+
+    def next_batch(self) -> Optional[List[Request]]:
+        """Block until a batch is ready; None once stopping and empty.
+
+        The batch is the head-of-line request plus every queued request
+        sharing its signature, in arrival order, up to
+        ``max_batch_size`` total rows; the window closes early when the
+        row budget is filled."""
+        with self._lock:
+            while True:
+                self._reap(time.monotonic())
+                if not self._q:
+                    if self._stopping:
+                        return None
+                    self._not_empty.wait(0.05)
+                    continue
+
+                head = self._q[0]
+                # the coalescing window is anchored on the OLDEST queued
+                # request: one that already waited its share dispatches
+                # immediately instead of paying the window again
+                window_end = head.submit_t + self.max_wait_ms / 1e3
+                while not self._stopping:
+                    rows = sum(r.rows for r in self._q
+                               if r.signature == head.signature)
+                    if rows >= self.max_batch_size:
+                        break
+                    remaining = window_end - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._not_empty.wait(remaining)
+                    self._reap(time.monotonic())
+                    if not self._q:
+                        break
+                    head = self._q[0]
+                if not self._q:
+                    continue  # everything expired/cancelled mid-wait
+
+                batch, rest, rows = [], deque(), 0
+                for r in self._q:
+                    if r.signature == head.signature and (
+                            not batch
+                            or rows + r.rows <= self.max_batch_size):
+                        batch.append(r)
+                        rows += r.rows
+                    else:
+                        rest.append(r)
+                self._q = rest
+                self._note_depth()
+                return batch

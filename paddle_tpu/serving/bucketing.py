@@ -1,0 +1,145 @@
+"""Shape bucketing: the padded-shape discipline XLA serving demands.
+
+Every distinct concrete input shape reaching the exported StableHLO
+program triggers a fresh XLA compile (shape-polymorphic artifacts are
+specialized per shape at call time). Free-form request shapes would grow
+the compile cache without bound and stall the serving loop on each new
+shape, so the server quantizes shapes to a small bucket set: batch rows
+round up to the next power of two (capped at ``max_batch_size``) and a
+designated sequence axis rounds up to the next configured bucket, both
+zero-padded; outputs are sliced back to the request's real rows / length
+on fetch. Reference analog: Paddle Inference's TensorRT path collects
+min/max/opt shape ranges per input for the same reason (SURVEY §2.4) —
+bounded engine count under dynamic shapes.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["next_pow2", "BucketSpec", "ShapeBucketPolicy"]
+
+
+def next_pow2(n: int) -> int:
+    if n <= 1:
+        return 1
+    return 1 << (int(n - 1).bit_length())
+
+
+class BucketSpec:
+    """One warmup target: a (batch_bucket, seq_bucket) pair. ``seq`` is
+    None for models without a bucketed sequence axis."""
+
+    __slots__ = ("batch", "seq")
+
+    def __init__(self, batch: int, seq: Optional[int] = None):
+        self.batch = int(batch)
+        self.seq = None if seq is None else int(seq)
+
+    def __repr__(self):
+        return f"BucketSpec(batch={self.batch}, seq={self.seq})"
+
+
+class ShapeBucketPolicy:
+    """Quantize request shapes onto the bucket lattice and pad/unpad.
+
+    - ``max_batch_size``: batch buckets are the powers of two up to this
+      cap (``pad_batch=False`` disables batch rounding: each coalesced
+      batch runs at its exact row count).
+    - ``seq_buckets``: sorted ascending bucket lengths for the sequence
+      axis, or None to disable sequence padding entirely (the safe
+      default — sequence padding assumes per-position independence,
+      i.e. padding rows/positions with zeros cannot perturb the real
+      positions' outputs).
+    - ``seq_axis``: which axis of each feed is the sequence axis
+      (feeds with ndim <= seq_axis are left untouched).
+    """
+
+    def __init__(self, max_batch_size: int = 8, pad_batch: bool = True,
+                 seq_buckets: Optional[Sequence[int]] = None,
+                 seq_axis: int = 1):
+        self.max_batch_size = int(max_batch_size)
+        self.pad_batch = pad_batch
+        self.seq_buckets = sorted(int(s) for s in seq_buckets) \
+            if seq_buckets else None
+        self.seq_axis = int(seq_axis)
+
+    # ---- bucket selection ----
+    def bucket_batch(self, rows: int) -> int:
+        if not self.pad_batch:
+            return rows
+        return min(next_pow2(rows), self.max_batch_size)
+
+    def bucket_seq(self, length: int) -> int:
+        if self.seq_buckets is None:
+            return length
+        for b in self.seq_buckets:
+            if b >= length:
+                return b
+        # beyond the largest bucket: round to next_pow2 so the cache
+        # still stays bounded-ish rather than one entry per length
+        return next_pow2(length)
+
+    # ---- request signature (grouping key for the batcher) ----
+    def signature(self, feeds: List[np.ndarray]) -> Tuple:
+        """Hashable compatibility key: two requests may share one device
+        batch iff their per-feed dtypes and non-batch shapes (after
+        sequence bucketing) are identical."""
+        sig = []
+        for a in feeds:
+            shape = list(a.shape[1:])  # drop the batch axis
+            ax = self.seq_axis - 1     # seq axis within the rest
+            if self.seq_buckets is not None and 0 <= ax < len(shape):
+                shape[ax] = self.bucket_seq(shape[ax])
+            sig.append((str(a.dtype), tuple(shape)))
+        return tuple(sig)
+
+    # ---- padding ----
+    def pad_request_seq(self, feeds: List[np.ndarray]) -> List[np.ndarray]:
+        """Zero-pad each feed's sequence axis up to its bucket."""
+        if self.seq_buckets is None:
+            return feeds
+        out = []
+        for a in feeds:
+            if a.ndim > self.seq_axis:
+                cur = a.shape[self.seq_axis]
+                tgt = self.bucket_seq(cur)
+                if tgt != cur:
+                    pad = [(0, 0)] * a.ndim
+                    pad[self.seq_axis] = (0, tgt - cur)
+                    a = np.pad(a, pad)
+            out.append(a)
+        return out
+
+    def pad_rows(self, arr: np.ndarray, target_rows: int) -> np.ndarray:
+        """Zero-pad axis 0 up to ``target_rows``."""
+        cur = arr.shape[0]
+        if cur == target_rows:
+            return arr
+        pad = [(0, 0)] * arr.ndim
+        pad[0] = (0, target_rows - cur)
+        return np.pad(arr, pad)
+
+    # ---- unpadding ----
+    def unpad_output(self, out: np.ndarray, orig_seq: Optional[int]):
+        """Slice a per-request output back to the request's real
+        sequence length. Applied only when the output still carries the
+        padded extent at ``seq_axis`` (outputs that reduced the sequence
+        away — pooled logits, scalars — pass through untouched)."""
+        if self.seq_buckets is None or orig_seq is None:
+            return out
+        ax = self.seq_axis
+        if out.ndim > ax and out.shape[ax] == self.bucket_seq(orig_seq) \
+                and out.shape[ax] != orig_seq:
+            idx = [slice(None)] * out.ndim
+            idx[ax] = slice(0, orig_seq)
+            return out[tuple(idx)]
+        return out
+
+    @staticmethod
+    def elements_per_row(sig: Tuple) -> int:
+        """Input elements one (padded) batch row carries under this
+        signature — the padding-waste denominator unit for metrics."""
+        return sum(int(np.prod(shape)) if shape else 1
+                   for _, shape in sig)

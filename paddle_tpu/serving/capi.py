@@ -1,0 +1,112 @@
+"""C-ABI integration: route PD_* predictors through a shared server.
+
+``native/csrc/pd_capi.cc`` calls ``wrap_capi(pred)`` after every
+``PD_PredictorCreate``. With ``FLAGS_serving_capi_batching`` off
+(default) the predictor passes through untouched — the existing
+single-request capi behavior. With it on, all PD_Predictors created for
+the same model prefix share ONE underlying Predictor + InferenceServer,
+and each wrapper's ``run()`` submits to the shared queue and blocks on
+its future — so a C host running the standard one-PD_Predictor-per-
+thread pattern gets its threads' requests coalesced into device batches
+with zero client-side changes.
+
+Each wrapper keeps its OWN input/output handle Tensors (the C contract
+scopes handles to a predictor), with output handles stable per fetch
+name across runs (ADVICE #1 semantics).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+__all__ = ["wrap_capi"]
+
+_lock = threading.Lock()
+_shared: Dict[tuple, "InferenceServerEntry"] = {}
+
+
+class InferenceServerEntry:
+    __slots__ = ("server", "refs")
+
+    def __init__(self, server):
+        self.server = server
+        self.refs = 0
+
+
+def _server_for(pred):
+    from .server import InferenceServer
+
+    cfg = getattr(pred, "_config", None)
+    key = (getattr(cfg, "_prefix", None) or id(pred),
+           getattr(cfg, "_params_path", None))
+    with _lock:
+        entry = _shared.get(key)
+        if entry is None:
+            entry = _shared[key] = InferenceServerEntry(InferenceServer(
+                pred, name=f"capi_{len(_shared)}"))
+        entry.refs += 1
+        return entry.server
+
+
+class CapiServingPredictor:
+    """Predictor-shaped facade over a shared InferenceServer — exposes
+    exactly the surface pd_capi.cc touches."""
+
+    def __init__(self, server):
+        from ..inference import Tensor
+
+        self._server = server
+        base = server.predictor
+        self._inputs = {
+            name: Tensor(name, spec)
+            for name, spec in zip(base._artifact.feed_names,
+                                  base._artifact.feeds)}
+        self._outputs: Dict[str, object] = {}
+        self._Tensor = Tensor
+
+    def get_input_names(self):
+        return list(self._server.predictor.get_input_names())
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def get_output_names(self):
+        return sorted(self._outputs) or ["fetch_0"]
+
+    def get_output_handle(self, name):
+        t = self._outputs.get(name)
+        if t is None:
+            t = self._outputs[name] = self._Tensor(name)
+        return t
+
+    def run(self):
+        feeds = []
+        for n in self._server.predictor.get_input_names():
+            h = self._inputs[n]
+            if h._value is None:
+                raise RuntimeError(f"input '{n}' not set")
+            feeds.append(h._value)
+        fut = self._server.submit(feeds)
+        outs = fut.result()
+        for i, o in enumerate(outs):
+            self.get_output_handle(f"fetch_{i}")._value = o
+        return True
+
+    def clear_intermediate_tensor(self):
+        pass
+
+    def try_shrink_memory(self):
+        pass
+
+
+def wrap_capi(pred):
+    """Identity unless FLAGS_serving_capi_batching is enabled (called
+    from pd_capi.cc; must never raise — a serving-layer problem should
+    degrade to the plain predictor, not kill PD_PredictorCreate)."""
+    try:
+        from ..framework.flags import flag_value
+        if not flag_value("FLAGS_serving_capi_batching"):
+            return pred
+        return CapiServingPredictor(_server_for(pred))
+    except Exception:  # noqa: BLE001 - degrade, never break the C ABI
+        return pred

@@ -1,0 +1,166 @@
+"""Serving observability: per-server metrics + a named registry.
+
+Wired into the rest of the stack rather than freestanding:
+
+- every counter bump mirrors into ``framework.monitor`` (the reference's
+  STAT_ADD int64 registry, platform/monitor.cc) under a
+  ``serving_<server>_*`` name, so existing monitor consumers see serving
+  traffic alongside the framework's other stats;
+- batch executions are wrapped in ``profiler.RecordEvent`` spans by the
+  server, so the host tracer's chrome export shows serving batches on
+  the timeline.
+
+Schema (``snapshot()`` / ``to_json()``)::
+
+    {"server": str,
+     "counters": {"submitted", "completed", "rejected", "timed_out",
+                  "cancelled", "failed", "batches"},
+     "queue": {"depth", "capacity", "peak_depth"},
+     "batch_size_hist": {"<rows>": count, ...},
+     "padding": {"real_elements", "padded_elements", "waste_ratio"},
+     "latency_ms": {"count", "p50", "p95", "p99", "max"},
+     "compile_cache": {"hits", "misses", "signatures"}}
+"""
+from __future__ import annotations
+
+import json
+import math
+import threading
+from collections import deque
+from typing import Dict, List, Optional
+
+__all__ = ["ServingMetrics", "register", "get", "unregister",
+           "all_snapshots"]
+
+_COUNTERS = ("submitted", "completed", "rejected", "timed_out",
+             "cancelled", "failed", "batches")
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile over an already-sorted sample."""
+    if not sorted_vals:
+        return 0.0
+    k = max(0, min(len(sorted_vals) - 1,
+                   math.ceil(q / 100.0 * len(sorted_vals)) - 1))
+    return float(sorted_vals[k])
+
+
+class ServingMetrics:
+    """Thread-safe metric sink for one server. Latency keeps a bounded
+    window (``window`` most recent request latencies) so a long-running
+    server's percentiles track current behavior, not its whole life."""
+
+    def __init__(self, name: str = "default", window: int = 2048):
+        self.name = name
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {c: 0 for c in _COUNTERS}
+        self._batch_hist: Dict[int, int] = {}
+        self._latency = deque(maxlen=int(window))
+        self._queue_depth = 0
+        self._queue_capacity = 0
+        self._peak_depth = 0
+        self._real_elements = 0
+        self._padded_elements = 0
+        self._compile_hits = 0
+        self._compile_misses = 0
+        self._signatures = set()
+
+    # ---- recording ----
+    def count(self, name: str, n: int = 1):
+        from ..framework import monitor
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+        monitor.stat_add(f"serving_{self.name}_{name}", n)
+
+    def queue_depth(self, depth: int, capacity: int):
+        with self._lock:
+            self._queue_depth = depth
+            self._queue_capacity = capacity
+            self._peak_depth = max(self._peak_depth, depth)
+
+    def observe_batch(self, rows: int, real_elements: int,
+                      padded_elements: int):
+        from ..framework import monitor
+        with self._lock:
+            self._counters["batches"] += 1
+            self._batch_hist[rows] = self._batch_hist.get(rows, 0) + 1
+            self._real_elements += real_elements
+            self._padded_elements += padded_elements
+        monitor.stat_add(f"serving_{self.name}_batches", 1)
+
+    def observe_latency(self, ms: float):
+        with self._lock:
+            self._latency.append(float(ms))
+
+    def observe_compile(self, hit: bool, signature=None):
+        with self._lock:
+            if hit:
+                self._compile_hits += 1
+            else:
+                self._compile_misses += 1
+                if signature is not None:
+                    self._signatures.add(signature)
+
+    # ---- export ----
+    def snapshot(self) -> dict:
+        with self._lock:
+            lat = sorted(self._latency)
+            padded = self._padded_elements
+            real = self._real_elements
+            return {
+                "server": self.name,
+                "counters": dict(self._counters),
+                "queue": {"depth": self._queue_depth,
+                          "capacity": self._queue_capacity,
+                          "peak_depth": self._peak_depth},
+                "batch_size_hist": {str(k): v for k, v in
+                                    sorted(self._batch_hist.items())},
+                "padding": {
+                    "real_elements": real,
+                    "padded_elements": padded,
+                    "waste_ratio": (padded - real) / padded if padded
+                    else 0.0},
+                "latency_ms": {
+                    "count": len(lat),
+                    "p50": _percentile(lat, 50),
+                    "p95": _percentile(lat, 95),
+                    "p99": _percentile(lat, 99),
+                    "max": lat[-1] if lat else 0.0},
+                "compile_cache": {"hits": self._compile_hits,
+                                  "misses": self._compile_misses,
+                                  "signatures": len(self._signatures)},
+            }
+
+    def to_json(self, indent: Optional[int] = None) -> str:
+        return json.dumps(self.snapshot(), indent=indent, sort_keys=True)
+
+    def export_json(self, path: str):
+        with open(path, "w") as f:
+            f.write(self.to_json(indent=1))
+
+
+# ---- named registry (one entry per live server) ----
+_reg_lock = threading.Lock()
+_registry: Dict[str, ServingMetrics] = {}
+
+
+def register(m: ServingMetrics) -> ServingMetrics:
+    with _reg_lock:
+        _registry[m.name] = m
+    return m
+
+
+def get(name: str) -> Optional[ServingMetrics]:
+    with _reg_lock:
+        return _registry.get(name)
+
+
+def unregister(name: str):
+    with _reg_lock:
+        _registry.pop(name, None)
+
+
+def all_snapshots() -> dict:
+    with _reg_lock:
+        servers = list(_registry.values())
+    return {m.name: m.snapshot() for m in servers}

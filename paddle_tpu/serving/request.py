@@ -1,0 +1,63 @@
+"""Request/Future plumbing for the serving layer.
+
+Reference analog: the request objects Paddle Serving / the capi_exp host
+loop juggle around AnalysisPredictor. Here a request is a list of numpy
+feed arrays (ordered by the predictor's feed names) plus a
+``concurrent.futures.Future`` the caller blocks on; the dynamic batcher
+(batcher.py) owns the queue of these and the server worker resolves the
+futures.
+"""
+from __future__ import annotations
+
+import time
+from concurrent.futures import Future
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["QueueFullError", "DeadlineExceededError", "ServerClosedError",
+           "Request"]
+
+
+class QueueFullError(RuntimeError):
+    """Raised by ``InferenceServer.submit`` when the bounded request
+    queue is at capacity — the backpressure signal; callers shed load or
+    retry with their own policy instead of growing an unbounded queue."""
+
+
+class DeadlineExceededError(TimeoutError):
+    """Set on a request's future when its deadline passed before the
+    batcher could schedule it (the request is dropped, never run)."""
+
+
+class ServerClosedError(RuntimeError):
+    """Raised by ``submit`` after shutdown began, and set on still-queued
+    futures when shutdown is not draining."""
+
+
+class Request:
+    """One inference request: per-feed arrays + the future resolved with
+    the per-request output list (outputs unpadded back to the request's
+    own rows / sequence lengths)."""
+
+    __slots__ = ("feeds", "rows", "future", "submit_t", "deadline",
+                 "signature", "orig_seq")
+
+    def __init__(self, feeds: List[np.ndarray], rows: int,
+                 signature: Tuple, orig_seq: Optional[List[int]] = None,
+                 timeout_ms: Optional[float] = None):
+        self.feeds = feeds
+        self.rows = rows
+        self.signature = signature
+        self.orig_seq = orig_seq
+        self.future: Future = Future()
+        self.submit_t = time.monotonic()
+        self.deadline = (self.submit_t + timeout_ms / 1e3
+                         if timeout_ms else None)
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return self.deadline is not None and \
+            (now if now is not None else time.monotonic()) > self.deadline
+
+    def latency_ms(self) -> float:
+        return (time.monotonic() - self.submit_t) * 1e3

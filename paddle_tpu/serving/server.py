@@ -1,0 +1,337 @@
+"""InferenceServer: request-level dynamic-batching serving loop.
+
+Owns a ``paddle_tpu.inference.Predictor`` and turns its one-shot
+``run`` into a request-level service: callers ``submit`` per-request
+feeds and get a Future; a worker thread drains the bounded queue,
+coalesces shape-compatible requests into one padded device batch
+(bucketing.py), executes through the Predictor's batched ``run_many``
+fast path, and resolves each Future with that request's unpadded
+outputs. ``warmup`` pre-compiles the bucket lattice so steady-state
+traffic never hits an XLA compile.
+
+Why a layer above Predictor instead of a faster ``run``: VERDICT.md
+measured single-request serving as host-dominated (ERNIE-base p50 ~21x
+device compute) — the win is amortizing that host overhead over many
+requests per device dispatch, which needs a queue, not a faster call.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from . import metrics as metrics_mod
+from .batcher import DynamicBatcher
+from .bucketing import BucketSpec, ShapeBucketPolicy
+from .request import (DeadlineExceededError, QueueFullError, Request,
+                      ServerClosedError)
+
+__all__ = ["InferenceServer"]
+
+FeedLike = Union[Dict[str, np.ndarray], Sequence[np.ndarray]]
+
+
+def _flag(name, default):
+    from ..framework.flags import flag_value
+    try:
+        v = flag_value(name)
+    except KeyError:
+        return default
+    return v
+
+
+class InferenceServer:
+    """Dynamic-batching server over one Predictor.
+
+    Parameters default to the ``FLAGS_serving_*`` knobs
+    (framework/flags.py) so a deployment can be tuned without code
+    changes. ``seq_buckets``/``seq_axis`` opt into sequence-length
+    bucketing (see ShapeBucketPolicy for the independence assumption);
+    batch-row padding to powers of two is on by default and can be
+    disabled with ``pad_batch=False``.
+
+    ``start=False`` defers the worker thread: requests queue up until
+    ``start()`` (or ``serve_forever``) — useful for tests and for
+    pre-loading a queue before measuring.
+    """
+
+    def __init__(self, predictor, *, max_batch_size: Optional[int] = None,
+                 max_wait_ms: Optional[float] = None,
+                 queue_capacity: Optional[int] = None,
+                 default_timeout_ms: Optional[float] = None,
+                 pad_batch: Optional[bool] = None,
+                 seq_buckets: Optional[Sequence[int]] = None,
+                 seq_axis: int = 1, name: str = "default",
+                 start: bool = True):
+        self.predictor = predictor
+        self.max_batch_size = int(max_batch_size if max_batch_size
+                                  is not None
+                                  else _flag("FLAGS_serving_max_batch_size",
+                                             8))
+        self.max_wait_ms = float(max_wait_ms if max_wait_ms is not None
+                                 else _flag("FLAGS_serving_max_wait_ms",
+                                            2.0))
+        cap = queue_capacity if queue_capacity is not None \
+            else _flag("FLAGS_serving_queue_capacity", 64)
+        self.default_timeout_ms = default_timeout_ms \
+            if default_timeout_ms is not None \
+            else (_flag("FLAGS_serving_default_timeout_ms", 0.0) or None)
+        if pad_batch is None:
+            pad_batch = bool(_flag("FLAGS_serving_pad_batch_pow2", True))
+        self.policy = ShapeBucketPolicy(
+            max_batch_size=self.max_batch_size, pad_batch=pad_batch,
+            seq_buckets=seq_buckets, seq_axis=seq_axis)
+        self.metrics = metrics_mod.register(metrics_mod.ServingMetrics(
+            name, window=int(_flag("FLAGS_serving_latency_window", 2048))))
+        self._batcher = DynamicBatcher(
+            max_batch_size=self.max_batch_size,
+            max_wait_ms=self.max_wait_ms, capacity=int(cap),
+            metrics=self.metrics)
+        self._feed_names = list(predictor.get_input_names())
+        self._closed = False
+        self._worker: Optional[threading.Thread] = None
+        self._loop_running = False      # a thread is inside _loop
+        self._compiled = set()          # signatures already executed
+        self._lock = threading.Lock()
+        if start:
+            self.start()
+
+    # ------------------------------------------------------ lifecycle
+    def start(self):
+        with self._lock:
+            if self._closed:
+                raise ServerClosedError("server already shut down")
+            if self._worker is None or not self._worker.is_alive():
+                self._worker = threading.Thread(
+                    target=self._loop, name=f"serving-{self.metrics.name}",
+                    daemon=True)
+                self._worker.start()
+        return self
+
+    def serve_forever(self):
+        """Run the batching loop in the CALLING thread until
+        ``shutdown`` (from another thread) — the synchronous deployment
+        mode, mirroring the reference C++ serving hosts that own the
+        loop themselves."""
+        with self._lock:
+            if self._closed:
+                raise ServerClosedError("server already shut down")
+            if self._worker is not None and self._worker.is_alive():
+                raise RuntimeError(
+                    "worker thread already running; serve_forever is the "
+                    "no-thread mode (construct with start=False)")
+        self._loop()
+
+    def shutdown(self, drain: bool = True, timeout: Optional[float] = None):
+        """Stop accepting requests; with ``drain`` (default) finish
+        everything already queued, otherwise fail pending futures with
+        ServerClosedError. Idempotent."""
+        with self._lock:
+            self._closed = True
+        if not drain:
+            self._batcher.cancel_pending(
+                ServerClosedError("server shut down before this request "
+                                  "was scheduled"))
+        self._batcher.stop()      # worker exits once the queue is empty
+        w = self._worker
+        if w is not None and w.is_alive() and \
+                w is not threading.current_thread():
+            w.join(timeout)
+        elif drain and not self._loop_running:
+            # never-started server (start=False): drain inline so
+            # queued futures still resolve; a live serve_forever loop
+            # drains itself (stop() above lets it exit once empty)
+            self._loop()
+        else:
+            deadline = None if timeout is None \
+                else time.monotonic() + timeout
+            while drain and self._loop_running and \
+                    (deadline is None or time.monotonic() < deadline):
+                time.sleep(0.005)  # wait out a serve_forever drain
+        metrics_mod.unregister(self.metrics.name)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown(drain=exc[0] is None)
+        return False
+
+    # ------------------------------------------------------ submission
+    def _normalize(self, feed: FeedLike) -> List[np.ndarray]:
+        if isinstance(feed, dict):
+            missing = [n for n in self._feed_names if n not in feed]
+            if missing:
+                raise KeyError(f"feed missing inputs {missing}")
+            arrs = [np.asarray(feed[n]) for n in self._feed_names]
+        else:
+            arrs = [np.asarray(a) for a in feed]
+            if len(arrs) != len(self._feed_names):
+                raise ValueError(
+                    f"expected {len(self._feed_names)} feeds "
+                    f"({self._feed_names}), got {len(arrs)}")
+        return arrs
+
+    def submit(self, feed: FeedLike,
+               timeout_ms: Optional[float] = None):
+        """Enqueue one request; returns a Future resolving to the list
+        of output arrays for THIS request (padded rows/positions already
+        sliced away). Raises QueueFullError at capacity and
+        ServerClosedError after shutdown."""
+        if self._closed:
+            raise ServerClosedError("server is shut down")
+        arrs = self._normalize(feed)
+        rows = int(arrs[0].shape[0]) if arrs[0].ndim else 1
+        if rows > self.max_batch_size:
+            raise ValueError(
+                f"request carries {rows} rows > max_batch_size="
+                f"{self.max_batch_size}; split it or raise the cap")
+        orig_seq = None
+        if self.policy.seq_buckets is not None:
+            ax = self.policy.seq_axis
+            orig_seq = [int(a.shape[ax]) if a.ndim > ax else -1
+                        for a in arrs]
+            arrs = self.policy.pad_request_seq(arrs)
+        req = Request(arrs, rows, self.policy.signature(arrs),
+                      orig_seq=orig_seq,
+                      timeout_ms=timeout_ms if timeout_ms is not None
+                      else self.default_timeout_ms)
+        self.metrics.count("submitted")
+        try:
+            self._batcher.put(req)
+        except QueueFullError:
+            self.metrics.count("rejected")
+            raise
+        return req.future
+
+    def submit_many(self, feeds: Sequence[FeedLike],
+                    timeout_ms: Optional[float] = None):
+        return [self.submit(f, timeout_ms=timeout_ms) for f in feeds]
+
+    # ------------------------------------------------------- warmup
+    def bucket_specs(self) -> List[BucketSpec]:
+        """The full bucket lattice traffic can land on: power-of-two
+        batch buckets up to max_batch_size crossed with the configured
+        seq buckets. ``warmup(server.bucket_specs())`` pre-compiles
+        everything, so steady state runs compile-free. (With
+        ``pad_batch=False`` every row count is its own shape; only the
+        max-batch point is returned.)"""
+        if self.policy.pad_batch:
+            batches, b = [], 1
+            while b < self.max_batch_size:
+                batches.append(b)
+                b <<= 1
+            batches.append(self.max_batch_size)
+        else:
+            batches = [self.max_batch_size]
+        seqs = self.policy.seq_buckets or [None]
+        return [BucketSpec(b, s) for b in batches for s in seqs]
+
+    def warmup(self, bucket_specs: Optional[Sequence] = None) -> int:
+        """Pre-compile the bucket lattice: for each spec — a BucketSpec,
+        an int batch bucket, or a (batch, seq) tuple — run one zero
+        batch through the predictor so XLA compiles it before traffic
+        arrives; defaults to the full ``bucket_specs()`` lattice.
+        Returns the number of fresh compiles triggered."""
+        if bucket_specs is None:
+            bucket_specs = self.bucket_specs()
+        specs = []
+        for s in bucket_specs:
+            if isinstance(s, BucketSpec):
+                specs.append(s)
+            elif isinstance(s, (tuple, list)):
+                specs.append(BucketSpec(*s))
+            else:
+                specs.append(BucketSpec(int(s)))
+        feed_specs = getattr(self.predictor, "_artifact").feeds
+        fresh = 0
+        for spec in specs:
+            arrs = []
+            for fs in feed_specs:
+                shape = [d if d not in (None, -1) else 1
+                         for d in fs["shape"]]
+                shape[0] = spec.batch
+                ax = self.policy.seq_axis
+                if spec.seq is not None and len(shape) > ax:
+                    shape[ax] = spec.seq
+                arrs.append(np.zeros(tuple(shape), fs["dtype"]))
+            sig = self.policy.signature(arrs)
+            req = Request(arrs, spec.batch, sig)
+            fresh += self._execute([req], record_latency=False)
+            req.future.result()    # surface warmup failures loudly
+        return fresh
+
+    # ------------------------------------------------------ execution
+    def _loop(self):
+        self._loop_running = True
+        try:
+            while True:
+                batch = self._batcher.next_batch()
+                if batch is None:
+                    return
+                self._execute(batch)
+        finally:
+            self._loop_running = False
+
+    def _execute(self, batch: List[Request],
+                 record_latency: bool = True) -> int:
+        """Run one coalesced batch; resolve every future. Returns 1 on
+        a compile-cache miss (a shape XLA had not seen), else 0."""
+        from ..profiler import RecordEvent
+
+        rows = sum(r.rows for r in batch)
+        padded_rows = self.policy.bucket_batch(rows)
+        sig = batch[0].signature
+        # padding waste: real input elements vs elements the padded
+        # device batch actually carries
+        per_row = self.policy.elements_per_row(sig)
+        real = sum(int(np.prod(a.shape)) if a.ndim else 1
+                   for r in batch for a in r.feeds)
+        self.metrics.observe_batch(rows, real, padded_rows * per_row)
+
+        cache_key = (sig, padded_rows)
+        miss = cache_key not in self._compiled
+        self._compiled.add(cache_key)
+        self.metrics.observe_compile(hit=not miss, signature=cache_key)
+
+        feeds_list = [r.feeds for r in batch]
+        n_pad = padded_rows - rows
+        if n_pad:
+            pad_feeds = [np.zeros((n_pad,) + tuple(a.shape[1:]), a.dtype)
+                         for a in batch[0].feeds]
+            feeds_list = feeds_list + [pad_feeds]
+        try:
+            with RecordEvent(f"serving::batch[rows={rows}"
+                             f",padded={padded_rows}]"):
+                results = self.predictor.run_many(feeds_list)
+        except Exception as e:  # noqa: BLE001 - fault barrier: the
+            # worker thread must survive any model error and fail only
+            # the requests of THIS batch
+            for r in batch:
+                if r.future.set_running_or_notify_cancel():
+                    r.future.set_exception(e)
+                self.metrics.count("failed")
+            return int(miss)
+        for r, outs in zip(batch, results):   # padding slice (if any)
+            if not r.future.set_running_or_notify_cancel():
+                continue                      # cancelled between drain+run
+            if r.orig_seq is not None and r.orig_seq[0] > 0:
+                # outputs are unpadded against the FIRST feed's original
+                # sequence length (the single-sequence-input common case)
+                outs = [self.policy.unpad_output(o, r.orig_seq[0])
+                        for o in outs]
+            r.future.set_result(outs)
+            self.metrics.count("completed")
+            if record_latency:
+                self.metrics.observe_latency(r.latency_ms())
+        return int(miss)
+
+    # ------------------------------------------------------ inspection
+    @property
+    def queue_depth(self) -> int:
+        return len(self._batcher)
+
+    def metrics_json(self, indent: Optional[int] = None) -> str:
+        return self.metrics.to_json(indent=indent)

@@ -131,15 +131,32 @@ def ctr_metric_bundle(*_a, **_k):
 
 # ------------------------------------------------- execution strategies
 
+_warned_inert = set()
+
+
+def _warn_inert_once(shim: str):
+    """One warning per inert shim (class.attr), so a user porting a
+    reference script learns which of their knobs do nothing here without
+    getting a warning per training step."""
+    if shim not in _warned_inert:
+        _warned_inert.add(shim)
+        warnings.warn(
+            f"{shim} is an inert compatibility shim in paddle_tpu: the "
+            f"XLA compilation pipeline subsumes the reference's graph-"
+            f"pass / executor knobs; the value is recorded but has no "
+            f"effect")
+
+
 class BuildStrategy:
     """Attribute bag (reference BuildStrategy steers C++ graph passes;
     XLA's pipeline subsumes them, so every knob is accepted and
-    recorded but has no effect)."""
+    recorded but has no effect — setting one warns once per attr)."""
 
     def __init__(self):
         self.__dict__["_opts"] = {}
 
     def __setattr__(self, k, v):
+        _warn_inert_once(f"{type(self).__name__}.{k}")
         self._opts[k] = v
 
     def __getattr__(self, k):
@@ -166,10 +183,11 @@ class CompiledProgram:
                            exec_strategy=None, share_vars_from=None,
                            places=None):
         warnings.warn(
-            "CompiledProgram.with_data_parallel: single-process data "
-            "parallelism is expressed through the device mesh "
-            "(fleet.init hybrid_configs) in paddle_tpu; running the "
-            "program as-is")
+            "CompiledProgram.with_data_parallel is an inert shim: XLA "
+            "whole-program compilation subsumes the reference's multi-"
+            "card graph replication — single-process data parallelism "
+            "is expressed through the device mesh (fleet.init "
+            "hybrid_configs); running the program as-is")
         return self
 
     def __getattr__(self, k):

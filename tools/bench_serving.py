@@ -1,0 +1,122 @@
+"""CPU micro-bench: dynamic-batched serving vs serial Predictor.run.
+
+Acceptance gauge for ISSUE 1: at batchable load (many outstanding
+single-row requests) the InferenceServer must deliver >= 2x the
+throughput of a serial one-request-at-a-time loop over the same
+Predictor — the host-overhead amortization VERDICT.md said the serving
+story was missing. Runs on CPU (JAX_PLATFORMS=cpu) so it measures the
+dispatch/coalescing machinery, not accelerator speed.
+
+    python tools/bench_serving.py [--requests 256] [--batch 16] [--json]
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+from paddle_tpu import inference, serving  # noqa: E402
+
+
+def build_predictor(tmpdir, hidden=256):
+    paddle.seed(0)
+    net = nn.Sequential(
+        nn.Linear(64, hidden), nn.Tanh(),
+        nn.Linear(hidden, hidden), nn.Tanh(),
+        nn.Linear(hidden, 16)).eval()
+    prefix = os.path.join(tmpdir, "bench_model")
+    paddle.jit.save(net, prefix, input_spec=[
+        paddle.static.InputSpec([None, 64], "float32", "x")],
+        pdmodel_format=False)
+    return inference.create_predictor(inference.Config(prefix))
+
+
+def bench_serial(pred, reqs):
+    # warm the shape so serial pays no compile inside the timed region
+    pred.run([reqs[0]])
+    t0 = time.perf_counter()
+    for r in reqs:
+        pred.run([r])
+    dt = time.perf_counter() - t0
+    return len(reqs) / dt, dt
+
+
+def bench_server(pred, reqs, max_batch, wait_ms):
+    srv = serving.InferenceServer(
+        pred, max_batch_size=max_batch, max_wait_ms=wait_ms,
+        queue_capacity=len(reqs) + 1, name="bench", start=False)
+    srv.warmup()                      # full pow2 lattice: no compiles
+    t0 = time.perf_counter()          # inside the timed region
+    futs = srv.submit_many([[r] for r in reqs])
+    srv.start()
+    for f in futs:
+        f.result(timeout=600)
+    dt = time.perf_counter() - t0
+    snap = srv.metrics.snapshot()
+    srv.shutdown()
+    return len(reqs) / dt, dt, snap
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=256)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--wait-ms", type=float, default=5.0)
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output only")
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    reqs = [rng.randn(1, 64).astype("float32")
+            for _ in range(args.requests)]
+
+    with tempfile.TemporaryDirectory() as d:
+        pred = build_predictor(d)
+        serial_rps, serial_s = bench_serial(pred, reqs)
+        batched_rps, batched_s, snap = bench_server(
+            pred, reqs, args.batch, args.wait_ms)
+
+    out = {
+        "requests": args.requests,
+        "max_batch_size": args.batch,
+        "serial_rps": round(serial_rps, 1),
+        "serial_total_s": round(serial_s, 4),
+        "batched_rps": round(batched_rps, 1),
+        "batched_total_s": round(batched_s, 4),
+        "speedup": round(batched_rps / serial_rps, 2),
+        "batches": snap["counters"]["batches"],
+        "batch_size_hist": snap["batch_size_hist"],
+        "compile_cache": snap["compile_cache"],
+        "latency_ms": snap["latency_ms"],
+    }
+    if args.json:
+        print(json.dumps(out, indent=1))
+    else:
+        print(f"serial : {out['serial_rps']:>9.1f} req/s "
+              f"({out['serial_total_s']}s for {args.requests})")
+        print(f"batched: {out['batched_rps']:>9.1f} req/s "
+              f"({out['batched_total_s']}s, "
+              f"{out['batches']} device batches)")
+        print(f"speedup: {out['speedup']}x  "
+              f"(target >= 2x at batchable load)")
+        print(f"compile cache: {out['compile_cache']}")
+        print(f"latency ms: p50={out['latency_ms']['p50']:.2f} "
+              f"p95={out['latency_ms']['p95']:.2f} "
+              f"p99={out['latency_ms']['p99']:.2f}")
+    return 0 if out["speedup"] >= 2.0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

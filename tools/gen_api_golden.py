@@ -31,6 +31,7 @@ SURFACES = [
     "paddle_tpu.distributed.fleet",
     "paddle_tpu.optimizer",
     "paddle_tpu.inference",
+    "paddle_tpu.serving",
     "paddle_tpu.io",
     "paddle_tpu.amp",
     "paddle_tpu.jit",
