@@ -115,3 +115,11 @@ define_flag("FLAGS_serving_capi_batching", False,
             "InferenceServer so C hosts get request coalescing")
 define_flag("FLAGS_serving_latency_window", 2048,
             "latency samples kept for the serving p50/p95/p99 metrics")
+define_flag("FLAGS_serving_pipeline_depth", 2,
+            "batches allowed in flight between dispatch and completion: "
+            "the worker assembles batch N+1 while batch N computes on "
+            "device (0 = synchronous execute, the pre-pipeline path)")
+define_flag("FLAGS_serving_donate_inputs", True,
+            "donate device input buffers to the jitted serving dispatch "
+            "so XLA reuses them for outputs (effective on accelerator "
+            "backends; CPU has no donation and falls back silently)")

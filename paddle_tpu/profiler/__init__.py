@@ -69,26 +69,52 @@ class _HostTracer:
         if n is not None:
             n.host_tracer_start()
 
-    def add(self, name, start_ns, end_ns, tid):
+    def add(self, name, start_ns, end_ns, tid, args=None):
         if not self.enabled:
             return
         n = self._native_lib()
-        if n is not None and n.host_tracer_enabled():
+        # the native recorder's ABI is (name, start, end) — spans that
+        # carry args metadata are recorded Python-side instead and
+        # spliced into the native export (see _merge_python_events)
+        if n is not None and n.host_tracer_enabled() and not args:
             n.host_tracer_record(name.encode(), start_ns, end_ns)
             return
+        ev = {"name": name, "ph": "X", "ts": start_ns / 1e3,
+              "dur": (end_ns - start_ns) / 1e3, "pid": os.getpid(),
+              "tid": tid}
+        if args:
+            ev["args"] = dict(args)   # chrome-trace per-span metadata
         with self._lock:
-            self.events.append(
-                {"name": name, "ph": "X", "ts": start_ns / 1e3,
-                 "dur": (end_ns - start_ns) / 1e3, "pid": os.getpid(),
-                 "tid": tid})
+            self.events.append(ev)
 
     def export_chrome_tracing(self, path):
         n = self._native_lib()
         if n is not None and n.host_tracer_event_count() > 0:
             n.host_tracer_stop(path.encode())
+            if self.events:
+                self._merge_python_events(path)
             return
         with open(path, "w") as f:
             json.dump({"traceEvents": self.events}, f)
+
+    def _merge_python_events(self, path):
+        """Splice Python-side (args-carrying) spans into a native
+        chrome-trace export so one file shows both."""
+        try:
+            with open(path) as f:
+                data = json.load(f)
+            with self._lock:
+                extra = list(self.events)
+            if isinstance(data, list):
+                data.extend(extra)
+            elif isinstance(data, dict):
+                data.setdefault("traceEvents", []).extend(extra)
+            else:
+                return
+            with open(path, "w") as f:
+                json.dump(data, f)
+        except Exception:
+            pass  # the native trace stays usable; args spans are additive
 
 
 _tracer = _HostTracer()
@@ -97,12 +123,22 @@ _tracer = _HostTracer()
 class RecordEvent:
     """Span marker usable as context manager or begin/end pair — same surface
     as paddle.profiler.RecordEvent; also emits a jax named span so device
-    traces correlate."""
+    traces correlate. ``args`` (a shallow dict, e.g. the serving layer's
+    ``{"rows": 8, "padded": 8}``) lands in the chrome-trace event's
+    ``args`` field and can be extended during the span via
+    ``set_arg`` — the serving pipeline stamps measured stage times onto
+    its spans this way."""
 
-    def __init__(self, name, event_type=None):
+    def __init__(self, name, event_type=None, args=None):
         self.name = name
+        self.args = dict(args) if args else None
         self._jax_ctx = None
         self._start = None
+
+    def set_arg(self, key, value):
+        if self.args is None:
+            self.args = {}
+        self.args[key] = value
 
     def begin(self):
         self._start = time.perf_counter_ns()
@@ -118,7 +154,7 @@ class RecordEvent:
             self._jax_ctx = None
         if self._start is not None:
             _tracer.add(self.name, self._start, time.perf_counter_ns(),
-                        threading.get_ident())
+                        threading.get_ident(), self.args)
 
     def __enter__(self):
         self.begin()

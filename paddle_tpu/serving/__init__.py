@@ -8,19 +8,26 @@ XLA program and THIS package is the serving shell around it.
 Pieces:
 
 - ``InferenceServer`` (server.py): owns a Predictor; ``submit(feed) ->
-  Future`` / ``submit_many`` / synchronous ``serve_forever``; graceful
-  ``shutdown(drain=True)``; ``warmup(bucket_specs)`` pre-compiles the
-  shape lattice.
+  Future`` / bulk ``submit_many`` / synchronous ``serve_forever``;
+  graceful ``shutdown(drain=True)``; ``warmup(bucket_specs)``
+  pre-compiles the shape lattice. Execution is a 3-stage pipeline
+  (staging-pool host assembly -> jitted async dispatch with donated
+  inputs -> completion thread), ``FLAGS_serving_pipeline_depth``
+  batches in flight, so host assembly overlaps device compute;
+  depth 0 restores the synchronous executor.
 - ``DynamicBatcher`` (batcher.py): bounded queue with backpressure
   (``QueueFullError``), per-request deadlines
-  (``DeadlineExceededError``), max_batch_size/max_wait_ms coalescing.
+  (``DeadlineExceededError``), max_batch_size/max_wait_ms coalescing;
+  any FULL shape bucket dispatches immediately instead of waiting out
+  an older bucket's window.
 - ``ShapeBucketPolicy`` / ``BucketSpec`` (bucketing.py): power-of-two
   batch + sequence-length buckets with zero padding and
   unpad-on-fetch, keeping the XLA compile cache bounded and warm.
 - ``ServingMetrics`` (metrics.py): queue depth, batch-size histogram,
-  padding-waste ratio, latency percentiles, compile-cache hit rate —
-  JSON-exportable, mirrored into framework.monitor, batch spans on the
-  host tracer's chrome export.
+  padding-waste ratio, latency percentiles, compile-cache hit rate,
+  per-batch host/device stage split (``stage_ms``) — JSON-exportable,
+  mirrored into framework.monitor, stage spans on the host tracer's
+  chrome export.
 - ``wrap_capi`` (capi.py): the hook pd_capi.cc calls so C clients get
   request batching behind ``FLAGS_serving_capi_batching``.
 

@@ -85,14 +85,17 @@ class ShapeBucketPolicy:
     def signature(self, feeds: List[np.ndarray]) -> Tuple:
         """Hashable compatibility key: two requests may share one device
         batch iff their per-feed dtypes and non-batch shapes (after
-        sequence bucketing) are identical."""
+        sequence bucketing) are identical. The dtype component is numpy's
+        C-level ``dtype.str`` ('<f4' style) — ``str(dtype)`` goes through
+        a slow Python ``__str__`` that dominated per-request submit cost
+        at high ingest rates; both are valid np.zeros/np.dtype inputs."""
         sig = []
         for a in feeds:
             shape = list(a.shape[1:])  # drop the batch axis
             ax = self.seq_axis - 1     # seq axis within the rest
             if self.seq_buckets is not None and 0 <= ax < len(shape):
                 shape[ax] = self.bucket_seq(shape[ax])
-            sig.append((str(a.dtype), tuple(shape)))
+            sig.append((a.dtype.str, tuple(shape)))
         return tuple(sig)
 
     # ---- padding ----

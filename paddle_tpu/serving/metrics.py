@@ -19,7 +19,19 @@ Schema (``snapshot()`` / ``to_json()``)::
      "batch_size_hist": {"<rows>": count, ...},
      "padding": {"real_elements", "padded_elements", "waste_ratio"},
      "latency_ms": {"count", "p50", "p95", "p99", "max"},
+     "stage_ms": {"count",
+                  "assembly" | "dispatch" | "device_wait" | "fetch" |
+                  "host" | "device": {"p50", "p95", "p99", "max"},
+                  "host_fraction"},
      "compile_cache": {"hits", "misses", "signatures"}}
+
+``stage_ms`` is the per-batch host/device time split from the
+pipelined executor: ``assembly`` (staging-pool copy), ``dispatch``
+(device_put + async dispatch), ``device_wait`` (blocking until device
+compute finishes), ``fetch`` (device->host transfer). ``host`` =
+assembly+dispatch+fetch, ``device`` = device_wait, and
+``host_fraction`` is sum(host)/sum(host+device) over the window — the
+continuously measured version of PERF.md's "~95% host overhead" claim.
 """
 from __future__ import annotations
 
@@ -64,6 +76,9 @@ class ServingMetrics:
         self._compile_hits = 0
         self._compile_misses = 0
         self._signatures = set()
+        self._stages = {k: deque(maxlen=int(window))
+                        for k in ("assembly", "dispatch", "device_wait",
+                                  "fetch", "host", "device")}
 
     # ---- recording ----
     def count(self, name: str, n: int = 1):
@@ -91,6 +106,27 @@ class ServingMetrics:
     def observe_latency(self, ms: float):
         with self._lock:
             self._latency.append(float(ms))
+
+    def observe_latency_many(self, ms_list):
+        """Bulk latency append: one lock acquisition per batch instead
+        of one per request (the completion stage resolves whole batches
+        at a time)."""
+        with self._lock:
+            self._latency.extend(float(m) for m in ms_list)
+
+    def observe_stage_times(self, assembly_ms: float, dispatch_ms: float,
+                            device_wait_ms: float, fetch_ms: float):
+        """Per-batch pipeline stage durations; host = everything the
+        host CPU did (assembly + dispatch + fetch), device = time spent
+        waiting on device compute."""
+        with self._lock:
+            self._stages["assembly"].append(float(assembly_ms))
+            self._stages["dispatch"].append(float(dispatch_ms))
+            self._stages["device_wait"].append(float(device_wait_ms))
+            self._stages["fetch"].append(float(fetch_ms))
+            self._stages["host"].append(
+                float(assembly_ms + dispatch_ms + fetch_ms))
+            self._stages["device"].append(float(device_wait_ms))
 
     def observe_compile(self, hit: bool, signature=None):
         with self._lock:
@@ -126,10 +162,26 @@ class ServingMetrics:
                     "p95": _percentile(lat, 95),
                     "p99": _percentile(lat, 99),
                     "max": lat[-1] if lat else 0.0},
+                "stage_ms": self._stage_snapshot(),
                 "compile_cache": {"hits": self._compile_hits,
                                   "misses": self._compile_misses,
                                   "signatures": len(self._signatures)},
             }
+
+    def _stage_snapshot(self) -> dict:
+        """Per-stage percentiles + host fraction (lock held)."""
+        out = {"count": len(self._stages["host"])}
+        for name, window in self._stages.items():
+            vals = sorted(window)
+            out[name] = {"p50": _percentile(vals, 50),
+                         "p95": _percentile(vals, 95),
+                         "p99": _percentile(vals, 99),
+                         "max": vals[-1] if vals else 0.0}
+        host = sum(self._stages["host"])
+        device = sum(self._stages["device"])
+        out["host_fraction"] = host / (host + device) \
+            if host + device else 0.0
+        return out
 
     def to_json(self, indent: Optional[int] = None) -> str:
         return json.dumps(self.snapshot(), indent=indent, sort_keys=True)
