@@ -80,6 +80,7 @@ from . import jit  # noqa: F401
 from . import metric  # noqa: F401
 from . import nn  # noqa: F401
 from . import linalg  # noqa: F401
+from . import observability  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import profiler  # noqa: F401
 from . import quantization  # noqa: F401

@@ -119,7 +119,21 @@ define_flag("FLAGS_serving_pipeline_depth", 2,
             "batches allowed in flight between dispatch and completion: "
             "the worker assembles batch N+1 while batch N computes on "
             "device (0 = synchronous execute, the pre-pipeline path)")
+define_flag("FLAGS_serving_telemetry_port", -1,
+            "HTTP telemetry endpoint (/metrics /healthz /statusz) the "
+            "InferenceServer attaches on construction: -1 disabled, "
+            "0 ephemeral port, >0 fixed port; one shared endpoint per "
+            "process")
 define_flag("FLAGS_serving_donate_inputs", True,
             "donate device input buffers to the jitted serving dispatch "
             "so XLA reuses them for outputs (effective on accelerator "
             "backends; CPU has no donation and falls back silently)")
+
+# Observability knobs (paddle_tpu.observability — the telemetry layer).
+define_flag("FLAGS_training_telemetry", False,
+            "auto-inject the TrainingTelemetryCallback into Model.fit "
+            "(step time, examples/sec, loss into the metric registry)")
+define_flag("FLAGS_profiler_span_metrics", False,
+            "mirror profiler RecordEvent span durations into the "
+            "paddle_profiler_span_ms histogram so chrome traces and "
+            "scraped /metrics agree")

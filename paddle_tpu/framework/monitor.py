@@ -1,33 +1,47 @@
 """Named stat registry (reference: paddle/fluid/platform/monitor.cc —
-STAT_ADD/STAT_RESET int64 counters exported for observability)."""
+STAT_ADD/STAT_RESET int64 counters exported for observability).
+
+Backed by the unified telemetry layer: the stats ARE a label set on the
+``paddle_monitor_stat`` Counter in ``observability.default_registry()``,
+so everything recorded here shows up verbatim on a scraped ``/metrics``
+page as ``paddle_monitor_stat{name="..."}``. The historical flat-int
+API (stat_add/stat_get/stat_reset/stat_names) is unchanged;
+``stats_snapshot()`` is the sanctioned bulk export — nothing outside
+this module should reach into the underlying storage.
+"""
 from __future__ import annotations
 
-import threading
-from typing import Dict
+from typing import Dict, Optional
 
-_lock = threading.Lock()
-_stats: Dict[str, int] = {}
+from ..observability.registry import default_registry
+
+_counter = default_registry().counter(
+    "paddle_monitor_stat",
+    "framework STAT_ADD int64 counters (platform/monitor.cc analog)",
+    ("name",))
 
 
 def stat_add(name: str, value: int = 1) -> int:
-    with _lock:
-        _stats[name] = _stats.get(name, 0) + int(value)
-        return _stats[name]
+    return int(_counter.labels(name=name).inc(int(value)))
 
 
 def stat_get(name: str) -> int:
-    with _lock:
-        return _stats.get(name, 0)
+    child = _counter.get(name=name)
+    return int(child.value) if child is not None else 0
 
 
-def stat_reset(name: str = None):
-    with _lock:
-        if name is None:
-            _stats.clear()
-        else:
-            _stats.pop(name, None)
+def stat_reset(name: Optional[str] = None):
+    if name is None:
+        _counter.clear()
+    else:
+        _counter.remove(name=name)
 
 
 def stat_names():
-    with _lock:
-        return sorted(_stats)
+    return sorted(key[0] for key in _counter.label_values())
+
+
+def stats_snapshot() -> Dict[str, int]:
+    """All stats as one dict — the export the exposition layer (and any
+    other consumer) uses instead of touching internal storage."""
+    return {key[0]: int(child.value) for key, child in _counter.items()}

@@ -232,6 +232,17 @@ def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,
         cbs.append(ModelCheckpoint(save_freq, save_dir))
     if not any(isinstance(c, LRScheduler) for c in cbs) and model is not None:
         cbs.append(LRScheduler())
+    if mode == "train":
+        try:
+            from ..framework.flags import flag_value
+            if flag_value("FLAGS_training_telemetry"):
+                from ..observability.training import \
+                    TrainingTelemetryCallback
+                if not any(isinstance(c, TrainingTelemetryCallback)
+                           for c in cbs):
+                    cbs.append(TrainingTelemetryCallback())
+        except Exception:  # noqa: BLE001 - telemetry is additive; fit
+            pass           # must run even if the registry is broken
     clist = CallbackList(cbs)
     clist.set_model(model)
     clist.set_params({"epochs": epochs, "steps": steps, "verbose": verbose,

@@ -1,0 +1,81 @@
+"""paddle_tpu.observability — the unified telemetry layer.
+
+The reference framework ships first-class observability
+(platform/monitor.cc's STAT_ADD registry, the HostTracer/CudaTracer
+profiler pair); this package is its production-grade TPU-native
+counterpart and the ONE place every subsystem reports into:
+
+- ``registry``: typed metric families — ``Counter``, ``Gauge``,
+  ``Histogram`` — with Prometheus-style label sets, plus
+  ``PercentileWindow``, the bounded-window nearest-rank percentile
+  estimator shared with ``serving.metrics``;
+- ``exposition``: Prometheus text format 0.0.4 + a JSON mirror;
+- ``httpd``: a stdlib ``http.server`` endpoint (``/metrics``,
+  ``/healthz``, ``/statusz``) that ``InferenceServer`` attaches via
+  ``FLAGS_serving_telemetry_port`` and scripts start with
+  ``start_telemetry_server()``;
+- ``runtime``: JAX compile-event listeners, device-memory gauges, and
+  profiler RecordEvent span mirroring;
+- ``training``: a ``Model.fit`` callback + ``optimizer.step`` hook for
+  step time / examples-per-sec / loss (lazy — imported on first
+  attribute access so this package stays importable before hapi and
+  optimizer exist in the import order).
+
+``framework.monitor``'s stat_add/stat_get are a Counter view onto the
+default registry; ``serving.ServingMetrics`` is backed by these types
+while keeping its ``snapshot()`` schema byte-compatible.
+"""
+from __future__ import annotations
+
+from . import exposition, httpd, registry, runtime  # noqa: F401
+from .exposition import (  # noqa: F401
+    PROMETHEUS_CONTENT_TYPE, json_snapshot, json_text, prometheus_text,
+)
+from .httpd import (  # noqa: F401
+    TelemetryServer, add_health_check, get_telemetry_server, healthz,
+    remove_health_check, start_telemetry_server, stop_telemetry_server,
+)
+from .registry import (  # noqa: F401
+    DEFAULT_MS_BUCKETS, Counter, Gauge, Histogram, MetricRegistry,
+    PercentileWindow, default_registry, sanitize_metric_name,
+)
+from .runtime import (  # noqa: F401
+    install_device_memory_collector, install_jax_monitoring,
+    mirror_profiler_spans,
+)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricRegistry",
+    "PercentileWindow", "default_registry",
+    "sanitize_metric_name", "DEFAULT_MS_BUCKETS",
+    "prometheus_text", "json_snapshot", "json_text",
+    "PROMETHEUS_CONTENT_TYPE",
+    "TelemetryServer", "start_telemetry_server", "get_telemetry_server",
+    "stop_telemetry_server", "add_health_check", "remove_health_check",
+    "healthz",
+    "install_jax_monitoring", "install_device_memory_collector",
+    "mirror_profiler_spans",
+    "TrainingTelemetryCallback", "instrument_optimizers",
+    "uninstrument_optimizers",
+    "registry", "exposition", "httpd", "runtime", "training",
+]
+
+_LAZY = {
+    "TrainingTelemetryCallback": "training",
+    "instrument_optimizers": "training",
+    "uninstrument_optimizers": "training",
+    "training": None,
+}
+
+
+def __getattr__(name):
+    # training pulls in the optimizer package; defer it so importing
+    # paddle_tpu.observability (framework.monitor does, very early)
+    # never walks back up into partially-initialized siblings
+    if name in _LAZY:
+        import importlib
+        mod = importlib.import_module(__name__ + ".training")
+        if _LAZY[name] is None:
+            return mod
+        return getattr(mod, name)
+    raise AttributeError(name)

@@ -1,0 +1,255 @@
+"""Telemetry HTTP endpoint — stdlib ``http.server``, zero dependencies.
+
+Serves three paths off a daemon thread:
+
+- ``/metrics``  — Prometheus text format (0.0.4); ``?format=json`` or
+  an ``Accept: application/json`` header switches to the JSON mirror;
+- ``/healthz``  — runs the registered health checks, 200 when all pass,
+  503 otherwise, JSON body either way;
+- ``/statusz``  — process/runtime status page (pid, uptime, backend,
+  live serving servers, metric family count).
+
+``InferenceServer`` attaches via ``FLAGS_serving_telemetry_port``
+(-1 disabled, 0 ephemeral, >0 fixed); standalone training scripts call
+``start_telemetry_server()`` explicitly. One shared server per process
+— the registry is process-wide, so one scrape endpoint serves every
+subsystem.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional, Tuple
+
+from .exposition import (PROMETHEUS_CONTENT_TYPE, json_text,
+                         prometheus_text)
+from .registry import MetricRegistry, default_registry
+
+__all__ = [
+    "TelemetryServer", "start_telemetry_server", "get_telemetry_server",
+    "stop_telemetry_server", "add_health_check", "remove_health_check",
+    "healthz",
+]
+
+_start_time = time.time()
+
+# ---------------------------------------------------------------- health
+_health_lock = threading.Lock()
+_health_checks: Dict[str, Callable] = {}
+
+
+def add_health_check(name: str, fn: Callable):
+    """Register ``fn() -> bool | (bool, info)``; raising counts as
+    unhealthy. All checks must pass for /healthz to return 200."""
+    with _health_lock:
+        _health_checks[name] = fn
+
+
+def remove_health_check(name: str):
+    with _health_lock:
+        _health_checks.pop(name, None)
+
+
+def healthz() -> Tuple[bool, dict]:
+    with _health_lock:
+        checks = dict(_health_checks)
+    ok, detail = True, {}
+    for name, fn in checks.items():
+        try:
+            res = fn()
+            if isinstance(res, tuple):
+                c_ok, info = bool(res[0]), res[1]
+            else:
+                c_ok, info = bool(res), None
+        except Exception as e:  # noqa: BLE001 - a raising probe is a
+            c_ok, info = False, repr(e)  # failing probe, not a crash
+        detail[name] = {"ok": c_ok}
+        if info is not None:
+            detail[name]["info"] = info
+        ok = ok and c_ok
+    return ok, {"status": "ok" if ok else "unhealthy", "checks": detail}
+
+
+def _statusz() -> dict:
+    out = {
+        "pid": os.getpid(),
+        "uptime_s": round(time.time() - _start_time, 3),
+        "python": sys.version.split()[0],
+        "argv": list(sys.argv),
+    }
+    try:
+        reg = default_registry()
+        out["metric_families"] = len(reg.collect())
+    except Exception:  # noqa: BLE001
+        pass
+    try:  # live serving servers (lazy — serving may not be imported)
+        serving_metrics = sys.modules.get("paddle_tpu.serving.metrics")
+        if serving_metrics is not None:
+            out["serving_servers"] = sorted(
+                serving_metrics.all_snapshots())
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        jax = sys.modules.get("jax")
+        if jax is not None:
+            out["jax"] = {"version": jax.__version__,
+                          "backend": jax.default_backend(),
+                          "device_count": jax.device_count()}
+    except Exception:  # noqa: BLE001
+        pass
+    return out
+
+
+# ---------------------------------------------------------------- server
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "paddle-tpu-telemetry/1.0"
+
+    def _send(self, code: int, body: str, ctype: str):
+        data = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler ABI
+        path, _, query = self.path.partition("?")
+        registry = self.server.registry  # type: ignore[attr-defined]
+        try:
+            if path == "/metrics":
+                want_json = ("format=json" in query or "application/json"
+                             in (self.headers.get("Accept") or ""))
+                if want_json:
+                    self._send(200, json_text(registry, indent=1),
+                               "application/json")
+                else:
+                    self._send(200, prometheus_text(registry),
+                               PROMETHEUS_CONTENT_TYPE)
+            elif path == "/healthz":
+                ok, detail = healthz()
+                self._send(200 if ok else 503,
+                           json.dumps(detail, indent=1, sort_keys=True),
+                           "application/json")
+            elif path == "/statusz":
+                self._send(200, json.dumps(_statusz(), indent=1,
+                                           sort_keys=True, default=str),
+                           "application/json")
+            elif path == "/":
+                self._send(200, "paddle-tpu telemetry\n"
+                                "/metrics  /healthz  /statusz\n",
+                           "text/plain; charset=utf-8")
+            else:
+                self._send(404, "not found\n",
+                           "text/plain; charset=utf-8")
+        except Exception as e:  # noqa: BLE001 - a scrape bug must never
+            try:                # kill the handler thread
+                self._send(500, f"internal error: {e!r}\n",
+                           "text/plain; charset=utf-8")
+            except Exception:  # noqa: BLE001
+                pass
+
+    def log_message(self, *args):  # silence per-request stderr noise
+        pass
+
+
+class TelemetryServer:
+    """Owns one ThreadingHTTPServer on a daemon thread. ``port=0`` binds
+    an ephemeral port; read the actual one back from ``.port``."""
+
+    def __init__(self, port: int = 0, host: str = "0.0.0.0",
+                 registry: Optional[MetricRegistry] = None):
+        self._requested_port = int(port)
+        self.host = host
+        self.registry = registry or default_registry()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def running(self) -> bool:
+        return self._httpd is not None
+
+    @property
+    def port(self) -> Optional[int]:
+        return self._httpd.server_address[1] if self._httpd else None
+
+    def url(self, path: str = "/metrics") -> str:
+        host = "127.0.0.1" if self.host in ("0.0.0.0", "::") else self.host
+        return f"http://{host}:{self.port}{path}"
+
+    def start(self) -> "TelemetryServer":
+        if self._httpd is not None:
+            return self
+        httpd = ThreadingHTTPServer((self.host, self._requested_port),
+                                    _Handler)
+        httpd.daemon_threads = True
+        httpd.registry = self.registry  # type: ignore[attr-defined]
+        self._httpd = httpd
+        self._thread = threading.Thread(
+            target=httpd.serve_forever, name="telemetry-http",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        t, self._thread = self._thread, None
+        if t is not None and t.is_alive():
+            t.join(timeout=5)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+_singleton_lock = threading.Lock()
+_singleton: Optional[TelemetryServer] = None
+
+
+def start_telemetry_server(port: Optional[int] = None,
+                           host: str = "0.0.0.0",
+                           registry: Optional[MetricRegistry] = None,
+                           install_collectors: bool = True
+                           ) -> TelemetryServer:
+    """Start (or return) the shared process-wide telemetry endpoint.
+    Default collectors — device memory, JAX compile events, profiler
+    span mirroring when ``FLAGS_profiler_span_metrics`` is on — are
+    installed on first start so a bare scrape already carries runtime
+    gauges."""
+    global _singleton
+    with _singleton_lock:
+        if _singleton is not None and _singleton.running:
+            return _singleton
+        srv = TelemetryServer(port=0 if port is None else int(port),
+                              host=host, registry=registry)
+        srv.start()
+        _singleton = srv
+    if install_collectors:
+        try:
+            from . import runtime
+            runtime.install_all(registry)
+        except Exception:  # noqa: BLE001 - collectors are best-effort;
+            pass           # the endpoint itself must come up regardless
+    return _singleton
+
+
+def get_telemetry_server() -> Optional[TelemetryServer]:
+    with _singleton_lock:
+        return _singleton
+
+
+def stop_telemetry_server():
+    global _singleton
+    with _singleton_lock:
+        srv, _singleton = _singleton, None
+    if srv is not None:
+        srv.stop()

@@ -1,0 +1,167 @@
+"""Runtime instrumentation feeding the registry: JAX compile events,
+live device memory, and profiler-span mirroring.
+
+Everything here is install-on-demand and idempotent — importing this
+module touches nothing heavy; ``install_all()`` (run by
+``start_telemetry_server``) wires the probes:
+
+- ``install_jax_monitoring``: a ``jax.monitoring`` listener pair
+  counting runtime events (``paddle_jax_events_total{event=}``) and
+  timing the durated ones — compilation first among them —
+  (``paddle_jax_event_duration_seconds{event=}``), the scrapeable
+  version of "how often and how long are we compiling";
+- ``install_device_memory_collector``: a scrape-time collector setting
+  ``paddle_device_memory_bytes{device=,stat=}`` from PJRT
+  ``memory_stats()`` where the runtime exposes it, falling back to the
+  live ``jax.Array`` set (framework.memory's estimator) on backends
+  that don't (CPU);
+- ``mirror_profiler_spans``: hooks the profiler's RecordEvent sink so
+  every host span ALSO lands in
+  ``paddle_profiler_span_ms{span=}`` — span timing in chrome traces and
+  scraped histograms then agree by construction.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .registry import MetricRegistry, default_registry
+
+__all__ = [
+    "install_jax_monitoring", "install_device_memory_collector",
+    "mirror_profiler_spans", "install_all",
+]
+
+_jax_monitoring_installed = False
+
+
+def install_jax_monitoring(registry: Optional[MetricRegistry] = None
+                           ) -> bool:
+    """Register jax.monitoring listeners (once per process). Returns
+    True when listeners are live, False when this jax build has no
+    monitoring hooks."""
+    global _jax_monitoring_installed
+    if _jax_monitoring_installed:
+        return True
+    reg = registry or default_registry()
+    try:
+        from jax import monitoring
+    except Exception:  # noqa: BLE001 - no monitoring in this jax build
+        return False
+    events = reg.counter(
+        "paddle_jax_events_total",
+        "jax.monitoring events by name (compilation cache activity, "
+        "backend init, ...)", ("event",))
+    durations = reg.histogram(
+        "paddle_jax_event_duration_seconds",
+        "durations of timed jax.monitoring events (jit compile/trace "
+        "time lives here)", ("event",),
+        buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
+                 30.0, 60.0, 120.0))
+
+    def _on_event(name, **kw):
+        try:
+            events.labels(event=str(name)).inc()
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _on_duration(name, secs, **kw):
+        try:
+            events.labels(event=str(name)).inc()
+            durations.labels(event=str(name)).observe(float(secs))
+        except Exception:  # noqa: BLE001
+            pass
+
+    try:
+        monitoring.register_event_listener(_on_event)
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:  # noqa: BLE001
+        return False
+    _jax_monitoring_installed = True
+    return True
+
+
+def _device_label(dev) -> str:
+    return f"{getattr(dev, 'platform', 'unknown')}:{getattr(dev, 'id', 0)}"
+
+
+def install_device_memory_collector(
+        registry: Optional[MetricRegistry] = None) -> bool:
+    """Scrape-time gauge of live device memory per device. PJRT stats
+    where available; the framework.memory live-array estimator (exact
+    current usage, observed peak) on backends without them."""
+    reg = registry or default_registry()
+    gauge = reg.gauge(
+        "paddle_device_memory_bytes",
+        "device memory by device and stat (bytes_in_use / "
+        "peak_bytes_in_use; live-array estimate on backends without "
+        "PJRT memory_stats)", ("device", "stat"))
+
+    def _collect(_reg):
+        import jax
+
+        from ..framework import memory as fmem
+        for dev in jax.devices():
+            label = _device_label(dev)
+            stats = dev.memory_stats() if hasattr(dev, "memory_stats") \
+                else None
+            if stats:
+                for key in ("bytes_in_use", "peak_bytes_in_use",
+                            "bytes_limit"):
+                    if key in stats:
+                        gauge.labels(device=label, stat=key).set(
+                            int(stats[key]))
+            else:
+                cur = fmem._live_bytes(dev)
+                gauge.labels(device=label, stat="bytes_in_use").set(cur)
+                peak = gauge.labels(device=label,
+                                    stat="peak_bytes_in_use")
+                peak.set(max(int(peak.value or 0), cur))
+
+    reg.register_collector(_collect, name="device_memory")
+    return True
+
+
+_span_histogram = None
+
+
+def mirror_profiler_spans(enable: bool = True,
+                          registry: Optional[MetricRegistry] = None
+                          ) -> bool:
+    """Route every profiler ``RecordEvent`` span duration into
+    ``paddle_profiler_span_ms{span=}`` so chrome-trace spans and scraped
+    metrics report the same timings. Spans mirror regardless of whether
+    a profiler session is recording — the sink is the registry, not the
+    tracer."""
+    global _span_histogram
+    from .. import profiler
+    if not enable:
+        profiler.set_span_sink(None)
+        return False
+    reg = registry or default_registry()
+    _span_histogram = reg.histogram(
+        "paddle_profiler_span_ms",
+        "host-tracer RecordEvent span durations (serving::assemble, "
+        "serving::dispatch, user spans, ...)", ("span",))
+
+    def _sink(name, dur_ms):
+        try:
+            _span_histogram.labels(span=str(name)).observe(dur_ms)
+        except Exception:  # noqa: BLE001
+            pass
+
+    profiler.set_span_sink(_sink)
+    return True
+
+
+def install_all(registry: Optional[MetricRegistry] = None):
+    """Everything a telemetry endpoint should carry by default.
+    Profiler-span mirroring is opt-in via FLAGS_profiler_span_metrics
+    (every RecordEvent takes the histogram path once enabled)."""
+    install_jax_monitoring(registry)
+    install_device_memory_collector(registry)
+    try:
+        from ..framework.flags import flag_value
+        if flag_value("FLAGS_profiler_span_metrics"):
+            mirror_profiler_spans(True, registry)
+    except Exception:  # noqa: BLE001
+        pass

@@ -1,0 +1,159 @@
+"""Training-step instrumentation: a hapi ``Model.fit`` callback and an
+``optimizer.step`` hook, both reporting into the metric registry.
+
+``TrainingTelemetryCallback`` is duck-typed against the hapi callback
+surface (it implements every ``on_*`` hook) rather than inheriting
+``hapi.callbacks.Callback``, so this module imports cleanly before the
+hapi package exists — observability sits below hapi in the import
+order. ``Model.fit`` injects it automatically when
+``FLAGS_training_telemetry`` is on; scripts can also add it explicitly
+to ``callbacks=[...]``.
+
+``instrument_optimizers()`` registers a step observer with
+``paddle_tpu.optimizer`` so every ``Optimizer.apply_gradients`` (the
+update half of ``step``) records its duration, parameter count, and
+current LR — covering raw training loops that never go through hapi.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from .registry import MetricRegistry, default_registry
+
+__all__ = ["TrainingTelemetryCallback", "instrument_optimizers",
+           "uninstrument_optimizers"]
+
+
+class TrainingTelemetryCallback:
+    """Records per-step training metrics from the fit loop:
+
+    - ``paddle_training_steps_total`` / ``paddle_training_epochs_total``
+    - ``paddle_training_step_ms`` histogram (bounded-window percentiles)
+    - ``paddle_training_loss`` gauge (last step's loss)
+    - ``paddle_training_examples_per_sec`` gauge when ``batch_size`` is
+      known (pass it to the constructor; the fit loop's loader owns it
+      and does not forward it through callback params).
+
+    ``now`` is injected for deterministic tests.
+    """
+
+    def __init__(self, registry: Optional[MetricRegistry] = None,
+                 batch_size: Optional[int] = None,
+                 now: Callable[[], float] = time.monotonic):
+        reg = registry or default_registry()
+        self._now = now
+        self.batch_size = batch_size
+        self._steps = reg.counter(
+            "paddle_training_steps_total", "optimizer steps seen by the "
+            "hapi fit loop")
+        self._epochs = reg.counter(
+            "paddle_training_epochs_total", "completed fit epochs")
+        self._step_ms = reg.histogram(
+            "paddle_training_step_ms", "wall time of one fit train step "
+            "(forward+backward+update)")
+        self._loss = reg.gauge(
+            "paddle_training_loss", "last training-step loss")
+        self._eps = reg.gauge(
+            "paddle_training_examples_per_sec",
+            "examples/sec from the last step (needs batch_size)")
+        self.model = None
+        self.params = {}
+        self._t0 = None
+
+    # -- hapi callback surface (duck-typed)
+    def set_model(self, model):
+        self.model = model
+
+    def set_params(self, params):
+        self.params = dict(params or {})
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._epochs.inc()
+
+    def on_train_batch_begin(self, step, logs=None):
+        self._t0 = self._now()
+
+    def on_train_batch_end(self, step, logs=None):
+        self._steps.inc()
+        if self._t0 is not None:
+            dt = self._now() - self._t0
+            self._t0 = None
+            self._step_ms.observe(dt * 1e3)
+            if self.batch_size and dt > 0:
+                self._eps.set(self.batch_size / dt)
+        loss = (logs or {}).get("loss")
+        if loss is not None:
+            try:
+                self._loss.set(float(loss))
+            except (TypeError, ValueError):
+                pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+    def on_eval_batch_begin(self, step, logs=None):
+        pass
+
+    def on_eval_batch_end(self, step, logs=None):
+        pass
+
+
+_optimizer_observer = None
+
+
+def instrument_optimizers(registry: Optional[MetricRegistry] = None
+                          ) -> bool:
+    """Hook every Optimizer.apply_gradients in the process. Idempotent;
+    returns True once the observer is registered."""
+    global _optimizer_observer
+    if _optimizer_observer is not None:
+        return True
+    reg = registry or default_registry()
+    steps = reg.counter(
+        "paddle_optimizer_steps_total",
+        "optimizer update calls (apply_gradients)", ("optimizer",))
+    step_ms = reg.histogram(
+        "paddle_optimizer_step_ms",
+        "wall time of one optimizer update", ("optimizer",))
+    lr_gauge = reg.gauge(
+        "paddle_optimizer_lr", "current learning rate", ("optimizer",))
+    params_gauge = reg.gauge(
+        "paddle_optimizer_params",
+        "parameter tensors updated by the last step", ("optimizer",))
+
+    def _observer(opt, duration_s, n_params):
+        name = type(opt).__name__
+        steps.labels(optimizer=name).inc()
+        step_ms.labels(optimizer=name).observe(duration_s * 1e3)
+        params_gauge.labels(optimizer=name).set(n_params)
+        try:
+            lr_gauge.labels(optimizer=name).set(float(opt.get_lr()))
+        except Exception:  # noqa: BLE001 - LR is best-effort garnish
+            pass
+
+    from ..optimizer import optimizer as opt_mod
+    opt_mod.register_step_observer(_observer)
+    _optimizer_observer = _observer
+    return True
+
+
+def uninstrument_optimizers():
+    global _optimizer_observer
+    if _optimizer_observer is None:
+        return
+    from ..optimizer import optimizer as opt_mod
+    opt_mod.unregister_step_observer(_optimizer_observer)
+    _optimizer_observer = None

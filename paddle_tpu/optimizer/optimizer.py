@@ -10,6 +10,7 @@ eager and compiled training.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Dict, List, Optional
 
 import jax
@@ -18,6 +19,23 @@ import jax.numpy as jnp
 from ..core.autograd import no_grad
 from ..core.tensor import Tensor
 from .lr import LRScheduler
+
+# Step observers: ``fn(optimizer, duration_s, n_params)`` called after
+# every apply_gradients. The observability layer registers here
+# (training.instrument_optimizers) so raw training loops — not just
+# hapi fit — feed step metrics; zero overhead while the list is empty.
+_step_observers: List = []
+
+
+def register_step_observer(fn):
+    if fn not in _step_observers:
+        _step_observers.append(fn)
+    return fn
+
+
+def unregister_step_observer(fn):
+    if fn in _step_observers:
+        _step_observers.remove(fn)
 
 
 class Optimizer:
@@ -98,6 +116,7 @@ class Optimizer:
         Used by ``step`` and by static-mode ``Executor.run`` replaying a
         ``minimize``d Program (reference: apply_gradients,
         /root/reference/python/paddle/optimizer/optimizer.py:969)."""
+        t0 = time.perf_counter() if _step_observers else None
         if self._grad_clip is not None:
             params_grads = self._grad_clip(params_grads)
         lr_val = self.get_lr()
@@ -129,6 +148,13 @@ class Optimizer:
             p._data = new_p
             for name in self._accum_names:
                 self._set_accum(name, p, new_state[name])
+        if t0 is not None:
+            dt = time.perf_counter() - t0
+            for fn in list(_step_observers):
+                try:
+                    fn(self, dt, len(params_grads))
+                except Exception:  # noqa: BLE001 - telemetry must never
+                    pass           # fail the update it observes
 
     def _decoupled_wd(self):
         return False

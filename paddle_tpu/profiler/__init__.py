@@ -119,6 +119,19 @@ class _HostTracer:
 
 _tracer = _HostTracer()
 
+# Optional span sink: when set (observability.mirror_profiler_spans),
+# every RecordEvent duration is ALSO fed to it — the bridge that keeps
+# chrome-trace span timing and scraped /metrics histograms in agreement.
+_span_sink = None
+
+
+def set_span_sink(fn):
+    """``fn(name, duration_ms)`` called at every RecordEvent end (None
+    to detach). The sink runs outside the tracer's enabled gate: spans
+    mirror into metrics whether or not a profiler session is recording."""
+    global _span_sink
+    _span_sink = fn
+
 
 class RecordEvent:
     """Span marker usable as context manager or begin/end pair — same surface
@@ -153,8 +166,15 @@ class RecordEvent:
             self._jax_ctx.__exit__(None, None, None)
             self._jax_ctx = None
         if self._start is not None:
-            _tracer.add(self.name, self._start, time.perf_counter_ns(),
+            end = time.perf_counter_ns()
+            _tracer.add(self.name, self._start, end,
                         threading.get_ident(), self.args)
+            sink = _span_sink
+            if sink is not None:
+                try:
+                    sink(self.name, (end - self._start) / 1e6)
+                except Exception:  # noqa: BLE001 - telemetry must never
+                    pass           # fail the instrumented code path
 
     def __enter__(self):
         self.begin()

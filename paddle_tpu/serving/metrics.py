@@ -1,14 +1,20 @@
 """Serving observability: per-server metrics + a named registry.
 
-Wired into the rest of the stack rather than freestanding:
+Backed by the unified telemetry layer (``paddle_tpu.observability``)
+rather than freestanding counters: every recording lands in typed
+metric families on the process-wide registry, so a scraped ``/metrics``
+page (see ``FLAGS_serving_telemetry_port``) shows serving traffic in
+Prometheus text format —
 
-- every counter bump mirrors into ``framework.monitor`` (the reference's
-  STAT_ADD int64 registry, platform/monitor.cc) under a
-  ``serving_<server>_*`` name, so existing monitor consumers see serving
-  traffic alongside the framework's other stats;
-- batch executions are wrapped in ``profiler.RecordEvent`` spans by the
-  server, so the host tracer's chrome export shows serving batches on
-  the timeline.
+    paddle_serving_requests_total{server="default",event="completed"}
+    paddle_serving_latency_ms_bucket{server="default",le="25"}
+    paddle_serving_stage_ms_bucket{server="default",stage="host",...}
+    paddle_serving_compile_total{server="default",result="miss"}
+
+— while ``snapshot()`` keeps the historical JSON schema byte-for-byte
+(below). Counter bumps still mirror into ``framework.monitor`` (itself
+a Counter view now) under ``serving_<server>_*`` names, and batch
+executions are wrapped in ``profiler.RecordEvent`` spans by the server.
 
 Schema (``snapshot()`` / ``to_json()``)::
 
@@ -32,14 +38,21 @@ compute finishes), ``fetch`` (device->host transfer). ``host`` =
 assembly+dispatch+fetch, ``device`` = device_wait, and
 ``host_fraction`` is sum(host)/sum(host+device) over the window — the
 continuously measured version of PERF.md's "~95% host overhead" claim.
+
+Percentiles come from ``observability.PercentileWindow`` (bounded
+window of the ``window`` most recent samples, nearest-rank estimator —
+the same class the registry's Histogram uses), so a long-running
+server's percentiles track current behavior, not its whole life.
 """
 from __future__ import annotations
 
 import json
-import math
 import threading
-from collections import deque
-from typing import Dict, List, Optional
+from typing import Dict, Optional
+
+from ..framework import monitor
+from ..observability.registry import (PercentileWindow, _nearest_rank,
+                                      default_registry)
 
 __all__ = ["ServingMetrics", "register", "get", "unregister",
            "all_snapshots"]
@@ -47,44 +60,118 @@ __all__ = ["ServingMetrics", "register", "get", "unregister",
 _COUNTERS = ("submitted", "completed", "rejected", "timed_out",
              "cancelled", "failed", "batches")
 
+_STAGES = ("assembly", "dispatch", "device_wait", "fetch", "host",
+           "device")
 
-def _percentile(sorted_vals: List[float], q: float) -> float:
-    """Nearest-rank percentile over an already-sorted sample."""
-    if not sorted_vals:
-        return 0.0
-    k = max(0, min(len(sorted_vals) - 1,
-                   math.ceil(q / 100.0 * len(sorted_vals)) - 1))
-    return float(sorted_vals[k])
+_ROW_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+
+
+def _percentile(sorted_vals, q: float) -> float:
+    """Nearest-rank percentile over an already-sorted sample (kept for
+    callers of the pre-registry module surface; the shared
+    implementation lives in observability.registry)."""
+    return _nearest_rank(sorted_vals, q)
 
 
 class ServingMetrics:
-    """Thread-safe metric sink for one server. Latency keeps a bounded
-    window (``window`` most recent request latencies) so a long-running
-    server's percentiles track current behavior, not its whole life."""
+    """Thread-safe metric sink for one server, backed by registry
+    families. Instantiating a name resets that server's label slice in
+    the shared families (a restarted server starts from zero, matching
+    the pre-registry behavior)."""
 
-    def __init__(self, name: str = "default", window: int = 2048):
+    def __init__(self, name: str = "default", window: int = 2048,
+                 registry=None):
         self.name = name
         self._lock = threading.Lock()
-        self._counters: Dict[str, int] = {c: 0 for c in _COUNTERS}
+        reg = self._registry = registry or default_registry()
+
+        self._f_events = reg.counter(
+            "paddle_serving_requests_total",
+            "serving request lifecycle events per server",
+            ("server", "event"))
+        self._f_latency = reg.histogram(
+            "paddle_serving_latency_ms",
+            "end-to-end request latency (submit -> future resolved)",
+            ("server",))
+        self._f_stage = reg.histogram(
+            "paddle_serving_stage_ms",
+            "per-batch pipeline stage durations (host = assembly+"
+            "dispatch+fetch, device = device_wait)",
+            ("server", "stage"))
+        self._f_batch_rows = reg.histogram(
+            "paddle_serving_batch_rows",
+            "real rows per coalesced device batch", ("server",),
+            buckets=_ROW_BUCKETS)
+        self._f_queue = reg.gauge(
+            "paddle_serving_queue_depth", "current request-queue depth",
+            ("server",))
+        self._f_capacity = reg.gauge(
+            "paddle_serving_queue_capacity", "bounded queue capacity",
+            ("server",))
+        self._f_peak = reg.gauge(
+            "paddle_serving_queue_peak_depth",
+            "highest queue depth observed", ("server",))
+        self._f_padding = reg.counter(
+            "paddle_serving_padding_elements_total",
+            "input elements by kind: real (caller-supplied) vs padded "
+            "(elements the bucketed device batch actually carries)",
+            ("server", "kind"))
+        self._f_compile = reg.counter(
+            "paddle_serving_compile_total",
+            "serving compile-cache lookups by result",
+            ("server", "result"))
+        self._f_signatures = reg.gauge(
+            "paddle_serving_compile_signatures",
+            "distinct compiled (signature, padded_rows) entries",
+            ("server",))
+
+        # a fresh ServingMetrics owns its server's slice from zero
+        for fam in (self._f_events, self._f_latency, self._f_stage,
+                    self._f_batch_rows, self._f_queue, self._f_capacity,
+                    self._f_peak, self._f_padding, self._f_compile,
+                    self._f_signatures):
+            fam.clear(server=name)
+
+        self._events = {c: self._f_events.labels(server=name, event=c)
+                        for c in _COUNTERS}
+        self._h_latency = self._f_latency.labels(server=name)
+        self._h_stages = {s: self._f_stage.labels(server=name, stage=s)
+                          for s in _STAGES}
+        self._h_batch_rows = self._f_batch_rows.labels(server=name)
+        self._c_real = self._f_padding.labels(server=name, kind="real")
+        self._c_padded = self._f_padding.labels(server=name,
+                                                kind="padded")
+        self._c_hits = self._f_compile.labels(server=name, result="hit")
+        self._c_misses = self._f_compile.labels(server=name,
+                                                result="miss")
+
+        # bounded windows for the snapshot percentiles (per instance so
+        # each server honors ITS window size; the family windows back
+        # the shared /metrics exposition)
+        self._latency = PercentileWindow(int(window))
+        self._stages = {k: PercentileWindow(int(window))
+                        for k in _STAGES}
         self._batch_hist: Dict[int, int] = {}
-        self._latency = deque(maxlen=int(window))
         self._queue_depth = 0
         self._queue_capacity = 0
         self._peak_depth = 0
         self._real_elements = 0
         self._padded_elements = 0
-        self._compile_hits = 0
-        self._compile_misses = 0
         self._signatures = set()
-        self._stages = {k: deque(maxlen=int(window))
-                        for k in ("assembly", "dispatch", "device_wait",
-                                  "fetch", "host", "device")}
 
     # ---- recording ----
+    def _event_child(self, name: str):
+        child = self._events.get(name)
+        if child is None:
+            with self._lock:
+                child = self._events.get(name)
+                if child is None:
+                    child = self._events[name] = self._f_events.labels(
+                        server=self.name, event=name)
+        return child
+
     def count(self, name: str, n: int = 1):
-        from ..framework import monitor
-        with self._lock:
-            self._counters[name] = self._counters.get(name, 0) + n
+        self._event_child(name).inc(n)
         monitor.stat_add(f"serving_{self.name}_{name}", n)
 
     def queue_depth(self, depth: int, capacity: int):
@@ -92,60 +179,77 @@ class ServingMetrics:
             self._queue_depth = depth
             self._queue_capacity = capacity
             self._peak_depth = max(self._peak_depth, depth)
+            peak = self._peak_depth
+        self._f_queue.labels(server=self.name).set(depth)
+        self._f_capacity.labels(server=self.name).set(capacity)
+        self._f_peak.labels(server=self.name).set(peak)
 
     def observe_batch(self, rows: int, real_elements: int,
                       padded_elements: int):
-        from ..framework import monitor
         with self._lock:
-            self._counters["batches"] += 1
             self._batch_hist[rows] = self._batch_hist.get(rows, 0) + 1
             self._real_elements += real_elements
             self._padded_elements += padded_elements
+        self._events["batches"].inc()
+        self._h_batch_rows.observe(rows)
+        self._c_real.inc(real_elements)
+        self._c_padded.inc(padded_elements)
         monitor.stat_add(f"serving_{self.name}_batches", 1)
 
     def observe_latency(self, ms: float):
         with self._lock:
-            self._latency.append(float(ms))
+            self._latency.observe(float(ms))
+        self._h_latency.observe(ms)
 
     def observe_latency_many(self, ms_list):
         """Bulk latency append: one lock acquisition per batch instead
         of one per request (the completion stage resolves whole batches
         at a time)."""
+        ms_list = [float(m) for m in ms_list]
         with self._lock:
-            self._latency.extend(float(m) for m in ms_list)
+            self._latency.extend(ms_list)
+        self._h_latency.observe_many(ms_list)
 
     def observe_stage_times(self, assembly_ms: float, dispatch_ms: float,
                             device_wait_ms: float, fetch_ms: float):
         """Per-batch pipeline stage durations; host = everything the
         host CPU did (assembly + dispatch + fetch), device = time spent
         waiting on device compute."""
+        vals = {"assembly": float(assembly_ms),
+                "dispatch": float(dispatch_ms),
+                "device_wait": float(device_wait_ms),
+                "fetch": float(fetch_ms),
+                "host": float(assembly_ms + dispatch_ms + fetch_ms),
+                "device": float(device_wait_ms)}
         with self._lock:
-            self._stages["assembly"].append(float(assembly_ms))
-            self._stages["dispatch"].append(float(dispatch_ms))
-            self._stages["device_wait"].append(float(device_wait_ms))
-            self._stages["fetch"].append(float(fetch_ms))
-            self._stages["host"].append(
-                float(assembly_ms + dispatch_ms + fetch_ms))
-            self._stages["device"].append(float(device_wait_ms))
+            for k, v in vals.items():
+                self._stages[k].observe(v)
+        for k, v in vals.items():
+            self._h_stages[k].observe(v)
 
     def observe_compile(self, hit: bool, signature=None):
-        with self._lock:
-            if hit:
-                self._compile_hits += 1
-            else:
-                self._compile_misses += 1
-                if signature is not None:
-                    self._signatures.add(signature)
+        if hit:
+            self._c_hits.inc()
+            return
+        self._c_misses.inc()
+        if signature is not None:
+            with self._lock:
+                self._signatures.add(signature)
+                n = len(self._signatures)
+            self._f_signatures.labels(server=self.name).set(n)
 
     # ---- export ----
     def snapshot(self) -> dict:
         with self._lock:
-            lat = sorted(self._latency)
+            counters = {c: 0 for c in _COUNTERS}
+            counters.update({ev: int(child.value)
+                             for ev, child in self._events.items()})
             padded = self._padded_elements
             real = self._real_elements
+            lat = self._latency.snapshot()
             return {
                 "server": self.name,
-                "counters": dict(self._counters),
+                "counters": counters,
                 "queue": {"depth": self._queue_depth,
                           "capacity": self._queue_capacity,
                           "peak_depth": self._peak_depth},
@@ -156,15 +260,10 @@ class ServingMetrics:
                     "padded_elements": padded,
                     "waste_ratio": (padded - real) / padded if padded
                     else 0.0},
-                "latency_ms": {
-                    "count": len(lat),
-                    "p50": _percentile(lat, 50),
-                    "p95": _percentile(lat, 95),
-                    "p99": _percentile(lat, 99),
-                    "max": lat[-1] if lat else 0.0},
+                "latency_ms": lat,
                 "stage_ms": self._stage_snapshot(),
-                "compile_cache": {"hits": self._compile_hits,
-                                  "misses": self._compile_misses,
+                "compile_cache": {"hits": int(self._c_hits.value),
+                                  "misses": int(self._c_misses.value),
                                   "signatures": len(self._signatures)},
             }
 
@@ -172,13 +271,11 @@ class ServingMetrics:
         """Per-stage percentiles + host fraction (lock held)."""
         out = {"count": len(self._stages["host"])}
         for name, window in self._stages.items():
-            vals = sorted(window)
-            out[name] = {"p50": _percentile(vals, 50),
-                         "p95": _percentile(vals, 95),
-                         "p99": _percentile(vals, 99),
-                         "max": vals[-1] if vals else 0.0}
-        host = sum(self._stages["host"])
-        device = sum(self._stages["device"])
+            snap = window.snapshot()
+            snap.pop("count")
+            out[name] = snap
+        host = self._stages["host"].sum()
+        device = self._stages["device"].sum()
         out["host_fraction"] = host / (host + device) \
             if host + device else 0.0
         return out

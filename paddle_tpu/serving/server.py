@@ -138,6 +138,7 @@ class InferenceServer:
                  seq_axis: int = 1, name: str = "default",
                  pipeline_depth: Optional[int] = None,
                  donate_inputs: Optional[bool] = None,
+                 telemetry_port: Optional[int] = None,
                  start: bool = True):
         self.predictor = predictor
         self.max_batch_size = int(max_batch_size if max_batch_size
@@ -178,8 +179,36 @@ class InferenceServer:
         self._loop_running = False      # a thread is inside _loop
         self._compiled = set()          # signatures already executed
         self._lock = threading.Lock()
+        self.telemetry = self._attach_telemetry(telemetry_port)
         if start:
             self.start()
+
+    def _attach_telemetry(self, telemetry_port: Optional[int]):
+        """Attach the shared observability endpoint (/metrics /healthz
+        /statusz). Port -1 = off (the flag default), 0 = ephemeral,
+        >0 = fixed. The endpoint is process-wide and outlives this
+        server — the registry it exposes aggregates every subsystem —
+        so shutdown() deregisters only this server's health check."""
+        port = telemetry_port if telemetry_port is not None \
+            else _flag("FLAGS_serving_telemetry_port", -1)
+        if port is None or int(port) < 0:
+            return None
+        from .. import observability
+        srv = observability.start_telemetry_server(port=int(port))
+        observability.add_health_check(
+            f"serving:{self.metrics.name}", self._health)
+        return srv
+
+    def _health(self):
+        """Healthy while accepting traffic: not shut down, and if the
+        worker was ever started it must still be alive."""
+        if self._closed:
+            return False, "shut down"
+        w = self._worker
+        if w is not None and not w.is_alive() and not self._loop_running:
+            return False, "worker thread died"
+        return True, {"queue_depth": self.queue_depth,
+                      "inflight_batches": self.inflight_batches}
 
     # ------------------------------------------------------ lifecycle
     def start(self):
@@ -236,6 +265,9 @@ class InferenceServer:
                     (deadline is None or time.monotonic() < deadline):
                 time.sleep(0.005)  # wait out a serve_forever drain
         self._stop_completion(timeout)
+        if self.telemetry is not None:
+            from ..observability import remove_health_check
+            remove_health_check(f"serving:{self.metrics.name}")
         metrics_mod.unregister(self.metrics.name)
 
     def __enter__(self):

@@ -1,0 +1,486 @@
+"""paddle_tpu.observability — the unified telemetry layer (ISSUE 3).
+
+Covers the acceptance surface: registry types (Counter/Gauge/Histogram)
+with label sets, the bounded-window percentile estimator, Prometheus
+and JSON exposition, the HTTP endpoint (/metrics /healthz /statusz),
+the framework.monitor Counter view, serving-schema preservation, the
+training-step callback, the optimizer step hook, JAX runtime probes,
+and profiler span mirroring — plus the live-InferenceServer scrape the
+issue names verbatim.
+"""
+import json
+import math
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import inference, observability, serving
+from paddle_tpu.observability import (Counter, Gauge, Histogram,
+                                      MetricRegistry, PercentileWindow,
+                                      TelemetryServer, json_snapshot,
+                                      prometheus_text)
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read().decode(), dict(r.headers)
+
+
+# ------------------------------------------------------------- registry
+class TestRegistryTypes:
+    def test_counter_inc_and_value(self):
+        reg = MetricRegistry()
+        c = reg.counter("t_requests_total", "help text")
+        assert c.inc() == 1
+        assert c.inc(4) == 5
+        assert c.value == 5
+
+    def test_counter_labels_are_distinct_children(self):
+        reg = MetricRegistry()
+        c = reg.counter("t_evt", "", ("server", "event"))
+        c.labels(server="a", event="ok").inc(2)
+        c.labels(server="a", event="err").inc()
+        c.labels(server="b", event="ok").inc(7)
+        assert c.labels(server="a", event="ok").value == 2
+        assert c.labels(server="b", event="ok").value == 7
+        assert len(c.label_values()) == 3
+
+    def test_label_validation(self):
+        reg = MetricRegistry()
+        c = reg.counter("t_lbl", "", ("x",))
+        with pytest.raises(ValueError):
+            c.labels(y="1")
+        with pytest.raises(ValueError):
+            c.labels("a", "b")
+        with pytest.raises(ValueError):
+            reg.counter("t_lbl", "", ("x", "y"))  # labelset conflict
+
+    def test_type_conflict_raises(self):
+        reg = MetricRegistry()
+        reg.counter("t_dup", "")
+        with pytest.raises(ValueError):
+            reg.gauge("t_dup", "")
+
+    def test_get_never_creates(self):
+        reg = MetricRegistry()
+        c = reg.counter("t_probe", "", ("name",))
+        assert c.get(name="missing") is None
+        assert c.label_values() == []
+
+    def test_clear_partial_labels(self):
+        reg = MetricRegistry()
+        c = reg.counter("t_clear", "", ("server", "event"))
+        c.labels(server="a", event="x").inc()
+        c.labels(server="a", event="y").inc()
+        c.labels(server="b", event="x").inc()
+        c.clear(server="a")
+        assert [k for k in c.label_values()] == [("b", "x")]
+
+    def test_gauge_set_inc_dec_and_function(self):
+        reg = MetricRegistry()
+        g = reg.gauge("t_gauge", "")
+        g.set(10)
+        g.inc(5)
+        g.dec(2)
+        assert g.value == 13
+        g.set_function(lambda: 42)
+        assert g.value == 42
+        broken = reg.gauge("t_broken", "")
+        broken.set_function(lambda: 1 / 0)
+        assert math.isnan(broken.value)  # broken probe never raises
+
+    def test_histogram_buckets_cumulative(self):
+        reg = MetricRegistry()
+        h = reg.histogram("t_hist", "", buckets=(1, 5, 10))
+        for v in (0.5, 1.0, 3, 7, 100):
+            h.observe(v)
+        child = h.labels()
+        buckets = dict(child.buckets())
+        assert buckets[1.0] == 2        # le semantics: 1.0 lands in le=1
+        assert buckets[5.0] == 3
+        assert buckets[10.0] == 4
+        assert buckets[float("inf")] == 5 == child.count
+        assert child.sum == pytest.approx(111.5)
+
+    def test_idempotent_get_or_create(self):
+        reg = MetricRegistry()
+        assert reg.counter("t_same", "") is reg.counter("t_same", "")
+
+    def test_invalid_metric_name(self):
+        with pytest.raises(ValueError):
+            Counter("has spaces", "")
+        assert observability.sanitize_metric_name(
+            "serving span (ms)") == "serving_span__ms_"
+        assert observability.sanitize_metric_name(
+            "serving::assemble") == "serving::assemble"  # ':' is legal
+
+
+class TestPercentileWindow:
+    def test_nearest_rank_matches_serving_estimator(self):
+        from paddle_tpu.serving.metrics import _percentile
+        vals = sorted(np.random.RandomState(0).rand(100).tolist())
+        w = PercentileWindow(maxlen=1000)
+        w.extend(vals)
+        for q in (50, 95, 99):
+            assert w.percentile(q) == _percentile(vals, q)
+
+    def test_maxlen_bound(self):
+        w = PercentileWindow(maxlen=4)
+        w.extend(range(10))
+        assert w.values() == [6.0, 7.0, 8.0, 9.0]
+
+    def test_max_age_prunes_with_injected_clock(self):
+        t = [0.0]
+        w = PercentileWindow(maxlen=100, max_age_s=10, now=lambda: t[0])
+        w.observe(1)
+        t[0] = 5.0
+        w.observe(2)
+        t[0] = 11.0  # first sample is now 11s old
+        assert w.values() == [2.0]
+        assert len(w) == 1
+
+    def test_snapshot_schema(self):
+        w = PercentileWindow()
+        w.extend([1, 2, 3])
+        snap = w.snapshot()
+        assert set(snap) == {"count", "p50", "p95", "p99", "max"}
+        assert snap["count"] == 3 and snap["max"] == 3.0
+
+
+# ----------------------------------------------------------- exposition
+class TestExposition:
+    def _reg(self):
+        reg = MetricRegistry()
+        c = reg.counter("exp_total", "a counter", ("kind",))
+        c.labels(kind='we"ird\nname').inc(3)
+        reg.gauge("exp_gauge", "a gauge").set(1.5)
+        reg.histogram("exp_ms", "a histogram",
+                      buckets=(1, 10)).observe(4)
+        return reg
+
+    def test_prometheus_text_format(self):
+        text = prometheus_text(self._reg())
+        assert "# HELP exp_total a counter" in text
+        assert "# TYPE exp_total counter" in text
+        assert 'exp_total{kind="we\\"ird\\nname"} 3' in text
+        assert "# TYPE exp_gauge gauge" in text
+        assert "exp_gauge 1.5" in text
+        assert "# TYPE exp_ms histogram" in text
+        assert 'exp_ms_bucket{le="1"} 0' in text
+        assert 'exp_ms_bucket{le="10"} 1' in text
+        assert 'exp_ms_bucket{le="+Inf"} 1' in text
+        assert "exp_ms_sum 4" in text
+        assert "exp_ms_count 1" in text
+
+    def test_json_snapshot(self):
+        snap = json_snapshot(self._reg())
+        assert snap["exp_total"]["type"] == "counter"
+        assert snap["exp_total"]["samples"][0]["value"] == 3
+        hist = snap["exp_ms"]["samples"][0]
+        assert hist["count"] == 1 and hist["buckets"]["+Inf"] == 1
+        assert hist["window"]["p50"] == 4.0
+        json.dumps(snap)  # fully serializable
+
+    def test_collector_runs_at_scrape(self):
+        reg = MetricRegistry()
+        g = reg.gauge("exp_pull", "")
+
+        reg.register_collector(lambda r: g.set(7), name="pull7")
+        assert "exp_pull 7" in prometheus_text(reg)
+        reg.register_collector(lambda r: 1 / 0, name="broken")
+        assert "exp_pull 7" in prometheus_text(reg)  # survives a bad probe
+
+
+# ----------------------------------------------------------------- http
+class TestTelemetryEndpoint:
+    @pytest.fixture()
+    def server(self):
+        reg = MetricRegistry()
+        reg.counter("http_hits_total", "hits").inc(9)
+        srv = TelemetryServer(port=0, registry=reg)
+        srv.start()
+        yield srv
+        srv.stop()
+
+    def test_metrics_prometheus(self, server):
+        status, body, headers = _get(server.url("/metrics"))
+        assert status == 200
+        assert headers["Content-Type"].startswith("text/plain")
+        assert "http_hits_total 9" in body
+
+    def test_metrics_json(self, server):
+        status, body, _ = _get(server.url("/metrics?format=json"))
+        assert status == 200
+        assert json.loads(body)["http_hits_total"]["samples"][0][
+            "value"] == 9
+
+    def test_healthz_ok_and_unhealthy(self, server):
+        status, body, _ = _get(server.url("/healthz"))
+        assert status == 200 and json.loads(body)["status"] == "ok"
+        observability.add_health_check("t_fail", lambda: (False, "boom"))
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _get(server.url("/healthz"))
+            assert exc.value.code == 503
+            detail = json.loads(exc.value.read())
+            assert detail["checks"]["t_fail"] == {"ok": False,
+                                                  "info": "boom"}
+        finally:
+            observability.remove_health_check("t_fail")
+        status, _, _ = _get(server.url("/healthz"))
+        assert status == 200
+
+    def test_healthz_raising_probe_is_unhealthy(self, server):
+        observability.add_health_check("t_raise",
+                                       lambda: 1 / 0)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _get(server.url("/healthz"))
+            assert exc.value.code == 503
+        finally:
+            observability.remove_health_check("t_raise")
+
+    def test_statusz(self, server):
+        status, body, _ = _get(server.url("/statusz"))
+        sz = json.loads(body)
+        assert status == 200 and sz["pid"] > 0 and "uptime_s" in sz
+
+    def test_404(self, server):
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(server.url("/nope"))
+        assert exc.value.code == 404
+
+
+# ------------------------------------------------------- monitor view
+class TestMonitorView:
+    def test_stats_surface_on_default_registry(self):
+        from paddle_tpu.framework import monitor
+        monitor.stat_reset()
+        monitor.stat_add("t_obs_stat", 11)
+        assert monitor.stat_get("t_obs_stat") == 11
+        assert monitor.stats_snapshot()["t_obs_stat"] == 11
+        text = prometheus_text(observability.default_registry())
+        assert 'paddle_monitor_stat{name="t_obs_stat"} 11' in text
+        monitor.stat_reset("t_obs_stat")
+        assert monitor.stat_get("t_obs_stat") == 0
+        assert "t_obs_stat" not in monitor.stats_snapshot()
+
+    def test_stat_get_does_not_mint_series(self):
+        from paddle_tpu.framework import monitor
+        monitor.stat_reset()
+        assert monitor.stat_get("t_never_written") == 0
+        assert "t_never_written" not in monitor.stat_names()
+
+
+# --------------------------------------------------- serving metrics
+class TestServingMetricsOnRegistry:
+    def test_snapshot_schema_preserved(self):
+        reg = MetricRegistry()
+        m = serving.ServingMetrics("t_schema", window=16, registry=reg)
+        m.count("submitted", 3)
+        m.count("completed", 2)
+        m.queue_depth(2, 8)
+        m.observe_batch(4, real_elements=30, padded_elements=32)
+        m.observe_latency_many([1.0, 2.0, 3.0])
+        m.observe_stage_times(1.0, 0.5, 2.0, 0.5)
+        m.observe_compile(hit=False, signature="sig1")
+        m.observe_compile(hit=True)
+        snap = m.snapshot()
+        assert set(snap) == {"server", "counters", "queue",
+                             "batch_size_hist", "padding", "latency_ms",
+                             "stage_ms", "compile_cache"}
+        assert set(snap["counters"]) >= {"submitted", "completed",
+                                         "rejected", "timed_out",
+                                         "cancelled", "failed",
+                                         "batches"}
+        assert snap["counters"]["submitted"] == 3
+        assert snap["counters"]["batches"] == 1
+        assert snap["queue"] == {"depth": 2, "capacity": 8,
+                                 "peak_depth": 2}
+        assert snap["batch_size_hist"] == {"4": 1}
+        assert snap["padding"]["waste_ratio"] == pytest.approx(2 / 32)
+        assert snap["latency_ms"]["count"] == 3
+        assert snap["latency_ms"]["p50"] == 2.0
+        assert snap["stage_ms"]["host"]["p50"] == 2.0
+        assert snap["stage_ms"]["host_fraction"] == pytest.approx(0.5)
+        assert snap["compile_cache"] == {"hits": 1, "misses": 1,
+                                         "signatures": 1}
+
+    def test_exposed_in_prometheus_text(self):
+        reg = MetricRegistry()
+        m = serving.ServingMetrics("t_prom", registry=reg)
+        m.count("completed", 5)
+        m.observe_latency(12.5)
+        text = prometheus_text(reg)
+        assert ('paddle_serving_requests_total{event="completed",'
+                'server="t_prom"} 5') in text
+        assert 'paddle_serving_latency_ms_bucket{le="25",server="t_prom"} 1' \
+            in text
+
+    def test_reinstantiation_resets_server_slice(self):
+        reg = MetricRegistry()
+        m1 = serving.ServingMetrics("t_reset", registry=reg)
+        m1.count("completed", 99)
+        m2 = serving.ServingMetrics("t_reset", registry=reg)
+        assert m2.snapshot()["counters"]["completed"] == 0
+
+
+# ----------------------------------------------------- training hooks
+class TestTrainingTelemetry:
+    def test_fit_callback_records_step_metrics(self):
+        reg = MetricRegistry()
+        t = [100.0]
+        cb = observability.TrainingTelemetryCallback(
+            registry=reg, batch_size=32, now=lambda: t[0])
+        for step, loss in enumerate([0.5, 0.25]):
+            cb.on_train_batch_begin(step)
+            t[0] += 0.010                      # a 10ms step
+            cb.on_train_batch_end(step, {"loss": loss})
+        cb.on_epoch_end(0)
+        assert reg.get("paddle_training_steps_total").labels().value == 2
+        assert reg.get("paddle_training_epochs_total").labels().value == 1
+        assert reg.get("paddle_training_loss").labels().value == 0.25
+        hist = reg.get("paddle_training_step_ms").labels()
+        assert hist.count == 2
+        assert hist.percentile(50) == pytest.approx(10.0)
+        assert reg.get("paddle_training_examples_per_sec"
+                       ).labels().value == pytest.approx(3200.0)
+
+    def test_callback_is_hapi_compatible(self):
+        from paddle_tpu.hapi.callbacks import CallbackList
+        cb = observability.TrainingTelemetryCallback(
+            registry=MetricRegistry())
+        clist = CallbackList([cb])
+        clist.set_params({"epochs": 1})
+        clist.on_train_begin()
+        clist.on_train_batch_begin(0)
+        clist.on_train_batch_end(0, {"loss": 1.0})
+        clist.on_eval_begin()
+        clist.on_eval_end()
+        clist.on_train_end()
+
+    def test_flag_injects_callback_into_fit_config(self):
+        from paddle_tpu.hapi.callbacks import config_callbacks
+        from paddle_tpu.observability.training import \
+            TrainingTelemetryCallback
+        paddle.set_flags({"FLAGS_training_telemetry": True})
+        try:
+            clist = config_callbacks(verbose=0)
+            assert any(isinstance(c, TrainingTelemetryCallback)
+                       for c in clist.callbacks)
+        finally:
+            paddle.set_flags({"FLAGS_training_telemetry": False})
+        clist = config_callbacks(verbose=0)
+        assert not any(isinstance(c, TrainingTelemetryCallback)
+                       for c in clist.callbacks)
+
+    def test_optimizer_step_hook(self):
+        reg = MetricRegistry()
+        observability.instrument_optimizers(reg)
+        try:
+            w = paddle.create_parameter([2, 2], "float32")
+            opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                       parameters=[w])
+            for _ in range(3):
+                loss = paddle.sum(w * w)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+            steps = reg.get("paddle_optimizer_steps_total")
+            assert steps.labels(optimizer="SGD").value == 3
+            assert reg.get("paddle_optimizer_step_ms").labels(
+                optimizer="SGD").count == 3
+            assert reg.get("paddle_optimizer_lr").labels(
+                optimizer="SGD").value == pytest.approx(0.1)
+            assert reg.get("paddle_optimizer_params").labels(
+                optimizer="SGD").value == 1
+        finally:
+            observability.uninstrument_optimizers()
+
+
+# -------------------------------------------------------- runtime probes
+class TestRuntimeProbes:
+    def test_device_memory_collector(self):
+        reg = MetricRegistry()
+        assert observability.install_device_memory_collector(reg)
+        text = prometheus_text(reg)
+        assert "paddle_device_memory_bytes" in text
+        assert 'stat="bytes_in_use"' in text
+
+    def test_jax_monitoring_install_is_safe_and_idempotent(self):
+        ok = observability.install_jax_monitoring()
+        assert isinstance(ok, bool)
+        assert observability.install_jax_monitoring() == ok
+        if ok:
+            reg = observability.default_registry()
+            assert reg.get("paddle_jax_events_total") is not None
+            assert reg.get(
+                "paddle_jax_event_duration_seconds") is not None
+
+    def test_profiler_span_mirroring(self):
+        from paddle_tpu import profiler
+        reg = MetricRegistry()
+        observability.mirror_profiler_spans(True, reg)
+        try:
+            with profiler.RecordEvent("t_obs_span"):
+                pass
+            child = reg.get("paddle_profiler_span_ms").get(
+                span="t_obs_span")
+            assert child is not None and child.count == 1
+        finally:
+            observability.mirror_profiler_spans(False)
+        with profiler.RecordEvent("t_obs_span2"):
+            pass
+        assert reg.get("paddle_profiler_span_ms").get(
+            span="t_obs_span2") is None
+
+
+# ------------------------------------------------ live-server scrape
+class TestLiveServerScrape:
+    @pytest.fixture()
+    def predictor(self, tmp_path):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(),
+                            nn.Linear(16, 4)).eval()
+        p = str(tmp_path / "obs_model")
+        paddle.jit.save(net, p, input_spec=[
+            paddle.static.InputSpec([None, 8], "float32", "x")])
+        return inference.create_predictor(inference.Config(p))
+
+    def test_curl_metrics_on_live_inference_server(self, predictor):
+        """The acceptance criterion verbatim: a live InferenceServer's
+        /metrics carries serving counters, latency/stage histograms,
+        compile-cache stats, and device-memory gauges."""
+        srv = serving.InferenceServer(predictor, max_batch_size=4,
+                                      max_wait_ms=5, name="t_live",
+                                      telemetry_port=0)
+        try:
+            srv.warmup()
+            rng = np.random.RandomState(0)
+            futs = srv.submit_many(
+                [[rng.randn(1, 8).astype("float32")] for _ in range(6)])
+            for f in futs:
+                f.result(timeout=60)
+            assert srv.telemetry is not None and srv.telemetry.port
+            _, text, headers = _get(srv.telemetry.url("/metrics"))
+            assert headers["Content-Type"].startswith("text/plain")
+            assert ('paddle_serving_requests_total{event="completed",'
+                    'server="t_live"} 6') in text
+            assert 'paddle_serving_latency_ms_bucket' in text
+            assert ('paddle_serving_stage_ms_bucket' in text
+                    and 'stage="host"' in text)
+            assert ('paddle_serving_compile_total{result="miss",'
+                    'server="t_live"}') in text
+            assert "paddle_device_memory_bytes" in text
+            status, body, _ = _get(srv.telemetry.url("/healthz"))
+            assert status == 200
+            assert json.loads(body)["checks"]["serving:t_live"]["ok"]
+        finally:
+            srv.shutdown()
+        # health check detaches with the server
+        status, body, _ = _get(srv.telemetry.url("/healthz"))
+        assert "serving:t_live" not in json.loads(body)["checks"]

@@ -1,0 +1,125 @@
+"""Profiler chrome-trace merge of args-annotated Python spans with
+native-tracer events (ISSUE 3 satellite; the ``_merge_python_events``
+path added in PR 2): schema of merged events, args preserved, no
+duplicates."""
+import json
+
+import pytest
+
+from paddle_tpu.profiler import RecordEvent, _HostTracer
+
+
+def _span(name, ts=1.0, dur=2.0, args=None):
+    ev = {"name": name, "ph": "X", "ts": ts, "dur": dur, "pid": 1,
+          "tid": 1}
+    if args:
+        ev["args"] = dict(args)
+    return ev
+
+
+@pytest.fixture()
+def tracer():
+    t = _HostTracer()
+    t._native = False        # force the pure-Python recording path
+    t.enabled = True
+    t.events = []
+    return t
+
+
+class TestPythonOnlyExport:
+    def test_export_schema_and_args(self, tracer, tmp_path):
+        tracer.add("plain", 1_000, 3_000, tid=7)
+        tracer.add("annotated", 5_000, 9_000, tid=7,
+                   args={"rows": 8, "padded": 16})
+        path = str(tmp_path / "trace.json")
+        tracer.export_chrome_tracing(path)
+        data = json.load(open(path))
+        evs = data["traceEvents"]
+        assert len(evs) == 2
+        for ev in evs:
+            assert {"name", "ph", "ts", "dur", "pid", "tid"} <= set(ev)
+            assert ev["ph"] == "X"
+        plain, annotated = evs
+        assert plain["name"] == "plain" and "args" not in plain
+        assert annotated["args"] == {"rows": 8, "padded": 16}
+        assert annotated["ts"] == 5.0 and annotated["dur"] == 4.0  # ns->us
+
+    def test_disabled_tracer_records_nothing(self, tracer):
+        tracer.enabled = False
+        tracer.add("ignored", 0, 1, tid=1)
+        assert tracer.events == []
+
+
+class TestMergeWithNativeExport:
+    def test_merge_into_dict_form(self, tracer, tmp_path):
+        """Native export is ``{"traceEvents": [...]}`` — the python
+        args-spans must be spliced in alongside, both schemas intact."""
+        path = str(tmp_path / "native.json")
+        native = [_span("native::op", 1.0, 2.0),
+                  _span("native::op2", 4.0, 1.0)]
+        json.dump({"traceEvents": list(native)}, open(path, "w"))
+        tracer.add("serving::assemble", 10_000, 20_000, tid=3,
+                   args={"rows": 4})
+        tracer._merge_python_events(path)
+        merged = json.load(open(path))["traceEvents"]
+        assert len(merged) == 3
+        names = [e["name"] for e in merged]
+        assert names.count("native::op") == 1       # no duplicates
+        assert names.count("serving::assemble") == 1
+        spliced = [e for e in merged
+                   if e["name"] == "serving::assemble"][0]
+        assert spliced["args"] == {"rows": 4}       # args preserved
+
+    def test_merge_into_bare_list_form(self, tracer, tmp_path):
+        """Chrome traces also come as a bare event array."""
+        path = str(tmp_path / "native_list.json")
+        json.dump([_span("native::op")], open(path, "w"))
+        tracer.add("py::span", 1_000, 2_000, tid=1, args={"k": "v"})
+        tracer._merge_python_events(path)
+        merged = json.load(open(path))
+        assert isinstance(merged, list) and len(merged) == 2
+        assert merged[1]["args"] == {"k": "v"}
+
+    def test_merge_tolerates_malformed_native_file(self, tracer,
+                                                   tmp_path):
+        path = str(tmp_path / "broken.json")
+        open(path, "w").write("{not json")
+        tracer.add("py::span", 1_000, 2_000, tid=1, args={"k": 1})
+        tracer._merge_python_events(path)     # must not raise
+        assert open(path).read() == "{not json"  # native file untouched
+
+    def test_merge_leaves_unknown_shapes_alone(self, tracer, tmp_path):
+        path = str(tmp_path / "odd.json")
+        json.dump("just a string", open(path, "w"))
+        tracer.add("py::span", 1_000, 2_000, tid=1, args={"k": 1})
+        tracer._merge_python_events(path)
+        assert json.load(open(path)) == "just a string"
+
+    def test_merge_is_idempotent_per_export(self, tracer, tmp_path):
+        """One export call splices each python span exactly once, even
+        when the native file already holds a prior merge's spans."""
+        path = str(tmp_path / "twice.json")
+        json.dump({"traceEvents": [_span("native::op")]}, open(path, "w"))
+        tracer.add("py::span", 1_000, 2_000, tid=1, args={"k": 1})
+        tracer._merge_python_events(path)
+        first = json.load(open(path))["traceEvents"]
+        assert [e["name"] for e in first].count("py::span") == 1
+
+
+class TestRecordEventArgsPath:
+    def test_record_event_args_land_in_export(self, tmp_path,
+                                              monkeypatch):
+        import paddle_tpu.profiler as prof
+        t = _HostTracer()
+        t._native = False
+        t.enabled = True
+        monkeypatch.setattr(prof, "_tracer", t)
+        with RecordEvent("e2e::span", args={"rows": 2}) as ev:
+            ev.set_arg("extra_ms", 1.5)
+        path = str(tmp_path / "e2e.json")
+        t.export_chrome_tracing(path)
+        evs = json.load(open(path))["traceEvents"]
+        assert len(evs) == 1
+        assert evs[0]["name"] == "e2e::span"
+        assert evs[0]["args"] == {"rows": 2, "extra_ms": 1.5}
+        assert evs[0]["dur"] >= 0

@@ -151,6 +151,39 @@ class _PR1Server(serving.InferenceServer):
         return 0
 
 
+def scrape_check(server_name, snap, expected_requests):
+    """End-to-end check of the exposition path (ISSUE 3): start the
+    shared telemetry endpoint, scrape /metrics over HTTP, and assert the
+    scraped serving counters equal the bench's own request accounting
+    (and the ServingMetrics snapshot). Registry families outlive the
+    shut-down server, so scraping after the run sees the full totals."""
+    import re
+    import urllib.request
+
+    from paddle_tpu import observability
+
+    tel = observability.start_telemetry_server(port=0)
+    text = urllib.request.urlopen(tel.url("/metrics"),
+                                  timeout=10).read().decode()
+
+    def scraped(event):
+        m = re.search(
+            rf'paddle_serving_requests_total\{{event="{event}",'
+            rf'server="{server_name}"\}} (\d+)', text)
+        return int(m.group(1)) if m else -1
+
+    detail, ok = {}, True
+    for ev in ("submitted", "completed", "batches"):
+        got, want = scraped(ev), snap["counters"][ev]
+        detail[ev] = {"scraped": got, "snapshot": want}
+        ok = ok and got == want
+    detail["requests"] = {"scraped": scraped("completed"),
+                          "expected": expected_requests}
+    ok = ok and scraped("completed") == expected_requests
+    detail["ok"] = ok
+    return ok, detail
+
+
 def _stage_summary(snap):
     st = snap["stage_ms"]
     return {
@@ -189,6 +222,10 @@ def run_default(args):
         "latency_ms": snap["latency_ms"],
         "stage_ms": _stage_summary(snap),
     }
+    scrape_ok = True
+    if args.scrape:
+        scrape_ok, out["scrape"] = scrape_check("bench", snap,
+                                                args.requests)
     if args.json:
         print(json.dumps(out, indent=1))
     else:
@@ -204,7 +241,10 @@ def run_default(args):
               f"p95={out['latency_ms']['p95']:.2f} "
               f"p99={out['latency_ms']['p99']:.2f}")
         print(f"host/device split: {out['stage_ms']}")
-    return 0 if out["speedup"] >= 2.0 else 1
+        if args.scrape:
+            print(f"scrape check ({'OK' if scrape_ok else 'MISMATCH'}): "
+                  f"{out['scrape']}")
+    return 0 if out["speedup"] >= 2.0 and scrape_ok else 1
 
 
 def run_pipeline(args):
@@ -318,6 +358,10 @@ def main():
                     help="model width (0 = auto: 256)")
     ap.add_argument("--layers", type=int, default=2,
                     help="hidden Linear+Tanh blocks in the bench model")
+    ap.add_argument("--scrape", action="store_true",
+                    help="scrape /metrics over HTTP at end-of-run and "
+                         "assert scraped serving counters match the "
+                         "bench's own request accounting")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output only")
     args = ap.parse_args()

@@ -32,6 +32,7 @@ SURFACES = [
     "paddle_tpu.optimizer",
     "paddle_tpu.inference",
     "paddle_tpu.serving",
+    "paddle_tpu.observability",
     "paddle_tpu.io",
     "paddle_tpu.amp",
     "paddle_tpu.jit",
