@@ -42,7 +42,7 @@ from .framework.device import (  # noqa: F401
     device_count, get_device, is_compiled_with_cuda, is_compiled_with_rocm,
     is_compiled_with_tpu, is_compiled_with_xpu, set_device,
 )
-from .framework.flags import get_flags, set_flags  # noqa: F401
+from .framework.flags import flags_snapshot, get_flags, set_flags  # noqa: F401
 from .framework.random import get_rng_state, seed, set_rng_state  # noqa: F401
 from .framework.misc import (  # noqa: F401
     LazyGuard, batch, check_shape, disable_signal_handler, finfo, flops,

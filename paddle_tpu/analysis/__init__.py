@@ -1,0 +1,87 @@
+"""paddle_tpu.analysis — AST static analysis with a CI gate.
+
+The compile-time checks the reference framework gets from C++ (typed
+gflags registration, tracer asserts, lock annotations), rebuilt as
+linters over this repo's Python:
+
+- ``TracerSafetyAnalyzer`` — host syncs / impurity reachable from
+  ``@jit`` / ``to_static`` / ``train_step`` entry points (TS001-TS005);
+- ``FlagConsistencyAnalyzer`` — every ``FLAGS_*`` string resolves to a
+  ``define_flag`` definition with a compatible type; dead flags are
+  reported (FC001-FC004);
+- ``LockDisciplineAnalyzer`` — unguarded shared-state writes in the
+  threaded serving/observability packages (LK001-LK003).
+
+Entry points: ``tools/pdlint.py`` (CLI, text/JSON, exit codes) and
+``tests/test_static_analysis.py`` (the gate — fails on any finding not
+excused by ``tests/fixtures/pdlint_baseline.json``). Pure stdlib: an
+analysis run parses, never imports, the code under inspection.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+from .core import (Analyzer, Finding, SourceFile, baseline_entry,
+                   filter_new, iter_python_files, load_baseline,
+                   parse_files, run_analyzers, write_baseline)
+from .flag_consistency import FlagConsistencyAnalyzer
+from .lock_discipline import LockDisciplineAnalyzer
+from .tracer_safety import TracerSafetyAnalyzer
+
+__all__ = [
+    "Analyzer", "Finding", "SourceFile",
+    "TracerSafetyAnalyzer", "FlagConsistencyAnalyzer",
+    "LockDisciplineAnalyzer",
+    "all_analyzers", "analyzer_names", "default_paths", "repo_root",
+    "default_baseline_path", "run_project",
+    "iter_python_files", "parse_files", "run_analyzers",
+    "load_baseline", "write_baseline", "filter_new", "baseline_entry",
+]
+
+
+def all_analyzers() -> List[Analyzer]:
+    return [TracerSafetyAnalyzer(), FlagConsistencyAnalyzer(),
+            LockDisciplineAnalyzer()]
+
+
+def analyzer_names() -> List[str]:
+    return [a.name for a in all_analyzers()]
+
+
+def repo_root() -> str:
+    """The checkout root (parent of the installed package dir)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def default_paths(root: Optional[str] = None) -> List[str]:
+    """The trees the flag-consistency contract spans; only the ones
+    that exist (an installed wheel has no tools/ or tests/)."""
+    root = root or repo_root()
+    return [p for p in (os.path.join(root, d)
+                        for d in ("paddle_tpu", "tools", "tests"))
+            if os.path.isdir(p)]
+
+
+def default_baseline_path(root: Optional[str] = None) -> str:
+    return os.path.join(root or repo_root(), "tests", "fixtures",
+                        "pdlint_baseline.json")
+
+
+def run_project(paths: Optional[Sequence[str]] = None,
+                analyzers: Optional[Sequence[Analyzer]] = None,
+                root: Optional[str] = None,
+                baseline_path: Optional[str] = None) -> Dict:
+    """One-call project run: walk, analyze, apply baseline. Returns
+    ``{"findings": [...], "new": [...], "baseline_size": int}`` —
+    ``new`` is what a CI gate should fail on."""
+    root = root or repo_root()
+    findings = run_analyzers(paths or default_paths(root),
+                             analyzers or all_analyzers(), root=root)
+    bl_path = baseline_path if baseline_path is not None \
+        else default_baseline_path(root)
+    baseline = load_baseline(bl_path) if bl_path else {}
+    return {"findings": findings,
+            "new": filter_new(findings, baseline),
+            "baseline_size": len(baseline)}

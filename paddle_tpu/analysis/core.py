@@ -1,0 +1,215 @@
+"""Shared static-analysis core: file walker, findings, baseline.
+
+Reference analog: the C++ tree catches whole classes of misuse at
+compile time (typed gflags in paddle/phi/core/flags.cc, lock
+annotations, tracer asserts). A Python/JAX rebuild has no compiler to
+lean on, so this package supplies the equivalent as AST-based
+analyzers that run in CI (tests/test_static_analysis.py) and from the
+command line (tools/pdlint.py).
+
+Everything here is stdlib-only (ast/os/json) — an analyzer run never
+imports the modules it inspects, so pdlint can vet code that would
+crash at import time.
+
+Findings carry a line number for humans but fingerprint WITHOUT it
+(rule + path + symbol + detail), so a committed baseline survives
+unrelated edits shifting lines.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+__all__ = [
+    "Finding", "SourceFile", "Analyzer", "iter_python_files",
+    "parse_files", "run_analyzers", "load_baseline", "write_baseline",
+    "filter_new", "baseline_entry",
+]
+
+_SKIP_DIRS = {".git", "__pycache__", ".claude", "build", "dist",
+              ".pytest_cache", "fixtures", "node_modules"}
+
+# per-file suppression for deliberate-negative code (analyzer
+# self-tests, fixtures that must reference phantom flags):
+#   # pdlint: skip-file
+#   # pdlint: disable=flag_consistency,tracer_safety
+_PRAGMA = re.compile(
+    r"#[ \t]*pdlint:[ \t]*(skip-file|disable=([A-Za-z0-9_, \t]+))")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic. ``severity`` is "error" (blocks) or "warning"
+    (reported, still baselined/gated so new ones can't creep in).
+    ``symbol`` is the enclosing context (qualname, class attr, flag
+    name); ``detail`` the offending token — together with rule+path
+    they form the line-number-independent fingerprint."""
+
+    analyzer: str
+    rule: str
+    path: str           # repo-relative, posix separators
+    line: int
+    col: int
+    message: str
+    symbol: str = ""
+    detail: str = ""
+    severity: str = "error"
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.rule}:{self.path}:{self.symbol}:{self.detail}"
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"[{self.analyzer}/{self.rule}] {self.severity}: "
+                f"{self.message}")
+
+    def to_dict(self) -> dict:
+        return {"analyzer": self.analyzer, "rule": self.rule,
+                "path": self.path, "line": self.line, "col": self.col,
+                "severity": self.severity, "symbol": self.symbol,
+                "detail": self.detail, "message": self.message,
+                "fingerprint": self.fingerprint}
+
+
+@dataclass
+class SourceFile:
+    """A parsed file handed to every analyzer: one walk + one
+    ``ast.parse`` shared by all three."""
+
+    path: str           # absolute
+    rel: str            # repo-relative, posix
+    source: str
+    tree: Optional[ast.AST] = None
+    error: Optional[Finding] = field(default=None)
+    disabled: Set[str] = field(default_factory=set)
+
+    @staticmethod
+    def parse_pragmas(source: str) -> Set[str]:
+        """Analyzer names this file opts out of; {"*"} = all."""
+        out: Set[str] = set()
+        m = _PRAGMA.search(source)
+        if m:
+            if m.group(1) == "skip-file":
+                out.add("*")
+            else:
+                out.update(n.strip() for n in m.group(2).split(",")
+                           if n.strip())
+        return out
+
+
+class Analyzer:
+    """Base: subclasses set ``name`` and implement ``run``."""
+
+    name = "base"
+
+    def run(self, files: Sequence[SourceFile]) -> List[Finding]:
+        raise NotImplementedError
+
+
+def iter_python_files(paths: Iterable[str],
+                      root: Optional[str] = None) -> List[str]:
+    """Expand files/directories into a sorted, deduplicated list of .py
+    paths, skipping VCS/cache/fixture directories."""
+    out = []
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p) and p.endswith(".py"):
+            out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in _SKIP_DIRS)
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return sorted(set(out))
+
+
+def parse_files(file_paths: Sequence[str],
+                root: Optional[str] = None) -> List[SourceFile]:
+    """Read + parse every path; a syntax error becomes a CORE001
+    finding on the file instead of aborting the run."""
+    root = os.path.abspath(root or os.getcwd())
+    files = []
+    for path in file_paths:
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except OSError as e:
+            files.append(SourceFile(path, rel, "", error=Finding(
+                "core", "CORE002", rel, 0, 0,
+                f"unreadable file: {e}", detail="unreadable")))
+            continue
+        sf = SourceFile(path, rel, source,
+                        disabled=SourceFile.parse_pragmas(source))
+        try:
+            sf.tree = ast.parse(source, filename=rel)
+        except SyntaxError as e:
+            sf.error = Finding(
+                "core", "CORE001", rel, e.lineno or 0, e.offset or 0,
+                f"syntax error: {e.msg}", detail="syntax-error")
+        files.append(sf)
+    return files
+
+
+def run_analyzers(paths: Sequence[str], analyzers: Sequence[Analyzer],
+                  root: Optional[str] = None) -> List[Finding]:
+    """Walk ``paths``, parse once, run every analyzer; findings come
+    back sorted by (path, line, rule) for stable output."""
+    files = parse_files(iter_python_files(paths, root), root)
+    findings = [f.error for f in files
+                if f.error is not None and "*" not in f.disabled]
+    parsed = [f for f in files if f.tree is not None]
+    for an in analyzers:
+        findings.extend(an.run(
+            [f for f in parsed
+             if "*" not in f.disabled and an.name not in f.disabled]))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule,
+                                           f.detail))
+
+
+# ------------------------------------------------------------ baseline
+def baseline_entry(f: Finding) -> dict:
+    """The readable on-disk form; matching is by fingerprint only, the
+    rest is context for whoever prunes the file."""
+    return {"fingerprint": f.fingerprint, "rule": f.rule,
+            "path": f.path, "symbol": f.symbol,
+            "severity": f.severity, "message": f.message}
+
+
+def load_baseline(path: str) -> Dict[str, dict]:
+    """fingerprint -> entry; an absent file is an empty baseline."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return {e["fingerprint"]: e for e in data.get("findings", [])}
+
+
+def write_baseline(path: str, findings: Sequence[Finding]):
+    entries = sorted((baseline_entry(f) for f in findings),
+                     key=lambda e: e["fingerprint"])
+    # one entry per fingerprint: repeats of the same pattern in one
+    # symbol are suppressed together, as intended
+    seen, unique = set(), []
+    for e in entries:
+        if e["fingerprint"] not in seen:
+            seen.add(e["fingerprint"])
+            unique.append(e)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "tool": "pdlint",
+                   "findings": unique}, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def filter_new(findings: Sequence[Finding],
+               baseline: Dict[str, dict]) -> List[Finding]:
+    """Findings not excused by the baseline — what the CI gate fails
+    on."""
+    return [f for f in findings if f.fingerprint not in baseline]

@@ -1,0 +1,200 @@
+"""Flag-consistency analyzer: every FLAGS_* reference must resolve.
+
+The reference framework registers ~90 gflags in one compile-checked
+translation unit (paddle/phi/core/flags.cc): a dangling FLAGS_* name
+or a type-mismatched default is a build break. Our registry
+(``framework/flags.py``) is runtime-checked only — ``flag_value`` on
+an undefined name raises in production, and a stale string in an env
+dict silently does nothing. This analyzer restores the compile-time
+contract over ``paddle_tpu/``, ``tools/`` and ``tests/``:
+
+  FC001  FLAGS_* string referenced but never defined via define_flag
+  FC002  flag defined but never read anywhere (warning — compat
+         shims live in the baseline)
+  FC003  set_flags({...}) literal whose type can't coerce to the
+         flag's default type
+  FC004  duplicate define_flag with a different default type
+
+Docstring mentions count for FC001 resolution (stale docs are stale
+code) but not as "reads" for FC002 — documenting a flag is not using
+it.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Sequence
+
+from .core import Analyzer, Finding, SourceFile
+
+__all__ = ["FlagConsistencyAnalyzer"]
+
+_TOKEN = re.compile(r"FLAGS_[A-Za-z0-9_]+")
+
+
+def _norm(name: str) -> str:
+    return name if name.startswith("FLAGS_") else "FLAGS_" + name
+
+
+class _Def:
+    __slots__ = ("name", "path", "line", "col", "type_")
+
+    def __init__(self, name, path, line, col, type_):
+        self.name = name
+        self.path = path
+        self.line = line
+        self.col = col
+        self.type_ = type_          # python type of the default, or None
+
+
+class _Ref:
+    __slots__ = ("name", "path", "line", "col", "is_doc")
+
+    def __init__(self, name, path, line, col, is_doc):
+        self.name = name
+        self.path = path
+        self.line = line
+        self.col = col
+        self.is_doc = is_doc
+
+
+def _call_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+# types the registry's _Flag.set coerces without loss
+_COMPATIBLE = {
+    bool: (bool,),
+    int: (int,),            # bool is a subclass of int; allowed via it
+    float: (int, float),
+    str: (str,),
+}
+
+
+class FlagConsistencyAnalyzer(Analyzer):
+    name = "flag_consistency"
+
+    def run(self, files: Sequence[SourceFile]) -> List[Finding]:
+        defs: Dict[str, _Def] = {}
+        dupes: List[Finding] = []
+        refs: List[_Ref] = []
+        type_errs: List[Finding] = []
+
+        for sf in files:
+            doc_spans = _docstring_nodes(sf.tree)
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Call) and \
+                        _call_name(node) == "define_flag":
+                    self._collect_def(sf, node, defs, dupes)
+                if isinstance(node, ast.Constant) and \
+                        isinstance(node.value, str):
+                    for tok in _TOKEN.findall(node.value):
+                        if tok.endswith("_"):   # "FLAGS_serving_" prose
+                            continue
+                        refs.append(_Ref(tok, sf.rel, node.lineno,
+                                         node.col_offset,
+                                         id(node) in doc_spans))
+
+        # FC003 runs as a second pass so every definition is known,
+        # whatever order the files were walked in
+        for sf in files:
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Call) and \
+                        _call_name(node) == "set_flags":
+                    type_errs.extend(self._check_set_flags(
+                        sf, node, defs))
+
+        findings: List[Finding] = list(dupes) + type_errs
+        def_sites = {(d.path, d.line) for d in defs.values()}
+        read = set()
+        for r in refs:
+            name = _norm(r.name)
+            at_def = (r.path, r.line) in def_sites
+            if name not in defs and not at_def:
+                findings.append(Finding(
+                    self.name, "FC001", r.path, r.line, r.col,
+                    f"{name} referenced but never defined via "
+                    f"define_flag (framework/flags.py)",
+                    symbol=name, detail=name))
+            if not r.is_doc and not at_def:
+                read.add(name)
+        for name, d in sorted(defs.items()):
+            if name not in read:
+                findings.append(Finding(
+                    self.name, "FC002", d.path, d.line, d.col,
+                    f"{name} is defined but never read (dead flag, or "
+                    f"a compat shim worth baselining)",
+                    symbol=name, detail=name, severity="warning"))
+        return findings
+
+    def _collect_def(self, sf, node, defs, dupes):
+        if not node.args or not isinstance(node.args[0], ast.Constant) \
+                or not isinstance(node.args[0].value, str):
+            return
+        name = _norm(node.args[0].value)
+        type_ = None
+        if len(node.args) > 1 and isinstance(node.args[1], ast.Constant):
+            type_ = type(node.args[1].value)
+        d = _Def(name, sf.rel, node.lineno, node.col_offset, type_)
+        prev = defs.get(name)
+        if prev is None:
+            defs[name] = d
+        elif type_ is not None and prev.type_ is not None and \
+                type_ is not prev.type_:
+            dupes.append(Finding(
+                self.name, "FC004", sf.rel, node.lineno,
+                node.col_offset,
+                f"{name} redefined with default type "
+                f"{type_.__name__}, first defined as "
+                f"{prev.type_.__name__} at {prev.path}:{prev.line}",
+                symbol=name, detail=f"{prev.type_.__name__}->"
+                                    f"{type_.__name__}"))
+
+    def _check_set_flags(self, sf, node, defs) -> List[Finding]:
+        out = []
+        if not node.args or not isinstance(node.args[0], ast.Dict):
+            return out
+        for k, v in zip(node.args[0].keys, node.args[0].values):
+            if not (isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)
+                    and isinstance(v, ast.Constant)):
+                continue
+            name = _norm(k.value)
+            d = defs.get(name)
+            if d is None or d.type_ is None:
+                continue        # FC001 covers undefined names
+            ok_types = _COMPATIBLE.get(d.type_, (d.type_,))
+            vt = type(v.value)
+            # a bool literal satisfies int-typed flags (python bool IS
+            # an int) and, per _Flag.set, bool flags parse strings
+            if vt is bool and d.type_ in (bool, int):
+                continue
+            if d.type_ is bool and vt is str:
+                continue
+            if vt not in ok_types:
+                out.append(Finding(
+                    self.name, "FC003", sf.rel, k.lineno, k.col_offset,
+                    f"set_flags gives {name} a {vt.__name__} literal "
+                    f"but its default is {d.type_.__name__}",
+                    symbol=name, detail=f"{vt.__name__}!="
+                                        f"{d.type_.__name__}"))
+        return out
+
+
+def _docstring_nodes(tree) -> set:
+    """id()s of Constant nodes sitting in docstring position."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            body = node.body
+            if body and isinstance(body[0], ast.Expr) and \
+                    isinstance(body[0].value, ast.Constant) and \
+                    isinstance(body[0].value.value, str):
+                out.add(id(body[0].value))
+    return out

@@ -1,0 +1,292 @@
+"""Lock-discipline analyzer: unguarded shared state in threaded code.
+
+``paddle_tpu/serving/`` and ``paddle_tpu/observability/`` are the two
+places this codebase runs real threads (batching worker, completion
+thread, telemetry HTTP handlers, collectors). The discipline their
+classes follow — established in PRs 1-3 — is: shared mutable
+attributes are written inside ``with self._lock:``. This analyzer
+flags the drift cases that compile fine and fail only under traffic:
+
+  LK001  attribute written BOTH inside and outside a with-lock block
+         (outside __init__) — the unguarded write races the guarded
+         ones
+  LK002  attribute written without a lock in a method that runs on its
+         own thread (``threading.Thread(target=self.m)``) while other
+         methods also touch it (warning)
+  LK003  module-level global assigned both inside and outside a
+         ``with <lock>:`` block
+
+A class with no lock-like attribute at all is skipped: single-threaded
+helpers (dataclasses, request objects) are not the target, and
+"add a lock" is a design decision, not a lint fix.
+
+Lock-like: ``self.X = threading.Lock()/RLock()/Condition(...)``, plus
+any attribute whose name contains "lock" used as a ``with`` context.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Analyzer, Finding, SourceFile
+
+__all__ = ["LockDisciplineAnalyzer"]
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+_DEFAULT_DIRS = ("paddle_tpu/serving/", "paddle_tpu/observability/")
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and \
+            node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    name = f.attr if isinstance(f, ast.Attribute) else \
+        (f.id if isinstance(f, ast.Name) else "")
+    return name in _LOCK_CTORS
+
+
+class _Write:
+    __slots__ = ("attr", "method", "guarded", "line", "col")
+
+    def __init__(self, attr, method, guarded, line, col):
+        self.attr = attr
+        self.method = method
+        self.guarded = guarded
+        self.line = line
+        self.col = col
+
+
+class LockDisciplineAnalyzer(Analyzer):
+    def __init__(self, dirs: Sequence[str] = _DEFAULT_DIRS):
+        self.dirs = tuple(dirs)
+
+    name = "lock_discipline"
+
+    def run(self, files: Sequence[SourceFile]) -> List[Finding]:
+        out: List[Finding] = []
+        for sf in files:
+            if self.dirs and not any(sf.rel.startswith(d)
+                                     for d in self.dirs):
+                continue
+            for node in sf.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    out.extend(self._check_class(sf, node))
+            out.extend(self._check_module_globals(sf))
+        return out
+
+    # ------------------------------------------------------ classes
+    def _check_class(self, sf: SourceFile, cls: ast.ClassDef
+                     ) -> List[Finding]:
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]
+        lock_attrs: Set[str] = set()
+        for m in methods:
+            for n in ast.walk(m):
+                if isinstance(n, ast.Assign) and _is_lock_ctor(n.value):
+                    for t in n.targets:
+                        a = _self_attr(t)
+                        if a:
+                            lock_attrs.add(a)
+                if isinstance(n, ast.With):
+                    for item in n.items:
+                        a = _self_attr(item.context_expr)
+                        if a and "lock" in a.lower():
+                            lock_attrs.add(a)
+        if not lock_attrs:
+            return []
+
+        writes: List[_Write] = []
+        reads: Dict[str, Set[str]] = {}       # attr -> methods reading
+        thread_targets: Set[str] = set()
+        callsites: Dict[str, List[Tuple[str, bool]]] = {}
+        for m in methods:
+            self._scan_method(m, lock_attrs, writes, reads,
+                              thread_targets, callsites)
+
+        # a private helper whose EVERY call site holds the lock (either
+        # lexically or because the caller is itself such a helper) runs
+        # lock-held — the "# lock held" convention, made checkable.
+        # Optimistic fixpoint; public methods are never inferred held
+        # (external callers are invisible).
+        held = {m.name: True for m in methods
+                if m.name.startswith("_") and callsites.get(m.name)}
+        changed = True
+        while changed:
+            changed = False
+            for name in list(held):
+                if held[name] and any(
+                        not g and not held.get(caller, False)
+                        for caller, g in callsites[name]):
+                    held[name] = False
+                    changed = True
+        for w in writes:
+            if not w.guarded and held.get(w.method, False):
+                w.guarded = True
+
+        findings: List[Finding] = []
+        by_attr: Dict[str, List[_Write]] = {}
+        for w in writes:
+            by_attr.setdefault(w.attr, []).append(w)
+
+        for attr, ws in sorted(by_attr.items()):
+            post_init = [w for w in ws if w.method != "__init__"]
+            guarded = [w for w in post_init if w.guarded]
+            unguarded = [w for w in post_init if not w.guarded]
+            qual = f"{cls.name}.{attr}"
+            if guarded and unguarded:
+                for w in unguarded:
+                    findings.append(Finding(
+                        self.name, "LK001", sf.rel, w.line, w.col,
+                        f"self.{attr} is written under the lock "
+                        f"elsewhere in {cls.name} but unguarded here "
+                        f"in {w.method!r}",
+                        symbol=qual, detail=w.method))
+            elif unguarded and thread_targets:
+                touchers = {w.method for w in ws} | \
+                    reads.get(attr, set())
+                for w in unguarded:
+                    if w.method in thread_targets and \
+                            touchers - {w.method}:
+                        findings.append(Finding(
+                            self.name, "LK002", sf.rel, w.line, w.col,
+                            f"self.{attr} written without the lock in "
+                            f"thread-target {w.method!r} and touched "
+                            f"by {sorted(touchers - {w.method})} — "
+                            f"unguarded shared state",
+                            symbol=qual, detail=w.method,
+                            severity="warning"))
+        return findings
+
+    def _scan_method(self, m, lock_attrs, writes, reads,
+                     thread_targets, callsites):
+        def walk(node, guarded):
+            for child in ast.iter_child_nodes(node):
+                g = guarded
+                if isinstance(child, ast.With):
+                    for item in child.items:
+                        a = _self_attr(item.context_expr)
+                        if a in lock_attrs:
+                            g = True
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    continue            # nested scope ≠ this method
+                if isinstance(child, ast.Call):
+                    callee = _self_attr(child.func)
+                    if callee:
+                        callsites.setdefault(callee, []).append(
+                            (m.name, g))
+                self._note(child, m.name, g, lock_attrs, writes,
+                           reads, thread_targets)
+                walk(child, g)
+        walk(m, False)
+
+    @staticmethod
+    def _note(node, method, guarded, lock_attrs, writes, reads,
+              thread_targets):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Tuple):
+                    elts = t.elts
+                else:
+                    elts = [t]
+                for e in elts:
+                    a = _self_attr(e)
+                    if a and a not in lock_attrs:
+                        writes.append(_Write(a, method, guarded,
+                                             e.lineno, e.col_offset))
+        elif isinstance(node, ast.Attribute) and \
+                isinstance(node.ctx, ast.Load):
+            a = _self_attr(node)
+            if a:
+                reads.setdefault(a, set()).add(method)
+        if isinstance(node, ast.Call):
+            f = node.func
+            ctor = f.attr if isinstance(f, ast.Attribute) else \
+                (f.id if isinstance(f, ast.Name) else "")
+            if ctor == "Thread":
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        t = _self_attr(kw.value)
+                        if t:
+                            thread_targets.add(t)
+
+    # ------------------------------------------------------ globals
+    def _check_module_globals(self, sf: SourceFile) -> List[Finding]:
+        """LK003: module globals written both inside and outside
+        ``with <lock>:`` across the module's functions."""
+        guarded_writes: Dict[str, Tuple[int, int]] = {}
+        unguarded: Dict[str, List[Tuple[int, int, str]]] = {}
+        lock_names: Set[str] = set()
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Assign) and \
+                    _is_lock_ctor(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        lock_names.add(t.id)
+        if not lock_names:
+            return []
+
+        def scan_func(fn):
+            declared = {n for s in ast.walk(fn)
+                        if isinstance(s, ast.Global) for n in s.names}
+            if not declared:
+                return
+
+            def walk(node, guarded):
+                for child in ast.iter_child_nodes(node):
+                    g = guarded
+                    if isinstance(child, ast.With):
+                        for item in child.items:
+                            ctx = item.context_expr
+                            if isinstance(ctx, ast.Name) and \
+                                    ctx.id in lock_names:
+                                g = True
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                        continue
+                    if isinstance(child, ast.Assign):
+                        for t in child.targets:
+                            names = t.elts if isinstance(t, ast.Tuple) \
+                                else [t]
+                            for e in names:
+                                if isinstance(e, ast.Name) and \
+                                        e.id in declared:
+                                    if g:
+                                        guarded_writes.setdefault(
+                                            e.id, (e.lineno,
+                                                   e.col_offset))
+                                    else:
+                                        unguarded.setdefault(
+                                            e.id, []).append(
+                                            (e.lineno, e.col_offset,
+                                             fn.name))
+                    walk(child, g)
+            walk(fn, False)
+
+        for node in sf.tree.body:
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                scan_func(node)
+
+        out = []
+        for name in sorted(set(guarded_writes) & set(unguarded)):
+            for line, col, fn_name in unguarded[name]:
+                out.append(Finding(
+                    self.name, "LK003", sf.rel, line, col,
+                    f"module global {name!r} is lock-guarded elsewhere "
+                    f"but written bare in {fn_name!r}",
+                    symbol=name, detail=fn_name))
+        return out

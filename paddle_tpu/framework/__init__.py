@@ -2,7 +2,7 @@ from . import dtype, flags, place, random  # noqa: F401
 from .dtype import (  # noqa: F401
     DType, convert_dtype, get_default_dtype, set_default_dtype, to_jax_dtype,
 )
-from .flags import get_flags, set_flags  # noqa: F401
+from .flags import flags_snapshot, get_flags, set_flags  # noqa: F401
 from .place import (  # noqa: F401
     CPUPlace, CUDAPinnedPlace, CUDAPlace, Place, TPUPlace, XPUPlace,
 )

@@ -26,12 +26,27 @@ class _Flag:
     def _parse(self, s: str):
         if self.type_ is bool:
             return s.lower() in ("1", "true", "yes", "on")
-        return self.type_(s)
+        try:
+            return self.type_(s)
+        except (TypeError, ValueError) as e:
+            # the bare int("two") ValueError names neither the flag nor
+            # where the bad value came from — the env var IS the flag
+            # name, so say all three
+            raise ValueError(
+                f"flag {self.name}: cannot parse {s!r} from environment "
+                f"variable {self.name} as {self.type_.__name__} "
+                f"(default: {self.default!r})") from e
 
     def set(self, v):
         if self.type_ is bool and isinstance(v, str):
             v = self._parse(v)
-        self.value = self.type_(v)
+        try:
+            self.value = self.type_(v)
+        except (TypeError, ValueError) as e:
+            raise ValueError(
+                f"flag {self.name}: cannot coerce {v!r} to "
+                f"{self.type_.__name__} (default: {self.default!r})"
+            ) from e
 
 
 _REGISTRY: Dict[str, _Flag] = {}
@@ -70,6 +85,15 @@ def flag_value(name: str):
     return _REGISTRY[key].value
 
 
+def flags_snapshot() -> Dict[str, Dict[str, Any]]:
+    """Every registered flag with its live value, default, type name
+    and help text — the bulk export pdlint's ``--dump-flags`` and
+    debugging sessions use instead of reaching into ``_REGISTRY``."""
+    return {name: {"value": f.value, "default": f.default,
+                   "type": f.type_.__name__, "help": f.help}
+            for name, f in sorted(_REGISTRY.items())}
+
+
 # Core flags (the subset of the reference's flags.cc that has TPU meaning;
 # others are accepted as inert toggles so reference scripts don't break).
 define_flag("FLAGS_use_autotune", True, "kernel block-size autotuning (phi/kernels/autotune analog)")
@@ -84,6 +108,10 @@ define_flag("FLAGS_new_executor_serial_run", False, "run static programs op-seri
 define_flag("FLAGS_enable_pir_api", False, "compat no-op")
 define_flag("FLAGS_log_memory_stats", False, "log live/peak buffer stats on allocation")
 define_flag("FLAGS_tpu_matmul_precision", "default", "jax matmul precision: default|high|highest")
+define_flag("FLAGS_selected_tpus", 0,
+            "local TPU ordinal for this worker (the selected-gpus "
+            "analog); the launcher exports it per rank, "
+            "distributed.env reads it back as dev_id")
 define_flag("FLAGS_flash_min_seqlen", 2048,
             "below this query length attention uses the XLA softmax path "
             "(faster end-to-end, PERF.md); the Pallas flash kernel kicks "

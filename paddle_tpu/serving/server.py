@@ -406,7 +406,8 @@ class InferenceServer:
 
     # ------------------------------------------------------ execution
     def _loop(self):
-        self._loop_running = True
+        with self._lock:
+            self._loop_running = True
         pipelined = self.pipeline_depth > 0
         try:
             while True:
@@ -426,7 +427,8 @@ class InferenceServer:
         finally:
             if pipelined:
                 self._drain_pipeline()
-            self._loop_running = False
+            with self._lock:
+                self._loop_running = False
 
     # ---- stage 1: host assembly (staging pool) ----
     def _assemble(self, batch: List[Request], sig, padded_rows: int

@@ -1,0 +1,113 @@
+"""framework.flags edge cases: bool parsing, env-override precedence at
+definition time, the malformed-env error path, and flags_snapshot().
+
+Companion to the flag-consistency half of pdlint
+(tests/test_static_analysis.py): that gate proves every FLAGS_* string
+resolves statically; this file proves the runtime registry behaves at
+the edges the gate cannot see. The deliberately-phantom flag names
+below are why this file opts out of that analyzer:
+"""
+# pdlint: disable=flag_consistency
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework import flags as flags_mod
+from paddle_tpu.framework.flags import (define_flag, flag_value,
+                                        flags_snapshot, get_flags,
+                                        set_flags)
+
+
+class TestBoolFromString:
+    def test_truthy_string_variants(self):
+        define_flag("FLAGS_pdlt_bool", False, "test flag")
+        for s in ("1", "true", "True", "TRUE", "yes", "Yes", "on",
+                  "ON"):
+            set_flags({"FLAGS_pdlt_bool": s})
+            assert flag_value("FLAGS_pdlt_bool") is True, s
+
+    def test_falsy_string_variants(self):
+        define_flag("FLAGS_pdlt_bool", False, "test flag")
+        for s in ("0", "false", "False", "no", "off", ""):
+            set_flags({"FLAGS_pdlt_bool": True})
+            set_flags({"FLAGS_pdlt_bool": s})
+            assert flag_value("FLAGS_pdlt_bool") is False, s
+
+    def test_real_bools_and_prefixless_name(self):
+        define_flag("FLAGS_pdlt_bool2", True)
+        set_flags({"pdlt_bool2": False})    # FLAGS_ prefix optional
+        assert get_flags("pdlt_bool2") == {"pdlt_bool2": False}
+
+
+class TestEnvOverridePrecedence:
+    def test_env_wins_over_default_at_definition(self, monkeypatch):
+        monkeypatch.setenv("FLAGS_pdlt_env_int", "7")
+        define_flag("FLAGS_pdlt_env_int", 3, "env beats default")
+        assert flag_value("FLAGS_pdlt_env_int") == 7
+
+    def test_env_bool_parsing_at_definition(self, monkeypatch):
+        monkeypatch.setenv("FLAGS_pdlt_env_bool", "on")
+        define_flag("FLAGS_pdlt_env_bool", False)
+        assert flag_value("FLAGS_pdlt_env_bool") is True
+
+    def test_definition_is_idempotent_env_read_once(self, monkeypatch):
+        monkeypatch.setenv("FLAGS_pdlt_env_once", "5")
+        define_flag("FLAGS_pdlt_env_once", 1)
+        monkeypatch.setenv("FLAGS_pdlt_env_once", "9")
+        define_flag("FLAGS_pdlt_env_once", 1)   # registry hit, no re-read
+        assert flag_value("FLAGS_pdlt_env_once") == 5
+
+
+class TestMalformedValues:
+    def test_malformed_env_names_flag_env_and_type(self, monkeypatch):
+        monkeypatch.setenv("FLAGS_pdlt_bad_env", "two")
+        with pytest.raises(ValueError) as ei:
+            define_flag("FLAGS_pdlt_bad_env", 4, "int flag")
+        msg = str(ei.value)
+        assert "FLAGS_pdlt_bad_env" in msg      # the flag AND env var
+        assert "environment variable" in msg
+        assert "int" in msg
+        assert "'two'" in msg
+
+    def test_malformed_env_does_not_half_register(self, monkeypatch):
+        monkeypatch.setenv("FLAGS_pdlt_bad_env2", "nope")
+        with pytest.raises(ValueError):
+            define_flag("FLAGS_pdlt_bad_env2", 2)
+        monkeypatch.delenv("FLAGS_pdlt_bad_env2")
+        define_flag("FLAGS_pdlt_bad_env2", 2)   # recoverable
+        assert flag_value("FLAGS_pdlt_bad_env2") == 2
+
+    def test_malformed_set_names_flag_and_type(self):
+        define_flag("FLAGS_pdlt_depth", 2)
+        with pytest.raises(ValueError) as ei:
+            set_flags({"FLAGS_pdlt_depth": "deep"})
+        msg = str(ei.value)
+        assert "FLAGS_pdlt_depth" in msg
+        assert "int" in msg
+        assert flag_value("FLAGS_pdlt_depth") == 2  # unchanged
+
+    def test_unknown_flag_still_keyerror_free_message(self):
+        with pytest.raises(ValueError, match="FLAGS_pdlt_nonexistent"):
+            set_flags({"FLAGS_pdlt_nonexistent": 1})
+        with pytest.raises(ValueError, match="FLAGS_pdlt_nonexistent"):
+            get_flags(["FLAGS_pdlt_nonexistent"])
+
+
+class TestSnapshot:
+    def test_snapshot_shape_and_core_flags(self):
+        snap = flags_snapshot()
+        assert "FLAGS_use_autotune" in snap
+        entry = snap["FLAGS_use_autotune"]
+        assert set(entry) == {"value", "default", "type", "help"}
+        assert entry["type"] == "bool"
+        assert snap["FLAGS_serving_pipeline_depth"]["type"] == "int"
+        assert snap["FLAGS_selected_tpus"]["type"] == "int"
+
+    def test_snapshot_tracks_live_value_not_default(self):
+        define_flag("FLAGS_pdlt_snap", 10)
+        set_flags({"FLAGS_pdlt_snap": 42})
+        entry = flags_snapshot()["FLAGS_pdlt_snap"]
+        assert entry["value"] == 42
+        assert entry["default"] == 10
+
+    def test_snapshot_exported_at_top_level(self):
+        assert paddle.flags_snapshot is flags_mod.flags_snapshot

@@ -1,0 +1,492 @@
+"""pdlint CI gate + analyzer self-tests (paddle_tpu.analysis).
+
+Two halves:
+
+1. **The gate** — run all three analyzers over the whole repo and fail
+   on any finding not excused by tests/fixtures/pdlint_baseline.json.
+   This is the tier-1 enforcement of the tracer-safety / flag-registry
+   / lock-discipline contracts; fix the finding or (after review)
+   refresh the baseline with ``tools/pdlint.py --write-baseline``.
+
+2. **Self-tests** — synthetic modules written to tmp_path with known
+   violations (a ``time.time()`` under a jitted function, a dangling
+   ``FLAGS_*`` string, an unguarded shared-state write), proving each
+   analyzer still catches what the gate relies on it to catch. The
+   synthetic sources deliberately carry phantom FLAGS_* strings, hence
+   the per-file opt-out pragma:
+"""
+# pdlint: disable=flag_consistency
+import io
+import json
+import os
+import sys
+import textwrap
+from contextlib import redirect_stderr, redirect_stdout
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+try:
+    from paddle_tpu import analysis
+    from paddle_tpu.analysis import (FlagConsistencyAnalyzer,
+                                     LockDisciplineAnalyzer,
+                                     TracerSafetyAnalyzer)
+except Exception as e:  # noqa: BLE001 - the gate must skip, not error,
+    # when run from an environment where the repo root is not on the
+    # path (e.g. against an installed wheel without the test tree)
+    pytest.skip(f"repo root not importable, pdlint gate skipped: {e!r}",
+                allow_module_level=True)
+
+pytestmark = pytest.mark.pdlint
+
+
+def _write(tmp_path, name, source):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    return str(p)
+
+
+def _run(tmp_path, analyzers, **kw):
+    return analysis.run_analyzers([str(tmp_path)], analyzers,
+                                  root=str(tmp_path), **kw)
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+# ===================================================================
+# 1. the gate
+# ===================================================================
+class TestRepoGate:
+    def test_repo_clean_against_baseline(self):
+        res = analysis.run_project(root=REPO_ROOT)
+        new = res["new"]
+        listing = "\n".join(f.format() for f in new)
+        assert not new, (
+            f"pdlint found {len(new)} NEW finding(s) — fix them, or "
+            f"(after review) refresh the baseline via "
+            f"`python tools/pdlint.py --write-baseline`:\n{listing}")
+
+    def test_baseline_has_no_stale_entries(self):
+        """Every baselined fingerprint still corresponds to a real
+        finding — fixed findings must be pruned so the baseline only
+        ever shrinks for the right reason."""
+        res = analysis.run_project(root=REPO_ROOT)
+        live = {f.fingerprint for f in res["findings"]}
+        baseline = analysis.load_baseline(
+            analysis.default_baseline_path(REPO_ROOT))
+        stale = sorted(set(baseline) - live)
+        assert not stale, (
+            f"baseline entries whose findings no longer exist (prune "
+            f"them from pdlint_baseline.json): {stale}")
+
+    def test_gate_fails_on_injected_violation(self, tmp_path):
+        """The acceptance demo: inject a time.time() under a jitted
+        function in a tmp module, run the same project gate over it
+        with the real committed baseline — it must come back as a NEW
+        finding (i.e. the gate above would fail)."""
+        _write(tmp_path, "hot_path.py", """
+            import time
+            import jax
+
+            @jax.jit
+            def step(x):
+                t0 = time.time()
+                return x * t0
+        """)
+        res = analysis.run_project(
+            paths=[str(tmp_path)], root=str(tmp_path),
+            baseline_path=analysis.default_baseline_path(REPO_ROOT))
+        assert any(f.rule == "TS004" for f in res["new"]), \
+            "injected time.time() under @jax.jit was not flagged as new"
+
+
+# ===================================================================
+# 2. tracer-safety self-tests
+# ===================================================================
+class TestTracerSafety:
+    def test_all_rules_fire_under_jit(self, tmp_path):
+        _write(tmp_path, "mod.py", """
+            import os
+            import random
+            import time
+            import numpy as np
+            import jax
+
+            @jax.jit
+            def step(x, flag):
+                t = time.time()                 # TS004
+                r = random.random()             # TS003
+                z = np.random.randn(3)          # TS003
+                e = os.environ.get("FOO")       # TS005
+                h = os.environ["BAR"]           # TS005
+                v = float(x)                    # TS002
+                if flag:                        # TS002
+                    x = x + 1
+                n = x.numpy()                   # TS001
+                return x
+        """)
+        found = _run(tmp_path, [TracerSafetyAnalyzer()])
+        assert _rules(found) == {"TS001", "TS002", "TS003", "TS004",
+                                 "TS005"}
+
+    def test_reachability_through_helper(self, tmp_path):
+        _write(tmp_path, "mod.py", """
+            import time
+            import jax
+
+            def helper(x):
+                return x + time.perf_counter()
+
+            @jax.jit
+            def entry(x):
+                return helper(x)
+
+            def cold(x):
+                return time.time()      # NOT reachable from jit
+        """)
+        found = _run(tmp_path, [TracerSafetyAnalyzer()])
+        assert [f.symbol for f in found] == ["helper"]
+        assert "cold" not in {f.symbol for f in found}
+
+    def test_jit_call_site_and_train_step_entries(self, tmp_path):
+        _write(tmp_path, "mod.py", """
+            import time
+            import jax
+
+            def build():
+                def raw(a):
+                    return a.item()     # TS001 via jax.jit(raw)
+                return jax.jit(raw)
+
+            def train_step(batch):      # entry by name
+                return time.monotonic()
+        """)
+        found = _run(tmp_path, [TracerSafetyAnalyzer()])
+        assert {"TS001", "TS004"} <= _rules(found)
+        assert {"build.raw", "train_step"} <= {f.symbol for f in found}
+
+    def test_to_static_decorator_and_taint(self, tmp_path):
+        _write(tmp_path, "mod.py", """
+            import paddle_tpu as paddle
+
+            @paddle.jit.to_static
+            def fwd(x):
+                y = x * 2
+                return int(y)           # TS002 via taint y <- x
+        """)
+        found = _run(tmp_path, [TracerSafetyAnalyzer()])
+        assert _rules(found) == {"TS002"}
+        assert found[0].detail == "int(y)"
+
+    def test_untraced_code_is_not_flagged(self, tmp_path):
+        _write(tmp_path, "mod.py", """
+            import time
+
+            def plain(x):
+                return time.time() + float(x)
+        """)
+        assert _run(tmp_path, [TracerSafetyAnalyzer()]) == []
+
+
+# ===================================================================
+# 3. flag-consistency self-tests
+# ===================================================================
+class TestFlagConsistency:
+    def test_undefined_reference(self, tmp_path):
+        _write(tmp_path, "flags.py", """
+            def define_flag(name, default, help_=""):
+                pass
+            define_flag("FLAGS_real", 2, "defined and read")
+        """)
+        _write(tmp_path, "user.py", """
+            from flags import define_flag
+            x = flag_value("FLAGS_real")
+            y = flag_value("FLAGS_ghost")
+        """)
+        found = _run(tmp_path, [FlagConsistencyAnalyzer()])
+        fc1 = [f for f in found if f.rule == "FC001"]
+        assert [f.symbol for f in fc1] == ["FLAGS_ghost"]
+
+    def test_defined_but_never_read_is_warning(self, tmp_path):
+        _write(tmp_path, "flags.py", """
+            define_flag("FLAGS_dead", True, "nobody reads this")
+        """)
+        found = _run(tmp_path, [FlagConsistencyAnalyzer()])
+        assert [(f.rule, f.symbol, f.severity) for f in found] == \
+            [("FC002", "FLAGS_dead", "warning")]
+
+    def test_docstring_mention_resolves_but_is_not_a_read(
+            self, tmp_path):
+        _write(tmp_path, "mod.py", '''
+            """Tune via ``FLAGS_tunable`` and ``FLAGS_phantom``."""
+            define_flag("FLAGS_tunable", 4)
+        ''')
+        found = _run(tmp_path, [FlagConsistencyAnalyzer()])
+        assert ("FC001", "FLAGS_phantom") in \
+            {(f.rule, f.symbol) for f in found}
+        # documented-only flag still counts as unread
+        assert ("FC002", "FLAGS_tunable") in \
+            {(f.rule, f.symbol) for f in found}
+
+    def test_set_flags_type_mismatch(self, tmp_path):
+        _write(tmp_path, "mod.py", """
+            define_flag("FLAGS_depth", 2)
+            define_flag("FLAGS_ratio", 0.5)
+            set_flags({"FLAGS_depth": "deep"})     # FC003
+            set_flags({"FLAGS_ratio": 1})          # ok: int -> float
+            set_flags({"FLAGS_depth": True})       # ok: bool is int
+            x = flag_value("FLAGS_ratio")
+        """)
+        found = _run(tmp_path, [FlagConsistencyAnalyzer()])
+        fc3 = [f for f in found if f.rule == "FC003"]
+        assert [f.symbol for f in fc3] == ["FLAGS_depth"]
+
+    def test_duplicate_definition_type_conflict(self, tmp_path):
+        _write(tmp_path, "mod.py", """
+            define_flag("FLAGS_twice", 1)
+            define_flag("FLAGS_twice", "one")      # FC004
+            x = flag_value("FLAGS_twice")
+        """)
+        found = _run(tmp_path, [FlagConsistencyAnalyzer()])
+        assert ("FC004", "FLAGS_twice") in \
+            {(f.rule, f.symbol) for f in found}
+
+
+# ===================================================================
+# 4. lock-discipline self-tests
+# ===================================================================
+class TestLockDiscipline:
+    def test_mixed_guard_write_is_flagged(self, tmp_path):
+        _write(tmp_path, "srv.py", """
+            import threading
+
+            class Server:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._depth = 0
+
+                def locked_bump(self):
+                    with self._lock:
+                        self._depth += 1
+
+                def racy_reset(self):
+                    self._depth = 0         # LK001
+        """)
+        found = _run(tmp_path, [LockDisciplineAnalyzer(dirs=())])
+        assert [(f.rule, f.symbol, f.detail) for f in found] == \
+            [("LK001", "Server._depth", "racy_reset")]
+
+    def test_lock_held_helper_is_not_flagged(self, tmp_path):
+        """The '# lock held' convention: a private helper whose every
+        call site holds the lock inherits the guard."""
+        _write(tmp_path, "srv.py", """
+            import threading
+
+            class Server:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def _bump(self):
+                    self._n += 1            # called with lock held
+
+                def submit(self):
+                    with self._lock:
+                        self._bump()
+
+                def drain(self):
+                    with self._lock:
+                        self._bump()
+                        self._n = 0
+        """)
+        assert _run(tmp_path, [LockDisciplineAnalyzer(dirs=())]) == []
+
+    def test_thread_target_unguarded_write(self, tmp_path):
+        _write(tmp_path, "srv.py", """
+            import threading
+
+            class Server:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._running = False
+                    self._w = threading.Thread(target=self._loop)
+
+                def _loop(self):
+                    self._running = True    # LK002
+
+                def status(self):
+                    with self._lock:
+                        return self._running
+        """)
+        found = _run(tmp_path, [LockDisciplineAnalyzer(dirs=())])
+        assert [(f.rule, f.symbol) for f in found] == \
+            [("LK002", "Server._running")]
+
+    def test_module_global_mixed_guard(self, tmp_path):
+        _write(tmp_path, "reg.py", """
+            import threading
+
+            _lock = threading.Lock()
+            _singleton = None
+
+            def get():
+                global _singleton
+                with _lock:
+                    if _singleton is None:
+                        _singleton = object()
+                return _singleton
+
+            def reset():
+                global _singleton
+                _singleton = None           # LK003
+        """)
+        found = _run(tmp_path, [LockDisciplineAnalyzer(dirs=())])
+        assert [(f.rule, f.symbol, f.detail) for f in found] == \
+            [("LK003", "_singleton", "reset")]
+
+    def test_lockless_class_is_skipped(self, tmp_path):
+        _write(tmp_path, "plain.py", """
+            class Box:
+                def __init__(self):
+                    self.v = 0
+
+                def set(self, v):
+                    self.v = v
+        """)
+        assert _run(tmp_path, [LockDisciplineAnalyzer(dirs=())]) == []
+
+    def test_default_scope_is_serving_and_observability(self):
+        an = LockDisciplineAnalyzer()
+        assert an.dirs == ("paddle_tpu/serving/",
+                           "paddle_tpu/observability/")
+
+
+# ===================================================================
+# 5. core: fingerprints, baseline, walker, CLI
+# ===================================================================
+class TestCoreAndCli:
+    def test_fingerprint_survives_line_drift(self, tmp_path):
+        src = """
+            import time
+            import jax
+
+            @jax.jit
+            def step(x):
+                return x * time.time()
+        """
+        _write(tmp_path, "a.py", src)
+        before = _run(tmp_path, [TracerSafetyAnalyzer()])
+        _write(tmp_path, "a.py", "# a comment\n# another\n"
+               + textwrap.dedent(src))
+        after = _run(tmp_path, [TracerSafetyAnalyzer()])
+        assert [f.fingerprint for f in before] == \
+            [f.fingerprint for f in after]
+        assert before[0].line != after[0].line
+
+    def test_baseline_roundtrip_and_filter(self, tmp_path):
+        _write(tmp_path, "a.py", """
+            import time
+            import jax
+
+            @jax.jit
+            def step(x):
+                return x * time.time()
+        """)
+        found = _run(tmp_path, [TracerSafetyAnalyzer()])
+        bl = tmp_path / "baseline.json"
+        analysis.write_baseline(str(bl), found)
+        loaded = analysis.load_baseline(str(bl))
+        assert analysis.filter_new(found, loaded) == []
+        assert analysis.load_baseline(str(tmp_path / "missing.json")) \
+            == {}
+
+    def test_syntax_error_is_a_finding_not_a_crash(self, tmp_path):
+        _write(tmp_path, "bad.py", "def broken(:\n")
+        found = _run(tmp_path, [TracerSafetyAnalyzer()])
+        assert [(f.rule, f.analyzer) for f in found] == \
+            [("CORE001", "core")]
+
+    def test_pragma_disables_analyzer_per_file(self, tmp_path):
+        src = ("import time\nimport jax\n\n@jax.jit\n"
+               "def step(x):\n    return x * time.time()\n")
+        # assembled so THIS file's own pragma stays the regex's first hit
+        _write(tmp_path, "a.py",
+               "# pdlint" + ": disable=tracer_safety\n" + src)
+        _write(tmp_path, "b.py", src)
+        found = _run(tmp_path, [TracerSafetyAnalyzer()])
+        assert {f.path for f in found} == {"b.py"}
+        _write(tmp_path, "b.py", "# pdlint" + ": skip-file\n" + src)
+        assert _run(tmp_path, [TracerSafetyAnalyzer()]) == []
+
+    def test_walker_skips_cache_and_fixture_dirs(self, tmp_path):
+        (tmp_path / "__pycache__").mkdir()
+        (tmp_path / "__pycache__" / "x.py").write_text("1/0(")
+        (tmp_path / "fixtures").mkdir()
+        (tmp_path / "fixtures" / "y.py").write_text("also skipped(")
+        _write(tmp_path, "ok.py", "x = 1\n")
+        files = analysis.iter_python_files([str(tmp_path)])
+        assert [os.path.basename(f) for f in files] == ["ok.py"]
+
+    def _pdlint_main(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "pdlint_under_test",
+            os.path.join(REPO_ROOT, "tools", "pdlint.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.main
+
+    def test_cli_json_output_and_exit_codes(self, tmp_path):
+        main = self._pdlint_main()
+        _write(tmp_path, "dirty.py", """
+            import time
+            import jax
+
+            @jax.jit
+            def step(x):
+                return x * time.time()
+        """)
+        out, err = io.StringIO(), io.StringIO()
+        with redirect_stdout(out), redirect_stderr(err):
+            rc = main([str(tmp_path), "--json", "--no-baseline"])
+        assert rc == 1
+        doc = json.loads(out.getvalue())
+        assert doc["counts"]["new"] == doc["counts"]["total"] == 1
+        assert doc["findings"][0]["rule"] == "TS004"
+
+        _write(tmp_path, "dirty.py", "x = 1\n")
+        out = io.StringIO()
+        with redirect_stdout(out):
+            rc = main([str(tmp_path), "--no-baseline"])
+        assert rc == 0
+        assert "0 new" in out.getvalue()
+
+    def test_cli_rejects_unknown_analyzer_and_path(self, tmp_path):
+        main = self._pdlint_main()
+        err = io.StringIO()
+        with redirect_stdout(io.StringIO()), redirect_stderr(err):
+            assert main(["--analyzers", "nope"]) == 2
+        assert "unknown analyzers" in err.getvalue()
+        with redirect_stdout(io.StringIO()), redirect_stderr(err):
+            assert main([str(tmp_path / "missing_dir")]) == 2
+
+    def test_cli_baseline_write_then_clean(self, tmp_path):
+        main = self._pdlint_main()
+        _write(tmp_path, "dirty.py", """
+            import time
+            import jax
+
+            @jax.jit
+            def step(x):
+                return x * time.time()
+        """)
+        bl = str(tmp_path / "bl.json")
+        with redirect_stdout(io.StringIO()):
+            assert main([str(tmp_path), "--baseline", bl,
+                         "--write-baseline"]) == 0
+            assert main([str(tmp_path), "--baseline", bl]) == 0
+            assert main([str(tmp_path), "--baseline", bl,
+                         "--no-baseline"]) == 1

@@ -33,6 +33,7 @@ SURFACES = [
     "paddle_tpu.inference",
     "paddle_tpu.serving",
     "paddle_tpu.observability",
+    "paddle_tpu.analysis",
     "paddle_tpu.io",
     "paddle_tpu.amp",
     "paddle_tpu.jit",
@@ -52,9 +53,36 @@ def public_names(mod):
     return sorted(n for n in dir(mod) if not n.startswith("_"))
 
 
+def pdlint_gate():
+    """Refuse to lock in a new golden while the repo fails its own
+    static-analysis gate — tools/pdlint.py --json over the default
+    trees must report zero non-baselined findings."""
+    import subprocess
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "pdlint.py"), "--json"],
+        capture_output=True, text=True)
+    try:
+        doc = json.loads(r.stdout)
+        n_new = doc["counts"]["new"]
+    except (json.JSONDecodeError, KeyError):
+        sys.exit(f"gen_api_golden: pdlint --json produced no usable "
+                 f"report (rc={r.returncode}):\n{r.stderr[-2000:]}")
+    if r.returncode != 0 or n_new:
+        new_fps = "\n".join(doc.get("new", []))
+        sys.exit(f"gen_api_golden: {n_new} non-baselined pdlint "
+                 f"finding(s) — fix them (or re-baseline via "
+                 f"tools/pdlint.py --write-baseline) before "
+                 f"regenerating the API golden:\n{new_fps}")
+    print(f"pdlint gate: clean ({doc['counts']['total']} finding(s), "
+          f"all baselined)")
+
+
 def main():
     import importlib
 
+    pdlint_gate()
     golden = {"surfaces": {}, "ops": [], "converters": []}
     for name in SURFACES:
         mod = importlib.import_module(name)

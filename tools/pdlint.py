@@ -1,0 +1,131 @@
+"""pdlint — run the paddle_tpu.analysis static analyzers from the CLI.
+
+Reference analog: the compile step itself (typed gflags in
+paddle/phi/core/flags.cc, tracer asserts) plus tools/check_api_compatible.py
+style gates. Usage:
+
+    python tools/pdlint.py                     # whole repo, text output
+    python tools/pdlint.py paddle_tpu/serving  # a subtree
+    python tools/pdlint.py --json              # machine-readable
+    python tools/pdlint.py --analyzers flag_consistency
+    python tools/pdlint.py --write-baseline    # re-baseline (after review!)
+    python tools/pdlint.py --dump-flags        # runtime flags_snapshot()
+
+Findings already recorded in tests/fixtures/pdlint_baseline.json are
+reported as baselined and do NOT fail the run. Exit codes: 0 = clean
+against the baseline, 1 = new findings, 2 = usage/internal error.
+
+The CI twin is tests/test_static_analysis.py — it runs the same
+analyzers over the same trees and fails on any non-baselined finding.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="pdlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to analyze (default: paddle_tpu "
+                        "tools tests)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit one JSON document instead of text lines")
+    p.add_argument("--analyzers", default=None,
+                   help="comma-separated subset (default: all)")
+    p.add_argument("--baseline", default=None,
+                   help="baseline file (default: tests/fixtures/"
+                        "pdlint_baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline: every finding is new")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="rewrite the baseline from this run's findings "
+                        "and exit 0")
+    p.add_argument("--list-analyzers", action="store_true")
+    p.add_argument("--dump-flags", action="store_true",
+                   help="print framework.flags.flags_snapshot() as "
+                        "JSON and exit (runtime registry, not static)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    from paddle_tpu import analysis
+
+    if args.list_analyzers:
+        for name in analysis.analyzer_names():
+            print(name)
+        return 0
+    if args.dump_flags:
+        from paddle_tpu.framework.flags import flags_snapshot
+        print(json.dumps(flags_snapshot(), indent=1, sort_keys=True))
+        return 0
+
+    analyzers = analysis.all_analyzers()
+    if args.analyzers:
+        wanted = {a.strip() for a in args.analyzers.split(",") if
+                  a.strip()}
+        unknown = wanted - set(analysis.analyzer_names())
+        if unknown:
+            print(f"pdlint: unknown analyzers {sorted(unknown)} "
+                  f"(have: {analysis.analyzer_names()})",
+                  file=sys.stderr)
+            return 2
+        analyzers = [a for a in analyzers if a.name in wanted]
+
+    paths = [os.path.abspath(p) for p in args.paths] or \
+        analysis.default_paths(REPO_ROOT)
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"pdlint: no such path: {p}", file=sys.stderr)
+            return 2
+
+    baseline_path = args.baseline or \
+        analysis.default_baseline_path(REPO_ROOT)
+    findings = analysis.run_analyzers(paths, analyzers, root=REPO_ROOT)
+
+    if args.write_baseline:
+        analysis.write_baseline(baseline_path, findings)
+        print(f"pdlint: wrote {len(findings)} finding(s) to "
+              f"{os.path.relpath(baseline_path, REPO_ROOT)}")
+        return 0
+
+    baseline = {} if args.no_baseline else \
+        analysis.load_baseline(baseline_path)
+    new = analysis.filter_new(findings, baseline)
+
+    if args.as_json:
+        print(json.dumps({
+            "version": 1,
+            "analyzers": [a.name for a in analyzers],
+            "baseline": os.path.relpath(baseline_path, REPO_ROOT),
+            "baseline_size": len(baseline),
+            "counts": {"total": len(findings), "new": len(new)},
+            "findings": [f.to_dict() for f in findings],
+            "new": [f.fingerprint for f in new],
+        }, indent=1, sort_keys=True))
+        return 1 if new else 0
+
+    new_fps = {f.fingerprint for f in new}
+    for f in findings:
+        suffix = "" if f.fingerprint in new_fps else "  [baselined]"
+        print(f.format() + suffix)
+    n_base = len(findings) - len(new)
+    print(f"pdlint: {len(findings)} finding(s), {n_base} baselined, "
+          f"{len(new)} new")
+    if new:
+        print("pdlint: new findings — fix them, or (after review) "
+              "refresh the baseline with --write-baseline",
+              file=sys.stderr)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
