@@ -31,28 +31,46 @@ def _probe_backend(timeout=None, retries=None, sleep_s=20):
     touches jax: when the tunnel is wedged, backend init either raises
     UNAVAILABLE or hangs indefinitely (round-4 BENCH rc=1 / MULTICHIP
     rc=124), and a hang inside this process cannot be recovered. Bounded
-    retries, then a diagnostic verdict.
+    retries with a fixed backoff, every attempt timed.
 
-    Returns (platform_or_None, diagnostic_str)."""
+    Returns (platform_or_None, diagnostic_str, probe_dict) where
+    probe_dict records the full retry schedule — per-attempt elapsed
+    seconds, the backoff slept before each, and the error text — so a
+    skipped-bench JSON says exactly how long was spent deciding to skip
+    instead of an ambiguous rc-0 record."""
     import subprocess
 
     timeout = timeout or int(os.environ.get("BENCH_PROBE_TIMEOUT", 120))
     retries = retries or int(os.environ.get("BENCH_PROBE_RETRIES", 2))
     last = ""
+    attempts = []
+    t_start = time.monotonic()
     for attempt in range(retries):
         if attempt:
             time.sleep(sleep_s)
+        t0 = time.monotonic()
         try:
             r = subprocess.run(
                 [sys.executable, "-c",
                  "import jax; print(jax.devices()[0].platform)"],
                 capture_output=True, text=True, timeout=timeout)
+            elapsed = time.monotonic() - t0
             if r.returncode == 0 and r.stdout.strip():
-                return r.stdout.strip().splitlines()[-1], ""
+                return r.stdout.strip().splitlines()[-1], "", {
+                    "attempts": attempts, "total_s": round(
+                        time.monotonic() - t_start, 1)}
             last = (r.stderr or r.stdout).strip().replace("\n", " ")[-300:]
         except subprocess.TimeoutExpired:
+            elapsed = time.monotonic() - t0
             last = f"backend init hung >{timeout}s (tunnel wedged)"
-    return None, f"{retries} attempts failed; last: {last}"
+        attempts.append({"attempt": attempt + 1,
+                         "backoff_s": sleep_s if attempt else 0,
+                         "elapsed_s": round(elapsed, 1),
+                         "error": last})
+    probe = {"retries": retries, "timeout_s": timeout,
+             "backoff_s": sleep_s, "attempts": attempts,
+             "total_s": round(time.monotonic() - t_start, 1)}
+    return None, f"{retries} attempts failed; last: {last}", probe
 
 
 def _bench_resnet(args, paddle, TrainStep):
@@ -92,6 +110,7 @@ def _bench_resnet(args, paddle, TrainStep):
     peak = float(os.environ.get("BENCH_PEAK_FLOPS", 197e12))
     mfu = best * 3 * 4.089e9 / peak
     print(json.dumps({"metric": "resnet50_train_images_per_sec",
+                      "skipped": False,
                       "value": round(best, 1), "unit": "images/s",
                       "vs_baseline": round(best / 2000.0, 4),
                       "mfu": round(mfu, 4), "layout": layout}))
@@ -137,6 +156,7 @@ def _bench_bert(args, paddle, TrainStep):
     fpt = 6 * n + 12 * cfg.num_layers * cfg.hidden_size * seq
     peak = float(os.environ.get("BENCH_PEAK_FLOPS", 197e12))
     print(json.dumps({"metric": "bert_base_pretrain_tokens_per_sec",
+                      "skipped": False,
                       "value": round(best, 1), "unit": "tokens/s",
                       "vs_baseline": round(best * fpt / peak / 0.45, 4)}))
 
@@ -193,17 +213,22 @@ def main():
     else:
         # never touch jax in-process until a subprocess probe confirms the
         # backend initializes: a wedged tunnel would hang us unrecoverably
-        platform, diag = _probe_backend()
+        platform, diag, probe = _probe_backend()
         if platform is not None and platform not in ("tpu", "axon"):
             # jax can fall back to CPU silently when TPU init fails
             # non-fatally — a 1-core CPU "bench" would hang the driver
             # or report a meaningless number, so treat it as unavailable
             platform, diag = None, f"probe fell back to {platform!r}"
         if platform is None:
+            # "skipped": true matches the MULTICHIP_r*.json schema so a
+            # consumer can tell "no measurement" from "measured zero"
+            # without parsing the metric name, and the probe record says
+            # how the retry budget was spent
             print(json.dumps({
-                "metric": "backend_unavailable",
+                "metric": "backend_unavailable", "skipped": True,
                 "value": 0.0, "unit": "diagnostic", "vs_baseline": 0.0,
                 "error": f"TPU backend unreachable, bench skipped: {diag}",
+                "probe": probe,
             }))
             return 0
         import jax
@@ -371,6 +396,7 @@ def main():
 
     print(json.dumps({
         "metric": metric,
+        "skipped": False,
         "value": round(best, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.45, 4) if not args.smoke else 1.0,

@@ -72,6 +72,7 @@ from . import text  # noqa: F401
 from .hapi import callbacks  # noqa: F401
 from . import distribution  # noqa: F401
 from . import distributed  # noqa: F401
+from . import compile_cache  # noqa: F401
 from . import framework  # noqa: F401
 from . import incubate  # noqa: F401
 from . import inference  # noqa: F401

@@ -1,0 +1,209 @@
+"""Stable cache keys for the persistent compile cache.
+
+A cached executable is only reusable when EVERYTHING that shaped the
+compilation is identical: the traced Python (function/model source),
+the abstract operands (shapes, dtypes, weak types, shardings), the
+device mesh, the compile-relevant ``FLAGS_*`` values, and the
+jax/jaxlib + backend versions. The reference framework's program cache
+keys on (ProgramDesc, place, scope) for the same reason
+(/root/reference/python/paddle/fluid/executor.py program cache); here
+the key is a sha256 over a canonical JSON of all of the above, so a
+key collision requires a semantically identical compile.
+
+Fingerprints never require tracing — a cache HIT must skip both the
+Python trace and the XLA compile, so everything here is derived from
+source text, object structure, and flag values alone.
+"""
+from __future__ import annotations
+
+import hashlib
+import inspect
+import json
+from typing import Any, Dict, Optional, Tuple
+
+__all__ = [
+    "cache_key", "function_fingerprint", "layer_fingerprint",
+    "mesh_fingerprint", "environment_fingerprint",
+    "compile_relevant_flags", "mark_compile_relevant", "bytes_fingerprint",
+    "avals_signature",
+]
+
+# Flags whose value changes the compiled program (not just runtime
+# behavior). Subsystems that add such a flag register it with
+# ``mark_compile_relevant`` so stale executables can never be served
+# across a flag flip.
+_COMPILE_RELEVANT_FLAGS = {
+    "FLAGS_tpu_matmul_precision",
+    "FLAGS_use_autotune",
+    "FLAGS_flash_min_seqlen",
+    "FLAGS_flash_block_q",
+    "FLAGS_flash_block_k",
+    "FLAGS_cudnn_deterministic",
+    "FLAGS_serving_donate_inputs",
+}
+
+
+def mark_compile_relevant(name: str) -> str:
+    """Register a flag as compile-relevant: its live value becomes part
+    of every cache key, so changing it invalidates cached executables."""
+    if not name.startswith("FLAGS_"):
+        name = "FLAGS_" + name
+    _COMPILE_RELEVANT_FLAGS.add(name)
+    return name
+
+
+def compile_relevant_flags() -> Dict[str, Any]:
+    """Live values of every compile-relevant flag (missing ones are
+    skipped so the key survives flag-set evolution across versions)."""
+    from ..framework.flags import flag_value
+    out = {}
+    for name in sorted(_COMPILE_RELEVANT_FLAGS):
+        try:
+            out[name] = flag_value(name)
+        except KeyError:
+            continue
+    return out
+
+
+def _sha(parts) -> str:
+    h = hashlib.sha256()
+    for p in parts:
+        h.update(p.encode() if isinstance(p, str) else p)
+        h.update(b"\x00")
+    return h.hexdigest()
+
+
+def bytes_fingerprint(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def function_fingerprint(fn) -> str:
+    """Identity hash of a Python callable: qualified name + source text
+    (falling back to bytecode + consts for source-less callables, e.g.
+    lambdas defined in a REPL)."""
+    fn = inspect.unwrap(fn)
+    target = getattr(fn, "__func__", fn)       # bound method -> function
+    parts = [getattr(target, "__module__", "") or "",
+             getattr(target, "__qualname__", repr(target))]
+    code = getattr(target, "__code__", None)
+    if code is not None and target.__name__ == "<lambda>":
+        # getsource on a lambda returns the whole surrounding statement,
+        # so two identical lambdas on different lines would key apart —
+        # the compiled code object is the lambda's real identity
+        parts.append(code.co_code.hex())
+        parts.append(repr(code.co_consts))
+        parts.append(repr(code.co_names))
+        return _sha(parts)
+    try:
+        parts.append(inspect.getsource(target))
+    except (OSError, TypeError):
+        if code is not None:
+            parts.append(code.co_code.hex())
+            parts.append(repr(code.co_consts))
+        else:
+            parts.append(repr(target))
+    return _sha(parts)
+
+
+def layer_fingerprint(layer) -> str:
+    """Identity hash of a Layer tree: the class source of the layer and
+    every distinct sublayer class, plus the parameter/buffer structure
+    (names, shapes, dtypes — values ride as operands, not here)."""
+    seen, parts = set(), []
+    for sub in [layer, *layer.sublayers()]:
+        cls = type(sub)
+        if cls in seen:
+            continue
+        seen.add(cls)
+        parts.append(f"{cls.__module__}.{cls.__qualname__}")
+        try:
+            parts.append(inspect.getsource(cls))
+        except (OSError, TypeError):
+            pass
+    for name, p in layer.named_parameters():
+        parts.append(f"p:{name}:{tuple(p.shape)}:{p._data.dtype}:"
+                     f"{bool(p.stop_gradient)}")
+    for name, b in layer.named_buffers():
+        if b is not None:
+            parts.append(f"b:{name}:{tuple(b.shape)}:{b._data.dtype}")
+    return _sha(parts)
+
+
+def mesh_fingerprint(mesh) -> str:
+    """Canonical description of the device mesh a program was compiled
+    over; ``"none"`` for single-device eager compiles."""
+    if mesh is None:
+        return "none"
+    try:
+        kinds = sorted({getattr(d, "device_kind", str(d))
+                        for d in mesh.devices.flat})
+        return json.dumps({"axes": {str(k): int(v)
+                                    for k, v in dict(mesh.shape).items()},
+                           "kinds": kinds,
+                           "n": int(mesh.devices.size)}, sort_keys=True)
+    except Exception:  # noqa: BLE001 - an exotic mesh still needs A key
+        return repr(mesh)
+
+
+def environment_fingerprint() -> Dict[str, Any]:
+    """Toolchain + backend identity: a cache entry from a different
+    jax/jaxlib/backend must never load."""
+    import jax
+    import jaxlib
+    try:
+        dev = jax.devices()[0]
+        kind = getattr(dev, "device_kind", "unknown")
+        n = jax.device_count()
+    except Exception:  # noqa: BLE001 - backend init failure: still keyable
+        kind, n = "unavailable", 0
+    return {
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "backend": jax.default_backend(),
+        "device_kind": kind,
+        "n_devices": n,
+        "x64": bool(jax.config.jax_enable_x64),
+        "matmul_precision": str(jax.config.jax_default_matmul_precision),
+    }
+
+
+def _leaf_desc(x) -> list:
+    """Canonical (shape, dtype, weak_type, sharding-spec) of one operand
+    leaf; works for np/jax arrays, ShapeDtypeStructs, and scalars."""
+    shape = [str(d) for d in tuple(getattr(x, "shape", ()))]
+    dtype = str(getattr(x, "dtype", type(x).__name__))
+    weak = bool(getattr(x, "weak_type", False))
+    sharding = getattr(x, "sharding", None)
+    spec = str(getattr(sharding, "spec", "")) if sharding is not None else ""
+    return [shape, dtype, weak, spec]
+
+
+def avals_signature(tree) -> list:
+    """Abstract signature of an operand pytree: per-leaf descriptors
+    plus the tree structure (two different dict layouts with the same
+    leaves must not collide)."""
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return [[_leaf_desc(leaf) for leaf in leaves], str(treedef)]
+
+
+def cache_key(fn_fingerprint: str, args=None, *, mesh="__global__",
+              extra: Optional[dict] = None) -> Tuple[str, dict]:
+    """The full persistent-cache key: sha256 hex digest plus the parts
+    dict it was computed from (stored alongside the entry for
+    debugging). ``mesh`` defaults to the process's global mesh; pass
+    ``None`` explicitly for a compile known to be meshless."""
+    if mesh == "__global__":
+        from ..distributed.mesh_utils import get_global_mesh
+        mesh = get_global_mesh()
+    parts = {
+        "v": 1,
+        "fn": fn_fingerprint,
+        "args": avals_signature(args) if args is not None else None,
+        "mesh": mesh_fingerprint(mesh),
+        "flags": compile_relevant_flags(),
+        "env": environment_fingerprint(),
+        "extra": extra,
+    }
+    blob = json.dumps(parts, sort_keys=True, default=repr)
+    return hashlib.sha256(blob.encode()).hexdigest(), parts

@@ -113,7 +113,11 @@ class LoadedArtifact:
         self.meta = meta
         self.feed_names = meta["feed_names"]
         self.feeds = meta["feeds"]
-        self._exported = jax.export.deserialize(meta["stablehlo"])
+        # attribute-style jax.export only resolves after the submodule
+        # was imported somewhere; a fresh serving-only process (jit.load
+        # / Predictor with no prior jit.save) must import it explicitly
+        from jax import export as jexport
+        self._exported = jexport.deserialize(meta["stablehlo"])
         self._commit_weights()
 
     def _commit_weights(self):

@@ -157,6 +157,24 @@ define_flag("FLAGS_serving_donate_inputs", True,
             "so XLA reuses them for outputs (effective on accelerator "
             "backends; CPU has no donation and falls back silently)")
 
+# Persistent compile cache (paddle_tpu.compile_cache — cold-start
+# amortization across processes).
+define_flag("FLAGS_compile_cache_dir", "",
+            "directory for the persistent AOT compile cache (serialized "
+            "executables keyed by function/shape/mesh/flag/version "
+            "fingerprints); empty = disabled. A warm cache lets a "
+            "restarted process skip trace+XLA-compile at every wired "
+            "compile site (jit, TrainStep, serving warmup/dispatch)")
+define_flag("FLAGS_compile_cache_max_bytes", 1 << 30,
+            "size bound for FLAGS_compile_cache_dir: least-recently-"
+            "used entries are evicted past this many bytes (0 = "
+            "unbounded)")
+define_flag("FLAGS_serving_warmup_from_manifest", False,
+            "pre-warm a constructed InferenceServer from its persisted "
+            "warmup manifest (the batch signatures a previous process "
+            "actually compiled) when one exists under "
+            "FLAGS_compile_cache_dir — the restart-storm fast path")
+
 # Observability knobs (paddle_tpu.observability — the telemetry layer).
 define_flag("FLAGS_training_telemetry", False,
             "auto-inject the TrainingTelemetryCallback into Model.fit "

@@ -30,8 +30,15 @@ class StaticFunction:
         self._input_spec = input_spec
         self._jit_fn = None
         self.concrete_programs = []
+        # persistent-compile-cache memo: signature -> loaded AOT
+        # executable (False = failed, use the jit path); cleared
+        # whenever the traced function changes (_build_jit)
+        self._exec_memo = {}
+        self._fn_fp = None
 
     def _build_jit(self):
+        self._exec_memo = {}
+        self._fn_fp = None
         layer = self._layer
 
         if layer is not None:
@@ -94,6 +101,21 @@ class StaticFunction:
             self._build_jit()
             return self._invoke(*args, **kwargs)
 
+    def _will_record(self, tensors) -> bool:
+        """Mirror of apply_op's record condition: True when the call
+        will be differentiated (vjp traced through the callee) — in
+        which case a non-traceable AOT executable must not be
+        substituted for the jitted function."""
+        from ..amp.auto_cast import amp_state
+        from ..core import autograd as ag
+        if amp_state() is not None:
+            # autocast may rewrite operand dtypes at the dispatch
+            # boundary, invalidating the shape/dtype key the cached
+            # executable was compiled for
+            return True
+        return ag.grad_enabled() and any(not t.stop_gradient
+                                         for t in tensors)
+
     def _invoke(self, *args, **kwargs):
         arrays = [a._data if isinstance(a, Tensor) else a for a in args]
         if self._layer is not None:
@@ -105,20 +127,81 @@ class StaticFunction:
             # eager .backward() flows into the parameters.
             param_names = list(params.keys())
 
+            tensor_args = [t if isinstance(t, Tensor) else Tensor(t)
+                           for t in args]
+            exec_fn = None
+            if not self._will_record([*param_tensors, *tensor_args]):
+                exec_fn = self._cached_exec(
+                    params, buffers, [t._data for t in tensor_args],
+                    training)
+
             def one_op(*all_arrays):
                 p_arrays = dict(zip(param_names,
                                     all_arrays[:len(param_names)]))
                 in_arrays = all_arrays[len(param_names):]
+                if exec_fn is not None:
+                    return exec_fn(p_arrays, buffers, *in_arrays)
                 return self._jit_fn(p_arrays, buffers, *in_arrays,
                                     _training=training)
 
-            tensor_args = [t if isinstance(t, Tensor) else Tensor(t)
-                           for t in args]
             return apply_op("jit_program", one_op, *param_tensors,
                             *tensor_args)
         t_args = [Tensor(a) if not isinstance(a, Tensor) else a for a in args]
-        return apply_op("jit_program",
-                        lambda *arrs: self._jit_fn(*arrs), *t_args)
+        exec_fn = None
+        if not self._will_record(t_args):
+            exec_fn = self._cached_exec(None, None,
+                                        [t._data for t in t_args], False)
+        fn = exec_fn if exec_fn is not None else self._jit_fn
+        return apply_op("jit_program", lambda *arrs: fn(*arrs), *t_args)
+
+    def _cached_exec(self, params, buffers, arrays, training):
+        """Persistent-cache tier for the non-differentiating call path:
+        a loaded (or compiled + stored) AOT executable for this operand
+        signature, or None. A hit skips both the Python retrace and the
+        XLA compile a fresh process would otherwise pay."""
+        import jax
+
+        from ..framework.flags import flag_value
+        if not str(flag_value("FLAGS_compile_cache_dir") or ""):
+            return None
+        sig = (bool(training), tuple(
+            (tuple(getattr(a, "shape", ())),
+             str(getattr(a, "dtype", type(a).__name__)))
+            for a in jax.tree_util.tree_leaves((params, buffers, arrays))))
+        memo = self._exec_memo
+        if sig in memo:
+            fn = memo[sig]
+            return fn if fn is not False else None
+        fn = None
+        try:
+            from .. import compile_cache as cc
+            cache = cc.default_cache()
+            if cache is not None:
+                fp = self._fn_fp
+                if fp is None:
+                    parts = [cc.function_fingerprint(self._function)]
+                    if self._layer is not None:
+                        parts.append(cc.layer_fingerprint(self._layer))
+                    fp = self._fn_fp = cc.bytes_fingerprint(
+                        "\n".join(parts).encode())
+                key, kparts = cc.cache_key(
+                    fp, (params, buffers, arrays),
+                    extra={"site": "to_static",
+                           "training": bool(training)})
+                if self._layer is not None:
+                    def build():
+                        return self._jit_fn.lower(
+                            params, buffers, *arrays,
+                            _training=training).compile()
+                else:
+                    def build():
+                        return self._jit_fn.lower(*arrays).compile()
+                fn, _hit = cache.get_or_compile(key, build, site="jit",
+                                                meta=kparts)
+        except Exception:  # noqa: BLE001 - any cache/AOT failure falls
+            fn = None      # back to the jitted dispatch
+        memo[sig] = fn if fn is not None else False
+        return fn
 
     @property
     def forward(self):

@@ -147,6 +147,10 @@ class TrainStep:
         self._named_params = dict(model.named_parameters())
         self._trainable = {n: p for n, p in self._named_params.items()
                            if not p.stop_gradient}
+        # persistent-compile-cache memo: arg-signature -> loaded AOT
+        # executable (False = this signature failed AOT, use plain jit)
+        self._exec_memo: Dict = {}
+        self._step_fp: Optional[str] = None
 
     def _init_opt_state(self):
         opt = self.optimizer
@@ -176,6 +180,93 @@ class TrainStep:
         for name, p in self._trainable.items():
             for an in opt._accum_names:
                 opt._set_accum(an, p, state[name][an])
+
+    def _step_fingerprint(self) -> str:
+        """Identity of the compiled step WITHOUT tracing it: model class
+        sources + parameter structure, loss/optimizer update-rule
+        sources, clip/AMP/scaler/schedule config, and the per-parameter
+        constants the trace bakes in (weight decay, lr multipliers, ASP
+        masks). Anything that changes the lowered program must land
+        here — a collision serves wrong numerics from the cache."""
+        if self._step_fp is not None:
+            return self._step_fp
+        from ..compile_cache import fingerprint as fpmod
+        opt = self.optimizer
+        parts = [
+            fpmod.layer_fingerprint(self.model),
+            fpmod.function_fingerprint(self.loss_fn),
+            f"{type(opt).__module__}.{type(opt).__qualname__}",
+            fpmod.function_fingerprint(opt._update_rule),
+            repr(sorted(opt._accum_names)),
+            repr((self._amp_level, self._amp_dtype, self._donate)),
+            repr(getattr(opt, "_l2_coeff", None)),
+            repr(getattr(opt, "_dgc_cfg", None)),
+            repr(getattr(opt, "_localsgd_cfg", None)),
+        ]
+        gc = getattr(opt, "_grad_clip", None)
+        parts.append(repr((type(gc).__qualname__ if gc is not None
+                           else None,
+                           getattr(gc, "clip_norm", None),
+                           getattr(gc, "min", None),
+                           getattr(gc, "max", None))))
+        if self._scaler is not None:
+            parts.append(repr((float(self._scaler._incr_ratio),
+                               float(self._scaler._decr_ratio),
+                               int(self._scaler._incr_every),
+                               int(self._scaler._decr_every),
+                               bool(self._scaler._dynamic))))
+        for n in sorted(self._trainable):
+            p = self._trainable[n]
+            mult = getattr(p, "optimize_attr",
+                           {"learning_rate": 1.0})["learning_rate"]
+            parts.append(f"{n}:{opt._wd_for(p)}:{mult}")
+            mask = getattr(p, "_asp_mask", None)
+            if mask is not None:
+                parts.append(
+                    n + ":asp:" +
+                    fpmod.bytes_fingerprint(np.asarray(mask).tobytes()))
+        self._step_fp = fpmod.bytes_fingerprint(
+            "\n".join(parts).encode())
+        return self._step_fp
+
+    def _cached_step(self, call_args):
+        """Persistent-cache tier of the step dispatch: a ready AOT
+        executable for this argument signature, or None (cache
+        disabled, or this signature failed AOT — the jit path always
+        remains). A hit skips BOTH the Python trace and the XLA
+        compile; a miss traces once via ``lower`` and persists the
+        executable for the next process."""
+        from ..framework.flags import flag_value
+        if not str(flag_value("FLAGS_compile_cache_dir") or ""):
+            return None
+        multi = self._compiled is getattr(self, "_compiled_multi", None)
+        tag = f"multi:{self._multi_n}" if multi else "single"
+        leaves = jax.tree_util.tree_leaves(call_args)
+        sig = (tag, tuple(
+            (tuple(getattr(a, "shape", ())),
+             str(getattr(a, "dtype", type(a).__name__)))
+            for a in leaves))
+        memo = self._exec_memo
+        if sig in memo:
+            fn = memo[sig]
+            return fn if fn is not False else None
+        fn = None
+        try:
+            from .. import compile_cache as cc
+            cache = cc.default_cache()
+            if cache is not None:
+                key, parts = cc.cache_key(
+                    self._step_fingerprint(), list(call_args),
+                    extra={"site": "train_step", "tag": tag,
+                           "n_inputs": int(self._n_inputs)})
+                fn, _hit = cache.get_or_compile(
+                    key,
+                    lambda: self._compiled.lower(*call_args).compile(),
+                    site="train_step", meta=parts)
+        except Exception:  # noqa: BLE001 - any cache/AOT failure falls
+            fn = None      # back to the plain jit dispatch
+        memo[sig] = fn if fn is not None else False
+        return fn
 
     def _make_pure_step(self):
         """Dispatch to the step-structure builder: the plain GSPMD step,
@@ -572,8 +663,11 @@ class TrainStep:
                       if getattr(a, "ndim", 0) >= 1
                       and a.shape[0] % nshards == 0 else a
                       for a in arrays]
-        loss, new_params, new_state, new_sc = self._compiled(
-            params, buffers, opt_state, sc_state, lr, t, key, *arrays)
+        call_args = (params, buffers, opt_state, sc_state, lr, t, key,
+                     *arrays)
+        step_fn = self._cached_step(call_args)
+        loss, new_params, new_state, new_sc = \
+            (step_fn if step_fn is not None else self._compiled)(*call_args)
         if not getattr(loss, "is_fully_addressable", True):
             # multi-host mesh: the scalar loss is replicated; hand back the
             # process-local copy so .numpy()/float() work on every rank

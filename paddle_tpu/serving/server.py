@@ -178,8 +178,16 @@ class InferenceServer:
         self._worker: Optional[threading.Thread] = None
         self._loop_running = False      # a thread is inside _loop
         self._compiled = set()          # signatures already executed
+        self._manifest_recorded = set()  # signatures already persisted
         self._lock = threading.Lock()
         self.telemetry = self._attach_telemetry(telemetry_port)
+        self._manifest = self._init_manifest()
+        if self._manifest is not None and len(self._manifest) and \
+                bool(_flag("FLAGS_serving_warmup_from_manifest", False)):
+            # restart fast path: pre-compile exactly the signatures the
+            # previous process served — each one a persistent-cache
+            # load when the compile cache is warm
+            self.warmup_from_manifest()
         if start:
             self.start()
 
@@ -198,6 +206,31 @@ class InferenceServer:
         observability.add_health_check(
             f"serving:{self.metrics.name}", self._health)
         return srv
+
+    def _init_manifest(self):
+        """Warmup manifest for this (server, model) pair under the
+        persistent compile-cache directory; None when the cache is
+        disabled or the predictor has no stable artifact identity (the
+        protobuf-program path)."""
+        if not str(_flag("FLAGS_compile_cache_dir", "") or ""):
+            return None
+        try:
+            from ..compile_cache import WarmupManifest, default_cache
+            cache = default_cache()
+            fp_fn = getattr(self.predictor, "artifact_fingerprint", None)
+            fp = fp_fn() if callable(fp_fn) else None
+            if cache is None or fp is None:
+                return None
+            return WarmupManifest(WarmupManifest.default_path(
+                cache.directory, self.metrics.name, fp))
+        except Exception:  # noqa: BLE001 - the manifest is an
+            return None    # optimization artifact, never a hard dep
+
+    @property
+    def warmup_manifest(self):
+        """The live WarmupManifest (or None): runtime-dispatched batch
+        signatures, written through to disk as they first appear."""
+        return self._manifest
 
     def _health(self):
         """Healthy while accepting traffic: not shut down, and if the
@@ -404,6 +437,34 @@ class InferenceServer:
             req.future.result()    # surface warmup failures loudly
         return fresh
 
+    def warmup_from_manifest(self, path: Optional[str] = None) -> int:
+        """Replay the persisted warmup manifest: pre-compile exactly the
+        padded batch signatures a previous process dispatched (each one
+        a persistent-cache load when ``FLAGS_compile_cache_dir`` is
+        warm) instead of the full theoretical lattice. Returns the
+        fresh-compile count like ``warmup``; 0 when no manifest exists.
+        Signatures recorded under a larger ``max_batch_size`` than this
+        server's are skipped — they cannot occur here."""
+        if path is not None:
+            from ..compile_cache import WarmupManifest
+            manifest = WarmupManifest(path)
+        else:
+            manifest = self._manifest
+        if manifest is None:
+            return 0
+        fresh = 0
+        for spec in manifest.specs():
+            arrs = [np.zeros(tuple(shape), dtype)
+                    for shape, dtype in spec["feeds"]]
+            rows = int(arrs[0].shape[0]) if arrs[0].ndim else 1
+            if rows > self.max_batch_size:
+                continue
+            req = Request(arrs, rows, self.policy.signature(arrs))
+            fresh += self._execute([req], record_latency=False,
+                                   record_traffic=False)
+            req.future.result()    # surface replay failures loudly
+        return fresh
+
     # ------------------------------------------------------ execution
     def _loop(self):
         with self._lock:
@@ -471,7 +532,19 @@ class InferenceServer:
         cache_key = (sig, padded_rows)
         miss = cache_key not in self._compiled
         self._compiled.add(cache_key)
+        # counted on EVERY dispatch (runtime included), not just during
+        # warmup — steady-state traffic shows up as a stream of hits,
+        # so a dashboard can tell "compile-free" from "no data"
         self.metrics.observe_compile(hit=not miss, signature=cache_key)
+        if record_traffic and self._manifest is not None and \
+                cache_key not in self._manifest_recorded:
+            # first TRAFFIC dispatch of this signature (whether or not
+            # warmup pre-compiled it): persist it so a restarted server
+            # pre-warms exactly the lattice real traffic lands on
+            self._manifest_recorded.add(cache_key)
+            self._manifest.record(
+                [((padded_rows,) + tuple(shape), str(np.dtype(dtype)))
+                 for dtype, shape in sig])
 
         rows_list = [r.rows for r in batch]
         n_pad = padded_rows - rows

@@ -1,0 +1,487 @@
+"""Persistent compile cache (paddle_tpu.compile_cache, ISSUE 5).
+
+Covers the satellite-mandated properties: cache-key stability (same
+fn/shape -> hit; changed flag, dtype, or mesh -> miss), corruption and
+concurrent-writer tolerance (evict-and-recompile, never crash), plus
+the three wired compile sites (to_static, TrainStep, serving) and the
+warmup manifest record/replay cycle.
+"""
+import json
+import os
+import pickle
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import compile_cache as cc
+
+
+@pytest.fixture()
+def cache_dir(tmp_path):
+    d = str(tmp_path / "ccache")
+    paddle.set_flags({"FLAGS_compile_cache_dir": d})
+    cc.reset_default_cache()
+    yield d
+    paddle.set_flags({"FLAGS_compile_cache_dir": ""})
+    cc.reset_default_cache()
+
+
+def _delta(before, after, *keys):
+    return {k: after[k] - before[k] for k in keys}
+
+
+# ---------------------------------------------------------------- keys
+class TestCacheKey:
+    def test_same_fn_same_shape_same_key(self):
+        def f(x):
+            return x * 2
+
+        fp = cc.function_fingerprint(f)
+        x = np.ones((4, 8), np.float32)
+        k1, _ = cc.cache_key(fp, [x])
+        k2, _ = cc.cache_key(fp, [np.zeros((4, 8), np.float32)])
+        assert k1 == k2  # values don't matter, shapes/dtypes do
+
+    def test_dtype_and_shape_change_key(self):
+        def f(x):
+            return x * 2
+
+        fp = cc.function_fingerprint(f)
+        x = np.ones((4, 8), np.float32)
+        k, _ = cc.cache_key(fp, [x])
+        k_dtype, _ = cc.cache_key(fp, [x.astype(np.float64)])
+        k_shape, _ = cc.cache_key(fp, [np.ones((4, 9), np.float32)])
+        assert k != k_dtype and k != k_shape and k_dtype != k_shape
+
+    def test_flag_changes_key(self):
+        def f(x):
+            return x * 2
+
+        fp = cc.function_fingerprint(f)
+        x = np.ones((2,), np.float32)
+        k1, _ = cc.cache_key(fp, [x])
+        old = paddle.get_flags("FLAGS_tpu_matmul_precision")[
+            "FLAGS_tpu_matmul_precision"]
+        try:
+            paddle.set_flags({"FLAGS_tpu_matmul_precision": "highest"
+                              if old != "highest" else "default"})
+            k2, _ = cc.cache_key(fp, [x])
+        finally:
+            paddle.set_flags({"FLAGS_tpu_matmul_precision": old})
+        assert k1 != k2
+
+    def test_mesh_changes_key(self):
+        def f(x):
+            return x * 2
+
+        fp = cc.function_fingerprint(f)
+        x = np.ones((8,), np.float32)
+        devs = np.array(jax.devices())
+        mesh_a = jax.sharding.Mesh(devs.reshape(-1), ("dp",))
+        mesh_b = jax.sharding.Mesh(devs.reshape(2, -1), ("dp", "mp"))
+        k_none, _ = cc.cache_key(fp, [x], mesh=None)
+        k_a, _ = cc.cache_key(fp, [x], mesh=mesh_a)
+        k_b, _ = cc.cache_key(fp, [x], mesh=mesh_b)
+        assert len({k_none, k_a, k_b}) == 3
+
+    def test_function_identity_changes_key(self):
+        def f(x):
+            return x * 2
+
+        def g(x):
+            return x * 3
+
+        x = np.ones((2,), np.float32)
+        k_f, _ = cc.cache_key(cc.function_fingerprint(f), [x])
+        k_g, _ = cc.cache_key(cc.function_fingerprint(g), [x])
+        assert k_f != k_g
+
+    def test_tree_structure_changes_key(self):
+        fp = "fixed"
+        x = np.ones((2,), np.float32)
+        k_list, _ = cc.cache_key(fp, [x, x])
+        k_dict, _ = cc.cache_key(fp, {"a": x, "b": x})
+        assert k_list != k_dict
+
+    def test_extra_and_mark_compile_relevant(self):
+        fp = "fixed"
+        x = np.ones((2,), np.float32)
+        k1, _ = cc.cache_key(fp, [x], extra={"site": "a"})
+        k2, _ = cc.cache_key(fp, [x], extra={"site": "b"})
+        assert k1 != k2
+        name = cc.mark_compile_relevant("serving_pipeline_depth")
+        try:
+            k3, parts = cc.cache_key(fp, [x], extra={"site": "a"})
+            assert name in parts["flags"]
+            assert k3 != k1  # the flag set itself is part of the key
+        finally:
+            cc.fingerprint._COMPILE_RELEVANT_FLAGS.discard(name)
+
+
+# --------------------------------------------------------------- store
+class TestStoreAndCache:
+    def test_roundtrip_across_instances(self, cache_dir):
+        def f(x):
+            return jax.numpy.tanh(x) + 1
+
+        fp = cc.function_fingerprint(f)
+        x = np.full((3, 3), 0.5, np.float32)
+        key, parts = cc.cache_key(fp, [x])
+        jitted = jax.jit(f)
+        cache = cc.default_cache()
+        before = cc.stats()
+        fn1, hit1 = cache.get_or_compile(
+            key, lambda: jitted.lower(x).compile(), site="test",
+            meta=parts)
+        assert not hit1
+        # a brand-new CompileCache over the same dir = a fresh process
+        cache2 = cc.CompileCache(cache_dir)
+        fn2, hit2 = cache2.get_or_compile(
+            key, lambda: jitted.lower(x).compile(), site="test")
+        assert hit2
+        np.testing.assert_allclose(np.asarray(fn1(x)), np.asarray(fn2(x)))
+        d = _delta(before, cc.stats(), "hits", "misses", "stored")
+        assert d == {"hits": 1, "misses": 1, "stored": 1}
+
+    def test_corrupt_entry_evicts_and_recompiles(self, cache_dir):
+        def f(x):
+            return x * 4
+
+        fp = cc.function_fingerprint(f)
+        x = np.ones((2, 2), np.float32)
+        key, _ = cc.cache_key(fp, [x])
+        cache = cc.default_cache()
+        jitted = jax.jit(f)
+        cache.get_or_compile(key, lambda: jitted.lower(x).compile(),
+                             site="test")
+        path = cache.store_backend.path_for(key)
+        with open(path, "wb") as fh:
+            fh.write(b"\x00garbage not a pickle")
+        before = cc.stats()
+        fn, hit = cache.get_or_compile(
+            key, lambda: jitted.lower(x).compile(), site="test")
+        assert not hit  # evicted + recompiled, never a crash
+        np.testing.assert_allclose(np.asarray(fn(x)), 4.0)
+        assert cc.stats()["errors"] == before["errors"] + 1
+
+    def test_truncated_pickle_payload_tolerated(self, cache_dir):
+        """A record that unpickles but whose payload is garbage must
+        also evict-and-miss (the deserialize tier of corruption)."""
+        cache = cc.default_cache()
+        cache.store_backend.put("deadbeef", {
+            "kind": "executable", "payload": b"not an executable",
+            "meta": None})
+        assert cache.load("deadbeef", site="test") is None
+        assert not os.path.exists(cache.store_backend.path_for("deadbeef"))
+
+    def test_lru_eviction_bounds_size(self, tmp_path):
+        store = cc.CacheStore(str(tmp_path / "s"), max_bytes=4096)
+        big = b"x" * 1500
+        store.put("k1", {"kind": "raw", "payload": big, "meta": None})
+        store.put("k2", {"kind": "raw", "payload": big, "meta": None})
+        os.utime(store.path_for("k1"))  # k1 recently used -> keep
+        store.put("k3", {"kind": "raw", "payload": big, "meta": None})
+        keys = {k for k, _, _ in store.entries()}
+        assert "k3" in keys and len(keys) <= 2
+        assert store.total_bytes() <= 4096
+        # the just-written key survives its own write even if oversized
+        store2 = cc.CacheStore(str(tmp_path / "s2"), max_bytes=10)
+        store2.put("only", {"kind": "raw", "payload": big, "meta": None})
+        assert [k for k, _, _ in store2.entries()] == ["only"]
+
+    def test_concurrent_writers_same_key(self, cache_dir):
+        """N threads racing get_or_compile on one key: no crash, the
+        entry stays loadable, every thread gets a working callable."""
+        def f(x):
+            return x - 1
+
+        fp = cc.function_fingerprint(f)
+        x = np.ones((2,), np.float32)
+        key, _ = cc.cache_key(fp, [x])
+        jitted = jax.jit(f)
+        results, errors = [], []
+
+        def worker():
+            try:
+                cache = cc.CompileCache(cc.default_cache().directory)
+                fn, _ = cache.get_or_compile(
+                    key, lambda: jitted.lower(x).compile(), site="race")
+                results.append(float(np.asarray(fn(x))[0]))
+            except Exception as e:  # noqa: BLE001 - the assertion
+                errors.append(e)
+        threads = [threading.Thread(target=worker) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert all(r == 0.0 for r in results)
+        assert cc.default_cache().load(key, site="race") is not None
+
+    def test_stablehlo_fallback_tier(self, cache_dir, monkeypatch):
+        """When executable serialization is unsupported (the non-CPU
+        fallback the ISSUE names), the exported-StableHLO tier stores
+        the traced program instead; a load skips the retrace."""
+        from jax import export as jexport
+        from jax.experimental import serialize_executable as se
+
+        def boom(*a, **k):
+            raise NotImplementedError("no executable serialization")
+
+        monkeypatch.setattr(se, "serialize", boom)
+
+        def f(x):
+            return x * 5
+
+        x = np.ones((2,), np.float32)
+        jitted = jax.jit(f)
+        exported = jexport.export(jitted)(
+            jax.ShapeDtypeStruct(x.shape, x.dtype))
+        cache = cc.default_cache()
+        key, _ = cc.cache_key(cc.function_fingerprint(f), [x])
+        kind = cache.store(key, jitted.lower(x).compile(),
+                           site="test", exported_fallback=lambda: exported)
+        assert kind == "stablehlo"
+        monkeypatch.undo()
+        fn = cache.load(key, site="test")
+        assert fn is not None
+        np.testing.assert_allclose(np.asarray(fn(x)), 5.0)
+
+
+# ------------------------------------------------------------ manifest
+class TestWarmupManifest:
+    def test_record_replay_roundtrip(self, tmp_path):
+        path = str(tmp_path / "m.json")
+        m = cc.WarmupManifest(path)
+        assert len(m) == 0
+        assert m.record([((4, 8), "float32"), ((4,), "int64")])
+        assert not m.record([((4, 8), "float32"), ((4,), "int64")])
+        m2 = cc.WarmupManifest(path)  # fresh process
+        assert len(m2) == 1
+        spec = m2.specs()[0]
+        assert spec["feeds"] == [((4, 8), "float32"), ((4,), "int64")]
+
+    def test_corrupt_manifest_starts_empty(self, tmp_path):
+        path = str(tmp_path / "m.json")
+        with open(path, "w") as f:
+            f.write("{not json")
+        m = cc.WarmupManifest(path)
+        assert len(m) == 0
+        assert m.record([((1, 2), "float32")])  # and recovers on write
+        assert len(cc.WarmupManifest(path)) == 1
+
+    def test_version_skew_starts_empty(self, tmp_path):
+        path = str(tmp_path / "m.json")
+        with open(path, "w") as f:
+            json.dump({"version": 99, "entries": [{"feeds": []}]}, f)
+        assert len(cc.WarmupManifest(path)) == 0
+
+    def test_default_path_sanitizes_name(self, tmp_path):
+        p = cc.WarmupManifest.default_path(str(tmp_path), "a/b c", "f" * 64)
+        assert "/warmup/" in p.replace(os.sep, "/")
+        assert "a_b_c-" + "f" * 16 in os.path.basename(p)
+
+
+# --------------------------------------------------------- wired sites
+def _tiny_model():
+    paddle.seed(0)
+    return nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+
+
+class TestTrainStepSite:
+    def test_second_instance_hits_and_matches(self, cache_dir):
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.jit import TrainStep
+
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(4, 8).astype("float32"))
+        y = paddle.to_tensor(np.arange(4, dtype="int64") % 4)
+
+        def build():
+            net = _tiny_model()
+            opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                         parameters=net.parameters())
+            return TrainStep(net, lambda o, t: F.cross_entropy(o, t), opt)
+
+        before = cc.stats()
+        l1 = float(build()(x, y).numpy())
+        mid = cc.stats()
+        assert _delta(before, mid, "misses")["misses"] >= 1
+        l2 = float(build()(x, y).numpy())
+        after = cc.stats()
+        assert _delta(mid, after, "hits")["hits"] >= 1
+        assert _delta(mid, after, "misses")["misses"] == 0
+        assert abs(l1 - l2) < 1e-6  # cached executable: same numerics
+
+    def test_different_batch_shape_is_new_entry(self, cache_dir):
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.jit import TrainStep
+
+        net = _tiny_model()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=net.parameters())
+        step = TrainStep(net, lambda o, t: F.cross_entropy(o, t), opt)
+        rng = np.random.RandomState(0)
+        before = cc.stats()
+        step(paddle.to_tensor(rng.randn(2, 8).astype("float32")),
+             paddle.to_tensor(np.zeros(2, "int64")))
+        step(paddle.to_tensor(rng.randn(6, 8).astype("float32")),
+             paddle.to_tensor(np.zeros(6, "int64")))
+        assert _delta(before, cc.stats(), "misses")["misses"] >= 2
+
+
+class TestToStaticSite:
+    def test_no_grad_eval_hits_across_instances(self, cache_dir):
+        x = paddle.to_tensor(np.random.RandomState(1)
+                             .randn(2, 8).astype("float32"))
+        before = cc.stats()
+        with paddle.no_grad():
+            o1 = paddle.jit.to_static(_tiny_model().eval())(x).numpy()
+        mid = cc.stats()
+        assert _delta(before, mid, "misses")["misses"] >= 1
+        with paddle.no_grad():
+            o2 = paddle.jit.to_static(_tiny_model().eval())(x).numpy()
+        after = cc.stats()
+        assert _delta(mid, after, "hits")["hits"] >= 1
+        np.testing.assert_allclose(o1, o2, rtol=1e-6)
+
+    def test_grad_path_bypasses_cache_and_still_works(self, cache_dir):
+        net = _tiny_model()
+        st = paddle.jit.to_static(net)
+        x = paddle.to_tensor(np.random.RandomState(2)
+                             .randn(2, 8).astype("float32"))
+        out = st(x)
+        out.sum().backward()
+        assert net[0].weight.grad is not None  # vjp path untouched
+
+
+class TestServingSite:
+    def _export(self, tmp_path):
+        net = _tiny_model().eval()
+        prefix = str(tmp_path / "m")
+        paddle.jit.save(net, prefix, input_spec=[
+            paddle.static.InputSpec([None, 8], "float32", "x")],
+            pdmodel_format=False)
+        return prefix
+
+    def test_warmup_populates_then_restart_loads(self, cache_dir,
+                                                 tmp_path):
+        from paddle_tpu import inference, serving
+
+        prefix = self._export(tmp_path)
+        pred = inference.create_predictor(inference.Config(prefix))
+        srv = serving.InferenceServer(pred, max_batch_size=4, name="t_cc",
+                                      start=False, pipeline_depth=0)
+        before = cc.stats()
+        srv.warmup()
+        mid = cc.stats()
+        lattice = len(srv.bucket_specs())
+        assert _delta(before, mid, "misses")["misses"] == lattice
+        srv.start()
+        srv.submit([np.zeros((1, 8), np.float32)]).result(timeout=30)
+        assert len(srv.warmup_manifest) == 1  # traffic recorded
+        srv.shutdown()
+
+        # "restart": fresh predictor/server over the same artifact
+        cc.reset_default_cache()
+        pred2 = inference.create_predictor(inference.Config(prefix))
+        srv2 = serving.InferenceServer(pred2, max_batch_size=4,
+                                       name="t_cc", start=False,
+                                       pipeline_depth=0)
+        before2 = cc.stats()
+        replayed = srv2.warmup_from_manifest()
+        after2 = cc.stats()
+        assert replayed == 1
+        d = _delta(before2, after2, "hits", "misses")
+        assert d == {"hits": 1, "misses": 0}
+        srv2.start()
+        srv2.submit([np.zeros((1, 8), np.float32)]).result(timeout=30)
+        srv2.shutdown()
+
+    def test_runtime_dispatch_counts_compile_hits(self, cache_dir,
+                                                  tmp_path):
+        """Satellite: steady-state traffic must move the serving
+        compile counters (hits at runtime dispatch), not only
+        warmup()."""
+        from paddle_tpu import inference, serving
+
+        prefix = self._export(tmp_path)
+        pred = inference.create_predictor(inference.Config(prefix))
+        srv = serving.InferenceServer(pred, max_batch_size=4,
+                                      name="t_cc_rt", start=False,
+                                      pipeline_depth=0)
+        srv.warmup()
+        hits0 = srv.metrics.snapshot()["compile_cache"]["hits"]
+        srv.start()
+        for _ in range(3):
+            srv.submit([np.zeros((1, 8), np.float32)]).result(timeout=30)
+        snap = srv.metrics.snapshot()["compile_cache"]
+        assert snap["hits"] >= hits0 + 1  # runtime dispatches counted
+        srv.shutdown()
+
+    def test_auto_warmup_from_manifest_flag(self, cache_dir, tmp_path):
+        from paddle_tpu import inference, serving
+
+        prefix = self._export(tmp_path)
+        pred = inference.create_predictor(inference.Config(prefix))
+        srv = serving.InferenceServer(pred, max_batch_size=4,
+                                      name="t_cc_auto", start=False,
+                                      pipeline_depth=0)
+        srv.start()
+        srv.submit([np.zeros((2, 8), np.float32)]).result(timeout=30)
+        srv.shutdown()
+        try:
+            paddle.set_flags({"FLAGS_serving_warmup_from_manifest": True})
+            pred2 = inference.create_predictor(inference.Config(prefix))
+            before = cc.stats()
+            srv2 = serving.InferenceServer(pred2, max_batch_size=4,
+                                           name="t_cc_auto", start=False,
+                                           pipeline_depth=0)
+            assert _delta(before, cc.stats(), "hits")["hits"] == 1
+            srv2.shutdown()
+        finally:
+            paddle.set_flags({"FLAGS_serving_warmup_from_manifest": False})
+
+    def test_disabled_cache_changes_nothing(self, tmp_path):
+        from paddle_tpu import inference, serving
+
+        assert cc.default_cache() is None  # flag empty by default
+        prefix = self._export(tmp_path)
+        pred = inference.create_predictor(inference.Config(prefix))
+        srv = serving.InferenceServer(pred, max_batch_size=4,
+                                      name="t_cc_off", start=False,
+                                      pipeline_depth=0)
+        assert srv.warmup_manifest is None
+        assert srv.warmup_from_manifest() == 0
+        srv.start()
+        out = srv.submit([np.zeros((1, 8), np.float32)]).result(timeout=30)
+        assert out[0].shape == (1, 4)
+        srv.shutdown()
+
+
+class TestMetricsExposition:
+    def test_families_in_prometheus_text(self, cache_dir):
+        def f(x):
+            return x + 1
+
+        x = np.ones((2,), np.float32)
+        key, _ = cc.cache_key(cc.function_fingerprint(f), [x])
+        cache = cc.default_cache()
+        cache.get_or_compile(key, lambda: jax.jit(f).lower(x).compile(),
+                             site="expo")
+        cache.get_or_compile(key, lambda: jax.jit(f).lower(x).compile(),
+                             site="expo")
+        from paddle_tpu.observability import prometheus_text
+        text = prometheus_text()
+        assert "paddle_compile_cache_hits_total" in text
+        assert "paddle_compile_cache_misses_total" in text
+        assert 'site="expo"' in text
+
+    def test_stats_keys(self):
+        s = cc.stats()
+        assert set(s) >= {"hits", "misses", "errors", "evictions",
+                          "stored", "bytes", "entries"}
