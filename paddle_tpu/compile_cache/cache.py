@@ -17,6 +17,10 @@ families on the default observability registry:
     paddle_compile_cache_hits_total{site=}      persistent-cache hits
     paddle_compile_cache_misses_total{site=}    lookups that compiled
     paddle_compile_cache_errors_total{site=,kind=}  corrupt / unserializable
+    paddle_compile_cache_fallbacks_total{site=} stablehlo-tier stores on
+                                                backends that cannot
+                                                serialize executables
+                                                (designed, not an error)
     paddle_compile_cache_evictions_total        LRU evictions
     paddle_compile_cache_stored_total{site=,kind=}  entries written
     paddle_compile_cache_bytes                  on-disk size
@@ -61,6 +65,11 @@ class _Metrics:
             "paddle_compile_cache_errors_total",
             "cache entries evicted as corrupt / failed serializations",
             ("site", "kind"))
+        self.fallbacks = reg.counter(
+            "paddle_compile_cache_fallbacks_total",
+            "stores that skipped the executable tier because this "
+            "backend cannot serialize executables (the StableHLO tier "
+            "is the designed path there — not an error)", ("site",))
         self.evictions = reg.counter(
             "paddle_compile_cache_evictions_total",
             "entries removed by LRU size bounding")
@@ -86,6 +95,32 @@ def _get_metrics() -> _Metrics:
         if _metrics is None:
             _metrics = _Metrics()
         return _metrics
+
+
+# Whether this backend can serialize compiled executables, probed once
+# per process (None = not yet probed). Distinguishes the DESIGNED
+# fallback on backends without serialization support (counted under
+# fallbacks_total) from a genuine serialize failure on a supporting
+# backend (counted under errors_total) — otherwise such backends ring
+# the error alarm once per compile, masking real corruption.
+_serialize_support_lock = threading.Lock()
+_serialize_support: Optional[bool] = None
+
+
+def _serialize_supported() -> bool:
+    global _serialize_support
+    with _serialize_support_lock:
+        if _serialize_support is None:
+            try:
+                import jax
+                from jax.experimental import serialize_executable
+                probe = jax.jit(lambda: 0).lower().compile()
+                serialize_executable.serialize(probe)
+                _serialize_support = True
+            except Exception:  # noqa: BLE001 - any probe failure means
+                # the executable tier is unavailable on this backend
+                _serialize_support = False
+        return _serialize_support
 
 
 class CompileCache:
@@ -166,14 +201,21 @@ class CompileCache:
         Exported or its serialized bytes) provides the traced-lowering
         tier instead."""
         payload, kind = None, None
-        try:
-            from jax.experimental import serialize_executable
-            payload = pickle.dumps(serialize_executable.serialize(compiled),
-                                   protocol=4)
-            kind = KIND_EXECUTABLE
-        except Exception:  # noqa: BLE001 - backend without executable
-            # serialization: fall through to the stablehlo tier
-            self.metrics.errors.labels(site=site, kind="serialize").inc()
+        if _serialize_supported():
+            try:
+                from jax.experimental import serialize_executable
+                payload = pickle.dumps(
+                    serialize_executable.serialize(compiled), protocol=4)
+                kind = KIND_EXECUTABLE
+            except Exception:  # noqa: BLE001 - a genuine serialize
+                # failure on a supporting backend: count it, fall
+                # through to the stablehlo tier
+                self.metrics.errors.labels(site=site,
+                                           kind="serialize").inc()
+        else:
+            # backend without executable serialization: the stablehlo
+            # tier is the designed path, counted as a fallback
+            self.metrics.fallbacks.labels(site=site).inc()
         if payload is None and exported_fallback is not None:
             try:
                 exported = exported_fallback()
@@ -242,10 +284,13 @@ def default_cache() -> Optional[CompileCache]:
 
 
 def reset_default_cache():
-    """Drop the memoized default cache (tests that swap directories)."""
-    global _default
+    """Drop the memoized default cache and the serialize-support probe
+    (tests that swap directories or monkeypatch serialization)."""
+    global _default, _serialize_support
     with _default_lock:
         _default = None
+    with _serialize_support_lock:
+        _serialize_support = None
 
 
 def stats() -> dict:
@@ -261,6 +306,7 @@ def stats() -> dict:
         "hits": total(m.hits),
         "misses": total(m.misses),
         "errors": total(m.errors),
+        "fallbacks": total(m.fallbacks),
         "evictions": total(m.evictions),
         "stored": total(m.stored),
         "bytes": int(m.bytes.value),

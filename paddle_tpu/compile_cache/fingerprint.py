@@ -2,23 +2,33 @@
 
 A cached executable is only reusable when EVERYTHING that shaped the
 compilation is identical: the traced Python (function/model source),
-the abstract operands (shapes, dtypes, weak types, shardings), the
-device mesh, the compile-relevant ``FLAGS_*`` values, and the
-jax/jaxlib + backend versions. The reference framework's program cache
-keys on (ProgramDesc, place, scope) for the same reason
+the constants the trace bakes in (closure cells, referenced globals,
+helper-function bodies, layer constructor hyperparameters), the
+abstract operands (shapes, dtypes, weak types, shardings), the device
+mesh, the compile-relevant ``FLAGS_*`` values, and the jax/jaxlib +
+backend versions. The reference framework's program cache keys on
+(ProgramDesc, place, scope) for the same reason
 (/root/reference/python/paddle/fluid/executor.py program cache); here
 the key is a sha256 over a canonical JSON of all of the above, so a
 key collision requires a semantically identical compile.
 
 Fingerprints never require tracing — a cache HIT must skip both the
 Python trace and the XLA compile, so everything here is derived from
-source text, object structure, and flag values alone.
+source text, object structure, and flag values alone. The environment
+walk (``_callable_fp``) is depth-bounded: constants reachable only
+through more than ``_MAX_DEPTH`` levels of helper calls fall out of
+the key, erring toward a spurious MISS (a recompile), never a false
+hit. The remaining deliberate gap is state a trace reads from outside
+the function/layer object graph entirely (e.g. a file, an env var at
+trace time) — keep such reads out of traced code (pdlint TS005 flags
+them) or fold them into the key via ``cache_key(extra=...)``.
 """
 from __future__ import annotations
 
 import hashlib
 import inspect
 import json
+import types
 from typing import Any, Dict, Optional, Tuple
 
 __all__ = [
@@ -77,49 +87,175 @@ def bytes_fingerprint(data: bytes) -> str:
     return hashlib.sha256(data).hexdigest()
 
 
-def function_fingerprint(fn) -> str:
-    """Identity hash of a Python callable: qualified name + source text
-    (falling back to bytecode + consts for source-less callables, e.g.
-    lambdas defined in a REPL)."""
+# How many levels of (closure / global / callee) indirection the
+# fingerprint walk follows before describing a value by type alone.
+_MAX_DEPTH = 3
+
+_PRIMITIVES = (type(None), bool, int, float, complex, str, bytes)
+
+
+def _const_token(c) -> str:
+    """repr of one co_consts entry, with nested code objects replaced
+    by a bytecode hash — their default repr embeds a memory address,
+    which would key the same lambda apart across processes."""
+    if isinstance(c, types.CodeType):
+        return "code:" + hashlib.sha256(c.co_code).hexdigest()
+    return repr(c)
+
+
+def _collect_global_names(code, out: set):
+    out.update(code.co_names)
+    for c in code.co_consts:
+        if isinstance(c, types.CodeType):
+            _collect_global_names(c, out)
+
+
+def _value_desc(v, seen: set, depth: int) -> str:
+    """Deterministic description of a trace-baked constant: primitives
+    by repr, arrays by content hash, callables by recursive
+    fingerprint, containers element-wise; anything whose repr would be
+    address-dependent degrades to its type identity (a spurious miss,
+    never a false hit)."""
+    if isinstance(v, _PRIMITIVES):
+        return repr(v)
+    if depth <= 0:
+        return f"deep:{type(v).__module__}.{type(v).__qualname__}"
+    if isinstance(v, types.ModuleType):
+        return f"mod:{v.__name__}"
+    if isinstance(v, type):
+        parts = [f"{v.__module__}.{v.__qualname__}"]
+        try:
+            parts.append(inspect.getsource(v))
+        except (OSError, TypeError):
+            pass
+        return "cls:" + _sha(parts)
+    data = getattr(v, "_data", None)       # paddle Tensor/Parameter
+    if data is not None and hasattr(data, "shape") \
+            and hasattr(data, "dtype"):
+        v = data
+    if hasattr(v, "shape") and hasattr(v, "dtype"):
+        try:
+            import numpy as np
+            arr = np.asarray(v)
+            return (f"arr:{arr.shape}:{arr.dtype}:"
+                    f"{hashlib.sha256(arr.tobytes()).hexdigest()}")
+        except Exception:  # noqa: BLE001 - abstract/traced value: no bytes
+            return (f"aval:{tuple(getattr(v, 'shape', ()))}:"
+                    f"{getattr(v, 'dtype', '?')}")
+    if callable(v):
+        return "fn:" + _callable_fp(v, seen, depth)
+    if isinstance(v, dict):
+        items = sorted((repr(k), _value_desc(val, seen, depth - 1))
+                       for k, val in v.items())
+        return "{" + ",".join(f"{k}:{d}" for k, d in items) + "}"
+    if isinstance(v, (list, tuple)):
+        body = ",".join(_value_desc(x, seen, depth - 1) for x in v)
+        return ("[" if isinstance(v, list) else "(") + body + \
+            ("]" if isinstance(v, list) else ")")
+    if isinstance(v, (set, frozenset)):
+        return "{" + ",".join(
+            sorted(_value_desc(x, seen, depth - 1) for x in v)) + "}"
+    r = repr(v)
+    if " at 0x" in r or (r.startswith("<") and "0x" in r):
+        return f"obj:{type(v).__module__}.{type(v).__qualname__}"
+    return f"obj:{type(v).__module__}.{type(v).__qualname__}:{r}"
+
+
+def _callable_fp(fn, seen: set, depth: int) -> str:
+    """Recursive identity of a callable: qualified name + source text
+    (bytecode + consts for source-less callables), plus — down to
+    ``depth`` — the closure cell values, the referenced globals, and
+    thereby the bodies of the helper functions it calls. ``seen`` keys
+    on code objects so mutual recursion terminates."""
     fn = inspect.unwrap(fn)
     target = getattr(fn, "__func__", fn)       # bound method -> function
-    parts = [getattr(target, "__module__", "") or "",
-             getattr(target, "__qualname__", repr(target))]
+    qual = getattr(target, "__qualname__", None) \
+        or getattr(target, "__name__", None) or repr(type(target))
+    label = f"{getattr(target, '__module__', '') or ''}.{qual}"
     code = getattr(target, "__code__", None)
-    if code is not None and target.__name__ == "<lambda>":
+    if code is None:
+        return f"builtin:{label}"
+    if code in seen:
+        return f"rec:{label}"
+    seen.add(code)
+    parts = [label]
+    if target.__name__ == "<lambda>":
         # getsource on a lambda returns the whole surrounding statement,
         # so two identical lambdas on different lines would key apart —
         # the compiled code object is the lambda's real identity
         parts.append(code.co_code.hex())
-        parts.append(repr(code.co_consts))
+        parts.append(",".join(_const_token(c) for c in code.co_consts))
         parts.append(repr(code.co_names))
-        return _sha(parts)
-    try:
-        parts.append(inspect.getsource(target))
-    except (OSError, TypeError):
-        if code is not None:
+    else:
+        try:
+            parts.append(inspect.getsource(target))
+        except (OSError, TypeError):
             parts.append(code.co_code.hex())
-            parts.append(repr(code.co_consts))
-        else:
-            parts.append(repr(target))
+            parts.append(",".join(_const_token(c) for c in code.co_consts))
+    if depth > 0:
+        cells = getattr(target, "__closure__", None) or ()
+        for name, cell in zip(code.co_freevars, cells):
+            try:
+                val = cell.cell_contents
+            except ValueError:           # not yet filled
+                parts.append(f"cell:{name}:<unset>")
+                continue
+            parts.append(f"cell:{name}:{_value_desc(val, seen, depth - 1)}")
+        names: set = set()
+        _collect_global_names(code, names)
+        g = getattr(target, "__globals__", None) or {}
+        for name in sorted(names & set(g)):
+            parts.append(f"g:{name}:{_value_desc(g[name], seen, depth - 1)}")
     return _sha(parts)
+
+
+def function_fingerprint(fn) -> str:
+    """Identity hash of a Python callable: qualified name + source text
+    (falling back to bytecode + consts for source-less callables, e.g.
+    lambdas defined in a REPL), PLUS the trace-baked environment —
+    closure cell values, referenced module-level globals, and
+    (recursively, depth-bounded) the bodies of helper functions it
+    calls. Changing any of these changes the compiled program, so it
+    must change the key."""
+    return _sha(["fnv2", _callable_fp(fn, set(), _MAX_DEPTH)])
+
+
+# Layer bookkeeping that is either keyed elsewhere or trace-irrelevant:
+# parameters/sublayers/buffers are covered structurally below (values
+# ride as operands), and ``training`` is keyed separately by every call
+# site (it selects a different executable, not a different identity).
+_LAYER_INFRA = {"_parameters", "_sub_layers", "_buffers", "training"}
 
 
 def layer_fingerprint(layer) -> str:
     """Identity hash of a Layer tree: the class source of the layer and
-    every distinct sublayer class, plus the parameter/buffer structure
-    (names, shapes, dtypes — values ride as operands, not here)."""
-    seen, parts = set(), []
-    for sub in [layer, *layer.sublayers()]:
+    every distinct sublayer class, the per-instance configuration the
+    trace bakes in (constructor hyperparameters such as stride/padding/
+    epsilon/rate, registered hooks, and any other non-parameter
+    instance attributes, per sublayer path), plus the parameter/buffer
+    structure (names, shapes, dtypes — values ride as operands, not
+    here)."""
+    seen_cls, parts = set(), []
+    subs = [("", layer)]
+    named = getattr(layer, "named_sublayers", None)
+    if named is not None:
+        subs += list(named())
+    else:  # duck-typed layer without traversal: top level only
+        subs += [(str(i), s) for i, s in enumerate(layer.sublayers())]
+    for path, sub in subs:
         cls = type(sub)
-        if cls in seen:
-            continue
-        seen.add(cls)
-        parts.append(f"{cls.__module__}.{cls.__qualname__}")
-        try:
-            parts.append(inspect.getsource(cls))
-        except (OSError, TypeError):
-            pass
+        if cls not in seen_cls:
+            seen_cls.add(cls)
+            parts.append(f"{cls.__module__}.{cls.__qualname__}")
+            try:
+                parts.append(inspect.getsource(cls))
+            except (OSError, TypeError):
+                pass
+        cfg = ";".join(
+            f"{k}={_value_desc(v, set(), 2)}"
+            for k, v in sorted(vars(sub).items())
+            if k not in _LAYER_INFRA)
+        parts.append(f"cfg:{path}:{cls.__qualname__}:{cfg}")
     for name, p in layer.named_parameters():
         parts.append(f"p:{name}:{tuple(p.shape)}:{p._data.dtype}:"
                      f"{bool(p.stop_gradient)}")

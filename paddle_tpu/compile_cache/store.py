@@ -16,6 +16,14 @@ training cold paths depend on:
   total size fits ``max_bytes``. The just-written entry is never
   evicted by its own write, even if oversized — the caller paid for the
   compile and gets to use it at least once.
+
+TRUST: records are unpickled on read (executable payloads are pickled
+``jax.experimental.serialize_executable`` tuples — there is no
+pickle-free wire format for them), so anyone who can write to the
+cache directory can execute code in every process that reads it. The
+store creates the directory private-by-default (0o700) and the
+directory must only ever be one the deploying user trusts — never a
+shared or group-writable path (see ``FLAGS_compile_cache_dir``).
 """
 from __future__ import annotations
 
@@ -42,7 +50,11 @@ class CacheStore:
         self.directory = os.path.abspath(directory)
         self.max_bytes = int(max_bytes)
         self._lock = threading.Lock()
-        os.makedirs(self.directory, exist_ok=True)
+        # private-by-default: entries are unpickled on read, so the
+        # directory is a code-execution surface (module docstring); a
+        # pre-existing directory's mode is the operator's choice and is
+        # never widened or narrowed here
+        os.makedirs(self.directory, mode=0o700, exist_ok=True)
 
     def path_for(self, key: str) -> str:
         return os.path.join(self.directory, key + _SUFFIX)

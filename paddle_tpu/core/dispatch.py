@@ -72,7 +72,18 @@ def apply_op(name: str, jax_fn: Callable, *args, _outputs_stop_grad=None,
     # (the Block.append_op analog; see paddle_tpu/static/program.py).
     from ..static import program as static_program
     if static_program.in_static_mode():
-        out = f(*arrays)
+        # f must execute (now, for shape flow; later, under the
+        # Executor's jitted replay) WITHOUT re-entering recording: a
+        # composite fn (e.g. a to_static jit_program whose first trace
+        # happens here) dispatches further ops while it runs, and those
+        # belong inside THIS op — appending them to the Program would
+        # double-record them and capture trace-time tracers into
+        # Program state (Executor.run guards its replay the same way)
+        static_program._disable_static()
+        try:
+            out = f(*arrays)
+        finally:
+            static_program._enable_static()
         multi_s = isinstance(out, (tuple, list))
         out_leaves_s = list(out) if multi_s else [out]
         wrapped_s = [Tensor(o, stop_gradient=True) for o in out_leaves_s]

@@ -51,6 +51,17 @@ class _Flag:
 
 _REGISTRY: Dict[str, _Flag] = {}
 
+# Monotonic epoch bumped by every set_flags call. In-process memos
+# derived from flag values (e.g. the per-signature AOT-executable memos
+# at the compile-cache sites) key on this, so a flag flip or a
+# repointed FLAGS_compile_cache_dir can never keep serving a stale
+# memoized executable.
+_GENERATION = 0
+
+
+def flags_generation() -> int:
+    return _GENERATION
+
 
 def define_flag(name: str, default, help_: str = ""):
     if not name.startswith("FLAGS_"):
@@ -73,11 +84,13 @@ def get_flags(flags: Union[str, List[str]]) -> Dict[str, Any]:
 
 
 def set_flags(flags: Dict[str, Any]):
+    global _GENERATION
     for k, v in flags.items():
         key = k if k.startswith("FLAGS_") else "FLAGS_" + k
         if key not in _REGISTRY:
             raise ValueError(f"Unknown flag: {k}")
         _REGISTRY[key].set(v)
+    _GENERATION += 1
 
 
 def flag_value(name: str):
@@ -164,7 +177,11 @@ define_flag("FLAGS_compile_cache_dir", "",
             "executables keyed by function/shape/mesh/flag/version "
             "fingerprints); empty = disabled. A warm cache lets a "
             "restarted process skip trace+XLA-compile at every wired "
-            "compile site (jit, TrainStep, serving warmup/dispatch)")
+            "compile site (jit, TrainStep, serving warmup/dispatch). "
+            "TRUSTED PATH ONLY: entries are unpickled on load, so a "
+            "writer to this directory can execute code in every reader "
+            "— it is created 0o700 and must never be shared or "
+            "group-writable")
 define_flag("FLAGS_compile_cache_max_bytes", 1 << 30,
             "size bound for FLAGS_compile_cache_dir: least-recently-"
             "used entries are evicted past this many bytes (0 = "

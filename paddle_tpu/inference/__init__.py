@@ -478,10 +478,12 @@ class Predictor:
         batch signature, or None — the jitted path always remains as
         the fallback. Touches the cache only on the FIRST dispatch of a
         signature; afterwards the in-process memo answers."""
-        from ..framework.flags import flag_value
+        from ..framework.flags import flag_value, flags_generation
         if not str(flag_value("FLAGS_compile_cache_dir") or ""):
             return None
-        sig = (donating,) + tuple(
+        # flags_generation: a set_flags call (flag flip / repointed
+        # cache dir) invalidates the memo, never serving a stale exec
+        sig = (flags_generation(), donating) + tuple(
             (tuple(int(d) for d in a.shape), str(np.dtype(a.dtype)))
             for a in assembled)
         memo = self._aot_execs

@@ -102,12 +102,19 @@ class StaticFunction:
             return self._invoke(*args, **kwargs)
 
     def _will_record(self, tensors) -> bool:
-        """Mirror of apply_op's record condition: True when the call
-        will be differentiated (vjp traced through the callee) — in
-        which case a non-traceable AOT executable must not be
+        """Mirror of apply_op's capture conditions: True when the call
+        will be traced through later — differentiated (vjp through the
+        callee) or recorded into a static Program for jitted replay —
+        in which case a non-traceable AOT executable must not be
         substituted for the jitted function."""
         from ..amp.auto_cast import amp_state
         from ..core import autograd as ag
+        from ..static import program as static_program
+        if static_program.in_static_mode():
+            # apply_op records the callee into the Program; Executor.run
+            # later replays it under jax.jit, and tracing through a
+            # jax.stages.Compiled raises — record the traceable jit fn
+            return True
         if amp_state() is not None:
             # autocast may rewrite operand dtypes at the dispatch
             # boundary, invalidating the shape/dtype key the cached
@@ -161,10 +168,13 @@ class StaticFunction:
         XLA compile a fresh process would otherwise pay."""
         import jax
 
-        from ..framework.flags import flag_value
+        from ..framework.flags import flag_value, flags_generation
         if not str(flag_value("FLAGS_compile_cache_dir") or ""):
             return None
-        sig = (bool(training), tuple(
+        # flags_generation: any set_flags call (a compile-relevant flag
+        # flip, a repointed cache dir) invalidates the memo — the next
+        # call re-derives the key and re-consults the cache
+        sig = (flags_generation(), bool(training), tuple(
             (tuple(getattr(a, "shape", ())),
              str(getattr(a, "dtype", type(a).__name__)))
             for a in jax.tree_util.tree_leaves((params, buffers, arrays))))

@@ -236,13 +236,15 @@ class TrainStep:
         remains). A hit skips BOTH the Python trace and the XLA
         compile; a miss traces once via ``lower`` and persists the
         executable for the next process."""
-        from ..framework.flags import flag_value
+        from ..framework.flags import flag_value, flags_generation
         if not str(flag_value("FLAGS_compile_cache_dir") or ""):
             return None
         multi = self._compiled is getattr(self, "_compiled_multi", None)
         tag = f"multi:{self._multi_n}" if multi else "single"
         leaves = jax.tree_util.tree_leaves(call_args)
-        sig = (tag, tuple(
+        # flags_generation: a set_flags call (flag flip / repointed
+        # cache dir) invalidates the memo, never serving a stale exec
+        sig = (flags_generation(), tag, tuple(
             (tuple(getattr(a, "shape", ())),
              str(getattr(a, "dtype", type(a).__name__)))
             for a in leaves))

@@ -34,6 +34,29 @@ def _delta(before, after, *keys):
     return {k: after[k] - before[k] for k in keys}
 
 
+# module-level constant + function for the trace-baked-globals tests
+_GCONST = 2.0
+
+
+def _g_fn(x):
+    return x * _GCONST
+
+
+def _helper_a(x):
+    return x + 1
+
+
+def _helper_b(x):
+    return x + 2
+
+
+_HELPER = _helper_a
+
+
+def _calls_helper(x):
+    return _HELPER(x)
+
+
 # ---------------------------------------------------------------- keys
 class TestCacheKey:
     def test_same_fn_same_shape_same_key(self):
@@ -120,6 +143,91 @@ class TestCacheKey:
             assert k3 != k1  # the flag set itself is part of the key
         finally:
             cc.fingerprint._COMPILE_RELEVANT_FLAGS.discard(name)
+
+
+# ------------------------------------------- trace-baked constants
+class TestFingerprintCompleteness:
+    """A cached executable bakes in more than the top-level source:
+    closure cells, referenced globals, helper bodies, and layer
+    constructor hyperparameters all shape the lowered program and must
+    all shape the key (REVIEW: a collision here serves wrong numerics
+    from a warm cache)."""
+
+    def test_closure_constant_changes_fingerprint(self):
+        def make(k):
+            def f(x):
+                return x * k
+            return f
+
+        assert cc.function_fingerprint(make(2)) == \
+            cc.function_fingerprint(make(2))
+        assert cc.function_fingerprint(make(2)) != \
+            cc.function_fingerprint(make(3))
+
+    def test_global_constant_changes_fingerprint(self, monkeypatch):
+        import sys
+        mod = sys.modules[__name__]
+        f1 = cc.function_fingerprint(_g_fn)
+        assert f1 == cc.function_fingerprint(_g_fn)  # stable
+        monkeypatch.setattr(mod, "_GCONST", 3.0)
+        assert f1 != cc.function_fingerprint(_g_fn)
+
+    def test_helper_callee_body_changes_fingerprint(self, monkeypatch):
+        """The traced function's own source is unchanged — only the
+        helper it calls through a global differs."""
+        import sys
+        mod = sys.modules[__name__]
+        f1 = cc.function_fingerprint(_calls_helper)
+        monkeypatch.setattr(mod, "_HELPER", _helper_b)
+        assert f1 != cc.function_fingerprint(_calls_helper)
+
+    def test_closure_over_function_changes_fingerprint(self):
+        def make(helper):
+            def f(x):
+                return helper(x)
+            return f
+
+        assert cc.function_fingerprint(make(_helper_a)) != \
+            cc.function_fingerprint(make(_helper_b))
+        assert cc.function_fingerprint(make(_helper_a)) == \
+            cc.function_fingerprint(make(_helper_a))
+
+    def test_layer_hyperparameter_changes_fingerprint(self):
+        """Same class source, same parameter structure — only a
+        constructor hyperparameter the trace bakes in differs."""
+        a = nn.Sequential(nn.Linear(8, 4), nn.Dropout(0.1))
+        b = nn.Sequential(nn.Linear(8, 4), nn.Dropout(0.5))
+        same = nn.Sequential(nn.Linear(8, 4), nn.Dropout(0.1))
+        assert cc.layer_fingerprint(a) != cc.layer_fingerprint(b)
+        assert cc.layer_fingerprint(a) == cc.layer_fingerprint(same)
+
+    def test_custom_layer_attribute_changes_fingerprint(self):
+        class Scaled(nn.Layer):
+            def __init__(self, k):
+                super().__init__()
+                self.k = k
+
+            def forward(self, x):
+                return x * self.k
+
+        assert cc.layer_fingerprint(Scaled(2.0)) != \
+            cc.layer_fingerprint(Scaled(3.0))
+        assert cc.layer_fingerprint(Scaled(2.0)) == \
+            cc.layer_fingerprint(Scaled(2.0))
+
+    def test_array_constant_hashes_by_content(self):
+        class WithConst(nn.Layer):
+            def __init__(self, arr):
+                super().__init__()
+                self.mask = arr       # plain ndarray attr: trace-baked
+
+            def forward(self, x):
+                return x * self.mask
+
+        m1 = cc.layer_fingerprint(WithConst(np.ones(4, np.float32)))
+        m2 = cc.layer_fingerprint(WithConst(np.zeros(4, np.float32)))
+        m3 = cc.layer_fingerprint(WithConst(np.ones(4, np.float32)))
+        assert m1 != m2 and m1 == m3
 
 
 # --------------------------------------------------------------- store
@@ -225,7 +333,10 @@ class TestStoreAndCache:
     def test_stablehlo_fallback_tier(self, cache_dir, monkeypatch):
         """When executable serialization is unsupported (the non-CPU
         fallback the ISSUE names), the exported-StableHLO tier stores
-        the traced program instead; a load skips the retrace."""
+        the traced program instead; a load skips the retrace. The
+        designed fallback counts under ``fallbacks``, NOT ``errors`` —
+        a backend without serialization must not ring the error alarm
+        once per compile."""
         from jax import export as jexport
         from jax.experimental import serialize_executable as se
 
@@ -233,6 +344,9 @@ class TestStoreAndCache:
             raise NotImplementedError("no executable serialization")
 
         monkeypatch.setattr(se, "serialize", boom)
+        # re-probe under the monkeypatch: this process may already have
+        # probed the real (supporting) backend
+        monkeypatch.setattr(cc.cache, "_serialize_support", None)
 
         def f(x):
             return x * 5
@@ -243,13 +357,50 @@ class TestStoreAndCache:
             jax.ShapeDtypeStruct(x.shape, x.dtype))
         cache = cc.default_cache()
         key, _ = cc.cache_key(cc.function_fingerprint(f), [x])
+        before = cc.stats()
         kind = cache.store(key, jitted.lower(x).compile(),
                            site="test", exported_fallback=lambda: exported)
         assert kind == "stablehlo"
+        after = cc.stats()
+        assert after["errors"] == before["errors"]
+        assert after["fallbacks"] == before["fallbacks"] + 1
         monkeypatch.undo()
         fn = cache.load(key, site="test")
         assert fn is not None
         np.testing.assert_allclose(np.asarray(fn(x)), 5.0)
+
+    def test_genuine_serialize_failure_still_counts_error(
+            self, cache_dir, monkeypatch):
+        """On a backend whose probe says serialization works, a real
+        serialize failure is an error, not a fallback."""
+        from jax.experimental import serialize_executable as se
+
+        assert cc.cache._serialize_supported()  # probe the real backend
+
+        def boom(*a, **k):
+            raise RuntimeError("corrupt executable")
+
+        monkeypatch.setattr(se, "serialize", boom)
+
+        def f(x):
+            return x * 7
+
+        x = np.ones((2,), np.float32)
+        key, _ = cc.cache_key(cc.function_fingerprint(f), [x])
+        before = cc.stats()
+        kind = cc.default_cache().store(key, jax.jit(f).lower(x).compile(),
+                                        site="test")
+        assert kind is None
+        after = cc.stats()
+        assert after["errors"] == before["errors"] + 1
+        assert after["fallbacks"] == before["fallbacks"]
+
+    def test_cache_dir_created_private(self, cache_dir):
+        """Entries are unpickled on read: the store must create the
+        directory with no group/other access."""
+        cc.default_cache()  # instantiates the store, creating the dir
+        mode = os.stat(cache_dir).st_mode
+        assert mode & 0o077 == 0
 
 
 # ------------------------------------------------------------ manifest
@@ -357,6 +508,62 @@ class TestToStaticSite:
         out = st(x)
         out.sum().backward()
         assert net[0].weight.grad is not None  # vjp path untouched
+
+    def test_static_mode_never_records_aot_exec(self, cache_dir):
+        """REVIEW: in static-graph mode apply_op records the callee
+        into the Program for jitted replay — substituting the loaded
+        (non-traceable) AOT executable would raise at Executor.run.
+        The second StaticFunction models a warm restarted process: its
+        eager call is served straight from the persistent cache, so the
+        jit function's FIRST trace happens at record time — which must
+        not re-enter recording (tracers would leak into the Program)."""
+        def f(x):
+            return x * 2 + 1
+
+        st = paddle.jit.to_static(f)
+        x_np = np.ones((2, 4), np.float32)
+        with paddle.no_grad():
+            st(paddle.to_tensor(x_np))  # populates the persistent cache
+        st2 = paddle.jit.to_static(f)   # "fresh process": untraced jit
+        with paddle.no_grad():
+            st2(paddle.to_tensor(x_np))  # eager warm: AOT hit, no trace
+        paddle.enable_static()
+        try:
+            prog = paddle.static.Program()
+            with paddle.static.program_guard(prog):
+                x = paddle.static.data("x", [2, 4], "float32")
+                out = st2(x)
+            res = paddle.static.Executor().run(
+                prog, feed={"x": x_np}, fetch_list=[out])[0]
+        finally:
+            paddle.disable_static()
+        np.testing.assert_allclose(res, x_np * 2 + 1)
+
+    def test_flag_flip_invalidates_exec_memo(self, cache_dir):
+        """REVIEW: the per-signature exec memo must not outlive a
+        compile-relevant flag flip — set_flags bumps the generation the
+        memo keys on, forcing a fresh cache consult (which misses under
+        the new flag value)."""
+        st = paddle.jit.to_static(_tiny_model().eval())
+        x = paddle.to_tensor(np.random.RandomState(4)
+                             .randn(2, 8).astype("float32"))
+        with paddle.no_grad():
+            st(x)
+            st(x)  # memo answers; no new cache traffic
+        before = cc.stats()
+        old = paddle.get_flags("FLAGS_tpu_matmul_precision")[
+            "FLAGS_tpu_matmul_precision"]
+        new = "highest" if old != "highest" else "default"
+        try:
+            paddle.set_flags({"FLAGS_tpu_matmul_precision": new})
+            with paddle.no_grad():
+                st(x)
+        finally:
+            paddle.set_flags({"FLAGS_tpu_matmul_precision": old})
+        after = cc.stats()
+        # the memoized executable was NOT silently served: the flipped
+        # flag produced a different key, i.e. a fresh miss + compile
+        assert after["misses"] == before["misses"] + 1
 
 
 class TestServingSite:
