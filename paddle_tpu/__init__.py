@@ -73,6 +73,7 @@ from .hapi import callbacks  # noqa: F401
 from . import distribution  # noqa: F401
 from . import distributed  # noqa: F401
 from . import compile_cache  # noqa: F401
+from . import elastic  # noqa: F401
 from . import framework  # noqa: F401
 from . import incubate  # noqa: F401
 from . import inference  # noqa: F401

@@ -1,9 +1,11 @@
 """Lock-discipline analyzer: unguarded shared state in threaded code.
 
-``paddle_tpu/serving/`` and ``paddle_tpu/observability/`` are the two
-places this codebase runs real threads (batching worker, completion
-thread, telemetry HTTP handlers, collectors). The discipline their
-classes follow — established in PRs 1-3 — is: shared mutable
+``paddle_tpu/serving/``, ``paddle_tpu/observability/`` and
+``paddle_tpu/elastic/`` are the places this codebase runs real threads
+(batching worker, completion thread, telemetry HTTP handlers,
+collectors, the async checkpoint writer + its done callbacks and
+signal handlers). The discipline their classes follow — established in
+PRs 1-3 — is: shared mutable
 attributes are written inside ``with self._lock:``. This analyzer
 flags the drift cases that compile fine and fail only under traffic:
 
@@ -34,7 +36,8 @@ __all__ = ["LockDisciplineAnalyzer"]
 
 _LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
                "BoundedSemaphore"}
-_DEFAULT_DIRS = ("paddle_tpu/serving/", "paddle_tpu/observability/")
+_DEFAULT_DIRS = ("paddle_tpu/serving/", "paddle_tpu/observability/",
+                 "paddle_tpu/elastic/")
 
 
 def _self_attr(node: ast.AST) -> Optional[str]:

@@ -1,0 +1,40 @@
+"""paddle_tpu.elastic — preemption-tolerant training (ROADMAP item 4).
+
+Production TPU pods get preempted; the elastic stance here (SURVEY
+§5.3/§5.4) is job-level restart + bit-identical checkpoint resume:
+
+- :class:`CheckpointManager` captures the FULL training state — params,
+  optimizer slots, LR-scheduler step, global step, dataloader
+  epoch/offset, host+device RNG — on a step or wall-clock cadence, off
+  the critical path, onto the crash-safe atomic checkpoint layer in
+  ``framework.checkpoint`` (staged ``.tmp`` dirs, per-file fsync,
+  manifest-commit rename: a ``kill -9`` at any instant leaves either
+  the previous or the new checkpoint fully intact);
+- :class:`PreemptionHandler` turns SIGTERM/SIGINT into one last
+  bounded-deadline save before conventional termination;
+- ``restore_latest()`` quarantines corrupt/partial directories and
+  falls back, so recovery never dead-ends on save debris;
+- save/restore latency, bytes, last-success step, and steps lost on
+  preemption all land on the shared metric registry, with a
+  checkpoint-staleness check on ``/healthz``.
+
+Paired tooling: ``tools/faultinject.py`` SIGKILLs a real training
+subprocess mid-step / mid-save / mid-commit and asserts the resumed
+loss trajectory is bitwise identical to an uninterrupted run.
+"""
+from .manager import (CheckpointManager, RestoreResult,  # noqa: F401
+                      latest_checkpoint)
+from .preemption import (DEFAULT_PREEMPT_SIGNALS,  # noqa: F401
+                         PreemptionHandler)
+from ..framework.checkpoint import (AsyncCheckpointHandle,  # noqa: F401
+                                    CheckpointCorruptError,
+                                    list_checkpoints, load_sharded,
+                                    prune_checkpoints, save_sharded)
+
+__all__ = [
+    "CheckpointManager", "RestoreResult", "latest_checkpoint",
+    "PreemptionHandler", "DEFAULT_PREEMPT_SIGNALS",
+    "AsyncCheckpointHandle", "CheckpointCorruptError",
+    "list_checkpoints", "load_sharded", "prune_checkpoints",
+    "save_sharded",
+]

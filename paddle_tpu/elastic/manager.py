@@ -1,0 +1,592 @@
+"""CheckpointManager — full-training-state capture with kill-9 recovery.
+
+The elastic stance on TPU (SURVEY §5.3, ROADMAP item 4): pods are not
+survivable, so elasticity = job-level restart + checkpoint resume. This
+manager owns the checkpoint side of that contract on top of the
+crash-safe ``framework.checkpoint`` layer:
+
+- **full state**: parameters (+ buffers), optimizer slots, LR-scheduler
+  step, global step, dataloader epoch/offset, and host+device RNG state
+  — ``restore_latest()`` resumes bit-identically (the fault-injection
+  harness asserts the loss trajectory of a killed-and-resumed run
+  equals an uninterrupted one, bitwise);
+- **off the critical path**: saves snapshot device→host synchronously
+  (cheap) and stage+commit on a writer thread; at most one save is in
+  flight — the next one first waits for (and accounts) the previous;
+- **cadence**: step-interval (``FLAGS_ckpt_interval_steps``) or
+  wall-clock (``FLAGS_ckpt_interval_s``) via ``step()``, which is the
+  one call a training loop adds;
+- **preemption**: ``install_preemption_handlers()`` wires SIGTERM/
+  SIGINT to a bounded-deadline ``final_save`` (see ``preemption.py``);
+- **recovery**: ``restore_latest()`` walks checkpoints newest→oldest,
+  quarantining corrupt/partial directories (``CheckpointCorruptError``
+  → ``<dir>.corrupt-*``) and falling back, so no kill point can leave
+  the job unresumable while any older checkpoint survives;
+- **observability**: ``paddle_ckpt_{save,restore}_ms`` histograms (from
+  the framework layer), ``paddle_ckpt_bytes`` /
+  ``paddle_ckpt_last_success_step`` gauges, ``paddle_ckpt_saves_total``
+  / ``paddle_ckpt_corrupt_total`` / ``paddle_ckpt_steps_lost_total``
+  counters, and a ``/healthz`` staleness check on the PR 3 endpoint.
+
+Steps lost on preemption are measured, not guessed: ``step()`` drops a
+tiny atomic ``PROGRESS`` marker each call, and ``restore_latest()``
+counts ``progress_step - restored_step`` into
+``paddle_ckpt_steps_lost_total``.
+
+Single-writer contract: one live manager per checkpoint directory
+(matching the one-trainer-per-pod reality). Startup sweeps the staging
+debris of any predecessor killed mid-save.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..framework import random as _random
+from ..framework.checkpoint import (AsyncCheckpointHandle,
+                                    CheckpointCorruptError,
+                                    checkpoint_nbytes, list_checkpoints,
+                                    load_checkpoint_extra, load_sharded,
+                                    prune_checkpoints, quarantine_checkpoint,
+                                    save_sharded, sweep_stale_staging)
+from ..framework.flags import define_flag, flag_value
+from .preemption import PreemptionHandler
+
+__all__ = ["CheckpointManager", "RestoreResult", "latest_checkpoint"]
+
+define_flag("FLAGS_ckpt_keep", 3,
+            "checkpoints retained per directory (mtime-LRU: the oldest "
+            "beyond this many committed checkpoints are deleted after "
+            "each successful save; <= 0 keeps everything)")
+define_flag("FLAGS_ckpt_interval_steps", 0,
+            "CheckpointManager.step() saves every this many steps "
+            "(0 = no step-based cadence)")
+define_flag("FLAGS_ckpt_interval_s", 0.0,
+            "CheckpointManager.step() saves when this many seconds "
+            "passed since the last save attempt (0 = no wall-clock "
+            "cadence)")
+define_flag("FLAGS_ckpt_async", True,
+            "stage+commit checkpoint writes on a background thread "
+            "(device->host snapshot is always synchronous, so donation "
+            "or in-place updates after the call never corrupt the "
+            "checkpoint); off = fully synchronous saves")
+define_flag("FLAGS_ckpt_staleness_s", 0.0,
+            "checkpoint /healthz staleness threshold: unhealthy when "
+            "the last committed checkpoint is older than this many "
+            "seconds (0 = auto: 3x FLAGS_ckpt_interval_s when set, "
+            "else 1800)")
+
+_STEP_DIR_FMT = "step_{:08d}"
+_PROGRESS_NAME = "PROGRESS"
+
+_MODEL_PREFIX = "model/"
+_OPT_PREFIX = "opt/"
+_RNG_KEY = "rng/device_key"
+_RNG_NP_KEYS = "rng/np_keys"
+
+
+class RestoreResult:
+    """What ``restore_latest`` recovered. ``step`` is the NEXT step to
+    run (the saved global step); ``steps_lost`` is how far the dead
+    process had progressed beyond it (from the PROGRESS marker)."""
+
+    __slots__ = ("step", "epoch", "offset", "dataloader", "path",
+                 "steps_lost", "restore_ms", "extra")
+
+    def __init__(self, step, epoch, offset, dataloader, path, steps_lost,
+                 restore_ms, extra):
+        self.step = step
+        self.epoch = epoch
+        self.offset = offset
+        self.dataloader = dataloader
+        self.path = path
+        self.steps_lost = steps_lost
+        self.restore_ms = restore_ms
+        self.extra = extra
+
+    def __repr__(self):
+        return (f"RestoreResult(step={self.step}, epoch={self.epoch}, "
+                f"offset={self.offset}, steps_lost={self.steps_lost}, "
+                f"path={self.path!r})")
+
+
+def latest_checkpoint(directory: str) -> Optional[str]:
+    """Newest committed checkpoint directory under ``directory`` (no
+    integrity check — ``restore_latest`` does that), or None."""
+    ckpts = list_checkpoints(directory)
+    return ckpts[-1] if ckpts else None
+
+
+class CheckpointManager:
+    """Periodic, preemption-tolerant training-state checkpointing.
+
+    Typical loop::
+
+        mgr = CheckpointManager(dir, model=model, optimizer=opt,
+                                save_interval_steps=100)
+        res = mgr.restore_latest()
+        start = res.step if res else 0
+        mgr.install_preemption_handlers()
+        for step in range(start, total):
+            train_one_step(...)
+            mgr.step(step + 1, epoch=epoch, offset=batch_idx)
+        mgr.save(total, block=True, reason="final")
+    """
+
+    def __init__(self, directory: str, model=None, optimizer=None, *,
+                 parameters: Optional[Dict[str, Tensor]] = None,
+                 keep: Optional[int] = None,
+                 save_interval_steps: Optional[int] = None,
+                 save_interval_s: Optional[float] = None,
+                 async_save: Optional[bool] = None,
+                 capture_rng: bool = True,
+                 dataloader_state_fn: Optional[Callable[[], dict]] = None,
+                 health_check: bool = True,
+                 staleness_s: Optional[float] = None,
+                 clean_stale_staging: bool = True,
+                 now: Callable[[], float] = time.monotonic):
+        if model is None and optimizer is None and parameters is None:
+            raise ValueError(
+                "CheckpointManager needs at least one of model=, "
+                "optimizer=, parameters= (nothing to checkpoint)")
+        self.directory = os.path.abspath(directory)
+        self._model = model
+        self._optimizer = optimizer
+        self._parameters = dict(parameters) if parameters else None
+        self.keep = int(flag_value("FLAGS_ckpt_keep")
+                        if keep is None else keep)
+        self.save_interval_steps = int(
+            flag_value("FLAGS_ckpt_interval_steps")
+            if save_interval_steps is None else save_interval_steps)
+        self.save_interval_s = float(
+            flag_value("FLAGS_ckpt_interval_s")
+            if save_interval_s is None else save_interval_s)
+        self.async_save = bool(flag_value("FLAGS_ckpt_async")
+                               if async_save is None else async_save)
+        self._capture_rng = capture_rng
+        self._dataloader_state_fn = dataloader_state_fn
+        self._now = now
+        # a signal handler interrupting the main thread mid-call must be
+        # able to re-enter (final_save while step() holds the lock)
+        self._lock = threading.RLock()
+        self._inflight: Optional[AsyncCheckpointHandle] = None
+        self._inflight_step = -1
+        self._inflight_t0 = 0.0
+        self._last_attempt_time: Optional[float] = None
+        self._last_success_step = -1
+        self._last_success_walltime: Optional[float] = None
+        self._last_error: Optional[BaseException] = None
+        self._last_seen = {"step": -1, "epoch": None, "offset": None,
+                           "dataloader": None}
+        self._preemption: Optional[PreemptionHandler] = None
+        self._health_name: Optional[str] = None
+        self._staleness_s = staleness_s
+
+        os.makedirs(self.directory, exist_ok=True)
+        if clean_stale_staging:
+            sweep_stale_staging(self.directory)
+
+        from ..observability.registry import default_registry
+        reg = default_registry()
+        self._m_last_step = reg.gauge(
+            "paddle_ckpt_last_success_step",
+            "global step of the last committed checkpoint")
+        self._m_saves = reg.counter(
+            "paddle_ckpt_saves_total",
+            "checkpoint save attempts by outcome", ("result",))
+        self._m_corrupt = reg.counter(
+            "paddle_ckpt_corrupt_total",
+            "checkpoint directories quarantined as corrupt on restore")
+        self._m_steps_lost = reg.counter(
+            "paddle_ckpt_steps_lost_total",
+            "training steps re-run after restore because they "
+            "post-dated the last committed checkpoint")
+        if health_check:
+            self.enable_health_check()
+
+    # ----------------------------------------------------------- state
+    def _capture(self, step: int, epoch, offset, dataloader_state,
+                 reason: str):
+        """(arrays, extra) for one checkpoint. Arrays stay device-side
+        here — save_sharded host-snapshots them before returning."""
+        arrays: Dict[str, object] = {}
+        extra: Dict[str, object] = {
+            "train": {"step": int(step),
+                      "epoch": None if epoch is None else int(epoch),
+                      "offset": None if offset is None else int(offset),
+                      "wall_time": time.time(),
+                      "reason": reason},
+        }
+        if self._model is not None:
+            for k, v in self._model.state_dict().items():
+                arrays[_MODEL_PREFIX + k] = v
+        if self._parameters is not None:
+            for k, v in self._parameters.items():
+                arrays[_MODEL_PREFIX + k] = v
+        if self._optimizer is not None:
+            opt_scalars: Dict[str, object] = {}
+            for k, v in self._optimizer.state_dict().items():
+                if isinstance(v, Tensor):
+                    arrays[_OPT_PREFIX + k] = v
+                else:  # "@step" int, "LR_Scheduler" dict — JSON-able
+                    opt_scalars[k] = v
+            extra["optimizer"] = opt_scalars
+            params = self._optimizer._parameters or []
+            # accumulator keys embed parameter NAMES; record the order
+            # so restore can remap onto a live optimizer whose params
+            # were minted with different auto-names (same architecture,
+            # different name counter — the in-process restore case)
+            extra["optimizer_param_names"] = [
+                getattr(p, "name", "") for p in params]
+        if self._capture_rng:
+            seed, counter, key_data = _random.default_generator().get_state()
+            arrays[_RNG_KEY] = np.asarray(key_data)
+            np_state = np.random.get_state()
+            arrays[_RNG_NP_KEYS] = np.asarray(np_state[1])
+            extra["rng"] = {"seed": int(seed), "counter": int(counter),
+                            "np": [np_state[0], int(np_state[2]),
+                                   int(np_state[3]), float(np_state[4])]}
+        if dataloader_state is None and self._dataloader_state_fn is not None:
+            dataloader_state = self._dataloader_state_fn()
+        if dataloader_state is not None:
+            extra["dataloader"] = dataloader_state
+        return arrays, extra
+
+    def _apply(self, loaded: Dict[str, Tensor], extra: dict):
+        if self._model is not None:
+            model_sd = {k[len(_MODEL_PREFIX):]: v for k, v in loaded.items()
+                        if k.startswith(_MODEL_PREFIX)}
+            if model_sd:
+                self._model.set_state_dict(model_sd)
+        if self._parameters is not None:
+            for k, p in self._parameters.items():
+                v = loaded.get(_MODEL_PREFIX + k)
+                if v is not None:
+                    p.set_value(v.numpy())
+        if self._optimizer is not None:
+            opt_sd: Dict[str, object] = {
+                k[len(_OPT_PREFIX):]: v for k, v in loaded.items()
+                if k.startswith(_OPT_PREFIX)}
+            opt_sd.update(extra.get("optimizer") or {})
+            saved_names = extra.get("optimizer_param_names")
+            cur = self._optimizer._parameters or []
+            accums = getattr(self._optimizer, "_accum_names", [])
+            if saved_names and len(saved_names) == len(cur):
+                # remap slot keys from saved param names to the live
+                # ones by position (identical names = no-op rename);
+                # exact `<param>_<accum>` matches only, so one name
+                # being a prefix of another cannot mis-route a slot
+                rename = {}
+                for i, old in enumerate(saved_names):
+                    cur_name = getattr(cur[i], "name", "")
+                    if not old or not cur_name:
+                        continue
+                    for acc in accums:
+                        rename[f"{old}_{acc}"] = f"{cur_name}_{acc}"
+                opt_sd = {rename.get(k, k): v for k, v in opt_sd.items()}
+            self._optimizer.set_state_dict(opt_sd)
+        rng = extra.get("rng")
+        if self._capture_rng and rng is not None and _RNG_KEY in loaded:
+            key_data = np.asarray(loaded[_RNG_KEY].numpy())
+            _random.default_generator().set_state(
+                (int(rng["seed"]), int(rng["counter"]), key_data))
+            np_meta = rng.get("np")
+            if np_meta is not None and _RNG_NP_KEYS in loaded:
+                keys = np.asarray(loaded[_RNG_NP_KEYS].numpy())
+                np.random.set_state((np_meta[0], keys, int(np_meta[1]),
+                                     int(np_meta[2]), float(np_meta[3])))
+
+    # ------------------------------------------------------------ save
+    def step(self, step: int, epoch: Optional[int] = None,
+             offset: Optional[int] = None,
+             dataloader_state: Optional[dict] = None
+             ) -> Optional[AsyncCheckpointHandle]:
+        """Per-step hook: records progress (the steps-lost witness) and
+        saves when the step/wall-clock cadence says so. Returns the
+        in-flight handle when a save started."""
+        with self._lock:
+            self._last_seen = {"step": int(step), "epoch": epoch,
+                               "offset": offset,
+                               "dataloader": dataloader_state}
+        self._write_progress(step)
+        if not self._should_save(step):
+            return None
+        return self.save(step, epoch=epoch, offset=offset,
+                         dataloader_state=dataloader_state)
+
+    def _should_save(self, step: int) -> bool:
+        if self.save_interval_steps > 0 and step > 0 and \
+                step % self.save_interval_steps == 0:
+            return True
+        if self.save_interval_s > 0:
+            with self._lock:
+                last = self._last_attempt_time
+            if last is None or self._now() - last >= self.save_interval_s:
+                return True
+        return False
+
+    def _write_progress(self, step: int):
+        """Tiny atomic marker: how far training actually got. Read back
+        on restore to count steps lost to the kill. No fsync — it is a
+        hint, and a torn replace is impossible."""
+        import json
+        tmp = os.path.join(self.directory,
+                           f".{_PROGRESS_NAME}.tmp.{os.getpid()}")
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"step": int(step), "wall_time": time.time()}, f)
+            os.replace(tmp, os.path.join(self.directory, _PROGRESS_NAME))
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def _read_progress(self) -> Optional[int]:
+        import json
+        try:
+            with open(os.path.join(self.directory, _PROGRESS_NAME)) as f:
+                return int(json.load(f)["step"])
+        except Exception:  # noqa: BLE001 - absent/torn marker: no info
+            return None
+
+    def save(self, step: int, epoch: Optional[int] = None,
+             offset: Optional[int] = None,
+             dataloader_state: Optional[dict] = None,
+             block: bool = False, reason: str = "interval"
+             ) -> Optional[AsyncCheckpointHandle]:
+        """Checkpoint the full training state at ``step``. Async by
+        default (manager policy): snapshots host-side now, commits on
+        the writer thread. A previous in-flight save is awaited first —
+        at most one writer at a time, and save errors are recorded (in
+        metrics + ``last_error``) rather than raised, so a sick
+        filesystem degrades durability, not training."""
+        self.wait()  # errors from the previous save land in _last_error
+        arrays, extra = self._capture(step, epoch, offset,
+                                      dataloader_state, reason)
+        path = os.path.join(self.directory, _STEP_DIR_FMT.format(int(step)))
+        t0 = self._now()
+        with self._lock:
+            self._last_attempt_time = t0
+        use_async = self.async_save and not block
+        try:
+            handle = save_sharded(arrays, path, async_save=use_async,
+                                  extra=extra)
+        except Exception as e:  # noqa: BLE001 - record, don't kill train
+            self._record_save_result(step, error=e)
+            return None
+        if handle is None:
+            self._record_save_result(step, error=None)
+            return None
+        with self._lock:
+            self._inflight = handle
+            self._inflight_step = int(step)
+        handle.add_done_callback(self._on_save_done)
+        return handle
+
+    def _on_save_done(self, handle: AsyncCheckpointHandle):
+        with self._lock:
+            if self._inflight is handle:
+                self._inflight = None
+            step = self._inflight_step
+        self._record_save_result(step, error=handle.exception)
+
+    def _record_save_result(self, step: int,
+                            error: Optional[BaseException]):
+        if error is not None:
+            with self._lock:
+                self._last_error = error
+            self._m_saves.labels("error").inc()
+            return
+        with self._lock:
+            self._last_error = None
+            self._last_success_step = int(step)
+            self._last_success_walltime = time.time()
+        self._m_saves.labels("ok").inc()
+        self._m_last_step.set(int(step))
+        if self.keep > 0:
+            prune_checkpoints(self.directory, self.keep)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until no save is in flight. Unlike the raw handle,
+        never raises — writer errors are folded into save accounting by
+        the done callback. Returns False if still in flight."""
+        with self._lock:
+            handle = self._inflight
+        if handle is None:
+            return True
+        try:
+            return handle.wait(timeout)
+        except BaseException:  # noqa: BLE001 - already recorded by the
+            return True        # done callback
+
+    def final_save(self, deadline_s: Optional[float] = None,
+                   reason: str = "preempt") -> bool:
+        """Bounded-deadline last save (the preemption path). Saves the
+        most recently seen step unless it is already committed; waits at
+        most ``deadline_s`` for the commit. Returns True when the state
+        is committed durable."""
+        budget = float("inf") if deadline_s is None else float(deadline_s)
+        t_end = self._now() + budget
+        with self._lock:
+            seen = dict(self._last_seen)
+            done_step = self._last_success_step
+            inflight = self._inflight
+            inflight_step = self._inflight_step
+        step = seen["step"]
+        if step < 0:
+            return False  # never stepped: nothing meaningful to save
+        if done_step == step:
+            return True   # already durable
+        if inflight is not None and inflight_step == step:
+            return inflight.wait(max(0.0, t_end - self._now())) and \
+                inflight.exception is None
+        handle = self.save(step, epoch=seen["epoch"],
+                           offset=seen["offset"],
+                           dataloader_state=seen["dataloader"],
+                           reason=reason)
+        if handle is None:  # sync save (or failed: last_error records it)
+            with self._lock:
+                return self._last_success_step == step
+        ok = handle.wait(max(0.0, t_end - self._now()))
+        return ok and handle.exception is None
+
+    # --------------------------------------------------------- restore
+    def restore_latest(self, mesh=None) -> Optional[RestoreResult]:
+        """Load the newest intact checkpoint into the attached model/
+        optimizer/RNG and return its metadata. Corrupt or partial
+        directories are quarantined (``<dir>.corrupt-*``) and skipped —
+        after any kill, some checkpoint loads or None is returned (the
+        caller starts fresh)."""
+        self.wait()
+        progress = self._read_progress()
+        t0 = self._now()
+        for path in reversed(list_checkpoints(self.directory)):
+            try:
+                loaded = load_sharded(path, mesh=mesh)
+                extra = load_checkpoint_extra(path) or {}
+                self._apply(loaded, extra)
+            except CheckpointCorruptError:
+                self._m_corrupt.inc()
+                quarantine_checkpoint(path)
+                continue
+            train = extra.get("train") or {}
+            step = int(train.get("step", -1))
+            restore_ms = (self._now() - t0) * 1e3
+            steps_lost = max(0, progress - step) \
+                if (progress is not None and step >= 0) else 0
+            if steps_lost:
+                self._m_steps_lost.inc(steps_lost)
+            with self._lock:
+                self._last_success_step = step
+                self._last_success_walltime = time.time()
+            if step >= 0:
+                self._m_last_step.set(step)
+            return RestoreResult(
+                step=step, epoch=train.get("epoch"),
+                offset=train.get("offset"),
+                dataloader=extra.get("dataloader"),
+                path=path, steps_lost=steps_lost,
+                restore_ms=restore_ms, extra=extra)
+        return None
+
+    # ------------------------------------------------------ preemption
+    def install_preemption_handlers(self, signals=None,
+                                    deadline_s: Optional[float] = None
+                                    ) -> PreemptionHandler:
+        """Wire SIGTERM/SIGINT to a bounded final save (then chain to
+        the previous handler, so default termination still happens)."""
+        from .preemption import DEFAULT_PREEMPT_SIGNALS
+        handler = PreemptionHandler(
+            manager=self,
+            signals=DEFAULT_PREEMPT_SIGNALS if signals is None else signals,
+            deadline_s=deadline_s)
+        handler.install()
+        with self._lock:
+            self._preemption = handler
+        return handler
+
+    @property
+    def preempted(self) -> bool:
+        """True once a preemption signal arrived (cooperative loops
+        should drain and exit)."""
+        with self._lock:
+            handler = self._preemption
+        return handler.requested() if handler is not None else False
+
+    # ---------------------------------------------------------- health
+    def enable_health_check(self, staleness_s: Optional[float] = None):
+        """Register checkpoint staleness on the shared /healthz: fails
+        when the last committed checkpoint is older than the threshold
+        (or when the most recent save attempt errored)."""
+        from ..observability.httpd import add_health_check
+        if staleness_s is not None:
+            self._staleness_s = float(staleness_s)
+        name = f"checkpoint:{os.path.basename(self.directory)}"
+        add_health_check(name, self._health)
+        with self._lock:
+            self._health_name = name
+
+    def _staleness_threshold(self) -> float:
+        if self._staleness_s:
+            return float(self._staleness_s)
+        flagged = float(flag_value("FLAGS_ckpt_staleness_s"))
+        if flagged > 0:
+            return flagged
+        if self.save_interval_s > 0:
+            return 3.0 * self.save_interval_s
+        return 1800.0
+
+    def _health(self):
+        with self._lock:
+            err = self._last_error
+            last_wall = self._last_success_walltime
+            last_step = self._last_success_step
+        if err is not None:
+            return False, {"last_error": repr(err),
+                           "last_success_step": last_step}
+        if last_wall is None:
+            return True, {"state": "no checkpoint yet"}
+        age = time.time() - last_wall
+        limit = self._staleness_threshold()
+        return age <= limit, {"last_success_step": last_step,
+                              "age_s": round(age, 3),
+                              "staleness_limit_s": round(limit, 3)}
+
+    # --------------------------------------------------------- teardown
+    @property
+    def last_success_step(self) -> int:
+        with self._lock:
+            return self._last_success_step
+
+    @property
+    def last_error(self) -> Optional[BaseException]:
+        with self._lock:
+            return self._last_error
+
+    def close(self):
+        """Flush the in-flight save, uninstall signal handlers, and
+        drop the health check."""
+        self.wait()
+        with self._lock:
+            handler = self._preemption
+            self._preemption = None
+            health_name = self._health_name
+            self._health_name = None
+        if handler is not None:
+            handler.uninstall()
+        if health_name is not None:
+            from ..observability.httpd import remove_health_check
+            remove_health_check(health_name)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

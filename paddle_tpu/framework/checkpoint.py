@@ -1,29 +1,69 @@
-"""Sharded + async checkpointing keyed by PartitionSpec.
+"""Crash-safe sharded + async checkpointing keyed by PartitionSpec.
 
 Reference: fleet sharded-model save utils
 (/root/reference/python/paddle/distributed/fleet/meta_parallel/sharding/
 group_sharded_utils.py) and auto-parallel distributed save with
 merge-on-load (auto_parallel/dist_saver.py); SURVEY §5.4 prescribes a
-tensorstore-style sharded checkpoint for the TPU build.
+tensorstore-style sharded checkpoint for the TPU build. This module is
+the durable layer under ``paddle_tpu.elastic.CheckpointManager``.
 
-Format (directory):
-  meta.json                  {name: {shape, dtype, spec}}
-  <name>.npy                 the FULL array (host-gathered)
+Format (directory)::
 
-Arrays are gathered host-side at save (exact for any committed jax.Array)
-and re-placed at load against the current global mesh using each entry's
-recorded PartitionSpec — so a checkpoint written under one mesh layout
-restores sharded under another (the reference's merge-on-load +
-re-partition path, compressed into placement by spec). ``async_save``
-snapshots device arrays then writes on a background thread, overlapping
-serialization with the next training steps.
+  meta.json    {"format": 2, "entries": {name: {shape, dtype, spec,
+                file, sha256[, stored_as]}}}   — written LAST
+  extra.json   optional JSON sidecar (training state, RNG scalars)
+  <file>.npy   one host-gathered FULL array per entry
+
+Crash-safety protocol (the part ``elastic`` depends on):
+
+- **host snapshot before return**: every array is copied device→host
+  (``np.asarray``) *before* ``save_sharded`` returns, so a donated or
+  in-place-updated device buffer (``TrainStep`` donation) can never
+  leak post-save values into the checkpoint;
+- **staged atomic commit**: all files are written into a
+  ``<path>.tmp-<token>`` staging directory, each fsync'd, ``meta.json``
+  written last, the directory fsync'd, then ``os.replace``d onto the
+  final path (a directory rename — atomic on POSIX). A ``kill -9`` at
+  ANY instant leaves either the previous checkpoint or the new one
+  fully intact; a torn staging dir is ignored by every reader and swept
+  by the manager on startup;
+- **integrity manifest**: per-array sha256 over the raw bytes, verified
+  on load — a flipped bit or truncated file raises
+  ``CheckpointCorruptError`` (the manager quarantines and falls back to
+  the previous checkpoint) instead of silently loading garbage;
+- **hostile names**: entry names are percent-escaped into flat
+  filenames (``../x`` can no longer escape the checkpoint directory);
+  the escaping is recorded per entry in ``meta.json`` so names round-
+  trip exactly;
+- **non-numpy dtypes**: bf16 / fp8 arrays (``ml_dtypes``) are stored as
+  same-width unsigned views with the true dtype recorded in the
+  manifest — ``np.save`` would otherwise degrade them to opaque void
+  records that load back as raw ``V2`` bytes.
+
+Arrays are gathered host-side at save (exact for any committed
+jax.Array) and re-placed at load against the current global mesh using
+each entry's recorded PartitionSpec — so a checkpoint written under one
+mesh layout restores sharded under another (the reference's
+merge-on-load + re-partition path, compressed into placement by spec).
+``async_save`` hands the staged write + commit to a background thread,
+overlapping serialization with the next training steps.
+
+Fault-injection hooks: when ``PADDLE_CKPT_TEST_SLEEP_S`` is set (test
+harnesses only) the writer emits ``CKPT_WRITE``/``CKPT_COMMIT`` marker
+lines on stdout and sleeps at each, giving ``tools/faultinject.py`` a
+deterministic window to SIGKILL mid-save and mid-commit.
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
+import shutil
 import threading
-from typing import Dict, Optional
+import time
+import uuid
+from typing import Callable, Dict, List, Optional
+from urllib.parse import quote, unquote
 
 import jax
 import numpy as np
@@ -31,7 +71,23 @@ import numpy as np
 from ..core.tensor import Tensor
 from ..distributed.mesh_utils import get_global_mesh
 
-__all__ = ["save_sharded", "load_sharded", "AsyncCheckpointHandle"]
+__all__ = [
+    "save_sharded", "load_sharded", "AsyncCheckpointHandle",
+    "CheckpointCorruptError", "is_checkpoint_dir", "list_checkpoints",
+    "load_checkpoint_extra", "checkpoint_nbytes", "prune_checkpoints",
+    "quarantine_checkpoint", "sweep_stale_staging",
+]
+
+FORMAT_VERSION = 2
+META_NAME = "meta.json"
+EXTRA_NAME = "extra.json"
+_TMP_MARK = ".tmp-"
+_CORRUPT_MARK = ".corrupt-"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint directory is missing, truncated, or fails its
+    integrity manifest — recoverable by falling back to an older one."""
 
 
 def _spec_of(t) -> Optional[list]:
@@ -39,84 +95,486 @@ def _spec_of(t) -> Optional[list]:
     return list(spec) if spec is not None else None
 
 
-class AsyncCheckpointHandle:
-    def __init__(self, thread: threading.Thread):
-        self._thread = thread
-        self.exception = None
+# ------------------------------------------------------------- metrics
+def _metrics():
+    """(save_ms, restore_ms, bytes_gauge) on the default registry —
+    resolved lazily so importing the framework stays cheap and tests
+    that reset the registry always see live families."""
+    from ..observability.registry import default_registry
+    reg = default_registry()
+    return (
+        reg.histogram("paddle_ckpt_save_ms",
+                      "checkpoint save duration, snapshot to commit",
+                      ("mode",)),
+        reg.histogram("paddle_ckpt_restore_ms",
+                      "checkpoint load duration, read to placement"),
+        reg.gauge("paddle_ckpt_bytes",
+                  "total bytes of the last committed checkpoint"),
+    )
 
-    def wait(self):
-        self._thread.join()
-        if self.exception is not None:
+
+# ---------------------------------------------------------- test hooks
+def _test_hook(stage: str, path: str):
+    """Fault-injection point: with PADDLE_CKPT_TEST_SLEEP_S set, print a
+    marker and sleep so an external killer can land a SIGKILL inside a
+    specific save phase. Inert (two dict lookups) in production."""
+    s = os.environ.get("PADDLE_CKPT_TEST_SLEEP_S")
+    if not s:
+        return
+    import sys
+    # single atomic write: the writer thread's marker must not
+    # interleave mid-line with the training loop's own stdout
+    sys.stdout.write(f"CKPT_{stage} {path}\n")
+    sys.stdout.flush()
+    time.sleep(float(s))
+
+
+# ------------------------------------------------------ name / dtype io
+def _fname_for(name: str) -> str:
+    """Flat, filesystem-safe filename for an entry name. Separators and
+    every other non-alphanumeric byte are percent-escaped, so ``../x``
+    or ``a/b`` cannot traverse outside the checkpoint directory."""
+    return quote(name, safe="") + ".npy"
+
+
+def _check_fname(fname: str) -> str:
+    """Reject manifest filenames that could escape the directory —
+    covers legacy (v1) manifests where the raw name was the filename."""
+    if (not fname or fname != os.path.basename(fname)
+            or os.path.isabs(fname) or "/" in fname or "\\" in fname
+            or fname in (".", "..")):
+        raise CheckpointCorruptError(
+            f"unsafe entry filename {fname!r} in checkpoint manifest")
+    return fname
+
+
+def _dtype_is_npy_native(dt: np.dtype) -> bool:
+    """True when np.save/np.load round-trips this dtype exactly.
+    ml_dtypes types (bfloat16, float8_*) serialize as anonymous void
+    records and load back as raw bytes — those go through a view."""
+    try:
+        descr = np.lib.format.dtype_to_descr(dt)
+        return np.lib.format.descr_to_dtype(descr) == dt and dt.kind != "V"
+    except Exception:  # noqa: BLE001 - any descr failure => not native
+        return False
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # ships with jax; registers bf16/fp8 dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _write_json(path: str, obj, fsync: bool = True):
+    with open(path, "w") as f:
+        json.dump(obj, f, indent=1, sort_keys=True)
+        f.flush()
+        if fsync:
+            os.fsync(f.fileno())
+
+
+def _fsync_dir(path: str):
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # platform without dir fds: rename is still atomic
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+# ----------------------------------------------------------- the handle
+class AsyncCheckpointHandle:
+    """Owns the single writer thread of one async save.
+
+    The thread is constructed and started exactly once, in ``__init__``
+    (an earlier revision built a throwaway unstarted thread first, and
+    ``done()`` answered True for it — a never-started thread is not
+    alive). ``done()`` is truthful: it reports whether the write
+    *finished*, via an event the writer sets in a ``finally``, never
+    thread liveness guesses."""
+
+    def __init__(self, target: Callable[[], object]):
+        self.exception: Optional[BaseException] = None
+        self.result = None
+        self._finished = threading.Event()
+        self._cb_lock = threading.Lock()
+        self._callbacks: List[Callable] = []
+
+        def _run():
+            try:
+                self.result = target()
+            except BaseException as e:  # surfaced on wait()
+                self.exception = e
+            finally:
+                self._finished.set()
+                with self._cb_lock:
+                    cbs, self._callbacks = self._callbacks, []
+                for cb in cbs:
+                    try:
+                        cb(self)
+                    except Exception:  # noqa: BLE001 - a broken observer
+                        pass           # must not mask the save result
+
+        self._thread = threading.Thread(
+            target=_run, daemon=True, name="paddle-ckpt-writer")
+        self._thread.start()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Join the writer (bounded when ``timeout`` is given). Returns
+        ``done()``; re-raises the writer's exception once finished."""
+        self._thread.join(timeout)
+        if self._finished.is_set() and self.exception is not None:
             raise self.exception
+        return self._finished.is_set()
 
     def done(self) -> bool:
-        return not self._thread.is_alive()
+        return self._finished.is_set()
+
+    def add_done_callback(self, fn: Callable):
+        """Run ``fn(handle)`` on the writer thread after the save
+        finishes (immediately, on the caller, if it already has)."""
+        with self._cb_lock:
+            if not self._finished.is_set():
+                self._callbacks.append(fn)
+                return
+        fn(self)
+
+
+# --------------------------------------------------------------- save
+def _snapshot(state_dict: Dict[str, Tensor]):
+    """Materialize every array to host NOW and build manifest entries.
+    This runs on the caller's thread before save_sharded returns, which
+    is what makes async saves donation-safe."""
+    entries: Dict[str, dict] = {}
+    hosts: List = []
+    for name, t in state_dict.items():
+        if not isinstance(name, str) or not name:
+            raise ValueError(f"checkpoint entry names must be non-empty "
+                             f"strings, got {name!r}")
+        arr = t._data if isinstance(t, Tensor) else t
+        host = np.asarray(arr)
+        # the snapshot must be a PRIVATE buffer: np.asarray of a numpy
+        # input returns the input itself, and of a CPU jax array can be
+        # a zero-copy view of the device buffer — either way a later
+        # in-place update or donation would mutate "the checkpoint"
+        if host is arr or host.base is not None or \
+                not host.flags["OWNDATA"]:
+            host = np.array(host, copy=True)
+        elif not host.flags["C_CONTIGUOUS"]:
+            host = np.ascontiguousarray(host)
+        dt = np.dtype(host.dtype)
+        ent = {
+            "shape": [int(s) for s in host.shape],
+            "dtype": str(dt),
+            "spec": _spec_of(t),
+            "file": _fname_for(name),
+            "sha256": hashlib.sha256(host.tobytes()).hexdigest(),
+        }
+        if not _dtype_is_npy_native(dt):
+            # store a same-width unsigned view; np.save of ml_dtypes
+            # arrays writes an anonymous '|V2' record that np.load
+            # hands back as raw void bytes (dtype lost)
+            stored = np.dtype(f"u{dt.itemsize}")
+            host = host.view(stored)
+            ent["stored_as"] = str(stored)
+        entries[name] = ent
+        hosts.append((name, host))
+    return entries, hosts
+
+
+def _write_and_commit(tmp_dir: str, path: str, entries, hosts, extra,
+                      fsync: bool = True) -> int:
+    """Write every file into the staging dir (fsync each), manifest
+    last, then atomically rename the directory into place. Returns
+    total bytes committed."""
+    total = 0
+    try:
+        for name, host in hosts:
+            fpath = os.path.join(tmp_dir, entries[name]["file"])
+            _test_hook("WRITE", fpath)
+            with open(fpath, "wb") as f:
+                np.save(f, host, allow_pickle=False)
+                f.flush()
+                if fsync:
+                    os.fsync(f.fileno())
+            total += os.path.getsize(fpath)
+        if extra is not None:
+            epath = os.path.join(tmp_dir, EXTRA_NAME)
+            _write_json(epath, extra, fsync)
+            total += os.path.getsize(epath)
+        mpath = os.path.join(tmp_dir, META_NAME)
+        _write_json(mpath, {"format": FORMAT_VERSION, "entries": entries},
+                    fsync)
+        total += os.path.getsize(mpath)
+        if fsync:
+            _fsync_dir(tmp_dir)
+        _test_hook("COMMIT", path)
+        if os.path.isdir(path):
+            # overwrite-in-place callers (plain save_sharded to a fixed
+            # path): swap via a sidecar so readers of OTHER paths never
+            # see a partial dir. The manager always uses fresh step
+            # dirs, where the single os.replace below is the whole
+            # commit and is atomic against any kill.
+            old = path + f".old-{uuid.uuid4().hex[:8]}"
+            os.replace(path, old)
+            os.replace(tmp_dir, path)
+            shutil.rmtree(old, ignore_errors=True)
+        else:
+            os.replace(tmp_dir, path)
+        if fsync:
+            _fsync_dir(os.path.dirname(path) or ".")
+    except BaseException:
+        shutil.rmtree(tmp_dir, ignore_errors=True)
+        raise
+    return total
 
 
 def save_sharded(state_dict: Dict[str, Tensor], path: str,
-                 async_save: bool = False):
-    """Write a spec-annotated checkpoint directory. Returns an
-    AsyncCheckpointHandle when ``async_save`` (call .wait() before relying
-    on the files)."""
-    os.makedirs(path, exist_ok=True)
-    entries = {}
-    arrays = {}
-    for name, t in state_dict.items():
-        arr = t._data if isinstance(t, Tensor) else t
-        entries[name] = {
-            "shape": [int(s) for s in arr.shape],
-            "dtype": str(np.dtype(arr.dtype)) if not hasattr(
-                arr.dtype, "name") else arr.dtype.name,
-            "spec": _spec_of(t),
-        }
-        arrays[name] = arr  # device handle; materialized by the writer
+                 async_save: bool = False, extra: Optional[dict] = None,
+                 fsync: bool = True):
+    """Write a spec-annotated checkpoint directory atomically.
+
+    Device arrays are snapshotted to host BEFORE this returns (mutating
+    or donating the source tensors afterwards cannot affect the
+    checkpoint). With ``async_save`` the staged write + commit runs on
+    a background thread; returns an :class:`AsyncCheckpointHandle`
+    (call ``.wait()`` to surface errors / block on durability).
+    ``extra`` is an optional JSON-serializable sidecar readable via
+    :func:`load_checkpoint_extra`."""
+    t0 = time.perf_counter()
+    path = os.path.abspath(path)
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    entries, hosts = _snapshot(state_dict)
+    tmp_dir = path + _TMP_MARK + uuid.uuid4().hex[:8]
+    os.makedirs(tmp_dir)
+    mode = "async" if async_save else "sync"
 
     def write():
-        for name, arr in arrays.items():
-            np.save(os.path.join(path, f"{name}.npy"), np.asarray(arr),
-                    allow_pickle=False)
-        with open(os.path.join(path, "meta.json"), "w") as f:
-            json.dump(entries, f, indent=1)
+        total = _write_and_commit(tmp_dir, path, entries, hosts, extra,
+                                  fsync=fsync)
+        try:
+            save_ms, _, bytes_gauge = _metrics()
+            save_ms.labels(mode).observe((time.perf_counter() - t0) * 1e3)
+            bytes_gauge.set(total)
+        except Exception:  # noqa: BLE001 - telemetry must never fail
+            pass           # the save it measures
+        return total
 
     if async_save:
-        handle = AsyncCheckpointHandle(threading.Thread(target=write))
-
-        def run():
-            try:
-                write()
-            except BaseException as e:  # surfaced on wait()
-                handle.exception = e
-
-        handle._thread = threading.Thread(target=run, daemon=True)
-        handle._thread.start()
-        return handle
+        return AsyncCheckpointHandle(write)
     write()
     return None
 
 
-def load_sharded(path: str, mesh=None) -> Dict[str, Tensor]:
-    """Read a checkpoint directory; place each array against ``mesh`` (or
-    the global mesh) by its recorded PartitionSpec. Without a mesh the
-    arrays load replicated/single-device."""
+# --------------------------------------------------------------- load
+def _read_meta(path: str) -> Dict[str, dict]:
+    mpath = os.path.join(path, META_NAME)
+    try:
+        with open(mpath) as f:
+            meta = json.load(f)
+    except FileNotFoundError as e:
+        raise CheckpointCorruptError(
+            f"{path}: no {META_NAME} (uncommitted or not a checkpoint "
+            f"directory)") from e
+    except (OSError, ValueError) as e:
+        raise CheckpointCorruptError(f"{path}: unreadable manifest: "
+                                     f"{e}") from e
+    if not isinstance(meta, dict):
+        raise CheckpointCorruptError(f"{path}: malformed manifest")
+    if "entries" in meta:
+        entries = meta["entries"]
+    else:
+        entries = meta  # format v1: the manifest IS the entry map
+    if not isinstance(entries, dict):
+        raise CheckpointCorruptError(f"{path}: malformed manifest entries")
+    return entries
+
+
+def load_sharded(path: str, mesh=None, verify: bool = True
+                 ) -> Dict[str, Tensor]:
+    """Read a checkpoint directory; place each array against ``mesh``
+    (or the global mesh) by its recorded PartitionSpec. Without a mesh
+    the arrays load replicated/single-device. Raises
+    :class:`CheckpointCorruptError` on a missing/truncated/corrupt
+    directory (``verify`` additionally checks per-array sha256)."""
     from jax.sharding import NamedSharding, PartitionSpec
 
-    with open(os.path.join(path, "meta.json")) as f:
-        meta = json.load(f)
+    t0 = time.perf_counter()
+    path = os.path.abspath(path)
+    entries = _read_meta(path)
     mesh = mesh if mesh is not None else get_global_mesh()
     out = {}
-    for name, ent in meta.items():
-        arr = np.load(os.path.join(path, f"{name}.npy"),
-                      allow_pickle=False)
+    for name, ent in entries.items():
+        if not isinstance(ent, dict):
+            raise CheckpointCorruptError(f"{path}: malformed entry {name!r}")
+        fname = _check_fname(ent.get("file") or f"{name}.npy")
+        fpath = os.path.join(path, fname)
+        try:
+            raw = np.load(fpath, allow_pickle=False)
+        except FileNotFoundError as e:
+            raise CheckpointCorruptError(
+                f"{path}: missing array file {fname!r} for {name!r}") from e
+        except Exception as e:  # noqa: BLE001 - truncated/garbled .npy
+            raise CheckpointCorruptError(
+                f"{path}: unreadable array file {fname!r} for {name!r}: "
+                f"{e}") from e
+        if verify and "sha256" in ent:
+            digest = hashlib.sha256(
+                np.ascontiguousarray(raw).tobytes()).hexdigest()
+            if digest != ent["sha256"]:
+                raise CheckpointCorruptError(
+                    f"{path}: checksum mismatch for {name!r} "
+                    f"(stored {ent['sha256'][:12]}…, got {digest[:12]}…)")
+        if ent.get("stored_as"):
+            try:
+                raw = raw.view(_resolve_dtype(ent["dtype"]))
+            except Exception as e:  # noqa: BLE001
+                raise CheckpointCorruptError(
+                    f"{path}: cannot restore dtype {ent['dtype']!r} for "
+                    f"{name!r}: {e}") from e
+        if "shape" in ent and tuple(raw.shape) != tuple(ent["shape"]):
+            raise CheckpointCorruptError(
+                f"{path}: shape mismatch for {name!r}: manifest says "
+                f"{tuple(ent['shape'])}, file holds {tuple(raw.shape)}")
         spec = ent.get("spec")
         if mesh is not None and spec is not None:
             norm = tuple(a if (a in mesh.axis_names and mesh.shape[a] > 1)
                          else None for a in spec)
-            placed = jax.device_put(arr, NamedSharding(mesh,
+            placed = jax.device_put(raw, NamedSharding(mesh,
                                                        PartitionSpec(*norm)))
         else:
-            placed = jax.numpy.asarray(arr)
+            placed = jax.numpy.asarray(raw)
         t = Tensor(placed)
         if spec is not None:
             t.dist_spec = tuple(spec)
         out[name] = t
+    try:
+        _, restore_ms, _ = _metrics()
+        restore_ms.observe((time.perf_counter() - t0) * 1e3)
+    except Exception:  # noqa: BLE001
+        pass
     return out
+
+
+def load_checkpoint_extra(path: str) -> Optional[dict]:
+    """The ``extra`` sidecar stored by ``save_sharded(extra=...)``, or
+    None when the checkpoint has none."""
+    epath = os.path.join(path, EXTRA_NAME)
+    try:
+        with open(epath) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None
+    except (OSError, ValueError) as e:
+        raise CheckpointCorruptError(f"{path}: unreadable {EXTRA_NAME}: "
+                                     f"{e}") from e
+
+
+# --------------------------------------------------- directory hygiene
+def is_checkpoint_dir(path: str) -> bool:
+    """A committed checkpoint: a real directory holding a manifest and
+    not a staging (``.tmp-``) or quarantined (``.corrupt-``) leftover."""
+    base = os.path.basename(os.path.normpath(path))
+    if _TMP_MARK in base or _CORRUPT_MARK in base:
+        return False
+    return os.path.isfile(os.path.join(path, META_NAME))
+
+
+def list_checkpoints(root: str) -> List[str]:
+    """Committed checkpoint directories under ``root``, oldest first by
+    mtime (name as tiebreak so equal-mtime listings are stable)."""
+    try:
+        names = os.listdir(root)
+    except FileNotFoundError:
+        return []
+    found = []
+    for n in sorted(names):
+        p = os.path.join(root, n)
+        if is_checkpoint_dir(p):
+            try:
+                found.append((os.path.getmtime(p), p))
+            except OSError:
+                continue  # racing deletion
+    found.sort(key=lambda t: (t[0], t[1]))
+    return [p for _, p in found]
+
+
+def checkpoint_nbytes(path: str) -> int:
+    total = 0
+    try:
+        for n in os.listdir(path):
+            try:
+                total += os.path.getsize(os.path.join(path, n))
+            except OSError:
+                pass
+    except OSError:
+        pass
+    return total
+
+
+def quarantine_checkpoint(path: str) -> Optional[str]:
+    """Move a corrupt/partial checkpoint aside (never delete — the
+    operator may want the forensics). Returns the new path."""
+    dst = path.rstrip("/\\") + _CORRUPT_MARK + uuid.uuid4().hex[:8]
+    try:
+        os.replace(path, dst)
+        return dst
+    except OSError:
+        return None
+
+
+def prune_checkpoints(root: str, keep: int) -> List[str]:
+    """mtime-LRU retention: delete the oldest committed checkpoints
+    under ``root`` beyond the newest ``keep``. Returns deleted paths.
+    ``keep <= 0`` disables pruning (keep everything)."""
+    if keep <= 0:
+        return []
+    ckpts = list_checkpoints(root)
+    dead = ckpts[:-keep] if len(ckpts) > keep else []
+    removed = []
+    for p in dead:
+        shutil.rmtree(p, ignore_errors=True)
+        if not os.path.exists(p):
+            removed.append(p)
+    return removed
+
+
+def sweep_stale_staging(root: str, min_age_s: float = 0.0) -> List[str]:
+    """Remove leftover ``.tmp-`` staging directories under ``root`` —
+    the debris of writers killed mid-save. Callers must own the
+    directory exclusively (the manager's single-writer-per-dir
+    contract); ``min_age_s`` spares freshly-created stages."""
+    removed = []
+    try:
+        names = os.listdir(root)
+    except FileNotFoundError:
+        return removed
+    now = time.time()
+    for n in names:
+        if _TMP_MARK not in n:
+            continue
+        p = os.path.join(root, n)
+        if not os.path.isdir(p):
+            continue
+        try:
+            if min_age_s and now - os.path.getmtime(p) < min_age_s:
+                continue
+        except OSError:
+            continue
+        shutil.rmtree(p, ignore_errors=True)
+        if not os.path.exists(p):
+            removed.append(p)
+    return removed
+
+
+def decode_entry_name(fname: str) -> str:
+    """Inverse of the manifest filename escaping (debugging helper)."""
+    return unquote(fname[:-4] if fname.endswith(".npy") else fname)

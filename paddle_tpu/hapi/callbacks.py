@@ -10,7 +10,8 @@ import time
 import numpy as np
 
 __all__ = ["Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
-           "LRScheduler", "EarlyStopping", "config_callbacks"]
+           "ElasticCheckpoint", "LRScheduler", "EarlyStopping",
+           "config_callbacks"]
 
 
 class Callback:
@@ -126,6 +127,64 @@ class ModelCheckpoint(Callback):
         if self.save_dir:
             os.makedirs(self.save_dir, exist_ok=True)
             self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class ElasticCheckpoint(Callback):
+    """Preemption-tolerant checkpointing for ``Model.fit`` — the hapi
+    face of ``paddle_tpu.elastic.CheckpointManager``. Unlike
+    :class:`ModelCheckpoint` (per-epoch ``model.save``), this captures
+    FULL training state (optimizer slots, LR step, RNG) every
+    ``save_interval_steps`` global steps into an atomic, kill-9-safe
+    checkpoint directory, restores it when training starts, and wires
+    SIGTERM/SIGINT to a final bounded-deadline save.
+
+    ``fit`` replays data from the epoch start, so after a restore the
+    already-covered steps of the interrupted epoch are re-run — state
+    is never wrong, some work may repeat (job-level elasticity,
+    SURVEY §5.3). The restore result is exposed as ``.restored``."""
+
+    def __init__(self, directory, save_interval_steps=None,
+                 save_interval_s=None, keep=None, restore=True,
+                 preemption_handlers=True):
+        super().__init__()
+        self.directory = directory
+        self._kw = {"save_interval_steps": save_interval_steps,
+                    "save_interval_s": save_interval_s, "keep": keep}
+        self._restore = restore
+        self._preempt = preemption_handlers
+        self.manager = None
+        self.restored = None
+        self._global_step = 0
+        self._epoch = 0
+
+    def on_train_begin(self, logs=None):
+        from ..elastic import CheckpointManager
+        if self.manager is None:
+            self.manager = CheckpointManager(
+                self.directory, model=self.model.network,
+                optimizer=getattr(self.model, "_optimizer", None),
+                **{k: v for k, v in self._kw.items() if v is not None})
+        if self._restore:
+            self.restored = self.manager.restore_latest()
+            if self.restored is not None:
+                self._global_step = self.restored.step
+                self._epoch = self.restored.epoch or 0
+        if self._preempt:
+            self.manager.install_preemption_handlers()
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+
+    def on_train_batch_end(self, step, logs=None):
+        self._global_step += 1
+        self.manager.step(self._global_step, epoch=self._epoch,
+                          offset=step)
+
+    def on_train_end(self, logs=None):
+        if self.manager is not None:
+            self.manager.save(self._global_step, epoch=self._epoch,
+                              block=True, reason="final")
+            self.manager.close()
 
 
 class LRScheduler(Callback):

@@ -1,0 +1,130 @@
+"""Deterministic training worker for the fault-injection harness
+(tools/faultinject.py + tests/test_elastic_checkpoint.py).
+
+Trains a small MLP for a fixed number of steps under a
+``CheckpointManager``, logging one bitwise loss record per step. The
+run is a pure function of the seed and the step index — data comes
+from per-step ``np.random.RandomState``, targets from the global
+numpy RNG, and input noise from the framework's device RNG — so a run
+that is SIGKILLed at ANY instant and relaunched must replay the exact
+same per-step losses after ``restore_latest()`` (optimizer slots,
+LR-scheduler step, and both RNG streams are all checkpointed state).
+
+Protocol (stdout, line-oriented, parent reads unbuffered):
+  FRESH | RESUMED step=<s> restore_ms=<ms> steps_lost=<n>
+  STEP <k>                  after step k completes (k = completed steps)
+  CKPT_WRITE/CKPT_COMMIT    emitted by the checkpoint layer when
+                            PADDLE_CKPT_TEST_SLEEP_S is set (kill windows)
+  DONE digest=<sha256>      full run completed
+
+Loss log (``<ckpt_dir>/loss_log.txt``): one ``<step> <float32-hex>``
+line per executed step, appended across attempts and fsync'd, so the
+parent can assert every re-executed step reproduced the reference loss
+bit-for-bit.
+
+Env knobs (set by the parent):
+  ELASTIC_WORKER_BLOCK=1     synchronous saves (strict steps-lost bound)
+  ELASTIC_WORKER_STEP_SLEEP  seconds to sleep per step (signal tests)
+  ELASTIC_WORKER_SIGTERM_EXIT install preemption handlers (default 1)
+"""
+import hashlib
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import nn  # noqa: E402
+from paddle_tpu.elastic import CheckpointManager  # noqa: E402
+from paddle_tpu.framework import random as pt_random  # noqa: E402
+
+SEED = 71
+
+
+def build():
+    paddle.seed(SEED)
+    np.random.seed(SEED)
+    model = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 8))
+    sched = paddle.optimizer.lr.StepDecay(learning_rate=0.05, step_size=5,
+                                          gamma=0.7)
+    opt = paddle.optimizer.Adam(learning_rate=sched,
+                                parameters=model.parameters())
+    return model, opt, sched
+
+
+def state_digest(model, opt) -> str:
+    h = hashlib.sha256()
+    for name, p in sorted(model.state_dict().items()):
+        h.update(name.encode())
+        h.update(np.ascontiguousarray(np.asarray(p.numpy())).tobytes())
+    for name, v in sorted(opt.state_dict().items()):
+        h.update(name.encode())
+        if hasattr(v, "numpy"):
+            h.update(np.ascontiguousarray(np.asarray(v.numpy())).tobytes())
+        else:
+            h.update(repr(v).encode())
+    return h.hexdigest()
+
+
+def main():
+    ckpt_dir = sys.argv[1]
+    total = int(sys.argv[2])
+    interval = int(sys.argv[3]) if len(sys.argv) > 3 else 2
+    block = os.environ.get("ELASTIC_WORKER_BLOCK", "0") == "1"
+    step_sleep = float(os.environ.get("ELASTIC_WORKER_STEP_SLEEP", "0"))
+
+    model, opt, sched = build()
+    mgr = CheckpointManager(ckpt_dir, model=model, optimizer=opt,
+                            save_interval_steps=interval, keep=3,
+                            async_save=not block, health_check=False)
+    res = mgr.restore_latest()
+    if res is None:
+        start = 0
+        print("FRESH", flush=True)
+    else:
+        start = res.step
+        print(f"RESUMED step={res.step} restore_ms={res.restore_ms:.1f} "
+              f"steps_lost={res.steps_lost}", flush=True)
+    if os.environ.get("ELASTIC_WORKER_SIGTERM_EXIT", "1") == "1":
+        mgr.install_preemption_handlers()
+
+    log = open(os.path.join(ckpt_dir, "loss_log.txt"), "a")
+    for step in range(start, total):
+        rs = np.random.RandomState(1000 + step)
+        x = rs.randn(4, 8).astype(np.float32)
+        target = np.random.randn(4, 8).astype(np.float32)  # global np RNG
+        key = pt_random.default_generator().next_key()      # device RNG
+        noise = np.asarray(jax.random.normal(key, (4, 8), np.float32))
+        xt = paddle.to_tensor(x + 0.01 * noise)
+        out = model(xt)
+        loss = ((out - paddle.to_tensor(target)) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        sched.step()
+        loss32 = np.float32(float(np.asarray(loss.numpy())))
+        log.write(f"{step} {loss32.tobytes().hex()}\n")
+        log.flush()
+        os.fsync(log.fileno())
+        mgr.step(step + 1)
+        # single write: the async writer thread also prints markers
+        sys.stdout.write(f"STEP {step + 1}\n")
+        sys.stdout.flush()
+        if step_sleep:
+            import time
+            time.sleep(step_sleep)
+    mgr.save(total, block=True, reason="final")
+    mgr.close()
+    print(f"DONE digest={state_digest(model, opt)}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

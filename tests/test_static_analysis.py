@@ -358,10 +358,11 @@ class TestLockDiscipline:
         """)
         assert _run(tmp_path, [LockDisciplineAnalyzer(dirs=())]) == []
 
-    def test_default_scope_is_serving_and_observability(self):
+    def test_default_scope_covers_threaded_dirs(self):
         an = LockDisciplineAnalyzer()
         assert an.dirs == ("paddle_tpu/serving/",
-                           "paddle_tpu/observability/")
+                           "paddle_tpu/observability/",
+                           "paddle_tpu/elastic/")
 
 
 # ===================================================================

@@ -1,0 +1,285 @@
+"""faultinject — SIGKILL a real training run and prove it always recovers.
+
+The elastic acceptance test (ROADMAP item 4): a training subprocess
+(tests/elastic_ckpt_worker.py) is SIGKILLed at randomized points in
+three distinct phases —
+
+  mid-step     right after a step completes (a small random delay puts
+               the kill anywhere inside the next step's host/device work)
+  mid-save     inside the staged checkpoint write (the worker runs with
+               PADDLE_CKPT_TEST_SLEEP_S so the checkpoint layer emits a
+               CKPT_WRITE marker and sleeps — the kill lands mid-.npy)
+  mid-commit   in the window immediately before the atomic manifest-
+               commit rename (CKPT_COMMIT marker)
+
+— and relaunched until the run completes. The harness then asserts:
+
+  1. every relaunch resumed from a committed checkpoint (never from a
+     torn one: corrupt dirs are quarantined by restore_latest);
+  2. the loss trajectory is BITWISE identical to an uninterrupted
+     reference run, for every step of every attempt (params, optimizer
+     slots, LR schedule, and both RNG streams restored exactly);
+  3. the final state digest equals the reference run's;
+  4. steps lost per kill stay within the save cadence bound
+     (<= interval with synchronous saves; <= 2x interval with async
+     pipelined saves, where one save can still be in flight).
+
+Emits a BENCH-style machine-readable JSON record (kills survived,
+per-kill phase/steps-lost, median restore ms) to --out / stdout.
+
+Usage:
+  python tools/faultinject.py --steps 30 --interval 2 --kills 6
+  python tools/faultinject.py --mode block --out ELASTIC_r01.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "elastic_ckpt_worker.py")
+
+PHASES = ("mid-step", "mid-save", "mid-commit")
+
+
+def _worker_env(phase, mode, sleep_s):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["ELASTIC_WORKER_BLOCK"] = "1" if mode == "block" else "0"
+    if phase in ("mid-save", "mid-commit"):
+        # widen the write/commit windows so the kill reliably lands in
+        # the targeted phase; markers are printed at each window
+        env["PADDLE_CKPT_TEST_SLEEP_S"] = str(sleep_s)
+    else:
+        env.pop("PADDLE_CKPT_TEST_SLEEP_S", None)
+    return env
+
+
+def _read_loss_log(path):
+    """step -> set of float32-hex records (tolerates a torn last line)."""
+    records = {}
+    if not os.path.exists(path):
+        return records
+    with open(path, "rb") as f:
+        data = f.read()
+    for line in data.split(b"\n"):
+        parts = line.decode("utf-8", "replace").split()
+        if len(parts) != 2 or not parts[1] or len(parts[1]) != 8:
+            continue
+        try:
+            step = int(parts[0])
+        except ValueError:
+            continue
+        records.setdefault(step, set()).add(parts[1])
+    return records
+
+
+def run_attempt(ckpt_dir, steps, interval, phase, mode, rng, sleep_s,
+                kill=True):
+    """One worker launch; optionally SIGKILL it in ``phase``. Returns a
+    dict describing what happened."""
+    env = _worker_env(phase if kill else None, mode, sleep_s)
+    proc = subprocess.Popen(
+        [sys.executable, "-u", WORKER, ckpt_dir, str(steps), str(interval)],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True)
+    info = {"phase": phase if kill else None, "killed": False,
+            "fresh": False, "resumed_from": None, "restore_ms": None,
+            "steps_lost": None, "last_step_seen": 0, "done": False,
+            "digest": None}
+    # choose a kill trigger
+    kill_at_step = None
+    kill_marker = None
+    marker_skip = 0
+    arm_at = 0
+    if kill:
+        if phase == "mid-step":
+            kill_at_step = rng.randint(1, max(1, steps - 1))
+        elif phase == "mid-save":
+            kill_marker = "CKPT_WRITE"
+            # skip a random number of write markers so the kill lands on
+            # different arrays across kills
+            marker_skip = rng.randint(0, 3)
+        else:
+            kill_marker = "CKPT_COMMIT"
+        if kill_marker is not None:
+            # arm at a random step so the kill spreads across saves
+            # (not always the first one after launch)
+            arm_at = rng.randint(1, max(1, steps - interval))
+    try:
+        for line in proc.stdout:
+            line = line.strip()
+            if line == "FRESH":
+                info["fresh"] = True
+            elif line.startswith("RESUMED"):
+                kv = dict(p.split("=", 1) for p in line.split()[1:])
+                info["resumed_from"] = int(kv["step"])
+                info["restore_ms"] = float(kv["restore_ms"])
+                info["steps_lost"] = int(kv["steps_lost"])
+                if kill and phase == "mid-step":
+                    lo = info["resumed_from"] + 1
+                    kill_at_step = rng.randint(lo, max(lo, steps - 1))
+                elif kill and kill_marker is not None:
+                    lo = info["resumed_from"] + 1
+                    arm_at = rng.randint(lo, max(lo, steps - interval))
+            elif line.startswith("STEP "):
+                try:
+                    info["last_step_seen"] = int(line.split()[1])
+                except ValueError:
+                    continue  # torn line from a kill landing mid-write
+                if kill_at_step is not None and \
+                        info["last_step_seen"] >= kill_at_step:
+                    time.sleep(rng.uniform(0, 0.02))  # land inside work
+                    proc.send_signal(signal.SIGKILL)
+                    info["killed"] = True
+                    break
+            elif kill_marker is not None and line.startswith(kill_marker):
+                if info["last_step_seen"] < arm_at:
+                    continue
+                if marker_skip > 0:
+                    marker_skip -= 1
+                    continue
+                proc.send_signal(signal.SIGKILL)
+                info["killed"] = True
+                break
+            elif line.startswith("DONE"):
+                info["done"] = True
+                info["digest"] = line.split("digest=", 1)[1]
+    finally:
+        try:
+            proc.stdout.close()
+        except OSError:
+            pass
+        proc.wait(timeout=120)
+    return info
+
+
+def run(steps=30, interval=2, kills=6, mode="async", seed=0,
+        sleep_s=0.15, out=None, verbose=True):
+    rng = random.Random(seed)
+    t_start = time.time()
+
+    # 1. reference: uninterrupted run
+    ref_dir = tempfile.mkdtemp(prefix="faultinject-ref-")
+    ref = run_attempt(ref_dir, steps, interval, None, mode, rng,
+                      sleep_s, kill=False)
+    assert ref["done"], "reference run did not complete"
+    ref_losses = _read_loss_log(os.path.join(ref_dir, "loss_log.txt"))
+    assert len(ref_losses) == steps and \
+        all(len(v) == 1 for v in ref_losses.values()), \
+        "reference run must log exactly one loss per step"
+
+    # 2. fault run: kill/relaunch until done
+    dir_ = tempfile.mkdtemp(prefix="faultinject-")
+    kill_log = []
+    attempts = 0
+    max_resumed = 0
+    final = None
+    phase_cycle = [PHASES[i % len(PHASES)] for i in range(kills)]
+    rng.shuffle(phase_cycle)
+    while True:
+        attempts += 1
+        assert attempts <= kills + 10, "run never completed after kills"
+        phase = phase_cycle[len(kill_log)] if len(kill_log) < kills else None
+        info = run_attempt(dir_, steps, interval, phase, mode, rng,
+                           sleep_s, kill=phase is not None)
+        if attempts > 1:
+            # every relaunch either resumes from a committed checkpoint
+            # or starts FRESH (legitimate only before the first commit);
+            # a worker that crashed instead of doing either fails here
+            assert info["resumed_from"] is not None or info["fresh"], \
+                f"attempt {attempts} neither resumed nor restarted clean"
+            assert info["resumed_from"] is None or \
+                info["resumed_from"] >= max_resumed, \
+                f"resume went backwards: {info['resumed_from']} < " \
+                f"{max_resumed} (a committed checkpoint was lost)"
+            max_resumed = max(max_resumed, info["resumed_from"] or 0)
+        if info["killed"]:
+            kill_log.append(info)
+            if verbose:
+                print(f"  kill #{len(kill_log)} [{info['phase']}] at "
+                      f"step {info['last_step_seen']}", file=sys.stderr)
+            continue
+        if info["done"]:
+            final = info
+            break
+
+    # 3. assertions
+    bound = interval if mode == "block" else 2 * interval
+    resumes = [k for k in kill_log[1:] + [final]
+               if k and k.get("resumed_from") is not None]
+    lost = [k["steps_lost"] for k in resumes if k["steps_lost"] is not None]
+    for k in resumes:
+        assert k["steps_lost"] is None or k["steps_lost"] <= bound, \
+            f"lost {k['steps_lost']} steps, bound is {bound} ({mode})"
+    losses = _read_loss_log(os.path.join(dir_, "loss_log.txt"))
+    mismatches = []
+    for step, recs in losses.items():
+        want = ref_losses.get(step)
+        if want is None or recs != want:
+            mismatches.append((step, sorted(recs),
+                               sorted(want or ())))
+    assert not mismatches, \
+        f"loss trajectory diverged from reference at: {mismatches[:5]}"
+    assert set(losses) == set(ref_losses), "not every step was executed"
+    assert final["digest"] == ref["digest"], \
+        f"final state digest {final['digest'][:12]} != reference " \
+        f"{ref['digest'][:12]}"
+
+    restore_ms = sorted(r["restore_ms"] for r in resumes
+                        if r["restore_ms"] is not None)
+    record = {
+        "bench": "faultinject",
+        "schema": 1,
+        "mode": mode,
+        "steps": steps,
+        "save_interval": interval,
+        "kills_requested": kills,
+        "kills_survived": len(kill_log),
+        "attempts": attempts,
+        "phases": sorted({k["phase"] for k in kill_log}),
+        "steps_lost_per_kill": lost,
+        "steps_lost_bound": bound,
+        "median_restore_ms": restore_ms[len(restore_ms) // 2]
+        if restore_ms else None,
+        "trajectory_bitwise_equal": True,
+        "final_digest_equal": True,
+        "elapsed_s": round(time.time() - t_start, 3),
+        "kills": [{"phase": k["phase"], "at_step": k["last_step_seen"]}
+                  for k in kill_log],
+    }
+    if out:
+        with open(out, "w") as f:
+            json.dump(record, f, indent=1, sort_keys=True)
+            f.write("\n")
+    return record
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--interval", type=int, default=2,
+                    help="save every N steps")
+    ap.add_argument("--kills", type=int, default=6)
+    ap.add_argument("--mode", choices=("async", "block"), default="async")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--sleep-s", type=float, default=0.15,
+                    help="save/commit window width for targeted kills")
+    ap.add_argument("--out", default=None, help="write the JSON record here")
+    args = ap.parse_args()
+    record = run(steps=args.steps, interval=args.interval, kills=args.kills,
+                 mode=args.mode, seed=args.seed, sleep_s=args.sleep_s,
+                 out=args.out)
+    json.dump(record, sys.stdout, indent=1, sort_keys=True)
+    print()
+
+
+if __name__ == "__main__":
+    main()

@@ -35,6 +35,7 @@ SURFACES = [
     "paddle_tpu.observability",
     "paddle_tpu.analysis",
     "paddle_tpu.compile_cache",
+    "paddle_tpu.elastic",
     "paddle_tpu.io",
     "paddle_tpu.amp",
     "paddle_tpu.jit",
