@@ -29,8 +29,12 @@ MANIFEST_VERSION = 1
 
 class WarmupManifest:
     """Persisted set of compiled batch signatures for one (server,
-    model) pair. Entries are ``{"feeds": [[shape, dtype], ...]}`` —
-    the exact padded host-batch layout handed to the predictor."""
+    model) pair. Entries are ``{"feeds": [[shape, dtype], ...],
+    "site": str}`` — the exact padded host-batch layout handed to the
+    predictor (site "predict", the default) or to the decode engine's
+    prefill/decode dispatch ("generate_prefill"/"generate_decode"),
+    so a replayer only re-executes the signatures of ITS dispatch
+    path. Pre-site manifests load with site "predict"."""
 
     def __init__(self, path: str):
         self.path = os.path.abspath(path)
@@ -55,38 +59,44 @@ class WarmupManifest:
             for entry in data.get("entries", []):
                 feeds = [(tuple(int(d) for d in shape), str(dtype))
                          for shape, dtype in entry["feeds"]]
-                self._entries[self._key(feeds)] = {"feeds": feeds}
+                site = str(entry.get("site", "predict"))
+                self._entries[self._key(feeds, site)] = {
+                    "feeds": feeds, "site": site}
         except FileNotFoundError:
             pass
         except Exception:  # noqa: BLE001 - corrupt manifest: start empty
             self._entries = {}
 
     @staticmethod
-    def _key(feeds: Sequence[Tuple[tuple, str]]) -> str:
-        return json.dumps([[list(s), d] for s, d in feeds])
+    def _key(feeds: Sequence[Tuple[tuple, str]],
+             site: str = "predict") -> str:
+        return json.dumps([site, [[list(s), d] for s, d in feeds]])
 
     def __len__(self):
         with self._lock:
             return len(self._entries)
 
-    def specs(self) -> List[dict]:
-        """Recorded signatures, each ``{"feeds": [(shape, dtype), ...]}``
-        — the replay input for ``warmup_from_manifest``."""
+    def specs(self, site: Optional[str] = None) -> List[dict]:
+        """Recorded signatures, each ``{"feeds": [(shape, dtype), ...],
+        "site": str}`` — the replay input for ``warmup_from_manifest``.
+        ``site`` filters to one dispatch path (None = all)."""
         with self._lock:
-            return [dict(e) for e in self._entries.values()]
+            return [dict(e) for e in self._entries.values()
+                    if site is None or e["site"] == site]
 
-    def record(self, feeds: Sequence[Tuple[tuple, str]]) -> bool:
+    def record(self, feeds: Sequence[Tuple[tuple, str]],
+               site: str = "predict") -> bool:
         """Add one signature (``[(shape, dtype), ...]`` of the padded
         batch) and write through if new; returns True when it was new.
         Never raises — an unwritable manifest costs only warmup breadth
         on the next restart."""
         feeds = [(tuple(int(d) for d in shape), str(dtype))
                  for shape, dtype in feeds]
-        key = self._key(feeds)
+        key = self._key(feeds, site)
         with self._lock:
             if key in self._entries:
                 return False
-            self._entries[key] = {"feeds": feeds}
+            self._entries[key] = {"feeds": feeds, "site": str(site)}
             entries = [dict(e) for e in self._entries.values()]
         try:
             self._write(entries)
@@ -98,7 +108,8 @@ class WarmupManifest:
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
         data = {"version": MANIFEST_VERSION,
                 "entries": [{"feeds": [[list(s), d]
-                                       for s, d in e["feeds"]]}
+                                       for s, d in e["feeds"]],
+                             "site": e.get("site", "predict")}
                             for e in entries]}
         fd, tmp = tempfile.mkstemp(
             prefix=".tmp-", suffix=".json",

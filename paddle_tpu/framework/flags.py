@@ -170,6 +170,33 @@ define_flag("FLAGS_serving_donate_inputs", True,
             "so XLA reuses them for outputs (effective on accelerator "
             "backends; CPU has no donation and falls back silently)")
 
+# Decode serving knobs (paddle_tpu.serving.generation — the
+# continuous-batching autoregressive decode engine).
+define_flag("FLAGS_decode_max_batch", 8,
+            "in-flight decode batch width: the decode step compiles "
+            "ONCE at [max_batch, 1] and dead lanes are slot-masked, so "
+            "this bounds both concurrency and the compiled shape")
+define_flag("FLAGS_decode_page_size", 16,
+            "tokens per KV-cache page; sequences hold pages of the "
+            "preallocated per-layer pool via int32 block tables "
+            "(PagedAttention layout), so cache memory scales with live "
+            "tokens rather than max_seq_len x batch")
+define_flag("FLAGS_decode_kv_pages", 0,
+            "total pages per layer pool incl. the reserved trash page "
+            "(0 = auto: enough for max_batch sequences at the model's "
+            "max_seq_len)")
+define_flag("FLAGS_decode_queue_capacity", 64,
+            "bounded generation request queue; submit_generate raises "
+            "QueueFullError beyond this (backpressure, matching submit)")
+define_flag("FLAGS_decode_default_timeout_ms", 0.0,
+            "scheduling deadline applied when submit_generate passes "
+            "none (0 = no deadline); like serving submit, an expired "
+            "request is dropped before prefill, never mid-stream")
+define_flag("FLAGS_decode_warmup_from_manifest", False,
+            "pre-compile a constructed GenerationServer's decode step "
+            "and recorded prefill buckets from its persisted warmup "
+            "manifest under FLAGS_compile_cache_dir")
+
 # Persistent compile cache (paddle_tpu.compile_cache — cold-start
 # amortization across processes).
 define_flag("FLAGS_compile_cache_dir", "",

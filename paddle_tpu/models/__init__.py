@@ -2,7 +2,8 @@
 driven by fleet; here they ship in-tree as the hybrid-parallel north star —
 SURVEY §3.3 / BASELINE GPT-3 1.3B config)."""
 from .gpt import (  # noqa: F401
-    GPTConfig, GPTModel, GPTForCausalLM, GPTPretrainingCriterion, gpt2_medium,
+    GPTConfig, GPTKVCache, GPTModel, GPTForCausalLM,
+    GPTPretrainingCriterion, gpt2_medium,
     gpt_tiny, gpt2_small, gpt2_large, gpt3_1p3b,
 )
 from .bert import (  # noqa: F401

@@ -41,6 +41,7 @@ from ..nn.layer.norm import LayerNorm
 __all__ = [
     "gpt2_large",
     "GPTConfig", "GPTModel", "GPTForCausalLM", "GPTPretrainingCriterion",
+    "GPTKVCache",
     "gpt_tiny", "gpt2_small", "gpt2_medium", "gpt3_1p3b",
 ]
 
@@ -127,6 +128,51 @@ def _seq_constraint(x):
                     lambda a: with_constraint(a, "dp", "sep", None), x)
 
 
+class GPTKVCache:
+    """Paged KV-cache view threaded through ``GPTModel.forward``.
+
+    All array fields are framework Tensors (eager) or tracer-backed
+    Tensors (under jit via ``jit.functional.functional_call``):
+
+    - ``k``/``v``: per-layer pools — a list of ``[num_pages, page_size,
+      heads, head_dim]`` Tensors for the module stack, or ONE stacked
+      ``[num_layers, num_pages, page_size, heads, head_dim]`` Tensor
+      for ``GPTStackedTransformer``. Page 0 is the trash page
+      (ops/paged_attention.py).
+    - ``block_tables``: [B, P] int32 logical-page → pool-page map.
+    - ``ctx_len``: [B] int32 visible context length INCLUDING the
+      positions written by this forward.
+    - ``valid``: [B, S] bool — which fed positions are real (prefill
+      padding and dead decode lanes are False; their K/V writes go to
+      the trash page).
+    - ``positions``: [B, S] int32 absolute positions being fed.
+    - ``kind``: "prefill" (S = prompt window, ordinary causal attention
+      plus pool write) or "decode" (S = 1, attention reads the context
+      back through the block table).
+
+    ``forward(ids, cache=...)`` returns ``(logits, (k', v'))`` — the
+    updated pool pytree mirrors the input structure, so jitted callers
+    can donate the pools and carry them across steps.
+    """
+
+    __slots__ = ("kind", "page_size", "k", "v", "block_tables",
+                 "ctx_len", "valid", "positions")
+
+    def __init__(self, kind, page_size, k, v, block_tables, ctx_len,
+                 valid, positions):
+        if kind not in ("prefill", "decode"):
+            raise ValueError(f"kind must be 'prefill' or 'decode', "
+                             f"got {kind!r}")
+        self.kind = kind
+        self.page_size = int(page_size)
+        self.k = k
+        self.v = v
+        self.block_tables = block_tables
+        self.ctx_len = ctx_len
+        self.valid = valid
+        self.positions = positions
+
+
 class GPTEmbeddings(Layer):
     def __init__(self, config: GPTConfig):
         super().__init__()
@@ -138,10 +184,17 @@ class GPTEmbeddings(Layer):
             False, default_initializer=init)
         self.dropout = Dropout(config.dropout)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, positions=None):
         seq_len = input_ids.shape[-1]
         h = self.word_embeddings(input_ids)
-        h = h + self.position_embeddings[:seq_len]
+        if positions is not None:
+            # decode path: each row sits at its own absolute position
+            import jax.numpy as jnp
+            h = h + apply_op("position_embedding",
+                             lambda w, p: jnp.take(w, p, axis=0),
+                             self.position_embeddings, positions)
+        else:
+            h = h + self.position_embeddings[:seq_len]
         return _seq_constraint(self.dropout(h))
 
 
@@ -159,7 +212,7 @@ class GPTAttention(Layer):
             config.hidden_size, config.hidden_size, input_is_parallel=True)
         self.dropout = Dropout(config.dropout)
 
-    def forward(self, x):
+    def forward(self, x, kv_cache=None):
         b, s, _ = x.shape
         qkv = self.qkv_proj(x)                       # [B,S,3H]
         # head-major (nh, 3, hd) layout: the mp-sharded 3H dim factors with
@@ -170,6 +223,18 @@ class GPTAttention(Layer):
         q = qkv[:, :, :, 0]                          # [B,S,nh,hd]
         k = qkv[:, :, :, 1]
         v = qkv[:, :, :, 2]
+        if kv_cache is not None:
+            # paged-cache path: persist this window's K/V in the pool;
+            # decode attends through the block table (see GPTKVCache)
+            from ..ops.paged_attention import paged_attention_update
+            out, k_pool, v_pool = apply_op(
+                "paged_attention", paged_attention_update, q, k, v,
+                kv_cache.k, kv_cache.v, kv_cache.block_tables,
+                kv_cache.ctx_len, kv_cache.valid, kv_cache.positions,
+                page_size=kv_cache.page_size, kind=kv_cache.kind,
+                use_flash=self.use_flash)
+            out = out.reshape([b, s, self.hidden_size])
+            return self.dropout(self.out_proj(out)), k_pool, v_pool
         from ..nn.functional.attention import scaled_dot_product_attention
         out = scaled_dot_product_attention(
             q, k, v, is_causal=True, dropout_p=self.attn_dropout_p,
@@ -208,14 +273,19 @@ class GPTDecoderLayer(Layer):
         self.ln_2 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
         self.mlp = GPTMLP(config)
 
-    def forward(self, x):
+    def forward(self, x, kv_cache=None):
+        if kv_cache is not None:
+            a, k_pool, v_pool = self.attn(self.ln_1(x), kv_cache=kv_cache)
+            x = x + a
+            x = x + self.mlp(self.ln_2(x))
+            return _seq_constraint(x), k_pool, v_pool
         x = x + self.attn(self.ln_1(x))
         x = x + self.mlp(self.ln_2(x))
         return _seq_constraint(x)
 
 
 def _stacked_layer_fwd(p, x, *, num_heads, head_dim, eps, mp_size, sep_size,
-                       use_flash=True):
+                       use_flash=True, kv=None):
     """ONE decoder layer, manual SPMD (runs inside shard_map).
 
     x: [mb, s_local, H] (full hidden; seq sep-sharded). Params are the local
@@ -251,7 +321,16 @@ def _stacked_layer_fwd(p, x, *, num_heads, head_dim, eps, mp_size, sep_size,
     qkv = qkv.reshape(mb, s_loc, nh_loc, 3, head_dim)
     q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]  # [mb,s,nh,hd]
     sm_scale = 1.0 / math.sqrt(head_dim)
-    if sep_size > 1:
+    k_pool = v_pool = None
+    if kv is not None:
+        # paged-cache decode/prefill (single shard: mp/sep degenerate —
+        # GPTStackedTransformer enforces that before routing here)
+        from ..ops.paged_attention import paged_attention_update
+        (kp, vp, tables, ctx, valid, positions, page_size, kind) = kv
+        attn, k_pool, v_pool = paged_attention_update(
+            q, k, v, kp, vp, tables, ctx, valid, positions,
+            page_size=page_size, kind=kind, use_flash=use_flash)
+    elif sep_size > 1:
         from ..ops.ring_attention import _ring_attention_local
         attn = _ring_attention_local(q, k, v, axis_name="sep",
                                      axis_size=sep_size, causal=True,
@@ -281,7 +360,10 @@ def _stacked_layer_fwd(p, x, *, num_heads, head_dim, eps, mp_size, sep_size,
     d = u @ p["fc2_w"]
     if mp_size > 1:
         d = mp_allreduce(d, "mp")
-    return x + d + p["fc2_b"]
+    out = x + d + p["fc2_b"]
+    if kv is not None:
+        return out, k_pool, v_pool
+    return out
 
 
 class GPTStackedTransformer(Layer):
@@ -352,12 +434,15 @@ class GPTStackedTransformer(Layer):
         return (cfg.get("schedule_mode", "1F1B"),
                 int(cfg.get("virtual_pp_degree", 1) or 1))
 
-    def forward(self, x):
+    def forward(self, x, cache=None):
         import functools
 
         cfg = self.config
         names = list(self.SPECS.keys())
         params = [getattr(self, n) for n in names]
+
+        if cache is not None:
+            return self._forward_cached(x, params, names, cache)
 
         def fn(x_arr, *param_arrays):
             from ..distributed.mesh_utils import get_global_mesh
@@ -427,6 +512,47 @@ class GPTStackedTransformer(Layer):
 
         return apply_op("gpt_stacked_decoder", fn, x, *params)
 
+    def _forward_cached(self, x, params, names, cache):
+        """Paged-cache scan: pools are stacked ``[L, num_pages, ...]``
+        arrays carried through ``lax.scan`` alongside the layer-stacked
+        params. Single-shard only — cached decode under a live pp/mp/sep
+        mesh is not supported (the serving engine runs one replica)."""
+        import functools
+
+        cfg = self.config
+        page_size, kind = cache.page_size, cache.kind
+
+        def fn(x_arr, k_pools, v_pools, tables, ctx, valid, positions,
+               *param_arrays):
+            from ..distributed.mesh_utils import get_global_mesh
+            mesh = get_global_mesh()
+            if mesh is not None and any(
+                    mesh.shape.get(a, 1) > 1 for a in ("pp", "mp", "sep")):
+                raise NotImplementedError(
+                    "KV-cached decode is single-shard: drop the pp/mp/"
+                    "sep mesh axes (dp replicas serve independently)")
+            p = dict(zip(names, param_arrays))
+            layer = functools.partial(
+                _stacked_layer_fwd, num_heads=cfg.num_heads,
+                head_dim=cfg.hidden_size // cfg.num_heads,
+                eps=cfg.layer_norm_eps, mp_size=1, sep_size=1,
+                use_flash=cfg.use_flash_attention)
+
+            def step(c, xs):
+                p_slice, kp, vp = xs
+                out, kp2, vp2 = layer(
+                    p_slice, c, kv=(kp, vp, tables, ctx, valid,
+                                    positions, page_size, kind))
+                return out, (kp2, vp2)
+
+            out, (k2, v2) = jax.lax.scan(step, x_arr,
+                                         (p, k_pools, v_pools))
+            return out, k2, v2
+
+        return apply_op("gpt_stacked_decoder_cached", fn, x, cache.k,
+                        cache.v, cache.block_tables, cache.ctx_len,
+                        cache.valid, cache.positions, *params)
+
 
 class GPTModel(Layer):
     def __init__(self, config: GPTConfig):
@@ -441,7 +567,9 @@ class GPTModel(Layer):
                                      for _ in range(config.num_layers)])
         self.ln_f = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, cache=None):
+        if cache is not None:
+            return self._forward_cached(input_ids, cache)
         h = self.embeddings(input_ids)
         if self.config.stacked:
             h = self.decoder(h)
@@ -449,6 +577,24 @@ class GPTModel(Layer):
             for layer in self.layers:
                 h = layer(h)
         return self.ln_f(h)
+
+    def _forward_cached(self, input_ids, cache: GPTKVCache):
+        """Cache-threaded forward: returns ``(h, (k', v'))`` where the
+        updated pools mirror ``cache.k``/``cache.v`` structure."""
+        h = self.embeddings(input_ids, positions=cache.positions)
+        if self.config.stacked:
+            h, k_new, v_new = self.decoder(h, cache=cache)
+        else:
+            k_new, v_new = [], []
+            for i, layer in enumerate(self.layers):
+                view = GPTKVCache(
+                    cache.kind, cache.page_size, cache.k[i], cache.v[i],
+                    cache.block_tables, cache.ctx_len, cache.valid,
+                    cache.positions)
+                h, k_i, v_i = layer(h, kv_cache=view)
+                k_new.append(k_i)
+                v_new.append(v_i)
+        return self.ln_f(h), (k_new, v_new)
 
     # -- pipeline segmentation hook (pp_layers.LayerDesc consumers) --
     def pipeline_stages(self):
@@ -464,15 +610,48 @@ class GPTForCausalLM(Layer):
             self.lm_head = Linear(config.hidden_size, config.vocab_size,
                                   bias_attr=False)
 
-    def forward(self, input_ids):
-        h = self.gpt(input_ids)
+    def forward(self, input_ids, cache=None):
+        if cache is not None:
+            h, pools = self.gpt(input_ids, cache=cache)
+        else:
+            h = self.gpt(input_ids)
         if self.config.tie_word_embeddings:
             from ..tensor import linalg
             w = self.gpt.embeddings.word_embeddings.weight
             logits = linalg.matmul(h, w, transpose_y=True)
         else:
             logits = self.lm_head(h)
+        if cache is not None:
+            return logits, pools
         return logits
+
+    # ---- paged KV-cache plumbing (serving.generation engine) ----
+    def init_kv_pools(self, num_pages: int, page_size: int, dtype=None):
+        """Zeroed paged K/V pools shaped for this model: a list of
+        per-layer ``[num_pages, page_size, heads, head_dim]`` arrays
+        (module stack) or one stacked ``[L, ...]`` pair (stacked
+        decoder). Page 0 is the trash page and is never allocated.
+        Returns raw jax arrays ``(k, v)`` — engine plumbing, not
+        Tensors."""
+        import jax.numpy as jnp
+        cfg = self.config
+        nh, hd = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+        dt = dtype or self.gpt.embeddings.word_embeddings.weight._data.dtype
+        shape = (int(num_pages), int(page_size), nh, hd)
+        if cfg.stacked:
+            k = jnp.zeros((cfg.num_layers,) + shape, dt)
+            return k, jnp.zeros((cfg.num_layers,) + shape, dt)
+        return ([jnp.zeros(shape, dt) for _ in range(cfg.num_layers)],
+                [jnp.zeros(shape, dt) for _ in range(cfg.num_layers)])
+
+    def kv_cache_spec(self) -> dict:
+        """Geometry the decode engine sizes its cache from."""
+        cfg = self.config
+        return {"num_layers": cfg.num_layers,
+                "num_heads": cfg.num_heads,
+                "head_dim": cfg.hidden_size // cfg.num_heads,
+                "max_seq_len": cfg.max_seq_len,
+                "stacked": bool(cfg.stacked)}
 
     def num_params(self) -> int:
         return sum(int(np.prod(p.shape)) for p in self.parameters())

@@ -1,0 +1,141 @@
+"""Paged KV-cache attention (PagedAttention, Kwon et al. SOSP '23).
+
+Decode serving keeps each sequence's K/V in fixed-size *pages* of a
+preallocated per-layer pool rather than a contiguous
+``[batch, max_seq_len, ...]`` slab, so cache memory scales with live
+tokens and a sequence's pages can be scattered anywhere in the pool.
+A per-sequence int32 *block table* maps logical position ``p`` to pool
+page ``table[p // page_size]`` at offset ``p % page_size``.
+
+Pool layout is ``[num_pages, page_size, num_heads, head_dim]``.
+**Page 0 is the trash page**: the allocator never hands it out, and
+every masked write (padding positions, dead batch lanes) is redirected
+to a slot inside it, so scatter shapes stay fixed — the XLA-friendly
+substitute for dynamic-length writes. Trash-page contents are garbage
+and must never be gathered for a live position (the block tables of
+live sequences only reference allocated pages).
+
+These are pure jax functions; the model layer threads them through
+``apply_op`` (models/gpt.py) and the decode engine jits them via
+``serving.generation.model_fns``.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["flat_slots", "write_pool", "gather_pool",
+           "paged_attention_update"]
+
+
+def flat_slots(block_tables, positions, valid, page_size: int):
+    """Flat pool-slot index for each (row, position): ``page * page_size
+    + offset`` through the block table, or a trash-page slot (< page_size)
+    where ``valid`` is False.
+
+    block_tables: [B, P] int32; positions: [B, S] int32; valid: [B, S]
+    bool. Returns [B, S] int32.
+    """
+    page_idx = positions // page_size
+    offset = positions % page_size
+    # clip so dead lanes with positions past the table read page 0, not
+    # out of bounds (jax clamps gathers, but be explicit)
+    page_idx = jnp.clip(page_idx, 0, block_tables.shape[1] - 1)
+    pages = jnp.take_along_axis(block_tables, page_idx, axis=1)
+    slots = pages * page_size + offset
+    return jnp.where(valid, slots, offset)    # trash page = page 0
+
+
+def write_pool(pool, slots, kv):
+    """Scatter ``kv`` rows into the flattened pool at ``slots``.
+
+    pool: [num_pages, page_size, H, D]; slots: [N] int32 flat slot ids;
+    kv: [N, H, D]. Duplicate trash-slot writes are unordered — the trash
+    page holds garbage by contract.
+    """
+    num_pages, page_size = pool.shape[0], pool.shape[1]
+    flat = pool.reshape(num_pages * page_size, *pool.shape[2:])
+    flat = flat.at[slots].set(kv.astype(pool.dtype))
+    return flat.reshape(pool.shape)
+
+
+def gather_pool(pool, block_tables):
+    """Gather every slot a block table can address, in logical order.
+
+    pool: [num_pages, page_size, H, D]; block_tables: [B, P] int32.
+    Returns [B, P * page_size, H, D] where gathered row ``t`` holds
+    logical position ``t`` of each sequence (pages are table-ordered).
+    """
+    num_pages, page_size = pool.shape[0], pool.shape[1]
+    flat = pool.reshape(num_pages * page_size, *pool.shape[2:])
+    slots = (block_tables[:, :, None] * page_size
+             + jnp.arange(page_size, dtype=block_tables.dtype)[None, None])
+    b = block_tables.shape[0]
+    return flat[slots.reshape(b, -1)]
+
+
+def _decode_attention(q, ks, vs, ctx_len, scale):
+    """Single-position attention against the gathered paged context.
+
+    q: [B, 1, H, D]; ks/vs: [B, T, H, D]; ctx_len: [B] int32 — visible
+    context length INCLUDING the just-written position (self-attention
+    includes self). Masked slots get -1e30 (not -inf: an all-dead lane
+    must stay finite through softmax; its output is discarded).
+    """
+    logits = jnp.einsum("bqhd,bthd->bhqt", q, ks) * \
+        jnp.asarray(scale, q.dtype)
+    t = ks.shape[1]
+    mask = jnp.arange(t)[None, :] < ctx_len[:, None]       # [B, T]
+    logits = jnp.where(mask[:, None, None, :], logits,
+                       jnp.asarray(-1e30, logits.dtype))
+    probs = jax.nn.softmax(logits.astype(jnp.float32),
+                           axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqt,bthd->bqhd", probs, vs)
+    return out
+
+
+def paged_attention_update(q, k, v, k_pool, v_pool, block_tables,
+                           ctx_len, valid, positions, *, page_size: int,
+                           kind: str, use_flash: bool = True):
+    """One layer's cache-aware attention: write this call's K/V into the
+    paged pool, then attend.
+
+    q/k/v: [B, S, H, D] (S = prompt window for prefill, 1 for decode);
+    k_pool/v_pool: [num_pages, page_size, H, D]; block_tables: [B, P];
+    ctx_len: [B] visible length including the positions written here;
+    valid: [B, S] which fed positions are real; positions: [B, S]
+    absolute positions being written.
+
+    kind="prefill": K/V of the window are right here, so attention is
+    ordinary causal attention over the window (bit-identical to the
+    uncached path); the pool write only *persists* them for later
+    decode steps. Prompts are left-aligned, so a row's garbage pad
+    positions cannot leak into its real positions' outputs (causality).
+
+    kind="decode": S == 1; attention reads the whole context back
+    through the block table (write-then-gather, so self is included).
+
+    Returns (attn_out [B, S, H, D], k_pool', v_pool').
+    """
+    b, s = q.shape[0], q.shape[1]
+    slots = flat_slots(block_tables, positions, valid, page_size)
+    slots_flat = slots.reshape(b * s)
+    k_pool = write_pool(k_pool, slots_flat,
+                        k.reshape(b * s, *k.shape[2:]))
+    v_pool = write_pool(v_pool, slots_flat,
+                        v.reshape(b * s, *v.shape[2:]))
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    if kind == "prefill":
+        from .flash_attention import attention_bshd
+        out = attention_bshd(q, k, v, causal=True, scale=scale,
+                             use_flash=use_flash)
+    elif kind == "decode":
+        ks = gather_pool(k_pool, block_tables)
+        vs = gather_pool(v_pool, block_tables)
+        out = _decode_attention(q, ks, vs, ctx_len, scale)
+    else:
+        raise ValueError(f"kind must be 'prefill' or 'decode', got "
+                         f"{kind!r}")
+    return out, k_pool, v_pool

@@ -30,11 +30,16 @@ Pieces:
   chrome export.
 - ``wrap_capi`` (capi.py): the hook pd_capi.cc calls so C clients get
   request batching behind ``FLAGS_serving_capi_batching``.
+- ``generation`` (subpackage): autoregressive decode serving —
+  continuous batching over a paged KV cache with streaming token
+  futures (``GenerationServer.submit_generate``); knobs under
+  ``FLAGS_decode_*``.
 
 Knobs: ``FLAGS_serving_*`` in framework/flags.py.
 """
 from __future__ import annotations
 
+from . import generation  # noqa: F401  (decode-serving sub-namespace)
 from . import metrics  # noqa: F401  (the registry sub-namespace)
 from .batcher import DynamicBatcher
 from .bucketing import BucketSpec, ShapeBucketPolicy, next_pow2
@@ -48,5 +53,5 @@ __all__ = [
     "InferenceServer", "DynamicBatcher", "ShapeBucketPolicy",
     "BucketSpec", "ServingMetrics", "Request", "QueueFullError",
     "DeadlineExceededError", "ServerClosedError", "wrap_capi",
-    "next_pow2", "metrics",
+    "next_pow2", "metrics", "generation",
 ]

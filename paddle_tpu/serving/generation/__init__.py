@@ -1,0 +1,46 @@
+"""paddle_tpu.serving.generation — autoregressive decode serving.
+
+The generation analog of the batch-predict ``InferenceServer``:
+prefill/decode split over a paged KV cache (PagedAttention layout) with
+Orca-style continuous batching — sequences join and leave the in-flight
+decode batch every iteration, the decode step compiles ONCE at
+``[max_batch, 1]`` with dead lanes slot-masked, and tokens stream back
+through ``StreamingFuture``.
+
+Pieces:
+
+- ``GenerationServer`` (engine.py): ``submit_generate(prompt,
+  max_new_tokens, temperature) -> StreamingFuture`` with the serving
+  layer's backpressure/deadline semantics, continuous batcher worker,
+  ``paddle_decode_*`` metrics on the observability registry, warmup +
+  warmup-manifest replay over the decode lattice.
+- ``CachedDecoder`` (model_fns.py): the two jitted device entry points
+  (bucketed prefill, fixed-shape decode) over a cache-capable model,
+  KV pools donated where the backend supports it, persistent-compile-
+  cache AOT tier first.
+- ``PagedKVCache`` (kv_cache.py): preallocated per-layer
+  ``[num_pages, page_size, heads, head_dim]`` pools + the host page
+  allocator (page 0 reserved as the trash page for masked writes).
+- ``sample_next_tokens`` (sampling.py): vectorized host-side
+  greedy/temperature selection, shared with
+  ``HybridParallelInferenceHelper.generate``.
+
+Model contract: ``forward(ids, cache=...)`` returning ``(logits,
+(k', v'))`` plus ``init_kv_pools``/``kv_cache_spec`` — implemented by
+``models.GPTForCausalLM`` (module and stacked decoders); see
+``models.gpt.GPTKVCache`` for the threaded pytree.
+
+Knobs: ``FLAGS_decode_*`` in framework/flags.py.
+"""
+from __future__ import annotations
+
+from .engine import DecodeMetrics, GenerationServer, StreamingFuture
+from .kv_cache import PagedKVCache
+from .model_fns import CachedDecoder, supports_cached_decode
+from .sampling import sample_next_tokens
+
+__all__ = [
+    "GenerationServer", "StreamingFuture", "DecodeMetrics",
+    "PagedKVCache", "CachedDecoder", "supports_cached_decode",
+    "sample_next_tokens",
+]

@@ -1,0 +1,836 @@
+"""GenerationServer: continuous-batching autoregressive decode serving.
+
+Orca-style iteration-level scheduling (Yu et al., OSDI '22) over the
+paged KV cache: the in-flight decode batch is re-formed EVERY step —
+new sequences join as soon as a slot and pages free up, finished ones
+are evicted the step they finish — instead of the reap-and-dispatch
+barrier the batch-predict server uses. There is exactly one compiled
+decode shape, ``[max_batch, 1]`` with dead lanes slot-masked, so the
+whole decode lattice is two signatures (prefill buckets + the decode
+step) and a warm PR 5 compile cache makes it cold-start free.
+
+Flow per worker iteration:
+
+1. **admit**: pop FIFO requests while a batch slot AND their full page
+   reservation are available; drop expired ones
+   (``DeadlineExceededError``, matching ``submit`` semantics — a
+   deadline gates scheduling, never an in-flight stream).
+2. **prefill**: admitted prompts run one forward at their (pow2-row,
+   seq-bucket) shape — the PR 1/2 bucket lattice — writing prompt K/V
+   into their pages and sampling the first token.
+3. **decode**: one fixed-shape step for every live lane; sample on
+   host (vectorized, per-request RNG), stream tokens out through each
+   request's ``StreamingFuture``.
+4. **evict**: eos / length / cancelled sequences release pages
+   immediately (KV page eviction), freeing admission capacity for the
+   next iteration.
+
+Backpressure mirrors ``InferenceServer.submit``: a bounded queue
+raising ``QueueFullError``, ``ServerClosedError`` after shutdown, and
+a fault barrier that fails only the affected requests, never the
+worker.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..bucketing import ShapeBucketPolicy
+from ..request import (DeadlineExceededError, QueueFullError,
+                       ServerClosedError)
+from .kv_cache import PagedKVCache
+from .model_fns import CachedDecoder
+from .sampling import sample_next_tokens
+
+__all__ = ["GenerationServer", "StreamingFuture", "DecodeMetrics"]
+
+
+def _flag(name, default):
+    from ...framework.flags import flag_value
+    try:
+        v = flag_value(name)
+    except KeyError:
+        return default
+    return v
+
+
+class StreamingFuture:
+    """A generation request's result handle: tokens land one by one as
+    the engine emits them.
+
+    Consumer surface: iterate (``for tok in fut``) to stream, or
+    ``result(timeout)`` to block for the complete generated-token list;
+    ``tokens()`` snapshots what has landed so far; ``cancel()`` asks
+    the engine to evict the sequence at its next step. A failed
+    request raises its exception from ``result()``/iteration.
+    """
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._toks: List[int] = []
+        self._exc: Optional[BaseException] = None
+        self._done = False
+        self._finish_reason: Optional[str] = None
+        self._cancel_requested = False
+
+    # ---- consumer ----
+    def __iter__(self):
+        i = 0
+        while True:
+            with self._cond:
+                while len(self._toks) <= i and not self._done:
+                    self._cond.wait()
+                if i < len(self._toks):
+                    tok = self._toks[i]
+                    i += 1
+                else:
+                    if self._exc is not None:
+                        raise self._exc
+                    return
+            yield tok       # outside the lock: consumer code may block
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block until the stream finishes; returns ALL generated token
+        ids (eos included when one was produced)."""
+        with self._cond:
+            if not self._cond.wait_for(lambda: self._done, timeout):
+                raise TimeoutError("generation still streaming")
+            if self._exc is not None:
+                raise self._exc
+            return list(self._toks)
+
+    def tokens(self) -> List[int]:
+        with self._cond:
+            return list(self._toks)
+
+    def done(self) -> bool:
+        with self._cond:
+            return self._done
+
+    def exception(self) -> Optional[BaseException]:
+        with self._cond:
+            return self._exc
+
+    @property
+    def finish_reason(self) -> Optional[str]:
+        """"eos" | "length" | "cancelled" | "error" | deadline/shutdown
+        reasons; None while streaming."""
+        with self._cond:
+            return self._finish_reason
+
+    def cancel(self) -> bool:
+        """Request eviction; returns False when already finished. The
+        engine honors it at its next harvest — tokens already emitted
+        stay available."""
+        with self._cond:
+            if self._done:
+                return False
+            self._cancel_requested = True
+            return True
+
+    def cancelled(self) -> bool:
+        with self._cond:
+            return self._finish_reason == "cancelled"
+
+    # ---- engine side ----
+    def _emit(self, tok: int):
+        with self._cond:
+            self._toks.append(int(tok))
+            self._cond.notify_all()
+
+    def _finish(self, reason: str):
+        with self._cond:
+            if self._done:
+                return
+            self._done = True
+            self._finish_reason = reason
+            self._cond.notify_all()
+
+    def _fail(self, exc: BaseException, reason: str = "error"):
+        with self._cond:
+            if self._done:
+                return
+            self._exc = exc
+            self._done = True
+            self._finish_reason = reason
+            self._cond.notify_all()
+
+
+class _Request:
+    __slots__ = ("prompt", "max_new", "temperature", "rng", "future",
+                 "submit_t", "deadline")
+
+    def __init__(self, prompt: np.ndarray, max_new: int,
+                 temperature: float, seed: Optional[int],
+                 timeout_ms: Optional[float]):
+        self.prompt = prompt
+        self.max_new = int(max_new)
+        self.temperature = float(temperature)
+        self.rng = np.random.RandomState(seed)
+        self.future = StreamingFuture()
+        self.submit_t = time.monotonic()
+        self.deadline = (self.submit_t + timeout_ms / 1e3
+                         if timeout_ms else None)
+
+    def expired(self, now: float) -> bool:
+        return self.deadline is not None and now > self.deadline
+
+
+class _ActiveSeq:
+    """One live lane of the in-flight decode batch."""
+
+    __slots__ = ("req", "slot", "pages", "ctx", "max_total",
+                 "last_token", "n_generated", "last_emit_t")
+
+    def __init__(self, req: _Request, slot: int, pages: List[int],
+                 max_total: int):
+        self.req = req
+        self.slot = slot
+        self.pages = pages
+        self.ctx = len(req.prompt)      # tokens whose K/V is cached
+        self.max_total = max_total      # prompt + generation budget
+        self.last_token = -1
+        self.n_generated = 0
+        self.last_emit_t = 0.0
+
+
+_EVENTS = ("submitted", "completed", "rejected", "timed_out",
+           "cancelled", "failed")
+
+
+class DecodeMetrics:
+    """Decode-serving metric families on the PR 3 registry, plus
+    bounded windows for the JSON snapshot percentiles."""
+
+    def __init__(self, name: str, max_batch: int, page_capacity: int,
+                 window: int = 2048, registry=None):
+        from ...observability.registry import (PercentileWindow,
+                                               default_registry)
+        self.name = name
+        self._lock = threading.Lock()
+        reg = registry or default_registry()
+        occ_buckets = sorted({1, 2, 4, 8, 16, 32, 64, 128,
+                              max(1, int(max_batch))})
+        self._f_events = reg.counter(
+            "paddle_decode_requests_total",
+            "generation request lifecycle events per engine",
+            ("server", "event"))
+        self._f_tokens = reg.counter(
+            "paddle_decode_tokens_total",
+            "tokens emitted by the decode engine", ("server",))
+        self._f_inter = reg.histogram(
+            "paddle_decode_inter_token_ms",
+            "latency between consecutive streamed tokens of a sequence",
+            ("server",))
+        self._f_step = reg.histogram(
+            "paddle_decode_step_ms",
+            "device step durations by stage (prefill batch / decode "
+            "iteration)", ("server", "stage"))
+        self._f_occ = reg.histogram(
+            "paddle_decode_batch_occupancy",
+            "live lanes per decode iteration (continuous-batching "
+            "utilization of the fixed [max_batch, 1] step)",
+            ("server",), buckets=occ_buckets)
+        self._f_pages = reg.gauge(
+            "paddle_decode_kv_pages",
+            "KV-cache page occupancy by state", ("server", "state"))
+        self._f_evict = reg.counter(
+            "paddle_decode_kv_page_evictions_total",
+            "pages released by finished/cancelled sequences",
+            ("server",))
+        self._f_compile = reg.counter(
+            "paddle_decode_compile_total",
+            "decode-engine dispatch signatures by compile-cache result",
+            ("server", "result"))
+        for fam in (self._f_events, self._f_tokens, self._f_inter,
+                    self._f_step, self._f_occ, self._f_pages,
+                    self._f_evict, self._f_compile):
+            fam.clear(server=name)
+        self._events = {e: self._f_events.labels(server=name, event=e)
+                        for e in _EVENTS}
+        self._c_tokens = self._f_tokens.labels(server=name)
+        self._h_inter = self._f_inter.labels(server=name)
+        self._h_step = {s: self._f_step.labels(server=name, stage=s)
+                        for s in ("prefill", "decode")}
+        self._h_occ = self._f_occ.labels(server=name)
+        self._g_used = self._f_pages.labels(server=name, state="used")
+        self._g_free = self._f_pages.labels(server=name, state="free")
+        self._c_evict = self._f_evict.labels(server=name)
+        self._c_hit = self._f_compile.labels(server=name, result="hit")
+        self._c_miss = self._f_compile.labels(server=name,
+                                              result="miss")
+        self._w_inter = PercentileWindow(int(window))
+        self._w_step = {s: PercentileWindow(int(window))
+                        for s in ("prefill", "decode")}
+        self._occ_sum = 0
+        self._occ_n = 0
+        self._page_capacity = int(page_capacity)
+
+    def count(self, event: str, n: int = 1):
+        self._events[event].inc(n)
+
+    def observe_tokens(self, n: int):
+        self._c_tokens.inc(n)
+
+    def observe_inter_token(self, ms_list: Sequence[float]):
+        ms_list = [float(m) for m in ms_list]
+        if not ms_list:
+            return
+        with self._lock:
+            self._w_inter.extend(ms_list)
+        self._h_inter.observe_many(ms_list)
+
+    def observe_step(self, stage: str, ms: float):
+        with self._lock:
+            self._w_step[stage].observe(float(ms))
+        self._h_step[stage].observe(float(ms))
+
+    def observe_occupancy(self, n_active: int):
+        with self._lock:
+            self._occ_sum += int(n_active)
+            self._occ_n += 1
+        self._h_occ.observe(n_active)
+
+    def set_kv_pages(self, used: int, free: int):
+        self._g_used.set(used)
+        self._g_free.set(free)
+
+    def observe_evictions(self, n_pages: int):
+        self._c_evict.inc(n_pages)
+
+    def observe_compile(self, hit: bool):
+        (self._c_hit if hit else self._c_miss).inc()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            occ = (self._occ_sum / self._occ_n) if self._occ_n else 0.0
+            return {
+                "server": self.name,
+                "counters": {e: int(c.value)
+                             for e, c in self._events.items()},
+                "tokens_total": int(self._c_tokens.value),
+                "inter_token_ms": self._w_inter.snapshot(),
+                "step_ms": {s: w.snapshot()
+                            for s, w in self._w_step.items()},
+                "batch_occupancy": {"mean": occ, "steps": self._occ_n},
+                "kv_pages": {"capacity": self._page_capacity,
+                             "used": int(self._g_used.value),
+                             "free": int(self._g_free.value),
+                             "evicted_total": int(self._c_evict.value)},
+                "compile_cache": {"hits": int(self._c_hit.value),
+                                  "misses": int(self._c_miss.value)},
+            }
+
+
+class GenerationServer:
+    """Continuous-batching decode engine over one cache-capable
+    causal-LM Layer (``GPTForCausalLM`` or anything matching
+    ``model_fns.supports_cached_decode``).
+
+    ``submit_generate(prompt, ...) -> StreamingFuture`` with bounded-
+    queue backpressure and scheduling deadlines; parameters default to
+    the ``FLAGS_decode_*`` knobs. The model is snapshot at construction
+    (weight updates after construction are not picked up) and put in
+    eval mode.
+    """
+
+    def __init__(self, model, *, max_batch: Optional[int] = None,
+                 page_size: Optional[int] = None,
+                 num_pages: Optional[int] = None,
+                 max_seq_len: Optional[int] = None,
+                 seq_buckets: Optional[Sequence[int]] = None,
+                 queue_capacity: Optional[int] = None,
+                 default_timeout_ms: Optional[float] = None,
+                 eos_token_id: Optional[int] = None,
+                 pad_token_id: int = 0,
+                 donate: Optional[bool] = None,
+                 name: str = "generate",
+                 telemetry_port: Optional[int] = None,
+                 start: bool = True):
+        model.eval()
+        self.model = model
+        spec = model.kv_cache_spec()
+        self.max_batch = int(max_batch if max_batch is not None
+                             else _flag("FLAGS_decode_max_batch", 8))
+        self.page_size = int(page_size if page_size is not None
+                             else _flag("FLAGS_decode_page_size", 16))
+        self.max_seq_len = int(max_seq_len if max_seq_len is not None
+                               else spec["max_seq_len"])
+        self.eos_token_id = eos_token_id
+        self.pad_token_id = int(pad_token_id)
+        self.pages_per_seq = -(-self.max_seq_len // self.page_size)
+        if num_pages is None:
+            num_pages = int(_flag("FLAGS_decode_kv_pages", 0))
+        if not num_pages:
+            num_pages = 1 + self.max_batch * self.pages_per_seq
+        self.default_timeout_ms = default_timeout_ms \
+            if default_timeout_ms is not None \
+            else (_flag("FLAGS_decode_default_timeout_ms", 0.0) or None)
+        cap = queue_capacity if queue_capacity is not None \
+            else _flag("FLAGS_decode_queue_capacity", 64)
+        self.queue_capacity = int(cap)
+        if seq_buckets is None:
+            seq_buckets, b = [], 8
+            while b < self.max_seq_len:
+                seq_buckets.append(b)
+                b <<= 1
+            seq_buckets.append(self.max_seq_len)
+        self.policy = ShapeBucketPolicy(
+            max_batch_size=self.max_batch, pad_batch=True,
+            seq_buckets=seq_buckets, seq_axis=1)
+        self.decoder = CachedDecoder(
+            model, max_batch=self.max_batch, page_size=self.page_size,
+            pages_per_seq=self.pages_per_seq, donate=donate)
+        self.kv = PagedKVCache(model, num_pages=int(num_pages),
+                               page_size=self.page_size)
+        self.metrics = DecodeMetrics(name, self.max_batch,
+                                     self.kv.capacity)
+        self.metrics.set_kv_pages(0, self.kv.capacity)
+        # ONE Condition is both the engine lock and the wakeup channel
+        self._lock = threading.Condition()
+        self._queue: "deque[_Request]" = deque()
+        self._slots: List[Optional[_ActiveSeq]] = [None] * self.max_batch
+        self._tables = np.zeros((self.max_batch, self.pages_per_seq),
+                                np.int32)
+        self._closed = False
+        self._abort = False
+        self._loop_running = False
+        self._worker: Optional[threading.Thread] = None
+        self._steps = 0
+        self.telemetry = self._attach_telemetry(telemetry_port, name)
+        self._manifest_recorded = set()
+        self._manifest = self._init_manifest(name)
+        if self._manifest is not None and len(self._manifest) and \
+                bool(_flag("FLAGS_decode_warmup_from_manifest", False)):
+            self.warmup_from_manifest()
+        if start:
+            self.start()
+
+    # ------------------------------------------------------ plumbing
+    def _attach_telemetry(self, telemetry_port, name):
+        port = telemetry_port if telemetry_port is not None \
+            else _flag("FLAGS_serving_telemetry_port", -1)
+        if port is None or int(port) < 0:
+            return None
+        from ... import observability
+        srv = observability.start_telemetry_server(port=int(port))
+        observability.add_health_check(f"decode:{name}", self._health)
+        return srv
+
+    def _init_manifest(self, name):
+        if not str(_flag("FLAGS_compile_cache_dir", "") or ""):
+            return None
+        try:
+            from ...compile_cache import WarmupManifest, default_cache
+            cache = default_cache()
+            if cache is None:
+                return None
+            return WarmupManifest(WarmupManifest.default_path(
+                cache.directory, f"decode-{name}",
+                self.decoder.fingerprint()))
+        except Exception:  # noqa: BLE001 - optimization artifact only
+            return None
+
+    @property
+    def warmup_manifest(self):
+        return self._manifest
+
+    def _health(self):
+        if self._closed:
+            return False, "shut down"
+        w = self._worker
+        if w is not None and not w.is_alive() and not self._loop_running:
+            return False, "worker thread died"
+        return True, {"queue_depth": self.queue_depth,
+                      "active_sequences": self.active_sequences}
+
+    @property
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    @property
+    def active_sequences(self) -> int:
+        with self._lock:
+            return sum(1 for s in self._slots if s is not None)
+
+    def metrics_snapshot(self) -> dict:
+        return self.metrics.snapshot()
+
+    # ------------------------------------------------------ lifecycle
+    def start(self):
+        with self._lock:
+            if self._closed:
+                raise ServerClosedError("engine already shut down")
+            if self._worker is None or not self._worker.is_alive():
+                self._worker = threading.Thread(
+                    target=self._loop,
+                    name=f"decode-{self.metrics.name}", daemon=True)
+                self._worker.start()
+        return self
+
+    def shutdown(self, drain: bool = True,
+                 timeout: Optional[float] = None):
+        """Stop accepting requests; ``drain`` (default) lets queued and
+        in-flight sequences finish, otherwise both are failed with
+        ServerClosedError. Idempotent."""
+        with self._lock:
+            self._closed = True
+            if not drain:
+                self._abort = True
+            self._lock.notify_all()
+        w = self._worker
+        if w is not None and w.is_alive() and \
+                w is not threading.current_thread():
+            w.join(timeout)
+        elif not self._loop_running:
+            # never-started engine (start=False): run the loop inline so
+            # queued requests still drain (or abort) instead of hanging
+            # their futures forever
+            self._loop()
+        if self.telemetry is not None:
+            from ...observability import remove_health_check
+            remove_health_check(f"decode:{self.metrics.name}")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown(drain=exc[0] is None)
+        return False
+
+    # ------------------------------------------------------ submission
+    def submit_generate(self, prompt, max_new_tokens: int = 32,
+                        temperature: float = 0.0,
+                        timeout_ms: Optional[float] = None,
+                        seed: Optional[int] = None) -> StreamingFuture:
+        """Enqueue one prompt; returns the token stream. ``timeout_ms``
+        is a SCHEDULING deadline (like ``InferenceServer.submit``): a
+        request still queued past it fails with DeadlineExceededError;
+        once prefilled, the stream always runs to completion. Raises
+        QueueFullError at capacity, ServerClosedError after shutdown,
+        ValueError for prompts that leave no room to generate."""
+        if self._closed:
+            raise ServerClosedError("engine is shut down")
+        prompt = np.asarray(
+            prompt.numpy() if hasattr(prompt, "numpy") else prompt
+        ).astype(np.int64).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if prompt.size >= self.max_seq_len:
+            raise ValueError(
+                f"prompt length {prompt.size} leaves no room to "
+                f"generate within max_seq_len={self.max_seq_len}")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        req = _Request(prompt, max_new_tokens, temperature, seed,
+                       timeout_ms if timeout_ms is not None
+                       else self.default_timeout_ms)
+        with self._lock:
+            if self._closed:
+                raise ServerClosedError("engine is shut down")
+            if len(self._queue) >= self.queue_capacity:
+                self.metrics.count("rejected")
+                raise QueueFullError(
+                    f"generation queue at capacity "
+                    f"({self.queue_capacity})")
+            self._queue.append(req)
+            self.metrics.count("submitted")
+            self._lock.notify_all()
+        return req.future
+
+    def generate(self, prompt, max_new_tokens: int = 32,
+                 temperature: float = 0.0,
+                 timeout_ms: Optional[float] = None,
+                 seed: Optional[int] = None) -> List[int]:
+        """Synchronous convenience: submit and block for the full
+        generated-token list."""
+        return self.submit_generate(
+            prompt, max_new_tokens, temperature, timeout_ms,
+            seed).result()
+
+    # ------------------------------------------------------ warmup
+    def warmup(self, seq_buckets: Optional[Sequence[int]] = None,
+               batch_buckets: Optional[Sequence[int]] = None) -> int:
+        """Pre-compile the decode lattice: the single decode-step shape
+        plus every (pow2-row, seq-bucket) prefill shape admission can
+        dispatch — continuous batching prefills PARTIAL row groups as
+        slots churn, so the row ladder matters, not just max_batch.
+        Returns the number of fresh signatures."""
+        fresh = self._warm_decode()
+        seqs = list(seq_buckets if seq_buckets is not None
+                    else (self.policy.seq_buckets or []))
+        if batch_buckets is None:
+            batch_buckets, r = [], 1
+            while r < self.max_batch:
+                batch_buckets.append(r)
+                r <<= 1
+            batch_buckets.append(self.max_batch)
+        for s in seqs:
+            for r in batch_buckets:
+                fresh += self._warm_prefill(int(r), int(s))
+        return fresh
+
+    def _warm_decode(self) -> int:
+        logits, k2, v2, fresh = self.decoder.decode(
+            np.zeros(self.max_batch, np.int64),
+            np.zeros(self.max_batch, np.int32),
+            np.zeros(self.max_batch, bool),
+            np.zeros(self.max_batch, np.int32),
+            np.zeros_like(self._tables), self.kv.k, self.kv.v)
+        np.asarray(logits)
+        self.kv.k, self.kv.v = k2, v2
+        self._note_dispatch("generate_decode", fresh, [
+            ((self.max_batch,), "int64"), ((self.max_batch,), "int32"),
+            ((self.max_batch,), "bool"), ((self.max_batch,), "int32"),
+            (self._tables.shape, "int32")], record=False)
+        return int(fresh)
+
+    def _warm_prefill(self, rows: int, seq: int) -> int:
+        ids = np.zeros((rows, seq), np.int64)
+        lens = np.zeros(rows, np.int32)
+        tables = np.zeros((rows, self.pages_per_seq), np.int32)
+        last, k2, v2, fresh = self.decoder.prefill(
+            ids, lens, tables, self.kv.k, self.kv.v)
+        np.asarray(last)
+        self.kv.k, self.kv.v = k2, v2
+        self._note_dispatch("generate_prefill", fresh, [
+            (ids.shape, "int64"), (lens.shape, "int32"),
+            (tables.shape, "int32")], record=False)
+        return int(fresh)
+
+    def warmup_from_manifest(self, path: Optional[str] = None) -> int:
+        """Replay the persisted decode/prefill signatures a previous
+        process dispatched — each a persistent-cache load when
+        ``FLAGS_compile_cache_dir`` is warm. Returns the fresh-compile
+        count; 0 when no manifest exists."""
+        if path is not None:
+            from ...compile_cache import WarmupManifest
+            manifest = WarmupManifest(path)
+        else:
+            manifest = self._manifest
+        if manifest is None:
+            return 0
+        fresh = 0
+        for spec in manifest.specs(site="generate_prefill"):
+            (rows, seq) = spec["feeds"][0][0]
+            if rows > self.max_batch or seq > self.max_seq_len:
+                continue
+            fresh += self._warm_prefill(int(rows), int(seq))
+        if manifest.specs(site="generate_decode"):
+            fresh += self._warm_decode()
+        return fresh
+
+    def _note_dispatch(self, site: str, fresh: bool, feeds,
+                       record: bool = True):
+        """Compile accounting per dispatch; TRAFFIC dispatches (not
+        warmup replays) persist their signature so a restarted engine
+        pre-warms exactly the observed lattice."""
+        self.metrics.observe_compile(hit=not fresh)
+        if record and self._manifest is not None:
+            key = (site, tuple(tuple(s) for s, _ in feeds))
+            if key not in self._manifest_recorded:
+                self._manifest_recorded.add(key)
+                self._manifest.record(feeds, site=site)
+
+    # ------------------------------------------------------ worker
+    def _loop(self):
+        with self._lock:
+            self._loop_running = True
+        try:
+            while True:
+                self._admit_and_prefill()
+                with self._lock:
+                    active = [s for s in self._slots if s is not None]
+                    if self._abort:
+                        self._do_abort()
+                        return
+                    if not active:
+                        if self._closed and not self._queue:
+                            return
+                        self._lock.wait(0.05)
+                        continue
+                self._decode_iteration(active)
+        finally:
+            with self._lock:
+                self._loop_running = False
+
+    def _do_abort(self):
+        """drain=False shutdown: fail everything still live (lock
+        held)."""
+        err = ServerClosedError("engine shut down before completion")
+        for req in self._queue:
+            req.future._fail(err, reason="shutdown")
+            self.metrics.count("failed")
+        self._queue.clear()
+        for seq in list(self._slots):
+            if seq is not None:
+                seq.req.future._fail(err, reason="shutdown")
+                self._release(seq, "failed")
+
+    # ---- admission + prefill ----
+    def _admit_and_prefill(self):
+        admitted: List[_ActiveSeq] = []
+        now = time.monotonic()
+        with self._lock:
+            # deadline sweep over the whole queue (it is bounded)
+            live = deque()
+            for req in self._queue:
+                if req.expired(now):
+                    self.metrics.count("timed_out")
+                    req.future._fail(
+                        DeadlineExceededError(
+                            "deadline passed before the request could "
+                            "be scheduled"), reason="timed_out")
+                else:
+                    live.append(req)
+            self._queue = live
+            free_slots = [i for i, s in enumerate(self._slots)
+                          if s is None]
+            while self._queue and free_slots:
+                req = self._queue[0]
+                max_total = min(len(req.prompt) + req.max_new,
+                                self.max_seq_len)
+                pages = self.kv.alloc(self.kv.pages_for(max_total))
+                if pages is None:
+                    break       # FIFO head-of-line until pages free up
+                self._queue.popleft()
+                slot = free_slots.pop(0)
+                seq = _ActiveSeq(req, slot, pages, max_total)
+                self._slots[slot] = seq
+                self._tables[slot, :] = 0
+                self._tables[slot, :len(pages)] = pages
+                admitted.append(seq)
+            if admitted:
+                self.metrics.set_kv_pages(self.kv.used_pages,
+                                          self.kv.free_pages)
+        if not admitted:
+            return
+        # prefill OUTSIDE the lock, grouped by prompt seq bucket
+        groups: Dict[int, List[_ActiveSeq]] = {}
+        for seq in admitted:
+            bucket = min(self.policy.bucket_seq(len(seq.req.prompt)),
+                         self.max_seq_len)
+            groups.setdefault(bucket, []).append(seq)
+        for bucket, seqs in groups.items():
+            self._prefill_group(seqs, bucket)
+
+    def _prefill_group(self, seqs: List[_ActiveSeq], seq_bucket: int):
+        rows = len(seqs)
+        padded = min(self.policy.bucket_batch(rows), self.max_batch)
+        ids = np.full((padded, seq_bucket), self.pad_token_id, np.int64)
+        lens = np.zeros(padded, np.int32)
+        tables = np.zeros((padded, self.pages_per_seq), np.int32)
+        for i, seq in enumerate(seqs):
+            p = seq.req.prompt
+            ids[i, :len(p)] = p
+            lens[i] = len(p)
+            tables[i] = self._tables[seq.slot]
+        t0 = time.perf_counter()
+        try:
+            last, k2, v2, fresh = self.decoder.prefill(
+                ids, lens, tables, self.kv.k, self.kv.v)
+            logits = np.asarray(last)
+        except Exception as e:  # noqa: BLE001 - fault barrier: fail
+            # only THIS group's requests; the worker survives
+            with self._lock:
+                for seq in seqs:
+                    seq.req.future._fail(e)
+                    self._release(seq, "failed")
+            return
+        self.kv.k, self.kv.v = k2, v2
+        ms = (time.perf_counter() - t0) * 1e3
+        self.metrics.observe_step("prefill", ms)
+        self._note_dispatch("generate_prefill", fresh, [
+            (ids.shape, "int64"), (lens.shape, "int32"),
+            (tables.shape, "int32")])
+        self._sample_and_emit(seqs, logits[:rows])
+
+    # ---- one decode iteration ----
+    def _decode_iteration(self, active: List[_ActiveSeq]):
+        tokens = np.zeros(self.max_batch, np.int64)
+        positions = np.zeros(self.max_batch, np.int32)
+        mask = np.zeros(self.max_batch, bool)
+        ctx_after = np.zeros(self.max_batch, np.int32)
+        for seq in active:
+            tokens[seq.slot] = seq.last_token
+            positions[seq.slot] = seq.ctx
+            mask[seq.slot] = True
+            ctx_after[seq.slot] = seq.ctx + 1
+        t0 = time.perf_counter()
+        try:
+            logits, k2, v2, fresh = self.decoder.decode(
+                tokens, positions, mask, ctx_after, self._tables,
+                self.kv.k, self.kv.v)
+            logits = np.asarray(logits)
+        except Exception as e:  # noqa: BLE001 - fault barrier: a model
+            # error fails the in-flight sequences, not the engine
+            with self._lock:
+                for seq in active:
+                    seq.req.future._fail(e)
+                    self._release(seq, "failed")
+            return
+        self.kv.k, self.kv.v = k2, v2
+        ms = (time.perf_counter() - t0) * 1e3
+        self._steps += 1
+        self.metrics.observe_step("decode", ms)
+        self.metrics.observe_occupancy(len(active))
+        self._note_dispatch("generate_decode", fresh, [
+            ((self.max_batch,), "int64"), ((self.max_batch,), "int32"),
+            ((self.max_batch,), "bool"), ((self.max_batch,), "int32"),
+            (self._tables.shape, "int32")])
+        for seq in active:
+            seq.ctx += 1
+        self._sample_and_emit(active,
+                              logits[[s.slot for s in active]])
+
+    # ---- shared harvest: sample, stream, evict ----
+    def _sample_and_emit(self, seqs: List[_ActiveSeq],
+                         logits: np.ndarray):
+        temps = np.array([s.req.temperature for s in seqs], np.float64)
+        uniforms = np.array([s.req.rng.random_sample() for s in seqs])
+        toks = sample_next_tokens(logits, temps, uniforms=uniforms)
+        now = time.monotonic()
+        inter = []
+        self.metrics.observe_tokens(len(seqs))
+        with self._lock:
+            for seq, tok in zip(seqs, toks):
+                seq.last_token = int(tok)
+                seq.n_generated += 1
+                if seq.n_generated > 1:
+                    inter.append((now - seq.last_emit_t) * 1e3)
+                seq.last_emit_t = now
+                seq.req.future._emit(tok)
+                if seq.req.future._cancel_requested:
+                    seq.req.future._finish("cancelled")
+                    self._release(seq, "cancelled")
+                elif self.eos_token_id is not None and \
+                        int(tok) == self.eos_token_id:
+                    seq.req.future._finish("eos")
+                    self._release(seq, "completed")
+                elif seq.n_generated >= seq.req.max_new or \
+                        seq.ctx + 1 > seq.max_total:
+                    # ctx + 1: emitting one more token would need a
+                    # cache slot past this sequence's reservation
+                    seq.req.future._finish("length")
+                    self._release(seq, "completed")
+        if inter:
+            self.metrics.observe_inter_token(inter)
+
+    def _release(self, seq: _ActiveSeq, event: str):
+        """Evict one sequence: pages back to the pool, slot freed
+        (lock held)."""
+        if self._slots[seq.slot] is not seq:
+            return
+        self._slots[seq.slot] = None
+        self._tables[seq.slot, :] = 0
+        self.kv.free(seq.pages)
+        self.metrics.observe_evictions(len(seq.pages))
+        self.metrics.count(event)
+        self.metrics.set_kv_pages(self.kv.used_pages,
+                                  self.kv.free_pages)
+        self._lock.notify_all()

@@ -1,0 +1,80 @@
+"""Paged KV cache: device pools + the host-side page allocator.
+
+The device side is a per-layer pool pytree (``model.init_kv_pools``)
+shaped ``[num_pages, page_size, heads, head_dim]`` whose contents the
+jitted prefill/decode steps update functionally (ops/paged_attention);
+the host side here owns which pages belong to whom: a free list, the
+per-slot page assignments, and the occupancy/eviction accounting. Page
+0 is the reserved trash page (masked writes land there) and is never
+handed out.
+
+Thread-safety: the engine's worker thread is the only mutator; the
+allocator itself is plain data guarded by the engine lock.
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+__all__ = ["PagedKVCache"]
+
+
+class PagedKVCache:
+    """Host bookkeeping for one pool pytree.
+
+    ``num_pages`` INCLUDES the trash page, so ``capacity`` (allocatable
+    pages) is ``num_pages - 1``. ``alloc`` is all-or-nothing: a request
+    that cannot get its full reservation gets nothing, so admission
+    control can retry later without partial-reservation leaks.
+    """
+
+    def __init__(self, model, num_pages: int, page_size: int,
+                 dtype=None):
+        if num_pages < 2:
+            raise ValueError("need at least one allocatable page plus "
+                             "the trash page")
+        self.page_size = int(page_size)
+        self.num_pages = int(num_pages)
+        self.k, self.v = model.init_kv_pools(self.num_pages,
+                                             self.page_size, dtype)
+        self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
+        self.evicted_pages_total = 0
+
+    # ---- geometry ----
+    @property
+    def capacity(self) -> int:
+        return self.num_pages - 1
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return self.capacity - len(self._free)
+
+    def pages_for(self, tokens: int) -> int:
+        """Pages needed to hold ``tokens`` positions."""
+        return max(1, math.ceil(tokens / self.page_size))
+
+    # ---- allocation ----
+    def alloc(self, n_pages: int) -> Optional[List[int]]:
+        """Take ``n_pages`` from the free list, or None (and take
+        nothing) if fewer are free."""
+        if n_pages > len(self._free):
+            return None
+        taken = self._free[-n_pages:]
+        del self._free[-n_pages:]
+        return taken
+
+    def free(self, pages: List[int]):
+        """Return a finished sequence's pages (its eviction from the
+        cache). The page contents stay as garbage until rewritten —
+        correctness relies on block tables, not on zeroing."""
+        for p in pages:
+            if not 0 < p < self.num_pages:
+                raise ValueError(f"page {p} out of range")
+        self._free.extend(pages)
+        self.evicted_pages_total += len(pages)
+        if len(self._free) > self.capacity:
+            raise RuntimeError("double free: free list exceeds capacity")

@@ -1,0 +1,242 @@
+"""Jitted prefill/decode steps over a cache-capable causal-LM Layer.
+
+``CachedDecoder`` functionalizes the model once (``jit.functional``),
+then exposes exactly two device entry points:
+
+- ``prefill(ids, prompt_lens, tables, pools)`` — one forward over a
+  padded prompt window that writes the prompt's K/V into the paged
+  pools and returns only the last real position's logits ``[B, vocab]``
+  (the full ``[B, S, vocab]`` tensor never crosses to the host);
+- ``decode(tokens, positions, active, ctx, tables, pools)`` — the
+  fixed-shape ``[max_batch, 1]`` decode step: append one position per
+  live lane, attend through the block tables, return ``[B, vocab]``.
+
+Both are ``jax.jit``-compiled with the KV pools donated on backends
+that support donation (the pools update in place on device), and both
+consult the persistent compile cache (PR 5) first: on a warm
+``FLAGS_compile_cache_dir`` the first dispatch of a signature loads a
+ready AOT executable instead of tracing + compiling.
+"""
+from __future__ import annotations
+
+import hashlib
+import inspect
+import json
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["CachedDecoder", "supports_cached_decode"]
+
+
+def supports_cached_decode(model) -> bool:
+    """True when ``model.forward`` accepts a ``cache`` argument and the
+    model can build its own paged pools — the duck-typed contract the
+    decode engine and the hybrid-parallel generate helper key on."""
+    fwd = getattr(model, "forward", None)
+    if fwd is None or not callable(getattr(model, "init_kv_pools", None)):
+        return False
+    try:
+        return "cache" in inspect.signature(fwd).parameters
+    except (TypeError, ValueError):  # builtins / C-level callables
+        return False
+
+
+class CachedDecoder:
+    """Prefill/decode dispatch for one model instance.
+
+    ``page_size``/``pages_per_seq`` fix the block-table geometry
+    (``T = pages_per_seq * page_size`` gathered context slots);
+    ``max_batch`` fixes the decode-step shape. The caller owns the pool
+    pytree (see ``PagedKVCache``) and threads it through every call —
+    when ``donate`` is active the passed-in pools are consumed and MUST
+    be replaced by the returned ones.
+
+    Not thread-safe against concurrent mutation of the model's
+    parameters (the engine snapshots them here at construction).
+    """
+
+    def __init__(self, model, *, max_batch: int, page_size: int,
+                 pages_per_seq: int, donate: Optional[bool] = None):
+        import jax
+
+        from ...jit.functional import state_arrays
+        from ...models.gpt import GPTKVCache
+
+        if not supports_cached_decode(model):
+            raise TypeError(
+                f"{type(model).__name__} does not support KV-cached "
+                f"decode (forward must accept cache=, and the model "
+                f"must expose init_kv_pools)")
+        self.model = model
+        self.max_batch = int(max_batch)
+        self.page_size = int(page_size)
+        self.pages_per_seq = int(pages_per_seq)
+        self._params, self._buffers = state_arrays(model)
+        self._donate = bool(donate) if donate is not None \
+            else jax.default_backend() != "cpu"
+        self._fp: Optional[str] = None
+        # per-signature AOT memo; False marks "tried, unavailable"
+        self._aot: Dict[tuple, object] = {}
+        self.compiled_signatures = set()    # (site, shape-sig) seen
+
+        _Tensor = None
+
+        def _wrap(a):
+            nonlocal _Tensor
+            if _Tensor is None:
+                from ...core.tensor import Tensor
+                _Tensor = Tensor
+            return _Tensor(a, stop_gradient=True)
+
+        import jax.numpy as jnp
+
+        from ...jit.functional import functional_call
+
+        page = self.page_size
+
+        def _prefill(params, buffers, ids, prompt_lens, tables, k, v):
+            b, s = ids.shape
+            positions = jnp.broadcast_to(
+                jnp.arange(s, dtype=jnp.int32), (b, s))
+            valid = positions < prompt_lens[:, None]
+            cache = GPTKVCache(
+                "prefill", page,
+                jax.tree_util.tree_map(_wrap, k),
+                jax.tree_util.tree_map(_wrap, v),
+                _wrap(tables), _wrap(prompt_lens), _wrap(valid),
+                _wrap(positions))
+            logits, (k2, v2) = functional_call(
+                model, params, buffers, ids, cache=cache, training=False)
+            # only the last REAL position's logits leave the device
+            idx = jnp.clip(prompt_lens.astype(jnp.int32) - 1, 0, s - 1)
+            idx = jnp.broadcast_to(idx[:, None, None],
+                                   (b, 1, logits.shape[-1]))
+            last = jnp.take_along_axis(logits, idx, axis=1)[:, 0]
+            return last, k2, v2
+
+        def _decode(params, buffers, tokens, positions, active, ctx,
+                    tables, k, v):
+            b = tokens.shape[0]
+            ids = tokens[:, None]
+            cache = GPTKVCache(
+                "decode", page,
+                jax.tree_util.tree_map(_wrap, k),
+                jax.tree_util.tree_map(_wrap, v),
+                _wrap(tables), _wrap(ctx), _wrap(active[:, None]),
+                _wrap(positions[:, None].astype(jnp.int32)))
+            logits, (k2, v2) = functional_call(
+                model, params, buffers, ids, cache=cache, training=False)
+            return logits[:, 0], k2, v2
+
+        donate_pf = (5, 6) if self._donate else ()
+        donate_dc = (7, 8) if self._donate else ()
+        self._prefill_jit = jax.jit(_prefill, donate_argnums=donate_pf)
+        self._decode_jit = jax.jit(_decode, donate_argnums=donate_dc)
+
+    def refresh_params(self):
+        """Re-snapshot the model's current parameter arrays (they are
+        call operands, not baked constants, so no recompile — the
+        hybrid-parallel generate helper calls this per generate() so a
+        training step between calls is picked up)."""
+        from ...jit.functional import state_arrays
+        self._params, self._buffers = state_arrays(self.model)
+
+    # ------------------------------------------------------ identity
+    def fingerprint(self) -> str:
+        """Stable identity of (model params/config/code, decode
+        geometry) for persistent-cache keys and the warmup manifest."""
+        if self._fp is None:
+            from ...compile_cache import layer_fingerprint
+            geom = {"max_batch": self.max_batch,
+                    "page_size": self.page_size,
+                    "pages_per_seq": self.pages_per_seq,
+                    "donate": self._donate, "v": 1}
+            h = hashlib.sha256(layer_fingerprint(self.model).encode())
+            h.update(json.dumps(geom, sort_keys=True).encode())
+            self._fp = h.hexdigest()
+        return self._fp
+
+    # ------------------------------------------------------ dispatch
+    @staticmethod
+    def _sig_of(args) -> tuple:
+        """Shape signature of the NON-weight operands (params/buffers
+        are fixed for this decoder's lifetime — hashing their hundreds
+        of leaves per decode step would be pure overhead)."""
+        import jax
+        return tuple(
+            (tuple(int(d) for d in a.shape), str(np.dtype(a.dtype)))
+            for a in jax.tree_util.tree_leaves(args[2:]))
+
+    def _aot_exec(self, site: str, jitted, args):
+        """Persistent-cache tier (mirrors Predictor._aot_serving_call):
+        load-or-compile an AOT executable for this signature, memoized
+        per (site, signature, flags generation); any failure degrades
+        to the plain jitted dispatch."""
+        from ...framework.flags import flag_value, flags_generation
+        if not str(flag_value("FLAGS_compile_cache_dir") or ""):
+            return None
+        sig = (site, flags_generation()) + self._sig_of(args)
+        memo = self._aot
+        if sig in memo:
+            fn = memo[sig]
+            return fn if fn is not False else None
+        fn = None
+        try:
+            import jax
+
+            from ... import compile_cache as cc
+            cache = cc.default_cache()
+            if cache is not None:
+                specs = jax.tree_util.tree_map(
+                    lambda a: jax.ShapeDtypeStruct(
+                        tuple(a.shape), np.dtype(a.dtype)), args)
+                key, parts = cc.cache_key(
+                    self.fingerprint(), list(specs), mesh=None,
+                    extra={"site": site})
+                fn, _hit = cache.get_or_compile(
+                    key, lambda: jitted.lower(*specs).compile(),
+                    site=site, meta=parts)
+        except Exception:  # noqa: BLE001 - AOT is an optimization
+            fn = None      # tier; never let it break decode
+        memo[sig] = fn if fn is not None else False
+        return fn
+
+    def _dispatch(self, site: str, jitted, args) -> Tuple[object, bool]:
+        """Returns ``(outputs, was_new_signature)``."""
+        sig = (site,) + self._sig_of(args)
+        fresh = sig not in self.compiled_signatures
+        self.compiled_signatures.add(sig)
+        fn = self._aot_exec(site, jitted, args) or jitted
+        return fn(*args), fresh
+
+    def prefill(self, ids: np.ndarray, prompt_lens: np.ndarray,
+                tables: np.ndarray, k, v):
+        """ids [B, S] int64 (left-aligned, zero-padded); prompt_lens
+        [B] int32 (0 = dead pad row); tables [B, P] int32. Returns
+        ``(last_logits [B, vocab] jax array, k', v', new_signature)``."""
+        args = (self._params, self._buffers,
+                np.ascontiguousarray(ids, np.int64),
+                np.ascontiguousarray(prompt_lens, np.int32),
+                np.ascontiguousarray(tables, np.int32), k, v)
+        (last, k2, v2), fresh = self._dispatch(
+            "generate_prefill", self._prefill_jit, args)
+        return last, k2, v2, fresh
+
+    def decode(self, tokens: np.ndarray, positions: np.ndarray,
+               active: np.ndarray, ctx: np.ndarray,
+               tables: np.ndarray, k, v):
+        """One fixed-shape decode step. tokens [B] int64; positions [B]
+        int32 (slot being written); active [B] bool; ctx [B] int32
+        visible length INCLUDING this token; tables [B, P] int32.
+        Returns ``(logits [B, vocab] jax array, k', v',
+        new_signature)``."""
+        args = (self._params, self._buffers,
+                np.ascontiguousarray(tokens, np.int64),
+                np.ascontiguousarray(positions, np.int32),
+                np.ascontiguousarray(active, bool),
+                np.ascontiguousarray(ctx, np.int32),
+                np.ascontiguousarray(tables, np.int32), k, v)
+        (logits, k2, v2), fresh = self._dispatch(
+            "generate_decode", self._decode_jit, args)
+        return logits, k2, v2, fresh

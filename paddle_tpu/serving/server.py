@@ -453,7 +453,10 @@ class InferenceServer:
         if manifest is None:
             return 0
         fresh = 0
-        for spec in manifest.specs():
+        # only the batch-predict signatures: decode-engine entries
+        # ("generate_*" sites) are replayed by GenerationServer, whose
+        # feeds mean nothing to the Predictor dispatch
+        for spec in manifest.specs(site="predict"):
             arrs = [np.zeros(tuple(shape), dtype)
                     for shape, dtype in spec["feeds"]]
             rows = int(arrs[0].shape[0]) if arrs[0].ndim else 1

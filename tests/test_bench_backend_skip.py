@@ -1,0 +1,60 @@
+"""Regression tests for the BENCH_r04 crash: a backend that wedges
+AFTER the subprocess probe succeeded used to kill bench.py rc=1 from
+inside whatever eager op dispatched first (a ``convert_element_type``
+on the 1.3B path — which made it LOOK like a dtype regression). The
+bench must classify in-process backend-unavailable errors and emit the
+structured ``"skipped": true`` record instead."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestBackendUnavailableClassifier:
+    def test_matches_the_r04_error_shape(self):
+        sys.path.insert(0, REPO)
+        import bench
+        e = RuntimeError(
+            "Unable to initialize backend 'axon': UNAVAILABLE: TPU "
+            "backend setup/compile error (Unavailable). (set "
+            "JAX_PLATFORMS='' to automatically choose an available "
+            "backend)")
+        assert bench._backend_unavailable(e)
+        assert bench._backend_unavailable(
+            RuntimeError("Unable to initialize backend 'rocm': boom"))
+
+    def test_real_errors_still_raise(self):
+        sys.path.insert(0, REPO)
+        import bench
+        assert not bench._backend_unavailable(
+            ValueError("operand dtypes must match"))
+        assert not bench._backend_unavailable(
+            TypeError("convert_element_type got bad new_dtype"))
+
+
+@pytest.mark.slow
+def test_probe_pass_then_wedge_emits_skip_record():
+    """End-to-end: probe says the backend is fine, the in-process first
+    op then hits backend-unavailable (simulated with an uninstallable
+    JAX_PLATFORMS) — bench must exit 0 with a skipped record, not
+    crash."""
+    env = dict(os.environ, JAX_PLATFORMS="rocm")
+    env.pop("XLA_FLAGS", None)
+    code = (
+        "import sys; sys.argv = ['bench.py', '--config', 'small', "
+        "'--steps', '1', '--windows', '1']\n"
+        "import bench\n"
+        "bench._probe_backend = lambda **kw: ('tpu', '', "
+        "{'attempts': []})\n"
+        "raise SystemExit(bench.main())\n")
+    r = subprocess.run([sys.executable, "-c", code], cwd=REPO, env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    record = json.loads(r.stdout.strip().splitlines()[-1])
+    assert record["skipped"] is True
+    assert record["metric"] == "backend_unavailable"
+    assert "after a successful probe" in record["error"]

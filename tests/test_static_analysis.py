@@ -364,6 +364,32 @@ class TestLockDiscipline:
                            "paddle_tpu/observability/",
                            "paddle_tpu/elastic/")
 
+    def test_scope_includes_decode_engine_subpackage(self, tmp_path):
+        """The serving/ prefix must reach the generation subpackage —
+        the decode engine runs a real worker thread, so its lock
+        discipline is in scope (an injected violation there is
+        reported)."""
+        pkg = tmp_path / "paddle_tpu" / "serving" / "generation"
+        pkg.mkdir(parents=True)
+        (pkg / "engine.py").write_text(textwrap.dedent("""
+            import threading
+
+            class Engine:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def guarded(self):
+                    with self._lock:
+                        self._n += 1
+
+                def unguarded(self):
+                    self._n = 5
+        """))
+        findings = _run(tmp_path, [LockDisciplineAnalyzer()])
+        assert any(f.rule == "LK001" and "generation" in f.path
+                   for f in findings)
+
 
 # ===================================================================
 # 5. core: fingerprints, baseline, walker, CLI

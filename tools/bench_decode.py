@@ -1,0 +1,158 @@
+"""Decode-serving benchmark: paged-KV continuous batching vs the
+full-window generate() baseline.
+
+Measures, on the same model/prompts/token budget:
+
+- **baseline**: ``HybridParallelInferenceHelper._full_window_generate``
+  — the pre-PR-7 path that re-runs the whole O(T^2 L) padded-window
+  forward for every emitted token (one compiled shape, greedy);
+- **engine**: ``GenerationServer`` — prefill once per prompt, then
+  fixed-shape ``[max_batch, 1]`` cached decode steps with continuous
+  batching, tokens streamed per request.
+
+Reports aggregate decode tokens/s for both, the speedup ratio, p99
+inter-token latency (engine: measured between streamed tokens;
+baseline: window time / tokens, the lockstep equivalent), and a
+cached-vs-uncached logits equivalence probe. One JSON line to stdout;
+``--out`` also writes the committed BENCH_DECODE_r*.json record.
+
+Usage: JAX_PLATFORMS=cpu python tools/bench_decode.py
+       [--batch 8] [--prompt-len 12] [--max-new 48] [--trials 3]
+       [--requests N] [--out BENCH_DECODE_rNN.json]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _median(xs):
+    xs = sorted(xs)
+    return xs[len(xs) // 2]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=8,
+                    help="concurrent prompts (= engine max_batch)")
+    ap.add_argument("--requests", type=int, default=0,
+                    help="total requests per engine trial (0 = 2x "
+                         "batch, exercising join/evict churn)")
+    ap.add_argument("--prompt-len", type=int, default=12)
+    ap.add_argument("--max-new", type=int, default=48)
+    ap.add_argument("--page-size", type=int, default=16)
+    ap.add_argument("--trials", type=int, default=3)
+    ap.add_argument("--out", default=None,
+                    help="also write the JSON record here")
+    args = ap.parse_args()
+
+    import jax
+
+    if jax.default_backend() == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.fleet.utils import (
+        HybridParallelInferenceHelper)
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+    from paddle_tpu.serving.generation import GenerationServer
+
+    paddle.seed(0)
+    cfg = gpt_tiny(use_flash_attention=False)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+
+    b, plen, new = args.batch, args.prompt_len, args.max_new
+    total = plen + new
+    assert total <= cfg.max_seq_len
+    rng = np.random.RandomState(0)
+    prompts = rng.randint(0, cfg.vocab_size, (b, plen)).astype("int64")
+    n_requests = args.requests or 2 * b
+
+    # ---- equivalence probe: cached decode logits vs full forward ----
+    from paddle_tpu.serving.generation.model_fns import CachedDecoder
+    pages_per_seq = -(-cfg.max_seq_len // args.page_size)
+    dec = CachedDecoder(model, max_batch=b, page_size=args.page_size,
+                        pages_per_seq=pages_per_seq)
+    k, v = model.init_kv_pools(1 + b * pages_per_seq, args.page_size)
+    tables = (1 + np.arange(b * pages_per_seq, dtype=np.int32)
+              .reshape(b, pages_per_seq))
+    lens = np.full(b, plen, np.int32)
+    last, k, v, _ = dec.prefill(prompts, lens, tables, k, v)
+    cur = np.asarray(last).argmax(-1)
+    ref_ids = np.concatenate([prompts, cur[:, None]], 1)
+    logits, k, v, _ = dec.decode(
+        cur, np.full(b, plen, np.int32), np.ones(b, bool),
+        np.full(b, plen + 1, np.int32), tables, k, v)
+    ref = model(paddle.to_tensor(ref_ids)).numpy()[:, -1]
+    equiv = float(np.abs(np.asarray(logits) - ref).max())
+
+    # ---- baseline: full-window generate ----
+    helper = HybridParallelInferenceHelper(model, max_length=total)
+    helper._full_window_generate(prompts, total, 0.0, 0)  # compile+warm
+    base_tps, base_tok_ms = [], []
+    for _ in range(args.trials):
+        t0 = time.perf_counter()
+        out = helper._full_window_generate(prompts, total, 0.0, 0)
+        dt = time.perf_counter() - t0
+        assert out.shape == (b, total)
+        base_tps.append(b * new / dt)
+        base_tok_ms.append(dt / new * 1e3)
+    baseline = _median(base_tps)
+
+    # ---- engine: continuous-batching cached decode ----
+    eng_tps, eng_p99 = [], []
+    occupancy = None
+    for trial in range(args.trials):
+        srv = GenerationServer(
+            model, max_batch=b, page_size=args.page_size,
+            name=f"bench{trial}", start=False)
+        srv.warmup(seq_buckets=[srv.policy.bucket_seq(plen)])
+        srv.start()
+        t0 = time.perf_counter()
+        futs = [srv.submit_generate(prompts[i % b], max_new_tokens=new)
+                for i in range(n_requests)]
+        done = [f.result(timeout=600) for f in futs]
+        dt = time.perf_counter() - t0
+        n_tokens = sum(len(d) for d in done)
+        snap = srv.metrics_snapshot()
+        srv.shutdown()
+        eng_tps.append(n_tokens / dt)
+        eng_p99.append(snap["inter_token_ms"].get("p99", 0.0))
+        occupancy = snap["batch_occupancy"]
+    engine = _median(eng_tps)
+
+    record = {
+        "metric": "decode_tokens_per_sec",
+        "skipped": False,
+        "value": round(engine, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(engine / baseline, 3) if baseline else 0.0,
+        "baseline_full_window_tokens_per_sec": round(baseline, 1),
+        "baseline_per_token_ms": round(_median(base_tok_ms), 3),
+        "engine_p99_inter_token_ms": round(_median(eng_p99), 3),
+        "batch_occupancy": occupancy,
+        "cached_vs_uncached_max_abs_diff": equiv,
+        "config": {"model": "gpt_tiny", "batch": b,
+                   "requests_per_trial": n_requests,
+                   "prompt_len": plen, "max_new_tokens": new,
+                   "page_size": args.page_size,
+                   "trials": args.trials,
+                   "backend": jax.default_backend()},
+    }
+    line = json.dumps(record)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(json.dumps(record, indent=1, sort_keys=True) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

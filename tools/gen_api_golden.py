@@ -32,6 +32,7 @@ SURFACES = [
     "paddle_tpu.optimizer",
     "paddle_tpu.inference",
     "paddle_tpu.serving",
+    "paddle_tpu.serving.generation",
     "paddle_tpu.observability",
     "paddle_tpu.analysis",
     "paddle_tpu.compile_cache",
