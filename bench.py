@@ -73,24 +73,15 @@ def _probe_backend(timeout=None, retries=None, sleep_s=20):
     return None, f"{retries} attempts failed; last: {last}", probe
 
 
-def _backend_unavailable(e: BaseException) -> bool:
-    """True when an exception is the runtime telling us the accelerator
-    backend cannot be initialized (as opposed to a real model/dtype
-    bug).
-
-    Root cause of the BENCH_r04 "convert_element_type crash": the
-    subprocess probe succeeded, then the tunnel wedged before this
-    process's first eager op — which happened to be a
-    ``convert_element_type`` on the 1.3B path — so backend init raised
-    ``RuntimeError: Unable to initialize backend ... UNAVAILABLE`` from
-    inside a dtype op's dispatch and the bench died rc=1 with a
-    traceback that LOOKED like a dtype regression. Any first op would
-    have raised the same error; the fix is to classify it and emit the
-    structured skip record instead of crashing."""
-    text = f"{type(e).__name__}: {e}"
-    return ("Unable to initialize backend" in text
-            or "UNAVAILABLE" in text
-            or "failed to initialize" in text.lower())
+# The classifier lives in tools/_bench_common.py (shared by every
+# tools/bench_*.py); the BENCH_r04 root cause — probe succeeds, tunnel
+# wedges, the FIRST in-process eager op (a convert_element_type on the
+# 1.3B path) surfaces backend-unavailable looking like a dtype bug —
+# is documented there. The alias keeps this bench's public shape.
+from tools._bench_common import (  # noqa: E402
+    backend_unavailable as _backend_unavailable,
+    skip_record as _skip_record,
+)
 
 
 def _bench_resnet(args, paddle, TrainStep):
@@ -240,16 +231,12 @@ def main():
         # or report a meaningless number, so treat it as unavailable
         platform, diag = None, f"probe fell back to {platform!r}"
     if platform is None:
-        # "skipped": true matches the MULTICHIP_r*.json schema so a
-        # consumer can tell "no measurement" from "measured zero"
-        # without parsing the metric name, and the probe record says
-        # how the retry budget was spent
-        print(json.dumps({
-            "metric": "backend_unavailable", "skipped": True,
-            "value": 0.0, "unit": "diagnostic", "vs_baseline": 0.0,
-            "error": f"TPU backend unreachable, bench skipped: {diag}",
-            "probe": probe,
-        }))
+        # the shared structured skip record (tools/_bench_common.py):
+        # "no measurement" stays distinguishable from "measured zero",
+        # and the probe record says how the retry budget was spent
+        print(json.dumps(_skip_record(
+            f"TPU backend unreachable, bench skipped: {diag}",
+            probe=probe)))
         return 0
     try:
         return _run(args)
@@ -260,14 +247,10 @@ def main():
         # raises backend-unavailable. That is a skip, not a crash.
         if not _backend_unavailable(e):
             raise
-        print(json.dumps({
-            "metric": "backend_unavailable", "skipped": True,
-            "value": 0.0, "unit": "diagnostic", "vs_baseline": 0.0,
-            "error": ("TPU backend wedged after a successful probe, "
-                      f"bench skipped: {type(e).__name__}: "
-                      f"{str(e)[:300]}"),
-            "probe": probe,
-        }))
+        print(json.dumps(_skip_record(
+            ("TPU backend wedged after a successful probe, "
+             f"bench skipped: {type(e).__name__}: {str(e)[:300]}"),
+            probe=probe)))
         return 0
 
 

@@ -227,3 +227,37 @@ define_flag("FLAGS_profiler_span_metrics", False,
             "mirror profiler RecordEvent span durations into the "
             "paddle_profiler_span_ms histogram so chrome traces and "
             "scraped /metrics agree")
+
+# Serving-fleet knobs (paddle_tpu.serving.fleet — router + N replica
+# worker processes with rolling hot weight swap).
+define_flag("FLAGS_serving_ready_requires_warmup", False,
+            "gate readiness (/readyz, InferenceServer.ready, "
+            "GenerationServer.ready) on warmup: the server reports "
+            "not-ready until warmup()/warmup_from_manifest() completes. "
+            "Fleet workers enable this so the router never routes "
+            "traffic to a replica that would compile on the request "
+            "path; liveness (/healthz) is unaffected")
+define_flag("FLAGS_fleet_replicas", 2,
+            "default replica count a ReplicaSupervisor spawns when the "
+            "caller does not pass one")
+define_flag("FLAGS_fleet_retries", 2,
+            "router retry budget per batch: a dispatch shed with "
+            "QueueFullError (HTTP 429) or refused by a not-ready "
+            "replica is retried on another replica this many times "
+            "before the batch fails with QueueFullError")
+define_flag("FLAGS_fleet_health_interval_ms", 200.0,
+            "router readiness-poll cadence: every interval each known "
+            "replica's /readyz is probed and the routable set updated")
+define_flag("FLAGS_fleet_restart_backoff_ms", 200.0,
+            "supervisor respawn backoff after a replica process exits "
+            "unexpectedly (doubles per consecutive crash of the same "
+            "replica, capped at 30x)")
+define_flag("FLAGS_fleet_request_timeout_s", 120.0,
+            "router-side HTTP timeout for one forwarded batch or "
+            "generation stream read; a replica that blows it fails "
+            "only the in-flight requests riding that connection")
+define_flag("FLAGS_fleet_drain_timeout_s", 30.0,
+            "rolling-swap drain bound: max seconds swap_weights waits "
+            "for one draining replica's outstanding requests to reach "
+            "zero before the swap aborts (remaining replicas keep the "
+            "old weights — never a half-broken fleet)")

@@ -11,9 +11,9 @@ counterpart and the ONE place every subsystem reports into:
   estimator shared with ``serving.metrics``;
 - ``exposition``: Prometheus text format 0.0.4 + a JSON mirror;
 - ``httpd``: a stdlib ``http.server`` endpoint (``/metrics``,
-  ``/healthz``, ``/statusz``) that ``InferenceServer`` attaches via
-  ``FLAGS_serving_telemetry_port`` and scripts start with
-  ``start_telemetry_server()``;
+  ``/healthz`` liveness, ``/readyz`` readiness, ``/statusz``) that
+  ``InferenceServer`` attaches via ``FLAGS_serving_telemetry_port``
+  and scripts start with ``start_telemetry_server()``;
 - ``runtime``: JAX compile-event listeners, device-memory gauges, and
   profiler RecordEvent span mirroring;
 - ``training``: a ``Model.fit`` callback + ``optimizer.step`` hook for
@@ -32,8 +32,10 @@ from .exposition import (  # noqa: F401
     PROMETHEUS_CONTENT_TYPE, json_snapshot, json_text, prometheus_text,
 )
 from .httpd import (  # noqa: F401
-    TelemetryServer, add_health_check, get_telemetry_server, healthz,
-    remove_health_check, start_telemetry_server, stop_telemetry_server,
+    TelemetryServer, add_health_check, add_readiness_check,
+    get_telemetry_server, healthz, readyz, remove_health_check,
+    remove_readiness_check, start_telemetry_server,
+    stop_telemetry_server,
 )
 from .registry import (  # noqa: F401
     DEFAULT_MS_BUCKETS, Counter, Gauge, Histogram, MetricRegistry,
@@ -52,7 +54,8 @@ __all__ = [
     "PROMETHEUS_CONTENT_TYPE",
     "TelemetryServer", "start_telemetry_server", "get_telemetry_server",
     "stop_telemetry_server", "add_health_check", "remove_health_check",
-    "healthz",
+    "healthz", "add_readiness_check", "remove_readiness_check",
+    "readyz",
     "install_jax_monitoring", "install_device_memory_collector",
     "mirror_profiler_spans",
     "TrainingTelemetryCallback", "instrument_optimizers",

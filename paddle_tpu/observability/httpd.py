@@ -1,11 +1,17 @@
 """Telemetry HTTP endpoint — stdlib ``http.server``, zero dependencies.
 
-Serves three paths off a daemon thread:
+Serves four paths off a daemon thread:
 
 - ``/metrics``  — Prometheus text format (0.0.4); ``?format=json`` or
   an ``Accept: application/json`` header switches to the JSON mirror;
 - ``/healthz``  — runs the registered health checks, 200 when all pass,
-  503 otherwise, JSON body either way;
+  503 otherwise, JSON body either way (LIVENESS: the process is up and
+  its workers have not died);
+- ``/readyz``   — runs the registered readiness checks, same contract
+  (READINESS: the process may be handed traffic — e.g. a serving
+  replica flips ready only once warmup completed, so a fleet router
+  never routes to a cold replica; distinct from liveness: a warming
+  replica is alive but not ready);
 - ``/statusz``  — process/runtime status page (pid, uptime, backend,
   live serving servers, metric family count).
 
@@ -32,7 +38,8 @@ from .registry import MetricRegistry, default_registry
 __all__ = [
     "TelemetryServer", "start_telemetry_server", "get_telemetry_server",
     "stop_telemetry_server", "add_health_check", "remove_health_check",
-    "healthz",
+    "healthz", "add_readiness_check", "remove_readiness_check",
+    "readyz",
 ]
 
 _start_time = time.time()
@@ -40,6 +47,7 @@ _start_time = time.time()
 # ---------------------------------------------------------------- health
 _health_lock = threading.Lock()
 _health_checks: Dict[str, Callable] = {}
+_readiness_checks: Dict[str, Callable] = {}
 
 
 def add_health_check(name: str, fn: Callable):
@@ -54,9 +62,24 @@ def remove_health_check(name: str):
         _health_checks.pop(name, None)
 
 
-def healthz() -> Tuple[bool, dict]:
+def add_readiness_check(name: str, fn: Callable):
+    """Register a READINESS probe (same ``fn() -> bool | (bool, info)``
+    contract as health checks): all must pass for /readyz to return
+    200. Readiness means "send me traffic" — a serving replica
+    registers one that flips true only after warmup completes —
+    whereas health means "the process is alive". A router routes on
+    readiness; a supervisor restarts on (lack of) liveness."""
     with _health_lock:
-        checks = dict(_health_checks)
+        _readiness_checks[name] = fn
+
+
+def remove_readiness_check(name: str):
+    with _health_lock:
+        _readiness_checks.pop(name, None)
+
+
+def _run_checks(checks: Dict[str, Callable],
+                unhealthy: str) -> Tuple[bool, dict]:
     ok, detail = True, {}
     for name, fn in checks.items():
         try:
@@ -71,7 +94,21 @@ def healthz() -> Tuple[bool, dict]:
         if info is not None:
             detail[name]["info"] = info
         ok = ok and c_ok
-    return ok, {"status": "ok" if ok else "unhealthy", "checks": detail}
+    return ok, {"status": "ok" if ok else unhealthy, "checks": detail}
+
+
+def healthz() -> Tuple[bool, dict]:
+    with _health_lock:
+        checks = dict(_health_checks)
+    return _run_checks(checks, "unhealthy")
+
+
+def readyz() -> Tuple[bool, dict]:
+    """Run the registered readiness checks. With none registered the
+    process is vacuously ready (mirrors /healthz semantics)."""
+    with _health_lock:
+        checks = dict(_readiness_checks)
+    return _run_checks(checks, "not ready")
 
 
 def _statusz() -> dict:
@@ -134,13 +171,19 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200 if ok else 503,
                            json.dumps(detail, indent=1, sort_keys=True),
                            "application/json")
+            elif path == "/readyz":
+                ok, detail = readyz()
+                self._send(200 if ok else 503,
+                           json.dumps(detail, indent=1, sort_keys=True),
+                           "application/json")
             elif path == "/statusz":
                 self._send(200, json.dumps(_statusz(), indent=1,
                                            sort_keys=True, default=str),
                            "application/json")
             elif path == "/":
                 self._send(200, "paddle-tpu telemetry\n"
-                                "/metrics  /healthz  /statusz\n",
+                                "/metrics  /healthz  /readyz  "
+                                "/statusz\n",
                            "text/plain; charset=utf-8")
             else:
                 self._send(404, "not found\n",

@@ -34,6 +34,11 @@ Pieces:
   continuous batching over a paged KV cache with streaming token
   futures (``GenerationServer.submit_generate``); knobs under
   ``FLAGS_decode_*``.
+- ``fleet`` (subpackage): multi-replica serving — a front-end
+  ``FleetRouter`` over N supervised replica worker processes with
+  readiness-based routing, load shedding, warm scale-out from the
+  shared compile cache, and rolling hot weight swap; knobs under
+  ``FLAGS_fleet_*``.
 
 Knobs: ``FLAGS_serving_*`` in framework/flags.py.
 """
@@ -48,10 +53,11 @@ from .metrics import ServingMetrics
 from .request import (DeadlineExceededError, QueueFullError, Request,
                       ServerClosedError)
 from .server import InferenceServer
+from . import fleet  # noqa: F401,E402  (after server: fleet wraps it)
 
 __all__ = [
     "InferenceServer", "DynamicBatcher", "ShapeBucketPolicy",
     "BucketSpec", "ServingMetrics", "Request", "QueueFullError",
     "DeadlineExceededError", "ServerClosedError", "wrap_capi",
-    "next_pow2", "metrics", "generation",
+    "next_pow2", "metrics", "generation", "fleet",
 ]

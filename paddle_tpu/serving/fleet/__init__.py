@@ -1,0 +1,51 @@
+"""paddle_tpu.serving.fleet — multi-replica serving.
+
+One ``InferenceServer`` process tops out at one GIL and one device
+queue; production traffic needs N of them behind one front end. This
+package is that fleet:
+
+- ``ReplicaSupervisor`` (supervisor.py) spawns and keeps alive N
+  replica worker processes (``worker.py`` run as
+  ``python -m paddle_tpu.serving.fleet.worker``), each hosting an
+  ``InferenceServer`` (and optionally a ``GenerationServer``) warmed
+  from the shared ``FLAGS_compile_cache_dir`` + warmup manifest — so
+  scale-out and crash recovery are warm starts, and a crashed
+  replica is respawned automatically.
+- ``FleetRouter`` (router.py) load-balances ``submit`` /
+  ``submit_many`` / ``submit_generate`` across replicas
+  (least-outstanding), routes only to READY replicas (readiness =
+  warmup complete, split from liveness — see ``/readyz``), sheds
+  load by retrying a replica's ``QueueFullError`` elsewhere before
+  failing the batch, streams decode tokens back per request, and
+  performs the rolling hot weight swap (``swap_weights``): drain one
+  replica, ``/reload`` the version-stamped artifact, verify ready,
+  next — zero downtime, zero failed in-flight requests.
+- ``RouterApp`` / ``ReplicaApp`` are the stdlib-HTTP shells (same
+  plumbing family as ``observability.httpd``); ``codec.py`` is the
+  explicit binary wire format (no pickle on sockets).
+- ``FleetMetrics`` (metrics.py) exports the ``paddle_fleet_*``
+  families on the PR 3 registry; the router's
+  ``/metrics?merged=1`` view re-labels every replica's own scrape
+  with ``replica="<id>"``.
+
+Knobs: ``FLAGS_fleet_*`` + ``FLAGS_serving_ready_requires_warmup``
+in framework/flags.py. Bench: ``tools/bench_fleet.py``.
+"""
+from __future__ import annotations
+
+from . import codec  # noqa: F401
+from .metrics import FleetMetrics, merge_prometheus_texts
+from .router import (FleetRouter, NoReadyReplicaError, ReplicaError,
+                     RouterApp)
+from .supervisor import (ProcessReplicaFactory, ReplicaSupervisor,
+                         SubprocessReplica)
+from .worker import (PredictorBackend, ReplicaApp, StubBackend,
+                     ThreadReplicaFactory)
+
+__all__ = [
+    "FleetRouter", "RouterApp", "ReplicaSupervisor",
+    "ProcessReplicaFactory", "SubprocessReplica", "ReplicaApp",
+    "PredictorBackend", "StubBackend", "ThreadReplicaFactory",
+    "FleetMetrics", "merge_prometheus_texts", "NoReadyReplicaError",
+    "ReplicaError", "codec",
+]

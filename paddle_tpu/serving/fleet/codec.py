@@ -1,0 +1,177 @@
+"""Wire codec for the fleet data plane: raw-buffer array framing.
+
+The router forwards request batches to replica workers over plain
+HTTP; the payload is numpy arrays. JSON-of-nested-lists would burn the
+router's single thread-pool CPU on float formatting, and pickle would
+widen the trusted surface from "the compile-cache directory" to "every
+socket peer" — so the wire format is a minimal explicit framing of
+``(dtype, shape, raw C-contiguous bytes)``, decodable with
+``np.frombuffer`` and nothing else. Only shapes/dtypes/bytes cross the
+wire; nothing on the decode path executes content.
+
+Layout (all integers little-endian):
+
+- array:    u8 dtype-str length, dtype.str ascii, u8 ndim,
+            u32 x ndim dims, u64 nbytes, raw buffer
+- batch:    magic ``PDFB``, u32 n_requests, per request
+            (u32 n_feeds, n_feeds arrays)
+- results:  magic ``PDFR``, u32 n_requests, per request u8 status —
+            0 = ok (u32 n_outputs, arrays) or an error code
+            (u32 utf-8 length, message) mapping back to the serving
+            exception types, so ``QueueFullError`` raised in a replica
+            process is ``QueueFullError`` again out of the router.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Sequence, Union
+
+import numpy as np
+
+from ..request import (DeadlineExceededError, QueueFullError,
+                       ServerClosedError)
+
+__all__ = [
+    "encode_batch", "decode_batch", "encode_results", "decode_results",
+    "peek_batch_size", "CodecError", "BATCH_MAGIC", "RESULTS_MAGIC",
+]
+
+BATCH_MAGIC = b"PDFB"
+RESULTS_MAGIC = b"PDFR"
+
+# status codes for per-request results (0 = ok)
+_OK = 0
+_ERR_GENERIC = 1
+_ERR_QUEUE_FULL = 2
+_ERR_DEADLINE = 3
+_ERR_CLOSED = 4
+
+_CODE_OF = {QueueFullError: _ERR_QUEUE_FULL,
+            DeadlineExceededError: _ERR_DEADLINE,
+            ServerClosedError: _ERR_CLOSED}
+_EXC_OF: Dict[int, type] = {_ERR_QUEUE_FULL: QueueFullError,
+                            _ERR_DEADLINE: DeadlineExceededError,
+                            _ERR_CLOSED: ServerClosedError,
+                            _ERR_GENERIC: RuntimeError}
+
+
+class CodecError(ValueError):
+    """Malformed fleet wire payload."""
+
+
+def _put_array(parts: List[bytes], a: np.ndarray):
+    a = np.ascontiguousarray(a)
+    ds = a.dtype.str.encode("ascii")
+    parts.append(struct.pack("<B", len(ds)))
+    parts.append(ds)
+    parts.append(struct.pack("<B", a.ndim))
+    parts.append(struct.pack(f"<{a.ndim}I", *a.shape)
+                 if a.ndim else b"")
+    parts.append(struct.pack("<Q", a.nbytes))
+    parts.append(a.tobytes())
+
+
+class _Reader:
+    __slots__ = ("data", "ofs")
+
+    def __init__(self, data: bytes):
+        self.data = data
+        self.ofs = 0
+
+    def take(self, n: int) -> bytes:
+        if self.ofs + n > len(self.data):
+            raise CodecError("truncated fleet payload")
+        out = self.data[self.ofs:self.ofs + n]
+        self.ofs += n
+        return out
+
+    def u8(self) -> int:
+        return self.take(1)[0]
+
+    def u32(self) -> int:
+        return struct.unpack("<I", self.take(4))[0]
+
+    def u64(self) -> int:
+        return struct.unpack("<Q", self.take(8))[0]
+
+    def array(self) -> np.ndarray:
+        dtype = np.dtype(self.take(self.u8()).decode("ascii"))
+        ndim = self.u8()
+        shape = struct.unpack(f"<{ndim}I", self.take(4 * ndim)) \
+            if ndim else ()
+        nbytes = self.u64()
+        want = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize \
+            if ndim else dtype.itemsize
+        if nbytes != want:
+            raise CodecError(
+                f"array payload {nbytes}B != shape/dtype size {want}B")
+        buf = self.take(nbytes)
+        return np.frombuffer(buf, dtype=dtype).reshape(shape)
+
+
+def encode_batch(feeds_list: Sequence[Sequence[np.ndarray]]) -> bytes:
+    """Encode a ``submit_many`` batch: a list of per-request feed
+    lists (each ordered like the model's feed names)."""
+    parts: List[bytes] = [BATCH_MAGIC,
+                          struct.pack("<I", len(feeds_list))]
+    for feeds in feeds_list:
+        parts.append(struct.pack("<I", len(feeds)))
+        for a in feeds:
+            _put_array(parts, np.asarray(a))
+    return b"".join(parts)
+
+
+def peek_batch_size(data: bytes) -> int:
+    """Request count of an encoded batch without decoding the arrays —
+    the router's pass-through path needs only this for accounting."""
+    if len(data) < 8 or data[:4] != BATCH_MAGIC:
+        raise CodecError("not a fleet batch payload")
+    return struct.unpack("<I", data[4:8])[0]
+
+
+def decode_batch(data: bytes) -> List[List[np.ndarray]]:
+    r = _Reader(data)
+    if r.take(4) != BATCH_MAGIC:
+        raise CodecError("not a fleet batch payload")
+    return [[r.array() for _ in range(r.u32())]
+            for _ in range(r.u32())]
+
+
+def encode_results(
+        results: Sequence[Union[Sequence[np.ndarray], BaseException]]
+) -> bytes:
+    """Encode per-request outcomes: each entry is either the request's
+    output-array list or the exception that failed it (only that
+    request — a replica-side fault barrier maps per request)."""
+    parts: List[bytes] = [RESULTS_MAGIC,
+                          struct.pack("<I", len(results))]
+    for res in results:
+        if isinstance(res, BaseException):
+            code = _CODE_OF.get(type(res), _ERR_GENERIC)
+            msg = f"{type(res).__name__}: {res}".encode(
+                "utf-8", "replace")
+            parts.append(struct.pack("<BI", code, len(msg)))
+            parts.append(msg)
+        else:
+            parts.append(struct.pack("<BI", _OK, len(res)))
+            for a in res:
+                _put_array(parts, np.asarray(a))
+    return b"".join(parts)
+
+
+def decode_results(
+        data: bytes
+) -> List[Union[List[np.ndarray], BaseException]]:
+    r = _Reader(data)
+    if r.take(4) != RESULTS_MAGIC:
+        raise CodecError("not a fleet results payload")
+    out: List[Union[List[np.ndarray], BaseException]] = []
+    for _ in range(r.u32()):
+        status = r.u8()
+        n = r.u32()
+        if status == _OK:
+            out.append([r.array() for _ in range(n)])
+        else:
+            msg = r.take(n).decode("utf-8", "replace")
+            out.append(_EXC_OF.get(status, RuntimeError)(msg))
+    return out

@@ -1,0 +1,816 @@
+"""Front-end router: readiness-routed load balancing over N replicas.
+
+``FleetRouter`` is the fleet's single client-facing surface. It keeps
+a live view of the replica set (seeded explicitly or discovered from a
+``ReplicaSupervisor``), polls every replica's ``/readyz`` on a cadence
+(``FLAGS_fleet_health_interval_ms``), and dispatches:
+
+- ``submit`` / ``submit_many`` — the batch is encoded once (codec.py)
+  and forwarded WHOLE to one replica, preserving the replica-side
+  dynamic batcher's coalescing. Replica choice is least-outstanding
+  (the queue-depth signal a heterogeneous fleet needs; with equal
+  queues it degrades to round-robin). A shed (HTTP 429 =
+  ``QueueFullError``) or an unreachable/not-ready replica triggers a
+  retry on a DIFFERENT replica up to ``FLAGS_fleet_retries`` times,
+  then the batch fails with ``QueueFullError`` — load shedding
+  surfaces to the caller exactly like a single server's backpressure.
+- ``submit_generate`` — a streaming decode request: tokens are
+  re-emitted into the caller's ``StreamingFuture`` as the replica's
+  ndjson stream produces them.
+
+Routing is on READINESS, not liveness: a replica that is alive but
+still replaying its warmup manifest receives nothing; the moment its
+``/readyz`` flips, traffic flows. In-flight requests on a replica
+that dies mid-request fail (only those — no silent cross-replica
+retry of possibly-executed work); requests never yet sent to a
+replica are always safe to re-route.
+
+``swap_weights`` is the rolling hot swap: one replica at a time is
+drained (marked unroutable, outstanding waited to zero), told to
+``/reload`` the version-stamped artifact (warm from the shared
+compile cache), verified ready again, and returned to rotation —
+zero downtime, zero failed in-flight requests, fleet-wide.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Mapping, Optional, Sequence
+
+import numpy as np
+
+from ..generation.engine import StreamingFuture
+from ..request import QueueFullError, ServerClosedError
+from . import codec
+from .metrics import FleetMetrics, merge_prometheus_texts
+
+__all__ = ["FleetRouter", "RouterApp", "NoReadyReplicaError",
+           "ReplicaError"]
+
+
+def _flag(name, default):
+    from ...framework.flags import flag_value
+    try:
+        v = flag_value(name)
+    except KeyError:
+        return default
+    return v
+
+
+# data-plane traffic is always direct to the replica sockets — an
+# http_proxy env var must never detour (or break) intra-fleet calls
+_OPENER = urllib.request.build_opener(
+    urllib.request.ProxyHandler({}))
+
+
+class NoReadyReplicaError(ServerClosedError):
+    """No replica is currently ready to take traffic."""
+
+
+class ReplicaError(RuntimeError):
+    """A replica failed mid-request (connection died after dispatch);
+    only the requests riding that connection fail."""
+
+
+class _Replica:
+    """Router-side view of one replica. Mutable fields are guarded by
+    the router lock."""
+
+    __slots__ = ("replica_id", "url", "outstanding", "ready", "alive",
+                 "draining", "version", "errors")
+
+    def __init__(self, replica_id, url: str):
+        self.replica_id = replica_id
+        self.url = url.rstrip("/")
+        self.outstanding = 0
+        self.ready = False
+        self.alive = False
+        self.draining = False
+        self.version: Optional[str] = None
+        self.errors = 0
+
+
+class FleetRouter:
+    """Load balancer + swap orchestrator over a replica set.
+
+    ``replicas`` seeds a static ``{id: url}`` map; ``supervisor``
+    (optional) is re-polled every health tick so spawned/respawned
+    replicas join and dead ones leave automatically — when attached,
+    the supervisor is authoritative for the replica set.
+    ``start=False`` skips the poll thread (tests drive
+    ``poll_replicas()`` explicitly)."""
+
+    def __init__(self, replicas: Optional[Mapping] = None, *,
+                 supervisor=None, retries: Optional[int] = None,
+                 health_interval_ms: Optional[float] = None,
+                 request_timeout_s: Optional[float] = None,
+                 pool_size: Optional[int] = None,
+                 name: str = "fleet", start: bool = True):
+        self.name = name
+        self.supervisor = supervisor
+        self.retries = int(retries if retries is not None
+                           else _flag("FLAGS_fleet_retries", 2))
+        self.health_interval_ms = float(
+            health_interval_ms if health_interval_ms is not None
+            else _flag("FLAGS_fleet_health_interval_ms", 200.0))
+        self.request_timeout_s = float(
+            request_timeout_s if request_timeout_s is not None
+            else _flag("FLAGS_fleet_request_timeout_s", 120.0))
+        self.metrics = FleetMetrics(name)
+        self._lock = threading.Lock()
+        self._replicas: Dict[object, _Replica] = {}
+        self._rr = 0                    # round-robin tie-breaker
+        self._closed = False
+        self._poll_thread: Optional[threading.Thread] = None
+        self._poll_wake = threading.Event()
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=int(pool_size) if pool_size else 32,
+            thread_name_prefix=f"fleet-router-{name}")
+        for rid, url in (replicas or {}).items():
+            self._replicas[rid] = _Replica(rid, url)
+        if supervisor is not None:
+            self._sync_supervisor()
+        self.poll_replicas()            # synchronous first probe
+        if start:
+            self._start_polling()
+
+    # ------------------------------------------------------ replica set
+    def add_replica(self, replica_id, url: str):
+        with self._lock:
+            if replica_id not in self._replicas:
+                self._replicas[replica_id] = _Replica(replica_id, url)
+
+    def remove_replica(self, replica_id):
+        with self._lock:
+            self._replicas.pop(replica_id, None)
+        self.metrics.drop_replica(str(replica_id))
+
+    def _sync_supervisor(self):
+        eps = self.supervisor.endpoints()
+        with self._lock:
+            for rid, url in eps.items():
+                rep = self._replicas.get(rid)
+                if rep is None:
+                    self._replicas[rid] = _Replica(rid, url)
+                elif rep.url != url.rstrip("/"):
+                    # respawned under the same id: fresh state
+                    self._replicas[rid] = _Replica(rid, url)
+            for rid in list(self._replicas):
+                if rid not in eps:
+                    self._replicas.pop(rid)
+
+    def _http(self, url: str, data: Optional[bytes] = None,
+              timeout: Optional[float] = None,
+              ctype: str = "application/octet-stream"):
+        req = urllib.request.Request(
+            url, data=data, method="POST" if data is not None
+            else "GET")
+        if data is not None:
+            req.add_header("Content-Type", ctype)
+        return _OPENER.open(req,
+                            timeout=timeout or self.request_timeout_s)
+
+    def poll_replicas(self):
+        """One readiness sweep over the known set (plus a supervisor
+        re-sync when attached). The poll thread calls this on its
+        cadence; tests and ``wait_ready`` call it directly."""
+        if self.supervisor is not None:
+            self._sync_supervisor()
+        with self._lock:
+            reps = list(self._replicas.values())
+        for rep in reps:
+            ready, alive, version = False, False, None
+            try:
+                with self._http(rep.url + "/readyz",
+                                timeout=max(
+                                    2.0, self.health_interval_ms
+                                    / 1e3)) as resp:
+                    body = json.loads(resp.read() or b"{}")
+                    ready, alive = bool(body.get("ready")), True
+                    version = body.get("version")
+            except urllib.error.HTTPError as e:
+                alive = True            # it answered: alive, not ready
+                try:
+                    version = json.loads(
+                        e.read() or b"{}").get("version")
+                except ValueError:
+                    pass
+            except Exception:  # noqa: BLE001 - unreachable = not live
+                pass
+            with self._lock:
+                if self._replicas.get(rep.replica_id) is rep:
+                    rep.ready, rep.alive = ready, alive
+                    if version:
+                        rep.version = version
+        self._update_state_gauges()
+
+    def _update_state_gauges(self):
+        with self._lock:
+            reps = list(self._replicas.values())
+            known = len(reps)
+            ready = sum(1 for r in reps
+                        if r.ready and not r.draining)
+            live = sum(1 for r in reps if r.alive)
+            draining = sum(1 for r in reps if r.draining)
+        self.metrics.set_replica_states(known, ready, live, draining)
+
+    def _start_polling(self):
+        if self._poll_thread is None or \
+                not self._poll_thread.is_alive():
+            self._poll_thread = threading.Thread(
+                target=self._poll_loop,
+                name=f"fleet-router-poll-{self.name}", daemon=True)
+            self._poll_thread.start()
+
+    def _poll_loop(self):
+        while not self._closed:
+            self._poll_wake.wait(self.health_interval_ms / 1e3)
+            self._poll_wake.clear()
+            if self._closed:
+                return
+            try:
+                self.poll_replicas()
+            except Exception:  # noqa: BLE001 - the poll loop must
+                pass           # survive any replica weirdness
+
+    def wait_ready(self, n: int = 1, timeout: float = 60.0) -> bool:
+        """Block until >= n replicas are routable (ready, not
+        draining)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            self.poll_replicas()
+            if len(self._routable()) >= n:
+                return True
+            time.sleep(0.05)
+        return len(self._routable()) >= n
+
+    # ------------------------------------------------------ routing
+    def _routable(self) -> List[_Replica]:
+        with self._lock:
+            return [r for r in self._replicas.values()
+                    if r.ready and r.alive and not r.draining]
+
+    def _pick(self, exclude: set) -> Optional[_Replica]:
+        with self._lock:
+            ready = [r for r in self._replicas.values()
+                     if r.ready and r.alive and not r.draining
+                     and r.replica_id not in exclude]
+            if not ready:
+                return None
+            low = min(r.outstanding for r in ready)
+            tied = [r for r in ready if r.outstanding == low]
+            self._rr += 1
+            return tied[self._rr % len(tied)]
+
+    def _acquire(self, rep: _Replica, n: int):
+        with self._lock:
+            rep.outstanding += n
+            out = rep.outstanding
+        self.metrics.set_outstanding(str(rep.replica_id), out)
+
+    def _release(self, rep: _Replica, n: int):
+        with self._lock:
+            rep.outstanding = max(0, rep.outstanding - n)
+            out = rep.outstanding
+        self.metrics.set_outstanding(str(rep.replica_id), out)
+
+    def _forward_batch(self, body: bytes, n_req: int,
+                       timeout_ms: Optional[float]) -> bytes:
+        """Send one encoded batch to the best replica, with the
+        shed/unavailable retry policy. Returns the raw results
+        payload (the HTTP front-end passes it through untouched; the
+        Python API decodes it)."""
+        self.metrics.count("routed", n_req)
+        suffix = f"/submit_many?timeout_ms={timeout_ms}" \
+            if timeout_ms else "/submit_many"
+        attempts = 0
+        tried: set = set()
+        while True:
+            rep = self._pick(tried)
+            if rep is None and tried:
+                # every routable replica tried: widen to re-tries
+                tried = set()
+                rep = self._pick(tried)
+            if rep is None:
+                self.metrics.count("shed", n_req)
+                raise NoReadyReplicaError(
+                    "no ready replica (fleet cold, draining, or "
+                    "down)")
+            self._acquire(rep, n_req)
+            t0 = time.perf_counter()
+            try:
+                with self._http(rep.url + suffix, data=body,
+                                ctype="application/x-paddle-fleet"
+                                ) as resp:
+                    payload = resp.read()
+                self.metrics.observe_latency(
+                    (time.perf_counter() - t0) * 1e3)
+                self.metrics.count("completed", n_req)
+                return payload
+            except urllib.error.HTTPError as e:
+                e.read()
+                if e.code == 429:       # replica shed the whole batch
+                    self.metrics.count_shed(str(rep.replica_id))
+                    reason = "queue_full"
+                elif e.code == 503:     # closed/not ready after all
+                    with self._lock:
+                        rep.ready = False
+                    reason = "unavailable"
+                else:
+                    self.metrics.count("failed", n_req)
+                    raise ReplicaError(
+                        f"replica {rep.replica_id} returned HTTP "
+                        f"{e.code}")
+            except (ConnectionRefusedError, urllib.error.URLError,
+                    ConnectionResetError, TimeoutError) as e:
+                # Refused before the request was read: nothing
+                # executed, safe to re-route. Anything after dispatch
+                # may have executed — fail, don't double-run.
+                refused = isinstance(e, ConnectionRefusedError) or \
+                    isinstance(getattr(e, "reason", None),
+                               ConnectionRefusedError)
+                with self._lock:
+                    rep.alive = refused and rep.alive
+                    rep.ready = False
+                if not refused:
+                    self.metrics.count("failed", n_req)
+                    raise ReplicaError(
+                        f"replica {rep.replica_id} died mid-request: "
+                        f"{type(e).__name__}: {e}") from e
+                reason = "unavailable"
+            finally:
+                self._release(rep, n_req)
+            tried.add(rep.replica_id)
+            attempts += 1
+            if attempts > self.retries:
+                self.metrics.count("shed", n_req)
+                raise QueueFullError(
+                    f"fleet shed the batch after {attempts} "
+                    f"attempts (all replicas at capacity)")
+            self.metrics.count_retry(reason)
+
+    # ------------------------------------------------------ client API
+    def submit(self, feed, timeout_ms: Optional[float] = None):
+        """One request -> Future of its output-array list (the
+        ``InferenceServer.submit`` contract, fleet-wide)."""
+        return self.submit_many([feed], timeout_ms=timeout_ms)[0]
+
+    def submit_many(self, feeds: Sequence,
+                    timeout_ms: Optional[float] = None):
+        """Bulk submit: the batch rides ONE replica dispatch (the
+        replica's dynamic batcher coalesces it further). Returns one
+        Future per request; per-request replica-side failures resolve
+        individual futures, a fleet-wide shed fails them all with
+        QueueFullError."""
+        if self._closed:
+            raise ServerClosedError("router is shut down")
+        norm = []
+        for f in feeds:
+            if isinstance(f, dict):
+                raise TypeError(
+                    "fleet submit takes positional feed lists "
+                    "(ordered like the model's inputs); dict feeds "
+                    "are a single-process InferenceServer feature")
+            norm.append([np.asarray(a) for a in f]
+                        if isinstance(f, (list, tuple))
+                        else [np.asarray(f)])
+        body = codec.encode_batch(norm)
+        futs = [concurrent.futures.Future() for _ in norm]
+
+        def _run():
+            try:
+                payload = self._forward_batch(body, len(norm),
+                                              timeout_ms)
+                results = codec.decode_results(payload)
+                if len(results) != len(futs):
+                    raise ReplicaError(
+                        f"replica answered {len(results)} results "
+                        f"for {len(futs)} requests")
+            except BaseException as e:  # noqa: BLE001 - fail them all
+                for f in futs:
+                    if f.set_running_or_notify_cancel():
+                        f.set_exception(e)
+                return
+            for f, res in zip(futs, results):
+                if not f.set_running_or_notify_cancel():
+                    continue
+                if isinstance(res, BaseException):
+                    f.set_exception(res)
+                else:
+                    f.set_result(res)
+
+        self._pool.submit(_run)
+        return futs
+
+    def submit_generate(self, prompt, max_new_tokens: int = 32,
+                        temperature: float = 0.0,
+                        timeout_ms: Optional[float] = None,
+                        seed: Optional[int] = None) -> StreamingFuture:
+        """Fleet-wide ``GenerationServer.submit_generate``: tokens
+        stream back through the returned future as the chosen
+        replica's decode loop emits them."""
+        if self._closed:
+            raise ServerClosedError("router is shut down")
+        fut = StreamingFuture()
+        body = json.dumps({
+            "prompt": [int(t) for t in np.asarray(prompt).ravel()],
+            "max_new_tokens": int(max_new_tokens),
+            "temperature": float(temperature),
+            "timeout_ms": timeout_ms, "seed": seed}).encode()
+        self.metrics.count("routed")
+        self._pool.submit(self._run_generate, body, fut)
+        return fut
+
+    def _run_generate(self, body: bytes, fut: StreamingFuture):
+        tried: set = set()
+        for attempt in range(self.retries + 1):
+            rep = self._pick(tried)
+            if rep is None:
+                tried = set()
+                rep = self._pick(tried)
+            if rep is None:
+                self.metrics.count("shed")
+                fut._fail(NoReadyReplicaError("no ready replica"),
+                          reason="shed")
+                return
+            self._acquire(rep, 1)
+            emitted = False
+            try:
+                with self._http(rep.url + "/generate", data=body,
+                                ctype="application/json") as resp:
+                    for line in resp:
+                        if fut._cancel_requested:
+                            fut._finish("cancelled")
+                            return
+                        ev = json.loads(line)
+                        if ev.get("done"):
+                            reason = ev.get("finish_reason", "eos")
+                            if ev.get("error"):
+                                fut._fail(
+                                    ReplicaError(ev["error"]),
+                                    reason="error")
+                            else:
+                                fut._finish(reason)
+                            self.metrics.count("completed")
+                            return
+                        emitted = True
+                        fut._emit(int(ev["t"]))
+                # stream closed without a terminal event: the replica
+                # died mid-stream
+                raise ReplicaError(
+                    f"replica {rep.replica_id} closed the stream "
+                    f"mid-generation")
+            except urllib.error.HTTPError as e:
+                e.read()
+                if e.code in (429, 503) and not emitted:
+                    self.metrics.count_retry(
+                        "queue_full" if e.code == 429
+                        else "unavailable")
+                    if e.code == 429:
+                        self.metrics.count_shed(str(rep.replica_id))
+                    tried.add(rep.replica_id)
+                    continue
+                self.metrics.count("failed")
+                fut._fail(QueueFullError(f"HTTP {e.code}")
+                          if e.code == 429
+                          else ReplicaError(f"HTTP {e.code}"),
+                          reason="error")
+                return
+            except BaseException as e:  # noqa: BLE001 - tokens may
+                # already be consumed: never silently re-run the
+                # stream on another replica
+                if not emitted and isinstance(
+                        e, (ConnectionRefusedError,
+                            urllib.error.URLError)):
+                    with self._lock:
+                        rep.ready = False
+                    self.metrics.count_retry("unavailable")
+                    tried.add(rep.replica_id)
+                    continue
+                self.metrics.count("failed")
+                fut._fail(ReplicaError(
+                    f"replica {rep.replica_id} stream failed: "
+                    f"{type(e).__name__}: {e}"), reason="error")
+                return
+            finally:
+                self._release(rep, 1)
+        self.metrics.count("shed")
+        fut._fail(QueueFullError(
+            f"fleet shed the stream after {self.retries + 1} "
+            f"attempts"), reason="shed")
+
+    # ------------------------------------------------------ hot swap
+    def swap_weights(self, model_prefix: str, *,
+                     drain_timeout_s: Optional[float] = None,
+                     ready_timeout_s: float = 300.0) -> dict:
+        """Rolling hot weight swap: drain -> /reload -> ready, one
+        replica at a time. Raises on the first failed replica (the
+        already-swapped ones keep the new weights, the rest keep the
+        old — the fleet stays serviceable either way); the drained
+        replica is always returned to rotation."""
+        drain_timeout_s = float(
+            drain_timeout_s if drain_timeout_s is not None
+            else _flag("FLAGS_fleet_drain_timeout_s", 30.0))
+        report = {"model_prefix": str(model_prefix), "replicas": []}
+        with self._lock:
+            order = sorted(self._replicas,
+                           key=lambda rid: str(rid))
+        for rid in order:
+            with self._lock:
+                rep = self._replicas.get(rid)
+                if rep is None or not rep.alive:
+                    continue
+                rep.draining = True
+            self._update_state_gauges()
+            t0 = time.perf_counter()
+            try:
+                self._drain_one(rep, drain_timeout_s)
+                t_drained = time.perf_counter()
+                with self._http(
+                        rep.url + "/reload",
+                        data=json.dumps(
+                            {"model_prefix": str(model_prefix)}
+                        ).encode(),
+                        ctype="application/json",
+                        timeout=ready_timeout_s) as resp:
+                    version = json.loads(resp.read()).get("version")
+                self._await_ready(rep, ready_timeout_s)
+                self.metrics.count_swap("replica_reloaded")
+                report["replicas"].append({
+                    "replica": str(rid), "version": version,
+                    "drain_ms": round((t_drained - t0) * 1e3, 1),
+                    "reload_ms": round(
+                        (time.perf_counter() - t_drained) * 1e3, 1)})
+            except BaseException:
+                self.metrics.count_swap("failed")
+                raise
+            finally:
+                with self._lock:
+                    rep.draining = False
+                self._update_state_gauges()
+        self.metrics.count_swap("completed")
+        return report
+
+    def _drain_one(self, rep: _Replica, timeout_s: float):
+        deadline = time.monotonic() + timeout_s
+        while True:
+            with self._lock:
+                if rep.outstanding == 0:
+                    return
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"replica {rep.replica_id} still has "
+                    f"{rep.outstanding} outstanding after "
+                    f"{timeout_s}s drain")
+            time.sleep(0.002)
+
+    def _await_ready(self, rep: _Replica, timeout_s: float):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                with self._http(rep.url + "/readyz",
+                                timeout=5.0) as resp:
+                    if json.loads(resp.read()).get("ready"):
+                        with self._lock:
+                            rep.ready, rep.alive = True, True
+                        return
+            except Exception:  # noqa: BLE001 - keep polling until the
+                pass           # deadline decides
+            time.sleep(0.02)
+        raise TimeoutError(
+            f"replica {rep.replica_id} not ready again within "
+            f"{timeout_s}s of reload")
+
+    # ------------------------------------------------------ inspection
+    def replica_states(self) -> List[dict]:
+        with self._lock:
+            return [{"replica": str(r.replica_id), "url": r.url,
+                     "ready": r.ready, "alive": r.alive,
+                     "draining": r.draining,
+                     "outstanding": r.outstanding,
+                     "version": r.version}
+                    for r in self._replicas.values()]
+
+    def metrics_snapshot(self) -> dict:
+        return self.metrics.snapshot()
+
+    def merged_metrics(self) -> str:
+        """Fleet-wide Prometheus scrape: this process's registry plus
+        every live replica's /metrics re-labeled with its id."""
+        from ...observability import default_registry, prometheus_text
+        texts = {}
+        with self._lock:
+            reps = [(str(r.replica_id), r.url)
+                    for r in self._replicas.values() if r.alive]
+        for rid, url in reps:
+            try:
+                with self._http(url + "/metrics",
+                                timeout=5.0) as resp:
+                    texts[rid] = resp.read().decode()
+            except Exception:  # noqa: BLE001 - a scrape-dead replica
+                pass           # just drops out of the merged view
+        return merge_prometheus_texts(
+            texts, own=prometheus_text(default_registry()))
+
+    # ------------------------------------------------------ lifecycle
+    def shutdown(self):
+        self._closed = True
+        self._poll_wake.set()
+        t = self._poll_thread
+        if t is not None and t.is_alive():
+            t.join(timeout=5)
+        self._pool.shutdown(wait=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+
+# ---------------------------------------------------------------- http
+class _RouterHandler(BaseHTTPRequestHandler):
+    """HTTP front-end over a FleetRouter: the external data plane.
+
+    ``POST /submit_many`` and ``POST /generate`` speak the replica
+    wire protocol (codec.py / ndjson) and PASS THE BODY THROUGH — the
+    router never decodes the arrays, so its per-request CPU cost is a
+    replica pick plus a socket copy. Serving-layer errors map to the
+    same status codes replicas use (429 shed, 503 no ready replica),
+    so a client cannot tell one server from a fleet."""
+
+    server_version = "paddle-tpu-fleet-router/1.0"
+
+    def _send(self, code: int, body: bytes,
+              ctype: str = "application/json"):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    @property
+    def _router(self) -> FleetRouter:
+        return self.server.router  # type: ignore[attr-defined]
+
+    def log_message(self, *args):
+        pass
+
+    def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler ABI
+        path, _, query = self.path.partition("?")
+        try:
+            if path == "/metrics":
+                from ...observability import (default_registry,
+                                              prometheus_text)
+                from ...observability.exposition import \
+                    PROMETHEUS_CONTENT_TYPE
+                text = self._router.merged_metrics() \
+                    if "merged=1" in query \
+                    else prometheus_text(default_registry())
+                self._send(200, text.encode(),
+                           PROMETHEUS_CONTENT_TYPE)
+            elif path == "/healthz":
+                live = any(s["alive"]
+                           for s in self._router.replica_states())
+                self._send(200 if live else 503, json.dumps(
+                    {"ok": live,
+                     "replicas": self._router.replica_states()},
+                    sort_keys=True).encode())
+            elif path == "/readyz":
+                n = len(self._router._routable())
+                self._send(200 if n else 503, json.dumps(
+                    {"ready": bool(n),
+                     "ready_replicas": n}).encode())
+            elif path == "/statusz":
+                self._send(200, json.dumps(
+                    {"router": self._router.name,
+                     "replicas": self._router.replica_states(),
+                     "metrics": self._router.metrics_snapshot()},
+                    sort_keys=True, default=str).encode())
+            else:
+                self._send(404, b"not found\n", "text/plain")
+        except Exception as e:  # noqa: BLE001 - handler fault barrier
+            try:
+                self._send(500, f"{e!r}\n".encode(), "text/plain")
+            except Exception:  # noqa: BLE001
+                pass
+
+    def do_POST(self):  # noqa: N802 - BaseHTTPRequestHandler ABI
+        path, _, query = self.path.partition("?")
+        try:
+            n = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(n) if n else b""
+            if path == "/submit_many":
+                timeout_ms = None
+                for part in query.split("&"):
+                    if part.startswith("timeout_ms="):
+                        timeout_ms = \
+                            float(part.split("=", 1)[1]) or None
+                n_req = codec.peek_batch_size(body)
+                payload = self._router._forward_batch(
+                    body, n_req, timeout_ms)
+                self._send(200, payload, "application/x-paddle-fleet")
+            elif path == "/generate":
+                self._generate(body)
+            else:
+                self._send(404, b"not found\n", "text/plain")
+        except NoReadyReplicaError as e:
+            self._send(503, f"{e}\n".encode(), "text/plain")
+        except QueueFullError as e:
+            self._send(429, f"{e}\n".encode(), "text/plain")
+        except codec.CodecError as e:
+            self._send(400, f"{e}\n".encode(), "text/plain")
+        except Exception as e:  # noqa: BLE001 - handler fault barrier
+            try:
+                self._send(500, f"{type(e).__name__}: {e}\n".encode(),
+                           "text/plain")
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _generate(self, body: bytes):
+        req = json.loads(body or b"{}")
+        fut = self._router.submit_generate(
+            req["prompt"],
+            max_new_tokens=int(req.get("max_new_tokens", 32)),
+            temperature=float(req.get("temperature", 0.0)),
+            timeout_ms=req.get("timeout_ms"),
+            seed=req.get("seed"))
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.end_headers()
+        try:
+            for tok in fut:
+                self.wfile.write(
+                    json.dumps({"t": int(tok)}).encode() + b"\n")
+                self.wfile.flush()
+            self.wfile.write(json.dumps(
+                {"done": True,
+                 "finish_reason": fut.finish_reason}).encode() + b"\n")
+        except BrokenPipeError:
+            fut.cancel()
+        except BaseException as e:  # noqa: BLE001 - stream the error
+            try:
+                self.wfile.write(json.dumps(
+                    {"done": True, "finish_reason": "error",
+                     "error": f"{type(e).__name__}: {e}"}).encode()
+                    + b"\n")
+            except OSError:
+                pass
+
+
+class RouterApp:
+    """The router's HTTP front-end on a daemon thread (same shape as
+    worker.ReplicaApp). ``port=0`` binds ephemeral."""
+
+    def __init__(self, router: FleetRouter, host: str = "0.0.0.0",
+                 port: int = 0):
+        self.router = router
+        self.host = host
+        self._requested_port = int(port)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> Optional[int]:
+        return self._httpd.server_address[1] if self._httpd else None
+
+    def url(self, path: str = "") -> str:
+        host = "127.0.0.1" if self.host in ("0.0.0.0", "::") \
+            else self.host
+        return f"http://{host}:{self.port}{path}"
+
+    def start(self) -> "RouterApp":
+        if self._httpd is not None:
+            return self
+        httpd = ThreadingHTTPServer((self.host, self._requested_port),
+                                    _RouterHandler)
+        httpd.daemon_threads = True
+        httpd.router = self.router      # type: ignore[attr-defined]
+        self._httpd = httpd
+        self._thread = threading.Thread(
+            target=httpd.serve_forever,
+            name=f"fleet-router-http-{self.router.name}", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        t, self._thread = self._thread, None
+        if t is not None and t.is_alive():
+            t.join(timeout=5)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

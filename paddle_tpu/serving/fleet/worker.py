@@ -1,0 +1,725 @@
+"""Replica worker: one process hosting a serving backend behind HTTP.
+
+A fleet replica is this module run as a process
+(``python -m paddle_tpu.serving.fleet.worker``): it builds a backend —
+a real ``InferenceServer`` over a loaded ``Predictor`` (and optionally
+a ``GenerationServer``), or the accelerator-emulating ``StubBackend``
+— binds ``ReplicaApp`` (the stdlib HTTP service over that backend),
+announces its port to the supervisor through an atomically-written
+announce file, runs warmup (flipping readiness), and serves until
+``POST /shutdown`` or SIGTERM.
+
+Data plane (binary codec, see codec.py):
+
+- ``POST /submit_many?timeout_ms=`` — one coalesced request batch in,
+  per-request results out; whole-batch ``QueueFullError`` is HTTP 429
+  (the router's shed/retry signal), per-request failures ride the
+  results framing so one bad request never fails its batch peers.
+- ``POST /generate`` — JSON request in, newline-delimited JSON token
+  events streamed out (close-delimited body), one decode stream per
+  connection.
+
+Control plane (JSON):
+
+- ``GET /healthz`` (liveness) / ``GET /readyz`` (readiness = warmup
+  complete) / ``GET /metrics`` (this process's registry, Prometheus
+  text) / ``GET /statusz``
+- ``POST /reload`` — hot weight swap: load the version-stamped
+  artifact named in the body, warm the replacement server from the
+  shared compile cache + manifest, atomically swap it in, drain the
+  old one. The router drains this replica first, so in-flight
+  requests never see the swap.
+- ``POST /shutdown`` — graceful exit.
+
+``ThreadReplicaFactory`` runs the same app+backend on a thread in the
+current process — the tier-1 test double and the single-process
+deployment mode; the wire protocol and routing logic are identical.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..request import QueueFullError, ServerClosedError
+from . import codec
+
+__all__ = ["ReplicaApp", "PredictorBackend", "StubBackend",
+           "ThreadReplicaFactory", "write_announce_file",
+           "read_announce_file"]
+
+
+def _flag(name, default):
+    from ...framework.flags import flag_value
+    try:
+        v = flag_value(name)
+    except KeyError:
+        return default
+    return v
+
+
+class _ConnectionDrop(Exception):
+    """Raised by a backend to simulate a replica crash from the
+    peer's perspective: the handler closes the connection without a
+    response (the router sees a dead socket, exactly like a killed
+    process) and the backend reports unhealthy afterwards."""
+
+
+def write_announce_file(path: str, port: int):
+    """Atomically publish this worker's address for the supervisor
+    (partial reads are impossible: tmp + rename)."""
+    data = json.dumps({"pid": os.getpid(), "port": int(port),
+                       "url": f"http://127.0.0.1:{int(port)}"})
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(data)
+    os.replace(tmp, path)
+
+
+def read_announce_file(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+# ---------------------------------------------------------------- backends
+class PredictorBackend:
+    """The real replica backend: a ``Predictor`` loaded from a
+    version-stamped artifact prefix, served by an ``InferenceServer``
+    with the readiness gate on, optionally alongside a
+    ``GenerationServer`` for decode traffic.
+
+    ``reload(prefix)`` is the hot-swap path: build + warm a complete
+    replacement server (compile-cache warm, so seconds not minutes),
+    swap it in atomically, then drain the old one — callers queued on
+    the old server finish on the old weights, everything after the
+    swap runs the new ones.
+    """
+
+    def __init__(self, model_prefix: str, *,
+                 max_batch_size: Optional[int] = None,
+                 seq_buckets: Optional[Sequence[int]] = None,
+                 seq_axis: int = 1,
+                 warmup_mode: str = "auto",
+                 name: str = "replica",
+                 generation_model=None):
+        self._name = name
+        self._max_batch_size = max_batch_size
+        self._seq_buckets = list(seq_buckets) if seq_buckets else None
+        self._seq_axis = int(seq_axis)
+        self._warmup_mode = warmup_mode
+        self._lock = threading.Lock()
+        self._reloading = False
+        self._gen = None
+        self._server, self._version = self._build(model_prefix)
+        if generation_model is not None:
+            from ..generation import GenerationServer
+            self._gen = GenerationServer(generation_model,
+                                         name=f"{name}-gen")
+
+    def _build(self, model_prefix: str):
+        from ... import inference
+        from ..server import InferenceServer
+        pred = inference.create_predictor(
+            inference.Config(str(model_prefix)))
+        srv = InferenceServer(
+            pred, max_batch_size=self._max_batch_size,
+            seq_buckets=self._seq_buckets, seq_axis=self._seq_axis,
+            name=self._name, ready_requires_warmup=True, start=True)
+        fp = pred.artifact_fingerprint()
+        version = os.path.basename(str(model_prefix)) + \
+            (f"@{fp[:8]}" if fp else "")
+        return srv, version
+
+    # ---- service surface ----
+    def submit_many(self, feeds_list, timeout_ms=None):
+        return self._server.submit_many(feeds_list,
+                                        timeout_ms=timeout_ms)
+
+    def generate(self, prompt, max_new_tokens, temperature, timeout_ms,
+                 seed):
+        if self._gen is None:
+            raise RuntimeError("this replica hosts no generation "
+                               "engine (start it with a generation "
+                               "model)")
+        return self._gen.submit_generate(
+            prompt, max_new_tokens=max_new_tokens,
+            temperature=temperature, timeout_ms=timeout_ms, seed=seed)
+
+    def warmup(self) -> int:
+        """Warm per ``warmup_mode``: "manifest" replays the persisted
+        traffic signatures (the warm scale-out path), "lattice" the
+        full bucket lattice, "auto" manifest-when-present else
+        lattice, "none" flips ready without compiling."""
+        return self._warm_server(self._server)
+
+    def _warm_server(self, srv) -> int:
+        mode = self._warmup_mode
+        n = 0
+        if mode == "none":
+            srv.mark_ready()
+        elif mode == "manifest":
+            n = srv.warmup_from_manifest()
+            srv.mark_ready()   # empty/absent manifest: nothing to warm
+        elif mode == "lattice":
+            n = srv.warmup()
+        else:   # auto
+            manifest = srv.warmup_manifest
+            if manifest is not None and len(manifest):
+                n = srv.warmup_from_manifest()
+            else:
+                n = srv.warmup()
+        if self._gen is not None and not self._gen.ready:
+            n += self._gen.warmup()
+        return n
+
+    def ready(self) -> bool:
+        with self._lock:
+            if self._reloading:
+                return False
+            srv, gen = self._server, self._gen
+        return srv.ready and (gen is None or gen.ready)
+
+    def health(self):
+        ok, info = self._server._health()
+        return ok, {"server": info}
+
+    def reload(self, model_prefix: str) -> str:
+        """Swap to the artifact at ``model_prefix``; returns the new
+        version stamp. Failure leaves the current server untouched."""
+        with self._lock:
+            self._reloading = True
+        try:
+            new_srv, version = self._build(model_prefix)
+            try:
+                self._warm_server(new_srv)
+            except BaseException:
+                new_srv.shutdown(drain=False)
+                raise
+            with self._lock:
+                old, self._server = self._server, new_srv
+                self._version = version
+            old.shutdown(drain=True)
+            return version
+        finally:
+            with self._lock:
+                self._reloading = False
+
+    def info(self) -> dict:
+        with self._lock:
+            version = self._version
+        return {"backend": "predictor", "version": version,
+                "name": self._name,
+                "generation": self._gen is not None}
+
+    def shutdown(self, drain: bool = True):
+        self._server.shutdown(drain=drain)
+        if self._gen is not None:
+            self._gen.shutdown(drain=drain)
+
+
+class StubBackend:
+    """Accelerator-emulating backend for fleet benches and tests.
+
+    A real replica on an accelerator spends its request latency
+    waiting on the device, not burning host CPU — so on a single-core
+    CI box the fleet's process-level parallelism is invisible with
+    real CPU-bound models (N processes share one core) but entirely
+    real in production. The stub reproduces the production shape:
+    one "device" per replica (a lock), ``device_ms`` of held-lock
+    sleep per dispatched batch of up to ``max_batch`` rows, a bounded
+    outstanding budget that sheds with ``QueueFullError`` (HTTP 429
+    through the app), deterministic outputs (``x * scale`` with
+    ``scale`` derived from the weight version, so a hot swap is
+    observable in the payloads), and optional crash triggers for
+    failure-path tests. Everything around it — codec, HTTP, router,
+    supervisor — is the production code path.
+    """
+
+    def __init__(self, *, device_ms: float = 5.0, max_batch: int = 8,
+                 queue_capacity: int = 64, warmup_s: float = 0.0,
+                 version: str = "v0",
+                 crash_value: Optional[float] = None,
+                 crash_mode: str = "drop",
+                 token_ms: Optional[float] = None):
+        self.device_ms = float(device_ms)
+        self.max_batch = int(max_batch)
+        self.queue_capacity = int(queue_capacity)
+        self.warmup_s = float(warmup_s)
+        self.crash_value = crash_value
+        self.crash_mode = crash_mode
+        self.token_ms = (float(token_ms) if token_ms is not None
+                         else self.device_ms / 4.0)
+        self._lock = threading.Lock()
+        self._device = threading.Lock()   # the one emulated device
+        self._outstanding = 0
+        self._warmed = False
+        self._alive = True
+        self._version = str(version)
+        self._scale = self._scale_of(version)
+        self.dispatches = 0
+
+    @staticmethod
+    def _scale_of(version: str) -> float:
+        # deterministic per-version output scale: v0 -> 1.0, v1 -> 2.0
+        import zlib
+        return 1.0 + (zlib.crc32(str(version).encode()) % 7)
+
+    def _maybe_crash(self, feeds_list):
+        if self.crash_value is None:
+            return
+        for feeds in feeds_list:
+            for a in feeds:
+                flat = np.asarray(a).ravel()
+                if flat.size and float(flat[0]) == self.crash_value:
+                    with self._lock:
+                        self._alive = False
+                        self._warmed = False
+                    if self.crash_mode == "exit":
+                        os._exit(17)
+                    raise _ConnectionDrop("stub crash trigger")
+
+    def submit_many(self, feeds_list, timeout_ms=None):
+        import concurrent.futures
+        n = len(feeds_list)
+        with self._lock:
+            if not self._alive:
+                raise ServerClosedError("stub backend crashed")
+            if self._outstanding + n > self.queue_capacity:
+                raise QueueFullError(
+                    f"stub at capacity ({self.queue_capacity})")
+            self._outstanding += n
+            scale = self._scale
+        try:
+            self._maybe_crash(feeds_list)
+            batches = -(-n // self.max_batch)
+            with self._device:     # one device: dispatches serialize
+                time.sleep(self.device_ms * batches / 1e3)
+                with self._lock:
+                    self.dispatches += batches
+            futs = []
+            for feeds in feeds_list:
+                f = concurrent.futures.Future()
+                f.set_result([np.asarray(a, np.float32) * scale
+                              for a in feeds])
+                futs.append(f)
+            return futs
+        finally:
+            with self._lock:
+                self._outstanding -= n
+
+    def generate(self, prompt, max_new_tokens, temperature, timeout_ms,
+                 seed):
+        from ..generation.engine import StreamingFuture
+        fut = StreamingFuture()
+        prompt = np.asarray(prompt).ravel()
+        base = int(prompt[-1]) if prompt.size else 0
+
+        def _stream():
+            for i in range(int(max_new_tokens)):
+                time.sleep(self.token_ms / 1e3)
+                fut._emit((base + 1 + i) % 50000)
+                if fut._cancel_requested:
+                    fut._finish("cancelled")
+                    return
+            fut._finish("length")
+
+        threading.Thread(target=_stream, daemon=True).start()
+        return fut
+
+    def warmup(self) -> int:
+        if self.warmup_s:
+            time.sleep(self.warmup_s)
+        with self._lock:
+            self._warmed = True
+        return 0
+
+    def ready(self) -> bool:
+        with self._lock:
+            return self._warmed and self._alive
+
+    def health(self):
+        with self._lock:
+            if not self._alive:
+                return False, "crashed"
+            return True, {"outstanding": self._outstanding}
+
+    def reload(self, model_prefix: str) -> str:
+        version = os.path.basename(str(model_prefix))
+        with self._device:      # a swap waits out the in-flight batch
+            with self._lock:
+                self._version = version
+                self._scale = self._scale_of(version)
+        return version
+
+    def info(self) -> dict:
+        with self._lock:
+            return {"backend": "stub", "version": self._version,
+                    "device_ms": self.device_ms,
+                    "outstanding": self._outstanding,
+                    "dispatches": self.dispatches}
+
+    def shutdown(self, drain: bool = True):
+        with self._lock:
+            self._alive = False
+
+    @property
+    def version(self) -> str:
+        with self._lock:
+            return self._version
+
+
+# ---------------------------------------------------------------- app
+class _ReplicaHandler(BaseHTTPRequestHandler):
+    server_version = "paddle-tpu-replica/1.0"
+
+    # ---- plumbing ----
+    def _send(self, code: int, body: bytes,
+              ctype: str = "application/json"):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, obj):
+        self._send(code, json.dumps(obj, sort_keys=True).encode())
+
+    def _body(self) -> bytes:
+        n = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(n) if n else b""
+
+    @property
+    def _backend(self):
+        return self.server.backend  # type: ignore[attr-defined]
+
+    def log_message(self, *args):
+        pass
+
+    # ---- control plane ----
+    def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler ABI
+        path = self.path.partition("?")[0]
+        try:
+            if path == "/healthz":
+                ok, info = self._backend.health()
+                self._send_json(200 if ok else 503,
+                                {"ok": ok, "info": info})
+            elif path == "/readyz":
+                ready = self._backend.ready()
+                self._send_json(
+                    200 if ready else 503,
+                    {"ready": ready,
+                     "version": self._backend.info().get("version")})
+            elif path == "/metrics":
+                from ...observability import (default_registry,
+                                              prometheus_text)
+                from ...observability.exposition import \
+                    PROMETHEUS_CONTENT_TYPE
+                self._send(200,
+                           prometheus_text(default_registry()).encode(),
+                           PROMETHEUS_CONTENT_TYPE)
+            elif path == "/statusz":
+                self._send_json(200, self._backend.info())
+            else:
+                self._send(404, b"not found\n", "text/plain")
+        except _ConnectionDrop:
+            self.close_connection = True
+        except Exception as e:  # noqa: BLE001 - a probe bug must not
+            try:                # kill the handler thread
+                self._send(500, f"{e!r}\n".encode(), "text/plain")
+            except Exception:  # noqa: BLE001
+                pass
+
+    def do_POST(self):  # noqa: N802 - BaseHTTPRequestHandler ABI
+        path, _, query = self.path.partition("?")
+        try:
+            if path == "/submit_many":
+                self._submit_many(query)
+            elif path == "/generate":
+                self._generate()
+            elif path == "/reload":
+                req = json.loads(self._body() or b"{}")
+                version = self._backend.reload(req["model_prefix"])
+                self._send_json(200, {"ok": True, "version": version})
+            elif path == "/shutdown":
+                self._send_json(200, {"ok": True})
+                self.server.app._request_shutdown()  # type: ignore
+            else:
+                self._send(404, b"not found\n", "text/plain")
+        except _ConnectionDrop:
+            # crash simulation: vanish mid-request, no response bytes
+            self.close_connection = True
+            try:
+                self.connection.close()
+            except OSError:
+                pass
+        except QueueFullError as e:
+            self._send(429, f"{e}\n".encode(), "text/plain")
+        except ServerClosedError as e:
+            self._send(503, f"{e}\n".encode(), "text/plain")
+        except Exception as e:  # noqa: BLE001 - fault barrier for the
+            try:                # handler thread
+                self._send(500, f"{type(e).__name__}: {e}\n".encode(),
+                           "text/plain")
+            except Exception:  # noqa: BLE001
+                pass
+
+    # ---- data plane ----
+    def _submit_many(self, query: str):
+        timeout_ms = None
+        for part in query.split("&"):
+            if part.startswith("timeout_ms="):
+                timeout_ms = float(part.split("=", 1)[1]) or None
+        feeds_list = codec.decode_batch(self._body())
+        futs = self._backend.submit_many(feeds_list,
+                                         timeout_ms=timeout_ms)
+        results = []
+        for f in futs:
+            try:
+                results.append(f.result(timeout=self.server.app
+                                        .request_timeout_s))
+            except BaseException as e:  # noqa: BLE001 - per-request
+                results.append(e)       # failures ride the framing
+        self._send(200, codec.encode_results(results),
+                   "application/x-paddle-fleet")
+
+    def _generate(self):
+        req = json.loads(self._body() or b"{}")
+        fut = self._backend.generate(
+            np.asarray(req["prompt"], np.int64),
+            int(req.get("max_new_tokens", 32)),
+            float(req.get("temperature", 0.0)),
+            req.get("timeout_ms"), req.get("seed"))
+        # close-delimited stream: one JSON line per token event, then
+        # the terminal line with the finish reason
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.end_headers()
+        try:
+            for tok in fut:
+                self.wfile.write(
+                    json.dumps({"t": int(tok)}).encode() + b"\n")
+                self.wfile.flush()
+            self.wfile.write(json.dumps(
+                {"done": True,
+                 "finish_reason": fut.finish_reason}).encode() + b"\n")
+        except BrokenPipeError:
+            fut.cancel()        # client went away: stop generating
+        except BaseException as e:  # noqa: BLE001 - stream the error
+            try:
+                self.wfile.write(json.dumps(
+                    {"done": True, "finish_reason": "error",
+                     "error": f"{type(e).__name__}: {e}"}).encode()
+                    + b"\n")
+            except OSError:
+                pass
+
+
+class ReplicaApp:
+    """One ThreadingHTTPServer bound to a backend, on a daemon
+    thread. ``port=0`` binds ephemeral; read ``.port`` / ``.url``
+    back."""
+
+    def __init__(self, backend, host: str = "127.0.0.1",
+                 port: int = 0,
+                 request_timeout_s: Optional[float] = None):
+        self.backend = backend
+        self.host = host
+        self.request_timeout_s = float(
+            request_timeout_s if request_timeout_s is not None
+            else _flag("FLAGS_fleet_request_timeout_s", 120.0))
+        self._requested_port = int(port)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._shutdown_requested = threading.Event()
+
+    @property
+    def port(self) -> Optional[int]:
+        return self._httpd.server_address[1] if self._httpd else None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "ReplicaApp":
+        if self._httpd is not None:
+            return self
+        httpd = ThreadingHTTPServer((self.host, self._requested_port),
+                                    _ReplicaHandler)
+        httpd.daemon_threads = True
+        httpd.backend = self.backend        # type: ignore[attr-defined]
+        httpd.app = self                    # type: ignore[attr-defined]
+        self._httpd = httpd
+        self._thread = threading.Thread(
+            target=httpd.serve_forever, name="fleet-replica-http",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def _request_shutdown(self):
+        self._shutdown_requested.set()
+
+    def wait_shutdown(self, timeout: Optional[float] = None) -> bool:
+        return self._shutdown_requested.wait(timeout)
+
+    def stop(self):
+        httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        t, self._thread = self._thread, None
+        if t is not None and t.is_alive():
+            t.join(timeout=5)
+
+
+# ---------------------------------------------------------------- local
+class ThreadReplicaFactory:
+    """Spawns replicas as threads in THIS process — the supervisor's
+    test double and the single-process deployment mode. Each "process"
+    is a ReplicaApp over a backend built by ``backend_factory``;
+    ``kill()`` drops it abruptly (closed sockets, exit code 1), like a
+    SIGKILLed worker."""
+
+    def __init__(self, backend_factory):
+        self.backend_factory = backend_factory
+        self.spawned: List["_ThreadReplica"] = []
+
+    def __call__(self, replica_id: int) -> "_ThreadReplica":
+        rep = _ThreadReplica(self.backend_factory(replica_id))
+        self.spawned.append(rep)
+        return rep
+
+
+class _ThreadReplica:
+    """ReplicaProcess protocol over an in-thread ReplicaApp."""
+
+    def __init__(self, backend):
+        self.backend = backend
+        self.app = ReplicaApp(backend).start()
+        self._rc: Optional[int] = None
+        self.pid = -os.getpid()     # marks "not a real process"
+        backend.warmup()
+
+    def url(self) -> Optional[str]:
+        return self.app.url if self._rc is None else None
+
+    def poll(self) -> Optional[int]:
+        if self._rc is None and self.app.wait_shutdown(0):
+            self._rc = 0
+        return self._rc
+
+    def terminate(self):
+        if self._rc is None:
+            self.backend.shutdown(drain=True)
+            self.app.stop()
+            self._rc = 0
+
+    def kill(self):
+        if self._rc is None:
+            self.backend.shutdown(drain=False)
+            self.app.stop()
+            self._rc = 1
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[int]:
+        return self.poll()
+
+
+# ---------------------------------------------------------------- main
+def _parse_args(argv):
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="paddle-tpu fleet replica worker")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--announce", default=None,
+                    help="announce-file path the supervisor polls")
+    ap.add_argument("--model-prefix", default=None)
+    ap.add_argument("--warmup", default="auto",
+                    choices=("auto", "manifest", "lattice", "none"))
+    ap.add_argument("--max-batch-size", type=int, default=0)
+    ap.add_argument("--seq-buckets", default="",
+                    help="comma list, e.g. 8,16,32 ('' = no seq "
+                         "bucketing)")
+    ap.add_argument("--name", default=None)
+    ap.add_argument("--generation-preset", default="",
+                    help="'tiny' hosts a seeded gpt_tiny "
+                         "GenerationServer next to the predictor")
+    ap.add_argument("--stub", action="store_true",
+                    help="accelerator-emulating stub backend (no "
+                         "model; fleet benches + failure drills)")
+    ap.add_argument("--stub-device-ms", type=float, default=5.0)
+    ap.add_argument("--stub-max-batch", type=int, default=8)
+    ap.add_argument("--stub-capacity", type=int, default=64)
+    ap.add_argument("--stub-warmup-s", type=float, default=0.0)
+    ap.add_argument("--stub-version", default="v0")
+    ap.add_argument("--stub-crash-value", type=float, default=None)
+    ap.add_argument("--stub-crash-mode", default="exit",
+                    choices=("exit", "drop"))
+    return ap.parse_args(argv)
+
+
+def _build_backend(args):
+    if args.stub:
+        return StubBackend(
+            device_ms=args.stub_device_ms,
+            max_batch=args.stub_max_batch,
+            queue_capacity=args.stub_capacity,
+            warmup_s=args.stub_warmup_s,
+            version=args.stub_version,
+            crash_value=args.stub_crash_value,
+            crash_mode=args.stub_crash_mode)
+    if not args.model_prefix:
+        raise SystemExit("worker: need --model-prefix or --stub")
+    gen_model = None
+    if args.generation_preset:
+        import paddle_tpu as paddle
+        from ...models import GPTForCausalLM, gpt_tiny
+        paddle.seed(0)
+        gen_model = GPTForCausalLM(
+            gpt_tiny(use_flash_attention=False))
+    buckets = [int(b) for b in args.seq_buckets.split(",") if b]
+    return PredictorBackend(
+        args.model_prefix,
+        max_batch_size=args.max_batch_size or None,
+        seq_buckets=buckets or None,
+        warmup_mode=args.warmup,
+        name=args.name or f"replica-{os.getpid()}",
+        generation_model=gen_model)
+
+
+def main(argv=None) -> int:
+    import signal
+
+    args = _parse_args(argv)
+    backend = _build_backend(args)
+    app = ReplicaApp(backend, host=args.host,
+                     port=args.port).start()
+    if args.announce:
+        write_announce_file(args.announce, app.port)
+
+    def _sigterm(signum, frame):
+        app._request_shutdown()
+
+    try:
+        signal.signal(signal.SIGTERM, _sigterm)
+        signal.signal(signal.SIGINT, _sigterm)
+    except ValueError:
+        pass    # not the main thread (embedded use)
+    # liveness is up (the app answers /healthz) but readiness stays
+    # false until this warmup pass — the whole point of the split
+    backend.warmup()
+    app.wait_shutdown()
+    backend.shutdown(drain=True)
+    app.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -401,6 +401,11 @@ class GenerationServer:
         self._loop_running = False
         self._worker: Optional[threading.Thread] = None
         self._steps = 0
+        # readiness gate (mirrors InferenceServer): not-ready until a
+        # warmup pass completes, so a fleet router skips cold engines
+        self._ready_gate = bool(
+            _flag("FLAGS_serving_ready_requires_warmup", False))
+        self._warmed = threading.Event()
         self.telemetry = self._attach_telemetry(telemetry_port, name)
         self._manifest_recorded = set()
         self._manifest = self._init_manifest(name)
@@ -419,6 +424,8 @@ class GenerationServer:
         from ... import observability
         srv = observability.start_telemetry_server(port=int(port))
         observability.add_health_check(f"decode:{name}", self._health)
+        observability.add_readiness_check(f"decode:{name}",
+                                          self._readiness)
         return srv
 
     def _init_manifest(self, name):
@@ -447,6 +454,30 @@ class GenerationServer:
             return False, "worker thread died"
         return True, {"queue_depth": self.queue_depth,
                       "active_sequences": self.active_sequences}
+
+    @property
+    def ready(self) -> bool:
+        """Traffic-readiness (see InferenceServer.ready): live, and —
+        when the ``FLAGS_serving_ready_requires_warmup`` gate is on —
+        warmed up."""
+        if self._closed:
+            return False
+        return self._warmed.is_set() or not self._ready_gate
+
+    def mark_ready(self):
+        self._warmed.set()
+
+    def _readiness(self):
+        return self.ready, {"warmed": self._warmed.is_set(),
+                            "gated": self._ready_gate}
+
+    def refresh_params(self):
+        """Re-snapshot the model's live parameters into the decode
+        engine (no recompile — params are call operands). The fleet's
+        in-process hot-swap path: update the model's weights, then
+        ``refresh_params()``; subsequent prefills/decodes use the new
+        weights while in-flight sequences keep streaming."""
+        self.decoder.refresh_params()
 
     @property
     def queue_depth(self) -> int:
@@ -493,8 +524,10 @@ class GenerationServer:
             # their futures forever
             self._loop()
         if self.telemetry is not None:
-            from ...observability import remove_health_check
+            from ...observability import (remove_health_check,
+                                          remove_readiness_check)
             remove_health_check(f"decode:{self.metrics.name}")
+            remove_readiness_check(f"decode:{self.metrics.name}")
 
     def __enter__(self):
         return self
@@ -573,6 +606,7 @@ class GenerationServer:
         for s in seqs:
             for r in batch_buckets:
                 fresh += self._warm_prefill(int(r), int(s))
+        self._warmed.set()
         return fresh
 
     def _warm_decode(self) -> int:
@@ -623,6 +657,7 @@ class GenerationServer:
             fresh += self._warm_prefill(int(rows), int(seq))
         if manifest.specs(site="generate_decode"):
             fresh += self._warm_decode()
+        self._warmed.set()
         return fresh
 
     def _note_dispatch(self, site: str, fresh: bool, feeds,

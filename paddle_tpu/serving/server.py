@@ -139,6 +139,7 @@ class InferenceServer:
                  pipeline_depth: Optional[int] = None,
                  donate_inputs: Optional[bool] = None,
                  telemetry_port: Optional[int] = None,
+                 ready_requires_warmup: Optional[bool] = None,
                  start: bool = True):
         self.predictor = predictor
         self.max_batch_size = int(max_batch_size if max_batch_size
@@ -180,6 +181,14 @@ class InferenceServer:
         self._compiled = set()          # signatures already executed
         self._manifest_recorded = set()  # signatures already persisted
         self._lock = threading.Lock()
+        # readiness (distinct from liveness): with the gate on, the
+        # server reports not-ready until a warmup pass completes, so a
+        # fleet router never routes traffic to a cold replica that
+        # would compile on the request path
+        self._ready_gate = bool(
+            ready_requires_warmup if ready_requires_warmup is not None
+            else _flag("FLAGS_serving_ready_requires_warmup", False))
+        self._warmed = threading.Event()
         self.telemetry = self._attach_telemetry(telemetry_port)
         self._manifest = self._init_manifest()
         if self._manifest is not None and len(self._manifest) and \
@@ -205,6 +214,8 @@ class InferenceServer:
         srv = observability.start_telemetry_server(port=int(port))
         observability.add_health_check(
             f"serving:{self.metrics.name}", self._health)
+        observability.add_readiness_check(
+            f"serving:{self.metrics.name}", self._readiness)
         return srv
 
     def _init_manifest(self):
@@ -242,6 +253,28 @@ class InferenceServer:
             return False, "worker thread died"
         return True, {"queue_depth": self.queue_depth,
                       "inflight_batches": self.inflight_batches}
+
+    # ------------------------------------------------------ readiness
+    @property
+    def ready(self) -> bool:
+        """True when this server should be handed traffic. Without the
+        warmup gate (``FLAGS_serving_ready_requires_warmup`` /
+        ``ready_requires_warmup=``) any live server is ready; with it,
+        readiness additionally requires a completed ``warmup()`` /
+        ``warmup_from_manifest()`` (or explicit ``mark_ready()``)."""
+        if self._closed:
+            return False
+        return self._warmed.is_set() or not self._ready_gate
+
+    def mark_ready(self):
+        """Flip readiness on without a warmup pass (a deployment that
+        accepts compiling on the request path)."""
+        self._warmed.set()
+
+    def _readiness(self):
+        ok = self.ready
+        return ok, {"warmed": self._warmed.is_set(),
+                    "gated": self._ready_gate}
 
     # ------------------------------------------------------ lifecycle
     def start(self):
@@ -299,8 +332,10 @@ class InferenceServer:
                 time.sleep(0.005)  # wait out a serve_forever drain
         self._stop_completion(timeout)
         if self.telemetry is not None:
-            from ..observability import remove_health_check
+            from ..observability import (remove_health_check,
+                                         remove_readiness_check)
             remove_health_check(f"serving:{self.metrics.name}")
+            remove_readiness_check(f"serving:{self.metrics.name}")
         metrics_mod.unregister(self.metrics.name)
 
     def __enter__(self):
@@ -435,6 +470,7 @@ class InferenceServer:
             fresh += self._execute([req], record_latency=False,
                                    record_traffic=False)
             req.future.result()    # surface warmup failures loudly
+        self._warmed.set()
         return fresh
 
     def warmup_from_manifest(self, path: Optional[str] = None) -> int:
@@ -466,6 +502,7 @@ class InferenceServer:
             fresh += self._execute([req], record_latency=False,
                                    record_traffic=False)
             req.future.result()    # surface replay failures loudly
+        self._warmed.set()
         return fresh
 
     # ------------------------------------------------------ execution

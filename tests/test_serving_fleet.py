@@ -1,0 +1,699 @@
+"""paddle_tpu.serving.fleet — multi-replica serving (ISSUE 8).
+
+Tier-1 tests run the REAL router/supervisor/worker-app code over
+in-process replicas (ReplicaApp threads on localhost sockets, the
+accelerator-emulating StubBackend) so the failure paths — crash
+mid-request, shed/retry accounting, rolling swap under concurrent
+traffic, respawn — are fast and deterministic; the multi-process
+end-to-end versions (real worker subprocesses, real Predictor
+replicas) are marked ``slow``.
+"""
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.serving import fleet
+from paddle_tpu.serving.fleet import codec
+from paddle_tpu.serving.request import (DeadlineExceededError,
+                                        QueueFullError,
+                                        ServerClosedError)
+
+_OPENER = urllib.request.build_opener(
+    urllib.request.ProxyHandler({}))
+
+
+# ------------------------------------------------------------- helpers
+def _stub_replica(**kw):
+    """One in-process replica: StubBackend behind the real HTTP app,
+    warmed unless warmup_s says otherwise."""
+    be = fleet.StubBackend(**kw)
+    app = fleet.ReplicaApp(be).start()
+    if not kw.get("warmup_s"):
+        be.warmup()
+    return be, app
+
+
+@pytest.fixture()
+def one_replica():
+    be, app = _stub_replica(device_ms=1.0)
+    router = fleet.FleetRouter({0: app.url}, name="t_one",
+                               start=False)
+    router.poll_replicas()
+    yield be, app, router
+    router.shutdown()
+    app.stop()
+
+
+def _feed(v=1.0, rows=1):
+    return [np.full((rows, 4), v, np.float32)]
+
+
+# ------------------------------------------------------------- codec
+class TestCodec:
+    def test_batch_roundtrip_mixed_dtypes(self):
+        feeds = [
+            [np.arange(6, dtype=np.float32).reshape(2, 3),
+             np.array([True, False])],
+            [np.ones((1, 3), np.int64), np.float64(3.5).reshape(())],
+        ]
+        data = codec.encode_batch(feeds)
+        assert codec.peek_batch_size(data) == 2
+        back = codec.decode_batch(data)
+        for want, got in zip(feeds, back):
+            for w, g in zip(want, got):
+                assert np.asarray(w).dtype == g.dtype
+                np.testing.assert_array_equal(np.asarray(w), g)
+
+    def test_results_roundtrip_errors_keep_types(self):
+        res = codec.encode_results([
+            [np.zeros((2, 2), np.float32)],
+            QueueFullError("full"),
+            DeadlineExceededError("late"),
+            ServerClosedError("closed"),
+            ValueError("boom"),
+        ])
+        back = codec.decode_results(res)
+        assert isinstance(back[0], list)
+        assert isinstance(back[1], QueueFullError)
+        assert isinstance(back[2], DeadlineExceededError)
+        assert isinstance(back[3], ServerClosedError)
+        assert isinstance(back[4], RuntimeError)
+        assert "boom" in str(back[4])
+
+    def test_truncated_and_garbage_payloads_raise(self):
+        data = codec.encode_batch([_feed()])
+        with pytest.raises(codec.CodecError):
+            codec.decode_batch(data[:-3])
+        with pytest.raises(codec.CodecError):
+            codec.decode_batch(b"NOPE" + data[4:])
+        with pytest.raises(codec.CodecError):
+            codec.peek_batch_size(b"xx")
+
+    def test_size_mismatch_rejected(self):
+        # header claims more bytes than shape*dtype: must not be
+        # silently reshaped
+        data = bytearray(codec.encode_batch([_feed()]))
+        # nbytes field sits right before the raw buffer (16 floats)
+        idx = len(data) - 16 - 8
+        data[idx:idx + 8] = (99).to_bytes(8, "little")
+        with pytest.raises(codec.CodecError):
+            codec.decode_batch(bytes(data))
+
+
+# ------------------------------------------------------------- metrics
+class TestMergedMetrics:
+    def test_replica_label_injection_and_header_dedup(self):
+        t0 = ("# HELP m_total doc\n# TYPE m_total counter\n"
+              'm_total{server="a"} 3\nplain 1\n')
+        t1 = ("# HELP m_total doc\n# TYPE m_total counter\n"
+              'm_total{server="a"} 5\n')
+        merged = fleet.merge_prometheus_texts({"r0": t0, "r1": t1})
+        assert merged.count("# HELP m_total doc") == 1
+        assert 'm_total{replica="r0",server="a"} 3' in merged
+        assert 'm_total{replica="r1",server="a"} 5' in merged
+        assert 'plain{replica="r0"} 1' in merged
+
+    def test_router_merged_view_includes_replicas(self, one_replica):
+        _, _, router = one_replica
+        merged = router.merged_metrics()
+        assert 'replica="0"' in merged
+
+
+# ------------------------------------------------------------- routing
+class TestRouting:
+    def test_submit_roundtrip_and_metrics(self, one_replica):
+        be, _, router = one_replica
+        futs = router.submit_many([_feed(2.0) for _ in range(5)])
+        for f in futs:
+            out = f.result(timeout=30)
+            np.testing.assert_allclose(
+                out[0], np.full((1, 4), 2.0) * be._scale)
+        snap = router.metrics_snapshot()
+        assert snap["counters"]["routed"] == 5
+        assert snap["counters"]["completed"] == 5
+        assert snap["counters"]["failed"] == 0
+
+    def test_routes_only_to_ready_replicas(self):
+        cold, cold_app = _stub_replica(device_ms=1.0, warmup_s=60.0)
+        warm, warm_app = _stub_replica(device_ms=1.0)
+        router = fleet.FleetRouter({"cold": cold_app.url,
+                                    "warm": warm_app.url},
+                                   name="t_ready", start=False)
+        try:
+            router.poll_replicas()
+            states = {s["replica"]: s
+                      for s in router.replica_states()}
+            assert states["cold"]["alive"] and \
+                not states["cold"]["ready"]
+            assert states["warm"]["ready"]
+            futs = router.submit_many([_feed() for _ in range(6)])
+            for f in futs:
+                f.result(timeout=30)
+            assert cold.dispatches == 0
+            assert warm.dispatches > 0
+        finally:
+            router.shutdown()
+            cold_app.stop()
+            warm_app.stop()
+
+    def test_no_ready_replica_raises(self):
+        cold, app = _stub_replica(device_ms=1.0, warmup_s=60.0)
+        router = fleet.FleetRouter({0: app.url}, name="t_cold",
+                                   start=False)
+        try:
+            router.poll_replicas()
+            fut = router.submit(_feed())
+            with pytest.raises(fleet.NoReadyReplicaError):
+                fut.result(timeout=30)
+            assert router.metrics_snapshot()["counters"]["shed"] == 1
+        finally:
+            router.shutdown()
+            app.stop()
+
+    def test_load_spreads_across_replicas(self):
+        reps = [_stub_replica(device_ms=2.0) for _ in range(2)]
+        router = fleet.FleetRouter(
+            {i: app.url for i, (_, app) in enumerate(reps)},
+            name="t_spread", start=False)
+        try:
+            router.poll_replicas()
+            futs = []
+            for _ in range(12):
+                futs.extend(router.submit_many([_feed()] * 2))
+            for f in futs:
+                f.result(timeout=30)
+            assert all(be.dispatches > 0 for be, _ in reps)
+        finally:
+            router.shutdown()
+            for _, app in reps:
+                app.stop()
+
+    def test_shed_retries_on_other_replica(self):
+        # tiny replica sheds (capacity 1 vs 4-request batch); the
+        # roomy one absorbs the retry
+        tiny, tiny_app = _stub_replica(device_ms=1.0,
+                                       queue_capacity=1)
+        roomy, roomy_app = _stub_replica(device_ms=1.0)
+        router = fleet.FleetRouter({"tiny": tiny_app.url,
+                                    "roomy": roomy_app.url},
+                                   name="t_shed", start=False)
+        try:
+            router.poll_replicas()
+            # drive until the pick lands on tiny at least once
+            for _ in range(6):
+                futs = router.submit_many([_feed()] * 4)
+                for f in futs:
+                    f.result(timeout=30)
+            snap = router.metrics_snapshot()
+            assert snap["counters"]["failed"] == 0
+            assert snap["retries"]["queue_full"] >= 1
+        finally:
+            router.shutdown()
+            tiny_app.stop()
+            roomy_app.stop()
+
+    def test_all_replicas_full_sheds_with_queue_full(self):
+        be, app = _stub_replica(device_ms=1.0, queue_capacity=1)
+        router = fleet.FleetRouter({0: app.url}, name="t_full",
+                                   retries=1, start=False)
+        try:
+            router.poll_replicas()
+            fut = router.submit_many([_feed()] * 4)[0]
+            with pytest.raises(QueueFullError):
+                fut.result(timeout=30)
+            snap = router.metrics_snapshot()
+            assert snap["counters"]["shed"] == 4
+            assert snap["retries"]["queue_full"] >= 1
+        finally:
+            router.shutdown()
+            app.stop()
+
+    def test_submit_after_shutdown_and_dict_feed(self, one_replica):
+        _, _, router = one_replica
+        with pytest.raises(TypeError):
+            router.submit_many([{"x": np.zeros((1, 4))}])
+        router.shutdown()
+        with pytest.raises(ServerClosedError):
+            router.submit(_feed())
+
+
+class TestCrashMidRequest:
+    def test_inflight_fails_others_survive(self):
+        crashy, crashy_app = _stub_replica(
+            device_ms=1.0, crash_value=666.0, crash_mode="drop")
+        safe, safe_app = _stub_replica(device_ms=1.0)
+        router = fleet.FleetRouter({"crashy": crashy_app.url,
+                                    "safe": safe_app.url},
+                                   name="t_crash", start=False)
+        try:
+            # phase 1: only the crashy replica is known, so the
+            # poison request deterministically lands on it
+            router.remove_replica("safe")
+            router.poll_replicas()
+            bad = router.submit(_feed(666.0))
+            with pytest.raises((fleet.ReplicaError,
+                                ServerClosedError)):
+                bad.result(timeout=30)
+            # phase 2: the healthy replica joins the fleet
+            router.add_replica("safe", safe_app.url)
+            # the crashed replica leaves the routable set...
+            router.poll_replicas()
+            routable = {s["replica"]
+                        for s in router.replica_states()
+                        if s["ready"]}
+            assert "crashy" not in routable
+            # ...and healthy traffic keeps flowing on the survivor
+            futs = router.submit_many([_feed() for _ in range(4)])
+            for f in futs:
+                f.result(timeout=30)
+            assert router.metrics_snapshot()[
+                "counters"]["failed"] >= 1
+        finally:
+            router.shutdown()
+            crashy_app.stop()
+            safe_app.stop()
+
+
+class TestRollingSwap:
+    def test_swap_under_traffic_loses_nothing(self):
+        import threading
+        reps = [_stub_replica(device_ms=1.0) for _ in range(2)]
+        router = fleet.FleetRouter(
+            {i: app.url for i, (_, app) in enumerate(reps)},
+            name="t_swap", start=False)
+        stats = {"done": 0, "failed": 0}
+        stop = threading.Event()
+
+        def _traffic():
+            while not stop.is_set():
+                futs = router.submit_many([_feed()] * 2)
+                for f in futs:
+                    try:
+                        f.result(timeout=30)
+                        stats["done"] += 1
+                    except Exception:  # noqa: BLE001 - counted
+                        stats["failed"] += 1
+                time.sleep(0.001)
+
+        try:
+            router.poll_replicas()
+            threads = [threading.Thread(target=_traffic)
+                       for _ in range(2)]
+            for t in threads:
+                t.start()
+            time.sleep(0.1)
+            report = router.swap_weights("models/v1",
+                                         drain_timeout_s=10)
+            time.sleep(0.1)
+            stop.set()
+            for t in threads:
+                t.join()
+            assert stats["failed"] == 0
+            assert stats["done"] > 0
+            assert len(report["replicas"]) == 2
+            assert all(be.version == "v1" for be, _ in reps)
+            # post-swap traffic carries the new version's scale
+            out = router.submit(_feed(1.0)).result(timeout=30)
+            np.testing.assert_allclose(
+                out[0], np.full((1, 4),
+                                fleet.StubBackend._scale_of("v1")))
+            snap = router.metrics_snapshot()
+            assert snap["swaps"]["replica_reloaded"] == 2
+            assert snap["swaps"]["completed"] == 1
+        finally:
+            stop.set()
+            router.shutdown()
+            for _, app in reps:
+                app.stop()
+
+    def test_swap_drains_before_reload(self):
+        # a slow in-flight batch must finish BEFORE its replica
+        # reloads: drain_ms in the report proves the wait happened
+        be, app = _stub_replica(device_ms=300.0)
+        router = fleet.FleetRouter({0: app.url}, name="t_drain",
+                                   start=False)
+        try:
+            router.poll_replicas()
+            fut = router.submit(_feed())
+            time.sleep(0.05)    # let the dispatch reach the stub
+            report = router.swap_weights("models/v2",
+                                         drain_timeout_s=30)
+            assert fut.result(timeout=30)  # completed, not failed
+            assert report["replicas"][0]["drain_ms"] > 100
+        finally:
+            router.shutdown()
+            app.stop()
+
+
+class TestGenerateRouting:
+    def test_stream_through_router(self, one_replica):
+        _, _, router = one_replica
+        fut = router.submit_generate([7], max_new_tokens=5)
+        assert list(fut) == [8, 9, 10, 11, 12]
+        assert fut.finish_reason == "length"
+        assert fut.result(timeout=5) == [8, 9, 10, 11, 12]
+
+    def test_generate_shed_when_cold(self):
+        cold, app = _stub_replica(device_ms=1.0, warmup_s=60.0)
+        router = fleet.FleetRouter({0: app.url}, name="t_gcold",
+                                   start=False)
+        try:
+            router.poll_replicas()
+            fut = router.submit_generate([1], max_new_tokens=3)
+            with pytest.raises(ServerClosedError):
+                fut.result(timeout=30)
+        finally:
+            router.shutdown()
+            app.stop()
+
+
+# ------------------------------------------------------------- http
+class TestRouterHTTP:
+    def test_data_plane_passthrough_and_status(self, one_replica):
+        be, _, router = one_replica
+        app = fleet.RouterApp(router, host="127.0.0.1").start()
+        try:
+            body = codec.encode_batch([_feed(3.0)] * 2)
+            req = urllib.request.Request(
+                app.url("/submit_many"), data=body)
+            with _OPENER.open(req, timeout=30) as resp:
+                results = codec.decode_results(resp.read())
+            assert len(results) == 2
+            np.testing.assert_allclose(
+                results[0][0], np.full((1, 4), 3.0) * be._scale)
+            with _OPENER.open(app.url("/readyz"),
+                              timeout=10) as resp:
+                assert json.loads(resp.read())["ready"] is True
+            with _OPENER.open(app.url("/statusz"),
+                              timeout=10) as resp:
+                status = json.loads(resp.read())
+            assert status["replicas"][0]["ready"] is True
+            with _OPENER.open(app.url("/metrics?merged=1"),
+                              timeout=10) as resp:
+                text = resp.read().decode()
+            assert "paddle_fleet_requests_total" in text
+            assert 'replica="0"' in text
+        finally:
+            app.stop()
+
+    def test_http_shed_maps_to_429_and_cold_to_503(self):
+        be, rep_app = _stub_replica(device_ms=1.0, queue_capacity=1)
+        router = fleet.FleetRouter({0: rep_app.url}, name="t_http2",
+                                   retries=0, start=False)
+        router.poll_replicas()
+        app = fleet.RouterApp(router, host="127.0.0.1").start()
+        try:
+            body = codec.encode_batch([_feed()] * 8)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _OPENER.open(urllib.request.Request(
+                    app.url("/submit_many"), data=body), timeout=30)
+            assert ei.value.code == 429
+            ei.value.read()
+            router.remove_replica(0)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _OPENER.open(urllib.request.Request(
+                    app.url("/submit_many"), data=body), timeout=30)
+            assert ei.value.code == 503
+            ei.value.read()
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _OPENER.open(app.url("/readyz"), timeout=10)
+            assert ei.value.code == 503
+            ei.value.read()
+        finally:
+            app.stop()
+            router.shutdown()
+            rep_app.stop()
+
+    def test_generate_over_http(self, one_replica):
+        _, _, router = one_replica
+        app = fleet.RouterApp(router, host="127.0.0.1").start()
+        try:
+            req = urllib.request.Request(
+                app.url("/generate"),
+                data=json.dumps({"prompt": [3],
+                                 "max_new_tokens": 4}).encode())
+            with _OPENER.open(req, timeout=30) as resp:
+                events = [json.loads(line)
+                          for line in resp if line.strip()]
+            toks = [e["t"] for e in events if "t" in e]
+            assert toks == [4, 5, 6, 7]
+            assert events[-1]["done"] is True
+            assert events[-1]["finish_reason"] == "length"
+        finally:
+            app.stop()
+
+
+# ------------------------------------------------------------- supervisor
+class TestSupervisor:
+    def test_respawn_after_kill(self):
+        fac = fleet.ThreadReplicaFactory(
+            lambda rid: fleet.StubBackend(device_ms=1.0))
+        sup = fleet.ReplicaSupervisor(fac, 2, restart_backoff_ms=10,
+                                      poll_interval_s=0.01).start()
+        try:
+            assert len(sup.endpoints()) == 2
+            fac.spawned[0].kill()       # SIGKILL stand-in
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if sup.restart_counts().get(0) == 1 and \
+                        len(sup.endpoints()) == 2:
+                    break
+                time.sleep(0.02)
+            assert sup.restart_counts()[0] == 1
+            assert len(sup.endpoints()) == 2
+            # the respawned replica is a NEW app on a new port
+            assert len(fac.spawned) == 3
+        finally:
+            sup.stop()
+
+    def test_restart_metric_counts(self):
+        fac = fleet.ThreadReplicaFactory(
+            lambda rid: fleet.StubBackend(device_ms=1.0))
+        metrics = fleet.FleetMetrics("t_restarts")
+        sup = fleet.ReplicaSupervisor(
+            fac, 1, restart_backoff_ms=10, poll_interval_s=0.01,
+            metrics=metrics).start()
+        try:
+            fac.spawned[0].kill()
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if metrics.snapshot()["restarts"] >= 1:
+                    break
+                time.sleep(0.02)
+            assert metrics.snapshot()["restarts"] == 1
+        finally:
+            sup.stop()
+
+    def test_scale_up_and_down(self):
+        fac = fleet.ThreadReplicaFactory(
+            lambda rid: fleet.StubBackend(device_ms=1.0))
+        sup = fleet.ReplicaSupervisor(fac, 1,
+                                      poll_interval_s=0.01).start()
+        try:
+            assert len(sup.endpoints()) == 1
+            sup.scale_to(3)
+            assert len(sup.endpoints()) == 3
+            sup.scale_to(1)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if len(sup.endpoints()) == 1:
+                    break
+                time.sleep(0.02)
+            assert len(sup.endpoints()) == 1
+            assert sup.replica_ids == [0]
+        finally:
+            sup.stop()
+
+    def test_router_follows_supervisor(self):
+        fac = fleet.ThreadReplicaFactory(
+            lambda rid: fleet.StubBackend(device_ms=1.0))
+        sup = fleet.ReplicaSupervisor(fac, 1, restart_backoff_ms=10,
+                                      poll_interval_s=0.01).start()
+        router = fleet.FleetRouter(supervisor=sup, name="t_follow",
+                                   start=False)
+        try:
+            router.poll_replicas()
+            assert len(router._routable()) == 1
+            sup.scale_to(2)         # warm scale-out: router sees it
+            router.poll_replicas()
+            assert len(router._routable()) == 2
+            futs = router.submit_many([_feed()] * 4)
+            for f in futs:
+                f.result(timeout=30)
+        finally:
+            router.shutdown()
+            sup.stop()
+
+
+# ------------------------------------------------------------- readiness
+class TestReadinessSplit:
+    def test_observability_readyz_vacuous_and_gated(self):
+        from paddle_tpu import observability as obs
+        ok, detail = obs.readyz()
+        base = len(detail["checks"])
+        obs.add_readiness_check("t_fleet_gate", lambda: False)
+        try:
+            ok, detail = obs.readyz()
+            assert not ok
+            assert len(detail["checks"]) == base + 1
+            # liveness is NOT affected by a readiness gate
+            h_ok, h_detail = obs.healthz()
+            assert "t_fleet_gate" not in h_detail["checks"]
+        finally:
+            obs.remove_readiness_check("t_fleet_gate")
+        assert obs.readyz()[0] or base > 0
+
+    def test_inference_server_ready_gate(self, tmp_path):
+        import paddle_tpu.nn as nn
+        from paddle_tpu import inference, serving
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 8), nn.Tanh()).eval()
+        prefix = str(tmp_path / "m")
+        paddle.jit.save(net, prefix, input_spec=[
+            paddle.static.InputSpec([None, 8], "float32", "x")])
+        pred = inference.create_predictor(inference.Config(prefix))
+        srv = serving.InferenceServer(
+            pred, max_batch_size=4, name="t_gate",
+            ready_requires_warmup=True, start=False)
+        try:
+            assert srv.ready is False       # gated, not warmed
+            srv.warmup()
+            assert srv.ready is True
+        finally:
+            srv.shutdown()
+        assert srv.ready is False           # closed = never ready
+
+    def test_ungated_server_ready_immediately(self, tmp_path):
+        import paddle_tpu.nn as nn
+        from paddle_tpu import inference, serving
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 8), nn.Tanh()).eval()
+        prefix = str(tmp_path / "m")
+        paddle.jit.save(net, prefix, input_spec=[
+            paddle.static.InputSpec([None, 8], "float32", "x")])
+        pred = inference.create_predictor(inference.Config(prefix))
+        srv = serving.InferenceServer(pred, max_batch_size=4,
+                                      name="t_ungated", start=False)
+        try:
+            assert srv.ready is True    # default: no warmup gate
+        finally:
+            srv.shutdown()
+
+    def test_worker_readyz_flips_after_warmup(self):
+        be, app = _stub_replica(device_ms=1.0, warmup_s=60.0)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _OPENER.open(app.url + "/readyz", timeout=10)
+            assert ei.value.code == 503
+            ei.value.read()
+            # liveness is already green while readiness is not
+            with _OPENER.open(app.url + "/healthz",
+                              timeout=10) as resp:
+                assert json.loads(resp.read())["ok"] is True
+            with be._lock:
+                be._warmed = True
+            with _OPENER.open(app.url + "/readyz",
+                              timeout=10) as resp:
+                assert json.loads(resp.read())["ready"] is True
+        finally:
+            app.stop()
+
+
+# ------------------------------------------------------------- e2e
+def _wait(predicate, timeout=60.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+@pytest.mark.slow
+class TestMultiProcessE2E:
+    def test_stub_worker_crash_respawn_and_traffic(self):
+        fac = fleet.ProcessReplicaFactory(
+            extra_args=["--stub", "--stub-device-ms", "2",
+                        "--stub-crash-value", "666",
+                        "--stub-crash-mode", "exit"],
+            env={"JAX_PLATFORMS": "cpu"})
+        sup = fleet.ReplicaSupervisor(fac, 2,
+                                      restart_backoff_ms=50).start()
+        router = fleet.FleetRouter(supervisor=sup, name="t_e2e",
+                                   health_interval_ms=100)
+        try:
+            assert router.wait_ready(2, timeout=60)
+            futs = router.submit_many([_feed() for _ in range(6)])
+            for f in futs:
+                f.result(timeout=60)
+            # kill one replica mid-request via the poison value
+            bad = router.submit(_feed(666.0))
+            with pytest.raises((fleet.ReplicaError,
+                                ServerClosedError)):
+                bad.result(timeout=60)
+            # traffic keeps flowing on the survivor
+            futs = router.submit_many([_feed() for _ in range(4)])
+            for f in futs:
+                f.result(timeout=60)
+            # and the supervisor brings the dead one back
+            assert _wait(lambda: sum(
+                sup.restart_counts().values()) >= 1 and
+                len(router._routable()) >= 2, timeout=60)
+        finally:
+            router.shutdown()
+            sup.stop()
+
+    def test_real_worker_parity_warm_manifest_and_reload(
+            self, tmp_path):
+        import paddle_tpu.nn as nn
+        from paddle_tpu import inference
+
+        def _save(name, seed):
+            paddle.seed(seed)
+            net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(),
+                                nn.Linear(16, 4)).eval()
+            prefix = str(tmp_path / name)
+            paddle.jit.save(net, prefix, input_spec=[
+                paddle.static.InputSpec([None, 8], "float32",
+                                        "x")])
+            return prefix
+
+        v1, v2 = _save("model_v1", 0), _save("model_v2", 7)
+        cache = str(tmp_path / "cache")
+        fac = fleet.ProcessReplicaFactory(
+            extra_args=["--model-prefix", v1, "--warmup", "auto",
+                        "--max-batch-size", "8"],
+            env={"JAX_PLATFORMS": "cpu",
+                 "FLAGS_compile_cache_dir": cache})
+        sup = fleet.ReplicaSupervisor(fac, 1).start()
+        router = fleet.FleetRouter(supervisor=sup, name="t_real",
+                                   health_interval_ms=100)
+        try:
+            assert router.wait_ready(1, timeout=120), \
+                router.replica_states()
+            x = np.random.RandomState(0).randn(2, 8).astype(
+                "float32")
+            out = router.submit([x]).result(timeout=120)
+            ref = inference.create_predictor(
+                inference.Config(v1)).run([x])[0]
+            np.testing.assert_allclose(out[0], ref, rtol=1e-5,
+                                       atol=1e-6)
+            # rolling hot swap to v2, then verify the new weights
+            report = router.swap_weights(v2)
+            assert report["replicas"][0]["version"].startswith(
+                "model_v2")
+            out2 = router.submit([x]).result(timeout=120)
+            ref2 = inference.create_predictor(
+                inference.Config(v2)).run([x])[0]
+            np.testing.assert_allclose(out2[0], ref2, rtol=1e-5,
+                                       atol=1e-6)
+            assert np.abs(out2[0] - out[0]).max() > 1e-6
+        finally:
+            router.shutdown()
+            sup.stop()

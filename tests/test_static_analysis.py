@@ -390,6 +390,33 @@ class TestLockDiscipline:
         assert any(f.rule == "LK001" and "generation" in f.path
                    for f in findings)
 
+    def test_scope_includes_fleet_subpackage(self, tmp_path):
+        """The serving/ prefix must also reach the fleet subpackage —
+        router poll thread, supervisor monitor thread, and HTTP
+        handler threads all mutate shared replica state, so its lock
+        discipline is in scope (an injected violation there is
+        reported)."""
+        pkg = tmp_path / "paddle_tpu" / "serving" / "fleet"
+        pkg.mkdir(parents=True)
+        (pkg / "router.py").write_text(textwrap.dedent("""
+            import threading
+
+            class Router:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._outstanding = 0
+
+                def acquire(self):
+                    with self._lock:
+                        self._outstanding += 1
+
+                def sloppy_release(self):
+                    self._outstanding -= 1
+        """))
+        findings = _run(tmp_path, [LockDisciplineAnalyzer()])
+        assert any(f.rule == "LK001" and "fleet" in f.path
+                   for f in findings)
+
 
 # ===================================================================
 # 5. core: fingerprints, baseline, walker, CLI

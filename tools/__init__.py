@@ -1,0 +1,3 @@
+"""Repo tooling (benches, pdlint, fixture generators). A package so
+the benches can share plumbing (``tools/_bench_common.py``) via
+``from tools import _bench_common`` from the repo root."""

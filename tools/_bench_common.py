@@ -1,0 +1,62 @@
+"""Shared bench plumbing: the backend-unavailable classifier and the
+structured skip record.
+
+Every bench in this repo prints one JSON line; when the accelerator
+backend cannot initialize, that line must be the ``"skipped": true``
+record (the MULTICHIP_r*.json schema) rather than a crash — BENCH_r04
+died with what LOOKED like a dtype regression because a wedged tunnel
+surfaced backend-unavailable from inside the first eager op's
+dispatch (a ``convert_element_type`` on the 1.3B path). The
+classifier + record format were root-caused and fixed in bench.py
+(PR 7); this module is the shared home so every ``tools/bench_*.py``
+skips identically instead of re-growing the crash. First slice of the
+ROADMAP item 5 perfci consolidation.
+"""
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+__all__ = ["backend_unavailable", "skip_record", "emit_record"]
+
+
+def backend_unavailable(e: BaseException) -> bool:
+    """True when an exception is the runtime telling us the
+    accelerator backend cannot be initialized (as opposed to a real
+    model/dtype bug). Matches both the init-time RuntimeError and the
+    probe-passed-then-wedged shape where the first in-process eager
+    op surfaces UNAVAILABLE from inside its dispatch."""
+    text = f"{type(e).__name__}: {e}"
+    return ("Unable to initialize backend" in text
+            or "UNAVAILABLE" in text
+            or "failed to initialize" in text.lower())
+
+
+def skip_record(error: str, probe: Optional[dict] = None,
+                **extra) -> dict:
+    """The structured no-measurement record: ``"skipped": true``
+    matches the MULTICHIP_r*.json schema so a consumer can tell "no
+    measurement" from "measured zero" without parsing the metric
+    name; ``probe`` carries the retry schedule when a subprocess
+    probe ran. Extra keys (e.g. ``config``) are appended."""
+    rec = {
+        "metric": "backend_unavailable", "skipped": True,
+        "value": 0.0, "unit": "diagnostic", "vs_baseline": 0.0,
+        "error": str(error),
+    }
+    if probe is not None:
+        rec["probe"] = probe
+    rec.update(extra)
+    return rec
+
+
+def emit_record(record: dict, out: Optional[str] = None) -> str:
+    """Print the one-line JSON record; with ``out``, also write the
+    committed pretty-printed BENCH_*.json form. Returns the line."""
+    line = json.dumps(record)
+    print(line)
+    if out:
+        with open(out, "w") as f:
+            f.write(json.dumps(record, indent=1, sort_keys=True)
+                    + "\n")
+    return line

@@ -21,7 +21,6 @@ Usage: JAX_PLATFORMS=cpu python tools/bench_decode.py
        [--requests N] [--out BENCH_DECODE_rNN.json]
 """
 import argparse
-import json
 import os
 import sys
 import time
@@ -31,6 +30,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 import numpy as np  # noqa: E402
 
+from tools._bench_common import (  # noqa: E402
+    backend_unavailable, emit_record, skip_record)
+
 
 def _median(xs):
     xs = sorted(xs)
@@ -38,6 +40,21 @@ def _median(xs):
 
 
 def main():
+    args = _parse_args()
+    try:
+        return _run(args)
+    except Exception as e:  # noqa: BLE001 - an unreachable backend is
+        # a structured skip, not a crash (shared classifier; see
+        # tools/_bench_common.py for the BENCH_r04 story)
+        if not backend_unavailable(e):
+            raise
+        emit_record(skip_record(
+            f"backend unreachable, decode bench skipped: "
+            f"{type(e).__name__}: {str(e)[:300]}"), out=args.out)
+        return 0
+
+
+def _parse_args():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=8,
                     help="concurrent prompts (= engine max_batch)")
@@ -50,8 +67,10 @@ def main():
     ap.add_argument("--trials", type=int, default=3)
     ap.add_argument("--out", default=None,
                     help="also write the JSON record here")
-    args = ap.parse_args()
+    return ap.parse_args()
 
+
+def _run(args):
     import jax
 
     if jax.default_backend() == "cpu":
@@ -146,11 +165,7 @@ def main():
                    "trials": args.trials,
                    "backend": jax.default_backend()},
     }
-    line = json.dumps(record)
-    print(line)
-    if args.out:
-        with open(args.out, "w") as f:
-            f.write(json.dumps(record, indent=1, sort_keys=True) + "\n")
+    emit_record(record, out=args.out)
     return 0
 
 

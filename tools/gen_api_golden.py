@@ -33,6 +33,7 @@ SURFACES = [
     "paddle_tpu.inference",
     "paddle_tpu.serving",
     "paddle_tpu.serving.generation",
+    "paddle_tpu.serving.fleet",
     "paddle_tpu.observability",
     "paddle_tpu.analysis",
     "paddle_tpu.compile_cache",
