@@ -10,7 +10,11 @@ linters over this repo's Python:
   ``define_flag`` definition with a compatible type; dead flags are
   reported (FC001-FC004);
 - ``LockDisciplineAnalyzer`` — unguarded shared-state writes in the
-  threaded serving/observability packages (LK001-LK003).
+  threaded serving/observability packages (LK001-LK003);
+- ``MetricDisciplineAnalyzer`` — registry metric families: names must
+  match ``paddle_[a-z0-9_]+`` and register once per name/type, and
+  histograms must never observe negative duration literals
+  (MD001-MD002).
 
 Entry points: ``tools/pdlint.py`` (CLI, text/JSON, exit codes) and
 ``tests/test_static_analysis.py`` (the gate — fails on any finding not
@@ -27,12 +31,13 @@ from .core import (Analyzer, Finding, SourceFile, baseline_entry,
                    parse_files, run_analyzers, write_baseline)
 from .flag_consistency import FlagConsistencyAnalyzer
 from .lock_discipline import LockDisciplineAnalyzer
+from .metric_discipline import MetricDisciplineAnalyzer
 from .tracer_safety import TracerSafetyAnalyzer
 
 __all__ = [
     "Analyzer", "Finding", "SourceFile",
     "TracerSafetyAnalyzer", "FlagConsistencyAnalyzer",
-    "LockDisciplineAnalyzer",
+    "LockDisciplineAnalyzer", "MetricDisciplineAnalyzer",
     "all_analyzers", "analyzer_names", "default_paths", "repo_root",
     "default_baseline_path", "run_project",
     "iter_python_files", "parse_files", "run_analyzers",
@@ -42,7 +47,7 @@ __all__ = [
 
 def all_analyzers() -> List[Analyzer]:
     return [TracerSafetyAnalyzer(), FlagConsistencyAnalyzer(),
-            LockDisciplineAnalyzer()]
+            LockDisciplineAnalyzer(), MetricDisciplineAnalyzer()]
 
 
 def analyzer_names() -> List[str]:

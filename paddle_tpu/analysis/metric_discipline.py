@@ -1,0 +1,117 @@
+"""Metric-discipline analyzer: registry families must stay coherent.
+
+The metric registry (``paddle_tpu.observability.registry``) is
+runtime-checked only: a family name that breaks Prometheus conventions
+scrapes fine until a real Prometheus server rejects it, and a name
+registered as a Counter in one module and a Gauge in another raises —
+but only on the code path that registers second, possibly deep into a
+serving process's lifetime. This analyzer restores the compile-time
+contract over the same trees the flag analyzer covers:
+
+  MD001  registry family registration (``reg.counter/gauge/histogram(
+         "<name>", ...)``) whose name does not match
+         ``paddle_[a-z0-9_]+``, or whose name is registered elsewhere
+         with a DIFFERENT family type — one family per name, one type
+         per family
+  MD002  a histogram/window ``observe``/``observe_many`` call with a
+         negative numeric duration literal — durations are measured,
+         never negative; a negative literal is a sign error waiting to
+         skew a latency percentile
+
+Only calls whose first argument is a string literal count as
+registrations, so ``np.histogram(arr, bins=...)`` and dynamic names
+are never false positives. Files that intentionally register
+synthetic names (registry unit tests) opt out with
+``# pdlint: disable=metric_discipline``.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Sequence, Tuple
+
+from .core import Analyzer, Finding, SourceFile
+
+__all__ = ["MetricDisciplineAnalyzer"]
+
+_NAME_PATTERN = re.compile(r"paddle_[a-z0-9_]+")
+_REGISTER_METHODS = ("counter", "gauge", "histogram")
+_OBSERVE_METHODS = ("observe", "observe_many")
+
+
+def _neg_literals(node: ast.AST) -> List[Tuple[float, int, int]]:
+    """Negative numeric literals in an expression (covers the bare
+    ``-5`` argument and ``[-1.0, 2.0]`` inside observe_many lists)."""
+    out = []
+    for n in ast.walk(node):
+        if isinstance(n, ast.UnaryOp) and \
+                isinstance(n.op, ast.USub) and \
+                isinstance(n.operand, ast.Constant) and \
+                isinstance(n.operand.value, (int, float)) and \
+                not isinstance(n.operand.value, bool):
+            out.append((-float(n.operand.value), n.lineno,
+                        n.col_offset))
+    return out
+
+
+class _Reg:
+    __slots__ = ("name", "kind", "path", "line", "col")
+
+    def __init__(self, name, kind, path, line, col):
+        self.name = name
+        self.kind = kind
+        self.path = path
+        self.line = line
+        self.col = col
+
+
+class MetricDisciplineAnalyzer(Analyzer):
+    name = "metric_discipline"
+
+    def run(self, files: Sequence[SourceFile]) -> List[Finding]:
+        regs: List[_Reg] = []
+        findings: List[Finding] = []
+        for sf in files:
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if not isinstance(f, ast.Attribute):
+                    continue
+                if f.attr in _REGISTER_METHODS:
+                    if node.args and \
+                            isinstance(node.args[0], ast.Constant) \
+                            and isinstance(node.args[0].value, str):
+                        regs.append(_Reg(node.args[0].value, f.attr,
+                                         sf.rel, node.lineno,
+                                         node.col_offset))
+                elif f.attr in _OBSERVE_METHODS:
+                    for arg in node.args:
+                        for val, line, col in _neg_literals(arg):
+                            findings.append(Finding(
+                                self.name, "MD002", sf.rel, line, col,
+                                f"{f.attr}() called with negative "
+                                f"duration literal {val} — durations "
+                                f"are measured, never negative",
+                                symbol=f.attr, detail=str(val)))
+
+        first_kind: Dict[str, _Reg] = {}
+        for r in regs:
+            if not _NAME_PATTERN.fullmatch(r.name):
+                findings.append(Finding(
+                    self.name, "MD001", r.path, r.line, r.col,
+                    f"registry metric name {r.name!r} must match "
+                    f"paddle_[a-z0-9_]+ (lowercase, paddle_ prefix)",
+                    symbol=r.name, detail=r.name))
+            prev = first_kind.get(r.name)
+            if prev is None:
+                first_kind[r.name] = r
+            elif prev.kind != r.kind:
+                findings.append(Finding(
+                    self.name, "MD001", r.path, r.line, r.col,
+                    f"metric {r.name!r} registered as {r.kind} here "
+                    f"but as {prev.kind} at {prev.path}:{prev.line} — "
+                    f"one family per name, one type per family",
+                    symbol=r.name,
+                    detail=f"{prev.kind}!={r.kind}"))
+        return findings

@@ -228,6 +228,24 @@ define_flag("FLAGS_profiler_span_metrics", False,
             "paddle_profiler_span_ms histogram so chrome traces and "
             "scraped /metrics agree")
 
+# Distributed request tracing (paddle_tpu.observability.tracing —
+# router->worker->engine spans + the /tracez flight recorder).
+define_flag("FLAGS_trace_sample_rate", 0.0,
+            "head-sampling rate for distributed request traces "
+            "(0 = tracing off, 1 = every request). The decision is "
+            "made once at ingress, deterministically from the trace "
+            "id, and propagated in the traceparent header; errored/"
+            "shed/deadline requests are tail-promoted into the "
+            "recorder regardless of the coin flip")
+define_flag("FLAGS_trace_buffer_spans", 4096,
+            "bound of the in-process span flight recorder (/tracez): "
+            "oldest spans are evicted past this many")
+define_flag("FLAGS_trace_max_spans_per_trace", 256,
+            "per-trace span cap in the flight recorder AND on the "
+            "unsampled pending list, so one long decode stream "
+            "cannot evict every other trace (excess spans are "
+            "counted as dropped)")
+
 # Serving-fleet knobs (paddle_tpu.serving.fleet — router + N replica
 # worker processes with rolling hot weight swap).
 define_flag("FLAGS_serving_ready_requires_warmup", False,

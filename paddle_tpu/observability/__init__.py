@@ -16,6 +16,12 @@ counterpart and the ONE place every subsystem reports into:
   and scripts start with ``start_telemetry_server()``;
 - ``runtime``: JAX compile-event listeners, device-memory gauges, and
   profiler RecordEvent span mirroring;
+- ``tracing``: distributed request tracing — W3C-shaped trace
+  contexts propagated router -> replica worker -> serving engine,
+  typed per-stage spans into a bounded in-process flight recorder
+  (``/tracez``), head sampling (``FLAGS_trace_sample_rate``) with
+  error/shed/deadline tail promotion, latency-histogram exemplars,
+  and a chrome-trace exporter that merges with the profiler's;
 - ``training``: a ``Model.fit`` callback + ``optimizer.step`` hook for
   step time / examples-per-sec / loss (lazy — imported on first
   attribute access so this package stays importable before hapi and
@@ -27,7 +33,7 @@ while keeping its ``snapshot()`` schema byte-compatible.
 """
 from __future__ import annotations
 
-from . import exposition, httpd, registry, runtime  # noqa: F401
+from . import exposition, httpd, registry, runtime, tracing  # noqa: F401
 from .exposition import (  # noqa: F401
     PROMETHEUS_CONTENT_TYPE, json_snapshot, json_text, prometheus_text,
 )
@@ -45,6 +51,12 @@ from .runtime import (  # noqa: F401
     install_device_memory_collector, install_jax_monitoring,
     mirror_profiler_spans,
 )
+from .tracing import (  # noqa: F401
+    Span, SpanBuffer, TraceContext, current_context, default_buffer,
+    export_chrome_trace, new_context, parse_traceparent,
+    record_exemplar, record_span, request_context, start_span,
+    tracez_payload, use_context,
+)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricRegistry",
@@ -58,9 +70,15 @@ __all__ = [
     "readyz",
     "install_jax_monitoring", "install_device_memory_collector",
     "mirror_profiler_spans",
+    "TraceContext", "Span", "SpanBuffer", "new_context",
+    "request_context", "current_context", "use_context",
+    "parse_traceparent", "start_span", "record_span",
+    "default_buffer", "tracez_payload", "export_chrome_trace",
+    "record_exemplar",
     "TrainingTelemetryCallback", "instrument_optimizers",
     "uninstrument_optimizers",
     "registry", "exposition", "httpd", "runtime", "training",
+    "tracing",
 ]
 
 _LAZY = {

@@ -141,6 +141,32 @@ def _statusz() -> dict:
     return out
 
 
+def tracez_text(query: str) -> str:
+    """The ``/tracez`` body: the flight recorder's recent traces as
+    JSON. Query params: ``trace_id=<32hex>`` (one trace),
+    ``min_ms=<float>`` (only traces at least that long),
+    ``limit=<n>`` (newest-first cap, default 100), and
+    ``format=chrome`` for a chrome-trace document of the selected
+    spans instead of the tracez schema. Shared by the telemetry
+    endpoint, replica workers, and the fleet router (which merges
+    replica payloads into its own)."""
+    from urllib.parse import parse_qs
+
+    from . import tracing
+    q = {k: v[-1] for k, v in parse_qs(query).items()}
+    trace_id = q.get("trace_id") or None
+    min_ms = float(q["min_ms"]) if q.get("min_ms") else None
+    limit = int(q.get("limit", 100))
+    payload = tracing.tracez_payload(trace_id=trace_id,
+                                     min_duration_ms=min_ms,
+                                     limit=limit)
+    if q.get("format") == "chrome":
+        spans = [s for t in payload["traces"] for s in t["spans"]]
+        return json.dumps(
+            {"traceEvents": tracing.chrome_trace_events(spans)})
+    return json.dumps(payload, indent=1, sort_keys=True)
+
+
 # ---------------------------------------------------------------- server
 class _Handler(BaseHTTPRequestHandler):
     server_version = "paddle-tpu-telemetry/1.0"
@@ -180,10 +206,12 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, json.dumps(_statusz(), indent=1,
                                            sort_keys=True, default=str),
                            "application/json")
+            elif path == "/tracez":
+                self._send(200, tracez_text(query), "application/json")
             elif path == "/":
                 self._send(200, "paddle-tpu telemetry\n"
                                 "/metrics  /healthz  /readyz  "
-                                "/statusz\n",
+                                "/statusz  /tracez\n",
                            "text/plain; charset=utf-8")
             else:
                 self._send(404, "not found\n",

@@ -40,6 +40,13 @@ Pieces:
   shared compile cache, and rolling hot weight swap; knobs under
   ``FLAGS_fleet_*``.
 
+Requests are traceable end to end: under ``FLAGS_trace_sample_rate``
+(or an ambient ``tracing.use_context``), every pipeline stage emits a
+typed span — queue wait, host assembly, device dispatch, device wait,
+fetch; prefill and per-iteration decode for generation — into the
+``paddle_tpu.observability.tracing`` flight recorder (``/tracez``),
+stitched across router/worker processes by trace id.
+
 Knobs: ``FLAGS_serving_*`` in framework/flags.py.
 """
 from __future__ import annotations

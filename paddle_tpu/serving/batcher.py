@@ -147,6 +147,17 @@ class DynamicBatcher:
                         f"past its deadline"))
                 if self.metrics is not None:
                     self.metrics.count("timed_out")
+                if getattr(r, "trace", None) is not None:
+                    # deadline expiry is a tail event: record the
+                    # queue-wait as an errored span (which promotes an
+                    # unsampled trace into the flight recorder)
+                    from ..observability import tracing
+                    tracing.record_span(
+                        r.trace, "serving::queue", stage="queue",
+                        start_unix_ns=r.t_wall_ns,
+                        duration_ms=r.latency_ms(), status="error",
+                        attrs={"error": "DeadlineExceededError"},
+                        root=True)
                 continue
             keep.append(r)
         if len(keep) != len(self._q):

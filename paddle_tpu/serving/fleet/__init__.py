@@ -27,6 +27,12 @@ package is that fleet:
   families on the PR 3 registry; the router's
   ``/metrics?merged=1`` view re-labels every replica's own scrape
   with ``replica="<id>"``.
+- Distributed request tracing
+  (``paddle_tpu.observability.tracing``): the router mints a
+  per-request trace context at ingress, stamps it onto the wire
+  (codec trace trailer / worker JSON), and its ``/tracez`` stitches
+  router + replica spans into one cross-process trace; ``/statusz``
+  aggregates per-replica readiness/outstanding/restarts/version.
 
 Knobs: ``FLAGS_fleet_*`` + ``FLAGS_serving_ready_requires_warmup``
 in framework/flags.py. Bench: ``tools/bench_fleet.py``.

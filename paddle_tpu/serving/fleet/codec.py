@@ -20,11 +20,17 @@ Layout (all integers little-endian):
             (u32 utf-8 length, message) mapping back to the serving
             exception types, so ``QueueFullError`` raised in a replica
             process is ``QueueFullError`` again out of the router.
+- trace trailer (optional): magic ``PDTC`` appended AFTER a batch's
+            last request — u32 n_requests, per request u16 length +
+            ascii ``traceparent`` (0 = untraced). Append-only, so the
+            router can stamp trace contexts onto an opaque client
+            body without decoding the arrays, and a decoder that
+            ignores it (``decode_batch``) keeps working unchanged.
 """
 from __future__ import annotations
 
 import struct
-from typing import Dict, List, Sequence, Union
+from typing import Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
@@ -32,12 +38,15 @@ from ..request import (DeadlineExceededError, QueueFullError,
                        ServerClosedError)
 
 __all__ = [
-    "encode_batch", "decode_batch", "encode_results", "decode_results",
-    "peek_batch_size", "CodecError", "BATCH_MAGIC", "RESULTS_MAGIC",
+    "encode_batch", "decode_batch", "decode_batch_ex",
+    "encode_results", "decode_results", "peek_batch_size",
+    "attach_trace_trailer", "CodecError",
+    "BATCH_MAGIC", "RESULTS_MAGIC", "TRACE_MAGIC",
 ]
 
 BATCH_MAGIC = b"PDFB"
 RESULTS_MAGIC = b"PDFR"
+TRACE_MAGIC = b"PDTC"
 
 # status codes for per-request results (0 = ok)
 _OK = 0
@@ -135,6 +144,77 @@ def decode_batch(data: bytes) -> List[List[np.ndarray]]:
         raise CodecError("not a fleet batch payload")
     return [[r.array() for _ in range(r.u32())]
             for _ in range(r.u32())]
+
+
+def attach_trace_trailer(
+        data: bytes,
+        traceparents: Sequence[Optional[str]]) -> bytes:
+    """Append per-request ``traceparent`` headers to an ALREADY
+    ENCODED batch (the router's pass-through path never decodes the
+    arrays). A payload that already carries a trailer is returned
+    unchanged — a client that stamped its own trace identities wins
+    over the router's."""
+    n = peek_batch_size(data)
+    if len(traceparents) != n:
+        raise CodecError(
+            f"trace trailer carries {len(traceparents)} entries for "
+            f"a batch of {n} requests")
+    if _has_trailer(data):
+        return data
+    parts: List[bytes] = [data, TRACE_MAGIC, struct.pack("<I", n)]
+    for tp in traceparents:
+        b = (tp or "").encode("ascii", "replace")
+        parts.append(struct.pack("<H", len(b)))
+        parts.append(b)
+    return b"".join(parts)
+
+
+def _has_trailer(data: bytes) -> bool:
+    """Cheap check for an existing trace trailer: the trailer is the
+    last section, so it is detectable from the tail (entry lengths
+    walked backwards would be ambiguous; instead re-scan forward from
+    the last magic occurrence and verify it parses to exactly EOF)."""
+    idx = data.rfind(TRACE_MAGIC)
+    if idx < 8:          # before any possible batch body
+        return False
+    try:
+        r = _Reader(data)
+        r.ofs = idx + 4
+        n = r.u32()
+        for _ in range(n):
+            ln = struct.unpack("<H", r.take(2))[0]
+            r.take(ln)
+        return r.ofs == len(data)
+    except (CodecError, struct.error):
+        return False
+
+
+def decode_batch_ex(
+        data: bytes
+) -> tuple:
+    """``(feeds_list, traceparents)`` — the worker-side decode.
+    ``traceparents`` is None when the payload carries no trailer,
+    else one ``Optional[str]`` per request."""
+    r = _Reader(data)
+    if r.take(4) != BATCH_MAGIC:
+        raise CodecError("not a fleet batch payload")
+    feeds = [[r.array() for _ in range(r.u32())]
+             for _ in range(r.u32())]
+    traceparents = None
+    if r.ofs + 8 <= len(r.data) and \
+            r.data[r.ofs:r.ofs + 4] == TRACE_MAGIC:
+        r.take(4)
+        n = r.u32()
+        if n != len(feeds):
+            raise CodecError(
+                f"trace trailer for {n} requests on a batch of "
+                f"{len(feeds)}")
+        traceparents = []
+        for _ in range(n):
+            ln = struct.unpack("<H", r.take(2))[0]
+            tp = r.take(ln).decode("ascii", "replace") if ln else None
+            traceparents.append(tp)
+    return feeds, traceparents
 
 
 def encode_results(

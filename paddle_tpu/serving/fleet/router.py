@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import concurrent.futures
 import json
+import os
 import threading
 import time
 import urllib.error
@@ -44,6 +45,7 @@ from typing import Dict, List, Mapping, Optional, Sequence
 
 import numpy as np
 
+from ...observability import tracing
 from ..generation.engine import StreamingFuture
 from ..request import QueueFullError, ServerClosedError
 from . import codec
@@ -122,6 +124,10 @@ class FleetRouter:
             request_timeout_s if request_timeout_s is not None
             else _flag("FLAGS_fleet_request_timeout_s", 120.0))
         self.metrics = FleetMetrics(name)
+        # stamp this process's spans as the router's (only when nothing
+        # else named the process — a worker main() names it first)
+        if tracing.process_name().startswith("pid-"):
+            tracing.set_process_name(f"router-{name}")
         self._lock = threading.Lock()
         self._replicas: Dict[object, _Replica] = {}
         self._rr = 0                    # round-robin tie-breaker
@@ -279,12 +285,47 @@ class FleetRouter:
             out = rep.outstanding
         self.metrics.set_outstanding(str(rep.replica_id), out)
 
+    def _traced_forward(self, body: bytes, n_req: int,
+                        timeout_ms: Optional[float],
+                        ctx) -> bytes:
+        """``_forward_batch`` under a ``router::request`` root span
+        (no-op wrapper when untraced). Failure records an errored root
+        span, which tail-promotes an unsampled trace."""
+        if ctx is None:
+            return self._forward_batch(body, n_req, timeout_ms)
+        rctx = ctx.child()
+        t_wall = time.time_ns()
+        t0 = time.perf_counter()
+        attrs = {"router": self.name, "n_req": n_req}
+        try:
+            payload = self._forward_batch(body, n_req, timeout_ms,
+                                          ctx=rctx)
+        except BaseException as e:
+            tracing.record_span(
+                rctx, "router::request", stage="router",
+                start_unix_ns=t_wall,
+                duration_ms=(time.perf_counter() - t0) * 1e3,
+                status="error",
+                attrs=dict(attrs,
+                           error=f"{type(e).__name__}: {e}"),
+                root=True)
+            raise
+        tracing.record_span(
+            rctx, "router::request", stage="router",
+            start_unix_ns=t_wall,
+            duration_ms=(time.perf_counter() - t0) * 1e3,
+            attrs=attrs, root=True)
+        return payload
+
     def _forward_batch(self, body: bytes, n_req: int,
-                       timeout_ms: Optional[float]) -> bytes:
+                       timeout_ms: Optional[float],
+                       ctx=None) -> bytes:
         """Send one encoded batch to the best replica, with the
         shed/unavailable retry policy. Returns the raw results
         payload (the HTTP front-end passes it through untouched; the
-        Python API decodes it)."""
+        Python API decodes it). With ``ctx``, every attempt gets a
+        ``router::forward`` span and the batch is stamped with a
+        trace trailer so the replica joins the trace."""
         self.metrics.count("routed", n_req)
         suffix = f"/submit_many?timeout_ms={timeout_ms}" \
             if timeout_ms else "/submit_many"
@@ -302,15 +343,24 @@ class FleetRouter:
                     "no ready replica (fleet cold, draining, or "
                     "down)")
             self._acquire(rep, n_req)
+            fctx = ctx.child() if ctx is not None else None
+            send_body = codec.attach_trace_trailer(
+                body, [fctx.to_traceparent()] * n_req) \
+                if fctx is not None else body
+            span_status, span_err = "ok", None
+            t_wall = time.time_ns()
             t0 = time.perf_counter()
             try:
-                with self._http(rep.url + suffix, data=body,
+                with self._http(rep.url + suffix, data=send_body,
                                 ctype="application/x-paddle-fleet"
                                 ) as resp:
                     payload = resp.read()
-                self.metrics.observe_latency(
-                    (time.perf_counter() - t0) * 1e3)
+                ms = (time.perf_counter() - t0) * 1e3
+                self.metrics.observe_latency(ms)
                 self.metrics.count("completed", n_req)
+                if ctx is not None:
+                    tracing.record_exemplar("paddle_fleet_request_ms",
+                                            ms, ctx.trace_id)
                 return payload
             except urllib.error.HTTPError as e:
                 e.read()
@@ -323,9 +373,11 @@ class FleetRouter:
                     reason = "unavailable"
                 else:
                     self.metrics.count("failed", n_req)
+                    span_status, span_err = "error", f"HTTP {e.code}"
                     raise ReplicaError(
                         f"replica {rep.replica_id} returned HTTP "
                         f"{e.code}")
+                span_status, span_err = "error", reason
             except (ConnectionRefusedError, urllib.error.URLError,
                     ConnectionResetError, TimeoutError) as e:
                 # Refused before the request was read: nothing
@@ -337,6 +389,8 @@ class FleetRouter:
                 with self._lock:
                     rep.alive = refused and rep.alive
                     rep.ready = False
+                span_status = "error"
+                span_err = f"{type(e).__name__}: {e}"
                 if not refused:
                     self.metrics.count("failed", n_req)
                     raise ReplicaError(
@@ -345,6 +399,16 @@ class FleetRouter:
                 reason = "unavailable"
             finally:
                 self._release(rep, n_req)
+                if fctx is not None:
+                    f_attrs = {"replica": str(rep.replica_id),
+                               "attempt": attempts}
+                    if span_err:
+                        f_attrs["error"] = span_err
+                    tracing.record_span(
+                        fctx, "router::forward", stage="forward",
+                        start_unix_ns=t_wall,
+                        duration_ms=(time.perf_counter() - t0) * 1e3,
+                        status=span_status, attrs=f_attrs, root=True)
             tried.add(rep.replica_id)
             attempts += 1
             if attempts > self.retries:
@@ -381,11 +445,16 @@ class FleetRouter:
                         else [np.asarray(f)])
         body = codec.encode_batch(norm)
         futs = [concurrent.futures.Future() for _ in norm]
+        # trace identity is captured on the CALLER's thread (ambient
+        # context or a fresh sampled one); the whole batch rides one
+        # trace — the single-request submit() case is the 1:1 trace
+        # the /tracez recipe documents
+        ctx = tracing.request_context()
 
         def _run():
             try:
-                payload = self._forward_batch(body, len(norm),
-                                              timeout_ms)
+                payload = self._traced_forward(body, len(norm),
+                                               timeout_ms, ctx)
                 results = codec.decode_results(payload)
                 if len(results) != len(futs):
                     raise ReplicaError(
@@ -417,14 +486,41 @@ class FleetRouter:
         if self._closed:
             raise ServerClosedError("router is shut down")
         fut = StreamingFuture()
-        body = json.dumps({
+        ctx = tracing.request_context()
+        gctx = ctx.child() if ctx is not None else None
+        req = {
             "prompt": [int(t) for t in np.asarray(prompt).ravel()],
             "max_new_tokens": int(max_new_tokens),
             "temperature": float(temperature),
-            "timeout_ms": timeout_ms, "seed": seed}).encode()
+            "timeout_ms": timeout_ms, "seed": seed}
+        if gctx is not None:
+            req["traceparent"] = gctx.to_traceparent()
+        body = json.dumps(req).encode()
         self.metrics.count("routed")
-        self._pool.submit(self._run_generate, body, fut)
+        self._pool.submit(self._run_generate_traced, body, fut, gctx)
         return fut
+
+    def _run_generate_traced(self, body: bytes, fut: StreamingFuture,
+                             gctx=None):
+        """``_run_generate`` under a ``router::generate`` root span
+        whose status mirrors the stream's outcome."""
+        if gctx is None:
+            return self._run_generate(body, fut)
+        t_wall = time.time_ns()
+        t0 = time.perf_counter()
+        self._run_generate(body, fut)
+        exc = fut.exception()
+        reason = fut.finish_reason
+        attrs = {"router": self.name,
+                 "finish_reason": reason or ""}
+        if exc is not None:
+            attrs["error"] = f"{type(exc).__name__}: {exc}"
+        tracing.record_span(
+            gctx, "router::generate", stage="router",
+            start_unix_ns=t_wall,
+            duration_ms=(time.perf_counter() - t0) * 1e3,
+            status="error" if exc is not None else "ok",
+            attrs=attrs, root=True)
 
     def _run_generate(self, body: bytes, fut: StreamingFuture):
         tried: set = set()
@@ -617,6 +713,58 @@ class FleetRouter:
         return merge_prometheus_texts(
             texts, own=prometheus_text(default_registry()))
 
+    def merged_tracez(self, trace_id: Optional[str] = None,
+                      min_duration_ms: Optional[float] = None,
+                      limit: int = 100) -> dict:
+        """Fleet-wide ``/tracez``: this process's flight recorder plus
+        every live replica's, stitched by trace id — the router span,
+        the worker span, and the engine's queue/assembly/dispatch/
+        device/fetch children come back as ONE trace."""
+        remote: List[dict] = []
+        with self._lock:
+            reps = [(str(r.replica_id), r.url)
+                    for r in self._replicas.values() if r.alive]
+        q = f"?limit={int(limit)}"
+        if trace_id:
+            q += f"&trace_id={trace_id}"
+        for rid, url in reps:
+            try:
+                with self._http(url + "/tracez" + q,
+                                timeout=5.0) as resp:
+                    doc = json.loads(resp.read())
+                for t in doc.get("traces", []):
+                    remote.extend(t.get("spans", []))
+            except Exception:  # noqa: BLE001 - a scrape-dead replica
+                pass           # drops out of the merged view
+        return tracing.tracez_payload(
+            trace_id=trace_id, min_duration_ms=min_duration_ms,
+            limit=limit, extra_spans=remote)
+
+    def statusz(self) -> dict:
+        """Fleet status page: per-replica id/readiness/outstanding/
+        version (+ restart counts when a supervisor is attached) and
+        the router's own counters — the single-server ``/statusz``
+        parity view for a fleet."""
+        replicas = self.replica_states()
+        restarts = {}
+        if self.supervisor is not None:
+            try:
+                restarts = {str(k): v for k, v in
+                            self.supervisor.restart_counts().items()}
+            except Exception:  # noqa: BLE001 - status must not 500 on
+                pass           # a half-stopped supervisor
+        for r in replicas:
+            r["restarts"] = restarts.get(r["replica"], 0)
+        return {
+            "router": self.name,
+            "pid": os.getpid(),
+            "replicas": replicas,
+            "ready_replicas": sum(1 for r in replicas
+                                  if r["ready"] and not r["draining"]),
+            "restarts_total": sum(restarts.values()),
+            "metrics": self.metrics_snapshot(),
+        }
+
     # ------------------------------------------------------ lifecycle
     def shutdown(self):
         self._closed = True
@@ -689,10 +837,24 @@ class _RouterHandler(BaseHTTPRequestHandler):
                      "ready_replicas": n}).encode())
             elif path == "/statusz":
                 self._send(200, json.dumps(
-                    {"router": self._router.name,
-                     "replicas": self._router.replica_states(),
-                     "metrics": self._router.metrics_snapshot()},
+                    self._router.statusz(),
                     sort_keys=True, default=str).encode())
+            elif path == "/tracez":
+                from urllib.parse import parse_qs
+                q = {k: v[-1] for k, v in parse_qs(query).items()}
+                doc = self._router.merged_tracez(
+                    trace_id=q.get("trace_id") or None,
+                    min_duration_ms=float(q["min_ms"])
+                    if q.get("min_ms") else None,
+                    limit=int(q.get("limit", 100)))
+                if q.get("format") == "chrome":
+                    from ...observability import tracing as _tracing
+                    spans = [s for t in doc["traces"]
+                             for s in t["spans"]]
+                    doc = {"traceEvents":
+                           _tracing.chrome_trace_events(spans)}
+                self._send(200, json.dumps(doc, sort_keys=True,
+                                           default=str).encode())
             else:
                 self._send(404, b"not found\n", "text/plain")
         except Exception as e:  # noqa: BLE001 - handler fault barrier
@@ -713,8 +875,13 @@ class _RouterHandler(BaseHTTPRequestHandler):
                         timeout_ms = \
                             float(part.split("=", 1)[1]) or None
                 n_req = codec.peek_batch_size(body)
-                payload = self._router._forward_batch(
-                    body, n_req, timeout_ms)
+                # external ingress: honor the caller's traceparent
+                # header, else make the head-sampling decision here
+                ctx = tracing.parse_traceparent(
+                    self.headers.get("traceparent")) or \
+                    tracing.request_context()
+                payload = self._router._traced_forward(
+                    body, n_req, timeout_ms, ctx)
                 self._send(200, payload, "application/x-paddle-fleet")
             elif path == "/generate":
                 self._generate(body)
@@ -735,12 +902,16 @@ class _RouterHandler(BaseHTTPRequestHandler):
 
     def _generate(self, body: bytes):
         req = json.loads(body or b"{}")
-        fut = self._router.submit_generate(
-            req["prompt"],
-            max_new_tokens=int(req.get("max_new_tokens", 32)),
-            temperature=float(req.get("temperature", 0.0)),
-            timeout_ms=req.get("timeout_ms"),
-            seed=req.get("seed"))
+        ctx = tracing.parse_traceparent(
+            req.get("traceparent")
+            or self.headers.get("traceparent"))
+        with tracing.use_context(ctx):
+            fut = self._router.submit_generate(
+                req["prompt"],
+                max_new_tokens=int(req.get("max_new_tokens", 32)),
+                temperature=float(req.get("temperature", 0.0)),
+                timeout_ms=req.get("timeout_ms"),
+                seed=req.get("seed"))
         self.send_response(200)
         self.send_header("Content-Type", "application/x-ndjson")
         self.end_headers()

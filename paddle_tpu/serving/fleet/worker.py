@@ -23,7 +23,8 @@ Control plane (JSON):
 
 - ``GET /healthz`` (liveness) / ``GET /readyz`` (readiness = warmup
   complete) / ``GET /metrics`` (this process's registry, Prometheus
-  text) / ``GET /statusz``
+  text) / ``GET /statusz`` / ``GET /tracez`` (this process's span
+  flight recorder; the router's merged ``/tracez`` fans out to it)
 - ``POST /reload`` — hot weight swap: load the version-stamped
   artifact named in the body, warm the replacement server from the
   shared compile cache + manifest, atomically swap it in, drain the
@@ -46,6 +47,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ...observability import tracing
 from ..request import QueueFullError, ServerClosedError
 from . import codec
 
@@ -139,9 +141,11 @@ class PredictorBackend:
         return srv, version
 
     # ---- service surface ----
-    def submit_many(self, feeds_list, timeout_ms=None):
+    def submit_many(self, feeds_list, timeout_ms=None,
+                    trace_contexts=None):
         return self._server.submit_many(feeds_list,
-                                        timeout_ms=timeout_ms)
+                                        timeout_ms=timeout_ms,
+                                        trace_contexts=trace_contexts)
 
     def generate(self, prompt, max_new_tokens, temperature, timeout_ms,
                  seed):
@@ -286,7 +290,8 @@ class StubBackend:
                         os._exit(17)
                     raise _ConnectionDrop("stub crash trigger")
 
-    def submit_many(self, feeds_list, timeout_ms=None):
+    def submit_many(self, feeds_list, timeout_ms=None,
+                    trace_contexts=None):
         import concurrent.futures
         n = len(feeds_list)
         with self._lock:
@@ -405,9 +410,15 @@ class _ReplicaHandler(BaseHTTPRequestHandler):
 
     # ---- control plane ----
     def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler ABI
-        path = self.path.partition("?")[0]
+        path, _, query = self.path.partition("?")
         try:
-            if path == "/healthz":
+            if path == "/tracez":
+                # this process's flight recorder — the router's merged
+                # /tracez fans out to every replica's
+                from ...observability.httpd import tracez_text
+                self._send(200, tracez_text(query).encode(),
+                           "application/json")
+            elif path == "/healthz":
                 ok, info = self._backend.health()
                 self._send_json(200 if ok else 503,
                                 {"ok": ok, "info": info})
@@ -477,9 +488,38 @@ class _ReplicaHandler(BaseHTTPRequestHandler):
         for part in query.split("&"):
             if part.startswith("timeout_ms="):
                 timeout_ms = float(part.split("=", 1)[1]) or None
-        feeds_list = codec.decode_batch(self._body())
-        futs = self._backend.submit_many(feeds_list,
-                                         timeout_ms=timeout_ms)
+        feeds_list, traceparents = codec.decode_batch_ex(self._body())
+        ctxs = [tracing.parse_traceparent(tp) if tp else None
+                for tp in (traceparents or [])] or None
+        lead = next((c for c in (ctxs or []) if c is not None), None)
+        if lead is None:
+            futs = self._backend.submit_many(feeds_list,
+                                             timeout_ms=timeout_ms)
+            results = self._collect(futs)
+        else:
+            # one worker-side span per handled batch; requests in the
+            # same trace re-parent under it so the stitched view shows
+            # router -> worker -> engine stages
+            with tracing.start_span(
+                    "worker::submit_many", stage="worker", ctx=lead,
+                    attrs={"n_req": len(feeds_list),
+                           "replica": self._backend.info().get(
+                               "name") or self._backend.info().get(
+                               "version", "")}) as sp:
+                ctxs = [sp.ctx if (c is not None and
+                                   c.trace_id == sp.ctx.trace_id)
+                        else c for c in ctxs]
+                futs = self._backend.submit_many(
+                    feeds_list, timeout_ms=timeout_ms,
+                    trace_contexts=ctxs)
+                results = self._collect(futs)
+                if any(isinstance(res, BaseException)
+                       for res in results):
+                    sp.set_attr("partial_failure", True)
+        self._send(200, codec.encode_results(results),
+                   "application/x-paddle-fleet")
+
+    def _collect(self, futs):
         results = []
         for f in futs:
             try:
@@ -487,16 +527,19 @@ class _ReplicaHandler(BaseHTTPRequestHandler):
                                         .request_timeout_s))
             except BaseException as e:  # noqa: BLE001 - per-request
                 results.append(e)       # failures ride the framing
-        self._send(200, codec.encode_results(results),
-                   "application/x-paddle-fleet")
+        return results
 
     def _generate(self):
         req = json.loads(self._body() or b"{}")
-        fut = self._backend.generate(
-            np.asarray(req["prompt"], np.int64),
-            int(req.get("max_new_tokens", 32)),
-            float(req.get("temperature", 0.0)),
-            req.get("timeout_ms"), req.get("seed"))
+        # ambient context for the submit: GenerationServer captures it
+        # into the request, so decode spans land in the caller's trace
+        ctx = tracing.parse_traceparent(req.get("traceparent"))
+        with tracing.use_context(ctx):
+            fut = self._backend.generate(
+                np.asarray(req["prompt"], np.int64),
+                int(req.get("max_new_tokens", 32)),
+                float(req.get("temperature", 0.0)),
+                req.get("timeout_ms"), req.get("seed"))
         # close-delimited stream: one JSON line per token event, then
         # the terminal line with the finish reason
         self.send_response(200)
@@ -698,6 +741,7 @@ def main(argv=None) -> int:
     import signal
 
     args = _parse_args(argv)
+    tracing.set_process_name(args.name or f"replica-{os.getpid()}")
     backend = _build_backend(args)
     app = ReplicaApp(backend, host=args.host,
                      port=args.port).start()

@@ -39,6 +39,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ...observability import tracing
 from ..bucketing import ShapeBucketPolicy
 from ..request import (DeadlineExceededError, QueueFullError,
                        ServerClosedError)
@@ -162,11 +163,11 @@ class StreamingFuture:
 
 class _Request:
     __slots__ = ("prompt", "max_new", "temperature", "rng", "future",
-                 "submit_t", "deadline")
+                 "submit_t", "deadline", "trace", "t_wall_ns")
 
     def __init__(self, prompt: np.ndarray, max_new: int,
                  temperature: float, seed: Optional[int],
-                 timeout_ms: Optional[float]):
+                 timeout_ms: Optional[float], trace=None):
         self.prompt = prompt
         self.max_new = int(max_new)
         self.temperature = float(temperature)
@@ -175,6 +176,11 @@ class _Request:
         self.submit_t = time.monotonic()
         self.deadline = (self.submit_t + timeout_ms / 1e3
                          if timeout_ms else None)
+        # trace identity (tracing.TraceContext child whose span id is
+        # the generate::request root span); warmup never builds a
+        # _Request, so warmup traffic is structurally untraced
+        self.trace = trace
+        self.t_wall_ns = time.time_ns() if trace is not None else 0
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now > self.deadline
@@ -560,14 +566,23 @@ class GenerationServer:
                 f"generate within max_seq_len={self.max_seq_len}")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        ctx = tracing.request_context()
         req = _Request(prompt, max_new_tokens, temperature, seed,
                        timeout_ms if timeout_ms is not None
-                       else self.default_timeout_ms)
+                       else self.default_timeout_ms,
+                       trace=ctx.child() if ctx is not None else None)
         with self._lock:
             if self._closed:
                 raise ServerClosedError("engine is shut down")
             if len(self._queue) >= self.queue_capacity:
                 self.metrics.count("rejected")
+                if req.trace is not None:
+                    tracing.record_span(
+                        req.trace, "generate::shed", stage="shed",
+                        start_unix_ns=req.t_wall_ns, duration_ms=0.0,
+                        status="error",
+                        attrs={"server": self.metrics.name,
+                               "error": "QueueFullError"}, root=True)
                 raise QueueFullError(
                     f"generation queue at capacity "
                     f"({self.queue_capacity})")
@@ -706,6 +721,8 @@ class GenerationServer:
             if seq is not None:
                 seq.req.future._fail(err, reason="shutdown")
                 self._release(seq, "failed")
+                self._trace_finish([seq], "error",
+                                   error="ServerClosedError")
 
     # ---- admission + prefill ----
     def _admit_and_prefill(self):
@@ -721,6 +738,16 @@ class GenerationServer:
                         DeadlineExceededError(
                             "deadline passed before the request could "
                             "be scheduled"), reason="timed_out")
+                    if req.trace is not None:
+                        tracing.record_span(
+                            req.trace, "generate::queue",
+                            stage="queue",
+                            start_unix_ns=req.t_wall_ns,
+                            duration_ms=(now - req.submit_t) * 1e3,
+                            status="error",
+                            attrs={"server": self.metrics.name,
+                                   "error": "DeadlineExceededError"},
+                            root=True)
                 else:
                     live.append(req)
             self._queue = live
@@ -745,6 +772,17 @@ class GenerationServer:
                                           self.kv.free_pages)
         if not admitted:
             return
+        t_adm = time.time_ns()
+        for seq in admitted:
+            if seq.req.trace is not None:
+                tracing.record_span(
+                    seq.req.trace, "generate::queue", stage="queue",
+                    start_unix_ns=seq.req.t_wall_ns,
+                    duration_ms=max(
+                        0.0, (t_adm - seq.req.t_wall_ns) / 1e6),
+                    attrs={"server": self.metrics.name,
+                           "slot": seq.slot,
+                           "pages": len(seq.pages)})
         # prefill OUTSIDE the lock, grouped by prompt seq bucket
         groups: Dict[int, List[_ActiveSeq]] = {}
         for seq in admitted:
@@ -765,6 +803,7 @@ class GenerationServer:
             ids[i, :len(p)] = p
             lens[i] = len(p)
             tables[i] = self._tables[seq.slot]
+        t_wall = time.time_ns()
         t0 = time.perf_counter()
         try:
             last, k2, v2, fresh = self.decoder.prefill(
@@ -776,10 +815,21 @@ class GenerationServer:
                 for seq in seqs:
                     seq.req.future._fail(e)
                     self._release(seq, "failed")
+            self._trace_finish(seqs, "error",
+                               error=f"{type(e).__name__}: {e}")
             return
         self.kv.k, self.kv.v = k2, v2
         ms = (time.perf_counter() - t0) * 1e3
         self.metrics.observe_step("prefill", ms)
+        for seq in seqs:
+            if seq.req.trace is not None:
+                tracing.record_span(
+                    seq.req.trace, "generate::prefill",
+                    stage="prefill", start_unix_ns=t_wall,
+                    duration_ms=ms,
+                    attrs={"server": self.metrics.name,
+                           "rows": rows, "seq_bucket": seq_bucket,
+                           "compile_miss": bool(fresh)})
         self._note_dispatch("generate_prefill", fresh, [
             (ids.shape, "int64"), (lens.shape, "int32"),
             (tables.shape, "int32")])
@@ -796,6 +846,7 @@ class GenerationServer:
             positions[seq.slot] = seq.ctx
             mask[seq.slot] = True
             ctx_after[seq.slot] = seq.ctx + 1
+        t_wall = time.time_ns()
         t0 = time.perf_counter()
         try:
             logits, k2, v2, fresh = self.decoder.decode(
@@ -808,12 +859,25 @@ class GenerationServer:
                 for seq in active:
                     seq.req.future._fail(e)
                     self._release(seq, "failed")
+            self._trace_finish(active, "error",
+                               error=f"{type(e).__name__}: {e}")
             return
         self.kv.k, self.kv.v = k2, v2
         ms = (time.perf_counter() - t0) * 1e3
         self._steps += 1
         self.metrics.observe_step("decode", ms)
         self.metrics.observe_occupancy(len(active))
+        for seq in active:
+            if seq.req.trace is not None:
+                # per-iteration span; long streams are bounded by the
+                # flight recorder's per-trace cap, not here
+                tracing.record_span(
+                    seq.req.trace, "generate::decode_step",
+                    stage="decode_step", start_unix_ns=t_wall,
+                    duration_ms=ms,
+                    attrs={"server": self.metrics.name,
+                           "step": seq.n_generated,
+                           "occupancy": len(active)})
         self._note_dispatch("generate_decode", fresh, [
             ((self.max_batch,), "int64"), ((self.max_batch,), "int32"),
             ((self.max_batch,), "bool"), ((self.max_batch,), "int32"),
@@ -843,18 +907,48 @@ class GenerationServer:
                 if seq.req.future._cancel_requested:
                     seq.req.future._finish("cancelled")
                     self._release(seq, "cancelled")
+                    self._trace_finish([seq], "ok",
+                                       finish_reason="cancelled")
                 elif self.eos_token_id is not None and \
                         int(tok) == self.eos_token_id:
                     seq.req.future._finish("eos")
                     self._release(seq, "completed")
+                    self._trace_finish([seq], "ok",
+                                       finish_reason="eos")
                 elif seq.n_generated >= seq.req.max_new or \
                         seq.ctx + 1 > seq.max_total:
                     # ctx + 1: emitting one more token would need a
                     # cache slot past this sequence's reservation
                     seq.req.future._finish("length")
                     self._release(seq, "completed")
+                    self._trace_finish([seq], "ok",
+                                       finish_reason="length")
         if inter:
             self.metrics.observe_inter_token(inter)
+
+    def _trace_finish(self, seqs: List[_ActiveSeq], status: str,
+                      finish_reason: Optional[str] = None,
+                      error: Optional[str] = None):
+        """Record each traced sequence's ``generate::request`` root
+        span (the whole-stream envelope). Error status tail-promotes
+        unsampled traces."""
+        now = time.time_ns()
+        for seq in seqs:
+            r = seq.req
+            if r.trace is None:
+                continue
+            attrs = {"server": self.metrics.name,
+                     "prompt_tokens": len(r.prompt),
+                     "tokens": seq.n_generated}
+            if finish_reason:
+                attrs["finish_reason"] = finish_reason
+            if error:
+                attrs["error"] = error
+            tracing.record_span(
+                r.trace, "generate::request", stage="request",
+                start_unix_ns=r.t_wall_ns,
+                duration_ms=max(0.0, (now - r.t_wall_ns) / 1e6),
+                status=status, attrs=attrs, root=True)
 
     def _release(self, seq: _ActiveSeq, event: str):
         """Evict one sequence: pages back to the pool, slot freed

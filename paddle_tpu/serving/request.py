@@ -38,14 +38,20 @@ class ServerClosedError(RuntimeError):
 class Request:
     """One inference request: per-feed arrays + the future resolved with
     the per-request output list (outputs unpadded back to the request's
-    own rows / sequence lengths)."""
+    own rows / sequence lengths).
+
+    ``trace`` (a ``tracing.TraceContext`` or None) marks the request as
+    traced: the server emits queue/assembly/dispatch/device_wait/fetch
+    spans into its trace. Warmup requests construct Request directly
+    and never carry one — warmup is structurally excluded from the
+    flight recorder, like it is from traffic metrics."""
 
     __slots__ = ("feeds", "rows", "future", "submit_t", "deadline",
-                 "signature", "orig_seq")
+                 "signature", "orig_seq", "trace", "t_wall_ns")
 
     def __init__(self, feeds: List[np.ndarray], rows: int,
                  signature: Tuple, orig_seq: Optional[List[int]] = None,
-                 timeout_ms: Optional[float] = None):
+                 timeout_ms: Optional[float] = None, trace=None):
         self.feeds = feeds
         self.rows = rows
         self.signature = signature
@@ -54,6 +60,8 @@ class Request:
         self.submit_t = time.monotonic()
         self.deadline = (self.submit_t + timeout_ms / 1e3
                          if timeout_ms else None)
+        self.trace = trace
+        self.t_wall_ns = time.time_ns() if trace is not None else 0
 
     def expired(self, now: Optional[float] = None) -> bool:
         return self.deadline is not None and \

@@ -38,6 +38,7 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+from ..observability import tracing
 from . import metrics as metrics_mod
 from .batcher import DynamicBatcher
 from .bucketing import BucketSpec, ShapeBucketPolicy
@@ -93,14 +94,18 @@ class _StagingPool:
 
 
 class _Inflight:
-    """A dispatched-but-unfetched batch riding the completion queue."""
+    """A dispatched-but-unfetched batch riding the completion queue.
+    ``traced`` holds the batch's trace-carrying requests (usually
+    empty) so the completion stage knows to emit device_wait/fetch
+    spans into their traces."""
 
     __slots__ = ("batch", "pending", "rows", "padded_rows",
                  "assembly_ms", "dispatch_ms", "record_latency",
-                 "record_traffic")
+                 "record_traffic", "traced")
 
     def __init__(self, batch, pending, rows, padded_rows, assembly_ms,
-                 dispatch_ms, record_latency, record_traffic):
+                 dispatch_ms, record_latency, record_traffic,
+                 traced=()):
         self.batch = batch
         self.pending = pending
         self.rows = rows
@@ -109,6 +114,7 @@ class _Inflight:
         self.dispatch_ms = dispatch_ms
         self.record_latency = record_latency
         self.record_traffic = record_traffic
+        self.traced = traced
 
 
 class InferenceServer:
@@ -369,17 +375,33 @@ class InferenceServer:
         ServerClosedError after shutdown."""
         if self._closed:
             raise ServerClosedError("server is shut down")
-        req = self._make_request(feed, timeout_ms)
+        req = self._make_request(feed, timeout_ms,
+                                 trace=tracing.request_context())
         self.metrics.count("submitted")
         try:
             self._batcher.put(req)
         except QueueFullError:
             self.metrics.count("rejected")
+            self._trace_shed([req])
             raise
         return req.future
 
+    def _trace_shed(self, reqs: Sequence[Request]):
+        """Tail-promote shed requests: a QueueFullError is exactly the
+        kind of tail event an unsampled trace must still record."""
+        now = time.time_ns()
+        for r in reqs:
+            if r.trace is not None:
+                tracing.record_span(
+                    r.trace, "serving::shed", stage="shed",
+                    start_unix_ns=now, duration_ms=0.0,
+                    status="error",
+                    attrs={"server": self.metrics.name,
+                           "error": "QueueFullError"}, root=True)
+
     def _make_request(self, feed: FeedLike,
-                      timeout_ms: Optional[float]) -> Request:
+                      timeout_ms: Optional[float],
+                      trace=None) -> Request:
         arrs = self._normalize(feed)
         rows = int(arrs[0].shape[0]) if arrs[0].ndim else 1
         if rows > self.max_batch_size:
@@ -392,26 +414,43 @@ class InferenceServer:
             orig_seq = [int(a.shape[ax]) if a.ndim > ax else -1
                         for a in arrs]
             arrs = self.policy.pad_request_seq(arrs)
+        # the request's trace context gets a child identity: that
+        # child's span id IS the serving::request span, and the stage
+        # spans (queue/assembly/...) parent under it
         return Request(arrs, rows, self.policy.signature(arrs),
                        orig_seq=orig_seq,
                        timeout_ms=timeout_ms if timeout_ms is not None
-                       else self.default_timeout_ms)
+                       else self.default_timeout_ms,
+                       trace=trace.child() if trace is not None
+                       else None)
 
     def submit_many(self, feeds: Sequence[FeedLike],
-                    timeout_ms: Optional[float] = None):
+                    timeout_ms: Optional[float] = None,
+                    trace_contexts: Optional[Sequence] = None):
         """Bulk ``submit``: requests are validated up front and
         enqueued with ONE batcher lock acquisition / metrics update —
         the per-request lock+notify+stat cost of a submit loop is real
         at high ingest rates. All-or-nothing on capacity: raises
-        QueueFullError without enqueueing any of the batch."""
+        QueueFullError without enqueueing any of the batch.
+        ``trace_contexts`` (one per feed, None entries allowed) carries
+        propagated trace identities — the fleet worker's path; without
+        it each request picks up the ambient/sampled context like
+        ``submit``."""
         if self._closed:
             raise ServerClosedError("server is shut down")
-        reqs = [self._make_request(f, timeout_ms) for f in feeds]
+        if trace_contexts is None:
+            reqs = [self._make_request(f, timeout_ms,
+                                       trace=tracing.request_context())
+                    for f in feeds]
+        else:
+            reqs = [self._make_request(f, timeout_ms, trace=ctx)
+                    for f, ctx in zip(feeds, trace_contexts)]
         self.metrics.count("submitted", len(reqs))
         try:
             self._batcher.put_many(reqs)
         except QueueFullError:
             self.metrics.count("rejected", len(reqs))
+            self._trace_shed(reqs)
             raise
         return [r.future for r in reqs]
 
@@ -593,6 +632,12 @@ class InferenceServer:
             # fetch_many's slices line up; its outputs are discarded
             rows_list.append(n_pad)
         span_args = {"rows": rows, "padded": padded_rows}
+        # request tracing: warmup batches (record_traffic=False) carry
+        # no trace contexts by construction, so the flight recorder
+        # only ever sees real traffic
+        traced = [r for r in batch if r.trace is not None] \
+            if record_traffic else []
+        t_wall = time.time_ns() if traced else 0
         t0 = time.perf_counter()
         try:
             with RecordEvent("serving::assemble", args=span_args):
@@ -609,11 +654,47 @@ class InferenceServer:
                 if r.future.set_running_or_notify_cancel():
                     r.future.set_exception(e)
                 self.metrics.count("failed")
+            self._trace_failed(traced, "dispatch", e)
             return None, int(miss)
         t2 = time.perf_counter()
+        assembly_ms = (t1 - t0) * 1e3
+        dispatch_ms = (t2 - t1) * 1e3
+        for r in traced:
+            # queue wait = submit to batch formation; the stage spans
+            # reuse the batch's measured intervals anchored on the
+            # wall clock so cross-process stitching lines up
+            attrs = dict(span_args, server=self.metrics.name)
+            tracing.record_span(
+                r.trace, "serving::queue", stage="queue",
+                start_unix_ns=r.t_wall_ns,
+                duration_ms=max(0.0, (t_wall - r.t_wall_ns) / 1e6),
+                attrs=attrs)
+            tracing.record_span(
+                r.trace, "serving::assembly", stage="assembly",
+                start_unix_ns=t_wall, duration_ms=assembly_ms,
+                attrs=attrs)
+            tracing.record_span(
+                r.trace, "serving::dispatch", stage="dispatch",
+                start_unix_ns=t_wall + int(assembly_ms * 1e6),
+                duration_ms=dispatch_ms,
+                attrs=dict(attrs, compile_miss=bool(miss)))
         return _Inflight(batch, pending, rows, padded_rows,
-                         (t1 - t0) * 1e3, (t2 - t1) * 1e3,
-                         record_latency, record_traffic), int(miss)
+                         assembly_ms, dispatch_ms,
+                         record_latency, record_traffic,
+                         traced=traced), int(miss)
+
+    def _trace_failed(self, traced, stage: str, exc: BaseException):
+        """Error spans + tail promotion for a failed batch's traced
+        requests (the fault-barrier counterpart of the happy-path
+        stage spans)."""
+        now = time.time_ns()
+        for r in traced:
+            tracing.record_span(
+                r.trace, f"serving::{stage}", stage=stage,
+                start_unix_ns=now, duration_ms=0.0, status="error",
+                attrs={"server": self.metrics.name,
+                       "error": f"{type(exc).__name__}: {exc}"},
+                root=True)
 
     # ---- stage 3: completion (block, fetch, unpad, resolve) ----
     def _complete(self, inf: _Inflight):
@@ -623,6 +704,7 @@ class InferenceServer:
         span = RecordEvent("serving::complete",
                            args={"rows": inf.rows,
                                  "padded": inf.padded_rows})
+        t_wall = time.time_ns() if inf.traced else 0
         try:
             with span:
                 t0 = time.perf_counter()
@@ -640,7 +722,19 @@ class InferenceServer:
                 if r.future.set_running_or_notify_cancel():
                     r.future.set_exception(e)
                 self.metrics.count("failed")
+            self._trace_failed(inf.traced, "fetch", e)
             return
+        for r in inf.traced:
+            attrs = {"rows": inf.rows, "padded": inf.padded_rows,
+                     "server": self.metrics.name}
+            tracing.record_span(
+                r.trace, "serving::device_wait", stage="device_wait",
+                start_unix_ns=t_wall, duration_ms=(t1 - t0) * 1e3,
+                attrs=attrs)
+            tracing.record_span(
+                r.trace, "serving::fetch", stage="fetch",
+                start_unix_ns=t_wall + int((t1 - t0) * 1e9),
+                duration_ms=(t2 - t1) * 1e3, attrs=attrs)
         completed = 0
         latencies = []
         for r, outs in zip(batch, results):   # padding slice (if any)
@@ -655,6 +749,15 @@ class InferenceServer:
             completed += 1
             if inf.record_latency:
                 latencies.append(r.latency_ms())
+            if r.trace is not None:
+                lat = r.latency_ms()
+                tracing.record_span(
+                    r.trace, "serving::request", stage="request",
+                    start_unix_ns=r.t_wall_ns, duration_ms=lat,
+                    attrs={"rows": r.rows,
+                           "server": self.metrics.name}, root=True)
+                tracing.record_exemplar("paddle_serving_latency_ms",
+                                        lat, r.trace.trace_id)
         # metrics are bulked per BATCH, not per request: count/stat_add
         # take two locks each, a measurable tax at high request rates
         if inf.record_traffic and completed:

@@ -8,6 +8,8 @@ training-step callback, the optimizer step hook, JAX runtime probes,
 and profiler span mirroring — plus the live-InferenceServer scrape the
 issue names verbatim.
 """
+# pdlint: disable=metric_discipline  (registry unit tests register
+# synthetic family names like "t_requests_total" on purpose)
 import json
 import math
 import urllib.error

@@ -2,7 +2,7 @@
 
 Two halves:
 
-1. **The gate** — run all three analyzers over the whole repo and fail
+1. **The gate** — run every analyzer over the whole repo and fail
    on any finding not excused by tests/fixtures/pdlint_baseline.json.
    This is the tier-1 enforcement of the tracer-safety / flag-registry
    / lock-discipline contracts; fix the finding or (after review)
@@ -31,6 +31,7 @@ try:
     from paddle_tpu import analysis
     from paddle_tpu.analysis import (FlagConsistencyAnalyzer,
                                      LockDisciplineAnalyzer,
+                                     MetricDisciplineAnalyzer,
                                      TracerSafetyAnalyzer)
 except Exception as e:  # noqa: BLE001 - the gate must skip, not error,
     # when run from an environment where the repo root is not on the
@@ -253,6 +254,79 @@ class TestFlagConsistency:
         found = _run(tmp_path, [FlagConsistencyAnalyzer()])
         assert ("FC004", "FLAGS_twice") in \
             {(f.rule, f.symbol) for f in found}
+
+
+# ===================================================================
+# 3b. metric-discipline self-tests
+# ===================================================================
+class TestMetricDiscipline:
+    def test_bad_name_and_type_conflict(self, tmp_path):
+        _write(tmp_path, "mod.py", """
+            from registry import default_registry
+            reg = default_registry()
+            ok = reg.counter("paddle_good_total", "fine")
+            bad = reg.counter("BadName", "uppercase")          # MD001
+            worse = reg.gauge("paddle-dashed", "bad chars")    # MD001
+            c = reg.counter("paddle_twice", "first kind")
+        """)
+        _write(tmp_path, "other.py", """
+            from registry import default_registry
+            g = default_registry().gauge("paddle_twice", "!")  # MD001
+        """)
+        found = _run(tmp_path, [MetricDisciplineAnalyzer()])
+        md1 = [f for f in found if f.rule == "MD001"]
+        assert {f.symbol for f in md1} == \
+            {"BadName", "paddle-dashed", "paddle_twice"}
+        conflict = next(f for f in md1 if f.symbol == "paddle_twice")
+        assert "counter" in conflict.detail and \
+            "gauge" in conflict.detail
+
+    def test_negative_duration_literal(self, tmp_path):
+        _write(tmp_path, "mod.py", """
+            hist.observe(-5.0)                 # MD002
+            hist.observe(5.0)                  # fine
+            hist.observe_many([1.0, -2, 3.0])  # MD002
+            hist.observe(x - 5.0)              # not a bare literal
+        """)
+        found = _run(tmp_path, [MetricDisciplineAnalyzer()])
+        md2 = sorted(f.detail for f in found if f.rule == "MD002")
+        assert md2 == ["-2.0", "-5.0"]
+
+    def test_dynamic_and_non_registry_calls_skipped(self, tmp_path):
+        _write(tmp_path, "mod.py", """
+            import numpy as np
+            h, _ = np.histogram(arr, bins=10)     # not a registration
+            fam = reg.counter(name_var, "dynamic name skipped")
+        """)
+        assert _run(tmp_path, [MetricDisciplineAnalyzer()]) == []
+
+    def test_gate_scope_reaches_repo_metric_sites(self, tmp_path):
+        """Scope self-test: an injected violation in a tmp module run
+        through the PROJECT gate (real baseline) must come back as a
+        new finding — i.e. the analyzer rides the same gate the other
+        three do."""
+        _write(tmp_path, "metrics.py", """
+            from paddle_tpu.observability.registry import \\
+                default_registry
+            bad = default_registry().counter("NotPaddleCase", "x")
+            h = default_registry().histogram("paddle_x_ms", "x")
+            h.observe(-1.5)
+        """)
+        res = analysis.run_project(
+            paths=[str(tmp_path)], root=str(tmp_path),
+            baseline_path=analysis.default_baseline_path(REPO_ROOT))
+        new_rules = {f.rule for f in res["new"]}
+        assert {"MD001", "MD002"} <= new_rules, new_rules
+
+    def test_repo_registers_cleanly(self):
+        """The whole repo passes metric discipline with ZERO baseline
+        entries — the satellite's 'baselined clean' claim, kept
+        honest."""
+        found = analysis.run_analyzers(
+            analysis.default_paths(REPO_ROOT),
+            [MetricDisciplineAnalyzer()], root=REPO_ROOT)
+        listing = "\n".join(f.format() for f in found)
+        assert not found, listing
 
 
 # ===================================================================

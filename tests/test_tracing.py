@@ -1,0 +1,756 @@
+"""Distributed request tracing (ISSUE 9 tentpole).
+
+Covers the acceptance surface: traceparent parse/format roundtrip,
+deterministic head sampling, flight-recorder bounding + per-trace
+caps, error/shed/deadline tail promotion, the serving pipeline's
+typed stage spans (queue / assembly / dispatch / device_wait / fetch
+under one ``serving::request`` root), warmup + readiness-poll
+exclusion, the generation engine's prefill / per-iteration decode
+spans, ``/tracez`` filtering on the observability httpd, the chrome
+exporter's schema compatibility with the profiler's, latency
+exemplars, the fleet codec's trace trailer, and — the headline —
+router -> worker -> engine span stitching under ONE trace id through
+``RouterApp`` over a multi-replica fleet (thread replicas in the fast
+tests, real worker processes in the slow one).
+"""
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import inference, serving
+from paddle_tpu.framework.flags import set_flags
+from paddle_tpu.observability import tracing
+from paddle_tpu.serving import fleet
+from paddle_tpu.serving.fleet import codec
+from paddle_tpu.serving.fleet.worker import (StubBackend,
+                                             ThreadReplicaFactory)
+
+
+def _opener():
+    return urllib.request.build_opener(
+        urllib.request.ProxyHandler({}))
+
+
+@pytest.fixture()
+def buffer():
+    """A private flight recorder installed as the process default for
+    the test's duration, with tracing off before and after."""
+    set_flags({"FLAGS_trace_sample_rate": 0.0})
+    prev = tracing.set_default_buffer(tracing.SpanBuffer(4096))
+    tracing.clear_exemplars()
+    yield tracing.default_buffer()
+    set_flags({"FLAGS_trace_sample_rate": 0.0})
+    tracing.set_default_buffer(prev)
+    tracing.clear_exemplars()
+
+
+def _export(tmp_path, name="m"):
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(),
+                        nn.Linear(16, 4)).eval()
+    p = str(tmp_path / name)
+    paddle.jit.save(net, p, input_spec=[
+        paddle.static.InputSpec([None, 8], "float32", "x")])
+    return p
+
+
+# ---------------------------------------------------------------- core
+class TestContext:
+    def test_traceparent_roundtrip(self):
+        ctx = tracing.new_context(sampled=True)
+        tp = ctx.to_traceparent()
+        assert len(tp) == 2 + 1 + 32 + 1 + 16 + 1 + 2
+        back = tracing.parse_traceparent(tp)
+        assert back.trace_id == ctx.trace_id
+        assert back.span_id == ctx.span_id
+        assert back.sampled
+
+    def test_unsampled_flag_roundtrip(self):
+        ctx = tracing.new_context(sampled=False)
+        assert tracing.parse_traceparent(
+            ctx.to_traceparent()).sampled is False
+
+    def test_garbage_headers_degrade_to_untraced(self):
+        for bad in (None, "", "garbage", "00-zz-yy-01",
+                    "00-" + "0" * 32 + "-" + "1" * 16 + "-01",
+                    "00-" + "a" * 32 + "-" + "0" * 16 + "-01",
+                    "ff-" + "a" * 32 + "-" + "b" * 16 + "-01",
+                    "00-" + "a" * 31 + "-" + "b" * 16 + "-01"):
+            assert tracing.parse_traceparent(bad) is None, bad
+
+    def test_sampling_deterministic_in_trace_id(self):
+        # the same trace id always gets the same decision at a given
+        # rate — the property that keeps a trace whole fleet-wide
+        ids = [tracing._gen_trace_id() for _ in range(200)]
+        for rate in (0.0, 0.3, 1.0):
+            first = [tracing.sample_decision(i, rate) for i in ids]
+            again = [tracing.sample_decision(i, rate) for i in ids]
+            assert first == again
+        assert not any(tracing.sample_decision(i, 0.0) for i in ids)
+        assert all(tracing.sample_decision(i, 1.0) for i in ids)
+        # monotone: sampled at r stays sampled at r' > r
+        at_03 = {i for i in ids if tracing.sample_decision(i, 0.3)}
+        at_07 = {i for i in ids if tracing.sample_decision(i, 0.7)}
+        assert at_03 <= at_07
+
+    def test_request_context_off_by_default(self, buffer):
+        assert tracing.request_context() is None
+        set_flags({"FLAGS_trace_sample_rate": 1.0})
+        assert tracing.request_context() is not None
+
+    def test_ambient_context_wins_over_sampling(self, buffer):
+        ctx = tracing.new_context(sampled=True)
+        with tracing.use_context(ctx):
+            assert tracing.request_context() is ctx
+
+
+class TestBuffer:
+    def test_bounded_eviction(self):
+        buf = tracing.SpanBuffer(max_spans=8, max_per_trace=100)
+        for i in range(20):
+            c = tracing.new_context(sampled=True)
+            tracing.record_span(c, f"s{i}", stage="x",
+                                start_unix_ns=time.time_ns(),
+                                duration_ms=1.0, buffer=buf)
+        assert len(buf) == 8
+        names = [s["name"] for s in buf.snapshot()]
+        assert names == [f"s{i}" for i in range(12, 20)]  # oldest out
+
+    def test_per_trace_cap_drops_and_counts(self):
+        buf = tracing.SpanBuffer(max_spans=100, max_per_trace=3)
+        c = tracing.new_context(sampled=True)
+        for i in range(10):
+            tracing.record_span(c, f"s{i}", stage="x",
+                                start_unix_ns=time.time_ns(),
+                                duration_ms=1.0, buffer=buf)
+        assert len(buf) == 3
+        assert buf.stats()["dropped"] == 7
+
+    def test_unsampled_records_nothing(self):
+        buf = tracing.SpanBuffer(max_spans=100)
+        c = tracing.new_context(sampled=False)
+        tracing.record_span(c, "s", stage="x",
+                            start_unix_ns=time.time_ns(),
+                            duration_ms=1.0, buffer=buf)
+        assert len(buf) == 0
+
+    def test_error_tail_promotion_flushes_pending(self):
+        buf = tracing.SpanBuffer(max_spans=100)
+        c = tracing.new_context(sampled=False)
+        for i in range(3):
+            tracing.record_span(c, f"ok{i}", stage="x",
+                                start_unix_ns=time.time_ns(),
+                                duration_ms=1.0, buffer=buf)
+        assert len(buf) == 0
+        tracing.record_span(c, "boom", stage="x",
+                            start_unix_ns=time.time_ns(),
+                            duration_ms=1.0, status="error",
+                            attrs={"error": "boom"}, buffer=buf)
+        # the 3 parked spans AND the error span land together
+        assert len(buf) == 4
+        assert c.recording      # everything later records directly
+
+    def test_start_span_nesting_and_error(self, buffer):
+        with tracing.start_span("outer", stage="o",
+                                ctx=tracing.new_context(sampled=True)):
+            with tracing.start_span("inner", stage="i") as sp:
+                sp.set_attr("k", 1)
+        snap = buffer.snapshot()
+        outer = next(s for s in snap if s["name"] == "outer")
+        inner = next(s for s in snap if s["name"] == "inner")
+        assert inner["parent_id"] == outer["span_id"]
+        assert inner["attrs"]["k"] == 1
+        with pytest.raises(RuntimeError):
+            with tracing.start_span(
+                    "bad", ctx=tracing.new_context(sampled=False)):
+                raise RuntimeError("x")
+        bad = next(s for s in buffer.snapshot()
+                   if s["name"] == "bad")
+        assert bad["status"] == "error"   # promoted despite unsampled
+
+
+# ---------------------------------------------------------------- views
+class TestViews:
+    def _fill(self, buffer):
+        c1 = tracing.new_context(sampled=True)
+        c2 = tracing.new_context(sampled=True)
+        t = time.time_ns()
+        tracing.record_span(c1, "a", stage="x", start_unix_ns=t,
+                            duration_ms=50.0)
+        tracing.record_span(c2, "b", stage="x", start_unix_ns=t,
+                            duration_ms=1.0)
+        return c1, c2
+
+    def test_group_and_filter(self, buffer):
+        c1, c2 = self._fill(buffer)
+        all_traces = tracing.tracez_payload()["traces"]
+        assert len(all_traces) == 2
+        only = tracing.tracez_payload(trace_id=c1.trace_id)["traces"]
+        assert len(only) == 1 and only[0]["trace_id"] == c1.trace_id
+        slow = tracing.tracez_payload(min_duration_ms=10.0)["traces"]
+        assert [t["trace_id"] for t in slow] == [c1.trace_id]
+
+    def test_httpd_tracez_endpoint(self, buffer):
+        from paddle_tpu import observability
+        c1, c2 = self._fill(buffer)
+        srv = observability.TelemetryServer(port=0,
+                                            host="127.0.0.1").start()
+        try:
+            with _opener().open(srv.url("/tracez"), timeout=10) as r:
+                doc = json.loads(r.read())
+            assert len(doc["traces"]) == 2
+            assert doc["buffer"]["spans"] == 2
+            url = srv.url(f"/tracez?trace_id={c1.trace_id}&min_ms=10")
+            with _opener().open(url, timeout=10) as r:
+                doc = json.loads(r.read())
+            assert len(doc["traces"]) == 1
+            with _opener().open(srv.url("/tracez?format=chrome"),
+                                timeout=10) as r:
+                cdoc = json.loads(r.read())
+            assert {e["name"] for e in cdoc["traceEvents"]
+                    if e["ph"] == "X"} == {"a", "b"}
+        finally:
+            srv.stop()
+
+    def test_chrome_export_merges_with_profiler_schema(self, buffer,
+                                                       tmp_path):
+        from paddle_tpu import profiler
+        self._fill(buffer)
+        # a profiler session records python spans in its own schema
+        profiler._tracer.start()
+        with profiler.RecordEvent("host::op", args={"rows": 2}):
+            pass
+        profiler._tracer.enabled = False
+        out = str(tmp_path / "trace.json")
+        n = tracing.export_chrome_trace(out, include_profiler=True)
+        data = json.load(open(out))
+        events = data["traceEvents"]
+        assert len(events) == n
+        xs = [e for e in events if e.get("ph") == "X"]
+        names = {e["name"] for e in xs}
+        assert {"a", "b", "host::op"} <= names
+        for e in xs:       # one shared schema: the profiler loader
+            assert {"name", "ph", "ts", "dur", "pid",
+                    "tid"} <= set(e)
+        loaded = profiler.load_profiler_result(out)
+        assert loaded.time_range_summary()["n_events"] == len(events)
+        # dedupe on merge: the same spans twice collapse
+        spans = buffer.snapshot()
+        assert len(tracing.merge_span_dicts(spans, spans)) == \
+            len(spans)
+
+    def test_exemplars_bucketed_latest_wins(self, buffer):
+        tracing.record_exemplar("paddle_serving_latency_ms", 30.0,
+                                "t1" * 16)
+        tracing.record_exemplar("paddle_serving_latency_ms", 40.0,
+                                "t2" * 16)
+        tracing.record_exemplar("paddle_serving_latency_ms", 400.0,
+                                "t3" * 16)
+        ex = tracing.exemplars("paddle_serving_latency_ms")
+        assert ex["50.0"]["trace_id"] == "t2" * 16   # latest in-bucket
+        assert ex["500.0"]["trace_id"] == "t3" * 16
+        assert "exemplars" in tracing.tracez_payload()
+
+
+# ---------------------------------------------------------------- serving
+class TestServingSpans:
+    def test_stage_spans_under_one_root(self, buffer, tmp_path):
+        pred = inference.create_predictor(
+            inference.Config(_export(tmp_path)))
+        srv = serving.InferenceServer(pred, max_batch_size=4,
+                                      max_wait_ms=5, name="t_tr1")
+        try:
+            srv.warmup()
+            assert len(buffer) == 0     # warmup is never traced
+            set_flags({"FLAGS_trace_sample_rate": 1.0})
+            srv.submit([np.ones((2, 8), np.float32)]).result(
+                timeout=60)
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and len(buffer) < 6:
+                time.sleep(0.01)
+            snap = buffer.snapshot()
+            stages = sorted(s["stage"] for s in snap)
+            assert stages == sorted(["queue", "assembly", "dispatch",
+                                     "device_wait", "fetch",
+                                     "request"]), stages
+            assert len({s["trace_id"] for s in snap}) == 1
+            root = next(s for s in snap if s["stage"] == "request")
+            for s in snap:
+                if s is not root:
+                    assert s["parent_id"] == root["span_id"]
+            # the completed request left a latency exemplar
+            assert tracing.exemplars("paddle_serving_latency_ms")
+        finally:
+            set_flags({"FLAGS_trace_sample_rate": 0.0})
+            srv.shutdown()
+
+    def test_unsampled_traffic_records_nothing(self, buffer,
+                                               tmp_path):
+        pred = inference.create_predictor(
+            inference.Config(_export(tmp_path)))
+        srv = serving.InferenceServer(pred, max_batch_size=4,
+                                      max_wait_ms=5, name="t_tr2")
+        try:
+            srv.warmup()
+            srv.submit([np.ones((1, 8), np.float32)]).result(
+                timeout=60)         # rate is 0.0: no context at all
+            time.sleep(0.1)
+            assert len(buffer) == 0
+        finally:
+            srv.shutdown()
+
+    def test_deadline_expiry_promotes_unsampled(self, buffer,
+                                                tmp_path):
+        pred = inference.create_predictor(
+            inference.Config(_export(tmp_path)))
+        srv = serving.InferenceServer(pred, max_batch_size=4,
+                                      max_wait_ms=5, name="t_tr3",
+                                      start=False)
+        try:
+            ctx = tracing.new_context(sampled=False)
+            with tracing.use_context(ctx):
+                fut = srv.submit([np.ones((1, 8), np.float32)],
+                                 timeout_ms=1.0)
+            time.sleep(0.05)
+            srv.start()
+            with pytest.raises(serving.DeadlineExceededError):
+                fut.result(timeout=60)
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and not len(buffer):
+                time.sleep(0.01)
+            snap = buffer.snapshot()
+            assert snap, "deadline expiry must tail-promote"
+            q = next(s for s in snap if s["stage"] == "queue")
+            assert q["status"] == "error"
+            assert q["trace_id"] == ctx.trace_id
+        finally:
+            srv.shutdown()
+
+    def test_shed_promotes_unsampled(self, buffer, tmp_path):
+        pred = inference.create_predictor(
+            inference.Config(_export(tmp_path)))
+        srv = serving.InferenceServer(pred, max_batch_size=2,
+                                      queue_capacity=1,
+                                      name="t_tr4", start=False)
+        try:
+            ctx = tracing.new_context(sampled=False)
+            with tracing.use_context(ctx):
+                srv.submit([np.ones((1, 8), np.float32)])
+                with pytest.raises(serving.QueueFullError):
+                    srv.submit([np.ones((1, 8), np.float32)])
+            shed = [s for s in buffer.snapshot()
+                    if s["stage"] == "shed"]
+            assert shed and shed[0]["status"] == "error"
+        finally:
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------- codec
+class TestCodecTrailer:
+    def test_roundtrip_and_backcompat(self):
+        feeds = [[np.ones((2, 4), np.float32)],
+                 [np.zeros((1, 4), np.float32)]]
+        body = codec.encode_batch(feeds)
+        out, tps = codec.decode_batch_ex(body)
+        assert tps is None and len(out) == 2
+        tp = tracing.new_context(sampled=True).to_traceparent()
+        stamped = codec.attach_trace_trailer(body, [tp, None])
+        out, tps = codec.decode_batch_ex(stamped)
+        assert tps == [tp, None]
+        np.testing.assert_array_equal(out[0][0], feeds[0][0])
+        # trailer-blind decoders and peek keep working
+        assert len(codec.decode_batch(stamped)) == 2
+        assert codec.peek_batch_size(stamped) == 2
+
+    def test_attach_is_idempotent_and_validates(self):
+        body = codec.encode_batch([[np.ones(3, np.float32)]])
+        tp = tracing.new_context(sampled=True).to_traceparent()
+        stamped = codec.attach_trace_trailer(body, [tp])
+        # a second stamp (the router on an already-traced client
+        # payload) leaves the client's identities alone
+        assert codec.attach_trace_trailer(stamped, [None]) == stamped
+        with pytest.raises(codec.CodecError):
+            codec.attach_trace_trailer(body, [tp, tp])
+
+    def test_trailer_count_mismatch_rejected(self):
+        body = codec.encode_batch([[np.ones(3, np.float32)]])
+        bad = body + codec.TRACE_MAGIC + (5).to_bytes(4, "little")
+        with pytest.raises(codec.CodecError):
+            codec.decode_batch_ex(bad)
+
+
+# ---------------------------------------------------------------- fleet
+def _stub_fleet(n=2, **stub_kw):
+    fac = ThreadReplicaFactory(
+        lambda rid: StubBackend(device_ms=1.0, **stub_kw))
+    reps = {i: fac(i).url() for i in range(n)}
+    router = fleet.FleetRouter(replicas=reps, name=f"t-trace-{n}",
+                               start=False)
+    assert router.wait_ready(n, timeout=20)
+    return fac, router
+
+
+class TestFleetTracing:
+    def test_router_worker_stitched_one_trace(self, buffer):
+        fac, router = _stub_fleet()
+        app = fleet.RouterApp(router, host="127.0.0.1").start()
+        try:
+            ctx = tracing.new_context(sampled=True)
+            body = codec.encode_batch([[np.ones((1, 4), np.float32)]])
+            req = urllib.request.Request(
+                app.url("/submit_many"), data=body,
+                headers={"Content-Type": "application/x-paddle-fleet",
+                         "traceparent": ctx.to_traceparent()})
+            with _opener().open(req, timeout=30) as resp:
+                results = codec.decode_results(resp.read())
+            assert not isinstance(results[0], BaseException)
+            with _opener().open(
+                    app.url(f"/tracez?trace_id={ctx.trace_id}"),
+                    timeout=10) as resp:
+                doc = json.loads(resp.read())
+            assert len(doc["traces"]) == 1
+            spans = doc["traces"][0]["spans"]
+            stages = {s["stage"] for s in spans}
+            assert {"router", "forward", "worker"} <= stages
+            assert {s["trace_id"] for s in spans} == {ctx.trace_id}
+            # parentage: forward under router::request, worker under
+            # forward — the cross-process chain
+            root = next(s for s in spans if s["stage"] == "router")
+            fwd = next(s for s in spans if s["stage"] == "forward")
+            wrk = next(s for s in spans if s["stage"] == "worker")
+            assert fwd["parent_id"] == root["span_id"]
+            assert wrk["parent_id"] == fwd["span_id"]
+            assert tracing.exemplars("paddle_fleet_request_ms")
+        finally:
+            app.stop()
+            router.shutdown()
+
+    def test_readiness_polls_and_warmup_leave_no_spans(self, buffer):
+        set_flags({"FLAGS_trace_sample_rate": 1.0})
+        try:
+            fac, router = _stub_fleet()   # spawn+warmup under rate=1
+            app = fleet.RouterApp(router, host="127.0.0.1").start()
+            try:
+                for _ in range(3):
+                    router.poll_replicas()
+                for path in ("/healthz", "/readyz", "/statusz"):
+                    with _opener().open(app.url(path),
+                                        timeout=10) as resp:
+                        resp.read()
+                m0 = router.metrics_snapshot()
+                assert m0["counters"]["routed"] == 0
+                assert m0["request_ms"]["count"] == 0
+                assert len(buffer) == 0, buffer.snapshot()
+            finally:
+                app.stop()
+                router.shutdown()
+        finally:
+            set_flags({"FLAGS_trace_sample_rate": 0.0})
+
+    def test_fleet_shed_promotes(self, buffer):
+        # capacity-1 stubs + retries exhausted -> QueueFullError; the
+        # unsampled trace must be tail-promoted with error spans
+        fac = ThreadReplicaFactory(
+            lambda rid: StubBackend(device_ms=200.0, max_batch=1,
+                                    queue_capacity=1))
+        reps = {0: fac(0).url()}
+        router = fleet.FleetRouter(replicas=reps, name="t-shed",
+                                   retries=1, start=False)
+        assert router.wait_ready(1, timeout=20)
+        try:
+            ctx = tracing.new_context(sampled=False)
+            with tracing.use_context(ctx):
+                futs = router.submit_many(
+                    [[np.ones((1, 4), np.float32)]] * 3)
+            errs = []
+            for f in futs:
+                try:
+                    f.result(timeout=60)
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+            if not errs:
+                pytest.skip("stub absorbed the burst; nothing shed")
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and not len(buffer):
+                time.sleep(0.01)
+            spans = buffer.snapshot(trace_id=ctx.trace_id)
+            assert any(s["status"] == "error" for s in spans), spans
+        finally:
+            router.shutdown()
+
+    def test_statusz_aggregates_replica_state(self, buffer):
+        fac, router = _stub_fleet()
+        app = fleet.RouterApp(router, host="127.0.0.1").start()
+        try:
+            router.submit([np.ones((1, 4), np.float32)]).result(
+                timeout=30)
+            with _opener().open(app.url("/statusz"),
+                                timeout=10) as resp:
+                doc = json.loads(resp.read())
+            assert doc["router"] == router.name
+            assert doc["ready_replicas"] == 2
+            assert len(doc["replicas"]) == 2
+            for r in doc["replicas"]:
+                assert {"replica", "ready", "outstanding",
+                        "restarts", "version"} <= set(r)
+            assert doc["metrics"]["counters"]["completed"] >= 1
+        finally:
+            app.stop()
+            router.shutdown()
+
+    def test_statusz_reports_supervisor_restarts(self, buffer):
+        crashed = {}
+
+        def factory(rid):
+            # second spawn of replica 0 marks a restart
+            crashed[rid] = crashed.get(rid, 0) + 1
+            return ThreadReplicaFactory(
+                lambda _rid: StubBackend(device_ms=1.0))(rid)
+
+        sup = fleet.ReplicaSupervisor(factory, 1,
+                                      poll_interval_s=0.01,
+                                      restart_backoff_ms=1.0)
+        sup._metrics = None
+        sup.start()
+        router = fleet.FleetRouter(supervisor=sup, name="t-restart",
+                                   start=False)
+        try:
+            assert router.wait_ready(1, timeout=20)
+            with sup._lock:
+                victim = sup._managed[0].proc
+            victim.kill()
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and \
+                    sup.restart_counts().get(0, 0) < 1:
+                time.sleep(0.02)
+            doc = router.statusz()
+            assert doc["restarts_total"] >= 1
+        finally:
+            router.shutdown()
+            sup.stop()
+
+    def test_engine_spans_join_fleet_trace(self, buffer, tmp_path):
+        """The acceptance path: a request through ``RouterApp`` on a
+        2-replica fleet of REAL InferenceServers yields ONE stitched
+        trace — router span + worker span + the engine's queue/
+        assembly/dispatch/device_wait/fetch children — retrievable
+        from the router's /tracez by trace id and exportable as a
+        valid chrome trace."""
+        from paddle_tpu.serving.fleet.worker import (PredictorBackend,
+                                                     ReplicaApp)
+        prefix = _export(tmp_path)
+        backends, apps = [], []
+        for i in range(2):
+            b = PredictorBackend(prefix, max_batch_size=4,
+                                 warmup_mode="lattice",
+                                 name=f"t-real-{i}")
+            backends.append(b)
+            apps.append(ReplicaApp(b).start())
+            b.warmup()
+        router = fleet.FleetRouter(
+            replicas={i: a.url for i, a in enumerate(apps)},
+            name="t-real-fleet", start=False)
+        rapp = fleet.RouterApp(router, host="127.0.0.1").start()
+        try:
+            assert router.wait_ready(2, timeout=60)
+            ctx = tracing.new_context(sampled=True)
+            body = codec.encode_batch([[np.ones((2, 8), np.float32)]])
+            req = urllib.request.Request(
+                rapp.url("/submit_many"), data=body,
+                headers={"Content-Type": "application/x-paddle-fleet",
+                         "traceparent": ctx.to_traceparent()})
+            with _opener().open(req, timeout=60) as resp:
+                results = codec.decode_results(resp.read())
+            assert not isinstance(results[0], BaseException)
+            assert results[0][0].shape == (2, 4)
+            want = {"router", "forward", "worker", "queue",
+                    "assembly", "dispatch", "device_wait", "fetch",
+                    "request"}
+            doc = None
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                with _opener().open(
+                        rapp.url(f"/tracez?trace_id={ctx.trace_id}"),
+                        timeout=10) as resp:
+                    doc = json.loads(resp.read())
+                if doc["traces"] and want <= {
+                        s["stage"]
+                        for s in doc["traces"][0]["spans"]}:
+                    break
+                time.sleep(0.05)
+            assert len(doc["traces"]) == 1, doc
+            spans = doc["traces"][0]["spans"]
+            stages = {s["stage"] for s in spans}
+            assert want <= stages, stages
+            assert {s["trace_id"] for s in spans} == {ctx.trace_id}
+            # engine root hangs under the worker span: full chain
+            wrk = next(s for s in spans if s["stage"] == "worker")
+            req_span = next(s for s in spans
+                            if s["stage"] == "request")
+            assert req_span["parent_id"] == wrk["span_id"]
+            # and it exports as a valid chrome trace
+            with _opener().open(
+                    rapp.url(f"/tracez?trace_id={ctx.trace_id}"
+                             f"&format=chrome"), timeout=10) as resp:
+                cdoc = json.loads(resp.read())
+            xs = [e for e in cdoc["traceEvents"] if e["ph"] == "X"]
+            assert len(xs) == len(spans)
+            for e in xs:
+                assert {"name", "ph", "ts", "dur", "pid",
+                        "tid"} <= set(e)
+        finally:
+            rapp.stop()
+            router.shutdown()
+            for b in backends:
+                b.shutdown()
+            for a in apps:
+                a.stop()
+
+    def test_generate_stream_joins_trace(self, buffer):
+        fac, router = _stub_fleet()
+        try:
+            ctx = tracing.new_context(sampled=True)
+            with tracing.use_context(ctx):
+                fut = router.submit_generate([1, 2, 3],
+                                             max_new_tokens=4)
+            toks = fut.result(timeout=30)
+            assert len(toks) == 4
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and not [
+                    s for s in buffer.snapshot(trace_id=ctx.trace_id)
+                    if s["stage"] == "router"]:
+                time.sleep(0.02)
+            spans = buffer.snapshot(trace_id=ctx.trace_id)
+            root = next(s for s in spans if s["stage"] == "router")
+            assert root["name"] == "router::generate"
+            assert root["attrs"]["finish_reason"] == "length"
+        finally:
+            router.shutdown()
+
+
+# ----------------------------------------------------------- generation
+class TestGenerationSpans:
+    @pytest.fixture(scope="class")
+    def gen_server(self):
+        from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+        from paddle_tpu.serving.generation import GenerationServer
+        paddle.seed(0)
+        model = GPTForCausalLM(gpt_tiny(use_flash_attention=False))
+        srv = GenerationServer(model, max_batch=2, max_seq_len=32,
+                               name="t_gen_tr")
+        srv.warmup()
+        yield srv
+        srv.shutdown()
+
+    def test_prefill_and_per_iteration_decode_spans(self, buffer,
+                                                    gen_server):
+        assert len(buffer) == 0     # warmup ran untraced
+        ctx = tracing.new_context(sampled=True)
+        with tracing.use_context(ctx):
+            fut = gen_server.submit_generate(np.array([1, 2, 3]),
+                                             max_new_tokens=4)
+        fut.result(timeout=120)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not [
+                s for s in buffer.snapshot(trace_id=ctx.trace_id)
+                if s["stage"] == "request"]:
+            time.sleep(0.02)
+        spans = buffer.snapshot(trace_id=ctx.trace_id)
+        stages = [s["stage"] for s in spans]
+        assert stages.count("prefill") == 1
+        # token 1 comes from prefill; 3 more decode iterations
+        assert stages.count("decode_step") == 3
+        assert "queue" in stages
+        root = next(s for s in spans if s["stage"] == "request")
+        assert root["attrs"]["finish_reason"] == "length"
+        assert root["attrs"]["tokens"] == 4
+        steps = sorted(s["attrs"]["step"] for s in spans
+                       if s["stage"] == "decode_step")
+        assert steps == [1, 2, 3]
+
+    def test_generation_deadline_promotes(self, buffer, gen_server):
+        ctx = tracing.new_context(sampled=False)
+        with tracing.use_context(ctx):
+            # consume both slots with long generations, then a
+            # deadline-doomed request behind them
+            long1 = gen_server.submit_generate([1], max_new_tokens=24)
+            long2 = gen_server.submit_generate([2], max_new_tokens=24)
+            doomed = gen_server.submit_generate([3],
+                                               max_new_tokens=2,
+                                               timeout_ms=1.0)
+        with pytest.raises(serving.DeadlineExceededError):
+            doomed.result(timeout=120)
+        long1.result(timeout=120)
+        long2.result(timeout=120)
+        spans = buffer.snapshot(trace_id=ctx.trace_id)
+        errs = [s for s in spans if s["status"] == "error"]
+        assert errs and errs[0]["attrs"]["error"] == \
+            "DeadlineExceededError"
+
+
+# ---------------------------------------------------------- multi-proc
+@pytest.mark.slow
+class TestMultiProcessFleet:
+    def test_stitched_trace_across_processes(self, buffer, tmp_path):
+        """Two real stub WORKER PROCESSES behind a RouterApp: one
+        request, one trace id, spans from the router process AND the
+        replica process stitched by the router's merged /tracez."""
+        fac = fleet.ProcessReplicaFactory(
+            extra_args=["--stub", "--stub-device-ms", "2"],
+            announce_dir=str(tmp_path))
+        sup = fleet.ReplicaSupervisor(fac, 2).start()
+        router = fleet.FleetRouter(supervisor=sup,
+                                   name="t-mp-trace",
+                                   health_interval_ms=100)
+        app = fleet.RouterApp(router, host="127.0.0.1").start()
+        try:
+            assert router.wait_ready(2, timeout=60)
+            ctx = tracing.new_context(sampled=True)
+            body = codec.encode_batch(
+                [[np.ones((1, 4), np.float32)]] * 2)
+            req = urllib.request.Request(
+                app.url("/submit_many"), data=body,
+                headers={"Content-Type":
+                         "application/x-paddle-fleet",
+                         "traceparent": ctx.to_traceparent()})
+            with _opener().open(req, timeout=60) as resp:
+                results = codec.decode_results(resp.read())
+            assert all(not isinstance(r, BaseException)
+                       for r in results)
+            doc = None
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                with _opener().open(
+                        app.url(f"/tracez?trace_id={ctx.trace_id}"),
+                        timeout=10) as resp:
+                    doc = json.loads(resp.read())
+                if doc["traces"] and {"router", "worker"} <= {
+                        s["stage"]
+                        for s in doc["traces"][0]["spans"]}:
+                    break
+                time.sleep(0.1)
+            assert doc["traces"], doc
+            spans = doc["traces"][0]["spans"]
+            procs = {s["process"] for s in spans}
+            # spans from >= 2 distinct processes, one trace
+            assert len(procs) >= 2, procs
+            assert any(p.startswith("router-") for p in procs)
+            assert any(p.startswith("replica-") for p in procs)
+            assert {s["trace_id"] for s in spans} == {ctx.trace_id}
+            # and the merged view exports as one valid chrome trace
+            with _opener().open(
+                    app.url(f"/tracez?trace_id={ctx.trace_id}"
+                            f"&format=chrome"), timeout=10) as resp:
+                cdoc = json.loads(resp.read())
+            pids = {e["pid"] for e in cdoc["traceEvents"]
+                    if e["ph"] == "X"}
+            assert len(pids) >= 2
+        finally:
+            app.stop()
+            router.shutdown()
+            sup.stop()

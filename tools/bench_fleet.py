@@ -24,10 +24,21 @@ Three phases, one committed BENCH_FLEET_r*.json record:
    version-stamped v2 artifact. Asserts ZERO failed requests and that
    post-swap outputs match a local v2 reference predictor.
 
+A fourth, separately-invoked phase (``--trace``) exercises the
+distributed-tracing layer instead: a fully-sampled run through the
+router front end whose per-stage span counts are cross-checked
+against the bench's own request accounting (every counted call must
+leave exactly one ``router::request``, one ``router::forward`` and
+one ``worker::submit_many`` span in the flight recorder), plus a
+tracing-off vs ``FLAGS_trace_sample_rate=0.05`` QPS comparison on the
+stub-process fleet — the acceptance bound is < 5% regression. Emits a
+TRACE_r*.json record.
+
 Usage: JAX_PLATFORMS=cpu python tools/bench_fleet.py
        [--replicas 4] [--duration 6] [--trials 2]
        [--device-ms 12] [--out BENCH_FLEET_rNN.json]
        [--skip-scaleout] [--skip-swap]
+       [--trace --out TRACE_rNN.json]
 """
 import argparse
 import json
@@ -400,6 +411,156 @@ def _phase_swap(args, workdir, prefix_v1, shared_cache):
         sup.stop()
 
 
+# ------------------------------------------------------------- tracing
+def _phase_trace_accounting(args):
+    """Fully-sampled in-process run: every counted request must leave
+    exactly one span per router stage and one worker span, so the
+    flight recorder's accounting is provably complete — not 'some
+    spans showed up'."""
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.observability import tracing
+    from paddle_tpu.serving import fleet
+    from paddle_tpu.serving.fleet import codec
+    from paddle_tpu.serving.fleet.worker import (StubBackend,
+                                                 ThreadReplicaFactory)
+
+    buf = tracing.SpanBuffer(max_spans=200_000, max_per_trace=64)
+    prev = tracing.set_default_buffer(buf)
+    set_flags({"FLAGS_trace_sample_rate": 1.0})
+    fac = ThreadReplicaFactory(
+        lambda rid: StubBackend(device_ms=1.0, max_batch=8,
+                                queue_capacity=512))
+    reps = {i: fac(i).url() for i in range(2)}
+    router = fleet.FleetRouter(replicas=reps, name="trace-acct",
+                               start=False)
+    app = fleet.RouterApp(router, host="127.0.0.1").start()
+    opener = _opener()
+    calls, k = int(args.trace_calls), 2
+    body = codec.encode_batch([[np.ones((1, 16), np.float32)]] * k)
+    try:
+        if not router.wait_ready(2, timeout=30):
+            raise RuntimeError("trace-accounting fleet not ready")
+        completed = 0
+        for _ in range(calls):
+            req = urllib.request.Request(
+                app.url("/submit_many"), data=body,
+                headers={"Content-Type":
+                         "application/x-paddle-fleet"})
+            with opener.open(req, timeout=60) as resp:
+                results = codec.decode_results(resp.read())
+            completed += sum(1 for r in results
+                             if not isinstance(r, BaseException))
+        time.sleep(0.3)     # let completion threads finish recording
+        spans = buf.snapshot()
+        by_stage = {}
+        for s in spans:
+            by_stage[s["stage"]] = by_stage.get(s["stage"], 0) + 1
+        expected = {"router": calls, "forward": calls,
+                    "worker": calls}
+        mismatches = {st: (by_stage.get(st, 0), want)
+                      for st, want in expected.items()
+                      if by_stage.get(st, 0) != want}
+        return {
+            "calls": calls, "requests_per_call": k,
+            "requests_completed": completed,
+            "span_counts": dict(sorted(by_stage.items())),
+            "expected_per_stage": expected,
+            "distinct_traces": len({s["trace_id"] for s in spans}),
+            "accounting_consistent": not mismatches,
+            "mismatches": mismatches,
+            "exemplar_buckets": len(
+                tracing.exemplars("paddle_fleet_request_ms")),
+        }
+    finally:
+        set_flags({"FLAGS_trace_sample_rate": 0.0})
+        tracing.set_default_buffer(prev)
+        app.stop()
+        router.shutdown()
+
+
+def _phase_trace_overhead(args):
+    """Aggregate QPS through real stub worker processes with tracing
+    off vs head-sampled at 5% — the acceptance bound is < 5%
+    regression. Sampling happens at router ingress, so the flag only
+    needs flipping in THIS (router) process."""
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.serving import fleet
+
+    out = {}
+    for label, rate in (("tracing_off", 0.0),
+                        ("sampled_0.05", 0.05)):
+        set_flags({"FLAGS_trace_sample_rate": rate})
+        trials = []
+        try:
+            for _ in range(args.trials):
+                fac = fleet.ProcessReplicaFactory(extra_args=[
+                    "--stub",
+                    "--stub-device-ms", str(args.device_ms),
+                    "--stub-max-batch", str(args.stub_batch),
+                    "--stub-capacity", str(args.stub_capacity)])
+                sup = fleet.ReplicaSupervisor(
+                    fac, args.replicas).start()
+                router = fleet.FleetRouter(
+                    supervisor=sup, name=f"trace-ovh-{label}",
+                    health_interval_ms=200)
+                try:
+                    if not router.wait_ready(args.replicas,
+                                             timeout=60):
+                        raise RuntimeError("overhead fleet not ready")
+                    app = fleet.RouterApp(router,
+                                          host="127.0.0.1").start()
+                    try:
+                        trials.append(_run_load(
+                            app.url(), k=args.load_k,
+                            threads=args.load_threads,
+                            procs=args.load_procs,
+                            duration_s=args.duration))
+                    finally:
+                        app.stop()
+                finally:
+                    router.shutdown()
+                    sup.stop()
+        finally:
+            set_flags({"FLAGS_trace_sample_rate": 0.0})
+        best = sorted(trials,
+                      key=lambda s: s["qps"])[len(trials) // 2]
+        best["trials_qps"] = [round(s["qps"], 1) for s in trials]
+        out[label] = best
+    off = out["tracing_off"]["qps"]
+    on = out["sampled_0.05"]["qps"]
+    out["qps_ratio"] = round(on / off, 4) if off else 0.0
+    out["regression_pct"] = round((1 - out["qps_ratio"]) * 100, 2)
+    return out
+
+
+def _run_trace(args):
+    import jax
+    acct = _phase_trace_accounting(args)
+    record = {
+        "metric": "fleet_trace_span_accounting",
+        "skipped": False,
+        "value": float(acct["span_counts"].get("router", 0)),
+        "unit": "spans",
+        "vs_baseline": 1.0 if acct["accounting_consistent"] else 0.0,
+        "accounting": acct,
+        "config": {
+            "replicas": args.replicas,
+            "device_ms": args.device_ms,
+            "backend": jax.default_backend(),
+            "host_cores": os.cpu_count(),
+        },
+    }
+    if not args.skip_overhead:
+        record["overhead"] = _phase_trace_overhead(args)
+    emit_record(record, out=args.out)
+    ok = acct["accounting_consistent"]
+    if "overhead" in record:
+        # soft bound on a shared CI box: report, only fail on a
+        # blowout far past the 5% acceptance target
+        ok = ok and record["overhead"]["qps_ratio"] >= 0.85
+    return 0 if ok else 1
+
+
 # ------------------------------------------------------------- main
 def main():
     args = _parse_args()
@@ -407,6 +568,8 @@ def main():
         print(json.dumps(_loadgen_main(json.loads(args.loadgen))))
         return 0
     try:
+        if args.trace:
+            return _run_trace(args)
         return _run(args)
     except Exception as e:  # noqa: BLE001 - an unreachable backend is
         # a structured skip, not a crash (tools/_bench_common.py)
@@ -436,6 +599,13 @@ def _parse_args():
     ap.add_argument("--swap-threads", type=int, default=3)
     ap.add_argument("--skip-scaleout", action="store_true")
     ap.add_argument("--skip-swap", action="store_true")
+    ap.add_argument("--trace", action="store_true",
+                    help="run the tracing phases instead: span-count "
+                         "cross-check + sampled-QPS overhead")
+    ap.add_argument("--trace-calls", type=int, default=150,
+                    help="HTTP calls in the span-accounting phase")
+    ap.add_argument("--skip-overhead", action="store_true",
+                    help="--trace: skip the QPS overhead comparison")
     ap.add_argument("--loadgen", default=None,
                     help=argparse.SUPPRESS)   # internal: loadgen child
     ap.add_argument("--out", default=None,

@@ -35,6 +35,7 @@ SURFACES = [
     "paddle_tpu.serving.generation",
     "paddle_tpu.serving.fleet",
     "paddle_tpu.observability",
+    "paddle_tpu.observability.tracing",
     "paddle_tpu.analysis",
     "paddle_tpu.compile_cache",
     "paddle_tpu.elastic",
