@@ -10,7 +10,8 @@ linters over this repo's Python:
   ``define_flag`` definition with a compatible type; dead flags are
   reported (FC001-FC004);
 - ``LockDisciplineAnalyzer`` — unguarded shared-state writes in the
-  threaded serving/observability packages (LK001-LK003);
+  threaded serving/observability/elastic/distributed packages
+  (LK001-LK003);
 - ``MetricDisciplineAnalyzer`` — registry metric families: names must
   match ``paddle_[a-z0-9_]+`` and register once per name/type, and
   histograms must never observe negative duration literals

@@ -1,10 +1,12 @@
 """Lock-discipline analyzer: unguarded shared state in threaded code.
 
-``paddle_tpu/serving/``, ``paddle_tpu/observability/`` and
-``paddle_tpu/elastic/`` are the places this codebase runs real threads
+``paddle_tpu/serving/``, ``paddle_tpu/observability/``,
+``paddle_tpu/elastic/`` and ``paddle_tpu/distributed/`` are the places
+this codebase runs real threads or holds cross-thread shared state
 (batching worker, completion thread, telemetry HTTP handlers,
 collectors, the async checkpoint writer + its done callbacks and
-signal handlers). The discipline their classes follow — established in
+signal handlers, the sharding API's generation counter and metric
+registration). The discipline their classes follow — established in
 PRs 1-3 — is: shared mutable
 attributes are written inside ``with self._lock:``. This analyzer
 flags the drift cases that compile fine and fail only under traffic:
@@ -37,7 +39,7 @@ __all__ = ["LockDisciplineAnalyzer"]
 _LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
                "BoundedSemaphore"}
 _DEFAULT_DIRS = ("paddle_tpu/serving/", "paddle_tpu/observability/",
-                 "paddle_tpu/elastic/")
+                 "paddle_tpu/elastic/", "paddle_tpu/distributed/")
 
 
 def _self_attr(node: ast.AST) -> Optional[str]:

@@ -257,8 +257,14 @@ def layer_fingerprint(layer) -> str:
             if k not in _LAYER_INFRA)
         parts.append(f"cfg:{path}:{cls.__qualname__}:{cfg}")
     for name, p in layer.named_parameters():
+        # dist_spec/opt_state_spec shape the lowered SPMD program under
+        # a mesh — two spec trees must never share an executable, at
+        # ANY compile site (train_step keys them via the unified
+        # surface's spec hash too; this covers to_static/serving)
         parts.append(f"p:{name}:{tuple(p.shape)}:{p._data.dtype}:"
-                     f"{bool(p.stop_gradient)}")
+                     f"{bool(p.stop_gradient)}:"
+                     f"{getattr(p, 'dist_spec', None)}:"
+                     f"{getattr(p, 'opt_state_spec', None)}")
     for name, b in layer.named_buffers():
         if b is not None:
             parts.append(f"b:{name}:{tuple(b.shape)}:{b._data.dtype}")

@@ -13,6 +13,11 @@ from .mesh_utils import (  # noqa: F401
 )
 from .parallel import DataParallel  # noqa: F401
 from .auto_parallel.interface import ProcessMesh, shard_op, shard_tensor  # noqa: F401
+from . import shard  # noqa: F401  (the unified sharding API)
+from .shard import (  # noqa: F401
+    apply_sharding, constrain, constrain_batch, constrain_seq,
+    shard_params, spec_tree, spec_tree_hash,
+)
 
 import types as _types
 from .fleet.meta_parallel.sharding import (  # noqa: F401

@@ -13,20 +13,18 @@ single-chip execution is exact (full weights).
 """
 from __future__ import annotations
 
-import jax
-import jax.numpy as jnp
-
-from ....core.dispatch import apply_op
 from ....nn import functional as F
 from ....nn import initializer as I
 from ....nn.initializer_utils import create_parameter_with_attr
 from ....nn.layer.layers import Layer
-from ...mesh_utils import get_global_mesh, with_constraint
+from ...mesh_utils import get_global_mesh
+from ...shard import constrain, mark_param
 
 
 def _mark(param, *spec):
-    param.dist_spec = tuple(spec)
-    return param
+    # unified-surface annotation: sets dist_spec AND bumps the spec
+    # generation so compiled-step memos see the change
+    return mark_param(param, spec)
 
 
 class VocabParallelEmbedding(Layer):
@@ -65,11 +63,9 @@ class ColumnParallelLinear(Layer):
         if get_global_mesh() is not None:
             spec = (None,) * (out.ndim - 1)
             if self.gather_output:
-                out = apply_op("mp_gather",
-                               lambda a: with_constraint(a, *spec, None), out)
+                out = constrain(out, *spec, None)
             else:
-                out = apply_op("mp_keep_sharded",
-                               lambda a: with_constraint(a, *spec, "mp"), out)
+                out = constrain(out, *spec, "mp")
         return out
 
 
@@ -94,8 +90,7 @@ class RowParallelLinear(Layer):
         out = F.linear(x, self.weight, self.bias)
         if get_global_mesh() is not None:
             spec = (None,) * (out.ndim - 1)
-            out = apply_op("mp_allreduce_out",
-                           lambda a: with_constraint(a, *spec, None), out)
+            out = constrain(out, *spec, None)
         return out
 
 

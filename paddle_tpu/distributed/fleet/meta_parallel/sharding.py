@@ -7,8 +7,9 @@ group_sharded_stage3.py:59; entry group_sharded_parallel at
 
 TPU-native: ZeRO is a sharding-spec choice, not a runtime protocol. Stage 1/2
 shard optimizer state (and grads) over the "sharding"/"dp" mesh axis; stage 3
-also shards parameters. The wrappers below mark parameters/optimizer state
-with dist specs consumed by the pjit step builder; GSPMD then emits
+also shards parameters. These wrappers are the paddle-API shims over the
+unified surface — ``paddle_tpu.distributed.shard`` owns the spec decision
+(``apply_sharding(zero=...)`` is the direct form); GSPMD then emits
 reduce-scatter/all-gather exactly where the reference does them by hand.
 """
 from __future__ import annotations
@@ -19,17 +20,15 @@ from ....nn.layer.layers import Layer
 def _flat_axis_spec(p, axis="sharding"):
     """Shard dim 0 of the param over the sharding axis when it divides
     evenly; fall back to replicated (scalars and non-divisible dims would
-    otherwise fail placement)."""
+    otherwise fail placement). Delegates to the unified surface's ZeRO
+    composition (shard._zero_compose over a replicated base)."""
     from ...mesh_utils import get_global_mesh
-    shape = p.shape
+    from ...shard import _zero_compose
+    shape = tuple(p.shape)
     if not shape:
         return None
-    mesh = get_global_mesh()
-    size = mesh.shape.get(axis, 1) if mesh is not None and         axis in mesh.axis_names else 1
-    if size <= 1 or shape[0] % size != 0:
-        return (None,) * len(shape)
-    # dim 0 (paddle's sharding also flattens; dim0 is fine for GSPMD)
-    return (axis,) + (None,) * (len(shape) - 1)
+    return _zero_compose((None,) * len(shape), shape, get_global_mesh(),
+                         axis=axis)
 
 
 class GroupShardedStage2(Layer):
@@ -47,8 +46,10 @@ class GroupShardedStage2(Layer):
         self._optimizer = optimizer
         # mark optimizer state sharding: the TrainStep builder reads
         # p.opt_state_spec when laying out accumulators
+        from ...shard import mark_param
         for p in layer.parameters():
-            p.opt_state_spec = _flat_axis_spec(p)
+            mark_param(p, getattr(p, "dist_spec", None),
+                       opt_state_spec=_flat_axis_spec(p))
 
     def forward(self, *args, **kwargs):
         return self._layer(*args, **kwargs)
@@ -63,10 +64,10 @@ class GroupShardedStage3(Layer):
         object.__setattr__(self, "_layer", layer)  # see GroupShardedStage2
         self.add_sublayer("layer", layer)
         self._optimizer = optimizer
+        from ...shard import mark_param
         for p in layer.parameters():
             spec = _flat_axis_spec(p)
-            p.dist_spec = spec
-            p.opt_state_spec = spec
+            mark_param(p, spec, opt_state_spec=spec)
 
     def forward(self, *args, **kwargs):
         return self._layer(*args, **kwargs)
@@ -76,8 +77,10 @@ class GroupShardedOptimizerStage2:
     def __init__(self, params, optim, group=None, offload=False, device="tpu",
                  **kwargs):
         self._optim = optim
+        from ...shard import mark_param
         for p in params:
-            p.opt_state_spec = _flat_axis_spec(p)
+            mark_param(p, getattr(p, "dist_spec", None),
+                       opt_state_spec=_flat_axis_spec(p))
 
     def __getattr__(self, item):
         return getattr(self.__dict__["_optim"], item)

@@ -90,21 +90,12 @@ def with_constraint(arr, *spec):
 
 def batch_axis_constraint(h):
     """Pin activations to batch-axis sharding (dim 0 over dp and/or the
-    ZeRO 'sharding' axis). Without this GSPMD can propagate a ZeRO
-    parameter sharding into activations (full global batch replicated per
-    chip with hidden-dim all-gathers — measured 2 GB/buffer on the
-    ERNIE-10B v5e-64 plan); the explicit constraint is the standard GSPMD
-    ZeRO recipe. No-op without a mesh. Accepts a Tensor (dispatched, so
-    it records) or a raw array."""
-    if get_global_mesh() is None:
-        return h
-    from ..core.dispatch import apply_op
-    from ..core.tensor import Tensor
-    fn = lambda a: with_constraint(  # noqa: E731
-        a, ("dp", "sharding"), *(None,) * (a.ndim - 1))
-    if isinstance(h, Tensor):
-        return apply_op("shard_batch", fn, h)
-    return fn(h)
+    ZeRO 'sharding' axis) — kept as the historical name; the
+    implementation is the unified surface's ``shard.constrain_batch``
+    (see that docstring for the GSPMD ZeRO rationale). No-op without a
+    mesh. Accepts a Tensor (dispatched, so it records) or a raw array."""
+    from .shard import constrain_batch
+    return constrain_batch(h)
 
 
 def manual_shard_map(f, mesh, in_specs, out_specs):

@@ -447,8 +447,17 @@ class Predictor:
             cache[donate] = False
             return None
         n = len(self._artifact.feed_names)
+        from ..distributed.shard import constrain_batch
+
+        def _call(w, *xs):
+            # unified-surface batch constraint: under a serving mesh
+            # (dp replicas / ZeRO) the assembled batch pins to the
+            # batch axes instead of inheriting whatever GSPMD
+            # propagates from the weights; meshless runs are untouched
+            return exported.call(w, *(constrain_batch(x) for x in xs))
+
         cache[donate] = jax.jit(
-            lambda w, *xs: exported.call(w, *xs),
+            _call,
             donate_argnums=tuple(range(1, n + 1)) if donate else ())
         return cache[donate]
 

@@ -17,6 +17,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
 from ..core.tensor import Tensor
+from ..distributed import shard as shard_api
 from ..distributed.mesh_utils import get_global_mesh
 from ..framework import random as random_mod
 from ..nn.clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
@@ -25,14 +26,13 @@ from .functional import _swapped_state, state_arrays
 
 def _norm_spec(mesh, spec):
     """Degrade axes absent from (or trivial in) the mesh to replication so
-    single-chip runs are unchanged."""
-    return tuple(s if (s in mesh.axis_names and mesh.shape[s] > 1) else None
-                 for s in spec or ())
+    single-chip runs are unchanged (the unified surface's normalize)."""
+    return shard_api.normalize_spec(spec, mesh)
 
 
 def _param_sharding(mesh, p):
     """NamedSharding for a parameter from its ``dist_spec`` annotation
-    (set by TP layers / sharding stages)."""
+    (set by the unified sharding API / TP layers / sharding stages)."""
     return NamedSharding(mesh,
                          PartitionSpec(*_norm_spec(mesh,
                                                    getattr(p, "dist_spec",
@@ -56,10 +56,9 @@ def _global_put(a, sharding):
 
 
 def _batch_axes(mesh):
-    """Mesh axes the input batch dim is sharded over: dp and (ZeRO) sharding."""
-    axes = [a for a in ("dp", "sharding") if a in mesh.axis_names
-            and mesh.shape[a] > 1]
-    return tuple(axes)
+    """Mesh axes the input batch dim is sharded over: dp and (ZeRO)
+    sharding (the unified surface's batch_axes)."""
+    return shard_api.batch_axes(mesh)
 
 
 def _functional_clip(grad_clip, grads: dict) -> dict:
@@ -184,17 +183,25 @@ class TrainStep:
     def _step_fingerprint(self) -> str:
         """Identity of the compiled step WITHOUT tracing it: model class
         sources + parameter structure, loss/optimizer update-rule
-        sources, clip/AMP/scaler/schedule config, and the per-parameter
+        sources, clip/AMP/scaler/schedule config, the per-parameter
         constants the trace bakes in (weight decay, lr multipliers, ASP
-        masks). Anything that changes the lowered program must land
-        here — a collision serves wrong numerics from the cache."""
-        if self._step_fp is not None:
+        masks), and the sharding spec tree (dist_spec/opt_state_spec
+        shape the lowered SPMD program — two spec trees must never
+        share an executable). Anything that changes the lowered program
+        must land here — a collision serves wrong numerics from the
+        cache."""
+        gen = shard_api.specs_generation()
+        if self._step_fp is not None and \
+                getattr(self, "_step_fp_gen", None) == gen:
             return self._step_fp
+        self._step_fp_gen = gen
         from ..compile_cache import fingerprint as fpmod
         opt = self.optimizer
         parts = [
             fpmod.layer_fingerprint(self.model),
             fpmod.function_fingerprint(self.loss_fn),
+            "specs:" + shard_api.spec_tree_hash(
+                shard_api.model_spec_tree(self.model)),
             f"{type(opt).__module__}.{type(opt).__qualname__}",
             fpmod.function_fingerprint(opt._update_rule),
             repr(sorted(opt._accum_names)),
@@ -242,9 +249,11 @@ class TrainStep:
         multi = self._compiled is getattr(self, "_compiled_multi", None)
         tag = f"multi:{self._multi_n}" if multi else "single"
         leaves = jax.tree_util.tree_leaves(call_args)
-        # flags_generation: a set_flags call (flag flip / repointed
-        # cache dir) invalidates the memo, never serving a stale exec
-        sig = (flags_generation(), tag, tuple(
+        # flags_generation / specs_generation: a set_flags call (flag
+        # flip / repointed cache dir) or a sharding re-annotation
+        # (apply_sharding, shard_spec, mark_param) invalidates the
+        # memo, never serving a stale exec for the old spec tree
+        sig = (flags_generation(), shard_api.specs_generation(), tag, tuple(
             (tuple(getattr(a, "shape", ())),
              str(getattr(a, "dtype", type(a).__name__)))
             for a in leaves))

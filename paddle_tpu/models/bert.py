@@ -32,10 +32,10 @@ __all__ = ["BertConfig", "BertModel", "BertForPretraining",
 
 
 def _batch_constraint(h):
-    """ZeRO activation batch-sharding pin — shared GSPMD plumbing, see
-    distributed/mesh_utils.batch_axis_constraint."""
-    from ..distributed.mesh_utils import batch_axis_constraint
-    return batch_axis_constraint(h)
+    """ZeRO activation batch-sharding pin — the unified surface's
+    ``distributed.shard.constrain_batch`` (no-op without a mesh)."""
+    from ..distributed.shard import constrain_batch
+    return constrain_batch(h)
 
 
 @dataclass

@@ -29,7 +29,6 @@ from ..core.dispatch import apply_op
 from ..core.tensor import Tensor
 from ..distributed.fleet.meta_parallel.mp_layers import (
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
-from ..distributed.mesh_utils import get_global_mesh, with_constraint
 from ..nn import functional as F
 from ..nn import initializer as I
 from ..nn.initializer_utils import create_parameter_with_attr
@@ -120,12 +119,11 @@ def gpt3_1p3b(**kw) -> GPTConfig:
 
 def _seq_constraint(x):
     """Sequence-parallel activation sharding over the 'sep' mesh axis
-    ([B, S, H] → S sharded). No-op without a mesh or sep axis."""
-    mesh = get_global_mesh()
-    if mesh is None or "sep" not in mesh.axis_names or mesh.shape["sep"] == 1:
-        return x
-    return apply_op("sp_shard",
-                    lambda a: with_constraint(a, "dp", "sep", None), x)
+    ([B, S, H] → S sharded) — the unified surface's
+    ``distributed.shard.constrain_seq``. No-op without a mesh or sep
+    axis."""
+    from ..distributed.shard import constrain_seq
+    return constrain_seq(x)
 
 
 class GPTKVCache:

@@ -180,6 +180,19 @@ class Layer:
     def full_name(self):
         return self._name_scope
 
+    # ---------------- sharding ----------------
+    def shard_spec(self, spec_map=None, **attr_specs):
+        """Declarative sharding annotation for this layer's parameters
+        (the ``paddle_tpu.distributed.shard`` override hook): either
+        keyword-per-attribute — ``layer.shard_spec(weight=(None, "mp"))``
+        — or a glob spec-map over ``named_parameters`` paths —
+        ``model.shard_spec({"encoder.*.qkv_proj.weight": (None, "mp")})``.
+        Overrides beat the rule table in ``shard.spec_tree``; ``None``
+        is an explicit replicated override. Returns self for chaining."""
+        from ...distributed import shard as _shard
+        _shard.annotate(self, spec_map, **attr_specs)
+        return self
+
     # ---------------- modes ----------------
     def train(self):
         self.training = True

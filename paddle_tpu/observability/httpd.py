@@ -138,6 +138,29 @@ def _statusz() -> dict:
                           "device_count": jax.device_count()}
     except Exception:  # noqa: BLE001
         pass
+    try:  # what sharding this process runs (lazy — shard may be absent)
+        shard_mod = sys.modules.get("paddle_tpu.distributed.shard")
+        mesh_mod = sys.modules.get("paddle_tpu.distributed.mesh_utils")
+        if shard_mod is not None:
+            reg = default_registry()
+            fam = reg.gauge(
+                "paddle_shard_spec_tree_info",
+                "Spec-tree identity of the live process's sharding "
+                "(value 1; the hash label identifies the tree)",
+                labelnames=("hash",))
+            hashes = [labels.get("hash", "") for labels, child
+                      in fam.collect() if child.value]
+            sharding = {"specs_generation":
+                        shard_mod.specs_generation(),
+                        "spec_tree_hash": hashes[0] if hashes else None}
+            mesh = mesh_mod.get_global_mesh() \
+                if mesh_mod is not None else None
+            if mesh is not None:
+                sharding["mesh_axes"] = {
+                    str(k): int(v) for k, v in dict(mesh.shape).items()}
+            out["sharding"] = sharding
+    except Exception:  # noqa: BLE001
+        pass
     return out
 
 

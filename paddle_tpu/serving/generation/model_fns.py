@@ -95,7 +95,13 @@ class CachedDecoder:
 
         page = self.page_size
 
+        from ...distributed.shard import constrain_batch
+
         def _prefill(params, buffers, ids, prompt_lens, tables, k, v):
+            # unified-surface batch pin: under a dp serving mesh the
+            # prefill window shards by request row; meshless (the
+            # single-replica engine default) this is the identity
+            ids = constrain_batch(ids)
             b, s = ids.shape
             positions = jnp.broadcast_to(
                 jnp.arange(s, dtype=jnp.int32), (b, s))
@@ -117,6 +123,7 @@ class CachedDecoder:
 
         def _decode(params, buffers, tokens, positions, active, ctx,
                     tables, k, v):
+            tokens = constrain_batch(tokens)
             b = tokens.shape[0]
             ids = tokens[:, None]
             cache = GPTKVCache(

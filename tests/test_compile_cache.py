@@ -692,3 +692,104 @@ class TestMetricsExposition:
         s = cc.stats()
         assert set(s) >= {"hits", "misses", "errors", "evictions",
                           "stored", "bytes", "entries"}
+
+
+# ------------------------------------------- sharding spec coherence
+class TestShardingSpecKeys:
+    """ISSUE 10 satellite: sharded executables must never cross-hit —
+    the spec tree is part of both the persistent cache key and the
+    in-process per-signature memo generation."""
+
+    def test_two_spec_trees_distinct_cache_keys(self):
+        """Same function, same mesh, two different spec trees on the
+        operands -> two cache keys (avals carry the sharding spec)."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        def f(x):
+            return x * 2
+
+        fp = cc.function_fingerprint(f)
+        devs = np.array(jax.devices())
+        mesh = jax.sharding.Mesh(devs.reshape(-1), ("dp",))
+        x = np.ones((8, 4), np.float32)
+        a = jax.device_put(x, NamedSharding(mesh, PartitionSpec("dp")))
+        b = jax.device_put(x, NamedSharding(mesh, PartitionSpec()))
+        k_a, _ = cc.cache_key(fp, [a], mesh=mesh)
+        k_b, _ = cc.cache_key(fp, [b], mesh=mesh)
+        assert k_a != k_b
+
+    def test_step_fingerprint_tracks_spec_tree(self):
+        """TrainStep's trace-free fingerprint folds the model's
+        dist_spec/opt_state_spec tree in: re-annotating the SAME model
+        changes the step identity (same mesh key, different specs)."""
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.distributed import shard
+        from paddle_tpu.distributed.mesh_utils import build_mesh
+        from paddle_tpu.jit import TrainStep
+
+        net = _tiny_model()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=net.parameters())
+        step = TrainStep(net, lambda o, t: F.cross_entropy(o, t), opt)
+        fp1 = step._step_fingerprint()
+        mesh = build_mesh({"sharding": len(jax.devices())})
+        shard.apply_sharding(net, mesh=mesh, zero="p_g_os")
+        fp2 = step._step_fingerprint()
+        assert fp1 != fp2
+        # and it is stable when nothing changes
+        assert step._step_fingerprint() == fp2
+
+    def test_spec_change_midprocess_invalidates_exec_memo(self,
+                                                          cache_dir):
+        """Flags-generation-style: a sharding re-annotation between
+        steps must invalidate the per-signature AOT memo — the next
+        step consults the cache freshly (a miss under the new spec
+        tree) instead of serving the stale executable."""
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.distributed import shard
+        from paddle_tpu.jit import TrainStep
+
+        net = _tiny_model()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=net.parameters())
+        step = TrainStep(net, lambda o, t: F.cross_entropy(o, t), opt)
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(4, 8).astype("float32"))
+        y = paddle.to_tensor(np.arange(4, dtype="int64") % 4)
+        step(x, y)
+        step(x, y)                       # memo answers
+        before = cc.stats()
+        # meshless annotation: specs all degrade to replicated, so the
+        # numerics and compiled structure are unchanged — but the memo
+        # generation must still turn over (the annotation COULD have
+        # changed layout; staleness is decided by generation, not luck)
+        shard.apply_sharding(net, mesh=None)
+        l3 = float(step(x, y).numpy())
+        after = cc.stats()
+        assert after["misses"] == before["misses"] + 1, \
+            "stale per-signature executable served across a spec change"
+        assert np.isfinite(l3)
+
+    def test_annotation_via_layer_shard_spec_also_invalidates(
+            self, cache_dir):
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.jit import TrainStep
+
+        net = _tiny_model()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=net.parameters())
+        step = TrainStep(net, lambda o, t: F.cross_entropy(o, t), opt)
+        x = paddle.to_tensor(np.random.RandomState(1)
+                             .randn(4, 8).astype("float32"))
+        y = paddle.to_tensor(np.arange(4, dtype="int64") % 4)
+        step(x, y)
+        step(x, y)                       # memo answers; no traffic
+        before = cc.stats()
+        net.shard_spec({"0.weight": (None, "mp")})
+        step(x, y)
+        after = cc.stats()
+        # the annotation bumps the generation, so the memo is NOT
+        # served — but an unapplied override does not change the step
+        # identity, so the fresh cache consult is a HIT, not a miss
+        assert after["hits"] == before["hits"] + 1
+        assert after["misses"] == before["misses"]

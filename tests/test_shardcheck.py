@@ -1,0 +1,153 @@
+"""tools/shardcheck.py — the no-TPU per-chip memory regression gate.
+
+Fast tier: the tiny plans (ernie_tiny_zero3 = LazyGuard + ZeRO-3 +
+AOT; gpt_tiny_tp = rule-table TP) compile on the 8-device virtual CPU
+mesh and must gate clean against the committed baseline; an injected
+regression (budget cut / doctored baseline) must fail the gate. Slow
+tier: the full ERNIE-10B plan (the CLI / CI job path).
+"""
+import copy
+import json
+import os
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from tools import shardcheck  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def fast_records():
+    """Run the fast plans ONCE for the whole module (each is a real
+    AOT compile)."""
+    return {name: shardcheck.run_plan(name)
+            for name in shardcheck.FAST_PLANS}
+
+
+class TestFastPlans:
+    def test_records_have_schema(self, fast_records):
+        for name, rec in fast_records.items():
+            assert rec["schema"] == shardcheck.SCHEMA
+            assert rec["plan"] == name
+            assert rec["per_chip"]["args_bytes"] > 0
+            assert rec["spec_tree_hash"]
+            assert rec["n_chips_compiled"] == 8
+
+    def test_zero3_sharding_took(self, fast_records):
+        """The compiled artifact's per-chip argument bytes must show
+        the 8-way ZeRO split actually happened: ~1/8 of the full
+        model+opt state, not the replicated total."""
+        rec = fast_records["ernie_tiny_zero3"]
+        n_params = rec["n_params"]
+        # f32 params + 2 bf16 moments = 8 bytes/param, + small buffers
+        full_state = n_params * 8
+        assert rec["per_chip"]["args_bytes"] < full_state / 8 * 1.5, \
+            "per-chip args near the replicated total: ZeRO did not take"
+        assert rec["sharded_fraction_bytes"] > 0.9
+
+    def test_predict_step_also_compiled(self, fast_records):
+        """The plan covers serving too: the forward-only compile's
+        per-chip args are roughly the sharded params alone (about half
+        the train step's params+moments)."""
+        rec = fast_records["ernie_tiny_zero3"]
+        assert rec["predict_per_chip"] is not None
+        assert 0 < rec["predict_per_chip"]["args_bytes"] < \
+            rec["per_chip"]["args_bytes"]
+
+    def test_gate_clean_against_committed_baseline(self, fast_records):
+        baseline = shardcheck.load_baseline(shardcheck.DEFAULT_BASELINE)
+        for name, rec in fast_records.items():
+            assert name in baseline, \
+                f"missing committed baseline entry for {name}"
+            fails = shardcheck.gate_record(rec, baseline[name])
+            assert fails == [], f"{name}: {fails}"
+
+    def test_gate_fails_on_injected_arg_regression(self, fast_records):
+        """A sharding break (e.g. a spec tree collapsing to replicated)
+        shows up as an args-bytes jump — the gate must catch it."""
+        baseline = shardcheck.load_baseline(shardcheck.DEFAULT_BASELINE)
+        rec = copy.deepcopy(fast_records["ernie_tiny_zero3"])
+        rec["per_chip"]["args_bytes"] *= 8          # replicated total
+        fails = shardcheck.gate_record(rec, baseline["ernie_tiny_zero3"])
+        assert any("argument bytes" in f for f in fails)
+
+    def test_gate_fails_on_budget_overrun(self, fast_records):
+        baseline = shardcheck.load_baseline(shardcheck.DEFAULT_BASELINE)
+        rec = copy.deepcopy(fast_records["ernie_tiny_zero3"])
+        rec["budget_gib"] = 1e-9                    # everything overruns
+        fails = shardcheck.gate_record(rec, baseline["ernie_tiny_zero3"])
+        assert any("budget" in f for f in fails)
+
+    def test_gate_fails_on_spec_tree_change(self, fast_records):
+        baseline = copy.deepcopy(
+            shardcheck.load_baseline(shardcheck.DEFAULT_BASELINE))
+        base = baseline["ernie_tiny_zero3"]
+        base["spec_tree_hash"] = "0" * 64
+        fails = shardcheck.gate_record(
+            fast_records["ernie_tiny_zero3"], base)
+        assert any("spec tree changed" in f for f in fails)
+
+    def test_gate_fails_on_sharded_fraction_drop(self, fast_records):
+        baseline = shardcheck.load_baseline(shardcheck.DEFAULT_BASELINE)
+        rec = copy.deepcopy(fast_records["gpt_tiny_tp"])
+        rec["sharded_fraction_bytes"] = 0.1
+        fails = shardcheck.gate_record(rec, baseline["gpt_tiny_tp"])
+        assert any("fraction dropped" in f for f in fails)
+
+
+class TestBaselineFile:
+    def test_committed_baseline_covers_all_plans(self):
+        baseline = shardcheck.load_baseline(shardcheck.DEFAULT_BASELINE)
+        assert set(shardcheck.PLANS) <= set(baseline)
+
+    def test_ernie10b_baseline_within_budget(self):
+        """The committed ERNIE-10B projection must sit within the
+        15.75 GiB/chip v5e budget — the acceptance number."""
+        baseline = shardcheck.load_baseline(shardcheck.DEFAULT_BASELINE)
+        rec = baseline["ernie10b"]
+        assert rec["budget_gib"] == 15.75
+        assert rec["projected_per_chip"]["target_chips"] == 64
+        assert rec["projected_per_chip"]["model_state_gib"] <= 15.75
+        assert rec["sharded_fraction_bytes"] > 0.99
+
+    def test_baseline_roundtrip(self, tmp_path, ):
+        baseline = shardcheck.load_baseline(shardcheck.DEFAULT_BASELINE)
+        path = str(tmp_path / "b.json")
+        shardcheck.write_baseline(path, baseline, tolerance=0.2)
+        again = shardcheck.load_baseline(path)
+        assert set(again) == set(baseline)
+        assert all(again[k]["tolerance"] == 0.2 for k in again)
+
+    def test_unknown_plan_cli_exits_2(self, capsys):
+        assert shardcheck.main(["--plans", "nope"]) == 2
+
+
+@pytest.mark.slow
+class TestErnie10B:
+    def test_full_plan_gates_clean(self):
+        """The real thing: AOT-compile the 9.9B-param ZeRO-3 step
+        (LazyGuard abstract params) and gate against the committed
+        baseline, including the 64-chip projection and budget."""
+        rec = shardcheck.run_plan("ernie10b")
+        baseline = shardcheck.load_baseline(shardcheck.DEFAULT_BASELINE)
+        fails = shardcheck.gate_record(rec, baseline["ernie10b"])
+        assert fails == [], fails
+        assert rec["n_params"] > 9e9
+        assert rec["projected_per_chip"]["model_state_gib"] <= 15.75
+
+
+def test_cli_json_shape(tmp_path, fast_records, capsys, monkeypatch):
+    """--json output carries records + failures; the committed
+    baseline keeps it green (rc 0)."""
+    monkeypatch.setattr(
+        shardcheck, "run_plan",
+        lambda name, tpu_topology="": fast_records[name])
+    rc = shardcheck.main(["--plans", ",".join(shardcheck.FAST_PLANS),
+                          "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert set(doc["records"]) == set(shardcheck.FAST_PLANS)
+    assert doc["failures"] == {}

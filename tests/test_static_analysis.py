@@ -436,7 +436,55 @@ class TestLockDiscipline:
         an = LockDisciplineAnalyzer()
         assert an.dirs == ("paddle_tpu/serving/",
                            "paddle_tpu/observability/",
-                           "paddle_tpu/elastic/")
+                           "paddle_tpu/elastic/",
+                           "paddle_tpu/distributed/")
+
+    def test_scope_includes_distributed_shard_module(self, tmp_path):
+        """Scope self-test for the unified sharding API: the
+        distributed/ prefix must reach the shard module — its
+        generation counter and metric registration are lock-guarded
+        shared state, so an injected unguarded write there is
+        reported."""
+        pkg = tmp_path / "paddle_tpu" / "distributed"
+        pkg.mkdir(parents=True)
+        (pkg / "shard.py").write_text(textwrap.dedent("""
+            import threading
+
+            class SpecState:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._generation = 0
+
+                def bump(self):
+                    with self._lock:
+                        self._generation += 1
+
+                def sloppy_reset(self):
+                    self._generation = 0
+        """))
+        findings = _run(tmp_path, [LockDisciplineAnalyzer()])
+        assert any(f.rule == "LK001" and "distributed/shard" in f.path
+                   for f in findings)
+
+    def test_tracer_safety_reaches_distributed_shard(self, tmp_path):
+        """The tracer-safety analyzer must flag impurity inside jitted
+        code in paddle_tpu/distributed/ — constraint helpers run under
+        every traced step, so a wall-clock read there would freeze into
+        the compiled program."""
+        pkg = tmp_path / "paddle_tpu" / "distributed"
+        pkg.mkdir(parents=True)
+        (pkg / "shard.py").write_text(textwrap.dedent("""
+            import time
+            import jax
+
+            @jax.jit
+            def constrain(x):
+                t = time.time()
+                return x * t
+        """))
+        findings = _run(tmp_path, [TracerSafetyAnalyzer()])
+        assert any(f.rule == "TS004" and "distributed/shard" in f.path
+                   for f in findings)
 
     def test_scope_includes_decode_engine_subpackage(self, tmp_path):
         """The serving/ prefix must reach the generation subpackage —

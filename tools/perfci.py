@@ -1,0 +1,326 @@
+"""perfci — the committed-perf-record regression gate (ROADMAP item 5).
+
+Every bench in this repo emits one JSON record; the committed copies
+(``BENCH_*.json``, ``TRACE_r01.json``, ``ELASTIC_r01.json``) are the
+perf trajectory. This tool loads them and enforces tolerance gates —
+train tok/s, decode/serving throughput and tail latency, fleet QPS,
+cold-start ratio, tracing overhead, elastic-recovery invariants — so
+every speed claim is enforced, not anecdotal.
+
+Skip classification reuses ``tools/_bench_common.py`` semantics: a
+record with ``"skipped": true`` (or the ``backend_unavailable``
+diagnostic metric, or a crashed ``rc != 0`` wrapper with no parsed
+measurement) is "no measurement", NOT "measured zero" — each gate
+evaluates the LATEST MEASURED record for its metric and reports
+newer skipped rounds as stale-measurement diagnostics.
+
+The "recorded sweeps that did NOT win" list from PERF.md ships here as
+machine-readable do-not-retry annotations (``--do-not-retry`` /
+``do_not_retry_for()``), so automation can refuse to re-run a sweep
+that was already measured as a loss.
+
+Usage:
+
+    python tools/perfci.py                 # gate the committed records
+    python tools/perfci.py --json          # machine-readable report
+    python tools/perfci.py --records DIR   # gate a different record dir
+    python tools/perfci.py --do-not-retry  # dump the sweep annotations
+
+Exit codes: 0 = every gate passes or is skipped-with-reason, 1 = a
+measured record regressed past tolerance, 2 = usage/internal error.
+The CI twin is tests/test_perfci.py.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from tools._bench_common import backend_unavailable  # noqa: E402,F401
+
+
+# ------------------------------------------------------------- gates
+# op: "min" — value must stay >= baseline*(1-rel_tol);
+#     "max" — value must stay <= baseline*(1+rel_tol);
+#     "true" — value must be truthy (invariant, no tolerance).
+GATES: List[Dict[str, Any]] = [
+    {"name": "train_tok_s_1p3b", "metric": "gpt3_1p3b_train_tokens_per_sec",
+     "files": "BENCH_r*.json", "path": ("value",),
+     "op": "min", "baseline": 10805.0, "rel_tol": 0.05,
+     "unit": "tokens/s",
+     "why": "PERF.md north star: GPT-3 1.3B b=2 s=2048 ~49.9% MFU"},
+    {"name": "decode_tok_s", "metric": "decode_tokens_per_sec",
+     "files": "BENCH_DECODE_r*.json", "path": ("value",),
+     "op": "min", "baseline": 8534.9, "rel_tol": 0.10,
+     "unit": "tokens/s",
+     "why": "continuous-batching decode throughput (PR 7)"},
+    {"name": "decode_p99_inter_token_ms",
+     "metric": "decode_tokens_per_sec",
+     "files": "BENCH_DECODE_r*.json",
+     "path": ("engine_p99_inter_token_ms",),
+     "op": "max", "baseline": 1.975, "rel_tol": 0.25, "unit": "ms",
+     "why": "decode tail latency between tokens"},
+    {"name": "fleet_qps", "metric": "fleet_aggregate_qps",
+     "files": "BENCH_FLEET_r*.json", "path": ("value",),
+     "op": "min", "baseline": 2524.0, "rel_tol": 0.10, "unit": "req/s",
+     "why": "4-replica router aggregate throughput (PR 8)"},
+    {"name": "fleet_coldstart_ratio", "metric": "fleet_aggregate_qps",
+     "files": "BENCH_FLEET_r*.json",
+     "path": ("scale_out", "warm_speedup"),
+     "op": "min", "baseline": 2.95, "rel_tol": 0.15, "unit": "x",
+     "why": "warm scale-out vs cold replica start (PR 5 compile cache)"},
+    {"name": "trace_accounting", "metric": "fleet_trace_span_accounting",
+     "files": "TRACE_r*.json",
+     "path": ("accounting", "accounting_consistent"),
+     "op": "true",
+     "why": "distributed tracing must not lose spans (PR 9)"},
+    {"name": "trace_overhead_pct", "metric": "fleet_trace_span_accounting",
+     "files": "TRACE_r*.json", "path": ("overhead", "regression_pct"),
+     "op": "max", "baseline": 0.0, "abs_tol": 5.0, "unit": "%",
+     "why": "sampled tracing QPS cost stays under 5%"},
+    {"name": "elastic_digest_equal", "metric": "__elastic__",
+     "files": "ELASTIC_r*.json", "path": ("final_digest_equal",),
+     "op": "true",
+     "why": "kill -9 recovery restores bit-identical state (PR 6)"},
+    {"name": "elastic_restore_ms", "metric": "__elastic__",
+     "files": "ELASTIC_r*.json", "path": ("median_restore_ms",),
+     "op": "max", "baseline": 5.7, "abs_tol": 50.0, "unit": "ms",
+     "why": "checkpoint restore must stay interactive-fast"},
+]
+
+
+# -------------------------------------------- do-not-retry annotations
+# PERF.md "Recorded sweeps that did NOT win", machine-readable: an
+# automation loop consults do_not_retry_for() before re-running a
+# sweep; each entry records what was measured so the negative result
+# is citable without re-paying for it.
+DO_NOT_RETRY: List[Dict[str, str]] = [
+    {"config": "gpt3_1p3b", "sweep": "flash-block sizes around 512x1024",
+     "result": "256x1024 -> 10664, 512x512 -> 10813, 1024x1024 -> 10822 "
+               "tok/s; all within ±2% noise of 10805",
+     "verdict": "defaults kept", "source": "PERF.md round 3"},
+    {"config": "gpt3_1p3b", "sweep": "batch=4 at s=2048",
+     "result": "OOM", "verdict": "b=2 is the single-chip ceiling with "
+     "f32 master params + bf16 moments + full remat",
+     "source": "PERF.md round 3"},
+    {"config": "gpt3_1p3b", "sweep": "recompute=dots / recompute=none",
+     "result": "runtime-tunnel compile helper crashes (HTTP 500, "
+               "reproducible)", "verdict": "full remat is the only "
+     "compilable 1.3B policy on this host", "source": "PERF.md round 3"},
+    {"config": "gpt3_1p3b", "sweep": "recompute=attn (save attention "
+     "outputs only)", "result": "10381 tok/s, WORSE than full remat",
+     "verdict": "save boundary costs more in lost fusion than the "
+     "recompute saves; policy stays available for memory-shaped "
+     "configs", "source": "PERF.md round 3"},
+    {"config": "ernie10b_aot", "sweep": "latency-hiding scheduler off",
+     "result": "UNIMPLEMENTED on the v5e-64 topology (async "
+               "collective-permute routing limitation)",
+     "verdict": "keep LHS on", "source": "PERF.md round 3"},
+    {"config": "gpt2_medium", "sweep": "batch 24/32",
+     "result": "OOM or slower", "verdict": "b=16 kept",
+     "source": "PERF.md round 2"},
+    {"config": "gpt2_774m+", "sweep": "recompute=dots",
+     "result": "OOM or slower", "verdict": "full remat at 774M+",
+     "source": "PERF.md round 2"},
+    {"config": "gpt2_medium", "sweep": "bf16 optimizer moments",
+     "result": "no speed win", "verdict": "kept only for memory-bound "
+     "configs", "source": "PERF.md round 2"},
+    {"config": "*", "sweep": "logsumexp cross-entropy rewrite",
+     "result": "no win", "verdict": "dropped", "source": "PERF.md round 2"},
+    {"config": "*", "sweep": "one-hot embedding backward",
+     "result": "no win", "verdict": "dropped", "source": "PERF.md round 2"},
+]
+
+
+def do_not_retry_for(config: str, sweep: Optional[str] = None
+                     ) -> List[Dict[str, str]]:
+    """Annotations matching a config (and optionally a sweep
+    substring) — consult before re-running a recorded sweep."""
+    out = []
+    for e in DO_NOT_RETRY:
+        if e["config"] not in ("*", config):
+            continue
+        if sweep and sweep.lower() not in e["sweep"].lower():
+            continue
+        out.append(dict(e))
+    return out
+
+
+# ------------------------------------------------------------ records
+_ROUND = re.compile(r"_r(\d+)\.json$")
+
+
+def _round_of(path: str) -> int:
+    m = _ROUND.search(os.path.basename(path))
+    return int(m.group(1)) if m else 0
+
+
+def normalize_record(path: str, doc: dict) -> dict:
+    """One record, classified: ``{"file", "round", "record",
+    "status"}`` with status "measured" | "skipped" | "crashed".
+    Wrapper-style BENCH_r files carry the measurement under "parsed"
+    with the driver rc alongside."""
+    rec = doc.get("parsed", doc)
+    rc = doc.get("rc")
+    if rec is None or (rc is not None and rc != 0 and "parsed" not in doc):
+        status = "crashed"
+        rec = {}
+    elif rec.get("skipped") or rec.get("metric") == "backend_unavailable":
+        status = "skipped"
+    elif rc is not None and rc != 0:
+        status = "crashed"
+    else:
+        status = "measured"
+    return {"file": os.path.basename(path), "round": _round_of(path),
+            "record": rec, "status": status}
+
+
+def load_records(root: str, pattern: str) -> List[dict]:
+    """All records matching the glob, newest round first."""
+    out = []
+    for path in glob.glob(os.path.join(root, pattern)):
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            out.append({"file": os.path.basename(path),
+                        "round": _round_of(path),
+                        "record": {}, "status": "crashed",
+                        "error": str(e)})
+            continue
+        out.append(normalize_record(path, doc))
+    return sorted(out, key=lambda r: -r["round"])
+
+
+def _dig(rec: dict, path) -> Any:
+    cur = rec
+    for k in path:
+        if not isinstance(cur, dict) or k not in cur:
+            return None
+        cur = cur[k]
+    return cur
+
+
+def evaluate_gate(gate: dict, records: List[dict]) -> dict:
+    """One gate against its record series: the newest MEASURED record
+    matching the gate's metric carries the value; newer skipped/crashed
+    rounds are reported as staleness diagnostics."""
+    matching = [r for r in records
+                if gate["metric"] == "__elastic__"
+                or r["record"].get("metric") == gate["metric"]
+                or r["status"] != "measured"]
+    measured = [r for r in matching if r["status"] == "measured"
+                and (gate["metric"] == "__elastic__"
+                     or r["record"].get("metric") == gate["metric"])]
+    res = {"gate": gate["name"], "metric": gate["metric"],
+           "why": gate["why"], "stale_rounds":
+               [f"{r['file']}:{r['status']}" for r in matching
+                if r["status"] != "measured"
+                and r["round"] > (measured[0]["round"] if measured
+                                  else -1)]}
+    if not measured:
+        res.update(status="skip", reason="no measured record committed")
+        return res
+    rec = measured[0]
+    value = _dig(rec["record"], gate["path"])
+    res["file"] = rec["file"]
+    res["value"] = value
+    if value is None:
+        res.update(status="skip",
+                   reason=f"field {'.'.join(gate['path'])} absent")
+        return res
+    op = gate["op"]
+    if op == "true":
+        ok = bool(value)
+        res.update(status="pass" if ok else "fail",
+                   reason=None if ok else "invariant is false")
+        return res
+    base = float(gate["baseline"])
+    if "abs_tol" in gate:
+        lo, hi = base - gate["abs_tol"], base + gate["abs_tol"]
+    else:
+        tol = float(gate.get("rel_tol", 0.1))
+        lo, hi = base * (1 - tol), base * (1 + tol)
+    value = float(value)
+    if op == "min":
+        ok = value >= lo
+        res["threshold"] = lo
+    else:
+        ok = value <= hi
+        res["threshold"] = hi
+    res.update(status="pass" if ok else "fail",
+               reason=None if ok else
+               f"{value} {gate.get('unit', '')} vs baseline {base} "
+               f"(threshold {res['threshold']:.4g}, op {op})")
+    return res
+
+
+def run(records_dir: str, gates: Optional[List[dict]] = None) -> dict:
+    gates = gates if gates is not None else GATES
+    results = []
+    for gate in gates:
+        records = load_records(records_dir, gate["files"])
+        results.append(evaluate_gate(gate, records))
+    counts = {"pass": 0, "fail": 0, "skip": 0}
+    for r in results:
+        counts[r["status"]] += 1
+    return {"version": 1, "records_dir": records_dir,
+            "results": results, "counts": counts,
+            "do_not_retry": DO_NOT_RETRY}
+
+
+# ----------------------------------------------------------------- cli
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="perfci", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--records", default=REPO_ROOT,
+                   help="directory holding the committed *_r*.json "
+                        "records (default: repo root)")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    p.add_argument("--do-not-retry", action="store_true",
+                   dest="dump_dnr",
+                   help="print the machine-readable do-not-retry sweep "
+                        "annotations and exit")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.dump_dnr:
+        print(json.dumps(DO_NOT_RETRY, indent=1, sort_keys=True))
+        return 0
+    if not os.path.isdir(args.records):
+        print(f"perfci: no such record dir: {args.records}",
+              file=sys.stderr)
+        return 2
+    report = run(args.records)
+    if args.as_json:
+        print(json.dumps(report, indent=1, sort_keys=True))
+        return 1 if report["counts"]["fail"] else 0
+    for r in report["results"]:
+        line = f"perfci[{r['gate']}]: {r['status'].upper()}"
+        if "value" in r and r.get("value") is not None:
+            line += f" value={r['value']}"
+        if r.get("file"):
+            line += f" ({r['file']})"
+        if r.get("reason"):
+            line += f" — {r['reason']}"
+        if r.get("stale_rounds"):
+            line += f" [stale: {', '.join(r['stale_rounds'])}]"
+        print(line)
+    c = report["counts"]
+    print(f"perfci: {c['pass']} pass, {c['skip']} skip, "
+          f"{c['fail']} fail over {len(report['results'])} gate(s)")
+    return 1 if c["fail"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
