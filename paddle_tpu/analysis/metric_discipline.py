@@ -17,6 +17,12 @@ contract over the same trees the flag analyzer covers:
          negative numeric duration literal — durations are measured,
          never negative; a negative literal is a sign error waiting to
          skew a latency percentile
+  MD003  Prometheus naming-convention suffixes: a counter registered
+         without a ``_total`` suffix, or a histogram whose name lacks
+         a unit suffix (``_ms`` / ``_bytes`` / ``_seconds``) — the
+         scraped name is the unit contract; an unsuffixed counter
+         reads like a gauge on a dashboard and an unitless histogram
+         invites ms-vs-seconds confusion downstream
 
 Only calls whose first argument is a string literal count as
 registrations, so ``np.histogram(arr, bins=...)`` and dynamic names
@@ -37,6 +43,7 @@ __all__ = ["MetricDisciplineAnalyzer"]
 _NAME_PATTERN = re.compile(r"paddle_[a-z0-9_]+")
 _REGISTER_METHODS = ("counter", "gauge", "histogram")
 _OBSERVE_METHODS = ("observe", "observe_many")
+_HISTOGRAM_UNIT_SUFFIXES = ("_ms", "_bytes", "_seconds")
 
 
 def _neg_literals(node: ast.AST) -> List[Tuple[float, int, int]]:
@@ -103,6 +110,21 @@ class MetricDisciplineAnalyzer(Analyzer):
                     f"registry metric name {r.name!r} must match "
                     f"paddle_[a-z0-9_]+ (lowercase, paddle_ prefix)",
                     symbol=r.name, detail=r.name))
+            if r.kind == "counter" and not r.name.endswith("_total"):
+                findings.append(Finding(
+                    self.name, "MD003", r.path, r.line, r.col,
+                    f"counter {r.name!r} lacks the _total suffix — "
+                    f"Prometheus counters are cumulative and the "
+                    f"suffix is the convention dashboards key on",
+                    symbol=r.name, detail="counter_suffix"))
+            elif r.kind == "histogram" and not \
+                    r.name.endswith(_HISTOGRAM_UNIT_SUFFIXES):
+                findings.append(Finding(
+                    self.name, "MD003", r.path, r.line, r.col,
+                    f"histogram {r.name!r} lacks a unit suffix "
+                    f"({'/'.join(_HISTOGRAM_UNIT_SUFFIXES)}) — the "
+                    f"scraped name is the unit contract",
+                    symbol=r.name, detail="histogram_unit"))
             prev = first_kind.get(r.name)
             if prev is None:
                 first_kind[r.name] = r

@@ -254,7 +254,12 @@ class CompileCache:
         fn = self.load(key, site=site)
         if fn is not None:
             return fn, True
-        compiled = build()
+        # a miss compiles: the build is compile badput on the goodput
+        # ledger (a frame, so jax.monitoring compile events firing
+        # inside claim their share instead of double-counting)
+        from ..observability.goodput import default_ledger
+        with default_ledger().timed("compile"):
+            compiled = build()
         self.store(key, compiled, meta=meta, site=site,
                    exported_fallback=exported_fallback)
         return compiled, False

@@ -27,6 +27,10 @@ crash-safe ``framework.checkpoint`` layer:
   ``paddle_ckpt_last_success_step`` gauges, ``paddle_ckpt_saves_total``
   / ``paddle_ckpt_corrupt_total`` / ``paddle_ckpt_steps_lost_total``
   counters, and a ``/healthz`` staleness check on the PR 3 endpoint.
+  The goodput ledger is fed too: the synchronous half of every save is
+  ``ckpt_save`` badput, restores are ``ckpt_restore``, and a restore's
+  steps-lost count arms replay attribution — the re-run steps land in
+  ``recovery`` instead of ``step``.
 
 Steps lost on preemption are measured, not guessed: ``step()`` drops a
 tiny atomic ``PROGRESS`` marker each call, and ``restore_latest()``
@@ -367,19 +371,30 @@ class CheckpointManager:
         metrics + ``last_error``) rather than raised, so a sick
         filesystem degrades durability, not training."""
         self.wait()  # errors from the previous save land in _last_error
-        arrays, extra = self._capture(step, epoch, offset,
-                                      dataloader_state, reason)
-        path = os.path.join(self.directory, _STEP_DIR_FMT.format(int(step)))
-        t0 = self._now()
-        with self._lock:
-            self._last_attempt_time = t0
-        use_async = self.async_save and not block
+        # goodput: only the SYNCHRONOUS part of a save (state capture +
+        # device->host snapshot, plus the full write when blocking)
+        # stalls training; the async writer thread runs alongside steps
+        # and is deliberately not badput
+        from ..observability.goodput import default_ledger
+        ledger = default_ledger()
+        ledger.begin("ckpt_save")
         try:
-            handle = save_sharded(arrays, path, async_save=use_async,
-                                  extra=extra)
-        except Exception as e:  # noqa: BLE001 - record, don't kill train
-            self._record_save_result(step, error=e)
-            return None
+            arrays, extra = self._capture(step, epoch, offset,
+                                          dataloader_state, reason)
+            path = os.path.join(self.directory,
+                                _STEP_DIR_FMT.format(int(step)))
+            t0 = self._now()
+            with self._lock:
+                self._last_attempt_time = t0
+            use_async = self.async_save and not block
+            try:
+                handle = save_sharded(arrays, path, async_save=use_async,
+                                      extra=extra)
+            except Exception as e:  # noqa: BLE001 - record, don't kill
+                self._record_save_result(step, error=e)  # training
+                return None
+        finally:
+            ledger.end()
         if handle is None:
             self._record_save_result(step, error=None)
             return None
@@ -463,14 +478,17 @@ class CheckpointManager:
         directories are quarantined (``<dir>.corrupt-*``) and skipped —
         after any kill, some checkpoint loads or None is returned (the
         caller starts fresh)."""
+        from ..observability.goodput import default_ledger
+        ledger = default_ledger()
         self.wait()
         progress = self._read_progress()
         t0 = self._now()
         for path in reversed(list_checkpoints(self.directory)):
             try:
-                loaded = load_sharded(path, mesh=mesh)
-                extra = load_checkpoint_extra(path) or {}
-                self._apply(loaded, extra)
+                with ledger.timed("ckpt_restore"):
+                    loaded = load_sharded(path, mesh=mesh)
+                    extra = load_checkpoint_extra(path) or {}
+                    self._apply(loaded, extra)
             except CheckpointCorruptError:
                 self._m_corrupt.inc()
                 quarantine_checkpoint(path)
@@ -482,6 +500,9 @@ class CheckpointManager:
                 if (progress is not None and step >= 0) else 0
             if steps_lost:
                 self._m_steps_lost.inc(steps_lost)
+                # the next steps_lost step frames are replayed work —
+                # MegaScale's preemption-recovery badput, not goodput
+                ledger.arm_replay(steps_lost)
             with self._lock:
                 self._last_success_step = step
                 self._last_success_walltime = time.time()

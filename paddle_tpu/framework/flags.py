@@ -228,6 +228,27 @@ define_flag("FLAGS_profiler_span_metrics", False,
             "paddle_profiler_span_ms histogram so chrome traces and "
             "scraped /metrics agree")
 
+# Goodput ledger + continuous step profiler + SLO monitor
+# (paddle_tpu.observability.{goodput,stepprof,slo}).
+define_flag("FLAGS_goodput_tolerance", 0.02,
+            "goodput_report() accounting tolerance: the report's "
+            "'closes' bit requires categories (incl. derived idle) to "
+            "sum to elapsed wall-clock within this fraction")
+define_flag("FLAGS_stepprof_window", 512,
+            "bound of the continuous step profiler's per-step "
+            "envelope ring (oldest envelopes are evicted past this)")
+define_flag("FLAGS_stepprof_anomaly_k", 6.0,
+            "straggler threshold: a step slower than "
+            "ewma + k * 1.4826 * MAD of its kind is flagged and "
+            "promoted into the trace flight recorder as an error span")
+define_flag("FLAGS_stepprof_min_samples", 32,
+            "step samples per kind before the straggler detector "
+            "arms (the EWMA/MAD baseline warm-up)")
+define_flag("FLAGS_slo_eval_interval_s", 10.0,
+            "cadence of the background SLO evaluator thread "
+            "(SLOMonitor.start(); explicit evaluate() calls are "
+            "always allowed)")
+
 # Distributed request tracing (paddle_tpu.observability.tracing —
 # router->worker->engine spans + the /tracez flight recorder).
 define_flag("FLAGS_trace_sample_rate", 0.0,

@@ -2,9 +2,9 @@
 STAT_ADD/STAT_RESET int64 counters exported for observability).
 
 Backed by the unified telemetry layer: the stats ARE a label set on the
-``paddle_monitor_stat`` Counter in ``observability.default_registry()``,
+``paddle_monitor_stat_total`` Counter in ``observability.default_registry()``,
 so everything recorded here shows up verbatim on a scraped ``/metrics``
-page as ``paddle_monitor_stat{name="..."}``. The historical flat-int
+page as ``paddle_monitor_stat_total{name="..."}``. The historical flat-int
 API (stat_add/stat_get/stat_reset/stat_names) is unchanged;
 ``stats_snapshot()`` is the sanctioned bulk export — nothing outside
 this module should reach into the underlying storage.
@@ -16,7 +16,7 @@ from typing import Dict, Optional
 from ..observability.registry import default_registry
 
 _counter = default_registry().counter(
-    "paddle_monitor_stat",
+    "paddle_monitor_stat_total",
     "framework STAT_ADD int64 counters (platform/monitor.cc analog)",
     ("name",))
 

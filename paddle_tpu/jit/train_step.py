@@ -606,7 +606,31 @@ class TrainStep:
         return lowered.compile(compiler_options)
 
     def __call__(self, *batch, n_inputs: Optional[int] = None):
-        """batch = model inputs followed by loss_fn extra args (labels)."""
+        """batch = model inputs followed by loss_fn extra args (labels).
+
+        The call runs inside a goodput ``step`` frame (compile events
+        fired by jax.monitoring during a first-call trace claim their
+        seconds out of the frame, so step vs compile attribution is
+        exact) and drops one envelope into the continuous step
+        profiler — stragglers become error spans in the flight
+        recorder."""
+        from ..observability.goodput import default_ledger
+        from ..observability.stepprof import default_profiler
+        ledger = default_ledger()
+        ledger.begin("step")
+        try:
+            out = self._call_inner(*batch, n_inputs=n_inputs)
+        finally:
+            wall_s = ledger.end()
+            try:
+                default_profiler().record_step(
+                    wall_s * 1e3, kind="train",
+                    step=int(self.optimizer._step_count))
+            except Exception:  # noqa: BLE001 - profiling is garnish on
+                pass           # the hot path, never a step failure
+        return out
+
+    def _call_inner(self, *batch, n_inputs: Optional[int] = None):
         self._n_inputs = n_inputs if n_inputs is not None else \
             getattr(self, "_n_inputs", len(batch) - 1)
         if self._compiled is None:

@@ -25,7 +25,18 @@ counterpart and the ONE place every subsystem reports into:
 - ``training``: a ``Model.fit`` callback + ``optimizer.step`` hook for
   step time / examples-per-sec / loss (lazy — imported on first
   attribute access so this package stays importable before hapi and
-  optimizer exist in the import order).
+  optimizer exist in the import order);
+- ``goodput``: the wall-clock goodput ledger — every second of a
+  training run classified as productive step time or typed badput
+  (compile / checkpoint / data stall / recovery / idle), with the
+  sum-to-elapsed accounting identity served at ``/goodputz``;
+- ``stepprof``: the always-on continuous step profiler — a bounded
+  ring of per-step timing envelopes with an EWMA+MAD straggler
+  detector that promotes slow steps into the trace flight recorder;
+- ``slo``: declarative latency SLOs evaluated over deterministic
+  rolling windows on the existing latency histograms, multi-window
+  multi-burn-rate alerting (``/sloz``, alert sinks,
+  ``paddle_slo_*`` gauges).
 
 ``framework.monitor``'s stat_add/stat_get are a Counter view onto the
 default registry; ``serving.ServingMetrics`` is backed by these types
@@ -33,9 +44,14 @@ while keeping its ``snapshot()`` schema byte-compatible.
 """
 from __future__ import annotations
 
-from . import exposition, httpd, registry, runtime, tracing  # noqa: F401
+from . import (exposition, goodput, httpd, registry, runtime,  # noqa: F401
+               slo, stepprof, tracing)
 from .exposition import (  # noqa: F401
     PROMETHEUS_CONTENT_TYPE, json_snapshot, json_text, prometheus_text,
+)
+from .goodput import (  # noqa: F401
+    GoodputLedger, default_ledger, goodput_report, goodputz_payload,
+    set_default_ledger,
 )
 from .httpd import (  # noqa: F401
     TelemetryServer, add_health_check, add_readiness_check,
@@ -48,8 +64,15 @@ from .registry import (  # noqa: F401
     PercentileWindow, default_registry, sanitize_metric_name,
 )
 from .runtime import (  # noqa: F401
-    install_device_memory_collector, install_jax_monitoring,
-    mirror_profiler_spans,
+    install_build_info, install_device_memory_collector,
+    install_jax_monitoring, mirror_profiler_spans,
+)
+from .slo import (  # noqa: F401
+    BurnRule, LatencySLO, SLOMonitor, add_alert_sink, default_monitor,
+    latency_slo, remove_alert_sink, set_default_monitor, sloz_payload,
+)
+from .stepprof import (  # noqa: F401
+    StepProfiler, default_profiler, record_step, set_default_profiler,
 )
 from .tracing import (  # noqa: F401
     Span, SpanBuffer, TraceContext, current_context, default_buffer,
@@ -69,7 +92,14 @@ __all__ = [
     "healthz", "add_readiness_check", "remove_readiness_check",
     "readyz",
     "install_jax_monitoring", "install_device_memory_collector",
-    "mirror_profiler_spans",
+    "mirror_profiler_spans", "install_build_info",
+    "GoodputLedger", "default_ledger", "set_default_ledger",
+    "goodput_report", "goodputz_payload",
+    "StepProfiler", "default_profiler", "set_default_profiler",
+    "record_step",
+    "BurnRule", "LatencySLO", "SLOMonitor", "default_monitor",
+    "set_default_monitor", "latency_slo", "add_alert_sink",
+    "remove_alert_sink", "sloz_payload",
     "TraceContext", "Span", "SpanBuffer", "new_context",
     "request_context", "current_context", "use_context",
     "parse_traceparent", "start_span", "record_span",
@@ -78,7 +108,7 @@ __all__ = [
     "TrainingTelemetryCallback", "instrument_optimizers",
     "uninstrument_optimizers",
     "registry", "exposition", "httpd", "runtime", "training",
-    "tracing",
+    "tracing", "goodput", "stepprof", "slo",
 ]
 
 _LAZY = {

@@ -13,7 +13,11 @@ Serves four paths off a daemon thread:
   never routes to a cold replica; distinct from liveness: a warming
   replica is alive but not ready);
 - ``/statusz``  — process/runtime status page (pid, uptime, backend,
-  live serving servers, metric family count).
+  live serving servers, metric family count);
+- ``/goodputz`` — the goodput ledger's accounting report plus the
+  continuous step profiler summary;
+- ``/sloz``     — declared SLOs with rolling-window attainment, burn
+  rates, and firing alerts (evaluated at scrape time).
 
 ``InferenceServer`` attaches via ``FLAGS_serving_telemetry_port``
 (-1 disabled, 0 ephemeral, >0 fixed); standalone training scripts call
@@ -231,10 +235,21 @@ class _Handler(BaseHTTPRequestHandler):
                            "application/json")
             elif path == "/tracez":
                 self._send(200, tracez_text(query), "application/json")
+            elif path == "/goodputz":
+                from .goodput import goodputz_payload
+                self._send(200, json.dumps(goodputz_payload(),
+                                           indent=1, sort_keys=True),
+                           "application/json")
+            elif path == "/sloz":
+                from .slo import sloz_payload
+                self._send(200, json.dumps(sloz_payload(), indent=1,
+                                           sort_keys=True),
+                           "application/json")
             elif path == "/":
                 self._send(200, "paddle-tpu telemetry\n"
                                 "/metrics  /healthz  /readyz  "
-                                "/statusz  /tracez\n",
+                                "/statusz  /tracez  /goodputz  "
+                                "/sloz\n",
                            "text/plain; charset=utf-8")
             else:
                 self._send(404, "not found\n",

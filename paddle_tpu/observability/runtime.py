@@ -18,7 +18,11 @@ module touches nothing heavy; ``install_all()`` (run by
 - ``mirror_profiler_spans``: hooks the profiler's RecordEvent sink so
   every host span ALSO lands in
   ``paddle_profiler_span_ms{span=}`` — span timing in chrome traces and
-  scraped histograms then agree by construction.
+  scraped histograms then agree by construction;
+- ``install_build_info``: the ``paddle_build_info`` info-gauge
+  (package/jax/jaxlib versions, backend, python as labels on a
+  constant 1) so every scraped record is attributable to the exact
+  build that produced it.
 """
 from __future__ import annotations
 
@@ -28,7 +32,7 @@ from .registry import MetricRegistry, default_registry
 
 __all__ = [
     "install_jax_monitoring", "install_device_memory_collector",
-    "mirror_profiler_spans", "install_all",
+    "mirror_profiler_spans", "install_build_info", "install_all",
 ]
 
 _jax_monitoring_installed = False
@@ -68,6 +72,12 @@ def install_jax_monitoring(registry: Optional[MetricRegistry] = None
         try:
             events.labels(event=str(name)).inc()
             durations.labels(event=str(name)).observe(float(secs))
+            # compile time is badput: feed the goodput ledger (the
+            # ledger's frame accounting subtracts it from any
+            # enclosing step frame, so nothing double-counts)
+            if "compil" in str(name).lower():
+                from .goodput import default_ledger
+                default_ledger().record("compile", float(secs))
         except Exception:  # noqa: BLE001
             pass
 
@@ -153,12 +163,51 @@ def mirror_profiler_spans(enable: bool = True,
     return True
 
 
+def install_build_info(registry: Optional[MetricRegistry] = None):
+    """``paddle_build_info`` info-gauge (value 1; the labels carry the
+    payload): package version, jax/jaxlib versions, backend, python.
+    Scraped records from different hosts/rounds become attributable —
+    the PERF.md r04/r05 wedged-round confusion was partly scrape
+    provenance nobody could reconstruct after the fact."""
+    import platform
+
+    reg = registry or default_registry()
+    labels = {"version": "unknown", "jax": "unknown",
+              "jaxlib": "unknown", "backend": "unknown",
+              "python": platform.python_version()}
+    try:
+        from .. import __version__
+        labels["version"] = str(__version__)
+    except Exception:  # noqa: BLE001 - partial info beats no info
+        pass
+    try:
+        import jax
+        labels["jax"] = str(jax.__version__)
+        labels["backend"] = str(jax.default_backend())
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        import jaxlib
+        labels["jaxlib"] = str(getattr(jaxlib, "__version__", "unknown"))
+    except Exception:  # noqa: BLE001
+        pass
+    gauge = reg.gauge(
+        "paddle_build_info",
+        "build/runtime identity of this process (value 1; version, "
+        "jax, jaxlib, backend, python ride the labels)",
+        ("version", "jax", "jaxlib", "backend", "python"))
+    gauge.clear()  # one identity per process: never two live children
+    gauge.labels(**labels).set(1)
+    return labels
+
+
 def install_all(registry: Optional[MetricRegistry] = None):
     """Everything a telemetry endpoint should carry by default.
     Profiler-span mirroring is opt-in via FLAGS_profiler_span_metrics
     (every RecordEvent takes the histogram path once enabled)."""
     install_jax_monitoring(registry)
     install_device_memory_collector(registry)
+    install_build_info(registry)
     try:
         from ..framework.flags import flag_value
         if flag_value("FLAGS_profiler_span_metrics"):

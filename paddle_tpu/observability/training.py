@@ -35,15 +35,32 @@ class TrainingTelemetryCallback:
       known (pass it to the constructor; the fit loop's loader owns it
       and does not forward it through callback params).
 
+    It is also the fit loop's feed into the goodput ledger and the
+    continuous step profiler: each train batch opens a ``step`` frame
+    (nested compile/checkpoint recordings subtract themselves, so the
+    accounting identity holds), the gap between one batch's end and
+    the next one's begin is attributed to ``data_stall`` (the input
+    pipeline had the wheel), and every step drops an envelope into the
+    step profiler's ring (straggler detection included).
+
     ``now`` is injected for deterministic tests.
     """
 
     def __init__(self, registry: Optional[MetricRegistry] = None,
                  batch_size: Optional[int] = None,
-                 now: Callable[[], float] = time.monotonic):
+                 now: Callable[[], float] = time.monotonic,
+                 ledger=None, step_profiler=None):
+        from .goodput import default_ledger
+        from .stepprof import default_profiler
         reg = registry or default_registry()
         self._now = now
         self.batch_size = batch_size
+        self._ledger = ledger if ledger is not None else \
+            default_ledger()
+        self._prof = step_profiler if step_profiler is not None else \
+            default_profiler()
+        self._t_batch_end = None
+        self._frame_open = False
         self._steps = reg.counter(
             "paddle_training_steps_total", "optimizer steps seen by the "
             "hapi fit loop")
@@ -69,10 +86,12 @@ class TrainingTelemetryCallback:
         self.params = dict(params or {})
 
     def on_train_begin(self, logs=None):
-        pass
+        self._ledger.start()
+        self._t_batch_end = None
 
     def on_train_end(self, logs=None):
-        pass
+        # post-fit time is idle/eval, not input stall
+        self._t_batch_end = None
 
     def on_epoch_begin(self, epoch, logs=None):
         pass
@@ -82,13 +101,29 @@ class TrainingTelemetryCallback:
 
     def on_train_batch_begin(self, step, logs=None):
         self._t0 = self._now()
+        if self._t_batch_end is not None:
+            # the time between the previous step's end and this one's
+            # begin belonged to the input pipeline
+            gap = self._now() - self._t_batch_end
+            self._t_batch_end = None
+            if gap > 0:
+                self._ledger.record("data_stall", gap)
+        self._ledger.begin("step")
+        self._frame_open = True
 
     def on_train_batch_end(self, step, logs=None):
+        if self._frame_open:
+            self._frame_open = False
+            self._ledger.end()
+        self._t_batch_end = self._now()
         self._steps.inc()
         if self._t0 is not None:
             dt = self._now() - self._t0
             self._t0 = None
             self._step_ms.observe(dt * 1e3)
+            self._prof.record_step(dt * 1e3, kind="train",
+                                   step=int(step) if step is not None
+                                   else None)
             if self.batch_size and dt > 0:
                 self._eps.set(self.batch_size / dt)
         loss = (logs or {}).get("loss")

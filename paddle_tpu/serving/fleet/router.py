@@ -740,6 +740,25 @@ class FleetRouter:
             trace_id=trace_id, min_duration_ms=min_duration_ms,
             limit=limit, extra_spans=remote)
 
+    def merged_sloz(self) -> dict:
+        """Fleet-wide ``/sloz``: this process's SLO evaluation plus
+        every live replica's, with rolling-window good/total counts
+        summed per (SLO, window) — fleet attainment, the way
+        ``merged_tracez`` stitches spans."""
+        from ...observability import slo as slo_mod
+        own = slo_mod.sloz_payload()
+        remotes: Dict[str, dict] = {}
+        with self._lock:
+            reps = [(str(r.replica_id), r.url)
+                    for r in self._replicas.values() if r.alive]
+        for rid, url in reps:
+            try:
+                with self._http(url + "/sloz", timeout=5.0) as resp:
+                    remotes[rid] = json.loads(resp.read())
+            except Exception:  # noqa: BLE001 - a scrape-dead replica
+                pass           # drops out of the merged view
+        return slo_mod.merge_sloz_payloads(own, remotes)
+
     def statusz(self) -> dict:
         """Fleet status page: per-replica id/readiness/outstanding/
         version (+ restart counts when a supervisor is attached) and
@@ -855,6 +874,14 @@ class _RouterHandler(BaseHTTPRequestHandler):
                            _tracing.chrome_trace_events(spans)}
                 self._send(200, json.dumps(doc, sort_keys=True,
                                            default=str).encode())
+            elif path == "/sloz":
+                self._send(200, json.dumps(
+                    self._router.merged_sloz(), sort_keys=True,
+                    default=str).encode())
+            elif path == "/goodputz":
+                from ...observability.goodput import goodputz_payload
+                self._send(200, json.dumps(
+                    goodputz_payload(), sort_keys=True).encode())
             else:
                 self._send(404, b"not found\n", "text/plain")
         except Exception as e:  # noqa: BLE001 - handler fault barrier

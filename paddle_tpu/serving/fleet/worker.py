@@ -24,7 +24,9 @@ Control plane (JSON):
 - ``GET /healthz`` (liveness) / ``GET /readyz`` (readiness = warmup
   complete) / ``GET /metrics`` (this process's registry, Prometheus
   text) / ``GET /statusz`` / ``GET /tracez`` (this process's span
-  flight recorder; the router's merged ``/tracez`` fans out to it)
+  flight recorder; the router's merged ``/tracez`` fans out to it) /
+  ``GET /sloz`` (this process's SLO evaluation; the router's merged
+  ``/sloz`` sums it fleet-wide) / ``GET /goodputz``
 - ``POST /reload`` — hot weight swap: load the version-stamped
   artifact named in the body, warm the replacement server from the
   shared compile cache + manifest, atomically swap it in, drain the
@@ -418,6 +420,18 @@ class _ReplicaHandler(BaseHTTPRequestHandler):
                 from ...observability.httpd import tracez_text
                 self._send(200, tracez_text(query).encode(),
                            "application/json")
+            elif path == "/sloz":
+                # this process's SLO evaluation — the router's merged
+                # /sloz sums window counts across replicas
+                from ...observability.slo import sloz_payload
+                self._send(200, json.dumps(
+                    sloz_payload(), sort_keys=True).encode(),
+                    "application/json")
+            elif path == "/goodputz":
+                from ...observability.goodput import goodputz_payload
+                self._send(200, json.dumps(
+                    goodputz_payload(), sort_keys=True).encode(),
+                    "application/json")
             elif path == "/healthz":
                 ok, info = self._backend.health()
                 self._send_json(200 if ok else 503,

@@ -867,6 +867,17 @@ class GenerationServer:
         self._steps += 1
         self.metrics.observe_step("decode", ms)
         self.metrics.observe_occupancy(len(active))
+        try:
+            # continuous step profiler: one envelope per decode
+            # iteration (occupancy + KV pressure ride along); a
+            # straggler iteration becomes an error span in /tracez
+            from ...observability.stepprof import default_profiler
+            default_profiler().record_step(
+                ms, kind="decode", step=self._steps,
+                device_ms=ms, occupancy=len(active),
+                kv_pages_used=self.kv.used_pages)
+        except Exception:  # noqa: BLE001 - profiling is garnish on the
+            pass           # decode hot path
         for seq in active:
             if seq.req.trace is not None:
                 # per-iteration span; long streams are bounded by the

@@ -1,0 +1,746 @@
+"""Goodput ledger + continuous step profiler + SLO burn-rate monitor
+(ISSUE 11).
+
+Every window/clock here is INJECTED — the burn-rate math, the goodput
+accounting identity, and the straggler detector are all exercised
+deterministically; the fleet test drives real HTTP replicas but keeps
+its SLO windows wide enough that wall-clock jitter cannot flip the
+verdict.
+"""
+# pdlint: disable=metric_discipline  (tests register synthetic
+# families on private registries on purpose)
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability, serving
+from paddle_tpu.framework.flags import set_flags
+from paddle_tpu.observability import goodput, slo, stepprof, tracing
+from paddle_tpu.observability.registry import MetricRegistry
+from paddle_tpu.serving import fleet
+
+
+def _get(url, timeout=10):
+    opener = urllib.request.build_opener(
+        urllib.request.ProxyHandler({}))
+    with opener.open(url, timeout=timeout) as r:
+        return r.status, r.read().decode()
+
+
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+@pytest.fixture()
+def fresh_defaults():
+    """Swap in fresh process-wide singletons so wired code paths
+    (TrainStep, CheckpointManager, the engine) record into instances
+    this test owns."""
+    led_prev = goodput.set_default_ledger(goodput.GoodputLedger())
+    prof_prev = stepprof.set_default_profiler(
+        stepprof.StepProfiler(min_samples=4))
+    mon_prev = slo.set_default_monitor(slo.SLOMonitor())
+    yield (goodput.default_ledger(), stepprof.default_profiler(),
+           slo.default_monitor())
+    goodput.set_default_ledger(led_prev)
+    stepprof.set_default_profiler(prof_prev)
+    slo.set_default_monitor(mon_prev)
+
+
+# ============================================================ goodput
+class TestGoodputLedger:
+    def test_frames_subtract_nested_recordings(self):
+        clock = _Clock()
+        led = goodput.GoodputLedger(registry=MetricRegistry(),
+                                    now=clock)
+        led.start()
+        led.begin("step")
+        clock.advance(2.0)
+        led.record("compile", 1.5)   # fired inside the step frame
+        led.end()
+        rep = led.report()
+        assert rep["categories_s"]["step"] == pytest.approx(0.5)
+        assert rep["categories_s"]["compile"] == pytest.approx(1.5)
+
+    def test_nested_frames_propagate_elapsed_to_parent(self):
+        clock = _Clock()
+        led = goodput.GoodputLedger(registry=MetricRegistry(),
+                                    now=clock)
+        led.begin("step")
+        clock.advance(0.25)
+        with led.timed("ckpt_save"):
+            clock.advance(1.0)
+        clock.advance(0.25)
+        led.end()
+        rep = led.report()
+        assert rep["categories_s"]["step"] == pytest.approx(0.5)
+        assert rep["categories_s"]["ckpt_save"] == pytest.approx(1.0)
+
+    def test_simulated_timeline_sums_to_wall_clock(self):
+        """The acceptance timeline: compile -> steps -> checkpoint ->
+        preempt-restore -> replay; categories + idle sum to elapsed
+        within 2%."""
+        clock = _Clock()
+        led = goodput.GoodputLedger(registry=MetricRegistry(),
+                                    now=clock)
+        led.start()
+        with led.timed("compile"):
+            clock.advance(8.0)
+        for _ in range(20):                       # productive steps
+            with led.timed("step"):
+                clock.advance(0.5)
+        clock.advance(1.0)                        # input stall
+        led.record("data_stall", 1.0)
+        with led.timed("ckpt_save"):
+            clock.advance(2.0)
+        clock.advance(0.7)                        # unattributed
+        with led.timed("ckpt_restore"):           # preempt-restore
+            clock.advance(1.5)
+        led.arm_replay(3)
+        for _ in range(5):                        # 3 replayed + 2 new
+            with led.timed("step"):
+                clock.advance(0.5)
+        rep = led.report()
+        cats = rep["categories_s"]
+        assert rep["accounting"]["closes"], rep["accounting"]
+        assert sum(cats.values()) == pytest.approx(rep["elapsed_s"])
+        assert cats["step"] == pytest.approx(11.0)   # 20 + 2 new
+        assert cats["recovery"] == pytest.approx(1.5)  # 3 replayed
+        assert cats["compile"] == pytest.approx(8.0)
+        assert cats["data_stall"] == pytest.approx(1.0)
+        assert cats["idle"] == pytest.approx(0.7)
+        assert rep["goodput_fraction"] == pytest.approx(
+            11.0 / rep["elapsed_s"], abs=1e-6)
+
+    def test_idle_counter_is_monotone_and_synced(self):
+        clock = _Clock()
+        reg = MetricRegistry()
+        led = goodput.GoodputLedger(registry=reg, now=clock)
+        led.start()
+        clock.advance(5.0)
+        led.report()
+        fam = reg.get("paddle_goodput_seconds_total")
+        idle1 = fam.labels(category="idle").value
+        assert idle1 == pytest.approx(5.0)
+        with led.timed("step"):
+            clock.advance(1.0)
+        led.report()
+        assert fam.labels(category="idle").value == \
+            pytest.approx(idle1)   # attributed time never shrinks idle
+        clock.advance(2.0)
+        led.report()
+        assert fam.labels(category="idle").value == pytest.approx(7.0)
+
+    def test_overlap_is_surfaced_not_hidden(self):
+        """Two threads claiming the same wall second overrun elapsed;
+        the report says so instead of silently closing."""
+        clock = _Clock()
+        led = goodput.GoodputLedger(registry=MetricRegistry(),
+                                    now=clock)
+        led.start()
+        clock.advance(1.0)
+        led.record("step", 1.0)
+        led.record("data_stall", 1.0)    # overlapping attribution
+        rep = led.report()
+        assert rep["accounting"]["overlap_s"] == pytest.approx(1.0)
+        assert not rep["accounting"]["closes"]
+
+    def test_unknown_category_rejected(self):
+        led = goodput.GoodputLedger(registry=MetricRegistry())
+        with pytest.raises(ValueError):
+            led.record("coffee_break", 1.0)
+
+    def test_goodputz_endpoint(self, fresh_defaults):
+        led, _, _ = fresh_defaults
+        led.start()
+        led.record("step", 1.0)
+        srv = observability.TelemetryServer(port=0).start()
+        try:
+            status, body = _get(srv.url("/goodputz"))
+            assert status == 200
+            doc = json.loads(body)
+            assert doc["goodput"]["categories_s"]["step"] >= 1.0
+            assert "steps" in doc
+        finally:
+            srv.stop()
+
+
+class TestGoodputWiring:
+    def test_train_step_records_step_and_profile(self, fresh_defaults):
+        led, prof, _ = fresh_defaults
+        import paddle_tpu.nn as nn
+        from paddle_tpu.jit import TrainStep
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        step = TrainStep(net, lambda o, y: ((o - y) ** 2).mean(), opt)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        y = paddle.to_tensor(np.zeros((2, 2), np.float32))
+        step(x, y)
+        step(x, y)
+        rep = led.report()
+        assert rep["categories_s"]["step"] > 0.0
+        envs = prof.envelopes(kind="train")
+        assert len(envs) == 2
+        assert envs[-1]["wall_ms"] > 0.0
+
+    def test_checkpoint_manager_feeds_ledger_and_replay(
+            self, fresh_defaults, tmp_path):
+        led, _, _ = fresh_defaults
+        from paddle_tpu.elastic import CheckpointManager
+        p = paddle.to_tensor(np.arange(4, dtype=np.float32))
+        p.name = "w"
+        mgr = CheckpointManager(str(tmp_path), parameters={"w": p},
+                                async_save=False, health_check=False)
+        mgr.save(5, block=True)
+        rep = led.report()
+        assert rep["categories_s"]["ckpt_save"] > 0.0
+        # progress ran ahead of the checkpoint: restore counts the
+        # lost steps and arms replay attribution
+        mgr._write_progress(8)
+        res = mgr.restore_latest()
+        assert res is not None and res.steps_lost == 3
+        assert led.report()["categories_s"]["ckpt_restore"] > 0.0
+        with led.timed("step"):
+            pass
+        assert led.report()["replay_steps_pending"] == 2
+        rep = led.report()
+        assert rep["categories_s"]["recovery"] >= 0.0
+        mgr.close()
+
+    def test_fit_callback_data_stall_and_step_frames(self):
+        clock = _Clock()
+        reg = MetricRegistry()
+        led = goodput.GoodputLedger(registry=reg, now=clock)
+        prof = stepprof.StepProfiler(min_samples=4, registry=reg,
+                                     now=clock, wall_ns=lambda: 0)
+        cb = observability.TrainingTelemetryCallback(
+            registry=reg, now=clock, ledger=led, step_profiler=prof)
+        cb.on_train_begin()
+        for i in range(3):
+            cb.on_train_batch_begin(i)
+            clock.advance(0.2)                 # the step itself
+            cb.on_train_batch_end(i, {"loss": 0.5})
+            clock.advance(0.05)                # the loader gap
+        cb.on_train_end()
+        rep = led.report()
+        assert rep["categories_s"]["step"] == pytest.approx(0.6)
+        # two inter-batch gaps (the post-train gap is not a stall)
+        assert rep["categories_s"]["data_stall"] == pytest.approx(0.1)
+        assert len(prof.envelopes(kind="train")) == 3
+
+
+# ============================================================ stepprof
+class TestStepProfiler:
+    def test_ring_is_bounded(self):
+        prof = stepprof.StepProfiler(window=8,
+                                     registry=MetricRegistry())
+        for i in range(50):
+            prof.record_step(1.0, kind="k", step=i)
+        envs = prof.envelopes(limit=100)
+        assert len(envs) == 8
+        assert envs[-1]["step"] == 49
+
+    def test_straggler_promotes_error_span(self):
+        buf_prev = tracing.set_default_buffer(tracing.SpanBuffer(64))
+        try:
+            prof = stepprof.StepProfiler(min_samples=8, anomaly_k=4.0,
+                                         registry=MetricRegistry())
+            for i in range(20):
+                prof.record_step(10.0 + (i % 3) * 0.2, kind="train",
+                                 step=i)
+            env = prof.record_step(200.0, kind="train", step=99)
+            assert env["anomaly"]["threshold_ms"] < 200.0
+            spans = tracing.default_buffer().snapshot()
+            straggler = [s for s in spans
+                         if s["name"] == "stepprof::straggler"]
+            assert len(straggler) == 1
+            assert straggler[0]["status"] == "error"
+            assert straggler[0]["attrs"]["step"] == 99
+            summary = prof.summary()
+            assert summary["kinds"]["train"]["anomalies"] == 1
+            assert summary["recent_anomalies"][-1]["step"] == 99
+        finally:
+            tracing.set_default_buffer(buf_prev)
+
+    def test_baseline_stays_quiet_and_anomalies_do_not_shift_it(self):
+        prof = stepprof.StepProfiler(min_samples=8, anomaly_k=6.0,
+                                     registry=MetricRegistry())
+        for i in range(64):
+            prof.record_step(5.0 + (i % 5) * 0.1, kind="d", step=i)
+        assert prof.summary()["kinds"]["d"]["anomalies"] == 0
+        ewma_before = prof.summary()["kinds"]["d"]["ewma_ms"]
+        for _ in range(5):
+            prof.record_step(500.0, kind="d")
+        # a straggler burst stays anomalous instead of becoming the
+        # new normal
+        assert prof.summary()["kinds"]["d"]["anomalies"] == 5
+        assert prof.summary()["kinds"]["d"]["ewma_ms"] == \
+            pytest.approx(ewma_before, rel=0.05)
+
+    def test_kinds_detect_independently(self):
+        prof = stepprof.StepProfiler(min_samples=4, anomaly_k=4.0,
+                                     registry=MetricRegistry())
+        for i in range(10):
+            prof.record_step(1.0, kind="train")
+            prof.record_step(50.0, kind="decode")
+        # 50ms is normal for decode, anomalous for train
+        assert "anomaly" not in prof.record_step(50.0, kind="decode")
+        assert "anomaly" in prof.record_step(50.0, kind="train")
+
+    def test_decode_engine_records_envelopes(self, fresh_defaults):
+        _, prof, _ = fresh_defaults
+        from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+        paddle.seed(0)
+        model = GPTForCausalLM(gpt_tiny(use_flash_attention=False))
+        eng = serving.generation.GenerationServer(
+            model, name="t_gp_eng", max_batch=2, start=True)
+        try:
+            toks = eng.generate([1, 2, 3], max_new_tokens=4)
+            assert len(toks) == 4
+        finally:
+            eng.shutdown()
+        envs = prof.envelopes(kind="decode")
+        assert envs, "decode iterations must drop envelopes"
+        assert envs[-1]["occupancy"] >= 1
+        assert "kv_pages_used" in envs[-1]
+
+
+# ============================================================ slo
+def _mk_slo(name, metric, clock, threshold=25.0, target=0.99,
+            short=10.0, long=40.0, labels=None, reg=None):
+    mon = slo.SLOMonitor(registry=reg or MetricRegistry(), now=clock)
+    s = slo.LatencySLO(name, metric, threshold_ms=threshold,
+                       target_fraction=target, labels=labels,
+                       burn_rules=[slo.BurnRule("fast", short, long,
+                                                14.4)])
+    mon.add(s)
+    return mon, s
+
+
+class TestBurnRateMath:
+    def test_fires_on_regression_quiet_at_baseline_recovers(self):
+        """The acceptance triad on a real registry histogram with an
+        injected clock: quiet -> regression fires within one
+        evaluation -> drained window resolves."""
+        clock = _Clock(1000.0)
+        reg = MetricRegistry()
+        hist = reg.histogram("t_slo_lat_ms", "", ("server",))
+        mon, _ = _mk_slo("p99", "t_slo_lat_ms", clock, reg=reg)
+        alerts = []
+        mon.add_alert_sink("test", alerts.append)
+        child = hist.labels(server="a")
+        mon.evaluate()
+        for _ in range(500):
+            child.observe(5.0)
+        clock.advance(5.0)
+        doc = mon.evaluate()
+        assert doc["slos"][0]["firing"] == []
+        assert alerts == []
+        # injected p99 regression: every new sample blows the budget
+        for _ in range(100):
+            child.observe(400.0)
+        clock.advance(5.0)
+        doc = mon.evaluate()
+        assert doc["slos"][0]["firing"] == ["fast"]
+        assert len(alerts) == 1 and alerts[0]["firing"]
+        assert alerts[0]["burn_short"] > 14.4
+        # regression stops; the short window drains past the bad
+        # samples and the alert resolves
+        for _ in range(2000):
+            child.observe(5.0)
+        clock.advance(11.0)
+        mon.evaluate()
+        clock.advance(35.0)
+        doc = mon.evaluate()
+        assert doc["slos"][0]["firing"] == []
+        assert alerts[-1]["firing"] is False
+
+    def test_both_windows_must_burn(self):
+        """A short blip trips the short window but not the long one —
+        multi-window alerting exists exactly to not page on it."""
+        clock = _Clock(0.0)
+        reg = MetricRegistry()
+        hist = reg.histogram("t_slo_blip_ms", "", ())
+        mon, _ = _mk_slo("p99", "t_slo_blip_ms", clock, reg=reg,
+                         short=10.0, long=1000.0)
+        child = hist.labels()
+        mon.evaluate()               # monitoring starts
+        for _ in range(100000):      # long healthy history
+            child.observe(1.0)
+        clock.advance(990.0)
+        mon.evaluate()
+        for _ in range(50):          # blip
+            child.observe(400.0)
+        clock.advance(10.0)
+        doc = mon.evaluate()
+        w = doc["slos"][0]["windows"]
+        assert w["10s"]["burn_rate"] > 14.4
+        assert w["1000s"]["burn_rate"] < 14.4
+        assert doc["slos"][0]["firing"] == []
+
+    def test_threshold_uses_bucket_bound(self):
+        clock = _Clock()
+        reg = MetricRegistry()
+        hist = reg.histogram("t_slo_eff_ms", "",
+                             buckets=(10.0, 50.0, 100.0))
+        mon, _ = _mk_slo("p", "t_slo_eff_ms", clock, threshold=60.0,
+                         reg=reg)
+        mon.evaluate()                 # monitoring starts
+        hist.labels().observe(30.0)    # good at the 50ms bound
+        hist.labels().observe(55.0)    # between bound and threshold:
+        clock.advance(5.0)             # conservatively bad
+        doc = mon.evaluate()
+        assert doc["slos"][0]["effective_threshold_ms"] == 50.0
+        w = doc["slos"][0]["windows"]["10s"]
+        assert (w["good"], w["total"]) == (1, 2)
+
+    def test_label_filter_selects_slice(self):
+        clock = _Clock()
+        reg = MetricRegistry()
+        hist = reg.histogram("t_slo_lbl_ms", "", ("server",))
+        mon, _ = _mk_slo("p", "t_slo_lbl_ms", clock,
+                         labels={"server": "good"}, reg=reg)
+        mon.evaluate()
+        for _ in range(100):
+            hist.labels(server="good").observe(1.0)
+            hist.labels(server="evil").observe(500.0)
+        clock.advance(5.0)
+        doc = mon.evaluate()
+        w = doc["slos"][0]["windows"]["10s"]
+        assert w["total"] == 100 and w["good"] == 100
+
+    def test_gauges_and_budget(self):
+        clock = _Clock()
+        reg = MetricRegistry()
+        reg.histogram("t_slo_g_ms", "", ()).labels().observe(1.0)
+        mon, _ = _mk_slo("pg", "t_slo_g_ms", clock, reg=reg)
+        mon.evaluate()
+        clock.advance(5.0)
+        mon.evaluate()
+        burn = reg.get("paddle_slo_burn_rate")
+        budget = reg.get("paddle_slo_budget_remaining")
+        assert burn.get(slo="pg", window="10s") is not None
+        assert budget.labels(slo="pg").value == pytest.approx(1.0)
+
+    def test_alert_carries_exemplar_trace_id(self):
+        clock = _Clock()
+        reg = MetricRegistry()
+        hist = reg.histogram("t_slo_ex_ms", "", ())
+        mon, _ = _mk_slo("pex", "t_slo_ex_ms", clock, reg=reg)
+        alerts = []
+        mon.add_alert_sink("t", alerts.append)
+        tracing.clear_exemplars()
+        try:
+            mon.evaluate()
+            trace_id = "ab" * 16
+            for _ in range(50):
+                hist.labels().observe(300.0)
+            tracing.record_exemplar("t_slo_ex_ms", 300.0, trace_id)
+            clock.advance(5.0)
+            mon.evaluate()
+            assert alerts and alerts[0]["exemplar_trace_id"] == trace_id
+        finally:
+            tracing.clear_exemplars()
+
+    def test_direct_feed_excludes_warmup_samples(self):
+        clock = _Clock()
+        reg = MetricRegistry()
+        mon = slo.SLOMonitor(registry=reg, now=clock)
+        mon.add(slo.LatencySLO("d", "t_absent_metric_ms", 10.0, 0.9,
+                               windows=(10.0,),
+                               burn_rules=[slo.BurnRule(
+                                   "fast", 10.0, 10.0, 1.0)]))
+        mon.evaluate()
+        for _ in range(10):
+            mon.observe("d", 500.0, warmup=True)   # excluded
+            mon.observe("d", 1.0)
+        clock.advance(5.0)
+        doc = mon.evaluate()
+        w = doc["slos"][0]["windows"]["10s"]
+        assert (w["good"], w["total"]) == (10, 10)
+        excl = reg.get("paddle_slo_samples_excluded_total")
+        assert excl.labels(slo="d").value == 10
+
+    def test_target_fraction_validation(self):
+        with pytest.raises(ValueError):
+            slo.LatencySLO("bad", "m", 1.0, 1.0)
+
+    def test_merge_sloz_payloads_sums_counts(self):
+        def entry(good, total):
+            return {"slo": {"name": "s", "target_fraction": 0.9},
+                    "windows": {"10s": {"good": good, "total": total,
+                                        "bad_fraction": 0.0,
+                                        "covered": True,
+                                        "burn_rate": 0.0}}}
+        merged = slo.merge_sloz_payloads(
+            {"process": "router", "slos": [entry(90, 100)]},
+            {"r0": {"slos": [entry(50, 100)]},
+             "r1": {"slos": [entry(100, 100)]}})
+        w = merged["slos"][0]["windows"]["10s"]
+        assert (w["good"], w["total"]) == (240, 300)
+        assert w["bad_fraction"] == pytest.approx(0.2)
+        assert w["burn_rate"] == pytest.approx(2.0)
+        assert merged["replicas"] == ["r0", "r1"]
+
+    def test_sloz_endpoint(self, fresh_defaults):
+        _, _, mon = fresh_defaults
+        mon.add(slo.LatencySLO("end", "paddle_serving_latency_ms",
+                               25.0, 0.99, windows=(60.0,),
+                               burn_rules=[slo.BurnRule(
+                                   "fast", 60.0, 60.0, 14.4)]))
+        srv = observability.TelemetryServer(port=0).start()
+        try:
+            status, body = _get(srv.url("/sloz"))
+            assert status == 200
+            doc = json.loads(body)
+            assert doc["slos"][0]["slo"]["name"] == "end"
+        finally:
+            srv.stop()
+
+
+# ===================================================== warmup exclusion
+class TestWarmupExclusion:
+    @pytest.fixture()
+    def predictor(self, tmp_path):
+        import paddle_tpu.nn as nn
+        from paddle_tpu import inference
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 8), nn.ReLU())
+        p = str(tmp_path / "m")
+        paddle.jit.save(net, p, input_spec=[
+            paddle.static.InputSpec([None, 8], "float32")])
+        return inference.create_predictor(inference.Config(p))
+
+    def test_warmup_traffic_never_lands_in_slo_windows(
+            self, predictor, fresh_defaults):
+        """Regression test at the target_fraction boundary: warmup
+        pre-compiles are orders of magnitude over the threshold; ONE
+        leaked warmup sample at P-of-N boundary traffic would flip
+        the SLO verdict. The exclusion (record_traffic=False, the PR 9
+        rule) must hold through the SLO window layer."""
+        _, _, mon = fresh_defaults
+        name = "t_slo_warm"
+        # exactly-at-boundary target: 1 bad in 100 is allowed, 2 are
+        # not; a leaked warmup sample is the difference
+        s = slo.LatencySLO("warm_p99", "paddle_serving_latency_ms",
+                           threshold_ms=1000.0, target_fraction=0.98,
+                           labels={"server": name}, windows=(600.0,),
+                           burn_rules=[slo.BurnRule(
+                               "fast", 600.0, 600.0, 1.0)])
+        mon.add(s)
+        mon.evaluate()
+        srv = serving.InferenceServer(
+            predictor, max_batch_size=4, name=name,
+            queue_capacity=128, ready_requires_warmup=True,
+            start=False)
+        n_warm = srv.warmup()          # slow compiles, all excluded
+        assert n_warm > 0
+        srv.start()
+        futs = srv.submit_many([[np.ones((1, 8), np.float32)]
+                                for _ in range(100)])
+        for f in futs:
+            f.result(timeout=60)
+        srv.shutdown()
+        doc = mon.evaluate()
+        w = doc["slos"][0]["windows"]["10m"]
+        assert w["total"] == 100, \
+            "warmup batches leaked into the SLO sample window"
+        assert w["good"] == w["total"]
+        assert doc["slos"][0]["firing"] == []
+
+
+# ============================================================ fleet
+class TestFleetSLO:
+    def test_two_replica_regression_fires_fast_burn_with_exemplar(
+            self, fresh_defaults):
+        """The acceptance scenario: a 2-replica fleet, an injected
+        latency regression, the fast-burn alert inside one evaluation
+        pass, carrying a PR 9 exemplar trace id; the router's /sloz
+        aggregates both replicas."""
+        _, _, mon = fresh_defaults
+        name = "t_slo_fleet"
+        bes, apps = [], []
+        for _ in range(2):
+            be = fleet.StubBackend(device_ms=1.0)
+            app = fleet.ReplicaApp(be).start()
+            be.warmup()
+            bes.append(be)
+            apps.append(app)
+        set_flags({"FLAGS_trace_sample_rate": 1.0})
+        tracing.clear_exemplars()
+        router = fleet.FleetRouter(
+            {i: app.url for i, app in enumerate(apps)},
+            name=name, start=False)
+        try:
+            router.poll_replicas()
+            mon.add(slo.LatencySLO(
+                "fleet_p99", "paddle_fleet_request_ms",
+                threshold_ms=50.0, target_fraction=0.99,
+                labels={"router": name}, windows=(600.0, 1200.0),
+                burn_rules=[slo.BurnRule("fast_burn", 600.0, 1200.0,
+                                         14.4)]))
+            alerts = []
+            mon.add_alert_sink("t", alerts.append)
+            mon.evaluate()
+            for f in router.submit_many([[np.ones((1, 4),
+                                          np.float32)]] * 8):
+                f.result(timeout=30)
+            doc = mon.evaluate()
+            assert doc["slos"][0]["firing"] == []
+            # inject the regression: both replicas slow to 40x the
+            # threshold
+            for be in bes:
+                be.device_ms = 200.0
+            for f in router.submit_many([[np.ones((1, 4),
+                                          np.float32)]] * 6):
+                f.result(timeout=60)
+            doc = mon.evaluate()       # ONE evaluation pass later
+            assert doc["slos"][0]["firing"] == ["fast_burn"]
+            assert len(alerts) == 1 and alerts[0]["firing"]
+            exemplar = alerts[0]["exemplar_trace_id"]
+            assert exemplar and len(exemplar) == 32
+            # the exemplar is retrievable as a trace
+            spans = router.merged_tracez(trace_id=exemplar)
+            assert spans["traces"], \
+                "exemplar trace id must resolve in /tracez"
+        finally:
+            set_flags({"FLAGS_trace_sample_rate": 0.0})
+            tracing.clear_exemplars()
+            router.shutdown()
+            for app in apps:
+                app.stop()
+
+    def test_router_app_serves_merged_sloz(self, fresh_defaults):
+        _, _, mon = fresh_defaults
+        be = fleet.StubBackend(device_ms=1.0)
+        app = fleet.ReplicaApp(be).start()
+        be.warmup()
+        router = fleet.FleetRouter({0: app.url}, name="t_slo_http",
+                                   start=False)
+        router.poll_replicas()
+        rapp = fleet.RouterApp(router).start()
+        try:
+            mon.add(slo.LatencySLO(
+                "http_p99", "paddle_fleet_request_ms", 50.0, 0.99,
+                labels={"router": "t_slo_http"}, windows=(600.0,),
+                burn_rules=[slo.BurnRule("fast", 600.0, 600.0,
+                                         14.4)]))
+            status, body = _get(rapp.url("/sloz"))
+            assert status == 200
+            doc = json.loads(body)
+            assert doc["replicas"] == ["0"]
+            assert any(e["slo"]["name"] == "http_p99"
+                       for e in doc["slos"])
+        finally:
+            rapp.stop()
+            router.shutdown()
+            app.stop()
+
+    def test_readiness_polling_records_no_slo_samples(
+            self, fresh_defaults):
+        """Readiness probes are control-plane traffic: polling must
+        not mint paddle_fleet_request_ms samples."""
+        _, _, mon = fresh_defaults
+        be = fleet.StubBackend(device_ms=1.0)
+        app = fleet.ReplicaApp(be).start()
+        be.warmup()
+        router = fleet.FleetRouter({0: app.url}, name="t_slo_ready",
+                                   start=False)
+        try:
+            mon.add(slo.LatencySLO(
+                "ready_p99", "paddle_fleet_request_ms", 50.0, 0.99,
+                labels={"router": "t_slo_ready"}, windows=(600.0,),
+                burn_rules=[slo.BurnRule("fast", 600.0, 600.0,
+                                         14.4)]))
+            mon.evaluate()
+            for _ in range(5):
+                router.poll_replicas()
+            doc = mon.evaluate()
+            assert doc["slos"][0]["windows"]["10m"]["total"] == 0
+        finally:
+            router.shutdown()
+            app.stop()
+
+
+# ============================================================ misc
+class TestBuildInfo:
+    def test_build_info_gauge(self):
+        from paddle_tpu.observability import runtime
+        labels = runtime.install_build_info()
+        assert labels["version"] == paddle.__version__
+        from paddle_tpu.observability.registry import default_registry
+        fam = default_registry().get("paddle_build_info")
+        children = fam.collect()
+        assert len(children) == 1
+        lab, child = children[0]
+        assert child.value == 1
+        assert lab["jax"] != "unknown"
+        assert lab["backend"] == "cpu"
+        # idempotent: a re-install never leaves two identities
+        runtime.install_build_info()
+        assert len(fam.collect()) == 1
+
+    def test_build_info_in_prometheus_text(self):
+        from paddle_tpu.observability import prometheus_text, runtime
+        runtime.install_build_info()
+        text = prometheus_text()
+        assert "paddle_build_info{" in text
+
+
+class TestSloReportTool:
+    def test_committed_record_renders(self):
+        import os
+        import sys
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from tools import slo_report
+        path = slo_report.newest_committed(slo_report.REPO_ROOT)
+        doc = slo_report.load_record(path)
+        text = slo_report.render_text(doc)
+        assert "CLOSES" in text
+        assert "goodput" in text
+        assert doc["goodput"]["accounting"]["closes"]
+
+    def test_live_scrape_roundtrip(self, fresh_defaults):
+        import os
+        import sys
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from tools import slo_report
+        led, _, _ = fresh_defaults
+        led.start()
+        led.record("step", 2.0)
+        srv = observability.TelemetryServer(port=0).start()
+        try:
+            doc = slo_report.fetch_live(srv.url(""))
+            assert doc["goodput"]["categories_s"]["step"] >= 2.0
+            text = slo_report.render_text(doc)
+            assert "goodput" in text
+        finally:
+            srv.stop()
+
+    def test_goodput_gate_in_perfci(self):
+        import os
+        import sys
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from tools import perfci
+        report = perfci.run(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        gates = {r["gate"]: r for r in report["results"]}
+        assert gates["goodput_accounting"]["status"] == "pass"
+        assert gates["goodput_fraction"]["status"] == "pass"
+        assert gates["goodput_overhead_pct"]["status"] == "pass"

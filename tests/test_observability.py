@@ -266,7 +266,7 @@ class TestMonitorView:
         assert monitor.stat_get("t_obs_stat") == 11
         assert monitor.stats_snapshot()["t_obs_stat"] == 11
         text = prometheus_text(observability.default_registry())
-        assert 'paddle_monitor_stat{name="t_obs_stat"} 11' in text
+        assert 'paddle_monitor_stat_total{name="t_obs_stat"} 11' in text
         monitor.stat_reset("t_obs_stat")
         assert monitor.stat_get("t_obs_stat") == 0
         assert "t_obs_stat" not in monitor.stats_snapshot()

@@ -318,15 +318,54 @@ class TestMetricDiscipline:
         new_rules = {f.rule for f in res["new"]}
         assert {"MD001", "MD002"} <= new_rules, new_rules
 
+    def test_md003_counter_and_histogram_suffixes(self, tmp_path):
+        _write(tmp_path, "mod.py", """
+            from registry import default_registry
+            reg = default_registry()
+            ok_c = reg.counter("paddle_reqs_total", "fine")
+            bad_c = reg.counter("paddle_reqs", "no suffix")    # MD003
+            ok_h1 = reg.histogram("paddle_lat_ms", "fine")
+            ok_h2 = reg.histogram("paddle_sz_bytes", "fine")
+            ok_h3 = reg.histogram("paddle_dur_seconds", "fine")
+            bad_h = reg.histogram("paddle_lat", "no unit")     # MD003
+            g = reg.gauge("paddle_depth", "gauges exempt")
+        """)
+        found = _run(tmp_path, [MetricDisciplineAnalyzer()])
+        md3 = {f.symbol: f.detail for f in found if f.rule == "MD003"}
+        assert md3 == {"paddle_reqs": "counter_suffix",
+                       "paddle_lat": "histogram_unit"}
+
+    def test_md003_scope_reaches_repo_gate(self, tmp_path):
+        """Injected MD003 violation through the PROJECT gate (real
+        baseline) must surface as a NEW finding — the extension rides
+        the same gate as MD001/MD002."""
+        _write(tmp_path, "metrics.py", """
+            from paddle_tpu.observability.registry import \\
+                default_registry
+            c = default_registry().counter("paddle_injected_md003", "")
+        """)
+        res = analysis.run_project(
+            paths=[str(tmp_path)], root=str(tmp_path),
+            baseline_path=analysis.default_baseline_path(REPO_ROOT))
+        assert "MD003" in {f.rule for f in res["new"]}
+
     def test_repo_registers_cleanly(self):
-        """The whole repo passes metric discipline with ZERO baseline
-        entries — the satellite's 'baselined clean' claim, kept
-        honest."""
+        """The whole repo passes metric discipline against the
+        baseline, and the only baselined entries are the two
+        deliberately-unitless histograms (rows / occupancy counts
+        have no ms/bytes/seconds unit to declare) — everything else
+        is suffix-clean after the MD003 sweep."""
         found = analysis.run_analyzers(
             analysis.default_paths(REPO_ROOT),
             [MetricDisciplineAnalyzer()], root=REPO_ROOT)
         listing = "\n".join(f.format() for f in found)
-        assert not found, listing
+        assert {f.symbol for f in found} <= {
+            "paddle_serving_batch_rows",
+            "paddle_decode_batch_occupancy"}, listing
+        baseline = analysis.load_baseline(
+            analysis.default_baseline_path(REPO_ROOT))
+        new = analysis.filter_new(found, baseline)
+        assert not new, "\n".join(f.format() for f in new)
 
 
 # ===================================================================

@@ -1,11 +1,13 @@
 """perfci — the committed-perf-record regression gate (ROADMAP item 5).
 
 Every bench in this repo emits one JSON record; the committed copies
-(``BENCH_*.json``, ``TRACE_r01.json``, ``ELASTIC_r01.json``) are the
-perf trajectory. This tool loads them and enforces tolerance gates —
-train tok/s, decode/serving throughput and tail latency, fleet QPS,
-cold-start ratio, tracing overhead, elastic-recovery invariants — so
-every speed claim is enforced, not anecdotal.
+(``BENCH_*.json``, ``TRACE_r01.json``, ``ELASTIC_r01.json``,
+``GOODPUT_r01.json``) are the perf trajectory. This tool loads them
+and enforces tolerance gates — train tok/s, decode/serving throughput
+and tail latency, fleet QPS, cold-start ratio, tracing overhead,
+elastic-recovery invariants, goodput accounting closure and always-on
+observability overhead — so every speed claim is enforced, not
+anecdotal.
 
 Skip classification reuses ``tools/_bench_common.py`` semantics: a
 record with ``"skipped": true`` (or the ``backend_unavailable``
@@ -93,6 +95,25 @@ GATES: List[Dict[str, Any]] = [
      "files": "ELASTIC_r*.json", "path": ("median_restore_ms",),
      "op": "max", "baseline": 5.7, "abs_tol": 50.0, "unit": "ms",
      "why": "checkpoint restore must stay interactive-fast"},
+    {"name": "goodput_accounting", "metric": "goodput_ledger",
+     "files": "GOODPUT_r*.json",
+     "path": ("report", "accounting", "closes"),
+     "op": "true",
+     "why": "goodput categories (+derived idle) must sum to elapsed "
+            "wall-clock within FLAGS_goodput_tolerance (PR 11)"},
+    {"name": "goodput_fraction", "metric": "goodput_ledger",
+     "files": "GOODPUT_r*.json", "path": ("value",),
+     "op": "min", "baseline": 0.08, "abs_tol": 0.06, "unit": "fraction",
+     "why": "the instrumented toy run must show real productive step "
+            "time (wide envelope: the compile-dominated harness "
+            "fraction tracks host speed)"},
+    {"name": "goodput_overhead_pct", "metric": "goodput_ledger",
+     "files": "GOODPUT_r*.json",
+     "path": ("overhead", "serving", "regression_pct"),
+     "op": "max", "baseline": 0.0, "abs_tol": 5.0, "unit": "%",
+     "why": "always-on step profiler + live SLO evaluation must not "
+            "tax bench_serving throughput (<2% claim, 5% gate for "
+            "shared-box noise, same envelope as trace_overhead_pct)"},
 ]
 
 
