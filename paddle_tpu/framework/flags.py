@@ -192,6 +192,21 @@ define_flag("FLAGS_decode_default_timeout_ms", 0.0,
             "scheduling deadline applied when submit_generate passes "
             "none (0 = no deadline); like serving submit, an expired "
             "request is dropped before prefill, never mid-stream")
+define_flag("FLAGS_decode_prefix_cache", True,
+            "shared-prefix KV reuse: keep finished sequences' FULL "
+            "pages in a radix index keyed by token content, so a "
+            "request whose prompt matches a cached prefix maps those "
+            "pages into its block table (refcounted, copy-on-write at "
+            "the divergence page) and prefills only its unique suffix; "
+            "unreferenced cached pages are LRU-evicted under pool "
+            "pressure")
+define_flag("FLAGS_decode_spec_k", 0,
+            "speculative decoding: tokens proposed per step by the "
+            "draft model (GenerationServer(draft_model=...)); the "
+            "target model verifies all k in one fixed-shape "
+            "[max_batch, k+1] step with accept-and-resample, so "
+            "output distribution matches non-speculative sampling "
+            "(0 = off; ignored without a draft model)")
 define_flag("FLAGS_decode_warmup_from_manifest", False,
             "pre-compile a constructed GenerationServer's decode step "
             "and recorded prefill buckets from its persisted warmup "

@@ -145,8 +145,11 @@ class GPTKVCache:
       the trash page).
     - ``positions``: [B, S] int32 absolute positions being fed.
     - ``kind``: "prefill" (S = prompt window, ordinary causal attention
-      plus pool write) or "decode" (S = 1, attention reads the context
-      back through the block table).
+      plus pool write), "decode" (S = 1, attention reads the context
+      back through the block table), or "chunked" (arbitrary S at a
+      non-zero starting position — shared-prefix suffix prefill and the
+      speculative-decoding verify window; per-position causal mask over
+      the gathered paged context).
 
     ``forward(ids, cache=...)`` returns ``(logits, (k', v'))`` — the
     updated pool pytree mirrors the input structure, so jitted callers
@@ -158,9 +161,9 @@ class GPTKVCache:
 
     def __init__(self, kind, page_size, k, v, block_tables, ctx_len,
                  valid, positions):
-        if kind not in ("prefill", "decode"):
-            raise ValueError(f"kind must be 'prefill' or 'decode', "
-                             f"got {kind!r}")
+        if kind not in ("prefill", "decode", "chunked"):
+            raise ValueError(f"kind must be 'prefill', 'decode' or "
+                             f"'chunked', got {kind!r}")
         self.kind = kind
         self.page_size = int(page_size)
         self.k = k

@@ -134,6 +134,16 @@ def _statusz() -> dict:
                 serving_metrics.all_snapshots())
     except Exception:  # noqa: BLE001
         pass
+    try:  # live decode engines: prefix-cache + page-accounting state
+        # (incl. the refcount-leak check), lazy like the above
+        gen_engine = sys.modules.get(
+            "paddle_tpu.serving.generation.engine")
+        if gen_engine is not None:
+            engines = gen_engine.engines_statusz()
+            if engines:
+                out["decode_engines"] = engines
+    except Exception:  # noqa: BLE001
+        pass
     try:
         jax = sys.modules.get("jax")
         if jax is not None:

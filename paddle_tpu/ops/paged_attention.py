@@ -29,6 +29,8 @@ import jax.numpy as jnp
 __all__ = ["flat_slots", "write_pool", "gather_pool",
            "paged_attention_update"]
 
+KINDS = ("prefill", "decode", "chunked")
+
 
 def flat_slots(block_tables, positions, valid, page_size: int):
     """Flat pool-slot index for each (row, position): ``page * page_size
@@ -96,6 +98,30 @@ def _decode_attention(q, ks, vs, ctx_len, scale):
     return out
 
 
+def _chunked_attention(q, ks, vs, positions, valid, scale):
+    """Window attention against the gathered paged context — the
+    decode mask generalized from S == 1 to an arbitrary window.
+
+    q: [B, S, H, D]; ks/vs: [B, T, H, D] (gathered in logical order);
+    positions: [B, S] int32 absolute position of each window token;
+    valid: [B, S]. Query ``s`` sees every logical slot ``t`` with
+    ``t <= positions[b, s]`` — its cached prefix plus the window up to
+    and including itself (write-then-gather, so self is present).
+    Masked slots get -1e30 (not -inf: a fully-masked dead lane must
+    stay finite through softmax; its output is discarded).
+    """
+    logits = jnp.einsum("bqhd,bthd->bhqt", q, ks) * \
+        jnp.asarray(scale, q.dtype)
+    t = ks.shape[1]
+    mask = (jnp.arange(t)[None, None, :] <= positions[:, :, None]) \
+        & valid[:, :, None]                                 # [B, S, T]
+    logits = jnp.where(mask[:, None, :, :], logits,
+                       jnp.asarray(-1e30, logits.dtype))
+    probs = jax.nn.softmax(logits.astype(jnp.float32),
+                           axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqt,bthd->bqhd", probs, vs)
+
+
 def paged_attention_update(q, k, v, k_pool, v_pool, block_tables,
                            ctx_len, valid, positions, *, page_size: int,
                            kind: str, use_flash: bool = True):
@@ -117,6 +143,14 @@ def paged_attention_update(q, k, v, k_pool, v_pool, block_tables,
     kind="decode": S == 1; attention reads the whole context back
     through the block table (write-then-gather, so self is included).
 
+    kind="chunked": arbitrary S over a NON-zero starting position —
+    suffix prefill after a shared-prefix cache hit, and the
+    speculative-decoding verify window. Same write-then-gather as
+    decode, with the mask generalized to per-(row, position) causality
+    (``t <= positions[b, s]``): window tokens attend to the cached
+    prefix AND causally within the window. With positions starting at
+    0 this computes the same math as prefill, via the gather path.
+
     Returns (attn_out [B, S, H, D], k_pool', v_pool').
     """
     b, s = q.shape[0], q.shape[1]
@@ -135,7 +169,10 @@ def paged_attention_update(q, k, v, k_pool, v_pool, block_tables,
         ks = gather_pool(k_pool, block_tables)
         vs = gather_pool(v_pool, block_tables)
         out = _decode_attention(q, ks, vs, ctx_len, scale)
+    elif kind == "chunked":
+        ks = gather_pool(k_pool, block_tables)
+        vs = gather_pool(v_pool, block_tables)
+        out = _chunked_attention(q, ks, vs, positions, valid, scale)
     else:
-        raise ValueError(f"kind must be 'prefill' or 'decode', got "
-                         f"{kind!r}")
+        raise ValueError(f"kind must be one of {KINDS}, got {kind!r}")
     return out, k_pool, v_pool

@@ -20,7 +20,15 @@ Pieces:
   cache AOT tier first.
 - ``PagedKVCache`` (kv_cache.py): preallocated per-layer
   ``[num_pages, page_size, heads, head_dim]`` pools + the host page
-  allocator (page 0 reserved as the trash page for masked writes).
+  allocator (page 0 reserved as the trash page for masked writes),
+  REFCOUNTED so full pages can be shared across sequences.
+- ``PrefixCache`` (prefix_cache.py): radix index over immutable full
+  KV pages keyed by token content — shared-prefix reuse with
+  copy-on-write at the divergence page and LRU eviction under pool
+  pressure.
+- ``accept_tokens`` (spec_decode.py): host-side accept-and-resample
+  for speculative decoding (draft proposes k, the target verifies all
+  k in one fixed-shape step; output distribution unchanged).
 - ``sample_next_tokens`` (sampling.py): vectorized host-side
   greedy/temperature selection, shared with
   ``HybridParallelInferenceHelper.generate``.
@@ -34,13 +42,17 @@ Knobs: ``FLAGS_decode_*`` in framework/flags.py.
 """
 from __future__ import annotations
 
-from .engine import DecodeMetrics, GenerationServer, StreamingFuture
+from .engine import (DecodeMetrics, GenerationServer, StreamingFuture,
+                     engines_statusz)
 from .kv_cache import PagedKVCache
 from .model_fns import CachedDecoder, supports_cached_decode
+from .prefix_cache import PrefixCache
 from .sampling import sample_next_tokens
+from .spec_decode import accept_tokens
 
 __all__ = [
     "GenerationServer", "StreamingFuture", "DecodeMetrics",
-    "PagedKVCache", "CachedDecoder", "supports_cached_decode",
-    "sample_next_tokens",
+    "PagedKVCache", "PrefixCache", "CachedDecoder",
+    "supports_cached_decode", "sample_next_tokens", "accept_tokens",
+    "engines_statusz",
 ]

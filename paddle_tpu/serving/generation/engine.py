@@ -14,16 +14,26 @@ Flow per worker iteration:
 1. **admit**: pop FIFO requests while a batch slot AND their full page
    reservation are available; drop expired ones
    (``DeadlineExceededError``, matching ``submit`` semantics — a
-   deadline gates scheduling, never an in-flight stream).
+   deadline gates scheduling, never an in-flight stream). Admission
+   consults the shared-prefix radix index (prefix_cache.py) first:
+   matched full pages are mapped into the block table (refcounted
+   sharing), shrinking the reservation AND the prefill window.
 2. **prefill**: admitted prompts run one forward at their (pow2-row,
    seq-bucket) shape — the PR 1/2 bucket lattice — writing prompt K/V
-   into their pages and sampling the first token.
+   into their pages and sampling the first token. Prefix hits run the
+   CHUNKED suffix prefill instead (attention reaches the cached
+   prefix through the block tables), then every prompt's full pages
+   are published to the index.
 3. **decode**: one fixed-shape step for every live lane; sample on
    host (vectorized, per-request RNG), stream tokens out through each
-   request's ``StreamingFuture``.
+   request's ``StreamingFuture``. With a draft model configured, each
+   iteration is instead draft-propose-k + ONE fixed-shape
+   ``[max_batch, k+1]`` verify step with accept-and-resample
+   (speculative decoding; output distribution unchanged).
 4. **evict**: eos / length / cancelled sequences release pages
    immediately (KV page eviction), freeing admission capacity for the
-   next iteration.
+   next iteration; completed sequences' full pages stay behind in the
+   prefix index (refcount 1) until pool pressure LRU-evicts them.
 
 Backpressure mirrors ``InferenceServer.submit``: a bounded queue
 raising ``QueueFullError``, ``ServerClosedError`` after shutdown, and
@@ -34,6 +44,7 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from collections import deque
 from typing import Dict, List, Optional, Sequence
 
@@ -44,10 +55,26 @@ from ..bucketing import ShapeBucketPolicy
 from ..request import (DeadlineExceededError, QueueFullError,
                        ServerClosedError)
 from .kv_cache import PagedKVCache
-from .model_fns import CachedDecoder
+from .model_fns import CachedDecoder, supports_cached_decode
+from .prefix_cache import PrefixCache
 from .sampling import sample_next_tokens
+from .spec_decode import accept_tokens, softmax
 
-__all__ = ["GenerationServer", "StreamingFuture", "DecodeMetrics"]
+__all__ = ["GenerationServer", "StreamingFuture", "DecodeMetrics",
+           "engines_statusz"]
+
+# live-engine registry for /statusz (weak: a dropped engine vanishes)
+_ENGINES_LOCK = threading.Lock()
+_ENGINES: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def engines_statusz() -> dict:
+    """``/statusz`` section: every live engine's prefix-cache,
+    speculative and page-accounting state (incl. the refcount-leak
+    check)."""
+    with _ENGINES_LOCK:
+        engines = list(_ENGINES)
+    return {e.metrics.name: e.statusz() for e in engines}
 
 
 def _flag(name, default):
@@ -190,18 +217,25 @@ class _ActiveSeq:
     """One live lane of the in-flight decode batch."""
 
     __slots__ = ("req", "slot", "pages", "ctx", "max_total",
-                 "last_token", "n_generated", "last_emit_t")
+                 "last_token", "n_generated", "last_emit_t",
+                 "prefix_len", "history", "draft_ctx", "published")
 
     def __init__(self, req: _Request, slot: int, pages: List[int],
-                 max_total: int):
+                 max_total: int, prefix_len: int = 0):
         self.req = req
         self.slot = slot
-        self.pages = pages
+        self.pages = pages              # prefix pages first, private after
         self.ctx = len(req.prompt)      # tokens whose K/V is cached
         self.max_total = max_total      # prompt + generation budget
         self.last_token = -1
         self.n_generated = 0
         self.last_emit_t = 0.0
+        self.prefix_len = int(prefix_len)   # cached tokens reused
+        # full token history (prompt + emitted) — spec-decode draft
+        # catch-up and publish-on-completion both key pages by content
+        self.history: List[int] = [int(t) for t in req.prompt]
+        self.draft_ctx = len(req.prompt)    # draft-pool cached tokens
+        self.published = False              # prompt pages in the index
 
 
 _EVENTS = ("submitted", "completed", "rejected", "timed_out",
@@ -252,9 +286,31 @@ class DecodeMetrics:
             "paddle_decode_compile_total",
             "decode-engine dispatch signatures by compile-cache result",
             ("server", "result"))
+        self._f_ttft = reg.histogram(
+            "paddle_decode_ttft_ms",
+            "time to first token: submit to first streamed token "
+            "(prefix-cache hits collapse the prefill share)",
+            ("server",))
+        self._f_pfx_hits = reg.counter(
+            "paddle_decode_prefix_hits_total",
+            "admissions whose prompt reused cached prefix pages",
+            ("server",))
+        self._f_pfx_reused = reg.counter(
+            "paddle_decode_prefix_tokens_reused_total",
+            "prompt tokens served from the prefix cache instead of "
+            "prefill", ("server",))
+        self._f_spec_prop = reg.counter(
+            "paddle_decode_spec_proposed_tokens_total",
+            "draft-model tokens proposed to the verify step",
+            ("server",))
+        self._f_spec_acc = reg.counter(
+            "paddle_decode_spec_accepted_tokens_total",
+            "proposed tokens the target model accepted", ("server",))
         for fam in (self._f_events, self._f_tokens, self._f_inter,
                     self._f_step, self._f_occ, self._f_pages,
-                    self._f_evict, self._f_compile):
+                    self._f_evict, self._f_compile, self._f_ttft,
+                    self._f_pfx_hits, self._f_pfx_reused,
+                    self._f_spec_prop, self._f_spec_acc):
             fam.clear(server=name)
         self._events = {e: self._f_events.labels(server=name, event=e)
                         for e in _EVENTS}
@@ -269,7 +325,13 @@ class DecodeMetrics:
         self._c_hit = self._f_compile.labels(server=name, result="hit")
         self._c_miss = self._f_compile.labels(server=name,
                                               result="miss")
+        self._h_ttft = self._f_ttft.labels(server=name)
+        self._c_pfx_hits = self._f_pfx_hits.labels(server=name)
+        self._c_pfx_reused = self._f_pfx_reused.labels(server=name)
+        self._c_spec_prop = self._f_spec_prop.labels(server=name)
+        self._c_spec_acc = self._f_spec_acc.labels(server=name)
         self._w_inter = PercentileWindow(int(window))
+        self._w_ttft = PercentileWindow(int(window))
         self._w_step = {s: PercentileWindow(int(window))
                         for s in ("prefill", "decode")}
         self._occ_sum = 0
@@ -311,6 +373,19 @@ class DecodeMetrics:
     def observe_compile(self, hit: bool):
         (self._c_hit if hit else self._c_miss).inc()
 
+    def observe_ttft(self, ms: float):
+        with self._lock:
+            self._w_ttft.observe(float(ms))
+        self._h_ttft.observe(float(ms))
+
+    def observe_prefix_hit(self, tokens_reused: int):
+        self._c_pfx_hits.inc()
+        self._c_pfx_reused.inc(int(tokens_reused))
+
+    def observe_spec(self, proposed: int, accepted: int):
+        self._c_spec_prop.inc(int(proposed))
+        self._c_spec_acc.inc(int(accepted))
+
     def snapshot(self) -> dict:
         with self._lock:
             occ = (self._occ_sum / self._occ_n) if self._occ_n else 0.0
@@ -319,6 +394,7 @@ class DecodeMetrics:
                 "counters": {e: int(c.value)
                              for e, c in self._events.items()},
                 "tokens_total": int(self._c_tokens.value),
+                "ttft_ms": self._w_ttft.snapshot(),
                 "inter_token_ms": self._w_inter.snapshot(),
                 "step_ms": {s: w.snapshot()
                             for s, w in self._w_step.items()},
@@ -329,6 +405,15 @@ class DecodeMetrics:
                              "evicted_total": int(self._c_evict.value)},
                 "compile_cache": {"hits": int(self._c_hit.value),
                                   "misses": int(self._c_miss.value)},
+                "prefix": {
+                    "hits": int(self._c_pfx_hits.value),
+                    "tokens_reused": int(self._c_pfx_reused.value)},
+                "spec": {
+                    "proposed": int(self._c_spec_prop.value),
+                    "accepted": int(self._c_spec_acc.value),
+                    "acceptance_rate": (
+                        int(self._c_spec_acc.value)
+                        / max(1, int(self._c_spec_prop.value)))},
             }
 
 
@@ -356,6 +441,9 @@ class GenerationServer:
                  donate: Optional[bool] = None,
                  name: str = "generate",
                  telemetry_port: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None,
+                 draft_model=None,
+                 spec_k: Optional[int] = None,
                  start: bool = True):
         model.eval()
         self.model = model
@@ -390,9 +478,43 @@ class GenerationServer:
             seq_buckets=seq_buckets, seq_axis=1)
         self.decoder = CachedDecoder(
             model, max_batch=self.max_batch, page_size=self.page_size,
-            pages_per_seq=self.pages_per_seq, donate=donate)
+            pages_per_seq=self.pages_per_seq, donate=donate,
+            max_positions=self.max_seq_len)
         self.kv = PagedKVCache(model, num_pages=int(num_pages),
                                page_size=self.page_size)
+        # ---- shared-prefix KV reuse (radix index over full pages)
+        if prefix_cache is None:
+            prefix_cache = bool(_flag("FLAGS_decode_prefix_cache", True))
+        self.prefix = PrefixCache(self.kv) if prefix_cache else None
+        # ---- speculative decoding (draft proposes, target verifies)
+        self.spec_k = int(spec_k if spec_k is not None
+                          else _flag("FLAGS_decode_spec_k", 0))
+        if draft_model is None:
+            self.spec_k = 0
+        self.draft: Optional[CachedDecoder] = None
+        self._draft_k = self._draft_v = None
+        if self.spec_k:
+            if not supports_cached_decode(draft_model):
+                raise TypeError("draft_model must support KV-cached "
+                                "decode (forward(cache=) + "
+                                "init_kv_pools)")
+            dspec = draft_model.kv_cache_spec()
+            if dspec["max_seq_len"] < self.max_seq_len:
+                raise ValueError(
+                    f"draft model max_seq_len={dspec['max_seq_len']} "
+                    f"is shorter than the engine's "
+                    f"max_seq_len={self.max_seq_len}")
+            draft_model.eval()
+            # the draft shares the target's block tables 1:1 (its own
+            # pools, same page geometry), so prefix-cache hits reuse
+            # draft K/V for free and rollback is the same truncation
+            self.draft = CachedDecoder(
+                draft_model, max_batch=self.max_batch,
+                page_size=self.page_size,
+                pages_per_seq=self.pages_per_seq, donate=donate,
+                max_positions=self.max_seq_len)
+            self._draft_k, self._draft_v = draft_model.init_kv_pools(
+                self.kv.num_pages, self.page_size)
         self.metrics = DecodeMetrics(name, self.max_batch,
                                      self.kv.capacity)
         self.metrics.set_kv_pages(0, self.kv.capacity)
@@ -418,6 +540,8 @@ class GenerationServer:
         if self._manifest is not None and len(self._manifest) and \
                 bool(_flag("FLAGS_decode_warmup_from_manifest", False)):
             self.warmup_from_manifest()
+        with _ENGINES_LOCK:
+            _ENGINES.add(self)
         if start:
             self.start()
 
@@ -482,8 +606,27 @@ class GenerationServer:
         engine (no recompile — params are call operands). The fleet's
         in-process hot-swap path: update the model's weights, then
         ``refresh_params()``; subsequent prefills/decodes use the new
-        weights while in-flight sequences keep streaming."""
+        weights while in-flight sequences keep streaming. Cached
+        prefix pages hold K/V computed with the OLD weights, so the
+        index is cleared — serving them to new-weight requests would
+        be silent staleness."""
         self.decoder.refresh_params()
+        if self.draft is not None:
+            self.draft.refresh_params()
+        self.clear_prefix_cache()
+
+    def clear_prefix_cache(self) -> int:
+        """Drop every unpinned cached prefix page back to the free
+        list (pages shared with in-flight sequences stay until those
+        finish). Returns the number of pages freed."""
+        if self.prefix is None:
+            return 0
+        with self._lock:
+            n = self.prefix.clear()
+            if n:
+                self.metrics.set_kv_pages(self.kv.used_pages,
+                                          self.kv.free_pages)
+            return n
 
     @property
     def queue_depth(self) -> int:
@@ -496,7 +639,29 @@ class GenerationServer:
             return sum(1 for s in self._slots if s is not None)
 
     def metrics_snapshot(self) -> dict:
-        return self.metrics.snapshot()
+        snap = self.metrics.snapshot()
+        with self._lock:
+            if self.prefix is not None:
+                snap["prefix"].update(self.prefix.stats())
+            snap["spec"]["k"] = self.spec_k
+            snap["kv_leak_check"] = self.kv.leak_check()
+        return snap
+
+    def statusz(self) -> dict:
+        """One engine's /statusz section: page accounting (with the
+        refcount-leak tripwire), prefix-cache and speculative state."""
+        with self._lock:
+            out = {
+                "closed": self._closed,
+                "queue_depth": len(self._queue),
+                "active_sequences": sum(
+                    1 for s in self._slots if s is not None),
+                "kv_leak_check": self.kv.leak_check(),
+                "spec_k": self.spec_k,
+            }
+            if self.prefix is not None:
+                out["prefix_cache"] = self.prefix.stats()
+        return out
 
     # ------------------------------------------------------ lifecycle
     def start(self):
@@ -605,11 +770,16 @@ class GenerationServer:
     def warmup(self, seq_buckets: Optional[Sequence[int]] = None,
                batch_buckets: Optional[Sequence[int]] = None) -> int:
         """Pre-compile the decode lattice: the single decode-step shape
-        plus every (pow2-row, seq-bucket) prefill shape admission can
-        dispatch — continuous batching prefills PARTIAL row groups as
-        slots churn, so the row ladder matters, not just max_batch.
-        Returns the number of fresh signatures."""
+        (plus the verify step under speculation) and every (pow2-row,
+        seq-bucket) prefill shape admission can dispatch — continuous
+        batching prefills PARTIAL row groups as slots churn, so the
+        row ladder matters, not just max_batch. With the prefix cache
+        on, the chunked (suffix-prefill) lattice is warmed alongside,
+        and a draft model's mirror signatures ride every warm. Returns
+        the number of fresh signatures."""
         fresh = self._warm_decode()
+        if self.spec_k:
+            fresh += self._warm_verify()
         seqs = list(seq_buckets if seq_buckets is not None
                     else (self.policy.seq_buckets or []))
         if batch_buckets is None:
@@ -621,23 +791,34 @@ class GenerationServer:
         for s in seqs:
             for r in batch_buckets:
                 fresh += self._warm_prefill(int(r), int(s))
+                if self.prefix is not None:
+                    fresh += self._warm_chunked(int(r), int(s))
         self._warmed.set()
         return fresh
 
     def _warm_decode(self) -> int:
+        args = (np.zeros(self.max_batch, np.int64),
+                np.zeros(self.max_batch, np.int32),
+                np.zeros(self.max_batch, bool),
+                np.zeros(self.max_batch, np.int32),
+                np.zeros_like(self._tables))
         logits, k2, v2, fresh = self.decoder.decode(
-            np.zeros(self.max_batch, np.int64),
-            np.zeros(self.max_batch, np.int32),
-            np.zeros(self.max_batch, bool),
-            np.zeros(self.max_batch, np.int32),
-            np.zeros_like(self._tables), self.kv.k, self.kv.v)
+            *args, self.kv.k, self.kv.v)
         np.asarray(logits)
         self.kv.k, self.kv.v = k2, v2
         self._note_dispatch("generate_decode", fresh, [
             ((self.max_batch,), "int64"), ((self.max_batch,), "int32"),
             ((self.max_batch,), "bool"), ((self.max_batch,), "int32"),
             (self._tables.shape, "int32")], record=False)
-        return int(fresh)
+        fresh = int(fresh)
+        if self.draft is not None:
+            dlogits, dk, dv, dfresh = self.draft.decode(
+                *args, self._draft_k, self._draft_v)
+            np.asarray(dlogits)
+            self._draft_k, self._draft_v = dk, dv
+            self.metrics.observe_compile(hit=not dfresh)
+            fresh += int(dfresh)
+        return fresh
 
     def _warm_prefill(self, rows: int, seq: int) -> int:
         ids = np.zeros((rows, seq), np.int64)
@@ -650,6 +831,55 @@ class GenerationServer:
         self._note_dispatch("generate_prefill", fresh, [
             (ids.shape, "int64"), (lens.shape, "int32"),
             (tables.shape, "int32")], record=False)
+        fresh = int(fresh)
+        if self.draft is not None:
+            dlast, dk, dv, dfresh = self.draft.prefill(
+                ids, lens, tables, self._draft_k, self._draft_v)
+            np.asarray(dlast)
+            self._draft_k, self._draft_v = dk, dv
+            self.metrics.observe_compile(hit=not dfresh)
+            fresh += int(dfresh)
+        return fresh
+
+    def _warm_chunked(self, rows: int, seq: int) -> int:
+        ids = np.zeros((rows, seq), np.int64)
+        start = np.zeros(rows, np.int32)
+        seg = np.zeros(rows, np.int32)
+        tables = np.zeros((rows, self.pages_per_seq), np.int32)
+        last, k2, v2, fresh = self.decoder.prefill_chunked(
+            ids, start, seg, tables, self.kv.k, self.kv.v)
+        np.asarray(last)
+        self.kv.k, self.kv.v = k2, v2
+        self._note_dispatch("generate_chunked", fresh, [
+            (ids.shape, "int64"), (start.shape, "int32"),
+            (seg.shape, "int32"), (tables.shape, "int32")],
+            record=False)
+        fresh = int(fresh)
+        if self.draft is not None:
+            dlast, dk, dv, dfresh = self.draft.prefill_chunked(
+                ids, start, seg, tables, self._draft_k, self._draft_v)
+            np.asarray(dlast)
+            self._draft_k, self._draft_v = dk, dv
+            self.metrics.observe_compile(hit=not dfresh)
+            fresh += int(dfresh)
+        return fresh
+
+    def _warm_verify(self) -> int:
+        """The ONE [max_batch, spec_k + 1] verify signature (site-
+        tagged in the manifest so a restarted engine replays it)."""
+        width = self.spec_k + 1
+        ids = np.zeros((self.max_batch, width), np.int64)
+        start = np.zeros(self.max_batch, np.int32)
+        seg = np.zeros(self.max_batch, np.int32)
+        tables = np.zeros_like(self._tables)
+        logits, k2, v2, fresh = self.decoder.verify(
+            ids, start, seg, tables, self.kv.k, self.kv.v)
+        np.asarray(logits)
+        self.kv.k, self.kv.v = k2, v2
+        self._note_dispatch("generate_verify", fresh, [
+            (ids.shape, "int64"), (start.shape, "int32"),
+            (seg.shape, "int32"), (tables.shape, "int32")],
+            record=False)
         return int(fresh)
 
     def warmup_from_manifest(self, path: Optional[str] = None) -> int:
@@ -670,8 +900,17 @@ class GenerationServer:
             if rows > self.max_batch or seq > self.max_seq_len:
                 continue
             fresh += self._warm_prefill(int(rows), int(seq))
+        for spec in manifest.specs(site="generate_chunked"):
+            (rows, seq) = spec["feeds"][0][0]
+            if rows > self.max_batch or seq > self.max_seq_len:
+                continue
+            fresh += self._warm_chunked(int(rows), int(seq))
         if manifest.specs(site="generate_decode"):
             fresh += self._warm_decode()
+        if self.spec_k and any(
+                spec["feeds"][0][0] == (self.max_batch, self.spec_k + 1)
+                for spec in manifest.specs(site="generate_verify")):
+            fresh += self._warm_verify()
         self._warmed.set()
         return fresh
 
@@ -704,7 +943,10 @@ class GenerationServer:
                             return
                         self._lock.wait(0.05)
                         continue
-                self._decode_iteration(active)
+                if self.draft is not None:
+                    self._spec_iteration(active)
+                else:
+                    self._decode_iteration(active)
         finally:
             with self._lock:
                 self._loop_running = False
@@ -757,15 +999,33 @@ class GenerationServer:
                 req = self._queue[0]
                 max_total = min(len(req.prompt) + req.max_new,
                                 self.max_seq_len)
-                pages = self.kv.alloc(self.kv.pages_for(max_total))
+                # admission consults the prefix index FIRST: matched
+                # full pages are shared (retained), only the remainder
+                # of the reservation comes from the free list
+                matched, shared = (0, [])
+                if self.prefix is not None:
+                    matched, shared = self.prefix.match(req.prompt)
+                need = self.kv.pages_for(max_total) - len(shared)
+                pages = self.kv.alloc(need)
+                if pages is None and self.prefix is not None:
+                    # pool pressure: reclaim LRU cache-only pages,
+                    # then retry once
+                    if self.prefix.evict(need - self.kv.free_pages):
+                        pages = self.kv.alloc(need)
                 if pages is None:
                     break       # FIFO head-of-line until pages free up
+                self.kv.retain(shared)
+                if self.prefix is not None:
+                    self.prefix.note_admission(matched)
+                    if matched:
+                        self.metrics.observe_prefix_hit(matched)
                 self._queue.popleft()
                 slot = free_slots.pop(0)
-                seq = _ActiveSeq(req, slot, pages, max_total)
+                seq = _ActiveSeq(req, slot, shared + pages, max_total,
+                                 prefix_len=matched)
                 self._slots[slot] = seq
                 self._tables[slot, :] = 0
-                self._tables[slot, :len(pages)] = pages
+                self._tables[slot, :len(seq.pages)] = seq.pages
                 admitted.append(seq)
             if admitted:
                 self.metrics.set_kv_pages(self.kv.used_pages,
@@ -783,14 +1043,27 @@ class GenerationServer:
                     attrs={"server": self.metrics.name,
                            "slot": seq.slot,
                            "pages": len(seq.pages)})
-        # prefill OUTSIDE the lock, grouped by prompt seq bucket
-        groups: Dict[int, List[_ActiveSeq]] = {}
+        # prefill OUTSIDE the lock: cold prompts grouped by prompt seq
+        # bucket (windowed causal attention), prefix hits grouped by
+        # SUFFIX bucket (chunked attention over the cached prefix) —
+        # the TTFT win is the suffix window being a fraction of the
+        # prompt window
+        cold: Dict[int, List[_ActiveSeq]] = {}
+        hot: Dict[int, List[_ActiveSeq]] = {}
         for seq in admitted:
-            bucket = min(self.policy.bucket_seq(len(seq.req.prompt)),
-                         self.max_seq_len)
-            groups.setdefault(bucket, []).append(seq)
-        for bucket, seqs in groups.items():
+            n_suffix = len(seq.req.prompt) - seq.prefix_len
+            if seq.prefix_len:
+                bucket = min(self.policy.bucket_seq(n_suffix),
+                             self.max_seq_len)
+                hot.setdefault(bucket, []).append(seq)
+            else:
+                bucket = min(self.policy.bucket_seq(n_suffix),
+                             self.max_seq_len)
+                cold.setdefault(bucket, []).append(seq)
+        for bucket, seqs in cold.items():
             self._prefill_group(seqs, bucket)
+        for bucket, seqs in hot.items():
+            self._prefill_chunked_group(seqs, bucket)
 
     def _prefill_group(self, seqs: List[_ActiveSeq], seq_bucket: int):
         rows = len(seqs)
@@ -809,6 +1082,13 @@ class GenerationServer:
             last, k2, v2, fresh = self.decoder.prefill(
                 ids, lens, tables, self.kv.k, self.kv.v)
             logits = np.asarray(last)
+            self.kv.k, self.kv.v = k2, v2
+            if self.draft is not None:
+                dlast, dk, dv, dfresh = self.draft.prefill(
+                    ids, lens, tables, self._draft_k, self._draft_v)
+                np.asarray(dlast)
+                self._draft_k, self._draft_v = dk, dv
+                self.metrics.observe_compile(hit=not dfresh)
         except Exception as e:  # noqa: BLE001 - fault barrier: fail
             # only THIS group's requests; the worker survives
             with self._lock:
@@ -818,7 +1098,6 @@ class GenerationServer:
             self._trace_finish(seqs, "error",
                                error=f"{type(e).__name__}: {e}")
             return
-        self.kv.k, self.kv.v = k2, v2
         ms = (time.perf_counter() - t0) * 1e3
         self.metrics.observe_step("prefill", ms)
         for seq in seqs:
@@ -829,11 +1108,85 @@ class GenerationServer:
                     duration_ms=ms,
                     attrs={"server": self.metrics.name,
                            "rows": rows, "seq_bucket": seq_bucket,
+                           "prefix_hit": False, "tokens_reused": 0,
                            "compile_miss": bool(fresh)})
         self._note_dispatch("generate_prefill", fresh, [
             (ids.shape, "int64"), (lens.shape, "int32"),
             (tables.shape, "int32")])
+        self._publish_prompts(seqs)
         self._sample_and_emit(seqs, logits[:rows])
+
+    def _prefill_chunked_group(self, seqs: List[_ActiveSeq],
+                               seq_bucket: int):
+        """Suffix prefill for prefix-cache hits: the window holds only
+        each prompt's unmatched tail; attention reaches the shared
+        prefix pages through the block tables (kind="chunked")."""
+        rows = len(seqs)
+        padded = min(self.policy.bucket_batch(rows), self.max_batch)
+        ids = np.full((padded, seq_bucket), self.pad_token_id, np.int64)
+        start = np.zeros(padded, np.int32)
+        seg = np.zeros(padded, np.int32)
+        tables = np.zeros((padded, self.pages_per_seq), np.int32)
+        for i, seq in enumerate(seqs):
+            suffix = seq.req.prompt[seq.prefix_len:]
+            ids[i, :len(suffix)] = suffix
+            start[i] = seq.prefix_len
+            seg[i] = len(suffix)
+            tables[i] = self._tables[seq.slot]
+        t_wall = time.time_ns()
+        t0 = time.perf_counter()
+        try:
+            last, k2, v2, fresh = self.decoder.prefill_chunked(
+                ids, start, seg, tables, self.kv.k, self.kv.v)
+            logits = np.asarray(last)
+            self.kv.k, self.kv.v = k2, v2
+            if self.draft is not None:
+                dlast, dk, dv, dfresh = self.draft.prefill_chunked(
+                    ids, start, seg, tables,
+                    self._draft_k, self._draft_v)
+                np.asarray(dlast)
+                self._draft_k, self._draft_v = dk, dv
+                self.metrics.observe_compile(hit=not dfresh)
+        except Exception as e:  # noqa: BLE001 - fault barrier, as above
+            with self._lock:
+                for seq in seqs:
+                    seq.req.future._fail(e)
+                    self._release(seq, "failed")
+            self._trace_finish(seqs, "error",
+                               error=f"{type(e).__name__}: {e}")
+            return
+        ms = (time.perf_counter() - t0) * 1e3
+        self.metrics.observe_step("prefill", ms)
+        for seq in seqs:
+            if seq.req.trace is not None:
+                tracing.record_span(
+                    seq.req.trace, "generate::prefill",
+                    stage="prefill", start_unix_ns=t_wall,
+                    duration_ms=ms,
+                    attrs={"server": self.metrics.name,
+                           "rows": rows, "seq_bucket": seq_bucket,
+                           "prefix_hit": True,
+                           "tokens_reused": seq.prefix_len,
+                           "compile_miss": bool(fresh)})
+        self._note_dispatch("generate_chunked", fresh, [
+            (ids.shape, "int64"), (start.shape, "int32"),
+            (seg.shape, "int32"), (tables.shape, "int32")])
+        self._publish_prompts(seqs)
+        self._sample_and_emit(seqs, logits[:rows])
+
+    def _publish_prompts(self, seqs: List[_ActiveSeq]):
+        """Index each prefilled prompt's FULL pages so later admissions
+        (including in-flight concurrency) can share them. Runs only
+        after the prefill that wrote the pages — and the draft mirror,
+        when speculation is on — completed, so indexed pages always
+        hold valid K/V in every pool."""
+        if self.prefix is None:
+            return
+        with self._lock:
+            for seq in seqs:
+                self.prefix.publish(seq.req.prompt, seq.pages,
+                                    n_tokens=len(seq.req.prompt))
+                seq.published = True
 
     # ---- one decode iteration ----
     def _decode_iteration(self, active: List[_ActiveSeq]):
@@ -875,7 +1228,10 @@ class GenerationServer:
             default_profiler().record_step(
                 ms, kind="decode", step=self._steps,
                 device_ms=ms, occupancy=len(active),
-                kv_pages_used=self.kv.used_pages)
+                kv_pages_used=self.kv.used_pages,
+                attrs={"prefix_tokens_reused":
+                       self.prefix.tokens_reused
+                       if self.prefix is not None else 0})
         except Exception:  # noqa: BLE001 - profiling is garnish on the
             pass           # decode hot path
         for seq in active:
@@ -898,30 +1254,207 @@ class GenerationServer:
         self._sample_and_emit(active,
                               logits[[s.slot for s in active]])
 
+    # ---- one speculative iteration: draft proposes, target verifies
+    def _spec_iteration(self, active: List[_ActiveSeq]):
+        """Draft-then-verify (Leviathan et al.): the draft model
+        proposes ``spec_k`` tokens per lane through its own paged pools
+        (same block tables), then the target scores the whole
+        ``[last_accepted, d_1..d_k]`` window in ONE fixed-shape
+        ``[max_batch, k + 1]`` verify step. Accept-and-resample on the
+        host keeps the output distribution identical to plain
+        sampling; rejected tokens' K/V writes sit on the lane's
+        already-reserved pages and are rolled back by truncating
+        ``ctx``/``draft_ctx`` — the pool itself is never mutated."""
+        b, k = self.max_batch, self.spec_k
+        t_wall = time.time_ns()
+        t0 = time.perf_counter()
+        try:
+            draft_toks, draft_probs = self._draft_propose(active, k)
+            draft_ms = (time.perf_counter() - t0) * 1e3
+            # ---- verify: one chunked window per lane
+            ids = np.zeros((b, k + 1), np.int64)
+            start = np.zeros(b, np.int32)
+            seg = np.zeros(b, np.int32)
+            for s in active:
+                ids[s.slot, 0] = s.last_token
+                ids[s.slot, 1:] = draft_toks[s.slot]
+                start[s.slot] = s.ctx
+                seg[s.slot] = k + 1
+            vlogits, k2, v2, fresh = self.decoder.verify(
+                ids, start, seg, self._tables, self.kv.k, self.kv.v)
+            vlogits = np.asarray(vlogits)
+        except Exception as e:  # noqa: BLE001 - fault barrier: a model
+            # error fails the in-flight sequences, not the engine
+            with self._lock:
+                for seq in active:
+                    seq.req.future._fail(e)
+                    self._release(seq, "failed")
+            self._trace_finish(active, "error",
+                               error=f"{type(e).__name__}: {e}")
+            return
+        self.kv.k, self.kv.v = k2, v2
+        ms = (time.perf_counter() - t0) * 1e3
+        self._steps += 1
+        self.metrics.observe_step("decode", ms)
+        self.metrics.observe_occupancy(len(active))
+        self._note_dispatch("generate_verify", fresh, [
+            (ids.shape, "int64"), (start.shape, "int32"),
+            (seg.shape, "int32"), (self._tables.shape, "int32")])
+        # ---- accept-and-resample per lane (host)
+        toks_lists: List[List[int]] = []
+        accs: List[int] = []
+        n_accepted = 0
+        for s in active:
+            remaining = min(s.req.max_new - s.n_generated,
+                            s.max_total - s.ctx)
+            emitted, acc = accept_tokens(
+                vlogits[s.slot], draft_toks[s.slot],
+                draft_probs.get(s.slot), s.req.temperature, s.req.rng,
+                max_emit=remaining,
+                eos_token_id=self.eos_token_id)
+            self.metrics.observe_spec(k, acc)
+            n_accepted += acc
+            s.ctx += len(emitted)
+            # rollback-by-truncation: positions past the accepted
+            # stream hold rejected garbage in both pools; the shrunken
+            # ctx masks them and the next write overwrites in place
+            s.draft_ctx = min(s.draft_ctx, s.ctx)
+            toks_lists.append(emitted)
+            accs.append(acc)
+        try:
+            from ...observability.stepprof import default_profiler
+            default_profiler().record_step(
+                ms, kind="decode", step=self._steps,
+                device_ms=ms, occupancy=len(active),
+                kv_pages_used=self.kv.used_pages,
+                attrs={"spec_proposed": k * len(active),
+                       "spec_accepted": n_accepted,
+                       "prefix_tokens_reused":
+                       self.prefix.tokens_reused
+                       if self.prefix is not None else 0})
+        except Exception:  # noqa: BLE001 - profiling is garnish
+            pass
+        for seq, toks, acc in zip(active, toks_lists, accs):
+            if seq.req.trace is not None:
+                tracing.record_span(
+                    seq.req.trace, "generate::verify",
+                    stage="verify", start_unix_ns=t_wall,
+                    duration_ms=ms,
+                    attrs={"server": self.metrics.name,
+                           "proposed": k, "accepted": acc,
+                           "emitted": len(toks),
+                           "draft_ms": round(draft_ms, 3),
+                           "occupancy": len(active)})
+        self._emit_batch(active, toks_lists)
+
+    def _draft_propose(self, active: List[_ActiveSeq], k: int):
+        """Run the draft model ``k`` single-token steps (same
+        [max_batch, 1] signature each time), sampling each lane's
+        proposal from the draft distribution with the request's own
+        RNG. Lanes whose draft pool lags the target context (one
+        position, after a fully-accepted round) catch up first with
+        masked feed steps. Returns ``(draft_toks [B, k] int64,
+        {slot: draft_probs [k, vocab]} for sampled lanes)``."""
+        b = self.max_batch
+        while True:
+            lag = [s for s in active if s.draft_ctx < s.ctx]
+            if not lag:
+                break
+            tokens = np.zeros(b, np.int64)
+            positions = np.zeros(b, np.int32)
+            mask = np.zeros(b, bool)
+            ctx_after = np.zeros(b, np.int32)
+            for s in lag:
+                tokens[s.slot] = s.history[s.draft_ctx]
+                positions[s.slot] = s.draft_ctx
+                mask[s.slot] = True
+                ctx_after[s.slot] = s.draft_ctx + 1
+            _, dk, dv, dfresh = self.draft.decode(
+                tokens, positions, mask, ctx_after, self._tables,
+                self._draft_k, self._draft_v)
+            self._draft_k, self._draft_v = dk, dv
+            self.metrics.observe_compile(hit=not dfresh)
+            for s in lag:
+                s.draft_ctx += 1
+        draft_toks = np.zeros((b, k), np.int64)
+        draft_probs: Dict[int, np.ndarray] = {}
+        feed = np.zeros(b, np.int64)
+        for s in active:
+            feed[s.slot] = s.last_token
+        for j in range(k):
+            positions = np.zeros(b, np.int32)
+            mask = np.zeros(b, bool)
+            ctx_after = np.zeros(b, np.int32)
+            for s in active:
+                positions[s.slot] = s.draft_ctx
+                mask[s.slot] = True
+                ctx_after[s.slot] = s.draft_ctx + 1
+            logits, dk, dv, dfresh = self.draft.decode(
+                feed, positions, mask, ctx_after, self._tables,
+                self._draft_k, self._draft_v)
+            logits = np.asarray(logits)
+            self._draft_k, self._draft_v = dk, dv
+            self.metrics.observe_compile(hit=not dfresh)
+            for s in active:
+                row = logits[s.slot]
+                if s.req.temperature > 0.0:
+                    p = softmax(row, s.req.temperature)
+                    probs = draft_probs.setdefault(
+                        s.slot, np.zeros((k, row.shape[-1])))
+                    probs[j] = p
+                    cdf = np.cumsum(p)
+                    tok = int(min(
+                        np.searchsorted(
+                            cdf, s.req.rng.random_sample() * cdf[-1],
+                            side="right"),
+                        row.shape[-1] - 1))
+                else:
+                    tok = int(row.argmax())
+                draft_toks[s.slot, j] = tok
+                feed[s.slot] = tok
+                s.draft_ctx += 1
+        return draft_toks, draft_probs
+
     # ---- shared harvest: sample, stream, evict ----
     def _sample_and_emit(self, seqs: List[_ActiveSeq],
                          logits: np.ndarray):
         temps = np.array([s.req.temperature for s in seqs], np.float64)
         uniforms = np.array([s.req.rng.random_sample() for s in seqs])
         toks = sample_next_tokens(logits, temps, uniforms=uniforms)
+        self._emit_batch(seqs, [[int(t)] for t in toks])
+
+    def _emit_batch(self, seqs: List[_ActiveSeq],
+                    toks_lists: List[List[int]]):
+        """Stream each sequence's newly-selected tokens (one from a
+        prefill/decode step, up to spec_k + 1 from a verify step),
+        then run the finish checks. Callers updated ``seq.ctx`` first."""
         now = time.monotonic()
         inter = []
-        self.metrics.observe_tokens(len(seqs))
+        total = sum(len(t) for t in toks_lists)
+        self.metrics.observe_tokens(total)
         with self._lock:
-            for seq, tok in zip(seqs, toks):
-                seq.last_token = int(tok)
-                seq.n_generated += 1
-                if seq.n_generated > 1:
-                    inter.append((now - seq.last_emit_t) * 1e3)
-                seq.last_emit_t = now
-                seq.req.future._emit(tok)
+            for seq, toks in zip(seqs, toks_lists):
+                for tok in toks:
+                    tok = int(tok)
+                    seq.last_token = tok
+                    seq.history.append(tok)
+                    seq.n_generated += 1
+                    if seq.n_generated == 1:
+                        self.metrics.observe_ttft(
+                            (now - seq.req.submit_t) * 1e3)
+                    else:
+                        inter.append((now - seq.last_emit_t) * 1e3)
+                    seq.last_emit_t = now
+                    seq.req.future._emit(tok)
+                if not toks:
+                    continue
                 if seq.req.future._cancel_requested:
                     seq.req.future._finish("cancelled")
                     self._release(seq, "cancelled")
                     self._trace_finish([seq], "ok",
                                        finish_reason="cancelled")
                 elif self.eos_token_id is not None and \
-                        int(tok) == self.eos_token_id:
+                        int(toks[-1]) == self.eos_token_id:
                     seq.req.future._finish("eos")
                     self._release(seq, "completed")
                     self._trace_finish([seq], "ok",
@@ -962,14 +1495,27 @@ class GenerationServer:
                 status=status, attrs=attrs, root=True)
 
     def _release(self, seq: _ActiveSeq, event: str):
-        """Evict one sequence: pages back to the pool, slot freed
-        (lock held)."""
+        """Evict one sequence: drop its page references, free the slot
+        (lock held). A COMPLETED sequence first publishes its full
+        pages — prompt AND generated tokens — into the prefix index,
+        so the pages stay cached (refcount 1, index-held) instead of
+        returning to the free list; everything else (partial tail
+        page, failed/cancelled streams) frees as refcounts hit zero."""
         if self._slots[seq.slot] is not seq:
             return
+        if event == "completed" and self.prefix is not None \
+                and seq.published:
+            # history[:ctx] are the positions whose K/V is actually in
+            # the pool (the final emitted token was never written);
+            # under speculation, cap at what the DRAFT pool also holds
+            # so shared pages are valid in both pools
+            n_ok = seq.ctx if self.draft is None \
+                else min(seq.ctx, seq.draft_ctx)
+            self.prefix.publish(seq.history, seq.pages, n_tokens=n_ok)
         self._slots[seq.slot] = None
         self._tables[seq.slot, :] = 0
-        self.kv.free(seq.pages)
-        self.metrics.observe_evictions(len(seq.pages))
+        freed = self.kv.release(seq.pages)
+        self.metrics.observe_evictions(freed)
         self.metrics.count(event)
         self.metrics.set_kv_pages(self.kv.used_pages,
                                   self.kv.free_pages)

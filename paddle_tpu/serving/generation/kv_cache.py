@@ -8,13 +8,23 @@ per-slot page assignments, and the occupancy/eviction accounting. Page
 0 is the reserved trash page (masked writes land there) and is never
 handed out.
 
+Pages are REFCOUNTED so the prefix cache can share immutable full
+pages across sequences (serving/generation/prefix_cache.py): ``alloc``
+hands out pages at refcount 1, ``retain`` adds a sharer (a second
+sequence mapping the page into its block table, or the prefix index
+pinning a published page), and ``release``/``free`` drop one
+reference — a page returns to the free list only when its LAST
+reference goes away. Freeing a *shared* page therefore decrements
+instead of double-returning it (the eviction-accounting bug class
+``assert_no_leaks`` exists to catch).
+
 Thread-safety: the engine's worker thread is the only mutator; the
 allocator itself is plain data guarded by the engine lock.
 """
 from __future__ import annotations
 
 import math
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 __all__ = ["PagedKVCache"]
 
@@ -38,6 +48,7 @@ class PagedKVCache:
         self.k, self.v = model.init_kv_pools(self.num_pages,
                                              self.page_size, dtype)
         self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
+        self._ref: Dict[int, int] = {}      # page -> live reference count
         self.evicted_pages_total = 0
 
     # ---- geometry ----
@@ -59,22 +70,85 @@ class PagedKVCache:
 
     # ---- allocation ----
     def alloc(self, n_pages: int) -> Optional[List[int]]:
-        """Take ``n_pages`` from the free list, or None (and take
-        nothing) if fewer are free."""
+        """Take ``n_pages`` from the free list (each at refcount 1), or
+        None (and take nothing) if fewer are free."""
         if n_pages > len(self._free):
             return None
         taken = self._free[-n_pages:]
         del self._free[-n_pages:]
+        for p in taken:
+            self._ref[p] = 1
         return taken
 
-    def free(self, pages: List[int]):
-        """Return a finished sequence's pages (its eviction from the
-        cache). The page contents stay as garbage until rewritten —
-        correctness relies on block tables, not on zeroing."""
+    def retain(self, pages: List[int]) -> None:
+        """Add one reference to each already-allocated page — a second
+        sequence sharing a cached prefix page, or the prefix index
+        pinning a published page."""
+        for p in pages:
+            if self._ref.get(p, 0) < 1:
+                raise ValueError(f"retain of unallocated page {p}")
+            self._ref[p] += 1
+
+    def refcount(self, page: int) -> int:
+        return self._ref.get(page, 0)
+
+    def release(self, pages: List[int]) -> int:
+        """Drop one reference per page; pages whose last reference goes
+        away return to the free list (their eviction from the pool —
+        contents stay as garbage until rewritten; correctness relies on
+        block tables, not on zeroing). Returns the number of pages
+        actually freed, which for shared pages is less than
+        ``len(pages)``."""
+        freed = 0
         for p in pages:
             if not 0 < p < self.num_pages:
                 raise ValueError(f"page {p} out of range")
-        self._free.extend(pages)
-        self.evicted_pages_total += len(pages)
+            n = self._ref.get(p, 0)
+            if n < 1:
+                raise RuntimeError(
+                    f"double free: page {p} has no live references")
+            if n == 1:
+                del self._ref[p]
+                self._free.append(p)
+                freed += 1
+            else:
+                self._ref[p] = n - 1
+        self.evicted_pages_total += freed
         if len(self._free) > self.capacity:
             raise RuntimeError("double free: free list exceeds capacity")
+        return freed
+
+    def free(self, pages: List[int]) -> int:
+        """Return a finished sequence's references. Alias of
+        ``release`` — kept because "free" is the engine-side verb; a
+        SHARED page is only decremented here, never pushed back onto
+        the free list while another sequence (or the prefix index)
+        still maps it."""
+        return self.release(pages)
+
+    # ---- invariants ----
+    def leak_check(self) -> dict:
+        """Accounting snapshot: free + referenced must cover capacity
+        exactly, with no page both free and referenced. Cheap enough
+        for /statusz."""
+        free_set = set(self._free)
+        overlap = sorted(free_set & set(self._ref))
+        bad_refs = sorted(p for p, n in self._ref.items() if n < 1)
+        return {
+            "capacity": self.capacity,
+            "free": len(self._free),
+            "referenced": len(self._ref),
+            "leaked": self.capacity - len(self._free) - len(self._ref),
+            "double_booked": overlap,
+            "nonpositive_refcounts": bad_refs,
+            "ok": (len(self._free) + len(self._ref) == self.capacity
+                   and not overlap and not bad_refs),
+        }
+
+    def assert_no_leaks(self) -> None:
+        """Raise if any page is neither free nor referenced (or both) —
+        the refcount-leak tripwire tests and /statusz run after
+        admit/share/finish/evict cycles."""
+        chk = self.leak_check()
+        if not chk["ok"]:
+            raise AssertionError(f"KV page accounting leak: {chk}")

@@ -9,9 +9,19 @@ then exposes exactly two device entry points:
   (the full ``[B, S, vocab]`` tensor never crosses to the host);
 - ``decode(tokens, positions, active, ctx, tables, pools)`` — the
   fixed-shape ``[max_batch, 1]`` decode step: append one position per
-  live lane, attend through the block tables, return ``[B, vocab]``.
+  live lane, attend through the block tables, return ``[B, vocab]``;
+- ``prefill_chunked(ids, start, seg_lens, tables, pools)`` — suffix
+  prefill at a per-row starting position: window tokens attend to the
+  already-cached prefix through the block tables (kind="chunked"),
+  used after a shared-prefix cache hit so only the unique suffix pays
+  prefill; returns the last real position's logits like ``prefill``;
+- ``verify(tokens, start, seg_lens, tables, pools)`` — the
+  speculative-decoding verify step: ONE fixed-shape
+  ``[max_batch, spec_k + 1]`` chunked forward scoring a draft model's
+  proposed tokens, returning ALL window logits ``[B, S, vocab]`` so
+  the host can run accept-and-resample.
 
-Both are ``jax.jit``-compiled with the KV pools donated on backends
+All are ``jax.jit``-compiled with the KV pools donated on backends
 that support donation (the pools update in place on device), and both
 consult the persistent compile cache (PR 5) first: on a warm
 ``FLAGS_compile_cache_dir`` the first dispatch of a signature loads a
@@ -57,7 +67,8 @@ class CachedDecoder:
     """
 
     def __init__(self, model, *, max_batch: int, page_size: int,
-                 pages_per_seq: int, donate: Optional[bool] = None):
+                 pages_per_seq: int, donate: Optional[bool] = None,
+                 max_positions: Optional[int] = None):
         import jax
 
         from ...jit.functional import state_arrays
@@ -72,6 +83,9 @@ class CachedDecoder:
         self.max_batch = int(max_batch)
         self.page_size = int(page_size)
         self.pages_per_seq = int(pages_per_seq)
+        self.max_positions = int(
+            max_positions if max_positions is not None
+            else model.kv_cache_spec()["max_seq_len"])
         self._params, self._buffers = state_arrays(model)
         self._donate = bool(donate) if donate is not None \
             else jax.default_backend() != "cpu"
@@ -136,10 +150,53 @@ class CachedDecoder:
                 model, params, buffers, ids, cache=cache, training=False)
             return logits[:, 0], k2, v2
 
+        max_pos = self.max_positions
+
+        def _chunked(params, buffers, ids, start, seg_lens, tables,
+                     k, v):
+            # suffix prefill / speculative verify window: per-row
+            # starting positions; attention reaches the cached prefix
+            # through the block tables (kind="chunked"). Returns ALL
+            # window logits [B, S, vocab].
+            ids = constrain_batch(ids)
+            b, s = ids.shape
+            offs = jnp.arange(s, dtype=jnp.int32)[None, :]
+            positions = start.astype(jnp.int32)[:, None] + offs
+            # positions past the model's addressable range (a verify
+            # window overhanging the budget) write to the trash page
+            # and mask themselves out; their logits are garbage the
+            # host never consumes
+            valid = (offs < seg_lens[:, None]) & (positions < max_pos)
+            ctx = (start + seg_lens).astype(jnp.int32)
+            cache = GPTKVCache(
+                "chunked", page,
+                jax.tree_util.tree_map(_wrap, k),
+                jax.tree_util.tree_map(_wrap, v),
+                _wrap(tables), _wrap(ctx), _wrap(valid),
+                _wrap(positions))
+            logits, (k2, v2) = functional_call(
+                model, params, buffers, ids, cache=cache, training=False)
+            return logits, k2, v2
+
+        def _prefill_chunked(params, buffers, ids, start, seg_lens,
+                             tables, k, v):
+            logits, k2, v2 = _chunked(params, buffers, ids, start,
+                                      seg_lens, tables, k, v)
+            b, s = ids.shape
+            idx = jnp.clip(seg_lens.astype(jnp.int32) - 1, 0, s - 1)
+            idx = jnp.broadcast_to(idx[:, None, None],
+                                   (b, 1, logits.shape[-1]))
+            last = jnp.take_along_axis(logits, idx, axis=1)[:, 0]
+            return last, k2, v2
+
         donate_pf = (5, 6) if self._donate else ()
         donate_dc = (7, 8) if self._donate else ()
+        donate_ck = (6, 7) if self._donate else ()
         self._prefill_jit = jax.jit(_prefill, donate_argnums=donate_pf)
         self._decode_jit = jax.jit(_decode, donate_argnums=donate_dc)
+        self._chunked_jit = jax.jit(_prefill_chunked,
+                                    donate_argnums=donate_ck)
+        self._verify_jit = jax.jit(_chunked, donate_argnums=donate_ck)
 
     def refresh_params(self):
         """Re-snapshot the model's current parameter arrays (they are
@@ -158,7 +215,8 @@ class CachedDecoder:
             geom = {"max_batch": self.max_batch,
                     "page_size": self.page_size,
                     "pages_per_seq": self.pages_per_seq,
-                    "donate": self._donate, "v": 1}
+                    "max_positions": self.max_positions,
+                    "donate": self._donate, "v": 2}
             h = hashlib.sha256(layer_fingerprint(self.model).encode())
             h.update(json.dumps(geom, sort_keys=True).encode())
             self._fp = h.hexdigest()
@@ -229,6 +287,40 @@ class CachedDecoder:
         (last, k2, v2), fresh = self._dispatch(
             "generate_prefill", self._prefill_jit, args)
         return last, k2, v2, fresh
+
+    def prefill_chunked(self, ids: np.ndarray, start: np.ndarray,
+                        seg_lens: np.ndarray, tables: np.ndarray, k, v):
+        """Suffix prefill after a prefix-cache hit. ids [B, S] int64
+        (left-aligned suffix tokens); start [B] int32 per-row absolute
+        offset (= matched prefix length, 0 = dead row); seg_lens [B]
+        int32 real suffix lengths; tables [B, P] int32 (prefix pages
+        first, then the row's private pages). Returns ``(last_logits
+        [B, vocab] jax array, k', v', new_signature)``."""
+        args = (self._params, self._buffers,
+                np.ascontiguousarray(ids, np.int64),
+                np.ascontiguousarray(start, np.int32),
+                np.ascontiguousarray(seg_lens, np.int32),
+                np.ascontiguousarray(tables, np.int32), k, v)
+        (last, k2, v2), fresh = self._dispatch(
+            "generate_chunked", self._chunked_jit, args)
+        return last, k2, v2, fresh
+
+    def verify(self, tokens: np.ndarray, start: np.ndarray,
+               seg_lens: np.ndarray, tables: np.ndarray, k, v):
+        """Speculative verify: one fixed-shape chunked forward over the
+        [last_accepted, d_1..d_k] window per lane, returning ALL window
+        logits ``[B, S, vocab]`` (S = spec_k + 1) so the host judges
+        every proposal in one device step. Rejected positions' K/V
+        writes land on the lane's already-reserved pages and are rolled
+        back by context-length truncation, never by pool mutation."""
+        args = (self._params, self._buffers,
+                np.ascontiguousarray(tokens, np.int64),
+                np.ascontiguousarray(start, np.int32),
+                np.ascontiguousarray(seg_lens, np.int32),
+                np.ascontiguousarray(tables, np.int32), k, v)
+        (logits, k2, v2), fresh = self._dispatch(
+            "generate_verify", self._verify_jit, args)
+        return logits, k2, v2, fresh
 
     def decode(self, tokens: np.ndarray, positions: np.ndarray,
                active: np.ndarray, ctx: np.ndarray,

@@ -282,14 +282,17 @@ class TestGenerationServer:
 
     def test_page_reuse_after_eviction(self):
         """Pool sized for ONE sequence: the second request reuses the
-        first one's evicted pages and still decodes correctly."""
+        first one's evicted pages and still decodes correctly.
+        (prefix_cache off: this pins the LEGACY eager-free accounting;
+        the cached-page variant lives in test_prefix_spec.py.)"""
         m, cfg = make_model()
         p1, p2 = [5, 7, 9], [8, 6, 4]
         r1 = self._reference(m, cfg, p1, 6)
         r2 = self._reference(m, cfg, p2, 6)
         # capacity: pages for one sequence of 3+6=9 tokens @ page 4 = 3
         with GenerationServer(m, max_batch=2, page_size=4, num_pages=4,
-                              max_seq_len=16, name="reuse") as srv:
+                              max_seq_len=16, prefix_cache=False,
+                              name="reuse") as srv:
             f1 = srv.submit_generate(p1, max_new_tokens=6)
             f2 = srv.submit_generate(p2, max_new_tokens=6)
             assert f1.result(timeout=60) == r1

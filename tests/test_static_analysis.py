@@ -551,6 +551,33 @@ class TestLockDiscipline:
         assert any(f.rule == "LK001" and "generation" in f.path
                    for f in findings)
 
+    def test_scope_includes_prefix_cache_module(self, tmp_path):
+        """Scope self-test for shared-prefix KV caching: the serving/
+        prefix must reach serving/generation/prefix_cache.py — the
+        radix index and page refcounts are shared state mutated from
+        the engine worker under the engine lock, so an injected
+        unguarded write there is reported."""
+        pkg = tmp_path / "paddle_tpu" / "serving" / "generation"
+        pkg.mkdir(parents=True)
+        (pkg / "prefix_cache.py").write_text(textwrap.dedent("""
+            import threading
+
+            class PrefixIndex:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._cached_pages = 0
+
+                def publish(self):
+                    with self._lock:
+                        self._cached_pages += 1
+
+                def sloppy_evict(self):
+                    self._cached_pages -= 1
+        """))
+        findings = _run(tmp_path, [LockDisciplineAnalyzer()])
+        assert any(f.rule == "LK001" and "prefix_cache" in f.path
+                   for f in findings)
+
     def test_scope_includes_fleet_subpackage(self, tmp_path):
         """The serving/ prefix must also reach the fleet subpackage —
         router poll thread, supervisor monitor thread, and HTTP

@@ -16,9 +16,24 @@ baseline: window time / tokens, the lockstep equivalent), and a
 cached-vs-uncached logits equivalence probe. One JSON line to stdout;
 ``--out`` also writes the committed BENCH_DECODE_r*.json record.
 
+Two further modes share ``_bench_common`` plumbing and emit ONE
+combined ``decode_prefix_spec`` record (BENCH_PREFIX_r*.json):
+
+- ``--prefix``: hot-vs-cold time-to-first-token with a shared
+  256-token preamble. Cold = empty prefix cache, full-prompt prefill;
+  hot = radix hit, chunked suffix-only prefill. Paired per trial on
+  one warmed engine (``clear_prefix_cache`` between pairs).
+- ``--spec``: speculative decoding single-stream throughput. The
+  draft is a small GPT; the TARGET is the draft plus zero-residual
+  tail layers (bit-identical logits, ~layers-ratio more compute), so
+  the mode measures the draft/verify machinery at its acceptance
+  ceiling with the rate reported honestly alongside; greedy parity
+  vs the non-speculative engine is asserted, not assumed.
+
 Usage: JAX_PLATFORMS=cpu python tools/bench_decode.py
        [--batch 8] [--prompt-len 12] [--max-new 48] [--trials 3]
-       [--requests N] [--out BENCH_DECODE_rNN.json]
+       [--requests N] [--prefix] [--spec] [--spec-k 4]
+       [--out BENCH_DECODE_rNN.json | BENCH_PREFIX_rNN.json]
 """
 import argparse
 import os
@@ -65,9 +80,166 @@ def _parse_args():
     ap.add_argument("--max-new", type=int, default=48)
     ap.add_argument("--page-size", type=int, default=16)
     ap.add_argument("--trials", type=int, default=3)
+    ap.add_argument("--prefix", action="store_true",
+                    help="hot-vs-cold TTFT with a shared preamble")
+    ap.add_argument("--spec", action="store_true",
+                    help="speculative decoding single-stream tok/s")
+    ap.add_argument("--spec-k", type=int, default=6,
+                    help="draft tokens proposed per verify step")
+    ap.add_argument("--preamble", type=int, default=256,
+                    help="shared-prefix preamble length (--prefix)")
     ap.add_argument("--out", default=None,
                     help="also write the JSON record here")
     return ap.parse_args()
+
+
+def _ttft(srv, prompt, max_new):
+    """Submit one request; wall-clock to the FIRST streamed token."""
+    t0 = time.perf_counter()
+    fut = srv.submit_generate(prompt, max_new_tokens=max_new)
+    for _ in fut:
+        break
+    ttft = (time.perf_counter() - t0) * 1e3
+    fut.result(timeout=600)
+    return ttft
+
+
+def _bench_prefix(args):
+    """Hot-vs-cold TTFT with a shared preamble: page-granular radix
+    hits turn the preamble prefill into block-table rows, leaving only
+    the unique suffix's chunked prefill on the critical path."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+    from paddle_tpu.serving.generation import GenerationServer
+
+    paddle.seed(0)
+    pre_len, suf_len, max_new = args.preamble, 8, 4
+    cfg = gpt_tiny(use_flash_attention=False, hidden_size=128,
+                   num_layers=4, num_heads=4,
+                   max_seq_len=2 * args.preamble)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(0)
+    preamble = list(rng.randint(0, cfg.vocab_size, pre_len))
+    srv = GenerationServer(model, max_batch=2, page_size=args.page_size,
+                           name="bench-prefix", start=False)
+    # warm every signature BOTH paths dispatch, so TTFT measures
+    # prefill compute, not compilation
+    full_bucket = srv.policy.bucket_seq(pre_len + suf_len)
+    suffix_bucket = srv.policy.bucket_seq(suf_len)
+    srv.warmup(seq_buckets=sorted({full_bucket, suffix_bucket}),
+               batch_buckets=[1])
+    srv.start()
+    cold_ms, hot_ms, reused = [], [], 0
+    for trial in range(args.trials):
+        srv.clear_prefix_cache()
+        suffix = list(rng.randint(0, cfg.vocab_size, suf_len))
+        cold_ms.append(_ttft(srv, preamble + suffix, max_new))
+        suffix = list(rng.randint(0, cfg.vocab_size, suf_len))
+        hot_ms.append(_ttft(srv, preamble + suffix, max_new))
+    snap = srv.metrics_snapshot()
+    reused = snap["prefix"]["tokens_reused"]
+    assert snap["prefix"]["hits"] == args.trials, snap["prefix"]
+    assert snap["kv_leak_check"]["ok"]
+    srv.shutdown()
+    cold, hot = _median(cold_ms), _median(hot_ms)
+    return {
+        "cold_ttft_ms": round(cold, 3),
+        "hot_ttft_ms": round(hot, 3),
+        "ttft_speedup": round(cold / hot, 3) if hot else 0.0,
+        "preamble_tokens": pre_len,
+        "suffix_tokens": suf_len,
+        "tokens_reused_total": int(reused),
+        "trials": args.trials,
+        "model": {"hidden": cfg.hidden_size,
+                  "layers": cfg.num_layers,
+                  "max_seq_len": cfg.max_seq_len},
+    }
+
+
+def _spec_model_pair(layers_draft=2, layers_extra=10):
+    """(draft, target) with BIT-IDENTICAL logits: the target is the
+    draft plus ``layers_extra`` residual blocks whose output
+    projections are zeroed (each contributes exactly 0 through the
+    residual stream) — honest target-sized compute at the acceptance
+    ceiling."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+
+    paddle.seed(0)
+    dcfg = gpt_tiny(use_flash_attention=False, num_layers=layers_draft)
+    draft = GPTForCausalLM(dcfg)
+    draft.eval()
+    paddle.seed(1)
+    tcfg = gpt_tiny(use_flash_attention=False,
+                    num_layers=layers_draft + layers_extra)
+    target = GPTForCausalLM(tcfg)
+    target.eval()
+    shared = dict(draft.named_parameters())
+    for name, p in target.named_parameters():
+        src = shared.get(name)
+        if src is not None and tuple(src.shape) == tuple(p.shape):
+            p.set_value(np.asarray(src.numpy()))
+    for layer in list(target.gpt.layers)[layers_draft:]:
+        for par in (layer.attn.out_proj.weight,
+                    layer.attn.out_proj.bias,
+                    layer.mlp.fc_out.weight, layer.mlp.fc_out.bias):
+            par.set_value(np.zeros(par.shape, dtype=par.numpy().dtype))
+    return draft, target, tcfg
+
+
+def _bench_spec(args):
+    """Single-stream tok/s, speculative vs plain, same target model.
+    Greedy parity is ASSERTED (the accept rule guarantees it); the
+    acceptance rate rides the record."""
+    from paddle_tpu.serving.generation import GenerationServer
+
+    draft, target, cfg = _spec_model_pair()
+    rng = np.random.RandomState(0)
+    prompt = list(rng.randint(0, cfg.vocab_size, args.prompt_len))
+    max_new = args.max_new
+
+    def run(srv):
+        srv.warmup(seq_buckets=[srv.policy.bucket_seq(len(prompt))],
+                   batch_buckets=[1])
+        srv.start()
+        streams, tps = [], []
+        for _ in range(args.trials):
+            srv.clear_prefix_cache()
+            t0 = time.perf_counter()
+            streams.append(srv.generate(prompt, max_new_tokens=max_new))
+            tps.append(max_new / (time.perf_counter() - t0))
+        snap = srv.metrics_snapshot()
+        srv.shutdown()
+        return streams, _median(tps), snap
+
+    base_srv = GenerationServer(target, max_batch=2,
+                                page_size=args.page_size,
+                                name="bench-spec-base", start=False)
+    base_streams, base_tps, _ = run(base_srv)
+    spec_srv = GenerationServer(target, max_batch=2,
+                                page_size=args.page_size,
+                                draft_model=draft, spec_k=args.spec_k,
+                                name="bench-spec", start=False)
+    spec_streams, spec_tps, snap = run(spec_srv)
+    parity = all(s == b for s, b in zip(spec_streams, base_streams))
+    spec = snap["spec"]
+    steps = snap["step_ms"]["decode"]["count"]
+    return {
+        "base_tok_s": round(base_tps, 1),
+        "spec_tok_s": round(spec_tps, 1),
+        "speedup": round(spec_tps / base_tps, 3) if base_tps else 0.0,
+        "greedy_parity": bool(parity),
+        "acceptance_rate": round(spec["acceptance_rate"], 4),
+        "accepted_tokens_per_step": round(
+            spec["accepted"] / max(1, steps), 3),
+        "spec_k": args.spec_k,
+        "max_new_tokens": max_new,
+        "trials": args.trials,
+        "model": {"draft_layers": 2,
+                  "target_layers": cfg.num_layers,
+                  "hidden": cfg.hidden_size},
+    }
 
 
 def _run(args):
@@ -75,6 +247,21 @@ def _run(args):
 
     if jax.default_backend() == "cpu":
         jax.config.update("jax_platforms", "cpu")
+
+    if args.prefix or args.spec:
+        record = {"metric": "decode_prefix_spec", "skipped": False,
+                  "unit": "x", "vs_baseline": 0.0}
+        if args.prefix:
+            record["prefix"] = _bench_prefix(args)
+            record["value"] = record["prefix"]["ttft_speedup"]
+        if args.spec:
+            record["spec"] = _bench_spec(args)
+            record.setdefault("value", record["spec"]["speedup"])
+        record["vs_baseline"] = record["value"]
+        record["config"] = {"backend": jax.default_backend(),
+                            "page_size": args.page_size}
+        emit_record(record, out=args.out)
+        return 0
 
     import paddle_tpu as paddle
     from paddle_tpu.distributed.fleet.utils import (

@@ -69,6 +69,25 @@ GATES: List[Dict[str, Any]] = [
      "path": ("engine_p99_inter_token_ms",),
      "op": "max", "baseline": 1.975, "rel_tol": 0.25, "unit": "ms",
      "why": "decode tail latency between tokens"},
+    {"name": "prefix_ttft_speedup", "metric": "decode_prefix_spec",
+     "files": "BENCH_PREFIX_r*.json",
+     "path": ("prefix", "ttft_speedup"),
+     "op": "min", "baseline": 3.0, "rel_tol": 0.0, "unit": "x",
+     "why": "hot-prefix TTFT >= 3x cold for a 256-token shared "
+            "preamble is the PR 12 acceptance floor (r01 measured "
+            "10.3x; radix hits turn preamble prefill into block-table "
+            "rows)"},
+    {"name": "spec_decode_speedup", "metric": "decode_prefix_spec",
+     "files": "BENCH_PREFIX_r*.json", "path": ("spec", "speedup"),
+     "op": "min", "baseline": 1.5, "rel_tol": 0.0, "unit": "x",
+     "why": "speculative single-stream tok/s >= 1.5x plain decode at "
+            "the acceptance ceiling (r01 measured 1.73x at k=6, "
+            "acceptance 1.0 by zero-residual construction)"},
+    {"name": "spec_greedy_parity", "metric": "decode_prefix_spec",
+     "files": "BENCH_PREFIX_r*.json", "path": ("spec", "greedy_parity"),
+     "op": "true",
+     "why": "accept-and-resample must keep speculative greedy output "
+            "identical to non-speculative decoding (PR 12)"},
     {"name": "fleet_qps", "metric": "fleet_aggregate_qps",
      "files": "BENCH_FLEET_r*.json", "path": ("value",),
      "op": "min", "baseline": 2524.0, "rel_tol": 0.10, "unit": "req/s",
