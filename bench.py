@@ -254,6 +254,65 @@ def main():
         return 0
 
 
+# hand-vs-cost-model agreement bound: divergence beyond this from BOTH
+# analytic candidates (plain 6N, full-remat ~8N) fails the record
+_COST_AGREE_TOL = 0.15
+
+
+def _train_cost_model_check(batch, seq, n_params, attn_flops):
+    """XLA cost-model FLOPs of the train executable that actually ran
+    (xstats registry) vs the hand formula. Returns the record section;
+    ``available`` is False when no analysis could be read (the bench
+    then reports the hand number alone instead of failing)."""
+    out = {"available": False}
+    try:
+        from paddle_tpu.observability import xstats
+        reg = xstats.default_exec_registry()
+        ents = [e for e in reg.entries()
+                if e.site == "train_step" and e.dispatches]
+        if not ents:
+            return out
+        ent = max(ents, key=lambda e: e.last_dispatch_unix_ms or 0)
+        ana = reg.ensure_analysis(ent)
+        if not ana or not ana.get("flops"):
+            out["error"] = ent.analysis_error
+            return out
+        # a run_steps window executable wraps K steps in a lax.scan;
+        # XLA's HLO cost analysis counts the while BODY once (it does
+        # not multiply by trip count), so the per-token normalization
+        # tries both readings and keeps the closer one — either way a
+        # real model-shape drift moves the FLOPs far beyond the bound
+        tag = ent.signature[0][1] if ent.signature else "tag:single"
+        steps = int(tag.rsplit(":", 1)[1]) if "multi" in tag else 1
+        per_token = {"body_once": ana["flops"] / (batch * seq),
+                     "times_steps":
+                     ana["flops"] / (steps * batch * seq)}
+        hand = 6 * n_params + attn_flops
+        # full remat re-runs the forward inside the backward: ~one
+        # extra model forward (2N) and a second attention pass
+        hand_remat = 8 * n_params + 2 * attn_flops
+        ratios = {f"{k}_vs_{h}": cm / hv
+                  for k, cm in per_token.items()
+                  for h, hv in (("plain", hand), ("remat", hand_remat))}
+        best_key = min(ratios, key=lambda k: abs(ratios[k] - 1.0))
+        out.update({
+            "available": True,
+            "flops_per_token": round(
+                per_token["body_once" if "body_once" in best_key
+                          else "times_steps"], 1),
+            "hand_flops_per_token": float(hand),
+            "hand_remat_flops_per_token": float(hand_remat),
+            "ratios": {k: round(v, 4) for k, v in ratios.items()},
+            "best": best_key,
+            "agrees": abs(ratios[best_key] - 1.0) <= _COST_AGREE_TOL,
+            "exec_flops": ana["flops"],
+            "window_steps": steps,
+        })
+    except Exception as e:  # noqa: BLE001 - the cross-check must not
+        out["error"] = f"{type(e).__name__}: {e}"  # sink a bench run
+    return out
+
+
 def _run(args):
     import jax  # noqa: F401 - the backend may init at first op below
 
@@ -418,13 +477,31 @@ def _run(args):
     mfu = achieved / peak
     assert np.isfinite(final), "loss diverged"
 
+    # cost-model cross-check: the XLA-counted FLOPs of the executable
+    # that actually ran (xstats registry) against the hand formula the
+    # MFU headline is derived from — silent model-shape drift in the
+    # hand 6ND would show up here as divergence. Full-remat configs
+    # legitimately execute ~an extra forward (8N-ish), so agreement is
+    # judged against the closer of the two analytic candidates.
+    cost_model = _train_cost_model_check(batch, seq, n_params,
+                                         attn_flops)
+
     print(json.dumps({
         "metric": metric,
         "skipped": False,
         "value": round(best, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.45, 4) if not args.smoke else 1.0,
+        "cost_model": cost_model,
     }))
+    if cost_model.get("available") and not cost_model["agrees"]:
+        print(f"# FAIL: cost-model FLOPs/token "
+              f"{cost_model['flops_per_token']:.3e} diverges "
+              f">{int(_COST_AGREE_TOL * 100)}% from the hand formula "
+              f"({cost_model['hand_flops_per_token']:.3e} plain / "
+              f"{cost_model['hand_remat_flops_per_token']:.3e} remat)",
+              file=sys.stderr)
+        return 1
 
 
 if __name__ == "__main__":

@@ -151,30 +151,37 @@ class CompileCache:
         """Materialize the cached executable for ``key``, or None.
         Counts a hit or a miss; a corrupt/unloadable entry is evicted
         and counted as an error + miss."""
+        return self.load_ex(key, site=site)[0]
+
+    def load_ex(self, key: str, site: str = "default"):
+        """``load`` plus the stored payload kind of a hit —
+        ``(fn, "executable" | "stablehlo")`` or ``(None, None)`` — so
+        ``get_or_compile`` can record the tier in xstats provenance."""
         t0 = time.perf_counter()
         try:
             record = self.store_backend.get(key)
         except Exception:  # noqa: BLE001 - corrupt record: already evicted
             self.metrics.errors.labels(site=site, kind="corrupt").inc()
             record = None
-        fn = None
+        fn, kind = None, None
         if record is not None:
             try:
                 fn = self._materialize(record)
+                kind = record["kind"]
             except Exception:  # noqa: BLE001 - undeserializable (e.g. a
                 # different jaxlib wrote it despite the env fingerprint,
                 # or a truncated payload that unpickled): evict, recompile
                 self.store_backend.remove(key)
                 self.metrics.errors.labels(site=site,
                                            kind="deserialize").inc()
-                fn = None
+                fn, kind = None, None
         if fn is None:
             self.metrics.misses.labels(site=site).inc()
-            return None
+            return None, None
         self.metrics.hits.labels(site=site).inc()
         self.metrics.load_ms.labels(site=site).observe(
             (time.perf_counter() - t0) * 1e3)
-        return fn
+        return fn, kind
 
     def _materialize(self, record):
         kind = record["kind"]
@@ -247,12 +254,21 @@ class CompileCache:
     # -------------------------------------------------------- combined
     def get_or_compile(self, key: str, build: Callable, *,
                        site: str = "default", meta: Optional[dict] = None,
-                       exported_fallback: Optional[Callable] = None
+                       exported_fallback: Optional[Callable] = None,
+                       xstats_meta: Optional[dict] = None
                        ) -> Tuple[Callable, bool]:
         """Load ``key`` or ``build()`` (a ``jax.stages.Compiled``),
-        store it, and return ``(callable, was_hit)``."""
-        fn = self.load(key, site=site)
+        store it, and return ``(callable, was_hit)``.
+
+        ``xstats_meta`` (``{"kind", "signature", "fingerprint",
+        "spec_hash", "lower_thunk", "provenance"}``, all optional)
+        registers the resulting executable in the xstats registry with
+        hit/miss/tier provenance added here — the one chokepoint every
+        persistent-cache compile site flows through."""
+        fn, tier = self.load_ex(key, site=site)
         if fn is not None:
+            self._register_xstats(site, key, fn, hit=True, tier=tier,
+                                  xstats_meta=xstats_meta)
             return fn, True
         # a miss compiles: the build is compile badput on the goodput
         # ledger (a frame, so jax.monitoring compile events firing
@@ -260,9 +276,38 @@ class CompileCache:
         from ..observability.goodput import default_ledger
         with default_ledger().timed("compile"):
             compiled = build()
-        self.store(key, compiled, meta=meta, site=site,
-                   exported_fallback=exported_fallback)
+        stored = self.store(key, compiled, meta=meta, site=site,
+                            exported_fallback=exported_fallback)
+        self._register_xstats(site, key, compiled, hit=False,
+                              tier=stored, xstats_meta=xstats_meta)
         return compiled, False
+
+    @staticmethod
+    def _register_xstats(site: str, key: str, fn, *, hit: bool,
+                         tier: Optional[str],
+                         xstats_meta: Optional[dict]):
+        """Best-effort xstats registration of a cache-mediated
+        executable; the cost/memory analysis is read straight off the
+        Compiled when the tier allows (the stablehlo tier hands over
+        the caller's lower thunk instead)."""
+        try:
+            from ..observability import xstats
+            if not xstats.enabled():
+                return
+            m = xstats_meta or {}
+            prov = dict(m.get("provenance") or {})
+            prov["cache"] = "hit" if hit else "miss"
+            if tier:
+                prov["tier"] = tier
+            signature = m.get("signature") or ((("key",), key),)
+            xstats.register_executable(
+                site, signature, kind=m.get("kind"),
+                fingerprint=m.get("fingerprint"),
+                spec_hash=m.get("spec_hash"), provenance=prov,
+                compiled=fn if hasattr(fn, "cost_analysis") else None,
+                lower_thunk=m.get("lower_thunk"))
+        except Exception:  # noqa: BLE001 - observability must never
+            pass           # break the compile path
 
 
 # ------------------------------------------------------- default cache

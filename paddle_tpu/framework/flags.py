@@ -264,6 +264,52 @@ define_flag("FLAGS_slo_eval_interval_s", 10.0,
             "(SLOMonitor.start(); explicit evaluate() calls are "
             "always allowed)")
 
+# Executable cost & roofline observability + on-demand device
+# profiling (paddle_tpu.observability.xstats — the /execz registry
+# and the /profilez capture ring).
+define_flag("FLAGS_xstats_enable", True,
+            "populate the process-wide executable registry at every "
+            "compile site (XLA cost/memory analysis per signature, "
+            "the /execz page, and the paddle_mfu{kind=} join with the "
+            "continuous step profiler); off = every hook is a no-op")
+define_flag("FLAGS_xstats_max_entries", 512,
+            "bound of the executable registry: least-recently-"
+            "registered entries are evicted past this many (counted "
+            "in paddle_exec_evicted_total)")
+define_flag("FLAGS_device_peak_flops", 0.0,
+            "per-chip peak FLOP/s for MFU and roofline computation; "
+            "0 = use the built-in per-platform table (TPU v5e bf16 "
+            "default). CPU CI sets this explicitly — no table entry "
+            "pretends to know a host CPU's peak")
+define_flag("FLAGS_device_peak_bytes_per_s", 0.0,
+            "per-chip peak memory bandwidth (bytes/s) for the "
+            "bandwidth-utilization gauge and the roofline ridge "
+            "point; 0 = per-platform table, same contract as "
+            "FLAGS_device_peak_flops")
+define_flag("FLAGS_profile_dir", "",
+            "directory of the bounded on-disk profile-capture ring "
+            "(/profilez artifacts); empty = a per-process directory "
+            "under the system temp dir")
+define_flag("FLAGS_profile_ring", 8,
+            "max retained capture artifacts in the /profilez ring "
+            "(oldest artifact files are deleted past this)")
+define_flag("FLAGS_profile_max_ms", 2000.0,
+            "hard bound on one /profilez capture duration — a "
+            "larger duration_ms query parameter is clamped here so a "
+            "scrape can never stall a replica for long")
+define_flag("FLAGS_profile_min_interval_s", 30.0,
+            "rate limit between profile captures (manual or "
+            "anomaly-triggered); captures inside the interval are "
+            "refused and counted in paddle_profile_rate_limited_total")
+define_flag("FLAGS_profile_on_anomaly", False,
+            "arm anomaly-triggered capture: a stepprof straggler "
+            "kicks off one rate-limited background device-profile "
+            "capture whose artifact records the straggler span's "
+            "trace id (see FLAGS_profile_anomaly_ms)")
+define_flag("FLAGS_profile_anomaly_ms", 500.0,
+            "duration of an anomaly-triggered capture (bounded by "
+            "FLAGS_profile_max_ms like every capture)")
+
 # Distributed request tracing (paddle_tpu.observability.tracing —
 # router->worker->engine spans + the /tracez flight recorder).
 define_flag("FLAGS_trace_sample_rate", 0.0,

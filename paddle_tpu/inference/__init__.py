@@ -345,6 +345,8 @@ class Predictor:
         # cache (compile_cache package); False marks a signature that
         # failed AOT so the hot path never retries it
         self._aot_execs: Dict[tuple, object] = {}
+        # xstats memo: (donating, assembled shapes) -> ExecEntry
+        self._xstats_memo: Dict[tuple, object] = {}
         self._artifact_fp = "__unset__"
         # output handles are STABLE per fetch name (reference capi_exp
         # semantics: handles are scope-var bound — a C host that hoists
@@ -517,11 +519,72 @@ class Predictor:
                     extra={"site": "serving", "donate": bool(donating)})
                 fn, _hit = cache.get_or_compile(
                     key, lambda: jitted.lower(w_specs, *x_specs).compile(),
-                    site="serving", meta=parts)
+                    site="serving", meta=parts,
+                    xstats_meta=self._xstats_meta(assembled, donating,
+                                                  jitted))
         except Exception:  # noqa: BLE001 - any AOT failure degrades to
             fn = None      # the jitted dispatch, never into the server
         memo[sig] = fn if fn is not None else False
         return fn
+
+    # ------------------------------------------------- xstats wiring
+    @staticmethod
+    def _xstats_signature(assembled, donating: bool) -> tuple:
+        from ..observability import xstats
+        return ((((int(bool(donating)),), "donate"),)
+                + xstats.signature_of(list(assembled)))
+
+    def _xstats_meta(self, assembled, donating: bool, jitted):
+        """xstats registration payload for the serving dispatch:
+        artifact identity + a lower thunk over abstract weight/feed
+        specs (scrape-time only)."""
+        try:
+            import jax
+
+            from ..observability import xstats
+            if not xstats.enabled():
+                return None
+            w_specs = [jax.ShapeDtypeStruct(w.shape, w.dtype)
+                       for w in self._artifact._weight_list]
+            x_specs = [jax.ShapeDtypeStruct(tuple(a.shape),
+                                            np.dtype(a.dtype))
+                       for a in assembled]
+            return {"kind": "serving",
+                    "signature": self._xstats_signature(assembled,
+                                                        donating),
+                    "fingerprint": self.artifact_fingerprint(),
+                    "lower_thunk":
+                    lambda: jitted.lower(w_specs, *x_specs)}
+        except Exception:  # noqa: BLE001 - observability is garnish
+            return None
+
+    def _xstats_note(self, assembled, donating: bool, jitted, aot):
+        """Per-dispatch note (memoized by assembled-batch shapes)."""
+        try:
+            from ..observability import xstats
+            if not xstats.enabled():
+                return
+            memo_key = (bool(donating), tuple(
+                (tuple(a.shape), str(a.dtype)) for a in assembled))
+            ent = self._xstats_memo.get(memo_key)
+            if ent is None:
+                sig = self._xstats_signature(assembled, donating)
+                if aot is not None:
+                    ent = xstats.register_executable("serving", sig)
+                else:
+                    meta = self._xstats_meta(assembled, donating,
+                                             jitted) or {}
+                    ent = xstats.register_executable(
+                        "serving", sig, kind="serving",
+                        fingerprint=meta.get("fingerprint"),
+                        provenance={"cache": "off"},
+                        lower_thunk=meta.get("lower_thunk"))
+                if ent is None:
+                    return
+                self._xstats_memo[memo_key] = ent
+            xstats.note_dispatch(ent)
+        except Exception:  # noqa: BLE001 - never break the serving
+            pass           # dispatch
 
     def dispatch_many(self, feeds_list=None, *, assembled=None,
                       rows=None, donate=False):
@@ -559,6 +622,7 @@ class Predictor:
             # (no trace, no XLA compile); cold, it compiles once and
             # persists for the next process
             aot = self._aot_serving_call(assembled, donating, fn)
+            self._xstats_note(assembled, donating, fn, aot)
             if donating:
                 # explicit transfer first so the donated buffers are
                 # committed device arrays (donating a host ndarray is

@@ -35,10 +35,13 @@ class StaticFunction:
         # whenever the traced function changes (_build_jit)
         self._exec_memo = {}
         self._fn_fp = None
+        # xstats memo: (training, operand shapes) -> ExecEntry
+        self._xstats_memo = {}
 
     def _build_jit(self):
         self._exec_memo = {}
         self._fn_fp = None
+        self._xstats_memo = {}
         layer = self._layer
 
         if layer is not None:
@@ -141,6 +144,9 @@ class StaticFunction:
                 exec_fn = self._cached_exec(
                     params, buffers, [t._data for t in tensor_args],
                     training)
+            self._xstats_note(params, buffers,
+                              [t._data for t in tensor_args], training,
+                              exec_fn)
 
             def one_op(*all_arrays):
                 p_arrays = dict(zip(param_names,
@@ -158,6 +164,8 @@ class StaticFunction:
         if not self._will_record(t_args):
             exec_fn = self._cached_exec(None, None,
                                         [t._data for t in t_args], False)
+        self._xstats_note(None, None, [t._data for t in t_args], False,
+                          exec_fn)
         fn = exec_fn if exec_fn is not None else self._jit_fn
         return apply_op("jit_program", lambda *arrs: fn(*arrs), *t_args)
 
@@ -206,12 +214,78 @@ class StaticFunction:
                 else:
                     def build():
                         return self._jit_fn.lower(*arrays).compile()
-                fn, _hit = cache.get_or_compile(key, build, site="jit",
-                                                meta=kparts)
+                fn, _hit = cache.get_or_compile(
+                    key, build, site="jit", meta=kparts,
+                    xstats_meta=self._xstats_meta(params, buffers,
+                                                  arrays, training))
         except Exception:  # noqa: BLE001 - any cache/AOT failure falls
             fn = None      # back to the jitted dispatch
         memo[sig] = fn if fn is not None else False
         return fn
+
+    # ------------------------------------------------- xstats wiring
+    @staticmethod
+    def _xstats_signature(params, buffers, arrays, training) -> tuple:
+        from ..observability import xstats
+        return ((((int(bool(training)),), "training"),)
+                + xstats.signature_of((params, buffers, arrays)))
+
+    def _xstats_meta(self, params, buffers, arrays, training):
+        """xstats registration payload: identity + a lower thunk over
+        abstract operand specs (computed lazily at scrape time)."""
+        try:
+            from ..observability import xstats
+            if not xstats.enabled():
+                return None
+            spec = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(
+                    tuple(getattr(a, "shape", ())), a.dtype),
+                (params, buffers, arrays))
+            jit_fn = self._jit_fn
+            if self._layer is not None:
+                def thunk():
+                    return jit_fn.lower(spec[0], spec[1], *spec[2],
+                                        _training=training)
+            else:
+                def thunk():
+                    return jit_fn.lower(*spec[2])
+            return {"kind": "jit",
+                    "signature": self._xstats_signature(
+                        params, buffers, arrays, training),
+                    "fingerprint": self._fn_fp,
+                    "lower_thunk": thunk}
+        except Exception:  # noqa: BLE001 - observability is garnish
+            return None
+
+    def _xstats_note(self, params, buffers, arrays, training, exec_fn):
+        """Per-call dispatch note (memoized by operand shapes)."""
+        try:
+            from ..observability import xstats
+            if not xstats.enabled():
+                return
+            memo_key = (bool(training), tuple(
+                (tuple(getattr(a, "shape", ())),
+                 str(getattr(a, "dtype", ""))) for a in arrays))
+            ent = self._xstats_memo.get(memo_key)
+            if ent is None:
+                sig = self._xstats_signature(params, buffers, arrays,
+                                             training)
+                if exec_fn is not None:
+                    ent = xstats.register_executable("jit", sig)
+                else:
+                    meta = self._xstats_meta(params, buffers, arrays,
+                                             training) or {}
+                    ent = xstats.register_executable(
+                        "jit", sig, kind="jit",
+                        fingerprint=meta.get("fingerprint"),
+                        provenance={"cache": "off"},
+                        lower_thunk=meta.get("lower_thunk"))
+                if ent is None:
+                    return
+                self._xstats_memo[memo_key] = ent
+            xstats.note_dispatch(ent)
+        except Exception:  # noqa: BLE001 - never break a jit call
+            pass
 
     @property
     def forward(self):

@@ -150,6 +150,9 @@ class TrainStep:
         # executable (False = this signature failed AOT, use plain jit)
         self._exec_memo: Dict = {}
         self._step_fp: Optional[str] = None
+        # xstats memo: (tag, batch-signature) -> ExecEntry so the
+        # per-step dispatch note is a dict hit, not a re-registration
+        self._xstats_memo: Dict = {}
 
     def _init_opt_state(self):
         opt = self.optimizer
@@ -273,11 +276,85 @@ class TrainStep:
                 fn, _hit = cache.get_or_compile(
                     key,
                     lambda: self._compiled.lower(*call_args).compile(),
-                    site="train_step", meta=parts)
+                    site="train_step", meta=parts,
+                    xstats_meta=self._xstats_meta(call_args, tag))
         except Exception:  # noqa: BLE001 - any cache/AOT failure falls
             fn = None      # back to the plain jit dispatch
         memo[sig] = fn if fn is not None else False
         return fn
+
+    # ------------------------------------------------- xstats wiring
+    @staticmethod
+    def _xstats_signature(call_args, tag: str) -> tuple:
+        """Registry signature of this step dispatch: the tag (single
+        vs a run_steps scan window, whose executable differs at equal
+        operand shapes) plus the operand shape/dtype tuple."""
+        from ..observability import xstats
+        return (((0,), "tag:" + tag),) + xstats.signature_of(call_args)
+
+    def _xstats_meta(self, call_args, tag: str):
+        """xstats registration payload for the persistent-cache tier:
+        identity + a lower thunk the registry can use at scrape time
+        when the stored tier has no Compiled to analyze."""
+        try:
+            from ..observability import xstats
+            if not xstats.enabled():
+                return None
+            specs = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(
+                    tuple(getattr(a, "shape", ())), a.dtype), call_args)
+            compiled_ref = self._compiled
+            spec_hash = None
+            try:
+                spec_hash = shard_api.spec_tree_hash(
+                    shard_api.model_spec_tree(self.model))
+            except Exception:  # noqa: BLE001 - provenance garnish
+                pass
+            return {"kind": "train",
+                    "signature": self._xstats_signature(call_args, tag),
+                    "fingerprint": self._step_fingerprint(),
+                    "spec_hash": spec_hash,
+                    "lower_thunk": lambda: compiled_ref.lower(*specs)}
+        except Exception:  # noqa: BLE001 - never break the step path
+            return None
+
+    def _xstats_note(self, call_args, step_fn):
+        """Per-step dispatch note into the xstats registry (memoized:
+        steady state is one dict lookup + a counter). Cache-off runs
+        register here with a lower thunk; cache-backed runs merge into
+        the entry ``get_or_compile`` created."""
+        try:
+            from ..observability import xstats
+            if not xstats.enabled():
+                return
+            multi = self._compiled is getattr(self, "_compiled_multi",
+                                              None)
+            tag = f"multi:{self._multi_n}" if multi else "single"
+            arrays = call_args[7:]
+            memo_key = (tag, tuple(
+                (tuple(getattr(a, "shape", ())),
+                 str(getattr(a, "dtype", ""))) for a in arrays))
+            ent = self._xstats_memo.get(memo_key)
+            if ent is None:
+                sig = self._xstats_signature(call_args, tag)
+                if step_fn is not None:
+                    # the persistent-cache tier registered this entry
+                    # inside get_or_compile — merge-fetch it
+                    ent = xstats.register_executable("train_step", sig)
+                else:
+                    meta = self._xstats_meta(call_args, tag) or {}
+                    ent = xstats.register_executable(
+                        "train_step", sig, kind="train",
+                        fingerprint=meta.get("fingerprint"),
+                        spec_hash=meta.get("spec_hash"),
+                        provenance={"cache": "off"},
+                        lower_thunk=meta.get("lower_thunk"))
+                if ent is None:
+                    return
+                self._xstats_memo[memo_key] = ent
+            xstats.note_dispatch(ent)
+        except Exception:  # noqa: BLE001 - observability is garnish on
+            pass           # the hot path, never a step failure
 
     def _make_pure_step(self):
         """Dispatch to the step-structure builder: the plain GSPMD step,
@@ -703,6 +780,7 @@ class TrainStep:
         step_fn = self._cached_step(call_args)
         loss, new_params, new_state, new_sc = \
             (step_fn if step_fn is not None else self._compiled)(*call_args)
+        self._xstats_note(call_args, step_fn)
         if not getattr(loss, "is_fully_addressable", True):
             # multi-host mesh: the scalar loss is replicated; hand back the
             # process-local copy so .numpy()/float() work on every rank

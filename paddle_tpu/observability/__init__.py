@@ -36,7 +36,14 @@ counterpart and the ONE place every subsystem reports into:
 - ``slo``: declarative latency SLOs evaluated over deterministic
   rolling windows on the existing latency histograms, multi-window
   multi-burn-rate alerting (``/sloz``, alert sinks,
-  ``paddle_slo_*`` gauges).
+  ``paddle_slo_*`` gauges);
+- ``xstats``: the executable cost & roofline registry — every compile
+  site registers its executables with XLA ``cost_analysis()`` /
+  ``memory_analysis()`` and provenance, joined with stepprof
+  envelopes into live ``paddle_mfu{kind=}`` / bandwidth-utilization
+  gauges and a roofline classification (``/execz``), plus the
+  on-demand and anomaly-triggered device-profile capture ring
+  (``/profilez``).
 
 ``framework.monitor``'s stat_add/stat_get are a Counter view onto the
 default registry; ``serving.ServingMetrics`` is backed by these types
@@ -45,7 +52,7 @@ while keeping its ``snapshot()`` schema byte-compatible.
 from __future__ import annotations
 
 from . import (exposition, goodput, httpd, registry, runtime,  # noqa: F401
-               slo, stepprof, tracing)
+               slo, stepprof, tracing, xstats)
 from .exposition import (  # noqa: F401
     PROMETHEUS_CONTENT_TYPE, json_snapshot, json_text, prometheus_text,
 )
@@ -80,6 +87,11 @@ from .tracing import (  # noqa: F401
     record_exemplar, record_span, request_context, start_span,
     tracez_payload, use_context,
 )
+from .xstats import (  # noqa: F401
+    ExecEntry, ExecRegistry, ProfileRing, capture_profile,
+    default_exec_registry, default_profile_ring, device_peaks,
+    execz_payload, profilez_payload, register_executable,
+)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricRegistry",
@@ -105,10 +117,14 @@ __all__ = [
     "parse_traceparent", "start_span", "record_span",
     "default_buffer", "tracez_payload", "export_chrome_trace",
     "record_exemplar",
+    "ExecEntry", "ExecRegistry", "ProfileRing",
+    "default_exec_registry", "default_profile_ring",
+    "register_executable", "device_peaks", "execz_payload",
+    "profilez_payload", "capture_profile",
     "TrainingTelemetryCallback", "instrument_optimizers",
     "uninstrument_optimizers",
     "registry", "exposition", "httpd", "runtime", "training",
-    "tracing", "goodput", "stepprof", "slo",
+    "tracing", "goodput", "stepprof", "slo", "xstats",
 ]
 
 _LAZY = {

@@ -17,7 +17,13 @@ Serves four paths off a daemon thread:
 - ``/goodputz`` — the goodput ledger's accounting report plus the
   continuous step profiler summary;
 - ``/sloz``     — declared SLOs with rolling-window attainment, burn
-  rates, and firing alerts (evaluated at scrape time).
+  rates, and firing alerts (evaluated at scrape time);
+- ``/execz``    — the executable cost & roofline registry: every
+  compile site's signatures with XLA FLOPs / bytes / memory, cache
+  provenance, live per-kind MFU and bandwidth utilization;
+- ``/profilez`` — the device-profile capture ring;
+  ``?duration_ms=`` runs one bounded, rate-limited ``jax.profiler``
+  capture and returns the chrome-trace document.
 
 ``InferenceServer`` attaches via ``FLAGS_serving_telemetry_port``
 (-1 disabled, 0 ephemeral, >0 fixed); standalone training scripts call
@@ -43,7 +49,7 @@ __all__ = [
     "TelemetryServer", "start_telemetry_server", "get_telemetry_server",
     "stop_telemetry_server", "add_health_check", "remove_health_check",
     "healthz", "add_readiness_check", "remove_readiness_check",
-    "readyz",
+    "readyz", "execz_text", "profilez_response",
 ]
 
 _start_time = time.time()
@@ -152,6 +158,22 @@ def _statusz() -> dict:
                           "device_count": jax.device_count()}
     except Exception:  # noqa: BLE001
         pass
+    try:  # persistent compile-cache health (hits/misses/fallbacks/
+        # entries/bytes) without scraping /metrics — lazy like the
+        # other sections; absent until the cache package is imported
+        cc = sys.modules.get("paddle_tpu.compile_cache")
+        if cc is not None:
+            section = dict(cc.stats())
+            try:
+                from ..framework.flags import flag_value
+                section["dir"] = str(
+                    flag_value("FLAGS_compile_cache_dir") or "")
+                section["enabled"] = bool(section["dir"])
+            except Exception:  # noqa: BLE001
+                pass
+            out["compile_cache"] = section
+    except Exception:  # noqa: BLE001
+        pass
     try:  # what sharding this process runs (lazy — shard may be absent)
         shard_mod = sys.modules.get("paddle_tpu.distributed.shard")
         mesh_mod = sys.modules.get("paddle_tpu.distributed.mesh_utils")
@@ -202,6 +224,42 @@ def tracez_text(query: str) -> str:
         return json.dumps(
             {"traceEvents": tracing.chrome_trace_events(spans)})
     return json.dumps(payload, indent=1, sort_keys=True)
+
+
+def execz_text(query: str = "") -> str:
+    """The ``/execz`` body: the executable registry with cost/memory
+    analysis materialized, per-site rollups, and the per-kind MFU /
+    roofline join state. ``?compute=0`` skips lazy analysis (pure
+    registry dump). Shared by the telemetry endpoint and replica
+    workers; the router aggregates replica payloads."""
+    from . import xstats
+    compute = "compute=0" not in (query or "")
+    return json.dumps(xstats.execz_payload(compute=compute),
+                      indent=1, sort_keys=True, default=str)
+
+
+def profilez_response(query: str = "") -> Tuple[int, str]:
+    """The ``/profilez`` behavior shared by every HTTP surface:
+    without ``duration_ms`` — list the capture ring; with it — run one
+    bounded, rate-limited capture and return the chrome-trace document
+    (429 when the rate limit refuses). Returns ``(status, body)``;
+    the body is JSON either way."""
+    from urllib.parse import parse_qs
+
+    from . import xstats
+    q = {k: v[-1] for k, v in parse_qs(query or "").items()}
+    if not q.get("duration_ms"):
+        return 200, json.dumps(xstats.profilez_payload(), indent=1,
+                               sort_keys=True)
+    got = xstats.capture_profile(float(q["duration_ms"]),
+                                 reason=q.get("reason", "manual"))
+    if got is None:
+        return 429, json.dumps(
+            {"error": "capture rate-limited or already in flight",
+             "ring": xstats.profilez_payload()}, indent=1,
+            sort_keys=True)
+    meta, doc = got
+    return 200, json.dumps(doc)
 
 
 # ---------------------------------------------------------------- server
@@ -255,11 +313,16 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, json.dumps(sloz_payload(), indent=1,
                                            sort_keys=True),
                            "application/json")
+            elif path == "/execz":
+                self._send(200, execz_text(query), "application/json")
+            elif path == "/profilez":
+                code, body = profilez_response(query)
+                self._send(code, body, "application/json")
             elif path == "/":
                 self._send(200, "paddle-tpu telemetry\n"
                                 "/metrics  /healthz  /readyz  "
                                 "/statusz  /tracez  /goodputz  "
-                                "/sloz\n",
+                                "/sloz  /execz  /profilez\n",
                            "text/plain; charset=utf-8")
             else:
                 self._send(404, "not found\n",

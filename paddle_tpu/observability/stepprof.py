@@ -172,9 +172,26 @@ class StepProfiler:
             if child is None:
                 child = st.hist_child = self._h_wall.labels(kind=kind)
         child.observe(wall_ms)
+        try:
+            # xstats join: the envelope's kind meets the live
+            # executable's cost model — paddle_mfu{kind=} and the
+            # bandwidth gauge move here (dict lookups + gauge sets;
+            # analysis is never computed on this path)
+            from . import xstats
+            xstats.on_step_envelope(env)
+        except Exception:  # noqa: BLE001 - garnish on the hot path
+            pass
         if anomaly is not None:
             self._c_anomalies.labels(kind=kind).inc()
-            self._emit_anomaly_span(env, anomaly)
+            trace_id = self._emit_anomaly_span(env, anomaly)
+            try:
+                # armed via FLAGS_profile_on_anomaly: the straggler
+                # kicks off one rate-limited background device-profile
+                # capture linked to the promoted span's trace id
+                from . import xstats
+                xstats.on_anomaly(env, trace_id)
+            except Exception:  # noqa: BLE001 - never break a step
+                pass
         return env
 
     _PEAK_PROBE_EVERY = 64
@@ -206,7 +223,9 @@ class StepProfiler:
     def _emit_anomaly_span(self, env: dict, anomaly: dict):
         """A straggler becomes a traceable event: an error-status span
         recorded under a fresh sampled context rides the PR 9
-        tail-promotion path into the flight recorder."""
+        tail-promotion path into the flight recorder. Returns the
+        span's trace id so the anomaly-capture artifact can link back
+        to it."""
         try:
             from . import tracing
             ctx = tracing.new_context(sampled=True)
@@ -224,8 +243,9 @@ class StepProfiler:
                 - int(env["wall_ms"] * 1e6),
                 duration_ms=env["wall_ms"], status="error",
                 attrs=attrs, root=True)
+            return ctx.trace_id
         except Exception:  # noqa: BLE001 - detection is garnish on the
-            pass           # hot path; never let it break a step
+            return None    # hot path; never let it break a step
 
     # ------------------------------------------------------- views
     def envelopes(self, kind: Optional[str] = None, limit: int = 100

@@ -759,6 +759,65 @@ class FleetRouter:
                 pass           # drops out of the merged view
         return slo_mod.merge_sloz_payloads(own, remotes)
 
+    def merged_execz(self) -> dict:
+        """Fleet-wide ``/execz``: this process's executable registry
+        plus every live replica's, keyed by replica id, with a
+        fleet-level per-site rollup — which replica is running which
+        executables at what cost, one page."""
+        from ...observability import xstats
+        own = xstats.execz_payload()
+        replicas: Dict[str, dict] = {}
+        with self._lock:
+            reps = [(str(r.replica_id), r.url)
+                    for r in self._replicas.values() if r.alive]
+        for rid, url in reps:
+            try:
+                with self._http(url + "/execz", timeout=10.0) as resp:
+                    replicas[rid] = json.loads(resp.read())
+            except Exception:  # noqa: BLE001 - a scrape-dead replica
+                pass           # drops out of the merged view
+        fleet_sites: Dict[str, dict] = {}
+        for payload in replicas.values():
+            for site, s in (payload.get("sites") or {}).items():
+                agg = fleet_sites.setdefault(
+                    site, {"entries": 0, "dispatches": 0, "flops": 0.0})
+                agg["entries"] += s.get("entries", 0)
+                agg["dispatches"] += s.get("dispatches", 0)
+                agg["flops"] = max(agg["flops"], s.get("flops", 0.0))
+        return {"router": own, "replicas": replicas,
+                "fleet": {"sites": fleet_sites,
+                          "replicas_merged": len(replicas)}}
+
+    def merged_profilez(self, duration_ms: Optional[float] = None
+                        ) -> dict:
+        """Fleet-wide ``/profilez``: without a duration, every live
+        replica's capture ring; with one, fan a bounded capture out to
+        ALL live replicas concurrently and return the stitched bundle
+        of chrome-trace documents keyed by replica id."""
+        with self._lock:
+            reps = [(str(r.replica_id), r.url)
+                    for r in self._replicas.values() if r.alive]
+        q = f"?duration_ms={float(duration_ms)}" if duration_ms else ""
+
+        def one(url):
+            timeout = 10.0 + (float(duration_ms) / 1e3
+                              if duration_ms else 0.0)
+            with self._http(url + "/profilez" + q,
+                            timeout=timeout) as resp:
+                return json.loads(resp.read())
+
+        replicas: Dict[str, dict] = {}
+        futs = {rid: self._pool.submit(one, url) for rid, url in reps}
+        for rid, fut in futs.items():
+            try:
+                replicas[rid] = fut.result()
+            except Exception as e:  # noqa: BLE001 - a refused or dead
+                replicas[rid] = {"error": repr(e)}  # replica is still
+                # part of the bundle: the operator sees who failed
+        return {"replicas": replicas,
+                "captured": duration_ms is not None,
+                "replicas_merged": len(replicas)}
+
     def statusz(self) -> dict:
         """Fleet status page: per-replica id/readiness/outstanding/
         version (+ restart counts when a supervisor is attached) and
@@ -882,6 +941,18 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 from ...observability.goodput import goodputz_payload
                 self._send(200, json.dumps(
                     goodputz_payload(), sort_keys=True).encode())
+            elif path == "/execz":
+                self._send(200, json.dumps(
+                    self._router.merged_execz(), sort_keys=True,
+                    default=str).encode())
+            elif path == "/profilez":
+                from urllib.parse import parse_qs
+                q = {k: v[-1] for k, v in parse_qs(query).items()}
+                doc = self._router.merged_profilez(
+                    duration_ms=float(q["duration_ms"])
+                    if q.get("duration_ms") else None)
+                self._send(200, json.dumps(doc, sort_keys=True,
+                                           default=str).encode())
             else:
                 self._send(404, b"not found\n", "text/plain")
         except Exception as e:  # noqa: BLE001 - handler fault barrier

@@ -26,7 +26,10 @@ Control plane (JSON):
   text) / ``GET /statusz`` / ``GET /tracez`` (this process's span
   flight recorder; the router's merged ``/tracez`` fans out to it) /
   ``GET /sloz`` (this process's SLO evaluation; the router's merged
-  ``/sloz`` sums it fleet-wide) / ``GET /goodputz``
+  ``/sloz`` sums it fleet-wide) / ``GET /goodputz`` /
+  ``GET /execz`` (this replica's executable cost/roofline registry;
+  the router's ``/execz`` aggregates) / ``GET /profilez`` (capture
+  ring; ``?duration_ms=`` runs one bounded device-profile capture)
 - ``POST /reload`` — hot weight swap: load the version-stamped
   artifact named in the body, warm the replacement server from the
   shared compile cache + manifest, atomically swap it in, drain the
@@ -432,6 +435,18 @@ class _ReplicaHandler(BaseHTTPRequestHandler):
                 self._send(200, json.dumps(
                     goodputz_payload(), sort_keys=True).encode(),
                     "application/json")
+            elif path == "/execz":
+                # this replica's executable cost/roofline registry —
+                # the router's /execz aggregates across replicas
+                from ...observability.httpd import execz_text
+                self._send(200, execz_text(query).encode(),
+                           "application/json")
+            elif path == "/profilez":
+                # list the capture ring, or (?duration_ms=) run one
+                # bounded capture on THIS replica and stream it back
+                from ...observability.httpd import profilez_response
+                code, body = profilez_response(query)
+                self._send(code, body.encode(), "application/json")
             elif path == "/healthz":
                 ok, info = self._backend.health()
                 self._send_json(200 if ok else 503,

@@ -1100,6 +1100,16 @@ class GenerationServer:
             return
         ms = (time.perf_counter() - t0) * 1e3
         self.metrics.observe_step("prefill", ms)
+        try:
+            # stepprof envelope per prefill group: joins with the
+            # generate_prefill executable for paddle_mfu{kind=prefill}
+            from ...observability.stepprof import default_profiler
+            default_profiler().record_step(
+                ms, kind="prefill", step=self._steps,
+                device_ms=ms, occupancy=rows,
+                kv_pages_used=self.kv.used_pages)
+        except Exception:  # noqa: BLE001 - profiling is garnish
+            pass
         for seq in seqs:
             if seq.req.trace is not None:
                 tracing.record_span(
@@ -1157,6 +1167,16 @@ class GenerationServer:
             return
         ms = (time.perf_counter() - t0) * 1e3
         self.metrics.observe_step("prefill", ms)
+        try:
+            # envelope for the suffix-prefill step (same prefill kind
+            # as the cold path: one MFU stream per step kind)
+            from ...observability.stepprof import default_profiler
+            default_profiler().record_step(
+                ms, kind="prefill", step=self._steps,
+                device_ms=ms, occupancy=rows,
+                kv_pages_used=self.kv.used_pages)
+        except Exception:  # noqa: BLE001 - profiling is garnish
+            pass
         for seq in seqs:
             if seq.req.trace is not None:
                 tracing.record_span(
@@ -1332,6 +1352,13 @@ class GenerationServer:
                        "prefix_tokens_reused":
                        self.prefix.tokens_reused
                        if self.prefix is not None else 0})
+            # the verify window alone (iteration minus draft proposal)
+            # as its own kind: joins with the generate_verify
+            # executable for paddle_mfu{kind=verify}
+            default_profiler().record_step(
+                max(ms - draft_ms, 0.0), kind="verify",
+                step=self._steps, occupancy=len(active),
+                attrs={"draft_ms": round(draft_ms, 4)})
         except Exception:  # noqa: BLE001 - profiling is garnish
             pass
         for seq, toks, acc in zip(active, toks_lists, accs):

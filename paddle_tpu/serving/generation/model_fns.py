@@ -93,6 +93,8 @@ class CachedDecoder:
         # per-signature AOT memo; False marks "tried, unavailable"
         self._aot: Dict[tuple, object] = {}
         self.compiled_signatures = set()    # (site, shape-sig) seen
+        # xstats memo: (site, shape-sig) -> ExecEntry
+        self._xstats_entries: Dict[tuple, object] = {}
 
         _Tensor = None
 
@@ -261,19 +263,70 @@ class CachedDecoder:
                     extra={"site": site})
                 fn, _hit = cache.get_or_compile(
                     key, lambda: jitted.lower(*specs).compile(),
-                    site=site, meta=parts)
+                    site=site, meta=parts,
+                    xstats_meta=self._xstats_meta(site, jitted, args))
         except Exception:  # noqa: BLE001 - AOT is an optimization
             fn = None      # tier; never let it break decode
         memo[sig] = fn if fn is not None else False
         return fn
+
+    def _xstats_meta(self, site: str, jitted, args):
+        """xstats registration payload for one decode entry point:
+        decoder identity + a lower thunk over abstract operand specs
+        (scrape-time only; params/buffers abstracted too)."""
+        try:
+            import jax
+
+            from ...observability import xstats
+            if not xstats.enabled():
+                return None
+            specs = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(
+                    tuple(a.shape), np.dtype(a.dtype)), args)
+            return {"signature": self._sig_of(args),
+                    "fingerprint": self.fingerprint(),
+                    "lower_thunk": lambda: jitted.lower(*specs)}
+        except Exception:  # noqa: BLE001 - observability is garnish
+            return None
+
+    def _xstats_note(self, site: str, sig: tuple, jitted, args,
+                     used_aot: bool):
+        """Per-dispatch note into the xstats registry (memoized by
+        (site, signature) — steady-state cost is one dict hit plus a
+        counter, on a path that just paid a device step)."""
+        try:
+            from ...observability import xstats
+            if not xstats.enabled():
+                return
+            ent = self._xstats_entries.get(sig)
+            if ent is None:
+                xsig = sig[1:]   # drop the site prefix: site is the key
+                if used_aot:
+                    ent = xstats.register_executable(site, xsig)
+                else:
+                    meta = self._xstats_meta(site, jitted, args) or {}
+                    ent = xstats.register_executable(
+                        site, xsig,
+                        fingerprint=meta.get("fingerprint"),
+                        provenance={"cache": "off"},
+                        lower_thunk=meta.get("lower_thunk"))
+                if ent is None:
+                    return
+                self._xstats_entries[sig] = ent
+            xstats.note_dispatch(ent)
+        except Exception:  # noqa: BLE001 - never break a decode step
+            pass
 
     def _dispatch(self, site: str, jitted, args) -> Tuple[object, bool]:
         """Returns ``(outputs, was_new_signature)``."""
         sig = (site,) + self._sig_of(args)
         fresh = sig not in self.compiled_signatures
         self.compiled_signatures.add(sig)
-        fn = self._aot_exec(site, jitted, args) or jitted
-        return fn(*args), fresh
+        aot = self._aot_exec(site, jitted, args)
+        fn = aot or jitted
+        out = fn(*args)
+        self._xstats_note(site, sig, jitted, args, aot is not None)
+        return out, fresh
 
     def prefill(self, ids: np.ndarray, prompt_lens: np.ndarray,
                 tables: np.ndarray, k, v):

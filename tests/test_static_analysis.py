@@ -578,6 +578,33 @@ class TestLockDiscipline:
         assert any(f.rule == "LK001" and "prefix_cache" in f.path
                    for f in findings)
 
+    def test_scope_includes_xstats_module(self, tmp_path):
+        """Scope self-test for PR 13: the observability/ prefix must
+        reach observability/xstats.py — the executable registry and
+        the capture ring are shared state mutated from compile sites,
+        scrape handlers, and the anomaly-capture thread, so an
+        injected unguarded write there is reported."""
+        pkg = tmp_path / "paddle_tpu" / "observability"
+        pkg.mkdir(parents=True)
+        (pkg / "xstats.py").write_text(textwrap.dedent("""
+            import threading
+
+            class ExecRegistry:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n_entries = 0
+
+                def register(self):
+                    with self._lock:
+                        self._n_entries += 1
+
+                def sloppy_clear(self):
+                    self._n_entries = 0
+        """))
+        findings = _run(tmp_path, [LockDisciplineAnalyzer()])
+        assert any(f.rule == "LK001" and "xstats" in f.path
+                   for f in findings)
+
     def test_scope_includes_fleet_subpackage(self, tmp_path):
         """The serving/ prefix must also reach the fleet subpackage —
         router poll thread, supervisor monitor thread, and HTTP

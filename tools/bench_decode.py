@@ -242,6 +242,47 @@ def _bench_spec(args):
     }
 
 
+_COST_AGREE_TOL = 0.15
+
+
+def _decode_cost_model_check(model, cfg, batch):
+    """XLA cost-model FLOPs of the fixed-shape decode executable that
+    ran (xstats registry, site generate_decode) against the hand
+    forward-only estimate: ``batch x (2N + 4·L·H·T)`` — every lane of
+    the fixed-shape step computes, and decode attention gathers the
+    full T-slot window through the block table. Divergence beyond
+    ±15% flags silent model-shape drift in the hand formula."""
+    out = {"available": False}
+    try:
+        from paddle_tpu.observability import xstats
+        reg = xstats.default_exec_registry()
+        ents = [e for e in reg.entries()
+                if e.site == "generate_decode" and e.dispatches]
+        if not ents:
+            return out
+        ent = max(ents, key=lambda e: e.last_dispatch_unix_ms or 0)
+        ana = reg.ensure_analysis(ent)
+        if not ana or not ana.get("flops"):
+            out["error"] = ent.analysis_error
+            return out
+        n_params = model.num_params()
+        t_slots = cfg.max_seq_len
+        hand = batch * (2 * n_params
+                        + 4 * cfg.num_layers * cfg.hidden_size
+                        * t_slots)
+        ratio = ana["flops"] / hand
+        out.update({
+            "available": True,
+            "exec_flops_per_step": ana["flops"],
+            "hand_flops_per_step": float(hand),
+            "ratio": round(ratio, 4),
+            "agrees": abs(ratio - 1.0) <= _COST_AGREE_TOL,
+        })
+    except Exception as e:  # noqa: BLE001 - the cross-check must not
+        out["error"] = f"{type(e).__name__}: {e}"  # sink a bench run
+    return out
+
+
 def _run(args):
     import jax
 
@@ -345,6 +386,7 @@ def _run(args):
         "engine_p99_inter_token_ms": round(_median(eng_p99), 3),
         "batch_occupancy": occupancy,
         "cached_vs_uncached_max_abs_diff": equiv,
+        "cost_model": _decode_cost_model_check(model, cfg, b),
         "config": {"model": "gpt_tiny", "batch": b,
                    "requests_per_trial": n_requests,
                    "prompt_len": plen, "max_new_tokens": new,
@@ -353,6 +395,12 @@ def _run(args):
                    "backend": jax.default_backend()},
     }
     emit_record(record, out=args.out)
+    if record["cost_model"].get("available") and \
+            not record["cost_model"]["agrees"]:
+        print("# FAIL: decode cost-model FLOPs diverge >15% from the "
+              "hand 2N estimate "
+              f"({record['cost_model']})", file=sys.stderr)
+        return 1
     return 0
 
 

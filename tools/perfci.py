@@ -133,6 +133,18 @@ GATES: List[Dict[str, Any]] = [
      "why": "always-on step profiler + live SLO evaluation must not "
             "tax bench_serving throughput (<2% claim, 5% gate for "
             "shared-box noise, same envelope as trace_overhead_pct)"},
+    {"name": "xstats_overhead_pct", "metric": "xstats_overhead",
+     "files": "XSTATS_r*.json",
+     "path": ("overhead", "serving", "regression_pct"),
+     "op": "max", "baseline": 0.0, "abs_tol": 5.0, "unit": "%",
+     "why": "executable-registry registration + armed anomaly capture "
+            "must not tax serving (PR 13; paired-trial trimmed mean, "
+            "same envelope as the other observability overhead gates)"},
+    {"name": "xstats_capture_loadable", "metric": "xstats_overhead",
+     "files": "XSTATS_r*.json", "path": ("capture", "loadable"),
+     "op": "true",
+     "why": "a /profilez capture must produce an artifact "
+            "load_profiler_result can read back (PR 13)"},
 ]
 
 

@@ -1,0 +1,166 @@
+"""xstats_overhead — the PR 13 acceptance gate: executable-registry
+registration plus armed anomaly capture must not tax serving.
+
+Paired-trial measurement in the ``slo_report.py`` style: bench_serving
+throughput with the xstats surfaces OFF (``FLAGS_xstats_enable=False``)
+vs ON **with anomaly capture armed** (``FLAGS_profile_on_anomaly=True``
+at a rate limit that never fires during the bench — "armed" is the
+steady production state; an actual capture is an incident, not
+steady state). Trials interleave so box drift cancels; the committed
+record (``XSTATS_r01.json``) is gated by ``tools/perfci.py``:
+regression must stay ≤5%, and the one real capture the harness takes at
+the end must produce an artifact ``load_profiler_result`` can read.
+
+Usage:
+
+    python tools/xstats_overhead.py --record XSTATS_r01.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def _bench_overhead(requests: int = 4096, trials: int = 9) -> dict:
+    import numpy as np
+
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.observability import stepprof, xstats
+    from tools.bench_serving import bench_server, build_predictor
+
+    rng = np.random.RandomState(0)
+    reqs = [rng.randn(1, 64).astype("float32")
+            for _ in range(requests)]
+    bare, inst = [], []
+    with tempfile.TemporaryDirectory() as d:
+        pred = build_predictor(os.path.join(d, "pred"))
+        bench_server(pred, reqs, 16, 5.0, name="xso-warm")  # warm jit
+
+        def run_bare(trial):
+            set_flags({"FLAGS_xstats_enable": False,
+                       "FLAGS_profile_on_anomaly": False})
+            rps, _, _ = bench_server(pred, reqs, 16, 5.0,
+                                     name=f"xso-bare-{trial}")
+            bare.append(rps)
+
+        def run_instrumented(trial):
+            set_flags({"FLAGS_xstats_enable": True,
+                       "FLAGS_profile_on_anomaly": True,
+                       "FLAGS_profile_min_interval_s": 86400.0,
+                       "FLAGS_profile_dir":
+                       os.path.join(d, "ring")})
+            rps, _, _ = bench_server(pred, reqs, 16, 5.0,
+                                     name=f"xso-inst-{trial}")
+            inst.append(rps)
+
+        try:
+            for trial in range(trials):
+                # alternate order so warmth credits neither regime
+                first, second = (run_bare, run_instrumented) \
+                    if trial % 2 == 0 else (run_instrumented, run_bare)
+                first(trial)
+                second(trial)
+            # steady-state per-step cost of the registry join itself:
+            # one registered+analyzed executable, a stream of envelopes
+            ent = xstats.register_executable(
+                "train_step", ((((8,), "float32"),)))
+            if ent is not None:
+                ent.analysis = {"flops": 1e9, "bytes_accessed": 1e8}
+            set_flags({"FLAGS_device_peak_flops": 1e12,
+                       "FLAGS_device_peak_bytes_per_s": 1e11})
+            prof = stepprof.StepProfiler(min_samples=10_000)
+            n_env = 20_000
+            t0 = time.perf_counter()
+            for i in range(n_env):
+                prof.record_step(5.0, kind="train", step=i)
+            per_env_us = (time.perf_counter() - t0) / n_env * 1e6
+        finally:
+            set_flags({"FLAGS_xstats_enable": True,
+                       "FLAGS_profile_on_anomaly": False,
+                       "FLAGS_profile_min_interval_s": 30.0,
+                       "FLAGS_profile_dir": "",
+                       "FLAGS_device_peak_flops": 0.0,
+                       "FLAGS_device_peak_bytes_per_s": 0.0})
+    per_pair = sorted((b - i) / b * 100 for b, i in zip(bare, inst))
+    trimmed = per_pair[1:-1] if len(per_pair) > 2 else per_pair
+    return {"requests": requests, "trials": trials,
+            "bare_rps": round(statistics.median(bare), 1),
+            "instrumented_rps": round(statistics.median(inst), 1),
+            "per_pair_pct": [round(p, 2) for p in per_pair],
+            "regression_pct": round(statistics.mean(trimmed), 2),
+            "join_per_envelope_us": round(per_env_us, 2)}
+
+
+def _capture_check() -> dict:
+    """One real capture, read back the way an operator would."""
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.observability import xstats
+    from paddle_tpu.profiler import load_profiler_result
+    with tempfile.TemporaryDirectory() as d:
+        set_flags({"FLAGS_profile_dir": d,
+                   "FLAGS_profile_min_interval_s": 0.0})
+        try:
+            got = xstats.capture_profile(100, reason="record")
+            if got is None:
+                return {"loadable": False, "error": "rate-limited"}
+            meta, _doc = got
+            res = load_profiler_result(meta["path"])
+            return {"loadable": True, "events": meta["events"],
+                    "loaded_events":
+                    res.time_range_summary()["n_events"]}
+        finally:
+            set_flags({"FLAGS_profile_dir": "",
+                       "FLAGS_profile_min_interval_s": 30.0})
+
+
+def run_record(requests: int, trials: int) -> dict:
+    from paddle_tpu.observability import xstats
+    overhead = _bench_overhead(requests=requests, trials=trials)
+    capture = _capture_check()
+    execz = xstats.execz_payload()
+    return {
+        "metric": "xstats_overhead",
+        "skipped": False,
+        "value": overhead["regression_pct"],
+        "unit": "%",
+        "overhead": {"serving": overhead},
+        "capture": capture,
+        "execz": {"sites": sorted(execz["sites"]),
+                  "n_entries": execz["n_entries"]},
+        "config": {"requests": requests, "trials": trials},
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="xstats_overhead",
+                                 description=__doc__)
+    ap.add_argument("--record", default=None, metavar="OUT",
+                    help="write the committed-record JSON to OUT")
+    ap.add_argument("--requests", type=int, default=4096)
+    ap.add_argument("--trials", type=int, default=9)
+    args = ap.parse_args(argv)
+    doc = run_record(args.requests, args.trials)
+    text = json.dumps(doc, indent=1, sort_keys=True)
+    if args.record:
+        with open(args.record, "w", encoding="utf-8") as f:
+            f.write(text + "\n")
+        ov = doc["overhead"]["serving"]
+        print(f"xstats_overhead: wrote {args.record} "
+              f"(regression {ov['regression_pct']}%, "
+              f"join {ov['join_per_envelope_us']}us/envelope, "
+              f"capture loadable={doc['capture']['loadable']})")
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
