@@ -1,25 +1,43 @@
-"""paddle_tpu.analysis — AST static analysis with a CI gate.
+"""paddle_tpu.analysis — interprocedural static analysis with a CI gate.
 
 The compile-time checks the reference framework gets from C++ (typed
-gflags registration, tracer asserts, lock annotations), rebuilt as
-linters over this repo's Python:
+gflags registration, tracer asserts, lock annotations, the inplace/
+donation pass), rebuilt as linters over this repo's Python. Since
+pdlint v2 the analyzers share one interprocedural engine
+(``analysis.engine``): a repo-wide call graph (bare / ``self.`` /
+module-qualified calls, ``functools.partial``, local lambdas/aliases,
+thread targets), a per-function CFG with exception edges, and a common
+taint lattice.
 
 - ``TracerSafetyAnalyzer`` — host syncs / impurity reachable from
-  ``@jit`` / ``to_static`` / ``train_step`` entry points (TS001-TS005);
+  ``@jit`` / ``to_static`` / ``train_step`` entry points, repo-wide
+  (TS001-TS005);
 - ``FlagConsistencyAnalyzer`` — every ``FLAGS_*`` string resolves to a
   ``define_flag`` definition with a compatible type; dead flags are
   reported (FC001-FC004);
 - ``LockDisciplineAnalyzer`` — unguarded shared-state writes in the
   threaded serving/observability/elastic/distributed packages
   (LK001-LK003);
-- ``MetricDisciplineAnalyzer`` — registry metric families: names must
-  match ``paddle_[a-z0-9_]+`` and register once per name/type, and
-  histograms must never observe negative duration literals
-  (MD001-MD002).
+- ``MetricDisciplineAnalyzer`` — registry metric families: naming,
+  one type per name, unit suffixes, non-negative duration literals
+  (MD001-MD003);
+- ``DonationSafetyAnalyzer`` — reads of buffers already donated to a
+  ``donate_argnums`` dispatch, and donated ``self``/module attributes
+  that outlive the call (DS001-DS002);
+- ``RecompileRiskAnalyzer`` — AOT compile sites outside the
+  ``compile_cache.get_or_compile`` chokepoint, unbucketed
+  data-dependent sizes in jitted signatures, set iteration ordering a
+  traced pytree (RR001-RR003);
+- ``ResourcePairingAnalyzer`` — ``PagedKVCache`` page retain/alloc
+  without release/free on some path (exception edges included), bare
+  ``lock.acquire()`` and manual ``__enter__`` without their pairs
+  (RP001-RP003).
 
-Entry points: ``tools/pdlint.py`` (CLI, text/JSON, exit codes) and
+Entry points: ``tools/pdlint.py`` (CLI: text/JSON/SARIF, git-aware
+``--changed-only``, baseline ratchet, exit codes) and
 ``tests/test_static_analysis.py`` (the gate — fails on any finding not
-excused by ``tests/fixtures/pdlint_baseline.json``). Pure stdlib: an
+excused by ``tests/fixtures/pdlint_baseline.json`` AND on stale
+baseline entries, so the baseline only ever shrinks). Pure stdlib: an
 analysis run parses, never imports, the code under inspection.
 """
 from __future__ import annotations
@@ -28,27 +46,37 @@ import os
 from typing import Dict, List, Optional, Sequence
 
 from .core import (Analyzer, Finding, SourceFile, baseline_entry,
-                   filter_new, iter_python_files, load_baseline,
-                   parse_files, run_analyzers, write_baseline)
+                   changed_files, filter_new, in_scope,
+                   iter_python_files, load_baseline, parse_files,
+                   run_analyzers, stale_entries, to_sarif,
+                   write_baseline)
+from .donation_safety import DonationSafetyAnalyzer
 from .flag_consistency import FlagConsistencyAnalyzer
 from .lock_discipline import LockDisciplineAnalyzer
 from .metric_discipline import MetricDisciplineAnalyzer
+from .recompile_risk import RecompileRiskAnalyzer
+from .resource_pairing import ResourcePairingAnalyzer
 from .tracer_safety import TracerSafetyAnalyzer
 
 __all__ = [
     "Analyzer", "Finding", "SourceFile",
     "TracerSafetyAnalyzer", "FlagConsistencyAnalyzer",
     "LockDisciplineAnalyzer", "MetricDisciplineAnalyzer",
+    "DonationSafetyAnalyzer", "RecompileRiskAnalyzer",
+    "ResourcePairingAnalyzer",
     "all_analyzers", "analyzer_names", "default_paths", "repo_root",
     "default_baseline_path", "run_project",
     "iter_python_files", "parse_files", "run_analyzers",
     "load_baseline", "write_baseline", "filter_new", "baseline_entry",
+    "stale_entries", "to_sarif", "changed_files", "in_scope",
 ]
 
 
 def all_analyzers() -> List[Analyzer]:
     return [TracerSafetyAnalyzer(), FlagConsistencyAnalyzer(),
-            LockDisciplineAnalyzer(), MetricDisciplineAnalyzer()]
+            LockDisciplineAnalyzer(), MetricDisciplineAnalyzer(),
+            DonationSafetyAnalyzer(), RecompileRiskAnalyzer(),
+            ResourcePairingAnalyzer()]
 
 
 def analyzer_names() -> List[str]:
@@ -80,8 +108,10 @@ def run_project(paths: Optional[Sequence[str]] = None,
                 root: Optional[str] = None,
                 baseline_path: Optional[str] = None) -> Dict:
     """One-call project run: walk, analyze, apply baseline. Returns
-    ``{"findings": [...], "new": [...], "baseline_size": int}`` —
-    ``new`` is what a CI gate should fail on."""
+    ``{"findings": [...], "new": [...], "baseline_size": int,
+    "stale": [...]}`` — ``new`` is what a CI gate should fail on;
+    ``stale`` are ratchet violations (baselined fingerprints the repo
+    no longer produces — prune them, the baseline only shrinks)."""
     root = root or repo_root()
     findings = run_analyzers(paths or default_paths(root),
                              analyzers or all_analyzers(), root=root)
@@ -90,4 +120,5 @@ def run_project(paths: Optional[Sequence[str]] = None,
     baseline = load_baseline(bl_path) if bl_path else {}
     return {"findings": findings,
             "new": filter_new(findings, baseline),
+            "stale": stale_entries(findings, baseline),
             "baseline_size": len(baseline)}

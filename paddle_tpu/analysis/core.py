@@ -27,8 +27,20 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set
 __all__ = [
     "Finding", "SourceFile", "Analyzer", "iter_python_files",
     "parse_files", "run_analyzers", "load_baseline", "write_baseline",
-    "filter_new", "baseline_entry",
+    "filter_new", "baseline_entry", "stale_entries", "to_sarif",
+    "changed_files", "in_scope",
 ]
+
+
+def in_scope(rel: str, dirs: Sequence[str]) -> bool:
+    """Whether a repo-relative path sits under one of the scope
+    directory prefixes. Matches at any path depth (``d in the middle
+    of rel`` as a full segment), so analyzer self-tests that rebuild a
+    ``paddle_tpu/serving/...`` tree under a tmp dir scope the same way
+    the real tree does. Empty ``dirs`` = everything in scope."""
+    if not dirs:
+        return True
+    return any(rel.startswith(d) or f"/{d}" in rel for d in dirs)
 
 _SKIP_DIRS = {".git", "__pycache__", ".claude", "build", "dist",
               ".pytest_cache", "fixtures", "node_modules"}
@@ -213,3 +225,95 @@ def filter_new(findings: Sequence[Finding],
     """Findings not excused by the baseline — what the CI gate fails
     on."""
     return [f for f in findings if f.fingerprint not in baseline]
+
+
+def stale_entries(findings: Sequence[Finding],
+                  baseline: Dict[str, dict]) -> List[str]:
+    """The RATCHET: baselined fingerprints the repo no longer produces.
+    A fixed finding must be pruned from the baseline, so the file only
+    ever shrinks — it can excuse history, never accumulate room for
+    new debt. Meaningful only for a run over the same trees the
+    baseline was written from (a subtree run makes everything look
+    stale)."""
+    live = {f.fingerprint for f in findings}
+    return sorted(set(baseline) - live)
+
+
+# --------------------------------------------------------------- sarif
+_SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/"
+                 "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
+
+
+def to_sarif(findings: Sequence[Finding],
+             analyzer_names: Sequence[str],
+             baseline: Optional[Dict[str, dict]] = None) -> dict:
+    """Findings as a SARIF 2.1.0 document (one run, driver 'pdlint').
+    Baselined findings get ``baselineState: "unchanged"`` so SARIF
+    viewers and code-scanning UIs fold them away; new ones are
+    ``"new"``. Fingerprints ride ``partialFingerprints`` under the
+    same key the CI gate matches on."""
+    baseline = baseline or {}
+    rules_seen: Dict[str, dict] = {}
+    results = []
+    for f in findings:
+        rules_seen.setdefault(f.rule, {
+            "id": f.rule,
+            "name": f.rule,
+            "properties": {"analyzer": f.analyzer},
+        })
+        results.append({
+            "ruleId": f.rule,
+            "level": "error" if f.severity == "error" else "warning",
+            "message": {"text": f.message},
+            "baselineState": ("unchanged" if f.fingerprint in baseline
+                              else "new"),
+            "partialFingerprints": {"pdlint/v1": f.fingerprint},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": max(1, f.line),
+                               "startColumn": max(1, f.col + 1)},
+                },
+                "logicalLocations": ([{"name": f.symbol}]
+                                     if f.symbol else []),
+            }],
+        })
+    return {
+        "$schema": _SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "pdlint",
+                "informationUri":
+                    "https://github.com/paddle-tpu/paddle-tpu",
+                "rules": [rules_seen[r] for r in sorted(rules_seen)],
+                "properties": {"analyzers": list(analyzer_names)},
+            }},
+            "columnKind": "unicodeCodePoints",
+            "results": results,
+        }],
+    }
+
+
+# ----------------------------------------------------------- changed
+def changed_files(ref: str, root: str) -> Optional[Set[str]]:
+    """Repo-relative posix paths changed vs ``ref`` (committed diff +
+    staged + unstaged + untracked), or None when git can't answer
+    (not a checkout, unknown ref) — callers should fall back to a full
+    run rather than silently analyzing nothing."""
+    import subprocess
+    out: Set[str] = set()
+    for args in (["git", "diff", "--name-only", ref, "--"],
+                 ["git", "ls-files", "--others",
+                  "--exclude-standard"]):
+        try:
+            r = subprocess.run(args, cwd=root, capture_output=True,
+                               text=True, timeout=30)
+        except (OSError, subprocess.SubprocessError):
+            return None
+        if r.returncode != 0:
+            return None
+        out.update(line.strip().replace(os.sep, "/")
+                   for line in r.stdout.splitlines() if line.strip())
+    return out

@@ -1,0 +1,327 @@
+"""Donation-safety analyzer: use-after-donate across jit dispatches.
+
+``donate_argnums`` hands an input buffer to XLA for reuse as an
+output: after the dispatch the Python reference still exists but the
+device buffer is DELETED — any later read raises (best case) or, on
+backends that recycle lazily, silently reads freshly-written output
+bytes. The reference framework's inplace/donation pass catches the C++
+analog at compile time; here the failure is a runtime crash on device,
+under traffic, on the first batch that actually donates. The serving
+dispatch (``FLAGS_serving_donate_inputs``), TrainStep's
+``donate_argnums=(0, 2)`` step and every CachedDecoder pool carry are
+exactly this shape.
+
+Rules:
+
+  DS001  a local name passed at a donated argument position of a
+         donating callable is READ again on some path after the call
+         without first being rebound
+  DS002  the expression at a donated position is ``self.<attr>`` (or a
+         module-level name) and some path reaches the function exit
+         without storing a fresh value back — the attribute outlives
+         the call holding a deleted buffer for every later method
+
+Donating callables are discovered statically, no imports: names/attrs
+bound to ``jax.jit(fn, donate_argnums=...)`` / ``pjit(...)``, both as
+locals (``fn = jax.jit(step, donate_argnums=(0,))``) and as class
+state (``self._compiled = jax.jit(..., donate_argnums=donate)`` in one
+method, dispatched from another — resolved through the class-level
+binding map). ``donate_argnums`` values resolve through int/tuple
+literals, a local name bound to one, and the
+``(0, 2) if flag else ()`` conditional idiom (union of branches:
+may-donate is the right semantics for a safety rule).
+
+The normal idiom — ``state = fn(state, batch)`` rebinding the donated
+name to the fresh output — is recognized and clean.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Analyzer, Finding, SourceFile, in_scope
+from .engine import build_cfg, dotted_name, head_exprs, iter_own_body
+
+__all__ = ["DonationSafetyAnalyzer"]
+
+_DEFAULT_DIRS = ("paddle_tpu/", "tools/")
+
+
+def _jit_call_donations(call: ast.Call) -> Optional[ast.AST]:
+    """The donate_argnums value expr of a jit/pjit call, or None."""
+    f = call.func
+    d = dotted_name(f)
+    if d is None or d.split(".")[-1] not in ("jit", "pjit"):
+        return None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            return kw.value
+    return None
+
+
+def _int_tuple(expr: ast.AST) -> Optional[Set[int]]:
+    """Tuple/list of int literals, or a single int literal."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, int) \
+            and not isinstance(expr.value, bool):
+        return {expr.value}
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        out: Set[int] = set()
+        for e in expr.elts:
+            if not (isinstance(e, ast.Constant)
+                    and isinstance(e.value, int)):
+                return None
+            out.add(e.value)
+        return out
+    return None
+
+
+def _resolve_donations(expr: ast.AST,
+                       local_consts: Dict[str, Set[int]]
+                       ) -> Optional[Set[int]]:
+    got = _int_tuple(expr)
+    if got is not None:
+        return got
+    if isinstance(expr, ast.IfExp):
+        a = _resolve_donations(expr.body, local_consts)
+        b = _resolve_donations(expr.orelse, local_consts)
+        if a is None and b is None:
+            return None
+        return (a or set()) | (b or set())
+    if isinstance(expr, ast.Name):
+        return local_consts.get(expr.id)
+    return None
+
+
+class DonationSafetyAnalyzer(Analyzer):
+    name = "donation_safety"
+
+    def __init__(self, dirs: Sequence[str] = _DEFAULT_DIRS):
+        self.dirs = tuple(dirs)
+
+    def run(self, files: Sequence[SourceFile]) -> List[Finding]:
+        out: List[Finding] = []
+        for sf in files:
+            if not in_scope(sf.rel, self.dirs):
+                continue
+            out.extend(self._run_file(sf))
+        return out
+
+    # ------------------------------------------------------ per file
+    def _run_file(self, sf: SourceFile) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in sf.tree.body:
+            if isinstance(node, ast.ClassDef):
+                findings.extend(self._check_class(sf, node))
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                findings.extend(self._check_function(sf, node, {}))
+        return findings
+
+    def _check_class(self, sf: SourceFile,
+                     cls: ast.ClassDef) -> List[Finding]:
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]
+        # class-level donating attrs: self.X = jit(..., donate_argnums=)
+        attr_don: Dict[str, Set[int]] = {}
+        for m in methods:
+            consts = self._local_int_tuples(m)
+            for n in iter_own_body(m):
+                if not isinstance(n, ast.Assign) or \
+                        not isinstance(n.value, ast.Call):
+                    continue
+                dexpr = _jit_call_donations(n.value)
+                if dexpr is None:
+                    continue
+                pos = _resolve_donations(dexpr, consts)
+                if not pos:
+                    continue
+                for t in n.targets:
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self":
+                        attr_don[t.attr] = \
+                            attr_don.get(t.attr, set()) | pos
+        out: List[Finding] = []
+        for m in methods:
+            out.extend(self._check_function(sf, m, attr_don,
+                                            qual=f"{cls.name}.{m.name}"))
+        return out
+
+    @staticmethod
+    def _local_int_tuples(fn) -> Dict[str, Set[int]]:
+        """Names bound (once) to an int-tuple literal or a
+        two-tuple-literal conditional — donate_argnums feeders."""
+        out: Dict[str, Set[int]] = {}
+        for n in iter_own_body(fn):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                    isinstance(n.targets[0], ast.Name):
+                got = _resolve_donations(n.value, out)
+                if got is not None:
+                    out[n.targets[0].id] = got
+        return out
+
+    # ------------------------------------------------------ function
+    def _check_function(self, sf: SourceFile, fn,
+                        attr_don: Dict[str, Set[int]],
+                        qual: Optional[str] = None) -> List[Finding]:
+        qual = qual or fn.name
+        consts = self._local_int_tuples(fn)
+        # local donating callables: F = jax.jit(..., donate_argnums=...)
+        local_don: Dict[str, Set[int]] = {}
+        for n in iter_own_body(fn):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                    isinstance(n.targets[0], ast.Name) and \
+                    isinstance(n.value, ast.Call):
+                dexpr = _jit_call_donations(n.value)
+                if dexpr is not None:
+                    pos = _resolve_donations(dexpr, consts)
+                    if pos:
+                        local_don[n.targets[0].id] = pos
+
+        cfg = build_cfg(fn)
+        findings: List[Finding] = []
+        for node in cfg.nodes:
+            if node.stmt is None:
+                continue
+            for call in (c for part in head_exprs(node.stmt)
+                         for c in ast.walk(part)
+                         if isinstance(c, ast.Call)):
+                pos = self._donated_positions(call, local_don,
+                                              attr_don, consts)
+                if not pos:
+                    continue
+                findings.extend(self._check_call(
+                    sf, qual, cfg, node, call, pos))
+        return findings
+
+    @staticmethod
+    def _donated_positions(call: ast.Call,
+                           local_don: Dict[str, Set[int]],
+                           attr_don: Dict[str, Set[int]],
+                           consts: Dict[str, Set[int]]
+                           ) -> Optional[Set[int]]:
+        f = call.func
+        if isinstance(f, ast.Name) and f.id in local_don:
+            return local_don[f.id]
+        if isinstance(f, ast.Attribute) and \
+                isinstance(f.value, ast.Name) and f.value.id == "self" \
+                and f.attr in attr_don:
+            return attr_don[f.attr]
+        if isinstance(f, ast.Call):
+            # jax.jit(fn, donate_argnums=...)(args...): direct dispatch
+            dexpr = _jit_call_donations(f)
+            if dexpr is not None:
+                return _resolve_donations(dexpr, consts)
+        return None
+
+    def _check_call(self, sf: SourceFile, qual: str, cfg, node,
+                    call: ast.Call, positions: Set[int]
+                    ) -> List[Finding]:
+        callee = dotted_name(call.func) or "<jit>"
+        findings: List[Finding] = []
+        for p in sorted(positions):
+            if p >= len(call.args):
+                continue
+            arg = call.args[p]
+            if isinstance(arg, ast.Name):
+                hit = self._read_after(cfg, node, call, arg.id)
+                if hit is not None:
+                    findings.append(Finding(
+                        self.name, "DS001", sf.rel,
+                        hit.lineno, hit.col_offset,
+                        f"{arg.id!r} is read after being donated at "
+                        f"position {p} of {callee}() — the buffer is "
+                        f"deleted by the dispatch (in {qual!r})",
+                        symbol=qual,
+                        detail=f"{callee}:arg{p}:{arg.id}"))
+            elif isinstance(arg, ast.Attribute) and \
+                    isinstance(arg.value, ast.Name) and \
+                    arg.value.id == "self":
+                if self._attr_outlives(cfg, node, call, arg.attr):
+                    findings.append(Finding(
+                        self.name, "DS002", sf.rel,
+                        arg.lineno, arg.col_offset,
+                        f"'self.{arg.attr}' is donated at position "
+                        f"{p} of {callee}() but not rebound on every "
+                        f"path — the attribute outlives the call "
+                        f"holding a deleted buffer (in {qual!r})",
+                        symbol=qual,
+                        detail=f"{callee}:arg{p}:self.{arg.attr}"))
+        return findings
+
+    # --------------------------------------------------- CFG queries
+    @staticmethod
+    def _stmt_rebinds(stmt: ast.AST, name: str) -> bool:
+        if not isinstance(stmt, ast.Assign):
+            return False
+        for t in stmt.targets:
+            elts = t.elts if isinstance(t, ast.Tuple) else [t]
+            for e in elts:
+                if isinstance(e, ast.Name) and e.id == name:
+                    return True
+        return False
+
+    @staticmethod
+    def _stmt_reads(stmt: ast.AST, name: str) -> Optional[ast.AST]:
+        for part in head_exprs(stmt):
+            for n in ast.walk(part):
+                if isinstance(n, ast.Name) and n.id == name and \
+                        isinstance(n.ctx, ast.Load):
+                    return n
+        return None
+
+    def _read_after(self, cfg, node, call: ast.Call,
+                    name: str) -> Optional[ast.AST]:
+        """First read of ``name`` on some path after ``node`` without
+        an intervening rebind (DS001); the dispatch statement itself
+        rebinding (``x = fn(x)``) is the clean idiom."""
+        if self._stmt_rebinds(node.stmt, name):
+            return None
+        seen: Set[int] = set()
+        stack = list(node.succ | node.exc_succ)
+        while stack:
+            cur = stack.pop()
+            if id(cur) in seen or cur.kind != "stmt":
+                continue
+            seen.add(id(cur))
+            hit = self._stmt_reads(cur.stmt, name)
+            if hit is not None:
+                return hit
+            if self._stmt_rebinds(cur.stmt, name):
+                continue
+            stack.extend(cur.all_succ())
+        return None
+
+    def _attr_outlives(self, cfg, node, call: ast.Call,
+                       attr: str) -> bool:
+        """Some path from the dispatch to an exit with no
+        ``self.<attr> = ...`` store (DS002)."""
+        def stores(stmt) -> bool:
+            if not isinstance(stmt, ast.Assign):
+                return False
+            for t in stmt.targets:
+                elts = t.elts if isinstance(t, ast.Tuple) else [t]
+                for e in elts:
+                    if isinstance(e, ast.Attribute) and \
+                            e.attr == attr and \
+                            isinstance(e.value, ast.Name) and \
+                            e.value.id == "self":
+                        return True
+            return False
+
+        if stores(node.stmt):
+            return False
+        seen: Set[int] = set()
+        stack = list(node.succ)     # dispatch raising = not donated
+        while stack:
+            cur = stack.pop()
+            if id(cur) in seen:
+                continue
+            seen.add(id(cur))
+            if cur.kind != "stmt":
+                return True         # reached an exit un-rebound
+            if stores(cur.stmt):
+                continue
+            stack.extend(cur.all_succ())
+        return False

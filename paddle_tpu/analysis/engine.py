@@ -1,0 +1,819 @@
+"""Interprocedural dataflow engine shared by the pdlint analyzers.
+
+PR 4/9/11 grew per-module, single-pass analyzers; the bug classes
+added since (use-after-donate across a `donate_argnums` dispatch,
+KV-page leaks on exception paths, compile sites outside the
+`get_or_compile` chokepoint) need three things those walkers lack,
+supplied here once for every analyzer:
+
+- **CallGraph** — a repo-wide graph over every parsed file: bare calls
+  to module functions, ``self.method`` / ``cls.method`` calls,
+  module-qualified calls through the import table (``mod.fn`` where
+  ``mod`` resolves to a repo module, absolute or relative import),
+  ``functools.partial(target, ...)`` pre-binding, lambdas and function
+  aliases assigned to locals, and ``threading.Thread(target=...)``
+  hand-offs. Nodes are ``(repo-relative-path, qualname)`` keys so
+  fingerprints stay line-independent.
+- **CFG** — a lightweight per-function control-flow graph at statement
+  granularity with EXCEPTION edges: every statement that can raise has
+  an edge to the nearest enclosing handler/finally (else the
+  exceptional exit), ``finally`` bodies sit on both the normal and the
+  exceptional continuation, ``return`` routes through enclosing
+  ``finally`` blocks. Two distinguished exits (normal, exceptional)
+  let resource analyses ask "held at *any* exit on *some* path?".
+  Exception edges are may-edges: any statement containing a call /
+  subscript / attribute access is assumed able to raise.
+- **Taint** — the tiny forward lattice ``tracer_safety`` has always
+  used (parameter-derived names, assignment propagation), factored out
+  so the donation/recompile analyzers share one definition of
+  "data-dependent value".
+
+Everything stays stdlib-``ast``: code is parsed, never imported.
+"""
+from __future__ import annotations
+
+import ast
+from collections import deque
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import SourceFile
+
+__all__ = [
+    "FuncNode", "ModuleInfo", "CallGraph", "CFG", "CFGNode",
+    "build_cfg", "dotted_name", "iter_own_body", "Taint",
+    "module_name_of", "head_exprs", "jit_identifier",
+    "decorated_entry", "jit_entries",
+]
+
+
+# ===================================================================
+# shared AST helpers
+# ===================================================================
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """x.y.z attribute chain as 'x.y.z', or None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def iter_own_body(func_node):
+    """Pre-order, SOURCE-ORDER walk of one function's own body (taint
+    propagation needs assignments before later uses). Nested defs and
+    lambdas are separate call-graph nodes, not descended into. Accepts
+    defs (``.body`` is a list) and lambdas (``.body`` is an expr)."""
+    body = func_node.body
+    queue = deque(body if isinstance(body, list) else [body])
+    while queue:
+        n = queue.popleft()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        queue.extendleft(reversed(list(ast.iter_child_nodes(n))))
+
+
+def head_exprs(stmt: ast.AST) -> List[ast.AST]:
+    """The expressions a CFG node for ``stmt`` actually evaluates:
+    compound statements (if/while/for/with/try) evaluate only their
+    HEAD — their bodies are separate CFG nodes. Dataflow consumers
+    must scan these instead of ``ast.walk(stmt)`` or every nested
+    statement would be double-counted at each enclosing head."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [i.context_expr for i in stmt.items]
+    if isinstance(stmt, ast.Try):
+        return []
+    return [stmt]
+
+
+def module_name_of(rel: str) -> str:
+    """Repo-relative posix path -> importable dotted module name
+    ('paddle_tpu/serving/__init__.py' -> 'paddle_tpu.serving')."""
+    name = rel[:-3] if rel.endswith(".py") else rel
+    name = name.replace("/", ".")
+    if name.endswith(".__init__"):
+        name = name[: -len(".__init__")]
+    return name
+
+
+class Taint:
+    """Parameter-derived names with forward assignment propagation —
+    the shared definition of "data-dependent Python value"."""
+
+    def __init__(self, func_node, extra: Iterable[str] = ()):
+        a = func_node.args
+        self.names: Set[str] = {p.arg for p in
+                                list(a.posonlyargs) + list(a.args)
+                                + list(a.kwonlyargs)
+                                + ([a.vararg] if a.vararg else [])
+                                + ([a.kwarg] if a.kwarg else [])
+                                } - {"self", "cls"}
+        self.names.update(extra)
+
+    def touches(self, expr: ast.AST) -> bool:
+        return any(isinstance(n, ast.Name) and n.id in self.names
+                   for n in ast.walk(expr))
+
+    def note_stmt(self, stmt: ast.AST):
+        """Propagate through ``x = <expr touching tainted>``."""
+        if isinstance(stmt, ast.Assign) and self.touches(stmt.value):
+            for t in stmt.targets:
+                targets = t.elts if isinstance(t, ast.Tuple) else [t]
+                for e in targets:
+                    if isinstance(e, ast.Name):
+                        self.names.add(e.id)
+
+
+# ===================================================================
+# call graph
+# ===================================================================
+class FuncNode:
+    """One function/method/named-lambda in the repo-wide graph."""
+
+    __slots__ = ("key", "node", "sf", "qualname", "class_name",
+                 "is_method", "entry_via")
+
+    def __init__(self, sf: SourceFile, node, qualname: str,
+                 class_name: Optional[str]):
+        self.key: Tuple[str, str] = (sf.rel, qualname)
+        self.node = node
+        self.sf = sf
+        self.qualname = qualname
+        self.class_name = class_name
+        self.is_method = class_name is not None
+        self.entry_via: Optional[str] = None
+
+
+class _Imports(ast.NodeVisitor):
+    """alias -> absolute dotted module/name, relative imports resolved
+    against the importing module's package."""
+
+    def __init__(self, modname: str, is_package: bool):
+        self.aliases: Dict[str, str] = {}
+        self._mod = modname
+        self._is_pkg = is_package
+
+    def _rel_base(self, level: int) -> str:
+        parts = self._mod.split(".")
+        # level 1 = the containing package: for a plain module that
+        # means dropping the module segment itself
+        drop = level - 1 if self._is_pkg else level
+        return ".".join(parts[: len(parts) - drop]) if drop else \
+            self._mod
+
+    def visit_Import(self, node):
+        for a in node.names:
+            if a.asname:
+                self.aliases[a.asname] = a.name
+            else:
+                head = a.name.split(".")[0]
+                self.aliases[head] = head
+
+    def visit_ImportFrom(self, node):
+        if node.level:
+            base = self._rel_base(node.level)
+            mod = f"{base}.{node.module}" if node.module else base
+        else:
+            mod = node.module or ""
+        for a in node.names:
+            self.aliases[a.asname or a.name] = \
+                f"{mod}.{a.name}" if mod else a.name
+
+    def resolve(self, dotted: str) -> str:
+        head, _, rest = dotted.partition(".")
+        head = self.aliases.get(head, head)
+        return f"{head}.{rest}" if rest else head
+
+
+class _FuncCollector(ast.NodeVisitor):
+    """All defs (plus lambdas assigned to names) with qualnames."""
+
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        self.stack: List[str] = []
+        self.class_stack: List[str] = []
+        self.funcs: Dict[str, FuncNode] = {}
+
+    def _add(self, node, name: str):
+        qual = ".".join(self.stack + [name])
+        cls = self.class_stack[-1] if self.class_stack and \
+            self.stack and self.stack[-1] == self.class_stack[-1] \
+            else None
+        self.funcs.setdefault(qual, FuncNode(self.sf, node, qual, cls))
+
+    def _visit_func(self, node):
+        self._add(node, node.name)
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_ClassDef(self, node):
+        self.stack.append(node.name)
+        self.class_stack.append(node.name)
+        self.generic_visit(node)
+        self.class_stack.pop()
+        self.stack.pop()
+
+    def visit_Assign(self, node):
+        # h = lambda ...: a named lambda is a real call-graph node
+        if isinstance(node.value, ast.Lambda) and \
+                len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            self._add(node.value, node.targets[0].id)
+        self.generic_visit(node)
+
+
+class ModuleInfo:
+    """Per-file slice of the graph: functions, imports, name index."""
+
+    __slots__ = ("sf", "modname", "imports", "funcs", "by_last",
+                 "by_class_method")
+
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        self.modname = module_name_of(sf.rel)
+        is_pkg = sf.rel.endswith("__init__.py")
+        self.imports = _Imports(self.modname, is_pkg)
+        self.imports.visit(sf.tree)
+        coll = _FuncCollector(sf)
+        coll.visit(sf.tree)
+        self.funcs: Dict[str, FuncNode] = coll.funcs
+        self.by_last: Dict[str, List[str]] = {}
+        self.by_class_method: Dict[Tuple[str, str], str] = {}
+        for qual, fn in self.funcs.items():
+            self.by_last.setdefault(qual.split(".")[-1], []).append(qual)
+            if fn.class_name is not None:
+                self.by_class_method[(fn.class_name,
+                                      qual.split(".")[-1])] = qual
+
+
+class CallGraph:
+    """Repo-wide call graph over a set of parsed SourceFiles."""
+
+    def __init__(self, files: Sequence[SourceFile]):
+        self.modules: Dict[str, ModuleInfo] = {}   # rel -> info
+        self.by_modname: Dict[str, ModuleInfo] = {}
+        self.funcs: Dict[Tuple[str, str], FuncNode] = {}
+        for sf in files:
+            if sf.tree is None:
+                continue
+            mi = ModuleInfo(sf)
+            self.modules[sf.rel] = mi
+            self.by_modname[mi.modname] = mi
+            for f in mi.funcs.values():
+                self.funcs[f.key] = f
+        self.edges: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
+        for mi in self.modules.values():
+            for fn in mi.funcs.values():
+                self.edges[fn.key] = self._callees(mi, fn)
+
+    # ------------------------------------------------- call resolution
+    def _resolve_dotted(self, mi: ModuleInfo,
+                        dotted: str) -> List[Tuple[str, str]]:
+        """'mod.fn' / 'pkg.mod.fn' through the import table to another
+        repo module's function, or a same-module name."""
+        resolved = mi.imports.resolve(dotted)
+        head, _, last = resolved.rpartition(".")
+        if not head:
+            return [mi.funcs[q].key for q in mi.by_last.get(last, ())]
+        out: List[Tuple[str, str]] = []
+        target = self.by_modname.get(head)
+        if target is not None and last in target.funcs:
+            out.append(target.funcs[last].key)
+        # Class.method via an imported class: pkg.mod.Class.method
+        head2, _, cls = head.rpartition(".")
+        if head2:
+            tm = self.by_modname.get(head2)
+            if tm is not None and (cls, last) in tm.by_class_method:
+                out.append(tm.funcs[tm.by_class_method[(cls,
+                                                        last)]].key)
+        return out
+
+    def _resolve_target(self, mi: ModuleInfo, fn: FuncNode,
+                        expr: ast.AST, aliases: Dict[str, str]
+                        ) -> List[Tuple[str, str]]:
+        """A callable expression -> candidate FuncNode keys."""
+        if isinstance(expr, ast.Name):
+            if expr.id in aliases:          # local alias / named lambda
+                key = aliases[expr.id]
+                if key in self.funcs:
+                    return [key]
+            scoped = f"{fn.qualname}.{expr.id}"
+            if scoped in mi.funcs:          # nested def / local lambda
+                return [mi.funcs[scoped].key]
+            out = self._resolve_dotted(mi, expr.id)
+            if out:
+                return out
+            return [mi.funcs[q].key
+                    for q in mi.by_last.get(expr.id, ())]
+        if isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name) and \
+                    expr.value.id in ("self", "cls"):
+                if fn.class_name is not None:
+                    q = mi.by_class_method.get((fn.class_name,
+                                                expr.attr))
+                    if q is not None:
+                        return [mi.funcs[q].key]
+                return [mi.funcs[q].key
+                        for q in mi.by_last.get(expr.attr, ())
+                        if mi.funcs[q].is_method]
+            d = dotted_name(expr)
+            if d is not None:
+                return self._resolve_dotted(mi, d)
+        return []
+
+    def _callees(self, mi: ModuleInfo,
+                 fn: FuncNode) -> Set[Tuple[str, str]]:
+        out: Set[Tuple[str, str]] = set()
+        aliases: Dict[str, Tuple[str, str]] = {}
+        for n in iter_own_body(fn.node):
+            # h = helper / h = self.m / h = lambda...: local callable
+            # aliases; calls through them resolve to the target
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                    isinstance(n.targets[0], ast.Name):
+                tgt = n.targets[0].id
+                if isinstance(n.value, ast.Lambda):
+                    lam = f"{fn.qualname}.{tgt}"
+                    if lam in mi.funcs:
+                        aliases[tgt] = mi.funcs[lam].key
+                elif isinstance(n.value, (ast.Name, ast.Attribute)):
+                    keys = self._resolve_target(mi, fn, n.value,
+                                                aliases)
+                    if len(keys) == 1:
+                        aliases[tgt] = keys[0]
+            if not isinstance(n, ast.Call):
+                continue
+            out.update(self._resolve_target(mi, fn, n.func, aliases))
+            d = dotted_name(n.func)
+            last = d.split(".")[-1] if d else ""
+            if last == "partial" and n.args:
+                # functools.partial(target, ...): pre-bound call edge
+                out.update(self._resolve_target(mi, fn, n.args[0],
+                                                aliases))
+            if last == "Thread":
+                for kw in n.keywords:
+                    if kw.arg == "target":
+                        out.update(self._resolve_target(
+                            mi, fn, kw.value, aliases))
+        out.discard(fn.key)
+        return out
+
+    # ------------------------------------------------- reachability
+    def reachable(self, roots: Iterable[Tuple[Tuple[str, str], str]]
+                  ) -> Dict[Tuple[str, str], str]:
+        """BFS over edges from ``(key, via)`` roots; returns
+        ``key -> via`` attribution of the first root that reached it."""
+        reach: Dict[Tuple[str, str], str] = {}
+        work = deque(roots)
+        while work:
+            key, via = work.popleft()
+            if key in reach or key not in self.funcs:
+                continue
+            reach[key] = via
+            for callee in self.edges.get(key, ()):
+                if callee not in reach:
+                    work.append((callee, via))
+        return reach
+
+
+# ===================================================================
+# control-flow graph with exception edges
+# ===================================================================
+class CFGNode:
+    """One statement (or a synthetic exit). ``succ`` are normal-flow
+    successors; ``exc_succ`` is where control goes if THIS statement
+    raises mid-execution (its own side effects incomplete) — resource
+    analyses start tracking only after an acquire completes, so they
+    follow ``succ | exc_succ`` everywhere except at the acquire node
+    itself, where only ``succ`` applies."""
+
+    __slots__ = ("stmt", "kind", "succ", "exc_succ", "none_names")
+
+    def __init__(self, stmt, kind: str = "stmt"):
+        self.stmt = stmt
+        self.kind = kind                   # stmt | exit | exc_exit
+        self.succ: Set["CFGNode"] = set()
+        self.exc_succ: Set["CFGNode"] = set()
+        # names statically known to be None when control enters this
+        # node (then-branch of `if x is None:` / `if not x:`): a
+        # resource variable that is None was never acquired
+        self.none_names: Set[str] = set()
+
+    def all_succ(self) -> Set["CFGNode"]:
+        return self.succ | self.exc_succ
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        if self.kind != "stmt":
+            return f"<{self.kind}>"
+        return f"<{type(self.stmt).__name__}@{self.stmt.lineno}>"
+
+
+class CFG:
+    __slots__ = ("entry", "exit", "exc_exit", "nodes")
+
+    def __init__(self):
+        self.exit = CFGNode(None, "exit")
+        self.exc_exit = CFGNode(None, "exc_exit")
+        self.nodes: List[CFGNode] = []
+        self.entry: Optional[CFGNode] = None
+
+
+_RAISERS = (ast.Call, ast.Subscript)
+
+
+def _may_raise(stmt: ast.stmt) -> bool:
+    """Statements that get an exception edge: calls and subscripts
+    (the realistic raisers — IndexError/KeyError and anything a callee
+    throws), plus explicit raise/assert. Bare attribute access and
+    arithmetic are treated as non-raising: modeling them as raisers
+    floods leak analysis with AttributeError-on-self paths no real
+    program takes. May-edges either way."""
+    if isinstance(stmt, (ast.Raise, ast.Assert)):
+        return True
+    for n in ast.walk(stmt):
+        if isinstance(n, _RAISERS):
+            return True
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            return False
+    return False
+
+
+def _none_test(test: ast.AST) -> Optional[Tuple[str, bool]]:
+    """``x is None``/``not x`` -> (x, True): the THEN branch sees x
+    None; ``x is not None`` -> (x, False): the ELSE branch does. An
+    ``and`` conjunction guarantees every conjunct in its THEN branch,
+    so a positive none-test inside one carries through."""
+    if isinstance(test, ast.Compare) and len(test.ops) == 1 and \
+            isinstance(test.comparators[0], ast.Constant) and \
+            test.comparators[0].value is None and \
+            isinstance(test.left, ast.Name):
+        return test.left.id, isinstance(test.ops[0], ast.Is)
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not) \
+            and isinstance(test.operand, ast.Name):
+        return test.operand.id, True
+    if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.And):
+        for v in test.values:
+            nt = _none_test(v)
+            if nt is not None and nt[1]:
+                return nt
+    return None
+
+
+class _Frag:
+    """A built sub-graph: its entry node and the open fall-through
+    ends the caller must connect to whatever follows."""
+
+    __slots__ = ("entry", "outs")
+
+    def __init__(self, entry: Optional[CFGNode], outs: List[CFGNode]):
+        self.entry = entry
+        self.outs = outs
+
+
+class _Frame:
+    """Enclosing-construct context while building."""
+
+    __slots__ = ("exc_cont", "break_out", "continue_to", "parent",
+                 "fin_frag", "saw_return", "saw_raise")
+
+    def __init__(self, exc_cont, break_out=None, continue_to=None,
+                 parent=None, fin_frag=None):
+        self.exc_cont: CFGNode = exc_cont  # where raises go
+        self.break_out = break_out         # pending break nodes, or None
+        self.continue_to = continue_to
+        self.parent = parent
+        self.fin_frag: Optional[_Frag] = fin_frag
+        self.saw_return = False            # a return routed through here
+        self.saw_raise = False             # an exception routed through
+
+    def nearest_loop(self) -> Optional["_Frame"]:
+        f = self
+        while f is not None:
+            if f.break_out is not None:
+                return f
+            f = f.parent
+        return None
+
+
+class _Builder:
+    """Statement-level CFG. ``finally`` bodies are built once and sit
+    on every continuation that actually routes through them (normal
+    fall-through, exception propagation when the protected body can
+    raise, return) — a may-path over-approximation that keeps leak
+    analysis usable without path duplication."""
+
+    def __init__(self):
+        self.cfg = CFG()
+
+    def node(self, stmt) -> CFGNode:
+        n = CFGNode(stmt)
+        self.cfg.nodes.append(n)
+        return n
+
+    def build(self, func_node) -> CFG:
+        body = func_node.body
+        if not isinstance(body, list):     # lambda
+            body = [ast.Expr(value=func_node.body)]
+        root = _Frame(self.cfg.exc_exit)
+        frag = self._seq(body, root)
+        self.cfg.entry = frag.entry if frag.entry is not None \
+            else self.cfg.exit
+        for o in frag.outs:
+            o.succ.add(self.cfg.exit)
+        return self.cfg
+
+    # ------------------------------------------------------ sequences
+    def _seq(self, stmts: List[ast.stmt], frame: _Frame) -> _Frag:
+        entry: Optional[CFGNode] = None
+        outs: List[CFGNode] = []
+        started = False
+        for stmt in stmts:
+            f = self._stmt(stmt, frame)
+            if f.entry is None:
+                continue
+            if not started:
+                entry, started = f.entry, True
+            else:
+                for o in outs:
+                    o.succ.add(f.entry)
+            outs = f.outs
+            if not outs:                   # terminal: rest is dead code
+                break
+        return _Frag(entry, outs)
+
+    # ------------------------------------------------------ statements
+    def _stmt(self, stmt: ast.stmt, frame: _Frame) -> _Frag:
+        if isinstance(stmt, ast.If):
+            return self._if(stmt, frame)
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            return self._loop(stmt, frame)
+        if isinstance(stmt, ast.Try):
+            return self._try(stmt, frame)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            head = self.node(stmt)
+            self._exc_edge(head, stmt, frame)
+            body = self._seq(stmt.body, frame)
+            if body.entry is not None:
+                head.succ.add(body.entry)
+                return _Frag(head, body.outs)
+            return _Frag(head, [head])
+        n = self.node(stmt)
+        self._exc_edge(n, stmt, frame)
+        if isinstance(stmt, ast.Return):
+            n.succ.add(self._return_target(frame))
+            return _Frag(n, [])
+        if isinstance(stmt, ast.Raise):
+            frame.saw_raise = True
+            n.succ.add(frame.exc_cont)
+            return _Frag(n, [])
+        if isinstance(stmt, ast.Break):
+            loop = frame.nearest_loop()
+            if loop is not None:
+                loop.break_out.append(n)
+            return _Frag(n, [])
+        if isinstance(stmt, ast.Continue):
+            loop = frame.nearest_loop()
+            if loop is not None and loop.continue_to is not None:
+                n.succ.add(loop.continue_to)
+            return _Frag(n, [])
+        return _Frag(n, [n])
+
+    def _if(self, stmt: ast.If, frame: _Frame) -> _Frag:
+        head = self.node(stmt)
+        self._exc_edge(head, stmt.test, frame, walk=True)
+        nt = _none_test(stmt.test)
+        outs: List[CFGNode] = []
+        then = self._seq(stmt.body, frame)
+        if then.entry is not None:
+            head.succ.add(then.entry)
+            if nt and nt[1]:
+                then.entry.none_names.add(nt[0])
+            outs.extend(then.outs)
+        if stmt.orelse:
+            els = self._seq(stmt.orelse, frame)
+            if els.entry is not None:
+                head.succ.add(els.entry)
+                if nt and not nt[1]:
+                    els.entry.none_names.add(nt[0])
+                outs.extend(els.outs)
+            else:
+                outs.append(head)
+        else:
+            outs.append(head)              # condition-false fall-through
+        return _Frag(head, outs)
+
+    def _loop(self, stmt, frame: _Frame) -> _Frag:
+        head = self.node(stmt)
+        self._exc_edge(head, stmt, frame)
+        inner = _Frame(frame.exc_cont, break_out=[], continue_to=head,
+                       parent=frame, fin_frag=None)
+        body = self._seq(stmt.body, inner)
+        if body.entry is not None:
+            head.succ.add(body.entry)
+            if isinstance(stmt, ast.While):
+                nt = _none_test(stmt.test)
+                if nt and nt[1]:
+                    body.entry.none_names.add(nt[0])
+            for o in body.outs:
+                o.succ.add(head)           # back edge
+        outs = [head] + inner.break_out
+        if stmt.orelse:
+            els = self._seq(stmt.orelse, frame)
+            if els.entry is not None:
+                head.succ.add(els.entry)
+                outs = inner.break_out + els.outs
+        frame.saw_return |= inner.saw_return
+        frame.saw_raise |= inner.saw_raise
+        return _Frag(head, outs)
+
+    def _try(self, stmt: ast.Try, frame: _Frame) -> _Frag:
+        fin = self._seq(stmt.finalbody, frame) if stmt.finalbody \
+            else None
+
+        # handlers: exceptions raised INSIDE a handler route through
+        # the finally (if any) or the enclosing continuation
+        handler_exc = fin.entry if fin is not None and \
+            fin.entry is not None else frame.exc_cont
+        handler_frags: List[_Frag] = []
+        for h in stmt.handlers:
+            h_frame = _Frame(handler_exc, parent=frame, fin_frag=fin)
+            h_frame.break_out = None
+            hf = self._seq(h.body, h_frame)
+            frame.saw_return |= h_frame.saw_return
+            handler_frags.append(hf)
+
+        # the protected body: raises go to the first handler, else the
+        # finally, else out
+        if handler_frags and handler_frags[0].entry is not None:
+            body_exc = handler_frags[0].entry
+        elif fin is not None and fin.entry is not None:
+            body_exc = fin.entry
+        else:
+            body_exc = frame.exc_cont
+        body_frame = _Frame(body_exc, parent=frame, fin_frag=fin)
+        body = self._seq(stmt.body + (stmt.orelse or []), body_frame)
+
+        # multiple handlers: a raising body statement may enter any
+        for extra in handler_frags[1:]:
+            if extra.entry is not None:
+                for n in self.cfg.nodes:
+                    if n.kind != "stmt":
+                        continue
+                    if body_exc in n.exc_succ:
+                        n.exc_succ.add(extra.entry)
+                    if body_exc in n.succ:
+                        n.succ.add(extra.entry)
+
+        outs: List[CFGNode] = []
+        if fin is not None and fin.entry is not None:
+            for o in body.outs:
+                o.succ.add(fin.entry)
+            for hf in handler_frags:
+                for o in hf.outs:
+                    o.succ.add(fin.entry)
+            # the finally's open ends continue: normally (caller
+            # connects), exceptionally (body could raise past the
+            # handlers), and to the function exit for returns routed
+            # through
+            if body_frame.saw_raise or self._body_may_raise(stmt):
+                if not stmt.handlers:
+                    for o in fin.outs:
+                        o.succ.add(frame.exc_cont)
+            if body_frame.saw_return:
+                for o in fin.outs:
+                    o.succ.add(self._return_target(frame))
+            outs = list(fin.outs)
+        else:
+            outs = list(body.outs)
+            for hf in handler_frags:
+                outs.extend(hf.outs)
+        frame.saw_raise |= body_frame.saw_raise and not stmt.handlers
+        entry = body.entry
+        if entry is None:
+            entry = fin.entry if fin is not None else None
+        if entry is None:
+            n = self.node(stmt)
+            return _Frag(n, [n])
+        return _Frag(entry, outs)
+
+    # ------------------------------------------------------ plumbing
+    def _exc_edge(self, node: CFGNode, stmt, frame: _Frame,
+                  walk: bool = False):
+        raising = any(isinstance(n, _RAISERS)
+                      for n in ast.walk(stmt)) \
+            if walk else _may_raise(stmt)
+        if raising:
+            frame.saw_raise = True
+            node.exc_succ.add(frame.exc_cont)
+
+    @staticmethod
+    def _body_may_raise(stmt: ast.Try) -> bool:
+        return any(_may_raise(s) for s in stmt.body)
+
+    def _return_target(self, frame: _Frame) -> CFGNode:
+        f = frame
+        while f is not None:
+            if f.fin_frag is not None and f.fin_frag.entry is not None:
+                f.saw_return = True
+                return f.fin_frag.entry
+            f.saw_return = True
+            f = f.parent
+        return self.cfg.exit
+
+
+def build_cfg(func_node) -> CFG:
+    """CFG for one function def (or lambda)."""
+    return _Builder().build(func_node)
+
+
+# ===================================================================
+# jit entry detection (shared by tracer_safety / recompile_risk)
+# ===================================================================
+_JIT_NAMES = {"jit", "to_static", "pjit"}
+
+
+def jit_identifier(node: ast.AST) -> Optional[str]:
+    """'jit'/'to_static'/'pjit' when this expression names a jit
+    wrapper (Name, dotted attribute, or
+    ``functools.partial(jax.jit, ...)``)."""
+    if isinstance(node, ast.Call):       # partial(jax.jit, ...)
+        for sub in [node.func] + list(node.args):
+            got = jit_identifier(sub)
+            if got:
+                return got
+        return None
+    d = dotted_name(node)
+    if d is None:
+        return None
+    last = d.split(".")[-1]
+    return last if last in _JIT_NAMES else None
+
+
+def decorated_entry(node) -> Optional[str]:
+    for dec in node.decorator_list:
+        got = jit_identifier(dec)
+        if got:
+            return got
+    return None
+
+
+def jit_entries(cg: CallGraph) -> List[Tuple[Tuple[str, str], str]]:
+    """Trace entry points across the whole graph: jit-decorated
+    functions, functions named ``train_step``, and functions passed to
+    a jit wrapper at a call site (``jax.jit(fn)``, ``jit(self.step)``,
+    ``jit(partial(step, ...))``). Marks ``FuncNode.entry_via`` and
+    returns ``[(key, via)]`` roots for ``CallGraph.reachable``."""
+    roots: List[Tuple[Tuple[str, str], str]] = []
+
+    def mark(fn: FuncNode, via: str):
+        if fn.entry_via is None:
+            fn.entry_via = via
+            roots.append((fn.key, via))
+
+    for mi in cg.modules.values():
+        for qual, fn in mi.funcs.items():
+            node = fn.node
+            if isinstance(node, ast.Lambda):
+                continue
+            via = decorated_entry(node)
+            if via is None and node.name == "train_step":
+                via = "train_step"
+            if via is not None:
+                mark(fn, via)
+        # call-site entries: jit(<target>) anywhere in the module
+        for n in ast.walk(mi.sf.tree):
+            if not isinstance(n, ast.Call) or not n.args:
+                continue
+            via = jit_identifier(n.func)
+            if via is None:
+                continue
+            tgt = n.args[0]
+            if isinstance(tgt, ast.Call):  # jit(partial(step, ...))
+                if dotted_name(tgt.func) and \
+                        dotted_name(tgt.func).split(".")[-1] == \
+                        "partial" and tgt.args:
+                    tgt = tgt.args[0]
+                else:
+                    continue
+            if isinstance(tgt, ast.Name):
+                for q in mi.by_last.get(tgt.id, ()):
+                    mark(mi.funcs[q], via)
+            elif isinstance(tgt, ast.Attribute) and \
+                    isinstance(tgt.value, ast.Name) and \
+                    tgt.value.id in ("self", "cls"):
+                for q in mi.by_last.get(tgt.attr, ()):
+                    if mi.funcs[q].is_method:
+                        mark(mi.funcs[q], via)
+    return roots

@@ -1,0 +1,256 @@
+"""Recompile-risk analyzer: compile-site and signature discipline.
+
+PR 13 multiplied compile sites (StaticFunction, TrainStep, Predictor,
+four CachedDecoder sites) and made ``compile_cache.get_or_compile``
+THE chokepoint: it is where persistent-cache tiers, xstats provenance
+and the goodput compile ledger all attach. A new AOT site wired around
+it compiles invisibly — no hit/miss counters, no cost analysis, no
+badput attribution. And any data-dependent Python value reaching a
+traced signature (a raw ``len(batch)``, an unbucketed ``arr.shape[i]``,
+a set iteration ordering pytree leaves) recompiles per distinct value
+— the unbounded-recompilation failure mode shape bucketing exists to
+prevent.
+
+Rules:
+
+  RR001  an AOT compile site (``<x>.lower(...).compile()``) in the
+         serving/inference/jit layers whose enclosing function never
+         routes through ``get_or_compile`` — xstats/provenance go dark
+  RR002  a raw data-dependent size (``len(<param>)``,
+         ``<param>.shape[i]``, or a local bound to one) passed to a
+         jit-wrapped callable without passing through a bucketing
+         helper (``bucket_seq`` / ``bucket_batch`` / ``next_pow2`` /
+         ``pages_for``) — one executable per distinct value
+  RR003  iteration over a ``set`` inside a trace-reachable function —
+         hash-randomized order bakes a different pytree leaf order
+         into the trace per process, defeating fingerprint/cache keys
+         (iterate ``sorted(s)`` instead)
+
+RR001/RR002 are scoped to the production dispatch layers
+(``serving/``, ``inference/``, ``jit/``); RR003 runs wherever the
+tracer-safety entry detection finds trace-reachable code.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set
+
+from .core import Analyzer, Finding, SourceFile, in_scope
+from .engine import (CallGraph, Taint, dotted_name, iter_own_body,
+                     jit_entries)
+
+__all__ = ["RecompileRiskAnalyzer"]
+
+_COMPILE_DIRS = ("paddle_tpu/serving/", "paddle_tpu/inference/",
+                 "paddle_tpu/jit/")
+_BUCKET_HELPERS = {"bucket_seq", "bucket_batch", "next_pow2",
+                   "pages_for", "bucket", "min", "max"}
+
+
+def _is_aot_site(call: ast.Call) -> bool:
+    """``<x>.lower(...).compile()``"""
+    f = call.func
+    return (isinstance(f, ast.Attribute) and f.attr == "compile"
+            and isinstance(f.value, ast.Call)
+            and isinstance(f.value.func, ast.Attribute)
+            and f.value.func.attr == "lower")
+
+
+def _jit_wrapped_names(fn) -> Set[str]:
+    """Locals bound to a jit/pjit call result in this function."""
+    out: Set[str] = set()
+    for n in iter_own_body(fn):
+        if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                isinstance(n.targets[0], ast.Name) and \
+                isinstance(n.value, ast.Call):
+            d = dotted_name(n.value.func)
+            if d and d.split(".")[-1] in ("jit", "pjit"):
+                out.add(n.targets[0].id)
+    return out
+
+
+def _raw_size_expr(expr: ast.AST, taint: Taint) -> Optional[str]:
+    """``len(p)`` / ``p.shape[i]`` over a tainted (parameter-derived)
+    value -> a stable description, else None."""
+    if isinstance(expr, ast.Call) and \
+            isinstance(expr.func, ast.Name) and \
+            expr.func.id == "len" and len(expr.args) == 1 and \
+            taint.touches(expr.args[0]):
+        d = dotted_name(expr.args[0]) or "<expr>"
+        return f"len({d})"
+    if isinstance(expr, ast.Subscript):
+        d = dotted_name(expr.value)
+        if d and d.endswith(".shape") and taint.touches(expr.value):
+            return f"{d}[i]"
+    return None
+
+
+class RecompileRiskAnalyzer(Analyzer):
+    name = "recompile_risk"
+
+    def __init__(self, compile_dirs: Sequence[str] = _COMPILE_DIRS):
+        self.compile_dirs = tuple(compile_dirs)
+
+    def run(self, files: Sequence[SourceFile]) -> List[Finding]:
+        out: List[Finding] = []
+        scoped = [sf for sf in files
+                  if in_scope(sf.rel, self.compile_dirs)]
+        for sf in scoped:
+            out.extend(self._check_compile_sites(sf))
+            out.extend(self._check_signature_taint(sf))
+        out.extend(self._check_set_iteration(files))
+        return out
+
+    # ------------------------------------------------- RR001
+    def _check_compile_sites(self, sf: SourceFile) -> List[Finding]:
+        findings: List[Finding] = []
+
+        def visit(node, func_stack: List):
+            for child in ast.iter_child_nodes(node):
+                stack = func_stack
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    stack = func_stack + [child]
+                if isinstance(child, ast.Call) and \
+                        _is_aot_site(child):
+                    # routed if ANY enclosing def calls get_or_compile
+                    # (build thunks are nested inside the site that
+                    # hands them to the cache)
+                    if not any(self._routed(e) for e in stack):
+                        qual = stack[-1].name if stack else "<module>"
+                        findings.append(Finding(
+                            self.name, "RR001", sf.rel,
+                            child.lineno, child.col_offset,
+                            f"AOT compile site in {qual!r} is not "
+                            f"routed through compile_cache."
+                            f"get_or_compile — no persistent tier, no "
+                            f"xstats provenance, no compile-badput "
+                            f"attribution", symbol=qual,
+                            detail="lower().compile()"))
+                visit(child, stack)
+
+        visit(sf.tree, [])
+        return findings
+
+    @staticmethod
+    def _routed(encl) -> bool:
+        """The enclosing def (build thunks included — they live inside
+        it) calls get_or_compile somewhere."""
+        if encl is None:
+            return False
+        for n in ast.walk(encl):
+            if isinstance(n, ast.Call):
+                d = dotted_name(n.func)
+                if d and d.split(".")[-1] == "get_or_compile":
+                    return True
+        return False
+
+    # ------------------------------------------------- RR002
+    def _check_signature_taint(self, sf: SourceFile) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                findings.extend(self._taint_function(sf, node))
+        return findings
+
+    def _taint_function(self, sf: SourceFile, fn) -> List[Finding]:
+        jitted = _jit_wrapped_names(fn)
+        if not jitted:
+            return []
+        taint = Taint(fn)
+        raw_sizes: Dict[str, str] = {}   # local -> description
+        findings: List[Finding] = []
+        for n in iter_own_body(fn):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                    isinstance(n.targets[0], ast.Name):
+                desc = _raw_size_expr(n.value, taint)
+                if desc is not None:
+                    raw_sizes[n.targets[0].id] = desc
+                elif isinstance(n.value, ast.Call):
+                    d = dotted_name(n.value.func) or ""
+                    if d.split(".")[-1] in _BUCKET_HELPERS:
+                        raw_sizes.pop(n.targets[0].id, None)
+            taint.note_stmt(n)
+            if not isinstance(n, ast.Call):
+                continue
+            f = n.func
+            if not (isinstance(f, ast.Name) and f.id in jitted):
+                continue
+            for i, arg in enumerate(n.args):
+                desc = _raw_size_expr(arg, taint)
+                if desc is None and isinstance(arg, ast.Name) and \
+                        arg.id in raw_sizes:
+                    desc = raw_sizes[arg.id]
+                if desc is not None:
+                    findings.append(Finding(
+                        self.name, "RR002", sf.rel, arg.lineno,
+                        arg.col_offset,
+                        f"unbucketed data-dependent size {desc} flows "
+                        f"into jitted call {f.id}() at position {i} — "
+                        f"one fresh compile per distinct value; route "
+                        f"it through the bucketing helpers "
+                        f"(in {fn.name!r})",
+                        symbol=fn.name,
+                        detail=f"{f.id}:arg{i}:{desc}"))
+        return findings
+
+    # ------------------------------------------------- RR003
+    def _check_set_iteration(self,
+                             files: Sequence[SourceFile]
+                             ) -> List[Finding]:
+        cg = CallGraph(files)
+        reach = cg.reachable(jit_entries(cg))
+        findings: List[Finding] = []
+        for key in sorted(reach):
+            fn = cg.funcs[key]
+            via = reach[key]
+            findings.extend(self._set_iters(fn, via))
+        return findings
+
+    def _set_iters(self, fn, via: str) -> List[Finding]:
+        node = fn.node
+        if isinstance(node, ast.Lambda):
+            return []
+        set_vars: Set[str] = set()
+        findings: List[Finding] = []
+
+        def is_set_expr(e: ast.AST) -> bool:
+            if isinstance(e, ast.Set) or isinstance(e, ast.SetComp):
+                return True
+            if isinstance(e, ast.Call):
+                d = dotted_name(e.func) or ""
+                return d in ("set", "frozenset")
+            if isinstance(e, ast.Name):
+                return e.id in set_vars
+            if isinstance(e, ast.BinOp) and \
+                    isinstance(e.op, (ast.BitOr, ast.BitAnd,
+                                      ast.Sub)):
+                return is_set_expr(e.left) or is_set_expr(e.right)
+            return False
+
+        def check_iter(it: ast.AST, where: ast.AST):
+            if is_set_expr(it):
+                d = dotted_name(it) if isinstance(
+                    it, (ast.Name, ast.Attribute)) else None
+                findings.append(Finding(
+                    self.name, "RR003", fn.sf.rel, where.lineno,
+                    where.col_offset,
+                    f"iteration over a set in {fn.qualname!r} (traced "
+                    f"via {via}) — hash-randomized order changes the "
+                    f"traced pytree per process; iterate sorted(...) "
+                    f"instead", symbol=fn.qualname,
+                    detail=f"set-iter:{d or 'set'}"))
+
+        for n in iter_own_body(node):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                    isinstance(n.targets[0], ast.Name) and \
+                    is_set_expr(n.value):
+                set_vars.add(n.targets[0].id)
+            if isinstance(n, (ast.For, ast.AsyncFor)):
+                check_iter(n.iter, n)
+            elif isinstance(n, (ast.ListComp, ast.SetComp,
+                                ast.DictComp, ast.GeneratorExp)):
+                for gen in n.generators:
+                    check_iter(gen.iter, n)
+        return findings

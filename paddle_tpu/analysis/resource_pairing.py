@@ -1,0 +1,344 @@
+"""Resource-pairing analyzer: acquire without release on SOME path.
+
+The refcounted ``PagedKVCache`` (PR 12) made page accounting a
+correctness invariant: a ``retain``/``alloc`` whose ``release``/
+``free`` is skipped on an exception path leaks pool pages until the
+engine wedges at admission — ``assert_no_leaks`` catches it at
+runtime, this analyzer catches it in CI. Same discipline for bare
+``lock.acquire()`` (use ``with`` or pair on every path) and manual
+``__enter__`` driving.
+
+Rules (all evaluated over the engine CFG, exception edges included):
+
+  RP001  ``<x>.alloc(...)`` result / ``<x>.retain(name)`` argument
+         reaches a function exit — normal or exceptional — on some
+         path with no ``release``/``free`` and no ownership transfer
+  RP002  ``<x>.acquire()`` outside a ``with`` item, with a path to an
+         exit that never calls ``<x>.release()``
+  RP003  ``<x>.__enter__()`` with a path to an exit that never calls
+         ``<x>.__exit__(...)``
+
+Ownership transfer (kills tracking): the resource name is returned /
+yielded, stored into an attribute / subscript / container, or passed
+as an argument to any call that is not a releaser — the callee or the
+holding object owns the release from there (the engine stores admitted
+pages in ``_ActiveSeq``/``self._slots`` and frees them in
+``_release``; that pattern is clean by construction here). A branch
+proving the name ``None`` (``if pages is None:``) also kills: the
+all-or-nothing allocator returned nothing.
+
+Scope: ``paddle_tpu/`` production code. Tests deliberately leak
+(tripwire assertions) and tools hold resources for their whole run.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Sequence, Set, Tuple
+
+from .core import Analyzer, Finding, SourceFile, in_scope
+from .engine import CFG, build_cfg, dotted_name, head_exprs
+
+__all__ = ["ResourcePairingAnalyzer"]
+
+_DEFAULT_DIRS = ("paddle_tpu/",)
+
+_ACQUIRERS = {
+    # attr -> (rule, kind, releaser attrs)
+    "alloc": ("RP001", "pages", ("release", "free")),
+    "retain": ("RP001", "pages", ("release", "free")),
+    "acquire": ("RP002", "lock", ("release",)),
+    "__enter__": ("RP003", "context", ("__exit__",)),
+}
+
+
+class _Resource:
+    __slots__ = ("rule", "kind", "var", "recv", "releasers", "node",
+                 "line", "col", "detail")
+
+    def __init__(self, rule, kind, var, recv, releasers, node,
+                 line, col):
+        self.rule = rule
+        self.kind = kind
+        self.var = var          # tracked local name (pages kinds)
+        self.recv = recv        # receiver dotted string (lock/context)
+        self.releasers = releasers
+        self.node = node        # CFGNode of the acquire
+        self.line = line
+        self.col = col
+        self.detail = f"{recv}.{kind}" if var is None else \
+            f"{var}:{kind}"
+
+
+def _call_of(stmt: ast.AST):
+    """Iterate every Call this CFG node's statement itself evaluates
+    (compound heads evaluate only their head expressions)."""
+    for part in head_exprs(stmt):
+        for n in ast.walk(part):
+            if isinstance(n, ast.Call):
+                yield n
+
+
+def _arg_names(call: ast.Call) -> Set[str]:
+    out: Set[str] = set()
+    for a in list(call.args) + [kw.value for kw in call.keywords]:
+        for n in ast.walk(a):
+            if isinstance(n, ast.Name):
+                out.add(n.id)
+    return out
+
+
+class ResourcePairingAnalyzer(Analyzer):
+    name = "resource_pairing"
+
+    def __init__(self, dirs: Sequence[str] = _DEFAULT_DIRS):
+        self.dirs = tuple(dirs)
+
+    def run(self, files: Sequence[SourceFile]) -> List[Finding]:
+        out: List[Finding] = []
+        for sf in files:
+            if not in_scope(sf.rel, self.dirs):
+                continue
+            cls_of = {}
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.ClassDef):
+                    for m in node.body:
+                        if isinstance(m, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                            cls_of[id(m)] = node
+            for node in ast.walk(sf.tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    out.extend(self._check_function(
+                        sf, node, cls_of.get(id(node))))
+        return out
+
+    # -------------------------------------------------- per function
+    def _check_function(self, sf: SourceFile, fn,
+                        cls: Optional[ast.ClassDef]) -> List[Finding]:
+        cfg = build_cfg(fn)
+        resources = self._find_acquires(fn, cfg, cls)
+        findings: List[Finding] = []
+        qual = fn.name
+        for res in resources:
+            leak = self._walk(res, cfg)
+            if leak is None:
+                continue
+            exit_kind = ("an exception path" if leak == "exc"
+                         else "a normal path")
+            if res.var is not None:
+                msg = (f"{res.kind} resource {res.var!r} acquired here "
+                       f"can reach a function exit on {exit_kind} "
+                       f"without {' / '.join(res.releasers)} — leaked "
+                       f"{res.kind}")
+            else:
+                msg = (f"{res.recv}.{'/'.join(res.releasers)} is never "
+                       f"called on {exit_kind} after this acquire")
+            findings.append(Finding(
+                self.name, res.rule, sf.rel, res.line, res.col,
+                f"{msg} (in {qual!r})", symbol=qual,
+                detail=res.detail))
+        return findings
+
+    # -------------------------------------------------- acquire sites
+    def _find_acquires(self, fn, cfg: CFG,
+                       cls: Optional[ast.ClassDef]) -> List[_Resource]:
+        # receivers used as `with` items are exempt (the context
+        # manager releases); so are with-item __enter__ sugar forms
+        with_recvs: Set[str] = set()
+        for n in ast.walk(fn):
+            if isinstance(n, (ast.With, ast.AsyncWith)):
+                for item in n.items:
+                    d = dotted_name(item.context_expr)
+                    if d:
+                        with_recvs.add(d)
+                    elif isinstance(item.context_expr, ast.Call):
+                        d = dotted_name(item.context_expr.func)
+                        if d:
+                            with_recvs.add(d)
+
+        out: List[_Resource] = []
+        for node in cfg.nodes:
+            stmt = node.stmt
+            if stmt is None:
+                continue
+            for call in _call_of(stmt):
+                f = call.func
+                if not isinstance(f, ast.Attribute):
+                    continue
+                spec = _ACQUIRERS.get(f.attr)
+                if spec is None:
+                    continue
+                rule, kind, releasers = spec
+                recv = dotted_name(f.value) or "<expr>"
+                if f.attr == "alloc":
+                    var = self._assigned_name(stmt, call)
+                    if var is None:
+                        continue        # result unused/complex: skip
+                    out.append(_Resource(rule, kind, var, recv,
+                                         releasers, node,
+                                         call.lineno,
+                                         call.col_offset))
+                elif f.attr == "retain":
+                    # only bare-Name retains are tracked; list
+                    # literals belong to structures that own them
+                    if len(call.args) == 1 and \
+                            isinstance(call.args[0], ast.Name):
+                        out.append(_Resource(
+                            rule, kind, call.args[0].id, recv,
+                            releasers, node, call.lineno,
+                            call.col_offset))
+                elif f.attr == "acquire":
+                    # lock protocol only: argless (or kw-only timeout)
+                    # acquire — pool/semaphore acquires that take
+                    # operands follow cross-method ownership protocols
+                    if call.args or recv in with_recvs or \
+                            recv == "<expr>":
+                        continue
+                    if self._class_pairs(cls, fn, recv, releasers):
+                        continue
+                    out.append(_Resource(rule, kind, None, recv,
+                                         releasers, node, call.lineno,
+                                         call.col_offset))
+                elif f.attr == "__enter__":
+                    if recv in with_recvs or recv == "<expr>":
+                        continue
+                    # delegation: the __enter__ RESULT is handed to
+                    # the caller / stored — whoever holds it owns the
+                    # __exit__ (the `return ctx.__enter__()` protocol)
+                    if self._result_escapes(stmt, call):
+                        continue
+                    if self._class_pairs(cls, fn, recv, releasers):
+                        continue
+                    out.append(_Resource(rule, kind, None, recv,
+                                         releasers, node, call.lineno,
+                                         call.col_offset))
+        return out
+
+    @staticmethod
+    def _result_escapes(stmt: ast.AST, call: ast.Call) -> bool:
+        """The call's value is returned / yielded / stored into an
+        attribute — ownership of the paired release moves with it."""
+        if isinstance(stmt, ast.Return) and stmt.value is call:
+            return True
+        if isinstance(stmt, ast.Expr) and isinstance(
+                stmt.value, (ast.Yield, ast.YieldFrom)):
+            return True
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, (ast.Attribute, ast.Subscript)):
+                    return True
+        return False
+
+    @staticmethod
+    def _class_pairs(cls: Optional[ast.ClassDef], fn, recv: str,
+                     releasers) -> bool:
+        """Cross-method protocol: an acquire on a ``self.<attr>``
+        receiver whose releaser is called on the SAME receiver
+        anywhere else in the class (begin/end, __enter__/__exit__
+        delegation) is paired at object scope, not path scope."""
+        if cls is None or not recv.startswith("self."):
+            return False
+        for m in cls.body:
+            if not isinstance(m, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)) or m is fn:
+                continue
+            for n in ast.walk(m):
+                if isinstance(n, ast.Call) and \
+                        isinstance(n.func, ast.Attribute) and \
+                        n.func.attr in releasers and \
+                        dotted_name(n.func.value) == recv:
+                    return True
+        return False
+
+    @staticmethod
+    def _assigned_name(stmt: ast.AST, call: ast.Call) -> Optional[str]:
+        """``X = <recv>.alloc(...)`` -> 'X' (simple Name target whose
+        value IS the alloc call)."""
+        if isinstance(stmt, ast.Assign) and stmt.value is call and \
+                len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name):
+            return stmt.targets[0].id
+        return None
+
+    # -------------------------------------------------- CFG dataflow
+    def _walk(self, res: _Resource, cfg: CFG) -> Optional[str]:
+        """DFS from the acquire's NORMAL successors; returns 'exc' /
+        'normal' for the first exit reached while still held, or None
+        when every path releases / transfers ownership."""
+        start = res.node.succ           # acquire raising = not acquired
+        seen: Set[int] = set()
+        stack = list(start)
+        while stack:
+            node = stack.pop()
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            if node.kind == "exit":
+                return "normal"
+            if node.kind == "exc_exit":
+                return "exc"
+            if res.var is not None and res.var in node.none_names:
+                continue                # statically None: not acquired
+            action = self._transfer(res, node.stmt)
+            if action == "kill":
+                continue
+            stack.extend(node.all_succ())
+        return None
+
+    def _transfer(self, res: _Resource, stmt: ast.AST) -> Optional[str]:
+        """Effect of one statement on the tracked resource."""
+        if res.var is None:
+            # lock/context: matched by receiver string
+            for call in _call_of(stmt):
+                f = call.func
+                if isinstance(f, ast.Attribute) and \
+                        f.attr in res.releasers and \
+                        dotted_name(f.value) == res.recv:
+                    return "kill"
+            return None
+        name = res.var
+        # release/free first — their args don't count as escapes
+        for call in _call_of(stmt):
+            f = call.func
+            if isinstance(f, ast.Attribute) and \
+                    f.attr in res.releasers and name in _arg_names(call):
+                return "kill"
+        # ownership transfer: returned / yielded / stored into a
+        # structure / passed to any other call
+        if isinstance(stmt, ast.Return) and stmt.value is not None and \
+                self._mentions(stmt.value, name):
+            return "kill"
+        for part in head_exprs(stmt):
+            for n in ast.walk(part):
+                if isinstance(n, (ast.Yield, ast.YieldFrom)) and \
+                        n.value is not None and \
+                        self._mentions(n.value, name):
+                    return "kill"
+        if isinstance(stmt, (ast.Assign, ast.AugAssign)):
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            value = stmt.value
+            for t in targets:
+                if isinstance(t, (ast.Attribute, ast.Subscript)) and \
+                        self._mentions(value, name):
+                    return "kill"       # stored: structure owns it now
+                if isinstance(t, ast.Name) and t.id == name and \
+                        not self._mentions(value, name):
+                    return "kill"       # rebound to something new
+                if isinstance(t, ast.Tuple):
+                    for e in t.elts:
+                        if isinstance(e, ast.Name) and e.id == name \
+                                and not self._mentions(value, name):
+                            return "kill"
+        for call in _call_of(stmt):
+            f = call.func
+            is_releaser = isinstance(f, ast.Attribute) and \
+                f.attr in res.releasers
+            if not is_releaser and name in _arg_names(call):
+                return "kill"           # callee owns it now
+        return None
+
+    @staticmethod
+    def _mentions(expr: ast.AST, name: str) -> bool:
+        return any(isinstance(n, ast.Name) and n.id == name
+                   for n in ast.walk(expr))

@@ -12,9 +12,12 @@ only finds out at runtime, on device. This analyzer finds them in CI.
 
 Entry points: functions decorated with (or passed to) ``jit`` /
 ``to_static`` / ``pjit``, plus functions named ``train_step``.
-Reachability is per module over a name-resolution call graph (bare
-calls to module functions, ``self.method`` calls), so helpers a jitted
-function calls are checked too.
+Reachability runs over the engine's REPO-WIDE call graph
+(``analysis.engine.CallGraph``): bare calls, ``self.method``,
+module-qualified calls across files, ``functools.partial(target,
+...)`` pre-binding, and lambdas/function aliases assigned to locals —
+the PR 4 per-module walker missed the last two (helpers dispatched
+through ``partial(self.m, ...)`` or a local lambda were unchecked).
 
 Rules:
   TS001  host-sync call (.numpy()/.item()/.tolist())
@@ -27,252 +30,88 @@ Rules:
 from __future__ import annotations
 
 import ast
-from collections import deque
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import List, Optional, Sequence
 
 from .core import Analyzer, Finding, SourceFile
+from .engine import (CallGraph, FuncNode, Taint, dotted_name,
+                     iter_own_body, jit_entries)
 
 __all__ = ["TracerSafetyAnalyzer"]
 
-_JIT_NAMES = {"jit", "to_static", "pjit"}
 _SYNC_ATTRS = {"numpy", "item", "tolist"}
 _CLOCK_ATTRS = {"time", "perf_counter", "monotonic", "process_time",
                 "time_ns", "perf_counter_ns", "monotonic_ns",
                 "clock_gettime"}
 
 
-def _dotted(node: ast.AST) -> Optional[str]:
-    """x.y.z attribute chain as 'x.y.z', or None."""
-    parts = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
-
-
-class _Imports(ast.NodeVisitor):
-    """alias -> fully dotted module/name, for resolving np.random etc."""
-
-    def __init__(self):
-        self.aliases: Dict[str, str] = {}
-
-    def visit_Import(self, node):
-        for a in node.names:
-            self.aliases[a.asname or a.name.split(".")[0]] = \
-                a.name if a.asname else a.name.split(".")[0]
-
-    def visit_ImportFrom(self, node):
-        if node.level:         # relative import — in-package, never
-            return             # stdlib random/time/os
-        mod = node.module or ""
-        for a in node.names:
-            self.aliases[a.asname or a.name] = f"{mod}.{a.name}"
-
-    def resolve(self, dotted: str) -> str:
-        head, _, rest = dotted.partition(".")
-        head = self.aliases.get(head, head)
-        return f"{head}.{rest}" if rest else head
-
-
-class _FuncInfo:
-    __slots__ = ("node", "qualname", "is_method", "entry_via")
-
-    def __init__(self, node, qualname, is_method):
-        self.node = node
-        self.qualname = qualname
-        self.is_method = is_method
-        self.entry_via: Optional[str] = None   # why it became an entry
-
-
-class _Collector(ast.NodeVisitor):
-    """All function defs with qualnames + jit-call-site entries."""
-
-    def __init__(self):
-        self.stack: List[str] = []
-        self.class_depth = 0
-        self.funcs: Dict[str, _FuncInfo] = {}
-        self.jit_call_args: List[Tuple[str, str]] = []  # (name, via)
-
-    def _visit_func(self, node):
-        qual = ".".join(self.stack + [node.name])
-        self.funcs[qual] = _FuncInfo(node, qual, self.class_depth > 0)
-        self.stack.append(node.name)
-        self.generic_visit(node)
-        self.stack.pop()
-
-    visit_FunctionDef = _visit_func
-    visit_AsyncFunctionDef = _visit_func
-
-    def visit_ClassDef(self, node):
-        self.stack.append(node.name)
-        self.class_depth += 1
-        self.generic_visit(node)
-        self.class_depth -= 1
-        self.stack.pop()
-
-    def visit_Call(self, node):
-        # jax.jit(fn) / to_static(fn): first positional arg that is a
-        # bare name becomes an entry point
-        via = _jit_identifier(node.func)
-        if via and node.args and isinstance(node.args[0], ast.Name):
-            self.jit_call_args.append((node.args[0].id, via))
-        self.generic_visit(node)
-
-
-def _jit_identifier(node: ast.AST) -> Optional[str]:
-    """'jit'/'to_static'/... when this expression names a jit wrapper
-    (Name, dotted attribute, or functools.partial(jax.jit, ...))."""
-    if isinstance(node, ast.Call):       # partial(jax.jit, ...)
-        for sub in [node.func] + list(node.args):
-            got = _jit_identifier(sub)
-            if got:
-                return got
-        return None
-    d = _dotted(node)
-    if d is None:
-        return None
-    last = d.split(".")[-1]
-    return last if last in _JIT_NAMES else None
-
-
-def _decorated_entry(node) -> Optional[str]:
-    for dec in node.decorator_list:
-        got = _jit_identifier(dec)
-        if got:
-            return got
-    return None
-
-
 class TracerSafetyAnalyzer(Analyzer):
     name = "tracer_safety"
 
     def run(self, files: Sequence[SourceFile]) -> List[Finding]:
-        out: List[Finding] = []
-        for sf in files:
-            out.extend(self._run_file(sf))
-        return out
-
-    # ------------------------------------------------------ per file
-    def _run_file(self, sf: SourceFile) -> List[Finding]:
-        imports = _Imports()
-        imports.visit(sf.tree)
-        coll = _Collector()
-        coll.visit(sf.tree)
-        if not coll.funcs:
-            return []
-
-        by_last: Dict[str, List[str]] = {}
-        for qual in coll.funcs:
-            by_last.setdefault(qual.split(".")[-1], []).append(qual)
-
-        entries: List[str] = []
-        for qual, info in coll.funcs.items():
-            via = _decorated_entry(info.node)
-            if via is None and info.node.name == "train_step":
-                via = "train_step"
-            if via is not None:
-                info.entry_via = via
-                entries.append(qual)
-        for name, via in coll.jit_call_args:
-            for qual in by_last.get(name, ()):
-                if coll.funcs[qual].entry_via is None:
-                    coll.funcs[qual].entry_via = via
-                    entries.append(qual)
-        if not entries:
-            return []
-
-        # reachability over bare-name and self.method calls
-        reach: Dict[str, str] = {}      # qualname -> root entry
-        work = [(q, coll.funcs[q].entry_via or "jit") for q in entries]
-        while work:
-            qual, root = work.pop()
-            if qual in reach:
-                continue
-            reach[qual] = root
-            for callee in self._callees(coll.funcs[qual].node):
-                for cq in by_last.get(callee, ()):
-                    if cq not in reach:
-                        work.append((cq, root))
-
+        graph = CallGraph(files)
+        reach = graph.reachable(jit_entries(graph))
         findings: List[Finding] = []
-        for qual, root in sorted(reach.items()):
+        for key in sorted(reach):
+            fn = graph.funcs[key]
             findings.extend(self._check_body(
-                sf, coll.funcs[qual], root, imports))
+                fn, reach[key], graph.modules[key[0]].imports))
         return findings
 
-    @staticmethod
-    def _callees(func_node) -> Set[str]:
-        """Bare and self.* call targets in this function's own body
-        (nested defs are separate functions)."""
-        out: Set[str] = set()
-        for node in _own_body_walk(func_node):
-            if not isinstance(node, ast.Call):
-                continue
-            f = node.func
-            if isinstance(f, ast.Name):
-                out.add(f.id)
-            elif isinstance(f, ast.Attribute) and \
-                    isinstance(f.value, ast.Name) and \
-                    f.value.id in ("self", "cls"):
-                out.add(f.attr)
-        return out
-
     # ------------------------------------------------------ checks
-    def _check_body(self, sf: SourceFile, info: _FuncInfo, root: str,
-                    imports: _Imports) -> List[Finding]:
-        node = info.node
-        tainted = {a.arg for a in
-                   list(node.args.posonlyargs) + list(node.args.args)
-                   + list(node.args.kwonlyargs)
-                   + ([node.args.vararg] if node.args.vararg else [])
-                   } - {"self", "cls"}
+    def _check_body(self, fn: FuncNode, root: str,
+                    imports) -> List[Finding]:
+        node = fn.node
+        taint = Taint(node)
+        # TS002's taint premise — "parameters are tracers" — only
+        # holds where jit binds the signature: the DIRECT entry. A
+        # transitively-reached helper's params are routinely host
+        # config (bool flags, op names) the caller passes statically;
+        # the impurity rules (TS001/3/4/5) stay context-free and apply
+        # everywhere reachable.
+        direct = fn.entry_via is not None
         findings: List[Finding] = []
 
         def emit(n, rule, detail, msg, severity="error"):
             findings.append(Finding(
-                self.name, rule, sf.rel, n.lineno, n.col_offset,
-                f"{msg} in {info.qualname!r} (traced via {root})",
-                symbol=info.qualname, detail=detail, severity=severity))
+                self.name, rule, fn.sf.rel, n.lineno, n.col_offset,
+                f"{msg} in {fn.qualname!r} (traced via {root})",
+                symbol=fn.qualname, detail=detail, severity=severity))
 
-        for n in _own_body_walk(node):
-            # taint propagation: x = <expr touching a tainted name>
-            if isinstance(n, ast.Assign) and _touches(n.value, tainted):
-                for t in n.targets:
-                    if isinstance(t, ast.Name):
-                        tainted.add(t.id)
+        for n in iter_own_body(node):
+            taint.note_stmt(n)
             if isinstance(n, ast.Call):
-                self._check_call(n, emit, tainted, imports)
-            if isinstance(n, (ast.If, ast.While)) and \
+                self._check_call(n, emit, taint if direct else None,
+                                 imports)
+            if direct and isinstance(n, (ast.If, ast.While)) and \
                     isinstance(n.test, ast.Name) and \
-                    n.test.id in tainted:
+                    n.test.id in taint.names:
                 emit(n.test, "TS002", f"if {n.test.id}:",
                      f"branch on traced value {n.test.id!r} — trace-"
                      f"time concretization")
             if isinstance(n, ast.Subscript) and \
                     isinstance(n.ctx, ast.Load):
-                d = _dotted(n.value)
+                d = dotted_name(n.value)
                 if d and imports.resolve(d) == "os.environ":
                     emit(n, "TS005", "os.environ[]",
                          "os.environ read freezes at trace time")
         return findings
 
-    def _check_call(self, n: ast.Call, emit, tainted, imports):
+    def _check_call(self, n: ast.Call, emit,
+                    taint: Optional[Taint], imports):
         f = n.func
         if isinstance(f, ast.Attribute):
             if f.attr in _SYNC_ATTRS and not n.args:
-                base = _dotted(f.value)
+                base = dotted_name(f.value)
                 root_seg = base.split(".")[0] if base else None
                 # module-attr calls (np.x.item) aren't value syncs;
                 # anything else (locals, self.*, call results) is
-                if root_seg is None or root_seg not in imports.aliases:
+                if root_seg is None or \
+                        root_seg not in imports.aliases:
                     emit(n, "TS001", f".{f.attr}()",
                          f".{f.attr}() forces a host sync/device "
                          f"round-trip")
                     return
-            d = _dotted(f)
+            d = dotted_name(f)
             if d is not None:
                 r = imports.resolve(d)
                 if r.startswith("random.") or \
@@ -292,32 +131,13 @@ class TracerSafetyAnalyzer(Analyzer):
                          f"{r}() environment read freezes at trace "
                          f"time")
                     return
-        elif isinstance(f, ast.Name) and f.id in ("float", "int",
-                                                  "bool") \
-                and len(n.args) == 1:
+        elif taint is not None and isinstance(f, ast.Name) and \
+                f.id in ("float", "int", "bool") and len(n.args) == 1:
             a = n.args[0]
             name = a.id if isinstance(a, ast.Name) else \
-                (_dotted(a) if isinstance(a, ast.Attribute) else None)
+                (dotted_name(a) if isinstance(a, ast.Attribute)
+                 else None)
             root_name = (name or "").split(".")[0]
-            if root_name in tainted:
+            if root_name in taint.names:
                 emit(n, "TS002", f"{f.id}({name})",
                      f"{f.id}() concretizes traced value {name!r}")
-
-
-def _own_body_walk(func_node):
-    """Pre-order, SOURCE-ORDER walk of this function's own body (taint
-    propagation needs assignments seen before later uses) — nested
-    function defs are separate call-graph nodes, not descended into."""
-    queue = deque(func_node.body)
-    while queue:
-        n = queue.popleft()
-        yield n
-        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
-                          ast.Lambda)):
-            continue
-        queue.extendleft(reversed(list(ast.iter_child_nodes(n))))
-
-
-def _touches(expr: ast.AST, names: Set[str]) -> bool:
-    return any(isinstance(n, ast.Name) and n.id in names
-               for n in ast.walk(expr))

@@ -1014,16 +1014,29 @@ class GenerationServer:
                         pages = self.kv.alloc(need)
                 if pages is None:
                     break       # FIFO head-of-line until pages free up
-                self.kv.retain(shared)
-                if self.prefix is not None:
-                    self.prefix.note_admission(matched)
-                    if matched:
-                        self.metrics.observe_prefix_hit(matched)
-                self._queue.popleft()
-                slot = free_slots.pop(0)
-                seq = _ActiveSeq(req, slot, shared + pages, max_total,
-                                 prefix_len=matched)
-                self._slots[slot] = seq
+                # exception barrier (pdlint RP001): between taking the
+                # reservation and publishing it into self._slots no
+                # failure may keep the references — a leaked page never
+                # returns to the free list and admission wedges once
+                # the pool drains
+                try:
+                    self.kv.retain(shared)
+                except BaseException:
+                    self.kv.release(pages)
+                    raise
+                try:
+                    if self.prefix is not None:
+                        self.prefix.note_admission(matched)
+                        if matched:
+                            self.metrics.observe_prefix_hit(matched)
+                    self._queue.popleft()
+                    slot = free_slots.pop(0)
+                    seq = _ActiveSeq(req, slot, shared + pages,
+                                     max_total, prefix_len=matched)
+                    self._slots[slot] = seq
+                except BaseException:
+                    self.kv.release(shared + pages)
+                    raise
                 self._tables[slot, :] = 0
                 self._tables[slot, :len(seq.pages)] = seq.pages
                 admitted.append(seq)

@@ -1,0 +1,866 @@
+"""pdlint v2: interprocedural engine + DS/RR/RP analyzer self-tests.
+
+Covers the engine primitives (repo-wide call graph, per-function CFG
+with exception edges), the three production-correctness analyzers
+(donation_safety DS001-2, recompile_risk RR001-3, resource_pairing
+RP001-3), the tracer-safety interprocedural upgrades
+(functools.partial / lambda-local / cross-module edges), and the CLI
+surface (--sarif, --changed-only, the baseline ratchet, exit codes).
+Synthetic modules carry deliberate violations, hence:
+"""
+# pdlint: disable=flag_consistency,resource_pairing,donation_safety,recompile_risk
+import io
+import json
+import os
+import subprocess
+import textwrap
+import time
+from contextlib import redirect_stderr, redirect_stdout
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+try:
+    from paddle_tpu import analysis
+    from paddle_tpu.analysis import (DonationSafetyAnalyzer,
+                                     RecompileRiskAnalyzer,
+                                     ResourcePairingAnalyzer,
+                                     TracerSafetyAnalyzer)
+    from paddle_tpu.analysis import engine as eng
+except Exception as e:  # noqa: BLE001 - mirror the main gate's skip
+    pytest.skip(f"repo root not importable, pdlint gate skipped: {e!r}",
+                allow_module_level=True)
+
+pytestmark = pytest.mark.pdlint
+
+
+def _write(base, relpath, source):
+    p = base
+    parts = relpath.split("/")
+    for d in parts[:-1]:
+        p = p / d
+        p.mkdir(exist_ok=True)
+    f = p / parts[-1]
+    f.write_text(textwrap.dedent(source))
+    return str(f)
+
+
+def _run(tmp_path, analyzers, **kw):
+    return analysis.run_analyzers([str(tmp_path)], analyzers,
+                                  root=str(tmp_path), **kw)
+
+
+def _graph(tmp_path):
+    files = analysis.parse_files(
+        analysis.iter_python_files([str(tmp_path)]), root=str(tmp_path))
+    return eng.CallGraph(files)
+
+
+# ===================================================================
+# 1. call graph
+# ===================================================================
+class TestCallGraph:
+    def test_cross_module_resolution(self, tmp_path):
+        _write(tmp_path, "pkg/__init__.py", "")
+        _write(tmp_path, "pkg/a.py", """
+            from .b import helper
+            from . import b
+
+            def top(x):
+                helper(x)
+                b.other(x)
+        """)
+        _write(tmp_path, "pkg/b.py", """
+            def helper(v):
+                return v
+
+            def other(v):
+                return v
+        """)
+        cg = _graph(tmp_path)
+        edges = cg.edges[("pkg/a.py", "top")]
+        assert ("pkg/b.py", "helper") in edges
+        assert ("pkg/b.py", "other") in edges
+
+    def test_partial_lambda_alias_and_thread_edges(self, tmp_path):
+        _write(tmp_path, "m.py", """
+            import functools
+            import threading
+
+            class C:
+                def work(self):
+                    pass
+
+                def spawn(self):
+                    threading.Thread(target=self.work).start()
+
+                def bind(self):
+                    fn = functools.partial(self.work, 1)
+                    return fn
+
+            def callee(x):
+                return x
+
+            def caller(x):
+                h = lambda v: callee(v)
+                g = callee
+                return h(x), g
+        """)
+        cg = _graph(tmp_path)
+        assert ("m.py", "C.work") in cg.edges[("m.py", "C.spawn")]
+        assert ("m.py", "C.work") in cg.edges[("m.py", "C.bind")]
+        assert ("m.py", "caller.h") in cg.edges[("m.py", "caller")]
+        assert ("m.py", "callee") in cg.edges[("m.py", "caller.h")]
+
+    def test_reachability_attribution(self, tmp_path):
+        _write(tmp_path, "m.py", """
+            def a():
+                b()
+
+            def b():
+                pass
+
+            def island():
+                pass
+        """)
+        cg = _graph(tmp_path)
+        reach = cg.reachable([(("m.py", "a"), "root")])
+        assert reach == {("m.py", "a"): "root", ("m.py", "b"): "root"}
+
+
+# ===================================================================
+# 2. CFG exception edges (via RP001 observable behavior)
+# ===================================================================
+class TestExceptionEdges:
+    SRC_NO_FINALLY = """
+        def leaky(kv, n):
+            pages = kv.alloc(n)
+            do_risky_work()
+            kv.release(pages)
+    """
+    SRC_WITH_FINALLY = """
+        def safe(kv, n):
+            pages = kv.alloc(n)
+            try:
+                do_risky_work()
+            finally:
+                kv.release(pages)
+    """
+
+    def test_finding_present_without_finally(self, tmp_path):
+        _write(tmp_path, "paddle_tpu/m.py", self.SRC_NO_FINALLY)
+        found = _run(tmp_path, [ResourcePairingAnalyzer()])
+        assert [(f.rule, f.symbol) for f in found] == \
+            [("RP001", "leaky")]
+        assert "exception path" in found[0].message
+
+    def test_finding_absent_with_finally(self, tmp_path):
+        _write(tmp_path, "paddle_tpu/m.py", self.SRC_WITH_FINALLY)
+        assert _run(tmp_path, [ResourcePairingAnalyzer()]) == []
+
+    def test_except_handler_release_is_clean(self, tmp_path):
+        _write(tmp_path, "paddle_tpu/m.py", """
+            def guarded(kv, n):
+                pages = kv.alloc(n)
+                try:
+                    do_risky_work()
+                except Exception:
+                    kv.release(pages)
+                    raise
+                kv.free(pages)
+        """)
+        assert _run(tmp_path, [ResourcePairingAnalyzer()]) == []
+
+    def test_none_branch_kills_tracking(self, tmp_path):
+        _write(tmp_path, "paddle_tpu/m.py", """
+            def all_or_nothing(kv, n):
+                pages = kv.alloc(n)
+                if pages is None:
+                    return None
+                holder.adopt(pages)
+        """)
+        assert _run(tmp_path, [ResourcePairingAnalyzer()]) == []
+
+    def test_early_return_leaks_on_normal_path(self, tmp_path):
+        _write(tmp_path, "paddle_tpu/m.py", """
+            def early(kv, n, cond):
+                pages = kv.alloc(n)
+                if cond:
+                    return 0
+                kv.release(pages)
+        """)
+        found = _run(tmp_path, [ResourcePairingAnalyzer()])
+        assert [(f.rule, f.detail) for f in found] == \
+            [("RP001", "pages:pages")]
+
+
+# ===================================================================
+# 3. donation safety
+# ===================================================================
+class TestDonationSafety:
+    def test_ds001_read_after_donate(self, tmp_path):
+        _write(tmp_path, "paddle_tpu/m.py", """
+            import jax
+
+            def loop(state, batch):
+                fn = jax.jit(step, donate_argnums=(0,))
+                out = fn(state, batch)
+                return state.sum() + out      # DS001: state is gone
+        """)
+        found = _run(tmp_path, [DonationSafetyAnalyzer()])
+        assert [(f.rule, f.detail) for f in found] == \
+            [("DS001", "fn:arg0:state")]
+
+    def test_rebind_idiom_is_clean(self, tmp_path):
+        _write(tmp_path, "paddle_tpu/m.py", """
+            import jax
+
+            def loop(state, batches):
+                fn = jax.jit(step, donate_argnums=(0,))
+                for batch in batches:
+                    state = fn(state, batch)
+                return state
+        """)
+        assert _run(tmp_path, [DonationSafetyAnalyzer()]) == []
+
+    def test_ds002_self_attr_outlives(self, tmp_path):
+        _write(tmp_path, "paddle_tpu/m.py", """
+            import jax
+
+            class Stepper:
+                def __init__(self, step):
+                    self._fn = jax.jit(step, donate_argnums=(0,))
+                    self._state = None
+
+                def tick(self, batch):
+                    out = self._fn(self._state, batch)   # DS002
+                    return out
+        """)
+        found = _run(tmp_path, [DonationSafetyAnalyzer()])
+        assert [(f.rule, f.symbol, f.detail) for f in found] == \
+            [("DS002", "Stepper.tick", "self._fn:arg0:self._state")]
+
+    def test_ds002_clean_when_rebound_every_path(self, tmp_path):
+        _write(tmp_path, "paddle_tpu/m.py", """
+            import jax
+
+            class Stepper:
+                def __init__(self, step):
+                    self._fn = jax.jit(step, donate_argnums=(0,))
+                    self._state = None
+
+                def tick(self, batch):
+                    loss, self._state = self._fn(self._state, batch)
+                    return loss
+        """)
+        assert _run(tmp_path, [DonationSafetyAnalyzer()]) == []
+
+    def test_conditional_donate_tuple_resolves(self, tmp_path):
+        """The TrainStep idiom: donate = (0, 2) if flag else ()."""
+        _write(tmp_path, "paddle_tpu/m.py", """
+            import jax
+
+            def build(flag, params, opt, batch):
+                donate = (0, 2) if flag else ()
+                fn = jax.jit(step, donate_argnums=donate)
+                loss = fn(params, batch, opt)
+                return loss, params.copy()     # DS001 on params
+        """)
+        found = _run(tmp_path, [DonationSafetyAnalyzer()])
+        assert ("DS001", "fn:arg0:params") in \
+            {(f.rule, f.detail) for f in found}
+
+
+# ===================================================================
+# 4. recompile risk
+# ===================================================================
+class TestRecompileRisk:
+    def test_rr001_unrouted_aot_site(self, tmp_path):
+        _write(tmp_path, "paddle_tpu/serving/m.py", """
+            import jax
+
+            def warm(fn, spec):
+                return jax.jit(fn).lower(spec).compile()   # RR001
+        """)
+        found = _run(tmp_path, [RecompileRiskAnalyzer()])
+        assert [(f.rule, f.symbol) for f in found] == \
+            [("RR001", "warm")]
+
+    def test_rr001_routed_site_is_clean(self, tmp_path):
+        _write(tmp_path, "paddle_tpu/serving/m.py", """
+            import jax
+
+            def warm(cache, key, fn, spec):
+                def build():
+                    return jax.jit(fn).lower(spec).compile()
+                out, hit = cache.get_or_compile(key, build)
+                return out
+        """)
+        assert _run(tmp_path, [RecompileRiskAnalyzer()]) == []
+
+    def test_rr001_out_of_scope_dirs_skipped(self, tmp_path):
+        _write(tmp_path, "paddle_tpu/ops/m.py", """
+            import jax
+
+            def bench(fn, spec):
+                return jax.jit(fn).lower(spec).compile()
+        """)
+        assert _run(tmp_path, [RecompileRiskAnalyzer()]) == []
+
+    def test_rr002_raw_len_into_jitted_call(self, tmp_path):
+        _write(tmp_path, "paddle_tpu/serving/m.py", """
+            import jax
+
+            def dispatch(batch):
+                fn = jax.jit(kernel)
+                n = len(batch)
+                return fn(batch, n)           # RR002: unbucketed
+        """)
+        found = _run(tmp_path, [RecompileRiskAnalyzer()])
+        assert [(f.rule, f.detail) for f in found] == \
+            [("RR002", "fn:arg1:len(batch)")]
+
+    def test_rr002_bucketed_size_is_clean(self, tmp_path):
+        _write(tmp_path, "paddle_tpu/serving/m.py", """
+            import jax
+
+            def dispatch(policy, batch):
+                fn = jax.jit(kernel)
+                n = policy.bucket_batch(len(batch))
+                return fn(batch, n)
+        """)
+        assert _run(tmp_path, [RecompileRiskAnalyzer()]) == []
+
+    def test_rr003_set_iteration_in_traced_fn(self, tmp_path):
+        _write(tmp_path, "m.py", """
+            import jax
+
+            @jax.jit
+            def gather(tree):
+                keys = {"w", "b"}
+                return [tree[k] for k in keys]    # RR003
+        """)
+        found = _run(tmp_path, [RecompileRiskAnalyzer()])
+        assert [(f.rule, f.symbol) for f in found] == \
+            [("RR003", "gather")]
+
+    def test_rr003_sorted_iteration_is_clean(self, tmp_path):
+        _write(tmp_path, "m.py", """
+            import jax
+
+            @jax.jit
+            def gather(tree):
+                keys = {"w", "b"}
+                return [tree[k] for k in sorted(keys)]
+        """)
+        assert _run(tmp_path, [RecompileRiskAnalyzer()]) == []
+
+    def test_rr003_untraced_set_iteration_not_flagged(self, tmp_path):
+        _write(tmp_path, "m.py", """
+            def host_side(tree):
+                keys = {"w", "b"}
+                return [tree[k] for k in keys]
+        """)
+        assert _run(tmp_path, [RecompileRiskAnalyzer()]) == []
+
+
+# ===================================================================
+# 5. resource pairing (lock / context rules)
+# ===================================================================
+class TestResourcePairing:
+    def test_rp002_bare_acquire_with_branchy_release(self, tmp_path):
+        _write(tmp_path, "paddle_tpu/m.py", """
+            def racy(lock, cond):
+                lock.acquire()
+                if cond:
+                    return 0              # RP002: held at this exit
+                lock.release()
+        """)
+        found = _run(tmp_path, [ResourcePairingAnalyzer()])
+        assert [(f.rule, f.symbol) for f in found] == \
+            [("RP002", "racy")]
+
+    def test_rp002_with_statement_is_clean(self, tmp_path):
+        _write(tmp_path, "paddle_tpu/m.py", """
+            def fine(lock, cond):
+                with lock:
+                    if cond:
+                        return 0
+                return 1
+        """)
+        assert _run(tmp_path, [ResourcePairingAnalyzer()]) == []
+
+    def test_rp002_all_path_release_is_clean(self, tmp_path):
+        _write(tmp_path, "paddle_tpu/m.py", """
+            def fine(lock):
+                lock.acquire()
+                try:
+                    work()
+                finally:
+                    lock.release()
+        """)
+        assert _run(tmp_path, [ResourcePairingAnalyzer()]) == []
+
+    def test_rp003_enter_without_exit(self, tmp_path):
+        _write(tmp_path, "paddle_tpu/m.py", """
+            def manual(span):
+                span.__enter__()
+                work()                     # RP003: may raise, no exit
+                span.__exit__(None, None, None)
+        """)
+        found = _run(tmp_path, [ResourcePairingAnalyzer()])
+        assert [(f.rule, f.symbol) for f in found] == \
+            [("RP003", "manual")]
+
+    def test_rp003_delegating_enter_is_clean(self, tmp_path):
+        """`return ctx.__enter__()` hands the pairing to the caller —
+        the autograd/profiler delegation protocol."""
+        _write(tmp_path, "paddle_tpu/m.py", """
+            class Guard:
+                def __enter__(self):
+                    ctx = make_ctx()
+                    return ctx.__enter__()
+
+                def __exit__(self, *exc):
+                    return None
+        """)
+        assert _run(tmp_path, [ResourcePairingAnalyzer()]) == []
+
+    def test_rp003_cross_method_pairing_is_clean(self, tmp_path):
+        """begin/end protocol: __exit__ called on the same self attr
+        elsewhere in the class."""
+        _write(tmp_path, "paddle_tpu/m.py", """
+            class Span:
+                def begin(self):
+                    self._ctx = make_ctx()
+                    self._ctx.__enter__()
+
+                def end(self):
+                    self._ctx.__exit__(None, None, None)
+        """)
+        assert _run(tmp_path, [ResourcePairingAnalyzer()]) == []
+
+    def test_scope_excludes_tests_tree(self, tmp_path):
+        """Tests deliberately leak (tripwire assertions) — out of
+        scope by default."""
+        _write(tmp_path, "tests/m.py", """
+            def leaky(kv, n):
+                pages = kv.alloc(n)
+                do_risky_work()
+                kv.release(pages)
+        """)
+        assert _run(tmp_path, [ResourcePairingAnalyzer()]) == []
+
+
+# ===================================================================
+# 6. tracer safety: interprocedural upgrades
+# ===================================================================
+class TestTracerSafetyInterprocedural:
+    def test_partial_self_method_is_followed(self, tmp_path):
+        """PR 4 false negative: a helper dispatched through
+        functools.partial(self.m, ...) went unchecked."""
+        _write(tmp_path, "m.py", """
+            import functools
+            import time
+            import jax
+
+            class Trainer:
+                @jax.jit
+                def step(self, x):
+                    fn = functools.partial(self._impure, 2)
+                    return fn(x)
+
+                def _impure(self, k, x):
+                    return x * time.time() * k     # TS004
+        """)
+        found = _run(tmp_path, [TracerSafetyAnalyzer()])
+        assert [(f.rule, f.symbol) for f in found] == \
+            [("TS004", "Trainer._impure")]
+
+    def test_lambda_assigned_to_local_is_followed(self, tmp_path):
+        """PR 4 false negative: lambdas bound to locals were invisible
+        to the call graph."""
+        _write(tmp_path, "m.py", """
+            import time
+            import jax
+
+            @jax.jit
+            def entry(x):
+                h = lambda v: v + time.perf_counter()    # TS004
+                return h(x)
+        """)
+        found = _run(tmp_path, [TracerSafetyAnalyzer()])
+        assert {(f.rule, f.symbol) for f in found} == \
+            {("TS004", "entry.h")}
+
+    def test_cross_module_helper_is_reached(self, tmp_path):
+        _write(tmp_path, "pkg/__init__.py", "")
+        _write(tmp_path, "pkg/hot.py", """
+            import jax
+
+            from .util import helper
+
+            @jax.jit
+            def entry(x):
+                return helper(x)
+        """)
+        _write(tmp_path, "pkg/util.py", """
+            import time
+
+            def helper(x):
+                return x + time.monotonic()     # TS004, other module
+
+            def cold(x):
+                return time.time()              # unreachable: clean
+        """)
+        found = _run(tmp_path, [TracerSafetyAnalyzer()])
+        assert [(f.rule, f.path, f.symbol) for f in found] == \
+            [("TS004", "pkg/util.py", "helper")]
+
+    def test_transitive_helper_branch_on_config_not_flagged(
+            self, tmp_path):
+        """TS002's params-are-tracers premise only holds at the direct
+        entry; a reached helper branching on a bool flag is host
+        config, not concretization."""
+        _write(tmp_path, "m.py", """
+            import jax
+
+            @jax.jit
+            def entry(x, flag):
+                if flag:                        # TS002: direct entry
+                    x = x + 1
+                return helper(x, True)
+
+            def helper(x, enable):
+                if enable:                      # config branch: clean
+                    return x * 2
+                return x
+        """)
+        found = _run(tmp_path, [TracerSafetyAnalyzer()])
+        assert [(f.rule, f.symbol) for f in found] == \
+            [("TS002", "entry")]
+
+
+# ===================================================================
+# 7. every new rule flips the CLI exit code
+# ===================================================================
+def _pdlint_main():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "pdlint_under_test",
+        os.path.join(REPO_ROOT, "tools", "pdlint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.main
+
+
+_RULE_SOURCES = {
+    "DS001": ("paddle_tpu/m.py", """
+        import jax
+
+        def loop(state, batch):
+            fn = jax.jit(step, donate_argnums=(0,))
+            out = fn(state, batch)
+            return state.sum() + out
+    """),
+    "DS002": ("paddle_tpu/m.py", """
+        import jax
+
+        class S:
+            def __init__(self, step):
+                self._fn = jax.jit(step, donate_argnums=(0,))
+
+            def tick(self, batch):
+                return self._fn(self._state, batch)
+    """),
+    "RR001": ("paddle_tpu/serving/m.py", """
+        import jax
+
+        def warm(fn, spec):
+            return jax.jit(fn).lower(spec).compile()
+    """),
+    "RR002": ("paddle_tpu/serving/m.py", """
+        import jax
+
+        def dispatch(batch):
+            fn = jax.jit(kernel)
+            return fn(batch, len(batch))
+    """),
+    "RR003": ("paddle_tpu/m.py", """
+        import jax
+
+        @jax.jit
+        def gather(tree):
+            keys = {"w", "b"}
+            return [tree[k] for k in keys]
+    """),
+    "RP001": ("paddle_tpu/m.py", """
+        def leaky(kv, n):
+            pages = kv.alloc(n)
+            do_risky_work()
+            kv.release(pages)
+    """),
+    "RP002": ("paddle_tpu/m.py", """
+        def racy(lock, cond):
+            lock.acquire()
+            if cond:
+                return 0
+            lock.release()
+    """),
+    "RP003": ("paddle_tpu/m.py", """
+        def manual(span):
+            span.__enter__()
+            work()
+            span.__exit__(None, None, None)
+    """),
+}
+
+
+class TestExitCodes:
+    @pytest.mark.parametrize("rule", sorted(_RULE_SOURCES))
+    def test_injected_violation_flips_exit_code(self, tmp_path, rule):
+        relpath, src = _RULE_SOURCES[rule]
+        _write(tmp_path, relpath, src)
+        main = _pdlint_main()
+        out = io.StringIO()
+        with redirect_stdout(out), redirect_stderr(io.StringIO()):
+            rc = main([str(tmp_path), "--json", "--no-baseline"])
+        assert rc == 1
+        doc = json.loads(out.getvalue())
+        assert rule in {f["rule"] for f in doc["findings"]}, \
+            doc["findings"]
+
+
+# ===================================================================
+# 8. fingerprint stability under line drift
+# ===================================================================
+class TestFingerprints:
+    @pytest.mark.parametrize("rule", ["DS001", "RR001", "RP001"])
+    def test_fingerprint_survives_line_drift(self, tmp_path, rule):
+        relpath, src = _RULE_SOURCES[rule]
+        analyzers = [DonationSafetyAnalyzer(), RecompileRiskAnalyzer(),
+                     ResourcePairingAnalyzer()]
+        _write(tmp_path, relpath, src)
+        before = [f for f in _run(tmp_path, analyzers)
+                  if f.rule == rule]
+        _write(tmp_path, relpath,
+               "# drift\n# drift\n" + textwrap.dedent(src))
+        after = [f for f in _run(tmp_path, analyzers)
+                 if f.rule == rule]
+        assert [f.fingerprint for f in before] == \
+            [f.fingerprint for f in after]
+        assert before[0].line != after[0].line
+
+
+# ===================================================================
+# 9. SARIF output
+# ===================================================================
+class TestSarif:
+    def test_sarif_document_shape_and_exit_code(self, tmp_path):
+        relpath, src = _RULE_SOURCES["RP001"]
+        _write(tmp_path, relpath, src)
+        main = _pdlint_main()
+        out = io.StringIO()
+        with redirect_stdout(out), redirect_stderr(io.StringIO()):
+            rc = main([str(tmp_path), "--sarif", "--no-baseline"])
+        assert rc == 1
+        doc = json.loads(out.getvalue())
+        assert doc["version"] == "2.1.0"
+        assert doc["$schema"].endswith("sarif-schema-2.1.0.json")
+        run = doc["runs"][0]
+        assert run["tool"]["driver"]["name"] == "pdlint"
+        rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+        results = run["results"]
+        assert results, "no SARIF results for an injected violation"
+        for res in results:
+            assert res["ruleId"] in rule_ids
+            assert res["level"] in ("error", "warning")
+            assert res["baselineState"] == "new"
+            loc = res["locations"][0]["physicalLocation"]
+            assert loc["artifactLocation"]["uri"]
+            assert loc["region"]["startLine"] >= 1
+            assert res["partialFingerprints"]["pdlint/v1"]
+
+    def test_sarif_clean_tree_exits_zero(self, tmp_path):
+        _write(tmp_path, "ok.py", "x = 1\n")
+        main = _pdlint_main()
+        out = io.StringIO()
+        with redirect_stdout(out):
+            rc = main([str(tmp_path), "--sarif", "--no-baseline"])
+        assert rc == 0
+        assert json.loads(out.getvalue())["runs"][0]["results"] == []
+
+    def test_sarif_marks_baselined_unchanged(self, tmp_path):
+        relpath, src = _RULE_SOURCES["RP001"]
+        _write(tmp_path, relpath, src)
+        bl = str(tmp_path / "bl.json")
+        main = _pdlint_main()
+        out = io.StringIO()
+        with redirect_stdout(out):
+            assert main([str(tmp_path), "--baseline", bl,
+                         "--write-baseline"]) == 0
+        out = io.StringIO()
+        with redirect_stdout(out):
+            rc = main([str(tmp_path), "--sarif", "--baseline", bl])
+        assert rc == 0
+        states = {r["baselineState"] for r in
+                  json.loads(out.getvalue())["runs"][0]["results"]}
+        assert states == {"unchanged"}
+
+
+# ===================================================================
+# 10. incremental (--changed-only) + ratchet
+# ===================================================================
+class TestChangedOnly:
+    def test_changed_files_against_git(self, tmp_path):
+        git = lambda *a: subprocess.run(  # noqa: E731
+            ["git", *a], cwd=tmp_path, capture_output=True, text=True)
+        if git("init").returncode != 0:
+            pytest.skip("git unavailable")
+        git("config", "user.email", "t@t")
+        git("config", "user.name", "t")
+        (tmp_path / "a.py").write_text("x = 1\n")
+        (tmp_path / "b.py").write_text("y = 1\n")
+        git("add", ".")
+        git("commit", "-m", "seed")
+        (tmp_path / "b.py").write_text("y = 2\n")
+        (tmp_path / "c.py").write_text("z = 1\n")     # untracked
+        changed = analysis.changed_files("HEAD", str(tmp_path))
+        assert changed == {"b.py", "c.py"}
+        assert analysis.changed_files("no-such-ref",
+                                      str(tmp_path)) is None
+
+    def test_changed_only_filters_findings(self, tmp_path,
+                                           monkeypatch):
+        """A finding is reported iff its file is in the diff."""
+        relpath, src = _RULE_SOURCES["RP001"]
+        _write(tmp_path, relpath, src)
+        _write(tmp_path, "paddle_tpu/other.py", """
+            def also_leaky(kv, n):
+                pages = kv.alloc(n)
+                do_risky_work()
+                kv.release(pages)
+        """)
+        main = _pdlint_main()
+
+        def fake_changed(ref, root):
+            assert ref == "origin/main"
+            # repo-relative form of ONE of the two tmp files
+            return {os.path.relpath(
+                os.path.join(str(tmp_path), "paddle_tpu", "other.py"),
+                REPO_ROOT).replace(os.sep, "/")}
+
+        monkeypatch.setattr(analysis, "changed_files", fake_changed)
+        out = io.StringIO()
+        with redirect_stdout(out), redirect_stderr(io.StringIO()):
+            rc = main([str(tmp_path), "--json", "--no-baseline",
+                       "--changed-only", "origin/main"])
+        assert rc == 1
+        doc = json.loads(out.getvalue())
+        assert doc["counts"]["new"] == 1
+        assert all(f["path"].endswith("other.py")
+                   for f in doc["findings"])
+
+
+class TestRatchet:
+    STALE = {"fingerprint": "TS004:ghost.py:gone:time.time",
+             "rule": "TS004", "path": "ghost.py", "symbol": "gone",
+             "severity": "error", "message": "synthetic stale entry"}
+
+    def test_run_project_reports_stale_entries(self, tmp_path):
+        bl = analysis.load_baseline(
+            analysis.default_baseline_path(REPO_ROOT))
+        data = {"version": 1, "tool": "pdlint",
+                "findings": list(bl.values()) + [self.STALE]}
+        stale_path = tmp_path / "stale_bl.json"
+        stale_path.write_text(json.dumps(data))
+        res = analysis.run_project(root=REPO_ROOT,
+                                   baseline_path=str(stale_path))
+        assert res["stale"] == [self.STALE["fingerprint"]]
+        assert not res["new"]
+
+    def test_cli_ratchet_fails_on_stale_entry(self, tmp_path):
+        bl = analysis.load_baseline(
+            analysis.default_baseline_path(REPO_ROOT))
+        data = {"version": 1, "tool": "pdlint",
+                "findings": list(bl.values()) + [self.STALE]}
+        stale_path = tmp_path / "stale_bl.json"
+        stale_path.write_text(json.dumps(data))
+        main = _pdlint_main()
+        out, err = io.StringIO(), io.StringIO()
+        with redirect_stdout(out), redirect_stderr(err):
+            rc = main(["--baseline", str(stale_path)])
+        assert rc == 1
+        assert "RATCHET" in err.getvalue()
+        assert self.STALE["fingerprint"] in err.getvalue()
+        # --no-ratchet downgrades it back to clean
+        with redirect_stdout(io.StringIO()):
+            assert main(["--baseline", str(stale_path),
+                         "--no-ratchet"]) == 0
+
+
+# ===================================================================
+# 11. gen_api_golden keeps refusing to regen on new findings
+# ===================================================================
+class TestGoldenGate:
+    def _gate(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "gen_api_golden_under_test",
+            os.path.join(REPO_ROOT, "tools", "gen_api_golden.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.pdlint_gate
+
+    def test_refuses_on_new_findings(self, monkeypatch):
+        """The golden must never lock in an API surface while pdlint
+        reports non-baselined findings — pinned against the --json v2
+        schema the expanded analyzer set emits."""
+        gate = self._gate()
+
+        class R:
+            returncode = 1
+            stderr = ""
+            stdout = json.dumps({
+                "counts": {"total": 3, "new": 2, "stale": 0},
+                "new": ["DS001:x.py:f:fn:arg0:state",
+                        "RP001:y.py:g:pages:pages"],
+            })
+
+        monkeypatch.setattr(subprocess, "run",
+                            lambda *a, **k: R())
+        with pytest.raises(SystemExit) as exc:
+            gate()
+        assert "2 non-baselined" in str(exc.value)
+
+    def test_passes_on_clean_report(self, monkeypatch, capsys):
+        gate = self._gate()
+
+        class R:
+            returncode = 0
+            stderr = ""
+            stdout = json.dumps({"counts": {"total": 1, "new": 0,
+                                            "stale": 0}, "new": []})
+
+        monkeypatch.setattr(subprocess, "run",
+                            lambda *a, **k: R())
+        gate()
+        assert "clean" in capsys.readouterr().out
+
+
+# ===================================================================
+# 12. runtime budget: the whole gate stays tier-1 fast
+# ===================================================================
+class TestRuntimeBudget:
+    BUDGET_S = 60.0
+
+    def test_full_repo_run_under_budget(self):
+        t0 = time.perf_counter()
+        res = analysis.run_project(root=REPO_ROOT)
+        dt = time.perf_counter() - t0
+        assert not res["new"], [f.format() for f in res["new"]]
+        assert dt < self.BUDGET_S, (
+            f"full pdlint run took {dt:.1f}s (budget "
+            f"{self.BUDGET_S}s) — the interprocedural engine must "
+            f"stay cheap enough for tier-1")

@@ -578,3 +578,81 @@ class TestStatusz:
                     "kv_leak_check"]["ok"]
             finally:
                 pass
+
+
+# ------------------------------------------- admission exception safety
+class TestAdmissionExceptionSafety:
+    """Regression for the pdlint RP001 finding (pdlint v2): an
+    exception raised between taking the page reservation and
+    publishing it into ``self._slots`` leaked the pages — they never
+    returned to the free list, so the pool drained request by request
+    until admission wedged forever. The admission path now releases
+    every reference on its exception paths."""
+
+    def _server(self):
+        m, _ = make_model()
+        return GenerationServer(m, max_batch=2, page_size=4,
+                                max_seq_len=16, prefix_cache=True,
+                                name="adm-exc", start=False)
+
+    def test_prefix_accounting_failure_releases_reservation(self):
+        srv = self._server()
+        srv.submit_generate([1, 2, 3], max_new_tokens=4)
+        free0 = srv.kv.free_pages
+
+        def boom(matched):
+            raise RuntimeError("index corrupted")
+
+        srv.prefix.note_admission = boom
+        with pytest.raises(RuntimeError, match="index corrupted"):
+            srv._admit_and_prefill()
+        del srv.prefix.note_admission   # restore the class method
+        assert srv.kv.free_pages == free0, \
+            "admission failure leaked KV pages"
+        srv.kv.assert_no_leaks()
+        assert all(s is None for s in srv._slots)
+        srv.shutdown(drain=False)
+
+    def test_retain_failure_releases_fresh_pages(self):
+        srv = self._server()
+        srv.submit_generate([1, 2, 3], max_new_tokens=4)
+        free0 = srv.kv.free_pages
+
+        def boom(pages):
+            raise RuntimeError("retain blew up")
+
+        srv.kv.retain = boom
+        with pytest.raises(RuntimeError, match="retain blew up"):
+            srv._admit_and_prefill()
+        del srv.kv.retain               # restore the class method
+        assert srv.kv.free_pages == free0, \
+            "retain failure leaked the fresh allocation"
+        srv.kv.assert_no_leaks()
+        srv.shutdown(drain=False)
+
+    def test_admission_still_works_after_recovered_failure(self):
+        """The barrier returns the pool to a state a later admission
+        can use: after one rigged failure, the same request admits
+        cleanly once the fault clears."""
+        srv = self._server()
+        srv.submit_generate([1, 2, 3], max_new_tokens=2)
+        calls = {"n": 0}
+        real = srv.prefix.note_admission
+
+        def flaky(matched):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("transient")
+            return real(matched)
+
+        srv.prefix.note_admission = flaky
+        with pytest.raises(RuntimeError):
+            srv._admit_and_prefill()
+        srv.kv.assert_no_leaks()
+        srv.start()
+        try:
+            toks = srv.generate([1, 2, 3], max_new_tokens=2)
+            assert len(toks) == 2
+        finally:
+            srv.shutdown()
+        srv.kv.assert_no_leaks()

@@ -7,16 +7,30 @@ style gates. Usage:
     python tools/pdlint.py                     # whole repo, text output
     python tools/pdlint.py paddle_tpu/serving  # a subtree
     python tools/pdlint.py --json              # machine-readable
+    python tools/pdlint.py --sarif             # SARIF 2.1.0 document
+    python tools/pdlint.py --changed-only origin/main   # incremental
     python tools/pdlint.py --analyzers flag_consistency
     python tools/pdlint.py --write-baseline    # re-baseline (after review!)
     python tools/pdlint.py --dump-flags        # runtime flags_snapshot()
 
 Findings already recorded in tests/fixtures/pdlint_baseline.json are
-reported as baselined and do NOT fail the run. Exit codes: 0 = clean
-against the baseline, 1 = new findings, 2 = usage/internal error.
+reported as baselined and do NOT fail the run. The baseline is a
+RATCHET: a full default-tree run also fails when the baseline contains
+fingerprints the repo no longer produces — fixed findings must be
+pruned (--write-baseline does), so the file only ever shrinks.
+
+``--changed-only REF`` still ANALYZES the whole tree (the engine's
+call graph is interprocedural — a caller two files away can change
+what is reachable) but REPORTS only findings in files changed vs the
+git ref, plus untracked files. The ratchet is skipped in this mode:
+a partial report cannot prove an entry stale.
+
+Exit codes: 0 = clean against the baseline, 1 = new findings or stale
+baseline entries, 2 = usage/internal error.
 
 The CI twin is tests/test_static_analysis.py — it runs the same
-analyzers over the same trees and fails on any non-baselined finding.
+analyzers over the same trees and fails on any non-baselined finding
+and on any stale baseline entry.
 """
 from __future__ import annotations
 
@@ -38,6 +52,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "tools tests)")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="emit one JSON document instead of text lines")
+    p.add_argument("--sarif", action="store_true",
+                   help="emit a SARIF 2.1.0 document (new findings "
+                        "carry baselineState=new)")
+    p.add_argument("--changed-only", default=None, metavar="REF",
+                   help="report only findings in files changed vs this "
+                        "git ref (analysis still runs repo-wide; "
+                        "ratchet skipped)")
     p.add_argument("--analyzers", default=None,
                    help="comma-separated subset (default: all)")
     p.add_argument("--baseline", default=None,
@@ -45,6 +66,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "pdlint_baseline.json)")
     p.add_argument("--no-baseline", action="store_true",
                    help="ignore the baseline: every finding is new")
+    p.add_argument("--no-ratchet", action="store_true",
+                   help="do not fail on stale baseline entries")
     p.add_argument("--write-baseline", action="store_true",
                    help="rewrite the baseline from this run's findings "
                         "and exit 0")
@@ -80,12 +103,21 @@ def main(argv=None) -> int:
             return 2
         analyzers = [a for a in analyzers if a.name in wanted]
 
+    full_default_run = not args.paths
     paths = [os.path.abspath(p) for p in args.paths] or \
         analysis.default_paths(REPO_ROOT)
     for p in paths:
         if not os.path.exists(p):
             print(f"pdlint: no such path: {p}", file=sys.stderr)
             return 2
+
+    changed = None
+    if args.changed_only is not None:
+        changed = analysis.changed_files(args.changed_only, REPO_ROOT)
+        if changed is None:
+            print(f"pdlint: git could not diff against "
+                  f"{args.changed_only!r}; running un-filtered",
+                  file=sys.stderr)
 
     baseline_path = args.baseline or \
         analysis.default_baseline_path(REPO_ROOT)
@@ -99,32 +131,62 @@ def main(argv=None) -> int:
 
     baseline = {} if args.no_baseline else \
         analysis.load_baseline(baseline_path)
-    new = analysis.filter_new(findings, baseline)
+
+    reported = findings
+    if changed is not None:
+        reported = [f for f in findings if f.path in changed]
+    new = analysis.filter_new(reported, baseline)
+
+    # the ratchet: only a full default-tree, all-analyzer run can
+    # prove a baseline entry dead (subtree/subset runs and
+    # changed-only reports see a partial world)
+    stale = []
+    ratchet_active = (full_default_run and changed is None
+                      and not args.no_baseline and not args.no_ratchet
+                      and not args.analyzers)
+    if ratchet_active:
+        stale = analysis.stale_entries(findings, baseline)
+
+    if args.sarif:
+        print(json.dumps(analysis.to_sarif(
+            reported, [a.name for a in analyzers], baseline),
+            indent=1, sort_keys=True))
+        return 1 if (new or stale) else 0
 
     if args.as_json:
         print(json.dumps({
-            "version": 1,
+            "version": 2,
             "analyzers": [a.name for a in analyzers],
             "baseline": os.path.relpath(baseline_path, REPO_ROOT),
             "baseline_size": len(baseline),
-            "counts": {"total": len(findings), "new": len(new)},
-            "findings": [f.to_dict() for f in findings],
+            "changed_only": args.changed_only,
+            "counts": {"total": len(reported), "new": len(new),
+                       "stale": len(stale)},
+            "findings": [f.to_dict() for f in reported],
             "new": [f.fingerprint for f in new],
+            "stale": stale,
         }, indent=1, sort_keys=True))
-        return 1 if new else 0
+        return 1 if (new or stale) else 0
 
     new_fps = {f.fingerprint for f in new}
-    for f in findings:
+    for f in reported:
         suffix = "" if f.fingerprint in new_fps else "  [baselined]"
         print(f.format() + suffix)
-    n_base = len(findings) - len(new)
-    print(f"pdlint: {len(findings)} finding(s), {n_base} baselined, "
-          f"{len(new)} new")
+    n_base = len(reported) - len(new)
+    print(f"pdlint: {len(reported)} finding(s), {n_base} baselined, "
+          f"{len(new)} new" + (f", {len(stale)} stale baseline "
+                               f"entry(ies)" if stale else ""))
     if new:
         print("pdlint: new findings — fix them, or (after review) "
               "refresh the baseline with --write-baseline",
               file=sys.stderr)
-    return 1 if new else 0
+    if stale:
+        print("pdlint: RATCHET — these baselined findings no longer "
+              "exist; prune them (the baseline only shrinks):",
+              file=sys.stderr)
+        for fp in stale:
+            print(f"  {fp}", file=sys.stderr)
+    return 1 if (new or stale) else 0
 
 
 if __name__ == "__main__":
