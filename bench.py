@@ -26,60 +26,16 @@ import time
 import numpy as np
 
 
-def _probe_backend(timeout=None, retries=None, sleep_s=20):
-    """Probe TPU backend availability in a SUBPROCESS before this process
-    touches jax: when the tunnel is wedged, backend init either raises
-    UNAVAILABLE or hangs indefinitely (round-4 BENCH rc=1 / MULTICHIP
-    rc=124), and a hang inside this process cannot be recovered. Bounded
-    retries with a fixed backoff, every attempt timed.
-
-    Returns (platform_or_None, diagnostic_str, probe_dict) where
-    probe_dict records the full retry schedule — per-attempt elapsed
-    seconds, the backoff slept before each, and the error text — so a
-    skipped-bench JSON says exactly how long was spent deciding to skip
-    instead of an ambiguous rc-0 record."""
-    import subprocess
-
-    timeout = timeout or int(os.environ.get("BENCH_PROBE_TIMEOUT", 120))
-    retries = retries or int(os.environ.get("BENCH_PROBE_RETRIES", 2))
-    last = ""
-    attempts = []
-    t_start = time.monotonic()
-    for attempt in range(retries):
-        if attempt:
-            time.sleep(sleep_s)
-        t0 = time.monotonic()
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; print(jax.devices()[0].platform)"],
-                capture_output=True, text=True, timeout=timeout)
-            elapsed = time.monotonic() - t0
-            if r.returncode == 0 and r.stdout.strip():
-                return r.stdout.strip().splitlines()[-1], "", {
-                    "attempts": attempts, "total_s": round(
-                        time.monotonic() - t_start, 1)}
-            last = (r.stderr or r.stdout).strip().replace("\n", " ")[-300:]
-        except subprocess.TimeoutExpired:
-            elapsed = time.monotonic() - t0
-            last = f"backend init hung >{timeout}s (tunnel wedged)"
-        attempts.append({"attempt": attempt + 1,
-                         "backoff_s": sleep_s if attempt else 0,
-                         "elapsed_s": round(elapsed, 1),
-                         "error": last})
-    probe = {"retries": retries, "timeout_s": timeout,
-             "backoff_s": sleep_s, "attempts": attempts,
-             "total_s": round(time.monotonic() - t_start, 1)}
-    return None, f"{retries} attempts failed; last: {last}", probe
-
-
-# The classifier lives in tools/_bench_common.py (shared by every
-# tools/bench_*.py); the BENCH_r04 root cause — probe succeeds, tunnel
-# wedges, the FIRST in-process eager op (a convert_element_type on the
-# 1.3B path) surfaces backend-unavailable looking like a dtype bug —
-# is documented there. The alias keeps this bench's public shape.
+# The classifier AND the wedge-safe subprocess probes live in
+# tools/_bench_common.py (shared by every tools/bench_*.py and by
+# tools/shardcheck.py's topology probe); the BENCH_r04 root cause —
+# probe succeeds, tunnel wedges, the FIRST in-process eager op (a
+# convert_element_type on the 1.3B path) surfaces backend-unavailable
+# looking like a dtype bug — is documented there. The aliases keep
+# this bench's public shape (tests monkeypatch bench._probe_backend).
 from tools._bench_common import (  # noqa: E402
     backend_unavailable as _backend_unavailable,
+    probe_backend as _probe_backend,
     skip_record as _skip_record,
 )
 

@@ -31,7 +31,12 @@ taint lattice.
 - ``ResourcePairingAnalyzer`` — ``PagedKVCache`` page retain/alloc
   without release/free on some path (exception edges included), bare
   ``lock.acquire()`` and manual ``__enter__`` without their pairs
-  (RP001-RP003).
+  (RP001-RP003);
+- ``TimeoutDisciplineAnalyzer`` — blocking socket/HTTP calls
+  (``urlopen``, ``socket.create_connection``, ``HTTPConnection``,
+  opener ``.open``) without an explicit timeout inside
+  ``paddle_tpu/serving/`` — an unbounded wait on a wedgeable peer
+  defeats the fleet's deadline/watchdog resilience (TD001).
 
 Entry points: ``tools/pdlint.py`` (CLI: text/JSON/SARIF, git-aware
 ``--changed-only``, baseline ratchet, exit codes) and
@@ -56,6 +61,7 @@ from .lock_discipline import LockDisciplineAnalyzer
 from .metric_discipline import MetricDisciplineAnalyzer
 from .recompile_risk import RecompileRiskAnalyzer
 from .resource_pairing import ResourcePairingAnalyzer
+from .timeout_discipline import TimeoutDisciplineAnalyzer
 from .tracer_safety import TracerSafetyAnalyzer
 
 __all__ = [
@@ -63,7 +69,7 @@ __all__ = [
     "TracerSafetyAnalyzer", "FlagConsistencyAnalyzer",
     "LockDisciplineAnalyzer", "MetricDisciplineAnalyzer",
     "DonationSafetyAnalyzer", "RecompileRiskAnalyzer",
-    "ResourcePairingAnalyzer",
+    "ResourcePairingAnalyzer", "TimeoutDisciplineAnalyzer",
     "all_analyzers", "analyzer_names", "default_paths", "repo_root",
     "default_baseline_path", "run_project",
     "iter_python_files", "parse_files", "run_analyzers",
@@ -76,7 +82,7 @@ def all_analyzers() -> List[Analyzer]:
     return [TracerSafetyAnalyzer(), FlagConsistencyAnalyzer(),
             LockDisciplineAnalyzer(), MetricDisciplineAnalyzer(),
             DonationSafetyAnalyzer(), RecompileRiskAnalyzer(),
-            ResourcePairingAnalyzer()]
+            ResourcePairingAnalyzer(), TimeoutDisciplineAnalyzer()]
 
 
 def analyzer_names() -> List[str]:

@@ -1,0 +1,135 @@
+"""Timeout-discipline analyzer: no unbounded blocking I/O in serving.
+
+The serving fleet's resilience story (deadline propagation, breakers,
+the wedge watchdog) is only as good as its weakest blocking call: one
+``urlopen`` without a timeout inside the router turns a wedged replica
+into a wedged ROUTER — the exact failure class PR 15 exists to bound.
+The repo convention is that every intra-fleet HTTP call goes through a
+helper that supplies a timeout (``FleetRouter._http``); this analyzer
+makes the convention a compile-time contract over
+``paddle_tpu/serving/``:
+
+  TD001  a blocking socket/HTTP call — ``urlopen(...)``,
+         ``socket.create_connection(...)``, an
+         ``HTTPConnection``/``HTTPSConnection`` construction, or
+         ``<opener>.open(...)`` on a urllib opener — without an
+         explicit timeout (the ``timeout=`` keyword, or the
+         positional timeout slot those signatures define). The
+         stdlib default for all of them is "block forever"; a fleet
+         data or control plane may never wait forever on a peer that
+         PERF.md history shows can silently wedge.
+
+Only ``paddle_tpu/serving/`` is in scope: benches and tests block on
+purpose, and non-serving library code has no peer that can wedge it.
+Deliberate-negative files opt out with
+``# pdlint: disable=timeout_discipline``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Sequence
+
+from .core import Analyzer, Finding, SourceFile, in_scope
+
+__all__ = ["TimeoutDisciplineAnalyzer"]
+
+_SCOPE_DIRS = ("paddle_tpu/serving",)
+
+# call name -> index of the positional timeout slot (None = keyword
+# only). urlopen(url, data=None, timeout=...) -> slot 2;
+# create_connection(address, timeout=...) -> slot 1;
+# HTTP(S)Connection(host, port=None, timeout=...) -> slot 2.
+_BLOCKING_CALLS = {
+    "urlopen": 2,
+    "create_connection": 1,
+    "HTTPConnection": 2,
+    "HTTPSConnection": 2,
+}
+
+
+def _call_name(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _is_opener_open(func: ast.AST) -> bool:
+    """``<receiver>.open(...)`` where the receiver reads as a urllib
+    opener (``_OPENER.open``, ``self.opener.open``, ...). Plain
+    ``open()`` (the builtin) and file-ish receivers never match."""
+    if not (isinstance(func, ast.Attribute) and func.attr == "open"):
+        return False
+    recv = func.value
+    name = None
+    if isinstance(recv, ast.Name):
+        name = recv.id
+    elif isinstance(recv, ast.Attribute):
+        name = recv.attr
+    return name is not None and "opener" in name.lower()
+
+
+def _has_timeout(call: ast.Call, pos_slot: Optional[int]) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "timeout":
+            return True
+        if kw.arg is None:      # **kwargs: assume the caller knows
+            return True
+    return pos_slot is not None and len(call.args) > pos_slot
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, analyzer: "TimeoutDisciplineAnalyzer",
+                 sf: SourceFile, findings: List[Finding]):
+        self.analyzer = analyzer
+        self.sf = sf
+        self.findings = findings
+        self.stack: List[str] = []
+
+    def visit_FunctionDef(self, node):  # noqa: N802 - ast ABI
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):  # noqa: N802 - ast ABI
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    def visit_Call(self, node):  # noqa: N802 - ast ABI
+        name = _call_name(node.func)
+        hit = None
+        if name in _BLOCKING_CALLS:
+            if not _has_timeout(node, _BLOCKING_CALLS[name]):
+                hit = name
+        elif _is_opener_open(node.func):
+            if not _has_timeout(node, None):
+                hit = "opener.open"
+        if hit is not None:
+            qual = ".".join(self.stack) or "<module>"
+            self.findings.append(Finding(
+                self.analyzer.name, "TD001", self.sf.rel,
+                node.lineno, node.col_offset,
+                f"blocking call {hit}() without an explicit timeout "
+                f"in serving code — the stdlib default blocks "
+                f"forever, so a wedged peer wedges this process too; "
+                f"pass timeout= (route fleet HTTP through the "
+                f"router/worker helpers that supply one)",
+                symbol=qual, detail=hit))
+        self.generic_visit(node)
+
+
+class TimeoutDisciplineAnalyzer(Analyzer):
+    name = "timeout_discipline"
+
+    def run(self, files: Sequence[SourceFile]) -> List[Finding]:
+        findings: List[Finding] = []
+        for sf in files:
+            if sf.tree is None or \
+                    not in_scope(sf.rel, _SCOPE_DIRS):
+                continue
+            _Visitor(self, sf, findings).visit(sf.tree)
+        return findings

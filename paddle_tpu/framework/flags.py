@@ -361,3 +361,53 @@ define_flag("FLAGS_fleet_drain_timeout_s", 30.0,
             "for one draining replica's outstanding requests to reach "
             "zero before the swap aborts (remaining replicas keep the "
             "old weights — never a half-broken fleet)")
+
+# Fleet resilience knobs (paddle_tpu.serving.fleet.resilience —
+# deadline propagation, per-replica circuit breakers, hedged
+# requests, retry backoff, and the device-wedge watchdog).
+define_flag("FLAGS_fleet_retry_backoff_ms", 10.0,
+            "base of the router's exponential retry backoff: retry N "
+            "sleeps uniform[0, min(cap, base * 2^N)] (full jitter, so "
+            "a fleet-wide brownout does not trigger a synchronized "
+            "retry storm); 0 = immediate retries (the pre-resilience "
+            "behavior)")
+define_flag("FLAGS_fleet_retry_backoff_max_ms", 500.0,
+            "cap of the router's exponential retry backoff sleep")
+define_flag("FLAGS_fleet_breaker_window", 16,
+            "per-replica circuit-breaker rolling outcome window: the "
+            "last N dispatch outcomes drive the open/close decision")
+define_flag("FLAGS_fleet_breaker_failure_ratio", 0.5,
+            "circuit-breaker open threshold: the breaker opens when "
+            "failures / window samples reaches this ratio (with at "
+            "least FLAGS_fleet_breaker_min_samples outcomes seen)")
+define_flag("FLAGS_fleet_breaker_min_samples", 4,
+            "minimum outcomes in the rolling window before the "
+            "failure ratio can open a breaker (no opening on the "
+            "first blip)")
+define_flag("FLAGS_fleet_breaker_open_ms", 1000.0,
+            "circuit-breaker cooldown: an open breaker sheds all "
+            "traffic from its replica for this long, then moves to "
+            "half-open and admits ONE probe request; the probe's "
+            "outcome closes or re-opens it")
+define_flag("FLAGS_fleet_breaker_latency_ms", 0.0,
+            "slow-but-alive threshold: a SUCCESSFUL dispatch slower "
+            "than this counts as a breaker failure, so a replica "
+            "serving 100x latency while /readyz-green still gets "
+            "drained (0 = latency never trips the breaker)")
+define_flag("FLAGS_fleet_hedge_ms", 0.0,
+            "request hedging floor: when a submit/submit_many "
+            "dispatch is still pending after max(this, the replica "
+            "latency window's FLAGS_fleet_hedge_quantile), a hedge "
+            "fires to a SECOND replica and the first response wins "
+            "(idempotent batch path only — submit_generate never "
+            "hedges); 0 = hedging off")
+define_flag("FLAGS_fleet_hedge_quantile", 0.95,
+            "latency quantile of the primary replica's rolling window "
+            "used as the adaptive hedge trigger (bounded below by "
+            "FLAGS_fleet_hedge_ms)")
+define_flag("FLAGS_fleet_wedge_timeout_ms", 0.0,
+            "device-wedge watchdog: a worker dispatch in flight "
+            "longer than this flips /readyz to not-ready, fails "
+            "waiting requests with ReplicaWedgedError and asks the "
+            "supervisor for a restart (worker processes exit; the "
+            "respawn is a warm start). 0 = watchdog off")

@@ -34,24 +34,42 @@ package is that fleet:
   router + replica spans into one cross-process trace; ``/statusz``
   aggregates per-replica readiness/outstanding/restarts/version.
 
+- Resilience layer (resilience.py, PR 15): end-to-end DEADLINE
+  propagation (router deducts per hop, codec ``PDDL`` trailer /
+  ``deadline_ms`` JSON field, worker rejects expired work before
+  dispatch, the generation engine evicts expired in-flight streams
+  with their pages freed); per-replica CIRCUIT BREAKERS with
+  half-open probing (slow-but-alive replicas drain even while
+  ``/readyz`` stays green); exponential-backoff-with-jitter retries;
+  HEDGED ``submit``/``submit_many`` (first response wins, duplicate
+  execution accounted in ``paddle_fleet_hedges_total``); and the
+  DEVICE-WEDGE WATCHDOG (``arm_wedge_watchdog``) that turns a hung
+  dispatch into a typed ``ReplicaWedgedError`` + supervisor respawn.
+  Proven by ``tools/chaos_fleet.py`` (CHAOS_r01.json, perfci-gated).
+
 Knobs: ``FLAGS_fleet_*`` + ``FLAGS_serving_ready_requires_warmup``
 in framework/flags.py. Bench: ``tools/bench_fleet.py``.
 """
 from __future__ import annotations
 
 from . import codec  # noqa: F401
+from . import resilience  # noqa: F401
 from .metrics import FleetMetrics, merge_prometheus_texts
+from .resilience import (CircuitBreaker, Deadline, ReplicaWedgedError,
+                         WedgeMonitor, WedgeWatchdog)
 from .router import (FleetRouter, NoReadyReplicaError, ReplicaError,
                      RouterApp)
 from .supervisor import (ProcessReplicaFactory, ReplicaSupervisor,
                          SubprocessReplica)
 from .worker import (PredictorBackend, ReplicaApp, StubBackend,
-                     ThreadReplicaFactory)
+                     ThreadReplicaFactory, arm_wedge_watchdog)
 
 __all__ = [
     "FleetRouter", "RouterApp", "ReplicaSupervisor",
     "ProcessReplicaFactory", "SubprocessReplica", "ReplicaApp",
     "PredictorBackend", "StubBackend", "ThreadReplicaFactory",
     "FleetMetrics", "merge_prometheus_texts", "NoReadyReplicaError",
-    "ReplicaError", "codec",
+    "ReplicaError", "codec", "resilience", "CircuitBreaker",
+    "Deadline", "ReplicaWedgedError", "WedgeMonitor", "WedgeWatchdog",
+    "arm_wedge_watchdog",
 ]

@@ -26,9 +26,17 @@ Layout (all integers little-endian):
             router can stamp trace contexts onto an opaque client
             body without decoding the arrays, and a decoder that
             ignores it (``decode_batch``) keeps working unchanged.
+- deadline trailer (optional): magic ``PDDL`` — u32 n_requests, per
+            request f64 REMAINING budget milliseconds (NaN =
+            unbounded). Relative-not-absolute because router and
+            worker wall clocks are not comparable; each hop deducts
+            its own elapsed time before re-stamping. Trailers may
+            appear in any order after the batch body; every section
+            must parse to exactly EOF.
 """
 from __future__ import annotations
 
+import math
 import struct
 from typing import Dict, List, Optional, Sequence, Union
 
@@ -36,17 +44,20 @@ import numpy as np
 
 from ..request import (DeadlineExceededError, QueueFullError,
                        ServerClosedError)
+from .resilience import ReplicaWedgedError
 
 __all__ = [
     "encode_batch", "decode_batch", "decode_batch_ex",
-    "encode_results", "decode_results", "peek_batch_size",
-    "attach_trace_trailer", "CodecError",
-    "BATCH_MAGIC", "RESULTS_MAGIC", "TRACE_MAGIC",
+    "decode_batch_trailers", "encode_results", "decode_results",
+    "peek_batch_size", "attach_trace_trailer",
+    "attach_deadline_trailer", "CodecError",
+    "BATCH_MAGIC", "RESULTS_MAGIC", "TRACE_MAGIC", "DEADLINE_MAGIC",
 ]
 
 BATCH_MAGIC = b"PDFB"
 RESULTS_MAGIC = b"PDFR"
 TRACE_MAGIC = b"PDTC"
+DEADLINE_MAGIC = b"PDDL"
 
 # status codes for per-request results (0 = ok)
 _OK = 0
@@ -54,13 +65,16 @@ _ERR_GENERIC = 1
 _ERR_QUEUE_FULL = 2
 _ERR_DEADLINE = 3
 _ERR_CLOSED = 4
+_ERR_WEDGED = 5
 
 _CODE_OF = {QueueFullError: _ERR_QUEUE_FULL,
             DeadlineExceededError: _ERR_DEADLINE,
-            ServerClosedError: _ERR_CLOSED}
+            ServerClosedError: _ERR_CLOSED,
+            ReplicaWedgedError: _ERR_WEDGED}
 _EXC_OF: Dict[int, type] = {_ERR_QUEUE_FULL: QueueFullError,
                             _ERR_DEADLINE: DeadlineExceededError,
                             _ERR_CLOSED: ServerClosedError,
+                            _ERR_WEDGED: ReplicaWedgedError,
                             _ERR_GENERIC: RuntimeError}
 
 
@@ -146,20 +160,83 @@ def decode_batch(data: bytes) -> List[List[np.ndarray]]:
             for _ in range(r.u32())]
 
 
+def _parse_trace_section(r: "_Reader", n_req: int):
+    n = r.u32()
+    if n != n_req:
+        raise CodecError(
+            f"trace trailer for {n} requests on a batch of {n_req}")
+    out = []
+    for _ in range(n):
+        ln = struct.unpack("<H", r.take(2))[0]
+        out.append(r.take(ln).decode("ascii", "replace")
+                   if ln else None)
+    return out
+
+
+def _parse_deadline_section(r: "_Reader", n_req: int):
+    n = r.u32()
+    if n != n_req:
+        raise CodecError(
+            f"deadline trailer for {n} requests on a batch of {n_req}")
+    out = []
+    for _ in range(n):
+        ms = struct.unpack("<d", r.take(8))[0]
+        out.append(None if math.isnan(ms) else float(ms))
+    return out
+
+
+_SECTION_PARSERS = {TRACE_MAGIC: _parse_trace_section,
+                    DEADLINE_MAGIC: _parse_deadline_section}
+
+
+def _walk_sections(r: "_Reader", n_req: int) -> Dict[bytes, list]:
+    """Parse the optional trailer sections (any order) to exactly EOF.
+    An unknown magic is a malformed payload, not a skippable blob —
+    sections carry no length prefix, so skipping is impossible."""
+    sections: Dict[bytes, list] = {}
+    while r.ofs < len(r.data):
+        magic = r.take(4)
+        parser = _SECTION_PARSERS.get(magic)
+        if parser is None:
+            raise CodecError(
+                f"unknown trailer section magic {magic!r}")
+        if magic in sections:
+            raise CodecError(
+                f"duplicate trailer section {magic!r}")
+        sections[magic] = parser(r, n_req)
+    return sections
+
+
+def _has_section(data: bytes, magic: bytes) -> bool:
+    """Whether an intact payload already carries a ``magic`` trailer
+    section (malformed trailers report False — the caller's append
+    will fail loudly at decode, never silently double-stamp)."""
+    idx = data.rfind(magic)
+    if idx < 8:          # before any possible batch body
+        return False
+    try:
+        n_req = peek_batch_size(data)
+        r = _Reader(data)
+        r.ofs = idx
+        return magic in _walk_sections(r, n_req)
+    except (CodecError, struct.error):
+        return False
+
+
 def attach_trace_trailer(
         data: bytes,
         traceparents: Sequence[Optional[str]]) -> bytes:
     """Append per-request ``traceparent`` headers to an ALREADY
     ENCODED batch (the router's pass-through path never decodes the
-    arrays). A payload that already carries a trailer is returned
-    unchanged — a client that stamped its own trace identities wins
-    over the router's."""
+    arrays). A payload that already carries a trace trailer is
+    returned unchanged — a client that stamped its own trace
+    identities wins over the router's."""
     n = peek_batch_size(data)
     if len(traceparents) != n:
         raise CodecError(
             f"trace trailer carries {len(traceparents)} entries for "
             f"a batch of {n} requests")
-    if _has_trailer(data):
+    if _has_section(data, TRACE_MAGIC):
         return data
     parts: List[bytes] = [data, TRACE_MAGIC, struct.pack("<I", n)]
     for tp in traceparents:
@@ -169,51 +246,49 @@ def attach_trace_trailer(
     return b"".join(parts)
 
 
-def _has_trailer(data: bytes) -> bool:
-    """Cheap check for an existing trace trailer: the trailer is the
-    last section, so it is detectable from the tail (entry lengths
-    walked backwards would be ambiguous; instead re-scan forward from
-    the last magic occurrence and verify it parses to exactly EOF)."""
-    idx = data.rfind(TRACE_MAGIC)
-    if idx < 8:          # before any possible batch body
-        return False
-    try:
-        r = _Reader(data)
-        r.ofs = idx + 4
-        n = r.u32()
-        for _ in range(n):
-            ln = struct.unpack("<H", r.take(2))[0]
-            r.take(ln)
-        return r.ofs == len(data)
-    except (CodecError, struct.error):
-        return False
+def attach_deadline_trailer(
+        data: bytes,
+        deadlines_ms: Sequence[Optional[float]]) -> bytes:
+    """Append per-request REMAINING deadline budgets (ms) to an
+    already-encoded batch. ``None`` = unbounded (NaN on the wire).
+    Like the trace trailer, a payload that already carries one is
+    returned unchanged — the upstream stamp (an external client that
+    budgeted its own hops) wins over the router's."""
+    n = peek_batch_size(data)
+    if len(deadlines_ms) != n:
+        raise CodecError(
+            f"deadline trailer carries {len(deadlines_ms)} entries "
+            f"for a batch of {n} requests")
+    if _has_section(data, DEADLINE_MAGIC):
+        return data
+    parts: List[bytes] = [data, DEADLINE_MAGIC, struct.pack("<I", n)]
+    for ms in deadlines_ms:
+        parts.append(struct.pack(
+            "<d", float("nan") if ms is None else float(ms)))
+    return b"".join(parts)
 
 
-def decode_batch_ex(
-        data: bytes
-) -> tuple:
-    """``(feeds_list, traceparents)`` — the worker-side decode.
-    ``traceparents`` is None when the payload carries no trailer,
-    else one ``Optional[str]`` per request."""
+def decode_batch_trailers(data: bytes) -> tuple:
+    """``(feeds_list, traceparents, deadlines_ms)`` — the worker-side
+    decode. ``traceparents`` / ``deadlines_ms`` are None when the
+    payload carries no such trailer, else one ``Optional`` entry per
+    request."""
     r = _Reader(data)
     if r.take(4) != BATCH_MAGIC:
         raise CodecError("not a fleet batch payload")
     feeds = [[r.array() for _ in range(r.u32())]
              for _ in range(r.u32())]
-    traceparents = None
-    if r.ofs + 8 <= len(r.data) and \
-            r.data[r.ofs:r.ofs + 4] == TRACE_MAGIC:
-        r.take(4)
-        n = r.u32()
-        if n != len(feeds):
-            raise CodecError(
-                f"trace trailer for {n} requests on a batch of "
-                f"{len(feeds)}")
-        traceparents = []
-        for _ in range(n):
-            ln = struct.unpack("<H", r.take(2))[0]
-            tp = r.take(ln).decode("ascii", "replace") if ln else None
-            traceparents.append(tp)
+    sections = _walk_sections(r, len(feeds))
+    return (feeds, sections.get(TRACE_MAGIC),
+            sections.get(DEADLINE_MAGIC))
+
+
+def decode_batch_ex(
+        data: bytes
+) -> tuple:
+    """``(feeds_list, traceparents)`` — the pre-deadline decode shape,
+    kept for callers that do not consume budgets."""
+    feeds, traceparents, _ = decode_batch_trailers(data)
     return feeds, traceparents
 
 

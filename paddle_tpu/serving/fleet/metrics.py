@@ -19,6 +19,18 @@ endpoint exposes them alongside everything else:
   lifecycle (replica_reloaded / completed / failed)
 - ``paddle_fleet_request_ms{router}`` — router-observed end-to-end
   batch latency
+- ``paddle_fleet_breaker_transitions_total{router,replica,state}`` —
+  circuit-breaker state entries (open / half_open / closed) per
+  replica
+- ``paddle_fleet_breaker_open{router,replica}`` — 1 while the
+  replica's breaker is open or half-open (shedding), else 0
+- ``paddle_fleet_hedges_total{router,event}`` — hedged-request
+  accounting: fired (a hedge dispatched), won (the hedge answered
+  first), wasted (the loser completed anyway — duplicate execution
+  paid for nothing)
+- ``paddle_fleet_deadline_rejects_total{router,where}`` — requests
+  rejected on an exhausted deadline budget, by the hop that caught
+  it (router / worker)
 
 ``merge_prometheus_texts`` builds the fleet-wide scrape: each
 replica's own /metrics text re-labeled with ``replica="<id>"`` and
@@ -35,6 +47,7 @@ __all__ = ["FleetMetrics", "merge_prometheus_texts"]
 
 _EVENTS = ("routed", "completed", "failed", "shed")
 _SWAP_EVENTS = ("replica_reloaded", "completed", "failed")
+_HEDGE_EVENTS = ("fired", "won", "wasted")
 
 
 class FleetMetrics:
@@ -75,9 +88,27 @@ class FleetMetrics:
         self._f_lat = reg.histogram(
             "paddle_fleet_request_ms",
             "router-observed end-to-end batch latency", ("router",))
+        self._f_breaker = reg.counter(
+            "paddle_fleet_breaker_transitions_total",
+            "circuit-breaker state entries per replica",
+            ("router", "replica", "state"))
+        self._f_breaker_open = reg.gauge(
+            "paddle_fleet_breaker_open",
+            "1 while the replica's breaker sheds (open/half-open)",
+            ("router", "replica"))
+        self._f_hedges = reg.counter(
+            "paddle_fleet_hedges_total",
+            "hedged-request accounting (fired / won / wasted "
+            "duplicate execution)", ("router", "event"))
+        self._f_deadline = reg.counter(
+            "paddle_fleet_deadline_rejects_total",
+            "requests rejected on an exhausted deadline budget, by "
+            "the hop that caught it", ("router", "where"))
         for fam in (self._f_events, self._f_retries, self._f_sheds,
                     self._f_outstanding, self._f_replicas,
-                    self._f_swaps, self._f_lat):
+                    self._f_swaps, self._f_lat, self._f_breaker,
+                    self._f_breaker_open, self._f_hedges,
+                    self._f_deadline):
             fam.clear(router=name)
         self._events = {e: self._f_events.labels(router=name, event=e)
                         for e in _EVENTS}
@@ -91,6 +122,11 @@ class FleetMetrics:
                         for s in ("known", "ready", "live",
                                   "draining")}
         self._h_lat = self._f_lat.labels(router=name)
+        self._hedges = {e: self._f_hedges.labels(router=name, event=e)
+                        for e in _HEDGE_EVENTS}
+        self._deadline = {w: self._f_deadline.labels(router=name,
+                                                     where=w)
+                          for w in ("router", "worker")}
         self._w_lat = PercentileWindow(int(window))
 
     def count(self, event: str, n: int = 1):
@@ -101,6 +137,19 @@ class FleetMetrics:
 
     def count_shed(self, replica: str):
         self._f_sheds.labels(router=self.name, replica=replica).inc()
+
+    def count_hedge(self, event: str, n: int = 1):
+        self._hedges[event].inc(n)
+
+    def count_deadline_reject(self, where: str, n: int = 1):
+        self._deadline[where].inc(n)
+
+    def count_breaker_transition(self, replica: str, state: str):
+        self._f_breaker.labels(router=self.name, replica=replica,
+                               state=state).inc()
+        self._f_breaker_open.labels(
+            router=self.name, replica=replica).set(
+            0 if state == "closed" else 1)
 
     def count_restart(self):
         self._f_restarts.labels(fleet=self.name).inc()
@@ -115,6 +164,8 @@ class FleetMetrics:
     def drop_replica(self, replica: str):
         self._f_outstanding.clear(router=self.name, replica=replica)
         self._f_sheds.clear(router=self.name, replica=replica)
+        self._f_breaker.clear(router=self.name, replica=replica)
+        self._f_breaker_open.clear(router=self.name, replica=replica)
 
     def set_replica_states(self, known: int, ready: int, live: int,
                            draining: int):
@@ -143,6 +194,10 @@ class FleetMetrics:
                          for s, g in self._states.items()},
             "restarts": int(
                 self._f_restarts.labels(fleet=self.name).value),
+            "hedges": {e: int(c.value)
+                       for e, c in self._hedges.items()},
+            "deadline_rejects": {w: int(c.value)
+                                 for w, c in self._deadline.items()},
             "request_ms": lat,
         }
 

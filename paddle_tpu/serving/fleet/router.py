@@ -30,12 +30,35 @@ drained (marked unroutable, outstanding waited to zero), told to
 ``/reload`` the version-stamped artifact (warm from the shared
 compile cache), verified ready again, and returned to rotation —
 zero downtime, zero failed in-flight requests, fleet-wide.
+
+Resilience layer (resilience.py, PR 15):
+
+- every request carries an absolute DEADLINE budget: the router
+  deducts elapsed time per hop, stamps the remaining milliseconds
+  onto the wire (codec deadline trailer / generate JSON field) so the
+  worker can reject already-expired work before it ever reaches the
+  device, and fails locally once the budget is gone instead of
+  burning retries on a dead request;
+- each replica has a CIRCUIT BREAKER fed by every dispatch outcome
+  (errors, sheds, and — with ``FLAGS_fleet_breaker_latency_ms`` —
+  slow-but-alive responses): an open breaker drains the replica even
+  while its ``/readyz`` stays green, a half-open probe re-admits it;
+- retries use EXPONENTIAL BACKOFF WITH FULL JITTER
+  (``FLAGS_fleet_retry_backoff_*``) instead of the fixed immediate
+  re-dispatch loop;
+- ``submit``/``submit_many`` (idempotent) optionally HEDGE: when the
+  primary dispatch exceeds the replica's rolling latency quantile, a
+  duplicate fires to a second replica and the first response wins
+  (``paddle_fleet_hedges_total`` accounts fired/won/wasted);
+  ``submit_generate`` never hedges — a token stream is not
+  idempotent.
 """
 from __future__ import annotations
 
 import concurrent.futures
 import json
 import os
+import random
 import threading
 import time
 import urllib.error
@@ -47,9 +70,12 @@ import numpy as np
 
 from ...observability import tracing
 from ..generation.engine import StreamingFuture
-from ..request import QueueFullError, ServerClosedError
+from ..request import (DeadlineExceededError, QueueFullError,
+                       ServerClosedError)
 from . import codec
 from .metrics import FleetMetrics, merge_prometheus_texts
+from .resilience import (CircuitBreaker, Deadline, latency_quantile,
+                         retry_backoff_ms)
 
 __all__ = ["FleetRouter", "RouterApp", "NoReadyReplicaError",
            "ReplicaError"]
@@ -81,12 +107,13 @@ class ReplicaError(RuntimeError):
 
 class _Replica:
     """Router-side view of one replica. Mutable fields are guarded by
-    the router lock."""
+    the router lock; the breaker carries its own lock."""
 
     __slots__ = ("replica_id", "url", "outstanding", "ready", "alive",
-                 "draining", "version", "errors")
+                 "draining", "version", "errors", "breaker")
 
-    def __init__(self, replica_id, url: str):
+    def __init__(self, replica_id, url: str,
+                 breaker: Optional[CircuitBreaker] = None):
         self.replica_id = replica_id
         self.url = url.rstrip("/")
         self.outstanding = 0
@@ -95,6 +122,8 @@ class _Replica:
         self.draining = False
         self.version: Optional[str] = None
         self.errors = 0
+        self.breaker = breaker if breaker is not None \
+            else CircuitBreaker()
 
 
 class FleetRouter:
@@ -112,6 +141,15 @@ class FleetRouter:
                  health_interval_ms: Optional[float] = None,
                  request_timeout_s: Optional[float] = None,
                  pool_size: Optional[int] = None,
+                 retry_backoff_ms_: Optional[float] = None,
+                 retry_backoff_max_ms: Optional[float] = None,
+                 breaker_window: Optional[int] = None,
+                 breaker_failure_ratio: Optional[float] = None,
+                 breaker_min_samples: Optional[int] = None,
+                 breaker_open_ms: Optional[float] = None,
+                 breaker_latency_ms: Optional[float] = None,
+                 hedge_ms: Optional[float] = None,
+                 hedge_quantile: Optional[float] = None,
                  name: str = "fleet", start: bool = True):
         self.name = name
         self.supervisor = supervisor
@@ -123,6 +161,26 @@ class FleetRouter:
         self.request_timeout_s = float(
             request_timeout_s if request_timeout_s is not None
             else _flag("FLAGS_fleet_request_timeout_s", 120.0))
+        self.retry_backoff_ms = float(
+            retry_backoff_ms_ if retry_backoff_ms_ is not None
+            else _flag("FLAGS_fleet_retry_backoff_ms", 10.0))
+        self.retry_backoff_max_ms = float(
+            retry_backoff_max_ms if retry_backoff_max_ms is not None
+            else _flag("FLAGS_fleet_retry_backoff_max_ms", 500.0))
+        self.hedge_ms = float(
+            hedge_ms if hedge_ms is not None
+            else _flag("FLAGS_fleet_hedge_ms", 0.0))
+        self.hedge_quantile = float(
+            hedge_quantile if hedge_quantile is not None
+            else _flag("FLAGS_fleet_hedge_quantile", 0.95))
+        self._breaker_kw = {
+            "window": breaker_window,
+            "failure_ratio": breaker_failure_ratio,
+            "min_samples": breaker_min_samples,
+            "open_ms": breaker_open_ms,
+            "latency_threshold_ms": breaker_latency_ms,
+        }
+        self._rng = random.Random()     # backoff jitter
         self.metrics = FleetMetrics(name)
         # stamp this process's spans as the router's (only when nothing
         # else named the process — a worker main() names it first)
@@ -138,7 +196,7 @@ class FleetRouter:
             max_workers=int(pool_size) if pool_size else 32,
             thread_name_prefix=f"fleet-router-{name}")
         for rid, url in (replicas or {}).items():
-            self._replicas[rid] = _Replica(rid, url)
+            self._replicas[rid] = self._new_replica(rid, url)
         if supervisor is not None:
             self._sync_supervisor()
         self.poll_replicas()            # synchronous first probe
@@ -146,10 +204,19 @@ class FleetRouter:
             self._start_polling()
 
     # ------------------------------------------------------ replica set
+    def _new_replica(self, replica_id, url: str) -> _Replica:
+        rid = str(replica_id)
+        breaker = CircuitBreaker(
+            on_transition=lambda old, new:
+            self.metrics.count_breaker_transition(rid, new),
+            **self._breaker_kw)
+        return _Replica(replica_id, url, breaker=breaker)
+
     def add_replica(self, replica_id, url: str):
         with self._lock:
             if replica_id not in self._replicas:
-                self._replicas[replica_id] = _Replica(replica_id, url)
+                self._replicas[replica_id] = \
+                    self._new_replica(replica_id, url)
 
     def remove_replica(self, replica_id):
         with self._lock:
@@ -162,10 +229,12 @@ class FleetRouter:
             for rid, url in eps.items():
                 rep = self._replicas.get(rid)
                 if rep is None:
-                    self._replicas[rid] = _Replica(rid, url)
+                    self._replicas[rid] = self._new_replica(rid, url)
                 elif rep.url != url.rstrip("/"):
-                    # respawned under the same id: fresh state
-                    self._replicas[rid] = _Replica(rid, url)
+                    # respawned under the same id: fresh state (the
+                    # breaker resets too — a restarted replica earns
+                    # its health record from scratch)
+                    self._replicas[rid] = self._new_replica(rid, url)
             for rid in list(self._replicas):
                 if rid not in eps:
                     self._replicas.pop(rid)
@@ -262,16 +331,34 @@ class FleetRouter:
                     if r.ready and r.alive and not r.draining]
 
     def _pick(self, exclude: set) -> Optional[_Replica]:
+        """Least-outstanding pick over the ready set, breaker-aware:
+        candidates are walked best-first and the first whose breaker
+        admits a dispatch wins (``allow()`` consumes the half-open
+        probe slot only for the replica actually picked — a False
+        answer consumes nothing). Returns None when every candidate
+        is unready or breaker-shed."""
         with self._lock:
             ready = [r for r in self._replicas.values()
                      if r.ready and r.alive and not r.draining
                      and r.replica_id not in exclude]
             if not ready:
                 return None
-            low = min(r.outstanding for r in ready)
-            tied = [r for r in ready if r.outstanding == low]
             self._rr += 1
-            return tied[self._rr % len(tied)]
+            rr = self._rr
+            # best-first: ascending outstanding, ties rotated so equal
+            # queues degrade to round-robin (the pre-breaker behavior)
+            by_depth: Dict[int, List[_Replica]] = {}
+            for r in ready:
+                by_depth.setdefault(r.outstanding, []).append(r)
+            ordered: List[_Replica] = []
+            for depth in sorted(by_depth):
+                tied = by_depth[depth]
+                ordered.extend(tied[rr % len(tied):]
+                               + tied[:rr % len(tied)])
+        for rep in ordered:
+            if rep.breaker.allow():
+                return rep
+        return None
 
     def _acquire(self, rep: _Replica, n: int):
         with self._lock:
@@ -321,17 +408,31 @@ class FleetRouter:
                        timeout_ms: Optional[float],
                        ctx=None) -> bytes:
         """Send one encoded batch to the best replica, with the
-        shed/unavailable retry policy. Returns the raw results
+        resilient retry policy: breaker-aware pick, exponential
+        backoff with full jitter between attempts, optional hedging
+        (the batch path is idempotent), and a deadline budget that is
+        deducted per hop and stamped onto the wire so the worker
+        rejects expired work before dispatch. Returns the raw results
         payload (the HTTP front-end passes it through untouched; the
         Python API decodes it). With ``ctx``, every attempt gets a
         ``router::forward`` span and the batch is stamped with a
         trace trailer so the replica joins the trace."""
         self.metrics.count("routed", n_req)
-        suffix = f"/submit_many?timeout_ms={timeout_ms}" \
-            if timeout_ms else "/submit_many"
+        # the budget clock starts where the caller handed the work
+        # over (submit_many passes a live Deadline so router-pool
+        # queueing time counts against it); a raw number means this
+        # hop is the ingress
+        deadline = timeout_ms if isinstance(timeout_ms, Deadline) \
+            else Deadline(timeout_ms)
         attempts = 0
         tried: set = set()
         while True:
+            if deadline.expired():
+                self.metrics.count_deadline_reject("router", n_req)
+                self.metrics.count("failed", n_req)
+                raise DeadlineExceededError(
+                    f"deadline budget exhausted at the router after "
+                    f"{attempts} attempt(s)")
             rep = self._pick(tried)
             if rep is None and tried:
                 # every routable replica tried: widen to re-tries
@@ -340,83 +441,273 @@ class FleetRouter:
             if rep is None:
                 self.metrics.count("shed", n_req)
                 raise NoReadyReplicaError(
-                    "no ready replica (fleet cold, draining, or "
-                    "down)")
-            self._acquire(rep, n_req)
-            fctx = ctx.child() if ctx is not None else None
-            send_body = codec.attach_trace_trailer(
-                body, [fctx.to_traceparent()] * n_req) \
-                if fctx is not None else body
-            span_status, span_err = "ok", None
-            t_wall = time.time_ns()
-            t0 = time.perf_counter()
-            try:
-                with self._http(rep.url + suffix, data=send_body,
-                                ctype="application/x-paddle-fleet"
-                                ) as resp:
-                    payload = resp.read()
-                ms = (time.perf_counter() - t0) * 1e3
-                self.metrics.observe_latency(ms)
+                    "no ready replica (fleet cold, draining, "
+                    "breaker-shed, or down)")
+            status, value = self._dispatch_maybe_hedged(
+                rep, body, n_req, deadline, ctx, attempts, tried)
+            if status == "ok":
                 self.metrics.count("completed", n_req)
-                if ctx is not None:
-                    tracing.record_exemplar("paddle_fleet_request_ms",
-                                            ms, ctx.trace_id)
-                return payload
-            except urllib.error.HTTPError as e:
-                e.read()
-                if e.code == 429:       # replica shed the whole batch
-                    self.metrics.count_shed(str(rep.replica_id))
-                    reason = "queue_full"
-                elif e.code == 503:     # closed/not ready after all
-                    with self._lock:
-                        rep.ready = False
-                    reason = "unavailable"
-                else:
-                    self.metrics.count("failed", n_req)
-                    span_status, span_err = "error", f"HTTP {e.code}"
-                    raise ReplicaError(
-                        f"replica {rep.replica_id} returned HTTP "
-                        f"{e.code}")
-                span_status, span_err = "error", reason
-            except (ConnectionRefusedError, urllib.error.URLError,
-                    ConnectionResetError, TimeoutError) as e:
-                # Refused before the request was read: nothing
-                # executed, safe to re-route. Anything after dispatch
-                # may have executed — fail, don't double-run.
-                refused = isinstance(e, ConnectionRefusedError) or \
-                    isinstance(getattr(e, "reason", None),
-                               ConnectionRefusedError)
-                with self._lock:
-                    rep.alive = refused and rep.alive
-                    rep.ready = False
-                span_status = "error"
-                span_err = f"{type(e).__name__}: {e}"
-                if not refused:
-                    self.metrics.count("failed", n_req)
-                    raise ReplicaError(
-                        f"replica {rep.replica_id} died mid-request: "
-                        f"{type(e).__name__}: {e}") from e
-                reason = "unavailable"
-            finally:
-                self._release(rep, n_req)
-                if fctx is not None:
-                    f_attrs = {"replica": str(rep.replica_id),
-                               "attempt": attempts}
-                    if span_err:
-                        f_attrs["error"] = span_err
-                    tracing.record_span(
-                        fctx, "router::forward", stage="forward",
-                        start_unix_ns=t_wall,
-                        duration_ms=(time.perf_counter() - t0) * 1e3,
-                        status=span_status, attrs=f_attrs, root=True)
-            tried.add(rep.replica_id)
+                return value
+            if status == "fatal":
+                self.metrics.count("failed", n_req)
+                raise value
             attempts += 1
             if attempts > self.retries:
                 self.metrics.count("shed", n_req)
                 raise QueueFullError(
                     f"fleet shed the batch after {attempts} "
                     f"attempts (all replicas at capacity)")
-            self.metrics.count_retry(reason)
+            self.metrics.count_retry(value)
+            self._backoff_sleep(attempts, deadline)
+
+    def _backoff_sleep(self, attempt: int, deadline: Deadline):
+        """Jittered exponential backoff before retry ``attempt``,
+        clamped to the remaining deadline budget."""
+        if self.retry_backoff_ms <= 0:
+            return
+        ms = deadline.clamp_ms(retry_backoff_ms(
+            attempt - 1, self.retry_backoff_ms,
+            self.retry_backoff_max_ms, self._rng))
+        if ms > 0:
+            time.sleep(ms / 1e3)
+
+    def _hedge_delay_ms(self, rep: _Replica) -> Optional[float]:
+        """How long to let ``rep``'s dispatch run before hedging to a
+        second replica: the ``FLAGS_fleet_hedge_quantile`` of the
+        PEER replicas' rolling latency windows (the potential hedge
+        targets — "someone else would usually have answered by now"),
+        floored by ``FLAGS_fleet_hedge_ms``. Keying on the peers
+        rather than the primary's own window matters: a uniformly
+        slow primary would otherwise inflate its own trigger and
+        never get hedged around. None = hedging off."""
+        if self.hedge_ms <= 0:
+            return None
+        with self._lock:
+            peers = [r for r in self._replicas.values()
+                     if r is not rep and r.ready and r.alive
+                     and not r.draining]
+        samples: List[float] = []
+        for p in peers:
+            samples.extend(p.breaker.latency_window())
+        q = latency_quantile(samples, self.hedge_quantile)
+        return max(self.hedge_ms, q) if q is not None \
+            else self.hedge_ms
+
+    def _dispatch_maybe_hedged(self, rep: _Replica, body: bytes,
+                               n_req: int, deadline: Deadline, ctx,
+                               attempt: int, tried: set):
+        """One retry attempt, possibly covered by a hedge: when the
+        primary dispatch is still pending past the hedge delay, a
+        duplicate fires to a second replica and the FIRST success
+        wins (submit/submit_many are idempotent — duplicate execution
+        is waste, not corruption; the loser's connection is closed
+        and its eventual completion is accounted as wasted work).
+        Returns ``(status, value)`` like ``_dispatch_once``; failed
+        replicas are added to ``tried``."""
+        delay_ms = self._hedge_delay_ms(rep)
+        if delay_ms is None:
+            res = self._dispatch_once(rep, body, n_req, deadline,
+                                      ctx, attempt)
+            if res[0] != "ok":
+                tried.add(rep.replica_id)
+            return res
+        decided = threading.Event()
+        progress = threading.Event()
+        lock = threading.Lock()
+        results: Dict[str, tuple] = {}
+        cancels = {"primary": {"resp": None, "cancelled": False},
+                   "hedge": {"resp": None, "cancelled": False}}
+
+        def _runner(key, target_rep, hedged):
+            res = self._dispatch_once(
+                target_rep, body, n_req, deadline, ctx, attempt,
+                hedge=hedged, cancel_box=cancels[key])
+            with lock:
+                results[key] = res
+                late = decided.is_set()
+            if late and res[0] == "ok":
+                # the loser completed successfully after the winner
+                # was returned (cancellation can only abort a leg
+                # whose response had started arriving): duplicate
+                # execution, paid for nothing
+                self.metrics.count_hedge("wasted")
+            progress.set()
+
+        threading.Thread(target=_runner, args=("primary", rep, False),
+                         daemon=True,
+                         name=f"fleet-dispatch-{self.name}").start()
+        hedge_rep: Optional[_Replica] = None
+        waited_hedge_delay = False
+        while True:
+            wait_s = None
+            if not waited_hedge_delay and hedge_rep is None:
+                wait_s = deadline.clamp_ms(delay_ms) / 1e3 \
+                    if deadline.bounded else delay_ms / 1e3
+            fired = progress.wait(wait_s)
+            progress.clear()
+            if not fired and hedge_rep is None:
+                # primary still pending past the hedge delay
+                waited_hedge_delay = True
+                hedge_rep = self._pick(tried | {rep.replica_id})
+                if hedge_rep is None:
+                    continue    # nobody to hedge to: wait primary out
+                self.metrics.count_hedge("fired")
+                threading.Thread(
+                    target=_runner, args=("hedge", hedge_rep, True),
+                    daemon=True,
+                    name=f"fleet-hedge-{self.name}").start()
+                continue
+            with lock:
+                p = results.get("primary")
+                h = results.get("hedge")
+                if p is not None and p[0] == "ok":
+                    decided.set()
+                elif h is not None and h[0] == "ok":
+                    decided.set()
+                elif p is not None and \
+                        (hedge_rep is None or h is not None):
+                    decided.set()   # everything launched has failed
+            if not decided.is_set():
+                continue
+            if p is not None and p[0] == "ok":
+                self._cancel_loser(cancels["hedge"])
+                return p
+            if h is not None and h[0] == "ok":
+                self.metrics.count_hedge("won")
+                self._cancel_loser(cancels["primary"])
+                return h
+            # both (or the only) dispatch failed: prefer the fatal
+            # outcome — it must surface, not be retried away
+            tried.add(rep.replica_id)
+            if hedge_rep is not None and h is not None:
+                tried.add(hedge_rep.replica_id)
+            if p is not None and p[0] == "fatal":
+                return p
+            if h is not None and h[0] == "fatal":
+                return h
+            return p if p is not None else h
+
+    @staticmethod
+    def _cancel_loser(cancel_box: dict):
+        """Abort the losing hedge leg: mark it cancelled (so its
+        failure is not charged to the replica's breaker) and close
+        its in-flight response to stop the transfer."""
+        cancel_box["cancelled"] = True
+        resp = cancel_box.get("resp")
+        if resp is not None:
+            try:
+                resp.close()
+            except OSError:
+                pass
+
+    def _dispatch_once(self, rep: _Replica, body: bytes, n_req: int,
+                       deadline: Deadline, ctx, attempt: int,
+                       hedge: bool = False,
+                       cancel_box: Optional[dict] = None):
+        """One HTTP dispatch of an encoded batch to one replica,
+        classified: ``("ok", payload)``, ``("retry", reason)`` for a
+        shed/unavailable outcome another replica can absorb, or
+        ``("fatal", exc)`` for a mid-request death (work may have
+        executed — never silently re-run outside a hedge). Records
+        the outcome on the replica's breaker and, when traced, emits
+        the per-attempt ``router::forward`` span."""
+        remaining = deadline.remaining_ms()
+        suffix = "/submit_many" if remaining is None \
+            else f"/submit_many?timeout_ms={remaining}"
+        # the socket timeout is bounded by the budget too (plus slack
+        # for the worker's own typed rejection to travel back): a
+        # hung replica must not hold an already-dead request for the
+        # full FLAGS_fleet_request_timeout_s
+        http_timeout = None if remaining is None else \
+            min(self.request_timeout_s,
+                max(0.05, remaining / 1e3 + 0.25))
+        self._acquire(rep, n_req)
+        fctx = ctx.child() if ctx is not None else None
+        send_body = codec.attach_trace_trailer(
+            body, [fctx.to_traceparent()] * n_req) \
+            if fctx is not None else body
+        if remaining is not None:
+            send_body = codec.attach_deadline_trailer(
+                send_body, [remaining] * n_req)
+        span_status, span_err = "ok", None
+        t_wall = time.time_ns()
+        t0 = time.perf_counter()
+        try:
+            try:
+                resp = self._http(rep.url + suffix, data=send_body,
+                                  timeout=http_timeout,
+                                  ctype="application/x-paddle-fleet")
+                if cancel_box is not None:
+                    cancel_box["resp"] = resp
+                with resp:
+                    payload = resp.read()
+                ms = (time.perf_counter() - t0) * 1e3
+                rep.breaker.record(True, ms)
+                self.metrics.observe_latency(ms)
+                if ctx is not None:
+                    tracing.record_exemplar(
+                        "paddle_fleet_request_ms", ms, ctx.trace_id)
+                return ("ok", payload)
+            except urllib.error.HTTPError as e:
+                e.read()
+                rep.breaker.record(False)
+                if e.code == 429:   # replica shed the whole batch
+                    self.metrics.count_shed(str(rep.replica_id))
+                    reason = "queue_full"
+                elif e.code == 503:  # closed/not ready after all
+                    with self._lock:
+                        rep.ready = False
+                    reason = "unavailable"
+                else:
+                    span_status, span_err = "error", f"HTTP {e.code}"
+                    return ("fatal", ReplicaError(
+                        f"replica {rep.replica_id} returned HTTP "
+                        f"{e.code}"))
+                span_status, span_err = "error", reason
+                return ("retry", reason)
+            except (ConnectionRefusedError, urllib.error.URLError,
+                    ConnectionResetError, TimeoutError,
+                    ValueError, OSError) as e:
+                if cancel_box is not None and \
+                        cancel_box.get("cancelled"):
+                    # the hedge race was decided against this leg and
+                    # its connection was closed under it: not the
+                    # replica's fault, nothing to record or report
+                    span_status, span_err = "error", "hedge_cancelled"
+                    return ("retry", "cancelled")
+                # Refused before the request was read: nothing
+                # executed, safe to re-route. Anything after dispatch
+                # may have executed — fatal, don't double-run (a
+                # HEDGE may still cover it: duplicate execution of
+                # the idempotent batch path is explicitly allowed).
+                refused = isinstance(e, ConnectionRefusedError) or \
+                    isinstance(getattr(e, "reason", None),
+                               ConnectionRefusedError)
+                rep.breaker.record(False)
+                with self._lock:
+                    rep.alive = refused and rep.alive
+                    rep.ready = False
+                span_status = "error"
+                span_err = f"{type(e).__name__}: {e}"
+                if not refused:
+                    return ("fatal", ReplicaError(
+                        f"replica {rep.replica_id} died mid-request: "
+                        f"{type(e).__name__}: {e}"))
+                return ("retry", "unavailable")
+        finally:
+            self._release(rep, n_req)
+            if fctx is not None:
+                f_attrs = {"replica": str(rep.replica_id),
+                           "attempt": attempt}
+                if hedge:
+                    f_attrs["hedge"] = True
+                if span_err:
+                    f_attrs["error"] = span_err
+                tracing.record_span(
+                    fctx, "router::forward", stage="forward",
+                    start_unix_ns=t_wall,
+                    duration_ms=(time.perf_counter() - t0) * 1e3,
+                    status=span_status, attrs=f_attrs, root=True)
 
     # ------------------------------------------------------ client API
     def submit(self, feed, timeout_ms: Optional[float] = None):
@@ -450,11 +741,14 @@ class FleetRouter:
         # trace — the single-request submit() case is the 1:1 trace
         # the /tracez recipe documents
         ctx = tracing.request_context()
+        # the deadline budget clock also starts HERE, on the caller's
+        # thread — router-pool queueing time is part of the budget
+        deadline = Deadline(timeout_ms)
 
         def _run():
             try:
                 payload = self._traced_forward(body, len(norm),
-                                               timeout_ms, ctx)
+                                               deadline, ctx)
                 results = codec.decode_results(payload)
                 if len(results) != len(futs):
                     raise ReplicaError(
@@ -479,10 +773,21 @@ class FleetRouter:
     def submit_generate(self, prompt, max_new_tokens: int = 32,
                         temperature: float = 0.0,
                         timeout_ms: Optional[float] = None,
-                        seed: Optional[int] = None) -> StreamingFuture:
+                        seed: Optional[int] = None,
+                        deadline_ms: Optional[float] = None
+                        ) -> StreamingFuture:
         """Fleet-wide ``GenerationServer.submit_generate``: tokens
         stream back through the returned future as the chosen
-        replica's decode loop emits them."""
+        replica's decode loop emits them. ``timeout_ms`` is the
+        replica-side SCHEDULING deadline (queued too long = dropped
+        unrun); ``deadline_ms`` is the end-to-end HARD budget — the
+        router deducts its own elapsed time before dispatch and the
+        engine evicts the stream (pages freed) when the budget
+        expires mid-generation. ``cancel()`` on the returned future
+        propagates to the replica: the stream connection is closed so
+        the engine evicts the sequence instead of decoding into a
+        dead socket. Never hedged — a token stream is not
+        idempotent."""
         if self._closed:
             raise ServerClosedError("router is shut down")
         fut = StreamingFuture()
@@ -495,20 +800,22 @@ class FleetRouter:
             "timeout_ms": timeout_ms, "seed": seed}
         if gctx is not None:
             req["traceparent"] = gctx.to_traceparent()
-        body = json.dumps(req).encode()
+        deadline = Deadline(deadline_ms)
         self.metrics.count("routed")
-        self._pool.submit(self._run_generate_traced, body, fut, gctx)
+        self._pool.submit(self._run_generate_traced, req, fut, gctx,
+                          deadline)
         return fut
 
-    def _run_generate_traced(self, body: bytes, fut: StreamingFuture,
-                             gctx=None):
+    def _run_generate_traced(self, req: dict, fut: StreamingFuture,
+                             gctx=None,
+                             deadline: Optional[Deadline] = None):
         """``_run_generate`` under a ``router::generate`` root span
         whose status mirrors the stream's outcome."""
         if gctx is None:
-            return self._run_generate(body, fut)
+            return self._run_generate(req, fut, deadline)
         t_wall = time.time_ns()
         t0 = time.perf_counter()
-        self._run_generate(body, fut)
+        self._run_generate(req, fut, deadline)
         exc = fut.exception()
         reason = fut.finish_reason
         attrs = {"router": self.name,
@@ -522,9 +829,18 @@ class FleetRouter:
             status="error" if exc is not None else "ok",
             attrs=attrs, root=True)
 
-    def _run_generate(self, body: bytes, fut: StreamingFuture):
+    def _run_generate(self, req: dict, fut: StreamingFuture,
+                      deadline: Optional[Deadline] = None):
+        deadline = deadline or Deadline.never()
         tried: set = set()
         for attempt in range(self.retries + 1):
+            if deadline.expired():
+                self.metrics.count_deadline_reject("router")
+                self.metrics.count("failed")
+                fut._fail(DeadlineExceededError(
+                    "deadline budget exhausted at the router"),
+                    reason="deadline")
+                return
             rep = self._pick(tried)
             if rep is None:
                 tried = set()
@@ -534,28 +850,58 @@ class FleetRouter:
                 fut._fail(NoReadyReplicaError("no ready replica"),
                           reason="shed")
                 return
+            # the replica sees what is LEFT of the budget, not what
+            # the caller started with — elapsed router time (queueing,
+            # earlier attempts, backoff) is already deducted
+            body_req = dict(req)
+            remaining = deadline.remaining_ms()
+            if remaining is not None:
+                body_req["deadline_ms"] = remaining
+            body = json.dumps(body_req).encode()
             self._acquire(rep, 1)
             emitted = False
             try:
-                with self._http(rep.url + "/generate", data=body,
-                                ctype="application/json") as resp:
-                    for line in resp:
-                        if fut._cancel_requested:
-                            fut._finish("cancelled")
-                            return
-                        ev = json.loads(line)
-                        if ev.get("done"):
-                            reason = ev.get("finish_reason", "eos")
-                            if ev.get("error"):
-                                fut._fail(
-                                    ReplicaError(ev["error"]),
-                                    reason="error")
-                            else:
-                                fut._finish(reason)
-                            self.metrics.count("completed")
-                            return
-                        emitted = True
-                        fut._emit(int(ev["t"]))
+                resp = self._http(rep.url + "/generate", data=body,
+                                  ctype="application/json")
+                # cancel propagation: closing the stream's socket is
+                # the cancel signal the replica can actually observe —
+                # its next token write fails, the worker cancels the
+                # engine future, the engine evicts the sequence and
+                # frees its pages
+                fut._set_cancel_hook(resp.close)
+                try:
+                    with resp:
+                        for line in resp:
+                            if fut._cancel_requested:
+                                fut._finish("cancelled")
+                                return
+                            ev = json.loads(line)
+                            if ev.get("done"):
+                                reason = ev.get("finish_reason",
+                                                "eos")
+                                if ev.get("error"):
+                                    # deadline evictions stay TYPED
+                                    # across the wire, like the
+                                    # batch codec's status codes
+                                    exc = DeadlineExceededError(
+                                        ev["error"]) \
+                                        if reason == "deadline" \
+                                        else ReplicaError(ev["error"])
+                                    fut._fail(exc, reason=reason)
+                                else:
+                                    fut._finish(reason)
+                                self.metrics.count("completed")
+                                rep.breaker.record(True)
+                                return
+                            emitted = True
+                            fut._emit(int(ev["t"]))
+                finally:
+                    fut._set_cancel_hook(None)
+                if fut._cancel_requested:
+                    # the cancel hook closed the socket under the
+                    # reader: a clean cancellation, not a dead replica
+                    fut._finish("cancelled")
+                    return
                 # stream closed without a terminal event: the replica
                 # died mid-stream
                 raise ReplicaError(
@@ -563,6 +909,7 @@ class FleetRouter:
                     f"mid-generation")
             except urllib.error.HTTPError as e:
                 e.read()
+                rep.breaker.record(False)
                 if e.code in (429, 503) and not emitted:
                     self.metrics.count_retry(
                         "queue_full" if e.code == 429
@@ -570,6 +917,7 @@ class FleetRouter:
                     if e.code == 429:
                         self.metrics.count_shed(str(rep.replica_id))
                     tried.add(rep.replica_id)
+                    self._backoff_sleep(attempt + 1, deadline)
                     continue
                 self.metrics.count("failed")
                 fut._fail(QueueFullError(f"HTTP {e.code}")
@@ -580,6 +928,11 @@ class FleetRouter:
             except BaseException as e:  # noqa: BLE001 - tokens may
                 # already be consumed: never silently re-run the
                 # stream on another replica
+                if fut._cancel_requested:
+                    # socket torn down by the cancel hook mid-read
+                    fut._finish("cancelled")
+                    return
+                rep.breaker.record(False)
                 if not emitted and isinstance(
                         e, (ConnectionRefusedError,
                             urllib.error.URLError)):
@@ -587,6 +940,7 @@ class FleetRouter:
                         rep.ready = False
                     self.metrics.count_retry("unavailable")
                     tried.add(rep.replica_id)
+                    self._backoff_sleep(attempt + 1, deadline)
                     continue
                 self.metrics.count("failed")
                 fut._fail(ReplicaError(
@@ -685,12 +1039,14 @@ class FleetRouter:
     # ------------------------------------------------------ inspection
     def replica_states(self) -> List[dict]:
         with self._lock:
-            return [{"replica": str(r.replica_id), "url": r.url,
-                     "ready": r.ready, "alive": r.alive,
-                     "draining": r.draining,
-                     "outstanding": r.outstanding,
-                     "version": r.version}
-                    for r in self._replicas.values()]
+            reps = list(self._replicas.values())
+        return [{"replica": str(r.replica_id), "url": r.url,
+                 "ready": r.ready, "alive": r.alive,
+                 "draining": r.draining,
+                 "outstanding": r.outstanding,
+                 "version": r.version,
+                 "breaker": r.breaker.snapshot()}
+                for r in reps]
 
     def metrics_snapshot(self) -> dict:
         return self.metrics.snapshot()
@@ -972,6 +1328,16 @@ class _RouterHandler(BaseHTTPRequestHandler):
                     if part.startswith("timeout_ms="):
                         timeout_ms = \
                             float(part.split("=", 1)[1]) or None
+                # external ingress deadline: the x-paddle-deadline-ms
+                # header is the caller's REMAINING budget (the header
+                # twin of the codec deadline trailer); it wins over
+                # the scheduling timeout as the propagated budget
+                hdr = self.headers.get("x-paddle-deadline-ms")
+                if hdr:
+                    try:
+                        timeout_ms = float(hdr) or None
+                    except ValueError:
+                        pass
                 n_req = codec.peek_batch_size(body)
                 # external ingress: honor the caller's traceparent
                 # header, else make the head-sampling decision here
@@ -985,6 +1351,8 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 self._generate(body)
             else:
                 self._send(404, b"not found\n", "text/plain")
+        except DeadlineExceededError as e:
+            self._send(504, f"{e}\n".encode(), "text/plain")
         except NoReadyReplicaError as e:
             self._send(503, f"{e}\n".encode(), "text/plain")
         except QueueFullError as e:
@@ -1003,13 +1371,22 @@ class _RouterHandler(BaseHTTPRequestHandler):
         ctx = tracing.parse_traceparent(
             req.get("traceparent")
             or self.headers.get("traceparent"))
+        deadline_ms = req.get("deadline_ms")
+        if deadline_ms is None:
+            hdr = self.headers.get("x-paddle-deadline-ms")
+            if hdr:
+                try:
+                    deadline_ms = float(hdr) or None
+                except ValueError:
+                    pass
         with tracing.use_context(ctx):
             fut = self._router.submit_generate(
                 req["prompt"],
                 max_new_tokens=int(req.get("max_new_tokens", 32)),
                 temperature=float(req.get("temperature", 0.0)),
                 timeout_ms=req.get("timeout_ms"),
-                seed=req.get("seed"))
+                seed=req.get("seed"),
+                deadline_ms=deadline_ms)
         self.send_response(200)
         self.send_header("Content-Type", "application/x-ndjson")
         self.end_headers()
@@ -1024,9 +1401,11 @@ class _RouterHandler(BaseHTTPRequestHandler):
         except BrokenPipeError:
             fut.cancel()
         except BaseException as e:  # noqa: BLE001 - stream the error
+            reason = "deadline" \
+                if isinstance(e, DeadlineExceededError) else "error"
             try:
                 self.wfile.write(json.dumps(
-                    {"done": True, "finish_reason": "error",
+                    {"done": True, "finish_reason": reason,
                      "error": f"{type(e).__name__}: {e}"}).encode()
                     + b"\n")
             except OSError:

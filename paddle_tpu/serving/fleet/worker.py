@@ -37,6 +37,17 @@ Control plane (JSON):
   requests never see the swap.
 - ``POST /shutdown`` — graceful exit.
 
+Resilience (resilience.py, PR 15): the worker consumes the codec
+DEADLINE trailer — a request whose budget is already exhausted when
+the batch arrives is answered with ``DeadlineExceededError`` WITHOUT
+ever being dispatched to the device — and hosts the DEVICE-WEDGE
+WATCHDOG: backends bracket device work on a ``WedgeMonitor``; a
+dispatch in flight longer than ``FLAGS_fleet_wedge_timeout_ms`` flips
+``/readyz`` to not-ready, fails requests waiting on the device with
+the typed ``ReplicaWedgedError``, and requests shutdown so the
+supervisor's respawn (a warm start) replaces the wedged process — a
+silent hang becomes a bounded, observable failure.
+
 ``ThreadReplicaFactory`` runs the same app+backend on a thread in the
 current process — the tier-1 test double and the single-process
 deployment mode; the wire protocol and routing logic are identical.
@@ -53,12 +64,14 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from ...observability import tracing
-from ..request import QueueFullError, ServerClosedError
+from ..request import (DeadlineExceededError, QueueFullError,
+                       ServerClosedError)
 from . import codec
+from .resilience import ReplicaWedgedError, WedgeMonitor, WedgeWatchdog
 
 __all__ = ["ReplicaApp", "PredictorBackend", "StubBackend",
            "ThreadReplicaFactory", "write_announce_file",
-           "read_announce_file"]
+           "read_announce_file", "arm_wedge_watchdog"]
 
 
 def _flag(name, default):
@@ -96,6 +109,85 @@ def read_announce_file(path: str) -> Optional[dict]:
         return None
 
 
+class _WorkerMetrics:
+    """Worker-process-side resilience counters on the default
+    registry (the router's merged /metrics re-labels them with
+    ``replica="<id>"``)."""
+
+    def __init__(self):
+        from ...observability.registry import default_registry
+        reg = default_registry()
+        name = tracing.process_name()
+        self._deadline = reg.counter(
+            "paddle_fleet_worker_deadline_rejects_total",
+            "requests answered DeadlineExceededError at the worker "
+            "without device dispatch (budget exhausted on arrival)",
+            ("replica",)).labels(replica=name)
+        self._wedges = reg.counter(
+            "paddle_fleet_wedge_events_total",
+            "device-wedge watchdog firings (dispatch exceeded "
+            "FLAGS_fleet_wedge_timeout_ms)",
+            ("replica",)).labels(replica=name)
+        self._wedged = reg.gauge(
+            "paddle_fleet_wedged",
+            "1 after the watchdog declared this replica's device "
+            "wedged (readiness stays red until restart)",
+            ("replica",)).labels(replica=name)
+
+    def count_deadline_reject(self, n: int = 1):
+        self._deadline.inc(n)
+
+    def count_wedge(self):
+        self._wedges.inc()
+        self._wedged.set(1)
+
+
+_WM_LOCK = threading.Lock()
+_WM: Optional[_WorkerMetrics] = None
+
+
+def _worker_metrics() -> _WorkerMetrics:
+    global _WM
+    with _WM_LOCK:
+        if _WM is None:
+            _WM = _WorkerMetrics()
+        return _WM
+
+
+def arm_wedge_watchdog(backend, app: "ReplicaApp", *,
+                       timeout_ms: Optional[float] = None,
+                       restart: bool = True,
+                       name: Optional[str] = None
+                       ) -> Optional[WedgeWatchdog]:
+    """Attach the device-wedge watchdog to a backend exposing a
+    ``wedge_monitor``: on firing it (1) marks the backend wedged so
+    ``/readyz`` flips not-ready and device-lock waiters fail with
+    ``ReplicaWedgedError``, (2) counts the event, and (3) with
+    ``restart``, requests app shutdown so the worker process exits
+    and the supervisor's respawn (a warm start) replaces it. Returns
+    None when the backend has no monitor or the timeout disables the
+    watchdog."""
+    monitor = getattr(backend, "wedge_monitor", None)
+    if monitor is None:
+        return None
+
+    def _on_wedge():
+        _worker_metrics().count_wedge()
+        mark = getattr(backend, "mark_wedged", None)
+        if mark is not None:
+            mark()
+        if restart:
+            app._request_shutdown()
+
+    wd = WedgeWatchdog(
+        monitor, timeout_ms=timeout_ms, on_wedge=_on_wedge,
+        name=name or tracing.process_name())
+    if not wd.enabled:
+        return None
+    app.watchdog = wd
+    return wd.start()
+
+
 # ---------------------------------------------------------------- backends
 class PredictorBackend:
     """The real replica backend: a ``Predictor`` loaded from a
@@ -125,6 +217,7 @@ class PredictorBackend:
         self._lock = threading.Lock()
         self._reloading = False
         self._gen = None
+        self.wedge_monitor = WedgeMonitor()
         self._server, self._version = self._build(model_prefix)
         if generation_model is not None:
             from ..generation import GenerationServer
@@ -148,19 +241,38 @@ class PredictorBackend:
     # ---- service surface ----
     def submit_many(self, feeds_list, timeout_ms=None,
                     trace_contexts=None):
-        return self._server.submit_many(feeds_list,
+        futs = self._server.submit_many(feeds_list,
                                         timeout_ms=timeout_ms,
                                         trace_contexts=trace_contexts)
+        # wedge ledger: one in-flight entry per batch, closed when the
+        # LAST future resolves — a batch that never resolves is the
+        # hang signature the watchdog fires on
+        if futs:
+            token = self.wedge_monitor.begin()
+            pending = {"n": len(futs)}
+            plock = threading.Lock()
+
+            def _done(_):
+                with plock:
+                    pending["n"] -= 1
+                    last = pending["n"] == 0
+                if last:
+                    self.wedge_monitor.end(token)
+
+            for f in futs:
+                f.add_done_callback(_done)
+        return futs
 
     def generate(self, prompt, max_new_tokens, temperature, timeout_ms,
-                 seed):
+                 seed, deadline_ms=None):
         if self._gen is None:
             raise RuntimeError("this replica hosts no generation "
                                "engine (start it with a generation "
                                "model)")
         return self._gen.submit_generate(
             prompt, max_new_tokens=max_new_tokens,
-            temperature=temperature, timeout_ms=timeout_ms, seed=seed)
+            temperature=temperature, timeout_ms=timeout_ms, seed=seed,
+            deadline_ms=deadline_ms)
 
     def warmup(self) -> int:
         """Warm per ``warmup_mode``: "manifest" replays the persisted
@@ -257,6 +369,7 @@ class StubBackend:
                  version: str = "v0",
                  crash_value: Optional[float] = None,
                  crash_mode: str = "drop",
+                 hang_value: Optional[float] = None,
                  token_ms: Optional[float] = None):
         self.device_ms = float(device_ms)
         self.max_batch = int(max_batch)
@@ -264,6 +377,10 @@ class StubBackend:
         self.warmup_s = float(warmup_s)
         self.crash_value = crash_value
         self.crash_mode = crash_mode
+        # hang trigger: a feed matching this value wedges the device —
+        # the dispatch holds the device lock and never completes (the
+        # watchdog's detection target), unlike crash_value's clean exit
+        self.hang_value = hang_value
         self.token_ms = (float(token_ms) if token_ms is not None
                          else self.device_ms / 4.0)
         self._lock = threading.Lock()
@@ -271,9 +388,12 @@ class StubBackend:
         self._outstanding = 0
         self._warmed = False
         self._alive = True
+        self._wedged = threading.Event()
+        self._hang = threading.Event()
         self._version = str(version)
         self._scale = self._scale_of(version)
         self.dispatches = 0
+        self.wedge_monitor = WedgeMonitor()
 
     @staticmethod
     def _scale_of(version: str) -> float:
@@ -295,6 +415,33 @@ class StubBackend:
                         os._exit(17)
                     raise _ConnectionDrop("stub crash trigger")
 
+    def mark_wedged(self):
+        """Watchdog hook: flip readiness red and wake every thread
+        parked on the device lock with the typed error."""
+        self._wedged.set()
+
+    def _maybe_hang(self, feeds_list):
+        if self.hang_value is None:
+            return
+        for feeds in feeds_list:
+            for a in feeds:
+                flat = np.asarray(a).ravel()
+                if flat.size and float(flat[0]) == self.hang_value:
+                    self._hang.set()
+
+    def _device_acquire(self):
+        """Wedge-aware device wait: threads queued behind a hung
+        dispatch fail with ``ReplicaWedgedError`` the moment the
+        watchdog declares the wedge, instead of blocking forever."""
+        while not self._device.acquire(timeout=0.05):
+            if self._wedged.is_set():
+                raise ReplicaWedgedError(
+                    "device wedged: dispatch queued behind a hung "
+                    "step, replica restarting")
+            with self._lock:
+                if not self._alive:
+                    raise ServerClosedError("stub backend crashed")
+
     def submit_many(self, feeds_list, timeout_ms=None,
                     trace_contexts=None):
         import concurrent.futures
@@ -302,6 +449,9 @@ class StubBackend:
         with self._lock:
             if not self._alive:
                 raise ServerClosedError("stub backend crashed")
+            if self._wedged.is_set():
+                raise ReplicaWedgedError(
+                    "device wedged, replica restarting")
             if self._outstanding + n > self.queue_capacity:
                 raise QueueFullError(
                     f"stub at capacity ({self.queue_capacity})")
@@ -309,11 +459,34 @@ class StubBackend:
             scale = self._scale
         try:
             self._maybe_crash(feeds_list)
+            self._maybe_hang(feeds_list)
             batches = -(-n // self.max_batch)
-            with self._device:     # one device: dispatches serialize
+            self._device_acquire()  # one device: dispatches serialize
+            token = self.wedge_monitor.begin()
+            try:
+                if self._hang.is_set():
+                    # the wedge: hold the device without completing
+                    # until the watchdog fires (or shutdown). The
+                    # hung dispatch then DROPS its connection (like
+                    # the restarting process it emulates) rather than
+                    # answering — a typed 503 would invite the router
+                    # to retry the wedge-triggering request onto a
+                    # healthy replica and cascade the wedge; only the
+                    # WAITERS (which never executed) answer with the
+                    # re-routable ReplicaWedgedError
+                    while not self._wedged.is_set():
+                        with self._lock:
+                            if not self._alive:
+                                raise ServerClosedError(
+                                    "stub backend crashed")
+                        time.sleep(0.01)
+                    raise _ConnectionDrop("device wedged mid-dispatch")
                 time.sleep(self.device_ms * batches / 1e3)
                 with self._lock:
                     self.dispatches += batches
+            finally:
+                self.wedge_monitor.end(token)
+                self._device.release()
             futs = []
             for feeds in feeds_list:
                 f = concurrent.futures.Future()
@@ -326,15 +499,23 @@ class StubBackend:
                 self._outstanding -= n
 
     def generate(self, prompt, max_new_tokens, temperature, timeout_ms,
-                 seed):
+                 seed, deadline_ms=None):
         from ..generation.engine import StreamingFuture
         fut = StreamingFuture()
         prompt = np.asarray(prompt).ravel()
         base = int(prompt[-1]) if prompt.size else 0
+        hard_deadline = (time.monotonic() + float(deadline_ms) / 1e3
+                         if deadline_ms else None)
 
         def _stream():
             for i in range(int(max_new_tokens)):
                 time.sleep(self.token_ms / 1e3)
+                if hard_deadline is not None and \
+                        time.monotonic() > hard_deadline:
+                    fut._fail(DeadlineExceededError(
+                        "deadline budget expired mid-stream"),
+                        reason="deadline")
+                    return
                 fut._emit((base + 1 + i) % 50000)
                 if fut._cancel_requested:
                     fut._finish("cancelled")
@@ -344,6 +525,31 @@ class StubBackend:
         threading.Thread(target=_stream, daemon=True).start()
         return fut
 
+    def chaos(self, spec: dict) -> dict:
+        """Runtime fault injection (the /chaos control plane the
+        chaos harness drives): ``{"device_ms": X}`` inflates per-batch
+        device latency (the slow-replica fault), ``{"capacity": N}``
+        resizes the shed threshold (0 = reject storm),
+        ``{"hang": true}`` wedges the device, ``{"restore": true}``
+        lifts latency/capacity faults. Returns the live settings."""
+        with self._lock:
+            if spec.get("restore"):
+                self.device_ms = float(spec.get(
+                    "device_ms", self.device_ms))
+                self.queue_capacity = int(spec.get(
+                    "capacity", self.queue_capacity))
+            else:
+                if "device_ms" in spec:
+                    self.device_ms = float(spec["device_ms"])
+                if "capacity" in spec:
+                    self.queue_capacity = int(spec["capacity"])
+        if spec.get("hang"):
+            self._hang.set()
+        return {"device_ms": self.device_ms,
+                "capacity": self.queue_capacity,
+                "hang": self._hang.is_set(),
+                "wedged": self._wedged.is_set()}
+
     def warmup(self) -> int:
         if self.warmup_s:
             time.sleep(self.warmup_s)
@@ -352,10 +558,14 @@ class StubBackend:
         return 0
 
     def ready(self) -> bool:
+        if self._wedged.is_set():
+            return False
         with self._lock:
             return self._warmed and self._alive
 
     def health(self):
+        if self._wedged.is_set():
+            return False, "wedged"
         with self._lock:
             if not self._alive:
                 return False, "crashed"
@@ -452,11 +662,14 @@ class _ReplicaHandler(BaseHTTPRequestHandler):
                 self._send_json(200 if ok else 503,
                                 {"ok": ok, "info": info})
             elif path == "/readyz":
-                ready = self._backend.ready()
-                self._send_json(
-                    200 if ready else 503,
-                    {"ready": ready,
-                     "version": self._backend.info().get("version")})
+                wd = getattr(self.server.app, "watchdog", None)
+                wedged = wd is not None and wd.wedged
+                ready = self._backend.ready() and not wedged
+                body = {"ready": ready,
+                        "version": self._backend.info().get("version")}
+                if wedged:
+                    body["wedged"] = True
+                self._send_json(200 if ready else 503, body)
             elif path == "/metrics":
                 from ...observability import (default_registry,
                                               prometheus_text)
@@ -488,6 +701,16 @@ class _ReplicaHandler(BaseHTTPRequestHandler):
                 req = json.loads(self._body() or b"{}")
                 version = self._backend.reload(req["model_prefix"])
                 self._send_json(200, {"ok": True, "version": version})
+            elif path == "/chaos":
+                # stub-only fault-injection control plane (the chaos
+                # harness drives slow/reject/hang at runtime)
+                chaos = getattr(self._backend, "chaos", None)
+                if chaos is None:
+                    self._send(501, b"backend has no chaos surface\n",
+                               "text/plain")
+                else:
+                    self._send_json(200, chaos(
+                        json.loads(self._body() or b"{}")))
             elif path == "/shutdown":
                 self._send_json(200, {"ok": True})
                 self.server.app._request_shutdown()  # type: ignore
@@ -502,6 +725,10 @@ class _ReplicaHandler(BaseHTTPRequestHandler):
                 pass
         except QueueFullError as e:
             self._send(429, f"{e}\n".encode(), "text/plain")
+        except ReplicaWedgedError as e:
+            # wedged = unavailable for anything not already riding the
+            # hung dispatch: 503 so the router re-routes safely
+            self._send(503, f"{e}\n".encode(), "text/plain")
         except ServerClosedError as e:
             self._send(503, f"{e}\n".encode(), "text/plain")
         except Exception as e:  # noqa: BLE001 - fault barrier for the
@@ -517,35 +744,71 @@ class _ReplicaHandler(BaseHTTPRequestHandler):
         for part in query.split("&"):
             if part.startswith("timeout_ms="):
                 timeout_ms = float(part.split("=", 1)[1]) or None
-        feeds_list, traceparents = codec.decode_batch_ex(self._body())
+        feeds_list, traceparents, deadlines = \
+            codec.decode_batch_trailers(self._body())
         ctxs = [tracing.parse_traceparent(tp) if tp else None
                 for tp in (traceparents or [])] or None
-        lead = next((c for c in (ctxs or []) if c is not None), None)
-        if lead is None:
-            futs = self._backend.submit_many(feeds_list,
-                                             timeout_ms=timeout_ms)
-            results = self._collect(futs)
-        else:
-            # one worker-side span per handled batch; requests in the
-            # same trace re-parent under it so the stitched view shows
-            # router -> worker -> engine stages
-            with tracing.start_span(
-                    "worker::submit_many", stage="worker", ctx=lead,
-                    attrs={"n_req": len(feeds_list),
-                           "replica": self._backend.info().get(
-                               "name") or self._backend.info().get(
-                               "version", "")}) as sp:
-                ctxs = [sp.ctx if (c is not None and
-                                   c.trace_id == sp.ctx.trace_id)
-                        else c for c in ctxs]
+        # deadline gate BEFORE dispatch: a request whose budget is
+        # already exhausted on arrival is answered now and never
+        # reaches the device — expiry-at-the-batcher was the only
+        # check before deadline propagation landed
+        slots: List[Optional[BaseException]] = [None] * len(feeds_list)
+        if deadlines is not None:
+            expired = [i for i, ms in enumerate(deadlines)
+                       if ms is not None and ms <= 0.0]
+            for i in expired:
+                slots[i] = DeadlineExceededError(
+                    "deadline budget exhausted before worker "
+                    "dispatch")
+            if expired:
+                _worker_metrics().count_deadline_reject(len(expired))
+                keep = [i for i in range(len(feeds_list))
+                        if slots[i] is None]
+                feeds_list = [feeds_list[i] for i in keep]
+                if ctxs is not None:
+                    ctxs = [ctxs[i] for i in keep]
+            live = [ms for ms in deadlines if ms is not None
+                    and ms > 0.0]
+            if live:
+                # the replica-side scheduling timeout honors the
+                # tightest surviving budget
+                tight = min(live)
+                timeout_ms = tight if timeout_ms is None \
+                    else min(timeout_ms, tight)
+        if feeds_list:
+            lead = next((c for c in (ctxs or []) if c is not None),
+                        None)
+            if lead is None:
                 futs = self._backend.submit_many(
-                    feeds_list, timeout_ms=timeout_ms,
-                    trace_contexts=ctxs)
+                    feeds_list, timeout_ms=timeout_ms)
                 results = self._collect(futs)
-                if any(isinstance(res, BaseException)
-                       for res in results):
-                    sp.set_attr("partial_failure", True)
-        self._send(200, codec.encode_results(results),
+            else:
+                # one worker-side span per handled batch; requests in
+                # the same trace re-parent under it so the stitched
+                # view shows router -> worker -> engine stages
+                with tracing.start_span(
+                        "worker::submit_many", stage="worker",
+                        ctx=lead,
+                        attrs={"n_req": len(feeds_list),
+                               "replica": self._backend.info().get(
+                                   "name") or self._backend.info().get(
+                                   "version", "")}) as sp:
+                    ctxs = [sp.ctx if (c is not None and
+                                       c.trace_id == sp.ctx.trace_id)
+                            else c for c in ctxs]
+                    futs = self._backend.submit_many(
+                        feeds_list, timeout_ms=timeout_ms,
+                        trace_contexts=ctxs)
+                    results = self._collect(futs)
+                    if any(isinstance(res, BaseException)
+                           for res in results):
+                        sp.set_attr("partial_failure", True)
+        else:
+            results = []
+        it = iter(results)
+        merged = [slot if slot is not None else next(it)
+                  for slot in slots]
+        self._send(200, codec.encode_results(merged),
                    "application/x-paddle-fleet")
 
     def _collect(self, futs):
@@ -568,7 +831,8 @@ class _ReplicaHandler(BaseHTTPRequestHandler):
                 np.asarray(req["prompt"], np.int64),
                 int(req.get("max_new_tokens", 32)),
                 float(req.get("temperature", 0.0)),
-                req.get("timeout_ms"), req.get("seed"))
+                req.get("timeout_ms"), req.get("seed"),
+                deadline_ms=req.get("deadline_ms"))
         # close-delimited stream: one JSON line per token event, then
         # the terminal line with the finish reason
         self.send_response(200)
@@ -585,9 +849,11 @@ class _ReplicaHandler(BaseHTTPRequestHandler):
         except BrokenPipeError:
             fut.cancel()        # client went away: stop generating
         except BaseException as e:  # noqa: BLE001 - stream the error
+            reason = "deadline" \
+                if isinstance(e, DeadlineExceededError) else "error"
             try:
                 self.wfile.write(json.dumps(
-                    {"done": True, "finish_reason": "error",
+                    {"done": True, "finish_reason": reason,
                      "error": f"{type(e).__name__}: {e}"}).encode()
                     + b"\n")
             except OSError:
@@ -611,6 +877,7 @@ class ReplicaApp:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._shutdown_requested = threading.Event()
+        self.watchdog: Optional[WedgeWatchdog] = None
 
     @property
     def port(self) -> Optional[int]:
@@ -734,6 +1001,13 @@ def _parse_args(argv):
     ap.add_argument("--stub-crash-value", type=float, default=None)
     ap.add_argument("--stub-crash-mode", default="exit",
                     choices=("exit", "drop"))
+    ap.add_argument("--stub-hang-value", type=float, default=None,
+                    help="a feed matching this value wedges the "
+                         "stub's device (the dispatch never "
+                         "completes; the wedge watchdog's target)")
+    ap.add_argument("--wedge-timeout-ms", type=float, default=None,
+                    help="device-wedge watchdog timeout (default: "
+                         "FLAGS_fleet_wedge_timeout_ms; <= 0 off)")
     return ap.parse_args(argv)
 
 
@@ -746,7 +1020,8 @@ def _build_backend(args):
             warmup_s=args.stub_warmup_s,
             version=args.stub_version,
             crash_value=args.stub_crash_value,
-            crash_mode=args.stub_crash_mode)
+            crash_mode=args.stub_crash_mode,
+            hang_value=args.stub_hang_value)
     if not args.model_prefix:
         raise SystemExit("worker: need --model-prefix or --stub")
     gen_model = None
@@ -774,6 +1049,10 @@ def main(argv=None) -> int:
     backend = _build_backend(args)
     app = ReplicaApp(backend, host=args.host,
                      port=args.port).start()
+    # the watchdog turns a wedged device into a bounded failure: flip
+    # readiness, fail device waiters, exit — the supervisor respawns
+    arm_wedge_watchdog(backend, app,
+                       timeout_ms=args.wedge_timeout_ms)
     if args.announce:
         write_announce_file(args.announce, app.port)
 

@@ -104,6 +104,7 @@ class StreamingFuture:
         self._done = False
         self._finish_reason: Optional[str] = None
         self._cancel_requested = False
+        self._on_cancel = None
 
     # ---- consumer ----
     def __iter__(self):
@@ -153,12 +154,35 @@ class StreamingFuture:
     def cancel(self) -> bool:
         """Request eviction; returns False when already finished. The
         engine honors it at its next harvest — tokens already emitted
-        stay available."""
+        stay available. A registered cancel hook (the fleet router's
+        socket-close propagation) fires outside the lock, so a routed
+        stream's cancellation reaches the replica instead of only
+        stopping client-side iteration."""
         with self._cond:
             if self._done:
                 return False
             self._cancel_requested = True
-            return True
+            hook = self._on_cancel
+        if hook is not None:
+            try:
+                hook()
+            except Exception:  # noqa: BLE001 - propagation is best-
+                pass           # effort; local cancel already holds
+        return True
+
+    def _set_cancel_hook(self, hook):
+        """Install/clear the propagation hook; when cancellation was
+        already requested, fire immediately (the cancel raced the
+        hook installation)."""
+        with self._cond:
+            self._on_cancel = hook
+            fire = hook is not None and self._cancel_requested \
+                and not self._done
+        if fire:
+            try:
+                hook()
+            except Exception:  # noqa: BLE001 - as above
+                pass
 
     def cancelled(self) -> bool:
         with self._cond:
@@ -190,11 +214,13 @@ class StreamingFuture:
 
 class _Request:
     __slots__ = ("prompt", "max_new", "temperature", "rng", "future",
-                 "submit_t", "deadline", "trace", "t_wall_ns")
+                 "submit_t", "deadline", "hard_deadline", "trace",
+                 "t_wall_ns")
 
     def __init__(self, prompt: np.ndarray, max_new: int,
                  temperature: float, seed: Optional[int],
-                 timeout_ms: Optional[float], trace=None):
+                 timeout_ms: Optional[float], trace=None,
+                 deadline_ms: Optional[float] = None):
         self.prompt = prompt
         self.max_new = int(max_new)
         self.temperature = float(temperature)
@@ -203,6 +229,11 @@ class _Request:
         self.submit_t = time.monotonic()
         self.deadline = (self.submit_t + timeout_ms / 1e3
                          if timeout_ms else None)
+        # the HARD end-to-end budget (fleet deadline propagation): an
+        # in-flight stream past it is evicted at batch re-form, unlike
+        # the scheduling-only ``deadline`` above
+        self.hard_deadline = (self.submit_t + deadline_ms / 1e3
+                              if deadline_ms else None)
         # trace identity (tracing.TraceContext child whose span id is
         # the generate::request root span); warmup never builds a
         # _Request, so warmup traffic is structurally untraced
@@ -210,7 +241,13 @@ class _Request:
         self.t_wall_ns = time.time_ns() if trace is not None else 0
 
     def expired(self, now: float) -> bool:
-        return self.deadline is not None and now > self.deadline
+        if self.deadline is not None and now > self.deadline:
+            return True
+        return self.hard_expired(now)
+
+    def hard_expired(self, now: float) -> bool:
+        return self.hard_deadline is not None and \
+            now > self.hard_deadline
 
 
 class _ActiveSeq:
@@ -711,13 +748,21 @@ class GenerationServer:
     def submit_generate(self, prompt, max_new_tokens: int = 32,
                         temperature: float = 0.0,
                         timeout_ms: Optional[float] = None,
-                        seed: Optional[int] = None) -> StreamingFuture:
+                        seed: Optional[int] = None,
+                        deadline_ms: Optional[float] = None
+                        ) -> StreamingFuture:
         """Enqueue one prompt; returns the token stream. ``timeout_ms``
         is a SCHEDULING deadline (like ``InferenceServer.submit``): a
         request still queued past it fails with DeadlineExceededError;
-        once prefilled, the stream always runs to completion. Raises
-        QueueFullError at capacity, ServerClosedError after shutdown,
-        ValueError for prompts that leave no room to generate."""
+        once prefilled, the stream always runs to completion.
+        ``deadline_ms`` is the HARD end-to-end budget (fleet deadline
+        propagation): a stream still decoding past it is EVICTED at
+        the next batch re-form — its pages return to the free list and
+        its future fails with DeadlineExceededError (tokens already
+        emitted stay available) — instead of burning decode steps on
+        an answer nobody is waiting for. Raises QueueFullError at
+        capacity, ServerClosedError after shutdown, ValueError for
+        prompts that leave no room to generate."""
         if self._closed:
             raise ServerClosedError("engine is shut down")
         prompt = np.asarray(
@@ -735,7 +780,8 @@ class GenerationServer:
         req = _Request(prompt, max_new_tokens, temperature, seed,
                        timeout_ms if timeout_ms is not None
                        else self.default_timeout_ms,
-                       trace=ctx.child() if ctx is not None else None)
+                       trace=ctx.child() if ctx is not None else None,
+                       deadline_ms=deadline_ms)
         with self._lock:
             if self._closed:
                 raise ServerClosedError("engine is shut down")
@@ -934,6 +980,7 @@ class GenerationServer:
             while True:
                 self._admit_and_prefill()
                 with self._lock:
+                    self._evict_expired_streams()
                     active = [s for s in self._slots if s is not None]
                     if self._abort:
                         self._do_abort()
@@ -950,6 +997,27 @@ class GenerationServer:
         finally:
             with self._lock:
                 self._loop_running = False
+
+    def _evict_expired_streams(self):
+        """Deadline check at batch re-form (lock held): an in-flight
+        stream whose HARD budget expired is evicted now — its future
+        fails with DeadlineExceededError (emitted tokens stay
+        readable), its pages return to the free list, and its lane
+        frees up for the admission pass — instead of spending further
+        decode steps on a request whose caller has already given up."""
+        now = time.monotonic()
+        for seq in list(self._slots):
+            if seq is None or not seq.req.hard_expired(now):
+                continue
+            seq.req.future._fail(
+                DeadlineExceededError(
+                    f"deadline budget expired after "
+                    f"{seq.n_generated} generated token(s); stream "
+                    f"evicted"), reason="deadline")
+            self._release(seq, "timed_out")
+            self._trace_finish([seq], "error",
+                               error="DeadlineExceededError",
+                               finish_reason="deadline")
 
     def _do_abort(self):
         """drain=False shutdown: fail everything still live (lock

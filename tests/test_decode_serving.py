@@ -350,6 +350,44 @@ class TestGenerationServer:
         assert srv.metrics_snapshot()["counters"]["timed_out"] == 1
         srv.shutdown()
 
+    def test_hard_deadline_evicts_inflight_stream(self):
+        """Fleet deadline propagation, engine side: a stream whose
+        HARD budget (deadline_ms) expires mid-generation is evicted
+        at batch re-form — future fails typed, already-emitted tokens
+        stay readable, and every page returns to the free list
+        instead of the engine burning decode steps to the length
+        cap."""
+        m, cfg = make_model()
+        with GenerationServer(m, max_batch=2, page_size=8,
+                              prefix_cache=False,
+                              name="hard_deadline") as srv:
+            fut = srv.submit_generate([5, 7, 9], max_new_tokens=200,
+                                      deadline_ms=120.0)
+            with pytest.raises(DeadlineExceededError):
+                fut.result(timeout=60)
+            assert fut.finish_reason == "deadline"
+            assert len(fut.tokens()) < 200     # evicted, not run out
+            assert srv.kv.free_pages == srv.kv.capacity
+            leak = srv.metrics_snapshot()["kv_leak_check"]
+            assert not leak.get("leaked"), leak
+            assert srv.metrics_snapshot()[
+                "counters"]["timed_out"] == 1
+            # the engine still serves after the eviction
+            assert srv.generate([1, 2], max_new_tokens=2) == \
+                self._reference(m, cfg, [1, 2], 2)
+
+    def test_scheduling_timeout_still_never_evicts_inflight(self):
+        """timeout_ms keeps its pre-deadline-propagation contract: it
+        gates SCHEDULING only — once decoding, a stream with a tiny
+        timeout_ms but no hard budget runs to completion."""
+        m, cfg = make_model()
+        ref = self._reference(m, cfg, [5, 7], 4)
+        with GenerationServer(m, max_batch=2, page_size=8,
+                              name="sched_only") as srv:
+            fut = srv.submit_generate([5, 7], max_new_tokens=4,
+                                      timeout_ms=30000.0)
+            assert fut.result(timeout=60) == ref
+
     def test_queue_full_backpressure(self):
         m, cfg = make_model()
         srv = GenerationServer(m, max_batch=2, page_size=8,

@@ -615,6 +615,377 @@ def _wait(predicate, timeout=60.0, interval=0.05):
     return predicate()
 
 
+# ------------------------------------------------------- resilience
+class TestCodecDeadlineTrailer:
+    def test_roundtrip_alongside_trace_trailer(self):
+        body = codec.encode_batch([_feed(), _feed()])
+        stamped = codec.attach_trace_trailer(
+            body, ["00-" + "a" * 32 + "-" + "b" * 16 + "-01", None])
+        stamped = codec.attach_deadline_trailer(stamped, [42.5, None])
+        feeds, tps, dls = codec.decode_batch_trailers(stamped)
+        assert len(feeds) == 2
+        assert tps[1] is None and tps[0].startswith("00-")
+        assert dls == [42.5, None]
+        # deadline-only payloads work too, and the 2-tuple decode
+        # shape survives for trailer-blind callers
+        d_only = codec.attach_deadline_trailer(body, [7.0, 7.0])
+        assert codec.decode_batch_trailers(d_only)[2] == [7.0, 7.0]
+        assert codec.decode_batch_ex(d_only)[1] is None
+        assert codec.peek_batch_size(d_only) == 2
+
+    def test_attach_is_idempotent_and_validates(self):
+        body = codec.encode_batch([_feed()])
+        stamped = codec.attach_deadline_trailer(body, [9.0])
+        assert codec.attach_deadline_trailer(stamped, [1.0]) == \
+            stamped
+        with pytest.raises(codec.CodecError):
+            codec.attach_deadline_trailer(body, [1.0, 2.0])
+
+    def test_wedged_error_round_trips(self):
+        from paddle_tpu.serving.fleet.resilience import \
+            ReplicaWedgedError
+        back = codec.decode_results(codec.encode_results(
+            [ReplicaWedgedError("device hung")]))
+        assert isinstance(back[0], ReplicaWedgedError)
+        assert "device hung" in str(back[0])
+
+
+class TestCircuitBreaker:
+    def test_slow_but_alive_replica_drained_then_readmitted(self):
+        """The readiness-is-insufficient scenario: a replica serving
+        100x latency stays /readyz-GREEN, but its latency-aware
+        breaker opens and traffic drains to the healthy replica; when
+        it recovers, the half-open probe re-admits it."""
+        slow, slow_app = _stub_replica(device_ms=80.0)
+        fast, fast_app = _stub_replica(device_ms=1.0)
+        router = fleet.FleetRouter(
+            {"slow": slow_app.url, "fast": fast_app.url},
+            name="t_breaker", start=False,
+            breaker_window=8, breaker_failure_ratio=0.5,
+            breaker_min_samples=2, breaker_open_ms=300.0,
+            breaker_latency_ms=30.0)
+        try:
+            router.poll_replicas()
+            for _ in range(8):
+                router.submit(_feed()).result(timeout=30)
+            states = {s["replica"]: s
+                      for s in router.replica_states()}
+            assert states["slow"]["ready"], \
+                "readyz must stay green — slowness is invisible to it"
+            assert states["slow"]["breaker"]["state"] == "open"
+            assert states["fast"]["breaker"]["state"] == "closed"
+            # drained: new traffic all lands on the healthy replica
+            drained_before = slow.dispatches
+            for _ in range(4):
+                router.submit(_feed()).result(timeout=30)
+            assert slow.dispatches == drained_before
+            # recovery: half-open probe re-admits after the cooldown
+            slow.device_ms = 1.0
+
+            def _probe_and_check():
+                router.submit(_feed()).result(timeout=30)
+                states = {s["replica"]: s["breaker"]["state"]
+                          for s in router.replica_states()}
+                return states["slow"] == "closed"
+
+            assert _wait(_probe_and_check, timeout=30)
+            assert slow.dispatches > drained_before
+            snap = {s["replica"]: s["breaker"]
+                    for s in router.replica_states()}
+            assert snap["slow"]["opens"] >= 1
+        finally:
+            router.shutdown()
+            slow_app.stop()
+            fast_app.stop()
+
+    def test_breaker_opens_on_shed_storm(self):
+        """Repeated 429s trip the breaker even though the replica is
+        alive and ready — fast-fail instead of hammering it."""
+        tiny, tiny_app = _stub_replica(device_ms=1.0,
+                                       queue_capacity=1)
+        router = fleet.FleetRouter(
+            {"tiny": tiny_app.url}, name="t_storm", retries=1,
+            start=False, retry_backoff_ms_=0.0,
+            breaker_window=8, breaker_failure_ratio=0.5,
+            breaker_min_samples=2, breaker_open_ms=10000.0)
+        try:
+            router.poll_replicas()
+            # one 6-request batch vs capacity 1: dispatch + retry both
+            # shed 429 -> the batch fails QueueFullError and the two
+            # recorded failures open the breaker
+            futs = router.submit_many([_feed()] * 6)
+            for f in futs:
+                with pytest.raises(QueueFullError):
+                    f.result(timeout=30)
+            st = router.replica_states()[0]["breaker"]["state"]
+            assert st == "open"
+            # open breaker = no routable target = typed shed
+            with pytest.raises(fleet.NoReadyReplicaError):
+                router.submit(_feed()).result(timeout=30)
+        finally:
+            router.shutdown()
+            tiny_app.stop()
+
+
+class TestHedging:
+    def test_hedged_submit_covers_slow_replica(self):
+        """With one slow and one fast replica, the hedge fires after
+        the peers' latency quantile and the fast replica's answer
+        wins; the accounting (fired >= won) is exposed."""
+        slow, slow_app = _stub_replica(device_ms=250.0)
+        fast, fast_app = _stub_replica(device_ms=1.0)
+        router = fleet.FleetRouter(
+            {"slow": slow_app.url, "fast": fast_app.url},
+            name="t_hedge", start=False,
+            breaker_failure_ratio=1.1, breaker_latency_ms=0.0,
+            hedge_ms=20.0, hedge_quantile=0.5)
+        try:
+            router.poll_replicas()
+            t0 = time.perf_counter()
+            # sequential singles: ties round-robin, so half the
+            # dispatches pick the slow replica and get hedged
+            for _ in range(6):
+                router.submit(_feed()).result(timeout=30)
+            elapsed_ms = (time.perf_counter() - t0) * 1e3
+            snap = router.metrics_snapshot()
+            assert snap["hedges"]["fired"] >= 1
+            assert snap["hedges"]["won"] >= 1
+            assert snap["hedges"]["won"] <= snap["hedges"]["fired"]
+            # 6 un-hedged requests would cost >= 3 * 250 ms
+            assert elapsed_ms < 3 * 250.0, elapsed_ms
+            assert snap["counters"]["failed"] == 0
+        finally:
+            router.shutdown()
+            slow_app.stop()
+            fast_app.stop()
+
+    def test_generate_never_hedges(self):
+        """The stream path is not idempotent: even with hedging
+        configured, submit_generate fires no hedges."""
+        be, app = _stub_replica(device_ms=50.0)
+        router = fleet.FleetRouter(
+            {0: app.url}, name="t_nohedge", start=False,
+            hedge_ms=1.0, hedge_quantile=0.5)
+        try:
+            router.poll_replicas()
+            fut = router.submit_generate([7], max_new_tokens=3)
+            assert list(fut) == [8, 9, 10]
+            assert router.metrics_snapshot()["hedges"]["fired"] == 0
+        finally:
+            router.shutdown()
+            app.stop()
+
+
+class TestDeadlinePropagation:
+    def test_router_fails_exhausted_budget_locally(self):
+        be, app = _stub_replica(device_ms=1.0)
+        router = fleet.FleetRouter({0: app.url}, name="t_ddl",
+                                   start=False)
+        try:
+            router.poll_replicas()
+            fut = router.submit(_feed(), timeout_ms=0.0001)
+            with pytest.raises(DeadlineExceededError):
+                fut.result(timeout=30)
+            snap = router.metrics_snapshot()
+            assert snap["deadline_rejects"]["router"] == 1
+        finally:
+            router.shutdown()
+            app.stop()
+
+    def test_worker_rejects_expired_before_dispatch(self):
+        """The acceptance scenario: a batch arriving with an
+        exhausted budget is answered typed WITHOUT a device dispatch
+        (the stub's dispatch counter is the witness); live requests
+        in the same batch still run."""
+        be, app = _stub_replica(device_ms=1.0)
+        try:
+            body = codec.attach_deadline_trailer(
+                codec.encode_batch([_feed(), _feed(3.0)]),
+                [-5.0, 5000.0])
+            req = urllib.request.Request(
+                app.url + "/submit_many", data=body)
+            with _OPENER.open(req, timeout=30) as resp:
+                results = codec.decode_results(resp.read())
+            assert isinstance(results[0], DeadlineExceededError)
+            assert isinstance(results[1], list)      # peer survived
+            np.testing.assert_allclose(
+                results[1][0], np.full((1, 4), 3.0) * be._scale)
+            assert be.dispatches == 1   # one batch, expired row gone
+        finally:
+            app.stop()
+
+    def test_generate_deadline_evicts_and_stays_typed(self):
+        """An in-flight routed stream whose budget expires fails with
+        DeadlineExceededError (typed across the ndjson wire), reason
+        "deadline"."""
+        be, app = _stub_replica(device_ms=1.0, token_ms=30.0)
+        router = fleet.FleetRouter({0: app.url}, name="t_gddl",
+                                   start=False)
+        try:
+            router.poll_replicas()
+            fut = router.submit_generate([7], max_new_tokens=50,
+                                         deadline_ms=100.0)
+            with pytest.raises(DeadlineExceededError):
+                fut.result(timeout=60)
+            assert fut.finish_reason == "deadline"
+            assert 0 < len(fut.tokens()) < 50
+        finally:
+            router.shutdown()
+            app.stop()
+
+
+class TestWedgeWatchdog:
+    def test_hang_flips_readyz_and_fails_waiters_typed(self):
+        """Thread-mode wedge drill: a hang poison wedges the device;
+        the watchdog flips /readyz, the queued waiter fails with the
+        typed ReplicaWedgedError (not an eternal block), and the
+        wedge is counted."""
+        from paddle_tpu.serving.fleet.resilience import \
+            ReplicaWedgedError
+        be = fleet.StubBackend(device_ms=1.0, hang_value=777.0)
+        be.warmup()
+        app = fleet.ReplicaApp(be).start()
+        wd = fleet.arm_wedge_watchdog(be, app, timeout_ms=150.0,
+                                      restart=False, name="t_wedge")
+        assert wd is not None
+        try:
+            import threading
+            poison_err = []
+
+            def _poison():
+                try:
+                    req = urllib.request.Request(
+                        app.url + "/submit_many",
+                        data=codec.encode_batch([_feed(777.0)]))
+                    _OPENER.open(req, timeout=30).read()
+                except Exception as e:  # noqa: BLE001 - expected
+                    poison_err.append(e)
+
+            t = threading.Thread(target=_poison, daemon=True)
+            t.start()
+            time.sleep(0.05)    # poison reaches the device first
+            # the waiter queued behind the wedge fails TYPED once the
+            # watchdog fires — never blocks past the bound
+            req = urllib.request.Request(
+                app.url + "/submit_many",
+                data=codec.encode_batch([_feed()]))
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _OPENER.open(req, timeout=30)
+            assert ei.value.code == 503
+            assert b"wedged" in ei.value.read()
+            assert wd.wedged and wd.wedge_count == 1
+            # /readyz red, /healthz reports the wedge
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _OPENER.open(app.url + "/readyz", timeout=10)
+            assert ei.value.code == 503
+            body = json.loads(ei.value.read())
+            assert body.get("wedged") is True
+            t.join(timeout=30)
+            assert poison_err, "the hung dispatch must fail, not " \
+                               "return"
+        finally:
+            wd.stop()
+            app.stop()
+
+    def test_wedge_triggers_supervisor_respawn(self):
+        """restart=True: the watchdog requests shutdown, the thread
+        replica exits, and the supervisor respawns a fresh one — the
+        process-mode recovery path, in-process."""
+        def _factory(rid):
+            be = fleet.StubBackend(device_ms=1.0, hang_value=777.0)
+            rep = fleet.ThreadReplicaFactory(lambda r: be)(rid)
+            fleet.arm_wedge_watchdog(be, rep.app, timeout_ms=150.0,
+                                     restart=True,
+                                     name=f"t_resp{rid}")
+            return rep
+
+        sup = fleet.ReplicaSupervisor(_factory, 1,
+                                      restart_backoff_ms=10,
+                                      poll_interval_s=0.01).start()
+        router = fleet.FleetRouter(supervisor=sup, name="t_wresp",
+                                   start=False)
+        try:
+            router.poll_replicas()
+            assert len(router._routable()) == 1
+            fut = router.submit(_feed(777.0))
+            with pytest.raises(Exception):
+                fut.result(timeout=30)
+            assert _wait(lambda: sup.restart_counts().get(0, 0) >= 1,
+                         timeout=30)
+            assert _wait(lambda: (router.poll_replicas() or
+                                  len(router._routable()) >= 1),
+                         timeout=30)
+            router.submit(_feed()).result(timeout=30)
+        finally:
+            router.shutdown()
+            sup.stop()
+
+
+class TestGenerateCancelPropagation:
+    def test_cancel_routed_stream_frees_replica_pages(self):
+        """Satellite regression: cancel() on a ROUTED stream must
+        reach the replica's engine — the sequence is evicted and its
+        KV pages return to the free list, not just client-side
+        iteration stopping."""
+        import paddle_tpu as paddle_
+        from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+        from paddle_tpu.serving.generation import GenerationServer
+        paddle_.seed(0)
+        engine = GenerationServer(
+            GPTForCausalLM(gpt_tiny(use_flash_attention=False)),
+            max_batch=2, page_size=8, prefix_cache=False,
+            name="t_routed_cancel")
+
+        class _GenBackend:
+            def generate(self, prompt, max_new_tokens, temperature,
+                         timeout_ms, seed, deadline_ms=None):
+                return engine.submit_generate(
+                    prompt, max_new_tokens=max_new_tokens,
+                    temperature=temperature, timeout_ms=timeout_ms,
+                    seed=seed, deadline_ms=deadline_ms)
+
+            def submit_many(self, *a, **k):
+                raise NotImplementedError
+
+            def warmup(self):
+                return 0
+
+            def ready(self):
+                return True
+
+            def health(self):
+                return True, {}
+
+            def info(self):
+                return {"backend": "gen", "version": "v0"}
+
+            def shutdown(self, drain=True):
+                pass
+
+        app = fleet.ReplicaApp(_GenBackend()).start()
+        router = fleet.FleetRouter({0: app.url}, name="t_cancelgen",
+                                   start=False)
+        try:
+            router.poll_replicas()
+            fut = router.submit_generate([5, 7, 9],
+                                         max_new_tokens=200)
+            assert _wait(lambda: len(fut.tokens()) >= 2, timeout=60)
+            assert fut.cancel()
+            assert _wait(fut.done, timeout=30)
+            assert fut.finish_reason == "cancelled"
+            # the ENGINE evicted the sequence: pages back on the
+            # free list, nothing leaked — the bug was client-side-
+            # only cancellation leaving the replica decoding
+            assert _wait(lambda: engine.kv.free_pages ==
+                         engine.kv.capacity, timeout=30), \
+                engine.kv.leak_check()
+            assert engine.active_sequences == 0
+        finally:
+            router.shutdown()
+            app.stop()
+            engine.shutdown(drain=False)
+
+
 @pytest.mark.slow
 class TestMultiProcessE2E:
     def test_stub_worker_crash_respawn_and_traffic(self):

@@ -32,6 +32,7 @@ try:
     from paddle_tpu.analysis import (FlagConsistencyAnalyzer,
                                      LockDisciplineAnalyzer,
                                      MetricDisciplineAnalyzer,
+                                     TimeoutDisciplineAnalyzer,
                                      TracerSafetyAnalyzer)
 except Exception as e:  # noqa: BLE001 - the gate must skip, not error,
     # when run from an environment where the repo root is not on the
@@ -759,3 +760,94 @@ class TestCoreAndCli:
             assert main([str(tmp_path), "--baseline", bl]) == 0
             assert main([str(tmp_path), "--baseline", bl,
                          "--no-baseline"]) == 1
+
+
+# ===================================================================
+# 2g. timeout discipline (TD001)
+# ===================================================================
+def _write_serving(tmp_path, name, source):
+    """TD001 is scoped to paddle_tpu/serving/ — self-test modules are
+    rebuilt under that subtree (in_scope matches it at any depth)."""
+    d = tmp_path / "paddle_tpu" / "serving"
+    d.mkdir(parents=True, exist_ok=True)
+    p = d / name
+    p.write_text(textwrap.dedent(source))
+    return str(p)
+
+
+class TestTimeoutDiscipline:
+    def test_flags_blocking_calls_without_timeout(self, tmp_path):
+        _write_serving(tmp_path, "mod.py", """
+            import socket
+            import urllib.request
+            from http.client import HTTPConnection, HTTPSConnection
+
+            _OPENER = urllib.request.build_opener()
+
+            def hop(url, req):
+                urllib.request.urlopen(url)                 # TD001
+                socket.create_connection(("h", 80))         # TD001
+                conn = HTTPConnection("h")                  # TD001
+                conn2 = HTTPSConnection("h", 443)           # TD001
+                _OPENER.open(req)                           # TD001
+        """)
+        found = _run(tmp_path, [TimeoutDisciplineAnalyzer()])
+        details = sorted(f.detail for f in found
+                         if f.rule == "TD001")
+        assert details == ["HTTPConnection", "HTTPSConnection",
+                           "create_connection", "opener.open",
+                           "urlopen"], details
+        assert all(f.symbol == "hop" for f in found)
+
+    def test_timeout_present_is_clean(self, tmp_path):
+        _write_serving(tmp_path, "ok.py", """
+            import socket
+            import urllib.request
+            from http.client import HTTPConnection
+
+            _OPENER = urllib.request.build_opener()
+
+            def hop(url, req, kw):
+                urllib.request.urlopen(url, timeout=5)      # kwarg
+                urllib.request.urlopen(url, None, 5)        # slot
+                socket.create_connection(("h", 80), 2.0)    # slot
+                HTTPConnection("h", 80, 5)                  # slot
+                _OPENER.open(req, timeout=5)
+                _OPENER.open(req, **kw)     # caller may pass timeout
+                open("somefile")            # builtin open: never I/O
+        """)
+        assert _run(tmp_path, [TimeoutDisciplineAnalyzer()]) == []
+
+    def test_out_of_scope_trees_not_flagged(self, tmp_path):
+        # identical code OUTSIDE paddle_tpu/serving/: benches and
+        # tests block on purpose
+        _write(tmp_path, "bench_x.py", """
+            import urllib.request
+            urllib.request.urlopen("http://x")
+        """)
+        assert _run(tmp_path, [TimeoutDisciplineAnalyzer()]) == []
+
+    def test_gate_scope_reaches_repo_serving(self, tmp_path):
+        """Scope self-test: an injected violation in a rebuilt
+        paddle_tpu/serving/ tree run through the PROJECT gate (real
+        baseline) must come back as a NEW finding — TD001 rides the
+        same gate as every other analyzer."""
+        _write_serving(tmp_path, "router2.py", """
+            import urllib.request
+
+            def forward(url):
+                return urllib.request.urlopen(url)
+        """)
+        res = analysis.run_project(
+            paths=[str(tmp_path)], root=str(tmp_path),
+            baseline_path=analysis.default_baseline_path(REPO_ROOT))
+        assert "TD001" in {f.rule for f in res["new"]}
+
+    def test_repo_serving_is_timeout_clean(self):
+        """The real serving tree carries NO timeout-less blocking
+        calls — the fleet convention (every intra-fleet HTTP call
+        supplies a timeout) holds with zero baselined debt."""
+        found = analysis.run_analyzers(
+            [os.path.join(REPO_ROOT, "paddle_tpu", "serving")],
+            [TimeoutDisciplineAnalyzer()], root=REPO_ROOT)
+        assert found == [], "\n".join(f.format() for f in found)

@@ -1,5 +1,5 @@
-"""Shared bench plumbing: the backend-unavailable classifier and the
-structured skip record.
+"""Shared bench plumbing: the backend-unavailable classifier, the
+structured skip record, and the wedged-tunnel-safe subprocess probes.
 
 Every bench in this repo prints one JSON line; when the accelerator
 backend cannot initialize, that line must be the ``"skipped": true``
@@ -9,15 +9,27 @@ surfaced backend-unavailable from inside the first eager op's
 dispatch (a ``convert_element_type`` on the 1.3B path). The
 classifier + record format were root-caused and fixed in bench.py
 (PR 7); this module is the shared home so every ``tools/bench_*.py``
-skips identically instead of re-growing the crash. First slice of the
-ROADMAP item 5 perfci consolidation.
+skips identically instead of re-growing the crash.
+
+The PROBES live here too (PR 15): ``bounded_subprocess_probe`` runs a
+code snippet in a throwaway subprocess under a hard timeout — the
+only safe way to ask "is the TPU tunnel alive?", because a wedged
+tunnel HANGS backend init (observed >120 s, no exception) and a hang
+inside the asking process is unrecoverable. ``probe_backend`` (bench
+startup: retries + backoff, full schedule recorded into the skip
+record) and shardcheck's topology probe are both built on it, so the
+two previously-duplicated wedge classifiers cannot drift apart again.
 """
 from __future__ import annotations
 
 import json
-from typing import Optional
+import os
+import sys
+import time
+from typing import Optional, Tuple
 
-__all__ = ["backend_unavailable", "skip_record", "emit_record"]
+__all__ = ["backend_unavailable", "skip_record", "emit_record",
+           "bounded_subprocess_probe", "probe_backend"]
 
 
 def backend_unavailable(e: BaseException) -> bool:
@@ -60,3 +72,71 @@ def emit_record(record: dict, out: Optional[str] = None) -> str:
             f.write(json.dumps(record, indent=1, sort_keys=True)
                     + "\n")
     return line
+
+
+def bounded_subprocess_probe(code: str, timeout_s: float,
+                             ok_token: str = "ok") -> dict:
+    """Run ``code`` with this interpreter in a THROWAWAY subprocess
+    under a hard timeout; success = rc 0 AND ``ok_token`` on stdout.
+    Returns ``{"ok", "elapsed_s", "error", "stdout"}`` — the one
+    probe primitive every wedge-safe availability check shares,
+    because a wedged TPU tunnel hangs in-process backend init with no
+    exception to catch."""
+    import subprocess
+    t0 = time.monotonic()
+    try:
+        res = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True,
+                             timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return {"ok": False,
+                "elapsed_s": round(time.monotonic() - t0, 1),
+                "error": f"probe hung >{timeout_s}s (tunnel wedged)",
+                "stdout": ""}
+    elapsed = round(time.monotonic() - t0, 1)
+    out = (res.stdout or "").strip()
+    if res.returncode == 0 and ok_token in out:
+        return {"ok": True, "elapsed_s": elapsed, "error": "",
+                "stdout": out}
+    err = (res.stderr or res.stdout or "").strip()
+    return {"ok": False, "elapsed_s": elapsed,
+            "error": err.replace("\n", " ")[-300:], "stdout": out}
+
+
+def probe_backend(timeout: Optional[float] = None,
+                  retries: Optional[int] = None,
+                  sleep_s: float = 20
+                  ) -> Tuple[Optional[str], str, dict]:
+    """Probe TPU backend availability before a bench process touches
+    jax: bounded retries with a fixed backoff, every attempt timed.
+
+    Returns ``(platform_or_None, diagnostic_str, probe_dict)`` where
+    ``probe_dict`` records the full retry schedule — per-attempt
+    elapsed seconds, the backoff slept before each, and the error
+    text — so a skipped-bench JSON says exactly how long was spent
+    deciding to skip instead of an ambiguous rc-0 record."""
+    timeout = timeout or int(os.environ.get("BENCH_PROBE_TIMEOUT",
+                                            120))
+    retries = retries or int(os.environ.get("BENCH_PROBE_RETRIES", 2))
+    last = ""
+    attempts = []
+    t_start = time.monotonic()
+    for attempt in range(retries):
+        if attempt:
+            time.sleep(sleep_s)
+        res = bounded_subprocess_probe(
+            "import jax; print(jax.devices()[0].platform)",
+            timeout_s=timeout, ok_token="")
+        if res["ok"] and res["stdout"]:
+            return res["stdout"].splitlines()[-1], "", {
+                "attempts": attempts, "total_s": round(
+                    time.monotonic() - t_start, 1)}
+        last = res["error"] or "probe produced no platform"
+        attempts.append({"attempt": attempt + 1,
+                         "backoff_s": sleep_s if attempt else 0,
+                         "elapsed_s": res["elapsed_s"],
+                         "error": last})
+    probe = {"retries": retries, "timeout_s": timeout,
+             "backoff_s": sleep_s, "attempts": attempts,
+             "total_s": round(time.monotonic() - t_start, 1)}
+    return None, f"{retries} attempts failed; last: {last}", probe

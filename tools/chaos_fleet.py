@@ -1,0 +1,479 @@
+"""chaos_fleet — inject fleet faults under load and prove recovery.
+
+The serving analog of tools/faultinject.py (ELASTIC_r01): a REAL
+multi-process stub fleet — worker subprocesses behind the production
+supervisor + router — carries continuous background load while faults
+are injected, and the resilience layer's claims are asserted, not
+assumed:
+
+  crash            poison request os._exit(17)s a replica mid-request:
+                   only the riding requests fail, the supervisor
+                   respawns, traffic never stops
+  hang             poison request wedges a replica's device (the
+                   dispatch never completes): the wedge watchdog flips
+                   /readyz, fails device waiters with the typed
+                   ReplicaWedgedError, exits the process; fleet is
+                   fully routable again within the recovery bound
+                   (2x FLAGS_fleet_wedge_timeout_ms)
+  slow-replica     /chaos inflates one replica's device_ms while its
+                   /readyz stays GREEN: the latency-aware circuit
+                   breaker opens and drains it anyway (readiness alone
+                   is proven insufficient), then half-open probing
+                   re-admits it after /chaos restore — the full
+                   open -> half-open -> closed cycle is observed
+  reject-storm     /chaos drops one replica's queue capacity to zero
+                   (every dispatch sheds 429): retries absorb the
+                   storm on the healthy replicas, nothing is lost
+  expired-deadline a batch stamped with an exhausted budget is
+                   rejected AT THE WORKER without a device dispatch
+                   (the stub's dispatch counter proves it), and the
+                   router fails over-budget requests locally
+
+Plus a paired HEDGE experiment: the same load over a {1 slow, 1 fast}
+fleet with hedging off vs on — hedged p99 must beat un-hedged p99,
+with duplicate-execution accounting (fired/won/wasted) closing.
+
+Asserted invariants (the perfci gates over the committed record):
+zero non-riding request loss, watchdog recovery within bound, breaker
+cycle observed, hedge p99 improvement + accounting closure, and a
+goodput floor over the whole chaos run.
+
+Usage:
+  python tools/chaos_fleet.py                       # full run, stdout
+  python tools/chaos_fleet.py --out CHAOS_r01.json  # committed record
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+CRASH_VALUE = 666.0
+HANG_VALUE = 777.0
+GOODPUT_FLOOR = 0.90
+
+
+def _feed(v=1.0):
+    return [np.full((1, 4), v, np.float32)]
+
+
+def _post(url, obj, timeout=10.0):
+    import urllib.request
+    opener = urllib.request.build_opener(
+        urllib.request.ProxyHandler({}))
+    req = urllib.request.Request(
+        url, data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"})
+    with opener.open(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+class LoadGen:
+    """Continuous background submit load; every request is accounted:
+    completed, riding-failure (classified exception during a fault),
+    or LOST (anything else — the invariant that must stay zero)."""
+
+    def __init__(self, router, n_threads=3):
+        from paddle_tpu.serving.fleet import (ReplicaError,
+                                              resilience)
+        from paddle_tpu.serving.request import (
+            DeadlineExceededError, QueueFullError, ServerClosedError)
+        self.router = router
+        self._riding_types = (ReplicaError,
+                              resilience.ReplicaWedgedError,
+                              ServerClosedError)
+        self._shed_types = (QueueFullError,)
+        self._deadline_types = (DeadlineExceededError,)
+        self.counts = {"completed": 0, "riding_failed": 0,
+                       "shed": 0, "deadline": 0, "lost": 0}
+        self.failure_types: dict = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads = [threading.Thread(target=self._run,
+                                          daemon=True)
+                         for _ in range(n_threads)]
+
+    def _classify(self, exc):
+        name = type(exc).__name__
+        with self._lock:
+            self.failure_types[name] = \
+                self.failure_types.get(name, 0) + 1
+            if isinstance(exc, self._riding_types):
+                self.counts["riding_failed"] += 1
+            elif isinstance(exc, self._shed_types):
+                self.counts["shed"] += 1
+            elif isinstance(exc, self._deadline_types):
+                self.counts["deadline"] += 1
+            else:
+                self.counts["lost"] += 1
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                futs = self.router.submit_many([_feed(), _feed()])
+            except Exception:  # noqa: BLE001 - router shut down
+                return         # under us: the run is over
+            for f in futs:
+                try:
+                    f.result(timeout=60)
+                    with self._lock:
+                        self.counts["completed"] += 1
+                except Exception as e:  # noqa: BLE001 - accounted
+                    self._classify(e)
+            time.sleep(0.002)
+
+    def start(self):
+        for t in self._threads:
+            t.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=60)
+
+
+def _wait(predicate, timeout=60.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+def _breaker_states(router):
+    return {s["replica"]: s["breaker"]
+            for s in router.replica_states()}
+
+
+def run_chaos(wedge_timeout_ms=4000.0, verbose=True):
+    """The main fleet: 3 worker processes with crash + hang triggers
+    and the wedge watchdog armed; faults injected under load."""
+    from paddle_tpu.serving import fleet
+
+    log = (lambda m: print(f"  {m}", file=sys.stderr)) if verbose \
+        else (lambda m: None)
+    fac = fleet.ProcessReplicaFactory(
+        extra_args=["--stub", "--stub-device-ms", "3",
+                    "--stub-capacity", "64",
+                    "--stub-crash-value", str(CRASH_VALUE),
+                    "--stub-crash-mode", "exit",
+                    "--stub-hang-value", str(HANG_VALUE),
+                    "--wedge-timeout-ms", str(wedge_timeout_ms)],
+        env={"JAX_PLATFORMS": "cpu"})
+    sup = fleet.ReplicaSupervisor(fac, 3, restart_backoff_ms=50)
+    sup.start()
+    router = fleet.FleetRouter(
+        supervisor=sup, name="chaos", health_interval_ms=100,
+        retries=4, retry_backoff_ms_=5.0, retry_backoff_max_ms=80.0,
+        breaker_window=8, breaker_failure_ratio=0.5,
+        breaker_min_samples=4, breaker_open_ms=700.0,
+        breaker_latency_ms=80.0)
+    faults = []
+    watchdog_rec = {}
+    breaker_rec = {"opened": False, "reclosed": False, "opens": 0}
+    deadline_rec = {}
+    try:
+        assert router.wait_ready(3, timeout=120), \
+            f"fleet never came up: {router.replica_states()}"
+        load = LoadGen(router).start()
+        time.sleep(0.5)     # healthy-baseline traffic
+
+        # ---- fault 1: crash (clean death mid-request) -------------
+        log("fault: crash (poison os._exit)")
+        t0 = time.monotonic()
+        try:
+            router.submit(_feed(CRASH_VALUE)).result(timeout=60)
+            crash_ok = False        # poison must NOT succeed
+        except Exception as e:  # noqa: BLE001 - expected riding fail
+            crash_ok = isinstance(
+                e, (fleet.ReplicaError,
+                    fleet.resilience.ReplicaWedgedError)) or \
+                "ServerClosed" in type(e).__name__
+        recovered = _wait(lambda: len(router._routable()) >= 3,
+                          timeout=60)
+        faults.append({"fault": "crash",
+                       "riding_failed_typed": bool(crash_ok),
+                       "recovered": bool(recovered),
+                       "recovery_s": round(time.monotonic() - t0, 2)})
+        assert recovered, "fleet did not recover from crash"
+
+        # ---- fault 2: hang (device wedge -> watchdog) -------------
+        log("fault: hang (device wedge)")
+        t0 = time.monotonic()
+        hang_fut = router.submit(_feed(HANG_VALUE))
+        # the riding request must FAIL (typed or socket-death), never
+        # hang the caller past the watchdog bound
+        hang_failed = False
+        try:
+            hang_fut.result(timeout=wedge_timeout_ms / 1e3 * 4)
+        except Exception:  # noqa: BLE001 - expected
+            hang_failed = True
+        recovered = _wait(lambda: len(router._routable()) >= 3,
+                          timeout=wedge_timeout_ms / 1e3 * 2 + 60)
+        recovery_s = time.monotonic() - t0
+        bound_s = 2.0 * wedge_timeout_ms / 1e3
+        watchdog_rec = {
+            "wedge_timeout_ms": wedge_timeout_ms,
+            "riding_failed": bool(hang_failed),
+            "recovered": bool(recovered),
+            "recovery_s": round(recovery_s, 2),
+            "bound_s": bound_s,
+            "recovered_within_bound": bool(recovered
+                                           and recovery_s <= bound_s),
+            "restarts": dict(sup.restart_counts()),
+        }
+        faults.append(dict(watchdog_rec, fault="hang"))
+        assert recovered, "fleet did not recover from wedge"
+
+        # ---- fault 3: slow-but-alive replica ----------------------
+        log("fault: slow replica (latency inflation)")
+        eps = sup.endpoints()
+        slow_rid, slow_url = sorted(eps.items())[0]
+        _post(slow_url + "/chaos", {"device_ms": 400.0})
+        opened = _wait(lambda: _breaker_states(router).get(
+            str(slow_rid), {}).get("state") in ("open", "half_open"),
+            timeout=30)
+        # readiness must still be green while the breaker sheds —
+        # the whole point: /readyz cannot see slow
+        states = {s["replica"]: s for s in router.replica_states()}
+        slow_state = states.get(str(slow_rid), {})
+        readyz_green = bool(slow_state.get("ready"))
+        breaker_rec["opened"] = bool(opened)
+        breaker_rec["readyz_green_while_open"] = readyz_green
+        _post(slow_url + "/chaos", {"restore": True,
+                                    "device_ms": 3.0})
+        reclosed = _wait(lambda: _breaker_states(router).get(
+            str(slow_rid), {}).get("state") == "closed", timeout=30)
+        breaker_rec["reclosed"] = bool(reclosed)
+        snap = _breaker_states(router).get(str(slow_rid), {})
+        breaker_rec["opens"] = int(snap.get("opens", 0))
+        breaker_rec["cycle_observed"] = bool(
+            opened and reclosed and breaker_rec["opens"] >= 1)
+        faults.append(dict(breaker_rec, fault="slow_replica"))
+        assert opened, "breaker never opened on the slow replica"
+        assert reclosed, "breaker never re-closed after recovery"
+
+        # ---- fault 4: reject storm --------------------------------
+        log("fault: reject storm (capacity 0)")
+        eps = sup.endpoints()
+        storm_rid, storm_url = sorted(eps.items())[-1]
+        before = dict(load.counts)
+        _post(storm_url + "/chaos", {"capacity": 0})
+        time.sleep(1.5)
+        _post(storm_url + "/chaos", {"restore": True,
+                                     "capacity": 64})
+        during = {k: load.counts[k] - before[k] for k in before}
+        faults.append({"fault": "reject_storm",
+                       "requests_during": during,
+                       "absorbed": during.get("lost", 0) == 0})
+
+        time.sleep(0.5)     # post-fault healthy traffic
+        load.stop()
+
+        # ---- fault 5: expired deadline ----------------------------
+        # (runs with the background load stopped so the stub dispatch
+        # counter is a clean never-dispatched witness)
+        log("fault: expired deadline")
+        # (a) router-level: an exhausted budget fails locally
+        router_rejects_before = router.metrics_snapshot()[
+            "deadline_rejects"]["router"]
+        fut = router.submit(_feed(), timeout_ms=0.001)
+        deadline_typed = False
+        try:
+            fut.result(timeout=30)
+        except Exception as e:  # noqa: BLE001 - expected
+            deadline_typed = "Deadline" in type(e).__name__
+        # (b) worker-level: a batch arriving pre-expired is answered
+        # without a device dispatch (stub dispatch counter frozen)
+        from paddle_tpu.serving.fleet import codec
+        import urllib.request
+        opener = urllib.request.build_opener(
+            urllib.request.ProxyHandler({}))
+        eps = sup.endpoints()
+        _, target_url = sorted(eps.items())[0]
+        with opener.open(target_url + "/statusz",
+                         timeout=10) as resp:
+            dispatches_before = json.loads(resp.read())["dispatches"]
+        body = codec.attach_deadline_trailer(
+            codec.encode_batch([_feed()]), [-5.0])
+        req = urllib.request.Request(
+            target_url + "/submit_many", data=body,
+            headers={"Content-Type": "application/x-paddle-fleet"})
+        with opener.open(req, timeout=10) as resp:
+            results = codec.decode_results(resp.read())
+        from paddle_tpu.serving.request import DeadlineExceededError
+        worker_rejected = isinstance(results[0],
+                                     DeadlineExceededError)
+        with opener.open(target_url + "/statusz",
+                         timeout=10) as resp:
+            dispatches_after = json.loads(resp.read())["dispatches"]
+        deadline_rec = {
+            "router_reject_typed": bool(deadline_typed),
+            "router_rejects": int(
+                router.metrics_snapshot()["deadline_rejects"]
+                ["router"] - router_rejects_before),
+            "worker_reject_typed": bool(worker_rejected),
+            "expired_never_dispatched": bool(
+                worker_rejected
+                and dispatches_after == dispatches_before),
+        }
+        faults.append(dict(deadline_rec, fault="expired_deadline"))
+        assert worker_rejected, \
+            f"worker dispatched expired work: {results[0]!r}"
+        assert deadline_rec["expired_never_dispatched"], \
+            "expired request reached the device"
+
+        total = sum(load.counts.values())
+        accounted = load.counts["completed"] + \
+            load.counts["riding_failed"] + load.counts["shed"] + \
+            load.counts["deadline"] + load.counts["lost"]
+        goodput = load.counts["completed"] / max(1, total)
+        return {
+            "replicas": 3,
+            "load": dict(load.counts,
+                         failure_types=load.failure_types),
+            "faults": faults,
+            "watchdog": watchdog_rec,
+            "breaker": breaker_rec,
+            "deadline": deadline_rec,
+            "invariants": {
+                "zero_non_riding_lost": load.counts["lost"] == 0,
+                "accounting_closes": accounted == total,
+                "goodput": round(goodput, 4),
+                "goodput_floor": GOODPUT_FLOOR,
+                "goodput_above_floor": goodput >= GOODPUT_FLOOR,
+            },
+        }
+    finally:
+        router.shutdown()
+        sup.stop()
+
+
+def run_hedge_experiment(verbose=True):
+    """Paired p99 measurement over {1 slow, 1 fast} replicas: the
+    same sequential load with hedging off, then on. With zero
+    outstanding on both at pick time the tie round-robins, so half
+    the un-hedged requests eat the slow replica's full latency; the
+    hedged run covers them after the hedge delay."""
+    from paddle_tpu.serving import fleet
+
+    log = (lambda m: print(f"  {m}", file=sys.stderr)) if verbose \
+        else (lambda m: None)
+
+    def _measure(hedge_ms):
+        fac = fleet.ProcessReplicaFactory(
+            extra_args=["--stub", "--stub-device-ms", "2"],
+            env={"JAX_PLATFORMS": "cpu"})
+        sup = fleet.ReplicaSupervisor(fac, 2, restart_backoff_ms=50)
+        sup.start()
+        router = fleet.FleetRouter(
+            supervisor=sup, name=f"hedge{int(hedge_ms)}",
+            health_interval_ms=100, retries=2,
+            # breaker neutralized: this phase measures hedging alone
+            breaker_failure_ratio=1.1, breaker_latency_ms=0.0,
+            hedge_ms=hedge_ms, hedge_quantile=0.5)
+        try:
+            assert router.wait_ready(2, timeout=120)
+            eps = sup.endpoints()
+            slow_rid, slow_url = sorted(eps.items())[0]
+            _post(slow_url + "/chaos", {"device_ms": 120.0})
+            lat = []
+            for _ in range(60):
+                t0 = time.perf_counter()
+                router.submit(_feed()).result(timeout=60)
+                lat.append((time.perf_counter() - t0) * 1e3)
+            lat.sort()
+            snap = router.metrics_snapshot()
+            return {"p50_ms": round(lat[len(lat) // 2], 1),
+                    "p99_ms": round(lat[int(len(lat) * 0.99)], 1),
+                    "hedges": snap["hedges"]}
+        finally:
+            router.shutdown()
+            sup.stop()
+
+    log("hedge: baseline (no hedging) over {slow, fast}")
+    base = _measure(hedge_ms=0.0)
+    log(f"hedge: p99 {base['p99_ms']} ms un-hedged; re-running "
+        f"hedged")
+    hedged = _measure(hedge_ms=25.0)
+    h = hedged["hedges"]
+    rec = {
+        "p99_no_hedge_ms": base["p99_ms"],
+        "p99_hedge_ms": hedged["p99_ms"],
+        "p50_no_hedge_ms": base["p50_ms"],
+        "p50_hedge_ms": hedged["p50_ms"],
+        "fired": h["fired"], "won": h["won"], "wasted": h["wasted"],
+        "p99_improved": hedged["p99_ms"] < base["p99_ms"],
+        # accounting closure: every hedge fired either won the race
+        # or its (possibly cancelled) loser leg is bounded by fired;
+        # wins and waste can never exceed what was fired
+        "accounting_closes": (h["won"] <= h["fired"]
+                              and h["wasted"] <= h["fired"]
+                              and h["fired"] > 0),
+    }
+    assert rec["p99_improved"], \
+        f"hedging did not improve p99: {base} vs {hedged}"
+    assert rec["accounting_closes"], f"hedge accounting broken: {h}"
+    return rec
+
+
+def run(out=None, wedge_timeout_ms=4000.0, verbose=True):
+    t_start = time.time()
+    chaos = run_chaos(wedge_timeout_ms=wedge_timeout_ms,
+                      verbose=verbose)
+    hedge = run_hedge_experiment(verbose=verbose)
+    inv = chaos["invariants"]
+    assert inv["zero_non_riding_lost"], \
+        f"non-riding requests lost: {chaos['load']}"
+    assert chaos["watchdog"]["recovered_within_bound"], \
+        f"watchdog recovery blew the bound: {chaos['watchdog']}"
+    assert chaos["breaker"]["cycle_observed"], \
+        f"no breaker cycle: {chaos['breaker']}"
+    record = {
+        "bench": "chaos_fleet",
+        "metric": "fleet_chaos_resilience",
+        "schema": 1,
+        "skipped": False,
+        "value": inv["goodput"],
+        "unit": "fraction",
+        "vs_baseline": round(inv["goodput"] / GOODPUT_FLOOR, 4),
+        "fault_classes": ["crash", "hang", "slow_replica",
+                          "reject_storm", "expired_deadline"],
+        "hedge": hedge,
+        "elapsed_s": round(time.time() - t_start, 1),
+        **{k: chaos[k] for k in ("replicas", "load", "faults",
+                                 "watchdog", "breaker", "deadline",
+                                 "invariants")},
+    }
+    if out:
+        with open(out, "w") as f:
+            json.dump(record, f, indent=1, sort_keys=True)
+            f.write("\n")
+    return record
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default=None,
+                    help="write the JSON record here")
+    ap.add_argument("--wedge-timeout-ms", type=float, default=4000.0)
+    ap.add_argument("--quiet", action="store_true")
+    args = ap.parse_args()
+    record = run(out=args.out, wedge_timeout_ms=args.wedge_timeout_ms,
+                 verbose=not args.quiet)
+    json.dump(record, sys.stdout, indent=1, sort_keys=True)
+    print()
+
+
+if __name__ == "__main__":
+    main()

@@ -145,6 +145,43 @@ GATES: List[Dict[str, Any]] = [
      "op": "true",
      "why": "a /profilez capture must produce an artifact "
             "load_profiler_result can read back (PR 13)"},
+    {"name": "chaos_zero_lost", "metric": "fleet_chaos_resilience",
+     "files": "CHAOS_r*.json",
+     "path": ("invariants", "zero_non_riding_lost"),
+     "op": "true",
+     "why": "under crash/hang/slow/shed/deadline fault injection, "
+            "only requests riding the failed dispatch may fail — "
+            "everything else re-routes (PR 15)"},
+    {"name": "chaos_recovery_bound", "metric": "fleet_chaos_resilience",
+     "files": "CHAOS_r*.json",
+     "path": ("watchdog", "recovered_within_bound"),
+     "op": "true",
+     "why": "a wedged device must be detected, drained and respawned "
+            "within 2x FLAGS_fleet_wedge_timeout_ms — a silent hang "
+            "is a bounded failure, not an outage (PR 15)"},
+    {"name": "chaos_breaker_cycle", "metric": "fleet_chaos_resilience",
+     "files": "CHAOS_r*.json", "path": ("breaker", "cycle_observed"),
+     "op": "true",
+     "why": "a slow-but-alive replica (readyz GREEN) must trip its "
+            "circuit breaker open and be re-admitted through a "
+            "half-open probe after recovery (PR 15)"},
+    {"name": "chaos_hedge_p99", "metric": "fleet_chaos_resilience",
+     "files": "CHAOS_r*.json", "path": ("hedge", "p99_improved"),
+     "op": "true",
+     "why": "hedged submit under an induced slow replica must beat "
+            "un-hedged p99 (r01: 124 ms -> 30 ms) (PR 15)"},
+    {"name": "chaos_hedge_accounting",
+     "metric": "fleet_chaos_resilience",
+     "files": "CHAOS_r*.json", "path": ("hedge", "accounting_closes"),
+     "op": "true",
+     "why": "duplicate-execution accounting must close: hedges won "
+            "and wasted are both bounded by hedges fired (PR 15)"},
+    {"name": "chaos_goodput", "metric": "fleet_chaos_resilience",
+     "files": "CHAOS_r*.json", "path": ("value",),
+     "op": "min", "baseline": 0.90, "rel_tol": 0.0,
+     "unit": "fraction",
+     "why": "background-load goodput across the whole chaos run "
+            "(r01: 0.9995 — riding failures are the only loss)"},
 ]
 
 

@@ -58,20 +58,16 @@ DEFAULT_BASELINE = os.path.join(REPO_ROOT, "tests", "fixtures",
 def tpu_topology_mesh(topology_name: str, axes: dict, timeout_s: int = 90):
     """A mesh over a REAL XLA:TPU AOT topology (no chips attached).
     ``get_topology_desc`` can HANG when the host's TPU tunnel is wedged
-    (observed: >120 s, not an exception), so availability is probed in
-    a throwaway subprocess with a hard timeout first; any failure
-    returns None and the caller falls back to local devices."""
-    import subprocess
+    (observed: >120 s, not an exception), so availability is probed
+    with the shared wedge-safe subprocess primitive
+    (tools/_bench_common.bounded_subprocess_probe — the same helper
+    bench.py's backend probe is built on) first; any failure returns
+    None and the caller falls back to local devices."""
+    from tools._bench_common import bounded_subprocess_probe
     probe = ("import jax; from jax.experimental import topologies; "
              f"topologies.get_topology_desc(platform='tpu', "
              f"topology_name={topology_name!r}); print('ok')")
-    try:
-        res = subprocess.run([sys.executable, "-c", probe],
-                             capture_output=True, text=True,
-                             timeout=timeout_s)
-    except subprocess.TimeoutExpired:
-        return None
-    if res.returncode != 0 or "ok" not in res.stdout:
+    if not bounded_subprocess_probe(probe, timeout_s)["ok"]:
         return None
     import numpy as np
     from jax.experimental import topologies
