@@ -411,3 +411,46 @@ define_flag("FLAGS_fleet_wedge_timeout_ms", 0.0,
             "waiting requests with ReplicaWedgedError and asks the "
             "supervisor for a restart (worker processes exit; the "
             "respawn is a warm start). 0 = watchdog off")
+
+# ---- multi-tenant scheduling (serving/scheduling/) ----
+define_flag("FLAGS_sched_policy_file", "",
+            "JSON tenant-policy file (rate/burst/weight/priority per "
+            "tenant); hot-reloaded on mtime change, like /reload. "
+            "Empty = flags-only policy")
+define_flag("FLAGS_sched_default_rate", 0.0,
+            "default tenant token-bucket refill rate in tokens/s "
+            "(admission cost is 1 token per request at the worker, "
+            "prompt+max_new tokens at the generation engine); "
+            "0 = unlimited")
+define_flag("FLAGS_sched_default_burst", 64.0,
+            "default tenant token-bucket depth (burst allowance)")
+define_flag("FLAGS_sched_default_weight", 1.0,
+            "default tenant weighted-fair-queuing weight (a weight-4 "
+            "tenant drains 4x the token volume of a weight-1 tenant "
+            "under contention)")
+define_flag("FLAGS_sched_default_priority", "standard",
+            "default tenant priority class: realtime | standard | "
+            "batch (admission prefers realtime; page-pressure "
+            "preemption evicts batch first and never touches a "
+            "higher class)")
+
+# ---- SLO-driven autoscaling (serving/scheduling/autoscaler.py) ----
+define_flag("FLAGS_autoscale_min_replicas", 1,
+            "autoscaler floor: never scale the fleet below this")
+define_flag("FLAGS_autoscale_max_replicas", 8,
+            "autoscaler ceiling: never scale the fleet above this")
+define_flag("FLAGS_autoscale_cooldown_s", 30.0,
+            "minimum seconds between scale actions in either "
+            "direction (hysteresis against flapping)")
+define_flag("FLAGS_autoscale_scale_in_quiet_s", 120.0,
+            "scale IN only after this long with no burn-rate rule "
+            "firing and queue/occupancy low (asymmetric hysteresis: "
+            "out fast, in slow)")
+define_flag("FLAGS_autoscale_queue_high", 16.0,
+            "router/worker queue depth above which the autoscaler "
+            "scales out")
+define_flag("FLAGS_autoscale_occupancy_high", 0.85,
+            "decode-slot occupancy fraction above which the "
+            "autoscaler scales out")
+define_flag("FLAGS_autoscale_interval_s", 5.0,
+            "autoscaler control-loop evaluation period in seconds")

@@ -18,6 +18,9 @@ Serves four paths off a daemon thread:
   continuous step profiler summary;
 - ``/sloz``     — declared SLOs with rolling-window attainment, burn
   rates, and firing alerts (evaluated at scrape time);
+- ``/schedz``   — multi-tenant admission control + autoscaler state:
+  per-tenant token buckets, shed counts, and the last autoscaling
+  decisions;
 - ``/execz``    — the executable cost & roofline registry: every
   compile site's signatures with XLA FLOPs / bytes / memory, cache
   provenance, live per-kind MFU and bandwidth utilization;
@@ -313,6 +316,11 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, json.dumps(sloz_payload(), indent=1,
                                            sort_keys=True),
                            "application/json")
+            elif path == "/schedz":
+                from ..serving.scheduling.schedz import schedz_payload
+                self._send(200, json.dumps(schedz_payload(), indent=1,
+                                           sort_keys=True),
+                           "application/json")
             elif path == "/execz":
                 self._send(200, execz_text(query), "application/json")
             elif path == "/profilez":
@@ -322,7 +330,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, "paddle-tpu telemetry\n"
                                 "/metrics  /healthz  /readyz  "
                                 "/statusz  /tracez  /goodputz  "
-                                "/sloz  /execz  /profilez\n",
+                                "/sloz  /schedz  /execz  /profilez\n",
                            "text/plain; charset=utf-8")
             else:
                 self._send(404, "not found\n",

@@ -39,6 +39,11 @@ Pieces:
   readiness-based routing, load shedding, warm scale-out from the
   shared compile cache, and rolling hot weight swap; knobs under
   ``FLAGS_fleet_*``.
+- ``scheduling`` (subpackage): multi-tenant admission control
+  (per-tenant token buckets, weighted-fair queuing, priority classes,
+  typed ``QuotaExceededError`` sheds) and the ``FleetAutoscaler``
+  control loop driving ``ReplicaSupervisor.scale_to``; knobs under
+  ``FLAGS_sched_*`` / ``FLAGS_autoscale_*``.
 
 Requests are traceable end to end: under ``FLAGS_trace_sample_rate``
 (or an ambient ``tracing.use_context``), every pipeline stage emits a
@@ -57,14 +62,16 @@ from .batcher import DynamicBatcher
 from .bucketing import BucketSpec, ShapeBucketPolicy, next_pow2
 from .capi import wrap_capi
 from .metrics import ServingMetrics
-from .request import (DeadlineExceededError, QueueFullError, Request,
-                      ServerClosedError)
+from .request import (DeadlineExceededError, QueueFullError,
+                      QuotaExceededError, Request, ServerClosedError)
 from .server import InferenceServer
 from . import fleet  # noqa: F401,E402  (after server: fleet wraps it)
+from . import scheduling  # noqa: F401,E402  (admission + autoscaling)
 
 __all__ = [
     "InferenceServer", "DynamicBatcher", "ShapeBucketPolicy",
     "BucketSpec", "ServingMetrics", "Request", "QueueFullError",
-    "DeadlineExceededError", "ServerClosedError", "wrap_capi",
-    "next_pow2", "metrics", "generation", "fleet",
+    "QuotaExceededError", "DeadlineExceededError", "ServerClosedError",
+    "wrap_capi", "next_pow2", "metrics", "generation", "fleet",
+    "scheduling",
 ]

@@ -35,11 +35,15 @@ __all__ = ["DynamicBatcher"]
 
 class DynamicBatcher:
     def __init__(self, max_batch_size: int = 8, max_wait_ms: float = 2.0,
-                 capacity: int = 64, metrics=None):
+                 capacity: int = 64, metrics=None, scheduler=None):
         self.max_batch_size = int(max_batch_size)
         self.max_wait_ms = float(max_wait_ms)
         self.capacity = int(capacity)
         self.metrics = metrics
+        # optional scheduling.AdmissionController: per-tenant quota on
+        # put() (typed QuotaExceededError sheds) + weighted-fair head
+        # pick in next_batch() when no bucket is full
+        self.scheduler = scheduler
         self._q: deque = deque()
         self._sig_rows: Dict[Tuple, int] = {}  # queued rows per signature
         self._deadlined = 0                    # queued reqs with deadlines
@@ -73,6 +77,13 @@ class DynamicBatcher:
 
     # ---- producer side ----
     def put(self, req: Request):
+        if self.scheduler is not None:
+            # per-tenant quota gate (cost = request rows); raises the
+            # typed QuotaExceededError — a QueueFullError subclass, so
+            # untyped callers shed it exactly like backpressure. The
+            # controller has its own lock; gate BEFORE taking ours.
+            self.scheduler.admit(getattr(req, "tenant", None),
+                                 cost=float(req.rows))
         with self._lock:
             if len(self._q) >= self.capacity:
                 raise QueueFullError(
@@ -88,7 +99,11 @@ class DynamicBatcher:
         list (a per-request ``put`` loop pays lock/notify/depth-metric
         per request — measurable at tens of thousands of requests/s).
         All-or-nothing: raises QueueFullError without enqueueing
-        anything if the batch doesn't fit."""
+        anything if the batch doesn't fit. Deliberately NOT
+        quota-gated: the bulk path is the fleet worker's, which sheds
+        per-tenant at ITS admission point before the backend sees the
+        batch — double-debiting the bucket here would halve every
+        tenant's effective rate."""
         with self._lock:
             if len(self._q) + len(reqs) > self.capacity:
                 raise QueueFullError(
@@ -218,6 +233,16 @@ class DynamicBatcher:
                     target = head.signature
                 if not self._q:
                     continue  # everything expired/cancelled mid-wait
+
+                if (self.scheduler is not None and len(self._q) > 1
+                        and self._full_signature() is None):
+                    # the window closed without a full bucket: the
+                    # dispatch slot goes to the tenant with the lowest
+                    # virtual finish tag (weighted-fair across tenants,
+                    # priority classes first) instead of strict FIFO
+                    sel = self.scheduler.select(self._q)
+                    if sel is not None:
+                        target = self._q[sel].signature
 
                 batch, rest, rows = [], deque(), 0
                 for r in self._q:

@@ -33,31 +33,41 @@ Layout (all integers little-endian):
             its own elapsed time before re-stamping. Trailers may
             appear in any order after the batch body; every section
             must parse to exactly EOF.
+- tenant trailer (optional): magic ``PDTN`` — u32 n_requests, per
+            request u16 length + ascii tenant id (0 = untagged; the
+            consumer maps untagged to the ``default`` tenant). Same
+            append-only / upstream-stamp-wins discipline as PDTC, so
+            a client that tagged its own tenancy is never relabeled
+            by the router.
 """
 from __future__ import annotations
 
 import math
+import re
 import struct
 from typing import Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
 from ..request import (DeadlineExceededError, QueueFullError,
-                       ServerClosedError)
+                       QuotaExceededError, ServerClosedError)
 from .resilience import ReplicaWedgedError
 
 __all__ = [
     "encode_batch", "decode_batch", "decode_batch_ex",
-    "decode_batch_trailers", "encode_results", "decode_results",
+    "decode_batch_trailers", "decode_batch_trailers_ex",
+    "encode_results", "decode_results",
     "peek_batch_size", "attach_trace_trailer",
-    "attach_deadline_trailer", "CodecError",
+    "attach_deadline_trailer", "attach_tenant_trailer", "CodecError",
     "BATCH_MAGIC", "RESULTS_MAGIC", "TRACE_MAGIC", "DEADLINE_MAGIC",
+    "TENANT_MAGIC",
 ]
 
 BATCH_MAGIC = b"PDFB"
 RESULTS_MAGIC = b"PDFR"
 TRACE_MAGIC = b"PDTC"
 DEADLINE_MAGIC = b"PDDL"
+TENANT_MAGIC = b"PDTN"
 
 # status codes for per-request results (0 = ok)
 _OK = 0
@@ -66,12 +76,18 @@ _ERR_QUEUE_FULL = 2
 _ERR_DEADLINE = 3
 _ERR_CLOSED = 4
 _ERR_WEDGED = 5
+_ERR_QUOTA = 6
 
+# QuotaExceededError subclasses QueueFullError, so _CODE_OF must map
+# the SUBCLASS first-match by exact type (dict lookup is exact) — a
+# quota shed crosses the wire as _ERR_QUOTA, not _ERR_QUEUE_FULL.
 _CODE_OF = {QueueFullError: _ERR_QUEUE_FULL,
+            QuotaExceededError: _ERR_QUOTA,
             DeadlineExceededError: _ERR_DEADLINE,
             ServerClosedError: _ERR_CLOSED,
             ReplicaWedgedError: _ERR_WEDGED}
 _EXC_OF: Dict[int, type] = {_ERR_QUEUE_FULL: QueueFullError,
+                            _ERR_QUOTA: QuotaExceededError,
                             _ERR_DEADLINE: DeadlineExceededError,
                             _ERR_CLOSED: ServerClosedError,
                             _ERR_WEDGED: ReplicaWedgedError,
@@ -185,8 +201,22 @@ def _parse_deadline_section(r: "_Reader", n_req: int):
     return out
 
 
+def _parse_tenant_section(r: "_Reader", n_req: int):
+    n = r.u32()
+    if n != n_req:
+        raise CodecError(
+            f"tenant trailer for {n} requests on a batch of {n_req}")
+    out = []
+    for _ in range(n):
+        ln = struct.unpack("<H", r.take(2))[0]
+        out.append(r.take(ln).decode("ascii", "replace")
+                   if ln else None)
+    return out
+
+
 _SECTION_PARSERS = {TRACE_MAGIC: _parse_trace_section,
-                    DEADLINE_MAGIC: _parse_deadline_section}
+                    DEADLINE_MAGIC: _parse_deadline_section,
+                    TENANT_MAGIC: _parse_tenant_section}
 
 
 def _walk_sections(r: "_Reader", n_req: int) -> Dict[bytes, list]:
@@ -268,10 +298,33 @@ def attach_deadline_trailer(
     return b"".join(parts)
 
 
-def decode_batch_trailers(data: bytes) -> tuple:
-    """``(feeds_list, traceparents, deadlines_ms)`` — the worker-side
-    decode. ``traceparents`` / ``deadlines_ms`` are None when the
-    payload carries no such trailer, else one ``Optional`` entry per
+def attach_tenant_trailer(
+        data: bytes,
+        tenants: Sequence[Optional[str]]) -> bytes:
+    """Append per-request tenant ids to an already-encoded batch.
+    ``None`` = untagged (0-length on the wire; the consumer maps it to
+    the ``default`` tenant). A payload already carrying a tenant
+    trailer is returned unchanged — a client that tagged its own
+    tenancy wins over the router's header-derived stamp."""
+    n = peek_batch_size(data)
+    if len(tenants) != n:
+        raise CodecError(
+            f"tenant trailer carries {len(tenants)} entries for "
+            f"a batch of {n} requests")
+    if _has_section(data, TENANT_MAGIC):
+        return data
+    parts: List[bytes] = [data, TENANT_MAGIC, struct.pack("<I", n)]
+    for t in tenants:
+        b = (t or "").encode("ascii", "replace")
+        parts.append(struct.pack("<H", len(b)))
+        parts.append(b)
+    return b"".join(parts)
+
+
+def decode_batch_trailers_ex(data: bytes) -> tuple:
+    """``(feeds_list, traceparents, deadlines_ms, tenants)`` — the
+    full worker-side decode. Each trailer slot is None when the
+    payload carries no such section, else one ``Optional`` entry per
     request."""
     r = _Reader(data)
     if r.take(4) != BATCH_MAGIC:
@@ -280,7 +333,16 @@ def decode_batch_trailers(data: bytes) -> tuple:
              for _ in range(r.u32())]
     sections = _walk_sections(r, len(feeds))
     return (feeds, sections.get(TRACE_MAGIC),
-            sections.get(DEADLINE_MAGIC))
+            sections.get(DEADLINE_MAGIC),
+            sections.get(TENANT_MAGIC))
+
+
+def decode_batch_trailers(data: bytes) -> tuple:
+    """``(feeds_list, traceparents, deadlines_ms)`` — the pre-tenant
+    decode shape, kept for callers that do not consume tenancy (the
+    ``decode_batch_ex`` back-compat pattern, one generation later)."""
+    feeds, traceparents, deadlines, _ = decode_batch_trailers_ex(data)
+    return feeds, traceparents, deadlines
 
 
 def decode_batch_ex(
@@ -328,5 +390,16 @@ def decode_results(
             out.append([r.array() for _ in range(n)])
         else:
             msg = r.take(n).decode("utf-8", "replace")
-            out.append(_EXC_OF.get(status, RuntimeError)(msg))
+            if status == _ERR_QUOTA:
+                # re-typed with its tenant: admit() phrases the
+                # message as "tenant '<name>' exceeded ...", so the
+                # per-tenant identity survives the wire without a
+                # second framing field
+                exc = QuotaExceededError(msg)
+                m = re.search(r"tenant '([^']+)'", msg)
+                if m:
+                    exc.tenant = m.group(1)
+                out.append(exc)
+            else:
+                out.append(_EXC_OF.get(status, RuntimeError)(msg))
     return out

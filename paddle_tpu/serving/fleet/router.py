@@ -710,13 +710,16 @@ class FleetRouter:
                     status=span_status, attrs=f_attrs, root=True)
 
     # ------------------------------------------------------ client API
-    def submit(self, feed, timeout_ms: Optional[float] = None):
+    def submit(self, feed, timeout_ms: Optional[float] = None,
+               tenant: Optional[str] = None):
         """One request -> Future of its output-array list (the
         ``InferenceServer.submit`` contract, fleet-wide)."""
-        return self.submit_many([feed], timeout_ms=timeout_ms)[0]
+        return self.submit_many([feed], timeout_ms=timeout_ms,
+                                tenant=tenant)[0]
 
     def submit_many(self, feeds: Sequence,
-                    timeout_ms: Optional[float] = None):
+                    timeout_ms: Optional[float] = None,
+                    tenant: Optional[str] = None):
         """Bulk submit: the batch rides ONE replica dispatch (the
         replica's dynamic batcher coalesces it further). Returns one
         Future per request; per-request replica-side failures resolve
@@ -735,6 +738,11 @@ class FleetRouter:
                         if isinstance(f, (list, tuple))
                         else [np.asarray(f)])
         body = codec.encode_batch(norm)
+        if tenant is not None:
+            # tenancy rides the wire as the PDTN trailer next to
+            # PDTC/PDDL; the worker's admission gate reads it back
+            body = codec.attach_tenant_trailer(
+                body, [tenant] * len(norm))
         futs = [concurrent.futures.Future() for _ in norm]
         # trace identity is captured on the CALLER's thread (ambient
         # context or a fresh sampled one); the whole batch rides one
@@ -774,7 +782,8 @@ class FleetRouter:
                         temperature: float = 0.0,
                         timeout_ms: Optional[float] = None,
                         seed: Optional[int] = None,
-                        deadline_ms: Optional[float] = None
+                        deadline_ms: Optional[float] = None,
+                        tenant: Optional[str] = None
                         ) -> StreamingFuture:
         """Fleet-wide ``GenerationServer.submit_generate``: tokens
         stream back through the returned future as the chosen
@@ -798,6 +807,8 @@ class FleetRouter:
             "max_new_tokens": int(max_new_tokens),
             "temperature": float(temperature),
             "timeout_ms": timeout_ms, "seed": seed}
+        if tenant is not None:
+            req["tenant"] = str(tenant)
         if gctx is not None:
             req["traceparent"] = gctx.to_traceparent()
         deadline = Deadline(deadline_ms)
@@ -1115,6 +1126,25 @@ class FleetRouter:
                 pass           # drops out of the merged view
         return slo_mod.merge_sloz_payloads(own, remotes)
 
+    def merged_schedz(self) -> dict:
+        """Fleet-wide ``/schedz``: this process's admission/autoscaler
+        state plus every live replica's, with per-tenant admission
+        event counts summed fleet-wide — one scrape answers "who is
+        being shed, where, and what did the autoscaler last do"."""
+        from ..scheduling import schedz as schedz_mod
+        own = schedz_mod.schedz_payload()
+        remotes: Dict[str, dict] = {}
+        with self._lock:
+            reps = [(str(r.replica_id), r.url)
+                    for r in self._replicas.values() if r.alive]
+        for rid, url in reps:
+            try:
+                with self._http(url + "/schedz", timeout=5.0) as resp:
+                    remotes[rid] = json.loads(resp.read())
+            except Exception:  # noqa: BLE001 - a scrape-dead replica
+                pass           # drops out of the merged view
+        return schedz_mod.merge_schedz_payloads(own, remotes)
+
     def merged_execz(self) -> dict:
         """Fleet-wide ``/execz``: this process's executable registry
         plus every live replica's, keyed by replica id, with a
@@ -1293,6 +1323,10 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 self._send(200, json.dumps(
                     self._router.merged_sloz(), sort_keys=True,
                     default=str).encode())
+            elif path == "/schedz":
+                self._send(200, json.dumps(
+                    self._router.merged_schedz(), sort_keys=True,
+                    default=str).encode())
             elif path == "/goodputz":
                 from ...observability.goodput import goodputz_payload
                 self._send(200, json.dumps(
@@ -1339,6 +1373,13 @@ class _RouterHandler(BaseHTTPRequestHandler):
                     except ValueError:
                         pass
                 n_req = codec.peek_batch_size(body)
+                # tenant ingress: the x-paddle-tenant header is the
+                # header twin of the PDTN trailer. attach is
+                # idempotent — a trailer already stamped upstream wins
+                t_hdr = self.headers.get("x-paddle-tenant")
+                if t_hdr:
+                    body = codec.attach_tenant_trailer(
+                        body, [t_hdr] * n_req)
                 # external ingress: honor the caller's traceparent
                 # header, else make the head-sampling decision here
                 ctx = tracing.parse_traceparent(
@@ -1379,6 +1420,9 @@ class _RouterHandler(BaseHTTPRequestHandler):
                     deadline_ms = float(hdr) or None
                 except ValueError:
                     pass
+        # tenant: the JSON field wins over the x-paddle-tenant header
+        tenant = req.get("tenant") or \
+            self.headers.get("x-paddle-tenant")
         with tracing.use_context(ctx):
             fut = self._router.submit_generate(
                 req["prompt"],
@@ -1386,7 +1430,8 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 temperature=float(req.get("temperature", 0.0)),
                 timeout_ms=req.get("timeout_ms"),
                 seed=req.get("seed"),
-                deadline_ms=deadline_ms)
+                deadline_ms=deadline_ms,
+                tenant=tenant)
         self.send_response(200)
         self.send_header("Content-Type", "application/x-ndjson")
         self.end_headers()

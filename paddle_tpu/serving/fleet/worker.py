@@ -26,7 +26,9 @@ Control plane (JSON):
   text) / ``GET /statusz`` / ``GET /tracez`` (this process's span
   flight recorder; the router's merged ``/tracez`` fans out to it) /
   ``GET /sloz`` (this process's SLO evaluation; the router's merged
-  ``/sloz`` sums it fleet-wide) / ``GET /goodputz`` /
+  ``/sloz`` sums it fleet-wide) / ``GET /schedz`` (this process's
+  admission + autoscaler state; the router's merged ``/schedz`` sums
+  tenant shed counts fleet-wide) / ``GET /goodputz`` /
   ``GET /execz`` (this replica's executable cost/roofline registry;
   the router's ``/execz`` aggregates) / ``GET /profilez`` (capture
   ring; ``?duration_ms=`` runs one bounded device-profile capture)
@@ -65,7 +67,7 @@ import numpy as np
 
 from ...observability import tracing
 from ..request import (DeadlineExceededError, QueueFullError,
-                       ServerClosedError)
+                       QuotaExceededError, ServerClosedError)
 from . import codec
 from .resilience import ReplicaWedgedError, WedgeMonitor, WedgeWatchdog
 
@@ -154,6 +156,28 @@ def _worker_metrics() -> _WorkerMetrics:
         return _WM
 
 
+_WSCHED_LOCK = threading.Lock()
+_WSCHED = None
+
+
+def _worker_scheduler():
+    """This worker process's admission controller (lazy singleton,
+    registered on /schedz). Gates /submit_many per-tenant at cost 1
+    token/request — the generation path gates in the engine instead,
+    at prompt+max_new token cost. With the default policy (rate 0 =
+    unlimited) the gate admits everything, so untagged fleets behave
+    exactly as before."""
+    global _WSCHED
+    with _WSCHED_LOCK:
+        if _WSCHED is None:
+            from ..scheduling import AdmissionController
+            from ..scheduling.schedz import register_controller
+            _WSCHED = AdmissionController(
+                name=f"worker:{tracing.process_name()}")
+            register_controller(_WSCHED)
+        return _WSCHED
+
+
 def arm_wedge_watchdog(backend, app: "ReplicaApp", *,
                        timeout_ms: Optional[float] = None,
                        restart: bool = True,
@@ -221,8 +245,11 @@ class PredictorBackend:
         self._server, self._version = self._build(model_prefix)
         if generation_model is not None:
             from ..generation import GenerationServer
+            # share the worker's admission controller: the engine
+            # gates at token cost and schedules decode WFQ/priority
             self._gen = GenerationServer(generation_model,
-                                         name=f"{name}-gen")
+                                         name=f"{name}-gen",
+                                         scheduler=_worker_scheduler())
 
     def _build(self, model_prefix: str):
         from ... import inference
@@ -264,7 +291,7 @@ class PredictorBackend:
         return futs
 
     def generate(self, prompt, max_new_tokens, temperature, timeout_ms,
-                 seed, deadline_ms=None):
+                 seed, deadline_ms=None, tenant=None):
         if self._gen is None:
             raise RuntimeError("this replica hosts no generation "
                                "engine (start it with a generation "
@@ -272,7 +299,7 @@ class PredictorBackend:
         return self._gen.submit_generate(
             prompt, max_new_tokens=max_new_tokens,
             temperature=temperature, timeout_ms=timeout_ms, seed=seed,
-            deadline_ms=deadline_ms)
+            deadline_ms=deadline_ms, tenant=tenant)
 
     def warmup(self) -> int:
         """Warm per ``warmup_mode``: "manifest" replays the persisted
@@ -499,7 +526,7 @@ class StubBackend:
                 self._outstanding -= n
 
     def generate(self, prompt, max_new_tokens, temperature, timeout_ms,
-                 seed, deadline_ms=None):
+                 seed, deadline_ms=None, tenant=None):
         from ..generation.engine import StreamingFuture
         fut = StreamingFuture()
         prompt = np.asarray(prompt).ravel()
@@ -640,6 +667,14 @@ class _ReplicaHandler(BaseHTTPRequestHandler):
                 self._send(200, json.dumps(
                     sloz_payload(), sort_keys=True).encode(),
                     "application/json")
+            elif path == "/schedz":
+                # this process's admission/autoscaler state — the
+                # router's merged /schedz sums tenant events fleet-wide
+                from ..scheduling.schedz import schedz_payload
+                _worker_scheduler()   # ensure the gate is registered
+                self._send(200, json.dumps(
+                    schedz_payload(), sort_keys=True).encode(),
+                    "application/json")
             elif path == "/goodputz":
                 from ...observability.goodput import goodputz_payload
                 self._send(200, json.dumps(
@@ -744,8 +779,8 @@ class _ReplicaHandler(BaseHTTPRequestHandler):
         for part in query.split("&"):
             if part.startswith("timeout_ms="):
                 timeout_ms = float(part.split("=", 1)[1]) or None
-        feeds_list, traceparents, deadlines = \
-            codec.decode_batch_trailers(self._body())
+        feeds_list, traceparents, deadlines, tenants = \
+            codec.decode_batch_trailers_ex(self._body())
         ctxs = [tracing.parse_traceparent(tp) if tp else None
                 for tp in (traceparents or [])] or None
         # deadline gate BEFORE dispatch: a request whose budget is
@@ -762,12 +797,30 @@ class _ReplicaHandler(BaseHTTPRequestHandler):
                     "dispatch")
             if expired:
                 _worker_metrics().count_deadline_reject(len(expired))
-                keep = [i for i in range(len(feeds_list))
-                        if slots[i] is None]
-                feeds_list = [feeds_list[i] for i in keep]
-                if ctxs is not None:
-                    ctxs = [ctxs[i] for i in keep]
-            live = [ms for ms in deadlines if ms is not None
+        # per-tenant quota gate, AFTER the deadline gate (an already-
+        # dead request must not debit its tenant's bucket) and before
+        # dispatch: a shed rides the results framing as the typed
+        # QuotaExceededError (codec status _ERR_QUOTA), so one noisy
+        # tenant never fails its batch peers. Untagged requests map
+        # to the 'default' tenant deterministically.
+        sched = _worker_scheduler()
+        tlist = tenants if tenants is not None \
+            else [None] * len(feeds_list)
+        for i, t in enumerate(tlist):
+            if slots[i] is None:
+                try:
+                    sched.admit(t, cost=1.0)
+                except QuotaExceededError as e:
+                    slots[i] = e
+        if any(s is not None for s in slots):
+            keep = [i for i in range(len(feeds_list))
+                    if slots[i] is None]
+            feeds_list = [feeds_list[i] for i in keep]
+            if ctxs is not None:
+                ctxs = [ctxs[i] for i in keep]
+        if deadlines is not None:
+            live = [ms for i, ms in enumerate(deadlines)
+                    if slots[i] is None and ms is not None
                     and ms > 0.0]
             if live:
                 # the replica-side scheduling timeout honors the
@@ -826,13 +879,30 @@ class _ReplicaHandler(BaseHTTPRequestHandler):
         # ambient context for the submit: GenerationServer captures it
         # into the request, so decode spans land in the caller's trace
         ctx = tracing.parse_traceparent(req.get("traceparent"))
+        # tenant: JSON field wins, else the x-paddle-tenant header
+        # (the router stamps the field; raw clients send the header)
+        tenant = req.get("tenant") or \
+            self.headers.get("x-paddle-tenant")
+        kwargs = {"deadline_ms": req.get("deadline_ms")}
+        if tenant is not None:
+            # tenant-blind backends (pre-PDTN generate signature)
+            # keep working: only pass the kwarg when they take it
+            import inspect
+            try:
+                params = inspect.signature(
+                    self._backend.generate).parameters
+                if "tenant" in params or any(
+                        p.kind == p.VAR_KEYWORD
+                        for p in params.values()):
+                    kwargs["tenant"] = tenant
+            except (TypeError, ValueError):
+                kwargs["tenant"] = tenant
         with tracing.use_context(ctx):
             fut = self._backend.generate(
                 np.asarray(req["prompt"], np.int64),
                 int(req.get("max_new_tokens", 32)),
                 float(req.get("temperature", 0.0)),
-                req.get("timeout_ms"), req.get("seed"),
-                deadline_ms=req.get("deadline_ms"))
+                req.get("timeout_ms"), req.get("seed"), **kwargs)
         # close-delimited stream: one JSON line per token event, then
         # the terminal line with the finish reason
         self.send_response(200)

@@ -53,7 +53,7 @@ import numpy as np
 from ...observability import tracing
 from ..bucketing import ShapeBucketPolicy
 from ..request import (DeadlineExceededError, QueueFullError,
-                       ServerClosedError)
+                       QuotaExceededError, ServerClosedError)
 from .kv_cache import PagedKVCache
 from .model_fns import CachedDecoder, supports_cached_decode
 from .prefix_cache import PrefixCache
@@ -215,12 +215,14 @@ class StreamingFuture:
 class _Request:
     __slots__ = ("prompt", "max_new", "temperature", "rng", "future",
                  "submit_t", "deadline", "hard_deadline", "trace",
-                 "t_wall_ns")
+                 "t_wall_ns", "tenant", "prio_rank", "n_done", "cost")
 
     def __init__(self, prompt: np.ndarray, max_new: int,
                  temperature: float, seed: Optional[int],
                  timeout_ms: Optional[float], trace=None,
-                 deadline_ms: Optional[float] = None):
+                 deadline_ms: Optional[float] = None,
+                 tenant: str = "default", prio_rank: int = 1,
+                 n_done: int = 0):
         self.prompt = prompt
         self.max_new = int(max_new)
         self.temperature = float(temperature)
@@ -239,6 +241,14 @@ class _Request:
         # _Request, so warmup traffic is structurally untraced
         self.trace = trace
         self.t_wall_ns = time.time_ns() if trace is not None else 0
+        # multi-tenant scheduling: the WFQ cost is token-denominated
+        # (prompt + generation budget); n_done counts tokens already
+        # streamed before a park/resume cycle, so TTFT and max_new
+        # accounting survive preemption
+        self.tenant = tenant
+        self.prio_rank = int(prio_rank)
+        self.n_done = int(n_done)
+        self.cost = float(len(prompt) + self.max_new)
 
     def expired(self, now: float) -> bool:
         if self.deadline is not None and now > self.deadline:
@@ -276,7 +286,7 @@ class _ActiveSeq:
 
 
 _EVENTS = ("submitted", "completed", "rejected", "timed_out",
-           "cancelled", "failed")
+           "cancelled", "failed", "parked", "preempted", "resumed")
 
 
 class DecodeMetrics:
@@ -481,6 +491,7 @@ class GenerationServer:
                  prefix_cache: Optional[bool] = None,
                  draft_model=None,
                  spec_k: Optional[int] = None,
+                 scheduler=None,
                  start: bool = True):
         model.eval()
         self.model = model
@@ -555,6 +566,14 @@ class GenerationServer:
         self.metrics = DecodeMetrics(name, self.max_batch,
                                      self.kv.capacity)
         self.metrics.set_kv_pages(0, self.kv.capacity)
+        # ---- multi-tenant admission (scheduling subsystem): an
+        # AdmissionController adds per-tenant token-bucket quotas,
+        # weighted-fair queue ordering, and priority-aware
+        # page-pressure preemption; None = classic FIFO engine
+        self.scheduler = scheduler
+        if scheduler is not None:
+            from ..scheduling.schedz import register_controller
+            register_controller(scheduler)
         # ONE Condition is both the engine lock and the wakeup channel
         self._lock = threading.Condition()
         self._queue: "deque[_Request]" = deque()
@@ -698,6 +717,11 @@ class GenerationServer:
             }
             if self.prefix is not None:
                 out["prefix_cache"] = self.prefix.stats()
+            if self.scheduler is not None:
+                depths: Dict[str, int] = {}
+                for q in self._queue:
+                    depths[q.tenant] = depths.get(q.tenant, 0) + 1
+                out["tenant_queue_depth"] = depths
         return out
 
     # ------------------------------------------------------ lifecycle
@@ -749,7 +773,8 @@ class GenerationServer:
                         temperature: float = 0.0,
                         timeout_ms: Optional[float] = None,
                         seed: Optional[int] = None,
-                        deadline_ms: Optional[float] = None
+                        deadline_ms: Optional[float] = None,
+                        tenant: Optional[str] = None
                         ) -> StreamingFuture:
         """Enqueue one prompt; returns the token stream. ``timeout_ms``
         is a SCHEDULING deadline (like ``InferenceServer.submit``): a
@@ -760,8 +785,11 @@ class GenerationServer:
         the next batch re-form — its pages return to the free list and
         its future fails with DeadlineExceededError (tokens already
         emitted stay available) — instead of burning decode steps on
-        an answer nobody is waiting for. Raises QueueFullError at
-        capacity, ServerClosedError after shutdown, ValueError for
+        an answer nobody is waiting for. ``tenant`` selects the
+        multi-tenant envelope when the engine has a scheduler
+        (untagged maps to ``default``): over-quota submissions raise
+        the typed per-tenant QuotaExceededError. Raises QueueFullError
+        at capacity, ServerClosedError after shutdown, ValueError for
         prompts that leave no room to generate."""
         if self._closed:
             raise ServerClosedError("engine is shut down")
@@ -777,11 +805,35 @@ class GenerationServer:
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         ctx = tracing.request_context()
+        prio_rank, tname = 1, "default"
+        if self.scheduler is not None:
+            pol = self.scheduler.policy.lookup(tenant)
+            tname, prio_rank = pol.tenant, pol.rank
+            # token-denominated quota: one submission spends
+            # prompt + generation budget from the tenant's bucket
+            cost = float(prompt.size + max_new_tokens)
+            if not self.scheduler.try_admit(tname, cost):
+                self.metrics.count("rejected")
+                err = QuotaExceededError(
+                    f"tenant {tname!r} exceeded its token quota "
+                    f"({pol.rate:g}/s, burst {pol.burst:g})",
+                    tenant=tname)
+                if ctx is not None:
+                    tracing.record_span(
+                        ctx.child(), "generate::shed", stage="shed",
+                        start_unix_ns=time.time_ns(), duration_ms=0.0,
+                        status="error",
+                        attrs={"server": self.metrics.name,
+                               "tenant": tname,
+                               "error": "QuotaExceededError"},
+                        root=True)
+                raise err
         req = _Request(prompt, max_new_tokens, temperature, seed,
                        timeout_ms if timeout_ms is not None
                        else self.default_timeout_ms,
                        trace=ctx.child() if ctx is not None else None,
-                       deadline_ms=deadline_ms)
+                       deadline_ms=deadline_ms,
+                       tenant=tname, prio_rank=prio_rank)
         with self._lock:
             if self._closed:
                 raise ServerClosedError("engine is shut down")
@@ -1019,6 +1071,74 @@ class GenerationServer:
                                error="DeadlineExceededError",
                                finish_reason="deadline")
 
+    def _preempt_for_pages(self, rank: int, need: int) -> bool:
+        """Priority-aware page pressure (lock held): park in-flight
+        streams of a STRICTLY lower priority class (higher rank
+        number) — lowest class first, youngest first within a class —
+        until ``need`` pages are free. Generalizes the expired-stream
+        eviction above: pages go back to the free list (leak_check
+        stays clean), but the stream is re-queued to RESUME from its
+        full token history instead of failing. Returns True when the
+        reservation now fits; equal-or-higher classes are never
+        touched."""
+        if need > self.kv.free_pages + sum(
+                len(s.pages) for s in self._slots
+                if s is not None and s.req.prio_rank > rank):
+            return False        # not even parking everyone would fit
+        victims = [s for s in self._slots
+                   if s is not None and s.req.prio_rank > rank]
+        victims.sort(key=lambda s: (-s.req.prio_rank,
+                                    -s.req.submit_t))
+        for seq in victims:
+            if self.kv.free_pages >= need:
+                break
+            self._park(seq)
+        return self.kv.free_pages >= need
+
+    def _park(self, seq: _ActiveSeq):
+        """Preempt ONE in-flight stream (lock held): free its pages
+        and lane, then re-queue it to resume — the resumed request's
+        prompt is the full token history, so a later prefill (prefix
+        cache permitting, a cheap one) reconstructs the K/V and the
+        SAME future keeps streaming where it left off. When resume is
+        impossible (engine closing, queue full, or the history already
+        fills max_seq_len) the stream fails with the typed per-tenant
+        QuotaExceededError instead of hanging."""
+        self._release(seq, "parked")
+        r = seq.req
+        history = list(seq.history)     # prompt + every emitted token
+        resumable = (not self._closed
+                     and len(self._queue) < self.queue_capacity
+                     and len(history) < self.max_seq_len
+                     and r.max_new - seq.n_generated >= 1)
+        if not resumable:
+            self.metrics.count("preempted")
+            r.future._fail(
+                QuotaExceededError(
+                    f"stream preempted by a higher priority class "
+                    f"after {seq.n_generated} token(s); resume "
+                    f"unavailable", tenant=r.tenant),
+                reason="preempted")
+            self._trace_finish([seq], "error",
+                               error="QuotaExceededError",
+                               finish_reason="preempted")
+            return
+        nr = _Request(np.asarray(history, np.int64),
+                      r.max_new - seq.n_generated, r.temperature,
+                      None, None, trace=r.trace,
+                      tenant=r.tenant, prio_rank=r.prio_rank,
+                      n_done=r.n_done + seq.n_generated)
+        # the resumed request IS the original request: same future,
+        # same RNG stream, same deadlines, same submit time (so the
+        # scheduling deadline keeps covering the whole stream)
+        nr.future = r.future
+        nr.rng = r.rng
+        nr.submit_t = r.submit_t
+        nr.deadline = r.deadline
+        nr.hard_deadline = r.hard_deadline
+        nr.t_wall_ns = r.t_wall_ns
+        self._queue.append(nr)
+
     def _do_abort(self):
         """drain=False shutdown: fail everything still live (lock
         held)."""
@@ -1064,7 +1184,13 @@ class GenerationServer:
             free_slots = [i for i, s in enumerate(self._slots)
                           if s is None]
             while self._queue and free_slots:
-                req = self._queue[0]
+                # weighted-fair pick across tenants (priority classes
+                # first) when a scheduler is attached; FIFO otherwise
+                idx = 0
+                if self.scheduler is not None and len(self._queue) > 1:
+                    sel = self.scheduler.select(self._queue)
+                    idx = sel if sel is not None else 0
+                req = self._queue[idx]
                 max_total = min(len(req.prompt) + req.max_new,
                                 self.max_seq_len)
                 # admission consults the prefix index FIRST: matched
@@ -1080,8 +1206,15 @@ class GenerationServer:
                     # then retry once
                     if self.prefix.evict(need - self.kv.free_pages):
                         pages = self.kv.alloc(need)
+                if pages is None and self.scheduler is not None:
+                    # priority-aware page pressure: park strictly
+                    # LOWER-priority in-flight streams (batch before
+                    # standard; realtime is never touched) until the
+                    # reservation fits, then retry once
+                    if self._preempt_for_pages(req.prio_rank, need):
+                        pages = self.kv.alloc(need)
                 if pages is None:
-                    break       # FIFO head-of-line until pages free up
+                    break       # head-of-line until pages free up
                 # exception barrier (pdlint RP001): between taking the
                 # reservation and publishing it into self._slots no
                 # failure may keep the references — a leaked page never
@@ -1097,7 +1230,7 @@ class GenerationServer:
                         self.prefix.note_admission(matched)
                         if matched:
                             self.metrics.observe_prefix_hit(matched)
-                    self._queue.popleft()
+                    del self._queue[idx]
                     slot = free_slots.pop(0)
                     seq = _ActiveSeq(req, slot, shared + pages,
                                      max_total, prefix_len=matched)
@@ -1548,8 +1681,14 @@ class GenerationServer:
                     seq.history.append(tok)
                     seq.n_generated += 1
                     if seq.n_generated == 1:
-                        self.metrics.observe_ttft(
-                            (now - seq.req.submit_t) * 1e3)
+                        if seq.req.n_done:
+                            # a parked stream came back: count the
+                            # resume, don't re-observe TTFT (its first
+                            # token happened before the preemption)
+                            self.metrics.count("resumed")
+                        else:
+                            self.metrics.observe_ttft(
+                                (now - seq.req.submit_t) * 1e3)
                     else:
                         inter.append((now - seq.last_emit_t) * 1e3)
                     seq.last_emit_t = now

@@ -15,14 +15,28 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["QueueFullError", "DeadlineExceededError", "ServerClosedError",
-           "Request"]
+__all__ = ["QueueFullError", "QuotaExceededError",
+           "DeadlineExceededError", "ServerClosedError", "Request"]
 
 
 class QueueFullError(RuntimeError):
     """Raised by ``InferenceServer.submit`` when the bounded request
     queue is at capacity — the backpressure signal; callers shed load or
     retry with their own policy instead of growing an unbounded queue."""
+
+
+class QuotaExceededError(QueueFullError):
+    """Per-TENANT shed: the tenant's token-bucket quota (or a
+    preemption by a higher priority class) rejected this request while
+    other tenants keep flowing. Subclasses ``QueueFullError`` so every
+    untyped shed path (HTTP 429 mapping, retry classification, loadgen
+    accounting) keeps treating it as load shedding; typed consumers
+    read ``.tenant`` for the per-tenant decision."""
+
+    def __init__(self, message: str = "tenant quota exceeded",
+                 tenant: str = "default"):
+        super().__init__(message)
+        self.tenant = tenant
 
 
 class DeadlineExceededError(TimeoutError):
@@ -47,15 +61,18 @@ class Request:
     flight recorder, like it is from traffic metrics."""
 
     __slots__ = ("feeds", "rows", "future", "submit_t", "deadline",
-                 "signature", "orig_seq", "trace", "t_wall_ns")
+                 "signature", "orig_seq", "trace", "t_wall_ns",
+                 "tenant")
 
     def __init__(self, feeds: List[np.ndarray], rows: int,
                  signature: Tuple, orig_seq: Optional[List[int]] = None,
-                 timeout_ms: Optional[float] = None, trace=None):
+                 timeout_ms: Optional[float] = None, trace=None,
+                 tenant: Optional[str] = None):
         self.feeds = feeds
         self.rows = rows
         self.signature = signature
         self.orig_seq = orig_seq
+        self.tenant = tenant
         self.future: Future = Future()
         self.submit_t = time.monotonic()
         self.deadline = (self.submit_t + timeout_ms / 1e3

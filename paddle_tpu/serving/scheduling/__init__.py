@@ -1,0 +1,46 @@
+"""paddle_tpu.serving.scheduling — multi-tenant admission control +
+SLO-driven autoscaling: the serving control loop's actuator half.
+
+PRs 11-15 built the sensors (burn-rate alert sinks, goodput ledger,
+deadline propagation, chaos harness); this package closes the loop:
+
+- ``policy``: tenant -> (rate, burst, weight, priority class) table,
+  from ``FLAGS_sched_*`` or a hot-reloadable JSON policy file. The
+  priority classes are ``realtime`` > ``standard`` > ``batch``;
+  untagged requests map deterministically to the ``default`` tenant.
+- ``admission``: per-tenant token buckets + weighted-fair queuing;
+  typed per-tenant ``QuotaExceededError`` sheds (riding the fleet
+  codec's status mapping) instead of global queue-full.
+- ``autoscaler``: ``FleetAutoscaler`` subscribes to the SLO monitor's
+  burn-rate alert sinks plus queue depth / decode occupancy and
+  drives ``ReplicaSupervisor.scale_to(n)`` with hysteresis.
+- ``schedz``: the ``/schedz`` JSON surface (httpd + worker +
+  router-merged, following the ``/sloz`` pattern) and the
+  ``paddle_sched_*`` / ``paddle_autoscale_*`` metric families.
+
+Tenancy propagates per request: an ``x-paddle-tenant`` HTTP header, a
+``tenant`` JSON field on ``/generate``, and a ``PDTN`` codec trailer
+next to PDTC/PDDL on the fleet wire.
+
+Knobs: ``FLAGS_sched_*`` / ``FLAGS_autoscale_*`` in framework/flags.py.
+"""
+from __future__ import annotations
+
+from .admission import (AdmissionController, TokenBucket,
+                        WeightedFairQueue)
+from .autoscaler import FleetAutoscaler
+from .metrics import AutoscaleMetrics, SchedMetrics
+from .policy import (DEFAULT_TENANT, PRIORITY_CLASSES, SchedulerPolicy,
+                     TenantPolicy, normalize_tenant, priority_rank)
+from .schedz import (merge_schedz_payloads, register_autoscaler,
+                     register_controller, schedz_payload)
+
+__all__ = [
+    "AdmissionController", "TokenBucket", "WeightedFairQueue",
+    "FleetAutoscaler", "SchedulerPolicy", "TenantPolicy",
+    "SchedMetrics", "AutoscaleMetrics",
+    "normalize_tenant", "priority_rank",
+    "DEFAULT_TENANT", "PRIORITY_CLASSES",
+    "schedz_payload", "merge_schedz_payloads",
+    "register_controller", "register_autoscaler",
+]

@@ -146,7 +146,7 @@ class InferenceServer:
                  donate_inputs: Optional[bool] = None,
                  telemetry_port: Optional[int] = None,
                  ready_requires_warmup: Optional[bool] = None,
-                 start: bool = True):
+                 scheduler=None, start: bool = True):
         self.predictor = predictor
         self.max_batch_size = int(max_batch_size if max_batch_size
                                   is not None
@@ -172,10 +172,14 @@ class InferenceServer:
             seq_buckets=seq_buckets, seq_axis=seq_axis)
         self.metrics = metrics_mod.register(metrics_mod.ServingMetrics(
             name, window=int(_flag("FLAGS_serving_latency_window", 2048))))
+        self.scheduler = scheduler  # scheduling.AdmissionController
+        if scheduler is not None:
+            from .scheduling.schedz import register_controller
+            register_controller(scheduler)
         self._batcher = DynamicBatcher(
             max_batch_size=self.max_batch_size,
             max_wait_ms=self.max_wait_ms, capacity=int(cap),
-            metrics=self.metrics)
+            metrics=self.metrics, scheduler=scheduler)
         self._feed_names = list(predictor.get_input_names())
         self._staging = _StagingPool(self.pipeline_depth + 2)
         self._completion_q: "queue.Queue[Optional[_Inflight]]" = \
@@ -368,15 +372,18 @@ class InferenceServer:
         return arrs
 
     def submit(self, feed: FeedLike,
-               timeout_ms: Optional[float] = None):
+               timeout_ms: Optional[float] = None,
+               tenant: Optional[str] = None):
         """Enqueue one request; returns a Future resolving to the list
         of output arrays for THIS request (padded rows/positions already
-        sliced away). Raises QueueFullError at capacity and
-        ServerClosedError after shutdown."""
+        sliced away). Raises QueueFullError at capacity (or its
+        QuotaExceededError subclass when a ``scheduler`` sheds the
+        ``tenant``) and ServerClosedError after shutdown."""
         if self._closed:
             raise ServerClosedError("server is shut down")
         req = self._make_request(feed, timeout_ms,
-                                 trace=tracing.request_context())
+                                 trace=tracing.request_context(),
+                                 tenant=tenant)
         self.metrics.count("submitted")
         try:
             self._batcher.put(req)
@@ -401,7 +408,8 @@ class InferenceServer:
 
     def _make_request(self, feed: FeedLike,
                       timeout_ms: Optional[float],
-                      trace=None) -> Request:
+                      trace=None,
+                      tenant: Optional[str] = None) -> Request:
         arrs = self._normalize(feed)
         rows = int(arrs[0].shape[0]) if arrs[0].ndim else 1
         if rows > self.max_batch_size:
@@ -422,7 +430,8 @@ class InferenceServer:
                        timeout_ms=timeout_ms if timeout_ms is not None
                        else self.default_timeout_ms,
                        trace=trace.child() if trace is not None
-                       else None)
+                       else None,
+                       tenant=tenant)
 
     def submit_many(self, feeds: Sequence[FeedLike],
                     timeout_ms: Optional[float] = None,

@@ -1,0 +1,600 @@
+"""Multi-tenant scheduling & SLO-driven autoscaling (ISSUE 16).
+
+The control loop's actuator half: tenant propagation (PDTN codec
+trailer / x-paddle-tenant header / JSON field), per-tenant token-bucket
+quotas with the typed ``QuotaExceededError``, weighted-fair queuing
+with priority classes, priority-aware KV page preemption in the
+generation engine, ``FleetAutoscaler`` hysteresis, and the ``/schedz``
+surface (worker + router-merged over real HTTP).
+
+Everything clock-injected where determinism matters; the engine tests
+run a real tiny model on CPU like tests/test_decode_serving.py.
+"""
+import json
+import os
+import textwrap
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.serving import fleet
+from paddle_tpu.serving.fleet import codec
+from paddle_tpu.serving.request import (QueueFullError,
+                                        QuotaExceededError)
+from paddle_tpu.serving.scheduling import (DEFAULT_TENANT,
+                                           AdmissionController,
+                                           FleetAutoscaler,
+                                           SchedulerPolicy,
+                                           TenantPolicy, TokenBucket,
+                                           WeightedFairQueue,
+                                           normalize_tenant)
+
+_OPENER = urllib.request.build_opener(
+    urllib.request.ProxyHandler({}))
+
+
+def _feed(v=1.0, rows=1):
+    return [np.full((rows, 4), v, np.float32)]
+
+
+def _policy(**tenants):
+    return SchedulerPolicy(tenants={
+        name: TenantPolicy(name, **spec)
+        for name, spec in tenants.items()})
+
+
+# ------------------------------------------------------- token bucket
+class TestTokenBucket:
+    def test_deterministic_refill_injected_clock(self):
+        b = TokenBucket(rate=2.0, burst=4.0, now=0.0)
+        # starts full: the burst admits
+        assert all(b.try_acquire(1.0, now=0.0) for _ in range(4))
+        assert not b.try_acquire(1.0, now=0.0)
+        # half a second refills exactly one token at 2/s
+        assert b.try_acquire(1.0, now=0.5)
+        assert not b.try_acquire(1.0, now=0.5)
+        # refill caps at burst no matter how long the sleep
+        assert b.available(1e6) == pytest.approx(4.0)
+
+    def test_all_or_nothing_spend(self):
+        b = TokenBucket(rate=1.0, burst=2.0, now=0.0)
+        assert not b.try_acquire(3.0, now=0.0)   # > available: refused
+        assert b.available(0.0) == pytest.approx(2.0)  # nothing spent
+        assert b.try_acquire(2.0, now=0.0)
+
+    def test_rate_zero_is_unlimited(self):
+        b = TokenBucket(rate=0.0, burst=1.0, now=0.0)
+        assert all(b.try_acquire(100.0, now=0.0) for _ in range(50))
+        assert b.available(0.0) == float("inf")
+
+
+# --------------------------------------------------------- normalize
+class TestNormalizeTenant:
+    @pytest.mark.parametrize("raw", [
+        None, "", "   ", 7, b"x", "a" * 65, "bad/slash", "sp ace",
+        "semi;colon"])
+    def test_untagged_and_invalid_map_to_default(self, raw):
+        assert normalize_tenant(raw) == DEFAULT_TENANT
+
+    def test_valid_names_preserved(self):
+        for name in ("rt", "team-a", "Team.B_2", "x" * 64):
+            assert normalize_tenant(name) == name
+
+
+# --------------------------------------------------------------- WFQ
+class TestWeightedFairQueue:
+    def test_weighted_interleave_three_tenants(self):
+        """Weights 4/2/1 with saturated backlogs: the first 14 pops
+        drain token volume proportional to weight."""
+        pol = _policy(a={"weight": 4.0}, b={"weight": 2.0},
+                      c={"weight": 1.0})
+        q = WeightedFairQueue(pol)
+        for i in range(8):
+            for t in ("a", "b", "c"):
+                q.push(f"{t}{i}", t)
+        first = [q.pop() for _ in range(14)]
+        by_tenant = {t: sum(1 for x in first if x.startswith(t))
+                     for t in "abc"}
+        assert by_tenant["a"] == 8          # weight-4 lane drains 4x
+        assert by_tenant["b"] == 4
+        assert by_tenant["c"] == 2
+        # FIFO within a tenant
+        a_items = [x for x in first if x.startswith("a")]
+        assert a_items == sorted(a_items, key=lambda s: int(s[1:]))
+
+    def test_priority_classes_before_fairness(self):
+        pol = _policy(rt={"priority": "realtime", "weight": 1.0},
+                      bulk={"priority": "batch", "weight": 100.0})
+        q = WeightedFairQueue(pol)
+        q.push("bulk0", "bulk")
+        q.push("rt0", "rt")
+        q.push("rt1", "rt")
+        # all queued realtime drains before any batch, weight be damned
+        assert [q.pop(), q.pop(), q.pop()] == ["rt0", "rt1", "bulk0"]
+
+    def test_idle_tenant_banks_no_credit(self):
+        pol = _policy(a={"weight": 1.0}, b={"weight": 1.0})
+        q = WeightedFairQueue(pol)
+        for i in range(6):
+            q.push(f"a{i}", "a")
+        for _ in range(6):
+            q.pop()                      # a's finish tag is far ahead
+        q.push("b0", "b")                # b slept through all of it
+        q.push("a6", "a")
+        # b's lane snaps to the global virtual clock: it gets ONE
+        # fair turn, not six banked ones
+        got = [q.pop(), q.pop()]
+        assert sorted(got) == ["a6", "b0"]
+
+
+# ----------------------------------------------------------- admission
+class TestAdmissionController:
+    def test_typed_quota_shed_other_tenants_unaffected(self):
+        clock = [0.0]
+        ctrl = AdmissionController(
+            policy=_policy(noisy={"rate": 1.0, "burst": 2.0}),
+            name="t_adm", now=lambda: clock[0])
+        assert ctrl.admit("noisy") == "noisy"
+        assert ctrl.admit("noisy") == "noisy"
+        with pytest.raises(QuotaExceededError) as ei:
+            ctrl.admit("noisy")
+        assert ei.value.tenant == "noisy"
+        assert isinstance(ei.value, QueueFullError)  # untyped callers
+        # the quiet tenant rides the unlimited default envelope
+        for _ in range(20):
+            ctrl.admit("quiet")
+        clock[0] = 1.0                   # 1s refills one noisy token
+        assert ctrl.try_admit("noisy")
+        assert not ctrl.try_admit("noisy")
+        snap = ctrl.snapshot()
+        assert snap["events"]["noisy"]["shed_quota"] >= 2
+        assert snap["events"]["quiet"]["admitted"] == 20
+
+    def test_select_is_weighted_and_fifo_per_tenant(self):
+        class R:
+            def __init__(self, tenant, tag):
+                self.tenant = tenant
+                self.tag = tag
+
+        ctrl = AdmissionController(
+            policy=_policy(rt={"priority": "realtime"},
+                           std={"priority": "standard"},
+                           bulk={"priority": "batch"}),
+            name="t_sel")
+        queue = [R("bulk", "b0"), R("std", "s0"), R("rt", "r0"),
+                 R("rt", "r1")]
+        order = []
+        while queue:
+            idx = ctrl.select(queue)
+            order.append(queue.pop(idx).tag)
+        assert order == ["r0", "r1", "s0", "b0"]
+        assert ctrl.select([]) is None
+
+    def test_policy_file_hot_reload(self, tmp_path):
+        path = tmp_path / "policy.json"
+        path.write_text(json.dumps(
+            {"tenants": {"n": {"rate": 1.0, "burst": 1.0}}}))
+        pol = SchedulerPolicy(path=str(path))
+        assert pol.lookup("n").rate == 1.0
+        path.write_text(json.dumps(
+            {"default": {"rate": 9.0, "burst": 9.0},
+             "tenants": {"n": {"rate": 5.0, "burst": 5.0}}}))
+        assert pol.reload()
+        assert pol.lookup("n").rate == 5.0
+        assert pol.lookup("unknown-tenant").rate == 9.0
+        snap = pol.snapshot()
+        assert snap["reloads"] >= 2 and snap["reload_errors"] == 0
+        # malformed file keeps the last good table, counts the error
+        path.write_text("{not json")
+        assert not pol.reload()
+        assert pol.lookup("n").rate == 5.0
+        assert pol.snapshot()["reload_errors"] == 1
+
+
+# ------------------------------------------------------- PDTN trailer
+class TestTenantTrailer:
+    def test_roundtrip_alongside_trace_and_deadline(self):
+        body = codec.encode_batch([_feed(), _feed()])
+        stamped = codec.attach_trace_trailer(
+            body, ["00-" + "a" * 32 + "-" + "b" * 16 + "-01", None])
+        stamped = codec.attach_deadline_trailer(stamped, [42.5, None])
+        stamped = codec.attach_tenant_trailer(stamped, ["rt", None])
+        feeds, tps, dls, tenants = \
+            codec.decode_batch_trailers_ex(stamped)
+        assert len(feeds) == 2
+        assert tps[0].startswith("00-") and dls == [42.5, None]
+        assert tenants == ["rt", None]
+
+    def test_trailer_blind_back_compat(self):
+        """A PDTN-stamped payload still decodes through every older
+        entry point (the decode_batch_ex pattern): trailer-blind
+        callers see the same feeds and never the tenant section."""
+        body = codec.encode_batch([_feed(3.0)])
+        stamped = codec.attach_tenant_trailer(body, ["team-a"])
+        assert codec.peek_batch_size(stamped) == 1
+        feeds, tps, dls = codec.decode_batch_trailers(stamped)
+        assert len(feeds) == 1
+        assert not any(tps or []) and not any(dls or [])
+        np.testing.assert_array_equal(
+            codec.decode_batch(stamped)[0][0], _feed(3.0)[0])
+
+    def test_attach_is_idempotent_and_validates(self):
+        body = codec.encode_batch([_feed()])
+        stamped = codec.attach_tenant_trailer(body, ["t1"])
+        # upstream stamp wins: re-stamping is a no-op, not an error
+        assert codec.attach_tenant_trailer(stamped, ["t2"]) == stamped
+        with pytest.raises(codec.CodecError):
+            codec.attach_tenant_trailer(body, ["a", "b"])
+
+    def test_quota_error_rides_status_mapping(self):
+        ctrl = AdmissionController(
+            policy=_policy(noisy={"rate": 1.0, "burst": 1.0}),
+            name="t_wire", now=lambda: 0.0)
+        ctrl.admit("noisy")
+        try:
+            ctrl.admit("noisy")
+        except QuotaExceededError as e:
+            wire = codec.encode_results([e])
+        back = codec.decode_results(wire)[0]
+        assert isinstance(back, QuotaExceededError)
+        assert back.tenant == "noisy"      # identity survives the wire
+        assert isinstance(back, QueueFullError)
+
+
+# ----------------------------------------------------- untagged ingress
+class TestUntaggedDefault:
+    """Satellite bugfix: untagged requests map deterministically to
+    the ``default`` tenant across all three ingress forms (no trailer,
+    no header, no JSON field)."""
+
+    def test_worker_http_untagged_and_tagged(self):
+        be = fleet.StubBackend(device_ms=1.0)
+        app = fleet.ReplicaApp(be).start()
+        be.warmup()
+        try:
+            def _submit(body):
+                req = urllib.request.Request(
+                    app.url + "/submit_many", data=body,
+                    headers={"Content-Type":
+                             "application/x-paddle-fleet"})
+                with _OPENER.open(req, timeout=10) as resp:
+                    return codec.decode_results(resp.read())
+
+            plain = codec.encode_batch([_feed()])
+            res = _submit(plain)                     # no trailer
+            assert not isinstance(res[0], Exception)
+            res = _submit(codec.attach_tenant_trailer(
+                codec.encode_batch([_feed()]), ["tagged-9"]))
+            assert not isinstance(res[0], Exception)
+            with _OPENER.open(app.url + "/schedz", timeout=10) as r:
+                doc = json.loads(r.read())
+            events = {}
+            for ctrl_doc in doc["admission"].values():
+                for t, ev in ctrl_doc.get("events", {}).items():
+                    events.setdefault(t, 0)
+                    events[t] += ev.get("admitted", 0)
+            assert events.get(DEFAULT_TENANT, 0) >= 1   # untagged
+            assert events.get("tagged-9", 0) >= 1       # tagged
+        finally:
+            app.stop()
+
+    def test_router_header_ingress_stamps_trailer(self):
+        """x-paddle-tenant on a raw router POST becomes the PDTN
+        trailer; a body stamped upstream wins over the header."""
+        be = fleet.StubBackend(device_ms=1.0)
+        app = fleet.ReplicaApp(be).start()
+        be.warmup()
+        router = fleet.FleetRouter({0: app.url}, name="t_hdr",
+                                   start=False)
+        router.poll_replicas()
+        rapp = fleet.RouterApp(router, host="127.0.0.1").start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{rapp.port}/submit_many",
+                data=codec.encode_batch([_feed()]),
+                headers={"Content-Type": "application/x-paddle-fleet",
+                         "x-paddle-tenant": "hdr-tenant"})
+            with _OPENER.open(req, timeout=10) as resp:
+                res = codec.decode_results(resp.read())
+            assert not isinstance(res[0], Exception)
+            with _OPENER.open(app.url + "/schedz", timeout=10) as r:
+                doc = json.loads(r.read())
+            seen = set()
+            for ctrl_doc in doc["admission"].values():
+                seen |= set(ctrl_doc.get("events", {}))
+            assert "hdr-tenant" in seen
+        finally:
+            rapp.stop()
+            router.shutdown()
+            app.stop()
+
+    def test_engine_untagged_maps_to_default(self):
+        ctrl = AdmissionController(name="t_eng_default")
+        assert ctrl.admit(None) == DEFAULT_TENANT
+        assert ctrl.admit("") == DEFAULT_TENANT
+        assert ctrl.snapshot()["events"][DEFAULT_TENANT][
+            "admitted"] == 2
+
+
+# ------------------------------------------------- engine preemption
+def _make_model():
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+    paddle.seed(0)
+    m = GPTForCausalLM(gpt_tiny(use_flash_attention=False))
+    m.eval()
+    return m
+
+
+class TestPriorityPreemption:
+    def _server(self, **kw):
+        from paddle_tpu.serving.generation import GenerationServer
+        sched = AdmissionController(
+            policy=_policy(rt={"priority": "realtime"},
+                           bulk={"priority": "batch"}),
+            name=kw.pop("name", "t_press"))
+        kw.setdefault("max_batch", 2)
+        kw.setdefault("page_size", 4)
+        kw.setdefault("num_pages", 8)
+        kw.setdefault("prefix_cache", False)
+        return GenerationServer(_make_model(), scheduler=sched, **kw)
+
+    def test_realtime_parks_batch_and_it_resumes_leak_clean(self):
+        with self._server(name="t_park") as srv:
+            bulk = srv.submit_generate([5, 6, 7, 8, 9, 10],
+                                       max_new_tokens=20,
+                                       tenant="bulk")
+            for _ in bulk:               # bulk holds its pages
+                break
+            rt = srv.submit_generate([1, 2, 3, 4], max_new_tokens=8,
+                                     tenant="rt")
+            assert len(rt.result(timeout=180)) == 8
+            assert len(bulk.result(timeout=180)) == 20
+            snap = srv.metrics_snapshot()
+            assert snap["counters"]["parked"] >= 1
+            assert snap["counters"]["resumed"] >= 1
+            leak = snap["kv_leak_check"]
+            assert leak["ok"], leak
+            assert leak["leaked"] == 0
+
+    def test_batch_never_preempts_higher_class(self):
+        with self._server(name="t_noup") as srv:
+            rt = srv.submit_generate([5, 6, 7, 8, 9, 10],
+                                     max_new_tokens=20, tenant="rt")
+            for _ in rt:                 # rt holds (all) the pages
+                break
+            bulk = srv.submit_generate([1, 2, 3, 4], max_new_tokens=8,
+                                       tenant="bulk")
+            assert len(rt.result(timeout=180)) == 20
+            assert len(bulk.result(timeout=180)) == 8  # waited its turn
+            snap = srv.metrics_snapshot()
+            assert snap["counters"]["parked"] == 0     # rt untouched
+            assert snap["kv_leak_check"]["ok"]
+
+    def test_engine_token_quota_typed(self):
+        from paddle_tpu.serving.generation import GenerationServer
+        sched = AdmissionController(
+            policy=_policy(capped={"rate": 1.0, "burst": 16.0}),
+            name="t_tokq", now=lambda: 0.0)
+        with GenerationServer(_make_model(), scheduler=sched,
+                              max_batch=2, page_size=4,
+                              prefix_cache=False,
+                              name="t_tokq") as srv:
+            fut = srv.submit_generate([1, 2, 3], max_new_tokens=4,
+                                      tenant="capped")   # cost 7
+            assert len(fut.result(timeout=180)) == 4
+            with pytest.raises(QuotaExceededError) as ei:
+                srv.submit_generate([1, 2, 3], max_new_tokens=12,
+                                    tenant="capped")     # cost 15 > 9
+            assert ei.value.tenant == "capped"
+            assert srv.statusz()["kv_leak_check"]["ok"]
+
+
+# ----------------------------------------------------- autoscaler
+class _FakeSup:
+    def __init__(self, n=2):
+        self.n = n
+        self.calls = []
+
+    @property
+    def replica_ids(self):
+        return list(range(self.n))
+
+    def scale_to(self, n):
+        self.calls.append(int(n))
+        self.n = int(n)
+
+
+class _FakeMonitor:
+    def __init__(self):
+        self.sinks = {}
+
+    def add_alert_sink(self, name, fn):
+        self.sinks[name] = fn
+
+    def remove_alert_sink(self, name):
+        self.sinks.pop(name, None)
+
+
+class TestAutoscalerHysteresis:
+    def _build(self, **kw):
+        clock = [0.0]
+        sup = _FakeSup(2)
+        mon = _FakeMonitor()
+        kw.setdefault("min_replicas", 2)
+        kw.setdefault("max_replicas", 4)
+        kw.setdefault("cooldown_s", 30.0)
+        kw.setdefault("scale_in_quiet_s", 120.0)
+        asc = FleetAutoscaler(sup, monitor=mon,
+                              now=lambda: clock[0],
+                              name="t_hys", **kw)
+        sink = mon.sinks["autoscaler-t_hys"]
+        return clock, sup, sink, asc
+
+    def _alert(self, firing, rule="fast_burn"):
+        return {"slo": "s", "rule": rule, "firing": firing,
+                "severity": "page"}
+
+    def test_square_wave_does_not_flap(self):
+        """A 20s-period fast_burn square wave for 5 simulated
+        minutes: scale-out marches to the cap (one step per cooldown)
+        and NOTHING scales in — the quiet window never accrues."""
+        clock, sup, sink, asc = self._build()
+        decisions = []
+        for t in range(0, 300):
+            clock[0] = float(t)
+            sink(self._alert(t % 20 < 10))
+            d = asc.evaluate()
+            if d:
+                decisions.append(d)
+        assert [d["direction"] for d in decisions] == ["out", "out"]
+        assert sup.calls == [3, 4]                # capped at max
+        # actions spaced by at least the cooldown
+        assert decisions[1]["t"] - decisions[0]["t"] >= 30.0
+
+    def test_scale_in_needs_sustained_quiet(self):
+        clock, sup, sink, asc = self._build()
+        sink(self._alert(True))
+        clock[0] = 1.0
+        assert asc.evaluate()["direction"] == "out"     # 2 -> 3
+        sink(self._alert(False))                        # resolved
+        clock[0] = 2.0
+        assert asc.evaluate() is None      # quiet clock starts here
+        clock[0] = 100.0
+        assert asc.evaluate() is None      # quiet only 98s < 120s
+        clock[0] = 125.0
+        d = asc.evaluate()                 # quiet 124s: in (3 -> 2)
+        assert d["direction"] == "in" and d["reason"] == \
+            "slow_burn_quiet"
+        # a scale-in resets the quiet clock: no cascade to min-1
+        clock[0] = 126.0
+        assert asc.evaluate() is None
+        clock[0] = 260.0
+        assert asc.evaluate() is None      # already at min_replicas
+        assert sup.n == 2
+
+    def test_queue_depth_signal_scales_out(self):
+        clock, sup, sink, asc = self._build(queue_high=8.0)
+        asc.queue_depth_fn = lambda: 20.0
+        clock[0] = 1.0
+        d = asc.evaluate()
+        assert d["direction"] == "out" and d["reason"] == \
+            "queue_depth"
+
+    def test_stop_removes_sink(self):
+        clock, sup, sink, asc = self._build()
+        mon = asc.monitor
+        assert "autoscaler-t_hys" in mon.sinks
+        asc.stop()
+        assert "autoscaler-t_hys" not in mon.sinks
+
+
+# ------------------------------------------------------- /schedz HTTP
+class TestSchedzSurface:
+    def test_worker_schedz_over_http(self):
+        be = fleet.StubBackend(device_ms=1.0)
+        app = fleet.ReplicaApp(be).start()
+        be.warmup()
+        try:
+            with _OPENER.open(app.url + "/schedz", timeout=10) as r:
+                assert r.status == 200
+                doc = json.loads(r.read())
+            assert "admission" in doc and "process" in doc
+            # the worker gate itself is registered
+            assert any(name.startswith("worker:")
+                       for name in doc["admission"])
+        finally:
+            app.stop()
+
+    def test_router_merged_schedz(self):
+        factory = fleet.ThreadReplicaFactory(
+            lambda rid: fleet.StubBackend(device_ms=1.0))
+        sup = fleet.ReplicaSupervisor(factory, 2,
+                                      poll_interval_s=0.05).start()
+        router = fleet.FleetRouter(supervisor=sup, name="t_schedz")
+        rapp = fleet.RouterApp(router, host="127.0.0.1").start()
+        try:
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and \
+                    len(router._routable()) < 2:
+                time.sleep(0.05)
+            for f in router.submit_many([_feed(), _feed()],
+                                        tenant="merge-t"):
+                f.result(timeout=30)
+            with _OPENER.open(
+                    f"http://127.0.0.1:{rapp.port}/schedz",
+                    timeout=10) as r:
+                assert r.status == 200
+                doc = json.loads(r.read())
+            assert len(doc["replicas"]) >= 2
+            assert "admission" in doc and "autoscalers" in doc
+            # fleet-wide per-tenant rollup (thread replicas share the
+            # process registry, so counts may double-count own+remote;
+            # presence and positivity are the contract here)
+            assert doc["tenants"].get("merge-t", {}).get(
+                "admitted", 0) >= 2
+        finally:
+            rapp.stop()
+            router.shutdown()
+            sup.stop()
+
+    def test_httpd_schedz_surface(self):
+        from paddle_tpu.observability.httpd import TelemetryServer
+        from paddle_tpu.serving.scheduling import register_controller
+        ctrl = AdmissionController(name="t_httpd_sched")
+        register_controller(ctrl)
+        ctrl.admit("h-tenant")
+        srv = TelemetryServer(host="127.0.0.1", port=0).start()
+        try:
+            with _OPENER.open(
+                    f"http://127.0.0.1:{srv.port}/schedz",
+                    timeout=10) as r:
+                assert r.status == 200
+                doc = json.loads(r.read())
+            assert "t_httpd_sched" in doc["admission"]
+        finally:
+            srv.stop()
+
+
+# ------------------------------------------------- lock discipline
+class TestLockDisciplineScope:
+    def test_scheduling_package_is_clean(self):
+        from paddle_tpu import analysis
+        from paddle_tpu.analysis import LockDisciplineAnalyzer
+        root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        sched_dir = os.path.join(root, "paddle_tpu", "serving",
+                                 "scheduling")
+        found = analysis.run_analyzers(
+            [sched_dir], [LockDisciplineAnalyzer()], root=root)
+        assert found == [], "\n".join(f.format() for f in found)
+
+    def test_injected_violation_is_caught(self, tmp_path):
+        """Self-test: a scheduling-shaped controller with an unguarded
+        bucket-table write must be flagged — proving the analyzer
+        actually covers the idioms this package uses."""
+        from paddle_tpu import analysis
+        from paddle_tpu.analysis import LockDisciplineAnalyzer
+        p = tmp_path / "bad_admission.py"
+        p.write_text(textwrap.dedent("""
+            import threading
+
+            class Controller:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._buckets = {}
+
+                def admit(self, tenant):
+                    with self._lock:
+                        self._buckets = dict(self._buckets)
+
+                def reset(self):
+                    self._buckets = {}      # LK001: unguarded
+        """))
+        found = analysis.run_analyzers(
+            [str(tmp_path)], [LockDisciplineAnalyzer(dirs=())],
+            root=str(tmp_path))
+        assert [(f.rule, f.symbol) for f in found] == \
+            [("LK001", "Controller._buckets")]

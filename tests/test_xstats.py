@@ -515,7 +515,7 @@ class TestFleetSurfaces:
 
 # ================================================= endpoint conformance
 _SURFACES = ("/metrics", "/healthz", "/readyz", "/statusz", "/tracez",
-             "/goodputz", "/sloz", "/execz", "/profilez")
+             "/goodputz", "/sloz", "/schedz", "/execz", "/profilez")
 
 
 class TestEndpointConformance:

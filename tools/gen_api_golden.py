@@ -34,6 +34,7 @@ SURFACES = [
     "paddle_tpu.serving",
     "paddle_tpu.serving.generation",
     "paddle_tpu.serving.fleet",
+    "paddle_tpu.serving.scheduling",
     "paddle_tpu.observability",
     "paddle_tpu.observability.tracing",
     "paddle_tpu.analysis",

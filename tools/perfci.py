@@ -182,6 +182,48 @@ GATES: List[Dict[str, Any]] = [
      "unit": "fraction",
      "why": "background-load goodput across the whole chaos run "
             "(r01: 0.9995 — riding failures are the only loss)"},
+    {"name": "sched_realtime_slo", "metric": "sched_control_loop",
+     "files": "SCHED_r*.json", "path": ("value",),
+     "op": "min", "baseline": 0.95, "rel_tol": 0.0,
+     "unit": "fraction",
+     "why": "realtime SLO attainment while the batch tenant floods — "
+            "the noisy-neighbor claim: per-tenant token buckets shed "
+            "the flood with the typed QuotaExceededError before it "
+            "can queue ahead of realtime work (PR 16, r01: 1.0)"},
+    {"name": "sched_fairness_floor", "metric": "sched_control_loop",
+     "files": "SCHED_r*.json", "path": ("fairness", "jain_weighted"),
+     "op": "min", "baseline": 0.80, "rel_tol": 0.0, "unit": "index",
+     "why": "weighted Jain fairness index over per-tenant "
+            "goodput/weight under tenant skew — admission must hold "
+            "configured shares when one tenant floods "
+            "(PR 16, r01: 0.985)"},
+    {"name": "sched_scale_reaction", "metric": "sched_control_loop",
+     "files": "SCHED_r*.json", "path": ("autoscale", "reaction_s"),
+     "op": "max", "baseline": 15.0, "abs_tol": 0.0, "unit": "s",
+     "why": "fleet-wide brownout -> fast-burn page -> scale_to "
+            "decision within the reaction bound; the alert-sink path "
+            "is the whole point of the autoscaler (PR 16, r01: 1.3s)"},
+    {"name": "sched_scale_in_hysteresis",
+     "metric": "sched_control_loop",
+     "files": "SCHED_r*.json", "path": ("autoscale", "scaled_in"),
+     "op": "true",
+     "why": "after restore + sustained quiet the fleet must scale "
+            "back in (cooldown + quiet-window hysteresis, never below "
+            "min_replicas) — scale-out alone is just a leak (PR 16)"},
+    {"name": "sched_page_leak_clean", "metric": "sched_control_loop",
+     "files": "SCHED_r*.json",
+     "path": ("invariants", "page_leak_clean"),
+     "op": "true",
+     "why": "priority preemption under KV pressure must return every "
+            "page: parked stream resumes, kv.leak_check() stays "
+            "clean (PR 16)"},
+    {"name": "sched_zero_lost", "metric": "sched_control_loop",
+     "files": "SCHED_r*.json", "path": ("invariants", "zero_lost"),
+     "op": "true",
+     "why": "across every loadgen scenario (ramp, skew, flash crowd, "
+            "trickle, brownout) failures are typed sheds or typed "
+            "deadline/quota errors — nothing is silently lost "
+            "(PR 16)"},
 ]
 
 
