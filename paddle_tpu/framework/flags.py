@@ -207,6 +207,26 @@ define_flag("FLAGS_decode_spec_k", 0,
             "[max_batch, k+1] step with accept-and-resample, so "
             "output distribution matches non-speculative sampling "
             "(0 = off; ignored without a draft model)")
+define_flag("FLAGS_decode_pallas_attention", False,
+            "route the decode/chunked serving attention through the "
+            "fused Pallas paged kernels (ops/pallas_paged_attention.py: "
+            "K/V read through the block table inside the kernel, online "
+            "softmax per page tile, no materialized gather) and serving "
+            "prefill through the pallas_attention.mha flash path; off = "
+            "the pure-JAX gather reference (always kept as fallback for "
+            "unsupported shapes). Read once at GenerationServer "
+            "construction — flipping it mid-process affects new servers "
+            "only, never a compiled decoder")
+define_flag("FLAGS_decode_kv_dtype", "",
+            "KV pool storage dtype for serving: '' = model dtype, "
+            "'float32', 'bfloat16', or 'int8' (symmetric absmax "
+            "quantization with per-slot-per-head f32 scales stored "
+            "alongside the pools; quantize-on-write, dequantize-on-read "
+            "in both the Pallas tiles and the pure-JAX gather). int8 "
+            "shrinks pool bytes ~3.5-4x, and auto pool sizing "
+            "(FLAGS_decode_kv_pages=0) grants sub-f32 dtypes 2x pages "
+            "= ~2x resident sequences per chip. Read once at server "
+            "construction, like FLAGS_decode_pallas_attention")
 define_flag("FLAGS_decode_warmup_from_manifest", False,
             "pre-compile a constructed GenerationServer's decode step "
             "and recorded prefill buckets from its persisted warmup "

@@ -154,13 +154,25 @@ class GPTKVCache:
     ``forward(ids, cache=...)`` returns ``(logits, (k', v'))`` — the
     updated pool pytree mirrors the input structure, so jitted callers
     can donate the pools and carry them across steps.
+
+    Quantized pools (FLAGS_decode_kv_dtype=int8) make each per-layer
+    pool a 2-tuple ``(int8 values, f32 scales)`` instead of one array
+    (ops/paged_attention.py docstring); everything here is
+    structure-agnostic — pools are opaque pytrees whose leaves get
+    wrapped/unwrapped at the boundaries.
+
+    ``use_pallas`` pins the fused-kernel routing decision
+    (ops/pallas_paged_attention.py) for every layer of this forward;
+    None defers to FLAGS_decode_pallas_attention at trace time. The
+    serving decoder always pins it (model_fns.CachedDecoder) so a flag
+    flip cannot disagree with an already-compiled executable.
     """
 
     __slots__ = ("kind", "page_size", "k", "v", "block_tables",
-                 "ctx_len", "valid", "positions")
+                 "ctx_len", "valid", "positions", "use_pallas")
 
     def __init__(self, kind, page_size, k, v, block_tables, ctx_len,
-                 valid, positions):
+                 valid, positions, use_pallas=None):
         if kind not in ("prefill", "decode", "chunked"):
             raise ValueError(f"kind must be 'prefill', 'decode' or "
                              f"'chunked', got {kind!r}")
@@ -172,6 +184,7 @@ class GPTKVCache:
         self.ctx_len = ctx_len
         self.valid = valid
         self.positions = positions
+        self.use_pallas = use_pallas
 
 
 class GPTEmbeddings(Layer):
@@ -226,14 +239,39 @@ class GPTAttention(Layer):
         v = qkv[:, :, :, 2]
         if kv_cache is not None:
             # paged-cache path: persist this window's K/V in the pool;
-            # decode attends through the block table (see GPTKVCache)
+            # decode attends through the block table (see GPTKVCache).
+            # Pool leaves ride flattened through apply_op — a quantized
+            # pool is a (values, scales) tuple and dispatch only
+            # wraps/unwraps top-level Tensor args.
+            import jax as _jax
+
             from ..ops.paged_attention import paged_attention_update
-            out, k_pool, v_pool = apply_op(
-                "paged_attention", paged_attention_update, q, k, v,
-                kv_cache.k, kv_cache.v, kv_cache.block_tables,
-                kv_cache.ctx_len, kv_cache.valid, kv_cache.positions,
+            k_leaves, pool_def = _jax.tree_util.tree_flatten(kv_cache.k)
+            v_leaves, _ = _jax.tree_util.tree_flatten(kv_cache.v)
+            nk = len(k_leaves)
+
+            def _flat_update(q, k, v, tables, ctx, valid, positions,
+                             *pool_leaves, **kw):
+                kp = _jax.tree_util.tree_unflatten(
+                    pool_def, pool_leaves[:nk])
+                vp = _jax.tree_util.tree_unflatten(
+                    pool_def, pool_leaves[nk:])
+                out, kp2, vp2 = paged_attention_update(
+                    q, k, v, kp, vp, tables, ctx, valid, positions, **kw)
+                return (out, *_jax.tree_util.tree_leaves(kp2),
+                        *_jax.tree_util.tree_leaves(vp2))
+
+            res = apply_op(
+                "paged_attention", _flat_update, q, k, v,
+                kv_cache.block_tables, kv_cache.ctx_len, kv_cache.valid,
+                kv_cache.positions, *k_leaves, *v_leaves,
                 page_size=kv_cache.page_size, kind=kv_cache.kind,
-                use_flash=self.use_flash)
+                use_flash=self.use_flash, use_pallas=kv_cache.use_pallas)
+            out = res[0]
+            k_pool = _jax.tree_util.tree_unflatten(
+                pool_def, res[1:1 + nk])
+            v_pool = _jax.tree_util.tree_unflatten(
+                pool_def, res[1 + nk:])
             out = out.reshape([b, s, self.hidden_size])
             return self.dropout(self.out_proj(out)), k_pool, v_pool
         from ..nn.functional.attention import scaled_dot_product_attention
@@ -327,10 +365,12 @@ def _stacked_layer_fwd(p, x, *, num_heads, head_dim, eps, mp_size, sep_size,
         # paged-cache decode/prefill (single shard: mp/sep degenerate —
         # GPTStackedTransformer enforces that before routing here)
         from ..ops.paged_attention import paged_attention_update
-        (kp, vp, tables, ctx, valid, positions, page_size, kind) = kv
+        (kp, vp, tables, ctx, valid, positions, page_size, kind,
+         use_pallas) = kv
         attn, k_pool, v_pool = paged_attention_update(
             q, k, v, kp, vp, tables, ctx, valid, positions,
-            page_size=page_size, kind=kind, use_flash=use_flash)
+            page_size=page_size, kind=kind, use_flash=use_flash,
+            use_pallas=use_pallas)
     elif sep_size > 1:
         from ..ops.ring_attention import _ring_attention_local
         attn = _ring_attention_local(q, k, v, axis_name="sep",
@@ -522,9 +562,15 @@ class GPTStackedTransformer(Layer):
 
         cfg = self.config
         page_size, kind = cache.page_size, cache.kind
+        use_pallas = cache.use_pallas
+        # pool leaves ride flattened through apply_op (quantized pools
+        # are (values, scales) tuples; dispatch only unwraps top-level
+        # Tensor args) and re-assemble inside the traced fn
+        k_leaves, pool_def = jax.tree_util.tree_flatten(cache.k)
+        v_leaves, _ = jax.tree_util.tree_flatten(cache.v)
+        nk = len(k_leaves)
 
-        def fn(x_arr, k_pools, v_pools, tables, ctx, valid, positions,
-               *param_arrays):
+        def fn(x_arr, tables, ctx, valid, positions, *rest):
             from ..distributed.mesh_utils import get_global_mesh
             mesh = get_global_mesh()
             if mesh is not None and any(
@@ -532,7 +578,10 @@ class GPTStackedTransformer(Layer):
                 raise NotImplementedError(
                     "KV-cached decode is single-shard: drop the pp/mp/"
                     "sep mesh axes (dp replicas serve independently)")
-            p = dict(zip(names, param_arrays))
+            k_pools = jax.tree_util.tree_unflatten(pool_def, rest[:nk])
+            v_pools = jax.tree_util.tree_unflatten(
+                pool_def, rest[nk:2 * nk])
+            p = dict(zip(names, rest[2 * nk:]))
             layer = functools.partial(
                 _stacked_layer_fwd, num_heads=cfg.num_heads,
                 head_dim=cfg.hidden_size // cfg.num_heads,
@@ -543,16 +592,25 @@ class GPTStackedTransformer(Layer):
                 p_slice, kp, vp = xs
                 out, kp2, vp2 = layer(
                     p_slice, c, kv=(kp, vp, tables, ctx, valid,
-                                    positions, page_size, kind))
+                                    positions, page_size, kind,
+                                    use_pallas))
                 return out, (kp2, vp2)
 
+            # scan slices each pool leaf's leading (layer) dim — tuple
+            # pools scan as pytrees, each step sees its layer's leaves
             out, (k2, v2) = jax.lax.scan(step, x_arr,
                                          (p, k_pools, v_pools))
-            return out, k2, v2
+            return (out, *jax.tree_util.tree_leaves(k2),
+                    *jax.tree_util.tree_leaves(v2))
 
-        return apply_op("gpt_stacked_decoder_cached", fn, x, cache.k,
-                        cache.v, cache.block_tables, cache.ctx_len,
-                        cache.valid, cache.positions, *params)
+        res = apply_op("gpt_stacked_decoder_cached", fn, x,
+                       cache.block_tables, cache.ctx_len, cache.valid,
+                       cache.positions, *k_leaves, *v_leaves, *params)
+        out = res[0]
+        k2 = jax.tree_util.tree_unflatten(pool_def, res[1:1 + nk])
+        v2 = jax.tree_util.tree_unflatten(pool_def,
+                                          res[1 + nk:1 + 2 * nk])
+        return out, k2, v2
 
 
 class GPTModel(Layer):
@@ -591,7 +649,7 @@ class GPTModel(Layer):
                 view = GPTKVCache(
                     cache.kind, cache.page_size, cache.k[i], cache.v[i],
                     cache.block_tables, cache.ctx_len, cache.valid,
-                    cache.positions)
+                    cache.positions, use_pallas=cache.use_pallas)
                 h, k_i, v_i = layer(h, kv_cache=view)
                 k_new.append(k_i)
                 v_new.append(v_i)
@@ -632,27 +690,48 @@ class GPTForCausalLM(Layer):
         per-layer ``[num_pages, page_size, heads, head_dim]`` arrays
         (module stack) or one stacked ``[L, ...]`` pair (stacked
         decoder). Page 0 is the trash page and is never allocated.
-        Returns raw jax arrays ``(k, v)`` — engine plumbing, not
-        Tensors."""
+        ``dtype`` may also be the string ``"int8"``: pools then become
+        ``(int8 values, f32 per-slot-per-head scales)`` tuples (see
+        ops.paged_attention for the quantized-pool contract). Returns
+        raw jax arrays ``(k, v)`` — engine plumbing, not Tensors."""
         import jax.numpy as jnp
         cfg = self.config
         nh, hd = cfg.num_heads, cfg.hidden_size // cfg.num_heads
-        dt = dtype or self.gpt.embeddings.word_embeddings.weight._data.dtype
         shape = (int(num_pages), int(page_size), nh, hd)
+        if isinstance(dtype, str) and dtype == "int8":
+            sshape = shape[:-1]
+
+            def mk(lead=()):
+                return (jnp.zeros(lead + shape, jnp.int8),
+                        jnp.zeros(lead + sshape, jnp.float32))
+
+            if cfg.stacked:
+                return mk((cfg.num_layers,)), mk((cfg.num_layers,))
+            return ([mk() for _ in range(cfg.num_layers)],
+                    [mk() for _ in range(cfg.num_layers)])
+        dt = dtype or self.gpt.embeddings.word_embeddings.weight._data.dtype
         if cfg.stacked:
             k = jnp.zeros((cfg.num_layers,) + shape, dt)
             return k, jnp.zeros((cfg.num_layers,) + shape, dt)
         return ([jnp.zeros(shape, dt) for _ in range(cfg.num_layers)],
                 [jnp.zeros(shape, dt) for _ in range(cfg.num_layers)])
 
-    def kv_cache_spec(self) -> dict:
-        """Geometry the decode engine sizes its cache from."""
+    def kv_cache_spec(self, kv_dtype: str = "") -> dict:
+        """Geometry the decode engine sizes its cache from.
+        ``kv_dtype`` ('' = model dtype) adds per-token byte accounting
+        so sizing and shardcheck agree on pool cost."""
+        from ..ops.paged_attention import kv_pool_bytes
         cfg = self.config
+        nh, hd = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+        per_token = cfg.num_layers * 2 * kv_pool_bytes(
+            1, 1, nh, hd, kv_dtype or None)
         return {"num_layers": cfg.num_layers,
-                "num_heads": cfg.num_heads,
-                "head_dim": cfg.hidden_size // cfg.num_heads,
+                "num_heads": nh,
+                "head_dim": hd,
                 "max_seq_len": cfg.max_seq_len,
-                "stacked": bool(cfg.stacked)}
+                "stacked": bool(cfg.stacked),
+                "kv_dtype": kv_dtype or "",
+                "kv_bytes_per_token": int(per_token)}
 
     def num_params(self) -> int:
         return sum(int(np.prod(p.shape)) for p in self.parameters())

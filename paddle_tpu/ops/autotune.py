@@ -102,3 +102,72 @@ def pick(kernel: str, key: Sequence, candidates: List[Tuple],
     _cache[ck] = list(best)
     _save()
     return best
+
+
+# ------------------- fused paged serving kernels (pallas_paged_attention)
+
+# Kernel names under which paged block choices persist in the cache.
+PAGED_KERNELS = ("paged_decode", "paged_chunked")
+
+
+def paged_block_candidates(kind: str, seq: int, num_heads: int,
+                           head_dim: int, page_size: int,
+                           pages_per_seq: int) -> List[Tuple]:
+    """Block-size table for the fused paged kernels: every legal
+    ``(block_q, block_h, pages_per_tile)``.
+
+    - block_q tiles the query window (decode is structurally S == 1;
+      chunked windows tile at powers of two up to the 128-row register
+      tile, the same ladder flash uses);
+    - block_h is the head-block per grid program (head_dim is the lane
+      dim, so a head-block trades grid programs for VMEM working set);
+    - pages_per_tile makes the K-tile a page-size multiple: a tile
+      spanning n table-adjacent pages is realized as n table-steered
+      block loads per program (pool pages are not address-adjacent, so
+      a bigger BlockSpec cannot express it).
+    """
+    if kind == "decode":
+        bqs = [1]
+    else:
+        bqs = sorted({c for c in (8, 16, 32, 64, 128)
+                      if c <= seq and seq % c == 0} | {seq})
+    bhs = [c for c in (1, 2, 4) if num_heads % c == 0] or [1]
+    ppts = [c for c in (1, 2, 4) if pages_per_seq % c == 0] or [1]
+    return [(bq, bh, ppt) for bq in bqs for bh in bhs for ppt in ppts]
+
+
+def paged_blocks(kind: str, seq: int, num_heads: int, head_dim: int,
+                 page_size: int, pages_per_seq: int, *, dtype: str = "",
+                 quantized: bool = False,
+                 overrides=(None, None, None)) -> Tuple[int, int, int]:
+    """Resolve ``(block_q, block_h, pages_per_tile)`` for one paged
+    kernel call: explicit overrides win, then a persisted
+    ``pretune_paged`` result, then conservative defaults. Serving calls
+    sit inside a trace where timing is impossible, and ``enabled()`` is
+    False off-TPU — interpret mode must never trigger the timer (the
+    guard tests/test_pallas_paged.py self-tests)."""
+    kern = "paged_decode" if kind == "decode" else "paged_chunked"
+    hit = None
+    if enabled():
+        hit = cached(kern, (seq, num_heads, head_dim, page_size,
+                            pages_per_seq, dtype, bool(quantized)))
+    defaults = (1 if kind == "decode" else _fit_pow2(seq),
+                1, 1) if hit is None else hit
+    bq, bh, ppt = (o if o is not None else d
+                   for o, d in zip(overrides, defaults))
+    if seq % bq or num_heads % bh or pages_per_seq % ppt:
+        raise ValueError(
+            f"paged blocks (block_q={bq}, block_h={bh}, "
+            f"pages_per_tile={ppt}) must divide (seq={seq}, "
+            f"heads={num_heads}, pages_per_seq={pages_per_seq})")
+    return int(bq), int(bh), int(ppt)
+
+
+def _fit_pow2(seq: int, cap: int = 128) -> int:
+    blk = 1
+    c = 2
+    while c <= min(seq, cap):
+        if seq % c == 0:
+            blk = c
+        c *= 2
+    return blk if seq % blk == 0 else seq

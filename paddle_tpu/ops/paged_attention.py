@@ -18,6 +18,20 @@ live sequences only reference allocated pages).
 These are pure jax functions; the model layer threads them through
 ``apply_op`` (models/gpt.py) and the decode engine jits them via
 ``serving.generation.model_fns``.
+
+Quantized pools (``FLAGS_decode_kv_dtype=int8``): a pool is then the
+2-tuple ``(values int8 [num_pages, page_size, H, D], scales f32
+[num_pages, page_size, H])`` — symmetric absmax quantization over
+head_dim, one scale per written (slot, head). Scales are per-slot
+rather than per-whole-page because pages fill incrementally (one token
+per decode step): a page-granular absmax would have to requantize the
+page's older int8 entries on every append, compounding rounding error
+up to page_size times, while per-slot scales quantize each value
+exactly once. The ~4x byte saving still holds within the scale
+overhead: 1 + 4/head_dim bytes per element vs 4 (3.76x at D=64).
+Quantize happens on write (``write_pool``), dequantize on read — in
+``gather_pool`` for the pure-JAX path and inside the Pallas tile loads
+(ops/pallas_paged_attention.py) for the fused path.
 """
 from __future__ import annotations
 
@@ -27,9 +41,70 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["flat_slots", "write_pool", "gather_pool",
-           "paged_attention_update"]
+           "paged_attention_update", "is_quantized_pool",
+           "quantize_kv_rows", "dequantize_kv", "kv_pool_bytes",
+           "resolve_kv_dtype"]
 
 KINDS = ("prefill", "decode", "chunked")
+KV_DTYPES = ("", "float32", "bfloat16", "int8")
+
+
+# ------------------------------------------------------- quantized pools
+
+def resolve_kv_dtype(name):
+    """Map a FLAGS_decode_kv_dtype value to an ``init_kv_pools`` dtype:
+    '' → None (model dtype), 'int8' → the string marker (tuple pools),
+    else the jnp dtype."""
+    name = (name or "").strip()
+    if name not in KV_DTYPES:
+        raise ValueError(
+            f"kv dtype must be one of {KV_DTYPES[1:]} (or '' for the "
+            f"model dtype), got {name!r}")
+    if not name:
+        return None
+    if name == "int8":
+        return "int8"
+    return jnp.dtype(name)
+
+
+def is_quantized_pool(pool) -> bool:
+    """True for the (int8 values, f32 scales) tuple representation."""
+    return isinstance(pool, (tuple, list)) and len(pool) == 2
+
+
+def quantize_kv_rows(kv):
+    """Symmetric absmax int8 quantization over head_dim.
+
+    kv: [N, H, D] float → (values int8 [N, H, D], scales f32 [N, H]).
+    All-zero rows (trash writes, zero-init pools) get scale 0 and
+    dequantize back to exact zeros.
+    """
+    kv32 = kv.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(kv32), axis=-1)
+    scale = absmax / jnp.float32(127.0)
+    safe = jnp.maximum(scale, jnp.float32(1e-12))[..., None]
+    q = jnp.clip(jnp.round(kv32 / safe), -127.0, 127.0).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(values, scales, dtype=jnp.float32):
+    """Inverse of ``quantize_kv_rows``: values [..., H, D] int8 with
+    scales [..., H] → float ``dtype``."""
+    return (values.astype(jnp.float32)
+            * scales.astype(jnp.float32)[..., None]).astype(dtype)
+
+
+def kv_pool_bytes(num_pages, page_size, num_heads, head_dim,
+                  kv_dtype) -> int:
+    """Bytes of ONE pool (K or V) per layer for a given storage dtype,
+    including the per-slot-per-head f32 scales when quantized. The
+    shardcheck KV-bytes projection and the engine's pool gauges both
+    size from here so they can never disagree."""
+    slots = int(num_pages) * int(page_size)
+    if (kv_dtype or "") == "int8":
+        return slots * num_heads * (head_dim * 1 + 4)
+    dt = jnp.dtype(kv_dtype) if kv_dtype else jnp.dtype(jnp.float32)
+    return slots * num_heads * head_dim * dt.itemsize
 
 
 def flat_slots(block_tables, positions, valid, page_size: int):
@@ -50,32 +125,55 @@ def flat_slots(block_tables, positions, valid, page_size: int):
     return jnp.where(valid, slots, offset)    # trash page = page 0
 
 
+def _scatter_flat(arr, slots, rows):
+    """Scatter ``rows`` into ``arr`` flattened over its (page, slot)
+    leading dims."""
+    num_pages, page_size = arr.shape[0], arr.shape[1]
+    flat = arr.reshape(num_pages * page_size, *arr.shape[2:])
+    flat = flat.at[slots].set(rows.astype(arr.dtype))
+    return flat.reshape(arr.shape)
+
+
 def write_pool(pool, slots, kv):
     """Scatter ``kv`` rows into the flattened pool at ``slots``.
 
-    pool: [num_pages, page_size, H, D]; slots: [N] int32 flat slot ids;
-    kv: [N, H, D]. Duplicate trash-slot writes are unordered — the trash
-    page holds garbage by contract.
+    pool: [num_pages, page_size, H, D] (or the quantized (values,
+    scales) tuple — this is the quantize-on-write point); slots: [N]
+    int32 flat slot ids; kv: [N, H, D]. Duplicate trash-slot writes are
+    unordered — the trash page holds garbage by contract.
     """
-    num_pages, page_size = pool.shape[0], pool.shape[1]
-    flat = pool.reshape(num_pages * page_size, *pool.shape[2:])
-    flat = flat.at[slots].set(kv.astype(pool.dtype))
-    return flat.reshape(pool.shape)
+    if is_quantized_pool(pool):
+        values, scales = pool
+        qrows, srows = quantize_kv_rows(kv)
+        return (_scatter_flat(values, slots, qrows),
+                _scatter_flat(scales, slots, srows))
+    return _scatter_flat(pool, slots, kv)
 
 
-def gather_pool(pool, block_tables):
-    """Gather every slot a block table can address, in logical order.
-
-    pool: [num_pages, page_size, H, D]; block_tables: [B, P] int32.
-    Returns [B, P * page_size, H, D] where gathered row ``t`` holds
-    logical position ``t`` of each sequence (pages are table-ordered).
-    """
-    num_pages, page_size = pool.shape[0], pool.shape[1]
-    flat = pool.reshape(num_pages * page_size, *pool.shape[2:])
+def _gather_flat(arr, block_tables):
+    num_pages, page_size = arr.shape[0], arr.shape[1]
+    flat = arr.reshape(num_pages * page_size, *arr.shape[2:])
     slots = (block_tables[:, :, None] * page_size
              + jnp.arange(page_size, dtype=block_tables.dtype)[None, None])
     b = block_tables.shape[0]
     return flat[slots.reshape(b, -1)]
+
+
+def gather_pool(pool, block_tables, out_dtype=None):
+    """Gather every slot a block table can address, in logical order.
+
+    pool: [num_pages, page_size, H, D] (or the quantized tuple — this
+    is the pure-JAX dequantize-on-read point); block_tables: [B, P]
+    int32. Returns [B, P * page_size, H, D] where gathered row ``t``
+    holds logical position ``t`` of each sequence (pages are
+    table-ordered).
+    """
+    if is_quantized_pool(pool):
+        values, scales = pool
+        vg = _gather_flat(values, block_tables)
+        sg = _gather_flat(scales, block_tables)
+        return dequantize_kv(vg, sg, out_dtype or jnp.float32)
+    return _gather_flat(pool, block_tables)
 
 
 def _decode_attention(q, ks, vs, ctx_len, scale):
@@ -124,7 +222,8 @@ def _chunked_attention(q, ks, vs, positions, valid, scale):
 
 def paged_attention_update(q, k, v, k_pool, v_pool, block_tables,
                            ctx_len, valid, positions, *, page_size: int,
-                           kind: str, use_flash: bool = True):
+                           kind: str, use_flash: bool = True,
+                           use_pallas=None):
     """One layer's cache-aware attention: write this call's K/V into the
     paged pool, then attend.
 
@@ -151,8 +250,22 @@ def paged_attention_update(q, k, v, k_pool, v_pool, block_tables,
     prefix AND causally within the window. With positions starting at
     0 this computes the same math as prefill, via the gather path.
 
+    ``use_pallas`` routes decode/chunked through the fused Pallas
+    read-through-table kernels and prefill through the mha flash path
+    (ops/pallas_paged_attention.py); None consults
+    FLAGS_decode_pallas_attention at trace time (the serving decoder
+    pins the value at construction instead, so a flag flip can never
+    silently disagree with an already-compiled executable). The pure
+    body below stays the reference and the automatic fallback for
+    unsupported shapes.
+
     Returns (attn_out [B, S, H, D], k_pool', v_pool').
     """
+    if kind not in KINDS:
+        raise ValueError(f"kind must be one of {KINDS}, got {kind!r}")
+    if use_pallas is None:
+        from ..framework.flags import flag_value
+        use_pallas = bool(flag_value("FLAGS_decode_pallas_attention"))
     b, s = q.shape[0], q.shape[1]
     slots = flat_slots(block_tables, positions, valid, page_size)
     slots_flat = slots.reshape(b * s)
@@ -162,17 +275,25 @@ def paged_attention_update(q, k, v, k_pool, v_pool, block_tables,
                         v.reshape(b * s, *v.shape[2:]))
     scale = 1.0 / math.sqrt(q.shape[-1])
     if kind == "prefill":
-        from .flash_attention import attention_bshd
-        out = attention_bshd(q, k, v, causal=True, scale=scale,
-                             use_flash=use_flash)
-    elif kind == "decode":
-        ks = gather_pool(k_pool, block_tables)
-        vs = gather_pool(v_pool, block_tables)
+        if use_pallas:
+            from .pallas_paged_attention import prefill_flash
+            out = prefill_flash(q, k, v, scale, use_flash=use_flash)
+        else:
+            from .flash_attention import attention_bshd
+            out = attention_bshd(q, k, v, causal=True, scale=scale,
+                                 use_flash=use_flash)
+        return out, k_pool, v_pool
+    if use_pallas:
+        from . import pallas_paged_attention as ppa
+        if ppa.supported(q, k_pool, block_tables, page_size, kind):
+            out = ppa.paged_attention(
+                q, k_pool, v_pool, block_tables, ctx_len, valid,
+                positions, page_size=page_size, kind=kind, scale=scale)
+            return out, k_pool, v_pool
+    ks = gather_pool(k_pool, block_tables, out_dtype=q.dtype)
+    vs = gather_pool(v_pool, block_tables, out_dtype=q.dtype)
+    if kind == "decode":
         out = _decode_attention(q, ks, vs, ctx_len, scale)
-    elif kind == "chunked":
-        ks = gather_pool(k_pool, block_tables)
-        vs = gather_pool(v_pool, block_tables)
-        out = _chunked_attention(q, ks, vs, positions, valid, scale)
     else:
-        raise ValueError(f"kind must be one of {KINDS}, got {kind!r}")
+        out = _chunked_attention(q, ks, vs, positions, valid, scale)
     return out, k_pool, v_pool

@@ -27,6 +27,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jaxlib renamed TPUCompilerParams -> CompilerParams across pallas
+# releases; resolve whichever this jaxlib ships so the kernels build
+# (and the interpret-mode CPU tests run) on either side of the rename.
+CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    pltpu.TPUCompilerParams
+
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 LANES = 128
@@ -143,7 +149,7 @@ def _mha_fwd(q, k, v, causal, sm_scale, block_q, block_k):
             jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
             jax.ShapeDtypeStruct((b * h, sq, LANES), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=_interpret(),
@@ -296,7 +302,7 @@ def _mha_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
                                lambda bh, i, j: (bh, i, _i0())),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=_interpret(),
@@ -329,7 +335,7 @@ def _mha_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
         ],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=_interpret(),

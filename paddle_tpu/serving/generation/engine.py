@@ -325,6 +325,10 @@ class DecodeMetrics:
         self._f_pages = reg.gauge(
             "paddle_decode_kv_pages",
             "KV-cache page occupancy by state", ("server", "state"))
+        self._f_pool_bytes = reg.gauge(
+            "paddle_decode_kv_pool_bytes",
+            "resident device bytes of the paged K/V pools (quantized "
+            "pools include their scale planes)", ("server", "dtype"))
         self._f_evict = reg.counter(
             "paddle_decode_kv_page_evictions_total",
             "pages released by finished/cancelled sequences",
@@ -355,6 +359,7 @@ class DecodeMetrics:
             "proposed tokens the target model accepted", ("server",))
         for fam in (self._f_events, self._f_tokens, self._f_inter,
                     self._f_step, self._f_occ, self._f_pages,
+                    self._f_pool_bytes,
                     self._f_evict, self._f_compile, self._f_ttft,
                     self._f_pfx_hits, self._f_pfx_reused,
                     self._f_spec_prop, self._f_spec_acc):
@@ -384,6 +389,8 @@ class DecodeMetrics:
         self._occ_sum = 0
         self._occ_n = 0
         self._page_capacity = int(page_capacity)
+        self._pool_bytes = 0
+        self._pool_dtype = "model"
 
     def count(self, event: str, n: int = 1):
         self._events[event].inc(n)
@@ -413,6 +420,12 @@ class DecodeMetrics:
     def set_kv_pages(self, used: int, free: int):
         self._g_used.set(used)
         self._g_free.set(free)
+
+    def set_kv_pool_bytes(self, nbytes: int, dtype: str):
+        self._pool_bytes = int(nbytes)
+        self._pool_dtype = dtype or "model"
+        self._f_pool_bytes.labels(
+            server=self.name, dtype=self._pool_dtype).set(int(nbytes))
 
     def observe_evictions(self, n_pages: int):
         self._c_evict.inc(n_pages)
@@ -449,7 +462,9 @@ class DecodeMetrics:
                 "kv_pages": {"capacity": self._page_capacity,
                              "used": int(self._g_used.value),
                              "free": int(self._g_free.value),
-                             "evicted_total": int(self._c_evict.value)},
+                             "evicted_total": int(self._c_evict.value),
+                             "pool_bytes": self._pool_bytes,
+                             "pool_dtype": self._pool_dtype},
                 "compile_cache": {"hits": int(self._c_hit.value),
                                   "misses": int(self._c_miss.value)},
                 "prefix": {
@@ -505,10 +520,28 @@ class GenerationServer:
         self.eos_token_id = eos_token_id
         self.pad_token_id = int(pad_token_id)
         self.pages_per_seq = -(-self.max_seq_len // self.page_size)
+        # fused-kernel / quantized-pool knobs: read ONCE here and
+        # pinned for the engine's lifetime (they join the decoder's
+        # geometry fingerprint, so warmup manifests and the persistent
+        # compile cache never mix executables across a flag flip)
+        from ...ops.paged_attention import kv_pool_bytes, resolve_kv_dtype
+        self.use_pallas = bool(_flag("FLAGS_decode_pallas_attention",
+                                     False))
+        self.kv_dtype = str(_flag("FLAGS_decode_kv_dtype", "") or "")
+        resolve_kv_dtype(self.kv_dtype)   # fail fast on a typo'd dtype
+        nh, hd = spec["num_heads"], spec["head_dim"]
+        f32_tok = kv_pool_bytes(1, 1, nh, hd, None)
+        cur_tok = kv_pool_bytes(1, 1, nh, hd, self.kv_dtype or None)
+        # sub-f32 pools grant extra resident sequences for the SAME
+        # device budget: int8 (~3.8x smaller) and bf16 (2x) both size
+        # to 2x pages ≈ 2x concurrently-resident sequences
+        self.kv_capacity_factor = max(1, min(2, f32_tok // max(cur_tok,
+                                                               1)))
         if num_pages is None:
             num_pages = int(_flag("FLAGS_decode_kv_pages", 0))
         if not num_pages:
-            num_pages = 1 + self.max_batch * self.pages_per_seq
+            num_pages = 1 + (self.max_batch * self.pages_per_seq
+                             * self.kv_capacity_factor)
         self.default_timeout_ms = default_timeout_ms \
             if default_timeout_ms is not None \
             else (_flag("FLAGS_decode_default_timeout_ms", 0.0) or None)
@@ -527,9 +560,11 @@ class GenerationServer:
         self.decoder = CachedDecoder(
             model, max_batch=self.max_batch, page_size=self.page_size,
             pages_per_seq=self.pages_per_seq, donate=donate,
-            max_positions=self.max_seq_len)
+            max_positions=self.max_seq_len,
+            use_pallas=self.use_pallas, kv_dtype=self.kv_dtype)
         self.kv = PagedKVCache(model, num_pages=int(num_pages),
-                               page_size=self.page_size)
+                               page_size=self.page_size,
+                               dtype=self.kv_dtype or None)
         # ---- shared-prefix KV reuse (radix index over full pages)
         if prefix_cache is None:
             prefix_cache = bool(_flag("FLAGS_decode_prefix_cache", True))
@@ -560,12 +595,16 @@ class GenerationServer:
                 draft_model, max_batch=self.max_batch,
                 page_size=self.page_size,
                 pages_per_seq=self.pages_per_seq, donate=donate,
-                max_positions=self.max_seq_len)
+                max_positions=self.max_seq_len,
+                use_pallas=self.use_pallas, kv_dtype=self.kv_dtype)
             self._draft_k, self._draft_v = draft_model.init_kv_pools(
-                self.kv.num_pages, self.page_size)
+                self.kv.num_pages, self.page_size,
+                self.kv_dtype or None)
         self.metrics = DecodeMetrics(name, self.max_batch,
                                      self.kv.capacity)
         self.metrics.set_kv_pages(0, self.kv.capacity)
+        self.metrics.set_kv_pool_bytes(self.kv.pool_bytes(),
+                                       self.kv_dtype)
         # ---- multi-tenant admission (scheduling subsystem): an
         # AdmissionController adds per-tenant token-bucket quotas,
         # weighted-fair queue ordering, and priority-aware

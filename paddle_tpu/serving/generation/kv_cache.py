@@ -45,6 +45,9 @@ class PagedKVCache:
                              "the trash page")
         self.page_size = int(page_size)
         self.num_pages = int(num_pages)
+        # dtype may be the string "int8" — pools then carry per-slot
+        # absmax scales alongside int8 values (ops/paged_attention)
+        self.kv_dtype = dtype if isinstance(dtype, str) else ""
         self.k, self.v = model.init_kv_pools(self.num_pages,
                                              self.page_size, dtype)
         self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
@@ -67,6 +70,14 @@ class PagedKVCache:
     def pages_for(self, tokens: int) -> int:
         """Pages needed to hold ``tokens`` positions."""
         return max(1, math.ceil(tokens / self.page_size))
+
+    def pool_bytes(self) -> int:
+        """Device bytes resident in the K+V pools (quantized pools
+        count their scale planes — that is the honest cost the sizing
+        math and shardcheck's projection gate both work from)."""
+        import jax
+        return sum(int(a.size) * int(a.dtype.itemsize)
+                   for a in jax.tree_util.tree_leaves((self.k, self.v)))
 
     # ---- allocation ----
     def alloc(self, n_pages: int) -> Optional[List[int]]:

@@ -68,9 +68,12 @@ class CachedDecoder:
 
     def __init__(self, model, *, max_batch: int, page_size: int,
                  pages_per_seq: int, donate: Optional[bool] = None,
-                 max_positions: Optional[int] = None):
+                 max_positions: Optional[int] = None,
+                 use_pallas: Optional[bool] = None,
+                 kv_dtype: Optional[str] = None):
         import jax
 
+        from ...framework.flags import flag_value
         from ...jit.functional import state_arrays
         from ...models.gpt import GPTKVCache
 
@@ -83,6 +86,16 @@ class CachedDecoder:
         self.max_batch = int(max_batch)
         self.page_size = int(page_size)
         self.pages_per_seq = int(pages_per_seq)
+        # pinned at construction: a flag flip mid-lifetime must not
+        # silently retrace half the entry points (both join the
+        # geometry fingerprint, so warmup manifests and the persistent
+        # compile cache key on them too)
+        self.use_pallas = bool(
+            flag_value("FLAGS_decode_pallas_attention")
+            if use_pallas is None else use_pallas)
+        self.kv_dtype = str(
+            flag_value("FLAGS_decode_kv_dtype")
+            if kv_dtype is None else kv_dtype) or ""
         self.max_positions = int(
             max_positions if max_positions is not None
             else model.kv_cache_spec()["max_seq_len"])
@@ -110,6 +123,7 @@ class CachedDecoder:
         from ...jit.functional import functional_call
 
         page = self.page_size
+        use_pallas = self.use_pallas
 
         from ...distributed.shard import constrain_batch
 
@@ -127,7 +141,7 @@ class CachedDecoder:
                 jax.tree_util.tree_map(_wrap, k),
                 jax.tree_util.tree_map(_wrap, v),
                 _wrap(tables), _wrap(prompt_lens), _wrap(valid),
-                _wrap(positions))
+                _wrap(positions), use_pallas=use_pallas)
             logits, (k2, v2) = functional_call(
                 model, params, buffers, ids, cache=cache, training=False)
             # only the last REAL position's logits leave the device
@@ -147,7 +161,8 @@ class CachedDecoder:
                 jax.tree_util.tree_map(_wrap, k),
                 jax.tree_util.tree_map(_wrap, v),
                 _wrap(tables), _wrap(ctx), _wrap(active[:, None]),
-                _wrap(positions[:, None].astype(jnp.int32)))
+                _wrap(positions[:, None].astype(jnp.int32)),
+                use_pallas=use_pallas)
             logits, (k2, v2) = functional_call(
                 model, params, buffers, ids, cache=cache, training=False)
             return logits[:, 0], k2, v2
@@ -175,7 +190,7 @@ class CachedDecoder:
                 jax.tree_util.tree_map(_wrap, k),
                 jax.tree_util.tree_map(_wrap, v),
                 _wrap(tables), _wrap(ctx), _wrap(valid),
-                _wrap(positions))
+                _wrap(positions), use_pallas=use_pallas)
             logits, (k2, v2) = functional_call(
                 model, params, buffers, ids, cache=cache, training=False)
             return logits, k2, v2
@@ -218,7 +233,9 @@ class CachedDecoder:
                     "page_size": self.page_size,
                     "pages_per_seq": self.pages_per_seq,
                     "max_positions": self.max_positions,
-                    "donate": self._donate, "v": 2}
+                    "donate": self._donate,
+                    "use_pallas": self.use_pallas,
+                    "kv_dtype": self.kv_dtype, "v": 3}
             h = hashlib.sha256(layer_fingerprint(self.model).encode())
             h.update(json.dumps(geom, sort_keys=True).encode())
             self._fp = h.hexdigest()

@@ -14,6 +14,21 @@ pytestmark = pytest.mark.dist
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# Some jaxlib builds compile out the CPU Gloo collectives, so a
+# multi-controller CPU mesh initializes fine but every cross-process
+# computation aborts with this runtime error. That is an environment
+# capability, not a launch/rendezvous bug — the launcher, TCPStore and
+# device-path plumbing under test all ran; skip instead of failing.
+_NO_MP_CPU = "Multiprocess computations aren't implemented on the CPU backend"
+
+
+def _skip_if_cpu_collectives_unsupported(r):
+    blob = (r.stdout or "") + (r.stderr or "")
+    if _NO_MP_CPU in blob:
+        pytest.skip("environmental: this jaxlib's CPU backend has no "
+                    "multiprocess collectives "
+                    f"({_NO_MP_CPU!r})")
+
 
 def test_launch_two_ranks_eager_collectives(tmp_path):
     env = dict(os.environ)
@@ -103,6 +118,7 @@ def test_multiprocess_spmd_trainstep(tmp_path):
          "--nproc_per_node", "2", "--jax_distributed",
          os.path.join(REPO, "tests", "mh_train_worker.py"), str(tmp_path)],
         cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    _skip_if_cpu_collectives_unsupported(r)
     assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
     l0 = (tmp_path / "mh_ok.0").read_text()
     l1 = (tmp_path / "mh_ok.1").read_text()
@@ -121,6 +137,7 @@ def test_eager_allreduce_device_path(tmp_path):
          "--nproc_per_node", "2", "--jax_distributed",
          os.path.join(REPO, "tests", "eager_ar_worker.py"), str(tmp_path)],
         cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    _skip_if_cpu_collectives_unsupported(r)
     assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
     for rank in (0, 1):
         assert (tmp_path / f"ar_ok.{rank}").read_text() == "True"
@@ -192,6 +209,7 @@ def test_eager_subgroup_device_path(tmp_path):
          os.path.join(REPO, "tests", "eager_subgroup_worker.py"),
          str(tmp_path)],
         cwd=REPO, env=env, capture_output=True, text=True, timeout=420)
+    _skip_if_cpu_collectives_unsupported(r)
     assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
     for rank in range(4):
         kinds = (tmp_path / f"sub_ok.{rank}").read_text().split(",")

@@ -633,6 +633,28 @@ class TestLockDiscipline:
         assert any(f.rule == "LK001" and "fleet" in f.path
                    for f in findings)
 
+    def test_tracer_safety_reaches_pallas_paged_kernels(self, tmp_path):
+        """Scope self-test for PR 17: tracer safety must reach
+        paddle_tpu/ops/pallas_paged_attention.py — the kernel wrapper
+        and its index maps trace under every jitted serving step, so
+        a wall-clock read (or any host impurity) there would freeze
+        into the compiled decode program."""
+        pkg = tmp_path / "paddle_tpu" / "ops"
+        pkg.mkdir(parents=True)
+        (pkg / "pallas_paged_attention.py").write_text(textwrap.dedent(
+            """
+            import time
+            import jax
+
+            @jax.jit
+            def paged_attention(q):
+                block_q = int(time.time()) % 8
+                return q * block_q
+            """))
+        findings = _run(tmp_path, [TracerSafetyAnalyzer()])
+        assert any(f.rule == "TS004" and "pallas_paged" in f.path
+                   for f in findings)
+
 
 # ===================================================================
 # 5. core: fingerprints, baseline, walker, CLI

@@ -30,10 +30,21 @@ combined ``decode_prefix_spec`` record (BENCH_PREFIX_r*.json):
   ceiling with the rate reported honestly alongside; greedy parity
   vs the non-speculative engine is asserted, not assumed.
 
+A third mode, ``--kernels``, runs PAIRED serving trials over the
+fused-kernel / quantized-KV matrix (``FLAGS_decode_pallas_attention``
+x ``FLAGS_decode_kv_dtype``) on ONE model: decode tok/s, TTFT and p99
+inter-token latency per variant, the int8 page-capacity ratio vs f32
+(the pool-sizing claim: same byte budget, ~2x resident sequences),
+greedy-parity across every variant's streams, and a clean page-leak
+check. Emits one ``decode_kernels`` record (BENCH_KERNELS_r*.json);
+on a CPU host the Pallas variants run in interpret mode, so their
+timings gate parity/capacity invariants, not kernel speed.
+
 Usage: JAX_PLATFORMS=cpu python tools/bench_decode.py
        [--batch 8] [--prompt-len 12] [--max-new 48] [--trials 3]
-       [--requests N] [--prefix] [--spec] [--spec-k 4]
-       [--out BENCH_DECODE_rNN.json | BENCH_PREFIX_rNN.json]
+       [--requests N] [--prefix] [--spec] [--spec-k 4] [--kernels]
+       [--out BENCH_DECODE_rNN.json | BENCH_PREFIX_rNN.json |
+        BENCH_KERNELS_rNN.json]
 """
 import argparse
 import os
@@ -86,6 +97,8 @@ def _parse_args():
                     help="speculative decoding single-stream tok/s")
     ap.add_argument("--spec-k", type=int, default=6,
                     help="draft tokens proposed per verify step")
+    ap.add_argument("--kernels", action="store_true",
+                    help="fused-kernel/quantized-KV variant matrix")
     ap.add_argument("--preamble", type=int, default=256,
                     help="shared-prefix preamble length (--prefix)")
     ap.add_argument("--out", default=None,
@@ -242,6 +255,111 @@ def _bench_spec(args):
     }
 
 
+# fused-kernel / quantized-KV variant matrix: name -> (kv_dtype,
+# pallas routing). f32+reference is the parity baseline; int8_pallas
+# is the serving configuration the capacity claim is about.
+_KERNEL_VARIANTS = [
+    ("f32", "", False),
+    ("f32_pallas", "", True),
+    ("int8", "int8", False),
+    ("int8_pallas", "int8", True),
+]
+
+
+def _bench_kernels(args):
+    """Paired trials across the kernel/quantization matrix on one
+    model and one prompt set. Greedy streams must be IDENTICAL across
+    all four variants (int8 is greedy-stable on this model; the 0.05
+    logits envelope is tested in tests/test_pallas_paged.py) and the
+    int8 pool must hold ~2x the pages of the f32 pool under the same
+    byte budget — those are the gated invariants; the per-variant
+    timings ride along as diagnostics (interpret-mode Pallas on CPU
+    is not a speed measurement)."""
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.framework import flags as F
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+    from paddle_tpu.serving.generation import GenerationServer
+
+    paddle.seed(0)
+    cfg = gpt_tiny(use_flash_attention=False)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    b, plen, new = args.batch, args.prompt_len, args.max_new
+    rng = np.random.RandomState(0)
+    prompts = [list(rng.randint(0, cfg.vocab_size, plen))
+               for _ in range(b)]
+
+    variants, streams = {}, {}
+    saved = F.get_flags(["FLAGS_decode_kv_dtype",
+                         "FLAGS_decode_pallas_attention"])
+    try:
+        for name, kd, up in _KERNEL_VARIANTS:
+            F.set_flags({"FLAGS_decode_kv_dtype": kd,
+                         "FLAGS_decode_pallas_attention": up})
+            srv = GenerationServer(model, max_batch=b,
+                                   page_size=args.page_size,
+                                   name=f"bench-kern-{name}",
+                                   start=False)
+            srv.warmup(seq_buckets=[srv.policy.bucket_seq(plen)])
+            srv.start()
+            ttfts = [_ttft(srv, prompts[0], new)
+                     for _ in range(args.trials)]
+            tps, runs = [], []
+            for _ in range(args.trials):
+                t0 = time.perf_counter()
+                futs = [srv.submit_generate(p, max_new_tokens=new)
+                        for p in prompts]
+                done = [list(f.result(timeout=600)) for f in futs]
+                tps.append(sum(len(d) for d in done)
+                           / (time.perf_counter() - t0))
+                runs.append(done)
+            snap = srv.metrics_snapshot()
+            chk = srv.kv.leak_check()
+            streams[name] = runs
+            variants[name] = {
+                "kv_dtype": kd or "float32",
+                "use_pallas": up,
+                "decode_tok_s": round(_median(tps), 1),
+                "ttft_ms": round(_median(ttfts), 3),
+                "p99_inter_token_ms": round(
+                    snap["inter_token_ms"].get("p99", 0.0), 3),
+                "capacity_pages": srv.kv.capacity,
+                "capacity_factor": srv.kv_capacity_factor,
+                "pool_bytes": srv.kv.pool_bytes(),
+                "leak_ok": bool(chk["ok"]) and chk["leaked"] == 0,
+            }
+            srv.shutdown()
+    finally:
+        F.set_flags(saved)
+
+    base = variants["f32"]
+    parity = all(streams[n] == streams["f32"] for n in streams)
+    ref, quant = base, variants["int8_pallas"]
+    return {
+        "metric": "decode_kernels",
+        "skipped": False,
+        "value": quant["decode_tok_s"],
+        "unit": "tokens/s",
+        "vs_baseline": round(
+            quant["decode_tok_s"] / ref["decode_tok_s"], 3)
+            if ref["decode_tok_s"] else 0.0,
+        "greedy_parity": bool(parity),
+        "leaks_clean": all(v["leak_ok"] for v in variants.values()),
+        "capacity_ratio": round(
+            quant["capacity_pages"] / ref["capacity_pages"], 3),
+        "pool_bytes_saved_pct": round(
+            100.0 * (1 - quant["pool_bytes"] / ref["pool_bytes"]), 1),
+        "variants": variants,
+        "config": {"model": "gpt_tiny", "batch": b,
+                   "prompt_len": plen, "max_new_tokens": new,
+                   "page_size": args.page_size, "trials": args.trials,
+                   "backend": jax.default_backend(),
+                   "pallas_interpret":
+                       jax.default_backend() == "cpu"},
+    }
+
+
 _COST_AGREE_TOL = 0.15
 
 
@@ -288,6 +406,17 @@ def _run(args):
 
     if jax.default_backend() == "cpu":
         jax.config.update("jax_platforms", "cpu")
+
+    if args.kernels:
+        record = _bench_kernels(args)
+        emit_record(record, out=args.out)
+        if not (record["greedy_parity"] and record["leaks_clean"]):
+            print("# FAIL: kernel-variant parity/leak invariant broke "
+                  f"(greedy_parity={record['greedy_parity']}, "
+                  f"leaks_clean={record['leaks_clean']})",
+                  file=sys.stderr)
+            return 1
+        return 0
 
     if args.prefix or args.spec:
         record = {"metric": "decode_prefix_spec", "skipped": False,

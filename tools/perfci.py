@@ -69,6 +69,45 @@ GATES: List[Dict[str, Any]] = [
      "path": ("engine_p99_inter_token_ms",),
      "op": "max", "baseline": 1.975, "rel_tol": 0.25, "unit": "ms",
      "why": "decode tail latency between tokens"},
+    {"name": "kernels_decode_tok_s", "metric": "decode_kernels",
+     "files": "BENCH_KERNELS_r*.json", "path": ("value",),
+     "op": "min", "baseline": 929.5, "rel_tol": 0.50,
+     "unit": "tokens/s",
+     "why": "int8+Pallas serving decode throughput (PR 17); on a CPU "
+            "record the kernel runs in interpret mode, so the wide "
+            "envelope guards against structural slowdowns (extra "
+            "dispatch, accidental dense gather), not kernel speed"},
+    {"name": "kernels_ttft_ms", "metric": "decode_kernels",
+     "files": "BENCH_KERNELS_r*.json",
+     "path": ("variants", "int8_pallas", "ttft_ms"),
+     "op": "max", "baseline": 1.7, "rel_tol": 0.50, "unit": "ms",
+     "why": "time-to-first-token with quantize-on-write prefill must "
+            "stay near the f32 path (r01: 1.69 vs 1.20 ms)"},
+    {"name": "kernels_p99_inter_token_ms", "metric": "decode_kernels",
+     "files": "BENCH_KERNELS_r*.json",
+     "path": ("variants", "int8_pallas", "p99_inter_token_ms"),
+     "op": "max", "baseline": 6.9, "rel_tol": 0.50, "unit": "ms",
+     "why": "fused-kernel decode tail latency between streamed "
+            "tokens (interpret-mode ceiling on CPU records)"},
+    {"name": "kernels_capacity_ratio", "metric": "decode_kernels",
+     "files": "BENCH_KERNELS_r*.json", "path": ("capacity_ratio",),
+     "op": "min", "baseline": 1.8, "rel_tol": 0.0, "unit": "x",
+     "why": "int8 KV pool must hold >= 1.8x the pages of the f32 "
+            "pool under the same byte budget — the quantized-KV "
+            "capacity claim (PR 17, r01: 2.0x at 38% fewer bytes)"},
+    {"name": "kernels_greedy_parity", "metric": "decode_kernels",
+     "files": "BENCH_KERNELS_r*.json", "path": ("greedy_parity",),
+     "op": "true",
+     "why": "every kernel/quantization variant (f32/int8 x "
+            "reference/Pallas) must emit the IDENTICAL greedy stream "
+            "— kernel routing is an optimization, never a model "
+            "change (PR 17)"},
+    {"name": "kernels_leaks_clean", "metric": "decode_kernels",
+     "files": "BENCH_KERNELS_r*.json", "path": ("leaks_clean",),
+     "op": "true",
+     "why": "page accounting must close after every variant's "
+            "trials — quantized pools share the refcounted "
+            "allocator (PR 17)"},
     {"name": "prefix_ttft_speedup", "metric": "decode_prefix_spec",
      "files": "BENCH_PREFIX_r*.json",
      "path": ("prefix", "ttft_speedup"),

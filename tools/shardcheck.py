@@ -232,6 +232,50 @@ FAST_PLANS = ("ernie_tiny_zero3", "gpt_tiny_tp")
 
 
 # ------------------------------------------------------------ execution
+def _kv_projection(model, page_size: int = 16, max_batch: int = 8):
+    """Serving KV-pool byte projection per pool dtype (the quantized-KV
+    sizing story, gated like the sharding bytes): for each supported
+    ``FLAGS_decode_kv_dtype`` this projects the engine's resident pool
+    bytes — including the capacity factor the engine actually grants
+    (sub-f32 dtypes get 2x pages, i.e. ~2x resident sequences) — so a
+    regression in the quantized layout (a scale plane growing, the
+    sizing rule regressing) trips the baseline gate.
+
+    None for models without the cached-decode contract."""
+    import numpy as np
+
+    from paddle_tpu.ops.paged_attention import kv_pool_bytes
+    from paddle_tpu.serving.generation.model_fns import \
+        supports_cached_decode
+
+    if not supports_cached_decode(model):
+        return None
+    spec = model.kv_cache_spec()
+    nh, hd = spec["num_heads"], spec["head_dim"]
+    layers = spec["num_layers"]
+    pages_per_seq = -(-spec["max_seq_len"] // page_size)
+    f32_tok = kv_pool_bytes(1, 1, nh, hd, None)
+    dtypes = {}
+    for dt in ("float32", "bfloat16", "int8"):
+        tok = kv_pool_bytes(1, 1, nh, hd, dt)
+        factor = max(1, min(2, f32_tok // max(tok, 1)))
+        num_pages = 1 + max_batch * pages_per_seq * factor
+        pool = layers * 2 * kv_pool_bytes(num_pages, page_size,
+                                          nh, hd, dt)
+        dtypes[dt] = {"token_bytes": int(tok),
+                      "capacity_factor": int(factor),
+                      "num_pages": int(num_pages),
+                      "pool_bytes": int(pool)}
+    ratio = dtypes["float32"]["token_bytes"] / \
+        dtypes["int8"]["token_bytes"]
+    return {"page_size": page_size, "max_batch": max_batch,
+            "pages_per_seq": int(pages_per_seq),
+            "head_dim": int(hd),
+            "dtypes": dtypes,
+            # per-token shrink 4/(1+4/D): 3.76x at D=64
+            "int8_bytes_ratio": round(float(ratio), 4)}
+
+
 def _mesh_kind(mesh) -> str:
     kinds = sorted({getattr(d, "device_kind", str(d))
                     for d in mesh.devices.flat})
@@ -329,6 +373,7 @@ def run_plan(name: str, tpu_topology: str = "") -> dict:
             },
             "spec_tree_hash": shard.spec_tree_hash(
                 shard.model_spec_tree(model)),
+            "kv_projection": _kv_projection(model),
         }
         rec.update(_sharding_counts(specs, named, plan["target_axes"]))
         return rec
@@ -371,6 +416,28 @@ def gate_record(rec: dict, base: dict) -> list:
             f"spec tree changed (hash {rec['spec_tree_hash'][:12]} vs "
             f"baseline {base['spec_tree_hash'][:12]}) — review the "
             f"sharding change, then --write-baseline")
+    kv = rec.get("kv_projection")
+    if kv is not None and base.get("kv_projection") is not None:
+        bkv = base["kv_projection"]
+        i8, f32 = kv["dtypes"]["int8"], kv["dtypes"]["float32"]
+        _within(i8["pool_bytes"], bkv["dtypes"]["int8"]["pool_bytes"],
+                "projected int8 KV pool bytes")
+        # the quantized-KV contract: ~2x resident sequences that still
+        # fit UNDER the f32 budget (the scale planes are the only
+        # overhead, per-token shrink 4/(1+4/head_dim))
+        if i8["capacity_factor"] < 2:
+            fails.append(
+                f"int8 capacity factor {i8['capacity_factor']} < 2 — "
+                f"quantized pools no longer buy the ~2x headroom")
+        if i8["pool_bytes"] > f32["pool_bytes"]:
+            fails.append(
+                f"int8 pool at 2x pages ({i8['pool_bytes']} B) "
+                f"exceeds the f32 pool at 1x ({f32['pool_bytes']} B)")
+        if kv["int8_bytes_ratio"] < bkv["int8_bytes_ratio"] - 0.01:
+            fails.append(
+                f"int8 per-token shrink regressed: "
+                f"{kv['int8_bytes_ratio']}x vs baseline "
+                f"{bkv['int8_bytes_ratio']}x")
     return fails
 
 
@@ -382,7 +449,9 @@ def load_baseline(path: str) -> dict:
 
 
 def write_baseline(path: str, records: dict, tolerance: float = 0.10):
-    plans = {}
+    # merge: re-baselining a SUBSET (--plans) must not drop the other
+    # plans' committed entries
+    plans = dict(load_baseline(path))
     for name, rec in records.items():
         entry = dict(rec)
         entry["tolerance"] = tolerance
