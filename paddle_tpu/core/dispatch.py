@@ -34,6 +34,11 @@ def wrap(arr, stop_gradient=True) -> Tensor:
 
 def _check_nan_inf(name, arrays):
     for a in arrays:
+        if isinstance(a, jax.core.Tracer):
+            # No concrete value under jit tracing — the fused on-device
+            # tripwires (observability.numerics, wired into TrainStep and
+            # CachedDecoder) own the compiled path.
+            continue
         if jnp.issubdtype(a.dtype, jnp.inexact):
             bad = bool(jnp.any(~jnp.isfinite(a)))
             if bad:

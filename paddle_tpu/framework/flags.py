@@ -348,6 +348,31 @@ define_flag("FLAGS_trace_max_spans_per_trace", 256,
             "cannot evict every other trace (excess spans are "
             "counted as dropped)")
 
+# Numerics & silent-data-corruption observability
+# (paddle_tpu.observability.numerics — NaN/Inf tripwires, sampled
+# shadow-verification against the pure-JAX oracle, device canary
+# sweeps, and the /numericsz surface). FLAGS_check_nan_inf (defined
+# with the core flags above) arms the tripwires at 100% duty; these
+# knobs give the fleet a cheaper sampled regime.
+define_flag("FLAGS_numerics_sample_rate", 0.0,
+            "fraction of train/decode steps whose output health stats "
+            "(finite fraction, max-abs, argmax-entropy, grad norm) are "
+            "published; FLAGS_check_nan_inf=true overrides this to "
+            "every step. The device reductions are fixed-shape and "
+            "their host read is deferred one step, so sampling costs "
+            "no extra device sync")
+define_flag("FLAGS_numerics_shadow_rate", 0.0,
+            "duty cycle of decode/chunked/verify shadow-verification: "
+            "a sampled dispatch is re-executed through the pure-JAX "
+            "oracle (use_pallas=False, non-donating) and max-abs "
+            "logit divergence is published as "
+            "paddle_numerics_shadow_divergence{kind,dtype}")
+define_flag("FLAGS_numerics_canary_period_s", 0.0,
+            "period of the per-worker deterministic checksum canary "
+            "sweep (SDC detection); 0 disables. The sweep also runs "
+            "on not-ready -> ready transitions; a failing canary "
+            "quarantines the replica (readiness flip + breaker open)")
+
 # Serving-fleet knobs (paddle_tpu.serving.fleet — router + N replica
 # worker processes with rolling hot weight swap).
 define_flag("FLAGS_serving_ready_requires_warmup", False,

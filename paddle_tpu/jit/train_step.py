@@ -9,6 +9,7 @@ training loops should use.
 from __future__ import annotations
 
 import functools
+import operator
 from typing import Callable, Dict, Optional
 
 import jax
@@ -153,6 +154,15 @@ class TrainStep:
         # xstats memo: (tag, batch-signature) -> ExecEntry so the
         # per-step dispatch note is a dict hit, not a re-registration
         self._xstats_memo: Dict = {}
+        # numerics tripwires: armed state pinned at construction (the
+        # in-graph grad-health reductions change the compiled program,
+        # same pin contract as CachedDecoder's use_pallas)
+        try:
+            from ..observability import numerics as _numerics
+            self._numerics_armed = (_numerics.train_tripwire_armed()
+                                    and bool(self._trainable))
+        except Exception:  # noqa: BLE001 - observability is garnish
+            self._numerics_armed = False
 
     def _init_opt_state(self):
         opt = self.optimizer
@@ -212,6 +222,7 @@ class TrainStep:
             repr(getattr(opt, "_l2_coeff", None)),
             repr(getattr(opt, "_dgc_cfg", None)),
             repr(getattr(opt, "_localsgd_cfg", None)),
+            repr(("numerics", getattr(self, "_numerics_armed", False))),
         ]
         gc = getattr(opt, "_grad_clip", None)
         parts.append(repr((type(gc).__qualname__ if gc is not None
@@ -356,6 +367,21 @@ class TrainStep:
         except Exception:  # noqa: BLE001 - observability is garnish on
             pass           # the hot path, never a step failure
 
+    def _numerics_note(self, num_stats, new_sc):
+        """Hand the step's device health scalars ([grad_norm,
+        grad_finite_fraction, loss_is_finite]) to the numerics layer.
+        Sampled on the host; the layer defers the actual device read
+        by one step, so this never syncs the step that produced them."""
+        try:
+            from ..observability import numerics
+            if not numerics.sample_decision(numerics.tripwire_rate()):
+                return
+            scale = new_sc.get("scale") if isinstance(new_sc, dict) \
+                else None
+            numerics.note_train_step(num_stats, loss_scale=scale)
+        except Exception:  # noqa: BLE001 - observability is garnish on
+            pass           # the hot path, never a step failure
+
     def _make_pure_step(self):
         """Dispatch to the step-structure builder: the plain GSPMD step,
         or the DGC / LocalSGD communication-reducing variants when the
@@ -392,6 +418,7 @@ class TrainStep:
                      for n, p in self._trainable.items()
                      if getattr(p, "_asp_mask", None) is not None}
         scaler = self._scaler
+        numerics_armed = getattr(self, "_numerics_armed", False)
         if scaler is not None:
             sc_cfg = dict(incr_ratio=float(scaler._incr_ratio),
                           decr_ratio=float(scaler._decr_ratio),
@@ -421,6 +448,26 @@ class TrainStep:
             else:
                 loss, grads = jax.value_and_grad(loss_of)(train_params)
                 found_inf = None
+            num_stats = None
+            if numerics_armed and grads:
+                # numerics tripwires: fixed-shape grad-health
+                # reductions fused into the step ([grad_norm,
+                # grad_finite_fraction, loss_is_finite] — the host
+                # read is deferred by the numerics layer, never here)
+                total_el = float(sum(
+                    int(np.prod(g.shape)) for g in grads.values()) or 1)
+                finite_ct = functools.reduce(
+                    operator.add,
+                    [jnp.sum(jnp.isfinite(g).astype(jnp.float32))
+                     for g in grads.values()])
+                sq = functools.reduce(
+                    operator.add,
+                    [jnp.sum(jnp.square(jnp.where(
+                        jnp.isfinite(g), g, 0).astype(jnp.float32)))
+                     for g in grads.values()])
+                num_stats = jnp.stack(
+                    [jnp.sqrt(sq), finite_ct / total_el,
+                     jnp.isfinite(loss).astype(jnp.float32)])
             # Pin each grad to its param's shard layout IMMEDIATELY: with
             # ZeRO ('sharding'/dist specs) XLA otherwise defers the
             # reduce-scatters and keeps full unsharded f32 grads live for
@@ -469,6 +516,15 @@ class TrainStep:
                 # both Adam moments of a zero-grad param) must NOT be CSE'd
                 # into one buffer — the next call feeds outputs back as
                 # DONATED inputs, and XLA rejects donating a buffer twice
+                if num_stats is not None:
+                    # health scalars ride a reserved sc_state key;
+                    # _call_inner pops it back out before reseeding so
+                    # the next call's operand structure is unchanged
+                    out_sc = dict(sc_state, numerics=num_stats)
+                    loss, new_params, new_state, out_sc = \
+                        jax.lax.optimization_barrier(
+                            (loss, new_params, new_state, out_sc))
+                    return loss, new_params, new_state, out_sc
                 loss, new_params, new_state = jax.lax.optimization_barrier(
                     (loss, new_params, new_state))
                 return loss, new_params, new_state, sc_state
@@ -487,6 +543,8 @@ class TrainStep:
                 good = jnp.where(inc, 0, good)
             new_sc = {"scale": scale, "good": good, "bad": bad,
                       "found_inf": found_inf}
+            if num_stats is not None:
+                new_sc["numerics"] = num_stats
             loss, new_params, new_state, new_sc = \
                 jax.lax.optimization_barrier(
                     (loss, new_params, new_state, new_sc))
@@ -572,6 +630,8 @@ class TrainStep:
             ps = self._pure_step
             self._multi_n = n_steps
 
+            has_num = {"seen": False}   # set at body trace time
+
             def multi(params, buffers, opt_state, sc_state, lr, t0, key,
                       *batch):
                 def body(carry, i):
@@ -579,19 +639,28 @@ class TrainStep:
                     k = jax.random.fold_in(key, i)
                     loss, p2, s2, sc2 = ps(params, buffers, opt_state,
                                            sc_state, lr, t0 + i, k, *batch)
-                    # the step ADDS found_inf to the scaler state; keep the
-                    # carry structure fixed and thread it as an output
+                    # the step ADDS found_inf (and, when the tripwires
+                    # are armed, numerics) to the scaler state; keep
+                    # the carry structure fixed and thread them as
+                    # outputs
                     fi = sc2.get("found_inf", jnp.zeros((), jnp.bool_)) \
                         if sc2 else jnp.zeros((), jnp.bool_)
+                    nm = sc2.get("numerics") if sc2 else None
+                    if nm is not None:
+                        has_num["seen"] = True
+                    else:
+                        nm = jnp.zeros((3,), jnp.float32)
                     sc_carry = {k2: v for k2, v in sc2.items()
-                                if k2 != "found_inf"}
-                    return (p2, s2, sc_carry), (loss, fi)
+                                if k2 not in ("found_inf", "numerics")}
+                    return (p2, s2, sc_carry), (loss, fi, nm)
 
-                (p, s, sc), (losses, fis) = jax.lax.scan(
+                (p, s, sc), (losses, fis, nums) = jax.lax.scan(
                     body, (params, opt_state, sc_state),
                     jnp.arange(n_steps, dtype=jnp.int32))
                 if sc:
                     sc = dict(sc, found_inf=fis[-1])
+                if has_num["seen"]:
+                    sc = dict(sc, numerics=nums[-1])
                 return losses[-1], p, s, sc
 
             self._compiled_multi = jax.jit(
@@ -762,6 +831,7 @@ class TrainStep:
                 }
             sc_state = dict(self._scaler_state)
             sc_state.pop("found_inf", None)
+            sc_state.pop("numerics", None)
         else:
             sc_state = {}
         # paddle dtype defaulting (python floats → default float dtype), not
@@ -781,6 +851,12 @@ class TrainStep:
         loss, new_params, new_state, new_sc = \
             (step_fn if step_fn is not None else self._compiled)(*call_args)
         self._xstats_note(call_args, step_fn)
+        num_stats = None
+        if isinstance(new_sc, dict) and "numerics" in new_sc:
+            # strip the reserved tripwire key so the scaler mirror and
+            # the next step's reseeded operands keep their structure
+            new_sc = dict(new_sc)
+            num_stats = new_sc.pop("numerics")
         if not getattr(loss, "is_fully_addressable", True):
             # multi-host mesh: the scalar loss is replicated; hand back the
             # process-local copy so .numpy()/float() work on every rank
@@ -796,6 +872,8 @@ class TrainStep:
             self._scaler._good_steps = new_sc["good"]
             self._scaler._bad_steps = new_sc["bad"]
             self._scaler._found_inf = new_sc["found_inf"]
+        if num_stats is not None:
+            self._numerics_note(num_stats, new_sc)
         if getattr(self, "_mesh", None) is not None:
             # outputs are already correctly sharded; next step reuses them
             # without re-placement (their old donated inputs are dropped)

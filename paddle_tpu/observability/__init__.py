@@ -43,7 +43,13 @@ counterpart and the ONE place every subsystem reports into:
   envelopes into live ``paddle_mfu{kind=}`` / bandwidth-utilization
   gauges and a roofline classification (``/execz``), plus the
   on-demand and anomaly-triggered device-profile capture ring
-  (``/profilez``).
+  (``/profilez``);
+- ``numerics``: the correctness-observability plane — NaN/Inf
+  tripwires over TrainStep grads and CachedDecoder logits
+  (``FLAGS_check_nan_inf`` implemented for real), sampled
+  shadow-verification of fused kernels against the pure-JAX oracle,
+  deterministic per-chip SDC canary sweeps feeding replica
+  quarantine, and the ``/numericsz`` surface.
 
 ``framework.monitor``'s stat_add/stat_get are a Counter view onto the
 default registry; ``serving.ServingMetrics`` is backed by these types
@@ -51,8 +57,8 @@ while keeping its ``snapshot()`` schema byte-compatible.
 """
 from __future__ import annotations
 
-from . import (exposition, goodput, httpd, registry, runtime,  # noqa: F401
-               slo, stepprof, tracing, xstats)
+from . import (exposition, goodput, httpd, numerics,  # noqa: F401
+               registry, runtime, slo, stepprof, tracing, xstats)
 from .exposition import (  # noqa: F401
     PROMETHEUS_CONTENT_TYPE, json_snapshot, json_text, prometheus_text,
 )
@@ -65,6 +71,10 @@ from .httpd import (  # noqa: F401
     get_telemetry_server, healthz, readyz, remove_health_check,
     remove_readiness_check, start_telemetry_server,
     stop_telemetry_server,
+)
+from .numerics import (  # noqa: F401
+    CanaryRunner, note_serving_logits, note_shadow_divergence,
+    numericsz_payload, run_device_canary,
 )
 from .registry import (  # noqa: F401
     DEFAULT_MS_BUCKETS, Counter, Gauge, Histogram, MetricRegistry,
@@ -121,10 +131,12 @@ __all__ = [
     "default_exec_registry", "default_profile_ring",
     "register_executable", "device_peaks", "execz_payload",
     "profilez_payload", "capture_profile",
+    "CanaryRunner", "note_serving_logits", "note_shadow_divergence",
+    "numericsz_payload", "run_device_canary",
     "TrainingTelemetryCallback", "instrument_optimizers",
     "uninstrument_optimizers",
-    "registry", "exposition", "httpd", "runtime", "training",
-    "tracing", "goodput", "stepprof", "slo", "xstats",
+    "registry", "exposition", "httpd", "numerics", "runtime",
+    "training", "tracing", "goodput", "stepprof", "slo", "xstats",
 ]
 
 _LAZY = {

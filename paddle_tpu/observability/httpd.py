@@ -26,7 +26,10 @@ Serves four paths off a daemon thread:
   provenance, live per-kind MFU and bandwidth utilization;
 - ``/profilez`` — the device-profile capture ring;
   ``?duration_ms=`` runs one bounded, rate-limited ``jax.profiler``
-  capture and returns the chrome-trace document.
+  capture and returns the chrome-trace document;
+- ``/numericsz`` — the correctness plane: NaN/Inf tripwire health,
+  shadow-verification divergence, int8 scale drift, device canary
+  state, and the numerics anomaly ledger.
 
 ``InferenceServer`` attaches via ``FLAGS_serving_telemetry_port``
 (-1 disabled, 0 ephemeral, >0 fixed); standalone training scripts call
@@ -52,7 +55,7 @@ __all__ = [
     "TelemetryServer", "start_telemetry_server", "get_telemetry_server",
     "stop_telemetry_server", "add_health_check", "remove_health_check",
     "healthz", "add_readiness_check", "remove_readiness_check",
-    "readyz", "execz_text", "profilez_response",
+    "readyz", "execz_text", "profilez_response", "numericsz_text",
 ]
 
 _start_time = time.time()
@@ -177,6 +180,13 @@ def _statusz() -> dict:
             out["compile_cache"] = section
     except Exception:  # noqa: BLE001
         pass
+    try:  # numerics health (lazy — absent until the numerics layer
+        # has something to say; importing observability pulls it in)
+        num = sys.modules.get("paddle_tpu.observability.numerics")
+        if num is not None:
+            out["numerics"] = num.numericsz_payload()
+    except Exception:  # noqa: BLE001
+        pass
     try:  # what sharding this process runs (lazy — shard may be absent)
         shard_mod = sys.modules.get("paddle_tpu.distributed.shard")
         mesh_mod = sys.modules.get("paddle_tpu.distributed.mesh_utils")
@@ -239,6 +249,17 @@ def execz_text(query: str = "") -> str:
     compute = "compute=0" not in (query or "")
     return json.dumps(xstats.execz_payload(compute=compute),
                       indent=1, sort_keys=True, default=str)
+
+
+def numericsz_text(query: str = "") -> str:
+    """The ``/numericsz`` body: tripwire/shadow/canary health from
+    the numerics layer (see ``numerics.numericsz_payload``). Shared by
+    the telemetry endpoint and replica workers; the router merges
+    replica payloads into a fleet view."""
+    del query  # no parameters yet; the signature matches its siblings
+    from . import numerics
+    return json.dumps(numerics.numericsz_payload(), indent=1,
+                      sort_keys=True, default=str)
 
 
 def profilez_response(query: str = "") -> Tuple[int, str]:
@@ -326,11 +347,15 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/profilez":
                 code, body = profilez_response(query)
                 self._send(code, body, "application/json")
+            elif path == "/numericsz":
+                self._send(200, numericsz_text(query),
+                           "application/json")
             elif path == "/":
                 self._send(200, "paddle-tpu telemetry\n"
                                 "/metrics  /healthz  /readyz  "
                                 "/statusz  /tracez  /goodputz  "
-                                "/sloz  /schedz  /execz  /profilez\n",
+                                "/sloz  /schedz  /execz  /profilez  "
+                                "/numericsz\n",
                            "text/plain; charset=utf-8")
             else:
                 self._send(404, "not found\n",

@@ -1,0 +1,842 @@
+"""Numerics & silent-data-corruption observability.
+
+Every other layer in this package watches *performance*; this one
+watches *correctness* — the reference framework's nan/inf debugger
+(``FLAGS_check_nan_inf``, /root/reference/paddle/fluid/framework/details/
+nan_inf_utils_detail.cc) rebuilt for a fleet where the dominant wrong-
+answer sources are fused Pallas kernels, int8 KV quantization, and
+per-chip silent data corruption:
+
+- **NaN/Inf tripwires** — fixed-shape on-device reductions over
+  TrainStep grads and CachedDecoder dispatch logits (finite fraction,
+  max-abs, argmax-entropy collapse, grad norm + EWMA drift, loss
+  scale). Host publication is deferred ONE step: each note enqueues
+  its device scalars and publishes the previous entry's, so the hot
+  path never gains a device sync. ``FLAGS_check_nan_inf`` arms every
+  step; ``FLAGS_numerics_sample_rate`` gives a sampled regime.
+- **Sampled shadow-verification** — a low-duty-cycle re-execution of
+  decode/chunked/verify dispatches through the pure-JAX oracle
+  (``use_pallas=False``), publishing max-abs logit divergence as
+  ``paddle_numerics_shadow_divergence{kind,dtype}``. Published as a
+  GAUGE family plus host-side ``PercentileWindow`` percentiles in the
+  /numericsz payload: metric_discipline's MD003 unit contract reserves
+  histogram names for ``_ms``/``_bytes``/``_seconds`` quantities, and
+  a unitless logit delta is none of those.
+- **Device canary sweeps** — a deterministic uint32 LCG/xorshift
+  checksum workload with a bit-exact numpy golden twin, run per worker
+  on ``FLAGS_numerics_canary_period_s`` and on readiness transitions.
+  A mismatch is per-chip SDC: the replica is quarantined (readiness
+  flip + breaker open) by ``fleet.worker.arm_canary`` rather than
+  silently serving garbage.
+
+Anomalies (non-finite outputs, shadow blow-ups, canary failures) are
+emitted as tail-promoted error spans into the trace flight recorder
+and handed to ``xstats.on_anomaly`` — the existing arm-gated,
+rate-limited path that spawns exactly one background ``/profilez``
+capture per episode, tagged with the promoted trace id.
+
+Everything here is garnish on hot paths: every note swallows its own
+exceptions, and with all three knobs at their 0.0 defaults every hook
+is a cheap no-op.
+"""
+from __future__ import annotations
+
+import collections
+import math
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "SHADOW_SITES", "CanaryRunner",
+    "enabled", "tripwire_rate", "shadow_rate", "train_tripwire_armed",
+    "sample_decision", "set_rng_for_tests", "reset_for_tests", "drain",
+    "note_serving_logits", "note_train_step", "note_shadow_divergence",
+    "note_int8_scales",
+    "canary_reference", "run_device_canary",
+    "numericsz_payload",
+]
+
+# dispatch sites eligible for oracle shadow-verification (prefill is
+# excluded: its cost dwarfs a decode step and the chunked path covers
+# the same kernel)
+SHADOW_SITES = ("generate_decode", "generate_chunked", "generate_verify")
+
+_EWMA_ALPHA = 0.1        # grad-norm drift smoothing
+_PENDING_MAX = 64        # deferred-publication queue bound
+_SHADOW_WINDOW = 512     # divergence percentile window per (kind, dtype)
+
+_CANARY_N = 4096
+_CANARY_ROUNDS = 4
+_CANARY_MASK = (1 << 32) - 1
+
+
+# ----------------------------------------------------------- knobs
+def _flag(name: str, default):
+    try:
+        from ..framework.flags import flag_value
+        return flag_value(name)
+    except KeyError:
+        return default
+
+
+def tripwire_rate() -> float:
+    """Effective tripwire duty cycle: ``FLAGS_check_nan_inf`` arms
+    every step (the reference debugger's contract), otherwise
+    ``FLAGS_numerics_sample_rate`` gives the cheap sampled regime."""
+    if bool(_flag("FLAGS_check_nan_inf", False)):
+        return 1.0
+    try:
+        rate = float(_flag("FLAGS_numerics_sample_rate", 0.0))
+    except (TypeError, ValueError):
+        return 0.0
+    return max(0.0, min(1.0, rate))
+
+
+def shadow_rate() -> float:
+    try:
+        rate = float(_flag("FLAGS_numerics_shadow_rate", 0.0))
+    except (TypeError, ValueError):
+        return 0.0
+    return max(0.0, min(1.0, rate))
+
+
+def enabled() -> bool:
+    return tripwire_rate() > 0.0 or shadow_rate() > 0.0
+
+
+def train_tripwire_armed() -> bool:
+    """Whether TrainStep should fuse the grad-health reductions into
+    its compiled step. Pinned at TrainStep construction (arming
+    mid-lifetime would change the compiled program — same contract as
+    CachedDecoder's use_pallas pin)."""
+    return tripwire_rate() > 0.0
+
+
+# ------------------------------------------------------------- rng
+_RNG_LOCK = threading.Lock()
+_rng = None
+
+
+def set_rng_for_tests(rng) -> None:
+    """Swap the sampling RNG (tests inject a seeded ``random.Random``
+    so duty-cycle decisions are reproducible); None restores the
+    default."""
+    global _rng
+    with _RNG_LOCK:
+        _rng = rng
+
+
+def sample_decision(rate: float) -> bool:
+    """One Bernoulli draw against ``rate`` from the module RNG."""
+    if rate <= 0.0:
+        return False
+    if rate >= 1.0:
+        return True
+    global _rng
+    with _RNG_LOCK:
+        if _rng is None:
+            import random
+            _rng = random.Random(0x9E3779B9)
+        return _rng.random() < rate
+
+
+# --------------------------------------------------------- metrics
+_METRICS_LOCK = threading.Lock()
+_metrics = None
+
+
+class _Metrics:
+    """Lazy singleton over the default registry (families are
+    get-or-create, so re-instantiation after ``reset_for_tests`` is
+    idempotent)."""
+
+    def __init__(self):
+        from .registry import default_registry
+        reg = default_registry()
+        self.checks = reg.counter(
+            "paddle_numerics_checks_total",
+            "tripwire health checks published, by dispatch kind",
+            ("kind",))
+        self.anomalies = reg.counter(
+            "paddle_numerics_anomalies_total",
+            "numerics anomalies (non-finite outputs, shadow blow-ups, "
+            "canary failures) by kind and reason", ("kind", "reason"))
+        self.shadow_checks = reg.counter(
+            "paddle_numerics_shadow_checks_total",
+            "sampled oracle shadow re-executions", ("kind", "dtype"))
+        self.canary_runs = reg.counter(
+            "paddle_numerics_canary_runs_total",
+            "device canary sweeps run")
+        self.canary_failures = reg.counter(
+            "paddle_numerics_canary_failures_total",
+            "device canary sweeps whose checksum mismatched (SDC)")
+        self.finite_fraction = reg.gauge(
+            "paddle_numerics_finite_fraction",
+            "fraction of finite values in the last checked output",
+            ("kind",))
+        self.max_abs = reg.gauge(
+            "paddle_numerics_logit_max_abs",
+            "max |logit| of the last checked output (finite values "
+            "only)", ("kind",))
+        self.argmax_entropy = reg.gauge(
+            "paddle_numerics_argmax_entropy",
+            "entropy of the batch argmax-id distribution — a collapse "
+            "to 0 on a busy batch means every lane argmaxes the same "
+            "token", ("kind",))
+        self.grad_norm = reg.gauge(
+            "paddle_numerics_grad_norm",
+            "global grad norm of the last checked train step")
+        self.grad_norm_drift = reg.gauge(
+            "paddle_numerics_grad_norm_drift",
+            "relative deviation of the last grad norm from its EWMA")
+        self.loss_scale = reg.gauge(
+            "paddle_numerics_loss_scale",
+            "live dynamic loss scale of the fused AMP step")
+        self.shadow_divergence = reg.gauge(
+            "paddle_numerics_shadow_divergence",
+            "max-abs logit divergence of the last shadow-verified "
+            "dispatch vs the pure-JAX oracle (unitless logit delta — "
+            "gauge + payload percentiles, not a histogram, per the "
+            "MD003 unit contract)", ("kind", "dtype"))
+        self.int8_scale_drift = reg.gauge(
+            "paddle_numerics_int8_scale_drift",
+            "relative drift of the int8 KV absmax-scale magnitude vs "
+            "its first-seen baseline", ("kind",))
+        self.canary_ok = reg.gauge(
+            "paddle_numerics_canary_ok",
+            "1 while the latest canary sweep matched its golden "
+            "checksum, 0 after a mismatch")
+
+
+def _get_metrics() -> _Metrics:
+    global _metrics
+    with _METRICS_LOCK:
+        if _metrics is None:
+            _metrics = _Metrics()
+        return _metrics
+
+
+# ------------------------------------------------- jitted reducers
+_FNS_LOCK = threading.Lock()
+_jit_fns: Dict[str, object] = {}
+_canary_ref_memo = None
+
+
+def _logit_stats_fn():
+    """Jitted [finite_fraction, max_abs, argmax_entropy] reduction
+    over a logits array ([B, vocab] or [B, S, vocab]); fixed output
+    shape (3,) so every call reuses one executable per input shape."""
+    with _FNS_LOCK:
+        fn = _jit_fns.get("logit_stats")
+        if fn is None:
+            import jax
+            import jax.numpy as jnp
+
+            def stats(logits):
+                x = logits.astype(jnp.float32)
+                finite = jnp.isfinite(x)
+                frac = jnp.mean(finite.astype(jnp.float32))
+                safe = jnp.where(finite, x, 0.0)
+                max_abs = jnp.max(jnp.abs(safe))
+                flat = safe.reshape(-1, safe.shape[-1])
+                am = jnp.argmax(flat, axis=-1)
+                counts = jnp.zeros(
+                    (safe.shape[-1],), jnp.float32).at[am].add(1.0)
+                p = counts / jnp.maximum(jnp.sum(counts), 1.0)
+                ent = -jnp.sum(jnp.where(p > 0.0, p * jnp.log(p), 0.0))
+                return jnp.stack([frac, max_abs, ent])
+
+            fn = jax.jit(stats)
+            _jit_fns["logit_stats"] = fn
+        return fn
+
+
+def _scale_summary_fn(n: int):
+    """Jitted mean of per-leaf mean-|scale| over ``n`` scale planes."""
+    key = f"int8_scales:{n}"
+    with _FNS_LOCK:
+        fn = _jit_fns.get(key)
+        if fn is None:
+            import jax
+            import jax.numpy as jnp
+
+            def summ(*xs):
+                acc = None
+                for x in xs:
+                    m = jnp.mean(jnp.abs(x.astype(jnp.float32)))
+                    acc = m if acc is None else acc + m
+                return acc / float(len(xs))
+
+            fn = jax.jit(summ)
+            _jit_fns[key] = fn
+        return fn
+
+
+def _canary_fn():
+    with _FNS_LOCK:
+        fn = _jit_fns.get("canary")
+        if fn is None:
+            import jax
+            import jax.numpy as jnp
+
+            def sweep():
+                x = jnp.arange(_CANARY_N, dtype=jnp.uint32)
+                for _ in range(_CANARY_ROUNDS):
+                    x = x * jnp.uint32(1664525) + jnp.uint32(1013904223)
+                    x = x ^ (x >> 16)
+                return jnp.sum(x)   # wrapping uint32 sum
+
+            fn = jax.jit(sweep)
+            _jit_fns["canary"] = fn
+        return fn
+
+
+def canary_reference() -> int:
+    """Host golden twin of the device canary: the same uint32
+    LCG+xorshift rounds in numpy (integer arrays wrap modularly), with
+    the wrapping device sum emulated as a uint64 sum mod 2^32."""
+    global _canary_ref_memo
+    with _FNS_LOCK:
+        if _canary_ref_memo is None:
+            x = np.arange(_CANARY_N, dtype=np.uint32)
+            for _ in range(_CANARY_ROUNDS):
+                x = x * np.uint32(1664525) + np.uint32(1013904223)
+                x = x ^ (x >> np.uint32(16))
+            _canary_ref_memo = int(x.astype(np.uint64).sum()) & _CANARY_MASK
+        return _canary_ref_memo
+
+
+# ----------------------------------------------------- state store
+class _State:
+    """All host-side numerics bookkeeping behind one lock. Device
+    scalars live in ``_pending`` until the NEXT note (or a drain)
+    publishes them — by then their computation has long completed, so
+    the read never stalls the step that produced them."""
+
+    def __init__(self):
+        from .registry import PercentileWindow
+        self._window_cls = PercentileWindow
+        self._lock = threading.Lock()
+        self._pending = collections.deque(maxlen=_PENDING_MAX)
+        self._serving: Dict[str, dict] = {}
+        self._train = {"steps": 0, "grad_norm": None,
+                       "grad_norm_ewma": None, "grad_norm_drift": None,
+                       "grad_finite_fraction": None, "loss_finite": None,
+                       "loss_scale": None}
+        self._shadow: Dict[Tuple[str, str], dict] = {}
+        self._int8: Dict[str, dict] = {}
+        self._canary = {"runs": 0, "failures": 0, "ok": None,
+                        "corrupt": False, "last": None}
+        self._anomalies = {"total": 0, "by_reason": {}, "last": None}
+
+    # -- deferred-publication queue
+    def push(self, entry: dict):
+        with self._lock:
+            self._pending.append(entry)
+            out = []
+            while len(self._pending) > 1:
+                out.append(self._pending.popleft())
+            return out
+
+    def pop_all(self):
+        with self._lock:
+            out = list(self._pending)
+            self._pending.clear()
+            return out
+
+    # -- per-domain updates (values are already host floats here)
+    def serving_update(self, kind, frac, max_abs, ent):
+        with self._lock:
+            rec = self._serving.setdefault(kind,
+                                           {"checks": 0, "anomalies": 0})
+            rec["checks"] += 1
+            rec["finite_fraction"] = frac
+            rec["max_abs"] = max_abs
+            rec["argmax_entropy"] = ent
+            rec["unix_ms"] = int(time.time() * 1e3)
+
+    def serving_anomaly(self, kind):
+        with self._lock:
+            rec = self._serving.setdefault(kind,
+                                           {"checks": 0, "anomalies": 0})
+            rec["anomalies"] += 1
+
+    def train_update(self, norm, frac, loss_finite, scale):
+        with self._lock:
+            t = self._train
+            t["steps"] += 1
+            t["grad_norm"] = norm
+            t["grad_finite_fraction"] = frac
+            t["loss_finite"] = bool(loss_finite >= 1.0)
+            if scale is not None:
+                t["loss_scale"] = scale
+            drift = t["grad_norm_drift"]
+            if math.isfinite(norm):
+                ewma = t["grad_norm_ewma"]
+                if ewma is None:
+                    drift = 0.0
+                    ewma = norm
+                else:
+                    drift = abs(norm - ewma) / max(abs(ewma), 1e-12)
+                    ewma = (1.0 - _EWMA_ALPHA) * ewma \
+                        + _EWMA_ALPHA * norm
+                t["grad_norm_ewma"] = ewma
+            t["grad_norm_drift"] = drift
+            return drift if drift is not None else 0.0
+
+    def shadow_update(self, kind, dtype, val):
+        with self._lock:
+            rec = self._shadow.get((kind, dtype))
+            if rec is None:
+                rec = {"count": 0, "last": 0.0, "max": 0.0,
+                       "window": self._window_cls(maxlen=_SHADOW_WINDOW)}
+                self._shadow[(kind, dtype)] = rec
+            rec["count"] += 1
+            rec["last"] = val
+            if math.isfinite(val):
+                rec["max"] = max(rec["max"], val)
+                rec["window"].observe(val)
+
+    def int8_update(self, kind, val):
+        with self._lock:
+            rec = self._int8.get(kind)
+            if rec is None:
+                rec = {"baseline": val, "last": val, "drift": 0.0,
+                       "notes": 0}
+                self._int8[kind] = rec
+            rec["notes"] += 1
+            rec["last"] = val
+            base = rec["baseline"]
+            rec["drift"] = abs(val - base) / max(abs(base), 1e-12)
+            return rec["drift"]
+
+    def canary_begin(self, ok: bool) -> bool:
+        """Counter + sticky-corrupt update; True when this failure
+        opens a NEW corruption episode (anomaly + quarantine fire once
+        per episode, not per sweep)."""
+        with self._lock:
+            c = self._canary
+            c["runs"] += 1
+            newly = (not ok) and not c["corrupt"]
+            if not ok:
+                c["failures"] += 1
+            c["ok"] = ok
+            c["corrupt"] = not ok
+            return newly
+
+    def canary_finish(self, res: dict):
+        with self._lock:
+            self._canary["last"] = dict(res)
+
+    def record_anomaly(self, kind, reason, trace_id, detail):
+        with self._lock:
+            a = self._anomalies
+            a["total"] += 1
+            a["by_reason"][reason] = a["by_reason"].get(reason, 0) + 1
+            a["last"] = {"kind": kind, "reason": reason,
+                         "trace_id": trace_id,
+                         "unix_ms": int(time.time() * 1e3),
+                         "detail": detail}
+
+    def payload(self) -> dict:
+        with self._lock:
+            shadow = {}
+            for (kind, dtype), rec in self._shadow.items():
+                snap = rec["window"].snapshot((50, 95, 99))
+                shadow[f"{kind}/{dtype}"] = {
+                    "count": rec["count"], "last": rec["last"],
+                    "max": rec["max"], "p50": snap["p50"],
+                    "p95": snap["p95"], "p99": snap["p99"]}
+            return {
+                "serving": {k: dict(v) for k, v in self._serving.items()},
+                "train": dict(self._train),
+                "shadow": shadow,
+                "int8": {k: dict(v) for k, v in self._int8.items()},
+                "canary": dict(self._canary),
+                "anomalies": {"total": self._anomalies["total"],
+                              "by_reason": dict(
+                                  self._anomalies["by_reason"]),
+                              "last": self._anomalies["last"]},
+                "pending": len(self._pending),
+            }
+
+
+_STATE_LOCK = threading.Lock()
+_state_obj: Optional[_State] = None
+
+
+def _state() -> _State:
+    global _state_obj
+    with _STATE_LOCK:
+        if _state_obj is None:
+            _state_obj = _State()
+        return _state_obj
+
+
+def reset_for_tests() -> None:
+    """Fresh state store + default RNG (metric families persist —
+    registration is get-or-create)."""
+    global _state_obj
+    with _STATE_LOCK:
+        _state_obj = _State()
+    set_rng_for_tests(None)
+
+
+# ------------------------------------------------------- anomalies
+def _emit_anomaly(kind: str, reason: str,
+                  detail: Optional[dict] = None) -> Optional[str]:
+    """One numerics anomaly: counter, tail-promoted error span in the
+    trace flight recorder, and the xstats anomaly hook (arm-gated +
+    rate-limited there, so a NaN storm spawns exactly one /profilez
+    capture). Returns the promoted trace id (None if tracing is
+    unavailable)."""
+    detail = {k: v for k, v in (detail or {}).items()
+              if isinstance(v, (str, int, float, bool)) or v is None}
+    try:
+        _get_metrics().anomalies.labels(kind=kind, reason=reason).inc()
+    except Exception:  # noqa: BLE001 - observability is garnish
+        pass
+    trace_id = None
+    try:
+        from . import tracing
+        ctx = tracing.new_context(sampled=True)
+        attrs = {"kind": kind, "reason": reason}
+        attrs.update(detail)
+        tracing.record_span(
+            ctx, f"numerics::{reason}", stage="numerics",
+            start_unix_ns=time.time_ns(), duration_ms=0.0,
+            attrs=attrs, status="error", root=True)
+        trace_id = ctx.trace_id
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from . import xstats
+        env = {"source": "numerics", "kind": kind, "reason": reason}
+        env.update(detail)
+        xstats.on_anomaly(env, trace_id)
+    except Exception:  # noqa: BLE001
+        pass
+    _state().record_anomaly(kind, reason, trace_id, detail)
+    return trace_id
+
+
+# ------------------------------------------------- publication path
+def _publish(entry: dict) -> None:
+    """Host-side publication of one queued entry (its device scalars
+    are from a PREVIOUS step and long since materialized)."""
+    try:
+        t = entry["type"]
+        if t == "serving":
+            _publish_serving(entry)
+        elif t == "train":
+            _publish_train(entry)
+        elif t == "shadow":
+            _publish_shadow(entry)
+        elif t == "int8":
+            _publish_int8(entry)
+    except Exception:  # noqa: BLE001 - a broken scalar must never
+        pass           # take down the path that enqueued it
+
+
+def _publish_serving(entry: dict) -> None:
+    kind = entry["kind"]
+    vals = np.asarray(entry["stats"], np.float64).reshape(-1)
+    frac, max_abs, ent = float(vals[0]), float(vals[1]), float(vals[2])
+    m = _get_metrics()
+    m.finite_fraction.labels(kind=kind).set(frac)
+    m.max_abs.labels(kind=kind).set(max_abs)
+    m.argmax_entropy.labels(kind=kind).set(ent)
+    _state().serving_update(kind, frac, max_abs, ent)
+    if not math.isfinite(frac) or frac < 1.0:
+        _state().serving_anomaly(kind)
+        _emit_anomaly(kind, "nonfinite",
+                      {"finite_fraction": frac, "max_abs": max_abs})
+
+
+def _publish_train(entry: dict) -> None:
+    vals = np.asarray(entry["stats"], np.float64).reshape(-1)
+    norm, frac, loss_finite = (float(vals[0]), float(vals[1]),
+                               float(vals[2]))
+    scale = entry.get("loss_scale")
+    if scale is not None:
+        scale = float(np.asarray(scale))
+    m = _get_metrics()
+    if math.isfinite(norm):
+        m.grad_norm.set(norm)
+    drift = _state().train_update(norm, frac, loss_finite, scale)
+    m.grad_norm_drift.set(drift)
+    if scale is not None:
+        m.loss_scale.set(scale)
+    m.finite_fraction.labels(kind="train").set(frac)
+    if not math.isfinite(norm) or frac < 1.0 or loss_finite < 1.0:
+        _emit_anomaly("train", "nonfinite",
+                      {"grad_norm_finite": math.isfinite(norm),
+                       "grad_finite_fraction": frac,
+                       "loss_finite": bool(loss_finite >= 1.0)})
+
+
+def _publish_shadow(entry: dict) -> None:
+    kind, dtype = entry["kind"], entry["dtype"]
+    val = float(np.asarray(entry["stats"]))
+    _get_metrics().shadow_divergence.labels(
+        kind=kind, dtype=dtype).set(val)
+    _state().shadow_update(kind, dtype, val)
+    if not math.isfinite(val):
+        _emit_anomaly(kind, "shadow_nonfinite", {"dtype": dtype})
+
+
+def _publish_int8(entry: dict) -> None:
+    kind = entry["kind"]
+    val = float(np.asarray(entry["stats"]))
+    drift = _state().int8_update(kind, val)
+    _get_metrics().int8_scale_drift.labels(kind=kind).set(drift)
+
+
+def _enqueue(entry: dict) -> None:
+    for e in _state().push(entry):
+        _publish(e)
+
+
+def drain() -> int:
+    """Publish every queued entry now (forces the deferred host reads
+    — tests and the /numericsz scrape call this; hot paths never do).
+    Returns the number of entries published."""
+    entries = _state().pop_all()
+    for e in entries:
+        _publish(e)
+    return len(entries)
+
+
+# ------------------------------------------------------- note APIs
+def note_serving_logits(kind: str, logits) -> None:
+    """Queue fixed-shape on-device health stats for one dispatch's
+    logits ([B, vocab] or [B, S, vocab]); the host read is deferred
+    one note (see ``_State``)."""
+    try:
+        stats = _logit_stats_fn()(logits)
+    except Exception:  # noqa: BLE001 - garnish
+        return
+    try:
+        _get_metrics().checks.labels(kind=kind).inc()
+    except Exception:  # noqa: BLE001
+        pass
+    _enqueue({"type": "serving", "kind": kind, "stats": stats})
+
+
+def note_train_step(stats, *, loss_scale=None) -> None:
+    """Queue one train step's in-graph health vector
+    ``[grad_norm, grad_finite_fraction, loss_is_finite]`` (device
+    scalars out of the fused step's reserved ``numerics`` output)."""
+    try:
+        _get_metrics().checks.labels(kind="train").inc()
+    except Exception:  # noqa: BLE001
+        pass
+    _enqueue({"type": "train", "stats": stats, "loss_scale": loss_scale})
+
+
+def note_shadow_divergence(kind: str, dtype: str, value) -> None:
+    """Queue one shadow-verified dispatch's max-abs logit divergence
+    vs the pure-JAX oracle; ``dtype`` labels the live KV regime
+    (``f32``/``int8``)."""
+    try:
+        _get_metrics().shadow_checks.labels(kind=kind,
+                                            dtype=dtype).inc()
+    except Exception:  # noqa: BLE001
+        pass
+    _enqueue({"type": "shadow", "kind": kind, "dtype": dtype,
+              "stats": value})
+
+
+def note_int8_scales(kind: str, k, v) -> None:
+    """Queue the mean |absmax scale| over the float scale planes of an
+    int8-quantized KV pool pytree — its drift against the first-seen
+    baseline is the live int8-vs-f32 health signal."""
+    try:
+        import jax
+        leaves = [a for a in jax.tree_util.tree_leaves((k, v))
+                  if np.issubdtype(np.dtype(a.dtype), np.floating)]
+        if not leaves:
+            return
+        s = _scale_summary_fn(len(leaves))(*leaves)
+    except Exception:  # noqa: BLE001 - garnish
+        return
+    _enqueue({"type": "int8", "kind": kind, "stats": s})
+
+
+# --------------------------------------------------------- canary
+def _record_canary(res: dict) -> None:
+    newly = _state().canary_begin(ok=bool(res.get("ok")))
+    m = _get_metrics()
+    try:
+        m.canary_runs.inc()
+        m.canary_ok.set(1.0 if res.get("ok") else 0.0)
+        if not res.get("ok"):
+            m.canary_failures.inc()
+    except Exception:  # noqa: BLE001
+        pass
+    if newly:
+        res["trace_id"] = _emit_anomaly(
+            "canary", "canary_failure",
+            {"name": res.get("name"), "got": res.get("got"),
+             "want": res.get("want"), "probe_ok":
+                 (res.get("probe") or {}).get("ok")
+                 if isinstance(res.get("probe"), dict) else None})
+    _state().canary_finish(res)
+
+
+def run_device_canary(record: bool = True) -> dict:
+    """One deterministic checksum sweep on the accelerator, compared
+    bit-exactly against the numpy golden twin. A mismatch IS silent
+    data corruption on this chip (the workload is integer-only — no
+    rounding freedom)."""
+    t0 = time.perf_counter()
+    got, err = None, None
+    try:
+        got = int(np.asarray(_canary_fn()())) & _CANARY_MASK
+    except Exception as e:  # noqa: BLE001 - a crashed sweep is a
+        err = repr(e)       # failure, not an exception to propagate
+    want = canary_reference()
+    res = {"ok": err is None and got == want, "got": got, "want": want,
+           "ms": (time.perf_counter() - t0) * 1e3,
+           "unix_ms": int(time.time() * 1e3)}
+    if err is not None:
+        res["error"] = err
+    if record:
+        _record_canary(res)
+    return res
+
+
+class CanaryRunner:
+    """Per-worker canary sweeps on a period and on not-ready→ready
+    transitions.
+
+    ``probe`` (a backend-supplied corruption self-check returning
+    ``{"ok": bool, ...}``) replaces the generic device checksum when
+    given — a stub backend has no accelerator to checksum but knows
+    how to round-trip its own arithmetic; a real backend gets the
+    device sweep. ``on_corrupt`` fires once per corruption episode
+    (quarantine wiring — readiness flip + breaker open — lives in
+    ``fleet.worker.arm_canary``)."""
+
+    def __init__(self, *, name: str = "", period_s: float = 0.0,
+                 probe: Optional[Callable[[], dict]] = None,
+                 ready_fn: Optional[Callable[[], bool]] = None,
+                 on_corrupt: Optional[Callable[[], None]] = None,
+                 device: Optional[bool] = None):
+        self.name = name
+        self.period_s = float(period_s)
+        self._probe = probe
+        self._ready_fn = ready_fn
+        self._on_corrupt = on_corrupt
+        self._device = (probe is None) if device is None else bool(device)
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._corrupt = False
+        self._fired = False
+        self._last: Optional[dict] = None
+
+    @property
+    def corrupt(self) -> bool:
+        with self._lock:
+            return self._corrupt
+
+    @property
+    def last(self) -> Optional[dict]:
+        with self._lock:
+            return self._last
+
+    def run_once(self) -> dict:
+        res = (run_device_canary(record=False) if self._device
+               else {"ok": True})
+        if self._probe is not None:
+            try:
+                p = self._probe()
+            except Exception as e:  # noqa: BLE001 - a crashed probe
+                p = {"ok": False, "error": repr(e)}   # is a failure
+            res = dict(res)
+            res["probe"] = p
+            res["ok"] = bool(res.get("ok", True)) and bool(p.get("ok"))
+        res.setdefault("unix_ms", int(time.time() * 1e3))
+        res["name"] = self.name
+        _record_canary(res)
+        fire = False
+        with self._lock:
+            self._last = res
+            if not res["ok"]:
+                self._corrupt = True
+                if not self._fired:
+                    self._fired = True
+                    fire = True
+            else:
+                # corruption cleared (e.g. chaos restore) — the NEXT
+                # episode must fire on_corrupt again
+                self._corrupt = False
+                self._fired = False
+        if fire and self._on_corrupt is not None:
+            try:
+                self._on_corrupt()
+            except Exception:  # noqa: BLE001 - quarantine wiring must
+                pass           # not kill the sweep loop
+        return res
+
+    def start(self) -> Optional["CanaryRunner"]:
+        if self.period_s <= 0.0:
+            return None
+        t = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"numerics-canary-{self.name or 'worker'}")
+        with self._lock:
+            self._thread = t
+        t.start()
+        return self
+
+    def _loop(self):
+        next_due = time.monotonic()     # first sweep right away
+        last_ready = None
+        while not self._stop.is_set():
+            ready = None
+            if self._ready_fn is not None:
+                try:
+                    ready = bool(self._ready_fn())
+                except Exception:  # noqa: BLE001
+                    ready = None
+            transition = ready is True and last_ready is False
+            if ready is not None:
+                last_ready = ready
+            if transition or time.monotonic() >= next_due:
+                try:
+                    self.run_once()
+                except Exception:  # noqa: BLE001 - keep sweeping
+                    pass
+                next_due = time.monotonic() + self.period_s
+            self._stop.wait(min(max(self.period_s, 0.01), 0.05))
+
+    def stop(self):
+        self._stop.set()
+        with self._lock:
+            t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+
+
+# ------------------------------------------------------- /numericsz
+def numericsz_payload() -> dict:
+    """The /numericsz document: knobs, per-kind serving health, train
+    health, shadow-divergence percentiles, int8 drift, canary state,
+    and the anomaly ledger (with the last promoted trace id). Scraping
+    drains the deferred-publication queue first."""
+    drain()
+    doc = _state().payload()
+    doc["enabled"] = enabled()
+    doc["rates"] = {
+        "tripwire": tripwire_rate(),
+        "shadow": shadow_rate(),
+        "check_nan_inf": bool(_flag("FLAGS_check_nan_inf", False)),
+        "canary_period_s": float(
+            _flag("FLAGS_numerics_canary_period_s", 0.0) or 0.0),
+    }
+    return doc

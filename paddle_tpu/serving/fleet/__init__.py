@@ -62,7 +62,8 @@ from .router import (FleetRouter, NoReadyReplicaError, ReplicaError,
 from .supervisor import (ProcessReplicaFactory, ReplicaSupervisor,
                          SubprocessReplica)
 from .worker import (PredictorBackend, ReplicaApp, StubBackend,
-                     ThreadReplicaFactory, arm_wedge_watchdog)
+                     ThreadReplicaFactory, arm_canary,
+                     arm_wedge_watchdog)
 
 __all__ = [
     "FleetRouter", "RouterApp", "ReplicaSupervisor",
@@ -71,5 +72,5 @@ __all__ = [
     "FleetMetrics", "merge_prometheus_texts", "NoReadyReplicaError",
     "ReplicaError", "codec", "resilience", "CircuitBreaker",
     "Deadline", "ReplicaWedgedError", "WedgeMonitor", "WedgeWatchdog",
-    "arm_wedge_watchdog",
+    "arm_wedge_watchdog", "arm_canary",
 ]

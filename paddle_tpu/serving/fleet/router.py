@@ -260,6 +260,7 @@ class FleetRouter:
             reps = list(self._replicas.values())
         for rep in reps:
             ready, alive, version = False, False, None
+            corrupt = False
             try:
                 with self._http(rep.url + "/readyz",
                                 timeout=max(
@@ -268,11 +269,13 @@ class FleetRouter:
                     body = json.loads(resp.read() or b"{}")
                     ready, alive = bool(body.get("ready")), True
                     version = body.get("version")
+                    corrupt = bool(body.get("corrupt"))
             except urllib.error.HTTPError as e:
                 alive = True            # it answered: alive, not ready
                 try:
-                    version = json.loads(
-                        e.read() or b"{}").get("version")
+                    body = json.loads(e.read() or b"{}")
+                    version = body.get("version")
+                    corrupt = bool(body.get("corrupt"))
                 except ValueError:
                     pass
             except Exception:  # noqa: BLE001 - unreachable = not live
@@ -282,6 +285,12 @@ class FleetRouter:
                     rep.ready, rep.alive = ready, alive
                     if version:
                         rep.version = version
+            if corrupt:
+                # SDC quarantine: the replica's canary caught silent
+                # corruption — not-ready alone still lets the breaker
+                # half-open probe traffic back in; force it open so
+                # nothing routes there until the episode clears
+                rep.breaker.force_open()
         self._update_state_gauges()
 
     def _update_state_gauges(self):
@@ -1174,6 +1183,47 @@ class FleetRouter:
                 "fleet": {"sites": fleet_sites,
                           "replicas_merged": len(replicas)}}
 
+    def merged_numericsz(self) -> dict:
+        """Fleet-wide ``/numericsz``: this process's numerics plane
+        plus every live replica's, keyed by replica id, with a fleet
+        rollup — total anomalies, canary failures, the corrupted
+        replica set, and the worst (lowest) finite fraction seen —
+        so one page answers "is anything on this fleet corrupting"."""
+        from ...observability import numerics
+        own = numerics.numericsz_payload()
+        replicas: Dict[str, dict] = {}
+        with self._lock:
+            reps = [(str(r.replica_id), r.url)
+                    for r in self._replicas.values() if r.alive]
+        for rid, url in reps:
+            try:
+                with self._http(url + "/numericsz",
+                                timeout=10.0) as resp:
+                    replicas[rid] = json.loads(resp.read())
+            except Exception:  # noqa: BLE001 - a scrape-dead replica
+                pass           # drops out of the merged view
+        anomalies = 0
+        canary_failures = 0
+        corrupt: List[str] = []
+        min_frac = 1.0
+        for rid, payload in replicas.items():
+            an = payload.get("anomalies") or {}
+            anomalies += int(an.get("total") or 0)
+            cn = payload.get("canary") or {}
+            canary_failures += int(cn.get("failures") or 0)
+            if cn.get("corrupt"):
+                corrupt.append(rid)
+            for s in (payload.get("serving") or {}).values():
+                f = s.get("finite_fraction")
+                if f is not None:
+                    min_frac = min(min_frac, float(f))
+        return {"router": own, "replicas": replicas,
+                "fleet": {"replicas_merged": len(replicas),
+                          "anomalies_total": anomalies,
+                          "canary_failures_total": canary_failures,
+                          "corrupt_replicas": sorted(corrupt),
+                          "min_finite_fraction": min_frac}}
+
     def merged_profilez(self, duration_ms: Optional[float] = None
                         ) -> dict:
         """Fleet-wide ``/profilez``: without a duration, every live
@@ -1334,6 +1384,10 @@ class _RouterHandler(BaseHTTPRequestHandler):
             elif path == "/execz":
                 self._send(200, json.dumps(
                     self._router.merged_execz(), sort_keys=True,
+                    default=str).encode())
+            elif path == "/numericsz":
+                self._send(200, json.dumps(
+                    self._router.merged_numericsz(), sort_keys=True,
                     default=str).encode())
             elif path == "/profilez":
                 from urllib.parse import parse_qs

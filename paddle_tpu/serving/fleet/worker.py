@@ -73,7 +73,7 @@ from .resilience import ReplicaWedgedError, WedgeMonitor, WedgeWatchdog
 
 __all__ = ["ReplicaApp", "PredictorBackend", "StubBackend",
            "ThreadReplicaFactory", "write_announce_file",
-           "read_announce_file", "arm_wedge_watchdog"]
+           "read_announce_file", "arm_wedge_watchdog", "arm_canary"]
 
 
 def _flag(name, default):
@@ -210,6 +210,40 @@ def arm_wedge_watchdog(backend, app: "ReplicaApp", *,
         return None
     app.watchdog = wd
     return wd.start()
+
+
+def arm_canary(backend, app: "ReplicaApp", *,
+               period_s: Optional[float] = None,
+               name: Optional[str] = None,
+               restart: bool = False):
+    """Attach the numerics SDC canary to this replica: a deterministic
+    checksum sweep per ``FLAGS_numerics_canary_period_s`` (and on
+    not-ready→ready transitions). Backends exposing ``canary_probe``
+    (the stub's corruption self-check) replace the generic device
+    sweep with it. On a corruption episode the replica quarantines
+    itself through the SAME path the wedge watchdog uses: readiness
+    flips red (``/readyz`` reports ``corrupt``), so the router's
+    poller opens the replica's breaker and drains it; with
+    ``restart``, the worker also exits for a supervisor respawn.
+    Returns the started runner or None when the period disables it."""
+    from ...observability.numerics import CanaryRunner
+    if period_s is None:
+        period_s = float(
+            _flag("FLAGS_numerics_canary_period_s", 0.0) or 0.0)
+    probe = getattr(backend, "canary_probe", None)
+
+    def _on_corrupt():
+        mark = getattr(backend, "mark_corrupt", None)
+        if mark is not None:
+            mark()
+        if restart:
+            app._request_shutdown()
+
+    runner = CanaryRunner(
+        name=name or tracing.process_name(), period_s=period_s,
+        probe=probe, ready_fn=backend.ready, on_corrupt=_on_corrupt)
+    app.canary = runner
+    return runner.start()
 
 
 # ---------------------------------------------------------------- backends
@@ -417,6 +451,12 @@ class StubBackend:
         self._alive = True
         self._wedged = threading.Event()
         self._hang = threading.Event()
+        # SDC emulation: "nan" poisons one output element per array,
+        # "bitflip" flips one mantissa bit — both silent (the request
+        # still succeeds; only the canary probe / numerics tripwires
+        # can tell). Set via /chaos, cleared by restore.
+        self._corrupt_mode: Optional[str] = None
+        self._quarantined = threading.Event()
         self._version = str(version)
         self._scale = self._scale_of(version)
         self.dispatches = 0
@@ -446,6 +486,47 @@ class StubBackend:
         """Watchdog hook: flip readiness red and wake every thread
         parked on the device lock with the typed error."""
         self._wedged.set()
+
+    def mark_corrupt(self):
+        """Canary quarantine hook (``arm_canary`` on_corrupt): flip
+        readiness red so the router drains this replica. Unlike a
+        wedge, in-flight work completes — corruption is silent, not
+        hung — and ``/chaos restore`` lifts the quarantine."""
+        self._quarantined.set()
+
+    @staticmethod
+    def _corrupt_array(a: np.ndarray, mode: str) -> np.ndarray:
+        a = np.array(a, np.float32, copy=True)
+        flat = a.ravel()
+        if flat.size:
+            if mode == "nan":
+                flat[0] = np.nan
+            elif mode == "bitflip":
+                bits = flat[:1].view(np.uint32)
+                bits ^= np.uint32(1 << 22)   # one mantissa bit
+        return a
+
+    def _apply_corruption(self, arrays):
+        with self._lock:
+            mode = self._corrupt_mode
+        if mode is None:
+            return arrays
+        return [self._corrupt_array(a, mode) for a in arrays]
+
+    def canary_probe(self) -> dict:
+        """Corruption self-check the canary runs instead of a device
+        checksum (there is no accelerator here): round-trip a known
+        vector through the SAME output path ``submit_many`` uses and
+        compare bit-exactly against the host-computed expectation."""
+        with self._lock:
+            scale = self._scale
+        probe = np.arange(8, dtype=np.float32)
+        got = self._apply_corruption([probe * scale])[0]
+        want = probe * scale
+        ok = got.tobytes() == want.tobytes()
+        return {"ok": ok,
+                "got_sum": float(np.nansum(got)),
+                "want_sum": float(want.sum())}
 
     def _maybe_hang(self, feeds_list):
         if self.hang_value is None:
@@ -517,8 +598,9 @@ class StubBackend:
             futs = []
             for feeds in feeds_list:
                 f = concurrent.futures.Future()
-                f.set_result([np.asarray(a, np.float32) * scale
-                              for a in feeds])
+                f.set_result(self._apply_corruption(
+                    [np.asarray(a, np.float32) * scale
+                     for a in feeds]))
                 futs.append(f)
             return futs
         finally:
@@ -557,25 +639,38 @@ class StubBackend:
         chaos harness drives): ``{"device_ms": X}`` inflates per-batch
         device latency (the slow-replica fault), ``{"capacity": N}``
         resizes the shed threshold (0 = reject storm),
-        ``{"hang": true}`` wedges the device, ``{"restore": true}``
-        lifts latency/capacity faults. Returns the live settings."""
+        ``{"hang": true}`` wedges the device,
+        ``{"corrupt": "nan"|"bitflip"}`` silently corrupts outputs
+        (the SDC-drill fault the canary must catch),
+        ``{"restore": true}`` lifts latency/capacity/corruption
+        faults. Returns the live settings."""
         with self._lock:
             if spec.get("restore"):
                 self.device_ms = float(spec.get(
                     "device_ms", self.device_ms))
                 self.queue_capacity = int(spec.get(
                     "capacity", self.queue_capacity))
+                self._corrupt_mode = None
             else:
                 if "device_ms" in spec:
                     self.device_ms = float(spec["device_ms"])
                 if "capacity" in spec:
                     self.queue_capacity = int(spec["capacity"])
+                if "corrupt" in spec:
+                    mode = spec["corrupt"]
+                    if mode not in (None, "nan", "bitflip"):
+                        raise ValueError(
+                            f"unknown corrupt mode {mode!r}")
+                    self._corrupt_mode = mode
+        if spec.get("restore"):
+            self._quarantined.clear()
         if spec.get("hang"):
             self._hang.set()
         return {"device_ms": self.device_ms,
                 "capacity": self.queue_capacity,
                 "hang": self._hang.is_set(),
-                "wedged": self._wedged.is_set()}
+                "wedged": self._wedged.is_set(),
+                "corrupt": self._corrupt_mode}
 
     def warmup(self) -> int:
         if self.warmup_s:
@@ -585,7 +680,7 @@ class StubBackend:
         return 0
 
     def ready(self) -> bool:
-        if self._wedged.is_set():
+        if self._wedged.is_set() or self._quarantined.is_set():
             return False
         with self._lock:
             return self._warmed and self._alive
@@ -692,6 +787,22 @@ class _ReplicaHandler(BaseHTTPRequestHandler):
                 from ...observability.httpd import profilez_response
                 code, body = profilez_response(query)
                 self._send(code, body.encode(), "application/json")
+            elif path == "/numericsz":
+                # this replica's numerics/SDC plane — the router's
+                # merged /numericsz rolls it up fleet-wide. THIS
+                # replica's canary runner overlays the process-global
+                # canary section: with several in-process replicas
+                # (ThreadReplicaFactory) the shared state would report
+                # the LAST sweep of any of them, not this one's.
+                from ...observability.httpd import numericsz_text
+                doc = json.loads(numericsz_text(query))
+                cn = getattr(self.server.app, "canary", None)
+                if cn is not None:
+                    doc["canary"] = dict(
+                        doc.get("canary") or {},
+                        corrupt=cn.corrupt, last=cn.last)
+                self._send(200, json.dumps(
+                    doc, sort_keys=True).encode(), "application/json")
             elif path == "/healthz":
                 ok, info = self._backend.health()
                 self._send_json(200 if ok else 503,
@@ -699,11 +810,18 @@ class _ReplicaHandler(BaseHTTPRequestHandler):
             elif path == "/readyz":
                 wd = getattr(self.server.app, "watchdog", None)
                 wedged = wd is not None and wd.wedged
-                ready = self._backend.ready() and not wedged
+                cn = getattr(self.server.app, "canary", None)
+                corrupt = cn is not None and cn.corrupt
+                ready = (self._backend.ready() and not wedged
+                         and not corrupt)
                 body = {"ready": ready,
                         "version": self._backend.info().get("version")}
                 if wedged:
                     body["wedged"] = True
+                if corrupt:
+                    # the router's poller opens this replica's breaker
+                    # on the flag — SDC quarantine, not just not-ready
+                    body["corrupt"] = True
                 self._send_json(200 if ready else 503, body)
             elif path == "/metrics":
                 from ...observability import (default_registry,
@@ -948,6 +1066,7 @@ class ReplicaApp:
         self._thread: Optional[threading.Thread] = None
         self._shutdown_requested = threading.Event()
         self.watchdog: Optional[WedgeWatchdog] = None
+        self.canary = None      # CanaryRunner via arm_canary
 
     @property
     def port(self) -> Optional[int]:
@@ -1078,6 +1197,9 @@ def _parse_args(argv):
     ap.add_argument("--wedge-timeout-ms", type=float, default=None,
                     help="device-wedge watchdog timeout (default: "
                          "FLAGS_fleet_wedge_timeout_ms; <= 0 off)")
+    ap.add_argument("--canary-period-s", type=float, default=None,
+                    help="numerics SDC canary sweep period (default: "
+                         "FLAGS_numerics_canary_period_s; <= 0 off)")
     return ap.parse_args(argv)
 
 
@@ -1123,6 +1245,9 @@ def main(argv=None) -> int:
     # readiness, fail device waiters, exit — the supervisor respawns
     arm_wedge_watchdog(backend, app,
                        timeout_ms=args.wedge_timeout_ms)
+    # the canary turns silent data corruption into the same bounded,
+    # observable failure: readiness red, router breaker open
+    arm_canary(backend, app, period_s=args.canary_period_s)
     if args.announce:
         write_announce_file(args.announce, app.port)
 

@@ -124,96 +124,125 @@ class CachedDecoder:
 
         page = self.page_size
         use_pallas = self.use_pallas
+        max_pos = self.max_positions
 
         from ...distributed.shard import constrain_batch
 
-        def _prefill(params, buffers, ids, prompt_lens, tables, k, v):
-            # unified-surface batch pin: under a dp serving mesh the
-            # prefill window shards by request row; meshless (the
-            # single-replica engine default) this is the identity
-            ids = constrain_batch(ids)
-            b, s = ids.shape
-            positions = jnp.broadcast_to(
-                jnp.arange(s, dtype=jnp.int32), (b, s))
-            valid = positions < prompt_lens[:, None]
-            cache = GPTKVCache(
-                "prefill", page,
-                jax.tree_util.tree_map(_wrap, k),
-                jax.tree_util.tree_map(_wrap, v),
-                _wrap(tables), _wrap(prompt_lens), _wrap(valid),
-                _wrap(positions), use_pallas=use_pallas)
-            logits, (k2, v2) = functional_call(
-                model, params, buffers, ids, cache=cache, training=False)
-            # only the last REAL position's logits leave the device
-            idx = jnp.clip(prompt_lens.astype(jnp.int32) - 1, 0, s - 1)
-            idx = jnp.broadcast_to(idx[:, None, None],
-                                   (b, 1, logits.shape[-1]))
-            last = jnp.take_along_axis(logits, idx, axis=1)[:, 0]
-            return last, k2, v2
+        def _make_fns(use_pallas):
+            # One closure set per kernel path. The real jits below bind
+            # the pinned ``self.use_pallas``; the shadow-verification
+            # oracle (observability.numerics) rebinds
+            # ``use_pallas=False`` to get the pure-JAX reference
+            # implementation without touching any dispatch state.
 
-        def _decode(params, buffers, tokens, positions, active, ctx,
-                    tables, k, v):
-            tokens = constrain_batch(tokens)
-            b = tokens.shape[0]
-            ids = tokens[:, None]
-            cache = GPTKVCache(
-                "decode", page,
-                jax.tree_util.tree_map(_wrap, k),
-                jax.tree_util.tree_map(_wrap, v),
-                _wrap(tables), _wrap(ctx), _wrap(active[:, None]),
-                _wrap(positions[:, None].astype(jnp.int32)),
-                use_pallas=use_pallas)
-            logits, (k2, v2) = functional_call(
-                model, params, buffers, ids, cache=cache, training=False)
-            return logits[:, 0], k2, v2
+            def _prefill(params, buffers, ids, prompt_lens, tables,
+                         k, v):
+                # unified-surface batch pin: under a dp serving mesh
+                # the prefill window shards by request row; meshless
+                # (the single-replica engine default) this is the
+                # identity
+                ids = constrain_batch(ids)
+                b, s = ids.shape
+                positions = jnp.broadcast_to(
+                    jnp.arange(s, dtype=jnp.int32), (b, s))
+                valid = positions < prompt_lens[:, None]
+                cache = GPTKVCache(
+                    "prefill", page,
+                    jax.tree_util.tree_map(_wrap, k),
+                    jax.tree_util.tree_map(_wrap, v),
+                    _wrap(tables), _wrap(prompt_lens), _wrap(valid),
+                    _wrap(positions), use_pallas=use_pallas)
+                logits, (k2, v2) = functional_call(
+                    model, params, buffers, ids, cache=cache,
+                    training=False)
+                # only the last REAL position's logits leave the device
+                idx = jnp.clip(prompt_lens.astype(jnp.int32) - 1, 0,
+                               s - 1)
+                idx = jnp.broadcast_to(idx[:, None, None],
+                                       (b, 1, logits.shape[-1]))
+                last = jnp.take_along_axis(logits, idx, axis=1)[:, 0]
+                return last, k2, v2
 
-        max_pos = self.max_positions
+            def _decode(params, buffers, tokens, positions, active,
+                        ctx, tables, k, v):
+                tokens = constrain_batch(tokens)
+                b = tokens.shape[0]
+                ids = tokens[:, None]
+                cache = GPTKVCache(
+                    "decode", page,
+                    jax.tree_util.tree_map(_wrap, k),
+                    jax.tree_util.tree_map(_wrap, v),
+                    _wrap(tables), _wrap(ctx), _wrap(active[:, None]),
+                    _wrap(positions[:, None].astype(jnp.int32)),
+                    use_pallas=use_pallas)
+                logits, (k2, v2) = functional_call(
+                    model, params, buffers, ids, cache=cache,
+                    training=False)
+                return logits[:, 0], k2, v2
 
-        def _chunked(params, buffers, ids, start, seg_lens, tables,
-                     k, v):
-            # suffix prefill / speculative verify window: per-row
-            # starting positions; attention reaches the cached prefix
-            # through the block tables (kind="chunked"). Returns ALL
-            # window logits [B, S, vocab].
-            ids = constrain_batch(ids)
-            b, s = ids.shape
-            offs = jnp.arange(s, dtype=jnp.int32)[None, :]
-            positions = start.astype(jnp.int32)[:, None] + offs
-            # positions past the model's addressable range (a verify
-            # window overhanging the budget) write to the trash page
-            # and mask themselves out; their logits are garbage the
-            # host never consumes
-            valid = (offs < seg_lens[:, None]) & (positions < max_pos)
-            ctx = (start + seg_lens).astype(jnp.int32)
-            cache = GPTKVCache(
-                "chunked", page,
-                jax.tree_util.tree_map(_wrap, k),
-                jax.tree_util.tree_map(_wrap, v),
-                _wrap(tables), _wrap(ctx), _wrap(valid),
-                _wrap(positions), use_pallas=use_pallas)
-            logits, (k2, v2) = functional_call(
-                model, params, buffers, ids, cache=cache, training=False)
-            return logits, k2, v2
+            def _chunked(params, buffers, ids, start, seg_lens, tables,
+                         k, v):
+                # suffix prefill / speculative verify window: per-row
+                # starting positions; attention reaches the cached
+                # prefix through the block tables (kind="chunked").
+                # Returns ALL window logits [B, S, vocab].
+                ids = constrain_batch(ids)
+                b, s = ids.shape
+                offs = jnp.arange(s, dtype=jnp.int32)[None, :]
+                positions = start.astype(jnp.int32)[:, None] + offs
+                # positions past the model's addressable range (a
+                # verify window overhanging the budget) write to the
+                # trash page and mask themselves out; their logits are
+                # garbage the host never consumes
+                valid = (offs < seg_lens[:, None]) & (positions < max_pos)
+                ctx = (start + seg_lens).astype(jnp.int32)
+                cache = GPTKVCache(
+                    "chunked", page,
+                    jax.tree_util.tree_map(_wrap, k),
+                    jax.tree_util.tree_map(_wrap, v),
+                    _wrap(tables), _wrap(ctx), _wrap(valid),
+                    _wrap(positions), use_pallas=use_pallas)
+                logits, (k2, v2) = functional_call(
+                    model, params, buffers, ids, cache=cache,
+                    training=False)
+                return logits, k2, v2
 
-        def _prefill_chunked(params, buffers, ids, start, seg_lens,
-                             tables, k, v):
-            logits, k2, v2 = _chunked(params, buffers, ids, start,
-                                      seg_lens, tables, k, v)
-            b, s = ids.shape
-            idx = jnp.clip(seg_lens.astype(jnp.int32) - 1, 0, s - 1)
-            idx = jnp.broadcast_to(idx[:, None, None],
-                                   (b, 1, logits.shape[-1]))
-            last = jnp.take_along_axis(logits, idx, axis=1)[:, 0]
-            return last, k2, v2
+            def _prefill_chunked(params, buffers, ids, start, seg_lens,
+                                 tables, k, v):
+                logits, k2, v2 = _chunked(params, buffers, ids, start,
+                                          seg_lens, tables, k, v)
+                b, s = ids.shape
+                idx = jnp.clip(seg_lens.astype(jnp.int32) - 1, 0, s - 1)
+                idx = jnp.broadcast_to(idx[:, None, None],
+                                       (b, 1, logits.shape[-1]))
+                last = jnp.take_along_axis(logits, idx, axis=1)[:, 0]
+                return last, k2, v2
+
+            return {"prefill": _prefill, "decode": _decode,
+                    "chunked": _prefill_chunked, "verify": _chunked}
+
+        self._make_fns = _make_fns
+        fns = _make_fns(use_pallas)
 
         donate_pf = (5, 6) if self._donate else ()
         donate_dc = (7, 8) if self._donate else ()
         donate_ck = (6, 7) if self._donate else ()
-        self._prefill_jit = jax.jit(_prefill, donate_argnums=donate_pf)
-        self._decode_jit = jax.jit(_decode, donate_argnums=donate_dc)
-        self._chunked_jit = jax.jit(_prefill_chunked,
+        self._prefill_jit = jax.jit(fns["prefill"],
+                                    donate_argnums=donate_pf)
+        self._decode_jit = jax.jit(fns["decode"],
+                                   donate_argnums=donate_dc)
+        self._chunked_jit = jax.jit(fns["chunked"],
                                     donate_argnums=donate_ck)
-        self._verify_jit = jax.jit(_chunked, donate_argnums=donate_ck)
+        self._verify_jit = jax.jit(fns["verify"],
+                                   donate_argnums=donate_ck)
+        # shadow-verification support (observability.numerics): oracle
+        # jits re-trace the SAME closures with use_pallas=False and NO
+        # donation — the oracle runs strictly before the real call so
+        # the donated operands are still alive when it reads them.
+        # Built lazily: zero cost until the first sampled shadow.
+        self._oracle_fns = None
+        self._oracle_jits: Dict[str, object] = {}
+        self._div_jit = None
 
     def refresh_params(self):
         """Re-snapshot the model's current parameter arrays (they are
@@ -334,15 +363,74 @@ class CachedDecoder:
         except Exception:  # noqa: BLE001 - never break a decode step
             pass
 
+    # ------------------------------------------- numerics tripwires
+    _ORACLE_KEYS = {"generate_decode": "decode",
+                    "generate_chunked": "chunked",
+                    "generate_verify": "verify"}
+
+    def _oracle_jit(self, site: str):
+        """Non-donating pure-JAX jit for a shadow-verified site, built
+        from the same closure factory as the real entry points but
+        with ``use_pallas=False`` (the reference implementation)."""
+        fn = self._oracle_jits.get(site)
+        if fn is None:
+            import jax
+            if self._oracle_fns is None:
+                self._oracle_fns = self._make_fns(False)
+            fn = jax.jit(self._oracle_fns[self._ORACLE_KEYS[site]])
+            self._oracle_jits[site] = fn
+        return fn
+
+    def _divergence_fn(self):
+        if self._div_jit is None:
+            import jax
+            import jax.numpy as jnp
+            self._div_jit = jax.jit(
+                lambda a, b: jnp.max(jnp.abs(
+                    a.astype(jnp.float32) - b.astype(jnp.float32))))
+        return self._div_jit
+
+    def _numerics_shadow(self, site: str, args):
+        """Sampled shadow re-execution through the pure-JAX oracle.
+        MUST run before the real (possibly donating) call: the oracle
+        jit never donates, and enqueue order guarantees it reads the
+        pools before the real executable consumes them."""
+        try:
+            from ...observability import numerics
+            if site not in numerics.SHADOW_SITES:
+                return None
+            if not numerics.sample_decision(numerics.shadow_rate()):
+                return None
+            return self._oracle_jit(site)(*args)
+        except Exception:  # noqa: BLE001 - observability is garnish
+            return None
+
+    def _numerics_note(self, site: str, out, shadow_out):
+        try:
+            from ...observability import numerics
+            kind = site[len("generate_"):]
+            if shadow_out is not None:
+                div = self._divergence_fn()(out[0], shadow_out[0])
+                numerics.note_shadow_divergence(
+                    kind, self.kv_dtype or "f32", div)
+            if numerics.sample_decision(numerics.tripwire_rate()):
+                numerics.note_serving_logits(kind, out[0])
+                if self.kv_dtype == "int8":
+                    numerics.note_int8_scales(kind, out[1], out[2])
+        except Exception:  # noqa: BLE001 - never break a decode step
+            pass
+
     def _dispatch(self, site: str, jitted, args) -> Tuple[object, bool]:
         """Returns ``(outputs, was_new_signature)``."""
         sig = (site,) + self._sig_of(args)
         fresh = sig not in self.compiled_signatures
         self.compiled_signatures.add(sig)
+        shadow_out = self._numerics_shadow(site, args)
         aot = self._aot_exec(site, jitted, args)
         fn = aot or jitted
         out = fn(*args)
         self._xstats_note(site, sig, jitted, args, aot is not None)
+        self._numerics_note(site, out, shadow_out)
         return out, fresh
 
     def prefill(self, ids: np.ndarray, prompt_lens: np.ndarray,

@@ -515,7 +515,8 @@ class TestFleetSurfaces:
 
 # ================================================= endpoint conformance
 _SURFACES = ("/metrics", "/healthz", "/readyz", "/statusz", "/tracez",
-             "/goodputz", "/sloz", "/schedz", "/execz", "/profilez")
+             "/goodputz", "/sloz", "/schedz", "/execz", "/profilez",
+             "/numericsz")
 
 
 class TestEndpointConformance:

@@ -28,6 +28,14 @@ assumed:
                    rejected AT THE WORKER without a device dispatch
                    (the stub's dispatch counter proves it), and the
                    router fails over-budget requests locally
+  numerics         /chaos silently corrupts one replica's outputs
+                   (NaN poison, then a single bit flip on another):
+                   the SDC canary catches both, the replica
+                   quarantines itself (/readyz corrupt -> router
+                   breaker forced open), the anomaly promotes an
+                   error span and triggers exactly one rate-limited
+                   /profilez capture carrying the trace id, healthy
+                   traffic never stops, and /chaos restore re-admits
 
 Plus a paired HEDGE experiment: the same load over a {1 slow, 1 fast}
 fleet with hedging off vs on — hedged p99 must beat un-hedged p99,
@@ -73,6 +81,14 @@ def _post(url, obj, timeout=10.0):
         url, data=json.dumps(obj).encode(),
         headers={"Content-Type": "application/json"})
     with opener.open(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _get(url, timeout=10.0):
+    import urllib.request
+    opener = urllib.request.build_opener(
+        urllib.request.ProxyHandler({}))
+    with opener.open(url, timeout=timeout) as resp:
         return json.loads(resp.read())
 
 
@@ -168,8 +184,14 @@ def run_chaos(wedge_timeout_ms=4000.0, verbose=True):
                     "--stub-crash-value", str(CRASH_VALUE),
                     "--stub-crash-mode", "exit",
                     "--stub-hang-value", str(HANG_VALUE),
-                    "--wedge-timeout-ms", str(wedge_timeout_ms)],
-        env={"JAX_PLATFORMS": "cpu"})
+                    "--wedge-timeout-ms", str(wedge_timeout_ms),
+                    "--canary-period-s", "0.2"],
+        env={"JAX_PLATFORMS": "cpu",
+             # the numerics drill's anomaly -> profile capture path:
+             # armed, un-throttled, and short enough to observe
+             "FLAGS_profile_on_anomaly": "1",
+             "FLAGS_profile_min_interval_s": "0",
+             "FLAGS_profile_anomaly_ms": "20"})
     sup = fleet.ReplicaSupervisor(fac, 3, restart_backoff_ms=50)
     sup.start()
     router = fleet.FleetRouter(
@@ -182,6 +204,7 @@ def run_chaos(wedge_timeout_ms=4000.0, verbose=True):
     watchdog_rec = {}
     breaker_rec = {"opened": False, "reclosed": False, "opens": 0}
     deadline_rec = {}
+    numerics_rec = {}
     try:
         assert router.wait_ready(3, timeout=120), \
             f"fleet never came up: {router.replica_states()}"
@@ -277,6 +300,84 @@ def run_chaos(wedge_timeout_ms=4000.0, verbose=True):
                        "requests_during": during,
                        "absorbed": during.get("lost", 0) == 0})
 
+        # ---- fault 6: silent data corruption (SDC drill) ----------
+        # two corruption classes, each on a different replica: a NaN
+        # poison (the tripwires' target) and a single mantissa bit
+        # flip (the canary's — a checksum-only failure no finiteness
+        # check can see). Detection must quarantine the replica
+        # (readyz corrupt -> breaker forced open), promote an anomaly
+        # span, and trigger exactly one /profilez capture carrying
+        # the promoted trace id; restore must re-admit.
+        def _sdc_drill(mode, rid, url):
+            lost_before = load.counts["lost"]
+            t0 = time.monotonic()
+            _post(url + "/chaos", {"corrupt": mode})
+
+            def _quarantined():
+                states = {s["replica"]: s
+                          for s in router.replica_states()}
+                s = states.get(str(rid), {})
+                return (not s.get("ready", True)
+                        and s.get("breaker", {}).get("state")
+                        == "open")
+            quarantined = _wait(_quarantined, timeout=30)
+            detect_s = time.monotonic() - t0
+            nz = _get(url + "/numericsz")
+            canary = nz.get("canary") or {}
+            trace_id = ((nz.get("anomalies") or {}).get("last")
+                        or {}).get("trace_id")
+            detected = bool(canary.get("corrupt")
+                            and canary.get("failures", 0) >= 1
+                            and trace_id)
+
+            def _anomaly_capture():
+                pz = _get(url + "/profilez")
+                return [a for a in (pz.get("artifacts") or [])
+                        if a.get("reason") == "anomaly"
+                        and a.get("trace_id") == trace_id]
+            captured = _wait(lambda: bool(_anomaly_capture()),
+                             timeout=30)
+            captures = _anomaly_capture()
+            _post(url + "/chaos", {"restore": True})
+            readmitted = _wait(
+                lambda: len(router._routable()) >= 3, timeout=60)
+            return {
+                "mode": mode, "replica": str(rid),
+                "detected": detected,
+                "quarantined": bool(quarantined),
+                "detect_s": round(detect_s, 2),
+                "anomaly_trace_id": trace_id,
+                "anomaly_capture": bool(captured),
+                "anomaly_captures_seen": len(captures),
+                "readmitted": bool(readmitted),
+                "lost_during": load.counts["lost"] - lost_before,
+            }
+
+        log("fault: numerics (NaN poison -> canary quarantine)")
+        eps = sup.endpoints()
+        ordered = sorted(eps.items())
+        nan_rec = _sdc_drill("nan", *ordered[0])
+        log("fault: numerics (KV bit flip -> canary quarantine)")
+        flip_rec = _sdc_drill("bitflip", *ordered[1])
+        numerics_rec = {
+            "nan": nan_rec, "bitflip": flip_rec,
+            "nan_detected": nan_rec["detected"]
+            and nan_rec["quarantined"],
+            "bitflip_detected": flip_rec["detected"]
+            and flip_rec["quarantined"],
+            "anomaly_capture": bool(nan_rec["anomaly_capture"]
+                                    and flip_rec["anomaly_capture"]),
+            "zero_lost": (nan_rec["lost_during"] == 0
+                          and flip_rec["lost_during"] == 0),
+            "recovered": bool(nan_rec["readmitted"]
+                              and flip_rec["readmitted"]),
+        }
+        faults.append(dict(numerics_rec, fault="numerics"))
+        assert numerics_rec["nan_detected"], \
+            f"NaN corruption went undetected: {nan_rec}"
+        assert numerics_rec["bitflip_detected"], \
+            f"bit flip went undetected: {flip_rec}"
+
         time.sleep(0.5)     # post-fault healthy traffic
         load.stop()
 
@@ -346,6 +447,7 @@ def run_chaos(wedge_timeout_ms=4000.0, verbose=True):
             "watchdog": watchdog_rec,
             "breaker": breaker_rec,
             "deadline": deadline_rec,
+            "numerics": numerics_rec,
             "invariants": {
                 "zero_non_riding_lost": load.counts["lost"] == 0,
                 "accounting_closes": accounted == total,
@@ -439,6 +541,9 @@ def run(out=None, wedge_timeout_ms=4000.0, verbose=True):
         f"watchdog recovery blew the bound: {chaos['watchdog']}"
     assert chaos["breaker"]["cycle_observed"], \
         f"no breaker cycle: {chaos['breaker']}"
+    nrec = chaos["numerics"]
+    assert nrec["nan_detected"] and nrec["bitflip_detected"], \
+        f"SDC drill failed: {nrec}"
     record = {
         "bench": "chaos_fleet",
         "metric": "fleet_chaos_resilience",
@@ -448,12 +553,13 @@ def run(out=None, wedge_timeout_ms=4000.0, verbose=True):
         "unit": "fraction",
         "vs_baseline": round(inv["goodput"] / GOODPUT_FLOOR, 4),
         "fault_classes": ["crash", "hang", "slow_replica",
-                          "reject_storm", "expired_deadline"],
+                          "reject_storm", "expired_deadline",
+                          "numerics"],
         "hedge": hedge,
         "elapsed_s": round(time.time() - t_start, 1),
         **{k: chaos[k] for k in ("replicas", "load", "faults",
                                  "watchdog", "breaker", "deadline",
-                                 "invariants")},
+                                 "numerics", "invariants")},
     }
     if out:
         with open(out, "w") as f:

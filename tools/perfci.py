@@ -215,6 +215,52 @@ GATES: List[Dict[str, Any]] = [
      "op": "true",
      "why": "duplicate-execution accounting must close: hedges won "
             "and wasted are both bounded by hedges fired (PR 15)"},
+    {"name": "numerics_overhead_pct", "metric": "numerics_overhead",
+     "files": "NUMERICS_r*.json",
+     "path": ("overhead", "serving", "regression_pct"),
+     "op": "max", "baseline": 0.0, "abs_tol": 3.0, "unit": "%",
+     "why": "sampled NaN/Inf tripwires + shadow-verification at "
+            "production duty cycle (2% / 0.5%) must not tax the "
+            "decode hot path (PR 18; paired-trial trimmed mean, "
+            "r01: 0.88%)"},
+    {"name": "numerics_drill_detects", "metric": "numerics_overhead",
+     "files": "NUMERICS_r*.json", "path": ("drill", "nan_detected"),
+     "op": "true",
+     "why": "a forced-NaN step must fire exactly one nonfinite "
+            "anomaly with a promoted trace id while a healthy step "
+            "fires none (PR 18)"},
+    {"name": "numerics_drill_capture", "metric": "numerics_overhead",
+     "files": "NUMERICS_r*.json", "path": ("drill", "anomaly_capture"),
+     "op": "true",
+     "why": "the anomaly must trigger exactly one rate-limited "
+            "/profilez capture carrying the anomaly's trace id "
+            "(PR 18)"},
+    {"name": "numerics_canary_golden", "metric": "numerics_overhead",
+     "files": "NUMERICS_r*.json", "path": ("canary", "golden_match"),
+     "op": "true",
+     "why": "the deterministic device canary checksum must match its "
+            "numpy golden twin bit-exactly — a mismatch IS silent "
+            "data corruption (PR 18)"},
+    {"name": "chaos_sdc_nan_detected",
+     "metric": "fleet_chaos_resilience",
+     "files": "CHAOS_r*.json", "path": ("numerics", "nan_detected"),
+     "op": "true",
+     "why": "an injected NaN-producing replica must be caught by its "
+            "canary, quarantined (readyz 503 + breaker forced open) "
+            "and readmitted after restore (PR 18)"},
+    {"name": "chaos_sdc_bitflip_detected",
+     "metric": "fleet_chaos_resilience",
+     "files": "CHAOS_r*.json",
+     "path": ("numerics", "bitflip_detected"),
+     "op": "true",
+     "why": "a single flipped mantissa bit — silent to sums — must "
+            "still be caught by the bit-exact canary round-trip and "
+            "quarantine the replica (PR 18)"},
+    {"name": "chaos_sdc_zero_lost", "metric": "fleet_chaos_resilience",
+     "files": "CHAOS_r*.json", "path": ("numerics", "zero_lost"),
+     "op": "true",
+     "why": "quarantining a corrupt replica must not fail foreground "
+            "traffic — the router re-routes around it (PR 18)"},
     {"name": "chaos_goodput", "metric": "fleet_chaos_resilience",
      "files": "CHAOS_r*.json", "path": ("value",),
      "op": "min", "baseline": 0.90, "rel_tol": 0.0,
