@@ -36,7 +36,19 @@ taint lattice.
   (``urlopen``, ``socket.create_connection``, ``HTTPConnection``,
   opener ``.open``) without an explicit timeout inside
   ``paddle_tpu/serving/`` — an unbounded wait on a wedgeable peer
-  defeats the fleet's deadline/watchdog resilience (TD001).
+  defeats the fleet's deadline/watchdog resilience (TD001);
+- ``LockOrderAnalyzer`` — the *system* of locks: a global
+  acquisition-order graph over the repo-wide call graph, reporting
+  order-inversion cycles (LD001), blocking I/O / subprocess / device
+  sync while a lock is held (LD002), and ``Condition.wait`` outside a
+  predicate loop (LD003).
+
+The runtime twin of ``LockOrderAnalyzer`` is ``analysis.sanitizer``
+(lockdep): opt-in instrumented ``Lock``/``RLock``/``Condition`` that
+observe the order the running program actually uses, raise on the
+first observed inversion, and bridge into the same Finding/SARIF
+pipeline via ``sanitizer.findings()`` (enabled under tier-1 with
+``FLAGS_lockdep``).
 
 Entry points: ``tools/pdlint.py`` (CLI: text/JSON/SARIF, git-aware
 ``--changed-only``, baseline ratchet, exit codes) and
@@ -51,13 +63,15 @@ import os
 from typing import Dict, List, Optional, Sequence
 
 from .core import (Analyzer, Finding, SourceFile, baseline_entry,
-                   changed_files, filter_new, in_scope,
-                   iter_python_files, load_baseline, parse_files,
-                   run_analyzers, stale_entries, to_sarif,
-                   write_baseline)
+                   changed_files, clear_run_cache, filter_new,
+                   in_scope, iter_python_files, load_baseline,
+                   parse_files, run_analyzers, stale_entries,
+                   to_sarif, write_baseline)
 from .donation_safety import DonationSafetyAnalyzer
 from .flag_consistency import FlagConsistencyAnalyzer
 from .lock_discipline import LockDisciplineAnalyzer
+from .lock_order import (LockOrderAnalyzer, LockOrderGraph,
+                         build_lock_graph)
 from .metric_discipline import MetricDisciplineAnalyzer
 from .recompile_risk import RecompileRiskAnalyzer
 from .resource_pairing import ResourcePairingAnalyzer
@@ -70,11 +84,13 @@ __all__ = [
     "LockDisciplineAnalyzer", "MetricDisciplineAnalyzer",
     "DonationSafetyAnalyzer", "RecompileRiskAnalyzer",
     "ResourcePairingAnalyzer", "TimeoutDisciplineAnalyzer",
+    "LockOrderAnalyzer", "LockOrderGraph", "build_lock_graph",
     "all_analyzers", "analyzer_names", "default_paths", "repo_root",
     "default_baseline_path", "run_project",
     "iter_python_files", "parse_files", "run_analyzers",
     "load_baseline", "write_baseline", "filter_new", "baseline_entry",
     "stale_entries", "to_sarif", "changed_files", "in_scope",
+    "clear_run_cache",
 ]
 
 
@@ -82,7 +98,8 @@ def all_analyzers() -> List[Analyzer]:
     return [TracerSafetyAnalyzer(), FlagConsistencyAnalyzer(),
             LockDisciplineAnalyzer(), MetricDisciplineAnalyzer(),
             DonationSafetyAnalyzer(), RecompileRiskAnalyzer(),
-            ResourcePairingAnalyzer(), TimeoutDisciplineAnalyzer()]
+            ResourcePairingAnalyzer(), TimeoutDisciplineAnalyzer(),
+            LockOrderAnalyzer()]
 
 
 def analyzer_names() -> List[str]:

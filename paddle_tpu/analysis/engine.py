@@ -65,16 +65,29 @@ def iter_own_body(func_node):
     """Pre-order, SOURCE-ORDER walk of one function's own body (taint
     propagation needs assignments before later uses). Nested defs and
     lambdas are separate call-graph nodes, not descended into. Accepts
-    defs (``.body`` is a list) and lambdas (``.body`` is an expr)."""
+    defs (``.body`` is a list) and lambdas (``.body`` is an expr).
+
+    Every analyzer re-walks the same bodies, so the flattened node
+    list is cached on the def node itself — AST nodes carry a
+    ``__dict__``, and the tree outlives any analyzer pass."""
+    cached = getattr(func_node, "_pdlint_own_body", None)
+    if cached is not None:
+        return cached
     body = func_node.body
+    out = []
     queue = deque(body if isinstance(body, list) else [body])
     while queue:
         n = queue.popleft()
-        yield n
+        out.append(n)
         if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
                           ast.Lambda)):
             continue
         queue.extendleft(reversed(list(ast.iter_child_nodes(n))))
+    try:
+        func_node._pdlint_own_body = out
+    except (AttributeError, TypeError):    # e.g. a slotted fake node
+        pass
+    return out
 
 
 def head_exprs(stmt: ast.AST) -> List[ast.AST]:
@@ -258,8 +271,37 @@ class ModuleInfo:
                                       qual.split(".")[-1])] = qual
 
 
+# CallGraph.shared(): one run_analyzers pass hands the SAME parsed
+# SourceFile objects to every analyzer, and three analyzers
+# (recompile_risk, tracer_safety, lock_order) each need the repo call
+# graph — building it once per parse instead of once per analyzer cuts
+# a full pdlint run by roughly a third.  Keyed on the identity of the
+# SourceFile objects; each entry keeps strong references so the ids
+# stay valid for the life of the entry.
+_SHARED_GRAPHS: list = []
+_SHARED_GRAPHS_MAX = 2
+
+
+def clear_shared_graphs():
+    _SHARED_GRAPHS.clear()
+
+
 class CallGraph:
     """Repo-wide call graph over a set of parsed SourceFiles."""
+
+    @classmethod
+    def shared(cls, files: Sequence[SourceFile]) -> "CallGraph":
+        """Memoized constructor for analyzers running over the same
+        parse (see module note above)."""
+        flist = [sf for sf in files if sf.tree is not None]
+        key = tuple(id(sf) for sf in flist)
+        for k, _refs, g in _SHARED_GRAPHS:
+            if k == key:
+                return g
+        g = cls(flist)
+        _SHARED_GRAPHS.append((key, flist, g))
+        del _SHARED_GRAPHS[:len(_SHARED_GRAPHS) - _SHARED_GRAPHS_MAX]
+        return g
 
     def __init__(self, files: Sequence[SourceFile]):
         self.modules: Dict[str, ModuleInfo] = {}   # rel -> info
@@ -774,7 +816,15 @@ def jit_entries(cg: CallGraph) -> List[Tuple[Tuple[str, str], str]]:
     functions, functions named ``train_step``, and functions passed to
     a jit wrapper at a call site (``jax.jit(fn)``, ``jit(self.step)``,
     ``jit(partial(step, ...))``). Marks ``FuncNode.entry_via`` and
-    returns ``[(key, via)]`` roots for ``CallGraph.reachable``."""
+    returns ``[(key, via)]`` roots for ``CallGraph.reachable``.
+
+    The scan marks nodes as it goes (``mark`` skips already-marked
+    functions), so a second pass over the same graph would see nothing
+    — the roots are cached on the graph so every analyzer sharing it
+    gets the same answer."""
+    cached = getattr(cg, "_jit_entries", None)
+    if cached is not None:
+        return list(cached)
     roots: List[Tuple[Tuple[str, str], str]] = []
 
     def mark(fn: FuncNode, via: str):
@@ -816,4 +866,5 @@ def jit_entries(cg: CallGraph) -> List[Tuple[Tuple[str, str], str]]:
                 for q in mi.by_last.get(tgt.attr, ()):
                     if mi.funcs[q].is_method:
                         mark(mi.funcs[q], via)
+    cg._jit_entries = list(roots)
     return roots

@@ -1,0 +1,715 @@
+"""Lock-order analyzer: the system of locks, not just each lock.
+
+``lock_discipline`` (LK001-3) checks that individual attributes are
+guarded; nothing checked that the *collection* of locks the serving
+stack now carries (router + breakers, supervisor, engine Condition,
+autoscaler, watchdogs, telemetry handlers) is deadlock-free, or that
+no thread blocks on I/O while holding one. This analyzer is the
+static half of the PR 19 lockdep pair (``analysis.sanitizer`` is the
+runtime half): it computes lock-acquisition paths through the PR 14
+repo-wide call graph, builds a global lock-order graph keyed by
+``(module, attr)`` lock identity, and reports:
+
+  LD001  lock-order inversion: a cycle in the observed acquisition-
+         order graph (some path acquires A then B, another B then A)
+         — a potential deadlock the moment both paths run
+         concurrently.
+  LD002  blocking call while a lock is held — socket/HTTP I/O
+         (``urlopen``, opener ``.open``, ``create_connection``),
+         ``subprocess`` spawn/wait, timeout-less ``queue.get()`` /
+         ``Future.result()`` / ``.wait()`` / ``.join()``, and device
+         sync (``.block_until_ready()``, ``jax.device_get``).
+         Interprocedural: a helper reached from a ``with self._lock:``
+         body is analyzed as lock-held; ``threading.Thread(target=)``
+         and ``functools.partial`` hand-offs do NOT propagate the held
+         set (the target runs on its own thread / later).
+  LD003  ``Condition.wait`` outside a predicate loop — a spurious or
+         stolen wakeup silently breaks the invariant the wait was
+         guarding (``wait_for`` supplies its own loop and is clean).
+
+Lock identity is syntactic and deliberately per-owner: a ``with
+self._lock:`` in class ``C`` of module ``m`` is the lock ``(m,
+"C._lock")``; module-level locks are ``(m, NAME)`` and follow
+imports. Two classes sharing one runtime lock object get distinct
+identities — that can MISS an inversion (the runtime sanitizer's
+job) but never invents one. Acquisitions counted are ``with`` blocks;
+bare ``.acquire()`` pairing is resource_pairing's RP002.
+
+One resolution extension over the engine: an unresolved
+``self.attr(...)`` call resolves to the unique same-module
+``__call__`` method when exactly one exists — the factory-callable
+idiom (``self.factory(rid)`` -> ``ProcessReplicaFactory.__call__``),
+which is precisely where the fleet hides a subprocess spawn.
+
+Scope: the threaded packages (serving/observability/elastic/
+distributed), same as lock_discipline. ``build_lock_graph`` exposes
+the order graph for ``tools/pdlint.py --dump-lock-graph``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Analyzer, Finding, SourceFile, in_scope
+from .engine import CallGraph, dotted_name
+
+__all__ = ["LockOrderAnalyzer", "LockOrderGraph", "build_lock_graph"]
+
+_DEFAULT_DIRS = ("paddle_tpu/serving/", "paddle_tpu/observability/",
+                 "paddle_tpu/elastic/", "paddle_tpu/distributed/")
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+_HTTP_FNS = {"urlopen", "create_connection"}
+_SUBPROCESS_FNS = {"run", "call", "check_call", "check_output"}
+# entry roots: what starts a thread of control
+_LOOP_NAMES = ("run", "serve_forever")
+_HANDLER_NAMES = {"do_GET", "do_POST", "do_PUT", "do_DELETE",
+                  "do_HEAD"}
+
+LockId = Tuple[str, str]                  # (module rel path, attr)
+
+
+def _display(lock: LockId) -> str:
+    return f"{lock[0]}:{lock[1]}"
+
+
+def _ctor_name(node: ast.AST) -> Optional[str]:
+    """'Lock'/'RLock'/... when ``node`` is a lock construction."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    name = f.attr if isinstance(f, ast.Attribute) else \
+        (f.id if isinstance(f, ast.Name) else "")
+    return name if name in _LOCK_CTORS else None
+
+
+def _lockish_attr(attr: str) -> bool:
+    low = attr.lower()
+    return ("lock" in low or "mutex" in low or "cond" in low
+            or low.endswith("_cv") or low == "cv")
+
+
+class _EdgeSite:
+    """Where an order edge was first observed."""
+
+    __slots__ = ("path", "line", "col", "func", "via")
+
+    def __init__(self, path, line, col, func, via=None):
+        self.path = path
+        self.line = line
+        self.col = col
+        self.func = func
+        self.via = via       # lock carried in from a caller, or None
+
+
+class LockOrderGraph:
+    """The global acquisition-order graph: ``edges[a][b]`` means some
+    path acquires ``b`` while holding ``a``."""
+
+    def __init__(self):
+        self.locks: Dict[LockId, str] = {}        # id -> ctor kind
+        self.edges: Dict[LockId, Dict[LockId, _EdgeSite]] = {}
+        self.roots: Dict[Tuple[str, str], str] = {}  # func key -> via
+
+    def add_lock(self, lock: LockId, kind: str):
+        self.locks.setdefault(lock, kind)
+
+    def add_edge(self, a: LockId, b: LockId, site: _EdgeSite):
+        if a == b:
+            return
+        self.edges.setdefault(a, {}).setdefault(b, site)
+
+    # ------------------------------------------------------ cycles
+    def cycles(self) -> List[List[LockId]]:
+        """Strongly connected components with more than one lock —
+        each is a potential-deadlock inversion set. Deterministic
+        order (sorted members, sorted components)."""
+        index: Dict[LockId, int] = {}
+        low: Dict[LockId, int] = {}
+        on_stack: Set[LockId] = set()
+        stack: List[LockId] = []
+        out: List[List[LockId]] = []
+        counter = [0]
+        nodes = sorted(set(self.locks) | set(self.edges)
+                       | {b for m in self.edges.values() for b in m})
+
+        def strongconnect(v: LockId):
+            # iterative Tarjan: (node, iterator) frames
+            work = [(v, iter(sorted(self.edges.get(v, ()))))]
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on_stack.add(v)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on_stack.add(w)
+                        work.append(
+                            (w, iter(sorted(self.edges.get(w, ())))))
+                        advanced = True
+                        break
+                    if w in on_stack:
+                        low[node] = min(low[node], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    comp = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        comp.append(w)
+                        if w == node:
+                            break
+                    if len(comp) > 1:
+                        out.append(sorted(comp))
+
+        for v in nodes:
+            if v not in index:
+                strongconnect(v)
+        return sorted(out)
+
+    # ------------------------------------------------------ dot
+    def to_dot(self) -> str:
+        """Graphviz DOT of the order graph; inversion-cycle members
+        are drawn red."""
+        cyclic = {l for comp in self.cycles() for l in comp}
+        lines = ["digraph lock_order {",
+                 "  rankdir=LR;",
+                 "  node [shape=box, fontname=monospace];"]
+        names = {}
+        for i, lock in enumerate(sorted(set(self.locks)
+                                        | set(self.edges))):
+            names[lock] = f"n{i}"
+            color = ', color=red' if lock in cyclic else ''
+            lines.append(
+                f'  n{i} [label="{_display(lock)}\\n'
+                f'({self.locks.get(lock, "implicit")})"{color}];')
+        for a in sorted(self.edges):
+            for b in sorted(self.edges[a]):
+                if b not in names:
+                    names[b] = f"n{len(names)}"
+                    lines.append(f'  {names[b]} '
+                                 f'[label="{_display(b)}"];')
+                site = self.edges[a][b]
+                attrs = f'label="{site.func}", fontsize=9'
+                if a in cyclic and b in cyclic:
+                    attrs += ", color=red"
+                lines.append(f"  {names[a]} -> {names[b]} [{attrs}];")
+        lines.append("}")
+        return "\n".join(lines) + "\n"
+
+
+# ===================================================================
+# per-function facts
+# ===================================================================
+class _Acquire:
+    __slots__ = ("lock", "held", "line", "col")
+
+    def __init__(self, lock, held, line, col):
+        self.lock = lock
+        self.held = held                   # tuple of LockId held before
+
+    # line/col in __init__ to keep slots simple
+        self.line = line
+        self.col = col
+
+
+class _Blocking:
+    __slots__ = ("token", "held", "line", "col")
+
+    def __init__(self, token, held, line, col):
+        self.token = token
+        self.held = held
+        self.line = line
+        self.col = col
+
+
+class _CallSite:
+    __slots__ = ("targets", "held")
+
+    def __init__(self, targets, held):
+        self.targets = targets             # tuple of func keys
+        self.held = held                   # tuple of LockId
+
+
+class _FuncFacts:
+    __slots__ = ("key", "qualname", "rel", "acquires", "blocking",
+                 "calls", "ld003")
+
+    def __init__(self, key, qualname, rel):
+        self.key = key
+        self.qualname = qualname
+        self.rel = rel
+        self.acquires: List[_Acquire] = []
+        self.blocking: List[_Blocking] = []
+        self.calls: List[_CallSite] = []
+        self.ld003: List[Tuple[str, int, int]] = []
+
+
+class _ModuleLocks:
+    """Lock identities one module defines or imports."""
+
+    __slots__ = ("globals_", "class_attrs", "cond_attrs")
+
+    def __init__(self):
+        self.globals_: Dict[str, Tuple[LockId, str]] = {}
+        # (class, attr) -> (LockId, kind)
+        self.class_attrs: Dict[Tuple[str, str], Tuple[LockId, str]] = {}
+        self.cond_attrs: Set[Tuple[str, str]] = set()
+
+
+def _discover_locks(cg: CallGraph, rels: Set[str]
+                    ) -> Dict[str, _ModuleLocks]:
+    out: Dict[str, _ModuleLocks] = {}
+    for rel in rels:
+        mi = cg.modules.get(rel)
+        if mi is None:
+            continue
+        ml = _ModuleLocks()
+        out[rel] = ml
+        tree = mi.sf.tree
+        # module-level: X = threading.Lock()
+        for node in tree.body:
+            if isinstance(node, ast.Assign):
+                kind = _ctor_name(node.value)
+                if kind:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            ml.globals_[t.id] = ((rel, t.id), kind)
+        # class attrs: self.X = <ctor> anywhere in the class; plus
+        # implicit lock-named attrs used as with-contexts
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for n in ast.walk(node):
+                if isinstance(n, ast.Assign):
+                    kind = _ctor_name(n.value)
+                    if not kind:
+                        continue
+                    for t in n.targets:
+                        if isinstance(t, ast.Attribute) and \
+                                isinstance(t.value, ast.Name) and \
+                                t.value.id == "self":
+                            lock = (rel, f"{node.name}.{t.attr}")
+                            ml.class_attrs[(node.name, t.attr)] = \
+                                (lock, kind)
+                            if kind == "Condition":
+                                ml.cond_attrs.add((node.name, t.attr))
+                elif isinstance(n, ast.With):
+                    for item in n.items:
+                        c = item.context_expr
+                        if isinstance(c, ast.Attribute) and \
+                                isinstance(c.value, ast.Name) and \
+                                c.value.id == "self" and \
+                                _lockish_attr(c.attr):
+                            ml.class_attrs.setdefault(
+                                (node.name, c.attr),
+                                ((rel, f"{node.name}.{c.attr}"),
+                                 "implicit"))
+    return out
+
+
+def _blocking_token(call: ast.Call) -> Optional[str]:
+    """The LD002 blocking classification of one call, or None."""
+    f = call.func
+    d = dotted_name(f)
+    last = f.attr if isinstance(f, ast.Attribute) else \
+        (f.id if isinstance(f, ast.Name) else "")
+    kwargs = {kw.arg for kw in call.keywords}
+    bounded = "timeout" in kwargs or None in kwargs    # **kw: trust
+    nargs = len(call.args)
+    if last in _HTTP_FNS:
+        return last            # network RTT under a lock: timeout or
+    if last == "open":         # not, the convoy is the bug
+        recv = f.value if isinstance(f, ast.Attribute) else None
+        name = recv.id if isinstance(recv, ast.Name) else (
+            recv.attr if isinstance(recv, ast.Attribute) else "")
+        if name and "opener" in name.lower():
+            return "opener.open"
+    if last == "Popen":
+        return "subprocess.Popen"
+    if last in _SUBPROCESS_FNS and d and \
+            d.split(".")[0] == "subprocess":
+        return f"subprocess.{last}"
+    if last == "communicate" and not bounded:
+        return "communicate"
+    if last == "get" and nargs == 0 and not kwargs:
+        return "queue.get"
+    if last == "result" and nargs == 0 and not kwargs:
+        return "Future.result"
+    if last in ("wait", "join") and nargs == 0 and not bounded:
+        return last
+    if last == "block_until_ready":
+        return "block_until_ready"
+    if last == "device_get":
+        return "device_get"
+    return None
+
+
+class _FactsBuilder:
+    """Walks one function body tracking the lexically-held lock set
+    and loop nesting; records acquisitions, call sites, blocking
+    calls, and naked Condition.waits."""
+
+    def __init__(self, cg: CallGraph, mi, fn, locks_by_rel, aliases):
+        self.cg = cg
+        self.mi = mi
+        self.fn = fn
+        self.locks = locks_by_rel
+        self.aliases = aliases
+        self.facts = _FuncFacts(fn.key, fn.qualname, fn.sf.rel)
+
+    # ------------------------------------------------- lock identity
+    def _lock_of(self, expr: ast.AST) -> Optional[Tuple[LockId, str]]:
+        ml = self.locks.get(self.fn.sf.rel)
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id == "self":
+            if self.fn.class_name is None or ml is None:
+                return None
+            got = ml.class_attrs.get((self.fn.class_name, expr.attr))
+            if got:
+                return got
+            if _lockish_attr(expr.attr):
+                return ((self.fn.sf.rel,
+                         f"{self.fn.class_name}.{expr.attr}"),
+                        "implicit")
+            return None
+        if isinstance(expr, ast.Name):
+            if ml and expr.id in ml.globals_:
+                return ml.globals_[expr.id]
+            # imported module-level lock: from .x import LOCK
+            resolved = self.mi.imports.resolve(expr.id)
+            head, _, lname = resolved.rpartition(".")
+            tm = self.cg.by_modname.get(head) if head else None
+            if tm is not None:
+                tml = self.locks.get(tm.sf.rel)
+                if tml and lname in tml.globals_:
+                    return tml.globals_[lname]
+        return None
+
+    def _is_condition(self, expr: ast.AST) -> bool:
+        got = self._lock_of(expr)
+        if got and got[1] == "Condition":
+            return True
+        if isinstance(expr, ast.Attribute):
+            a = expr.attr.lower()
+            return ("cond" in a or a.endswith("_cv") or a == "cv"
+                    or a in ("not_empty", "not_full",
+                             "all_tasks_done"))
+        return False
+
+    # ------------------------------------------------- traversal
+    def build(self) -> _FuncFacts:
+        body = self.fn.node.body
+        if not isinstance(body, list):     # lambda
+            body = [ast.Expr(value=body)]
+        self._stmts(body, (), False)
+        return self.facts
+
+    def _stmts(self, stmts, held, in_loop):
+        for s in stmts:
+            self._stmt(s, held, in_loop)
+
+    def _stmt(self, s, held, in_loop):
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return                         # separate call-graph node
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            inner = held
+            for item in s.items:
+                self._exprs([item.context_expr], inner, in_loop)
+                got = self._lock_of(item.context_expr)
+                if got:
+                    lock, kind = got
+                    self.facts.acquires.append(_Acquire(
+                        lock, inner, item.context_expr.lineno,
+                        item.context_expr.col_offset))
+                    if lock not in inner:
+                        inner = inner + (lock,)
+            self._stmts(s.body, inner, in_loop)
+            return
+        if isinstance(s, (ast.While,)):
+            self._exprs([s.test], held, in_loop)
+            self._stmts(s.body, held, True)
+            self._stmts(s.orelse, held, in_loop)
+            return
+        if isinstance(s, (ast.For, ast.AsyncFor)):
+            self._exprs([s.iter], held, in_loop)
+            self._stmts(s.body, held, True)
+            self._stmts(s.orelse, held, in_loop)
+            return
+        if isinstance(s, ast.If):
+            self._exprs([s.test], held, in_loop)
+            self._stmts(s.body, held, in_loop)
+            self._stmts(s.orelse, held, in_loop)
+            return
+        if isinstance(s, ast.Try):
+            self._stmts(s.body, held, in_loop)
+            for h in s.handlers:
+                self._stmts(h.body, held, in_loop)
+            self._stmts(s.orelse, held, in_loop)
+            self._stmts(s.finalbody, held, in_loop)
+            return
+        self._exprs([s], held, in_loop)
+
+    def _exprs(self, roots, held, in_loop):
+        """Scan expressions (not descending into nested defs/lambdas)
+        for calls."""
+        stack = list(roots)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            if isinstance(n, ast.Call):
+                self._call(n, held, in_loop)
+            stack.extend(ast.iter_child_nodes(n))
+
+    # ------------------------------------------------- calls
+    def _call(self, call: ast.Call, held, in_loop):
+        f = call.func
+        # LD003: naked Condition.wait outside a predicate loop
+        if isinstance(f, ast.Attribute) and f.attr == "wait" and \
+                self._is_condition(f.value) and not in_loop:
+            recv = dotted_name(f.value) or "<cond>"
+            self.facts.ld003.append(
+                (f"{recv}.wait", call.lineno, call.col_offset))
+        # LD002 blocking classification — a Condition's own wait
+        # RELEASES its lock, so it is LD003's business, not LD002's
+        token = _blocking_token(call)
+        if token and not (token == "wait" and isinstance(f,
+                          ast.Attribute) and
+                          self._is_condition(f.value)):
+            self.facts.blocking.append(_Blocking(
+                token, held, call.lineno, call.col_offset))
+        # propagation edges: direct calls only — Thread(target=) and
+        # partial() run on another thread / later, without our locks
+        targets = tuple(self.cg._resolve_target(
+            self.mi, self.fn, f, self.aliases))
+        if not targets and isinstance(f, ast.Attribute) and \
+                isinstance(f.value, ast.Name) and \
+                f.value.id == "self":
+            # factory-callable idiom: unique same-module __call__
+            calls_ = self.mi.by_last.get("__call__", ())
+            if len(calls_) == 1:
+                targets = (self.mi.funcs[calls_[0]].key,)
+        if targets:
+            self.facts.calls.append(_CallSite(targets, held))
+
+
+def _build_aliases(cg: CallGraph, mi, fn) -> Dict[str, Tuple[str, str]]:
+    """Local callable aliases, mirroring engine._callees."""
+    from .engine import iter_own_body
+    aliases: Dict[str, Tuple[str, str]] = {}
+    for n in iter_own_body(fn.node):
+        if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                isinstance(n.targets[0], ast.Name):
+            tgt = n.targets[0].id
+            if isinstance(n.value, ast.Lambda):
+                lam = f"{fn.qualname}.{tgt}"
+                if lam in mi.funcs:
+                    aliases[tgt] = mi.funcs[lam].key
+            elif isinstance(n.value, (ast.Name, ast.Attribute)):
+                keys = cg._resolve_target(mi, fn, n.value, aliases)
+                if len(keys) == 1:
+                    aliases[tgt] = keys[0]
+    return aliases
+
+
+def _thread_roots(cg: CallGraph, rels: Set[str]
+                  ) -> Dict[Tuple[str, str], str]:
+    """Thread-entry roots: Thread targets, HTTP handlers, worker
+    loops, signal handlers."""
+    roots: Dict[Tuple[str, str], str] = {}
+    for rel in sorted(rels):
+        mi = cg.modules.get(rel)
+        if mi is None:
+            continue
+        for qual, fn in mi.funcs.items():
+            last = qual.split(".")[-1]
+            if last in _HANDLER_NAMES:
+                roots.setdefault(fn.key, "http-handler")
+            elif last.endswith("_loop") or last in _LOOP_NAMES:
+                roots.setdefault(fn.key, "worker-loop")
+        for n in ast.walk(mi.sf.tree):
+            if not isinstance(n, ast.Call):
+                continue
+            d = dotted_name(n.func)
+            last = d.split(".")[-1] if d else ""
+            if last == "Thread":
+                for kw in n.keywords:
+                    if kw.arg == "target":
+                        t = dotted_name(kw.value)
+                        if t:
+                            name = t.split(".")[-1]
+                            for q in mi.by_last.get(name, ()):
+                                roots.setdefault(mi.funcs[q].key,
+                                                 "thread-target")
+            elif last == "signal" and d and \
+                    d.split(".")[0] == "signal" and len(n.args) >= 2:
+                t = dotted_name(n.args[1])
+                if t:
+                    name = t.split(".")[-1]
+                    for q in mi.by_last.get(name, ()):
+                        roots.setdefault(mi.funcs[q].key,
+                                         "signal-handler")
+    return roots
+
+
+# ===================================================================
+# the analysis
+# ===================================================================
+def _analyze(files: Sequence[SourceFile], dirs: Sequence[str]
+             ) -> Tuple[LockOrderGraph, List[Finding], str]:
+    name = LockOrderAnalyzer.name
+    scoped = [sf for sf in files if sf.tree is not None
+              and in_scope(sf.rel, dirs)]
+    graph = LockOrderGraph()
+    if not scoped:
+        return graph, [], name
+    cg = CallGraph.shared(files)
+    rels = {sf.rel for sf in scoped}
+    locks_by_rel = _discover_locks(cg, rels)
+    for ml in locks_by_rel.values():
+        for lock, kind in ml.globals_.values():
+            graph.add_lock(lock, kind)
+        for lock, kind in ml.class_attrs.values():
+            graph.add_lock(lock, kind)
+    graph.roots = _thread_roots(cg, rels)
+
+    facts: Dict[Tuple[str, str], _FuncFacts] = {}
+    for rel in sorted(rels):
+        mi = cg.modules[rel]
+        for fn in mi.funcs.values():
+            aliases = _build_aliases(cg, mi, fn)
+            facts[fn.key] = _FactsBuilder(
+                cg, mi, fn, locks_by_rel, aliases).build()
+
+    # ---- interprocedural: locks held at function entry (union over
+    # call sites, to fixpoint)
+    entry_held: Dict[Tuple[str, str], Set[LockId]] = \
+        {k: set() for k in facts}
+    entry_src: Dict[Tuple[str, str], Dict[LockId, str]] = \
+        {k: {} for k in facts}
+    changed = True
+    while changed:
+        changed = False
+        for key, fc in facts.items():
+            base = entry_held[key]
+            for cs in fc.calls:
+                flow = set(cs.held) | base
+                if not flow:
+                    continue
+                for tgt in cs.targets:
+                    if tgt not in entry_held or tgt == key:
+                        continue
+                    new = flow - entry_held[tgt]
+                    if new:
+                        entry_held[tgt] |= new
+                        for lock in new:
+                            entry_src[tgt].setdefault(lock,
+                                                      fc.qualname)
+                        changed = True
+
+    # ---- order edges
+    for key, fc in facts.items():
+        inherited = entry_held[key]
+        for acq in fc.acquires:
+            lex = list(acq.held)
+            for h in lex:
+                graph.add_edge(h, acq.lock, _EdgeSite(
+                    fc.rel, acq.line, acq.col, fc.qualname))
+            for h in sorted(inherited):
+                if h not in lex:
+                    graph.add_edge(h, acq.lock, _EdgeSite(
+                        fc.rel, acq.line, acq.col, fc.qualname,
+                        via=entry_src[key].get(h)))
+
+    findings: List[Finding] = []
+
+    # ---- LD001: inversion cycles
+    for comp in graph.cycles():
+        cycle_key = " <-> ".join(_display(c) for c in comp)
+        # exemplar edge inside the component, deterministic
+        site = None
+        funcs: List[str] = []
+        for a in comp:
+            for b, s in sorted(graph.edges.get(a, {}).items()):
+                if b in comp:
+                    funcs.append(s.func)
+                    if site is None or (s.path, s.line) < \
+                            (site.path, site.line):
+                        site = s
+        findings.append(Finding(
+            name, "LD001", site.path, site.line, site.col,
+            f"lock-order inversion between {cycle_key}: different "
+            f"paths acquire these locks in opposite orders "
+            f"(via {sorted(set(funcs))}) — a deadlock the moment "
+            f"the paths run concurrently; pick one global order",
+            symbol=cycle_key, detail="cycle"))
+
+    # ---- LD002: blocking while holding a lock
+    for key, fc in sorted(facts.items()):
+        inherited = entry_held[key]
+        for b in fc.blocking:
+            held_eff = list(b.held) + sorted(inherited -
+                                             set(b.held))
+            if not held_eff:
+                continue
+            lock = held_eff[0]
+            how = "held here" if b.held else (
+                f"held by caller "
+                f"{entry_src[key].get(lock, '?')}")
+            findings.append(Finding(
+                name, "LD002", fc.rel, b.line, b.col,
+                f"blocking call {b.token} while "
+                f"{_display(lock)} is {how} — every thread "
+                f"needing the lock now waits on this I/O; move "
+                f"the blocking work outside the critical section "
+                f"(snapshot under the lock, block outside)",
+                symbol=fc.qualname,
+                detail=f"{b.token}@{lock[1]}"))
+
+    # ---- LD003: Condition.wait outside a predicate loop
+    for key, fc in sorted(facts.items()):
+        for recv, line, col in fc.ld003:
+            findings.append(Finding(
+                name, "LD003", fc.rel, line, col,
+                f"{recv} outside a predicate loop — spurious/stolen "
+                f"wakeups silently break the waited-for condition; "
+                f"use `while not pred: cond.wait()` or "
+                f"cond.wait_for(pred)",
+                symbol=fc.qualname, detail=recv))
+
+    return graph, findings, name
+
+
+class LockOrderAnalyzer(Analyzer):
+    name = "lock_order"
+
+    def __init__(self, dirs: Sequence[str] = _DEFAULT_DIRS):
+        self.dirs = tuple(dirs)
+        # scope is configurable, so the run-cache key must carry it
+        self.cache_token = "lock_order:" + ",".join(self.dirs)
+
+    def run(self, files: Sequence[SourceFile]) -> List[Finding]:
+        _, findings, _ = _analyze(files, self.dirs)
+        return findings
+
+
+def build_lock_graph(files: Sequence[SourceFile],
+                     dirs: Sequence[str] = _DEFAULT_DIRS
+                     ) -> LockOrderGraph:
+    """The global lock-order graph (for --dump-lock-graph and
+    tooling); same scoping as the analyzer."""
+    graph, _, _ = _analyze(files, dirs)
+    return graph

@@ -199,7 +199,7 @@ class RecompileRiskAnalyzer(Analyzer):
     def _check_set_iteration(self,
                              files: Sequence[SourceFile]
                              ) -> List[Finding]:
-        cg = CallGraph(files)
+        cg = CallGraph.shared(files)
         reach = cg.reachable(jit_entries(cg))
         findings: List[Finding] = []
         for key in sorted(reach):

@@ -1,0 +1,464 @@
+"""Runtime lockdep: observe the lock order the program *actually*
+uses, and fail fast on inversions.
+
+The static half (``analysis.lock_order``) proves what the source
+*could* do; this module watches what a running process *does*. It is
+a Linux-lockdep-style sanitizer for ``threading`` primitives:
+
+* ``install()`` patches ``threading.Lock``/``RLock``/``Condition``
+  with instrumented factories. Only locks constructed from code
+  inside the repository root are instrumented (a cheap frame walk at
+  construction time); third-party and stdlib internals get the native
+  primitive back — zero overhead, zero compatibility risk outside
+  our own code.
+* Each instrumented lock belongs to a **lock class** keyed by its
+  construction site (``file:line``), the lockdep trick that keeps the
+  order graph bounded no matter how many instances a test suite
+  creates: every ``FleetRouter.__init__`` run yields the same class.
+* Every acquire pushes onto a per-thread stack; the first time class
+  B is acquired while class A is held, the edge A->B joins the
+  observed-order graph. If B->A was already observed, that is an
+  **inversion** — a deadlock waiting for the right interleaving —
+  and it is reported the first time it is *seen*, not the day it
+  finally hangs: recorded always, raised as ``LockdepViolation`` in
+  the acquiring thread when ``FLAGS_lockdep_raise`` is set.
+* Holds longer than ``FLAGS_lockdep_hold_warn_ms`` are recorded as
+  hold-time warnings (the runtime twin of static LD002: a long hold
+  under traffic is a convoy).
+
+``report()`` returns everything observed; the tier-1 conftest
+installs the sanitizer when ``FLAGS_lockdep`` is set and fails any
+test on whose watch a new violation appeared, so the whole suite
+runs sanitized. ``findings()`` bridges the report into pdlint
+``Finding`` objects (rules LD001/LD002 with a ``runtime:`` detail
+prefix) so runtime evidence rides the same SARIF pipeline as static
+results.
+
+Everything here is stdlib-only and must stay importable with no
+side effects; nothing is patched until ``install()``.
+"""
+# pdlint: disable=resource_pairing  -- this module IS the lock
+# implementation: acquire/release intentionally pair across methods
+# (__enter__/__exit__, _release_save/_acquire_restore)
+from __future__ import annotations
+
+import os
+import threading
+import time
+import sys
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..framework.flags import flag_ref
+
+# live registry objects, bound once — the acquire/release hot path
+# reads .value off them instead of a registry lookup per call
+_HOLD_WARN_MS = flag_ref("FLAGS_lockdep_hold_warn_ms")
+_RAISE_ON_INVERSION = flag_ref("FLAGS_lockdep_raise")
+
+__all__ = [
+    "LockdepViolation", "install", "uninstall", "installed",
+    "report", "reset", "findings", "repo_root",
+    "set_root_for_tests",
+]
+
+# the real primitives, captured before any patching
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+_REAL_CONDITION = threading.Condition
+
+_THIS_DIR = os.path.dirname(os.path.abspath(__file__))
+_root_override: Optional[str] = None
+
+
+class LockdepViolation(RuntimeError):
+    """Raised in the acquiring thread on the first observed
+    lock-order inversion for a lock-class pair."""
+
+
+def repo_root() -> str:
+    """The directory whose code gets instrumented locks: the
+    repository root (two levels above ``paddle_tpu.analysis``),
+    unless overridden via ``set_root_for_tests``."""
+    if _root_override is not None:
+        return _root_override
+    return os.path.dirname(os.path.dirname(_THIS_DIR))
+
+
+def set_root_for_tests(path: Optional[str]) -> None:
+    """Point the instrumentation boundary somewhere else (self-tests
+    construct locks from tmp files / interactive frames that are not
+    under the repo checkout). ``None`` restores the default."""
+    global _root_override
+    _root_override = path
+
+
+# ===================================================================
+# global sanitizer state
+# ===================================================================
+class _State:
+    def __init__(self):
+        self.mu = _REAL_LOCK()            # guards everything below
+        # observed order: class A -> {class B: (thread, stacknote)}
+        self.order: Dict[str, Dict[str, str]] = {}
+        self.inversions: List[dict] = []
+        self.long_holds: List[dict] = []
+        self.seen_pairs: Set[Tuple[str, str]] = set()
+        self.classes: Dict[str, int] = {}   # class -> instances made
+        self.acquires = 0
+
+    def snapshot(self) -> dict:
+        with self.mu:
+            return {
+                "classes": dict(self.classes),
+                "edges": {a: sorted(bs) for a, bs in
+                          sorted(self.order.items())},
+                "inversions": list(self.inversions),
+                "long_holds": list(self.long_holds),
+                "acquires": self.acquires,
+            }
+
+
+_state = _State()
+_tls = threading.local()
+# Bumped on reset(): per-thread seen-edge sets are keyed on it so a
+# reset invalidates every thread's fast-path cache, not just the
+# resetting thread's.
+_GEN = 0
+
+
+def _held_stack() -> list:
+    st = getattr(_tls, "held", None)
+    if st is None:
+        st = []
+        _tls.held = st
+    return st
+
+
+def _thread_seen() -> set:
+    """This thread's already-recorded (held, acquired) class pairs —
+    the acquire fast path consults it instead of the global state."""
+    if getattr(_tls, "gen", -1) != _GEN:
+        _tls.gen = _GEN
+        _tls.seen_edges = set()
+    return _tls.seen_edges
+
+
+def _site_class(skip_self: bool = True) -> Optional[str]:
+    """Construction-site lock class ``rel:line`` for the innermost
+    caller frame inside the repo root, or None (-> don't
+    instrument). Skips sanitizer and threading frames."""
+    root = repo_root() + os.sep
+    f = sys._getframe(2)
+    for _ in range(12):                    # bounded walk
+        if f is None:
+            return None
+        fn = f.f_code.co_filename
+        if fn.startswith(_THIS_DIR) or fn.endswith("threading.py"):
+            f = f.f_back
+            continue
+        if fn.startswith(root):
+            rel = os.path.relpath(fn, repo_root())
+            return f"{rel}:{f.f_lineno}"
+        return None
+    return None
+
+
+def _record_acquired(cls: str, t0: float):
+    """Called with the lock just acquired: order-graph bookkeeping.
+
+    Fast path: every (held, acquiring) class pair this thread has
+    already processed costs one thread-local set lookup and NO global
+    lock — steady-state traffic over a stable locking pattern runs
+    with zero cross-thread serialization.  Only the first time a
+    thread meets a pair does it enter the slow path, which updates the
+    shared order graph under ``_state.mu`` and runs the inversion
+    check.  An inversion is still always caught: whichever thread is
+    first to record the second orientation has, by definition, never
+    seen that pair before, so it cannot skip the check.
+
+    Raises LockdepViolation on a fresh inversion when configured."""
+    held = _held_stack()
+    _state.acquires += 1      # informational; unlocked by design
+    if held:
+        seen = _thread_seen()
+        fresh = [p for p, _ in held
+                 if p != cls and (p, cls) not in seen]
+        if fresh:
+            raise_msg = _record_pairs(cls, fresh, seen)
+            held.append((cls, t0))
+            if raise_msg is not None:
+                raise LockdepViolation(raise_msg)
+            return
+    held.append((cls, t0))
+
+
+def _record_pairs(cls: str, fresh: list, seen: set) -> Optional[str]:
+    """Slow path: merge this thread's new order edges into the global
+    graph and check each against the reverse orientation."""
+    raise_msg = None
+    with _state.mu:
+        for prev_cls in fresh:
+            pair = (prev_cls, cls)
+            _state.order.setdefault(prev_cls, {}).setdefault(
+                cls, threading.current_thread().name)
+            rev = _state.order.get(cls, {})
+            if prev_cls in rev and pair not in _state.seen_pairs \
+                    and (cls, prev_cls) not in _state.seen_pairs:
+                _state.seen_pairs.add(pair)
+                info = {
+                    "kind": "inversion",
+                    "first": cls, "second": prev_cls,
+                    "thread": threading.current_thread().name,
+                    "note": (f"{prev_cls} -> {cls} observed here; "
+                             f"{cls} -> {prev_cls} observed "
+                             f"earlier by {rev[prev_cls]}"),
+                }
+                _state.inversions.append(info)
+                if _RAISE_ON_INVERSION.value:
+                    raise_msg = (
+                        f"lock-order inversion: acquiring {cls} "
+                        f"while holding {prev_cls}, but the "
+                        f"opposite order was already observed "
+                        f"({info['note']}) — potential deadlock")
+    for prev_cls in fresh:
+        seen.add((prev_cls, cls))
+    return raise_msg
+
+
+def _record_released(cls: str):
+    held = _held_stack()
+    for i in range(len(held) - 1, -1, -1):
+        if held[i][0] == cls:
+            t0 = held[i][1]
+            del held[i]
+            warn_ms = _HOLD_WARN_MS.value or 0.0
+            if warn_ms > 0:
+                held_ms = (time.perf_counter() - t0) * 1e3
+                if held_ms > warn_ms:
+                    with _state.mu:
+                        _state.long_holds.append({
+                            "kind": "long_hold", "cls": cls,
+                            "held_ms": round(held_ms, 3),
+                            "thread":
+                                threading.current_thread().name,
+                        })
+            return
+
+
+# ===================================================================
+# instrumented primitives
+# ===================================================================
+class _InstrumentedBase:
+    """Shared acquire/release bookkeeping over an inner native lock.
+
+    Implements the private Condition protocol (``_is_owned``,
+    ``_release_save``, ``_acquire_restore``) so a real
+    ``threading.Condition`` can drive an instrumented lock."""
+
+    _reentrant = False
+
+    def __init__(self, inner, cls: str):
+        self._inner = inner
+        self._cls = cls
+        self._depth = 0                   # meaningful for RLock only
+
+    # -- core ----------------------------------------------------
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            if self._reentrant and self._depth > 0:
+                self._depth += 1          # nested: no new hold
+            else:
+                self._depth = 1
+                # clock AFTER acquisition: hold time measures how long
+                # the lock was held, not how long we waited for it
+                t0 = time.perf_counter()
+                try:
+                    _record_acquired(self._cls, t0)
+                except LockdepViolation:
+                    # abort the violating acquire entirely: the
+                    # caller does NOT hold the lock after the raise
+                    held = _held_stack()
+                    for i in range(len(held) - 1, -1, -1):
+                        if held[i][0] == self._cls:
+                            del held[i]
+                            break
+                    self._depth = 0
+                    self._inner.release()
+                    raise
+        return got
+
+    def release(self):
+        if self._reentrant and self._depth > 1:
+            self._depth -= 1
+            self._inner.release()
+            return
+        self._depth = 0
+        # release FIRST, bookkeep after: the sanitizer must not
+        # lengthen the critical section waiters are blocked on (and an
+        # unowned release raises before any bookkeeping runs)
+        self._inner.release()
+        _record_released(self._cls)
+
+    def locked(self):
+        return self._inner.locked() if hasattr(self._inner, "locked") \
+            else self._depth > 0
+
+    # stdlib Lock/RLock alias __enter__ to acquire (the context value
+    # is the acquire result, not the lock) — mirror it, one call less
+    __enter__ = acquire
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return (f"<lockdep {type(self).__name__} class={self._cls} "
+                f"inner={self._inner!r}>")
+
+    # -- Condition protocol --------------------------------------
+    def _is_owned(self):
+        inner = self._inner
+        if hasattr(inner, "_is_owned"):
+            return inner._is_owned()
+        # plain Lock: owned iff locked and not acquirable
+        if inner.acquire(False):
+            inner.release()
+            return False
+        return True
+
+    def _release_save(self):
+        depth = self._depth
+        self._depth = 0
+        if hasattr(self._inner, "_release_save"):
+            state = self._inner._release_save()
+        else:
+            self._inner.release()
+            state = None
+        _record_released(self._cls)
+        return (depth, state)
+
+    def _acquire_restore(self, saved):
+        depth, state = saved
+        if hasattr(self._inner, "_acquire_restore"):
+            self._inner._acquire_restore(state)
+        else:
+            self._inner.acquire()
+        self._depth = depth
+        _record_acquired(self._cls, time.perf_counter())
+
+
+class _InstrumentedLock(_InstrumentedBase):
+    _reentrant = False
+
+
+class _InstrumentedRLock(_InstrumentedBase):
+    _reentrant = True
+
+
+def _track_class(cls: Optional[str]) -> Optional[str]:
+    if cls is None:
+        return None
+    with _state.mu:
+        _state.classes[cls] = _state.classes.get(cls, 0) + 1
+    return cls
+
+
+def _lock_factory():
+    cls = _track_class(_site_class())
+    if cls is None:
+        return _REAL_LOCK()
+    return _InstrumentedLock(_REAL_LOCK(), cls)
+
+
+def _rlock_factory():
+    cls = _track_class(_site_class())
+    if cls is None:
+        return _REAL_RLOCK()
+    return _InstrumentedRLock(_REAL_RLOCK(), cls)
+
+
+def _condition_factory(lock=None):
+    if lock is None:
+        cls = _track_class(_site_class())
+        if cls is None:
+            return _REAL_CONDITION()
+        lock = _InstrumentedRLock(_REAL_RLOCK(), cls)
+    # a REAL Condition driving the instrumented lock through the
+    # Condition protocol; its internal waiter locks come from
+    # _thread.allocate_lock and are never instrumented
+    return _REAL_CONDITION(lock)
+
+
+# ===================================================================
+# install / report
+# ===================================================================
+_installed = False
+
+
+def install() -> None:
+    """Patch ``threading.Lock``/``RLock``/``Condition`` with the
+    instrumented factories. Idempotent."""
+    global _installed
+    if _installed:
+        return
+    threading.Lock = _lock_factory
+    threading.RLock = _rlock_factory
+    threading.Condition = _condition_factory
+    _installed = True
+
+
+def uninstall() -> None:
+    """Restore the native primitives. Already-created instrumented
+    locks keep working (they wrap real locks)."""
+    global _installed
+    threading.Lock = _REAL_LOCK
+    threading.RLock = _REAL_RLOCK
+    threading.Condition = _REAL_CONDITION
+    _installed = False
+
+
+def installed() -> bool:
+    return _installed
+
+
+def report() -> dict:
+    """Everything observed so far: lock classes, the order graph,
+    inversions, long holds, total acquire count."""
+    return _state.snapshot()
+
+
+def reset() -> None:
+    """Clear observed state (tests). Does not uninstall."""
+    global _state, _GEN
+    _state = _State()
+    _GEN += 1          # invalidate every thread's fast-path cache
+
+
+def findings() -> List["Finding"]:
+    """Bridge the runtime report into pdlint findings: inversions as
+    LD001, long holds as LD002, both with a ``runtime:`` detail
+    prefix so they are distinguishable from static results in SARIF
+    and never collide with the static baseline."""
+    from .core import Finding
+    snap = report()
+    out: List[Finding] = []
+    for inv in snap["inversions"]:
+        path, _, line = inv["first"].partition(":")
+        out.append(Finding(
+            "lockdep", "LD001", path, int(line or 0), 0,
+            f"runtime lock-order inversion: {inv['note']} "
+            f"(thread {inv['thread']})",
+            symbol=inv["first"],
+            detail=f"runtime:{inv['first']}<->{inv['second']}"))
+    for h in snap["long_holds"]:
+        path, _, line = h["cls"].partition(":")
+        out.append(Finding(
+            "lockdep", "LD002", path, int(line or 0), 0,
+            f"lock held {h['held_ms']} ms (> "
+            f"FLAGS_lockdep_hold_warn_ms) by thread "
+            f"{h['thread']} — long holds under traffic are "
+            f"convoys",
+            symbol=h["cls"], detail=f"runtime:hold:{h['cls']}",
+            severity="warning"))
+    return out

@@ -48,7 +48,7 @@ class TracerSafetyAnalyzer(Analyzer):
     name = "tracer_safety"
 
     def run(self, files: Sequence[SourceFile]) -> List[Finding]:
-        graph = CallGraph(files)
+        graph = CallGraph.shared(files)
         reach = graph.reachable(jit_entries(graph))
         findings: List[Finding] = []
         for key in sorted(reach):

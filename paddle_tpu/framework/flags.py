@@ -98,6 +98,15 @@ def flag_value(name: str):
     return _REGISTRY[key].value
 
 
+def flag_ref(name: str) -> _Flag:
+    """The live registry object for a flag. Hot paths bind this once
+    and read ``.value`` directly — same liveness as ``flag_value``
+    (``set_flags`` mutates the object in place) without paying a
+    registry lookup per call."""
+    key = name if name.startswith("FLAGS_") else "FLAGS_" + name
+    return _REGISTRY[key]
+
+
 def flags_snapshot() -> Dict[str, Dict[str, Any]]:
     """Every registered flag with its live value, default, type name
     and help text — the bulk export pdlint's ``--dump-flags`` and
@@ -499,3 +508,23 @@ define_flag("FLAGS_autoscale_occupancy_high", 0.85,
             "autoscaler scales out")
 define_flag("FLAGS_autoscale_interval_s", 5.0,
             "autoscaler control-loop evaluation period in seconds")
+
+# ---- runtime lockdep sanitizer (analysis/sanitizer.py) ----
+define_flag("FLAGS_lockdep", False,
+            "instrument threading.Lock/RLock/Condition constructed by "
+            "repo code with the lockdep sanitizer: per-thread "
+            "acquisition stacks, an observed lock-order graph, and an "
+            "error the FIRST time an AB/BA order inversion is "
+            "observed (not only when it deadlocks). Installed by the "
+            "tier-1 pytest fixture when set; opt-in because every "
+            "guarded acquire pays a bookkeeping tax")
+define_flag("FLAGS_lockdep_hold_warn_ms", 100.0,
+            "lockdep flags any instrumented lock held longer than "
+            "this many milliseconds (a long hold under traffic is a "
+            "convoy; holding across I/O is the static LD002 rule's "
+            "runtime twin). 0 disables hold-time tracking")
+define_flag("FLAGS_lockdep_raise", True,
+            "raise LockdepViolation in the acquiring thread on the "
+            "first observed inversion per lock pair (False = record "
+            "in sanitizer.report() only — crash-averse production "
+            "canaries)")

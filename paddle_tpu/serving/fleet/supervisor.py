@@ -122,6 +122,33 @@ class ProcessReplicaFactory:
         return SubprocessReplica(proc, announce)
 
 
+class _PendingProc:
+    """Placeholder proc for a slot whose factory call is in flight.
+
+    Spawning (``subprocess.Popen``, model warmup) happens OUTSIDE the
+    supervisor lock — a slow factory must never block ``endpoints()``
+    or the monitor — so the slot is published first with this
+    sentinel: alive (``poll() is None``, so the monitor never
+    "respawns" it) but unannounced (``url() is None``, so the router
+    never routes to it). The real proc replaces it under the lock
+    once the spawn returns."""
+
+    def poll(self):
+        return None
+
+    def url(self):
+        return None
+
+    def terminate(self):
+        pass
+
+    def kill(self):
+        pass
+
+    def wait(self, timeout=None):
+        return 0
+
+
 class _Managed:
     """Supervisor-side record of one replica slot."""
 
@@ -166,19 +193,42 @@ class ReplicaSupervisor:
         self._monitor: Optional[threading.Thread] = None
 
     # ------------------------------------------------------ lifecycle
+    def _spawn_into(self, rid: int):
+        """Run the factory for an already-reserved slot — called with
+        the lock NOT held — then publish the proc under the lock. If
+        the slot was retired or the supervisor stopped while the
+        spawn was in flight, the fresh proc is terminated instead."""
+        try:
+            proc = self.factory(rid)
+        except Exception:
+            with self._lock:
+                self._managed.pop(rid, None)
+            raise
+        with self._lock:
+            m = self._managed.get(rid)
+            orphaned = self._stopping or m is None or m.retiring
+            if not orphaned:
+                m.proc = proc
+        if orphaned:
+            proc.terminate()
+
     def start(self) -> "ReplicaSupervisor":
         with self._lock:
             if self._stopping:
                 raise RuntimeError("supervisor already stopped")
+            new_ids = []
             while self._next_id < self.n_replicas:
                 rid = self._next_id
                 self._next_id += 1
-                self._managed[rid] = _Managed(rid, self.factory(rid))
+                self._managed[rid] = _Managed(rid, _PendingProc())
+                new_ids.append(rid)
             if self._monitor is None or not self._monitor.is_alive():
                 self._monitor = threading.Thread(
                     target=self._monitor_loop,
                     name=f"fleet-supervisor-{self.name}", daemon=True)
                 self._monitor.start()
+        for rid in new_ids:
+            self._spawn_into(rid)
         return self
 
     def stop(self, timeout: float = 10.0):
@@ -210,6 +260,7 @@ class ReplicaSupervisor:
         highest-numbered ones gracefully) to ``n``."""
         n = int(n)
         to_stop = []
+        new_ids = []
         with self._lock:
             self.n_replicas = n
             live = sorted(rid for rid, m in self._managed.items()
@@ -222,10 +273,13 @@ class ReplicaSupervisor:
             while count < n:
                 rid = self._next_id
                 self._next_id += 1
-                self._managed[rid] = _Managed(rid, self.factory(rid))
+                self._managed[rid] = _Managed(rid, _PendingProc())
+                new_ids.append(rid)
                 count += 1
         for m in to_stop:
             m.proc.terminate()
+        for rid in new_ids:
+            self._spawn_into(rid)
 
     # ------------------------------------------------------ discovery
     def endpoints(self) -> Dict[int, str]:

@@ -24,9 +24,11 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 try:
     from paddle_tpu import analysis
     from paddle_tpu.analysis import (DonationSafetyAnalyzer,
+                                     LockOrderAnalyzer,
                                      RecompileRiskAnalyzer,
                                      ResourcePairingAnalyzer,
-                                     TracerSafetyAnalyzer)
+                                     TracerSafetyAnalyzer,
+                                     build_lock_graph)
     from paddle_tpu.analysis import engine as eng
 except Exception as e:  # noqa: BLE001 - mirror the main gate's skip
     pytest.skip(f"repo root not importable, pdlint gate skipped: {e!r}",
@@ -614,6 +616,47 @@ _RULE_SOURCES = {
             work()
             span.__exit__(None, None, None)
     """),
+    "LD001": ("paddle_tpu/serving/m.py", """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+
+            def fwd(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+
+            def rev(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        pass
+    """),
+    "LD002": ("paddle_tpu/serving/m.py", """
+        import threading
+        from urllib.request import urlopen
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def fetch(self, url):
+                with self._lock:
+                    return urlopen(url, timeout=1.0).read()
+    """),
+    "LD003": ("paddle_tpu/serving/m.py", """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._cv = threading.Condition()
+
+            def wait_once(self):
+                with self._cv:
+                    self._cv.wait(1.0)
+    """),
 }
 
 
@@ -850,12 +893,248 @@ class TestGoldenGate:
 
 
 # ===================================================================
-# 12. runtime budget: the whole gate stays tier-1 fast
+# 12. lock-order analyzer (LD001-LD003)
+# ===================================================================
+class TestLockOrder:
+    def _ld(self, tmp_path):
+        return _run(tmp_path, [LockOrderAnalyzer()])
+
+    def test_ld001_lexical_cycle(self, tmp_path):
+        relpath, src = _RULE_SOURCES["LD001"]
+        _write(tmp_path, relpath, src)
+        found = self._ld(tmp_path)
+        assert [f.rule for f in found] == ["LD001"]
+        assert "S._a_lock" in found[0].symbol
+        assert "S._b_lock" in found[0].symbol
+
+    def test_ld001_interprocedural_cycle(self, tmp_path):
+        # one arm of the inversion goes through a helper call
+        _write(tmp_path, "paddle_tpu/serving/m.py", """
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._a_lock = threading.Lock()
+                    self._b_lock = threading.Lock()
+
+                def fwd(self):
+                    with self._a_lock:
+                        self._take_b()
+
+                def _take_b(self):
+                    with self._b_lock:
+                        pass
+
+                def rev(self):
+                    with self._b_lock:
+                        with self._a_lock:
+                            pass
+        """)
+        found = self._ld(tmp_path)
+        assert [f.rule for f in found] == ["LD001"]
+
+    def test_ld001_consistent_order_is_clean(self, tmp_path):
+        _write(tmp_path, "paddle_tpu/serving/m.py", """
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._a_lock = threading.Lock()
+                    self._b_lock = threading.Lock()
+
+                def one(self):
+                    with self._a_lock:
+                        with self._b_lock:
+                            pass
+
+                def two(self):
+                    with self._a_lock:
+                        with self._b_lock:
+                            pass
+        """)
+        assert self._ld(tmp_path) == []
+
+    def test_ld002_direct_and_via_helper(self, tmp_path):
+        _write(tmp_path, "paddle_tpu/serving/m.py", """
+            import threading
+            from urllib.request import urlopen
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def direct(self):
+                    with self._lock:
+                        urlopen("http://x", timeout=1.0)
+
+                def indirect(self):
+                    with self._lock:
+                        self._io()
+
+                def _io(self):
+                    urlopen("http://x", timeout=1.0)
+        """)
+        found = self._ld(tmp_path)
+        assert [f.rule for f in found] == ["LD002", "LD002"]
+        syms = {f.symbol for f in found}
+        assert syms == {"C.direct", "C._io"}
+        # the interprocedural one names the caller that held the lock
+        by_sym = {f.symbol: f for f in found}
+        assert "C.indirect" in by_sym["C._io"].message
+
+    def test_ld002_thread_handoff_does_not_propagate(self, tmp_path):
+        # starting a thread while holding a lock is fine: the target
+        # runs on its own stack with an empty held set
+        _write(tmp_path, "paddle_tpu/serving/m.py", """
+            import threading
+            from urllib.request import urlopen
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def spawn(self):
+                    with self._lock:
+                        threading.Thread(target=self._loop).start()
+
+                def _loop(self):
+                    urlopen("http://x", timeout=1.0)
+        """)
+        assert self._ld(tmp_path) == []
+
+    def test_ld002_snapshot_then_io_outside_is_clean(self, tmp_path):
+        # the router/supervisor idiom the fix in serving/fleet uses
+        _write(tmp_path, "paddle_tpu/serving/m.py", """
+            import threading
+            from urllib.request import urlopen
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._urls = []
+
+                def poll(self):
+                    with self._lock:
+                        urls = list(self._urls)
+                    for u in urls:
+                        urlopen(u, timeout=1.0)
+        """)
+        assert self._ld(tmp_path) == []
+
+    def test_ld002_timeoutless_get_result_wait(self, tmp_path):
+        _write(tmp_path, "paddle_tpu/serving/m.py", """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def a(self, q):
+                    with self._lock:
+                        q.get()
+
+                def b(self, fut):
+                    with self._lock:
+                        fut.result()
+
+                def c(self, q, fut):
+                    with self._lock:
+                        q.get(timeout=0.1)
+                        fut.result(0.1)
+        """)
+        found = self._ld(tmp_path)
+        assert sorted(f.detail for f in found) == \
+            ["Future.result@C._lock", "queue.get@C._lock"]
+
+    def test_ld002_subprocess_via_factory_callable(self, tmp_path):
+        # the supervisor regression: self.factory(rid) resolves to
+        # the unique same-module __call__ that spawns a subprocess
+        _write(tmp_path, "paddle_tpu/serving/m.py", """
+            import subprocess
+            import threading
+
+            class Factory:
+                def __call__(self, rid):
+                    return subprocess.Popen(["echo", str(rid)])
+
+            class Supervisor:
+                def __init__(self, factory):
+                    self.factory = factory
+                    self._lock = threading.Lock()
+
+                def start(self):
+                    with self._lock:
+                        self.proc = self.factory(0)
+        """)
+        found = self._ld(tmp_path)
+        assert [f.rule for f in found] == ["LD002"]
+        assert found[0].symbol == "Factory.__call__"
+        assert "Supervisor.start" in found[0].message
+
+    def test_ld003_wait_in_loop_clean_outside_flagged(self, tmp_path):
+        _write(tmp_path, "paddle_tpu/serving/m.py", """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._cv = threading.Condition()
+                    self.ready = False
+
+                def good(self):
+                    with self._cv:
+                        while not self.ready:
+                            self._cv.wait(0.1)
+
+                def good_wait_for(self):
+                    with self._cv:
+                        self._cv.wait_for(lambda: self.ready)
+
+                def bad(self):
+                    with self._cv:
+                        self._cv.wait(0.1)
+        """)
+        found = self._ld(tmp_path)
+        assert [(f.rule, f.symbol) for f in found] == [("LD003",
+                                                        "W.bad")]
+
+    def test_out_of_scope_tree_is_ignored(self, tmp_path):
+        relpath, src = _RULE_SOURCES["LD001"]
+        _write(tmp_path, "paddle_tpu/training/m.py",
+               src)                      # not a threaded package
+        assert self._ld(tmp_path) == []
+
+    def test_lock_graph_dump(self, tmp_path):
+        relpath, src = _RULE_SOURCES["LD001"]
+        _write(tmp_path, relpath, src)
+        files = analysis.parse_files(
+            analysis.iter_python_files([str(tmp_path)]),
+            root=str(tmp_path))
+        dot = build_lock_graph(files).to_dot()
+        assert dot.startswith("digraph lock_order")
+        assert "S._a_lock" in dot and "S._b_lock" in dot
+        assert "color=red" in dot       # the cycle is highlighted
+
+    def test_dump_lock_graph_cli(self, tmp_path):
+        relpath, src = _RULE_SOURCES["LD001"]
+        _write(tmp_path, relpath, src)
+        main = _pdlint_main()
+        out = io.StringIO()
+        with redirect_stdout(out), redirect_stderr(io.StringIO()):
+            rc = main([str(tmp_path), "--dump-lock-graph"])
+        assert rc == 0
+        assert out.getvalue().startswith("digraph lock_order")
+
+
+# ===================================================================
+# 13. runtime budget: the whole gate stays tier-1 fast
 # ===================================================================
 class TestRuntimeBudget:
     BUDGET_S = 60.0
 
     def test_full_repo_run_under_budget(self):
+        # the default set must include the v3 lock-order analyzer
+        assert "lock_order" in analysis.analyzer_names()
+        analysis.clear_run_cache()       # time a genuinely cold run
         t0 = time.perf_counter()
         res = analysis.run_project(root=REPO_ROOT)
         dt = time.perf_counter() - t0
@@ -864,3 +1143,28 @@ class TestRuntimeBudget:
             f"full pdlint run took {dt:.1f}s (budget "
             f"{self.BUDGET_S}s) — the interprocedural engine must "
             f"stay cheap enough for tier-1")
+
+    def test_repeat_run_is_served_from_cache(self, tmp_path):
+        # identical repeat: same findings, served from the memo
+        relpath, bad_src = _RULE_SOURCES["LD002"]
+        _write(tmp_path, relpath,
+               "import threading\nL = threading.Lock()\n")
+        first = analysis.run_analyzers(
+            [str(tmp_path)], analysis.all_analyzers(),
+            root=str(tmp_path))
+        t0 = time.perf_counter()
+        again = analysis.run_analyzers(
+            [str(tmp_path)], analysis.all_analyzers(),
+            root=str(tmp_path))
+        cached_dt = time.perf_counter() - t0
+        assert [f.fingerprint for f in again] == \
+            [f.fingerprint for f in first]
+        assert cached_dt < 0.25
+        # any edit to an analyzed file invalidates the entry
+        _write(tmp_path, relpath, bad_src)
+        edited = analysis.run_analyzers(
+            [str(tmp_path)], analysis.all_analyzers(),
+            root=str(tmp_path))
+        assert "LD002" in {f.rule for f in edited}
+        assert [f.fingerprint for f in edited] != \
+            [f.fingerprint for f in first]

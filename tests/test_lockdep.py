@@ -1,0 +1,218 @@
+"""Runtime lockdep sanitizer self-tests (analysis/sanitizer.py).
+
+The key test provokes a REAL two-thread AB/BA lock-order inversion —
+sequenced so the threads never actually deadlock — and asserts the
+sanitizer reports it the first time it is observed. Also covered:
+lock-class identity by construction site, the instrumentation
+boundary (only repo-root code gets instrumented locks), RLock
+reentrancy, the Condition protocol round-trip, hold-time warnings,
+report()/findings() bridging, and install/uninstall hygiene.
+"""
+import os
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.analysis import sanitizer as sz
+from paddle_tpu.framework.flags import flag_value, set_flags
+
+pytestmark = pytest.mark.pdlint
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+@pytest.fixture
+def lockdep():
+    """Install the sanitizer scoped to the tests/ directory so locks
+    constructed by THIS file are instrumented; restore everything
+    (including a conftest-level install under FLAGS_lockdep) after."""
+    was_installed = sz.installed()
+    sz.set_root_for_tests(_HERE)
+    sz.install()
+    sz.reset()
+    try:
+        yield sz
+    finally:
+        sz.reset()                 # injected inversions must not trip
+        sz.set_root_for_tests(None)  # the conftest _lockdep_guard
+        if not was_installed:
+            sz.uninstall()
+
+
+def _ab_ba(lockdep):
+    """Run the canonical inversion: thread 1 takes A then B, then —
+    strictly after it finished — thread 2 takes B then A. No
+    interleaving, so no actual deadlock; lockdep must still see it."""
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+    assert type(lock_a).__name__ == "_InstrumentedLock"
+
+    def first():
+        with lock_a:
+            with lock_b:
+                pass
+
+    t1 = threading.Thread(target=first)
+    t1.start()
+    t1.join(5)
+    assert not t1.is_alive()
+
+    caught = []
+
+    def second():
+        try:
+            with lock_b:
+                with lock_a:
+                    pass
+        except sz.LockdepViolation as e:
+            caught.append(e)
+
+    t2 = threading.Thread(target=second)
+    t2.start()
+    t2.join(5)
+    assert not t2.is_alive(), "sanitizer must not deadlock the test"
+    return caught
+
+
+class TestInversion:
+    def test_ab_ba_raises_first_time_observed(self, lockdep):
+        caught = _ab_ba(lockdep)
+        assert len(caught) == 1
+        assert "inversion" in str(caught[0])
+        rep = lockdep.report()
+        assert len(rep["inversions"]) == 1
+        assert rep["inversions"][0]["kind"] == "inversion"
+
+    def test_raise_flag_off_records_only(self, lockdep):
+        set_flags({"FLAGS_lockdep_raise": False})
+        try:
+            caught = _ab_ba(lockdep)
+        finally:
+            set_flags({"FLAGS_lockdep_raise": True})
+        assert caught == []
+        assert len(lockdep.report()["inversions"]) == 1
+
+    def test_violating_acquire_is_aborted(self, lockdep):
+        _ab_ba(lockdep)
+        # after the raise, the violating thread holds NEITHER lock:
+        # both must be immediately acquirable
+        rep = lockdep.report()
+        assert len(rep["inversions"]) == 1
+        # a second AB/BA round dedupes (one report per class pair)
+        caught = _ab_ba(lockdep)
+        assert caught == []
+        assert len(lockdep.report()["inversions"]) == 1
+
+    def test_same_class_nesting_is_not_inversion(self, lockdep):
+        locks = [threading.Lock() for _ in range(2)]
+        with locks[0]:
+            with locks[1]:
+                pass
+        with locks[1]:
+            with locks[0]:
+                pass
+        assert lockdep.report()["inversions"] == []
+
+    def test_consistent_order_is_clean(self, lockdep):
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+        for _ in range(3):
+            with lock_a:
+                with lock_b:
+                    pass
+        rep = lockdep.report()
+        assert rep["inversions"] == []
+        assert len(rep["edges"]) == 1
+
+
+class TestPrimitives:
+    def test_rlock_reentrancy_single_hold(self, lockdep):
+        r = threading.RLock()
+        with r:
+            with r:
+                with r:
+                    pass
+        rep = lockdep.report()
+        assert rep["inversions"] == []
+        # one logical hold despite three acquires
+        assert rep["acquires"] == 1
+
+    def test_condition_wait_notify_roundtrip(self, lockdep):
+        cv = threading.Condition(threading.Lock())
+        done = []
+
+        def waiter():
+            with cv:
+                while not done:
+                    cv.wait(1.0)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.05)
+        with cv:
+            done.append(1)
+            cv.notify_all()
+        t.join(5)
+        assert not t.is_alive()
+
+    def test_bare_condition(self, lockdep):
+        cv = threading.Condition()
+        with cv:
+            cv.notify_all()
+        assert lockdep.report()["acquires"] >= 1
+
+    def test_hold_warning(self, lockdep):
+        set_flags({"FLAGS_lockdep_hold_warn_ms": 1.0})
+        try:
+            lk = threading.Lock()
+            with lk:
+                time.sleep(0.01)
+        finally:
+            set_flags({"FLAGS_lockdep_hold_warn_ms": 100.0})
+        holds = lockdep.report()["long_holds"]
+        assert len(holds) == 1
+        assert holds[0]["held_ms"] >= 1.0
+
+    def test_lock_class_is_construction_site(self, lockdep):
+        made = [threading.Lock() for _ in range(5)]
+        assert made
+        classes = lockdep.report()["classes"]
+        site, = [c for c in classes
+                 if c.startswith("test_lockdep.py:")]
+        assert classes[site] == 5     # five instances, ONE class
+
+
+class TestBoundary:
+    def test_out_of_root_code_gets_native_lock(self, lockdep):
+        # constructions from outside the instrumented root (here: a
+        # synthetic module compiled under /) stay native
+        ns = {}
+        code = compile("import threading\n"
+                       "lk = threading.Lock()\n",
+                       "/not-in-repo/other.py", "exec")
+        exec(code, ns)
+        assert type(ns["lk"]).__name__ == "lock"
+
+    def test_install_uninstall_restores(self):
+        was_installed = sz.installed()
+        sz.install()
+        assert sz.installed()
+        sz.uninstall()
+        assert threading.Lock is sz._REAL_LOCK
+        assert threading.RLock is sz._REAL_RLOCK
+        assert threading.Condition is sz._REAL_CONDITION
+        if was_installed:
+            sz.install()              # leave the world as found
+
+    def test_findings_bridge(self, lockdep):
+        _ab_ba(lockdep)
+        found = lockdep.findings()
+        ld001 = [f for f in found if f.rule == "LD001"]
+        assert len(ld001) == 1
+        assert ld001[0].analyzer == "lockdep"
+        assert ld001[0].detail.startswith("runtime:")
+
+    def test_flags_registered(self):
+        assert flag_value("FLAGS_lockdep") in (True, False)
+        assert flag_value("FLAGS_lockdep_hold_warn_ms") >= 0

@@ -10,6 +10,7 @@ replicas) are marked ``slow``.
 """
 import json
 import os
+import threading
 import time
 import urllib.error
 import urllib.request
@@ -509,6 +510,90 @@ class TestSupervisor:
             assert sup.replica_ids == [0]
         finally:
             sup.stop()
+
+    # LD002 regression (pdlint lock_order): the factory used to run
+    # INSIDE the supervisor lock, so a slow spawn (subprocess.Popen,
+    # model warmup) blocked endpoints()/the monitor/stop() for its
+    # whole duration. Spawns now happen outside the critical section
+    # against a published pending slot.
+    class _FakeProc:
+        def __init__(self, rid):
+            self.rid = rid
+            self.terminated = False
+
+        def poll(self):
+            return 0 if self.terminated else None
+
+        def url(self):
+            return None if self.terminated else f"mock://{self.rid}"
+
+        def terminate(self):
+            self.terminated = True
+
+        def kill(self):
+            self.terminated = True
+
+        def wait(self, timeout=None):
+            return 0
+
+    def test_slow_spawn_does_not_block_discovery(self):
+        unwedge = threading.Event()
+
+        def factory(rid):
+            if rid > 0:
+                unwedge.wait(5)          # second spawn wedges
+            return self._FakeProc(rid)
+
+        sup = fleet.ReplicaSupervisor(
+            factory, 1, poll_interval_s=0.01).start()
+        t = threading.Thread(target=sup.scale_to, args=(2,))
+        try:
+            t.start()
+            time.sleep(0.05)             # factory now blocked
+            t0 = time.monotonic()
+            eps = sup.endpoints()
+            ids = sup.replica_ids
+            counts = sup.restart_counts()
+            dt = time.monotonic() - t0
+            assert dt < 0.25, (
+                f"discovery blocked {dt:.2f}s behind an in-flight "
+                f"spawn — factory must run outside the lock")
+            assert eps == {0: "mock://0"}   # pending slot invisible
+            assert ids == [0, 1]            # ...but reserved
+            assert counts == {0: 0, 1: 0}
+        finally:
+            unwedge.set()
+            t.join(5)
+            sup.stop()
+        assert not t.is_alive()
+        assert sup.endpoints() == {}
+
+    def test_stop_during_spawn_terminates_orphan(self):
+        unwedge = threading.Event()
+        spawned = []
+
+        def factory(rid):
+            unwedge.wait(5)
+            p = self._FakeProc(rid)
+            spawned.append(p)
+            return p
+
+        sup = fleet.ReplicaSupervisor(factory, 1,
+                                      poll_interval_s=0.01)
+        t = threading.Thread(target=sup.start)
+        t.start()
+        try:
+            time.sleep(0.05)             # spawn in flight, lock free
+            t0 = time.monotonic()
+            sup.stop(timeout=1)
+            assert time.monotonic() - t0 < 1.0, \
+                "stop() must not wait behind an in-flight spawn"
+        finally:
+            unwedge.set()
+            t.join(5)
+        assert not t.is_alive()
+        # the late-arriving proc was orphaned and must be terminated
+        assert spawned and spawned[0].terminated
 
     def test_router_follows_supervisor(self):
         fac = fleet.ThreadReplicaFactory(

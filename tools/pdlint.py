@@ -12,6 +12,7 @@ style gates. Usage:
     python tools/pdlint.py --analyzers flag_consistency
     python tools/pdlint.py --write-baseline    # re-baseline (after review!)
     python tools/pdlint.py --dump-flags        # runtime flags_snapshot()
+    python tools/pdlint.py --dump-lock-graph   # lock-order graph as DOT
 
 Findings already recorded in tests/fixtures/pdlint_baseline.json are
 reported as baselined and do NOT fail the run. The baseline is a
@@ -75,6 +76,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dump-flags", action="store_true",
                    help="print framework.flags.flags_snapshot() as "
                         "JSON and exit (runtime registry, not static)")
+    p.add_argument("--dump-lock-graph", action="store_true",
+                   help="print the static lock-order graph as "
+                        "Graphviz DOT and exit (inversion cycles in "
+                        "red); respects positional paths")
     return p
 
 
@@ -110,6 +115,16 @@ def main(argv=None) -> int:
         if not os.path.exists(p):
             print(f"pdlint: no such path: {p}", file=sys.stderr)
             return 2
+
+    if args.dump_lock_graph:
+        from paddle_tpu.analysis import build_lock_graph
+        from paddle_tpu.analysis.core import (iter_python_files,
+                                              parse_files)
+        files = parse_files(list(iter_python_files(paths,
+                                                   root=REPO_ROOT)),
+                            root=REPO_ROOT)
+        sys.stdout.write(build_lock_graph(files).to_dot())
+        return 0
 
     changed = None
     if args.changed_only is not None:

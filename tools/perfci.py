@@ -309,6 +309,27 @@ GATES: List[Dict[str, Any]] = [
             "trickle, brownout) failures are typed sheds or typed "
             "deadline/quota errors — nothing is silently lost "
             "(PR 16)"},
+    {"name": "lockdep_overhead_pct", "metric": "lockdep_overhead",
+     "files": "LOCKDEP_r*.json",
+     "path": ("overhead", "serving", "regression_pct"),
+     "op": "max", "baseline": 0.0, "abs_tol": 5.0, "unit": "%",
+     "why": "the runtime lockdep sanitizer (instrumented Lock/RLock/"
+            "Condition, per-thread acquisition stacks, observed "
+            "order graph) must tax the lock-heavy dynamic-batched "
+            "serving path <= 5% (PR 19; paired-trial trimmed mean)"},
+    {"name": "lockdep_drill_detects", "metric": "lockdep_overhead",
+     "files": "LOCKDEP_r*.json",
+     "path": ("drill", "inversion_detected"), "op": "true",
+     "why": "an injected two-thread AB/BA lock-order inversion must "
+            "be reported the first time it is OBSERVED, without "
+            "deadlocking the drill (PR 19)"},
+    {"name": "lockdep_static_ld_clean", "metric": "lockdep_overhead",
+     "files": "LOCKDEP_r*.json", "path": ("pdlint", "ld_clean"),
+     "op": "true",
+     "why": "the static lock-order analyzer (LD001 inversion cycles, "
+            "LD002 blocking under a lock, LD003 naked Condition."
+            "wait) must be repo-clean with zero baseline entries — "
+            "genuine findings get fixed, not baselined (PR 19)"},
 ]
 
 
