@@ -240,6 +240,17 @@ define_flag("FLAGS_decode_warmup_from_manifest", False,
             "pre-compile a constructed GenerationServer's decode step "
             "and recorded prefill buckets from its persisted warmup "
             "manifest under FLAGS_compile_cache_dir")
+define_flag("FLAGS_serving_mesh_mp", 1,
+            "tensor-parallel degree of ONE serving replica: the "
+            "replica spans a {'mp': N} device mesh, weights shard by "
+            "the shard.py rule tables, paged KV pools shard along the "
+            "heads axis ([pages, page_size, heads/mp, head_dim]), and "
+            "the prefill/chunked/verify/decode entry points run GSPMD-"
+            "partitioned across all N chips (serving/mesh.py). <=1 = "
+            "single-shard (today's exact behavior: same fingerprints, "
+            "no recompiles). num_heads must divide evenly or "
+            "construction fails fast. Read once at server/backend "
+            "construction, like FLAGS_decode_pallas_attention")
 
 # Persistent compile cache (paddle_tpu.compile_cache — cold-start
 # amortization across processes).

@@ -354,6 +354,61 @@ class Predictor:
         # the CURRENT iteration's result); run() updates _value in place
         self._outputs: List[Tensor] = []
         self._output_handles: Dict[str, Tensor] = {}
+        # tensor-parallel serving mesh (serving/mesh.py), attached via
+        # attach_serving_mesh; None = single-shard (today's exact
+        # dispatch, fingerprints and cache keys)
+        self._serving_mesh = None
+        self._weight_spec_hash: Optional[str] = None
+
+    def attach_serving_mesh(self, mesh):
+        """Make this predictor's replica span a multi-chip ``{'mp': N}``
+        mesh: weights re-place committed-sharded through the
+        ``distributed.shard`` name rules + shape heuristics (the same
+        tables the training path and ``CachedDecoder`` use) and GSPMD
+        partitions the serving call from the operand layouts. Host-side
+        staging, codecs, breakers, deadlines all ride unchanged. Drops
+        every compiled/placement memo (the layouts changed); the spec
+        hash + mesh join the AOT cache key, so a mesh change can never
+        hit a single-shard executable. An inert mesh (None / 1 device)
+        restores today's behavior exactly. Returns self."""
+        from ..serving.mesh import ServingMesh
+        smesh = mesh if isinstance(mesh, ServingMesh) else ServingMesh(mesh)
+        self._serving_mesh = smesh
+        self._weight_spec_hash = None
+        self._serving_calls = {}
+        self._aot_execs = {}
+        self._xstats_memo = {}
+        self._feed_cache = {}
+        meta = getattr(self._artifact, "meta", None)
+        if not smesh.live:
+            if meta is not None:
+                # back to single-shard placement (committed, default
+                # device) so a detach really is a full round-trip
+                self._artifact._commit_weights()
+            return self
+        if meta is None:
+            raise ValueError(
+                "attach_serving_mesh needs the StableHLO artifact path "
+                "(.pdexec): the protobuf-program path executes per-op "
+                "and has no whole-program executable to partition")
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from ..distributed.shard import (default_rules, normalize_spec,
+                                         spec_tree_hash)
+        rules = default_rules()
+        names = list(meta["weight_names"])
+        specs: Dict[str, tuple] = {}
+        placed = []
+        for n, w in zip(names, self._artifact._weight_list):
+            spec = normalize_spec(rules.spec_for(n, tuple(w.shape)),
+                                  smesh.mesh, tuple(w.shape))
+            specs[n] = spec
+            placed.append(jax.device_put(
+                w, NamedSharding(smesh.mesh, PartitionSpec(*spec))))
+        self._artifact._weight_list = placed
+        self._weight_spec_hash = spec_tree_hash(specs)
+        return self
 
     # ---- reference Predictor API ----
     def get_input_names(self) -> List[str]:
@@ -514,9 +569,18 @@ class Predictor:
                 x_specs = [jax.ShapeDtypeStruct(tuple(a.shape),
                                                 np.dtype(a.dtype))
                            for a in assembled]
+                smesh = self._serving_mesh
+                extra = {"site": "serving", "donate": bool(donating)}
+                if smesh is not None and smesh.live:
+                    # spec tree + mesh join the key (the PR 10
+                    # pattern): a resharded replica can never load a
+                    # single-shard executable or vice versa
+                    extra["weight_specs"] = self._weight_spec_hash
                 key, parts = cc.cache_key(
-                    fp, [w_specs, x_specs], mesh=None,
-                    extra={"site": "serving", "donate": bool(donating)})
+                    fp, [w_specs, x_specs],
+                    mesh=None if smesh is None
+                    else smesh.mesh_for_cache_key(),
+                    extra=extra)
                 fn, _hit = cache.get_or_compile(
                     key, lambda: jitted.lower(w_specs, *x_specs).compile(),
                     site="serving", meta=parts,

@@ -169,10 +169,10 @@ class GPTKVCache:
     """
 
     __slots__ = ("kind", "page_size", "k", "v", "block_tables",
-                 "ctx_len", "valid", "positions", "use_pallas")
+                 "ctx_len", "valid", "positions", "use_pallas", "mesh")
 
     def __init__(self, kind, page_size, k, v, block_tables, ctx_len,
-                 valid, positions, use_pallas=None):
+                 valid, positions, use_pallas=None, mesh=None):
         if kind not in ("prefill", "decode", "chunked"):
             raise ValueError(f"kind must be 'prefill', 'decode' or "
                              f"'chunked', got {kind!r}")
@@ -185,6 +185,12 @@ class GPTKVCache:
         self.valid = valid
         self.positions = positions
         self.use_pallas = use_pallas
+        # serving replica's tensor-parallel mesh (serving/mesh.py) —
+        # threaded EXPLICITLY because the engine dispatches from worker
+        # threads that never see the thread-local global mesh. Only the
+        # Pallas shard_map dispatch consumes it; the pure-JAX path
+        # relies on GSPMD propagating the operands' heads sharding.
+        self.mesh = mesh
 
 
 class GPTEmbeddings(Layer):
@@ -266,7 +272,8 @@ class GPTAttention(Layer):
                 kv_cache.block_tables, kv_cache.ctx_len, kv_cache.valid,
                 kv_cache.positions, *k_leaves, *v_leaves,
                 page_size=kv_cache.page_size, kind=kv_cache.kind,
-                use_flash=self.use_flash, use_pallas=kv_cache.use_pallas)
+                use_flash=self.use_flash, use_pallas=kv_cache.use_pallas,
+                mesh=kv_cache.mesh)
             out = res[0]
             k_pool = _jax.tree_util.tree_unflatten(
                 pool_def, res[1:1 + nk])
@@ -362,15 +369,19 @@ def _stacked_layer_fwd(p, x, *, num_heads, head_dim, eps, mp_size, sep_size,
     sm_scale = 1.0 / math.sqrt(head_dim)
     k_pool = v_pool = None
     if kv is not None:
-        # paged-cache decode/prefill (single shard: mp/sep degenerate —
-        # GPTStackedTransformer enforces that before routing here)
+        # paged-cache decode/prefill. The scan body always runs
+        # single-program (mp_size=1 — GPTStackedTransformer enforces
+        # that before routing here); under a serving mesh the operands
+        # arrive mp-sharded and GSPMD partitions this whole block,
+        # except the Pallas kernels which dispatch per-shard through
+        # shard_map inside paged_attention_update (mesh kwarg).
         from ..ops.paged_attention import paged_attention_update
         (kp, vp, tables, ctx, valid, positions, page_size, kind,
-         use_pallas) = kv
+         use_pallas, serving_mesh) = kv
         attn, k_pool, v_pool = paged_attention_update(
             q, k, v, kp, vp, tables, ctx, valid, positions,
             page_size=page_size, kind=kind, use_flash=use_flash,
-            use_pallas=use_pallas)
+            use_pallas=use_pallas, mesh=serving_mesh)
     elif sep_size > 1:
         from ..ops.ring_attention import _ring_attention_local
         attn = _ring_attention_local(q, k, v, axis_name="sep",
@@ -556,13 +567,19 @@ class GPTStackedTransformer(Layer):
     def _forward_cached(self, x, params, names, cache):
         """Paged-cache scan: pools are stacked ``[L, num_pages, ...]``
         arrays carried through ``lax.scan`` alongside the layer-stacked
-        params. Single-shard only — cached decode under a live pp/mp/sep
-        mesh is not supported (the serving engine runs one replica)."""
+        params. A live 'mp' axis is fine: the scan body stays
+        single-program (mp_size=1) and GSPMD partitions it from the
+        operands' committed shardings (mp-sharded weights, heads-sharded
+        pools — serving/mesh.py), inserting the out/fc2 reduction
+        collectives itself. Only pp and sep genuinely can't thread a
+        paged-pool scan (stage-sliced layers / seq-sharded gather) and
+        still raise, naming the offending axis."""
         import functools
 
         cfg = self.config
         page_size, kind = cache.page_size, cache.kind
         use_pallas = cache.use_pallas
+        serving_mesh = cache.mesh
         # pool leaves ride flattened through apply_op (quantized pools
         # are (values, scales) tuples; dispatch only unwraps top-level
         # Tensor args) and re-assemble inside the traced fn
@@ -573,11 +590,15 @@ class GPTStackedTransformer(Layer):
         def fn(x_arr, tables, ctx, valid, positions, *rest):
             from ..distributed.mesh_utils import get_global_mesh
             mesh = get_global_mesh()
-            if mesh is not None and any(
-                    mesh.shape.get(a, 1) > 1 for a in ("pp", "mp", "sep")):
-                raise NotImplementedError(
-                    "KV-cached decode is single-shard: drop the pp/mp/"
-                    "sep mesh axes (dp replicas serve independently)")
+            for axis in ("pp", "sep"):
+                if mesh is not None and mesh.shape.get(axis, 1) > 1:
+                    raise NotImplementedError(
+                        f"KV-cached decode cannot run under a live "
+                        f"'{axis}' mesh axis: the paged-pool scan "
+                        f"carries whole layers and whole sequences. "
+                        f"Drop '{axis}' — dp replicas serve "
+                        f"independently and 'mp' tensor-parallelism is "
+                        f"supported via serving.mesh.ServingMesh")
             k_pools = jax.tree_util.tree_unflatten(pool_def, rest[:nk])
             v_pools = jax.tree_util.tree_unflatten(
                 pool_def, rest[nk:2 * nk])
@@ -593,7 +614,7 @@ class GPTStackedTransformer(Layer):
                 out, kp2, vp2 = layer(
                     p_slice, c, kv=(kp, vp, tables, ctx, valid,
                                     positions, page_size, kind,
-                                    use_pallas))
+                                    use_pallas, serving_mesh))
                 return out, (kp2, vp2)
 
             # scan slices each pool leaf's leading (layer) dim — tuple
@@ -649,7 +670,8 @@ class GPTModel(Layer):
                 view = GPTKVCache(
                     cache.kind, cache.page_size, cache.k[i], cache.v[i],
                     cache.block_tables, cache.ctx_len, cache.valid,
-                    cache.positions, use_pallas=cache.use_pallas)
+                    cache.positions, use_pallas=cache.use_pallas,
+                    mesh=cache.mesh)
                 h, k_i, v_i = layer(h, kv_cache=view)
                 k_new.append(k_i)
                 v_new.append(v_i)

@@ -210,6 +210,22 @@ def _statusz() -> dict:
             out["sharding"] = sharding
     except Exception:  # noqa: BLE001
         pass
+    try:  # serving tensor-parallel mesh: replica mesh shape + the
+        # per-chip projected KV-pool bytes of every live decode engine
+        # (lazy — absent until a mesh-attached engine exists)
+        gen_engine = sys.modules.get(
+            "paddle_tpu.serving.generation.engine")
+        if gen_engine is not None:
+            meshes = {}
+            for name, snap in (out.get("decode_engines") or {}).items():
+                sm = snap.get("serving_mesh")
+                if sm:
+                    meshes[name] = sm
+            if meshes:
+                sharding = out.setdefault("sharding", {})
+                sharding["serving_mesh"] = meshes
+    except Exception:  # noqa: BLE001
+        pass
     return out
 
 

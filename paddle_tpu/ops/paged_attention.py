@@ -220,10 +220,72 @@ def _chunked_attention(q, ks, vs, positions, valid, scale):
     return jnp.einsum("bhqt,bthd->bqhd", probs, vs)
 
 
+def _pool_shard_spec(pool):
+    """shard_map PartitionSpecs for one pool pytree, heads axis on
+    'mp': values [..., P, page, H, D] → P(None, None, 'mp', None),
+    quantized scales [..., P, page, H] → P(None, None, 'mp')."""
+    from jax.sharding import PartitionSpec as P
+    if is_quantized_pool(pool):
+        return (P(None, None, "mp", None), P(None, None, "mp"))
+    return P(None, None, "mp", None)
+
+
+def _mesh_mp(mesh):
+    """Live tensor-parallel degree of a serving mesh (0 when absent or
+    degenerate)."""
+    if mesh is None:
+        return 0
+    mp = int(mesh.shape.get("mp", 1))
+    return mp if mp > 1 else 0
+
+
+def _sharded_paged_attention(mesh, q, k_pool, v_pool, block_tables,
+                             ctx_len, valid, positions, *, page_size,
+                             kind, scale):
+    """Per-shard Pallas dispatch under a live mp mesh: every rank runs
+    the fused kernel on ITS heads-axis block of q and the pools
+    (attention is embarrassingly parallel over heads — no collective in
+    the body). GSPMD cannot partition a pallas_call itself, so this
+    shard_map wrapper is what keeps the fused path available under
+    tensor parallelism; the kernel sees local shapes, so the autotune
+    block table picks tile sizes for H/mp heads."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..distributed.mesh_utils import manual_shard_map
+    from . import pallas_paged_attention as ppa
+
+    def body(q_loc, kp_loc, vp_loc, tables, ctx, val, pos):
+        return ppa.paged_attention(
+            q_loc, kp_loc, vp_loc, tables, ctx, val, pos,
+            page_size=page_size, kind=kind, scale=scale)
+
+    qspec = P(None, None, "mp", None)
+    in_specs = (qspec, _pool_shard_spec(k_pool), _pool_shard_spec(v_pool),
+                P(), P(), P(), P())
+    return manual_shard_map(body, mesh, in_specs, qspec)(
+        q, k_pool, v_pool, block_tables, ctx_len, valid, positions)
+
+
+def _sharded_prefill_flash(mesh, q, k, v, scale, use_flash):
+    """Heads-sharded prefill through the flash kernel: each rank runs
+    the Pallas mha on its H/mp heads of the window."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..distributed.mesh_utils import manual_shard_map
+    from .pallas_paged_attention import prefill_flash
+
+    def body(q_loc, k_loc, v_loc):
+        return prefill_flash(q_loc, k_loc, v_loc, scale,
+                             use_flash=use_flash)
+
+    spec = P(None, None, "mp", None)
+    return manual_shard_map(body, mesh, (spec, spec, spec), spec)(q, k, v)
+
+
 def paged_attention_update(q, k, v, k_pool, v_pool, block_tables,
                            ctx_len, valid, positions, *, page_size: int,
                            kind: str, use_flash: bool = True,
-                           use_pallas=None):
+                           use_pallas=None, mesh=None):
     """One layer's cache-aware attention: write this call's K/V into the
     paged pool, then attend.
 
@@ -259,6 +321,17 @@ def paged_attention_update(q, k, v, k_pool, v_pool, block_tables,
     body below stays the reference and the automatic fallback for
     unsupported shapes.
 
+    ``mesh`` is the serving replica's tensor-parallel mesh
+    (serving/mesh.py) with weights and pools heads-sharded over 'mp'.
+    It only changes HOW the Pallas kernels dispatch: GSPMD cannot
+    partition a pallas_call, so under a live 'mp' axis the fused
+    kernels run per-shard through shard_map (each rank on its H/mp
+    heads-block of q and the pools). The pure-JAX path ignores the mesh
+    entirely — write/gather/attend are all heads-pointwise, and GSPMD
+    partitions them from the operands' committed shardings; that path
+    is the oracle the shard_map dispatch is tested against. Heads that
+    don't divide mp fall back to pure JAX.
+
     Returns (attn_out [B, S, H, D], k_pool', v_pool').
     """
     if kind not in KINDS:
@@ -266,16 +339,24 @@ def paged_attention_update(q, k, v, k_pool, v_pool, block_tables,
     if use_pallas is None:
         from ..framework.flags import flag_value
         use_pallas = bool(flag_value("FLAGS_decode_pallas_attention"))
+    mp = _mesh_mp(mesh)
+    heads = q.shape[2]
+    sharded = use_pallas and mp > 0 and heads % mp == 0
     b, s = q.shape[0], q.shape[1]
     slots = flat_slots(block_tables, positions, valid, page_size)
     slots_flat = slots.reshape(b * s)
+    # the pool scatter stays OUTSIDE shard_map: the flat
+    # [P*page, H, D] reshape keeps the heads dim intact, so GSPMD
+    # partitions the write from the pool's committed sharding
     k_pool = write_pool(k_pool, slots_flat,
                         k.reshape(b * s, *k.shape[2:]))
     v_pool = write_pool(v_pool, slots_flat,
                         v.reshape(b * s, *v.shape[2:]))
     scale = 1.0 / math.sqrt(q.shape[-1])
     if kind == "prefill":
-        if use_pallas:
+        if sharded:
+            out = _sharded_prefill_flash(mesh, q, k, v, scale, use_flash)
+        elif use_pallas:
             from .pallas_paged_attention import prefill_flash
             out = prefill_flash(q, k, v, scale, use_flash=use_flash)
         else:
@@ -286,9 +367,16 @@ def paged_attention_update(q, k, v, k_pool, v_pool, block_tables,
     if use_pallas:
         from . import pallas_paged_attention as ppa
         if ppa.supported(q, k_pool, block_tables, page_size, kind):
-            out = ppa.paged_attention(
-                q, k_pool, v_pool, block_tables, ctx_len, valid,
-                positions, page_size=page_size, kind=kind, scale=scale)
+            if sharded:
+                out = _sharded_paged_attention(
+                    mesh, q, k_pool, v_pool, block_tables, ctx_len,
+                    valid, positions, page_size=page_size, kind=kind,
+                    scale=scale)
+            else:
+                out = ppa.paged_attention(
+                    q, k_pool, v_pool, block_tables, ctx_len, valid,
+                    positions, page_size=page_size, kind=kind,
+                    scale=scale)
             return out, k_pool, v_pool
     ks = gather_pool(k_pool, block_tables, out_dtype=q.dtype)
     vs = gather_pool(v_pool, block_tables, out_dtype=q.dtype)

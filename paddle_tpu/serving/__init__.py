@@ -61,6 +61,7 @@ from . import metrics  # noqa: F401  (the registry sub-namespace)
 from .batcher import DynamicBatcher
 from .bucketing import BucketSpec, ShapeBucketPolicy, next_pow2
 from .capi import wrap_capi
+from .mesh import ServingMesh, serving_mesh_from_flags
 from .metrics import ServingMetrics
 from .request import (DeadlineExceededError, QueueFullError,
                       QuotaExceededError, Request, ServerClosedError)
@@ -73,5 +74,5 @@ __all__ = [
     "BucketSpec", "ServingMetrics", "Request", "QueueFullError",
     "QuotaExceededError", "DeadlineExceededError", "ServerClosedError",
     "wrap_capi", "next_pow2", "metrics", "generation", "fleet",
-    "scheduling",
+    "scheduling", "ServingMesh", "serving_mesh_from_flags",
 ]

@@ -276,6 +276,14 @@ class PredictorBackend:
         self._reloading = False
         self._gen = None
         self.wedge_monitor = WedgeMonitor()
+        # replica = mesh: ONE backend owns every device of its
+        # FLAGS_serving_mesh_mp tensor-parallel mesh (serving/mesh.py);
+        # the router, codec, breakers, deadlines, tenant scheduling and
+        # numerics canaries above this line see one replica as before.
+        # Built once here so predictor AND generation engine (and every
+        # reload) share the same device set.
+        from ..mesh import serving_mesh_from_flags
+        self.serving_mesh = serving_mesh_from_flags()
         self._server, self._version = self._build(model_prefix)
         if generation_model is not None:
             from ..generation import GenerationServer
@@ -283,13 +291,16 @@ class PredictorBackend:
             # gates at token cost and schedules decode WFQ/priority
             self._gen = GenerationServer(generation_model,
                                          name=f"{name}-gen",
-                                         scheduler=_worker_scheduler())
+                                         scheduler=_worker_scheduler(),
+                                         mesh=self.serving_mesh)
 
     def _build(self, model_prefix: str):
         from ... import inference
         from ..server import InferenceServer
         pred = inference.create_predictor(
             inference.Config(str(model_prefix)))
+        if self.serving_mesh.live:
+            pred.attach_serving_mesh(self.serving_mesh)
         srv = InferenceServer(
             pred, max_batch_size=self._max_batch_size,
             seq_buckets=self._seq_buckets, seq_axis=self._seq_axis,
@@ -397,9 +408,12 @@ class PredictorBackend:
     def info(self) -> dict:
         with self._lock:
             version = self._version
-        return {"backend": "predictor", "version": version,
-                "name": self._name,
-                "generation": self._gen is not None}
+        out = {"backend": "predictor", "version": version,
+               "name": self._name,
+               "generation": self._gen is not None}
+        if self.serving_mesh.live:
+            out["serving_mesh"] = self.serving_mesh.statusz()
+        return out
 
     def shutdown(self, drain: bool = True):
         self._server.shutdown(drain=drain)

@@ -507,10 +507,23 @@ class GenerationServer:
                  draft_model=None,
                  spec_k: Optional[int] = None,
                  scheduler=None,
+                 mesh=None,
                  start: bool = True):
         model.eval()
         self.model = model
         spec = model.kv_cache_spec()
+        # tensor-parallel replica mesh (serving/mesh.py): None defers
+        # to FLAGS_serving_mesh_mp, read ONCE here like the other
+        # decode knobs. The mesh is threaded EXPLICITLY through the
+        # decoder and pools — the engine's worker thread never sees a
+        # caller's thread-local global mesh.
+        from ..mesh import ServingMesh, serving_mesh_from_flags
+        if mesh is None:
+            self.serving_mesh = serving_mesh_from_flags()
+        else:
+            self.serving_mesh = mesh if isinstance(mesh, ServingMesh) \
+                else ServingMesh(mesh)
+        self.serving_mesh.validate_heads(int(spec["num_heads"]))
         self.max_batch = int(max_batch if max_batch is not None
                              else _flag("FLAGS_decode_max_batch", 8))
         self.page_size = int(page_size if page_size is not None
@@ -561,10 +574,12 @@ class GenerationServer:
             model, max_batch=self.max_batch, page_size=self.page_size,
             pages_per_seq=self.pages_per_seq, donate=donate,
             max_positions=self.max_seq_len,
-            use_pallas=self.use_pallas, kv_dtype=self.kv_dtype)
+            use_pallas=self.use_pallas, kv_dtype=self.kv_dtype,
+            mesh=self.serving_mesh)
         self.kv = PagedKVCache(model, num_pages=int(num_pages),
                                page_size=self.page_size,
-                               dtype=self.kv_dtype or None)
+                               dtype=self.kv_dtype or None,
+                               mesh=self.serving_mesh)
         # ---- shared-prefix KV reuse (radix index over full pages)
         if prefix_cache is None:
             prefix_cache = bool(_flag("FLAGS_decode_prefix_cache", True))
@@ -596,10 +611,14 @@ class GenerationServer:
                 page_size=self.page_size,
                 pages_per_seq=self.pages_per_seq, donate=donate,
                 max_positions=self.max_seq_len,
-                use_pallas=self.use_pallas, kv_dtype=self.kv_dtype)
+                use_pallas=self.use_pallas, kv_dtype=self.kv_dtype,
+                mesh=self.serving_mesh)
             self._draft_k, self._draft_v = draft_model.init_kv_pools(
                 self.kv.num_pages, self.page_size,
                 self.kv_dtype or None)
+            self._draft_k, self._draft_v = \
+                self.serving_mesh.place_pools(self._draft_k,
+                                              self._draft_v)
         self.metrics = DecodeMetrics(name, self.max_batch,
                                      self.kv.capacity)
         self.metrics.set_kv_pages(0, self.kv.capacity)
@@ -754,6 +773,11 @@ class GenerationServer:
                 "kv_leak_check": self.kv.leak_check(),
                 "spec_k": self.spec_k,
             }
+            if self.serving_mesh.live:
+                out["serving_mesh"] = self.serving_mesh.statusz(
+                    kv_pool_bytes=self.kv.pool_bytes(),
+                    num_heads=int(self.model.kv_cache_spec()
+                                  ["num_heads"]))
             if self.prefix is not None:
                 out["prefix_cache"] = self.prefix.stats()
             if self.scheduler is not None:

@@ -39,7 +39,7 @@ class PagedKVCache:
     """
 
     def __init__(self, model, num_pages: int, page_size: int,
-                 dtype=None):
+                 dtype=None, mesh=None):
         if num_pages < 2:
             raise ValueError("need at least one allocatable page plus "
                              "the trash page")
@@ -50,6 +50,15 @@ class PagedKVCache:
         self.kv_dtype = dtype if isinstance(dtype, str) else ""
         self.k, self.v = model.init_kv_pools(self.num_pages,
                                              self.page_size, dtype)
+        # serving mesh (serving/mesh.py): heads-sharded committed
+        # placement of the pool leaves. EVERYTHING host-side below —
+        # free list, refcounts, block tables — is layout-agnostic and
+        # identical with or without a mesh; only device bytes move.
+        if mesh is not None:
+            from ..mesh import ServingMesh
+            smesh = mesh if isinstance(mesh, ServingMesh) \
+                else ServingMesh(mesh)
+            self.k, self.v = smesh.place_pools(self.k, self.v)
         self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
         self._ref: Dict[int, int] = {}      # page -> live reference count
         self.evicted_pages_total = 0

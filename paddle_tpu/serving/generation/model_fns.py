@@ -70,12 +70,13 @@ class CachedDecoder:
                  pages_per_seq: int, donate: Optional[bool] = None,
                  max_positions: Optional[int] = None,
                  use_pallas: Optional[bool] = None,
-                 kv_dtype: Optional[str] = None):
+                 kv_dtype: Optional[str] = None, mesh=None):
         import jax
 
         from ...framework.flags import flag_value
         from ...jit.functional import state_arrays
         from ...models.gpt import GPTKVCache
+        from ..mesh import ServingMesh
 
         if not supports_cached_decode(model):
             raise TypeError(
@@ -86,6 +87,14 @@ class CachedDecoder:
         self.max_batch = int(max_batch)
         self.page_size = int(page_size)
         self.pages_per_seq = int(pages_per_seq)
+        # the replica's tensor-parallel mesh (serving/mesh.py): weights
+        # shard by the shard.py rule tables, pools along the heads axis.
+        # An inert mesh (None or 1 device) leaves EVERYTHING on the
+        # single-shard path byte-for-byte — fingerprints, cache keys,
+        # placement (regression-tested).
+        smesh = mesh if isinstance(mesh, ServingMesh) else ServingMesh(mesh)
+        smesh.validate_heads(int(model.kv_cache_spec()["num_heads"]))
+        self.serving_mesh = smesh
         # pinned at construction: a flag flip mid-lifetime must not
         # silently retrace half the entry points (both join the
         # geometry fingerprint, so warmup manifests and the persistent
@@ -100,6 +109,12 @@ class CachedDecoder:
             max_positions if max_positions is not None
             else model.kv_cache_spec()["max_seq_len"])
         self._params, self._buffers = state_arrays(model)
+        if smesh.live:
+            # committed mp-sharded placement: GSPMD partitions every
+            # entry point from these operand layouts — no in_shardings
+            # needed on the jits
+            self._params, self._buffers = smesh.place_state(
+                self._params, self._buffers, model=model)
         self._donate = bool(donate) if donate is not None \
             else jax.default_backend() != "cpu"
         self._fp: Optional[str] = None
@@ -125,6 +140,9 @@ class CachedDecoder:
         page = self.page_size
         use_pallas = self.use_pallas
         max_pos = self.max_positions
+        # threaded into the traced fns: pool-entry constraints + the
+        # per-shard Pallas dispatch (GPTKVCache.mesh); None when inert
+        live_mesh = smesh.mesh if smesh.live else None
 
         from ...distributed.shard import constrain_batch
 
@@ -142,6 +160,10 @@ class CachedDecoder:
                 # (the single-replica engine default) this is the
                 # identity
                 ids = constrain_batch(ids)
+                # heads-axis pin on the pool operands: GSPMD must never
+                # gather a pool (identity when the mesh is inert)
+                k = smesh.constrain_pools(k)
+                v = smesh.constrain_pools(v)
                 b, s = ids.shape
                 positions = jnp.broadcast_to(
                     jnp.arange(s, dtype=jnp.int32), (b, s))
@@ -151,7 +173,8 @@ class CachedDecoder:
                     jax.tree_util.tree_map(_wrap, k),
                     jax.tree_util.tree_map(_wrap, v),
                     _wrap(tables), _wrap(prompt_lens), _wrap(valid),
-                    _wrap(positions), use_pallas=use_pallas)
+                    _wrap(positions), use_pallas=use_pallas,
+                    mesh=live_mesh)
                 logits, (k2, v2) = functional_call(
                     model, params, buffers, ids, cache=cache,
                     training=False)
@@ -161,11 +184,15 @@ class CachedDecoder:
                 idx = jnp.broadcast_to(idx[:, None, None],
                                        (b, 1, logits.shape[-1]))
                 last = jnp.take_along_axis(logits, idx, axis=1)[:, 0]
-                return last, k2, v2
+                # tied lm_head leaves logits vocab-sharded under mp:
+                # gather ONCE inside the executable, not on the host
+                return smesh.replicate(last), k2, v2
 
             def _decode(params, buffers, tokens, positions, active,
                         ctx, tables, k, v):
                 tokens = constrain_batch(tokens)
+                k = smesh.constrain_pools(k)
+                v = smesh.constrain_pools(v)
                 b = tokens.shape[0]
                 ids = tokens[:, None]
                 cache = GPTKVCache(
@@ -174,11 +201,11 @@ class CachedDecoder:
                     jax.tree_util.tree_map(_wrap, v),
                     _wrap(tables), _wrap(ctx), _wrap(active[:, None]),
                     _wrap(positions[:, None].astype(jnp.int32)),
-                    use_pallas=use_pallas)
+                    use_pallas=use_pallas, mesh=live_mesh)
                 logits, (k2, v2) = functional_call(
                     model, params, buffers, ids, cache=cache,
                     training=False)
-                return logits[:, 0], k2, v2
+                return smesh.replicate(logits[:, 0]), k2, v2
 
             def _chunked(params, buffers, ids, start, seg_lens, tables,
                          k, v):
@@ -187,6 +214,8 @@ class CachedDecoder:
                 # prefix through the block tables (kind="chunked").
                 # Returns ALL window logits [B, S, vocab].
                 ids = constrain_batch(ids)
+                k = smesh.constrain_pools(k)
+                v = smesh.constrain_pools(v)
                 b, s = ids.shape
                 offs = jnp.arange(s, dtype=jnp.int32)[None, :]
                 positions = start.astype(jnp.int32)[:, None] + offs
@@ -201,11 +230,12 @@ class CachedDecoder:
                     jax.tree_util.tree_map(_wrap, k),
                     jax.tree_util.tree_map(_wrap, v),
                     _wrap(tables), _wrap(ctx), _wrap(valid),
-                    _wrap(positions), use_pallas=use_pallas)
+                    _wrap(positions), use_pallas=use_pallas,
+                    mesh=live_mesh)
                 logits, (k2, v2) = functional_call(
                     model, params, buffers, ids, cache=cache,
                     training=False)
-                return logits, k2, v2
+                return smesh.replicate(logits), k2, v2
 
             def _prefill_chunked(params, buffers, ids, start, seg_lens,
                                  tables, k, v):
@@ -251,6 +281,9 @@ class CachedDecoder:
         training step between calls is picked up)."""
         from ...jit.functional import state_arrays
         self._params, self._buffers = state_arrays(self.model)
+        if self.serving_mesh.live:
+            self._params, self._buffers = self.serving_mesh.place_state(
+                self._params, self._buffers, model=self.model)
 
     # ------------------------------------------------------ identity
     def fingerprint(self) -> str:
@@ -265,6 +298,13 @@ class CachedDecoder:
                     "donate": self._donate,
                     "use_pallas": self.use_pallas,
                     "kv_dtype": self.kv_dtype, "v": 3}
+            # mesh axes + weight spec-tree hash join the geometry ONLY
+            # when the mesh is live: an inert (None / 1-device) mesh
+            # must reuse today's fingerprints byte-for-byte, and a mesh
+            # or spec change must miss every cache keyed on this
+            mesh_parts = self.serving_mesh.fingerprint_parts(self.model)
+            if mesh_parts is not None:
+                geom["serving_mesh"] = mesh_parts
             h = hashlib.sha256(layer_fingerprint(self.model).encode())
             h.update(json.dumps(geom, sort_keys=True).encode())
             self._fp = h.hexdigest()
@@ -290,6 +330,11 @@ class CachedDecoder:
         if not str(flag_value("FLAGS_compile_cache_dir") or ""):
             return None
         sig = (site, flags_generation()) + self._sig_of(args)
+        if self.serving_mesh.live:
+            # PR 10 pattern: spec-tree edits bump the generation, so a
+            # re-annotated model can never hit a stale sharded AOT memo
+            from ...distributed.shard import specs_generation
+            sig = sig + ("specs_gen", specs_generation())
         memo = self._aot
         if sig in memo:
             fn = memo[sig]
@@ -305,7 +350,8 @@ class CachedDecoder:
                     lambda a: jax.ShapeDtypeStruct(
                         tuple(a.shape), np.dtype(a.dtype)), args)
                 key, parts = cc.cache_key(
-                    self.fingerprint(), list(specs), mesh=None,
+                    self.fingerprint(), list(specs),
+                    mesh=self.serving_mesh.mesh_for_cache_key(),
                     extra={"site": site})
                 fn, _hit = cache.get_or_compile(
                     key, lambda: jitted.lower(*specs).compile(),

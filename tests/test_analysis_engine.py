@@ -24,6 +24,7 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 try:
     from paddle_tpu import analysis
     from paddle_tpu.analysis import (DonationSafetyAnalyzer,
+                                     LockDisciplineAnalyzer,
                                      LockOrderAnalyzer,
                                      RecompileRiskAnalyzer,
                                      ResourcePairingAnalyzer,
@@ -1126,7 +1127,56 @@ class TestLockOrder:
 
 
 # ===================================================================
-# 13. runtime budget: the whole gate stays tier-1 fast
+# 13. scope self-test: serving-mesh module is inside the lock gates
+# ===================================================================
+class TestServingMeshScope:
+    """paddle_tpu/serving/mesh.py is new threaded-adjacent serving
+    code — both lock analyzers' default scope must cover it, so a
+    lock bug introduced there trips the tier-1 pdlint gate rather
+    than slipping past an out-of-scope filter."""
+
+    MESH_RELPATH = "paddle_tpu/serving/mesh.py"
+
+    def test_lock_order_scope_covers_serving_mesh(self, tmp_path):
+        _relpath, src = _RULE_SOURCES["LD001"]
+        _write(tmp_path, self.MESH_RELPATH, src)
+        found = _run(tmp_path, [LockOrderAnalyzer()])
+        assert [f.rule for f in found] == ["LD001"]
+        assert found[0].path.replace(os.sep, "/").endswith(
+            self.MESH_RELPATH)
+
+    def test_lock_discipline_scope_covers_serving_mesh(self, tmp_path):
+        _write(tmp_path, self.MESH_RELPATH, """
+            import threading
+
+            class PoolPlacer:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._placed = 0
+
+                def place(self):
+                    with self._lock:
+                        self._placed += 1
+
+                def racy_reset(self):
+                    self._placed = 0        # LK001
+        """)
+        found = _run(tmp_path, [LockDisciplineAnalyzer()])
+        assert [(f.rule, f.symbol) for f in found] == \
+            [("LK001", "PoolPlacer._placed")]
+
+    def test_repo_serving_mesh_is_clean(self):
+        path = os.path.join(REPO_ROOT, "paddle_tpu", "serving",
+                            "mesh.py")
+        assert os.path.exists(path)
+        found = analysis.run_analyzers(
+            [path], [LockOrderAnalyzer(), LockDisciplineAnalyzer()],
+            root=REPO_ROOT)
+        assert found == [], [f.format() for f in found]
+
+
+# ===================================================================
+# 14. runtime budget: the whole gate stays tier-1 fast
 # ===================================================================
 class TestRuntimeBudget:
     BUDGET_S = 60.0

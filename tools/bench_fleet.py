@@ -24,6 +24,17 @@ Three phases, one committed BENCH_FLEET_r*.json record:
    version-stamped v2 artifact. Asserts ZERO failed requests and that
    post-swap outputs match a local v2 reference predictor.
 
+A separately-invoked slice (``--mesh``) benches TENSOR-PARALLEL
+serving instead (serving/mesh.py: one replica spanning an ``mp``
+mesh): greedy decode tok/s and measured per-chip KV-pool residency
+for the sharded vs the single-shard engine, with a greedy-parity
+cross-check between the two. Emits a BENCH_TP_r*.json record. Honest
+caveat baked into the record: on the CPU virtual-device mesh the mp
+"chips" are XLA partitions sharing one host's cores — partitioning
+overhead without partitioned silicon — so the committed CPU record's
+perf claims are the memory split and parity, not the tok/s ratio;
+the TPU rows rerun via bench.py when a TPU is reachable.
+
 A fourth, separately-invoked phase (``--trace``) exercises the
 distributed-tracing layer instead: a fully-sampled run through the
 router front end whose per-stage span counts are cross-checked
@@ -39,6 +50,7 @@ Usage: JAX_PLATFORMS=cpu python tools/bench_fleet.py
        [--device-ms 12] [--out BENCH_FLEET_rNN.json]
        [--skip-scaleout] [--skip-swap]
        [--trace --out TRACE_rNN.json]
+       [--mesh --mesh-mp 8 --out BENCH_TP_rNN.json]
 """
 import argparse
 import json
@@ -411,6 +423,142 @@ def _phase_swap(args, workdir, prefix_v1, shared_cache):
         sup.stop()
 
 
+# ------------------------------------------------------- tensor-parallel
+def _mesh_decode_trial(model, mesh, *, batch, page_size, pages_per_seq,
+                       prefill_len, steps):
+    """Greedy decode ``steps`` tokens on ``batch`` streams through one
+    CachedDecoder (single-shard when ``mesh`` is None); returns tok/s,
+    the emitted greedy streams (for the parity cross-check) and the
+    MEASURED per-chip pool bytes of the placed KV pools."""
+    import jax
+
+    from paddle_tpu.serving.generation.model_fns import CachedDecoder
+    from paddle_tpu.serving.mesh import ServingMesh
+
+    smesh = ServingMesh(mesh)
+    dec = CachedDecoder(model, max_batch=batch, page_size=page_size,
+                        pages_per_seq=pages_per_seq, donate=False,
+                        use_pallas=False, mesh=smesh)
+    k, v = model.init_kv_pools(1 + batch * pages_per_seq, page_size)
+    k, v = smesh.place_pools(k, v)
+    pool_leaves = jax.tree_util.tree_leaves((k, v))
+    total_kv = sum(int(a.size) * int(a.dtype.itemsize)
+                   for a in pool_leaves)
+    per_chip_kv = sum(int(np.prod(a.addressable_shards[0].data.shape))
+                      * int(a.dtype.itemsize) for a in pool_leaves)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(1, 100, size=(batch, prefill_len)).astype(np.int64)
+    plens = np.full((batch,), prefill_len, np.int32)
+    tables = (1 + np.arange(batch * pages_per_seq, dtype=np.int32)
+              .reshape(batch, pages_per_seq))
+    last, k, v, _ = dec.prefill(ids, plens, tables, k, v)
+    toks = np.asarray(last).argmax(-1).astype(np.int64)
+    active = np.ones((batch,), bool)
+    streams = [toks.copy()]
+    # untimed warmup step compiles the decode executable
+    pos = plens.astype(np.int32)
+    lg, k, v, _ = dec.decode(toks, pos, active, pos + 1, tables, k, v)
+    toks = np.asarray(lg).argmax(-1).astype(np.int64)
+    streams.append(toks.copy())
+    t0 = time.perf_counter()
+    for i in range(steps):
+        pos = (plens + 1 + i).astype(np.int32)
+        lg, k, v, _ = dec.decode(toks, pos, active, pos + 1, tables,
+                                 k, v)
+        toks = np.asarray(lg).argmax(-1).astype(np.int64)
+        streams.append(toks.copy())
+    dt = time.perf_counter() - t0
+    return {
+        "decode_tok_s": round(batch * steps / dt, 1),
+        "kv_pool_bytes": int(total_kv),
+        "per_chip_kv_bytes": int(per_chip_kv),
+        "streams": np.stack(streams, 1),
+    }
+
+
+def _phase_mesh(args):
+    """Sharded vs single-shard decode for ONE replica spanning an
+    ``{'mp': N}`` mesh. The memory claim (per-chip KV = 1/mp of the
+    pool) and the greedy parity are exact on any backend; the tok/s
+    ratio only means something on real multi-chip silicon."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.mesh_utils import build_mesh
+    from paddle_tpu.models import GPTForCausalLM
+    from paddle_tpu.models.gpt import gpt_tiny
+
+    mp = int(args.mesh_mp)
+    paddle.seed(0)
+    cfg = gpt_tiny(num_heads=8, hidden_size=128, num_layers=4,
+                   vocab_size=256, max_seq_len=256, stacked=True,
+                   use_flash_attention=False)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    geom = dict(batch=int(args.mesh_batch), page_size=16,
+                pages_per_seq=8, prefill_len=32,
+                steps=int(args.mesh_steps))
+    single = _mesh_decode_trial(model, None, **geom)
+    sharded = _mesh_decode_trial(model, build_mesh({"mp": mp}), **geom)
+    parity = bool((single.pop("streams")
+                   == sharded.pop("streams")).all())
+    single.pop("per_chip_kv_bytes")      # meaningless without a mesh
+    sharded["per_chip_kv_fraction"] = round(
+        sharded["per_chip_kv_bytes"] / sharded["kv_pool_bytes"], 6)
+    return {
+        "mp": mp,
+        "devices": len(jax.devices()),
+        "model": {"hidden": cfg.hidden_size, "layers": cfg.num_layers,
+                  "heads": cfg.num_heads, "stacked": True},
+        **{k: v for k, v in geom.items()},
+        "single_shard": single,
+        "sharded": sharded,
+        "greedy_parity": parity,
+        "caveats": (
+            "CPU record: the mp 'chips' are XLA virtual partitions of "
+            "ONE host sharing the same cores, so sharded tok/s pays "
+            "partitioning overhead with no extra silicon — the "
+            "committed claims are the per-chip KV split and greedy "
+            "parity, not the tok/s ratio. TPU rows rerun via bench.py "
+            "when a TPU backend is reachable."),
+    }
+
+
+def _run_mesh(args):
+    import jax
+    mp = int(args.mesh_mp)
+    if len(jax.devices()) < mp:
+        # structured skip, same contract as an unreachable backend:
+        # a 1-chip host cannot hold an mp-way replica
+        emit_record(skip_record(
+            f"mesh unavailable: {len(jax.devices())} device(s) < "
+            f"mp={mp}; run under "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={mp} "
+            f"or on a multi-chip backend",
+            metric="serving_tp_decode"), out=args.out)
+        return 0
+    mesh = _phase_mesh(args)
+    record = {
+        "metric": "serving_tp_decode",
+        "skipped": False,
+        "value": mesh["sharded"]["decode_tok_s"],
+        "unit": "tok/s",
+        "vs_baseline": round(
+            mesh["sharded"]["decode_tok_s"]
+            / max(mesh["single_shard"]["decode_tok_s"], 1e-9), 3),
+        "mesh": mesh,
+        "config": {
+            "mesh_mp": mp,
+            "backend": jax.default_backend(),
+            "host_cores": os.cpu_count(),
+        },
+    }
+    emit_record(record, out=args.out)
+    ok = mesh["greedy_parity"] and \
+        abs(mesh["sharded"]["per_chip_kv_fraction"] - 1.0 / mp) < 1e-6
+    return 0 if ok else 1
+
+
 # ------------------------------------------------------------- tracing
 def _phase_trace_accounting(args):
     """Fully-sampled in-process run: every counted request must leave
@@ -568,6 +716,8 @@ def main():
         print(json.dumps(_loadgen_main(json.loads(args.loadgen))))
         return 0
     try:
+        if args.mesh:
+            return _run_mesh(args)
         if args.trace:
             return _run_trace(args)
         return _run(args)
@@ -599,6 +749,17 @@ def _parse_args():
     ap.add_argument("--swap-threads", type=int, default=3)
     ap.add_argument("--skip-scaleout", action="store_true")
     ap.add_argument("--skip-swap", action="store_true")
+    ap.add_argument("--mesh", action="store_true",
+                    help="run the tensor-parallel serving slice "
+                         "instead: sharded vs single-shard decode + "
+                         "per-chip KV residency (BENCH_TP_r*.json)")
+    ap.add_argument("--mesh-mp", type=int, default=8,
+                    help="--mesh: tensor-parallel degree of the one "
+                         "serving replica")
+    ap.add_argument("--mesh-batch", type=int, default=8)
+    ap.add_argument("--mesh-steps", type=int, default=48,
+                    help="--mesh: timed greedy decode steps per "
+                         "variant")
     ap.add_argument("--trace", action="store_true",
                     help="run the tracing phases instead: span-count "
                          "cross-check + sampled-QPS overhead")

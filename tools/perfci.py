@@ -136,6 +136,28 @@ GATES: List[Dict[str, Any]] = [
      "path": ("scale_out", "warm_speedup"),
      "op": "min", "baseline": 2.95, "rel_tol": 0.15, "unit": "x",
      "why": "warm scale-out vs cold replica start (PR 5 compile cache)"},
+    {"name": "tp_decode_tok_s", "metric": "serving_tp_decode",
+     "files": "BENCH_TP_r*.json", "path": ("value",),
+     "op": "min", "baseline": 1359.1, "rel_tol": 0.50,
+     "unit": "tokens/s",
+     "why": "mp-sharded single-replica decode throughput (serving "
+            "mesh). The CPU record's wide envelope guards structure "
+            "(an accidental pool gather, a resharding collective per "
+            "step), not speed — on the 8-way VIRTUAL device mesh the "
+            "shards share one host's cores"},
+    {"name": "tp_per_chip_kv_fraction", "metric": "serving_tp_decode",
+     "files": "BENCH_TP_r*.json",
+     "path": ("mesh", "sharded", "per_chip_kv_fraction"),
+     "op": "max", "baseline": 0.125, "rel_tol": 0.0, "unit": "x",
+     "why": "per-chip KV residency must be exactly 1/mp of the pool "
+            "(heads-sharded layout; measured from the placed shards, "
+            "not projected)"},
+    {"name": "tp_greedy_parity", "metric": "serving_tp_decode",
+     "files": "BENCH_TP_r*.json", "path": ("mesh", "greedy_parity"),
+     "op": "true",
+     "why": "the mp-sharded engine must emit the IDENTICAL greedy "
+            "stream as the single-shard path — tensor parallelism is "
+            "a layout, never a model change"},
     {"name": "trace_accounting", "metric": "fleet_trace_span_accounting",
      "files": "TRACE_r*.json",
      "path": ("accounting", "accounting_consistent"),

@@ -110,7 +110,8 @@ def _train_step_for(model, optimizer, loss_fn, amp_level=None):
 
 
 def _plan_ernie(cfg_factory, target_axes, budget_gib, seq, batch_per_chip,
-                moment_dtype="bfloat16", amp_level="O2"):
+                moment_dtype="bfloat16", amp_level="O2",
+                serving_mp=None):
     """ZeRO-3 ERNIE plan through the unified API: LazyGuard abstract
     params (~0 bytes of host RAM), ``apply_sharding(zero='p_g_os')``
     instead of the manual ``group_sharded_parallel`` wiring, AMP O2 +
@@ -165,9 +166,24 @@ def _plan_ernie(cfg_factory, target_axes, budget_gib, seq, batch_per_chip,
         return dict(model=model, step=step, batch=batch,
                     predict_lowered=predict_lowered, specs=specs)
 
+    serving = None
+    if serving_mp:
+        # encoder-only (no cached decode), so the serving rows are
+        # analytic: weight bytes through the name rules + KV geometry
+        # from the config (what an mp-replica serving this family's
+        # decoder variant would hold per chip)
+        cfg = cfg_factory()
+        serving = dict(
+            axes={"mp": int(serving_mp)},
+            geom=dict(num_layers=int(cfg.num_layers),
+                      num_heads=int(cfg.num_heads),
+                      head_dim=int(cfg.hidden_size) // int(cfg.num_heads),
+                      max_seq_len=int(cfg.max_position_embeddings)))
+
     return dict(build=build, target_axes=dict(target_axes),
                 budget_gib=budget_gib,
-                mesh_axes={k: v for k, v in target_axes.items()})
+                mesh_axes={k: v for k, v in target_axes.items()},
+                serving=serving)
 
 
 def plan_ernie10b():
@@ -177,7 +193,8 @@ def plan_ernie10b():
                               attention_probs_dropout_prob=0.0,
                               recompute=True),
         target_axes={"sharding": 64},   # v5e-64
-        budget_gib=15.75, seq=1024, batch_per_chip=1)
+        budget_gib=15.75, seq=1024, batch_per_chip=1,
+        serving_mp=8)                   # one v5e-8 serving replica
 
 
 def plan_ernie_tiny():
@@ -188,7 +205,8 @@ def plan_ernie_tiny():
     return _plan_ernie(
         lambda: ernie_tiny(),
         target_axes={"sharding": 8},
-        budget_gib=None, seq=32, batch_per_chip=1)
+        budget_gib=None, seq=32, batch_per_chip=1,
+        serving_mp=4)
 
 
 def plan_gpt_tiny_tp():
@@ -217,8 +235,12 @@ def plan_gpt_tiny_tp():
         return dict(model=model, step=step, batch=batch,
                     predict_lowered=None, specs=specs)
 
+    # gpt_tiny has the cached-decode contract, so its serving section
+    # ALSO AOT-compiles the sharded prefill+decode executables (the
+    # tier-1 serving gate; ernie plans only get the analytic rows)
     return dict(build=build, target_axes={"dp": 2, "mp": 4},
-                budget_gib=None, mesh_axes={"dp": 2, "mp": 4})
+                budget_gib=None, mesh_axes={"dp": 2, "mp": 4},
+                serving=dict(axes={"mp": 4}))
 
 
 PLANS = {
@@ -274,6 +296,120 @@ def _kv_projection(model, page_size: int = 16, max_batch: int = 8):
             "dtypes": dtypes,
             # per-token shrink 4/(1+4/D): 3.76x at D=64
             "int8_bytes_ratio": round(float(ratio), 4)}
+
+
+def _serving_aot(model, serving_axes, page_size: int, max_batch: int):
+    """AOT-compile the SHARDED prefill + decode executables exactly as
+    the serving engine builds them — a ``CachedDecoder`` bound to a
+    live ``{'mp': N}`` ``ServingMesh`` (serving/mesh.py), pools placed
+    heads-sharded, weights placed by the spec tree — and return their
+    per-chip memory plans. A spec tree that stops partitioning or a
+    decode graph that stops compiling under a live mesh fails HERE at
+    compile time, with no TPU attached. Uses the pure-JAX kernel path
+    (the shadow-verification oracle): that is the canonical GSPMD
+    partitioning the Pallas shard_map dispatch must agree with."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.serving.generation.model_fns import CachedDecoder
+    from paddle_tpu.serving.mesh import ServingMesh
+
+    mesh = local_mesh(dict(serving_axes))
+    smesh = ServingMesh(mesh)
+    if not smesh.live:
+        return None      # axes collapsed to 1 device — nothing to gate
+    pages_per_seq = 2
+    dec = CachedDecoder(model, max_batch=max_batch, page_size=page_size,
+                        pages_per_seq=pages_per_seq, donate=False,
+                        use_pallas=False, mesh=smesh)
+    k, v = model.init_kv_pools(1 + max_batch * pages_per_seq, page_size)
+    k, v = smesh.place_pools(k, v)
+    b, s = max_batch, page_size
+    ids = jnp.zeros((b, s), dtype=jnp.int32)
+    plens = jnp.full((b,), s, dtype=jnp.int32)
+    tables = jnp.zeros((b, pages_per_seq), dtype=jnp.int32)
+    prefill = dec._prefill_jit.lower(
+        dec._params, dec._buffers, ids, plens, tables, k, v).compile()
+    tokens = jnp.zeros((b,), dtype=jnp.int32)
+    positions = jnp.full((b,), s, dtype=jnp.int32)
+    active = jnp.ones((b,), dtype=bool)
+    ctx = jnp.full((b,), s + 1, dtype=jnp.int32)
+    decode = dec._decode_jit.lower(
+        dec._params, dec._buffers, tokens, positions, active, ctx,
+        tables, k, v).compile()
+    out = {}
+    for site, comp in (("prefill", prefill), ("decode", decode)):
+        ma = comp.memory_analysis()
+        out[site] = {"args_bytes": int(ma.argument_size_in_bytes),
+                     "temp_bytes": int(ma.temp_size_in_bytes)}
+    out["n_chips_compiled"] = int(mesh.devices.size)
+    out["mesh_axes"] = {a: int(d) for a, d in mesh.shape.items()}
+    return out
+
+
+def _serving_record(model, serving_axes: dict, geom=None,
+                    page_size: int = 16, max_batch: int = 8):
+    """Tensor-parallel SERVING projection at the replica's mesh degree
+    (serving/mesh.py: fleet replica = mesh): per-chip weight bytes
+    through the serving rule tables — the same name-based specs
+    ``Predictor.attach_serving_mesh`` places by, NOT the training
+    plan's ZeRO overrides — plus per-chip heads-sharded KV-pool bytes
+    per supported ``FLAGS_decode_kv_dtype`` (the per-dtype projection
+    above composed with the ``heads/mp`` split; host-side page
+    bookkeeping is layout-agnostic, only device bytes divide). Models
+    with the cached-decode contract additionally AOT-compile the
+    sharded prefill + decode entry points (``_serving_aot``).
+
+    ``geom`` supplies {num_layers, num_heads, head_dim, max_seq_len}
+    for encoder-only models (ernie10b) that have no
+    ``kv_cache_spec()``; their serving rows are analytic."""
+    from paddle_tpu.distributed import shard
+    from paddle_tpu.ops.paged_attention import kv_pool_bytes
+    from paddle_tpu.serving.generation.model_fns import \
+        supports_cached_decode
+
+    mp = int(serving_axes.get("mp", 1))
+    if geom is None:
+        spec = model.kv_cache_spec()
+        geom = {key: int(spec[key]) for key in
+                ("num_layers", "num_heads", "head_dim", "max_seq_len")}
+    nh, hd = geom["num_heads"], geom["head_dim"]
+    heads_ok = mp <= 1 or nh % mp == 0
+
+    rules = shard.default_rules()
+    named = dict(model.named_parameters())
+    specs = {n: rules.spec_for(n, tuple(p.shape))
+             for n, p in named.items()}
+    proj = shard.projected_bytes_per_chip(named, specs, serving_axes)
+
+    pages_per_seq = -(-geom["max_seq_len"] // page_size)
+    f32_tok = kv_pool_bytes(1, 1, nh, hd, None)
+    per_dtype = {}
+    for dt in ("float32", "bfloat16", "int8"):
+        tok = kv_pool_bytes(1, 1, nh, hd, dt)
+        factor = max(1, min(2, f32_tok // max(tok, 1)))
+        num_pages = 1 + max_batch * pages_per_seq * factor
+        pool = geom["num_layers"] * 2 * kv_pool_bytes(
+            num_pages, page_size, nh, hd, dt)
+        per_dtype[dt] = {
+            "pool_bytes": int(pool),
+            "per_chip_pool_bytes":
+                int(pool // mp) if heads_ok and mp > 1 else int(pool),
+        }
+    rec = {
+        "serving_axes": dict(serving_axes),
+        "heads_shardable": bool(heads_ok),
+        "num_heads": int(nh),
+        "page_size": int(page_size),
+        "max_batch": int(max_batch),
+        "weights_per_chip_bytes": int(proj["total_bytes"]),
+        "weights_spec_hash": shard.spec_tree_hash(specs),
+        "kv_per_chip": per_dtype,
+        "aot": None,
+    }
+    if supports_cached_decode(model) and heads_ok and mp > 1:
+        rec["aot"] = _serving_aot(model, serving_axes, page_size,
+                                  max_batch)
+    return rec
 
 
 def _mesh_kind(mesh) -> str:
@@ -376,6 +512,15 @@ def run_plan(name: str, tpu_topology: str = "") -> dict:
             "kv_projection": _kv_projection(model),
         }
         rec.update(_sharding_counts(specs, named, plan["target_axes"]))
+        serving = plan.get("serving")
+        if serving:
+            # the serving path threads its mesh EXPLICITLY (engine
+            # worker threads never see the thread-local global mesh) —
+            # clear the training mesh first so the compile below sees
+            # exactly what the engine sees
+            set_global_mesh(None)
+            rec["serving"] = _serving_record(model, serving["axes"],
+                                             geom=serving.get("geom"))
         return rec
     finally:
         set_global_mesh(None)
@@ -438,6 +583,37 @@ def gate_record(rec: dict, base: dict) -> list:
                 f"int8 per-token shrink regressed: "
                 f"{kv['int8_bytes_ratio']}x vs baseline "
                 f"{bkv['int8_bytes_ratio']}x")
+    srv, bsrv = rec.get("serving"), base.get("serving")
+    if srv is not None and bsrv is not None:
+        _within(srv["weights_per_chip_bytes"],
+                bsrv["weights_per_chip_bytes"],
+                "serving per-chip weight bytes")
+        for dt in ("float32", "int8"):
+            _within(srv["kv_per_chip"][dt]["per_chip_pool_bytes"],
+                    bsrv["kv_per_chip"][dt]["per_chip_pool_bytes"],
+                    f"serving per-chip {dt} KV pool bytes")
+        if bsrv.get("heads_shardable") and not srv.get("heads_shardable"):
+            fails.append(
+                f"serving heads axis no longer shardable: "
+                f"{srv['num_heads']} heads do not divide "
+                f"mp={srv['serving_axes'].get('mp')}")
+        if srv["weights_spec_hash"] != bsrv["weights_spec_hash"]:
+            fails.append(
+                f"serving weight spec tree changed (hash "
+                f"{srv['weights_spec_hash'][:12]} vs baseline "
+                f"{bsrv['weights_spec_hash'][:12]}) — review the "
+                f"rule-table change, then --write-baseline")
+        if bsrv.get("aot") is not None:
+            if srv.get("aot") is None:
+                fails.append(
+                    "sharded serving prefill+decode no longer "
+                    "AOT-compile (baseline has an aot record)")
+            else:
+                for site in ("prefill", "decode"):
+                    _within(srv["aot"][site]["args_bytes"],
+                            bsrv["aot"][site]["args_bytes"],
+                            f"sharded serving {site} per-chip "
+                            f"argument bytes")
     return fails
 
 
@@ -538,6 +714,15 @@ def main(argv=None) -> int:
               + (f" (budget {rec['budget_gib']} GiB)"
                  if rec["budget_gib"] else "")
               + f", specs {rec['spec_tree_hash'][:12]}")
+        srv = rec.get("serving")
+        if srv:
+            i8 = srv["kv_per_chip"]["int8"]["per_chip_pool_bytes"]
+            print(f"shardcheck[{name}]: serving "
+                  f"mp={srv['serving_axes'].get('mp')}: weights "
+                  f"{srv['weights_per_chip_bytes'] / GIB:.4f} GiB/chip, "
+                  f"int8 KV {i8 / GIB:.4f} GiB/chip"
+                  + (", sharded prefill+decode compiled"
+                     if srv.get("aot") else ""))
     for name, fs in sorted(failures.items()):
         for f_ in fs:
             print(f"shardcheck[{name}]: FAIL: {f_}", file=sys.stderr)
